@@ -1,0 +1,67 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's ResNet-50 fp32 training on 1×V100, bs=64
+≈ 343 img/s (BASELINE.md; docs perf.md:253).  The full SPMD step
+(fwd+bwd+optimizer, one XLA executable) runs on whatever jax.devices()
+provides — the real TPU under the driver.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as onp
+
+BASELINE_IMG_S = 343.0
+BATCH = 64
+IMAGE = 224
+STEPS = 20
+WARMUP = 3
+
+
+def main():
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+    from mxnet_tpu.ndarray import NDArray
+
+    net = get_resnet(1, 50, classes=1000)
+    net.initialize(init=mx.initializer.Xavier())
+    # finish deferred init
+    net(NDArray(onp.zeros((1, 3, IMAGE, IMAGE), onp.float32)))
+
+    mesh = make_mesh({"dp": -1})
+    trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                          optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.05,
+                                            "momentum": 0.9, "wd": 1e-4},
+                          mesh=mesh)
+
+    rng = onp.random.RandomState(0)
+    data = rng.randn(BATCH, 3, IMAGE, IMAGE).astype("float32")
+    label = rng.randint(0, 1000, size=(BATCH,)).astype("float32")
+
+    for _ in range(WARMUP):
+        loss = trainer.step(data, label)
+    loss.wait_to_read()
+
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        loss = trainer.step(data, label)
+    loss.wait_to_read()
+    dt = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / dt
+    print(json.dumps({
+        "metric": "resnet50_train_fp32_bs64_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,39 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with the capability
+surface of Apache MXNet (see SURVEY.md at the repo root).
+
+Import as ``import mxnet_tpu as mx``; the namespaces mirror the
+reference: ``mx.nd``, ``mx.np``, ``mx.autograd``, ``mx.gluon``,
+``mx.optimizer``, ``mx.kv``, ``mx.context``.
+"""
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import (Context, cpu, tpu, gpu, cpu_pinned, current_context,
+                      num_gpus, num_tpus)
+from . import base
+from . import context
+from . import engine
+from . import autograd
+from . import ops
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import random  # noqa: E402
+from . import initializer  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import lr_scheduler  # noqa: E402
+from . import gluon  # noqa: E402
+from . import kvstore  # noqa: E402
+from . import kvstore as kv  # noqa: E402
+from . import numpy  # noqa: E402
+from . import numpy as np  # noqa: E402
+from . import numpy_extension as npx  # noqa: E402
+from . import parallel  # noqa: E402
+from . import profiler  # noqa: E402
+from . import amp  # noqa: E402
+from . import test_utils  # noqa: E402
+from . import util  # noqa: E402
+from .util import is_np_array, set_np, reset_np  # noqa: E402
+from . import runtime  # noqa: E402
+from . import io  # noqa: E402
+from . import image  # noqa: E402

@@ -1,0 +1,33 @@
+"""NumPy dispatch protocol support.
+
+Parity: python/mxnet/numpy_dispatch_protocol.py — makes
+``numpy.mean(mx.np.array(...))`` etc. dispatch to our implementations via
+__array_function__/__array_ufunc__ on the ndarray type.
+"""
+from __future__ import annotations
+
+_module_funcs = {}
+
+
+def set_module_funcs(ns: dict) -> None:
+    for k, v in ns.items():
+        if callable(v) and not k.startswith("_"):
+            _module_funcs[k] = v
+    _install()
+
+
+def _install():
+    from .numpy import ndarray
+
+    def __array_function__(self, func, types, args, kwargs):
+        name = func.__name__
+        ours = _module_funcs.get(name)
+        if ours is None:
+            # fallback: evaluate on host numpy (parity: numpy/fallback.py)
+            import numpy as onp
+            new_args = [a.asnumpy() if isinstance(a, ndarray) else a
+                        for a in args]
+            return func(*new_args, **kwargs)
+        return ours(*args, **kwargs)
+
+    ndarray.__array_function__ = __array_function__

@@ -1,0 +1,15 @@
+"""Automatic Mixed Precision.
+
+Parity: python/mxnet/contrib/amp/ (amp.py init/init_trainer/convert_*,
+loss_scaler.py, lists/symbol_fp16.py) over the amp_cast ops and
+low_precision_pass.cc.  TPU-first: the target dtype is bfloat16 — same
+exponent range as fp32, so loss scaling is a no-op by default — but the
+full dynamic LossScaler is provided for float16 parity.
+"""
+from .amp import (init, init_trainer, scale_loss, unscale, convert_model,
+                  convert_hybrid_block)
+from .loss_scaler import LossScaler
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
+           "convert_hybrid_block", "LossScaler", "lists"]

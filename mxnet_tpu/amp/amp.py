@@ -1,0 +1,155 @@
+"""AMP core.
+
+Parity: python/mxnet/contrib/amp/amp.py (init :282, init_trainer :322,
+convert_model :548, convert_hybrid_block :633).  ``init`` patches the op
+registry so MXU-bound ops (conv/FC/matmul) compute in the target dtype
+with amp_cast insertions at their inputs — the imperative analogue of the
+reference's monkeypatching; graph-mode conversion casts parameters and
+wraps the block.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..ops import registry as _reg
+from . import lists
+from .loss_scaler import LossScaler
+
+_initialized = False
+_target_dtype = None
+_orig_fns = {}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable AMP globally (parity: amp.init).
+
+    Wraps the registered compute fn of every TARGET_DTYPE_OP so inputs are
+    cast to ``target_dtype`` (amp_cast) and outputs stay in low precision;
+    FP32_OPS get their inputs cast up.
+    """
+    global _initialized, _target_dtype
+    if _initialized:
+        return
+    dt = np_dtype(target_dtype)
+    _target_dtype = dt
+    low_ops = list(target_precision_ops or lists.TARGET_DTYPE_OPS)
+    fp32 = list(fp32_ops or lists.FP32_OPS)
+
+    def wrap_low(fn):
+        @functools.wraps(fn)
+        def wrapped(*arrays, **params):
+            cast = [a.astype(dt) if hasattr(a, "dtype")
+                    and onp.dtype(a.dtype) == onp.float32 else a
+                    for a in arrays]
+            return fn(*cast, **params)
+        return wrapped
+
+    def wrap_fp32(fn):
+        @functools.wraps(fn)
+        def wrapped(*arrays, **params):
+            cast = [a.astype(jnp.float32) if hasattr(a, "dtype")
+                    and onp.dtype(a.dtype) == dt else a for a in arrays]
+            return fn(*cast, **params)
+        return wrapped
+
+    for name in low_ops:
+        try:
+            op = _reg.get(name)
+        except MXNetError:
+            continue
+        if name not in _orig_fns:
+            _orig_fns[name] = op.fn
+            op.fn = wrap_low(op.fn)
+    for name in fp32:
+        try:
+            op = _reg.get(name)
+        except MXNetError:
+            continue
+        if name not in _orig_fns:
+            _orig_fns[name] = op.fn
+            op.fn = wrap_fp32(op.fn)
+    _initialized = True
+
+
+def reset():
+    """Undo init() (test helper; the reference has no un-init)."""
+    global _initialized, _target_dtype
+    for name, fn in _orig_fns.items():
+        _reg.get(name).fn = fn
+    _orig_fns.clear()
+    _initialized = False
+    _target_dtype = None
+
+
+def init_trainer(trainer):
+    """Attach a dynamic LossScaler to a Trainer (parity: amp.init_trainer)."""
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled:`` context."""
+
+    def __init__(self, loss, trainer):
+        self._trainer = trainer
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        if scaler is None:
+            init_trainer(trainer)
+            scaler = trainer._amp_loss_scaler
+        self._scaler = scaler
+        trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+        if isinstance(loss, (list, tuple)):
+            self._scaled = [l * scaler.loss_scale for l in loss]
+        else:
+            self._scaled = loss * scaler.loss_scale
+
+    def __enter__(self):
+        return self._scaled
+
+    def __exit__(self, *exc):
+        scaler = self._scaler
+        overflow = scaler.has_overflow(self._trainer._params)
+        scaler.update_scale(overflow)
+        if overflow:  # zero grads so the step is a no-op
+            for p in self._trainer._params:
+                if p._grad is not None:
+                    p.zero_grad()
+        return False
+
+
+def unscale(trainer):
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    for p in trainer._params:
+        if p._grad is not None:
+            p._grad._rebind(p._grad._data / scaler.loss_scale)
+
+
+def convert_model(net, target_dtype="bfloat16", cast_params=True):
+    """Cast a model for low-precision inference (parity: convert_model)."""
+    dt = np_dtype(target_dtype)
+    if cast_params:
+        for p in net.collect_params().values():
+            if p._data is not None and p.dtype == onp.float32:
+                p.cast(dt)
+    return net
+
+
+def convert_hybrid_block(block, target_dtype="bfloat16", cast_params=False):
+    """Parity: amp.convert_hybrid_block — here the block is wrapped so
+    inputs are cast to the target dtype and outputs back to fp32; the
+    heavy lifting (keeping sensitive ops fp32) comes from the patched
+    registry (init)."""
+    init(target_dtype)
+    if cast_params:
+        convert_model(block, target_dtype)
+    return block

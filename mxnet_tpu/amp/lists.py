@@ -1,0 +1,28 @@
+"""AMP op lists.
+
+Parity: python/mxnet/contrib/amp/lists/symbol_fp16.py / symbol_bf16.py —
+which ops run in low precision (MXU-bound), which stay fp32
+(numerically sensitive), which follow their inputs.
+"""
+
+# matmul/conv-class ops: always worth low precision on the MXU
+FP16_FP32_FUNCS = TARGET_DTYPE_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "dot", "batch_dot",
+    "matmul",
+]
+
+# numerically sensitive: keep fp32
+FP32_FUNCS = FP32_OPS = [
+    "softmax", "log_softmax", "softmax_cross_entropy", "SoftmaxOutput",
+    "BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm", "LRN", "RMSNorm",
+    "norm", "mean", "sum", "exp", "log", "erfinv", "CTCLoss",
+]
+
+# follow the widest input dtype
+WIDEST_TYPE_CASTS = CONDITIONAL_FP32_FUNCS = [
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "concat", "stack", "where",
+]
+
+BF16 = "bfloat16"
+FP16 = "float16"

@@ -1,0 +1,41 @@
+"""Dynamic loss scaler.
+
+Parity: python/mxnet/contrib/amp/loss_scaler.py:26 — scale up every
+`scale_window` clean steps, halve on overflow, skip the update that
+overflowed.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+__all__ = ["LossScaler"]
+
+
+class LossScaler:
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
+                 scale_window=2000):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        """Check grads for inf/nan (parity: LossScaler.has_overflow)."""
+        import jax.numpy as jnp
+        for p in params:
+            g = getattr(p, "_grad", None)
+            if g is None:
+                continue
+            if not bool(jnp.isfinite(g._data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0

@@ -1,0 +1,424 @@
+"""Imperative autograd: record/replay tape over ``jax.vjp``.
+
+TPU-native re-expression of the reference's autograd
+(``src/imperative/imperative.cc:204 RecordOp``, ``:377 Backward``;
+Python surface ``python/mxnet/autograd.py:120-513``).  While recording,
+every op invocation appends an ``_OpRecord`` (the op's pure jax function,
+its input arrays, and graph nodes for inputs/outputs).  ``backward``
+walks the tape in reverse, computing per-op cotangents with ``jax.vjp``
+(forward is rematerialized — the TPU-friendly trade of FLOPs for HBM),
+honoring ``grad_req`` write/add/null semantics (parity: OpReqType
+kWriteTo/kAddTo, include/mxnet/op_attr_types.h:46-58).
+
+``create_graph=True`` records every backward vjp as a tape op with node
+linkage back to the forward inputs, so second-order gradients work
+(parity: tests/python/unittest/test_higher_order_grad.py).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = [
+    "record", "pause", "train_mode", "predict_mode",
+    "is_recording", "is_training", "set_recording", "set_training",
+    "mark_variables", "backward", "grad", "Function",
+]
+
+# --------------------------------------------------------------------------
+# thread-local recording state (parity: Imperative thread-local is_train /
+# is_recording flags, include/mxnet/imperative.h)
+# --------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+        _state.tape = []
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    old, st.recording = st.recording, bool(flag)
+    return old
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    old, st.training = st.training, bool(flag)
+    return old
+
+
+class _Scope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec, self._train = recording, training
+
+    def __enter__(self):
+        if self._rec is not None:
+            self._old_rec = set_recording(self._rec)
+        if self._train is not None:
+            self._old_train = set_training(self._train)
+        return self
+
+    def __exit__(self, *exc):
+        if self._rec is not None:
+            set_recording(self._old_rec)
+        if self._train is not None:
+            set_training(self._old_train)
+        return False
+
+
+def record(train_mode: bool = True) -> _Scope:
+    """``with autograd.record():`` — turn on recording (+train mode)."""
+    return _Scope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _Scope:
+    """``with autograd.pause():`` — turn off recording inside a record scope."""
+    return _Scope(False, train_mode)
+
+
+def train_mode() -> _Scope:
+    return _Scope(None, True)
+
+
+def predict_mode() -> _Scope:
+    return _Scope(None, False)
+
+
+# --------------------------------------------------------------------------
+# tape structure
+# --------------------------------------------------------------------------
+
+class _Node:
+    """One version of an NDArray in the autograd graph (parity: AGInfo,
+    include/mxnet/imperative.h:53)."""
+
+    __slots__ = ("grad_array", "grad_req", "out_grad", "producer", "__weakref__")
+
+    def __init__(self):
+        self.grad_array = None      # NDArray sink (set by attach_grad)
+        self.grad_req = "null"
+        self.out_grad = None        # cotangent: jax array, or NDArray if create_graph
+        self.producer = None        # _OpRecord that produced this node
+
+
+class _OpRecord:
+    __slots__ = ("fn", "saved_inputs", "in_nodes", "out_nodes", "multi_out",
+                 "consumed")
+
+    def __init__(self, fn, saved_inputs, in_nodes, out_nodes, multi_out):
+        self.fn = fn
+        self.saved_inputs = saved_inputs
+        self.in_nodes = in_nodes
+        self.out_nodes = out_nodes
+        self.multi_out = multi_out
+        self.consumed = False
+
+
+def _tape() -> List[_OpRecord]:
+    return _st().tape
+
+
+def _record(fn, in_nodes, saved_inputs, out_nodes, multi_out):
+    rec = _OpRecord(fn, saved_inputs, in_nodes, out_nodes, multi_out)
+    for n in out_nodes:
+        n.producer = rec
+    _tape().append(rec)
+    return rec
+
+
+def record_apply(fn: Callable, nd_inputs: Sequence[Any], nd_outputs: Sequence[Any],
+                 multi_out: bool) -> None:
+    """Append one executed op to the tape.
+
+    ``fn(*arrays)`` must be the pure jax function that produced
+    ``nd_outputs``'s arrays from ``nd_inputs``'s arrays.  Called by the op
+    registry when recording is on (parity: Imperative::RecordOp).
+    """
+    _record(fn, [x._ensure_node() for x in nd_inputs],
+            [x._data for x in nd_inputs],
+            [o._new_node() for o in nd_outputs], multi_out)
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach gradient buffers to arrays (parity: autograd.mark_variables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        node = var._ensure_node()
+        node.grad_array = g
+        node.grad_req = req
+
+
+# --------------------------------------------------------------------------
+# backward
+# --------------------------------------------------------------------------
+
+def _ct_data(g):
+    """Raw jax array of a cotangent that may be an NDArray."""
+    return g._data if hasattr(g, "_data") else g
+
+
+def backward(heads, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True, create_graph: bool = False,
+             _collect_nodes=None):
+    """Run backward from ``heads`` (parity: Imperative::Backward,
+    python/mxnet/autograd.py:244).  ``_collect_nodes`` is the internal
+    channel used by :func:`grad` to read cotangents of specific nodes."""
+    from .ndarray import NDArray  # late import (cycle)
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+        if head_grads is not None and not isinstance(head_grads, (list, tuple)):
+            head_grads = [head_grads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # Seed output cotangents.
+    head_nodes = []
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, "_node", None)
+        if node is None:
+            continue
+        seed = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        if create_graph:
+            seed = NDArray(seed) if hg is None else hg
+        _accumulate(node, seed, create_graph)
+        head_nodes.append(node)
+    if not head_nodes:
+        raise MXNetError("backward: none of the heads is in a recorded graph; "
+                         "run the computation inside autograd.record()")
+
+    tape = _tape()
+    # Mark the subgraph reachable backwards from heads.
+    needed = set()
+    frontier = list(head_nodes)
+    seen_nodes = set()
+    while frontier:
+        node = frontier.pop()
+        if id(node) in seen_nodes:
+            continue
+        seen_nodes.add(id(node))
+        rec = node.producer
+        if rec is not None and id(rec) not in needed:
+            needed.add(id(rec))
+            frontier.extend(rec.in_nodes)
+
+    touched = list(head_nodes)
+    with _Scope(None, train_mode):
+        for rec in reversed(tape):
+            if id(rec) not in needed:
+                continue
+            out_grads = [n.out_grad for n in rec.out_nodes]
+            if all(g is None for g in out_grads):
+                continue
+            _apply_vjp(rec, out_grads, create_graph)
+            touched.extend(rec.in_nodes)
+            touched.extend(rec.out_nodes)
+            if not retain_graph:
+                rec.consumed = True
+
+    # Hand requested cotangents to grad() before they are cleared.
+    collected = None
+    if _collect_nodes is not None:
+        collected = [n.out_grad for n in _collect_nodes]
+
+    # Deliver accumulated grads into attached buffers (write/add semantics),
+    # then clear cotangents — grads persist only in grad buffers, matching
+    # the reference (AGInfo out_grads freed after Backward).
+    seen = set()
+    for node in touched:
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        if node.grad_array is not None and node.out_grad is not None \
+                and node.grad_req != "null":
+            buf = node.grad_array
+            g = _ct_data(node.out_grad)
+            if node.grad_req == "add":
+                buf._data = buf._data + g
+            else:
+                buf._data = g
+        node.out_grad = None
+
+    if not retain_graph:
+        _st().tape = [r for r in tape if not r.consumed]
+    return collected
+
+
+def _apply_vjp(rec: _OpRecord, out_grads, create_graph: bool):
+    """Compute input cotangents for one record and accumulate into in_nodes."""
+    from .ndarray import NDArray
+
+    fn, saved = rec.fn, rec.saved_inputs
+    out_specs = None
+    filled = []
+    for i, g in enumerate(out_grads):
+        if g is None:
+            if out_specs is None:
+                out_specs = jax.eval_shape(fn, *saved)
+                if not rec.multi_out:
+                    out_specs = (out_specs,)
+            z = jnp.zeros(out_specs[i].shape, out_specs[i].dtype)
+            filled.append(NDArray(z) if create_graph else z)
+        else:
+            filled.append(g)
+
+    n_in = len(saved)
+
+    def bwd(*args):
+        ins = args[:n_in]
+        cts = args[n_in:]
+        _, vjp_fn = jax.vjp(fn, *ins)
+        ct = tuple(cts) if rec.multi_out else cts[0]
+        return vjp_fn(ct)
+
+    if create_graph:
+        ct_nodes = [g._ensure_node() for g in filled]
+        args = list(saved) + [g._data for g in filled]
+        with _Scope(False, None):
+            out_arrays = bwd(*args)
+        out_nd = [NDArray(a) for a in out_arrays]
+        _record(bwd, list(rec.in_nodes) + ct_nodes, args,
+                [o._new_node() for o in out_nd], True)
+        for node, nd in zip(rec.in_nodes, out_nd):
+            _accumulate(node, nd, True)
+    else:
+        grads = bwd(*saved, *[_ct_data(g) for g in filled])
+        for node, g in zip(rec.in_nodes, grads):
+            _accumulate(node, g, False)
+
+
+def _accumulate(node: _Node, g, create_graph: bool):
+    if node.out_grad is None:
+        node.out_grad = g
+    elif create_graph:
+        node.out_grad = _recorded_add(node.out_grad, g)
+    else:
+        node.out_grad = node.out_grad + g
+
+
+def _recorded_add(a, b):
+    """a + b where both are NDArrays, recorded on the tape for 2nd order."""
+    from .ndarray import NDArray
+
+    fn = lambda x, y: x + y
+    out = NDArray(a._data + b._data)
+    _record(fn, [a._ensure_node(), b._ensure_node()], [a._data, b._data],
+            [out._new_node()], False)
+    return out
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Return gradients of heads w.r.t. variables without touching ``.grad``
+    buffers (parity: autograd.grad, python/mxnet/autograd.py:303)."""
+    from .ndarray import NDArray
+
+    single = isinstance(variables, NDArray)
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if single:
+        variables = [variables]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    var_nodes = [v._ensure_node() for v in variables]
+    saved = [(n.grad_array, n.grad_req, n.out_grad) for n in var_nodes]
+    for n in var_nodes:
+        n.grad_array, n.grad_req, n.out_grad = None, "null", None
+
+    collected = backward(heads, head_grads, retain_graph=retain_graph,
+                         train_mode=train_mode, create_graph=create_graph,
+                         _collect_nodes=var_nodes)
+
+    results = []
+    for v, n, g, (ga, gr, og) in zip(variables, var_nodes, collected, saved):
+        if g is None:
+            raise MXNetError("one of the variables is not differentiably "
+                             "connected to the heads")
+        out = g if isinstance(g, NDArray) else NDArray(g)
+        results.append(out)
+        n.grad_array, n.grad_req, n.out_grad = ga, gr, og
+    return results if not single else results[0] if len(results) == 1 else results
+
+
+# --------------------------------------------------------------------------
+# custom Function (parity: mx.autograd.Function, autograd.py:399-513)
+# --------------------------------------------------------------------------
+
+class Function:
+    """User-defined differentiable function.
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)``; call the instance on NDArrays.
+    Parity: python/mxnet/autograd.py:399 (Function), executed in the
+    reference by the custom-op worker pool (src/operator/custom/).
+    """
+
+    def __init__(self):
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray
+
+        with _Scope(False, None):
+            outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (list, tuple))
+        outs = list(outputs) if multi else [outputs]
+
+        if is_recording():
+            func = self
+
+            def run_fwd(*arrays):
+                nd_in = [NDArray(a) for a in arrays]
+                with _Scope(False, None):
+                    o = func.forward(*nd_in)
+                o = o if isinstance(o, (list, tuple)) else [o]
+                res = tuple(x._data for x in o)
+                return res if multi else res[0]
+
+            @jax.custom_vjp
+            def fn_cv(*arrays):
+                return run_fwd(*arrays)
+
+            def fn_fwd(*arrays):
+                return run_fwd(*arrays), None
+
+            def fn_bwd(res, cts):
+                nd_cts = [NDArray(c) for c in (cts if multi else (cts,))]
+                with _Scope(False, None):
+                    gin = func.backward(*nd_cts)
+                gin = gin if isinstance(gin, (list, tuple)) else [gin]
+                return tuple(g._data for g in gin)
+
+            fn_cv.defvjp(fn_fwd, fn_bwd)
+            record_apply(fn_cv, list(inputs), outs, multi_out=multi)
+        return outputs

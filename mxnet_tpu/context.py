@@ -1,0 +1,160 @@
+"""Device model.
+
+TPU-native re-expression of the reference's ``Context``
+(``include/mxnet/base.h:90-116``): a (device_type, device_id) pair plus a
+thread-local "current context" stack.  Device types are ``cpu`` and ``tpu``
+(``gpu`` is accepted as an alias for the accelerator so reference-era user
+code keeps working).  A Context resolves to a concrete ``jax.Device``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "tpu", "gpu", "cpu_pinned", "current_context",
+           "num_gpus", "num_tpus", "default_device"]
+
+_thread_local = threading.local()
+
+
+class Context:
+    """A device context. ``Context('tpu', 0)`` or ``Context('cpu')``.
+
+    Parity: ``Context`` in include/mxnet/base.h:90.  ``kCPUPinned`` /
+    ``kCPUShared`` collapse into plain ``cpu`` — host staging and shared
+    memory are handled by jax/XLA transfer machinery.
+    """
+
+    devtype2str = {1: "cpu", 2: "tpu", 3: "cpu_pinned", 5: "tpu"}
+    devstr2type = {"cpu": 1, "tpu": 2, "gpu": 2, "cpu_pinned": 3}
+
+    __slots__ = ("device_type", "device_id")
+
+    def __init__(self, device_type: str, device_id: int = 0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        if device_type not in self.devstr2type:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        # canonicalize gpu → tpu (accelerator)
+        self.device_type = "tpu" if device_type == "gpu" else device_type
+        self.device_id = int(device_id)
+
+    # -- identity ----------------------------------------------------------
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    __str__ = __repr__
+
+    # -- resolution --------------------------------------------------------
+    @property
+    def jax_device(self) -> jax.Device:
+        """Concrete jax.Device backing this context."""
+        kind = "cpu" if self.device_type.startswith("cpu") else None
+        if kind == "cpu":
+            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+        else:
+            devs = _accelerator_devices()
+        if not devs:
+            raise MXNetError(f"no devices for context {self}")
+        if self.device_id >= len(devs):
+            raise MXNetError(f"device_id {self.device_id} out of range for {self.device_type} "
+                             f"({len(devs)} visible)")
+        return devs[self.device_id]
+
+    @classmethod
+    def from_string(cls, s: str) -> "Context":
+        """Parse 'tpu(0)' / 'cpu' / 'gpu(1)' (parity: Context::FromString)."""
+        s = s.strip()
+        if "(" in s:
+            name, rest = s.split("(", 1)
+            return cls(name.strip(), int(rest.rstrip(")")))
+        return cls(s)
+
+    # -- context stack -----------------------------------------------------
+    def __enter__(self):
+        stack = _ctx_stack()
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _ctx_stack().pop()
+        return False
+
+
+def _has_platform(name: str) -> bool:
+    try:
+        jax.devices(name)
+        return True
+    except RuntimeError:
+        return False
+
+
+def _accelerator_devices():
+    """All non-CPU devices; falls back to CPU when running host-only tests."""
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return devs if devs else jax.devices()
+
+
+def _ctx_stack() -> List[Context]:
+    if not hasattr(_thread_local, "stack"):
+        _thread_local.stack = []
+    return _thread_local.stack
+
+
+def current_context() -> Context:
+    """Innermost ``with ctx:`` context, else the process default device."""
+    stack = _ctx_stack()
+    if stack:
+        return stack[-1]
+    return default_device()
+
+
+_default: Optional[Context] = None
+
+
+def default_device() -> Context:
+    """Default context: the first accelerator if present, else cpu."""
+    global _default
+    if _default is None:
+        dev = jax.devices()[0]
+        _default = Context("cpu" if dev.platform == "cpu" else "tpu", 0)
+    return _default
+
+
+def cpu(device_id: int = 0) -> Context:
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id: int = 0) -> Context:
+    return Context("cpu_pinned", device_id)
+
+
+def tpu(device_id: int = 0) -> Context:
+    return Context("tpu", device_id)
+
+
+def gpu(device_id: int = 0) -> Context:
+    """Alias for the accelerator context (reference-compat: mx.gpu())."""
+    return Context("tpu", device_id)
+
+
+def num_tpus() -> int:
+    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    return len(devs)
+
+
+def num_gpus() -> int:
+    """Reference-compat alias (mx.context.num_gpus)."""
+    return num_tpus()

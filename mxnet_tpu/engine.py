@@ -1,0 +1,90 @@
+"""Dependency-engine facade.
+
+The reference schedules every op as an async closure with read/write
+variable lists (``src/engine/threaded_engine.cc``).  On TPU, JAX's async
+dispatch + XLA's dataflow ordering provide the same guarantees: ops issue
+asynchronously, results are futures (``jax.Array``), and program order per
+buffer is preserved by the runtime.  What survives here is the *API*:
+
+- ``wait_all()``  — parity: ``Engine::WaitForAll`` / ``mx.nd.waitall()``
+- ``wait_for_var(arr)`` — parity: ``WaitForVar`` (block on one array)
+- ``set_bulk_size`` — kept as a no-op knob (XLA fusion replaces bulking)
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` — debug mode that synchronizes after
+  every op so exceptions surface at the faulting call, mirroring the
+  reference's naive engine (``src/engine/naive_engine.cc:51``).
+
+Exception propagation parity (``threaded_engine.cc:422-434``): JAX raises
+deferred errors at the first sync point; NaiveEngine mode makes that the
+op call site itself.
+"""
+from __future__ import annotations
+
+import jax
+
+from .base import getenv
+
+__all__ = ["naive_mode", "wait_all", "wait_for_var", "set_bulk_size", "bulk"]
+
+_naive = (getenv("MXNET_ENGINE_TYPE", "") or "").lower() == "naiveengine"
+
+
+def naive_mode() -> bool:
+    """True when MXNET_ENGINE_TYPE=NaiveEngine (synchronous debug engine)."""
+    return _naive
+
+
+def set_naive_mode(flag: bool) -> None:
+    global _naive
+    _naive = bool(flag)
+
+
+def wait_all() -> None:
+    """Block until all outstanding device work is complete.
+
+    Parity: Engine::WaitForAll (include/mxnet/engine.h) / mx.nd.waitall().
+    """
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    for d in jax.live_arrays():
+        try:
+            d.block_until_ready()
+        except Exception:
+            # deferred errors belong to whoever reads the array; waitall in the
+            # reference rethrows — match that.
+            raise
+
+
+def wait_for_var(value) -> None:
+    """Block until one array's producing computation finished (WaitForVar)."""
+    if hasattr(value, "wait_to_read"):
+        value.wait_to_read()
+    elif isinstance(value, jax.Array):
+        value.block_until_ready()
+
+
+_bulk_size = 0
+
+
+def set_bulk_size(size: int) -> int:
+    """Parity: mx.engine.set_bulk_size.  XLA fuses whole jitted steps, so
+    bulking is a no-op; the knob is preserved for API compatibility."""
+    global _bulk_size
+    old, _bulk_size = _bulk_size, int(size)
+    return old
+
+
+class bulk:
+    """``with mx.engine.bulk(n):`` context manager (no-op on TPU)."""
+
+    def __init__(self, size: int):
+        self._size = size
+
+    def __enter__(self):
+        self._old = set_bulk_size(self._size)
+        return self
+
+    def __exit__(self, *exc):
+        set_bulk_size(self._old)
+        return False

@@ -1,0 +1,16 @@
+"""mx.gluon — the high-level training API (parity: python/mxnet/gluon/)."""
+from .block import Block, HybridBlock, SymbolBlock
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import metric
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
+           "ParameterDict", "Trainer", "nn", "loss", "metric", "data", "rnn",
+           "model_zoo", "contrib"]

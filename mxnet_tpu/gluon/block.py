@@ -1,0 +1,421 @@
+"""Gluon Block / HybridBlock.
+
+Parity: python/mxnet/gluon/block.py (Block:201, HybridBlock:859).  The
+CachedOp analogue is TPU-native: ``hybridize()`` traces the forward into
+one jit-compiled XLA executable per input signature — whole-step fusion
+is the reference's engine *bulking* taken to its limit (SURVEY.md §3.3).
+The traced function is recorded on the autograd tape as a single op, so
+``CachedOp::Backward`` becomes jax.vjp through the compiled executable.
+
+Side effects inside a trace (BatchNorm moving stats, Dropout entropy) are
+handled the functional way: a trace context collects aux-state updates as
+extra outputs and threads PRNG keys as extra inputs.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray
+from .. import autograd as ag
+from ..ops import random as _rng
+from ..ops.registry import apply_jax
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nested_flatten"]
+
+
+# --------------------------------------------------------------------------
+# trace context: the in-trace side-channel for aux state + randomness
+# --------------------------------------------------------------------------
+
+class _TraceContext:
+    def __init__(self, base_key):
+        self.base_key = base_key
+        self.key_count = 0
+        self.aux: List[Tuple[Parameter, Any]] = []
+
+    def next_key(self):
+        self.key_count += 1
+        return jax.random.fold_in(self.base_key, self.key_count)
+
+    def aux_update(self, param: Parameter, new_value):
+        """Register `param <- new_value` to be applied after the call."""
+        if isinstance(new_value, NDArray):
+            new_value = new_value._data
+        self.aux.append((param, new_value))
+
+
+_trace_state = threading.local()
+
+
+def current_trace() -> Optional[_TraceContext]:
+    return getattr(_trace_state, "ctx", None)
+
+
+class _trace_scope:
+    def __init__(self, ctx: _TraceContext):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._old = getattr(_trace_state, "ctx", None)
+        _trace_state.ctx = self._ctx
+        self._old_hook = _rng.set_trace_hook(self._ctx.next_key)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        _trace_state.ctx = self._old
+        _rng.set_trace_hook(self._old_hook)
+        return False
+
+
+def nested_flatten(obj):
+    """Flatten nested lists/tuples/dicts of NDArrays; returns (leaves, treedef)
+    using jax pytree machinery on raw arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        obj, is_leaf=lambda x: isinstance(x, NDArray))
+    return leaves, treedef
+
+
+# --------------------------------------------------------------------------
+# Block
+# --------------------------------------------------------------------------
+
+class Block:
+    """Base class for all layers/models (parity: gluon/block.py:201)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._children: "OrderedDict[str, Block]" = OrderedDict()
+        self._reg_params: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._forward_hooks: List[Callable] = []
+        self._forward_pre_hooks: List[Callable] = []
+        self._prefix = prefix or ""
+
+    # -- attribute registration (parity: Block.__setattr__) ----------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Parameter):
+            self.__dict__.setdefault("_reg_params", OrderedDict())
+            self._reg_params[name] = value
+            if value._name in ("weight", "bias", "param", "const"):
+                value._name = name
+        elif isinstance(value, Block):
+            self.__dict__.setdefault("_children", OrderedDict())
+            self._children[name] = value
+        super().__setattr__(name, value)
+
+    def register_child(self, block: "Block", name: Optional[str] = None):
+        self._children[name or str(len(self._children))] = block
+
+    @property
+    def params(self) -> ParameterDict:
+        return ParameterDict(self._reg_params)
+
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        """Hierarchical name → Parameter (parity: Block.collect_params)."""
+        out = ParameterDict()
+        self._collect_params_into(out, "")
+        if select is not None:
+            import re
+            pat = re.compile(select)
+            out = ParameterDict({k: v for k, v in out.items()
+                                 if pat.search(k)})
+        return out
+
+    def _collect_params_into(self, out: ParameterDict, prefix: str):
+        for name, p in self._reg_params.items():
+            out[prefix + name] = p
+        for cname, child in self._children.items():
+            child._collect_params_into(out, f"{prefix}{cname}.")
+
+    # -- lifecycle ---------------------------------------------------------
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        from .. import initializer as init_mod
+        params = self.collect_params()
+        default = init if init is not None else init_mod.Uniform()
+        for p in params.values():
+            p.initialize(init=None, ctx=ctx, default_init=default,
+                         force_reinit=force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for p in self.collect_params().values():
+            p.cast(dtype)
+        for child in self._children.values():
+            child.cast(dtype)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    def reset_ctx(self, ctx):
+        self.collect_params().reset_ctx(ctx)
+
+    # -- persistence (parity: block.py:339 save_parameters / :375 load) ----
+    def save_parameters(self, filename: str, deduplicate: bool = False):
+        from ..ndarray import save as nd_save
+        params = self.collect_params()
+        nd_save(filename, {k: v.data() for k, v in params.items()})
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        params = self.collect_params()
+        for name, p in params.items():
+            if name in loaded:
+                p.set_data(loaded[name])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {name} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - set(params)
+            if extra:
+                raise MXNetError(
+                    f"file {filename} contains extra parameters: {extra}")
+
+    def save(self, prefix):
+        self.save_parameters(prefix + ".params")
+
+    def load(self, prefix):
+        self.load_parameters(prefix + ".params")
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+        return _HookHandle(self._forward_pre_hooks, hook)
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+        return _HookHandle(self._forward_hooks, hook)
+
+    # -- call --------------------------------------------------------------
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        params = self.collect_params()
+        total = sum(int(onp.prod(p.shape)) for p in params.values()
+                    if p.shape is not None and all(s > 0 for s in p.shape))
+        lines = [f"{type(self).__name__}: {len(params)} parameters, "
+                 f"{total} elements"]
+        for k, p in params.items():
+            lines.append(f"  {k}: {p.shape} {p.dtype}")
+        print("\n".join(lines))
+
+    def __repr__(self):
+        lines = [type(self).__name__ + "("]
+        for name, child in self._children.items():
+            child_repr = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {child_repr}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+class _HookHandle:
+    def __init__(self, hook_list, hook):
+        self._list, self._hook = hook_list, hook
+
+    def detach(self):
+        if self._hook in self._list:
+            self._list.remove(self._hook)
+
+
+# --------------------------------------------------------------------------
+# HybridBlock: jit-compiled CachedOp equivalent
+# --------------------------------------------------------------------------
+
+class HybridBlock(Block):
+    """Block that can be traced+compiled into one XLA executable
+    (parity: gluon/block.py:859; CachedOp src/imperative/cached_op.cc)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+        self._active = False
+        self._cached_graphs: Dict[Any, Any] = {}
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  **kwargs):
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_graphs.clear()
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def optimize_for(self, x, *args, backend=None, **kwargs):
+        self.hybridize(True)
+        return self(x, *args)
+
+    def infer_shape(self, *args):
+        pass  # shapes are inferred by tracing; deferred params by forward
+
+    def _has_deferred(self) -> bool:
+        return any(p._deferred_init is not None or p._data is None
+                   and p._deferred_init is not None
+                   for p in self.collect_params().values())
+
+    def __call__(self, *args, **kwargs):
+        if not self._active:
+            return super().__call__(*args, **kwargs)
+        nd_args = [a for a in args if isinstance(a, NDArray)]
+        if any(p._deferred_init is not None
+               for p in self.collect_params().values()):
+            # first call finishes deferred init eagerly (parity: CachedOp
+            # created on first forward, block.py:1403)
+            return super().__call__(*args, **kwargs)
+        return self._call_cached(args, kwargs)
+
+    def _signature(self, args, kwargs):
+        sig = [ag.is_training(), ag.is_recording()]
+        for a in args:
+            if isinstance(a, NDArray):
+                sig.append(("nd", a.shape, str(a.dtype)))
+            else:
+                sig.append(("py", repr(a)))
+        for k in sorted(kwargs):
+            v = kwargs[k]
+            sig.append((k, ("nd", v.shape, str(v.dtype))
+                        if isinstance(v, NDArray) else ("py", repr(v))))
+        return tuple(sig)
+
+    def _call_cached(self, args, kwargs):
+        params = self.collect_params()
+        pkeys = list(params.keys())
+        pvals = [params[k] for k in pkeys]
+        for p in pvals:
+            p._check_initialized()
+        sig = self._signature(args, kwargs)
+        entry = self._cached_graphs.get(sig)
+        if entry is None:
+            entry = self._build_cached(args, kwargs, pkeys, pvals)
+            self._cached_graphs[sig] = entry
+        jitted, cell = entry
+
+        key = _rng.next_key()
+        arrays = [NDArray(key)] + [p.data() for p in pvals] + \
+            [a for a in args if isinstance(a, NDArray)]
+        flat_out = apply_jax(jitted, arrays, multi_out=True)
+        n_out = cell["n_out"]
+        outs, aux = flat_out[:n_out], flat_out[n_out:]
+        # deliver aux-state updates (BatchNorm moving stats etc.)
+        for (param, _), new in zip(cell["aux_params"], aux):
+            with ag.pause():
+                param._data._rebind(new._data)
+        result = jax.tree_util.tree_unflatten(cell["treedef"],
+                                              [o for o in outs])
+        return result
+
+    def _build_cached(self, args, kwargs, pkeys, pvals):
+        """Trace self.forward into a pure jax function of
+        (key, *params, *inputs) (parity: CreateForwardGraph,
+        cached_op.h:191)."""
+        block = self
+        cell: Dict[str, Any] = {"n_out": None, "treedef": None,
+                                "aux_params": []}
+        nd_positions = [i for i, a in enumerate(args)
+                        if isinstance(a, NDArray)]
+        py_args = list(args)
+        training = ag.is_training()
+
+        def traced(key, *arrays):
+            p_arr = arrays[:len(pvals)]
+            in_arr = arrays[len(pvals):]
+            tc = _TraceContext(key)
+            saved = [p._data for p in pvals]
+            try:
+                for p, a in zip(pvals, p_arr):
+                    p._data = NDArray(a)
+                call_args = list(py_args)
+                for pos, a in zip(nd_positions, in_arr):
+                    call_args[pos] = NDArray(a)
+                with _trace_scope(tc), ag.pause(train_mode=training):
+                    out = block.forward(*call_args, **kwargs)
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))
+                raw = [l._data if isinstance(l, NDArray) else jnp.asarray(l)
+                       for l in leaves]
+                cell["n_out"] = len(raw)
+                cell["treedef"] = treedef
+                cell["aux_params"] = list(tc.aux)
+                return tuple(raw) + tuple(v for _, v in tc.aux)
+            finally:
+                for p, s in zip(pvals, saved):
+                    p._data = s
+
+        jitted = jax.jit(traced)
+        # prime the cache: one call to populate `cell` via tracing
+        key = _rng.next_key()
+        sample = [key] + [p.data()._data for p in pvals] + \
+            [args[i]._data for i in nd_positions]
+        jax.eval_shape(jitted, *sample)
+        return jitted, cell
+
+    # -- export (parity: HybridBlock.export, block.py:1296: symbol json +
+    #    params; here StableHLO via jax.export + params npz) --------------
+    def export(self, path: str, epoch: int = 0):
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        import json
+        manifest = {"format": "mxnet_tpu-stablehlo-v1",
+                    "signatures": [list(map(str, k))
+                                   for k in self._cached_graphs]}
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(manifest, f)
+        return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
+
+    def forward(self, x, *args):
+        raise NotImplementedError
+
+
+class SymbolBlock(HybridBlock):
+    """Construct a block from exported artifacts (parity: block.py:1479).
+
+    v1: re-load parameters onto a user-supplied forward function.
+    """
+
+    def __init__(self, forward_fn: Callable, params: Optional[dict] = None):
+        super().__init__()
+        self._forward_fn = forward_fn
+        if params:
+            for k, v in params.items():
+                self._reg_params[k] = v
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None,
+                forward_fn=None):
+        blk = SymbolBlock(forward_fn or (lambda *a: a[0]))
+        if param_file:
+            from ..ndarray import load as nd_load
+            loaded = nd_load(param_file)
+            for k, v in loaded.items():
+                p = Parameter(name=k, shape=v.shape, dtype=str(v.dtype))
+                p.set_data(v)
+                blk._reg_params[k] = p
+        return blk
+
+    def forward(self, *args):
+        return self._forward_fn(*args)

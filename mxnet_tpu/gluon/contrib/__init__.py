@@ -1,0 +1,7 @@
+"""gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
+from . import estimator
+from .layers import (SyncBatchNorm, PixelShuffle1D, PixelShuffle2D,
+                     PixelShuffle3D, HybridConcurrent, Concurrent, Identity)
+
+__all__ = ["estimator", "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D", "HybridConcurrent", "Concurrent", "Identity"]

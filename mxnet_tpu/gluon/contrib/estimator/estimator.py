@@ -1,0 +1,87 @@
+"""Estimator: high-level fit loop.
+
+Parity: python/mxnet/gluon/contrib/estimator/estimator.py.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ....base import MXNetError
+from .... import autograd
+from ...trainer import Trainer
+from ... import metric as metric_mod
+from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
+                            BatchBegin, BatchEnd, StoppingHandler,
+                            MetricHandler, LoggingHandler)
+
+__all__ = ["Estimator"]
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [metric_mod.Accuracy()]
+        if not isinstance(self.train_metrics, list):
+            self.train_metrics = [self.train_metrics]
+        self.val_metrics = val_metrics or \
+            [metric_mod.create(type(m).__name__.lower())
+             for m in self.train_metrics]
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3},
+            kvstore=None)
+
+    def evaluate(self, val_data, batch_axis=0):
+        for metric in self.val_metrics:
+            metric.reset()
+        for batch in val_data:
+            data, label = batch[0], batch[1]
+            pred = self.net(data)
+            for metric in self.val_metrics:
+                metric.update([label], [pred])
+        return {m.get()[0]: m.get()[1] for m in self.val_metrics}
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None, batch_axis=0):
+        if epochs is None and batches is None:
+            raise MXNetError("either epochs or batches must be specified")
+        handlers = list(event_handlers or [])
+        stopper = StoppingHandler(epochs, batches)
+        handlers.append(stopper)
+        handlers.append(MetricHandler(self.train_metrics))
+        train_begin = [h for h in handlers if isinstance(h, TrainBegin)]
+        epoch_begin = [h for h in handlers if isinstance(h, EpochBegin)]
+        batch_begin = [h for h in handlers if isinstance(h, BatchBegin)]
+        batch_end = [h for h in handlers if isinstance(h, BatchEnd)]
+        epoch_end = [h for h in handlers if isinstance(h, EpochEnd)]
+        train_end = [h for h in handlers if isinstance(h, TrainEnd)]
+
+        for h in train_begin:
+            h.train_begin(self)
+        while not stopper.stop_training:
+            for h in epoch_begin:
+                h.epoch_begin(self)
+            for batch in train_data:
+                for h in batch_begin:
+                    h.batch_begin(self)
+                data, label = batch[0], batch[1]
+                bs = data.shape[batch_axis]
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(bs)
+                stop = False
+                for h in batch_end:
+                    if h.batch_end(self, pred=pred, label=label, loss=loss):
+                        stop = True
+                if stop or stopper.stop_training:
+                    break
+            for h in epoch_end:
+                h.epoch_end(self)
+            if val_data is not None:
+                self.evaluate(val_data)
+        for h in train_end:
+            h.train_end(self)
+        return self

@@ -1,0 +1,198 @@
+"""Estimator event handlers.
+
+Parity: python/mxnet/gluon/contrib/estimator/event_handler.py.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
+           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+           "EarlyStoppingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+        return self.stop_training
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+        return self.stop_training
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    def __init__(self, metrics, priority=-1000):
+        self.metrics = metrics or []
+        self.priority = priority
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for metric in self.metrics:
+            metric.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        from ...metric import Loss as LossMetric
+        for metric in self.metrics:
+            if isinstance(metric, LossMetric):
+                metric.update(0, loss)
+            else:
+                metric.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(val_data=self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchBegin,
+                     BatchEnd):
+    def __init__(self, log_interval="epoch", metrics=None, priority=-1000):
+        self.log_interval = log_interval
+        self.metrics = metrics or []
+        self.priority = priority
+        self.batch_index = 0
+        self.current_epoch = 0
+        self.logger = logging.getLogger("mxnet_tpu.estimator")
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.train_start = time.time()
+        self.logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        elapsed = time.time() - self.train_start
+        self.logger.info("Training finished in %.3fs", elapsed)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.epoch_start = time.time()
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msg = f"Epoch {self.current_epoch} finished in " \
+              f"{time.time() - self.epoch_start:.3f}s: "
+        for m in self.metrics:
+            name, value = m.get()
+            msg += f"{name}: {value:.4f} "
+        self.logger.info(msg)
+        self.current_epoch += 1
+        self.batch_index = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        if isinstance(self.log_interval, int) and \
+                self.batch_index % self.log_interval == 0:
+            msg = f"[Epoch {self.current_epoch}][Batch {self.batch_index}] "
+            for m in self.metrics:
+                name, value = m.get()
+                msg += f"{name}: {value:.4f} "
+            self.logger.info(msg)
+        self.batch_index += 1
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 verbose=0, save_best=False, mode="auto", epoch_period=1,
+                 batch_period=None, max_checkpoints=5,
+                 resume_from_checkpoint=False):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.current_epoch = 0
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        import os
+        if self.epoch_period and \
+                (self.current_epoch + 1) % self.epoch_period == 0:
+            path = os.path.join(self.model_dir,
+                                f"{self.model_prefix}-epoch"
+                                f"{self.current_epoch}.params")
+            estimator.net.save_parameters(path)
+        self.current_epoch += 1
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto",
+                 baseline=None):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.wait = 0
+        self.best = None
+        self.stop_training = False
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        name, value = self.monitor.get()
+        if self.best is None or value < self.best - self.min_delta:
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stop_training = True
+        return self.stop_training

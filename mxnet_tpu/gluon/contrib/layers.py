@@ -1,0 +1,67 @@
+"""Contrib layers (parity: gluon/contrib/nn/basic_layers.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...ops.registry import apply_jax
+from ..block import HybridBlock
+from ..nn.basic_layers import (SyncBatchNorm, Identity, Concatenate as
+                               Concurrent, HybridConcatenate as
+                               HybridConcurrent)
+
+__all__ = ["SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+           "PixelShuffle3D", "HybridConcurrent", "Concurrent", "Identity"]
+
+
+class PixelShuffle1D(HybridBlock):
+    """Parity: contrib PixelShuffle1D."""
+
+    def __init__(self, factor):
+        super().__init__()
+        self._factor = int(factor)
+
+    def forward(self, x):
+        f = self._factor
+
+        def fn(a):
+            n, c, w = a.shape
+            out = a.reshape(n, c // f, f, w)
+            out = jnp.transpose(out, (0, 1, 3, 2))
+            return out.reshape(n, c // f, w * f)
+        return apply_jax(fn, [x])
+
+
+class PixelShuffle2D(HybridBlock):
+    def __init__(self, factor):
+        super().__init__()
+        if isinstance(factor, int):
+            factor = (factor, factor)
+        self._factor = tuple(factor)
+
+    def forward(self, x):
+        f1, f2 = self._factor
+
+        def fn(a):
+            n, c, h, w = a.shape
+            out = a.reshape(n, c // (f1 * f2), f1, f2, h, w)
+            out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+            return out.reshape(n, c // (f1 * f2), h * f1, w * f2)
+        return apply_jax(fn, [x])
+
+
+class PixelShuffle3D(HybridBlock):
+    def __init__(self, factor):
+        super().__init__()
+        if isinstance(factor, int):
+            factor = (factor, factor, factor)
+        self._factor = tuple(factor)
+
+    def forward(self, x):
+        f1, f2, f3 = self._factor
+
+        def fn(a):
+            n, c, d, h, w = a.shape
+            out = a.reshape(n, c // (f1 * f2 * f3), f1, f2, f3, d, h, w)
+            out = jnp.transpose(out, (0, 1, 5, 2, 6, 3, 7, 4))
+            return out.reshape(n, c // (f1 * f2 * f3), d * f1, h * f2, w * f3)
+        return apply_jax(fn, [x])

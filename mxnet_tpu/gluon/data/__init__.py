@@ -1,0 +1,10 @@
+"""gluon.data (parity: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
+                      IntervalSampler, FilterSampler)
+from .dataloader import DataLoader, default_batchify_fn
+from . import vision
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
+           "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "IntervalSampler", "FilterSampler", "DataLoader", "vision"]

@@ -1,0 +1,134 @@
+"""DataLoader.
+
+Parity: python/mxnet/gluon/data/dataloader.py:187 (DataLoader with
+multiprocessing workers + shared-memory NDArray hand-off).  TPU-first
+notes: batches stay as host numpy until the training step transfers them
+(one H2D per step); worker processes use a multiprocessing Pool with
+pickled numpy (the reference's shm ForkingPickler optimization is an
+optional fast path the C++ pipeline provides — see src_native/ io).
+"""
+from __future__ import annotations
+
+import multiprocessing
+from typing import Any, Callable, Optional
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from .dataset import Dataset
+from .sampler import BatchSampler, RandomSampler, SequentialSampler, Sampler
+
+__all__ = ["DataLoader", "default_batchify_fn", "default_mp_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (parity: dataloader.py default_batchify_fn)."""
+    if isinstance(data[0], NDArray):
+        return NDArray(onp.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_batchify_fn(list(x)) for x in zip(*data))
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return NDArray(arr)
+
+
+default_mp_batchify_fn = default_batchify_fn
+
+
+def _worker_fn(dataset, batchify_fn, indices):
+    batch = batchify_fn([dataset[i] for i in indices])
+    # return numpy to cross the process boundary
+    def to_np(x):
+        if isinstance(x, NDArray):
+            return x.asnumpy()
+        if isinstance(x, tuple):
+            return tuple(to_np(e) for e in x)
+        return x
+    return to_np(batch)
+
+
+class DataLoader:
+    """Loads batches from a Dataset (parity: gluon.data.DataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._pin_memory = pin_memory
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when batch_sampler "
+                                 "is not set")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle must be False with custom sampler")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise MXNetError("batch_size/shuffle/sampler/last_batch are "
+                             "mutually exclusive with batch_sampler")
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._thread_pool = thread_pool
+        self._timeout = timeout
+        self._pool = None
+
+    def _get_pool(self):
+        if self._pool is None:
+            if self._thread_pool:
+                from multiprocessing.pool import ThreadPool
+                self._pool = ThreadPool(self._num_workers)
+            else:
+                ctx = multiprocessing.get_context("fork")
+                self._pool = ctx.Pool(self._num_workers)
+        return self._pool
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._batchify_fn([self._dataset[i] for i in indices])
+            return
+
+        pool = self._get_pool()
+        pending = []
+        it = iter(self._batch_sampler)
+
+        def submit():
+            try:
+                indices = next(it)
+            except StopIteration:
+                return False
+            pending.append(pool.apply_async(
+                _worker_fn, (self._dataset, self._batchify_fn, indices)))
+            return True
+
+        for _ in range(self._prefetch + 1):
+            if not submit():
+                break
+        while pending:
+            result = pending.pop(0).get(self._timeout)
+            submit()
+            yield _rewrap(result)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def __del__(self):
+        if self._pool is not None:
+            self._pool.terminate()
+
+
+def _rewrap(x):
+    if isinstance(x, onp.ndarray):
+        return NDArray(x)
+    if isinstance(x, tuple):
+        return tuple(_rewrap(e) for e in x)
+    return x

@@ -1,0 +1,228 @@
+"""Vision transforms.
+
+Parity: python/mxnet/gluon/data/vision/transforms/ (ToTensor, Normalize,
+Resize, CenterCrop, RandomResizedCrop, RandomFlip*, Cast, Compose) over
+src/operator/image/ ops.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import Optional, Sequence, Tuple
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ....ndarray import NDArray
+from ....ops.registry import apply_jax
+from ...block import Block, HybridBlock
+from ...nn import Sequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomBrightness", "RandomContrast", "RandomSaturation",
+           "RandomLighting", "RandomColorJitter"]
+
+
+class Compose(Sequential):
+    """Parity: transforms.Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(HybridBlock):
+    """HWC uint8 → CHW float32 in [0,1] (parity: image to_tensor op)."""
+
+    def forward(self, x):
+        def fn(a):
+            a = a.astype(jnp.float32) / 255.0
+            if a.ndim == 3:
+                return jnp.transpose(a, (2, 0, 1))
+            return jnp.transpose(a, (0, 3, 1, 2))
+        return apply_jax(fn, [x])
+
+
+class Normalize(HybridBlock):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = onp.asarray(mean, dtype=onp.float32)
+        self._std = onp.asarray(std, dtype=onp.float32)
+
+    def forward(self, x):
+        mean, std = self._mean, self._std
+        def fn(a):
+            m = mean.reshape((-1,) + (1,) * (a.ndim - 1)) if mean.ndim else mean
+            s = std.reshape((-1,) + (1,) * (a.ndim - 1)) if std.ndim else std
+            return (a - m) / s
+        return apply_jax(fn, [x])
+
+
+class Resize(HybridBlock):
+    """Resize HWC image (parity: image resize op)."""
+
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        import jax
+        h, w = self._size[1], self._size[0]
+        def fn(a):
+            if a.ndim == 3:
+                return jax.image.resize(a.astype(jnp.float32),
+                                        (h, w, a.shape[2]), "linear")
+            return jax.image.resize(a.astype(jnp.float32),
+                                    (a.shape[0], h, w, a.shape[3]), "linear")
+        return apply_jax(fn, [x])
+
+
+class CenterCrop(HybridBlock):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def forward(self, x):
+        w, h = self._size
+        H, W = x.shape[-3], x.shape[-2]
+        y0 = max((H - h) // 2, 0)
+        x0 = max((W - w) // 2, 0)
+        def fn(a):
+            return a[..., y0:y0 + h, x0:x0 + w, :]
+        return apply_jax(fn, [x])
+
+
+class RandomResizedCrop(HybridBlock):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        import jax
+        import math
+        H, W = x.shape[-3], x.shape[-2]
+        area = H * W
+        for _ in range(10):
+            target_area = pyrandom.uniform(*self._scale) * area
+            log_ratio = (math.log(self._ratio[0]), math.log(self._ratio[1]))
+            aspect = math.exp(pyrandom.uniform(*log_ratio))
+            w = int(round(math.sqrt(target_area * aspect)))
+            h = int(round(math.sqrt(target_area / aspect)))
+            if 0 < w <= W and 0 < h <= H:
+                x0 = pyrandom.randint(0, W - w)
+                y0 = pyrandom.randint(0, H - h)
+                break
+        else:
+            w, h, x0, y0 = W, H, 0, 0
+        ow, oh = self._size
+        def fn(a):
+            crop = a[..., y0:y0 + h, x0:x0 + w, :]
+            return jax.image.resize(crop.astype(jnp.float32),
+                                    crop.shape[:-3] + (oh, ow, crop.shape[-1]),
+                                    "linear")
+        return apply_jax(fn, [x])
+
+
+class _RandomFlip(HybridBlock):
+    _axis = -2
+
+    def forward(self, x):
+        if pyrandom.random() < 0.5:
+            return x
+        ax = self._axis
+        return apply_jax(lambda a: jnp.flip(a, axis=ax), [x])
+
+
+class RandomFlipLeftRight(_RandomFlip):
+    _axis = -2
+
+
+class RandomFlipTopBottom(_RandomFlip):
+    _axis = -3
+
+
+class RandomBrightness(HybridBlock):
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        alpha = 1.0 + pyrandom.uniform(-self._b, self._b)
+        return apply_jax(lambda a: a * alpha, [x])
+
+
+class RandomContrast(HybridBlock):
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        alpha = 1.0 + pyrandom.uniform(-self._c, self._c)
+        def fn(a):
+            gray = a.mean(keepdims=True)
+            return a * alpha + gray * (1 - alpha)
+        return apply_jax(fn, [x])
+
+
+class RandomSaturation(HybridBlock):
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        alpha = 1.0 + pyrandom.uniform(-self._s, self._s)
+        def fn(a):
+            gray = a.mean(axis=-1, keepdims=True)
+            return a * alpha + gray * (1 - alpha)
+        return apply_jax(fn, [x])
+
+
+class RandomLighting(HybridBlock):
+    """AlexNet-style PCA noise (parity: transforms RandomLighting)."""
+
+    _eigval = onp.array([55.46, 4.794, 1.148], dtype=onp.float32)
+    _eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.814],
+                         [-0.5836, -0.6948, 0.4203]], dtype=onp.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha_std = alpha
+
+    def forward(self, x):
+        alpha = onp.random.normal(0, self._alpha_std, size=(3,)) \
+            .astype(onp.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return apply_jax(lambda a: a + rgb, [x])
+
+
+class RandomColorJitter(HybridBlock):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def forward(self, x):
+        ts = list(self._ts)
+        pyrandom.shuffle(ts)
+        for t in ts:
+            x = t(x)
+        return x

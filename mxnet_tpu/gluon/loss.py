@@ -1,0 +1,286 @@
+"""Gluon losses.
+
+Parity: python/mxnet/gluon/loss.py (15+ losses incl. CTC, Triplet, SDML).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+from ..ops.registry import invoke, apply_jax
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
+           "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
+           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+
+
+def _reshape_like(x, y):
+    return x.reshape(y.shape) if x.shape != y.shape else x
+
+
+def _apply_weighting(loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+class Loss(HybridBlock):
+    """Base loss (parity: loss.py Loss)."""
+
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def _mean_nonbatch(self, loss):
+        axes = tuple(i for i in range(loss.ndim) if i != self._batch_axis)
+        return loss.mean(axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(label, pred)
+        loss = invoke("square", [pred - label])
+        loss = _apply_weighting(loss, self._weight / 2, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(label, pred)
+        loss = invoke("abs", [pred - label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    """Parity: loss.py SigmoidBCELoss (from_sigmoid switch, pos_weight)."""
+
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def forward(self, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(label, pred)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                def fn(p, l):
+                    return jnp.maximum(p, 0) - p * l + jnp.log1p(jnp.exp(-jnp.abs(p)))
+                loss = apply_jax(fn, [pred, label])
+            else:
+                def fn(p, l, pw):
+                    log_wt = l * (pw - 1) + 1
+                    return jnp.maximum(p, 0) - p * l + \
+                        jnp.log1p(jnp.exp(-jnp.abs(p))) * log_wt
+                loss = apply_jax(fn, [pred, label, pos_weight])
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                def fn(p, l):
+                    return -(jnp.log(p + eps) * l + jnp.log1p(-p + eps) * (1 - l))
+                loss = apply_jax(fn, [pred, label])
+            else:
+                def fn(p, l, pw):
+                    return -(jnp.log(p + eps) * l * pw
+                             + jnp.log1p(-p + eps) * (1 - l))
+                loss = apply_jax(fn, [pred, label, pos_weight])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Parity: loss.py SoftmaxCrossEntropyLoss (sparse_label, from_logits)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def forward(self, pred, label, sample_weight=None):
+        axis = self._axis
+        if not self._from_logits:
+            logp = invoke("log_softmax", [pred], axis=axis)
+        else:
+            logp = pred
+        if self._sparse_label:
+            loss = -invoke("pick", [logp, label], axis=axis, keepdims=False)
+        else:
+            label = _reshape_like(label, logp)
+            loss = -(logp * label).sum(axis=axis)
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def forward(self, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = invoke("log_softmax", [pred], axis=self._axis)
+        def fn(p, l):
+            return l * (jnp.log(jnp.maximum(l, 1e-12)) - p)
+        loss = apply_jax(fn, [pred, label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class CTCLoss(Loss):
+    """Parity: loss.py CTCLoss over src/operator/nn/ctc_loss.cc."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        super().__init__(weight, 0, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def forward(self, pred, label, pred_lengths=None, label_lengths=None,
+                sample_weight=None):
+        if self._layout == "NTC":
+            pred = pred.swapaxes(0, 1)  # -> TNC
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+        loss = invoke("CTCLoss", [pred, label, pred_lengths, label_lengths],
+                      use_data_lengths=pred_lengths is not None,
+                      use_label_lengths=label_lengths is not None,
+                      blank_label="first")
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(label, pred)
+        rho = self._rho
+        def fn(p, l):
+            e = jnp.abs(p - l)
+            return jnp.where(e > rho, e - 0.5 * rho, 0.5 / rho * e * e)
+        loss = apply_jax(fn, [pred, label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(label, pred)
+        m = self._margin
+        loss = apply_jax(lambda p, l: jnp.maximum(0.0, m - p * l), [pred, label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(label, pred)
+        m = self._margin
+        loss = apply_jax(lambda p, l: jnp.square(jnp.maximum(0.0, m - p * l)),
+                         [pred, label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+
+    def forward(self, pred, label, sample_weight=None):
+        label = _reshape_like(label, pred)
+        fmt = self._label_format
+        def fn(p, l):
+            ll = l if fmt == "signed" else 2 * l - 1
+            return jnp.log1p(jnp.exp(-p * ll))
+        loss = apply_jax(fn, [pred, label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return self._mean_nonbatch(loss)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, pred, positive, negative, sample_weight=None):
+        m = self._margin
+        def fn(p, pos, neg):
+            axes = tuple(range(1, p.ndim))
+            d = jnp.sum(jnp.square(p - pos) - jnp.square(p - neg), axis=axes)
+            return jnp.maximum(d + m, 0.0)
+        loss = apply_jax(fn, [pred, positive, negative])
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def forward(self, pred, label, sample_weight=None, epsilon=1e-08):
+        label = _reshape_like(label, pred)
+        from_logits, full = self._from_logits, self._compute_full
+        def fn(p, l):
+            if from_logits:
+                loss = jnp.exp(p) - l * p
+            else:
+                loss = p - l * jnp.log(p + epsilon)
+            if full:
+                stirling = l * jnp.log(l + 1e-12) - l + \
+                    0.5 * jnp.log(2 * jnp.pi * (l + 1e-12))
+                loss = loss + jnp.where(l > 1, stirling, 0.0)
+            return loss
+        loss = apply_jax(fn, [pred, label])
+        loss = _apply_weighting(loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def forward(self, input1, input2, label, sample_weight=None):
+        m = self._margin
+        def fn(a, b, l):
+            a2 = a.reshape(a.shape[0], -1)
+            b2 = b.reshape(b.shape[0], -1)
+            cos = jnp.sum(a2 * b2, axis=1) / (
+                jnp.linalg.norm(a2, axis=1) * jnp.linalg.norm(b2, axis=1) + 1e-12)
+            ls = l.reshape(-1)
+            return jnp.where(ls == 1, 1.0 - cos, jnp.maximum(0.0, cos - m))
+        loss = apply_jax(fn, [input1, input2, label])
+        return _apply_weighting(loss, self._weight, sample_weight)

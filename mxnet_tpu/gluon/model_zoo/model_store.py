@@ -1,0 +1,43 @@
+"""Pretrained weight store.
+
+Parity: python/mxnet/gluon/model_zoo/model_store.py (get_model_file,
+purge, download from S3).  This environment has no egress; weights are
+looked up in MXNET_HOME/models and loading fails with a clear message if
+absent.
+"""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge", "load_pretrained"]
+
+
+def _model_dir():
+    return os.path.expanduser(os.environ.get(
+        "MXNET_HOME", os.path.join("~", ".mxnet")) + "/models")
+
+
+def get_model_file(name: str, root=None) -> str:
+    root = root or _model_dir()
+    path = os.path.join(os.path.expanduser(root), f"{name}.params")
+    for cand in (path, path + ".npz"):
+        if os.path.exists(cand):
+            return cand
+    raise MXNetError(
+        f"pretrained model {name!r} not found at {path}; this build has no "
+        "network egress — place the weights there manually")
+
+
+def load_pretrained(net, name: str, ctx=None, root=None):
+    net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net
+
+
+def purge(root=None):
+    root = os.path.expanduser(root or _model_dir())
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params") or f.endswith(".params.npz"):
+                os.remove(os.path.join(root, f))

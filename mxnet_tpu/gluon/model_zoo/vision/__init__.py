@@ -1,0 +1,29 @@
+"""model_zoo.vision (parity: python/mxnet/gluon/model_zoo/vision/)."""
+from . import resnet as _resnet
+from . import alexnet as _alexnet
+from . import vgg as _vgg
+from . import mobilenet as _mobilenet
+from . import squeezenet as _squeezenet
+from . import densenet as _densenet
+from . import inception as _inception
+
+from ....base import MXNetError
+
+_models = {}
+for _mod in (_resnet, _alexnet, _vgg, _mobilenet, _squeezenet, _densenet,
+             _inception):
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj):
+            globals()[_name] = _obj
+            if _name[0].islower() and not _name.startswith("get_"):
+                _models[_name] = _obj
+
+
+def get_model(name, **kwargs):
+    """Parity: vision.get_model."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} not found; available: {sorted(_models)}")
+    return _models[name](**kwargs)

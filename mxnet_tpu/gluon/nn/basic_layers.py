@@ -1,0 +1,477 @@
+"""Basic Gluon layers.
+
+Parity: python/mxnet/gluon/nn/basic_layers.py (Dense, Dropout, BatchNorm,
+Embedding, LayerNorm, GroupNorm, InstanceNorm, Flatten, Lambda,
+Sequential/HybridSequential) and activations.py.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ops.registry import invoke, apply_jax
+from ... import autograd as ag
+from ... import initializer as init_mod
+from ..block import Block, HybridBlock, current_trace
+from ..parameter import Parameter
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "SyncBatchNorm", "Embedding", "Flatten", "LayerNorm", "GroupNorm",
+           "InstanceNorm", "Lambda", "HybridLambda", "Identity", "Activation",
+           "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish", "SiLU",
+           "Softmax", "LogSoftmax", "Concatenate", "HybridConcatenate"]
+
+
+class Sequential(Block):
+    """Parity: nn.Sequential — stacks Blocks sequentially."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class HybridSequential(HybridBlock):
+    """Parity: nn.HybridSequential."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix, params)
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+        return self
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x)
+        return x
+
+    def __getitem__(self, key):
+        children = list(self._children.values())
+        if isinstance(key, slice):
+            net = type(self)()
+            net.add(*children[key])
+            return net
+        return children[key]
+
+    def __len__(self):
+        return len(self._children)
+
+    def __iter__(self):
+        return iter(self._children.values())
+
+
+class Dense(HybridBlock):
+    """Fully-connected layer (parity: nn.Dense over FullyConnected op,
+    src/operator/nn/fully_connected.cc)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._activation = activation
+        self.weight = Parameter(shape=(units, in_units), dtype=dtype,
+                                init=weight_initializer,
+                                allow_deferred_init=True)
+        self.bias = Parameter(shape=(units,), dtype=dtype,
+                              init=init_mod.create(bias_initializer)
+                              if bias_initializer else None,
+                              allow_deferred_init=True) if use_bias else None
+        if self.bias is not None:
+            # re-register under attr name done by __setattr__
+            pass
+
+    def _finish_deferred(self, x):
+        if self.weight._deferred_init is not None:
+            in_units = x.size // x.shape[0] if self._flatten else x.shape[-1]
+            self.weight._finish_deferred_init((self._units, in_units))
+        if self.bias is not None and self.bias._deferred_init is not None:
+            self.bias._finish_deferred_init((self._units,))
+
+    def forward(self, x):
+        self._finish_deferred(x)
+        out = invoke("FullyConnected",
+                     [x, self.weight.data(),
+                      self.bias.data() if self.bias is not None else None],
+                     num_hidden=self._units, no_bias=self.bias is None,
+                     flatten=self._flatten)
+        if self._activation:
+            out = invoke("Activation", [out], act_type=self._activation)
+        return out
+
+    def __repr__(self):
+        return f"Dense({self._units}, linear)" if not self._activation else \
+            f"Dense({self._units}, {self._activation})"
+
+
+class Dropout(HybridBlock):
+    """Parity: nn.Dropout over src/operator/nn/dropout.cc; PRNG key comes
+    from the global chain (eager) or the trace context (hybridized)."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = tuple(axes)
+
+    def forward(self, x):
+        if not ag.is_training() or self._rate <= 0:
+            return x
+        from ...ops.random import next_key
+        key = next_key()
+        return invoke("Dropout", [x, NDArray(key)], p=self._rate,
+                      axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Parity: nn.BatchNorm over src/operator/nn/batch_norm.cc.  Moving
+    stats are aux states: updated in-place eagerly, or routed through the
+    trace context as extra outputs when hybridized."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones", running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.gamma = Parameter(shape=(in_channels,),
+                               init=init_mod.create(gamma_initializer),
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter(shape=(in_channels,),
+                              init=init_mod.create(beta_initializer),
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+        self.running_mean = Parameter(
+            shape=(in_channels,), init=init_mod.create(running_mean_initializer),
+            allow_deferred_init=True, grad_req="null")
+        self.running_var = Parameter(
+            shape=(in_channels,),
+            init=init_mod.create(running_variance_initializer),
+            allow_deferred_init=True, grad_req="null")
+
+    def _finish_deferred(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            if p._deferred_init is not None:
+                p._finish_deferred_init((c,))
+
+    def forward(self, x):
+        self._finish_deferred(x)
+        training = ag.is_training() and not self._use_global_stats
+        out, mean, var = invoke(
+            "BatchNorm",
+            [x, self.gamma.data(), self.beta.data(),
+             self.running_mean.data(), self.running_var.data()],
+            eps=self._epsilon, momentum=self._momentum,
+            fix_gamma=not self._scale,
+            use_global_stats=self._use_global_stats, axis=self._axis,
+            use_batch_stats=training)
+        if training:
+            m = self._momentum
+            tc = current_trace()
+            new_mean = self.running_mean.data() * m + mean * (1 - m)
+            new_var = self.running_var.data() * m + var * (1 - m)
+            if tc is not None:
+                tc.aux_update(self.running_mean, new_mean)
+                tc.aux_update(self.running_var, new_var)
+            else:
+                with ag.pause():
+                    self.running_mean.data()._rebind(new_mean._data)
+                    self.running_var.data()._rebind(new_var._data)
+        return out
+
+    def __repr__(self):
+        return f"BatchNorm(axis={self._axis}, momentum={self._momentum}, " \
+               f"eps={self._epsilon})"
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device BatchNorm (parity: gluon/contrib SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc).  Under pjit/shard_map the
+    batch axis is sharded and XLA's psum makes plain BatchNorm already
+    synchronous; kept as an alias with the reference's signature."""
+
+    def __init__(self, in_channels=0, num_devices=None, **kwargs):
+        super().__init__(in_channels=in_channels, **kwargs)
+
+
+class Embedding(HybridBlock):
+    """Parity: nn.Embedding over the Embedding op (indexing_op)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
+                                init=weight_initializer)
+
+    def forward(self, x):
+        return invoke("Embedding", [x, self.weight.data()],
+                      input_dim=self._input_dim, output_dim=self._output_dim)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    def forward(self, x):
+        return invoke("flatten", [x])
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class LayerNorm(HybridBlock):
+    """Parity: nn.LayerNorm over src/operator/nn/layer_norm.cc."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,),
+                               init=init_mod.create(gamma_initializer),
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter(shape=(in_channels,),
+                              init=init_mod.create(beta_initializer),
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+    def forward(self, x):
+        c = x.shape[self._axis]
+        for p in (self.gamma, self.beta):
+            if p._deferred_init is not None:
+                p._finish_deferred_init((c,))
+        return invoke("LayerNorm", [x, self.gamma.data(), self.beta.data()],
+                      axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,),
+                               init=init_mod.create(gamma_initializer),
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter(shape=(in_channels,),
+                              init=init_mod.create(beta_initializer),
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p._deferred_init is not None:
+                p._finish_deferred_init((c,))
+        return invoke("GroupNorm", [x, self.gamma.data(), self.beta.data()],
+                      num_groups=self._num_groups, eps=self._epsilon)
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._epsilon = epsilon
+        self.gamma = Parameter(shape=(in_channels,),
+                               init=init_mod.create(gamma_initializer),
+                               allow_deferred_init=True,
+                               grad_req="write" if scale else "null")
+        self.beta = Parameter(shape=(in_channels,),
+                              init=init_mod.create(beta_initializer),
+                              allow_deferred_init=True,
+                              grad_req="write" if center else "null")
+
+    def forward(self, x):
+        c = x.shape[1]
+        for p in (self.gamma, self.beta):
+            if p._deferred_init is not None:
+                p._finish_deferred_init((c,))
+        return invoke("InstanceNorm", [x, self.gamma.data(), self.beta.data()],
+                      eps=self._epsilon)
+
+
+class Lambda(Block):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._func = function if callable(function) else None
+        self._fname = function if isinstance(function, str) else None
+
+    def forward(self, *args):
+        if self._func is not None:
+            return self._func(*args)
+        from ... import ndarray as nd
+        return getattr(nd, self._fname)(*args)
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, **kwargs):
+        super().__init__(**kwargs)
+        self._func = function if callable(function) else None
+        self._fname = function if isinstance(function, str) else None
+
+    def forward(self, *args):
+        if self._func is not None:
+            return self._func(*args)
+        from ... import ndarray as nd
+        return getattr(nd, self._fname)(*args)
+
+
+class Identity(HybridBlock):
+    def forward(self, x):
+        return x
+
+
+class Concatenate(Sequential):
+    """Run children on the same input, concat outputs (parity:
+    gluon/contrib HybridConcurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return invoke("concat", outs, dim=self.axis)
+
+
+class HybridConcatenate(HybridSequential):
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def forward(self, x):
+        outs = [block(x) for block in self._children.values()]
+        return invoke("concat", outs, dim=self.axis)
+
+
+# -- activation layers (parity: gluon/nn/activations.py) -------------------
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        super().__init__(**kwargs)
+        self._act_type = activation
+
+    def forward(self, x):
+        return invoke("Activation", [x], act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha=0.01, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=init_mod.Constant(0.25),
+                 in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        self.alpha = Parameter(name="alpha", shape=(in_channels,),
+                               init=alpha_initializer)
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x, self.alpha.data()], act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def forward(self, x):
+        return invoke("LeakyReLU", [x], act_type="selu")
+
+
+class GELU(HybridBlock):
+    def __init__(self, approximation="erf", **kwargs):
+        super().__init__(**kwargs)
+        self._approx = approximation
+
+    def forward(self, x):
+        return invoke("LeakyReLU", [x],
+                      act_type="gelu" if self._approx == "erf" else "gelu_tanh")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def forward(self, x):
+        b = self._beta
+        return apply_jax(lambda a: a * (1.0 / (1.0 + jnp.exp(-b * a))), [x])
+
+
+SiLU = Swish
+
+
+class Softmax(HybridBlock):
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+
+    def forward(self, x):
+        return invoke("softmax", [x], axis=self._axis)
+
+
+class LogSoftmax(HybridBlock):
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+
+    def forward(self, x):
+        return invoke("log_softmax", [x], axis=self._axis)

@@ -1,0 +1,275 @@
+"""Gluon Parameter.
+
+Parity: python/mxnet/gluon/parameter.py:47 (Parameter: deferred init,
+grad_req, lr/wd multipliers, per-context data) — on TPU a parameter is
+one logical array; multi-device placement is a sharding annotation
+applied by the parallel trainer (pjit/GSPMD), not per-device copies.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..context import Context, current_context
+from ..ndarray import NDArray
+from .. import initializer as init_mod
+from .. import autograd as ag
+
+__all__ = ["Parameter", "Constant", "ParameterDict",
+           "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter accessed before its shape is known (parity:
+    parameter.py DeferredInitializationError)."""
+
+
+def _shape_known(shape) -> bool:
+    return shape is not None and all(s > 0 for s in shape)
+
+
+class Parameter:
+    """A weight/bias/state tensor of a Block.
+
+    Parity: gluon/parameter.py:47.  ``grad_req`` in {'write','add','null'};
+    deferred init completes on first forward when the dependent dim is
+    seen (parity: :336,418).
+    """
+
+    def __init__(self, name: str = "weight", grad_req: str = "write",
+                 shape=None, dtype="float32", lr_mult: float = 1.0,
+                 wd_mult: float = 1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = np_dtype(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self.grad_req = grad_req if differentiable else "null"
+        self._differentiable = differentiable
+        self._data: Optional[NDArray] = None
+        self._grad: Optional[NDArray] = None
+        self._deferred_init: Optional[Tuple[Any, Any]] = None  # (init, ctx)
+        self._trainer = None
+        self._uuid = id(self)
+        self._sharding = None  # jax.sharding.PartitionSpec set by parallel
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._name
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape):
+            raise MXNetError(f"shape rank mismatch for {self.name}")
+        merged = []
+        for s0, s1 in zip(self._shape, new_shape):
+            if s0 <= 0:
+                merged.append(s1)
+            elif s1 <= 0 or s0 == s1:
+                merged.append(s0)
+            else:
+                raise MXNetError(
+                    f"incompatible shape for {self.name}: {self._shape} vs "
+                    f"{tuple(new_shape)}")
+        self._shape = tuple(merged)
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, " \
+               f"dtype={self.dtype})"
+
+    # -- init --------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        """Parity: parameter.py Parameter.initialize."""
+        if self._data is not None and not force_reinit:
+            return
+        default_init = default_init or init_mod.Uniform()
+        eff_init = self.init if init is None else init
+        if not _shape_known(self.shape):
+            if not self.allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize {self.name}: shape {self.shape} "
+                    "unknown and deferred init not allowed")
+            self._deferred_init = (eff_init or default_init, ctx)
+            return
+        self._finish_init(eff_init or default_init, ctx)
+
+    def _finish_init(self, initializer, ctx):
+        initializer = init_mod.create(initializer) \
+            if not isinstance(initializer, init_mod.Initializer) else initializer
+        data = initializer.init_array(self.name, self.shape, self.dtype)
+        self._data = NDArray(data, ctx=ctx if isinstance(ctx, Context) else
+                             (ctx[0] if ctx else None))
+        self._deferred_init = None
+        self._init_grad()
+
+    def _finish_deferred_init(self, inferred_shape=None):
+        if inferred_shape is not None:
+            self.shape = inferred_shape
+        if self._deferred_init is None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} was not initialized — call "
+                "net.initialize() first")
+        initializer, ctx = self._deferred_init
+        self._finish_init(initializer, ctx)
+
+    def _init_grad(self):
+        if self.grad_req == "null":
+            self._grad = None
+            return
+        self._grad = NDArray(jnp.zeros(self._data.shape, self._data.dtype))
+        ag.mark_variables([self._data_nd()], [self._grad], self.grad_req)
+
+    # -- access ------------------------------------------------------------
+    def _data_nd(self) -> NDArray:
+        return self._data
+
+    def _check_initialized(self):
+        if self._data is not None:
+            return
+        if self._deferred_init is not None:
+            raise DeferredInitializationError(
+                f"parameter {self.name} deferred (shape {self.shape})")
+        raise MXNetError(
+            f"parameter {self.name} has not been initialized; call "
+            "net.initialize()")
+
+    def data(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        return self._data
+
+    def list_data(self) -> List[NDArray]:
+        return [self.data()]
+
+    def grad(self, ctx=None) -> NDArray:
+        self._check_initialized()
+        if self._grad is None:
+            raise MXNetError(
+                f"cannot get gradient for parameter {self.name}: grad_req is "
+                "'null'")
+        return self._grad
+
+    def list_grad(self) -> List[NDArray]:
+        return [self.grad()]
+
+    def list_ctx(self):
+        self._check_initialized()
+        return [self._data.context]
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._rebind(jnp.zeros(self._grad.shape, self._grad.dtype))
+
+    def set_data(self, data):
+        if isinstance(data, NDArray):
+            data = data._data
+        if self._data is None:
+            self.shape = tuple(data.shape)
+            self._data = NDArray(data)
+            self._deferred_init = None
+            self._init_grad()
+        else:
+            self._data._rebind(jnp.asarray(data).astype(self._data.dtype))
+
+    def reset_ctx(self, ctx):
+        if self._data is not None:
+            self._data._rebind(self._data.as_in_context(
+                ctx if isinstance(ctx, Context) else ctx[0])._data)
+
+    def cast(self, dtype):
+        self.dtype = np_dtype(dtype)
+        if self._data is not None:
+            self._data._rebind(self._data._data.astype(self.dtype))
+            if self._grad is not None:
+                self._grad._rebind(self._grad._data.astype(self.dtype))
+                ag.mark_variables([self._data], [self._grad], self.grad_req)
+
+    def var(self):
+        from ..symbol import Symbol
+        return Symbol.var(self.name)
+
+    def shard(self, partition_spec):
+        """TPU-native extension: annotate this parameter with a GSPMD
+        PartitionSpec consumed by mxnet_tpu.parallel."""
+        self._sharding = partition_spec
+        return self
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (parity: parameter.py Constant)."""
+
+    def __init__(self, value, name: str = "const"):
+        if isinstance(value, NDArray):
+            value = value.asnumpy()
+        value = onp.asarray(value)
+        super().__init__(name=name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype,
+                         init=init_mod.Constant(0.0), differentiable=False)
+        self._value = value
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        self._data = NDArray(self._value)
+        self._deferred_init = None
+
+
+class ParameterDict(dict):
+    """dict of name → Parameter with batch ops.
+
+    The 2.0 reference returns a plain dict from ``collect_params``; the
+    helper methods here cover the 1.x ParameterDict idioms tests rely on.
+    """
+
+    def initialize(self, init=None, ctx=None, force_reinit=False, **kwargs):
+        for p in self.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for p in self.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for p in self.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for p in self.values():
+            setattr(p, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+        arg = {}
+        for name, p in self.items():
+            key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
+                else name
+            arg[key] = p.data()
+        nd_save(filename, arg)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import load as nd_load
+        loaded = nd_load(filename)
+        for name, p in self.items():
+            key = restore_prefix + name
+            if key in loaded:
+                p.set_data(loaded[key])
+            elif not allow_missing:
+                raise MXNetError(f"parameter {key} missing in {filename}")
+        if not ignore_extra:
+            extra = set(loaded) - {restore_prefix + n for n in self}
+            if extra:
+                raise MXNetError(f"extra parameters in {filename}: {extra}")

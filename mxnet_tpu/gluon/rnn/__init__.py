@@ -1,0 +1,9 @@
+"""gluon.rnn (parity: python/mxnet/gluon/rnn/)."""
+from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, BidirectionalCell, DropoutCell,
+                       ResidualCell, ZoneoutCell)
+from .rnn_layer import RNN, LSTM, GRU
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "ZoneoutCell", "RNN", "LSTM", "GRU"]

@@ -1,0 +1,315 @@
+"""RNN cell library.
+
+Parity: python/mxnet/gluon/rnn/rnn_cell.py (RNNCell, LSTMCell, GRUCell,
+SequentialRNNCell, BidirectionalCell, DropoutCell, ResidualCell,
+ZoneoutCell) — unrolled step-by-step; the fused layers in rnn_layer.py
+use lax.scan (the TPU path; parity with the cuDNN fused RNN op).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ...ndarray import NDArray
+from ...ops.registry import invoke, apply_jax
+from ... import initializer as init_mod
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "ZoneoutCell"]
+
+
+class RecurrentCell(HybridBlock):
+    """Base cell (parity: rnn_cell.py RecurrentCell)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(nd.zeros(shape, **kwargs) if func is None
+                          else func(shape=shape, **kwargs))
+        return states
+
+    def reset(self):
+        pass
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Unroll the cell for `length` steps (parity: rnn_cell.py unroll)."""
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        steps = [invoke("squeeze", [invoke("slice_axis", [inputs], axis=axis,
+                                           begin=i, end=i + 1)], axis=axis)
+                 for i in range(length)]
+        outputs = []
+        states = begin_state
+        for i in range(length):
+            out, states = self(steps[i], states)
+            outputs.append(out)
+        if valid_length is not None:
+            stacked = invoke("stack", outputs, axis=axis)
+            stacked = invoke("SequenceMask", [stacked, valid_length],
+                             use_sequence_length=True, axis=axis)
+            outputs = stacked
+            merge_outputs = True
+        if merge_outputs is None:
+            merge_outputs = False
+        if merge_outputs and not isinstance(outputs, NDArray):
+            outputs = invoke("stack", outputs, axis=axis)
+        return outputs, states
+
+
+class _BaseRNNCell(RecurrentCell):
+    def __init__(self, hidden_size, num_gates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = num_gates
+        self.i2h_weight = Parameter(shape=(ng * hidden_size, input_size),
+                                    init=i2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.h2h_weight = Parameter(shape=(ng * hidden_size, hidden_size),
+                                    init=h2h_weight_initializer,
+                                    allow_deferred_init=True)
+        self.i2h_bias = Parameter(shape=(ng * hidden_size,),
+                                  init=init_mod.create(i2h_bias_initializer),
+                                  allow_deferred_init=True)
+        self.h2h_bias = Parameter(shape=(ng * hidden_size,),
+                                  init=init_mod.create(h2h_bias_initializer),
+                                  allow_deferred_init=True)
+        self._num_gates = ng
+
+    def _finish_deferred(self, x):
+        if self.i2h_weight._deferred_init is not None:
+            self.i2h_weight._finish_deferred_init(
+                (self._num_gates * self._hidden_size, x.shape[-1]))
+        for p in (self.h2h_weight, self.i2h_bias, self.h2h_bias):
+            if p._deferred_init is not None:
+                p._finish_deferred_init(None)
+
+
+class RNNCell(_BaseRNNCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._finish_deferred(x)
+        h = states[0]
+        out = invoke("FullyConnected",
+                     [x, self.i2h_weight.data(), self.i2h_bias.data()],
+                     num_hidden=self._hidden_size, flatten=False) + \
+            invoke("FullyConnected",
+                   [h, self.h2h_weight.data(), self.h2h_bias.data()],
+                   num_hidden=self._hidden_size, flatten=False)
+        out = invoke("Activation", [out], act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(_BaseRNNCell):
+    """Parity: rnn_cell.py LSTMCell — gate order i, f, c, o (MXNet fused
+    RNN convention, rnn-inl.h)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._finish_deferred(x)
+        h, c = states
+        nh = self._hidden_size
+
+        def fn(xx, hh, cc, wi, wh, bi, bh):
+            gates = xx @ wi.T + bi + hh @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i = jnp.reciprocal(1 + jnp.exp(-i))
+            f = jnp.reciprocal(1 + jnp.exp(-f))
+            o = jnp.reciprocal(1 + jnp.exp(-o))
+            g = jnp.tanh(g)
+            new_c = f * cc + i * g
+            new_h = o * jnp.tanh(new_c)
+            return new_h, new_c
+
+        new_h, new_c = apply_jax(
+            fn, [x, h, c, self.i2h_weight.data(), self.h2h_weight.data(),
+                 self.i2h_bias.data(), self.h2h_bias.data()], multi_out=True)
+        return new_h, [new_h, new_c]
+
+
+class GRUCell(_BaseRNNCell):
+    """Parity: rnn_cell.py GRUCell — gate order r, z, n (reset/update/new)."""
+
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def forward(self, x, states):
+        self._finish_deferred(x)
+        h = states[0]
+
+        def fn(xx, hh, wi, wh, bi, bh):
+            gi = xx @ wi.T + bi
+            gh = hh @ wh.T + bh
+            ir, iz, inn = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jnp.reciprocal(1 + jnp.exp(-(ir + hr)))
+            z = jnp.reciprocal(1 + jnp.exp(-(iz + hz)))
+            n = jnp.tanh(inn + r * hn)
+            return (1 - z) * n + z * hh
+
+        new_h = apply_jax(
+            fn, [x, h, self.i2h_weight.data(), self.h2h_weight.data(),
+                 self.i2h_bias.data(), self.h2h_bias.data()])
+        return new_h, [new_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for cell in self._children.values():
+            infos.extend(cell.state_info(batch_size))
+        return infos
+
+    def forward(self, x, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            x, s = cell(x, states[p:p + n])
+            next_states.extend(s)
+            p += n
+        return x, next_states
+
+    def __len__(self):
+        return len(self._children)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, x, states):
+        from ... import autograd as ag
+        if self._rate > 0 and ag.is_training():
+            from ...ops.random import next_key
+            x = invoke("Dropout", [x, NDArray(next_key())], p=self._rate,
+                       axes=self._axes)
+        return x, states
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0,
+                 **kwargs):
+        super().__init__(base_cell, **kwargs)
+        self._zo, self._zs = zoneout_outputs, zoneout_states
+        self._prev_output = None
+
+    def forward(self, x, states):
+        from ... import autograd as ag
+        out, new_states = self.base_cell(x, states)
+        if ag.is_training():
+            from ...ops.random import next_key
+            import jax
+            if self._zo > 0:
+                mask = jax.random.bernoulli(next_key(), self._zo, out.shape)
+                prev = self._prev_output if self._prev_output is not None \
+                    else out * 0
+                out = apply_jax(lambda m, o, p: jnp.where(m, p, o),
+                                [NDArray(mask.astype(jnp.float32) > 0), out,
+                                 prev])
+            if self._zs > 0:
+                zipped = []
+                for new_s, old_s in zip(new_states, states):
+                    mask = jax.random.bernoulli(next_key(), self._zs,
+                                                new_s.shape)
+                    zipped.append(apply_jax(
+                        lambda m, n, o: jnp.where(m, o, n),
+                        [NDArray(mask.astype(jnp.float32) > 0), new_s, old_s]))
+                new_states = zipped
+        self._prev_output = out
+        return out, new_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError("BidirectionalCell cannot be stepped; "
+                                  "use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        axis = layout.find("T")
+        batch = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch)
+        nl = len(self.l_cell.state_info())
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, merge_outputs=True,
+            valid_length=valid_length)
+        rev = invoke("SequenceReverse", [inputs, valid_length],
+                     use_sequence_length=valid_length is not None, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, begin_state[nl:], layout, merge_outputs=True,
+            valid_length=valid_length)
+        r_out = invoke("SequenceReverse", [r_out, valid_length],
+                       use_sequence_length=valid_length is not None, axis=axis)
+        out = invoke("concat", [l_out, r_out], dim=2)
+        return out, l_states + r_states

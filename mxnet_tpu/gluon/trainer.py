@@ -1,0 +1,163 @@
+"""Gluon Trainer.
+
+Parity: python/mxnet/gluon/trainer.py:31 (kvstore setup :188, step :334,
+allreduce_grads :363, update :444).  On TPU the multi-device gradient
+reduction rides XLA collectives: with a `device` kvstore the grads are
+already mesh-reduced inside the compiled step (see mxnet_tpu.parallel);
+with `dist_*` kvstores the push/pull maps to jax.distributed collectives.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import optimizer as opt_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            param_list = []
+            for key in sorted(params.keys()):
+                param_list.append(params[key])
+            self._param2name = {id(p): n for n, p in params.items()}
+            params = param_list
+        else:
+            params = list(params)
+            self._param2name = {id(p): getattr(p, "name", str(i))
+                                for i, p in enumerate(params)}
+        self._params: List[Parameter] = []
+        self._params_to_init: List[Parameter] = []
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    f"Trainer expects Parameter instances, got {type(param)}")
+            param._trainer = self
+            self._params.append(param)
+        self._scale = 1.0
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_params = {"kvstore": kvstore,
+                                "update_on_kvstore": update_on_kvstore}
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._distributed = False
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        if isinstance(optimizer, opt_mod.Optimizer):
+            if optimizer_params:
+                raise MXNetError("optimizer_params must be empty when "
+                                 "optimizer is an Optimizer instance")
+            self._optimizer = optimizer
+        else:
+            self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._optimizer.param_dict = param_dict
+        self._updaters = [opt_mod.get_updater(self._optimizer)]
+
+    # -- kvstore (parity: trainer.py:188 _init_kvstore) --------------------
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kv = config["kvstore"]
+        if kv is None or kv is False:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        else:
+            from .. import kvstore as kv_mod
+            if isinstance(kv, str):
+                self._kvstore = kv_mod.create(kv)
+            else:
+                self._kvstore = kv
+            self._distributed = "dist" in getattr(self._kvstore, "type", "")
+            uok = config["update_on_kvstore"]
+            if uok is None:
+                uok = bool(self._distributed) and \
+                    self._kvstore.has_capability("optimizer")
+            if uok and not self._kvstore.has_capability("optimizer"):
+                uok = False
+            self._update_on_kvstore = uok
+            if self._compression_params:
+                self._kvstore.set_gradient_compression(self._compression_params)
+            # register params with the store
+            for i, p in enumerate(self._params):
+                if p._data is not None:
+                    self._kvstore.init(str(i), p.data())
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # -- training step (parity: trainer.py step:334) -----------------------
+    def step(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None:
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and param._grad is not None:
+                if self._update_on_kvstore:
+                    self._kvstore.pushpull(str(i), param.grad(),
+                                           out=param.data())
+                else:
+                    self._kvstore.pushpull(str(i), param.grad(),
+                                           out=param.grad())
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        if self._update_on_kvstore and self._kvstore is not None:
+            return  # weights already updated server-side during pushpull
+        updater = self._updaters[0]
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if param._grad is None:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(f"parameter {param.name} has no gradient")
+            updater(i, param.grad(), param.data())
+
+    # -- optimizer state persistence (parity: save_states/load_states) -----
+    def save_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "wb") as f:
+            f.write(self._updaters[0].get_states(dump_optimizer=False))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        with open(fname, "rb") as f:
+            self._updaters[0].set_states(f.read())

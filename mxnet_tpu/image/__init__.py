@@ -1,0 +1,13 @@
+"""mx.image — image loading + augmenters.
+
+Parity: python/mxnet/image/ (imread/imdecode/imresize, CreateAugmenter,
+ImageIter) over src/operator/image/.  cv2 is optional; PIL/numpy
+fallbacks keep it working in minimal environments.
+"""
+from .image import (imread, imdecode, imresize, resize_short, fixed_crop,
+                    center_crop, random_crop, color_normalize, ImageIter,
+                    CreateAugmenter, Augmenter)
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter",
+           "CreateAugmenter", "Augmenter"]

@@ -1,0 +1,307 @@
+"""Image utilities + ImageIter.
+
+Parity: python/mxnet/image/image.py (imread/imdecode/imresize, crop
+helpers, Augmenter chain via CreateAugmenter, ImageIter over .rec files).
+"""
+from __future__ import annotations
+
+import os
+import random as pyrandom
+from typing import List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..io.io import DataIter, DataBatch, DataDesc
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter",
+           "CreateAugmenter", "Augmenter"]
+
+
+def _cv2():
+    try:
+        import cv2
+        return cv2
+    except ImportError:
+        return None
+
+
+def imread(filename, flag=1, to_rgb=True) -> NDArray:
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imread(filename, flag)
+        if img is None:
+            raise MXNetError(f"cannot read image {filename}")
+        if to_rgb and img.ndim == 3:
+            img = img[:, :, ::-1]
+        return NDArray(onp.ascontiguousarray(img))
+    try:
+        from PIL import Image
+        img = onp.asarray(Image.open(filename).convert(
+            "RGB" if flag else "L"))
+        return NDArray(img)
+    except ImportError:
+        if filename.endswith(".npy"):
+            return NDArray(onp.load(filename))
+        raise MXNetError("no image backend (cv2/PIL) available")
+
+
+def imdecode(buf, flag=1, to_rgb=True) -> NDArray:
+    cv2 = _cv2()
+    if cv2 is not None:
+        img = cv2.imdecode(onp.frombuffer(buf, dtype=onp.uint8), flag)
+        if img is None:
+            raise MXNetError("image decode failed")
+        if to_rgb and img.ndim == 3:
+            img = img[:, :, ::-1]
+        return NDArray(onp.ascontiguousarray(img))
+    import io as _io
+    try:
+        return NDArray(onp.load(_io.BytesIO(buf)))
+    except Exception:
+        from PIL import Image
+        img = onp.asarray(Image.open(_io.BytesIO(buf)))
+        return NDArray(img)
+
+
+def imresize(src, w, h, interp=1) -> NDArray:
+    import jax
+    import jax.numpy as jnp
+    a = src._data if isinstance(src, NDArray) else jnp.asarray(src)
+    out = jax.image.resize(a.astype(jnp.float32), (h, w) + a.shape[2:],
+                           "linear" if interp else "nearest")
+    return NDArray(out.astype(a.dtype))
+
+
+def resize_short(src, size, interp=2) -> NDArray:
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2) -> NDArray:
+    out = NDArray(src._data[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = pyrandom.randint(0, w - new_w)
+    y0 = pyrandom.randint(0, h - new_h)
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    out = src - mean if not isinstance(mean, (int, float)) or mean else src
+    if std is not None:
+        out = out / std
+    return out
+
+
+class Augmenter:
+    """Base augmenter (parity: image.py Augmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src: NDArray) -> NDArray:
+        return src
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return NDArray(src._data[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(typ=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = mean
+        self.std = std
+
+    def __call__(self, src):
+        return color_normalize(src, NDArray(self.mean), NDArray(self.std))
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Parity: image.py CreateAugmenter — builds the standard augmenter
+    chain."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = onp.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = onp.array([58.395, 57.12, 57.375])
+    if mean is not None and std is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over .rec/.lst/raw files (parity: image.py ImageIter
+    over the C++ ImageRecordIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, aug_list=None, imglist=None, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.auglist = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self._records = []
+        if path_imgrec:
+            from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack_img
+            idx = os.path.splitext(path_imgrec)[0] + ".idx"
+            if os.path.exists(idx):
+                rec = MXIndexedRecordIO(idx, path_imgrec, "r")
+                for k in rec.keys:
+                    self._records.append(("rec", rec, k))
+            else:
+                rec = MXRecordIO(path_imgrec, "r")
+                while True:
+                    buf = rec.read()
+                    if buf is None:
+                        break
+                    self._records.append(("raw", buf, None))
+        elif imglist is not None:
+            for entry in imglist:
+                self._records.append(("list", entry[1], entry[0]))
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    label = float(parts[1])
+                    self._records.append(
+                        ("file", os.path.join(path_root, parts[-1]), label))
+        self.shuffle = shuffle
+        self._order = list(range(len(self._records)))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self.cur = 0
+        if self.shuffle:
+            pyrandom.shuffle(self._order)
+
+    def _read_one(self, i):
+        kind, src, extra = self._records[self._order[i]]
+        from ..recordio import unpack_img
+        if kind == "rec":
+            header, img = unpack_img(src.read_idx(extra))
+            label = float(header.label if onp.isscalar(header.label)
+                          else header.label[0])
+            return NDArray(img), label
+        if kind == "raw":
+            header, img = unpack_img(src)
+            label = float(header.label if onp.isscalar(header.label)
+                          else header.label[0])
+            return NDArray(img), label
+        if kind == "file":
+            return imread(src), extra
+        img, label = src, extra
+        return NDArray(img), float(label)
+
+    def next(self):
+        if self.cur >= len(self._records):
+            raise StopIteration
+        datas, labels = [], []
+        for _ in range(self.batch_size):
+            if self.cur >= len(self._records):
+                self.cur = 0  # pad by wraparound
+            img, label = self._read_one(self.cur)
+            self.cur += 1
+            for aug in self.auglist:
+                img = aug(img)
+            arr = img.asnumpy()
+            if arr.ndim == 3 and arr.shape[-1] in (1, 3):
+                arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+            datas.append(arr)
+            labels.append(label)
+        return DataBatch([NDArray(onp.stack(datas))],
+                         [NDArray(onp.asarray(labels, dtype=onp.float32))])
+
+    def iter_next(self):
+        return self.cur < len(self._records)

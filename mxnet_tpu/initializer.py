@@ -1,0 +1,260 @@
+"""Weight initializers.
+
+Parity: python/mxnet/initializer.py (Xavier, MSRAPrelu, Normal, Uniform,
+Orthogonal, One/Zero/Constant, Bilinear, LSTMBias; registry + descriptor
+pattern).
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Callable, Dict, Optional
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ops import random as _rng
+
+__all__ = ["Initializer", "register", "create", "Zero", "One", "Constant",
+           "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
+           "Bilinear", "LSTMBias", "InitDesc", "Mixed"]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(initializer, **kwargs) -> "Initializer":
+    if initializer is None:
+        return Uniform()
+    if isinstance(initializer, Initializer):
+        return initializer
+    if isinstance(initializer, str):
+        name = initializer.lower()
+        if name not in _REGISTRY:
+            raise MXNetError(f"unknown initializer {initializer!r}")
+        return _REGISTRY[name](**kwargs)
+    if callable(initializer):
+        return _Wrapped(initializer)
+    raise MXNetError(f"cannot create initializer from {initializer!r}")
+
+
+class InitDesc(str):
+    """Name + attrs descriptor (parity: initializer.py InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer; callable on (name, array-shape) returning values."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def init_array(self, name: str, shape, dtype) -> jnp.ndarray:
+        name = str(name)
+        if name.endswith("gamma") or "gamma" in name:
+            return self._init_gamma(shape, dtype)
+        if name.endswith("beta") or name.endswith("bias"):
+            return jnp.zeros(shape, dtype)
+        if "running_mean" in name or "moving_mean" in name:
+            return jnp.zeros(shape, dtype)
+        if "running_var" in name or "moving_var" in name:
+            return jnp.ones(shape, dtype)
+        return self._init_weight(name, shape, dtype)
+
+    def _init_gamma(self, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+    def _init_weight(self, name, shape, dtype):
+        raise NotImplementedError
+
+    def __call__(self, name, arr=None):
+        """Reference-compat: init(InitDesc, NDArray) fills arr in place."""
+        from .ndarray import NDArray
+        if isinstance(arr, NDArray):
+            arr._rebind(self.init_array(name, arr.shape, arr.dtype))
+            return arr
+        raise MXNetError("Initializer.__call__ expects (name, NDArray)")
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+class _Wrapped(Initializer):
+    def __init__(self, fn: Callable):
+        super().__init__()
+        self._fn = fn
+
+    def _init_weight(self, name, shape, dtype):
+        from .ndarray import NDArray
+        arr = NDArray(jnp.zeros(shape, dtype))
+        self._fn(name, arr)
+        return arr._data
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return jnp.zeros(shape, dtype)
+
+
+zeros = Zero  # reference alias @init.register("zeros")
+_REGISTRY["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        return jnp.ones(shape, dtype)
+
+
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, shape, dtype):
+        return jax.random.uniform(_rng.next_key(), shape, jnp.float32,
+                                  -self.scale, self.scale).astype(dtype)
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, shape, dtype):
+        return (self.sigma * jax.random.normal(
+            _rng.next_key(), shape, jnp.float32)).astype(dtype)
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, shape, dtype):
+        nout = shape[0]
+        nin = int(onp.prod(shape[1:])) if len(shape) > 1 else 1
+        key = _rng.next_key()
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(key, (nout, nin), jnp.float32, -1.0, 1.0)
+        else:
+            tmp = jax.random.normal(key, (nout, nin), jnp.float32)
+        u, _, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        return (self.scale * q).reshape(shape).astype(dtype)
+
+
+def _fan(shape, factor_type):
+    hw = 1
+    for s in shape[2:]:
+        hw *= s
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """Parity: initializer.py Xavier (magnitude=3, rnd_type uniform)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, shape, dtype):
+        fan_in, fan_out = _fan(shape, self.factor_type)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        else:
+            factor = fan_out
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        key = _rng.next_key()
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(key, shape, jnp.float32, -scale, scale)
+        else:
+            out = scale * jax.random.normal(key, shape, jnp.float32)
+        return out.astype(dtype)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, shape, dtype):
+        weight = onp.zeros(int(onp.prod(shape)), dtype="float32")
+        f = math.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(onp.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        return jnp.asarray(weight.reshape(shape), dtype)
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 (parity: initializer.py LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, shape, dtype):
+        b = onp.zeros(shape, "float32")
+        n = shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        return jnp.asarray(b, dtype)
+
+
+class Mixed:
+    """Pattern-matched initializer mix (parity: initializer.py Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(str(name)):
+                init(name, arr)
+                return
+        raise MXNetError(f"parameter {name} did not match any pattern")

@@ -1,0 +1,10 @@
+"""mx.io — legacy DataIter API.
+
+Parity: python/mxnet/io/io.py (DataIter :179, NDArrayIter :490,
+MXDataIter :799) + DataBatch/DataDesc.
+"""
+from .io import (DataIter, DataBatch, DataDesc, NDArrayIter, CSVIter,
+                 ResizeIter, PrefetchingIter)
+
+__all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter"]

@@ -1,0 +1,261 @@
+"""Legacy data iterators.
+
+Parity: python/mxnet/io/io.py — DataIter protocol (provide_data/
+provide_label, next/reset), NDArrayIter with shuffle + last-batch
+handling, CSVIter, prefetching wrapper over the same protocol the C++
+iterator chain implements (src/io/iter_prefetcher.h).
+"""
+from __future__ import annotations
+
+import threading
+import queue as _queue
+from collections import namedtuple
+from typing import Any, List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter"]
+
+
+class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
+    def __new__(cls, name, shape, dtype=onp.float32, layout="NCHW"):
+        ret = super().__new__(cls, name, shape)
+        ret.dtype = dtype
+        ret.layout = layout
+        return ret
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 provide_data=None, provide_label=None):
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    """Parity: io.py DataIter."""
+
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self) -> DataBatch:
+        if self.iter_next():
+            return DataBatch(self.getdata(), self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        return 0
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (onp.ndarray, NDArray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{'_' + str(i) if i else ''}": d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if isinstance(v, NDArray):
+            v = v.asnumpy()
+        out.append((k, onp.asarray(v)))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Parity: io.py NDArrayIter:490 (shuffle, pad/discard/roll_over)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, False, data_name)
+        self.label = _init_data(label, True, label_name)
+        self.num_data = self.data[0][1].shape[0] if self.data else 0
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._idx = onp.arange(self.num_data)
+        if shuffle:
+            onp.random.shuffle(self._idx)
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + v.shape[1:], v.dtype)
+                for k, v in self.label]
+
+    def reset(self):
+        self.cursor = -self.batch_size
+        if self.shuffle:
+            onp.random.shuffle(self._idx)
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        if self.last_batch_handle == "discard":
+            return self.cursor + self.batch_size <= self.num_data
+        return self.cursor < self.num_data
+
+    def _take(self, source):
+        end = self.cursor + self.batch_size
+        if end <= self.num_data:
+            sel = self._idx[self.cursor:end]
+        else:  # pad by wrapping around
+            pad = end - self.num_data
+            sel = onp.concatenate([self._idx[self.cursor:], self._idx[:pad]])
+        return [NDArray(v[sel]) for _, v in source]
+
+    def getdata(self):
+        return self._take(self.data)
+
+    def getlabel(self):
+        return self._take(self.label)
+
+    def getpad(self):
+        end = self.cursor + self.batch_size
+        return max(0, end - self.num_data)
+
+
+class CSVIter(DataIter):
+    """Parity: the C++ CSVIter (src/io/iter_csv.cc) — host-side here."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=None,
+                 batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        data = onp.loadtxt(data_csv, delimiter=",", dtype=onp.float32)
+        data = data.reshape((-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = onp.loadtxt(label_csv, delimiter=",", dtype=onp.float32)
+            if label_shape:
+                label = label.reshape((-1,) + tuple(label_shape))
+        self._inner = NDArrayIter(data, label, batch_size,
+                                  last_batch_handle="pad")
+
+    def reset(self):
+        self._inner.reset()
+
+    def next(self):
+        return self._inner.next()
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ResizeIter(DataIter):
+    """Resize an iterator to a fixed number of batches (parity: io.py
+    ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def next(self):
+        if self.cur == self.size:
+            raise StopIteration
+        try:
+            batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            batch = self.data_iter.next()
+        self.cur += 1
+        return batch
+
+    def iter_next(self):
+        return self.cur < self.size
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetcher (parity: io.py PrefetchingIter over the
+    C++ threaded prefetcher, src/io/iter_prefetcher.h)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
+        if not isinstance(iters, list):
+            iters = [iters]
+        if len(iters) != 1:
+            raise MXNetError("PrefetchingIter here supports one base iter")
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._depth = prefetch_depth
+        self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
+        self._thread = None
+        self._stop = threading.Event()
+        self._start()
+
+    def _start(self):
+        def run():
+            while not self._stop.is_set():
+                try:
+                    batch = self.iter.next()
+                except StopIteration:
+                    self._queue.put(None)
+                    return
+                self._queue.put(batch)
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def reset(self):
+        self._stop.set()
+        try:
+            while True:
+                self._queue.get_nowait()
+        except _queue.Empty:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self._stop.clear()
+        self.iter.reset()
+        self._start()
+
+    def next(self):
+        batch = self._queue.get()
+        if batch is None:
+            raise StopIteration
+        return batch
+
+    def iter_next(self):
+        return True

@@ -1,0 +1,16 @@
+"""mx.kv — key-value stores for parameter synchronization.
+
+Parity: python/mxnet/kvstore/ + src/kvstore/ (SURVEY.md §2.3).  Backends:
+- 'local'/'device': single-process (src/kvstore/kvstore_local.h,
+  kvstore_nccl.h) — host reduce or GSPMD psum over ICI.
+- 'dist_sync'/'dist_async'/'dist_device_sync': multi-host over
+  jax.distributed + DCN/ICI collectives (src/kvstore/kvstore_dist.h);
+  parameter-server state dissolves into sharded optimizer state.
+"""
+from .base import KVStoreBase, TestStore, create
+from .kvstore import KVStore
+from .gradient_compression import GradientCompression
+from . import dist  # registers DistKVStore
+
+__all__ = ["KVStoreBase", "KVStore", "TestStore", "create",
+           "GradientCompression"]

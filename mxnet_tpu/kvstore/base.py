@@ -1,0 +1,140 @@
+"""KVStore base + registry.
+
+Parity: python/mxnet/kvstore/base.py:74-246 (KVStoreBase.register,
+capability query OPTIMIZER, TestStore reference impl).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError
+
+__all__ = ["KVStoreBase", "TestStore", "create"]
+
+_KV_REGISTRY: Dict[str, type] = {}
+
+
+class KVStoreBase:
+    """Abstract key-value store for parameter synchronization."""
+
+    OPTIMIZER = "optimizer"
+
+    type = "base"
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        _KV_REGISTRY[name] = klass
+        return klass
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return False
+
+    def has_capability(self, capability: str) -> bool:
+        return type(self).is_capable(capability)
+
+    # -- interface (parity: include/mxnet/kvstore.h:59-466) ---------------
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        raise NotImplementedError
+
+    def broadcast(self, key, value, out, priority=0):
+        raise NotImplementedError
+
+    def set_optimizer(self, optimizer):
+        raise NotImplementedError
+
+    def set_gradient_compression(self, compression_params):
+        raise NotImplementedError
+
+    @property
+    def rank(self) -> int:
+        return 0
+
+    @property
+    def num_workers(self) -> int:
+        return 1
+
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise NotImplementedError
+
+    def load_optimizer_states(self, fname):
+        raise NotImplementedError
+
+
+def create(name: str = "local", **kwargs) -> KVStoreBase:
+    """Parity: mx.kv.create (src/kvstore/kvstore.cc:41-80).
+
+    Names: 'local', 'device' (single-process; ICI collectives),
+    'dist_sync', 'dist_device_sync', 'dist_async' (multi-host via
+    jax.distributed), 'horovod'-style adapters may register themselves.
+    """
+    if not isinstance(name, str):
+        return name
+    name = name.lower()
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        klass = _KV_REGISTRY["kvstore"]
+        return klass(name)
+    if name.startswith("dist"):
+        klass = _KV_REGISTRY["distkvstore"]
+        return klass(name)
+    if name in _KV_REGISTRY:
+        return _KV_REGISTRY[name](**kwargs)
+    raise MXNetError(f"unknown kvstore type {name!r}")
+
+
+@KVStoreBase.register
+class TestStore(KVStoreBase):
+    """Pure-python reference store (parity: kvstore/base.py:246)."""
+
+    type = "teststore"
+
+    def __init__(self):
+        self._data: Dict[Any, Any] = {}
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return capability != KVStoreBase.OPTIMIZER
+
+    def init(self, key, value):
+        self._data[key] = value.copy() if hasattr(value, "copy") else value
+
+    def push(self, key, value, priority=0):
+        if isinstance(value, (list, tuple)):
+            acc = value[0]
+            for v in value[1:]:
+                acc = acc + v
+            self._data[key] = acc
+        else:
+            self._data[key] = value
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        val = self._data[key]
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for t in targets:
+            if t is not None:
+                val.copyto(t)
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out, priority)
+        return out
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)

@@ -1,0 +1,172 @@
+"""Multi-host distributed KVStore over jax.distributed.
+
+Parity: src/kvstore/kvstore_dist.h (worker ZPush/ZPull over ps-lite) +
+kvstore_dist_server.h (sync aggregation + server-side optimizer).  The
+TPU-native design dissolves the parameter-server: every host holds the
+same replicated params; pushpull is an all-reduce over DCN/ICI issued
+through ``jax.experimental.multihost_utils`` /
+``jax.make_array_from_process_local_data``-style collectives.  Sync mode
+(`dist_sync`) is the natural fit for SPMD; `dist_async`'s
+apply-immediately semantics degenerate to sync on TPU (documented
+divergence — async PS has no ICI analogue, SURVEY.md §7 hard parts).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from ..base import MXNetError, getenv_int
+from ..ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["DistKVStore", "init_distributed"]
+
+_initialized = False
+
+
+def init_distributed(coordinator_address=None, num_processes=None,
+                     process_id=None):
+    """Bootstrap multi-host JAX (parity: ps-lite Scheduler handshake via
+    DMLC_PS_ROOT_URI env; here jax.distributed.initialize with the same
+    env-driven protocol)."""
+    global _initialized
+    if _initialized:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "MXNET_COORDINATOR_ADDR")
+    num_processes = num_processes or getenv_int("DMLC_NUM_WORKER", 0) or None
+    process_id = process_id if process_id is not None else \
+        (getenv_int("DMLC_WORKER_ID", -1) if "DMLC_WORKER_ID" in os.environ
+         else None)
+    if coordinator_address:
+        jax.distributed.initialize(coordinator_address, num_processes,
+                                   process_id)
+    _initialized = True
+
+
+@KVStoreBase.register
+class DistKVStore(KVStoreBase):
+    """'dist_sync' / 'dist_device_sync' / 'dist_async' store."""
+
+    def __init__(self, name: str = "dist_sync"):
+        self.type = name
+        init_distributed()
+        self._data: Dict[Any, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+        self._nproc = jax.process_count()
+        self._rank = jax.process_index()
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return True
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def num_workers(self) -> int:
+        return self._nproc
+
+    def _allreduce(self, value: NDArray) -> NDArray:
+        if self._nproc == 1:
+            return value
+        from jax.experimental import multihost_utils
+        summed = multihost_utils.process_allgather(value._data)
+        return NDArray(jnp.sum(summed, axis=0))
+
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, vals):
+            self._data[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        if len(keys) == 1:
+            value = [value]
+        for k, v in zip(keys, value):
+            local = v
+            if isinstance(v, (list, tuple)):
+                local = v[0]
+                for x in v[1:]:
+                    local = local + x
+            if self._compression is not None:
+                local = self._compression.compress(k, local)
+            reduced = self._allreduce(local)
+            if self._updater is not None and k in self._data:
+                self._updater(_key_int(k), reduced, self._data[k])
+            else:
+                self._data[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        for k, o in zip(keys, outs):
+            val = self._data[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if t is not None:
+                    val.copyto(t)
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            if self._updater is None:
+                self.pull(key, out, priority)
+            else:
+                self.pull(key, out, priority)
+        return out
+
+    def broadcast(self, key, value, out, priority=0):
+        """Broadcast rank-0's value to all (parity: KVStoreDist init +
+        pull; multihost broadcast over DCN)."""
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+            v = value if isinstance(value, NDArray) else value[0]
+            data = multihost_utils.broadcast_one_to_all(v._data)
+            self._data[key] = NDArray(data)
+        else:
+            self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    def barrier(self):
+        if self._nproc > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
+
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

@@ -1,0 +1,48 @@
+"""2-bit gradient compression with error feedback.
+
+Parity: src/kvstore/gradient_compression.h:38-131 (+ .cu kernel): values
+are quantized to {-threshold, 0, +threshold} with the quantization error
+kept as residual and added back next round.  On TPU this runs as a jitted
+elementwise kernel; its role in dist training is optional (EQuARX-style
+quantized collectives are the modern equivalent, see PAPERS.md).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray import NDArray
+
+__all__ = ["GradientCompression"]
+
+
+@jax.jit
+def _quantize_2bit(grad, residual, threshold):
+    acc = grad + residual
+    q = jnp.where(acc >= threshold, threshold,
+                  jnp.where(acc <= -threshold, -threshold, 0.0))
+    new_residual = acc - q
+    return q, new_residual
+
+
+class GradientCompression:
+    def __init__(self, type: str = "2bit", threshold: float = 0.5):
+        if type != "2bit":
+            raise ValueError(f"unsupported compression type {type!r}")
+        self.type = type
+        self.threshold = float(threshold)
+        self._residuals: Dict[int, jnp.ndarray] = {}
+
+    def get_params(self):
+        return {"type": self.type, "threshold": self.threshold}
+
+    def compress(self, key, grad: NDArray) -> NDArray:
+        res = self._residuals.get(key)
+        if res is None:
+            res = jnp.zeros(grad.shape, grad.dtype)
+        q, new_res = _quantize_2bit(grad._data, res,
+                                    jnp.asarray(self.threshold, grad.dtype))
+        self._residuals[key] = new_res
+        return NDArray(q)

@@ -1,0 +1,131 @@
+"""Single-process KVStore ('local'/'device').
+
+Parity: src/kvstore/kvstore_local.h:70 + the Comm hierarchy (comm.h:104
+CommCPU / :452 CommDevice / comm_tree.h topology-aware trees) and
+kvstore_nccl.h.  On TPU a single process sees every local chip through
+one XLA client, so "multi-device reduce" is either a host-side sum of a
+list of per-device values (the KVStoreLocal path) or — on the fast path —
+an in-program ``psum`` placed by GSPMD when training runs under
+mxnet_tpu.parallel.  Topology (the reference's gpu_topology.h spanning
+trees) is XLA's problem: ICI rings are chosen by the compiler.
+"""
+from __future__ import annotations
+
+import pickle
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .base import KVStoreBase
+
+__all__ = ["KVStore"]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Parity: mx.kv.create('local'|'device') wrapper
+    (python/mxnet/kvstore/kvstore.py:54)."""
+
+    def __init__(self, name: str = "device"):
+        self.type = name
+        self._data: Dict[Any, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression = None
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return True  # supports server-side (here: store-side) optimizer
+
+    def _reduce(self, value):
+        if isinstance(value, (list, tuple)):
+            acc = value[0]
+            for v in value[1:]:
+                acc = acc + v
+            return acc
+        return value
+
+    def init(self, key, value):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        for k, v in zip(keys, vals):
+            self._data[k] = v.copy()
+
+    def push(self, key, value, priority=0):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        if len(keys) == 1:
+            value = [value]
+        for k, v in zip(keys, value):
+            reduced = self._reduce(v)
+            if self._updater is not None:
+                if k not in self._data:
+                    self._data[k] = reduced.copy()
+                else:
+                    self._updater(_key_int(k), reduced, self._data[k])
+            else:
+                self._data[k] = reduced
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        if len(keys) == 1 and len(outs) > 1:
+            outs = [outs]
+        for k, o in zip(keys, outs):
+            val = self._data[k]
+            targets = o if isinstance(o, (list, tuple)) else [o]
+            for t in targets:
+                if t is not None:
+                    val.copyto(t)
+        return out
+
+    def pushpull(self, key, value, out=None, priority=0):
+        if self._updater is not None:
+            # server-side optimizer: push applies update, pull returns weight
+            self.push(key, value, priority)
+            if out is not None:
+                self.pull(key, out, priority)
+            return out
+        # plain allreduce semantics
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        vals = value if isinstance(value, (list, tuple)) else [value]
+        if len(keys) == 1:
+            vals = [value]
+        for k, v in zip(keys, vals):
+            self._data[k] = self._reduce(v)
+        if out is not None:
+            self.pull(key, out, priority)
+        return out
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        if out is not None:
+            self.pull(key, out, priority)
+
+    # -- optimizer (parity: update_on_kvstore / set_updater path) ----------
+    def set_optimizer(self, optimizer):
+        from .. import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_gradient_compression(self, compression_params):
+        from .gradient_compression import GradientCompression
+        self._compression = GradientCompression(**compression_params)
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k

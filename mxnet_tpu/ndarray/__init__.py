@@ -1,0 +1,24 @@
+"""mx.nd — the imperative NDArray namespace.
+
+Op functions are generated from the registry at import time, exactly as
+the reference code-gens this module from its C op registry
+(python/mxnet/ndarray/register.py).
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      linspace, eye, concat, stack, waitall, save, load,
+                      from_numpy, from_dlpack)
+from .register import populate_namespace, make_op_func
+from . import random
+from . import linalg
+
+populate_namespace(globals())
+
+# reference-compat names
+def zeros_like(a):  # noqa: F811 — registry version takes NDArray only too
+    from ..ops.registry import invoke
+    return invoke("zeros_like", [a])
+
+
+def ones_like(a):
+    from ..ops.registry import invoke
+    return invoke("ones_like", [a])

@@ -1,0 +1,135 @@
+"""mx.nd.linalg — linear-algebra op namespace.
+
+Parity: src/operator/tensor/la_op.cc (LAPACK/cuBLAS wrappers,
+linalg_impl.h).  On TPU these lower through XLA's linalg ops; the MXU
+handles the matmuls, the host/vector units the factorizations.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import jax.scipy.linalg as jsl
+
+from ..ops.registry import apply_jax
+
+__all__ = ["gemm", "gemm2", "potrf", "potri", "trsm", "trmm", "syrk",
+           "gelqf", "syevd", "sumlogdiag", "extractdiag", "makediag",
+           "extracttrian", "maketrian", "inverse", "det", "slogdet"]
+
+
+def gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0, beta=1.0,
+         axis=-2):
+    def fn(a, b, c):
+        ta = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        tb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return alpha * jnp.matmul(ta, tb) + beta * c
+    return apply_jax(fn, [A, B, C])
+
+
+def gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, axis=-2):
+    def fn(a, b):
+        ta = jnp.swapaxes(a, -1, -2) if transpose_a else a
+        tb = jnp.swapaxes(b, -1, -2) if transpose_b else b
+        return alpha * jnp.matmul(ta, tb)
+    return apply_jax(fn, [A, B])
+
+
+def potrf(A, lower=True):
+    return apply_jax(lambda a: jnp.linalg.cholesky(a) if lower else
+                     jnp.swapaxes(jnp.linalg.cholesky(a), -1, -2), [A])
+
+
+def potri(A, lower=True):
+    def fn(a):
+        eye = jnp.eye(a.shape[-1], dtype=a.dtype)
+        inv = jsl.solve_triangular(a, eye, lower=lower)
+        return jnp.matmul(jnp.swapaxes(inv, -1, -2), inv) if lower else \
+            jnp.matmul(inv, jnp.swapaxes(inv, -1, -2))
+    return apply_jax(fn, [A])
+
+
+def trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    def fn(a, b):
+        if rightside:
+            x = jsl.solve_triangular(jnp.swapaxes(a, -1, -2),
+                                     jnp.swapaxes(b, -1, -2),
+                                     lower=not lower, trans=1 if transpose else 0)
+            return alpha * jnp.swapaxes(x, -1, -2)
+        return alpha * jsl.solve_triangular(a, b, lower=lower,
+                                            trans=1 if transpose else 0)
+    return apply_jax(fn, [A, B])
+
+
+def trmm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0):
+    def fn(a, b):
+        tri = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            tri = jnp.swapaxes(tri, -1, -2)
+        return alpha * (jnp.matmul(b, tri) if rightside else jnp.matmul(tri, b))
+    return apply_jax(fn, [A, B])
+
+
+def syrk(A, transpose=False, alpha=1.0):
+    def fn(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose else jnp.matmul(a, at))
+    return apply_jax(fn, [A])
+
+
+def gelqf(A):
+    def fn(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return apply_jax(fn, [A], multi_out=True)
+
+
+def syevd(A):
+    def fn(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+    return apply_jax(fn, [A], multi_out=True)
+
+
+def sumlogdiag(A):
+    return apply_jax(lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2, axis2=-1)),
+                                       axis=-1), [A])
+
+
+def extractdiag(A, offset=0):
+    return apply_jax(lambda a: jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1),
+                     [A])
+
+
+def makediag(A, offset=0):
+    return apply_jax(lambda a: jnp.vectorize(
+        lambda v: jnp.diag(v, k=offset), signature="(n)->(m,m)")(a), [A])
+
+
+def extracttrian(A, offset=0, lower=True):
+    def fn(a):
+        n = a.shape[-1]
+        idx = jnp.tril_indices(n, k=offset) if lower else jnp.triu_indices(n, k=offset)
+        return a[..., idx[0], idx[1]]
+    return apply_jax(fn, [A])
+
+
+def maketrian(A, offset=0, lower=True):
+    def fn(a):
+        m = a.shape[-1]
+        # solve n(n+1)/2 = m for n (assumes offset=0)
+        n = int((-1 + (1 + 8 * m) ** 0.5) // 2)
+        idx = jnp.tril_indices(n, k=offset) if lower else jnp.triu_indices(n, k=offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return out.at[..., idx[0], idx[1]].set(a)
+    return apply_jax(fn, [A])
+
+
+def inverse(A):
+    return apply_jax(jnp.linalg.inv, [A])
+
+
+def det(A):
+    return apply_jax(jnp.linalg.det, [A])
+
+
+def slogdet(A):
+    return apply_jax(lambda a: tuple(jnp.linalg.slogdet(a)), [A], multi_out=True)

@@ -1,0 +1,513 @@
+"""NDArray: the imperative tensor.
+
+TPU-native re-expression of the reference NDArray
+(``include/mxnet/ndarray.h:82``, ``src/ndarray/ndarray.cc``): a handle
+wrapping an XLA device buffer (``jax.Array``) whose async-dispatch
+semantics replace the dependency-engine variable protocol —
+``wait_to_read`` == ``block_until_ready``.  In-place mutation rebinds the
+underlying immutable buffer and bumps the autograd version node (the
+engine-var version counter survives as node identity).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Any, Optional, Sequence
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype, dtype_name, check_shape
+from ..context import Context, current_context
+from .. import autograd as ag
+from ..ops import registry as _reg
+from ..ops.registry import apply_jax, invoke
+
+__all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
+           "linspace", "eye", "concat", "stack", "waitall", "save", "load",
+           "from_numpy", "from_dlpack"]
+
+
+def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
+    if isinstance(data, NDArray):
+        data = data._data
+    if isinstance(data, jax.Array):
+        arr = data if dtype is None else data.astype(np_dtype(dtype))
+        if ctx is not None:
+            arr = jax.device_put(arr, ctx.jax_device)
+        return arr
+    was_numpy = isinstance(data, onp.ndarray)
+    np_arr = onp.asarray(data, dtype=np_dtype(dtype) if dtype is not None else None)
+    if dtype is None:
+        if np_arr.dtype == onp.float64:
+            np_arr = np_arr.astype(onp.float32)
+        elif not was_numpy:
+            # python lists/scalars default to float32 (MXNet default dtype)
+            np_arr = np_arr.astype(onp.float32)
+    dev = (ctx or current_context()).jax_device
+    return jax.device_put(jnp.asarray(np_arr), dev)
+
+
+class NDArray:
+    """Multi-dimensional array on a device, with autograd hooks.
+
+    Parity: mx.nd.NDArray (python/mxnet/ndarray/ndarray.py).
+    """
+
+    __slots__ = ("_data", "_node", "_grad", "__weakref__")
+
+    def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
+        self._data = _as_jax(data, ctx, dtype)
+        self._node = None
+        self._grad = None
+
+    # -- autograd plumbing (used by mxnet_tpu.autograd) --------------------
+    def _ensure_node(self):
+        if self._node is None:
+            self._node = ag._Node()
+        return self._node
+
+    def _new_node(self):
+        self._node = ag._Node()
+        return self._node
+
+    def _adopt(self, other: "NDArray"):
+        """In-place update: take other's buffer + graph node, keep grad attach."""
+        old = self._node
+        self._data = other._data
+        self._node = other._node
+        if old is not None and old.grad_array is not None:
+            node = self._ensure_node()
+            node.grad_array = old.grad_array
+            node.grad_req = old.grad_req
+        return self
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return onp.dtype(self._data.dtype)
+
+    @property
+    def size(self):
+        return int(self._data.size)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self) -> Context:
+        dev = next(iter(self._data.devices()))
+        return Context("cpu" if dev.platform == "cpu" else "tpu", dev.id)
+
+    ctx = context
+    device = context
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    @property
+    def stype(self):
+        return "default"  # sparse storage types: see sparse module
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        """Allocate gradient buffer and mark for autograd
+        (parity: ndarray.py attach_grad)."""
+        self._grad = NDArray(jnp.zeros(self.shape, self.dtype))
+        ag.mark_variables([self], [self._grad], grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        ag.backward([self], [out_grad] if out_grad is not None else None,
+                    retain_graph=retain_graph, train_mode=train_mode)
+
+    def detach(self) -> "NDArray":
+        out = NDArray(self._data)
+        return out
+
+    # -- sync / transfer (parity: WaitToRead, CopyFromTo, asnumpy) ---------
+    def wait_to_read(self):
+        self._data.block_until_ready()
+
+    wait_to_write = wait_to_read
+
+    def asnumpy(self) -> onp.ndarray:
+        return onp.asarray(jax.device_get(self._data))
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(()).item()
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise MXNetError("truth value of multi-element NDArray is ambiguous")
+        return bool(self.asscalar())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def astype(self, dtype, copy=True) -> "NDArray":
+        if not copy and self.dtype == np_dtype(dtype):
+            return self
+        return invoke("cast", [self], dtype=dtype_name(np_dtype(dtype)))
+
+    def copy(self) -> "NDArray":
+        return NDArray(self._data)
+
+    def copyto(self, other):
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device))
+        if isinstance(other, NDArray):
+            other._rebind(jax.device_put(
+                self._data.astype(other.dtype),
+                next(iter(other._data.devices()))))
+            return other
+        raise TypeError(f"copyto: unsupported target {type(other)}")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.context:
+            return self
+        return NDArray(jax.device_put(self._data, ctx.jax_device))
+
+    as_in_ctx = as_in_context
+    to_device = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def as_np_ndarray(self):
+        from ..numpy import ndarray as np_ndarray
+        out = np_ndarray(self._data)
+        out._node = self._node
+        return out
+
+    # -- mutation ----------------------------------------------------------
+    def _rebind(self, new_data: jax.Array):
+        """Replace buffer contents; bumps the autograd version
+        (parity: engine var version increment on write)."""
+        old = self._node
+        self._data = new_data
+        self._node = None
+        if old is not None and old.grad_array is not None:
+            node = self._ensure_node()
+            node.grad_array = old.grad_array
+            node.grad_req = old.grad_req
+        return self
+
+    def __setitem__(self, key, value):
+        key = _norm_index(key, self.shape)
+        if isinstance(value, NDArray):
+            if ag.is_recording():
+                res = apply_jax(lambda d, v: d.at[key].set(v.astype(d.dtype)),
+                                [self, value])
+                self._adopt(res)
+                return
+            self._rebind(self._data.at[key].set(value._data.astype(self.dtype)))
+        else:
+            val = jnp.asarray(value, dtype=self.dtype) if not isinstance(
+                value, jax.Array) else value
+            self._rebind(self._data.at[key].set(val))
+
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            idx = key._data.astype(jnp.int32)
+            return apply_jax(lambda d: jnp.take(d, idx, axis=0), [self])
+        key = _norm_index(key, self.shape)
+        return apply_jax(lambda d: d[key], [self])
+
+    # -- arithmetic --------------------------------------------------------
+    def _binop(self, other, name, reverse=False):
+        if isinstance(other, NDArray):
+            a, b = (other, self) if reverse else (self, other)
+            return invoke(name, [a, b])
+        if isinstance(other, (numbers.Number, onp.number)):
+            c = other
+            op = _reg.get(name).fn
+            if reverse:
+                return apply_jax(lambda x: op(jnp.asarray(c, x.dtype)
+                                              if not isinstance(c, bool) else c, x),
+                                 [self])
+            return apply_jax(lambda x: op(x, jnp.asarray(c, x.dtype)
+                                          if not isinstance(c, bool) else c), [self])
+        return NotImplemented
+
+    def __add__(self, o): return self._binop(o, "elemwise_add")
+    def __radd__(self, o): return self._binop(o, "elemwise_add", True)
+    def __sub__(self, o): return self._binop(o, "elemwise_sub")
+    def __rsub__(self, o): return self._binop(o, "elemwise_sub", True)
+    def __mul__(self, o): return self._binop(o, "elemwise_mul")
+    def __rmul__(self, o): return self._binop(o, "elemwise_mul", True)
+    def __truediv__(self, o): return self._binop(o, "elemwise_div")
+    def __rtruediv__(self, o): return self._binop(o, "elemwise_div", True)
+    def __mod__(self, o): return self._binop(o, "broadcast_mod")
+    def __rmod__(self, o): return self._binop(o, "broadcast_mod", True)
+    def __pow__(self, o): return self._binop(o, "broadcast_power")
+    def __rpow__(self, o): return self._binop(o, "broadcast_power", True)
+    def __matmul__(self, o): return self._binop(o, "matmul")
+
+    def __floordiv__(self, o):
+        if isinstance(o, NDArray):
+            return apply_jax(lambda a, b: jnp.floor_divide(a, b), [self, o])
+        return apply_jax(lambda a: jnp.floor_divide(a, o), [self])
+
+    def __iadd__(self, o): return self._adopt(self.__add__(o))
+    def __isub__(self, o): return self._adopt(self.__sub__(o))
+    def __imul__(self, o): return self._adopt(self.__mul__(o))
+    def __itruediv__(self, o): return self._adopt(self.__truediv__(o))
+
+    def __neg__(self): return invoke("negative", [self])
+    def __abs__(self): return invoke("abs", [self])
+
+    def __eq__(self, o): return self._binop(o, "broadcast_equal")
+    def __ne__(self, o): return self._binop(o, "broadcast_not_equal")
+    def __gt__(self, o): return self._binop(o, "broadcast_greater")
+    def __ge__(self, o): return self._binop(o, "broadcast_greater_equal")
+    def __lt__(self, o): return self._binop(o, "broadcast_lesser")
+    def __le__(self, o): return self._binop(o, "broadcast_lesser_equal")
+
+    __hash__ = None  # mutable
+
+    def __repr__(self):
+        return f"\n{self.asnumpy()!r}\n<NDArray {('x'.join(map(str, self.shape)))} " \
+               f"@{self.context}>"
+
+    # -- method-style ops --------------------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if "shape" in kwargs:
+            shape = kwargs["shape"]
+        elif len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return invoke("reshape", [self], shape=tuple(shape),
+                      reverse=kwargs.get("reverse", False))
+
+    def reshape_like(self, other):
+        return invoke("reshape", [self], shape=other.shape)
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return invoke("transpose", [self], axes=axes or None)
+
+    def flatten(self): return invoke("flatten", [self])
+    def expand_dims(self, axis): return invoke("expand_dims", [self], axis=axis)
+    def squeeze(self, axis=None): return invoke("squeeze", [self], axis=axis)
+    def swapaxes(self, dim1, dim2):
+        return invoke("swapaxes", [self], dim1=dim1, dim2=dim2)
+
+    def sum(self, axis=None, keepdims=False):
+        return invoke("sum", [self], axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return invoke("mean", [self], axis=axis, keepdims=keepdims)
+
+    def max(self, axis=None, keepdims=False):
+        return invoke("max", [self], axis=axis, keepdims=keepdims)
+
+    def min(self, axis=None, keepdims=False):
+        return invoke("min", [self], axis=axis, keepdims=keepdims)
+
+    def prod(self, axis=None, keepdims=False):
+        return invoke("prod", [self], axis=axis, keepdims=keepdims)
+
+    def norm(self, ord=2, axis=None, keepdims=False):
+        return invoke("norm", [self], ord=ord, axis=axis, keepdims=keepdims)
+
+    def argmax(self, axis=None, keepdims=False):
+        return invoke("argmax", [self], axis=axis, keepdims=keepdims)
+
+    def argmin(self, axis=None, keepdims=False):
+        return invoke("argmin", [self], axis=axis, keepdims=keepdims)
+
+    def argsort(self, axis=-1, is_ascend=True):
+        return invoke("argsort", [self], axis=axis, is_ascend=is_ascend)
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke("sort", [self], axis=axis, is_ascend=is_ascend)
+
+    def topk(self, k=1, axis=-1, ret_typ="indices", is_ascend=False):
+        return invoke("topk", [self], k=k, axis=axis, ret_typ=ret_typ,
+                      is_ascend=is_ascend)
+
+    def clip(self, a_min=None, a_max=None):
+        return invoke("clip", [self], a_min=a_min, a_max=a_max)
+
+    def abs(self): return invoke("abs", [self])
+    def exp(self): return invoke("exp", [self])
+    def log(self): return invoke("log", [self])
+    def sqrt(self): return invoke("sqrt", [self])
+    def square(self): return invoke("square", [self])
+    def sigmoid(self): return invoke("sigmoid", [self])
+    def tanh(self): return invoke("tanh", [self])
+    def relu(self): return invoke("relu", [self])
+    def softmax(self, axis=-1): return invoke("softmax", [self], axis=axis)
+    def log_softmax(self, axis=-1): return invoke("log_softmax", [self], axis=axis)
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke("dot", [self, other], transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+
+    def slice(self, begin, end, step=None):
+        return invoke("slice", [self], begin=begin, end=end, step=step)
+
+    def slice_axis(self, axis, begin, end):
+        return invoke("slice_axis", [self], axis=axis, begin=begin, end=end)
+
+    def take(self, indices, axis=0, mode="clip"):
+        return invoke("take", [self, indices], axis=axis, mode=mode)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return invoke("one_hot", [self], depth=depth, on_value=on_value,
+                      off_value=off_value)
+
+    def tile(self, reps): return invoke("tile", [self], reps=reps)
+
+    def repeat(self, repeats, axis=None):
+        return invoke("repeat", [self], repeats=repeats, axis=axis)
+
+    def broadcast_to(self, shape):
+        return invoke("broadcast_to", [self], shape=shape)
+
+    def broadcast_like(self, other):
+        return invoke("broadcast_like", [self, other])
+
+    def flip(self, axis): return invoke("flip", [self], axis=axis)
+
+    def tostype(self, stype):
+        if stype != "default":
+            raise MXNetError("sparse storage conversion: use mxnet_tpu.sparse")
+        return self
+
+    # numpy protocol
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+
+def _norm_index(key, shape):
+    """Normalize an index key: NDArray indices → jax arrays (int32)."""
+    if isinstance(key, NDArray):
+        return key._data.astype(jnp.int32) if jnp.issubdtype(
+            key._data.dtype, jnp.number) else key._data
+    if isinstance(key, tuple):
+        return tuple(_norm_index(k, shape) for k in key)
+    if isinstance(key, list):
+        return onp.asarray(key)
+    return key
+
+
+# --------------------------------------------------------------------------
+# factory functions (parity: init ops + ndarray utility functions)
+# --------------------------------------------------------------------------
+
+def array(source_array, ctx=None, dtype=None) -> NDArray:
+    return NDArray(source_array, ctx=ctx, dtype=dtype)
+
+
+def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    return NDArray(jnp.zeros(check_shape(shape), np_dtype(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
+    return NDArray(jnp.ones(check_shape(shape), np_dtype(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kwargs) -> NDArray:
+    return NDArray(jnp.full(check_shape(shape), val, np_dtype(dtype)), ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return zeros(shape, ctx=ctx, dtype=dtype)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArray:
+    out = jnp.arange(start, stop, step, np_dtype(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint,
+                                dtype=np_dtype(dtype)), ctx=ctx)
+
+
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    return NDArray(jnp.eye(N, M if M else None, k, np_dtype(dtype)), ctx=ctx)
+
+
+def concat(*arrays, dim=1):
+    return invoke("concat", list(arrays), dim=dim)
+
+
+def stack(*arrays, axis=0):
+    return invoke("stack", list(arrays), axis=axis)
+
+
+def waitall():
+    from .. import engine
+    engine.wait_all()
+
+
+def from_numpy(a, zero_copy=False):
+    return NDArray(a)
+
+
+def from_dlpack(capsule):
+    return NDArray(jnp.from_dlpack(capsule))
+
+
+# -- serialization (parity: NDArray::Save/Load, src/ndarray/ndarray.cc:1679;
+#    MXNDArraySave/Load C API).  Format: numpy .npz with a manifest key.
+def save(fname: str, data):
+    if isinstance(data, NDArray):
+        payload, names = [data], ["__single__:0"]
+    elif isinstance(data, (list, tuple)):
+        payload, names = list(data), [f"__list__:{i}" for i in range(len(data))]
+    elif isinstance(data, dict):
+        payload, names = list(data.values()), [f"__dict__:{k}" for k in data]
+    else:
+        raise MXNetError("save: data must be NDArray, list, or dict")
+    arrays = {n: p.asnumpy() for n, p in zip(names, payload)}
+    onp.savez(fname, **arrays)
+
+
+def load(fname: str):
+    if not fname.endswith(".npz"):
+        import os
+        if os.path.exists(fname + ".npz") and not os.path.exists(fname):
+            fname = fname + ".npz"
+    with onp.load(fname, allow_pickle=False) as z:
+        keys = list(z.keys())
+        if keys and keys[0].startswith("__single__"):
+            return NDArray(z[keys[0]])
+        if keys and keys[0].startswith("__list__"):
+            order = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))
+            return [NDArray(z[k]) for k in order]
+        out = {}
+        for k in keys:
+            name = k.split(":", 1)[1] if ":" in k else k
+            out[name] = NDArray(z[k])
+        return out

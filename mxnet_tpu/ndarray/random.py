@@ -1,0 +1,117 @@
+"""mx.nd.random — sampling factory functions.
+
+Parity: python/mxnet/ndarray/random.py over src/operator/random/
+samplers.  Stateless jax.random keys are drawn from the global seed
+state (mxnet_tpu.ops.random); inside a CachedOp trace the key is a real
+traced input.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..base import np_dtype, check_shape
+from ..ops import random as _r
+from ..ops.registry import get as _get, apply_jax
+from .ndarray import NDArray
+import functools
+
+__all__ = ["uniform", "normal", "randn", "gamma", "exponential", "poisson",
+           "negative_binomial", "generalized_negative_binomial", "randint",
+           "multinomial", "bernoulli", "shuffle", "laplace", "rayleigh",
+           "gumbel", "logistic", "seed"]
+
+seed = _r.seed
+
+
+def _sample(op_name, shape, dtype, ctx, extra_inputs=(), **params):
+    shape = check_shape(shape if shape is not None else 1)
+    key = _r.next_key()
+    fn = functools.partial(_get(op_name).fn,
+                           shape=shape, dtype=np_dtype(dtype), **params)
+    key_nd = NDArray(key)
+    return apply_jax(lambda k, *rest: fn(k, *rest),
+                     [key_nd, *extra_inputs], record=False)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_uniform", shape, dtype, ctx, low=low, high=high)
+    return out._adopt(r) if out is not None else r
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_normal", shape, dtype, ctx, loc=loc, scale=scale)
+    return out._adopt(r) if out is not None else r
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None):
+    return normal(loc, scale, shape or (1,), dtype, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_gamma", shape, dtype, ctx, alpha=alpha, beta=beta)
+    return out._adopt(r) if out is not None else r
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_exponential", shape, dtype, ctx, lam=1.0 / scale)
+    return out._adopt(r) if out is not None else r
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_poisson", shape, dtype, ctx, lam=lam)
+    return out._adopt(r) if out is not None else r
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_negative_binomial", shape, dtype, ctx, k=k, p=p)
+    return out._adopt(r) if out is not None else r
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, out=None, **kw):
+    r = _sample("_random_generalized_negative_binomial", shape, dtype, ctx,
+                mu=mu, alpha=alpha)
+    return out._adopt(r) if out is not None else r
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    r = _sample("_random_randint", shape, dtype, ctx, low=low, high=high)
+    return out._adopt(r) if out is not None else r
+
+
+def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_bernoulli", shape, dtype, ctx, prob=prob)
+    return out._adopt(r) if out is not None else r
+
+
+def laplace(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_laplace", shape, dtype, ctx, loc=loc, scale=scale)
+    return out._adopt(r) if out is not None else r
+
+
+def rayleigh(scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_rayleigh", shape, dtype, ctx, scale=scale)
+    return out._adopt(r) if out is not None else r
+
+
+def gumbel(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_gumbel", shape, dtype, ctx, loc=loc, scale=scale)
+    return out._adopt(r) if out is not None else r
+
+
+def logistic(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None, **kw):
+    r = _sample("_random_logistic", shape, dtype, ctx, loc=loc, scale=scale)
+    return out._adopt(r) if out is not None else r
+
+
+def multinomial(data, shape=(), get_prob=False, dtype="int32", **kw):
+    key = _r.next_key()
+    fn = functools.partial(_get("_sample_multinomial").fn,
+                           shape=shape, get_prob=get_prob, dtype=np_dtype(dtype))
+    return apply_jax(lambda k, d: fn(k, d), [NDArray(key), data], record=False)
+
+
+def shuffle(data, **kw):
+    key = _r.next_key()
+    return apply_jax(lambda k, d: _get("_shuffle").fn(k, d),
+                     [NDArray(key), data], record=False)

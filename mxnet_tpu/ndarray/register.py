@@ -1,0 +1,85 @@
+"""Generate Python op functions from the registry at import time.
+
+Parity: the reference code-gens ``mx.nd.*`` op modules from the C
+registry on import (python/mxnet/ndarray/register.py:115-277,
+``_init_op_module`` base.py:601).  Here the registry is Python, so
+"codegen" is building wrapper functions that split positional NDArray
+inputs from scalar/static params using the op function's signature.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict
+
+from ..ops import registry as _reg
+from ..ops.registry import apply_jax
+import functools
+
+__all__ = ["make_op_func", "populate_namespace"]
+
+
+def _analyze(fn):
+    sig = inspect.signature(fn)
+    arr_params = []     # positional (array) parameter names
+    kw_params = []      # keyword-only (static attr) names
+    has_var_pos = False
+    for p in sig.parameters.values():
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD):
+            arr_params.append(p.name)
+        elif p.kind == p.VAR_POSITIONAL:
+            has_var_pos = True
+        elif p.kind == p.KEYWORD_ONLY:
+            kw_params.append(p.name)
+    return arr_params, kw_params, has_var_pos
+
+
+def make_op_func(name: str):
+    """Build the user-facing function for a registered op."""
+    op = _reg.get(name)
+    arr_params, kw_params, var_pos = _analyze(op.fn)
+    n_arr = len(arr_params)
+
+    def op_func(*args, out=None, name=None, **kwargs):
+        from .ndarray import NDArray
+
+        if var_pos:
+            inputs = [a for a in args if isinstance(a, NDArray)]
+        else:
+            inputs, extra = [], []
+            for i, a in enumerate(args):
+                if isinstance(a, NDArray):
+                    inputs.append(a)
+                elif a is None and i < n_arr:
+                    continue  # optional array input omitted
+                else:
+                    # scalar positional → map onto keyword-only params in order
+                    extra.append(a)
+            for pname, val in zip(
+                    [k for k in kw_params if k not in kwargs], extra):
+                kwargs[pname] = val
+        # normalize list params to tuples (hashable, jit-safe)
+        for k, v in list(kwargs.items()):
+            if isinstance(v, list):
+                kwargs[k] = tuple(v)
+        fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
+        result = apply_jax(fn, inputs, multi_out=op.multi_out)
+        if out is not None:
+            outs = result if isinstance(result, list) else [result]
+            targets = out if isinstance(out, (list, tuple)) else [out]
+            for t, r in zip(targets, outs):
+                t._adopt(r)
+            return out
+        return result
+
+    op_func.__name__ = name
+    op_func.__doc__ = op.doc or f"Registered op {name} (see mxnet_tpu.ops)."
+    return op_func
+
+
+def populate_namespace(ns: Dict[str, Any], names=None) -> None:
+    """Install op functions into a module namespace dict."""
+    for name in (names or _reg.list_ops()):
+        if name.startswith("_random") or name.startswith("_sample"):
+            continue  # exposed via .random with key plumbing
+        if name not in ns:
+            ns[name] = make_op_func(name)

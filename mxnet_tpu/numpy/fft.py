@@ -1,0 +1,30 @@
+"""mx.np.fft — FFT namespace (the reference exposes fft/ifft via
+src/operator/contrib/fft.cc (cuFFT); on TPU XLA lowers jnp.fft)."""
+from __future__ import annotations
+
+import jax.numpy as _jnp
+
+from ..ndarray.ndarray import NDArray as _NDArray
+from ..ops.registry import apply_jax as _apply_jax
+
+
+def _lift(jfn):
+    def f(a, *args, **kwargs):
+        return _apply_jax(lambda x: jfn(x, *args, **kwargs), [a])
+    f.__name__ = jfn.__name__
+    return f
+
+
+fft = _lift(_jnp.fft.fft)
+ifft = _lift(_jnp.fft.ifft)
+fft2 = _lift(_jnp.fft.fft2)
+ifft2 = _lift(_jnp.fft.ifft2)
+fftn = _lift(_jnp.fft.fftn)
+ifftn = _lift(_jnp.fft.ifftn)
+rfft = _lift(_jnp.fft.rfft)
+irfft = _lift(_jnp.fft.irfft)
+fftshift = _lift(_jnp.fft.fftshift)
+ifftshift = _lift(_jnp.fft.ifftshift)
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
+           "fftshift", "ifftshift"]

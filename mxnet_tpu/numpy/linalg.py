@@ -1,0 +1,53 @@
+"""mx.np.linalg (parity: python/mxnet/numpy/linalg.py over
+src/operator/numpy/linalg/)."""
+from __future__ import annotations
+
+import jax.numpy as _jnp
+
+from ..ndarray.ndarray import NDArray as _NDArray
+from ..ops.registry import apply_jax as _apply_jax
+
+
+def _lift(jfn, multi=False, name=None):
+    def f(*args, **kwargs):
+        nd_in = [a for a in args if isinstance(a, _NDArray)]
+        pos = [i for i, a in enumerate(args) if isinstance(a, _NDArray)]
+        rest = list(args)
+
+        def fn(*arrays):
+            call = list(rest)
+            for p, a in zip(pos, arrays):
+                call[p] = a
+            out = jfn(*call, **kwargs)
+            return tuple(out) if multi else out
+
+        return _apply_jax(fn, nd_in, multi_out=multi)
+    f.__name__ = name or jfn.__name__
+    return f
+
+
+norm = _lift(_jnp.linalg.norm)
+svd = _lift(_jnp.linalg.svd, multi=True)
+qr = _lift(_jnp.linalg.qr, multi=True)
+cholesky = _lift(_jnp.linalg.cholesky)
+inv = _lift(_jnp.linalg.inv)
+pinv = _lift(_jnp.linalg.pinv)
+det = _lift(_jnp.linalg.det)
+slogdet = _lift(_jnp.linalg.slogdet, multi=True)
+solve = _lift(_jnp.linalg.solve)
+lstsq = _lift(_jnp.linalg.lstsq, multi=True)
+eig = _lift(_jnp.linalg.eig, multi=True)
+eigh = _lift(_jnp.linalg.eigh, multi=True)
+eigvals = _lift(_jnp.linalg.eigvals)
+eigvalsh = _lift(_jnp.linalg.eigvalsh)
+matrix_rank = _lift(_jnp.linalg.matrix_rank)
+matrix_power = _lift(_jnp.linalg.matrix_power)
+multi_dot = _lift(_jnp.linalg.multi_dot)
+tensorinv = _lift(_jnp.linalg.tensorinv)
+tensorsolve = _lift(_jnp.linalg.tensorsolve)
+cond = _lift(_jnp.linalg.cond)
+
+__all__ = ["norm", "svd", "qr", "cholesky", "inv", "pinv", "det", "slogdet",
+           "solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
+           "matrix_rank", "matrix_power", "multi_dot", "tensorinv",
+           "tensorsolve", "cond"]

@@ -1,0 +1,170 @@
+"""mx.np.random (parity: python/mxnet/numpy/random.py over
+src/operator/numpy/random/)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from ..ops.random import next_key, seed  # noqa: F401
+from . import ndarray
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "permutation", "gamma", "beta", "exponential",
+           "poisson", "multinomial", "multivariate_normal", "logistic",
+           "gumbel", "laplace", "rayleigh", "pareto", "power", "weibull",
+           "chisquare", "f", "lognormal", "binomial", "geometric"]
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    return ndarray(jax.random.uniform(next_key(), _shape(size),
+                                      np_dtype(dtype or "float32"),
+                                      minval=low, maxval=high))
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None,
+           out=None):
+    return ndarray(loc + scale * jax.random.normal(
+        next_key(), _shape(size), np_dtype(dtype or "float32")))
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size or None)
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size or None)
+
+
+def randint(low, high=None, size=None, dtype=None, ctx=None, device=None,
+            out=None):
+    if high is None:
+        low, high = 0, low
+    return ndarray(jax.random.randint(next_key(), _shape(size), low, high,
+                                      np_dtype(dtype or "int32")))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, out=None):
+    if isinstance(a, int):
+        a_arr = jnp.arange(a)
+    else:
+        a_arr = a._data if hasattr(a, "_data") else jnp.asarray(a)
+    p_arr = None if p is None else (p._data if hasattr(p, "_data")
+                                    else jnp.asarray(p))
+    return ndarray(jax.random.choice(next_key(), a_arr, _shape(size), replace,
+                                     p_arr))
+
+
+def shuffle(x):
+    x._rebind(jax.random.permutation(next_key(), x._data, axis=0))
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return ndarray(jax.random.permutation(next_key(), x))
+    return ndarray(jax.random.permutation(next_key(), x._data, axis=0))
+
+
+def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    return ndarray(jax.random.gamma(next_key(), shape, _shape(size),
+                                    np_dtype(dtype or "float32")) * scale)
+
+
+def beta(a, b, size=None, dtype=None, ctx=None):
+    return ndarray(jax.random.beta(next_key(), a, b, _shape(size),
+                                   np_dtype(dtype or "float32")))
+
+
+def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    return ndarray(scale * jax.random.exponential(
+        next_key(), _shape(size), np_dtype(dtype or "float32")))
+
+
+def poisson(lam=1.0, size=None, dtype=None, ctx=None, out=None):
+    return ndarray(jax.random.poisson(next_key(), lam, _shape(size)).astype(
+        np_dtype(dtype or "int64")))
+
+
+def multinomial(n, pvals, size=None):
+    p = pvals._data if hasattr(pvals, "_data") else jnp.asarray(pvals)
+    shape = _shape(size)
+    draws = jax.random.categorical(next_key(), jnp.log(jnp.maximum(p, 1e-37)),
+                                   shape=shape + (n,))
+    k = p.shape[-1]
+    return ndarray(jax.nn.one_hot(draws, k).sum(axis=-2).astype(jnp.int64))
+
+
+def multivariate_normal(mean, cov, size=None, check_valid=None, tol=None):
+    m = mean._data if hasattr(mean, "_data") else jnp.asarray(mean)
+    c = cov._data if hasattr(cov, "_data") else jnp.asarray(cov)
+    return ndarray(jax.random.multivariate_normal(next_key(), m, c,
+                                                  _shape(size) or None))
+
+
+def logistic(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return ndarray(loc + scale * jax.random.logistic(next_key(),
+                                                     _shape(size)))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None, ctx=None, out=None):
+    return ndarray(loc + scale * jax.random.gumbel(next_key(), _shape(size)))
+
+
+def laplace(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, out=None):
+    return ndarray(loc + scale * jax.random.laplace(next_key(),
+                                                    _shape(size)))
+
+
+def rayleigh(scale=1.0, size=None, ctx=None, out=None):
+    u = jax.random.uniform(next_key(), _shape(size), minval=1e-7, maxval=1.0)
+    return ndarray(scale * jnp.sqrt(-2.0 * jnp.log(u)))
+
+
+def pareto(a, size=None, ctx=None, out=None):
+    return ndarray(jax.random.pareto(next_key(), a, _shape(size)) )
+
+
+def power(a, size=None, ctx=None, out=None):
+    u = jax.random.uniform(next_key(), _shape(size), minval=1e-7, maxval=1.0)
+    return ndarray(u ** (1.0 / a))
+
+
+def weibull(a, size=None, ctx=None, out=None):
+    u = jax.random.uniform(next_key(), _shape(size), minval=1e-7, maxval=1.0)
+    return ndarray((-jnp.log(u)) ** (1.0 / a))
+
+
+def chisquare(df, size=None, dtype=None, ctx=None):
+    return ndarray(2.0 * jax.random.gamma(next_key(), df / 2.0,
+                                          _shape(size)))
+
+
+def f(dfnum, dfden, size=None, ctx=None):
+    num = 2.0 * jax.random.gamma(next_key(), dfnum / 2.0, _shape(size))
+    den = 2.0 * jax.random.gamma(jax.random.fold_in(next_key(), 1),
+                                 dfden / 2.0, _shape(size))
+    return ndarray((num / dfnum) / (den / dfden))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None, dtype=None, ctx=None, out=None):
+    return ndarray(jnp.exp(mean + sigma * jax.random.normal(next_key(),
+                                                            _shape(size))))
+
+
+def binomial(n, p, size=None, dtype=None, ctx=None, out=None):
+    return ndarray(jax.random.binomial(next_key(), n, p, _shape(size))
+                   .astype(np_dtype(dtype or "int64")))
+
+
+def geometric(p, size=None, ctx=None):
+    u = jax.random.uniform(next_key(), _shape(size), minval=1e-7, maxval=1.0)
+    return ndarray(jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(jnp.int64))

@@ -1,0 +1,70 @@
+"""mx.npx — numpy extensions (parity: python/mxnet/numpy_extension/ —
+the `_npx_*` op namespace: nn ops with numpy arrays, sequence ops,
+set_np/reset_np re-exports)."""
+from __future__ import annotations
+
+from ..util import set_np, reset_np, is_np_array, use_np  # noqa: F401
+from ..context import cpu, gpu, tpu, num_gpus, num_tpus  # noqa: F401
+from ..ndarray.register import make_op_func as _make
+from ..ops import registry as _reg
+
+# nn/extension ops under their npx names (parity: _npx_* registrations)
+_NPX_OPS = {
+    "activation": "Activation",
+    "batch_norm": "BatchNorm",
+    "convolution": "Convolution",
+    "deconvolution": "Deconvolution",
+    "fully_connected": "FullyConnected",
+    "pooling": "Pooling",
+    "dropout": "Dropout",
+    "embedding": "Embedding",
+    "layer_norm": "LayerNorm",
+    "group_norm": "GroupNorm",
+    "instance_norm": "InstanceNorm",
+    "leaky_relu": "LeakyReLU",
+    "softmax": "softmax",
+    "log_softmax": "log_softmax",
+    "masked_softmax": "softmax",
+    "topk": "topk",
+    "pick": "pick",
+    "one_hot": "one_hot",
+    "rnn": None,
+    "sequence_mask": "SequenceMask",
+    "smooth_l1": "smooth_l1",
+    "gamma": "gamma",
+    "reshape_like": None,
+    "broadcast_like": "broadcast_like",
+    "arange_like": "arange_like",
+    "shape_array": "shape_array",
+    "gather_nd": "gather_nd",
+    "scatter_nd": "scatter_nd",
+    "slice": "slice",
+    "slice_axis": "slice_axis",
+    "slice_like": "slice_like",
+    "ctc_loss": "CTCLoss",
+    "sigmoid": "sigmoid",
+    "relu": "relu",
+}
+
+for _npx_name, _op_name in _NPX_OPS.items():
+    if _op_name is not None and _op_name in _reg._REGISTRY:
+        globals()[_npx_name] = _make(_op_name)
+
+
+def reshape_like(a, b):
+    return a.reshape(b.shape)
+
+
+def waitall():
+    from ..ndarray import waitall as _w
+    _w()
+
+
+def load(fname):
+    from ..ndarray import load as _l
+    return _l(fname)
+
+
+def save(fname, data):
+    from ..ndarray import save as _s
+    return _s(fname, data)

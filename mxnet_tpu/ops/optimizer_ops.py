@@ -1,0 +1,241 @@
+"""Optimizer update kernels as ops.
+
+Parity: ``src/operator/optimizer_op.cc`` (sgd_update:501, adam_update:649,
+lamb_update_phase1:917, plus mom/nag/ftml/ftrl/rmsprop/signum/adagrad/
+adadelta and the multi-precision fp16 variants — SURVEY.md §2.2).  Each op
+is a pure function returning the *new* (weight, state...) tuple; the
+in-place mutation of the reference becomes a buffer rebind in
+``mxnet_tpu.optimizer`` (and buffer donation under jit).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+def _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * weight
+
+
+@register("sgd_update")
+def sgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", multi_out=True)
+def sgd_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", multi_out=True)
+def nag_mom_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", multi_out=True)
+def adam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - lr * m / (jnp.sqrt(v) + epsilon), m, v
+
+
+@register("adamw_update", multi_out=True)
+def adamw_update(weight, grad, mean, var, *, lr, eta=1.0, beta1=0.9,
+                 beta2=0.999, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                 clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    return weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) + wd * weight), m, v
+
+
+@register("ftml_update", multi_out=True)
+def ftml_update(weight, grad, d, v, z, *, lr, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_grad, wd)
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    d_new = (1 - beta1 ** t) / lr * (jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+    sigma = d_new - beta1 * d
+    z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+    return -z_new / d_new, d_new, v_new, z_new
+
+
+@register("ftrl_update", multi_out=True)
+def ftrl_update(weight, grad, z, n, *, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    n_new = n + jnp.square(g)
+    sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+    z_new = z + g - sigma * weight
+    w = jnp.where(
+        jnp.abs(z_new) <= lamda1, 0.0,
+        -(z_new - jnp.sign(z_new) * lamda1) /
+        ((beta + jnp.sqrt(n_new)) / lr + wd))
+    return w, z_new, n_new
+
+
+@register("rmsprop_update", multi_out=True)
+def rmsprop_update(weight, grad, n, *, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new
+
+
+@register("rmspropalex_update", multi_out=True)
+def rmspropalex_update(weight, grad, n, g_state, delta, *, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    g_new = gamma1 * g_state + (1 - gamma1) * g
+    delta_new = gamma2 * delta - lr * g / jnp.sqrt(n_new - jnp.square(g_new) + epsilon)
+    w = weight + delta_new
+    if clip_weights is not None and clip_weights > 0:
+        w = jnp.clip(w, -clip_weights, clip_weights)
+    return w, n_new, g_new, delta_new
+
+
+@register("signsgd_update")
+def signsgd_update(weight, grad, *, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return weight - lr * (jnp.sign(g) + wd * weight)
+
+
+@register("signum_update", multi_out=True)
+def signum_update(weight, grad, mom, *, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = momentum * mom - (1 - momentum) * (g + wd * weight)
+    w = (1 - lr * wd_lh) * weight + lr * jnp.sign(m)
+    return w, m
+
+
+@register("adagrad_update", multi_out=True)
+def adagrad_update(weight, grad, history, *, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    h = history + jnp.square(g)
+    return weight - lr * g / (jnp.sqrt(h) + epsilon), h
+
+
+@register("adadelta_update", multi_out=True)
+def adadelta_update(weight, grad, acc_g, acc_delta, *, rho=0.9, epsilon=1e-5,
+                    wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    acc_g_new = rho * acc_g + (1 - rho) * jnp.square(g)
+    delta = jnp.sqrt(acc_delta + epsilon) / jnp.sqrt(acc_g_new + epsilon) * g
+    acc_delta_new = rho * acc_delta + (1 - rho) * jnp.square(delta)
+    return weight - delta, acc_g_new, acc_delta_new
+
+
+@register("adamax_update", multi_out=True)
+def adamax_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                  t=1, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    m = beta1 * mean + (1 - beta1) * g
+    u = jnp.maximum(beta2 * var, jnp.abs(g))
+    return weight - (lr / (1 - beta1 ** t)) * m / (u + 1e-8), m, u
+
+
+@register("nadam_update", multi_out=True)
+def nadam_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, t=1, schedule_decay=0.004, m_schedule=1.0,
+                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    mt = beta1 * (1.0 - 0.5 * 0.96 ** (t * schedule_decay))
+    mt1 = beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * schedule_decay))
+    ms = m_schedule * mt
+    ms1 = ms * mt1
+    g_prime = g / (1 - ms)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    m_prime = m / (1 - ms1)
+    v_prime = v / (1 - beta2 ** t)
+    m_bar = (1 - mt) * g_prime + mt1 * m_prime
+    return weight - lr * m_bar / (jnp.sqrt(v_prime) + epsilon), m, v
+
+
+@register("lamb_update", multi_out=True)
+def lamb_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0,
+                lower_bound=-1.0, upper_bound=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = m / (1 - beta1 ** t)
+        vh = v / (1 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    r = mh / (jnp.sqrt(vh) + epsilon) + wd * weight
+    w_norm = jnp.linalg.norm(weight)
+    r_norm = jnp.linalg.norm(r)
+    if lower_bound is not None and lower_bound > 0:
+        w_norm = jnp.maximum(w_norm, lower_bound)
+    if upper_bound is not None and upper_bound > 0:
+        w_norm = jnp.minimum(w_norm, upper_bound)
+    ratio = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return weight - lr * ratio * r, m, v
+
+
+@register("lars_update", multi_out=True)
+def lars_update(weight, grad, mom, *, lr, eta=0.001, momentum=0.9,
+                epsilon=1e-9, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w_norm = jnp.linalg.norm(weight)
+    g_norm = jnp.linalg.norm(g)
+    local_lr = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wd * w_norm + epsilon), 1.0)
+    new_mom = momentum * mom + local_lr * lr * (g + wd * weight)
+    return weight - new_mom, new_mom
+
+
+@register("sgld_update")
+def sgld_update(weight, grad, noise, *, lr, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    return weight - 0.5 * lr * g + jnp.sqrt(lr) * noise
+
+
+@register("dcasgd_update", multi_out=True)
+def dcasgd_update(weight, grad, prev_weight, *, lr, lamda=0.04, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0):
+    g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
+    comp = g + lamda * g * g * (weight - prev_weight)
+    return weight - lr * comp, weight

@@ -1,0 +1,152 @@
+"""Random sampling ops + global PRNG state.
+
+Parity: ``src/operator/random/`` samplers and the per-device ``kRandom``
+resource (include/mxnet/resource.h:39-47).  TPU-first: randomness is
+stateless (``jax.random`` keys); the global MXNet-style seed state lives
+here and hands out split keys.  Inside a traced/jitted CachedOp the key
+is threaded as a real input (see gluon/block.py key plumbing), never
+baked in as a constant.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["seed", "next_key", "current_key"]
+
+_state = threading.local()
+
+
+def _get():
+    if not hasattr(_state, "key"):
+        _state.key = jax.random.PRNGKey(0)
+        _state.trace_hook = None
+    return _state
+
+
+def seed(seed_state: int, ctx="all") -> None:
+    """Parity: mx.random.seed (python/mxnet/random.py)."""
+    _get().key = jax.random.PRNGKey(int(seed_state))
+
+
+def set_trace_hook(hook) -> Optional[object]:
+    """Install a hook that supplies keys during CachedOp tracing (so the
+    traced program takes fresh entropy per call instead of a constant)."""
+    st = _get()
+    old, st.trace_hook = st.trace_hook, hook
+    return old
+
+
+def next_key():
+    st = _get()
+    if st.trace_hook is not None:
+        return st.trace_hook()
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def current_key():
+    return _get().key
+
+
+# -- samplers: fn(key, *, params) -> array ---------------------------------
+# (exposed as mx.nd.random.* factory functions in ndarray/random.py)
+
+@register("_random_uniform")
+def _uniform(key, *, low=0.0, high=1.0, shape=(1,), dtype=jnp.float32):
+    return jax.random.uniform(key, shape, dtype, minval=low, maxval=high)
+
+
+@register("_random_normal")
+def _normal(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32):
+    return loc + scale * jax.random.normal(key, shape, dtype)
+
+
+@register("_random_gamma")
+def _gamma(key, *, alpha=1.0, beta=1.0, shape=(1,), dtype=jnp.float32):
+    return jax.random.gamma(key, alpha, shape, dtype) * beta
+
+
+@register("_random_exponential")
+def _exponential(key, *, lam=1.0, shape=(1,), dtype=jnp.float32):
+    return jax.random.exponential(key, shape, dtype) / lam
+
+
+@register("_random_poisson")
+def _poisson(key, *, lam=1.0, shape=(1,), dtype=jnp.float32):
+    return jax.random.poisson(key, lam, shape).astype(dtype)
+
+
+@register("_random_negative_binomial")
+def _neg_binomial(key, *, k=1, p=0.5, shape=(1,), dtype=jnp.float32):
+    # NB(k, p) == Poisson(Gamma(k, (1-p)/p))
+    g = jax.random.gamma(key, k, shape) * ((1.0 - p) / p)
+    return jax.random.poisson(jax.random.fold_in(key, 1), g, shape).astype(dtype)
+
+
+@register("_random_generalized_negative_binomial")
+def _gen_neg_binomial(key, *, mu=1.0, alpha=1.0, shape=(1,), dtype=jnp.float32):
+    if alpha == 0.0:
+        return jax.random.poisson(key, mu, shape).astype(dtype)
+    r = 1.0 / alpha
+    g = jax.random.gamma(key, r, shape) * (mu * alpha)
+    return jax.random.poisson(jax.random.fold_in(key, 1), g, shape).astype(dtype)
+
+
+@register("_random_randint")
+def _randint(key, *, low=0, high=1, shape=(1,), dtype=jnp.int32):
+    return jax.random.randint(key, shape, low, high, dtype)
+
+
+@register("_random_bernoulli")
+def _bernoulli(key, *, prob=0.5, shape=(1,), dtype=jnp.float32):
+    return jax.random.bernoulli(key, prob, shape).astype(dtype)
+
+
+@register("_sample_multinomial")
+def _multinomial(key, data, *, shape=(), get_prob=False, dtype=jnp.int32):
+    """data: (..., K) probabilities; draws `shape` samples per row."""
+    logits = jnp.log(jnp.maximum(data, 1e-37))
+    n = 1
+    for s in (shape if isinstance(shape, tuple) else (shape,)):
+        n *= s if s else 1
+    out_shape = data.shape[:-1] + ((shape,) if isinstance(shape, int) and shape
+                                   else tuple(shape) if shape else ())
+    samples = jax.random.categorical(
+        key, logits, axis=-1,
+        shape=(n,) + data.shape[:-1]) if n > 1 else \
+        jax.random.categorical(key, logits, axis=-1)
+    if n > 1:
+        samples = jnp.moveaxis(samples, 0, -1).reshape(out_shape)
+    return samples.astype(dtype)
+
+
+@register("_shuffle")
+def _shuffle(key, data):
+    return jax.random.permutation(key, data, axis=0)
+
+
+@register("_random_laplace")
+def _laplace(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32):
+    return loc + scale * jax.random.laplace(key, shape, dtype)
+
+
+@register("_random_rayleigh")
+def _rayleigh(key, *, scale=1.0, shape=(1,), dtype=jnp.float32):
+    u = jax.random.uniform(key, shape, dtype, minval=1e-7, maxval=1.0)
+    return scale * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+@register("_random_gumbel")
+def _gumbel(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32):
+    return loc + scale * jax.random.gumbel(key, shape, dtype)
+
+
+@register("_random_logistic")
+def _logistic(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32):
+    return loc + scale * jax.random.logistic(key, shape, dtype)

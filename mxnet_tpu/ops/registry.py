@@ -1,0 +1,121 @@
+"""Single operator registry.
+
+Parity: the NNVM op registry (`NNVM_REGISTER_OP`, see e.g. Convolution at
+src/operator/nn/convolution.cc:399) collapsed to its TPU-native core: an
+op is a *name* plus a *pure jax function* ``fn(*arrays, **params)``.
+Shape/type inference is jax's tracing; FGradient is ``jax.vjp``; kernel
+dispatch/fusion is XLA.  Python-facing namespaces (``mx.nd``, ``mx.np``)
+are generated from this registry the same way the reference code-gens its
+op modules from the C registry (python/mxnet/ndarray/register.py:115-277).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..base import MXNetError
+
+__all__ = ["Operator", "register", "alias", "get", "list_ops", "invoke",
+           "apply_jax"]
+
+_REGISTRY: Dict[str, "Operator"] = {}
+
+
+class Operator:
+    """One registered op: name + pure jax ``fn(*arrays, **params)``."""
+
+    __slots__ = ("name", "fn", "multi_out", "aliases", "doc")
+
+    def __init__(self, name: str, fn: Callable, multi_out: bool = False,
+                 aliases: Sequence[str] = ()):
+        self.name = name
+        self.fn = fn
+        self.multi_out = multi_out
+        self.aliases = tuple(aliases)
+        self.doc = fn.__doc__
+
+    def __repr__(self):
+        return f"<Operator {self.name}>"
+
+
+def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False):
+    """Decorator registering a pure jax function as an op.
+
+    The function signature is ``fn(*arrays, **params)`` where arrays are
+    jax.Array positional args and params are keyword-only static attrs
+    (parity: dmlc::Parameter per-op param structs).
+    """
+
+    def deco(fn: Callable):
+        op = Operator(name, fn, multi_out=multi_out, aliases=aliases)
+        if name in _REGISTRY:
+            raise MXNetError(f"op {name!r} registered twice")
+        _REGISTRY[name] = op
+        for a in aliases:
+            _REGISTRY[a] = op
+        return fn
+
+    return deco
+
+
+def alias(existing: str, new: str) -> None:
+    _REGISTRY[new] = _REGISTRY[existing]
+
+
+def get(name: str) -> Operator:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        raise MXNetError(f"unknown operator {name!r}") from None
+
+
+def list_ops() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+# --------------------------------------------------------------------------
+# invocation (parity: Imperative::Invoke, src/imperative/imperative.cc:98)
+# --------------------------------------------------------------------------
+
+def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
+              record: Optional[bool] = None):
+    """Run a pure jax function on NDArrays, wrap outputs, record on tape.
+
+    This is the one funnel every op call goes through — the analogue of
+    InvokeOp → PushFCompute → engine (imperative_utils.h:448): jax's async
+    dispatch replaces the engine push; the tape hook replaces RecordOp.
+    """
+    from .. import autograd
+    from ..ndarray import NDArray
+    from .. import engine
+
+    arrays = [x._data for x in nd_inputs]
+    out = fn(*arrays)
+    multi = multi_out or isinstance(out, (tuple, list))
+    outs = list(out) if isinstance(out, (tuple, list)) else [out]
+    nd_outs = [NDArray(o) for o in outs]
+
+    should_record = autograd.is_recording() if record is None else record
+    if should_record:
+        autograd.record_apply(fn, list(nd_inputs), nd_outs, multi_out=multi)
+
+    if engine.naive_mode():
+        for o in nd_outs:
+            o._data.block_until_ready()
+
+    return nd_outs if multi else nd_outs[0]
+
+
+def invoke(name: str, nd_inputs: Sequence[Any], **params):
+    """Invoke a registered op by name on NDArray inputs.
+
+    ``None`` entries in ``nd_inputs`` are dropped (optional inputs like a
+    no-bias Convolution's bias).
+    """
+    op = get(name)
+    nd_inputs = [x for x in nd_inputs if x is not None]
+    if params:
+        fn = functools.partial(op.fn, **params)
+    else:
+        fn = op.fn
+    return apply_jax(fn, nd_inputs, multi_out=op.multi_out)

@@ -1,0 +1,604 @@
+"""Tensor ops: elementwise, reductions, linear algebra, shape manipulation,
+indexing, ordering.
+
+Parity target: ``src/operator/tensor/`` (elemwise_*, broadcast_reduce,
+dot, matrix_op, indexing_op, ordering_op, init_op — SURVEY.md §2.2).
+Implementations are one-liner lax/jnp calls on purpose: XLA supplies the
+kernels, fusion, and layout; the value here is the registry surface and
+MXNet-compatible parameterization.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+
+# --------------------------------------------------------------------------
+# elementwise binary (+ broadcast_* aliases: the reference distinguishes
+# elemwise_add (same-shape) from broadcast_add; numpy semantics subsume both)
+# --------------------------------------------------------------------------
+
+def _binary(name, fn, extra=()):
+    register(name, aliases=tuple(extra))(fn)
+
+
+_binary("elemwise_add", lambda a, b: a + b,
+        ("broadcast_add", "_plus", "add", "broadcast_plus"))
+_binary("elemwise_sub", lambda a, b: a - b,
+        ("broadcast_sub", "_minus", "subtract", "broadcast_minus"))
+_binary("elemwise_mul", lambda a, b: a * b, ("broadcast_mul", "_mul", "multiply"))
+_binary("elemwise_div", lambda a, b: a / b, ("broadcast_div", "_div", "divide"))
+_binary("broadcast_mod", lambda a, b: jnp.mod(a, b), ("_mod", "mod"))
+_binary("broadcast_power", lambda a, b: jnp.power(a, b), ("_power", "power"))
+_binary("broadcast_maximum", jnp.maximum, ("maximum",))
+_binary("broadcast_minimum", jnp.minimum, ("minimum",))
+_binary("broadcast_hypot", jnp.hypot, ("hypot",))
+_binary("broadcast_equal", lambda a, b: (a == b).astype(a.dtype), ("_equal",))
+_binary("broadcast_not_equal", lambda a, b: (a != b).astype(a.dtype), ("_not_equal",))
+_binary("broadcast_greater", lambda a, b: (a > b).astype(a.dtype), ("_greater",))
+_binary("broadcast_greater_equal", lambda a, b: (a >= b).astype(a.dtype),
+        ("_greater_equal",))
+_binary("broadcast_lesser", lambda a, b: (a < b).astype(a.dtype), ("_lesser",))
+_binary("broadcast_lesser_equal", lambda a, b: (a <= b).astype(a.dtype),
+        ("_lesser_equal",))
+_binary("broadcast_logical_and", lambda a, b: jnp.logical_and(a, b).astype(a.dtype),
+        ("logical_and",))
+_binary("broadcast_logical_or", lambda a, b: jnp.logical_or(a, b).astype(a.dtype),
+        ("logical_or",))
+_binary("broadcast_logical_xor", lambda a, b: jnp.logical_xor(a, b).astype(a.dtype),
+        ("logical_xor",))
+_binary("arctan2", jnp.arctan2, ("_npi_arctan2",))
+
+
+# --------------------------------------------------------------------------
+# elementwise unary (parity: src/operator/tensor/elemwise_unary_op_*.cc)
+# --------------------------------------------------------------------------
+
+_UNARY = {
+    "negative": jnp.negative, "abs": jnp.abs, "sign": jnp.sign,
+    "rint": jnp.rint, "round": jnp.round, "ceil": jnp.ceil, "floor": jnp.floor,
+    "trunc": jnp.trunc, "fix": jnp.trunc,
+    "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x), "cbrt": jnp.cbrt,
+    "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "reciprocal": jnp.reciprocal,
+    "logical_not": lambda x: jnp.logical_not(x).astype(x.dtype),
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+    "isnan": jnp.isnan, "isinf": jnp.isinf, "isfinite": jnp.isfinite,
+}
+for _name, _fn in _UNARY.items():
+    register(_name)(_fn)
+alias("gammaln", "lgamma")
+alias("negative", "_np_negative")
+
+
+@register("clip")
+def _clip(a, *, a_min=None, a_max=None):
+    return jnp.clip(a, a_min, a_max)
+
+
+@register("cast", aliases=("Cast",))
+def _cast(a, *, dtype):
+    from ..base import np_dtype
+    return a.astype(np_dtype(dtype))
+
+
+@register("smooth_l1")
+def _smooth_l1(a, *, scalar=1.0):
+    # parity: src/operator/tensor — f(x) = 0.5 (sx)^2 if |x|<1/s^2 else |x|-0.5/s^2
+    s2 = scalar * scalar
+    absx = jnp.abs(a)
+    return jnp.where(absx < 1.0 / s2, 0.5 * s2 * a * a, absx - 0.5 / s2)
+
+
+# --------------------------------------------------------------------------
+# reductions (parity: src/operator/tensor/broadcast_reduce_op_*.cc)
+# --------------------------------------------------------------------------
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(name, jfn, extra=()):
+    def fn(a, *, axis=None, keepdims=False, exclude=False):
+        ax = _norm_axis(axis)
+        if exclude and ax is not None:
+            axs = (ax,) if isinstance(ax, int) else ax
+            ax = tuple(i for i in range(a.ndim) if i not in axs)
+        return jfn(a, axis=ax, keepdims=keepdims)
+    fn.__name__ = name
+    register(name, aliases=tuple(extra))(fn)
+
+
+_reduce("sum", jnp.sum, ("sum_axis",))
+_reduce("mean", jnp.mean)
+_reduce("prod", jnp.prod)
+_reduce("nansum", jnp.nansum)
+_reduce("nanprod", jnp.nanprod)
+_reduce("max", jnp.max, ("max_axis",))
+_reduce("min", jnp.min, ("min_axis",))
+
+
+@register("norm")
+def _norm(a, *, ord=2, axis=None, keepdims=False):
+    ax = _norm_axis(axis)
+    if ord == 1:
+        return jnp.sum(jnp.abs(a), axis=ax, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdims))
+
+
+@register("argmax")
+def _argmax(a, *, axis=None, keepdims=False):
+    out = jnp.argmax(a, axis=_norm_axis(axis), keepdims=keepdims)
+    return out.astype(jnp.float32)  # reference returns float indices
+
+
+@register("argmin")
+def _argmin(a, *, axis=None, keepdims=False):
+    out = jnp.argmin(a, axis=_norm_axis(axis), keepdims=keepdims)
+    return out.astype(jnp.float32)
+
+
+@register("cumsum", aliases=("_np_cumsum",))
+def _cumsum(a, *, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=dtype)
+
+
+@register("cumprod")
+def _cumprod(a, *, axis=None, dtype=None):
+    return jnp.cumprod(a, axis=axis, dtype=dtype)
+
+
+@register("logsumexp")
+def _logsumexp(a, *, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(a, axis=_norm_axis(axis), keepdims=keepdims)
+
+
+# --------------------------------------------------------------------------
+# linear algebra (parity: dot-inl.h, la_op via LAPACK/cuBLAS — on TPU the
+# MXU eats these; bf16 accumulation in fp32 is XLA's default)
+# --------------------------------------------------------------------------
+
+@register("dot")
+def _dot(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = a.T if a.ndim <= 2 else jnp.moveaxis(a, 0, -1)
+    if transpose_b:
+        b = b.T if b.ndim <= 2 else jnp.moveaxis(b, -1, 0)
+    return jnp.dot(a, b)
+
+
+@register("batch_dot")
+def _batch_dot(a, b, *, transpose_a=False, transpose_b=False):
+    if transpose_a:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_b:
+        b = jnp.swapaxes(b, -1, -2)
+    return jnp.matmul(a, b)
+
+
+register("matmul", aliases=("_npi_matmul",))(jnp.matmul)
+register("tensordot")(lambda a, b, *, axes=2: jnp.tensordot(a, b, axes=axes))
+register("kron")(jnp.kron)
+register("outer")(jnp.outer)
+register("vdot")(lambda a, b: jnp.vdot(a, b))
+register("inner")(jnp.inner)
+
+
+@register("khatri_rao")
+def _khatri_rao(*mats):
+    out = mats[0]
+    for m in mats[1:]:
+        out = (out[:, None, :] * m[None, :, :]).reshape(-1, out.shape[-1])
+    return out
+
+
+# --------------------------------------------------------------------------
+# shape manipulation (parity: matrix_op.cc reshape/transpose/slice family)
+# --------------------------------------------------------------------------
+
+@register("reshape", aliases=("Reshape",))
+def _reshape(a, *, shape, reverse=False):
+    # MXNet special codes: 0 copy-dim, -1 infer, -2 copy-rest, -3 merge-two,
+    # -4 split (src/operator/tensor/matrix_op.cc Reshape docs)
+    shape = list(shape)
+    if reverse:
+        a_shape = list(a.shape)[::-1]
+        shape = shape[::-1]
+    else:
+        a_shape = list(a.shape)
+    out, src_i, i = [], 0, 0
+    while i < len(shape):
+        s = shape[i]
+        if s == 0:
+            out.append(a_shape[src_i]); src_i += 1
+        elif s == -1:
+            out.append(-1); src_i += 1
+        elif s == -2:
+            out.extend(a_shape[src_i:]); src_i = len(a_shape)
+        elif s == -3:
+            out.append(a_shape[src_i] * a_shape[src_i + 1]); src_i += 2
+        elif s == -4:
+            d1, d2 = shape[i + 1], shape[i + 2]
+            cur = a_shape[src_i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2]); src_i += 1; i += 2
+        else:
+            out.append(s); src_i += 1
+        i += 1
+    if reverse:
+        out = out[::-1]
+    return jnp.reshape(a, tuple(out))
+
+
+@register("transpose")
+def _transpose(a, *, axes=None):
+    if axes is None or len(axes) == 0:
+        return jnp.transpose(a)
+    return jnp.transpose(a, axes)
+
+
+register("swapaxes", aliases=("SwapAxis",))(
+    lambda a, *, dim1=0, dim2=0: jnp.swapaxes(a, dim1, dim2))
+register("expand_dims")(lambda a, *, axis: jnp.expand_dims(a, axis))
+register("squeeze")(lambda a, *, axis=None: jnp.squeeze(
+    a, axis if axis is None or isinstance(axis, int) else tuple(axis)))
+
+
+@register("flatten", aliases=("Flatten",))
+def _flatten(a):
+    return jnp.reshape(a, (a.shape[0], -1))
+
+
+@register("concat", aliases=("Concat",))
+def _concat(*args, dim=1):
+    return jnp.concatenate(args, axis=dim)
+
+
+@register("stack")
+def _stack(*args, axis=0):
+    return jnp.stack(args, axis=axis)
+
+
+@register("split", aliases=("SliceChannel", "split_v2"), multi_out=True)
+def _split(a, *, num_outputs=None, axis=1, squeeze_axis=False, indices=None):
+    if indices is not None:
+        parts = jnp.split(a, list(indices), axis=axis)
+    else:
+        parts = jnp.split(a, num_outputs, axis=axis)
+    if squeeze_axis:
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts)
+
+
+@register("slice", aliases=("crop",))
+def _slice(a, *, begin, end, step=None):
+    slices = []
+    step = step or [None] * len(begin)
+    for b, e, s in zip(begin, end, step):
+        slices.append(slice(b, e, s))
+    return a[tuple(slices)]
+
+
+@register("slice_axis")
+def _slice_axis(a, *, axis, begin, end):
+    idx = [slice(None)] * a.ndim
+    idx[axis] = slice(begin, end)
+    return a[tuple(idx)]
+
+
+@register("slice_like")
+def _slice_like(a, b, *, axes=()):
+    axes = axes or range(min(a.ndim, b.ndim))
+    idx = [slice(None)] * a.ndim
+    for ax in axes:
+        idx[ax] = slice(0, b.shape[ax])
+    return a[tuple(idx)]
+
+
+@register("tile")
+def _tile(a, *, reps):
+    return jnp.tile(a, reps)
+
+
+@register("repeat")
+def _repeat(a, *, repeats, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+@register("pad", aliases=("Pad",))
+def _pad(a, *, mode="constant", pad_width=(), constant_value=0.0):
+    pw = list(zip(pad_width[::2], pad_width[1::2]))
+    if mode == "constant":
+        return jnp.pad(a, pw, constant_values=constant_value)
+    return jnp.pad(a, pw, mode={"edge": "edge", "reflect": "reflect"}[mode])
+
+
+@register("flip", aliases=("reverse",))
+def _flip(a, *, axis):
+    return jnp.flip(a, axis=axis)
+
+
+@register("roll")
+def _roll(a, *, shift, axis=None):
+    return jnp.roll(a, shift, axis=axis)
+
+
+@register("depth_to_space")
+def _depth_to_space(a, *, block_size):
+    n, c, h, w = a.shape
+    b = block_size
+    x = a.reshape(n, b, b, c // (b * b), h, w)
+    x = x.transpose(0, 3, 4, 1, 5, 2)
+    return x.reshape(n, c // (b * b), h * b, w * b)
+
+
+@register("space_to_depth")
+def _space_to_depth(a, *, block_size):
+    n, c, h, w = a.shape
+    b = block_size
+    x = a.reshape(n, c, h // b, b, w // b, b)
+    x = x.transpose(0, 3, 5, 1, 2, 4)
+    return x.reshape(n, c * b * b, h // b, w // b)
+
+
+@register("diag")
+def _diag(a, *, k=0):
+    return jnp.diag(a, k=k) if a.ndim <= 2 else jnp.diagonal(a, offset=k)
+
+
+@register("broadcast_to")
+def _broadcast_to(a, *, shape):
+    shape = tuple(s if s != 0 else a.shape[i] for i, s in enumerate(shape))
+    return jnp.broadcast_to(a, shape)
+
+
+@register("broadcast_like")
+def _broadcast_like(a, b):
+    return jnp.broadcast_to(a, b.shape)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(a, *, axis=(), size=()):
+    axis = (axis,) if isinstance(axis, int) else axis
+    size = (size,) if isinstance(size, int) else size
+    shape = list(a.shape)
+    for ax, s in zip(axis, size):
+        shape[ax] = s
+    return jnp.broadcast_to(a, tuple(shape))
+
+
+# --------------------------------------------------------------------------
+# indexing (parity: indexing_op.cc take/gather/scatter + one_hot)
+# --------------------------------------------------------------------------
+
+@register("take")
+def _take(a, indices, *, axis=0, mode="clip"):
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("pick")
+def _pick(a, index, *, axis=-1, keepdims=False, mode="clip"):
+    idx = jnp.clip(index.astype(jnp.int32), 0, a.shape[axis] - 1)
+    out = jnp.take_along_axis(a, jnp.expand_dims(idx, axis), axis=axis)
+    return out if keepdims else jnp.squeeze(out, axis=axis)
+
+
+@register("gather_nd")
+def _gather_nd(a, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return a[idx]
+
+
+@register("scatter_nd")
+def _scatter_nd(data, indices, *, shape):
+    idx = tuple(indices.astype(jnp.int32))
+    out = jnp.zeros(shape, data.dtype)
+    return out.at[idx].set(data)
+
+
+@register("one_hot")
+def _one_hot(indices, *, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    from ..base import np_dtype
+    oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+    return (oh * (on_value - off_value) + off_value).astype(np_dtype(dtype))
+
+
+@register("where")
+def _where(cond, a, b):
+    return jnp.where(cond.astype(bool), a, b)
+
+
+@register("boolean_mask_nonzero")
+def _nonzero(a):
+    return jnp.stack(jnp.nonzero(a), axis=-1)
+
+
+@register("take_along_axis")
+def _take_along_axis(a, indices, *, axis):
+    return jnp.take_along_axis(a, indices.astype(jnp.int32), axis=axis)
+
+
+# --------------------------------------------------------------------------
+# ordering (parity: ordering_op.cc sort/topk/argsort)
+# --------------------------------------------------------------------------
+
+@register("sort")
+def _sort(a, *, axis=-1, is_ascend=True):
+    out = jnp.sort(a, axis=axis)
+    return out if is_ascend else jnp.flip(out, axis=axis)
+
+
+@register("argsort")
+def _argsort(a, *, axis=-1, is_ascend=True, dtype="float32"):
+    from ..base import np_dtype
+    out = jnp.argsort(a, axis=axis)
+    if not is_ascend:
+        out = jnp.flip(out, axis=axis)
+    return out.astype(np_dtype(dtype))
+
+
+@register("topk", multi_out=False)
+def _topk(a, *, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32"):
+    from ..base import np_dtype
+    ax = axis if axis is not None else -1
+    src = -a if is_ascend else a
+    src = jnp.moveaxis(src, ax, -1)
+    vals, idx = lax.top_k(src, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "both":
+        return (vals, idx.astype(np_dtype(dtype)))
+    return idx.astype(np_dtype(dtype))
+
+
+# --------------------------------------------------------------------------
+# init / creation ops (parity: init_op.cc) — these take no array inputs;
+# they're exposed through factory functions in mxnet_tpu.ndarray.
+# --------------------------------------------------------------------------
+
+@register("zeros_like")
+def _zeros_like(a):
+    return jnp.zeros_like(a)
+
+
+@register("ones_like")
+def _ones_like(a):
+    return jnp.ones_like(a)
+
+
+@register("full_like")
+def _full_like(a, *, fill_value):
+    return jnp.full_like(a, fill_value)
+
+
+@register("arange_like")
+def _arange_like(a, *, start=0.0, step=1.0, axis=None):
+    n = a.size if axis is None else a.shape[axis]
+    return start + step * jnp.arange(n, dtype=a.dtype)
+
+
+@register("shape_array")
+def _shape_array(a):
+    return jnp.array(a.shape, dtype=jnp.int64)
+
+
+@register("size_array")
+def _size_array(a):
+    return jnp.array([a.size], dtype=jnp.int64)
+
+
+# --------------------------------------------------------------------------
+# sequence ops (parity: sequence_mask/last/reverse ops, src/operator/)
+# --------------------------------------------------------------------------
+
+@register("SequenceMask", aliases=("sequence_mask",))
+def _sequence_mask(data, sequence_length=None, *, use_sequence_length=False,
+                   value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return data
+    maxlen = data.shape[axis]
+    steps = jnp.arange(maxlen)
+    # mask shape: broadcast steps along `axis` against batch on axis 1-axis
+    mask = steps[:, None] < sequence_length[None, :]  # (maxlen, batch)
+    if axis == 1:
+        mask = mask.T
+    extra = data.ndim - 2
+    mask = mask.reshape(mask.shape + (1,) * extra)
+    return jnp.where(mask, data, jnp.asarray(value, data.dtype))
+
+
+@register("SequenceLast", aliases=("sequence_last",))
+def _sequence_last(data, sequence_length=None, *, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[axis] = -1
+        return data[tuple(idx)]
+    last = (sequence_length - 1).astype(jnp.int32)
+    moved = jnp.moveaxis(data, axis, 0)  # (T, B, ...)
+    return jnp.take_along_axis(
+        moved, last.reshape((1, -1) + (1,) * (moved.ndim - 2)), axis=0)[0]
+
+
+@register("SequenceReverse", aliases=("sequence_reverse",))
+def _sequence_reverse(data, sequence_length=None, *, use_sequence_length=False,
+                      axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return jnp.flip(data, axis=axis)
+    moved = jnp.moveaxis(data, axis, 0)
+    T = moved.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)
+    out = jnp.take_along_axis(
+        moved, src.reshape(src.shape + (1,) * (moved.ndim - 2)), axis=0)
+    return jnp.moveaxis(out, 0, axis)
+
+
+# misc
+@register("Embedding")
+def _embedding(data, weight, *, input_dim=None, output_dim=None, dtype=None,
+               sparse_grad=False):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+@register("L2Normalization")
+def _l2_normalization(data, *, eps=1e-10, mode="instance"):
+    if mode == "instance":
+        axes = tuple(range(1, data.ndim))
+    elif mode == "channel":
+        axes = (1,)
+    else:  # spatial
+        axes = tuple(range(2, data.ndim))
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=axes, keepdims=True) + eps)
+    return data / norm
+
+
+@register("InstanceNorm")
+def _instance_norm(data, gamma, beta, *, eps=1e-3):
+    axes = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=axes, keepdims=True)
+    var = jnp.var(data, axis=axes, keepdims=True)
+    xn = (data - mean) / jnp.sqrt(var + eps)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return xn * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("allclose")
+def _allclose(a, b, *, rtol=1e-5, atol=1e-8, equal_nan=False):
+    return jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan).astype(
+        jnp.float32).reshape((1,))
+
+
+@register("histogram", multi_out=True)
+def _histogram(a, *, bin_cnt=10, range=None):
+    lo, hi = range if range is not None else (float(a.min()), float(a.max()))
+    cnt, edges = jnp.histogram(a, bins=bin_cnt, range=(lo, hi))
+    return cnt, edges

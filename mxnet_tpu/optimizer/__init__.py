@@ -1,0 +1,12 @@
+"""Optimizers (parity: python/mxnet/optimizer/ — one class per file in the
+reference; consolidated here over the optimizer-update ops in
+mxnet_tpu/ops/optimizer_ops.py)."""
+from .optimizer import (Optimizer, Updater, create, register, get_updater,
+                        SGD, NAG, Adam, AdamW, AdaGrad, AdaDelta, Adamax,
+                        Nadam, RMSProp, FTML, FTRL, LAMB, LARS, Signum,
+                        SGLD, DCASGD, Test)
+
+__all__ = ["Optimizer", "Updater", "create", "register", "get_updater",
+           "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta", "Adamax",
+           "Nadam", "RMSProp", "FTML", "FTRL", "LAMB", "LARS", "Signum",
+           "SGLD", "DCASGD", "Test"]

@@ -1,0 +1,557 @@
+"""Optimizer base + the full optimizer family.
+
+Parity: python/mxnet/optimizer/optimizer.py (Optimizer/Updater/registry)
+and the per-optimizer files (sgd.py, adam.py, lamb.py, ...).  The update
+rules live in mxnet_tpu/ops/optimizer_ops.py (parity:
+src/operator/optimizer_op.cc) as pure functions; updates here are
+jit-cached per (op, static-params) with lr/wd passed as device scalars so
+schedule changes never trigger recompilation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops import registry as _reg
+
+__all__ = ["Optimizer", "Updater", "create", "register", "get_updater"]
+
+_OPT_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    """Parity: Optimizer.register decorator."""
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _OPT_REGISTRY:
+        raise MXNetError(f"unknown optimizer {name!r}")
+    return _OPT_REGISTRY[name](**kwargs)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...],
+                   n_arrays: int):
+    """jit-compiled update kernel; lr and wd are dynamic scalar args."""
+    base_fn = _reg.get(op_name).fn
+    static = dict(static_params)
+
+    def step(lr, wd, *arrays):
+        return base_fn(*arrays, lr=lr, wd=wd, **static)
+
+    return jax.jit(step)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_update_nolr(op_name: str, static_params: Tuple[Tuple[str, Any], ...],
+                        n_arrays: int):
+    base_fn = _reg.get(op_name).fn
+    static = dict(static_params)
+
+    def step(wd, *arrays):
+        return base_fn(*arrays, wd=wd, **static)
+
+    return jax.jit(step)
+
+
+class Optimizer:
+    """Base optimizer (parity: optimizer.py Optimizer).
+
+    Subclasses implement ``create_state`` and ``update_impl``; state is a
+    tuple of NDArrays (the reference mutates them in place, here the
+    buffers are rebound after each functional update).
+    """
+
+    # name of the op in ops/optimizer_ops.py; subclasses set it
+    op_name: Optional[str] = None
+    uses_lr = True
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=0,
+                 use_fused_step=True, **extra):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.param_dict = param_dict or {}
+        self.idx2name = param_idx2name or {}
+        self.num_update = 0
+        self._index_update_count: Dict[int, int] = {}
+        self._lr_mult: Dict[str, float] = {}
+        self._wd_mult: Dict[str, float] = {}
+
+    # -- schedules/multipliers (parity: optimizer.py learning_rate logic) --
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self._lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._wd_mult = dict(args_wd_mult)
+
+    def _get_lr(self, index) -> float:
+        lr = self.learning_rate
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            lr *= getattr(self.param_dict[name], "lr_mult", 1.0)
+        lr *= self._lr_mult.get(name, 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        name = self.idx2name.get(index, index)
+        if name in self.param_dict:
+            wd *= getattr(self.param_dict[name], "wd_mult", 1.0)
+        wd *= self._wd_mult.get(name, 1.0)
+        return wd
+
+    def _update_count(self, index):
+        cnt = self._index_update_count.get(index, 0) + 1
+        self._index_update_count[index] = cnt
+        self.num_update = max(cnt, self.num_update)
+        return cnt
+
+    # -- state -------------------------------------------------------------
+    def create_state(self, index, weight) -> Tuple[NDArray, ...]:
+        return ()
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == onp.float16:
+            master = NDArray(weight._data.astype(jnp.float32))
+            return (master,) + tuple(self.create_state(index, master))
+        return self.create_state(index, weight)
+
+    def _zeros_state(self, weight, n=1, dtype=None):
+        return tuple(NDArray(jnp.zeros(weight.shape, dtype or weight.dtype))
+                     for _ in range(n))
+
+    # -- update ------------------------------------------------------------
+    def static_params(self, index) -> Dict[str, Any]:
+        """Per-op static attrs (everything but lr/wd/arrays)."""
+        return {}
+
+    def update(self, index, weight, grad, state):
+        """Apply one update (parity: Optimizer.update).  Mutates weight and
+        state NDArrays by rebinding their buffers."""
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        arrays = [weight._data, grad._data] + [s._data for s in state]
+        params = dict(self.static_params(index))
+        params.setdefault("rescale_grad", float(self.rescale_grad))
+        params.setdefault(
+            "clip_gradient",
+            float(self.clip_gradient) if self.clip_gradient is not None else -1.0)
+        key = tuple(sorted(params.items()))
+        if self.uses_lr:
+            fn = _jitted_update(self.op_name, key, len(arrays))
+            out = fn(jnp.float32(lr), jnp.float32(wd), *arrays)
+        else:
+            fn = _jitted_update_nolr(self.op_name, key, len(arrays))
+            out = fn(jnp.float32(wd), *arrays)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        weight._rebind(outs[0])
+        for s, new in zip(state, outs[1:]):
+            s._rebind(new)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == onp.float16:
+            master, sub_state = state[0], state[1:]
+            grad32 = NDArray(grad._data.astype(jnp.float32))
+            self.update(index, master, grad32, sub_state)
+            weight._rebind(master._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+
+# --------------------------------------------------------------------------
+# the family (parity: python/mxnet/optimizer/<name>.py each)
+# --------------------------------------------------------------------------
+
+@register
+class SGD(Optimizer):
+    """Parity: optimizer/sgd.py; ops sgd_update/sgd_mom_update
+    (src/operator/optimizer_op.cc:501,313)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lazy_update=True,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+        self.op_name = "sgd_mom_update" if momentum != 0.0 else "sgd_update"
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return ()
+        return self._zeros_state(weight, 1)
+
+    def static_params(self, index):
+        return {"momentum": self.momentum} if self.momentum != 0.0 else {}
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.op_name = "nag_mom_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1)
+
+    def static_params(self, index):
+        return {"momentum": self.momentum}
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.op_name = "adam_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon}
+
+    def update(self, index, weight, grad, state):
+        # bias correction folded into lr (parity: adam.py step computation)
+        t = self._index_update_count.get(index, 0) + 1
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        saved_lr = self.lr_scheduler, self.lr
+        lr = self._get_lr(index) * (coef2 ** 0.5) / coef1
+        self._update_count(index)
+        wd = self._get_wd(index)
+        params = dict(self.static_params(index))
+        params.setdefault("rescale_grad", float(self.rescale_grad))
+        params.setdefault(
+            "clip_gradient",
+            float(self.clip_gradient) if self.clip_gradient is not None else -1.0)
+        key = tuple(sorted(params.items()))
+        arrays = [weight._data, grad._data] + [s._data for s in state]
+        fn = _jitted_update(self.op_name, key, len(arrays))
+        out = fn(jnp.float32(lr), jnp.float32(wd), *arrays)
+        weight._rebind(out[0])
+        for s, new in zip(state, out[1:]):
+            s._rebind(new)
+
+
+@register
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.op_name = "adamw_update"
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=0.01, epsilon=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.epsilon = epsilon
+        self.op_name = "adagrad_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1)
+
+    def static_params(self, index):
+        return {"epsilon": self.epsilon}
+
+
+@register
+class AdaDelta(Optimizer):
+    uses_lr = False
+
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+        self.op_name = "adadelta_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        return {"rho": self.rho, "epsilon": self.epsilon}
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.op_name = "adamax_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        t = self._index_update_count.get(index, 0) + 1
+        return {"beta1": self.beta1, "beta2": self.beta2, "t": t}
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+        self.op_name = "nadam_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        t = self._index_update_count.get(index, 0) + 1
+        mt = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mt
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "t": t,
+                "schedule_decay": self.schedule_decay,
+                "m_schedule": self.m_schedule}
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.9, momentum=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.rho, self.momentum, self.epsilon = rho, momentum, epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+        self.op_name = "rmspropalex_update" if centered else "rmsprop_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 3 if self.centered else 1)
+
+    def static_params(self, index):
+        p = {"gamma1": self.rho, "epsilon": self.epsilon,
+             "clip_weights": float(self.clip_weights)
+             if self.clip_weights is not None else -1.0}
+        if self.centered:
+            p["gamma2"] = self.momentum
+        return p
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.op_name = "ftml_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 3)
+
+    def static_params(self, index):
+        t = self._index_update_count.get(index, 0) + 1
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "t": t}
+
+    def update(self, index, weight, grad, state):
+        # ftml uses clip_grad name (parity: optimizer_op.cc FTMLParam)
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        params = dict(self.static_params(index))
+        params["rescale_grad"] = float(self.rescale_grad)
+        params["clip_grad"] = float(self.clip_gradient) \
+            if self.clip_gradient is not None else -1.0
+        key = tuple(sorted(params.items()))
+        arrays = [weight._data, grad._data] + [s._data for s in state]
+        fn = _jitted_update(self.op_name, key, len(arrays))
+        out = fn(jnp.float32(lr), jnp.float32(wd), *arrays)
+        weight._rebind(out[0])
+        for s, new in zip(state, out[1:]):
+            s._rebind(new)
+
+
+@register
+class FTRL(Optimizer):
+    def __init__(self, learning_rate=0.1, lamda1=0.01, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1, self.beta = lamda1, beta
+        self.op_name = "ftrl_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        return {"lamda1": self.lamda1, "beta": self.beta}
+
+
+@register
+class LAMB(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.bias_correction = bias_correction
+        self.op_name = "lamb_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        t = self._index_update_count.get(index, 0) + 1
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "t": t,
+                "bias_correction": self.bias_correction,
+                "lower_bound": float(self.lower_bound)
+                if self.lower_bound is not None else -1.0,
+                "upper_bound": float(self.upper_bound)
+                if self.upper_bound is not None else -1.0}
+
+
+@register
+class LARS(Optimizer):
+    def __init__(self, learning_rate=0.1, momentum=0.9, eta=0.001,
+                 epsilon=1e-9, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.eta, self.epsilon = momentum, eta, epsilon
+        self.op_name = "lars_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1)
+
+    def static_params(self, index):
+        return {"momentum": self.momentum, "eta": self.eta,
+                "epsilon": self.epsilon}
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum, self.wd_lh = momentum, wd_lh
+        self.op_name = "signum_update" if momentum != 0.0 else "signsgd_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 1) if self.momentum != 0.0 else ()
+
+    def static_params(self, index):
+        if self.momentum != 0.0:
+            return {"momentum": self.momentum, "wd_lh": self.wd_lh}
+        return {}
+
+
+@register
+class SGLD(Optimizer):
+    def __init__(self, learning_rate=0.1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.op_name = "sgld_update"
+
+    def update(self, index, weight, grad, state):
+        from ..ops.random import next_key
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        noise = jax.random.normal(next_key(), weight.shape).astype(weight._data.dtype)
+        fn = _reg.get("sgld_update").fn
+        out = fn(weight._data, grad._data, noise, lr=lr, wd=wd,
+                 rescale_grad=self.rescale_grad,
+                 clip_gradient=self.clip_gradient
+                 if self.clip_gradient is not None else -1.0)
+        weight._rebind(out)
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, learning_rate=0.01, lamda=0.04, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda = lamda
+        self.op_name = "dcasgd_update"
+
+    def create_state(self, index, weight):
+        return (NDArray(weight._data),)
+
+    def static_params(self, index):
+        return {"lamda": self.lamda}
+
+
+@register
+class Test(Optimizer):
+    """Parity: optimizer.py Test optimizer (w += rescale_grad * grad)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def create_state(self, index, weight):
+        return ()
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        weight._rebind(weight._data + self.rescale_grad * grad._data)
+
+
+# --------------------------------------------------------------------------
+# Updater (parity: python/mxnet/optimizer/updater.py — state dict mgmt,
+# used by KVStore server-side updates and local update paths)
+# --------------------------------------------------------------------------
+
+class Updater:
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        state_np = {k: tuple(s.asnumpy() for s in v)
+                    for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((state_np, type(self.optimizer).__name__))
+        return pickle.dumps(state_np)
+
+    def set_states(self, states):
+        import pickle
+        data = pickle.loads(states)
+        if isinstance(data, tuple):
+            data = data[0]
+        self.states = {k: tuple(NDArray(a) for a in v)
+                       for k, v in data.items()}
+        self.states_synced = {k: True for k in self.states}
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

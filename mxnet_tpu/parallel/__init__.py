@@ -1,0 +1,15 @@
+"""mxnet_tpu.parallel — device meshes + SPMD training.
+
+TPU-native replacement for the reference's multi-device machinery
+(SURVEY.md §2.3): instead of KVStore Comm trees / NCCL rings, a
+``jax.sharding.Mesh`` over the chips and GSPMD partitioning.  Data
+parallelism = shard the batch axis; tensor/sequence parallelism =
+PartitionSpecs on parameters/activations; XLA inserts the all-reduces
+over ICI (the reference's gpu_topology.h spanning-tree solver has no
+equivalent here — the compiler owns topology).
+"""
+from .mesh import make_mesh, default_mesh, data_parallel_spec, replicated
+from .trainer import SPMDTrainer
+
+__all__ = ["make_mesh", "default_mesh", "data_parallel_spec", "replicated",
+           "SPMDTrainer"]

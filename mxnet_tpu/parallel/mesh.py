@@ -1,0 +1,64 @@
+"""Device mesh helpers.
+
+The axis-name convention (used across the framework):
+  dp — data parallel, tp — tensor/model parallel, pp — pipeline,
+  sp — sequence/context parallel, ep — expert parallel.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as onp
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ["make_mesh", "default_mesh", "data_parallel_spec", "replicated"]
+
+
+def make_mesh(axes: Dict[str, int] | None = None,
+              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build a Mesh; axes maps axis-name → size (-1 = fill remaining).
+
+    ``make_mesh({"dp": -1})`` → 1-D data-parallel mesh over all devices;
+    ``make_mesh({"dp": 2, "tp": 4})`` → 2×4.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    axes = dict(axes or {"dp": -1})
+    sizes = list(axes.values())
+    n = len(devices)
+    if -1 in sizes:
+        known = 1
+        for s in sizes:
+            if s != -1:
+                known *= s
+        sizes[sizes.index(-1)] = max(n // known, 1)
+    total = 1
+    for s in sizes:
+        total *= s
+    if total > n:
+        raise ValueError(f"mesh {dict(zip(axes, sizes))} needs {total} "
+                         f"devices, have {n}")
+    dev_array = onp.array(devices[:total]).reshape(sizes)
+    return Mesh(dev_array, tuple(axes.keys()))
+
+
+_default: Optional[Mesh] = None
+
+
+def default_mesh() -> Mesh:
+    global _default
+    if _default is None:
+        _default = make_mesh({"dp": -1})
+    return _default
+
+
+def data_parallel_spec(mesh: Mesh, batch_axis: int = 0,
+                       ndim: int = 2) -> NamedSharding:
+    """Sharding for a batch tensor: batch axis split over 'dp'."""
+    spec = [None] * ndim
+    spec[batch_axis] = "dp"
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

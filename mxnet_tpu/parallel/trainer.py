@@ -1,0 +1,168 @@
+"""SPMDTrainer: one fully-compiled, mesh-partitioned training step.
+
+This is the TPU-native fast path that subsumes the reference's
+KVStore+engine pipeline (SURVEY.md §3.4): forward, backward, gradient
+all-reduce, and the optimizer update are one XLA executable; GSPMD
+inserts the ICI collectives that `CommDevice`/NCCL provided.  Gluon's
+eager Trainer remains for API parity; benchmarks and multi-chip training
+use this.
+
+Design notes:
+- params stay replicated (pure DP) or follow per-parameter
+  PartitionSpecs (TP/SP) set via ``Parameter.shard``.
+- batch tensors are sharded on the 'dp' mesh axis.
+- optimizer state lives as a pytree of arrays, donated every step
+  (buffer donation == the reference's in-place update kernels).
+- BatchNorm moving stats ride the trace-context aux mechanism and are
+  folded back after each step.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .. import autograd as ag
+from ..gluon.block import _TraceContext, _trace_scope
+from ..ops import registry as _reg
+from ..ops.random import next_key
+from .. import optimizer as opt_mod
+from .mesh import default_mesh
+
+__all__ = ["SPMDTrainer"]
+
+
+class SPMDTrainer:
+    def __init__(self, net, loss_fn: Callable, optimizer="sgd",
+                 optimizer_params: Optional[dict] = None,
+                 mesh: Optional[Mesh] = None, batch_axis: int = 0,
+                 donate: bool = True):
+        self.net = net
+        self.loss_fn = loss_fn
+        self.mesh = mesh or default_mesh()
+        self.batch_axis = batch_axis
+        self.optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
+        self._params = net.collect_params()
+        self._pkeys = list(self._params.keys())
+        for p in self._params.values():
+            p._check_initialized()
+        self._opt_state = {
+            k: tuple(s._data for s in
+                     self.optimizer.create_state(i, self._params[k].data()))
+            for i, k in enumerate(self._pkeys)}
+        self._step_cache: Dict[Any, Any] = {}
+        self._donate = donate
+        self.num_update = 0
+
+    # -- sharding ----------------------------------------------------------
+    def _param_sharding(self, param):
+        spec = param._sharding or PartitionSpec()
+        return NamedSharding(self.mesh, spec)
+
+    def _batch_sharding(self, ndim):
+        spec = [None] * ndim
+        if "dp" in self.mesh.axis_names:
+            spec[self.batch_axis] = "dp"
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    # -- compiled step -----------------------------------------------------
+    def _build_step(self, data_shape, data_dtype, label_shape, label_dtype):
+        net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
+        pkeys = self._pkeys
+        params = [self._params[k] for k in pkeys]
+        cell = {"aux": []}
+
+        def step(key, lr, wd, p_arrays, opt_state, data, label):
+            def loss_of(p_list):
+                tc = _TraceContext(key)
+                saved = [p._data for p in params]
+                try:
+                    for p, a in zip(params, p_list):
+                        p._data = NDArray(a)
+                    with _trace_scope(tc), ag.pause(train_mode=True):
+                        out = net.forward(NDArray(data))
+                        loss = loss_fn(out, NDArray(label))
+                    cell["aux"] = list(tc.aux)
+                    return loss._data.mean(), tuple(v for _, v in tc.aux)
+                finally:
+                    for p, s in zip(params, saved):
+                        p._data = s
+
+            (loss_val, aux), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(list(p_arrays))
+
+            new_params, new_state = [], []
+            for k, w, g, st in zip(pkeys, p_arrays, grads, opt_state):
+                param = self._params[k]
+                if param.grad_req == "null":
+                    new_params.append(w)
+                    new_state.append(st)
+                    continue
+                sp = dict(opt.static_params(0))
+                sp.setdefault("rescale_grad", 1.0)
+                sp.setdefault("clip_gradient",
+                              float(opt.clip_gradient)
+                              if opt.clip_gradient is not None else -1.0)
+                fn = _reg.get(opt.op_name).fn
+                eff_lr = lr * param.lr_mult
+                eff_wd = wd * param.wd_mult
+                if opt.uses_lr:
+                    out = fn(w, g, *st, lr=eff_lr, wd=eff_wd, **sp)
+                else:
+                    out = fn(w, g, *st, wd=eff_wd, **sp)
+                outs = out if isinstance(out, tuple) else (out,)
+                new_params.append(outs[0])
+                new_state.append(tuple(outs[1:]))
+            return new_params, new_state, loss_val, aux
+
+        p_shardings = [self._param_sharding(p) for p in params]
+        s_shardings = [tuple(self._param_sharding(p) for _ in st)
+                       for p, st in zip(params,
+                                        (self._opt_state[k] for k in pkeys))]
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        in_shardings = (rep, rep, rep, p_shardings, s_shardings,
+                        self._batch_sharding(len(data_shape)),
+                        self._batch_sharding(len(label_shape)))
+        donate = (3, 4) if self._donate else ()
+        jitted = jax.jit(step, in_shardings=in_shardings,
+                         donate_argnums=donate)
+        return jitted, cell
+
+    def step(self, data, label, batch_size: Optional[int] = None):
+        """One training step; returns the (device) loss as NDArray."""
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
+        entry = self._step_cache.get(sig)
+        if entry is None:
+            entry = self._build_step(*sig)
+            self._step_cache[sig] = entry
+        jitted, cell = entry
+        self.num_update += 1
+        lr = jnp.float32(self.optimizer.learning_rate)
+        wd = jnp.float32(self.optimizer.wd)
+        self.optimizer.num_update = self.num_update
+        p_arrays = [self._params[k].data()._data for k in self._pkeys]
+        opt_state = [self._opt_state[k] for k in self._pkeys]
+        new_p, new_s, loss, aux = jitted(next_key(), lr, wd, p_arrays,
+                                         opt_state, d, l)
+        for k, w, st in zip(self._pkeys, new_p, new_s):
+            with ag.pause():
+                self._params[k].data()._rebind(w)
+            self._opt_state[k] = tuple(st)
+        for (param, _), new in zip(cell["aux"], aux):
+            param._data._rebind(new)
+        return NDArray(loss)
+
+    def fit(self, data_iter, epochs=1, verbose=False):
+        losses = []
+        for _ in range(epochs):
+            for batch in data_iter:
+                d, l = batch[0], batch[1]
+                losses.append(self.step(d, l))
+        return losses

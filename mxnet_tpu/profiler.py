@@ -1,0 +1,163 @@
+"""mx.profiler.
+
+Parity: python/mxnet/profiler.py:34-477 (set_config, start/stop/pause,
+dump, dumps, scoped Task/Frame/Event/Counter/Marker) over src/profiler/.
+TPU-native backend: jax.profiler (XPlane/TensorBoard traces replace the
+Chrome-trace JSON; the aggregate table is kept host-side).
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
+           "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+
+_config = {"profile_all": False, "profile_symbolic": False,
+           "profile_imperative": False, "profile_memory": False,
+           "profile_api": False, "filename": "profile.json",
+           "aggregate_stats": False}
+_running = False
+_trace_dir: Optional[str] = None
+_agg: Dict[str, list] = defaultdict(list)
+
+
+def set_config(**kwargs):
+    """Parity: profiler.set_config."""
+    _config.update(kwargs)
+
+
+def start(profile_process="worker"):
+    global _running, _trace_dir
+    if _running:
+        return
+    _trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+    try:
+        jax.profiler.start_trace(_trace_dir)
+        _running = True
+    except Exception:
+        _running = False
+
+
+def stop(profile_process="worker"):
+    global _running
+    if _running:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _running = False
+
+
+def pause(profile_process="worker"):
+    stop(profile_process)
+
+
+def resume(profile_process="worker"):
+    start(profile_process)
+
+
+def dump(finished=True, profile_process="worker"):
+    """Write the trace (xplane dir path written into the json filename slot)."""
+    stop()
+    with open(_config["filename"], "w") as f:
+        import json
+        json.dump({"traceEvents": _dump_agg_events(),
+                   "xplane_dir": _trace_dir}, f)
+
+
+def dumps(reset=False):
+    """Return aggregate stats as a printable table (parity: dumps)."""
+    lines = ["Profile Statistics:",
+             f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"]
+    for name, times in sorted(_agg.items()):
+        total = sum(times) * 1e3
+        lines.append(f"{name:<40}{len(times):>8}{total:>12.3f}"
+                     f"{total / max(len(times), 1):>12.3f}")
+    if reset:
+        _agg.clear()
+    return "\n".join(lines)
+
+
+def _dump_agg_events():
+    events = []
+    for name, times in _agg.items():
+        for t in times:
+            events.append({"name": name, "ph": "X", "dur": t * 1e6})
+    return events
+
+
+class _Scope:
+    """Base profiling scope; records wall time into the aggregate table and
+    emits a jax.profiler TraceAnnotation."""
+
+    def __init__(self, name):
+        self.name = name
+        self._ann = None
+
+    def start(self):
+        self._t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def stop(self):
+        _agg[self.name].append(time.perf_counter() - self._t0)
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class Task(_Scope):
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Frame(_Scope):
+    def __init__(self, name, domain=None):
+        super().__init__(name)
+
+
+class Event(_Scope):
+    def __init__(self, name):
+        super().__init__(name)
+
+
+class Marker:
+    def __init__(self, name, domain=None):
+        self.name = name
+
+    def mark(self, scope="process"):
+        _agg[f"marker:{self.name}"].append(0.0)
+
+
+class Counter:
+    def __init__(self, name, domain=None, value=None):
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+
+def scope(name="<unk>:"):
+    return _Scope(name)

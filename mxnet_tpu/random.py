@@ -1,0 +1,17 @@
+"""mx.random — global seed + top-level samplers.
+
+Parity: python/mxnet/random.py (seed, uniform, normal, ...) over the
+kRandom per-device resource; TPU-native state is a jax PRNG key chain
+(mxnet_tpu/ops/random.py).
+"""
+from .ops.random import seed, next_key, current_key
+from .ndarray.random import (uniform, normal, randn, gamma, exponential,
+                             poisson, negative_binomial,
+                             generalized_negative_binomial, randint,
+                             multinomial, bernoulli, shuffle, laplace,
+                             rayleigh, gumbel, logistic)
+
+__all__ = ["seed", "uniform", "normal", "randn", "gamma", "exponential",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "randint", "multinomial", "bernoulli", "shuffle", "laplace",
+           "rayleigh", "gumbel", "logistic", "next_key", "current_key"]

@@ -1,0 +1,63 @@
+"""mx.runtime — feature introspection.
+
+Parity: python/mxnet/runtime.py:76 (feature_list) over src/libinfo.cc.
+Features report what this build supports at runtime.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name: str, enabled: bool):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self.enabled else '✖'} {self.name}]"
+
+
+class Features(dict):
+    """Runtime feature set (parity: mx.runtime.Features)."""
+
+    def __init__(self):
+        feats = {
+            "TPU": any(d.platform != "cpu" for d in jax.devices()),
+            "CPU": True,
+            "BF16": True,
+            "F16C": True,
+            "INT64_TENSOR_SIZE": True,
+            "JIT": True,          # CachedOp == XLA jit
+            "PALLAS": _has_pallas(),
+            "DIST_KVSTORE": True,  # jax.distributed backend
+            "PROFILER": True,
+            "SIGNAL_HANDLER": False,
+            "OPENCV": _has_cv(),
+            "BLAS_OPEN": True,
+        }
+        super().__init__({k: Feature(k, v) for k, v in feats.items()})
+
+    def is_enabled(self, name: str) -> bool:
+        return self[name].enabled
+
+
+def _has_pallas() -> bool:
+    try:
+        from jax.experimental import pallas  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def _has_cv() -> bool:
+    try:
+        import cv2  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+def feature_list():
+    return list(Features().values())

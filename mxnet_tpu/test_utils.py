@@ -1,0 +1,112 @@
+"""Test utilities.
+
+Parity: python/mxnet/test_utils.py — assert_almost_equal (:649),
+check_numeric_gradient finite-difference checking (:1039),
+check_consistency cross-context comparison (:1486), default_context (:56).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import numpy as onp
+
+from .context import Context, cpu, current_context
+from .ndarray import NDArray
+from . import autograd
+
+__all__ = ["default_context", "assert_almost_equal", "almost_equal",
+           "check_numeric_gradient", "check_consistency", "rand_ndarray",
+           "same", "rand_shape_nd"]
+
+
+def default_context() -> Context:
+    return current_context()
+
+
+def _as_np(a):
+    if isinstance(a, NDArray):
+        return a.asnumpy()
+    return onp.asarray(a)
+
+
+def same(a, b) -> bool:
+    return onp.array_equal(_as_np(a), _as_np(b))
+
+
+def almost_equal(a, b, rtol=1e-5, atol=1e-20) -> bool:
+    return onp.allclose(_as_np(a), _as_np(b), rtol=rtol, atol=atol)
+
+
+def assert_almost_equal(a, b, rtol=1e-5, atol=1e-6, names=("a", "b")):
+    a_np, b_np = _as_np(a), _as_np(b)
+    a_np = a_np.astype(onp.float64) if a_np.dtype.kind == "f" else a_np
+    b_np = b_np.astype(onp.float64) if b_np.dtype.kind == "f" else b_np
+    onp.testing.assert_allclose(a_np, b_np, rtol=rtol, atol=atol,
+                                err_msg=f"{names[0]} != {names[1]}")
+
+
+def rand_ndarray(shape, dtype="float32", ctx=None, low=-1.0, high=1.0) -> NDArray:
+    data = onp.random.uniform(low, high, size=shape).astype(dtype)
+    return NDArray(data, ctx=ctx)
+
+
+def rand_shape_nd(ndim, dim=10):
+    return tuple(onp.random.randint(1, dim + 1, size=ndim).tolist())
+
+
+def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
+                           eps: float = 1e-3, rtol: float = 1e-2,
+                           atol: float = 1e-3):
+    """Finite-difference gradient check of a scalar-output function.
+
+    ``fn(*inputs)`` returns an NDArray; its sum is the objective.
+    Parity: test_utils.py:1039 check_numeric_gradient.
+    """
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+        loss = out.sum()
+    loss.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for i, x in enumerate(inputs):
+        x_np = x.asnumpy().astype(onp.float64)
+        num_grad = onp.zeros_like(x_np)
+        flat = x_np.reshape(-1)
+        num_flat = num_grad.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            x._rebind(NDArray(x_np.astype(x.dtype))._data)
+            with autograd.pause():
+                f_pos = float(fn(*inputs).sum().asscalar())
+            flat[j] = orig - eps
+            x._rebind(NDArray(x_np.astype(x.dtype))._data)
+            with autograd.pause():
+                f_neg = float(fn(*inputs).sum().asscalar())
+            flat[j] = orig
+            x._rebind(NDArray(x_np.astype(x.dtype))._data)
+            num_flat[j] = (f_pos - f_neg) / (2 * eps)
+        onp.testing.assert_allclose(
+            analytic[i], num_grad, rtol=rtol, atol=atol,
+            err_msg=f"gradient mismatch on input {i}")
+
+
+def check_consistency(fn: Callable, inputs: Sequence[onp.ndarray],
+                      ctx_list: Optional[Sequence[Context]] = None,
+                      dtypes=("float32",), rtol=1e-4, atol=1e-5):
+    """Run ``fn`` across contexts/dtypes and compare outputs pairwise
+    (parity: test_utils.py:1486 — the GPU↔CPU oracle, here TPU↔CPU)."""
+    ctx_list = list(ctx_list) if ctx_list else [cpu(), current_context()]
+    results = []
+    for ctx in ctx_list:
+        for dt in dtypes:
+            nd_in = [NDArray(x.astype(dt), ctx=ctx) for x in inputs]
+            out = fn(*nd_in)
+            results.append(_as_np(out))
+    ref = results[0].astype(onp.float64)
+    for r in results[1:]:
+        onp.testing.assert_allclose(ref, r.astype(onp.float64),
+                                    rtol=rtol, atol=atol)
+    return results

@@ -1,0 +1,97 @@
+"""Utility switches.
+
+Parity: python/mxnet/util.py — np-shape/np-array global modes
+(util.py:53,162,355,764).  In this framework numpy semantics are native
+(zero-size dims and scalars always work), so the switches only toggle
+which array type the Gluon layers hand back (`mx.np.ndarray` vs
+`mx.nd.NDArray`).
+"""
+from __future__ import annotations
+
+import functools
+import threading
+
+__all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "np_array",
+           "np_shape", "use_np", "set_np_shape", "getenv", "setenv"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "np_array"):
+        _state.np_array = False
+        _state.np_shape = True  # numpy shape semantics are native here
+    return _state
+
+
+def is_np_array() -> bool:
+    return _st().np_array
+
+
+def is_np_shape() -> bool:
+    return _st().np_shape
+
+
+def set_np_shape(active: bool) -> bool:
+    st = _st()
+    old, st.np_shape = st.np_shape, bool(active)
+    return old
+
+
+def set_np(shape: bool = True, array: bool = True, dtype: bool = False) -> None:
+    st = _st()
+    st.np_shape = bool(shape)
+    st.np_array = bool(array)
+
+
+def reset_np() -> None:
+    set_np(shape=True, array=False)
+
+
+class _NpScope:
+    def __init__(self, shape=True, array=True):
+        self._shape, self._array = shape, array
+
+    def __enter__(self):
+        st = _st()
+        self._old = (st.np_shape, st.np_array)
+        st.np_shape, st.np_array = self._shape, self._array
+        return self
+
+    def __exit__(self, *exc):
+        st = _st()
+        st.np_shape, st.np_array = self._old
+        return False
+
+
+def np_array(active: bool = True) -> _NpScope:
+    return _NpScope(shape=_st().np_shape, array=active)
+
+
+def np_shape(active: bool = True) -> _NpScope:
+    return _NpScope(shape=active, array=_st().np_array)
+
+
+def use_np(func):
+    """Decorator: run `func` with numpy semantics on (parity: util.use_np)."""
+    if isinstance(func, type):
+        return func  # class decoration: numpy semantics are native
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NpScope(shape=True, array=True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def getenv(name):
+    import os
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    import os
+    if value is None:
+        os.environ.pop(name, None)
+    else:
+        os.environ[name] = value

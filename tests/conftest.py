@@ -1,0 +1,34 @@
+"""Test configuration: force an 8-device virtual CPU mesh.
+
+Parity with the reference test strategy (SURVEY.md §4): multi-device
+tests run on emulated devices (xla_force_host_platform_device_count),
+the way the reference emulates clusters with --launcher local.
+
+The container's sitecustomize registers the axon TPU backend and sets
+jax_platforms via jax.config, so an env var alone is not enough — we
+override the config knob before any backend initializes.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as _onp
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything():
+    """Deterministic seeds per test (parity: with_seed() decorator,
+    tests/python/unittest/common.py:163)."""
+    _onp.random.seed(0)
+    import mxnet_tpu as mx
+    mx.random.seed(0)
+    yield

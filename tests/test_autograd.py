@@ -1,0 +1,176 @@
+"""Autograd (parity: tests/python/unittest/test_autograd.py +
+test_higher_order_grad.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_basic_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([0.5, -0.5])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * 2 + 1).exp().sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * onp.exp(2 * x.asnumpy() + 1), rtol=1e-4)
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([1.0, 10.0]))
+    assert_almost_equal(x.grad, [3.0, 30.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0])
+    x.attach_grad()
+    for _ in range(3):
+        with autograd.record():
+            y = x * 2
+        y.backward()
+    assert_almost_equal(x.grad, [2.0])
+
+
+def test_multiple_inputs():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad, b.asnumpy() + 1)
+    assert_almost_equal(b.grad, a.asnumpy())
+
+
+def test_is_recording_training():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+            assert autograd.is_recording()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # d(z)/dx = y.detach() = 4, not through y
+    assert_almost_equal(x.grad, [4.0])
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    with autograd.record():
+        y = (x * x).sum()
+    (gx,) = autograd.grad(y, [x])
+    assert_almost_equal(gx, 2 * x.asnumpy())
+    # grad buffers untouched
+    assert x.grad is None
+
+
+def test_higher_order():
+    x = nd.array([1.0, 2.0])
+    with autograd.record():
+        y = (x * x * x).sum()
+        (gx,) = autograd.grad(y, [x], create_graph=True, retain_graph=True)
+        z = gx.sum()
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x * x).sum()
+        (gx,) = autograd.grad(y, [x], create_graph=True, retain_graph=True)
+        z = (gx * gx).sum()
+    z.backward()
+    # d/dx (3x^2)^2 = 2*(3x^2)*6x = 36 x^3
+    assert_almost_equal(x.grad, 36 * x.asnumpy() ** 3, rtol=1e-4)
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            import numpy as np
+            y = nd.array(1 / (1 + onp.exp(-x.asnumpy())))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    f = Sigmoid()
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    s = 1 / (1 + onp.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-5)
+
+
+def test_backward_through_ops():
+    x = nd.array(onp.random.randn(3, 4).astype("float32"))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.dot(x, x.T).sum()
+    y.backward()
+    expect = 2 * x.asnumpy().sum(axis=0, keepdims=True) + 0 * x.asnumpy()
+    # d/dX sum(X X^T) = 2 * sum over? verify numerically instead
+    eps = 1e-3
+    xn = x.asnumpy().astype(onp.float64)
+    num = onp.zeros_like(xn)
+    for i in range(xn.shape[0]):
+        for j in range(xn.shape[1]):
+            xp = xn.copy(); xp[i, j] += eps
+            xm = xn.copy(); xm[i, j] -= eps
+            num[i, j] = ((xp @ xp.T).sum() - (xm @ xm.T).sum()) / (2 * eps)
+    assert_almost_equal(x.grad, num, rtol=1e-2, atol=1e-3)
+
+
+def test_unconnected_raises():
+    x = nd.array([1.0])
+    with pytest.raises(Exception):
+        x.backward()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 2.0])
+    g = nd.zeros((2,))
+    autograd.mark_variables([x], [g])
+    with autograd.record():
+        y = x * 5
+    y.backward()
+    assert_almost_equal(g, [5.0, 5.0])

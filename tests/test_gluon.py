@@ -1,0 +1,269 @@
+"""Gluon blocks/params/trainer (parity: tests/python/unittest/test_gluon.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.array(onp.random.randn(2, 3).astype("float32"))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert_almost_equal(out, x.asnumpy() @ w.T + b, rtol=1e-4)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(4)
+    layer.initialize()
+    x = nd.array(onp.random.randn(2, 7).astype("float32"))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    assert layer.weight.shape == (4, 7)
+
+
+def test_uninitialized_raises():
+    layer = nn.Dense(4, in_units=3)
+    with pytest.raises(Exception):
+        layer(nd.ones((1, 3)))
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(3))
+    net.initialize()
+    params = net.collect_params()
+    assert len(params) == 4
+    out = net(nd.ones((2, 5)))
+    assert out.shape == (2, 3)
+    names = list(params.keys())
+    assert any("weight" in n for n in names)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=3, padding=1, activation="relu"))
+    net.add(nn.MaxPool2D())
+    net.add(nn.Conv2D(4, kernel_size=3))
+    net.add(nn.GlobalAvgPool2D())
+    net.add(nn.Flatten())
+    net.initialize()
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 4)
+
+
+def test_batchnorm_layer_updates_stats():
+    bn = nn.BatchNorm(in_channels=3)
+    bn.initialize()
+    x = nd.array(onp.random.randn(8, 3, 4, 4).astype("float32") * 3 + 1)
+    with autograd.record():
+        out = bn(x)
+    rm = bn.running_mean.data().asnumpy()
+    assert abs(rm).sum() > 0  # moving mean moved off zero
+    # eval mode uses running stats
+    out_eval = bn(x)
+    assert out_eval.shape == x.shape
+
+
+def test_dropout_layer():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = nd.ones((100, 100))
+    out_eval = do(x)
+    assert_almost_equal(out_eval, x.asnumpy())
+    with autograd.record():
+        out_train = do(x)
+    frac = (out_train.asnumpy() == 0).mean()
+    assert 0.4 < frac < 0.6
+
+
+def test_grad_flow_through_net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="tanh"))
+    net.add(nn.Dense(1))
+    net.initialize()
+    x = nd.array(onp.random.randn(5, 3).astype("float32"))
+    with autograd.record():
+        out = net(x).sum()
+    out.backward()
+    for p in net.collect_params().values():
+        g = p.grad()
+        assert g.shape == p.shape
+        assert onp.abs(g.asnumpy()).sum() > 0
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize()
+    x = nd.array(onp.random.randn(3, 8).astype("float32"))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()  # first call (deferred-init path done already)
+    hybrid2 = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5)
+    assert_almost_equal(eager, hybrid2, rtol=1e-5)
+
+
+def test_hybridize_grad_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, activation="sigmoid"))
+    net.add(nn.Dense(2))
+    net.initialize()
+    x = nd.array(onp.random.randn(4, 5).astype("float32"))
+
+    def run():
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        return {k: p.grad().asnumpy().copy()
+                for k, p in net.collect_params().items()}
+
+    g_eager = run()
+    net.hybridize()
+    g_hybrid = run()
+    for k in g_eager:
+        assert_almost_equal(g_eager[k], g_hybrid[k], rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore=None)
+    w_before = net.weight.data().asnumpy().copy()
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    g = net.weight.grad().asnumpy().copy()
+    trainer.step(batch_size=2)
+    w_after = net.weight.data().asnumpy()
+    assert_almost_equal(w_after, w_before - 0.1 * g / 2, rtol=1e-5)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3))
+    net.add(nn.Dense(2, in_units=4))
+    net.initialize()
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3))
+    net2.add(nn.Dense(2, in_units=4))
+    net2.load_parameters(f)
+    x = nd.ones((1, 3))
+    assert_almost_equal(net(x), net2(x).asnumpy())
+
+
+def test_losses():
+    from mxnet_tpu.gluon import loss as gloss
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[1.5, 2.5], [2.0, 5.0]])
+    l2 = gloss.L2Loss()(pred, label)
+    assert_almost_equal(l2, ((pred.asnumpy() - label.asnumpy()) ** 2).mean(1)
+                        / 2, rtol=1e-5)
+    l1 = gloss.L1Loss()(pred, label)
+    assert_almost_equal(l1, onp.abs(pred.asnumpy()
+                                    - label.asnumpy()).mean(1), rtol=1e-5)
+    logits = nd.array(onp.random.randn(4, 5).astype("float32"))
+    lbl = nd.array([0, 2, 1, 4])
+    ce = gloss.SoftmaxCrossEntropyLoss()(logits, lbl)
+    p = onp.exp(logits.asnumpy())
+    p /= p.sum(-1, keepdims=True)
+    expect = -onp.log(p[onp.arange(4), [0, 2, 1, 4]])
+    assert_almost_equal(ce, expect, rtol=1e-4)
+
+
+def test_constant_param():
+    class Net(gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            self.const = gluon.Constant(onp.array([2.0], "float32"))
+            self.dense = nn.Dense(1, in_units=2)
+
+        def forward(self, x):
+            return self.dense(x) * self.const.data()
+
+    net = Net()
+    net.initialize()
+    out = net(nd.ones((1, 2)))
+    assert out.shape == (1, 1)
+
+
+def test_lr_scheduler_in_trainer():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=1.0)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 1.0, "lr_scheduler": sched},
+                            kvstore=None)
+    x = nd.ones((1, 1))
+    for i in range(4):
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        trainer.step(1)
+    assert trainer.learning_rate == 0.25
+
+
+def test_metric_accuracy():
+    from mxnet_tpu.gluon import metric
+    acc = metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]])
+    label = nd.array([1, 0, 0])
+    acc.update([label], [pred])
+    assert abs(acc.get()[1] - 2.0 / 3) < 1e-6
+    comp = metric.CompositeEvalMetric()
+    comp.add(metric.Accuracy())
+    comp.add(metric.MSE())
+    assert len(comp.metrics) == 2
+
+
+def test_mnist_lenet_convergence():
+    """The §7 stage-4 milestone: LeNet on (synthetic) MNIST learns.
+
+    Parity: example/gluon/mnist + tests/python/train convergence tests.
+    """
+    from mxnet_tpu.gluon.data.vision import MNIST
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import transforms
+
+    train = MNIST(train=True).transform_first(
+        transforms.Compose([transforms.ToTensor()]))
+    loader = DataLoader(train, batch_size=64, shuffle=True)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, kernel_size=5, activation="relu"))
+    net.add(nn.MaxPool2D(2, 2))
+    net.add(nn.Conv2D(16, kernel_size=3, activation="relu"))
+    net.add(nn.MaxPool2D(2, 2))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(64, activation="relu"))
+    net.add(nn.Dense(10))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3}, kvstore=None)
+    from mxnet_tpu.gluon import metric
+    acc = metric.Accuracy()
+    for epoch in range(3):
+        acc.reset()
+        for data, label in loader:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            acc.update([label], [out])
+    assert acc.get()[1] > 0.85, f"LeNet failed to learn: acc={acc.get()[1]}"

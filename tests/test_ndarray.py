@@ -1,0 +1,169 @@
+"""NDArray basics (parity: tests/python/unittest/test_ndarray.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == onp.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    b = nd.zeros((3, 4))
+    assert (b.asnumpy() == 0).all()
+    c = nd.ones((2, 3), dtype="int32")
+    assert c.dtype == onp.int32
+    d = nd.full((2, 2), 7.0)
+    assert (d.asnumpy() == 7).all()
+    e = nd.arange(0, 10, 2)
+    assert e.shape == (5,)
+    f = nd.eye(3)
+    assert_almost_equal(f, onp.eye(3, dtype=onp.float32))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    assert_almost_equal(a + b, onp.array([5, 7, 9], onp.float32))
+    assert_almost_equal(a - b, onp.array([-3, -3, -3], onp.float32))
+    assert_almost_equal(a * b, onp.array([4, 10, 18], onp.float32))
+    assert_almost_equal(b / a, onp.array([4, 2.5, 2], onp.float32))
+    assert_almost_equal(a + 1, onp.array([2, 3, 4], onp.float32))
+    assert_almost_equal(2 * a, onp.array([2, 4, 6], onp.float32))
+    assert_almost_equal(1 / a, 1 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(-a, -a.asnumpy())
+    assert_almost_equal(abs(-a), a.asnumpy())
+
+
+def test_inplace():
+    a = nd.array([1.0, 2.0])
+    a += 1
+    assert_almost_equal(a, [2.0, 3.0])
+    a *= 2
+    assert_almost_equal(a, [4.0, 6.0])
+
+
+def test_comparison():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([3.0, 2.0, 1.0])
+    assert_almost_equal(a == b, [0.0, 1.0, 0.0])
+    assert_almost_equal(a < b, [1.0, 0.0, 0.0])
+    assert_almost_equal(a >= b, [0.0, 1.0, 1.0])
+
+
+def test_indexing():
+    a = nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert_almost_equal(a[0], onp.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1, 2], onp.arange(20, 24))
+    assert_almost_equal(a[:, 1], a.asnumpy()[:, 1])
+    assert_almost_equal(a[0, 1:3], a.asnumpy()[0, 1:3])
+    assert float(a[1, 2, 3].asscalar()) == 23
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1, 1] = 5.0
+    assert a.asnumpy()[1, 1] == 5.0
+    a[0] = nd.ones((3,))
+    assert (a.asnumpy()[0] == 1).all()
+
+
+def test_reshape_transpose():
+    a = nd.array(onp.arange(12).reshape(3, 4))
+    assert a.reshape(4, 3).shape == (4, 3)
+    assert a.reshape((2, 6)).shape == (2, 6)
+    assert a.reshape(-1, 2).shape == (6, 2)
+    assert a.reshape(0, -1).shape == (3, 4)  # MXNet 0 = copy dim
+    assert a.T.shape == (4, 3)
+    assert_almost_equal(a.T, a.asnumpy().T)
+    assert a.flatten().shape == (3, 4)
+    b = nd.array(onp.arange(24).reshape(2, 3, 4))
+    assert b.transpose(2, 0, 1).shape == (4, 2, 3)
+    assert b.swapaxes(0, 2).shape == (4, 3, 2)
+    assert b.expand_dims(1).shape == (2, 1, 3, 4)
+
+
+def test_reduce():
+    a = nd.array(onp.arange(12, dtype=onp.float32).reshape(3, 4))
+    assert_almost_equal(a.sum(), a.asnumpy().sum())
+    assert_almost_equal(a.sum(axis=0), a.asnumpy().sum(0))
+    assert_almost_equal(a.mean(axis=1, keepdims=True),
+                        a.asnumpy().mean(1, keepdims=True))
+    assert_almost_equal(a.max(axis=0), a.asnumpy().max(0))
+    assert_almost_equal(a.min(), a.asnumpy().min())
+    assert_almost_equal(a.argmax(axis=1), a.asnumpy().argmax(1).astype("f"))
+
+
+def test_dtype_cast():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == onp.int32
+    c = a.astype(onp.float16)
+    assert c.dtype == onp.float16
+
+
+def test_copy_context():
+    a = nd.array([1.0, 2.0])
+    b = a.copy()
+    b += 1
+    assert_almost_equal(a, [1.0, 2.0])
+    c = a.as_in_context(mx.cpu())
+    assert c.context.device_type in ("cpu", "tpu")
+    d = nd.zeros((2,))
+    a.copyto(d)
+    assert_almost_equal(d, [1.0, 2.0])
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    d = nd.stack(a, b, axis=0)
+    assert d.shape == (2, 2, 3)
+    parts = nd.split(nd.array(onp.arange(12).reshape(2, 6)), num_outputs=3,
+                     axis=1)
+    assert len(parts) == 3
+    assert parts[0].shape == (2, 2)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "arrays")
+    a = nd.array([1.0, 2.0])
+    b = nd.array([[3.0]])
+    nd.save(fname, {"a": a, "b": b})
+    loaded = nd.load(fname)
+    assert_almost_equal(loaded["a"], a.asnumpy())
+    assert_almost_equal(loaded["b"], b.asnumpy())
+    nd.save(fname, [a, b])
+    la = nd.load(fname)
+    assert isinstance(la, list) and len(la) == 2
+    nd.save(fname, a)
+    s = nd.load(fname)
+    assert_almost_equal(s, a.asnumpy())
+
+
+def test_waitall_and_scalar():
+    a = nd.ones((4,))
+    nd.waitall()
+    assert float((a.sum())) == 4.0
+    assert int(nd.array([3]).asscalar()) == 3
+    with pytest.raises(Exception):
+        nd.array([1, 2]).asscalar()
+
+
+def test_take_onehot_where():
+    a = nd.array(onp.arange(10, dtype=onp.float32))
+    idx = nd.array([1, 3, 5])
+    assert_almost_equal(a.take(idx), [1.0, 3.0, 5.0])
+    oh = nd.array([0, 2]).one_hot(3)
+    assert_almost_equal(oh, [[1, 0, 0], [0, 0, 1]])
+    cond = nd.array([1.0, 0.0, 1.0])
+    x = nd.array([1.0, 2.0, 3.0])
+    y = nd.array([10.0, 20.0, 30.0])
+    assert_almost_equal(nd.where(cond, x, y), [1.0, 20.0, 3.0])

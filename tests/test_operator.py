@@ -1,0 +1,243 @@
+"""Operator correctness against numpy oracle (parity:
+tests/python/unittest/test_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_fully_connected():
+    x = nd.array(onp.random.randn(4, 8).astype("float32"))
+    w = nd.array(onp.random.randn(3, 8).astype("float32"))
+    b = nd.array(onp.random.randn(3).astype("float32"))
+    out = nd.FullyConnected(x, w, b, num_hidden=3)
+    expect = x.asnumpy() @ w.asnumpy().T + b.asnumpy()
+    assert_almost_equal(out, expect, rtol=1e-4)
+    out2 = nd.FullyConnected(x, w, None, num_hidden=3, no_bias=True)
+    assert_almost_equal(out2, x.asnumpy() @ w.asnumpy().T, rtol=1e-4)
+
+
+def test_convolution_shapes():
+    x = nd.array(onp.random.randn(2, 3, 8, 8).astype("float32"))
+    w = nd.array(onp.random.randn(4, 3, 3, 3).astype("float32"))
+    b = nd.array(onp.zeros(4, "float32"))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4)
+    assert out.shape == (2, 4, 6, 6)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1))
+    assert out.shape == (2, 4, 8, 8)
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=4, stride=(2, 2),
+                         pad=(1, 1))
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_convolution_vs_manual():
+    # 1x1 conv == matmul over channels
+    x = onp.random.randn(2, 3, 5, 5).astype("float32")
+    w = onp.random.randn(4, 3, 1, 1).astype("float32")
+    out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(1, 1),
+                         num_filter=4, no_bias=True)
+    expect = onp.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+    assert_almost_equal(out, expect, rtol=1e-4)
+
+
+def test_grouped_and_depthwise_conv():
+    x = nd.array(onp.random.randn(1, 4, 6, 6).astype("float32"))
+    w = nd.array(onp.random.randn(4, 1, 3, 3).astype("float32"))
+    out = nd.Convolution(x, w, None, kernel=(3, 3), num_filter=4,
+                         num_group=4, no_bias=True)
+    assert out.shape == (1, 4, 4, 4)
+    # each output channel = conv of corresponding input channel
+    from scipy.signal import correlate2d
+    for c in range(4):
+        expect = correlate2d(x.asnumpy()[0, c], w.asnumpy()[c, 0], "valid")
+        assert_almost_equal(out.asnumpy()[0, c], expect, rtol=1e-3, atol=1e-4)
+
+
+def test_deconvolution():
+    x = nd.array(onp.random.randn(1, 2, 4, 4).astype("float32"))
+    w = nd.array(onp.random.randn(2, 3, 3, 3).astype("float32"))
+    out = nd.Deconvolution(x, w, None, kernel=(3, 3), num_filter=3,
+                           stride=(2, 2), no_bias=True)
+    # out = (i-1)*s - 2p + k = 3*2 + 3 = 9
+    assert out.shape == (1, 3, 9, 9)
+    out = nd.Deconvolution(x, w, None, kernel=(3, 3), num_filter=3,
+                           stride=(2, 2), pad=(1, 1), adj=(1, 1),
+                           no_bias=True)
+    assert out.shape == (1, 3, 8, 8)
+
+
+def test_pooling():
+    x = nd.array(onp.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    assert_almost_equal(out, [[[[5, 7], [13, 15]]]])
+    out = nd.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+    assert_almost_equal(out, [[[[2.5, 4.5], [10.5, 12.5]]]])
+    out = nd.Pooling(x, kernel=(2, 2), global_pool=True, pool_type="max")
+    assert_almost_equal(out, [[[[15.0]]]])
+    out = nd.Pooling(x, kernel=(3, 3), stride=(2, 2), pool_type="max",
+                     pooling_convention="full")
+    assert out.shape == (1, 1, 2, 2)
+
+
+def test_batchnorm():
+    x = onp.random.randn(4, 3, 5, 5).astype("float32")
+    gamma = onp.random.rand(3).astype("float32") + 0.5
+    beta = onp.random.randn(3).astype("float32")
+    mean = onp.zeros(3, "float32")
+    var = onp.ones(3, "float32")
+    out, m, v = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                             nd.array(mean), nd.array(var), fix_gamma=False,
+                             use_batch_stats=True, eps=1e-5)
+    bm = x.mean(axis=(0, 2, 3))
+    bv = x.var(axis=(0, 2, 3))
+    expect = (x - bm[None, :, None, None]) / onp.sqrt(
+        bv[None, :, None, None] + 1e-5) * gamma[None, :, None, None] \
+        + beta[None, :, None, None]
+    assert_almost_equal(out, expect, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(m, bm, rtol=1e-4)
+
+
+def test_layernorm():
+    x = onp.random.randn(4, 10).astype("float32")
+    g = onp.ones(10, "float32")
+    b = onp.zeros(10, "float32")
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    mu = x.mean(-1, keepdims=True)
+    sd = onp.sqrt(x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, (x - mu) / sd, rtol=1e-4, atol=1e-5)
+
+
+def test_softmax_family():
+    x = onp.random.randn(3, 5).astype("float32")
+    out = nd.softmax(nd.array(x))
+    e = onp.exp(x - x.max(-1, keepdims=True))
+    assert_almost_equal(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    lout = nd.log_softmax(nd.array(x))
+    assert_almost_equal(lout, onp.log(e / e.sum(-1, keepdims=True)),
+                        rtol=1e-4, atol=1e-5)
+    length = nd.array([2, 5, 3])
+    mout = nd.softmax(nd.array(x), length, use_length=True, axis=-1)
+    mnp = mout.asnumpy()
+    assert mnp[0, 2:].sum() == 0
+    assert abs(mnp[0, :2].sum() - 1) < 1e-5
+
+
+def test_activations():
+    x = onp.array([-2.0, -0.5, 0.0, 0.5, 2.0], "float32")
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="relu"),
+                        onp.maximum(x, 0))
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="sigmoid"),
+                        1 / (1 + onp.exp(-x)), rtol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="tanh"),
+                        onp.tanh(x), rtol=1e-5)
+    assert_almost_equal(nd.Activation(nd.array(x), act_type="softrelu"),
+                        onp.log1p(onp.exp(x)), rtol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(nd.array(x), act_type="leaky",
+                                     slope=0.1),
+                        onp.where(x > 0, x, 0.1 * x), rtol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0),
+                        onp.where(x > 0, x, onp.expm1(x)), rtol=1e-5)
+
+
+def test_dropout_op():
+    x = nd.ones((1000,))
+    with autograd.record():  # train mode
+        from mxnet_tpu.ops.random import next_key
+        out = nd.Dropout(x, nd.NDArray(next_key()), p=0.5)
+    kept = (out.asnumpy() != 0).mean()
+    assert 0.4 < kept < 0.6
+    assert_almost_equal(out.asnumpy()[out.asnumpy() != 0],
+                        onp.full((out.asnumpy() != 0).sum(), 2.0))
+
+
+def test_elementwise_broadcast():
+    a = onp.random.randn(3, 1, 4).astype("float32")
+    b = onp.random.randn(1, 5, 4).astype("float32")
+    out = nd.broadcast_add(nd.array(a), nd.array(b))
+    assert_almost_equal(out, a + b, rtol=1e-5)
+    out = nd.broadcast_mul(nd.array(a), nd.array(b))
+    assert_almost_equal(out, a * b, rtol=1e-5)
+    out = nd.broadcast_maximum(nd.array(a), nd.array(b))
+    assert_almost_equal(out, onp.maximum(a, b))
+
+
+def test_dot_batchdot():
+    a = onp.random.randn(3, 4).astype("float32")
+    b = onp.random.randn(4, 5).astype("float32")
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b)), a @ b, rtol=1e-4)
+    assert_almost_equal(nd.dot(nd.array(a), nd.array(b.T), transpose_b=True),
+                        a @ b, rtol=1e-4)
+    ba = onp.random.randn(2, 3, 4).astype("float32")
+    bb = onp.random.randn(2, 4, 5).astype("float32")
+    assert_almost_equal(nd.batch_dot(nd.array(ba), nd.array(bb)), ba @ bb,
+                        rtol=1e-4)
+
+
+def test_topk_sort():
+    x = onp.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], "float32")
+    idx = nd.topk(nd.array(x), k=2)
+    assert_almost_equal(idx, [[0, 2], [1, 2]])
+    vals = nd.topk(nd.array(x), k=2, ret_typ="value")
+    assert_almost_equal(vals, [[3, 2], [5, 4]])
+    s = nd.sort(nd.array(x), axis=1)
+    assert_almost_equal(s, onp.sort(x, 1))
+    a = nd.argsort(nd.array(x), axis=1)
+    assert_almost_equal(a, onp.argsort(x, 1).astype("f"))
+
+
+def test_sequence_ops():
+    x = onp.arange(24, dtype="float32").reshape(4, 2, 3)  # (T, N, C)
+    length = nd.array([2, 4])
+    out = nd.SequenceMask(nd.array(x), length, use_sequence_length=True,
+                          value=-1.0)
+    outn = out.asnumpy()
+    assert (outn[2:, 0] == -1).all()
+    assert (outn[:, 1] == x[:, 1]).all()
+    last = nd.SequenceLast(nd.array(x), length, use_sequence_length=True)
+    assert_almost_equal(last, onp.stack([x[1, 0], x[3, 1]]))
+    rev = nd.SequenceReverse(nd.array(x), length, use_sequence_length=True)
+    revn = rev.asnumpy()
+    assert_almost_equal(revn[0, 0], x[1, 0])
+    assert_almost_equal(revn[1, 0], x[0, 0])
+    assert_almost_equal(revn[0, 1], x[3, 1])
+
+
+def test_embedding():
+    w = onp.random.randn(10, 4).astype("float32")
+    idx = nd.array([1, 3, 1])
+    out = nd.Embedding(idx, nd.array(w), input_dim=10, output_dim=4)
+    assert_almost_equal(out, w[[1, 3, 1]])
+
+
+def test_grad_of_conv_pool_dense():
+    x = nd.array(onp.random.randn(2, 3, 6, 6).astype("float32") * 0.5)
+    w = nd.array(onp.random.randn(4, 3, 3, 3).astype("float32") * 0.3)
+
+    def f(x_, w_):
+        c = nd.Convolution(x_, w_, None, kernel=(3, 3), num_filter=4,
+                           no_bias=True)
+        p = nd.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="avg")
+        return p * p
+
+    check_numeric_gradient(f, [x, w], eps=1e-2, rtol=5e-2, atol=1e-2)
+
+
+def test_ctc_loss_smoke():
+    T, N, C = 10, 2, 5
+    data = nd.array(onp.random.randn(T, N, C).astype("float32"))
+    label = nd.array(onp.array([[1, 2], [2, 3]], dtype="float32"))
+    loss = nd.CTCLoss(data, label)
+    assert loss.shape == (N,)
+    assert (loss.asnumpy() > 0).all()
+
+
+def test_clip_norm_misc():
+    x = onp.random.randn(4, 4).astype("float32")
+    assert_almost_equal(nd.clip(nd.array(x), -0.5, 0.5),
+                        onp.clip(x, -0.5, 0.5))
+    assert_almost_equal(nd.norm(nd.array(x)),
+                        onp.sqrt((x ** 2).sum()), rtol=1e-4)
+    assert_almost_equal(nd.norm(nd.array(x), axis=1),
+                        onp.sqrt((x ** 2).sum(1)), rtol=1e-4)

@@ -1,0 +1,126 @@
+"""Mesh/SPMD tests on the 8-device virtual CPU mesh (parity:
+tests/python/gpu/test_device.py + multi-device kvstore tests)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_virtual_devices_present():
+    import jax
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh():
+    from mxnet_tpu.parallel import make_mesh
+    mesh = make_mesh({"dp": -1})
+    assert mesh.devices.size == 8
+    mesh2 = make_mesh({"dp": 4, "tp": 2})
+    assert mesh2.axis_names == ("dp", "tp")
+
+
+def test_spmd_trainer_matches_single_device():
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    def build():
+        onp.random.seed(3)
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu", in_units=4))
+        net.add(nn.Dense(2, in_units=16))
+        net.initialize()
+        return net
+
+    x = onp.random.RandomState(0).randn(8, 4).astype("float32")
+    y = onp.random.RandomState(1).randint(0, 2, size=(8,)).astype("float32")
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    # single-device eager reference
+    net_ref = build()
+    trainer_ref = gluon.Trainer(net_ref.collect_params(), "sgd",
+                                {"learning_rate": 0.5}, kvstore=None)
+    for _ in range(3):
+        with autograd.record():
+            loss = loss_fn(net_ref(nd.array(x)), nd.array(y)).mean()
+        loss.backward()
+        trainer_ref.step(1)  # loss already mean-ed: rescale 1
+
+    # SPMD over 8 virtual devices
+    net_spmd = build()
+    trainer = SPMDTrainer(net_spmd, loss_fn, optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.5},
+                          mesh=make_mesh({"dp": -1}))
+    for _ in range(3):
+        trainer.step(x, y)
+
+    for k in net_ref.collect_params():
+        w_ref = net_ref.collect_params()[k].data().asnumpy()
+        w_spmd = net_spmd.collect_params()[k].data().asnumpy()
+        assert_almost_equal(w_ref, w_spmd, rtol=1e-4, atol=1e-5)
+
+
+def test_spmd_tensor_parallel_shard():
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+    from jax.sharding import PartitionSpec
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu", in_units=4))
+    net.add(nn.Dense(8, in_units=16))
+    net.initialize()
+    net[1].weight.shard(PartitionSpec("tp", None))
+    net[1].bias.shard(PartitionSpec("tp"))
+    mesh = make_mesh({"dp": 4, "tp": 2})
+    trainer = SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                          optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.1},
+                          mesh=mesh)
+    x = onp.random.randn(8, 4).astype("float32")
+    y = onp.random.randint(0, 8, size=(8,)).astype("float32")
+    l1 = float(trainer.step(x, y).asnumpy())
+    l2 = float(trainer.step(x, y).asnumpy())
+    assert l2 < l1 + 1.0  # trains without error; loss roughly sane
+
+
+def test_graft_dryrun_multichip():
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "__graft_entry__", "/root/repo/__graft_entry__.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
+
+
+def test_kvstore_local_pushpull():
+    kv = mx.kv.create("local")
+    kv.init("w", nd.ones((3,)))
+    # multi-"device" values reduce
+    vals = [nd.ones((3,)), nd.ones((3,)) * 2]
+    out = nd.zeros((3,))
+    kv.pushpull("w", vals, out=out)
+    assert_almost_equal(out, [3.0, 3.0, 3.0])
+
+
+def test_kvstore_server_side_optimizer():
+    kv = mx.kv.create("device")
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    w = nd.ones((2,))
+    kv.init("0", w)
+    grad = nd.ones((2,))
+    out = nd.zeros((2,))
+    kv.pushpull("0", grad, out=out)
+    assert_almost_equal(out, [0.9, 0.9])
+
+
+def test_trainer_with_kvstore_device():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1}, kvstore="device")
+    x = nd.ones((2, 2))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(2)  # should not raise
