@@ -1,0 +1,388 @@
+"""Attention ops: Pallas flash attention + transformer contrib parity.
+
+TPU-first design: the hot path is a Pallas flash-attention kernel
+(online-softmax over K/V blocks, f32 accumulators in VMEM scratch,
+grid = (batch*heads, q_blocks, k_blocks) with the k dimension innermost
+so scratch persists across it).  Backward recomputes per-q-block from
+the saved logsumexp (the standard flash backward), expressed as a
+`lax.scan` so memory stays O(seq * block) — XLA tiles the matmuls onto
+the MXU.
+
+Parity targets (API, not implementation):
+- `_contrib_interleaved_matmul_selfatt_qk/valatt`,
+  `_contrib_interleaved_matmul_encdec_qk/valatt`
+  (reference: src/operator/contrib/transformer.cc:650-860 — fused
+  interleaved-projection attention matmuls; semantics documented in the
+  op describe() blocks there).
+- `_contrib_div_sqrt_dim` (src/operator/contrib/transformer.cc).
+- `flash_attention` itself is a capability the reference lacks — the
+  long-context path called for by SURVEY.md §5 ("Long-context /
+  sequence parallelism: absent in reference").
+
+Sequence/context parallelism (ring attention over a mesh axis) builds
+on `_online_block` below; see mxnet_tpu/parallel/ring_attention.py.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .registry import register
+
+__all__ = ["flash_attention", "attention_reference", "online_block_update",
+           "masked_softmax"]
+
+_NEG_INF = -1e30  # finite -inf stand-in: keeps masked-row math NaN-free
+
+
+# --------------------------------------------------------------------------
+# reference (materialized-scores) attention — the numerics oracle
+# --------------------------------------------------------------------------
+
+def attention_reference(q, k, v, causal=False, sm_scale=None, bias=None):
+    """Plain softmax(QK^T)V on (B, H, S, D) tensors."""
+    d = q.shape[-1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        qpos = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        kpos = lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(qpos >= kpos, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+# --------------------------------------------------------------------------
+# pallas forward kernel
+# --------------------------------------------------------------------------
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   acc_ref, m_ref, l_ref, *,
+                   sm_scale, causal, block_q, block_k, seq_k):
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_start = i * block_q
+    k_start = j * block_k
+
+    # causal: whole k-block above the diagonal contributes nothing
+    run = (q_start + block_q - 1 >= k_start) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                      # (block_q, d)
+        k = k_ref[0]                      # (block_k, d)
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        kpos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kpos < seq_k               # crop padded keys
+        if causal:
+            qpos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            mask = mask & (qpos >= kpos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]             # (block_q, 1)
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.exp(s - m_cur)            # (block_q, block_k)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=1, keepdims=True)
+        pv = lax.dot_general(p.astype(v_ref.dtype), v_ref[0],
+                             (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, 0] + jnp.log(l[:, 0])).astype(lse_ref.dtype)
+
+
+def _ceil_to(x, m):
+    return (x + m - 1) // m * m
+
+
+def _fa_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k):
+    """q,k,v: (BH, S, D) → (out (BH, Sq, D), lse (BH, Sq))."""
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, _ceil_to(seq_q, 128))
+    block_k = min(block_k, _ceil_to(seq_k, 128))
+    pq = _ceil_to(seq_q, block_q) - seq_q
+    pk = _ceil_to(seq_k, block_k) - seq_k
+    if pq:
+        q = jnp.pad(q, ((0, 0), (0, pq), (0, 0)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pk), (0, 0)))
+    nq = q.shape[1] // block_q
+    nk = k.shape[1] // block_k
+
+    kernel = functools.partial(
+        _fa_fwd_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_k=seq_k)
+    scratch_shapes = [
+        pltpu.VMEM((block_q, d), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+        pltpu.VMEM((block_q, 128), jnp.float32),
+    ]
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(bh, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, q.shape[1], d), q.dtype),
+            jax.ShapeDtypeStruct((bh, q.shape[1]), jnp.float32),
+        ],
+        scratch_shapes=scratch_shapes,
+        interpret=jax.default_backend() != "tpu",
+    )(q, k, v)
+    if pq:
+        out = out[:, :seq_q]
+        lse = lse[:, :seq_q]
+    return out, lse
+
+
+# --------------------------------------------------------------------------
+# backward: recompute per q-block from saved lse (flash backward), scanned
+# --------------------------------------------------------------------------
+
+def _fa_backward(causal, sm_scale, block_q, res, do):
+    q, k, v, out, lse = res           # (BH, Sq, D) ... lse (BH, Sq)
+    bh, seq_q, d = q.shape
+    block_q = min(block_q, _ceil_to(seq_q, 128))
+    pq = _ceil_to(seq_q, block_q) - seq_q
+    if pq:
+        pad3 = ((0, 0), (0, pq), (0, 0))
+        q = jnp.pad(q, pad3)
+        out = jnp.pad(out, pad3)
+        do = jnp.pad(do, pad3)
+        lse = jnp.pad(lse, ((0, 0), (0, pq)))
+    nq = q.shape[1] // block_q
+
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)          # (BH, Sq')
+
+    def body(carry, idx):
+        dk, dv = carry
+        qi = lax.dynamic_slice_in_dim(q, idx * block_q, block_q, 1)
+        doi = lax.dynamic_slice_in_dim(do, idx * block_q, block_q, 1)
+        lsei = lax.dynamic_slice_in_dim(lse, idx * block_q, block_q, 1)
+        di = lax.dynamic_slice_in_dim(delta, idx * block_q, block_q, 1)
+        s = jnp.einsum("bqd,bkd->bqk", qi, k,
+                       preferred_element_type=jnp.float32) * sm_scale
+        qpos = idx * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        kpos = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        mask = qpos < seq_q
+        if causal:
+            mask = mask & (qpos >= kpos)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lsei[..., None])          # (BH, bq, Sk)
+        p = jnp.where(mask, p, 0.0)
+        dv = dv + jnp.einsum("bqk,bqd->bkd", p, doi.astype(jnp.float32))
+        dp = jnp.einsum("bqd,bkd->bqk", doi.astype(jnp.float32),
+                        v.astype(jnp.float32))
+        ds = p * (dp - di[..., None]) * sm_scale
+        dqi = jnp.einsum("bqk,bkd->bqd", ds, k.astype(jnp.float32))
+        dk = dk + jnp.einsum("bqk,bqd->bkd", ds, qi.astype(jnp.float32))
+        return (dk, dv), dqi
+
+    init = (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    (dk, dv), dq_chunks = lax.scan(body, init, jnp.arange(nq))
+    dq = jnp.moveaxis(dq_chunks, 0, 1).reshape(bh, nq * block_q, d)
+    if pq:
+        dq = dq[:, :seq_q]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# --------------------------------------------------------------------------
+# public flash_attention on raw arrays (custom_vjp over the pallas fwd)
+# --------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, sm_scale, block_q, block_k):
+    out, _ = _fa_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    out, lse = _fa_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    return _fa_backward(causal, sm_scale, block_q, res, do)
+
+
+_flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=False, sm_scale=None,
+                    block_q=512, block_k=512):
+    """Flash attention on (B, H, S, D) (or (BH, S, D)) arrays."""
+    squeeze = q.ndim == 3
+    if squeeze:
+        q, k, v = q[None], k[None], v[None]
+    b, h, sq, d = q.shape
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, k.shape[2], d)
+    vf = v.reshape(b * h, v.shape[2], d)
+    out = _flash_attention(qf, kf, vf, bool(causal), float(scale),
+                           int(block_q), int(block_k))
+    out = out.reshape(b, h, sq, d)
+    return out[0] if squeeze else out
+
+
+register("flash_attention", aliases=("_npx_flash_attention",))(
+    lambda q, k, v, causal=False, sm_scale=None, block_q=512, block_k=512:
+    flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
+                    block_q=block_q, block_k=block_k))
+
+
+# --------------------------------------------------------------------------
+# online-softmax block update — shared with ring attention
+# --------------------------------------------------------------------------
+
+def online_block_update(o, m, l, q, k, v, sm_scale, mask=None):
+    """One flash/ring accumulator update with a new K/V block.
+
+    o: (B,H,Sq,D) f32 accum; m,l: (B,H,Sq,1) f32 running max / normalizer.
+    Returns updated (o, m, l).
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if mask is not None:
+        s = jnp.where(mask, s, _NEG_INF)
+    m_cur = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+    corr = jnp.exp(m - m_cur)
+    p = jnp.exp(s - m_cur)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    l_new = l * corr + p.sum(axis=-1, keepdims=True)
+    o_new = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                  v.astype(jnp.float32))
+    return o_new, m_cur, l_new
+
+
+# --------------------------------------------------------------------------
+# masked softmax (parity: softmax with length masking used by transformer)
+# --------------------------------------------------------------------------
+
+@register("masked_softmax", aliases=("_npx_masked_softmax",))
+def masked_softmax(x, mask=None, *, axis=-1, temperature=1.0):
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), x, _NEG_INF)
+    p = jax.nn.softmax(x / temperature, axis=axis)
+    if mask is not None:
+        p = jnp.where(mask.astype(bool), p, 0.0)
+    return p
+
+
+# --------------------------------------------------------------------------
+# contrib transformer parity ops (semantics per transformer.cc describe())
+# --------------------------------------------------------------------------
+
+@register("_contrib_div_sqrt_dim", aliases=("div_sqrt_dim",))
+def _div_sqrt_dim(x):
+    return x / math.sqrt(x.shape[-1])
+
+
+def _split_interleaved(qkv, heads, n):
+    """(S, B, heads*hd*n) → n tensors of (B*heads, S, hd)."""
+    s, b, e = qkv.shape
+    hd = e // (heads * n)
+    t = qkv.reshape(s, b, heads, n, hd)
+    outs = []
+    for i in range(n):
+        proj = jnp.transpose(t[:, :, :, i, :], (1, 2, 0, 3))
+        outs.append(proj.reshape(b * heads, s, hd))
+    return outs
+
+
+@register("_contrib_interleaved_matmul_selfatt_qk",
+          aliases=("interleaved_matmul_selfatt_qk",))
+def _imm_selfatt_qk(queries_keys_values, *, heads):
+    q, k, _ = _split_interleaved(queries_keys_values, heads, 3)
+    q = q / math.sqrt(q.shape[-1])
+    return jnp.einsum("nqd,nkd->nqk", q, k)
+
+
+def _attend_and_merge_heads(attention, v, heads):
+    """attention (B*H, Sq, Sk) × v (B*H, Sk, hd) → (Sq, B, H*hd)."""
+    out = jnp.einsum("nqk,nkd->nqd", attention, v)
+    bh, s, hd = out.shape
+    b = bh // heads
+    out = jnp.transpose(out.reshape(b, heads, s, hd), (2, 0, 1, 3))
+    return out.reshape(s, b, heads * hd)
+
+
+@register("_contrib_interleaved_matmul_selfatt_valatt",
+          aliases=("interleaved_matmul_selfatt_valatt",))
+def _imm_selfatt_valatt(queries_keys_values, attention, *, heads):
+    _, _, v = _split_interleaved(queries_keys_values, heads, 3)
+    return _attend_and_merge_heads(attention, v, heads)
+
+
+@register("_contrib_interleaved_matmul_encdec_qk",
+          aliases=("interleaved_matmul_encdec_qk",))
+def _imm_encdec_qk(queries, keys_values, *, heads):
+    sq, b, e = queries.shape
+    hd = e // heads
+    q = jnp.transpose(queries.reshape(sq, b, heads, hd), (1, 2, 0, 3))
+    q = q.reshape(b * heads, sq, hd) / math.sqrt(hd)
+    k, _ = _split_interleaved(keys_values, heads, 2)
+    return jnp.einsum("nqd,nkd->nqk", q, k)
+
+
+@register("_contrib_interleaved_matmul_encdec_valatt",
+          aliases=("interleaved_matmul_encdec_valatt",))
+def _imm_encdec_valatt(keys_values, attention, *, heads):
+    _, v = _split_interleaved(keys_values, heads, 2)
+    return _attend_and_merge_heads(attention, v, heads)
+
+
+# -- multi-head attention convenience op (flash-backed) --------------------
+
+@register("multi_head_attention", aliases=("_npx_multi_head_attention",))
+def _multi_head_attention(q, k, v, *, num_heads, causal=False,
+                          use_flash=True):
+    """(B, S, E) inputs pre-projected; splits heads, attends, re-merges."""
+    b, sq, e = q.shape
+    hd = e // num_heads
+    def split(x):
+        return jnp.transpose(x.reshape(b, x.shape[1], num_heads, hd),
+                             (0, 2, 1, 3))
+    qh, kh, vh = split(q), split(k), split(v)
+    if use_flash:
+        out = flash_attention(qh, kh, vh, causal=causal)
+    else:
+        out = attention_reference(qh, kh, vh, causal=causal)
+    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, e)

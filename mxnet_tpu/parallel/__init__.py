@@ -10,6 +10,7 @@ equivalent here — the compiler owns topology).
 """
 from .mesh import make_mesh, default_mesh, data_parallel_spec, replicated
 from .trainer import SPMDTrainer
+from .ring_attention import ring_attention, ring_self_attention
 
 __all__ = ["make_mesh", "default_mesh", "data_parallel_spec", "replicated",
-           "SPMDTrainer"]
+           "SPMDTrainer", "ring_attention", "ring_self_attention"]

@@ -1,0 +1,93 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Each device holds a sequence shard of Q, K, V.  K/V shards rotate
+around the ring (`lax.ppermute` → XLA collective-permute riding ICI)
+while every device folds the visiting block into flash-attention
+online-softmax accumulators — attention over sequences far larger than
+one chip's HBM, with compute/communication overlap handled by XLA's
+async collectives.
+
+The reference has no equivalent (SURVEY.md §5: "Long-context / sequence
+parallelism: absent"); this is the capability the TPU build adds.
+Expressed with `lax.scan` over ring steps so it is differentiable
+(the transpose of ppermute is the reverse ppermute — backward runs the
+ring the other way for free).
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+from jax import shard_map
+
+from ..ops.attention import online_block_update, _NEG_INF
+
+__all__ = ["ring_attention", "ring_self_attention"]
+
+
+def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
+                   sm_scale: Optional[float] = None):
+    """Per-shard ring attention body; call inside shard_map/pjit.
+
+    q, k, v: (B, H, S_local, D) — this device's sequence shard.
+    Returns the local output shard (B, H, S_local, D).
+    """
+    n = lax.psum(1, axis_name)
+    my = lax.axis_index(axis_name)
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+
+    q32 = q.astype(jnp.float32)
+    o0 = jnp.zeros((b, h, sq, d), jnp.float32)
+    m0 = jnp.full((b, h, sq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, sq, 1), jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, ring_step):
+        o, m, l, kc, vc = carry
+        kv_idx = (my - ring_step) % n
+
+        def update(o, m, l):
+            mask = None
+            if causal:
+                qpos = (my * sq
+                        + lax.broadcasted_iota(jnp.int32, (b, h, sq, sk), 2))
+                kpos = (kv_idx * sk
+                        + lax.broadcasted_iota(jnp.int32, (b, h, sq, sk), 3))
+                mask = qpos >= kpos
+            return online_block_update(o, m, l, q32, kc, vc, scale, mask)
+
+        if causal:
+            # shards strictly above the diagonal contribute nothing —
+            # skip both matmuls, keep only the ring rotation
+            o, m, l = lax.cond(kv_idx <= my, update,
+                               lambda o, m, l: (o, m, l), o, m, l)
+        else:
+            o, m, l = update(o, m, l)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (o, m, l, kc, vc), None
+
+    (o, m, l, _, _), _ = lax.scan(
+        step, (o0, m0, l0, k.astype(jnp.float32), v.astype(jnp.float32)),
+        jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l).astype(q.dtype)
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
+                        causal: bool = False,
+                        sm_scale: Optional[float] = None):
+    """shard_map wrapper: shards the sequence axis of (B,H,S,D) over
+    ``axis_name`` and runs ring attention across the mesh."""
+    spec = PartitionSpec(None, None, axis_name, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, sm_scale=sm_scale)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

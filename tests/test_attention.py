@@ -1,0 +1,138 @@
+"""Flash attention + ring attention + contrib transformer op tests.
+
+Parity model: the reference cross-checks kernels against a materialized
+reference implementation (check_consistency, SURVEY.md §4); here the
+oracle is plain softmax(QK^T)V.
+"""
+import numpy as onp
+import pytest
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.attention import (flash_attention, attention_reference)
+from mxnet_tpu.parallel import make_mesh, ring_self_attention
+
+
+def _rand(*shape, seed=0):
+    return onp.random.RandomState(seed).randn(*shape).astype("float32")
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (256, 128), (100, 180)])
+def test_flash_vs_reference(causal, sq, sk):
+    if causal and sq != sk:
+        pytest.skip("causal requires square")
+    q = jnp.asarray(_rand(2, 3, sq, 64, seed=1))
+    k = jnp.asarray(_rand(2, 3, sk, 64, seed=2))
+    v = jnp.asarray(_rand(2, 3, sk, 64, seed=3))
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+    ref = attention_reference(q, k, v, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_grads(causal):
+    q = jnp.asarray(_rand(1, 2, 128, 32, seed=4))
+    k = jnp.asarray(_rand(1, 2, 128, 32, seed=5))
+    v = jnp.asarray(_rand(1, 2, 128, 32, seed=6))
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=causal,
+                               block_q=64, block_k=64).sum()
+
+    def loss_ref(q, k, v):
+        return attention_reference(q, k, v, causal=causal).sum()
+
+    g1 = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_full(causal):
+    mesh = make_mesh({"sp": 8})
+    q = jnp.asarray(_rand(1, 2, 8 * 16, 32, seed=7))
+    k = jnp.asarray(_rand(1, 2, 8 * 16, 32, seed=8))
+    v = jnp.asarray(_rand(1, 2, 8 * 16, 32, seed=9))
+    out = ring_self_attention(q, k, v, mesh, causal=causal)
+    ref = attention_reference(q, k, v, causal=causal)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+
+def test_ring_attention_grad():
+    mesh = make_mesh({"sp": 4})
+    q = jnp.asarray(_rand(1, 1, 64, 16, seed=10))
+    k = jnp.asarray(_rand(1, 1, 64, 16, seed=11))
+    v = jnp.asarray(_rand(1, 1, 64, 16, seed=12))
+
+    def loss_ring(q, k, v):
+        return ring_self_attention(q, k, v, mesh, causal=True).sum()
+
+    def loss_ref(q, k, v):
+        return attention_reference(q, k, v, causal=True).sum()
+
+    g1 = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-3, atol=1e-3)
+
+
+def test_interleaved_selfatt_matches_unfused():
+    """Against the documented equivalent-code semantics
+    (transformer.cc:650 describe block)."""
+    s, b, heads, hd = 6, 2, 4, 8
+    qkv = mx.nd.array(_rand(s, b, heads * hd * 3, seed=13))
+    att = mx.nd.interleaved_matmul_selfatt_qk(qkv, heads=heads)
+    assert att.shape == (b * heads, s, s)
+
+    tmp = qkv.asnumpy().reshape(s, b, heads, 3, hd)
+    q = onp.transpose(tmp[:, :, :, 0, :], (1, 2, 0, 3)).reshape(-1, s, hd)
+    kk = onp.transpose(tmp[:, :, :, 1, :], (1, 2, 0, 3)).reshape(-1, s, hd)
+    expect = onp.einsum("nqd,nkd->nqk", q / onp.sqrt(hd), kk)
+    onp.testing.assert_allclose(att.asnumpy(), expect, rtol=1e-5, atol=1e-5)
+
+    out = mx.nd.interleaved_matmul_selfatt_valatt(qkv, att, heads=heads)
+    assert out.shape == (s, b, heads * hd)
+    vv = onp.transpose(tmp[:, :, :, 2, :], (1, 2, 0, 3)).reshape(-1, s, hd)
+    eo = onp.einsum("nqk,nkd->nqd", att.asnumpy(), vv)
+    eo = eo.reshape(b, heads, s, hd).transpose(2, 0, 1, 3).reshape(s, b, -1)
+    onp.testing.assert_allclose(out.asnumpy(), eo, rtol=1e-5, atol=1e-5)
+
+
+def test_interleaved_encdec_shapes():
+    s, b, heads, hd = 5, 2, 2, 4
+    qs = mx.nd.array(_rand(s, b, heads * hd, seed=14))
+    kv = mx.nd.array(_rand(s + 2, b, heads * hd * 2, seed=15))
+    att = mx.nd.interleaved_matmul_encdec_qk(qs, kv, heads=heads)
+    assert att.shape == (b * heads, s, s + 2)
+    out = mx.nd.interleaved_matmul_encdec_valatt(kv, att, heads=heads)
+    assert out.shape == (s, b, heads * hd)
+
+
+def test_masked_softmax():
+    x = mx.nd.array(_rand(2, 3, 4, seed=16))
+    mask = mx.nd.array((onp.arange(4) < 3).astype("float32").reshape(1, 1, 4)
+                       * onp.ones((2, 3, 4), "float32"))
+    p = mx.nd.masked_softmax(x, mask)
+    pn = p.asnumpy()
+    assert onp.allclose(pn[..., 3], 0.0)
+    onp.testing.assert_allclose(pn.sum(-1), onp.ones((2, 3)), rtol=1e-5)
+
+
+def test_multi_head_attention_op():
+    b, s, e, h = 2, 32, 64, 4
+    q = mx.nd.array(_rand(b, s, e, seed=17))
+    k = mx.nd.array(_rand(b, s, e, seed=18))
+    v = mx.nd.array(_rand(b, s, e, seed=19))
+    out = mx.nd.multi_head_attention(q, k, v, num_heads=h, causal=True)
+    ref = mx.nd.multi_head_attention(q, k, v, num_heads=h, causal=True,
+                                     use_flash=False)
+    assert out.shape == (b, s, e)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
+                                rtol=2e-4, atol=2e-4)
