@@ -25,6 +25,8 @@ from . import lr_scheduler  # noqa: E402
 from . import gluon  # noqa: E402
 from . import kvstore  # noqa: E402
 from . import kvstore as kv  # noqa: E402
+from . import symbol  # noqa: E402
+from . import symbol as sym  # noqa: E402
 from . import numpy  # noqa: E402
 from . import numpy as np  # noqa: E402
 from . import numpy_extension as npx  # noqa: E402

@@ -392,30 +392,64 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Construct a block from exported artifacts (parity: block.py:1479).
+    """Run a Symbol graph as a gluon block (parity: block.py:1479).
 
-    v1: re-load parameters onto a user-supplied forward function.
+    Symbol arguments that are not listed as inputs become Parameters;
+    ``imports`` re-loads an exported symbol json + params file.
     """
 
-    def __init__(self, forward_fn: Callable, params: Optional[dict] = None):
+    def __init__(self, outputs, inputs, params: Optional[dict] = None):
         super().__init__()
-        self._forward_fn = forward_fn
-        if params:
-            for k, v in params.items():
-                self._reg_params[k] = v
+        from ..symbol import Symbol, Group
+        if isinstance(outputs, (list, tuple)):
+            outputs = Group(list(outputs))
+        if not isinstance(outputs, Symbol):
+            raise MXNetError("SymbolBlock expects a Symbol")
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i if isinstance(i, str) else i.name
+                             for i in inputs]
+        self._arg_names = outputs.list_arguments()
+        self._fn = outputs._lower(self._arg_names)
+        params = params or {}
+        for name in self._arg_names:
+            if name in self._input_names:
+                continue
+            p = Parameter(name=name, allow_deferred_init=True)
+            if name in params:
+                v = params[name]
+                p.set_data(v if isinstance(v, NDArray) else NDArray(v))
+            self._reg_params[name] = p
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, ctx=None,
-                forward_fn=None):
-        blk = SymbolBlock(forward_fn or (lambda *a: a[0]))
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        from ..symbol import load as sym_load
+        outputs = sym_load(symbol_file)
+        params = {}
         if param_file:
             from ..ndarray import load as nd_load
-            loaded = nd_load(param_file)
-            for k, v in loaded.items():
-                p = Parameter(name=k, shape=v.shape, dtype=str(v.dtype))
-                p.set_data(v)
-                blk._reg_params[k] = p
-        return blk
+            params = nd_load(param_file)
+        return SymbolBlock(outputs, input_names, params=params)
 
     def forward(self, *args):
-        return self._forward_fn(*args)
+        if len(args) != len(self._input_names):
+            raise MXNetError(
+                f"SymbolBlock expects {len(self._input_names)} inputs "
+                f"{self._input_names}, got {len(args)}")
+        feed = dict(zip(self._input_names, args))
+        nd_inputs = []
+        for name in self._arg_names:
+            if name in feed:
+                a = feed[name]
+            else:
+                p = self._reg_params[name]
+                if p._data is None:
+                    raise MXNetError(
+                        f"SymbolBlock parameter {name!r} has no value; "
+                        "pass it via params= or set_data() before forward")
+                a = p.data()
+            nd_inputs.append(a if isinstance(a, NDArray) else NDArray(a))
+        outs = apply_jax(lambda *arr: tuple(self._fn(list(arr))),
+                         nd_inputs, multi_out=True)
+        return outs[0] if len(outs) == 1 else outs

@@ -10,6 +10,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
 from .register import populate_namespace, make_op_func
 from . import random
 from . import linalg
+from . import contrib
 
 populate_namespace(globals())
 

@@ -1,0 +1,230 @@
+"""Control-flow and contrib NDArray ops.
+
+Parity: ``mx.nd.contrib.foreach / while_loop / cond``
+(src/operator/control_flow.cc:1094,1155,1216 — subgraph-executing
+stateful ops with full backward; python/mxnet/ndarray/contrib.py).
+TPU-native: the user body is traced into ``lax.scan`` /
+``lax.while_loop`` / ``lax.cond`` — compiler-friendly control flow
+instead of subgraph re-execution, differentiable because the whole
+construct is recorded on the autograd tape as one op.
+
+Closed-over NDArrays (e.g. RNN weights referenced inside the body) are
+discovered with a one-shot capture trace (`CaptureScope`) and threaded
+as real inputs, so gradients flow to them — the analogue of the
+reference's control-flow subgraph input capture.
+
+``while_loop`` follows the reference contract that ``max_iterations``
+bounds the loop; it lowers to a bounded, predicate-gated ``lax.scan``
+so it stays reverse-differentiable (jax's ``while_loop`` is not), and
+trims outputs to the realized step count outside of traces.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .. import autograd as ag
+from ..ops.registry import apply_jax, CaptureScope
+from .ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf"]
+
+
+def _as_list(x) -> Tuple[List[Any], bool]:
+    if isinstance(x, (list, tuple)):
+        return list(x), False
+    return [x], True
+
+
+def _wrap(arrays) -> List[NDArray]:
+    return [NDArray(a) for a in arrays]
+
+
+def _raw(nds) -> List[Any]:
+    out = []
+    for x in (nds if isinstance(nds, (list, tuple)) else [nds]):
+        out.append(x._data if isinstance(x, NDArray) else jnp.asarray(x))
+    return out
+
+
+def _nd(x) -> NDArray:
+    return x if isinstance(x, NDArray) else NDArray(x)
+
+
+class _swapped:
+    """Temporarily rebind captured NDArrays' buffers to traced values."""
+
+    def __init__(self, nds, arrays):
+        self._nds = list(nds)
+        self._arrays = list(arrays)
+
+    def __enter__(self):
+        self._saved = [p._data for p in self._nds]
+        for p, a in zip(self._nds, self._arrays):
+            p._data = a
+        return self
+
+    def __exit__(self, *exc):
+        for p, s in zip(self._nds, self._saved):
+            p._data = s
+        return False
+
+
+def foreach(body: Callable, data, init_states, name: str = "foreach"):
+    """Iterate ``body(data_t, states) -> (outputs, new_states)`` over
+    axis 0 of ``data`` (parity: control_flow.cc `_foreach`)."""
+    data_list, data_single = _as_list(data)
+    states_list, states_single = _as_list(init_states)
+    data_list = [_nd(x) for x in data_list]
+    states_list = [_nd(x) for x in states_list]
+    n_data, n_states = len(data_list), len(states_list)
+
+    with CaptureScope() as scope, ag.pause():
+        d0 = [x[0] for x in data_list]
+        body(d0[0] if data_single else d0,
+             states_list[0] if states_single else list(states_list))
+    captured = scope.captured(exclude=data_list + states_list)
+
+    def fn(*arrays):
+        xs = tuple(arrays[:n_data])
+        init = tuple(arrays[n_data:n_data + n_states])
+        cap = arrays[n_data + n_states:]
+
+        def step(carry, x):
+            with _swapped(captured, cap), ag.pause():
+                x_nd = _wrap(x)
+                c_nd = _wrap(carry)
+                out, new_states = body(
+                    x_nd[0] if data_single else x_nd,
+                    c_nd[0] if states_single else c_nd)
+            return tuple(_raw(new_states)), tuple(_raw(out))
+
+        carry, ys = lax.scan(step, init, xs)
+        return tuple(ys) + tuple(carry)
+
+    flat = apply_jax(fn, data_list + states_list + captured, multi_out=True)
+    outs, states = flat[:len(flat) - n_states], flat[len(flat) - n_states:]
+    return (outs[0] if len(outs) == 1 else list(outs),
+            states[0] if states_single else list(states))
+
+
+def while_loop(cond: Callable, func: Callable, loop_vars,
+               max_iterations: int | None = None, name: str = "while_loop"):
+    """Bounded while loop (parity: control_flow.cc `_while_loop`).
+
+    ``cond(*loop_vars) -> boolean scalar``; ``func(*loop_vars) ->
+    (step_output, new_loop_vars)``.  Returns (stacked outputs, final
+    loop vars); outputs beyond the realized iteration count are
+    dropped eagerly (zero-padded under jit, as shapes must be static).
+    """
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations")
+    lv_list, lv_single = _as_list(loop_vars)
+    lv_list = [_nd(x) for x in lv_list]
+    n_vars = len(lv_list)
+
+    with CaptureScope() as scope, ag.pause():
+        cond(*lv_list)
+        func(*lv_list)
+    captured = scope.captured(exclude=lv_list)
+
+    def fn(*arrays):
+        init = tuple(arrays[:n_vars])
+        cap = arrays[n_vars:]
+
+        def run_body(vals):
+            with _swapped(captured, cap), ag.pause():
+                v_nd = _wrap(vals)
+                out, new_vars = func(*v_nd)
+                out_l, _ = _as_list(out)
+                new_l, _ = _as_list(new_vars)
+                pred = cond(*_wrap(_raw(new_l)))
+            return (tuple(_raw(new_l)), tuple(_raw(out_l)),
+                    jnp.asarray(_raw([pred])[0], bool).reshape(()))
+
+        def step(carry, _):
+            vals, active, count = carry
+
+            def run(args):
+                vals, count = args
+                new_vals, outs, still = run_body(vals)
+                return new_vals, outs, still, count + 1
+
+            def skip(args):
+                vals, count = args
+                _, outs, _ = run_body(vals)
+                zeros = tuple(jnp.zeros_like(o) for o in outs)
+                return vals, zeros, jnp.asarray(False), count
+
+            new_vals, outs, still, count = lax.cond(
+                active, run, skip, (vals, count))
+            return (new_vals, active & still, count), outs
+
+        with _swapped(captured, cap), ag.pause():
+            pred0 = cond(*_wrap(init))
+        (vals, _, count), ys = lax.scan(
+            step, (init, jnp.asarray(_raw([pred0])[0], bool).reshape(()),
+                   jnp.asarray(0, jnp.int32)),
+            None, length=max_iterations)
+        return tuple(ys) + tuple(vals) + (count,)
+
+    flat = apply_jax(fn, lv_list + captured, multi_out=True)
+    count = flat[-1]
+    outs = flat[:len(flat) - n_vars - 1]
+    final_vars = flat[len(flat) - n_vars - 1:-1]
+    try:  # eager: trim to realized steps (parity: dynamic-length outputs)
+        n = int(count.asnumpy())
+        outs = [o[:n] for o in outs]
+    except (jax.errors.TracerArrayConversionError,
+            jax.errors.ConcretizationTypeError):
+        pass  # inside a trace: shapes stay static, padded with zeros
+    return (outs[0] if len(outs) == 1 else list(outs),
+            final_vars[0] if lv_single else list(final_vars))
+
+
+def cond(pred, then_func: Callable, else_func: Callable, name: str = "cond"):
+    """Conditional execution (parity: control_flow.cc `_cond`).
+
+    ``pred`` is a scalar NDArray/boolean; branches are zero-arg
+    callables returning NDArrays with matching shapes."""
+    pred_nd = _nd(pred)
+
+    with CaptureScope() as scope, ag.pause():
+        then_func()
+        else_func()
+    captured = scope.captured(exclude=[pred_nd])
+
+    def fn(p, *cap):
+        def then_branch(_):
+            with _swapped(captured, cap), ag.pause():
+                out, _ = _as_list(then_func())
+            return tuple(_raw(out))
+
+        def else_branch(_):
+            with _swapped(captured, cap), ag.pause():
+                out, _ = _as_list(else_func())
+            return tuple(_raw(out))
+
+        return lax.cond(jnp.asarray(p, bool).reshape(()),
+                        then_branch, else_branch, operand=None)
+
+    flat = apply_jax(fn, [pred_nd] + captured, multi_out=True)
+    return flat[0] if len(flat) == 1 else flat
+
+
+# -- small contrib helpers (parity: mx.contrib misc ops) -------------------
+
+def isfinite(data):
+    return apply_jax(lambda x: jnp.isfinite(x).astype(jnp.float32), [data])
+
+
+def isnan(data):
+    return apply_jax(lambda x: jnp.isnan(x).astype(jnp.float32), [data])
+
+
+def isinf(data):
+    return apply_jax(lambda x: jnp.isinf(x).astype(jnp.float32), [data])

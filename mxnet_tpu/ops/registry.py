@@ -77,6 +77,34 @@ def list_ops() -> List[str]:
 # invocation (parity: Imperative::Invoke, src/imperative/imperative.cc:98)
 # --------------------------------------------------------------------------
 
+class CaptureScope:
+    """Records which pre-existing NDArrays a traced closure consumes.
+
+    The control-flow ops (contrib.foreach/while_loop/cond) run the user
+    body once under this scope to discover closed-over NDArrays — the
+    analogue of the reference's subgraph input capture when building
+    control-flow subgraphs (control_flow.cc)."""
+
+    def __init__(self):
+        self.used: dict = {}
+        self.created: set = set()
+
+    def __enter__(self):
+        _capture_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _capture_stack.pop()
+        return False
+
+    def captured(self, exclude=()):
+        skip = {id(x) for x in exclude} | self.created
+        return [obj for i, obj in self.used.items() if i not in skip]
+
+
+_capture_stack: List[CaptureScope] = []
+
+
 def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
               record: Optional[bool] = None):
     """Run a pure jax function on NDArrays, wrap outputs, record on tape.
@@ -94,6 +122,13 @@ def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
     multi = multi_out or isinstance(out, (tuple, list))
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
     nd_outs = [NDArray(o) for o in outs]
+
+    if _capture_stack:
+        scope = _capture_stack[-1]
+        for x in nd_inputs:
+            scope.used.setdefault(id(x), x)
+        for o in nd_outs:
+            scope.created.add(id(o))
 
     should_record = autograd.is_recording() if record is None else record
     if should_record:

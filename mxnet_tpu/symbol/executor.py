@@ -1,0 +1,115 @@
+"""Executor: a bound, jit-compiled symbol.
+
+Parity: python/mxnet/executor.py:25 (the Executor wrapper over CachedOp)
+— ``forward``/``backward``/``outputs``/``grad_arrays`` with grad_req
+semantics (write/add/null, op_attr_types.h:46-58).  TPU-native: binding
+lowers the whole graph once to a jitted function; backward is the jitted
+vjp of that function — static memory planning and engine bulking are
+XLA's buffer assignment and whole-graph fusion.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad, grad_req="write"):
+        from ..ndarray import NDArray
+
+        self._symbol = symbol
+        self._ctx = ctx
+        self._arg_names = symbol.list_arguments()
+        if isinstance(args, (list, tuple)):
+            args = dict(zip(self._arg_names, args))
+        if args is None or set(self._arg_names) - set(args):
+            missing = set(self._arg_names) - set(args or {})
+            raise MXNetError(f"bind: missing arguments {sorted(missing)}")
+        self._args: Dict[str, NDArray] = {n: args[n]
+                                          for n in self._arg_names}
+        if isinstance(args_grad, (list, tuple)):
+            args_grad = dict(zip(self._arg_names, args_grad))
+        self._args_grad = args_grad
+        if isinstance(grad_req, str):
+            grad_req = {n: grad_req for n in self._arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            grad_req = dict(zip(self._arg_names, grad_req))
+        self._grad_req = grad_req
+
+        fn = symbol._lower(self._arg_names)
+        self._fwd = jax.jit(lambda arrays: fn(arrays))
+        self._vjp = None
+        self.outputs: List[NDArray] = []
+
+    @property
+    def arg_dict(self):
+        return dict(self._args)
+
+    @property
+    def grad_dict(self):
+        return dict(self._args_grad or {})
+
+    @property
+    def arg_arrays(self):
+        return [self._args[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        if self._args_grad is None:
+            return [None] * len(self._arg_names)
+        return [self._args_grad.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return []
+
+    def copy_params_from(self, arg_params, aux_params=None):
+        for n, v in arg_params.items():
+            if n in self._args:
+                self._args[n]._rebind(v._data)
+
+    def forward(self, is_train: bool = False, **kwargs):
+        from ..ndarray import NDArray
+        for n, v in kwargs.items():
+            if n not in self._args:
+                raise MXNetError(f"forward: unknown argument {n!r}")
+            self._args[n] = v if isinstance(v, NDArray) else NDArray(v)
+        arrays = [self._args[n]._data for n in self._arg_names]
+        if is_train:
+            outs, vjp_fn = jax.vjp(lambda a: self._fwd(a), arrays)
+            self._vjp = vjp_fn
+        else:
+            outs = self._fwd(arrays)
+            self._vjp = None
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        from ..ndarray import NDArray
+        if self._vjp is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        if out_grads is None:
+            cots = [jnp.ones(o.shape, o.dtype) for o in self.outputs]
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                    for g in out_grads]
+        (grads,) = self._vjp(list(cots))
+        if self._args_grad is not None:
+            for name, g in zip(self._arg_names, grads):
+                req = self._grad_req.get(name, "write")
+                if req == "null" or name not in self._args_grad:
+                    continue
+                tgt = self._args_grad[name]
+                if req == "add":
+                    tgt._rebind(tgt._data + g)
+                else:
+                    tgt._rebind(g)
+        return [NDArray(g) for g in grads]

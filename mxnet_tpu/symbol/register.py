@@ -1,0 +1,46 @@
+"""Generate symbolic op functions from the registry.
+
+Parity: python/mxnet/symbol/register.py — the symbol namespace is
+code-generated from the same op registry as ``mx.nd``; here the
+generated function builds a graph node instead of invoking the kernel.
+"""
+from __future__ import annotations
+
+import inspect
+from typing import Any, Dict
+
+from ..ops import registry as _reg
+from .symbol import Symbol, _apply
+
+__all__ = ["make_sym_func", "populate_namespace"]
+
+
+def make_sym_func(name: str):
+    op = _reg.get(name)
+    sig = inspect.signature(op.fn)
+
+    def sym_func(*args, name=None, **kwargs):
+        inputs = [a for a in args if isinstance(a, Symbol)]
+        extras = [a for a in args
+                  if not isinstance(a, Symbol) and a is not None]
+        if extras:
+            kw_names = [p.name for p in sig.parameters.values()
+                        if p.kind == p.KEYWORD_ONLY and p.name not in kwargs]
+            for pname, val in zip(kw_names, extras):
+                kwargs[pname] = val
+        for k, v in list(kwargs.items()):
+            if isinstance(v, list):
+                kwargs[k] = tuple(v)
+        return _apply(op.name, inputs, name=name, **kwargs)
+
+    sym_func.__name__ = name
+    sym_func.__doc__ = op.doc or f"Symbolic op {name}."
+    return sym_func
+
+
+def populate_namespace(ns: Dict[str, Any], names=None) -> None:
+    for name in (names or _reg.list_ops()):
+        if name.startswith("_random") or name.startswith("_sample"):
+            continue
+        if name not in ns:
+            ns[name] = make_sym_func(name)

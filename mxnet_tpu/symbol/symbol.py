@@ -1,0 +1,409 @@
+"""Symbol: declarative graph construction API.
+
+Parity: ``mx.sym`` (python/mxnet/symbol/symbol.py, 3,288 LoC) and the
+nnvm graph IR it fronts.  TPU-native: a Symbol is a lightweight DAG of
+registry-op nodes; *binding* it lowers the whole graph to one jitted
+XLA executable (the reference's ``_bind`` → CachedOp Executor path,
+python/mxnet/executor.py:25).  Shape/type inference is `jax.eval_shape`
+over the same lowering — one mechanism instead of per-op FInferShape.
+
+JSON (de)serialization mirrors the reference's symbol json (nodes /
+arg_nodes / heads layout, src/nnvm/legacy_json_util.cc) so models can
+be saved and re-loaded by name.
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..ops import registry as _reg
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_UNNAMED_COUNT: Dict[str, int] = {}
+
+
+def _auto_name(op_name: str) -> str:
+    base = op_name.lower().lstrip("_")
+    i = _UNNAMED_COUNT.get(base, 0)
+    _UNNAMED_COUNT[base] = i + 1
+    return f"{base}{i}"
+
+
+class _Node:
+    """One graph node: a free variable or an op application."""
+
+    __slots__ = ("op_name", "name", "params", "inputs", "num_outputs")
+
+    def __init__(self, op_name: Optional[str], name: str,
+                 params: Optional[dict] = None,
+                 inputs: Optional[List[Tuple["_Node", int]]] = None,
+                 num_outputs: int = 1):
+        self.op_name = op_name          # None → variable ("null" op)
+        self.name = name
+        self.params = dict(params or {})
+        self.inputs = list(inputs or [])
+        self.num_outputs = num_outputs
+
+    @property
+    def is_var(self) -> bool:
+        return self.op_name is None
+
+
+class Symbol:
+    """A (possibly multi-output) reference into the graph."""
+
+    def __init__(self, outputs: Sequence[Tuple[_Node, int]]):
+        self._outputs: List[Tuple[_Node, int]] = list(outputs)
+
+    # -- construction ------------------------------------------------------
+    @property
+    def name(self) -> str:
+        return self._outputs[0][0].name
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    def __iter__(self):
+        return (Symbol([o]) for o in self._outputs)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            for node, i in _topo_order([o[0] for o in self._outputs]):
+                if node.name == idx:
+                    return Symbol([(node, 0)])
+            raise MXNetError(f"no internal symbol named {idx!r}")
+        if isinstance(idx, slice):
+            return Group([Symbol([o]) for o in self._outputs[idx]])
+        if idx < len(self._outputs):
+            return Symbol([self._outputs[idx]])
+        node, _ = self._outputs[0]
+        if not node.is_var:
+            # multi-output op (e.g. BatchNorm's aux outputs): select lazily
+            return Symbol([(node, idx)])
+        raise IndexError(idx)
+
+    def __len__(self):
+        return len(self._outputs)
+
+    @property
+    def num_outputs(self) -> int:
+        return len(self._outputs)
+
+    # -- graph introspection (parity: list_arguments/list_outputs) ---------
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._var_nodes()]
+
+    def list_outputs(self) -> List[str]:
+        out = []
+        for node, i in self._outputs:
+            suffix = "" if node.num_outputs == 1 else str(i)
+            out.append(f"{node.name}_output{suffix}"
+                       if not node.is_var else node.name)
+        return out
+
+    def list_inputs(self) -> List[str]:
+        return self.list_arguments()
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []  # aux states ride the Parameter mechanism in gluon
+
+    def _var_nodes(self) -> List[_Node]:
+        return [n for n in _topo_nodes([o[0] for o in self._outputs])
+                if n.is_var]
+
+    def get_internals(self) -> "Symbol":
+        nodes = _topo_nodes([o[0] for o in self._outputs])
+        return Group([Symbol([(n, i)]) for n in nodes
+                      for i in range(n.num_outputs)])
+
+    def get_children(self) -> Optional["Symbol"]:
+        node = self._outputs[0][0]
+        if not node.inputs:
+            return None
+        return Group([Symbol([inp]) for inp in node.inputs])
+
+    @property
+    def attr_dict(self):
+        return {n.name: {k: str(v) for k, v in n.params.items()}
+                for n in _topo_nodes([o[0] for o in self._outputs])}
+
+    # -- composition (parity: symbol call substitution) --------------------
+    def __call__(self, **kwargs):
+        """Substitute named variables with other symbols."""
+        mapping = {}
+        for name, sym in kwargs.items():
+            if not isinstance(sym, Symbol):
+                raise TypeError("compose expects Symbols")
+            mapping[name] = sym._outputs[0]
+        memo: Dict[int, _Node] = {}
+
+        def edge(node: _Node, idx: int) -> Tuple[_Node, int]:
+            if node.is_var and node.name in mapping:
+                return mapping[node.name]  # carries its own output index
+            return (rebuild(node), idx)
+
+        def rebuild(node: _Node) -> _Node:
+            if id(node) in memo:
+                return memo[id(node)]
+            new = _Node(node.op_name, node.name, node.params,
+                        [edge(n, i) for n, i in node.inputs],
+                        node.num_outputs)
+            memo[id(node)] = new
+            return new
+
+        return Symbol([edge(n, i) for n, i in self._outputs])
+
+    # -- arithmetic --------------------------------------------------------
+    def _binop(self, other, op, reverse=False):
+        if isinstance(other, Symbol):
+            a, b = (other, self) if reverse else (self, other)
+            return _apply(op, [a, b])
+        # scalar: lift through the scalar-aware op lambda
+        c = float(other)
+        scalar_op = {"elemwise_add": "_plus_scalar",
+                     "elemwise_sub": "_rminus_scalar" if reverse
+                     else "_minus_scalar",
+                     "elemwise_mul": "_mul_scalar",
+                     "elemwise_div": "_rdiv_scalar" if reverse
+                     else "_div_scalar",
+                     "broadcast_power": "_rpower_scalar" if reverse
+                     else "_power_scalar"}.get(op)
+        if scalar_op and scalar_op in _reg._REGISTRY:
+            return _apply(scalar_op, [self], scalar=c)
+        return _apply(op, [self], _scalar=c, _reverse=reverse)
+
+    def __add__(self, o): return self._binop(o, "elemwise_add")
+    def __radd__(self, o): return self._binop(o, "elemwise_add", True)
+    def __sub__(self, o): return self._binop(o, "elemwise_sub")
+    def __rsub__(self, o): return self._binop(o, "elemwise_sub", True)
+    def __mul__(self, o): return self._binop(o, "elemwise_mul")
+    def __rmul__(self, o): return self._binop(o, "elemwise_mul", True)
+    def __truediv__(self, o): return self._binop(o, "elemwise_div")
+    def __rtruediv__(self, o): return self._binop(o, "elemwise_div", True)
+    def __pow__(self, o): return self._binop(o, "broadcast_power")
+    def __neg__(self): return _apply("negative", [self])
+
+    def __eq__(self, o):
+        return self._binop(o, "broadcast_equal") if isinstance(o, Symbol) \
+            else NotImplemented
+    __hash__ = object.__hash__
+
+    # -- evaluation --------------------------------------------------------
+    def _lower(self, arg_names: List[str]):
+        """Build fn(list-of-arrays) -> list-of-output-arrays."""
+        order = _topo_nodes([o[0] for o in self._outputs])
+        pos = {name: i for i, name in enumerate(arg_names)}
+
+        def fn(arg_arrays):
+            vals: Dict[int, Any] = {}
+            for node in order:
+                if node.is_var:
+                    if node.name not in pos:
+                        raise MXNetError(f"missing argument {node.name!r}")
+                    vals[id(node)] = [arg_arrays[pos[node.name]]]
+                else:
+                    ins = [vals[id(n)][i] for n, i in node.inputs]
+                    op = _reg.get(node.op_name)
+                    out = op.fn(*ins, **node.params)
+                    vals[id(node)] = list(out) if isinstance(
+                        out, (tuple, list)) else [out]
+            return [vals[id(n)][i] for n, i in self._outputs]
+
+        return fn
+
+    def infer_shape(self, **kwargs):
+        """Infer output shapes from argument shapes via jax.eval_shape
+        (parity: symbol.infer_shape)."""
+        args = self.list_arguments()
+        structs = []
+        for name in args:
+            if name not in kwargs:
+                raise MXNetError(f"infer_shape: missing shape for {name!r}")
+            structs.append(jax.ShapeDtypeStruct(tuple(kwargs[name]),
+                                                jnp.float32))
+        fn = self._lower(args)
+        outs = jax.eval_shape(lambda a: fn(a), structs)
+        arg_shapes = [tuple(s.shape) for s in structs]
+        out_shapes = [tuple(o.shape) for o in outs]
+        return arg_shapes, out_shapes, []
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        structs = [jax.ShapeDtypeStruct((1,), np_dtype(kwargs.get(n)))
+                   for n in args]
+        return ([s.dtype for s in structs], [jnp.float32], [])
+
+    def eval(self, ctx=None, **kwargs):
+        from ..ndarray import NDArray
+        args = self.list_arguments()
+        fn = self._lower(args)
+        arrays = []
+        for name in args:
+            if name not in kwargs:
+                raise MXNetError(f"eval: missing argument {name!r}")
+            v = kwargs[name]
+            arrays.append(v._data if isinstance(v, NDArray)
+                          else jnp.asarray(v))
+        return [NDArray(o) for o in fn(arrays)]
+
+    # -- binding (parity: simple_bind → Executor over CachedOp) ------------
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from .executor import Executor
+        return Executor(self, ctx, args, args_grad, grad_req)
+
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..ndarray import NDArray
+        arg_names = self.list_arguments()
+        arg_shapes, _, _ = self.infer_shape(**shapes)
+        args = {n: NDArray(onp.zeros(s, "float32"))
+                for n, s in zip(arg_names, arg_shapes)}
+        grads = {n: NDArray(onp.zeros(s, "float32"))
+                 for n, s in zip(arg_names, arg_shapes)} \
+            if grad_req != "null" else None
+        return self.bind(ctx, args, grads, grad_req)
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self) -> str:
+        nodes = _topo_nodes([o[0] for o in self._outputs])
+        idx = {id(n): i for i, n in enumerate(nodes)}
+        out_nodes = []
+        for n in nodes:
+            out_nodes.append({
+                "op": "null" if n.is_var else n.op_name,
+                "name": n.name,
+                "attrs": _json_attrs(n.params),
+                "inputs": [[idx[id(src)], i, 0] for src, i in n.inputs],
+            })
+        payload = {
+            "nodes": out_nodes,
+            "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var],
+            "heads": [[idx[id(n)], i, 0] for n, i in self._outputs],
+            "attrs": {"mxnet_version": ["int", 20000],
+                      "format": "mxnet_tpu-symbol-v1"},
+        }
+        return json.dumps(payload, indent=2)
+
+    def save(self, fname: str):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+def _json_attrs(params: dict) -> dict:
+    out = {}
+    for k, v in params.items():
+        if isinstance(v, tuple):
+            out[k] = list(v)
+        elif isinstance(v, (int, float, bool, str, type(None), list)):
+            out[k] = v
+        else:
+            out[k] = str(v)
+    return out
+
+
+def _from_json_attrs(attrs: dict) -> dict:
+    return {k: tuple(v) if isinstance(v, list) else v
+            for k, v in attrs.items()}
+
+
+def _topo_nodes(roots: List[_Node]) -> List[_Node]:
+    seen, order = set(), []
+
+    def visit(node):
+        if id(node) in seen:
+            return
+        seen.add(id(node))
+        for src, _ in node.inputs:
+            visit(src)
+        order.append(node)
+
+    for r in roots:
+        visit(r)
+    return order
+
+
+def _topo_order(roots: List[_Node]):
+    return [(n, 0) for n in _topo_nodes(roots)]
+
+
+def _apply(op_name: str, inputs: List[Symbol], name: Optional[str] = None,
+           **params) -> Symbol:
+    op = _reg.get(op_name)
+    reverse = params.pop("_reverse", None)
+    scalar = params.pop("_scalar", None)
+    if scalar is not None:
+        # wrap scalar into the op's params for lowering via a lambda op —
+        # represent as an explicit broadcastable constant variable-free node
+        params["__scalar__"] = scalar
+        params["__reverse__"] = bool(reverse)
+        op_name = "_scalar_wrap:" + op_name
+        _ensure_scalar_wrap(op_name)
+    node = _Node(op_name, name or _auto_name(op_name.split(":")[-1]),
+                 params, [(s._outputs[0][0], s._outputs[0][1])
+                          for s in inputs],
+                 num_outputs=1)
+    n_out = _probe_num_outputs(op)
+    node.num_outputs = n_out
+    return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 \
+        else Symbol([(node, 0)])
+
+
+def _ensure_scalar_wrap(wrapped_name: str):
+    if wrapped_name in _reg._REGISTRY:
+        return
+    base = wrapped_name.split(":", 1)[1]
+    base_fn = _reg.get(base).fn
+
+    def fn(x, **params):
+        c = params.pop("__scalar__")
+        rev = params.pop("__reverse__", False)
+        cv = jnp.asarray(c, x.dtype)
+        return base_fn(cv, x, **params) if rev else base_fn(x, cv, **params)
+
+    _reg._REGISTRY[wrapped_name] = _reg.Operator(wrapped_name, fn)
+
+
+def _probe_num_outputs(op) -> int:
+    return 1  # multi-out ops report 1 head; outputs split lazily on index
+
+
+def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
+    return Symbol([(_Node(None, name), 0)])
+
+
+var = Variable
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    outs = []
+    for s in symbols:
+        outs.extend(s._outputs)
+    return Symbol(outs)
+
+
+def load_json(json_str: str) -> Symbol:
+    payload = json.loads(json_str)
+    nodes: List[_Node] = []
+    for spec in payload["nodes"]:
+        if spec["op"] == "null":
+            node = _Node(None, spec["name"])
+        else:
+            params = _from_json_attrs(spec.get("attrs", {}))
+            if spec["op"].startswith("_scalar_wrap:"):
+                _ensure_scalar_wrap(spec["op"])
+            node = _Node(spec["op"], spec["name"], params)
+        node.inputs = [(nodes[i], oi) for i, oi, _ in spec["inputs"]]
+        nodes.append(node)
+    heads = [(nodes[i], oi) for i, oi, _ in payload["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())

@@ -1,0 +1,162 @@
+"""Control-flow op tests (parity: tests/python/unittest test coverage of
+_foreach/_while_loop/_cond, control_flow.cc:1094-1216)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.ndarray.contrib import foreach, while_loop, cond
+
+
+def test_foreach_cumsum():
+    data = mx.nd.array(onp.arange(8, dtype="float32").reshape(8, 1))
+    init = mx.nd.array([0.0])
+
+    def body(x, state):
+        new = x + state
+        return new, new
+
+    outs, final = foreach(body, data, init)
+    expect = onp.cumsum(onp.arange(8.0)).reshape(8, 1)
+    onp.testing.assert_allclose(outs.asnumpy(), expect)
+    onp.testing.assert_allclose(final.asnumpy(), [28.0])
+
+
+def test_foreach_multiple_states_and_outputs():
+    data = mx.nd.array(onp.ones((4, 2), "float32"))
+
+    def body(x, states):
+        s0, s1 = states
+        return [x + s0, x * s1], [s0 + 1.0, s1 * 2.0]
+
+    outs, states = foreach(body, data,
+                           [mx.nd.array([0.0, 0.0]), mx.nd.array([1.0, 1.0])])
+    assert outs[0].shape == (4, 2)
+    onp.testing.assert_allclose(states[0].asnumpy(), [4.0, 4.0])
+    onp.testing.assert_allclose(states[1].asnumpy(), [16.0, 16.0])
+
+
+def test_foreach_grad():
+    data = mx.nd.array(onp.arange(1.0, 5.0, dtype="float32").reshape(4, 1))
+    data.attach_grad()
+    init = mx.nd.array([1.0])
+
+    def body(x, s):
+        new = x * s
+        return new, new
+
+    with ag.record():
+        outs, final = foreach(body, data, init)
+        loss = final.sum()
+    loss.backward()
+    # final = prod(data); d final / d x_i = prod / x_i
+    prod = float(onp.prod(onp.arange(1.0, 5.0)))
+    expect = prod / onp.arange(1.0, 5.0).reshape(4, 1)
+    onp.testing.assert_allclose(data.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_while_loop_counts():
+    def cond_fn(i, s):
+        return i < 5
+
+    def func(i, s):
+        return i, (i + 1, s + i)
+
+    outs, (i, s) = while_loop(cond_fn, func,
+                              (mx.nd.array([0.0]), mx.nd.array([0.0])),
+                              max_iterations=10)
+    onp.testing.assert_allclose(i.asnumpy(), [5.0])
+    onp.testing.assert_allclose(s.asnumpy(), [10.0])
+    assert outs.shape[0] == 5  # trimmed to realized steps eagerly
+
+
+def test_while_loop_zero_iters():
+    outs, final = while_loop(lambda i: i < 0.0,
+                             lambda i: (i, i + 1),
+                             mx.nd.array([5.0]), max_iterations=4)
+    onp.testing.assert_allclose(final.asnumpy(), [5.0])
+    assert outs.shape[0] == 0
+
+
+def test_cond_branches():
+    x = mx.nd.array([3.0])
+    y = mx.nd.array([4.0])
+    out = cond(x < y, lambda: x + y, lambda: x - y)
+    onp.testing.assert_allclose(out.asnumpy(), [7.0])
+    out = cond(x > y, lambda: x + y, lambda: x - y)
+    onp.testing.assert_allclose(out.asnumpy(), [-1.0])
+
+
+def test_cond_grad():
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with ag.record():
+        out = cond(mx.nd.array([1.0]), lambda: x * x, lambda: x)
+        out.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_foreach_in_hybridblock():
+    """Control flow must trace into a jitted HybridBlock forward."""
+    from mxnet_tpu.gluon import HybridBlock, nn
+
+    class Cum(HybridBlock):
+        def forward(self, x):
+            outs, final = foreach(lambda t, s: (t + s, t + s), x,
+                                  mx.nd.zeros((x.shape[1],)))
+            return final
+
+    net = Cum()
+    net.hybridize()
+    x = mx.nd.array(onp.ones((3, 2), "float32"))
+    out = net(x)
+    onp.testing.assert_allclose(out.asnumpy(), [3.0, 3.0])
+    out2 = net(x)  # cached path
+    onp.testing.assert_allclose(out2.asnumpy(), [3.0, 3.0])
+
+
+def test_isfinite_family():
+    x = mx.nd.array([1.0, onp.inf, -onp.inf, onp.nan])
+    from mxnet_tpu.ndarray.contrib import isfinite, isnan, isinf
+    onp.testing.assert_allclose(isfinite(x).asnumpy(), [1, 0, 0, 0])
+    onp.testing.assert_allclose(isnan(x).asnumpy(), [0, 0, 0, 1])
+    onp.testing.assert_allclose(isinf(x).asnumpy(), [0, 1, 1, 0])
+
+
+def test_foreach_closure_weight_grad():
+    """RNN-style: grads must flow to weights captured by the body closure."""
+    w = mx.nd.array([[2.0]])
+    w.attach_grad()
+    data = mx.nd.array(onp.ones((3, 1, 1), "float32"))
+    init = mx.nd.array([[1.0]])
+
+    def body(x, h):
+        new = mx.nd.dot(h, w) + x
+        return new, new
+
+    with ag.record():
+        outs, final = foreach(body, data, init)
+        loss = final.sum()
+    loss.backward()
+    # h3 = ((1*w + 1)*w + 1)*w + 1 → dh3/dw = 3w^2 + 2w + 1 = 17
+    onp.testing.assert_allclose(w.grad.asnumpy(), [[17.0]], rtol=1e-5)
+
+
+def test_while_loop_closure_grad():
+    scale = mx.nd.array([3.0])
+    scale.attach_grad()
+
+    def cond_fn(i, acc):
+        return i < 3
+
+    def func(i, acc):
+        return acc, (i + 1, acc * scale)
+
+    with ag.record():
+        outs, (i, acc) = while_loop(cond_fn, func,
+                                    (mx.nd.array([0.0]), mx.nd.array([1.0])),
+                                    max_iterations=5)
+        loss = acc.sum()
+    loss.backward()
+    # acc = scale^3 → d/dscale = 3*scale^2 = 27
+    onp.testing.assert_allclose(scale.grad.asnumpy(), [27.0], rtol=1e-5)

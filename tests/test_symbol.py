@@ -1,0 +1,179 @@
+"""Symbol API tests (parity model: tests/python/unittest/test_symbol.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import sym
+from mxnet_tpu.base import MXNetError
+
+
+def test_variable_and_compose():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b * 2.0
+    assert sorted(c.list_arguments()) == ["a", "b"]
+    outs = c.eval(a=mx.nd.array([1.0, 2.0]), b=mx.nd.array([3.0, 4.0]))
+    onp.testing.assert_allclose(outs[0].asnumpy(), [7.0, 10.0])
+
+
+def test_scalar_arith_all_directions():
+    a = sym.Variable("a")
+    exprs = [a + 1.0, 1.0 + a, a - 1.0, 1.0 - a, a * 2.0, 2.0 * a,
+             a / 2.0, 2.0 / a, a ** 2.0, -a]
+    x = onp.array([1.0, 2.0, 4.0], "float32")
+    expect = [x + 1, 1 + x, x - 1, 1 - x, x * 2, 2 * x,
+              x / 2, 2 / x, x ** 2, -x]
+    for e, ref in zip(exprs, expect):
+        out = e.eval(a=mx.nd.array(x))[0].asnumpy()
+        onp.testing.assert_allclose(out, ref, rtol=1e-6)
+
+
+def test_op_namespace_and_infer_shape():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    b = sym.Variable("b")
+    fc = sym.FullyConnected(data, w, b, num_hidden=16)
+    act = sym.Activation(fc, act_type="relu")
+    args, outs, _ = act.infer_shape(data=(4, 8), w=(16, 8), b=(16,))
+    assert outs == [(4, 16)]
+
+
+def test_bind_forward_backward():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = (a * b).sum() if hasattr(sym.Symbol, "sum") else sym.sum(a * b)
+    an = onp.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    bn = onp.array([[5.0, 6.0], [7.0, 8.0]], "float32")
+    ex = c.simple_bind(a=an.shape, b=bn.shape)
+    out = ex.forward(is_train=True, a=mx.nd.array(an), b=mx.nd.array(bn))
+    onp.testing.assert_allclose(out[0].asnumpy(), (an * bn).sum(), rtol=1e-6)
+    ex.backward()
+    onp.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), bn)
+    onp.testing.assert_allclose(ex.grad_dict["b"].asnumpy(), an)
+
+
+def test_grad_req_add_and_null():
+    a = sym.Variable("a")
+    loss = sym.sum(a * a)
+    an = onp.array([1.0, 2.0], "float32")
+    ex = loss.simple_bind(a=an.shape, grad_req="add")
+    ex.forward(is_train=True, a=mx.nd.array(an))
+    ex.backward()
+    ex.forward(is_train=True, a=mx.nd.array(an))
+    ex.backward()
+    onp.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), 4 * an)
+
+    ex2 = loss.simple_bind(a=an.shape, grad_req="null")
+    ex2.forward(is_train=True, a=mx.nd.array(an))
+    ex2.backward()  # no grads written
+    assert ex2.grad_arrays == [None]
+
+
+def test_json_roundtrip():
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    net = sym.Activation(sym.FullyConnected(data, w, None, num_hidden=4,
+                                            no_bias=True),
+                         act_type="tanh")
+    js = net.tojson()
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    x = onp.random.RandomState(0).randn(2, 3).astype("float32")
+    wn = onp.random.RandomState(1).randn(4, 3).astype("float32")
+    o1 = net.eval(data=mx.nd.array(x), w=mx.nd.array(wn))[0].asnumpy()
+    o2 = net2.eval(data=mx.nd.array(x), w=mx.nd.array(wn))[0].asnumpy()
+    onp.testing.assert_allclose(o1, o2, rtol=1e-6)
+
+
+def test_save_load_file(tmp_path):
+    a = sym.Variable("a")
+    net = sym.exp(a) + 1.0
+    f = str(tmp_path / "net-symbol.json")
+    net.save(f)
+    net2 = sym.load(f)
+    out = net2.eval(a=mx.nd.array([0.0]))[0].asnumpy()
+    onp.testing.assert_allclose(out, [2.0], rtol=1e-6)
+
+
+def test_get_internals_and_getitem():
+    a = sym.Variable("a")
+    h = sym.relu(a * 2.0, name="hidden") if hasattr(sym, "relu") \
+        else sym.Activation(a * 2.0, act_type="relu", name="hidden")
+    out = sym.sum(h, name="out")
+    internals = out.get_internals()
+    names = [s.name for s in internals]
+    assert "hidden" in names
+    hid = out["hidden"]
+    r = hid.eval(a=mx.nd.array([-1.0, 3.0]))[0].asnumpy()
+    onp.testing.assert_allclose(r, [0.0, 6.0])
+
+
+def test_compose_substitution():
+    a = sym.Variable("x")
+    inner = sym.exp(a)
+    b = sym.Variable("y")
+    outer = inner(x=b * 2.0)
+    assert outer.list_arguments() == ["y"]
+    out = outer.eval(y=mx.nd.array([1.0]))[0].asnumpy()
+    onp.testing.assert_allclose(out, [onp.exp(2.0)], rtol=1e-6)
+
+
+def test_missing_arg_errors():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    c = a + b
+    with pytest.raises(MXNetError):
+        c.eval(a=mx.nd.array([1.0]))
+    with pytest.raises(MXNetError):
+        c.infer_shape(a=(1,))
+
+
+def test_group():
+    a = sym.Variable("a")
+    g = sym.Group([sym.exp(a), sym.log(a)])
+    outs = g.eval(a=mx.nd.array([1.0]))
+    assert len(outs) == 2
+    onp.testing.assert_allclose(outs[0].asnumpy(), [onp.e], rtol=1e-6)
+    onp.testing.assert_allclose(outs[1].asnumpy(), [0.0], atol=1e-7)
+
+
+def test_symbol_block(tmp_path):
+    from mxnet_tpu.gluon import SymbolBlock
+    data = sym.Variable("data")
+    w = sym.Variable("fc_weight")
+    net_sym = sym.Activation(
+        sym.FullyConnected(data, w, None, num_hidden=4, no_bias=True),
+        act_type="relu")
+    wn = onp.random.RandomState(0).randn(4, 6).astype("float32")
+    blk = SymbolBlock(net_sym, ["data"], params={"fc_weight": wn})
+    x = mx.nd.array(onp.random.RandomState(1).randn(2, 6).astype("float32"))
+    out = blk(x)
+    expect = onp.maximum(x.asnumpy() @ wn.T, 0)
+    onp.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-5)
+
+    # file round-trip: symbol json + params
+    sfile = str(tmp_path / "m-symbol.json")
+    pfile = str(tmp_path / "m-0000.params")
+    net_sym.save(sfile)
+    mx.nd.save(pfile, {"fc_weight": mx.nd.array(wn)})
+    blk2 = SymbolBlock.imports(sfile, ["data"], pfile + ".npz")
+    onp.testing.assert_allclose(blk2(x).asnumpy(), expect, rtol=1e-5)
+
+
+def test_symbol_block_grads():
+    from mxnet_tpu.gluon import SymbolBlock
+    from mxnet_tpu import autograd as ag
+    data = sym.Variable("data")
+    w = sym.Variable("w")
+    net_sym = sym.FullyConnected(data, w, None, num_hidden=3, no_bias=True)
+    wn = onp.ones((3, 2), "float32")
+    blk = SymbolBlock(net_sym, ["data"], params={"w": wn})
+    for p in blk.collect_params().values():
+        p.initialize()
+    x = mx.nd.array([[1.0, 2.0]])
+    with ag.record():
+        out = blk(x)
+        loss = out.sum()
+    loss.backward()
+    g = blk.collect_params()["w"].grad()
+    onp.testing.assert_allclose(g.asnumpy(), onp.tile(x.asnumpy(), (3, 1)))
