@@ -5,6 +5,8 @@ MXDataIter :799) + DataBatch/DataDesc.
 """
 from .io import (DataIter, DataBatch, DataDesc, NDArrayIter, CSVIter,
                  ResizeIter, PrefetchingIter)
+from . import native
+from .native import ImageRecordIter
 
 __all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter"]
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "native"]

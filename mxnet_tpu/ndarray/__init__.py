@@ -11,6 +11,7 @@ from .register import populate_namespace, make_op_func
 from . import random
 from . import linalg
 from . import contrib
+from . import sparse
 
 populate_namespace(globals())
 

@@ -396,9 +396,10 @@ class NDArray:
     def flip(self, axis): return invoke("flip", [self], axis=axis)
 
     def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("sparse storage conversion: use mxnet_tpu.sparse")
-        return self
+        if stype == "default":
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
 
     # numpy protocol
     def __array__(self, dtype=None):

@@ -1,0 +1,379 @@
+"""Sparse NDArray storage types: row_sparse and csr.
+
+Parity: ``include/mxnet/ndarray.h:61-65`` (kDefaultStorage /
+kRowSparseStorage / kCSRStorage), ``src/operator/tensor/cast_storage``,
+sparse dot (``src/operator/tensor/dot-inl.h``), ``sparse_retain``, and
+the python surface ``python/mxnet/ndarray/sparse.py``.
+
+TPU-native notes: sparse layouts live as (data, indices[, indptr])
+device arrays; compute that benefits from the MXU densifies per-block
+(csr·dense dot goes through jax.experimental.sparse BCOO, which XLA
+lowers to gather/segment-sum), while row_sparse exists mainly as the
+*gradient* format for embedding-style updates — its purpose is to make
+optimizer updates touch only the live rows (scatter-apply), which is
+exactly how the reference uses it (sgd/adam `_update` row_sparse
+kernels, optimizer_op.cc).
+
+Sparse tensors are eager-only containers (nnz is data-dependent —
+incompatible with XLA static shapes); converting to dense re-enters
+the jit world.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..base import MXNetError, np_dtype
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "zeros", "array",
+           "cast_storage", "retain", "dot", "add", "where_rows"]
+
+
+class BaseSparseNDArray:
+    """Common surface shared by both sparse storage types."""
+
+    stype = "undefined"
+
+    def __init__(self, shape: Tuple[int, ...], dtype):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = onp.dtype(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def asnumpy(self) -> onp.ndarray:
+        return self.todense().asnumpy()
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return (f"\n<{type(self).__name__} "
+                f"{'x'.join(map(str, self.shape))} nnz={self.nnz}>")
+
+    def tostype(self, stype: str):
+        if stype == self.stype:
+            return self
+        if stype == "default":
+            return self.todense()
+        return cast_storage(self.todense(), stype)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._rebind(self.todense()._data)
+            return other
+        raise MXNetError("copyto: unsupported target for sparse")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """Rows `indices` hold `data`; all other rows are zero
+    (parity: ndarray.h kRowSparseStorage; python sparse.py
+    RowSparseNDArray)."""
+
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape):
+        data = jnp.asarray(data)
+        indices = jnp.asarray(indices, jnp.int32)
+        super().__init__(shape, data.dtype)
+        if data.shape[1:] != tuple(shape[1:]):
+            raise MXNetError(
+                f"row_sparse data row shape {data.shape[1:]} != "
+                f"array row shape {tuple(shape[1:])}")
+        if data.shape[0] != indices.shape[0]:
+            raise MXNetError("row_sparse data/indices length mismatch")
+        self.data = data          # (nnz_rows, *row_shape)
+        self.indices = indices    # (nnz_rows,) sorted
+
+    @property
+    def nnz(self):
+        return int(self.data.shape[0])
+
+    def todense(self) -> NDArray:
+        out = jnp.zeros(self.shape, self.dtype)
+        if self.nnz:
+            out = out.at[self.indices].set(self.data)
+        return NDArray(out)
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        return retain(self, indices)
+
+    def __neg__(self):
+        return RowSparseNDArray(-self.data, self.indices, self.shape)
+
+    def __mul__(self, scalar):
+        return RowSparseNDArray(self.data * scalar, self.indices, self.shape)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, scalar):
+        return RowSparseNDArray(self.data / scalar, self.indices, self.shape)
+
+    def __add__(self, other):
+        return add(self, other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """Compressed sparse row 2-D matrix (parity: kCSRStorage)."""
+
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape):
+        data = jnp.asarray(data)
+        super().__init__(shape, data.dtype)
+        if len(shape) != 2:
+            raise MXNetError("csr storage is 2-D only")
+        self.data = data                                  # (nnz,)
+        self.indices = jnp.asarray(indices, jnp.int32)    # (nnz,) col idx
+        self.indptr = jnp.asarray(indptr, jnp.int32)      # (rows+1,)
+
+    @property
+    def nnz(self):
+        return int(self.data.shape[0])
+
+    def todense(self) -> NDArray:
+        rows, cols = self.shape
+        counts = self.indptr[1:] - self.indptr[:-1]
+        row_ids = jnp.repeat(jnp.arange(rows), counts,
+                             total_repeat_length=self.nnz)
+        out = jnp.zeros(self.shape, self.dtype)
+        if self.nnz:
+            out = out.at[row_ids, self.indices].set(self.data)
+        return NDArray(out)
+
+    def _to_bcoo(self) -> jsparse.BCOO:
+        rows = self.shape[0]
+        counts = self.indptr[1:] - self.indptr[:-1]
+        row_ids = jnp.repeat(jnp.arange(rows), counts,
+                             total_repeat_length=self.nnz)
+        idx = jnp.stack([row_ids, self.indices], axis=1)
+        return jsparse.BCOO((self.data, idx), shape=self.shape)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            if i == slice(None):
+                return self
+            raise MXNetError("csr slicing supports full slice only")
+        if i < 0:
+            i += self.shape[0]
+        if not 0 <= i < self.shape[0]:
+            raise IndexError(f"row {i} out of bounds for {self.shape}")
+        lo, hi = int(self.indptr[i]), int(self.indptr[i + 1])
+        out = onp.zeros((1, self.shape[1]), self.dtype)
+        cols = onp.asarray(self.indices[lo:hi])
+        out[0, cols] = onp.asarray(self.data[lo:hi])
+        return _dense_array(out)
+
+
+# --------------------------------------------------------------------------
+# constructors (parity: mx.nd.sparse.row_sparse_array / csr_matrix)
+# --------------------------------------------------------------------------
+
+def row_sparse_array(arg, shape=None, dtype=None) -> RowSparseNDArray:
+    if isinstance(arg, RowSparseNDArray):
+        return arg
+    if isinstance(arg, tuple) and len(arg) == 2:
+        data, indices = arg
+        data = jnp.asarray(data, np_dtype(dtype) if dtype else None)
+        if shape is None:
+            raise MXNetError("row_sparse_array((data, indices)) needs shape")
+        return RowSparseNDArray(data, indices, shape)
+    # dense source
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else onp.asarray(arg)
+    return cast_storage(_dense_array(dense.astype(
+        np_dtype(dtype) if dtype else dense.dtype)), "row_sparse")
+
+
+def csr_matrix(arg, shape=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg, CSRNDArray):
+        return arg
+    if isinstance(arg, tuple) and len(arg) == 3:
+        data, indices, indptr = arg
+        if shape is None:
+            raise MXNetError("csr_matrix((data, indices, indptr)) needs shape")
+        return CSRNDArray(jnp.asarray(
+            data, np_dtype(dtype) if dtype else None), indices, indptr, shape)
+    dense = arg.asnumpy() if isinstance(arg, NDArray) else onp.asarray(arg)
+    return cast_storage(_dense_array(dense.astype(
+        np_dtype(dtype) if dtype else dense.dtype)), "csr")
+
+
+def zeros(stype: str, shape, ctx=None, dtype=None):
+    dt = np_dtype(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int32), shape)
+    if stype == "csr":
+        return CSRNDArray(jnp.zeros((0,), dt), jnp.zeros((0,), jnp.int32),
+                          jnp.zeros((shape[0] + 1,), jnp.int32), shape)
+    if stype == "default":
+        from .ndarray import zeros as dzeros
+        return dzeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+def array(source, stype="default", shape=None, dtype=None):
+    if stype == "row_sparse":
+        return row_sparse_array(source, shape=shape, dtype=dtype)
+    if stype == "csr":
+        return csr_matrix(source, shape=shape, dtype=dtype)
+    return _dense_array(source, dtype=dtype)
+
+
+# --------------------------------------------------------------------------
+# cast_storage (parity: src/operator/tensor/cast_storage-inl.h)
+# --------------------------------------------------------------------------
+
+def cast_storage(arr, stype: str):
+    if isinstance(arr, BaseSparseNDArray):
+        return arr.tostype(stype)
+    if stype == "default":
+        return arr
+    a = arr.asnumpy()
+    if stype == "row_sparse":
+        axes = tuple(range(1, a.ndim))
+        nz = onp.where(a.any(axis=axes) if axes else a != 0)[0]
+        return RowSparseNDArray(a[nz], nz.astype(onp.int32), a.shape)
+    if stype == "csr":
+        if a.ndim != 2:
+            raise MXNetError("csr storage is 2-D only")
+        rows, cols = onp.nonzero(a)
+        indptr = onp.zeros(a.shape[0] + 1, onp.int32)
+        counts = onp.bincount(rows, minlength=a.shape[0])
+        indptr[1:] = onp.cumsum(counts)
+        return CSRNDArray(a[rows, cols], cols.astype(onp.int32),
+                          indptr, a.shape)
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+# --------------------------------------------------------------------------
+# sparse ops (parity: sparse_retain, dot-inl.h sparse paths, elemwise add)
+# --------------------------------------------------------------------------
+
+def retain(rsp: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """Keep only the requested rows (parity: _sparse_retain op)."""
+    if isinstance(indices, NDArray):
+        indices = indices.asnumpy()
+    want = onp.asarray(indices, onp.int32)
+    have = onp.asarray(rsp.indices)
+    keep_mask = onp.isin(have, want)
+    keep = onp.where(keep_mask)[0]
+    return RowSparseNDArray(rsp.data[keep], have[keep], rsp.shape)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Sparse-aware dot: csr·dense, csr^T·dense, rsp'·dense
+    (parity: dot-inl.h FInferStorageType dispatch table)."""
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        bcoo = lhs._to_bcoo()
+        if transpose_a:
+            out = jsparse.bcoo_dot_general(
+                bcoo, rhs._data, dimension_numbers=(((0,), (0,)), ((), ())))
+        else:
+            out = jsparse.bcoo_dot_general(
+                bcoo, rhs._data, dimension_numbers=(((1,), (0,)), ((), ())))
+        return NDArray(out)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        # rsp^T · dense → row_sparse rows gather-matmul
+        if not transpose_a:
+            return NDArray(jnp.matmul(lhs.todense()._data, rhs._data))
+        out = jnp.zeros((lhs.shape[1], rhs.shape[1]),
+                        jnp.result_type(lhs.dtype, rhs.dtype))
+        if lhs.nnz:
+            picked = rhs._data[lhs.indices]
+            out = jnp.einsum("nr,nc->rc", lhs.data, picked)
+        return NDArray(out)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from ..ops.registry import invoke
+        return invoke("dot", [lhs, rhs], transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+    raise MXNetError(
+        f"dot: unsupported storage combination "
+        f"({getattr(lhs, 'stype', 'default')}, "
+        f"{getattr(rhs, 'stype', 'default')})")
+
+
+def add(lhs, rhs):
+    """Elementwise add across storage types."""
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
+                                                        RowSparseNDArray):
+        if lhs.shape != rhs.shape:
+            raise MXNetError("add: shape mismatch")
+        idx = onp.union1d(onp.asarray(lhs.indices), onp.asarray(rhs.indices))
+        data = onp.zeros((len(idx),) + lhs.shape[1:],
+                         onp.result_type(lhs.dtype, rhs.dtype))
+        for src in (lhs, rhs):
+            pos = onp.searchsorted(idx, onp.asarray(src.indices))
+            onp.add.at(data, pos, onp.asarray(src.data))
+        return RowSparseNDArray(data, idx.astype(onp.int32), lhs.shape)
+    a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    return a + b
+
+
+def where_rows(rsp: RowSparseNDArray) -> NDArray:
+    """Indices of non-zero rows (parity: indices attribute access)."""
+    return NDArray(rsp.indices)
+
+
+# --------------------------------------------------------------------------
+# sparse optimizer updates (parity: optimizer_op.cc row_sparse kernels —
+# sgd_update:501 / adam_update:649 sparse paths, lazy_update semantics)
+# --------------------------------------------------------------------------
+
+def sgd_update(weight: NDArray, grad: RowSparseNDArray, lr: float,
+               wd: float = 0.0, rescale_grad: float = 1.0,
+               clip_gradient: float = -1.0) -> NDArray:
+    """Apply SGD only to rows present in the row_sparse gradient."""
+    g = grad.data * rescale_grad
+    if clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    rows = grad.indices
+    w_rows = weight._data[rows]
+    new_rows = w_rows - lr * (g + wd * w_rows)
+    weight._rebind(weight._data.at[rows].set(new_rows))
+    return weight
+
+
+def sgd_mom_update(weight: NDArray, grad: RowSparseNDArray, mom: NDArray,
+                   lr: float, momentum: float = 0.9, wd: float = 0.0,
+                   rescale_grad: float = 1.0) -> NDArray:
+    """Lazy momentum update: momentum decays only on live rows
+    (parity: sgd_mom row_sparse 'lazy_update' semantics)."""
+    rows = grad.indices
+    g = grad.data * rescale_grad + wd * weight._data[rows]
+    m_rows = momentum * mom._data[rows] - lr * g
+    mom._rebind(mom._data.at[rows].set(m_rows))
+    weight._rebind(weight._data.at[rows].add(m_rows))
+    return weight
+
+
+def adagrad_update(weight: NDArray, grad: RowSparseNDArray, history: NDArray,
+                   lr: float, epsilon: float = 1e-7, wd: float = 0.0,
+                   rescale_grad: float = 1.0) -> NDArray:
+    """Row-sparse AdaGrad (parity: _sparse_adagrad_update,
+    src/operator/contrib/optimizer_op.cc group_adagrad)."""
+    rows = grad.indices
+    g = grad.data * rescale_grad
+    if wd:
+        g = g + wd * weight._data[rows]
+    h_rows = history._data[rows] + g * g
+    history._rebind(history._data.at[rows].set(h_rows))
+    step = lr * g / (jnp.sqrt(h_rows) + epsilon)
+    weight._rebind(weight._data.at[rows].add(-step))
+    return weight

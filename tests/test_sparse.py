@@ -1,0 +1,134 @@
+"""Sparse NDArray tests (parity model: tests/python/unittest/
+test_sparse_ndarray.py, test_sparse_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.base import MXNetError
+
+
+def _dense_with_zero_rows(rows=6, cols=4, zero_rows=(1, 3, 4), seed=0):
+    a = onp.random.RandomState(seed).randn(rows, cols).astype("float32")
+    for r in zero_rows:
+        a[r] = 0
+    return a
+
+
+def test_cast_storage_row_sparse_roundtrip():
+    a = _dense_with_zero_rows()
+    nd = mx.nd.array(a)
+    rsp = nd.tostype("row_sparse")
+    assert rsp.stype == "row_sparse"
+    assert rsp.nnz == 3
+    onp.testing.assert_allclose(rsp.asnumpy(), a)
+    back = rsp.tostype("default")
+    onp.testing.assert_allclose(back.asnumpy(), a)
+
+
+def test_cast_storage_csr_roundtrip():
+    a = _dense_with_zero_rows()
+    a[0, 1] = 0.0
+    csr = mx.nd.array(a).tostype("csr")
+    assert csr.stype == "csr"
+    assert csr.nnz == int((a != 0).sum())
+    onp.testing.assert_allclose(csr.asnumpy(), a)
+
+
+def test_constructors():
+    rsp = sparse.row_sparse_array(
+        (onp.ones((2, 3), "float32"), [1, 4]), shape=(6, 3))
+    assert rsp.shape == (6, 3) and rsp.nnz == 2
+    dense = rsp.asnumpy()
+    assert dense[1].sum() == 3 and dense[0].sum() == 0
+
+    csr = sparse.csr_matrix(
+        (onp.array([1.0, 2.0, 3.0], "float32"), [0, 2, 1], [0, 2, 2, 3]),
+        shape=(3, 3))
+    expect = onp.array([[1, 0, 2], [0, 0, 0], [0, 3, 0]], "float32")
+    onp.testing.assert_allclose(csr.asnumpy(), expect)
+    # csr row access
+    onp.testing.assert_allclose(csr[0].asnumpy(), expect[0:1])
+
+    z = sparse.zeros("row_sparse", (4, 2))
+    assert z.nnz == 0
+    onp.testing.assert_allclose(z.asnumpy(), onp.zeros((4, 2)))
+
+
+def test_retain():
+    rsp = sparse.row_sparse_array(
+        (onp.arange(6, dtype="float32").reshape(3, 2), [0, 2, 5]),
+        shape=(6, 2))
+    kept = sparse.retain(rsp, [0, 5])
+    assert kept.nnz == 2
+    assert list(onp.asarray(kept.indices)) == [0, 5]
+    onp.testing.assert_allclose(kept.asnumpy()[2], [0, 0])
+
+
+def test_csr_dot_dense():
+    a = _dense_with_zero_rows(5, 4, (2,), seed=1)
+    b = onp.random.RandomState(2).randn(4, 3).astype("float32")
+    csr = mx.nd.array(a).tostype("csr")
+    out = sparse.dot(csr, mx.nd.array(b))
+    onp.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5)
+    outT = sparse.dot(csr, mx.nd.array(
+        onp.random.RandomState(3).randn(5, 2).astype("float32")),
+        transpose_a=True)
+    assert outT.shape == (4, 2)
+
+
+def test_rsp_dot_dense_transpose():
+    a = _dense_with_zero_rows(6, 4, (0, 2, 3), seed=4)
+    b = onp.random.RandomState(5).randn(6, 3).astype("float32")
+    rsp = mx.nd.array(a).tostype("row_sparse")
+    out = sparse.dot(rsp, mx.nd.array(b), transpose_a=True)
+    onp.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5)
+
+
+def test_sparse_add():
+    a = sparse.row_sparse_array((onp.ones((1, 2), "float32"), [1]),
+                                shape=(4, 2))
+    b = sparse.row_sparse_array((2 * onp.ones((2, 2), "float32"), [1, 3]),
+                                shape=(4, 2))
+    c = sparse.add(a, b)
+    assert c.stype == "row_sparse" and c.nnz == 2
+    expect = onp.zeros((4, 2), "float32")
+    expect[1] = 3.0
+    expect[3] = 2.0
+    onp.testing.assert_allclose(c.asnumpy(), expect)
+    # mixed sparse+dense → dense
+    d = sparse.add(a, mx.nd.ones((4, 2)))
+    onp.testing.assert_allclose(
+        d.asnumpy(), onp.ones((4, 2)) + a.asnumpy())
+
+
+def test_sparse_sgd_update_touches_only_live_rows():
+    w = mx.nd.array(onp.ones((5, 2), "float32"))
+    g = sparse.row_sparse_array((onp.ones((2, 2), "float32"), [1, 3]),
+                                shape=(5, 2))
+    sparse.sgd_update(w, g, lr=0.5)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[0], [1, 1])
+    onp.testing.assert_allclose(out[1], [0.5, 0.5])
+    onp.testing.assert_allclose(out[3], [0.5, 0.5])
+
+
+def test_sparse_adagrad_update():
+    w = mx.nd.array(onp.ones((4, 2), "float32"))
+    h = mx.nd.zeros((4, 2))
+    g = sparse.row_sparse_array((onp.full((1, 2), 2.0, "float32"), [2]),
+                                shape=(4, 2))
+    sparse.adagrad_update(w, g, h, lr=1.0, epsilon=0.0)
+    out = w.asnumpy()
+    onp.testing.assert_allclose(out[2], [0.0, 0.0])  # 1 - 2/sqrt(4)
+    onp.testing.assert_allclose(h.asnumpy()[2], [4.0, 4.0])
+    onp.testing.assert_allclose(out[0], [1.0, 1.0])
+
+
+def test_sparse_errors():
+    with pytest.raises(MXNetError):
+        sparse.csr_matrix((onp.ones(2), [0, 1], [0, 1, 2]))  # no shape
+    with pytest.raises(MXNetError):
+        sparse.zeros("bogus", (2, 2))
+    with pytest.raises(MXNetError):
+        sparse.row_sparse_array((onp.ones((2, 3)), [0]), shape=(4, 3))

@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""im2rec: pack an image folder/list into a RecordIO file.
+
+Parity: tools/im2rec.py + tools/im2rec.cc in the reference — builds the
+.lst (index label path) listing and the .rec/.idx pair consumed by
+ImageRecordIter.  Uses the native writer (src_native/recordio.cc) when
+available, the pure-Python one otherwise; output is byte-compatible
+with the reference's dmlc recordio format.
+
+Usage:
+  python tools/im2rec.py PREFIX IMAGE_ROOT --list      # make PREFIX.lst
+  python tools/im2rec.py PREFIX IMAGE_ROOT             # pack PREFIX.rec
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as onp
+
+
+EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def make_list(prefix, root, recursive=True, shuffle=True, seed=0):
+    entries = []
+    label_map = {}
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        cls = os.path.relpath(dirpath, root)
+        for fname in sorted(filenames):
+            if os.path.splitext(fname)[1].lower() not in EXTS:
+                continue
+            if cls not in label_map:
+                label_map[cls] = len(label_map)
+            rel = os.path.relpath(os.path.join(dirpath, fname), root)
+            entries.append((rel, label_map[cls]))
+        if not recursive:
+            break
+    if shuffle:
+        random.Random(seed).shuffle(entries)
+    lst = prefix + ".lst"
+    with open(lst, "w") as f:
+        for i, (rel, label) in enumerate(entries):
+            f.write(f"{i}\t{float(label)}\t{rel}\n")
+    print(f"wrote {len(entries)} entries to {lst}")
+    return lst
+
+
+def read_list(lst):
+    with open(lst) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx = int(parts[0])
+            label = [float(x) for x in parts[1:-1]]
+            yield idx, label, parts[-1]
+
+
+def pack(prefix, root, quality=95, resize=0, color=1):
+    from mxnet_tpu import recordio
+    try:
+        from mxnet_tpu.io import native
+        writer = native.NativeRecordWriter(prefix + ".rec")
+        native_mode = True
+    except Exception:
+        writer = recordio.MXRecordIO(prefix + ".rec", "w")
+        native_mode = False
+    import cv2
+    idx_file = open(prefix + ".idx", "w")
+    count = 0
+    for idx, label, rel in read_list(prefix + ".lst"):
+        path = os.path.join(root, rel)
+        img = cv2.imread(path, color)
+        if img is None:
+            print(f"skip unreadable {path}", file=sys.stderr)
+            continue
+        if resize:
+            h, w = img.shape[:2]
+            scale = resize / min(h, w)
+            img = cv2.resize(img, (int(w * scale), int(h * scale)))
+        if len(label) == 1:
+            header = recordio.IRHeader(0, label[0], idx, 0)
+        else:
+            header = recordio.IRHeader(len(label),
+                                       onp.asarray(label, onp.float32),
+                                       idx, 0)
+        payload = recordio.pack_img(header, img, quality=quality)
+        if native_mode:
+            pos = writer.write(payload)
+        else:
+            pos = writer.tell() if hasattr(writer, "tell") else 0
+            writer.write(payload)
+        idx_file.write(f"{idx}\t{pos}\n")
+        count += 1
+    writer.close()
+    idx_file.close()
+    print(f"packed {count} images into {prefix}.rec")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix")
+    ap.add_argument("root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate the .lst only")
+    ap.add_argument("--no-shuffle", action="store_true")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--resize", type=int, default=0)
+    ap.add_argument("--color", type=int, default=1)
+    args = ap.parse_args()
+    if args.list or not os.path.exists(args.prefix + ".lst"):
+        make_list(args.prefix, args.root, shuffle=not args.no_shuffle)
+    if not args.list:
+        pack(args.prefix, args.root, quality=args.quality,
+             resize=args.resize, color=args.color)
+
+
+if __name__ == "__main__":
+    main()
