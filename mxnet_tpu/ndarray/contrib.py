@@ -228,3 +228,22 @@ def isnan(data):
 
 def isinf(data):
     return apply_jax(lambda x: jnp.isinf(x).astype(jnp.float32), [data])
+
+
+# -- registry-backed contrib ops ------------------------------------------
+# Every op registered as ``_contrib_<Name>`` surfaces here as
+# ``mx.nd.contrib.<Name>`` — the analogue of the reference's codegen of
+# the contrib namespace (python/mxnet/ndarray/register.py).
+
+def _populate_contrib():
+    from ..ops import registry as _reg
+    from .register import make_op_func
+    for _n in _reg.list_ops():
+        if _n.startswith("_contrib_"):
+            short = _n[len("_contrib_"):]
+            if short not in globals():
+                globals()[short] = make_op_func(_n)
+                __all__.append(short)
+
+
+_populate_contrib()

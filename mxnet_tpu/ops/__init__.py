@@ -12,5 +12,6 @@ from . import nn      # noqa: F401
 from . import random  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import attention  # noqa: F401
+from . import vision  # noqa: F401
 
 __all__ = ["register", "get", "list_ops", "invoke", "apply_jax"]

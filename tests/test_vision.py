@@ -1,0 +1,313 @@
+"""Vision/detection/spatial op correctness (parity:
+tests/python/unittest/test_operator.py ROI/NMS/STN sections and
+tests/python/unittest/test_contrib_operator.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _rand(*shape):
+    return onp.random.randn(*shape).astype("float32")
+
+
+# -- box ops ---------------------------------------------------------------
+
+def _np_iou(a, b):
+    tlx = max(a[0], b[0]); tly = max(a[1], b[1])
+    brx = min(a[2], b[2]); bry = min(a[3], b[3])
+    w = max(brx - tlx, 0); h = max(bry - tly, 0)
+    inter = w * h
+    ua = (a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1]) - inter
+    return inter / ua if ua > 0 else 0.0
+
+
+def test_box_iou():
+    lhs = onp.abs(_rand(5, 4)); lhs[:, 2:] += lhs[:, :2] + 0.5
+    rhs = onp.abs(_rand(3, 4)); rhs[:, 2:] += rhs[:, :2] + 0.5
+    out = nd.contrib.box_iou(nd.array(lhs), nd.array(rhs)).asnumpy()
+    assert out.shape == (5, 3)
+    for i in range(5):
+        for j in range(3):
+            assert abs(out[i, j] - _np_iou(lhs[i], rhs[j])) < 1e-5
+
+
+def test_box_nms():
+    # rows: [cls, score, x1, y1, x2, y2]
+    data = onp.array([[[0, 0.9, 0.0, 0.0, 1.0, 1.0],
+                       [0, 0.8, 0.05, 0.05, 1.0, 1.0],   # overlaps first
+                       [0, 0.7, 2.0, 2.0, 3.0, 3.0],     # far away
+                       [1, 0.6, 0.0, 0.0, 1.0, 1.0]]],   # other class
+                     "float32")
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             id_index=0).asnumpy()
+    assert out[0, 0, 1] == pytest.approx(0.9)        # kept
+    assert (out[0, 1] == -1).all()                   # suppressed
+    assert out[0, 2, 1] == pytest.approx(0.7)        # kept (no overlap)
+    assert out[0, 3, 1] == pytest.approx(0.6)        # kept (other class)
+    # force_suppress ignores class ids
+    out2 = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                              id_index=0, force_suppress=True).asnumpy()
+    assert (out2[0, 3] == -1).all()
+
+
+def test_box_decode_encode_roundtrip():
+    anchors = onp.array([[[0.1, 0.1, 0.4, 0.5], [0.3, 0.3, 0.9, 0.8]]],
+                        "float32")
+    zeros = onp.zeros((1, 2, 4), "float32")
+    out = nd.contrib.box_decode(nd.array(zeros), nd.array(anchors)).asnumpy()
+    assert_almost_equal(out, anchors, rtol=1e-5, atol=1e-6)
+
+
+# -- ROI ops ---------------------------------------------------------------
+
+def test_roi_align_constant():
+    data = onp.full((1, 2, 8, 8), 3.5, "float32")
+    rois = onp.array([[0, 0, 0, 7, 7]], "float32")
+    out = nd.contrib.ROIAlign(nd.array(data), nd.array(rois),
+                              pooled_size=(2, 2), spatial_scale=1.0).asnumpy()
+    assert out.shape == (1, 2, 2, 2)
+    assert_almost_equal(out, onp.full((1, 2, 2, 2), 3.5), rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    data = nd.array(_rand(1, 2, 8, 8))
+    rois = nd.array(onp.array([[0, 1, 1, 6, 6]], "float32"))
+    data.attach_grad()
+    with autograd.record():
+        out = nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                                  spatial_scale=1.0)
+        loss = out.sum()
+    loss.backward()
+    assert onp.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_roi_pooling_max():
+    data = onp.arange(16, dtype="float32").reshape(1, 1, 4, 4)
+    rois = onp.array([[0, 0, 0, 3, 3]], "float32")
+    out = nd.ROIPooling(nd.array(data), nd.array(rois), pooled_size=(2, 2),
+                        spatial_scale=1.0).asnumpy()
+    # exact integer bins: max of each 2x2 quadrant
+    assert_almost_equal(out[0, 0], onp.array([[5., 7.], [13., 15.]]))
+
+
+def test_psroi_pooling_shape():
+    p, od = 2, 3
+    data = _rand(1, od * p * p, 8, 8)
+    rois = onp.array([[0, 0, 0, 7, 7]], "float32")
+    out = nd.contrib.PSROIPooling(nd.array(data), nd.array(rois),
+                                  output_dim=od, pooled_size=p,
+                                  spatial_scale=1.0)
+    assert out.shape == (1, od, p, p)
+
+
+# -- MultiBox SSD stack ----------------------------------------------------
+
+def test_multibox_prior():
+    data = nd.array(_rand(1, 3, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(data, sizes=(0.5, 0.25),
+                                       ratios=(1.0, 2.0)).asnumpy()
+    # num anchors per pixel = num_sizes + num_ratios - 1 = 3
+    assert anchors.shape == (1, 4 * 4 * 3, 4)
+    # first anchor centered at ((0.5/4), (0.5/4)) with size 0.5
+    a0 = anchors[0, 0]
+    assert a0[0] == pytest.approx(0.125 - 0.25, abs=1e-5)
+    assert a0[2] == pytest.approx(0.125 + 0.25, abs=1e-5)
+
+
+def test_multibox_target():
+    anchor = onp.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0]]], "float32")
+    # one gt box of class 2 matching anchor 1
+    label = onp.array([[[2.0, 0.52, 0.52, 0.98, 0.98],
+                        [-1, -1, -1, -1, -1]]], "float32")
+    cls_pred = onp.zeros((1, 3, 2), "float32")
+    lt, lm, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchor), nd.array(label), nd.array(cls_pred))
+    ct = ct.asnumpy()
+    assert ct.shape == (1, 2)
+    assert ct[0, 1] == pytest.approx(3.0)            # class 2 → target 3
+    assert ct[0, 0] == pytest.approx(0.0)            # background
+    lm = lm.asnumpy().reshape(1, 2, 4)
+    assert (lm[0, 1] == 1).all() and (lm[0, 0] == 0).all()
+
+
+def test_box_nms_large_class_ids():
+    # float32-precision regression: large class ids must not corrupt IoU
+    data = onp.array([[[4000, 0.9, 0.0, 0.0, 1.0, 1.0],
+                       [4000, 0.8, 0.0, 0.0, 1.0, 1.0]]], "float32")
+    out = nd.contrib.box_nms(nd.array(data), overlap_thresh=0.5,
+                             id_index=0).asnumpy()
+    assert out[0, 0, 1] == pytest.approx(0.9)
+    assert (out[0, 1] == -1).all()      # same class → suppressed
+
+
+def test_multibox_target_padded_labels():
+    # a padded (-1) label row must not clobber anchor 0's forced match
+    anchor = onp.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0]]], "float32")
+    label = onp.array([[[2.0, 0.05, 0.05, 0.3, 0.3],
+                        [-1, -1, -1, -1, -1]]], "float32")
+    cls_pred = onp.zeros((1, 3, 2), "float32")
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchor), nd.array(label), nd.array(cls_pred))
+    assert ct.asnumpy()[0, 0] == pytest.approx(3.0)
+
+
+def test_multibox_target_negative_mining():
+    anchor = onp.array([[[0.0, 0.0, 0.5, 0.5],
+                         [0.5, 0.5, 1.0, 1.0],
+                         [0.0, 0.5, 0.5, 1.0],
+                         [0.5, 0.0, 1.0, 0.5]]], "float32")
+    label = onp.array([[[1.0, 0.02, 0.02, 0.48, 0.48]]], "float32")
+    # cls_pred: anchor 1 is the hardest negative (high non-bg confidence)
+    cls_pred = onp.zeros((1, 2, 4), "float32")
+    cls_pred[0, 1, 1] = 5.0
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        nd.array(anchor), nd.array(label), nd.array(cls_pred),
+        negative_mining_ratio=1.0, ignore_label=-1.0)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == pytest.approx(2.0)   # matched, class 1 → 2
+    assert ct[1] == pytest.approx(0.0)   # kept hard negative
+    assert ct[2] == -1.0 and ct[3] == -1.0   # ignored negatives
+
+
+def test_multibox_detection():
+    anchor = onp.array([[[0.1, 0.1, 0.4, 0.4],
+                         [0.6, 0.6, 0.9, 0.9]]], "float32")
+    cls_prob = onp.array([[[0.2, 0.1],      # background
+                           [0.7, 0.1],      # class 0
+                           [0.1, 0.8]]],    # class 1
+                         "float32")
+    loc_pred = onp.zeros((1, 8), "float32")
+    out = nd.contrib.MultiBoxDetection(
+        nd.array(cls_prob), nd.array(loc_pred), nd.array(anchor)).asnumpy()
+    assert out.shape == (1, 2, 6)
+    # anchor 0 → class 0 @ 0.7, box == anchor (zero offsets)
+    row = out[0, 0]
+    assert row[0] == pytest.approx(0.0)
+    assert row[1] == pytest.approx(0.7)
+    assert_almost_equal(row[2:], anchor[0, 0], rtol=1e-4, atol=1e-5)
+
+
+# -- spatial transform ops -------------------------------------------------
+
+def test_bilinear_sampler_identity():
+    data = _rand(2, 3, 5, 7)
+    ys, xs = onp.meshgrid(onp.linspace(-1, 1, 5), onp.linspace(-1, 1, 7),
+                          indexing="ij")
+    grid = onp.stack([xs, ys])[None].repeat(2, axis=0).astype("float32")
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    assert_almost_equal(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = _rand(1, 2, 6, 6)
+    theta = onp.array([[1, 0, 0, 0, 1, 0]], "float32")
+    out = nd.SpatialTransformer(nd.array(data), nd.array(theta),
+                                target_shape=(6, 6)).asnumpy()
+    assert_almost_equal(out, data, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_generator_affine():
+    theta = onp.array([[1, 0, 0, 0, 1, 0]], "float32")
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(4, 4)).asnumpy()
+    assert grid.shape == (1, 2, 4, 4)
+    assert grid[0, 0, 0, 0] == pytest.approx(-1.0)
+    assert grid[0, 0, -1, -1] == pytest.approx(1.0)
+
+
+def test_correlation_self():
+    data = _rand(1, 4, 6, 6)
+    out = nd.Correlation(nd.array(data), nd.array(data),
+                         max_displacement=0).asnumpy()
+    assert out.shape == (1, 1, 6, 6)
+    assert_almost_equal(out[0, 0], (data * data).mean(axis=1)[0], rtol=1e-4)
+
+
+def test_correlation_flownet_shape():
+    # FlowNet config: pad == max_displacement → output spatial size == input
+    d1, d2 = _rand(1, 2, 16, 16), _rand(1, 2, 16, 16)
+    out = nd.Correlation(nd.array(d1), nd.array(d2), max_displacement=4,
+                         pad_size=4).asnumpy()
+    assert out.shape == (1, 81, 16, 16)
+    # center pixel, zero displacement channel == plain correlation
+    mid = 81 // 2
+    expect = (d1 * d2).mean(axis=1)
+    assert_almost_equal(out[0, mid], expect[0], rtol=1e-4)
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    x = _rand(1, 3, 7, 7)
+    w = _rand(4, 3, 3, 3)
+    offset = onp.zeros((1, 2 * 9, 5, 5), "float32")
+    ref = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=4, no_bias=True).asnumpy()
+    out = nd.contrib.DeformableConvolution(
+        nd.array(x), nd.array(offset), nd.array(w), None, kernel=(3, 3),
+        num_filter=4, no_bias=True).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+
+
+# -- misc contrib ----------------------------------------------------------
+
+def test_quadratic():
+    x = _rand(3, 4)
+    out = nd.contrib.quadratic(nd.array(x), a=2.0, b=-1.0, c=0.5).asnumpy()
+    assert_almost_equal(out, 2 * x * x - x + 0.5, rtol=1e-5)
+
+
+def test_allclose():
+    a = _rand(4)
+    assert nd.contrib.allclose(nd.array(a), nd.array(a)).asnumpy()[0] == 1.0
+    assert nd.contrib.allclose(nd.array(a),
+                               nd.array(a + 1)).asnumpy()[0] == 0.0
+
+
+def test_arange_like():
+    x = nd.array(_rand(2, 3))
+    out = nd.contrib.arange_like(x).asnumpy()
+    assert_almost_equal(out, onp.arange(6, dtype="float32").reshape(2, 3))
+    out2 = nd.contrib.arange_like(x, axis=1, start=5, step=2).asnumpy()
+    assert_almost_equal(out2, onp.array([5., 7., 9.], "float32"))
+
+
+def test_gradientmultiplier():
+    x = nd.array(_rand(3))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=3.0)
+        loss = y.sum()
+    loss.backward()
+    assert_almost_equal(x.grad, onp.full((3,), 3.0, "float32"), rtol=1e-5)
+
+
+def test_index_copy_index_array():
+    old = nd.array(onp.zeros((5, 2), "float32"))
+    new = nd.array(onp.ones((2, 2), "float32"))
+    idx = nd.array(onp.array([1, 3], "float32"))
+    out = nd.contrib.index_copy(old, idx, new).asnumpy()
+    assert out[1].sum() == 2 and out[3].sum() == 2 and out[0].sum() == 0
+    ia = nd.contrib.index_array(nd.array(onp.zeros((2, 3)))).asnumpy()
+    assert ia.shape == (2, 3, 2)
+    assert (ia[1, 2] == [1, 2]).all()
+
+
+def test_boolean_mask():
+    data = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    index = nd.array(onp.array([0, 1, 0, 1], "float32"))
+    out = nd.contrib.boolean_mask(data, index).asnumpy()
+    assert out.shape == (2, 3)
+    assert_almost_equal(out[0], onp.array([3., 4., 5.]))
+
+
+def test_count_sketch():
+    data = onp.ones((2, 4), "float32")
+    h = onp.array([[0, 1, 1, 2]], "float32")
+    s = onp.array([[1, -1, 1, 1]], "float32")
+    out = nd.contrib.count_sketch(nd.array(data), nd.array(h), nd.array(s),
+                                  out_dim=3).asnumpy()
+    assert_almost_equal(out, onp.array([[1., 0., 1.], [1., 0., 1.]]))
