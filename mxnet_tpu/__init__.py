@@ -37,5 +37,7 @@ from . import test_utils  # noqa: E402
 from . import util  # noqa: E402
 from .util import is_np_array, set_np, reset_np  # noqa: E402
 from . import runtime  # noqa: E402
+from . import operator  # noqa: E402
+from . import contrib  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402

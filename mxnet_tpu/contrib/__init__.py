@@ -1,0 +1,2 @@
+"""mx.contrib — quantization and other contrib frontends."""
+from . import quantization  # noqa: F401

@@ -1,0 +1,183 @@
+"""INT8 post-training quantization of Gluon networks.
+
+Parity: the reference's quantization flow (graph pass
+src/operator/quantization/quantize_graph_pass.cc + calibration
+calibrate.cc) — there the conversion rewrites the symbol graph to
+insert quantize/dequantize and replace conv/FC with quantized kernels;
+here the TPU-native equivalent swaps Dense/Conv2D blocks for
+``QuantizedDense``/``QuantizedConv2D`` blocks whose forward runs the
+int8 ops (ops/quantization.py) on the MXU with calibrated ranges.
+
+Usage::
+
+    qnet = quantize_net(net, calib_data=[batch1, batch2], calib_mode="entropy")
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ops.registry import invoke
+from ..gluon.block import HybridBlock
+from ..gluon import nn as gnn
+from ..ops.quantization import calibrate_minmax, calibrate_entropy
+
+__all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D"]
+
+
+def _quantize_param(arr):
+    """Per-tensor symmetric int8 quantization of a weight/bias array."""
+    a = arr.asnumpy()
+    mn, mx = float(a.min()), float(a.max())
+    q, qmn, qmx = invoke("_contrib_quantize_v2", [arr],
+                         min_calib_range=mn, max_calib_range=mx)
+    return q, qmn, qmx
+
+
+class QuantizedDense(HybridBlock):
+    """int8 Dense with calibrated input range."""
+
+    def __init__(self, src: "gnn.Dense", in_min, in_max):
+        super().__init__()
+        self._units = src._units
+        self._flatten = src._flatten
+        self._activation = src._activation
+        self._in_min, self._in_max = float(in_min), float(in_max)
+        self.qweight, self.wmin, self.wmax = _quantize_param(
+            src.weight.data())
+        self._no_bias = src.bias is None
+        if not self._no_bias:
+            self.qbias, self.bmin, self.bmax = _quantize_param(
+                src.bias.data())
+
+    def forward(self, x):
+        qx, dmin, dmax = invoke(
+            "_contrib_quantize_v2", [x], min_calib_range=self._in_min,
+            max_calib_range=self._in_max)
+        bias = (None, None, None) if self._no_bias else (
+            self.qbias, self.bmin, self.bmax)
+        out, _, _ = invoke(
+            "_contrib_quantized_fully_connected",
+            [qx, self.qweight, dmin, dmax, self.wmin, self.wmax,
+             bias[0], bias[1], bias[2]],
+            num_hidden=self._units, no_bias=self._no_bias,
+            flatten=self._flatten)
+        if self._activation:
+            out = invoke("Activation", [out], act_type=self._activation)
+        return out
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 Conv2D with calibrated input range."""
+
+    def __init__(self, src: "gnn.Conv2D", in_min, in_max):
+        super().__init__()
+        self._kernel = src._kernel
+        self._strides = src._strides
+        self._padding = src._padding
+        self._dilation = src._dilation
+        self._groups = src._groups
+        self._channels = src._channels
+        self._activation = src._activation
+        self._layout = src._layout
+        self._in_min, self._in_max = float(in_min), float(in_max)
+        self.qweight, self.wmin, self.wmax = _quantize_param(
+            src.weight.data())
+        self._no_bias = src.bias is None
+        if not self._no_bias:
+            self.qbias, self.bmin, self.bmax = _quantize_param(
+                src.bias.data())
+
+    def forward(self, x):
+        qx, dmin, dmax = invoke(
+            "_contrib_quantize_v2", [x], min_calib_range=self._in_min,
+            max_calib_range=self._in_max)
+        bias = (None, None, None) if self._no_bias else (
+            self.qbias, self.bmin, self.bmax)
+        out, _, _ = invoke(
+            "_contrib_quantized_conv",
+            [qx, self.qweight, dmin, dmax, self.wmin, self.wmax,
+             bias[0], bias[1], bias[2]],
+            kernel=self._kernel, num_filter=self._channels,
+            stride=self._strides, pad=self._padding, dilate=self._dilation,
+            num_group=self._groups, no_bias=self._no_bias,
+            layout=self._layout)
+        if self._activation:
+            out = invoke("Activation", [out], act_type=self._activation)
+        return out
+
+
+def _walk(block, prefix=""):
+    for name, child in list(block._children.items()):
+        yield block, name, child, prefix + name
+        yield from _walk(child, prefix + name + ".")
+
+
+def quantize_net(net: HybridBlock, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers: List[str] = ()):
+    """Swap Dense/Conv2D layers of ``net`` for int8 equivalents.
+
+    ``calib_data``: iterable of NDArray batches run through the net to
+    collect per-layer input ranges.  ``calib_mode``: ``naive`` (min/max,
+    calibrate.cc min-max mode) or ``entropy`` (KL threshold search,
+    calibrate.cc ComputeEntropy).
+    """
+    if quantized_dtype != "int8":
+        raise MXNetError("only int8 supported")
+    if calib_data is None:
+        raise MXNetError("quantize_net requires calib_data batches")
+    calib = (calibrate_entropy if calib_mode == "entropy"
+             else calibrate_minmax)
+
+    # exact types only: subclasses may have divergent forward math
+    targets = [(parent, name, child, path)
+               for parent, name, child, path in _walk(net)
+               if type(child) in (gnn.Dense, gnn.Conv2D)
+               and path not in exclude_layers]
+
+    # calibration must see every layer's real input: temporarily disable
+    # hybridized cached-graph execution (it bypasses forward hooks), and
+    # drop stale cached graphs afterwards so the swapped-in quantized
+    # children actually run.
+    all_blocks = [net] + [c for _, _, c, _ in _walk(net)]
+    hybridized = list({id(b): (b, b._active) for b in all_blocks
+                       if hasattr(b, "_active")}.values())
+    for b, _ in hybridized:
+        b._active = False
+
+    # collect input samples per target layer via forward pre-hooks
+    samples = {path: [] for _, _, _, path in targets}
+    hooks = []
+    for _, _, child, path in targets:
+        def make_hook(p):
+            def hook(block, inputs):
+                samples[p].append(inputs[0].asnumpy())
+            return hook
+        child._forward_pre_hooks.append(make_hook(path))
+        hooks.append(child)
+    try:
+        for batch in calib_data:
+            net(batch if isinstance(batch, NDArray) else NDArray(batch))
+    finally:
+        for child in hooks:
+            child._forward_pre_hooks.pop()
+        for b, active in hybridized:
+            b._active = active
+            if hasattr(b, "_cached_graphs"):
+                b._cached_graphs.clear()
+
+    for parent, name, child, path in targets:
+        if not samples[path]:
+            continue
+        mn, mx = calib(samples[path])
+        if isinstance(child, gnn.Dense):
+            q = QuantizedDense(child, mn, mx)
+        else:
+            q = QuantizedConv2D(child, mn, mx)
+        parent._children[name] = q
+        if getattr(parent, name, None) is child:
+            object.__setattr__(parent, name, q)
+    return net

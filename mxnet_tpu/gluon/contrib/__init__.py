@@ -2,6 +2,16 @@
 from . import estimator
 from .layers import (SyncBatchNorm, PixelShuffle1D, PixelShuffle2D,
                      PixelShuffle3D, HybridConcurrent, Concurrent, Identity)
+from . import rnn_cells
+from . import rnn_cells as rnn  # reference path: gluon.contrib.rnn
+from .rnn_cells import (Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
+                        Conv1DLSTMCell, Conv2DLSTMCell, Conv3DLSTMCell,
+                        Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell,
+                        VariationalDropoutCell, LSTMPCell)
 
 __all__ = ["estimator", "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
-           "PixelShuffle3D", "HybridConcurrent", "Concurrent", "Identity"]
+           "PixelShuffle3D", "HybridConcurrent", "Concurrent", "Identity",
+           "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
+           "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",
+           "Conv1DGRUCell", "Conv2DGRUCell", "Conv3DGRUCell",
+           "VariationalDropoutCell", "LSTMPCell"]

@@ -1,0 +1,13 @@
+"""gluon.probability — distributions, transformations, stochastic blocks.
+
+Parity: python/mxnet/gluon/probability/ (distributions/, transformation/,
+block/stochastic_block.py).  TPU-first: every density/sampler is a pure
+jax function funneled through the op registry (autograd-recordable,
+jit-traceable); sampling draws stateless `jax.random` keys from the
+global key chain (ops/random.py) so it is reproducible and trace-safe.
+"""
+from .distributions import *  # noqa: F401,F403
+from .transformation import *  # noqa: F401,F403
+from .stochastic_block import StochasticBlock, StochasticSequential  # noqa: F401
+
+from . import distributions, transformation, stochastic_block  # noqa: F401

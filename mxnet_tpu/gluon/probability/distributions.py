@@ -1,0 +1,1468 @@
+"""Probability distributions.
+
+Parity: python/mxnet/gluon/probability/distributions/ — one class per
+file there (normal.py, gamma.py, ... divergence.py); here one module,
+same class surface.  Each method builds a pure jax function over the
+distribution's parameters and funnels it through ``apply_jax`` so
+log-probs/samples are autograd-recorded NDArrays; pathwise
+(reparameterized) gradients come directly from jax's differentiable
+samplers (``has_grad`` on the reference marks the same property).
+"""
+from __future__ import annotations
+
+import math
+from numbers import Number
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+
+from ...ndarray import NDArray
+from ...ops.registry import apply_jax
+from ...ops.random import next_key
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "HalfNormal", "Laplace",
+    "Cauchy", "HalfCauchy", "Uniform", "Exponential", "Gamma", "Beta",
+    "Chi2", "FisherSnedecor", "StudentT", "Gumbel", "Pareto", "Weibull",
+    "Bernoulli", "Binomial", "Geometric", "NegativeBinomial", "Poisson",
+    "Categorical", "OneHotCategorical", "RelaxedBernoulli",
+    "RelaxedOneHotCategorical", "Multinomial", "MultivariateNormal",
+    "Dirichlet", "Independent", "kl_divergence", "register_kl",
+]
+
+_EULER = 0.5772156649015329
+
+
+def _nd(x, dtype=jnp.float32):
+    if isinstance(x, NDArray):
+        return x
+    return NDArray(jnp.asarray(x, dtype))
+
+
+def _shape_of(x):
+    return tuple(x.shape)
+
+
+def _size_tuple(size):
+    if size is None:
+        return ()
+    if isinstance(size, Number):
+        return (int(size),)
+    return tuple(int(s) for s in size)
+
+
+class Distribution:
+    r"""Base distribution (parity: distributions/distribution.py
+    ``Distribution``): ``sample``/``sample_n``/``log_prob``/``prob``/
+    ``cdf``/``icdf``/``mean``/``variance``/``stddev``/``entropy``/
+    ``broadcast_to``/``enumerate_support``."""
+
+    has_grad = False
+    has_enumerate_support = False
+    arg_constraints: dict = {}
+    _param_names: tuple = ()
+
+    def __init__(self, event_dim=0, validate_args=None):
+        self.event_dim = event_dim
+        self._validate_args = validate_args
+        shapes = [
+            _shape_of(getattr(self, n)) for n in self._param_names
+            if getattr(self, n, None) is not None
+        ]
+        batch = ()
+        for s in shapes:
+            batch = onp.broadcast_shapes(batch, s)
+        if self.event_dim:
+            batch = batch[:-self.event_dim] if len(batch) >= self.event_dim else ()
+        self.batch_shape = batch
+        self.event_shape = ()
+
+    # -- helpers -----------------------------------------------------------
+    def _params(self):
+        return [getattr(self, n) for n in self._param_names
+                if getattr(self, n, None) is not None]
+
+    def _op(self, fn, *extra):
+        return apply_jax(fn, self._params() + list(extra))
+
+    def _sample_shape(self, size):
+        return _size_tuple(size) + tuple(self.batch_shape) + tuple(self.event_shape)
+
+    def _sample_op(self, fn, size):
+        """fn(key, shape, *params) -> array."""
+        key = next_key()
+        shape = self._sample_shape(size)
+        return apply_jax(lambda *ps: fn(key, shape, *ps), self._params())
+
+    # -- surface -----------------------------------------------------------
+    def sample(self, size=None):
+        raise NotImplementedError
+
+    def sample_n(self, size=None):
+        return self.sample(_size_tuple(size))
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return self.log_prob(value).exp()
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return self.variance.sqrt()
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def perplexity(self):
+        return self.entropy().exp()
+
+    def enumerate_support(self):
+        raise NotImplementedError
+
+    def broadcast_to(self, batch_shape):
+        new = self.__new__(type(self))
+        new.__dict__.update(self.__dict__)
+        batch_shape = _size_tuple(batch_shape)
+        for n in self._param_names:
+            p = getattr(self, n, None)
+            if p is not None:
+                setattr(new, n, p.broadcast_to(
+                    batch_shape + tuple(self.event_shape)))
+        new.batch_shape = batch_shape
+        return new
+
+    def __repr__(self):
+        args = ", ".join(
+            f"{n}={getattr(self, n).shape}" for n in self._param_names
+            if getattr(self, n, None) is not None)
+        return f"{type(self).__name__}({args})"
+
+
+class ExponentialFamily(Distribution):
+    """Parity: distributions/exp_family.py — marker base class for
+    exponential-family members (enables Bregman-form KL in principle)."""
+
+
+# ---------------------------------------------------------------------------
+# continuous location-scale family
+# ---------------------------------------------------------------------------
+
+class Normal(ExponentialFamily):
+    has_grad = True
+    _param_names = ("loc", "scale")
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        self.loc, self.scale = _nd(loc), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, loc, sc: loc + sc * jax.random.normal(k, s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda loc, sc, v: -((v - loc) ** 2) / (2 * sc ** 2)
+            - jnp.log(sc) - 0.5 * math.log(2 * math.pi), _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda loc, sc, v: jsp.ndtr((v - loc) / sc), _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda loc, sc, v: loc + sc * jsp.ndtri(v), _nd(value))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        return self._op(
+            lambda loc, sc: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(sc))
+
+
+class HalfNormal(Normal):
+    """|X|, X ~ Normal(0, scale) (parity: half_normal.py)."""
+    _param_names = ("scale",)
+
+    def __init__(self, scale=1.0, **kw):
+        self.scale = _nd(scale)
+        self.loc = None
+        Distribution.__init__(self, **kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, sc: jnp.abs(sc * jax.random.normal(k, s)), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda sc, v: -(v ** 2) / (2 * sc ** 2) - jnp.log(sc)
+            + 0.5 * math.log(2 / math.pi), _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda sc, v: jsp.erf(v / (sc * math.sqrt(2))), _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda sc, v: sc * math.sqrt(2) * jsp.erfinv(v), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda sc: sc * math.sqrt(2 / math.pi))
+
+    @property
+    def variance(self):
+        return self._op(lambda sc: sc ** 2 * (1 - 2 / math.pi))
+
+    def entropy(self):
+        return self._op(
+            lambda sc: 0.5 * math.log(math.pi / 2) + 0.5 + jnp.log(sc))
+
+
+class Laplace(Distribution):
+    has_grad = True
+    _param_names = ("loc", "scale")
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        self.loc, self.scale = _nd(loc), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, loc, sc: loc + sc * jax.random.laplace(k, s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda loc, sc, v: -jnp.abs(v - loc) / sc - jnp.log(2 * sc),
+            _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda loc, sc, v: 0.5 - 0.5 * jnp.sign(v - loc)
+            * jnp.expm1(-jnp.abs(v - loc) / sc), _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda loc, sc, v: loc - sc * jnp.sign(v - 0.5)
+            * jnp.log1p(-2 * jnp.abs(v - 0.5)), _nd(value))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self._op(lambda loc, sc: 2 * sc ** 2)
+
+    def entropy(self):
+        return self._op(lambda loc, sc: 1 + jnp.log(2 * sc))
+
+
+class Cauchy(Distribution):
+    has_grad = True
+    _param_names = ("loc", "scale")
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        self.loc, self.scale = _nd(loc), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, loc, sc: loc + sc * jax.random.cauchy(k, s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda loc, sc, v: -jnp.log(math.pi * sc
+                                        * (1 + ((v - loc) / sc) ** 2)),
+            _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda loc, sc, v: jnp.arctan((v - loc) / sc) / math.pi + 0.5,
+            _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda loc, sc, v: loc + sc * jnp.tan(math.pi * (v - 0.5)),
+            _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda loc, sc: jnp.full(jnp.shape(loc), jnp.nan))
+
+    @property
+    def variance(self):
+        return self._op(lambda loc, sc: jnp.full(jnp.shape(loc), jnp.nan))
+
+    def entropy(self):
+        return self._op(lambda loc, sc: jnp.log(4 * math.pi * sc))
+
+
+class HalfCauchy(Cauchy):
+    _param_names = ("scale",)
+
+    def __init__(self, scale=1.0, **kw):
+        self.scale = _nd(scale)
+        self.loc = None
+        Distribution.__init__(self, **kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, sc: jnp.abs(sc * jax.random.cauchy(k, s)), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda sc, v: math.log(2) - jnp.log(math.pi * sc
+                                                * (1 + (v / sc) ** 2)),
+            _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda sc, v: 2 * jnp.arctan(v / sc) / math.pi, _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda sc, v: sc * jnp.tan(math.pi * v / 2), _nd(value))
+
+    def entropy(self):
+        return self._op(lambda sc: jnp.log(2 * math.pi * sc))
+
+
+class Uniform(Distribution):
+    has_grad = True
+    _param_names = ("low", "high")
+
+    def __init__(self, low=0.0, high=1.0, **kw):
+        self.low, self.high = _nd(low), _nd(high)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, lo, hi: lo + (hi - lo) * jax.random.uniform(k, s),
+            size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda lo, hi, v: jnp.where(
+                (v >= lo) & (v <= hi), -jnp.log(hi - lo), -jnp.inf),
+            _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda lo, hi, v: jnp.clip((v - lo) / (hi - lo), 0.0, 1.0),
+            _nd(value))
+
+    def icdf(self, value):
+        return self._op(lambda lo, hi, v: lo + v * (hi - lo), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda lo, hi: (lo + hi) / 2)
+
+    @property
+    def variance(self):
+        return self._op(lambda lo, hi: (hi - lo) ** 2 / 12)
+
+    def entropy(self):
+        return self._op(lambda lo, hi: jnp.log(hi - lo))
+
+
+class Exponential(ExponentialFamily):
+    has_grad = True
+    _param_names = ("scale",)
+
+    def __init__(self, scale=1.0, **kw):
+        self.scale = _nd(scale)  # mean; rate = 1/scale
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, sc: sc * jax.random.exponential(k, s), size)
+
+    def log_prob(self, value):
+        return self._op(lambda sc, v: -v / sc - jnp.log(sc), _nd(value))
+
+    def cdf(self, value):
+        return self._op(lambda sc, v: -jnp.expm1(-v / sc), _nd(value))
+
+    def icdf(self, value):
+        return self._op(lambda sc, v: -sc * jnp.log1p(-v), _nd(value))
+
+    @property
+    def mean(self):
+        return self.scale
+
+    @property
+    def variance(self):
+        return self.scale ** 2
+
+    def entropy(self):
+        return self._op(lambda sc: 1 + jnp.log(sc))
+
+
+class Gamma(ExponentialFamily):
+    has_grad = True
+    _param_names = ("shape_param", "scale")
+
+    def __init__(self, shape=1.0, scale=1.0, **kw):
+        self.shape_param, self.scale = _nd(shape), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, a, sc: sc * jax.random.gamma(k, a, s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda a, sc, v: (a - 1) * jnp.log(v) - v / sc
+            - jsp.gammaln(a) - a * jnp.log(sc), _nd(value))
+
+    def cdf(self, value):
+        return self._op(lambda a, sc, v: jsp.gammainc(a, v / sc), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda a, sc: a * sc)
+
+    @property
+    def variance(self):
+        return self._op(lambda a, sc: a * sc ** 2)
+
+    def entropy(self):
+        return self._op(
+            lambda a, sc: a + jnp.log(sc) + jsp.gammaln(a)
+            + (1 - a) * jsp.digamma(a))
+
+
+class Beta(ExponentialFamily):
+    has_grad = True
+    _param_names = ("alpha", "beta")
+
+    def __init__(self, alpha=1.0, beta=1.0, **kw):
+        self.alpha, self.beta = _nd(alpha), _nd(beta)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, a, b: jax.random.beta(k, a, b, s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda a, b, v: (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+            - jsp.betaln(a, b), _nd(value))
+
+    def cdf(self, value):
+        return self._op(lambda a, b, v: jsp.betainc(a, b, v), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda a, b: a / (a + b))
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1)))
+
+    def entropy(self):
+        return self._op(
+            lambda a, b: jsp.betaln(a, b) - (a - 1) * jsp.digamma(a)
+            - (b - 1) * jsp.digamma(b)
+            + (a + b - 2) * jsp.digamma(a + b))
+
+
+class Chi2(Gamma):
+    _param_names = ("df",)
+
+    def __init__(self, df, **kw):
+        self.df = _nd(df)
+        self.shape_param = self.df * 0.5
+        self.scale = _nd(2.0)
+        Distribution.__init__(self, **kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, df: jax.random.chisquare(k, df, shape=s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda df, v: (df / 2 - 1) * jnp.log(v) - v / 2
+            - jsp.gammaln(df / 2) - (df / 2) * math.log(2), _nd(value))
+
+    def cdf(self, value):
+        return self._op(lambda df, v: jsp.gammainc(df / 2, v / 2), _nd(value))
+
+    @property
+    def mean(self):
+        return self.df
+
+    @property
+    def variance(self):
+        return self.df * 2
+
+    def entropy(self):
+        return self._op(
+            lambda df: df / 2 + math.log(2) + jsp.gammaln(df / 2)
+            + (1 - df / 2) * jsp.digamma(df / 2))
+
+
+class FisherSnedecor(Distribution):
+    """F-distribution (parity: fishersnedecor.py)."""
+    _param_names = ("df1", "df2")
+
+    def __init__(self, df1, df2, **kw):
+        self.df1, self.df2 = _nd(df1), _nd(df2)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, d1, d2: jax.random.f(k, d1, d2, shape=s), size)
+
+    def log_prob(self, value):
+        def fn(d1, d2, v):
+            h1, h2 = d1 / 2, d2 / 2
+            return (h1 * jnp.log(d1) + h2 * jnp.log(d2)
+                    + (h1 - 1) * jnp.log(v)
+                    - (h1 + h2) * jnp.log(d2 + d1 * v)
+                    - jsp.betaln(h1, h2))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(
+            lambda d1, d2: jnp.where(d2 > 2, d2 / (d2 - 2), jnp.nan))
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda d1, d2: jnp.where(
+                d2 > 4,
+                2 * d2 ** 2 * (d1 + d2 - 2)
+                / (d1 * (d2 - 2) ** 2 * (d2 - 4)), jnp.nan))
+
+
+class StudentT(Distribution):
+    _param_names = ("df", "loc", "scale")
+
+    def __init__(self, df, loc=0.0, scale=1.0, **kw):
+        self.df, self.loc, self.scale = _nd(df), _nd(loc), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, df, loc, sc: loc + sc * jax.random.t(k, df, shape=s),
+            size)
+
+    def log_prob(self, value):
+        def fn(df, loc, sc, v):
+            z = (v - loc) / sc
+            return (jsp.gammaln((df + 1) / 2) - jsp.gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(sc)
+                    - (df + 1) / 2 * jnp.log1p(z ** 2 / df))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(
+            lambda df, loc, sc: jnp.where(df > 1, loc, jnp.nan))
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda df, loc, sc: jnp.where(
+                df > 2, sc ** 2 * df / (df - 2),
+                jnp.where(df > 1, jnp.inf, jnp.nan)))
+
+    def entropy(self):
+        def fn(df, loc, sc):
+            return ((df + 1) / 2 * (jsp.digamma((df + 1) / 2)
+                                    - jsp.digamma(df / 2))
+                    + 0.5 * jnp.log(df) + jsp.betaln(df / 2, 0.5)
+                    + jnp.log(sc))
+        return self._op(fn)
+
+
+class Gumbel(Distribution):
+    has_grad = True
+    _param_names = ("loc", "scale")
+
+    def __init__(self, loc=0.0, scale=1.0, **kw):
+        self.loc, self.scale = _nd(loc), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, loc, sc: loc + sc * jax.random.gumbel(k, s), size)
+
+    def log_prob(self, value):
+        def fn(loc, sc, v):
+            z = (v - loc) / sc
+            return -(z + jnp.exp(-z)) - jnp.log(sc)
+        return self._op(fn, _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda loc, sc, v: jnp.exp(-jnp.exp(-(v - loc) / sc)),
+            _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda loc, sc, v: loc - sc * jnp.log(-jnp.log(v)), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda loc, sc: loc + sc * _EULER)
+
+    @property
+    def variance(self):
+        return self._op(lambda loc, sc: (math.pi * sc) ** 2 / 6)
+
+    def entropy(self):
+        return self._op(lambda loc, sc: jnp.log(sc) + 1 + _EULER)
+
+
+class Pareto(Distribution):
+    _param_names = ("alpha", "scale")
+
+    def __init__(self, alpha, scale=1.0, **kw):
+        self.alpha, self.scale = _nd(alpha), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, a, sc: sc * jax.random.pareto(k, a, shape=s), size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda a, sc, v: jnp.log(a) + a * jnp.log(sc)
+            - (a + 1) * jnp.log(v), _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda a, sc, v: 1 - (sc / v) ** a, _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda a, sc, v: sc * (1 - v) ** (-1 / a), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(
+            lambda a, sc: jnp.where(a > 1, a * sc / (a - 1), jnp.inf))
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda a, sc: jnp.where(
+                a > 2, sc ** 2 * a / ((a - 1) ** 2 * (a - 2)), jnp.inf))
+
+    def entropy(self):
+        return self._op(
+            lambda a, sc: jnp.log(sc / a) + 1 + 1 / a)
+
+
+class Weibull(Distribution):
+    _param_names = ("concentration", "scale")
+
+    def __init__(self, concentration, scale=1.0, **kw):
+        self.concentration, self.scale = _nd(concentration), _nd(scale)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, c, sc: jax.random.weibull_min(k, sc, c, shape=s),
+            size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda c, sc, v: jnp.log(c / sc) + (c - 1) * jnp.log(v / sc)
+            - (v / sc) ** c, _nd(value))
+
+    def cdf(self, value):
+        return self._op(
+            lambda c, sc, v: -jnp.expm1(-((v / sc) ** c)), _nd(value))
+
+    def icdf(self, value):
+        return self._op(
+            lambda c, sc, v: sc * (-jnp.log1p(-v)) ** (1 / c), _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(
+            lambda c, sc: sc * jnp.exp(jsp.gammaln(1 + 1 / c)))
+
+    @property
+    def variance(self):
+        return self._op(
+            lambda c, sc: sc ** 2 * (jnp.exp(jsp.gammaln(1 + 2 / c))
+                                     - jnp.exp(2 * jsp.gammaln(1 + 1 / c))))
+
+    def entropy(self):
+        return self._op(
+            lambda c, sc: _EULER * (1 - 1 / c) + jnp.log(sc / c) + 1)
+
+
+# ---------------------------------------------------------------------------
+# discrete
+# ---------------------------------------------------------------------------
+
+def _prob_logit(prob, logit):
+    if (prob is None) == (logit is None):
+        raise ValueError("pass exactly one of prob=, logit=")
+    if prob is not None:
+        return _nd(prob), None
+    return None, _nd(logit)
+
+
+class Bernoulli(ExponentialFamily):
+    has_enumerate_support = True
+    _param_names = ("prob", "logit")
+
+    def __init__(self, prob=None, logit=None, **kw):
+        if prob is None and logit is None:
+            prob = 0.5
+        self.prob, self.logit = _prob_logit(prob, logit)
+        super().__init__(**kw)
+
+    def _p(self):
+        """jax fn arg -> probability."""
+        if self.prob is not None:
+            return lambda p: p
+        return lambda l: jax.nn.sigmoid(l)
+
+    def sample(self, size=None):
+        p = self._p()
+        return self._sample_op(
+            lambda k, s, x: jax.random.bernoulli(k, p(x), s).astype(
+                jnp.float32), size)
+
+    def log_prob(self, value):
+        if self.logit is not None:
+            return self._op(
+                lambda l, v: v * l - jax.nn.softplus(l), _nd(value))
+        return self._op(
+            lambda p, v: v * jnp.log(p) + (1 - v) * jnp.log1p(-p),
+            _nd(value))
+
+    @property
+    def mean(self):
+        p = self._p()
+        return self._op(lambda x: p(x))
+
+    @property
+    def variance(self):
+        p = self._p()
+        return self._op(lambda x: p(x) * (1 - p(x)))
+
+    def entropy(self):
+        p = self._p()
+        return self._op(
+            lambda x: -(p(x) * jnp.log(p(x))
+                        + (1 - p(x)) * jnp.log1p(-p(x))))
+
+    def enumerate_support(self):
+        return self._op(
+            lambda x: jnp.stack([jnp.zeros(jnp.shape(x)),
+                                 jnp.ones(jnp.shape(x))]))
+
+
+class Binomial(Distribution):
+    _param_names = ("n", "prob", "logit")
+
+    def __init__(self, n=1, prob=None, logit=None, **kw):
+        if prob is None and logit is None:
+            prob = 0.5
+        self.n = _nd(n)
+        self.prob, self.logit = _prob_logit(prob, logit)
+        super().__init__(**kw)
+
+    def _p(self):
+        if self.prob is not None:
+            return lambda n, p: p
+        return lambda n, l: jax.nn.sigmoid(l)
+
+    def sample(self, size=None):
+        p = self._p()
+        return self._sample_op(
+            lambda k, s, n, x: jax.random.binomial(k, n, p(n, x), shape=s),
+            size)
+
+    def log_prob(self, value):
+        p = self._p()
+        def fn(n, x, v):
+            pp = p(n, x)
+            return (jsp.gammaln(n + 1) - jsp.gammaln(v + 1)
+                    - jsp.gammaln(n - v + 1)
+                    + v * jnp.log(pp) + (n - v) * jnp.log1p(-pp))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        p = self._p()
+        return self._op(lambda n, x: n * p(n, x))
+
+    @property
+    def variance(self):
+        p = self._p()
+        return self._op(lambda n, x: n * p(n, x) * (1 - p(n, x)))
+
+
+class Geometric(Distribution):
+    """# failures before first success (parity: geometric.py)."""
+    _param_names = ("prob", "logit")
+
+    def __init__(self, prob=None, logit=None, **kw):
+        if prob is None and logit is None:
+            prob = 0.5
+        self.prob, self.logit = _prob_logit(prob, logit)
+        super().__init__(**kw)
+
+    def _p(self):
+        if self.prob is not None:
+            return lambda p: p
+        return lambda l: jax.nn.sigmoid(l)
+
+    def sample(self, size=None):
+        p = self._p()
+        return self._sample_op(
+            lambda k, s, x: jax.random.geometric(k, p(x), shape=s).astype(
+                jnp.float32) - 1, size)
+
+    def log_prob(self, value):
+        p = self._p()
+        return self._op(
+            lambda x, v: v * jnp.log1p(-p(x)) + jnp.log(p(x)), _nd(value))
+
+    def cdf(self, value):
+        p = self._p()
+        return self._op(
+            lambda x, v: 1 - (1 - p(x)) ** (jnp.floor(v) + 1), _nd(value))
+
+    @property
+    def mean(self):
+        p = self._p()
+        return self._op(lambda x: (1 - p(x)) / p(x))
+
+    @property
+    def variance(self):
+        p = self._p()
+        return self._op(lambda x: (1 - p(x)) / p(x) ** 2)
+
+    def entropy(self):
+        p = self._p()
+        return self._op(
+            lambda x: -((1 - p(x)) * jnp.log1p(-p(x))
+                        + p(x) * jnp.log(p(x))) / p(x))
+
+
+class NegativeBinomial(Distribution):
+    """# failures before the n-th success (parity: negative_binomial.py)."""
+    _param_names = ("n", "prob", "logit")
+
+    def __init__(self, n, prob=None, logit=None, **kw):
+        if prob is None and logit is None:
+            prob = 0.5
+        self.n = _nd(n)
+        self.prob, self.logit = _prob_logit(prob, logit)
+        super().__init__(**kw)
+
+    def _p(self):
+        if self.prob is not None:
+            return lambda n, p: p
+        return lambda n, l: jax.nn.sigmoid(l)
+
+    def sample(self, size=None):
+        p = self._p()
+        def fn(k, s, n, x):
+            # Gamma-Poisson mixture: lam ~ Gamma(n, (1-p)/p); X ~ Poisson(lam)
+            k1, k2 = jax.random.split(k)
+            pp = p(n, x)
+            lam = jax.random.gamma(k1, n, s) * (1 - pp) / pp
+            return jax.random.poisson(k2, lam, s).astype(jnp.float32)
+        return self._sample_op(fn, size)
+
+    def log_prob(self, value):
+        p = self._p()
+        def fn(n, x, v):
+            pp = p(n, x)
+            return (jsp.gammaln(v + n) - jsp.gammaln(v + 1) - jsp.gammaln(n)
+                    + n * jnp.log(pp) + v * jnp.log1p(-pp))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        p = self._p()
+        return self._op(lambda n, x: n * (1 - p(n, x)) / p(n, x))
+
+    @property
+    def variance(self):
+        p = self._p()
+        return self._op(lambda n, x: n * (1 - p(n, x)) / p(n, x) ** 2)
+
+
+class Poisson(ExponentialFamily):
+    _param_names = ("rate",)
+
+    def __init__(self, rate=1.0, **kw):
+        self.rate = _nd(rate)
+        super().__init__(**kw)
+
+    def sample(self, size=None):
+        return self._sample_op(
+            lambda k, s, r: jax.random.poisson(k, r, s).astype(jnp.float32),
+            size)
+
+    def log_prob(self, value):
+        return self._op(
+            lambda r, v: v * jnp.log(r) - r - jsp.gammaln(v + 1), _nd(value))
+
+    @property
+    def mean(self):
+        return self.rate
+
+    @property
+    def variance(self):
+        return self.rate
+
+
+class Categorical(Distribution):
+    has_enumerate_support = True
+    _param_names = ("prob", "logit")
+
+    def __init__(self, num_events=None, prob=None, logit=None, **kw):
+        self.prob, self.logit = _prob_logit(prob, logit)
+        p = self.prob if self.prob is not None else self.logit
+        self.num_events = int(num_events) if num_events else p.shape[-1]
+        super().__init__(event_dim=1, **kw)
+
+    def _logits(self):
+        if self.logit is not None:
+            return lambda l: jax.nn.log_softmax(l, axis=-1)
+        return lambda p: jnp.log(p / jnp.sum(p, -1, keepdims=True))
+
+    def sample(self, size=None):
+        lg = self._logits()
+        key = next_key()
+        shape = _size_tuple(size) + tuple(self.batch_shape)
+        return apply_jax(
+            lambda x: jax.random.categorical(key, lg(x), shape=shape).astype(
+                jnp.float32), self._params())
+
+    def log_prob(self, value):
+        lg = self._logits()
+        def fn(x, v):
+            logp = lg(x)
+            return jnp.take_along_axis(
+                logp, v.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        raise NotImplementedError("Categorical has no scalar mean")
+
+    def entropy(self):
+        lg = self._logits()
+        return self._op(
+            lambda x: -jnp.sum(jnp.exp(lg(x)) * lg(x), axis=-1))
+
+    def enumerate_support(self):
+        n = self.num_events
+        return self._op(
+            lambda x: jnp.broadcast_to(
+                jnp.arange(n, dtype=jnp.float32).reshape(
+                    (n,) + (1,) * len(self.batch_shape)),
+                (n,) + tuple(self.batch_shape)))
+
+
+class OneHotCategorical(Categorical):
+    def sample(self, size=None):
+        idx = super().sample(size)
+        n = self.num_events
+        return apply_jax(
+            lambda i: jax.nn.one_hot(i.astype(jnp.int32), n), [idx])
+
+    def log_prob(self, value):
+        lg = self._logits()
+        return self._op(
+            lambda x, v: jnp.sum(lg(x) * v, axis=-1), _nd(value))
+
+    def enumerate_support(self):
+        n = self.num_events
+        return self._op(
+            lambda x: jnp.broadcast_to(
+                jnp.eye(n, dtype=jnp.float32).reshape(
+                    (n,) + (1,) * len(self.batch_shape) + (n,)),
+                (n,) + tuple(self.batch_shape) + (n,)))
+
+
+class RelaxedBernoulli(Distribution):
+    """Gumbel-sigmoid relaxation (parity: relaxed_bernoulli.py)."""
+    has_grad = True
+    _param_names = ("prob", "logit")
+
+    def __init__(self, T=1.0, prob=None, logit=None, **kw):
+        self.T = float(T)
+        self.prob, self.logit = _prob_logit(prob, logit)
+        super().__init__(**kw)
+
+    def _l(self):
+        if self.logit is not None:
+            return lambda l: l
+        return lambda p: jnp.log(p) - jnp.log1p(-p)
+
+    def sample(self, size=None):
+        lf, T = self._l(), self.T
+        def fn(k, s, x):
+            u = jax.random.uniform(k, s, minval=1e-7, maxval=1 - 1e-7)
+            gl = jnp.log(u) - jnp.log1p(-u)
+            return jax.nn.sigmoid((lf(x) + gl) / T)
+        return self._sample_op(fn, size)
+
+    def log_prob(self, value):
+        lf, T = self._l(), self.T
+        def fn(x, v):
+            l = lf(x)
+            diff = l - T * (jnp.log(v) - jnp.log1p(-v))
+            return (math.log(T) + diff - 2 * jax.nn.softplus(diff)
+                    - jnp.log(v) - jnp.log1p(-v))
+        return self._op(fn, _nd(value))
+
+
+class RelaxedOneHotCategorical(Distribution):
+    """Gumbel-softmax / concrete (parity: relaxed_one_hot_categorical.py)."""
+    has_grad = True
+    _param_names = ("prob", "logit")
+
+    def __init__(self, T=1.0, prob=None, logit=None, **kw):
+        self.T = float(T)
+        self.prob, self.logit = _prob_logit(prob, logit)
+        p = self.prob if self.prob is not None else self.logit
+        self.num_events = p.shape[-1]
+        super().__init__(event_dim=1, **kw)
+
+    def _logits(self):
+        if self.logit is not None:
+            return lambda l: jax.nn.log_softmax(l, axis=-1)
+        return lambda p: jnp.log(p / jnp.sum(p, -1, keepdims=True))
+
+    def sample(self, size=None):
+        lg, T = self._logits(), self.T
+        key = next_key()
+        shape = (_size_tuple(size) + tuple(self.batch_shape)
+                 + (self.num_events,))
+        def fn(x):
+            g = jax.random.gumbel(key, shape)
+            return jax.nn.softmax((lg(x) + g) / T, axis=-1)
+        return apply_jax(fn, self._params())
+
+    def log_prob(self, value):
+        lg, T, n = self._logits(), self.T, self.num_events
+        def fn(x, v):
+            # concrete density (Maddison et al. 2017, eq. 6)
+            log_scale = (jsp.gammaln(jnp.asarray(float(n)))
+                         + (n - 1) * math.log(T))
+            inner = lg(x) - T * jnp.log(v)
+            return (log_scale + jnp.sum(inner, -1)
+                    - n * jax.nn.logsumexp(inner, axis=-1)
+                    - jnp.sum(jnp.log(v), -1))
+        return self._op(fn, _nd(value))
+
+
+class Multinomial(Distribution):
+    _param_names = ("prob", "logit")
+
+    def __init__(self, num_events=None, prob=None, logit=None,
+                 total_count=1, **kw):
+        self.total_count = int(total_count)
+        self.prob, self.logit = _prob_logit(prob, logit)
+        p = self.prob if self.prob is not None else self.logit
+        self.num_events = int(num_events) if num_events else p.shape[-1]
+        super().__init__(event_dim=1, **kw)
+
+    def _pr(self):
+        if self.prob is not None:
+            return lambda p: p / jnp.sum(p, -1, keepdims=True)
+        return lambda l: jax.nn.softmax(l, axis=-1)
+
+    def sample(self, size=None):
+        pr, tc = self._pr(), self.total_count
+        key = next_key()
+        shape = _size_tuple(size) + tuple(self.batch_shape)
+        def fn(x):
+            idx = jax.random.categorical(
+                key, jnp.log(pr(x)), shape=(tc,) + shape)
+            return jnp.sum(jax.nn.one_hot(idx, self.num_events), axis=0)
+        return apply_jax(fn, self._params())
+
+    def log_prob(self, value):
+        pr = self._pr()
+        def fn(x, v):
+            p = pr(x)
+            return (jsp.gammaln(jnp.sum(v, -1) + 1)
+                    - jnp.sum(jsp.gammaln(v + 1), -1)
+                    + jnp.sum(v * jnp.log(p), -1))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        pr, tc = self._pr(), self.total_count
+        return self._op(lambda x: tc * pr(x))
+
+    @property
+    def variance(self):
+        pr, tc = self._pr(), self.total_count
+        return self._op(lambda x: tc * pr(x) * (1 - pr(x)))
+
+
+class Dirichlet(ExponentialFamily):
+    has_grad = True
+    _param_names = ("alpha",)
+
+    def __init__(self, alpha, **kw):
+        self.alpha = _nd(alpha)
+        super().__init__(event_dim=1, **kw)
+
+    def sample(self, size=None):
+        key = next_key()
+        shape = _size_tuple(size) + tuple(self.batch_shape)
+        return apply_jax(
+            lambda a: jax.random.dirichlet(key, a, shape), [self.alpha])
+
+    def log_prob(self, value):
+        def fn(a, v):
+            return (jnp.sum((a - 1) * jnp.log(v), -1)
+                    + jsp.gammaln(jnp.sum(a, -1))
+                    - jnp.sum(jsp.gammaln(a), -1))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        return self._op(lambda a: a / jnp.sum(a, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        def fn(a):
+            a0 = jnp.sum(a, -1, keepdims=True)
+            return a * (a0 - a) / (a0 ** 2 * (a0 + 1))
+        return self._op(fn)
+
+    def entropy(self):
+        def fn(a):
+            a0 = jnp.sum(a, -1)
+            K = a.shape[-1]
+            return (jnp.sum(jsp.gammaln(a), -1) - jsp.gammaln(a0)
+                    + (a0 - K) * jsp.digamma(a0)
+                    - jnp.sum((a - 1) * jsp.digamma(a), -1))
+        return self._op(fn)
+
+
+class MultivariateNormal(Distribution):
+    has_grad = True
+    _param_names = ("loc", "cov", "precision", "scale_tril")
+
+    def __init__(self, loc, cov=None, precision=None, scale_tril=None, **kw):
+        given = [x is not None for x in (cov, precision, scale_tril)]
+        if sum(given) != 1:
+            raise ValueError(
+                "pass exactly one of cov=, precision=, scale_tril=")
+        self.loc = _nd(loc)
+        self.cov = _nd(cov) if cov is not None else None
+        self.precision = _nd(precision) if precision is not None else None
+        self.scale_tril = _nd(scale_tril) if scale_tril is not None else None
+        Distribution.__init__(self, event_dim=1)
+        # batch shape: broadcast(loc[:-1], matrix[:-2])
+        mat = next(m for m in (self.cov, self.precision, self.scale_tril)
+                   if m is not None)
+        self.batch_shape = onp.broadcast_shapes(
+            tuple(self.loc.shape[:-1]), tuple(mat.shape[:-2]))
+        self.event_shape = (self.loc.shape[-1],)
+
+    def _tril(self):
+        if self.scale_tril is not None:
+            return lambda loc, m: m
+        if self.cov is not None:
+            return lambda loc, m: jnp.linalg.cholesky(m)
+        return lambda loc, m: jnp.linalg.cholesky(jnp.linalg.inv(m))
+
+    def sample(self, size=None):
+        trilf = self._tril()
+        key = next_key()
+        shape = (_size_tuple(size) + tuple(self.batch_shape)
+                 + tuple(self.event_shape))
+        def fn(loc, m):
+            L = trilf(loc, m)
+            eps = jax.random.normal(key, shape)
+            return loc + jnp.einsum("...ij,...j->...i", L, eps)
+        return apply_jax(fn, self._params())
+
+    def log_prob(self, value):
+        trilf = self._tril()
+        def fn(loc, m, v):
+            L = trilf(loc, m)
+            d = v - loc
+            z = jax.scipy.linalg.solve_triangular(
+                L, d[..., None], lower=True)[..., 0]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            k = v.shape[-1]
+            return (-0.5 * jnp.sum(z ** 2, -1) - half_logdet
+                    - 0.5 * k * math.log(2 * math.pi))
+        return self._op(fn, _nd(value))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        trilf = self._tril()
+        def fn(loc, m):
+            L = trilf(loc, m)
+            return jnp.sum(L * L, axis=-1)
+        return self._op(fn)
+
+    def entropy(self):
+        trilf = self._tril()
+        def fn(loc, m):
+            L = trilf(loc, m)
+            k = loc.shape[-1]
+            half_logdet = jnp.sum(
+                jnp.log(jnp.diagonal(L, axis1=-2, axis2=-1)), -1)
+            return 0.5 * k * (1 + math.log(2 * math.pi)) + half_logdet
+        return self._op(fn)
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims as event dims (parity:
+    independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_ndims, **kw):
+        self.base_dist = base
+        self.n_event = int(reinterpreted_batch_ndims)
+        Distribution.__init__(self)
+        b = tuple(base.batch_shape)
+        self.batch_shape = b[:len(b) - self.n_event]
+        self.event_shape = b[len(b) - self.n_event:] + tuple(base.event_shape)
+
+    def sample(self, size=None):
+        return self.base_dist.sample(size)
+
+    def sample_n(self, size=None):
+        return self.base_dist.sample_n(size)
+
+    def log_prob(self, value):
+        lp = self.base_dist.log_prob(value)
+        axes = tuple(range(lp.ndim - self.n_event, lp.ndim))
+        return lp.sum(axis=axes) if axes else lp
+
+    @property
+    def mean(self):
+        return self.base_dist.mean
+
+    @property
+    def variance(self):
+        return self.base_dist.variance
+
+    def entropy(self):
+        ent = self.base_dist.entropy()
+        axes = tuple(range(ent.ndim - self.n_event, ent.ndim))
+        return ent.sum(axis=axes) if axes else ent
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (parity: distributions/divergence.py +
+# utils.py _KL_storage — lookup by (type(p), type(q)) walking the MRO)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    best = None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            rank = (type(p).__mro__.index(pc), type(q).__mro__.index(qc))
+            if best is None or rank < best[0]:
+                best = (rank, fn)
+    if best is None:
+        raise NotImplementedError(
+            f"KL({type(p).__name__} || {type(q).__name__}) not registered")
+    return best[1](p, q)
+
+
+def _binop(fn, *nds):
+    return apply_jax(fn, list(nds))
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    return _binop(
+        lambda l1, s1, l2, s2: jnp.log(s2 / s1)
+        + (s1 ** 2 + (l1 - l2) ** 2) / (2 * s2 ** 2) - 0.5,
+        p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _binop(
+        lambda a1, b1, a2, b2: jnp.where(
+            (a2 <= a1) & (b1 <= b2),
+            jnp.log((b2 - a2) / (b1 - a1)), jnp.inf),
+        p.low, p.high, q.low, q.high)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    return _binop(
+        lambda s1, s2: jnp.log(s2 / s1) + s1 / s2 - 1, p.scale, q.scale)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    return _binop(
+        lambda l1, b1, l2, b2: jnp.log(b2 / b1)
+        + jnp.abs(l1 - l2) / b2
+        + b1 / b2 * jnp.exp(-jnp.abs(l1 - l2) / b1) - 1,
+        p.loc, p.scale, q.loc, q.scale)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _binop(
+        lambda r1, r2: r1 * jnp.log(r1 / r2) - r1 + r2, p.rate, q.rate)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    def fn(a1, s1, a2, s2):
+        return ((a1 - a2) * jsp.digamma(a1) - jsp.gammaln(a1)
+                + jsp.gammaln(a2) + a2 * jnp.log(s2) - a2 * jnp.log(s1)
+                + a1 * (s1 / s2 - 1))
+    return _binop(fn, p.shape_param, p.scale, q.shape_param, q.scale)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    def fn(a1, b1, a2, b2):
+        t1 = jsp.betaln(a2, b2) - jsp.betaln(a1, b1)
+        return (t1 + (a1 - a2) * jsp.digamma(a1)
+                + (b1 - b2) * jsp.digamma(b1)
+                + (a2 - a1 + b2 - b1) * jsp.digamma(a1 + b1))
+    return _binop(fn, p.alpha, p.beta, q.alpha, q.beta)
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    def fn(a1, a2):
+        s1 = jnp.sum(a1, -1)
+        return (jsp.gammaln(s1) - jnp.sum(jsp.gammaln(a1), -1)
+                - jsp.gammaln(jnp.sum(a2, -1))
+                + jnp.sum(jsp.gammaln(a2), -1)
+                + jnp.sum((a1 - a2) * (jsp.digamma(a1)
+                                       - jsp.digamma(s1)[..., None]), -1))
+    return _binop(fn, p.alpha, q.alpha)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    def pf(d):
+        if d.prob is not None:
+            return d.prob, lambda x: x
+        return d.logit, lambda x: jax.nn.sigmoid(x)
+    (pp, f1), (qp, f2) = pf(p), pf(q)
+    def fn(x1, x2):
+        p1, p2 = f1(x1), f2(x2)
+        return (p1 * (jnp.log(p1) - jnp.log(p2))
+                + (1 - p1) * (jnp.log1p(-p1) - jnp.log1p(-p2)))
+    return _binop(fn, pp, qp)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    def pf(d):
+        if d.prob is not None:
+            return d.prob, lambda x: x
+        return d.logit, lambda x: jax.nn.sigmoid(x)
+    (pp, f1), (qp, f2) = pf(p), pf(q)
+    def fn(x1, x2):
+        p1, p2 = f1(x1), f2(x2)
+        return (-(-((1 - p1) * jnp.log1p(-p1) + p1 * jnp.log(p1)) / p1)
+                - (jnp.log1p(-p2) * (1 - p1) / p1) - jnp.log(p2))
+    return _binop(fn, pp, qp)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    def lf(d):
+        if d.logit is not None:
+            return d.logit, lambda x: jax.nn.log_softmax(x, -1)
+        return d.prob, lambda x: jnp.log(x / jnp.sum(x, -1, keepdims=True))
+    (pp, f1), (qp, f2) = lf(p), lf(q)
+    def fn(x1, x2):
+        lp, lq = f1(x1), f2(x2)
+        return jnp.sum(jnp.exp(lp) * (lp - lq), -1)
+    return _binop(fn, pp, qp)
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    pt, qt = p._tril(), q._tril()
+    def fn(l1, m1, l2, m2):
+        L1, L2 = pt(l1, m1), qt(l2, m2)
+        k = l1.shape[-1]
+        M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+        tr = jnp.sum(M ** 2, axis=(-2, -1))
+        d = l2 - l1
+        z = jax.scipy.linalg.solve_triangular(
+            L2, d[..., None], lower=True)[..., 0]
+        maha = jnp.sum(z ** 2, -1)
+        logdet = (jnp.sum(jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)), -1)
+                  - jnp.sum(jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)), -1))
+        return 0.5 * (tr + maha - k) + logdet
+    return _binop(fn, p.loc, p._params()[1], q.loc, q._params()[1])
+
+
+@register_kl(HalfNormal, HalfNormal)
+def _kl_half_normal(p, q):
+    # densities are 2·N(0,s) on x>=0: the 2s cancel, same form as
+    # zero-mean Normal KL
+    return _binop(
+        lambda s1, s2: jnp.log(s2 / s1) + s1 ** 2 / (2 * s2 ** 2) - 0.5,
+        p.scale, q.scale)
+
+
+@register_kl(HalfCauchy, HalfCauchy)
+def _kl_half_cauchy(p, q):
+    # KL(Cauchy(0,g1)||Cauchy(0,g2)) = log((g1+g2)^2/(4 g1 g2)); the
+    # half-distribution factors of 2 cancel
+    return _binop(
+        lambda g1, g2: jnp.log((g1 + g2) ** 2 / (4 * g1 * g2)),
+        p.scale, q.scale)

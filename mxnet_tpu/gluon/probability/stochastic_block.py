@@ -1,0 +1,81 @@
+"""StochasticBlock — blocks that accumulate auxiliary (e.g. KL) losses.
+
+Parity: python/mxnet/gluon/probability/block/stochastic_block.py
+(`StochasticBlock.collectLoss` decorator, `add_loss`, `.losses`;
+`StochasticSequential`).
+"""
+from __future__ import annotations
+
+import functools
+
+from ..block import HybridBlock
+
+__all__ = ["StochasticBlock", "StochasticSequential"]
+
+
+class StochasticBlock(HybridBlock):
+    """HybridBlock whose forward may emit intermediate losses via
+    ``self.add_loss``; decorate forward with ``StochasticBlock.collectLoss``
+    and read ``block.losses`` after calling."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._losses = []
+        self._losscache = []
+        self._flag = False
+
+    def add_loss(self, loss):
+        self._losscache.append(loss)
+
+    @staticmethod
+    def collectLoss(func):
+        @functools.wraps(func)
+        def inner(self, *args, **kwargs):
+            self._losscache = []
+            out = func(self, *args, **kwargs)
+            self._losses = list(self._losscache)
+            self._losscache = []
+            self._flag = True
+            return out
+        return inner
+
+    def __call__(self, *args, **kwargs):
+        self._flag = False
+        out = super().__call__(*args, **kwargs)
+        if not self._flag:
+            # forward not decorated: no aux losses this call
+            self._losses = []
+        return out
+
+    @property
+    def losses(self):
+        return self._losses
+
+
+class StochasticSequential(StochasticBlock):
+    """Sequential container aggregating child losses (parity:
+    StochasticSequential)."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._layers = []
+
+    def add(self, *blocks):
+        for b in blocks:
+            self._layers.append(b)
+            self.register_child(b)
+
+    @StochasticBlock.collectLoss
+    def forward(self, x, *args):
+        for block in self._layers:
+            x = block(x)
+            if isinstance(block, StochasticBlock):
+                for l in block.losses:
+                    self.add_loss(l)
+        return x
+
+    def __getitem__(self, i):
+        return self._layers[i]
+
+    def __len__(self):
+        return len(self._layers)

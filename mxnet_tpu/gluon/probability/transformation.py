@@ -1,0 +1,274 @@
+"""Bijective transformations + TransformedDistribution.
+
+Parity: python/mxnet/gluon/probability/transformation/transformation.py
+(Transformation, ExpTransform, AffineTransform, PowerTransform,
+SigmoidTransform, SoftmaxTransform, AbsTransform, ComposeTransform) and
+distributions/transformed_distribution.py.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...ndarray import NDArray
+from ...ops.registry import apply_jax
+from .distributions import Distribution, _nd
+
+__all__ = ["Transformation", "ExpTransform", "LogTransform",
+           "AffineTransform", "PowerTransform", "SigmoidTransform",
+           "SoftmaxTransform", "AbsTransform", "ComposeTransform",
+           "TransformedDistribution"]
+
+
+def _op(fn, *nds):
+    return apply_jax(fn, [_nd(x) for x in nds])
+
+
+class Transformation:
+    """y = T(x), with inverse and log|dy/dx| (parity: Transformation)."""
+
+    bijective = True
+    event_dim = 0
+
+    @property
+    def sign(self):
+        """+1 for monotone increasing, -1 for decreasing (may be an
+        NDArray for elementwise-signed transforms like AffineTransform
+        with array scale)."""
+        return 1
+
+    def __call__(self, x):
+        return self._forward_compute(x)
+
+    def _forward_compute(self, x):
+        raise NotImplementedError
+
+    def _inverse_compute(self, y):
+        raise NotImplementedError
+
+    @property
+    def inv(self):
+        return _InverseTransformation(self)
+
+    def log_det_jacobian(self, x, y):
+        raise NotImplementedError
+
+
+class _InverseTransformation(Transformation):
+    def __init__(self, base):
+        self._base = base
+        self.event_dim = base.event_dim
+
+    def _forward_compute(self, y):
+        return self._base._inverse_compute(y)
+
+    def _inverse_compute(self, x):
+        return self._base._forward_compute(x)
+
+    @property
+    def inv(self):
+        return self._base
+
+    @property
+    def sign(self):
+        return self._base.sign
+
+    def log_det_jacobian(self, y, x):
+        return -self._base.log_det_jacobian(x, y)
+
+
+class ExpTransform(Transformation):
+    def _forward_compute(self, x):
+        return _op(jnp.exp, x)
+
+    def _inverse_compute(self, y):
+        return _op(jnp.log, y)
+
+    def log_det_jacobian(self, x, y):
+        return _nd(x)
+
+
+class LogTransform(Transformation):
+    def _forward_compute(self, x):
+        return _op(jnp.log, x)
+
+    def _inverse_compute(self, y):
+        return _op(jnp.exp, y)
+
+    def log_det_jacobian(self, x, y):
+        return _op(lambda v: -jnp.log(v), x)
+
+
+class AffineTransform(Transformation):
+    def __init__(self, loc=0.0, scale=1.0, event_dim=0):
+        self.loc, self.scale = loc, scale
+        self.event_dim = event_dim
+
+    @property
+    def sign(self):
+        if isinstance(self.scale, (int, float)):
+            return 1 if self.scale >= 0 else -1
+        return _op(jnp.sign, self.scale)
+
+    def _forward_compute(self, x):
+        return _op(lambda l, s, v: l + s * v, self.loc, self.scale, x)
+
+    def _inverse_compute(self, y):
+        return _op(lambda l, s, v: (v - l) / s, self.loc, self.scale, y)
+
+    def log_det_jacobian(self, x, y):
+        def fn(l, s, v):
+            out = jnp.broadcast_to(jnp.log(jnp.abs(s)), jnp.shape(v))
+            if self.event_dim:
+                out = jnp.sum(
+                    out, axis=tuple(range(-self.event_dim, 0)))
+            return out
+        return _op(fn, self.loc, self.scale, x)
+
+
+class PowerTransform(Transformation):
+    """x^e on the positive half-line — monotone increasing for e > 0."""
+
+    def __init__(self, exponent=1.0):
+        self.exponent = exponent
+
+    @property
+    def sign(self):
+        if isinstance(self.exponent, (int, float)):
+            return 1 if self.exponent >= 0 else -1
+        return _op(jnp.sign, self.exponent)
+
+    def _forward_compute(self, x):
+        return _op(lambda e, v: v ** e, self.exponent, x)
+
+    def _inverse_compute(self, y):
+        return _op(lambda e, v: v ** (1 / e), self.exponent, y)
+
+    def log_det_jacobian(self, x, y):
+        return _op(lambda e, xv, yv: jnp.log(jnp.abs(e * yv / xv)),
+                   self.exponent, x, y)
+
+
+class SigmoidTransform(Transformation):
+    def _forward_compute(self, x):
+        return _op(jax.nn.sigmoid, x)
+
+    def _inverse_compute(self, y):
+        return _op(lambda v: jnp.log(v) - jnp.log1p(-v), y)
+
+    def log_det_jacobian(self, x, y):
+        return _op(
+            lambda v: -jax.nn.softplus(v) - jax.nn.softplus(-v), x)
+
+
+class SoftmaxTransform(Transformation):
+    bijective = False
+    event_dim = 1
+
+    def _forward_compute(self, x):
+        return _op(lambda v: jax.nn.softmax(v, axis=-1), x)
+
+    def _inverse_compute(self, y):
+        return _op(jnp.log, y)
+
+
+class AbsTransform(Transformation):
+    bijective = False
+
+    def _forward_compute(self, x):
+        return _op(jnp.abs, x)
+
+    def _inverse_compute(self, y):
+        return y
+
+
+class ComposeTransform(Transformation):
+    def __init__(self, parts):
+        self._parts = list(parts)
+        self.event_dim = max((p.event_dim for p in self._parts), default=0)
+
+    @property
+    def sign(self):
+        s = 1
+        for p in self._parts:
+            s = s * p.sign
+        return s
+
+    def _forward_compute(self, x):
+        for p in self._parts:
+            x = p(x)
+        return x
+
+    def _inverse_compute(self, y):
+        for p in reversed(self._parts):
+            y = p._inverse_compute(y)
+        return y
+
+    def log_det_jacobian(self, x, y):
+        total = None
+        cur = x
+        for p in self._parts:
+            nxt = p(cur)
+            term = p.log_det_jacobian(cur, nxt)
+            # reduce to the compose's batch ndim
+            extra = self.event_dim - p.event_dim
+            if extra > 0:
+                term = term.sum(axis=tuple(range(-extra, 0)))
+            total = term if total is None else total + term
+            cur = nxt
+        return total
+
+
+class TransformedDistribution(Distribution):
+    """Distribution of T(X) for X ~ base (parity:
+    transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base_dist = base
+        if isinstance(transforms, Transformation):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+        Distribution.__init__(self)
+        self.batch_shape = base.batch_shape
+        self.event_shape = base.event_shape
+
+    def sample(self, size=None):
+        x = self.base_dist.sample(size)
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+    def log_prob(self, value):
+        event_dim = max([t.event_dim for t in self.transforms]
+                        + [self.base_dist.event_dim])
+        y = _nd(value)
+        lp = None
+        for t in reversed(self.transforms):
+            x = t._inverse_compute(y)
+            term = t.log_det_jacobian(x, y)
+            extra = event_dim - t.event_dim
+            if extra > 0:
+                term = term.sum(axis=tuple(range(-extra, 0)))
+            lp = (-term) if lp is None else lp - term
+            y = x
+        base_lp = self.base_dist.log_prob(y)
+        extra = event_dim - self.base_dist.event_dim
+        if extra > 0:
+            base_lp = base_lp.sum(axis=tuple(range(-extra, 0)))
+        return base_lp if lp is None else base_lp + lp
+
+    def cdf(self, value):
+        y = _nd(value)
+        sign = 1
+        for t in reversed(self.transforms):
+            if not t.bijective:
+                raise NotImplementedError("cdf of non-bijective transform")
+            sign = sign * t.sign
+            y = t._inverse_compute(y)
+        base = self.base_dist.cdf(y)
+        if isinstance(sign, (int, float)):
+            return base if sign > 0 else 1 - base
+        # elementwise orientation: F = (1-s)/2 + s*F_base
+        return (1 - sign) * 0.5 + sign * base

@@ -24,3 +24,9 @@ def zeros_like(a):  # noqa: F811 — registry version takes NDArray only too
 def ones_like(a):
     from ..ops.registry import invoke
     return invoke("ones_like", [a])
+
+
+def Custom(*inputs, op_type, **kwargs):
+    """Invoke a Python CustomOp (parity: mx.nd.Custom, operator.py)."""
+    from ..operator import Custom as _custom
+    return _custom(*inputs, op_type=op_type, **kwargs)

@@ -31,7 +31,8 @@ from .. import autograd as ag
 from ..ops.registry import apply_jax, CaptureScope
 from .ndarray import NDArray
 
-__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf"]
+__all__ = ["foreach", "while_loop", "cond", "isfinite", "isnan", "isinf",
+           "boolean_mask"]
 
 
 def _as_list(x) -> Tuple[List[Any], bool]:
@@ -234,6 +235,25 @@ def isinf(data):
 # Every op registered as ``_contrib_<Name>`` surfaces here as
 # ``mx.nd.contrib.<Name>`` — the analogue of the reference's codegen of
 # the contrib namespace (python/mxnet/ndarray/register.py).
+
+def boolean_mask(data, index, axis: int = 0):
+    """Select rows of ``data`` where ``index`` is nonzero (parity:
+    src/operator/contrib/boolean_mask.cc, with backward).
+
+    The mask is read eagerly (dynamic output shape, like the reference's
+    FComputeEx dense op); the recorded computation is a static gather,
+    so gradients flow to ``data`` (scatter-add via the gather VJP).
+    """
+    import numpy as _onp
+    import jax.numpy as _jnp
+    from ..ops.registry import apply_jax as _apply
+
+    data = _nd(data)
+    idx = _onp.asarray(_nd(index).asnumpy()).astype(bool)
+    sel = _jnp.asarray(_onp.nonzero(idx)[0], _jnp.int32)
+    ax = axis
+    return _apply(lambda d: _jnp.take(d, sel, axis=ax), [data])
+
 
 def _populate_contrib():
     from ..ops import registry as _reg

@@ -1,0 +1,178 @@
+"""Python-defined custom operators.
+
+Parity: python/mxnet/operator.py — ``CustomOp`` (:434), ``CustomOpProp``
+(:487), ``register`` (:710), invoked as ``mx.nd.Custom(..., op_type=...)``.
+The reference executes these on a dedicated C++ worker thread pool that
+calls back into Python (src/operator/custom/custom-inl.h:52,223); here
+the TPU-native analogue is ``jax.pure_callback`` — the op body runs on
+the host, outside the XLA program, with inferred static output shapes so
+a Custom op is usable both eagerly and inside a jit-traced CachedOp.
+Gradients plug into autograd via ``jax.custom_vjp`` exactly like
+``mx.autograd.Function``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Type
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, np_dtype
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_prop", "Custom"]
+
+
+class CustomOp:
+    """Base class for user ops (parity: operator.py:434 CustomOp).
+
+    Implement ``forward(is_train, req, in_data, out_data, aux)`` and
+    ``backward(req, out_grad, in_data, out_data, in_grad, aux)``; write
+    results with ``self.assign``.
+    """
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    def assign(self, dst, req, src):
+        """Honor OpReqType (parity: kWriteTo/kAddTo/kNullOp,
+        include/mxnet/op_attr_types.h:46-58)."""
+        if req == "null":
+            return
+        if req in ("write", "inplace"):
+            dst[:] = src
+        elif req == "add":
+            dst[:] = dst + src
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Op metadata: arguments, outputs, shape/type inference (parity:
+    operator.py:487 CustomOpProp)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+_PROPS: Dict[str, Type[CustomOpProp]] = {}
+
+
+def register(reg_name: str):
+    """Decorator registering a CustomOpProp subclass under ``op_type``
+    (parity: operator.py:710 register)."""
+
+    def deco(prop_cls: Type[CustomOpProp]):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _PROPS[reg_name] = prop_cls
+        return prop_cls
+
+    return deco
+
+
+def get_prop(name: str) -> Type[CustomOpProp]:
+    if name not in _PROPS:
+        raise MXNetError(
+            f"custom op {name!r} not registered; known: {sorted(_PROPS)}")
+    return _PROPS[name]
+
+
+def _as_numpy_nd(arrays):
+    """Wrap host numpy arrays as NDArrays for the user's op body."""
+    from .ndarray import NDArray
+    return [NDArray(onp.asarray(a)) for a in arrays]
+
+
+def Custom(*inputs, op_type: str, **kwargs):
+    """Invoke a registered custom op (parity: mx.nd.Custom).
+
+    Works eagerly and inside jit tracing: the op body runs host-side via
+    ``jax.pure_callback`` with shapes fixed by ``infer_shape``.
+    """
+    from . import autograd
+    from .ndarray import NDArray
+    from .ops.registry import apply_jax
+
+    prop = get_prop(op_type)(**kwargs)
+    n_out = len(prop.list_outputs())
+    in_shapes = [tuple(x.shape) for x in inputs]
+    _, out_shapes, _ = prop.infer_shape(in_shapes)
+    in_types = [x.dtype for x in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    op = prop.create_operator(None, in_shapes, in_types)
+    multi = n_out > 1
+    out_spec = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np_dtype(t))
+        for s, t in zip(out_shapes, out_types))
+    in_spec = tuple(
+        jax.ShapeDtypeStruct(tuple(s), np_dtype(t))
+        for s, t in zip(in_shapes, in_types))
+    is_train = autograd.is_training() or autograd.is_recording()
+
+    def host_forward(*arrays):
+        in_nd = _as_numpy_nd(arrays)
+        out_nd = _as_numpy_nd(
+            onp.zeros(s, np_dtype(t))
+            for s, t in zip(out_shapes, out_types))
+        op.forward(is_train, ["write"] * n_out, in_nd, out_nd, [])
+        return tuple(o.asnumpy().astype(np_dtype(t), copy=False)
+                     for o, t in zip(out_nd, out_types))
+
+    def host_backward(*arrays):
+        grads = _as_numpy_nd(arrays[:n_out])
+        ins = _as_numpy_nd(arrays[n_out:n_out + len(inputs)])
+        outs = _as_numpy_nd(arrays[n_out + len(inputs):])
+        in_grad = _as_numpy_nd(
+            onp.zeros(s.shape, s.dtype) for s in in_spec)
+        op.backward(["write"] * len(in_grad), grads, ins, outs, in_grad, [])
+        return tuple(g.asnumpy().astype(s.dtype, copy=False)
+                     for g, s in zip(in_grad, in_spec))
+
+    @jax.custom_vjp
+    def fn(*arrays):
+        res = jax.pure_callback(host_forward, out_spec, *arrays)
+        return tuple(res) if multi else res[0]
+
+    def fn_fwd(*arrays):
+        res = jax.pure_callback(host_forward, out_spec, *arrays)
+        return (tuple(res) if multi else res[0]), (arrays, tuple(res))
+
+    def fn_bwd(saved, cts, ):
+        arrays, outs = saved
+        cts_t = tuple(cts) if multi else (cts,)
+        gin = jax.pure_callback(host_backward, in_spec,
+                                *(cts_t + arrays + outs))
+        return tuple(gin)
+
+    fn.defvjp(fn_fwd, fn_bwd)
+    return apply_jax(fn, list(inputs), multi_out=multi)

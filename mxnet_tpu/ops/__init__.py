@@ -13,5 +13,6 @@ from . import random  # noqa: F401
 from . import optimizer_ops  # noqa: F401
 from . import attention  # noqa: F401
 from . import vision  # noqa: F401
+from . import quantization  # noqa: F401
 
 __all__ = ["register", "get", "list_ops", "invoke", "apply_jax"]

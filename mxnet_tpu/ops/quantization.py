@@ -1,0 +1,273 @@
+"""INT8 quantization op family.
+
+Parity: src/operator/quantization/ — quantize (quantize.cc),
+quantize_v2 (quantize_v2.cc), dequantize (dequantize.cc), requantize
+(requantize.cc semantics via quantization_utils.h), quantized_conv
+(quantized_conv.cc), quantized_fully_connected
+(quantized_fully_connected.cc), quantized_pooling
+(quantized_pooling.cc), quantized_flatten (quantized_flatten.cc),
+quantized_elemwise_add (quantized_elemwise_add.cc), quantized_concat
+(quantized_concat.cc), calibration histogram/KL (calibrate.cc).
+
+TPU-first: int8 tensors ride the MXU via ``lax.dot_general`` /
+``lax.conv_general_dilated`` with ``preferred_element_type=int32`` —
+the exact analogue of the reference's cuDNN/MKLDNN int8 kernels with
+int32 accumulation.  Ranges are carried as separate min/max arrays
+exactly like the reference's 3-output convention (out, min, max).
+"""
+from __future__ import annotations
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+INT8_MIN, INT8_MAX = -127.0, 127.0   # symmetric, matches reference int8
+INT32_RANGE = 2147483647.0
+
+
+def _q_scale(mn, mx):
+    """float range -> int8 scale (symmetric; quantization_utils.h
+    FloatToQuantized semantics)."""
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return jnp.where(amax > 0, INT8_MAX / amax, 1.0)
+
+
+@register("_contrib_quantize", multi_out=True)
+def _quantize(data, min_range, max_range, *, out_type="int8"):
+    """float → int8 with given range; returns (q, min, max)."""
+    scale = _q_scale(min_range, max_range)
+    q = jnp.clip(jnp.rint(data * scale), INT8_MIN, INT8_MAX).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return q, -amax, amax
+
+
+@register("_contrib_quantize_v2", multi_out=True)
+def _quantize_v2(data, *, out_type="int8", min_calib_range=None,
+                 max_calib_range=None):
+    """float → int8; range from calibration params or the data itself
+    (quantize_v2.cc)."""
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, data.dtype)
+        mx = jnp.asarray(max_calib_range, data.dtype)
+    else:
+        mn = jnp.min(data)
+        mx = jnp.max(data)
+    scale = _q_scale(mn, mx)
+    q = jnp.clip(jnp.rint(data * scale), INT8_MIN, INT8_MAX).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return q, -amax, amax
+
+
+@register("_contrib_dequantize")
+def _dequantize(data, min_range, max_range, *, out_type="float32"):
+    amax = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (amax / INT8_MAX)
+
+
+@register("_contrib_requantize", multi_out=True)
+def _requantize(data, min_range, max_range, *, min_calib_range=None,
+                max_calib_range=None):
+    """int32 → int8 (requantize.cc): rescale accumulator into int8."""
+    real = data.astype(jnp.float32) * (
+        jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / INT32_RANGE)
+    if min_calib_range is not None and max_calib_range is not None:
+        mn = jnp.asarray(min_calib_range, jnp.float32)
+        mx = jnp.asarray(max_calib_range, jnp.float32)
+    else:
+        mn = jnp.min(real)
+        mx = jnp.max(real)
+    scale = _q_scale(mn, mx)
+    q = jnp.clip(jnp.rint(real * scale), INT8_MIN, INT8_MAX).astype(jnp.int8)
+    amax = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return q, -amax, amax
+
+
+@register("_contrib_quantized_fully_connected", multi_out=True)
+def _quantized_fc(data, weight, dmin, dmax, wmin, wmax, bias=None,
+                  bmin=None, bmax=None, *,
+                  num_hidden, no_bias=False, flatten=True):
+    """int8 FC with int32 accumulation (quantized_fully_connected.cc).
+
+    Bias inputs trail so a no-bias call simply omits them (invoke()
+    drops None inputs)."""
+    x = data.reshape(data.shape[0], -1) if flatten else data
+    acc = lax.dot_general(
+        x, weight, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    ds = _q_scale(dmin, dmax)
+    ws = _q_scale(wmin, wmax)
+    out = acc.astype(jnp.float32) / (ds * ws)
+    if not no_bias:
+        bs = _q_scale(bmin, bmax)
+        out = out + bias.astype(jnp.float32) / bs
+    return out, jnp.min(out), jnp.max(out)
+
+
+@register("_contrib_quantized_conv", multi_out=True)
+def _quantized_conv(data, weight, dmin, dmax, wmin, wmax, bias=None,
+                    bmin=None, bmax=None, *,
+                    kernel, num_filter, stride=(1, 1), pad=(0, 0),
+                    dilate=(1, 1), num_group=1, no_bias=False, layout="NCHW"):
+    """int8 conv, int32 accumulation (quantized_conv.cc)."""
+    from .nn import _conv_dnums
+    n = len(kernel)
+    dnums = _conv_dnums(n, layout)
+    acc = lax.conv_general_dilated(
+        data.astype(jnp.int8), weight.astype(jnp.int8),
+        window_strides=tuple(stride),
+        padding=[(p, p) for p in pad],
+        rhs_dilation=tuple(dilate),
+        feature_group_count=num_group,
+        dimension_numbers=dnums,
+        preferred_element_type=jnp.int32)
+    ds = _q_scale(dmin, dmax)
+    ws = _q_scale(wmin, wmax)
+    out = acc.astype(jnp.float32) / (ds * ws)
+    if not no_bias:
+        bs = _q_scale(bmin, bmax)
+        b = bias.astype(jnp.float32) / bs
+        out = out + (b if dnums[2].endswith("C")
+                     else b.reshape((1, -1) + (1,) * n))
+    return out, jnp.min(out), jnp.max(out)
+
+
+@register("_contrib_quantized_pooling", multi_out=True)
+def _quantized_pooling(data, mn, mx, *, kernel, pool_type="max",
+                       stride=None, pad=None, global_pool=False):
+    """int8 pooling passes ranges through (quantized_pooling.cc)."""
+    k = kernel if isinstance(kernel, (tuple, list)) else (kernel, kernel)
+    stride = stride or k
+    pad = pad or (0, 0)
+    x = data.astype(jnp.int32)
+    if global_pool:
+        k = data.shape[2:]
+        stride = (1, 1)
+        pad = (0, 0)
+    dims = (1, 1) + tuple(k)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if pool_type == "max":
+        out = lax.reduce_window(x, -(2 ** 31), lax.max, dims, strides, pads)
+        out = out.astype(jnp.int8)
+    else:
+        s = lax.reduce_window(x, 0, lax.add, dims, strides, pads)
+        cnt = k[0] * k[1]
+        out = jnp.clip(jnp.rint(s / cnt), INT8_MIN, INT8_MAX).astype(jnp.int8)
+    return out, mn, mx
+
+
+@register("_contrib_quantized_flatten", multi_out=True)
+def _quantized_flatten(data, mn, mx):
+    return data.reshape(data.shape[0], -1), mn, mx
+
+
+@register("_contrib_quantized_elemwise_add", multi_out=True)
+def _quantized_elemwise_add(a, b, amin, amax, bmin, bmax):
+    """int8 + int8 → float-rescaled int8 sum (quantized_elemwise_add.cc)."""
+    asc = _q_scale(amin, amax)
+    bsc = _q_scale(bmin, bmax)
+    real = a.astype(jnp.float32) / asc + b.astype(jnp.float32) / bsc
+    mn, mx = jnp.min(real), jnp.max(real)
+    scale = _q_scale(mn, mx)
+    q = jnp.clip(jnp.rint(real * scale), INT8_MIN, INT8_MAX).astype(jnp.int8)
+    amax2 = jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+    return q, -amax2, amax2
+
+
+@register("_contrib_quantized_concat", multi_out=True)
+def _quantized_concat(*args, dim=1, num_args=None):
+    """Concat int8 inputs, unifying ranges (quantized_concat.cc).
+
+    args = (d0, d1, ..., min0, max0, min1, max1, ...)."""
+    n = num_args if num_args is not None else len(args) // 3
+    datas = args[:n]
+    mins = args[n::2]
+    maxs = args[n + 1::2]
+    amax = jnp.stack([jnp.maximum(jnp.abs(mn), jnp.abs(mx))
+                      for mn, mx in zip(mins, maxs)]).max()
+    outs = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        sc = jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / amax
+        outs.append(jnp.clip(jnp.rint(d.astype(jnp.float32) * sc),
+                             INT8_MIN, INT8_MAX).astype(jnp.int8))
+    return jnp.concatenate(outs, axis=dim), -amax, amax
+
+
+# ---------------------------------------------------------------------------
+# calibration (parity: calibrate.cc — min/max and KL-divergence/entropy)
+# ---------------------------------------------------------------------------
+
+def calibrate_minmax(samples):
+    """Min/max calibration over a list of host arrays."""
+    mn = min(float(onp.min(s)) for s in samples)
+    mx = max(float(onp.max(s)) for s in samples)
+    return mn, mx
+
+
+def _smooth_distribution(p, eps=1e-4):
+    """calibrate.cc SmoothDistribution: move eps onto zero entries."""
+    is_zero = p == 0
+    n_zeros = int(is_zero.sum())
+    n_nonzeros = p.size - n_zeros
+    if n_nonzeros == 0:
+        return None
+    eps1 = eps * n_zeros / n_nonzeros
+    if eps1 >= 1.0:
+        return None
+    out = p.astype(onp.float64).copy()
+    out[is_zero] += eps
+    out[~is_zero] -= eps1
+    return out
+
+
+def calibrate_entropy(samples, num_bins=2001, num_quantized_bins=255):
+    """KL-divergence threshold search — a faithful re-expression of
+    calibrate.cc CalibrateComputeCPU: symmetric histogram around zero,
+    clipped mass folded into p's edge bins (but NOT into q), both
+    distributions eps-smoothed before KL."""
+    arr = onp.concatenate([onp.asarray(s).ravel() for s in samples])
+    arr = arr[onp.isfinite(arr)]
+    amax = float(onp.abs(arr).max()) if arr.size else 1.0
+    if amax == 0:
+        return -1e-8, 1e-8
+    hist, edges = onp.histogram(arr, bins=num_bins, range=(-amax, amax))
+    hist = hist.astype(onp.float64)
+    zero_idx = num_bins // 2
+    nhq = num_quantized_bins // 2
+    best_div, best_t = None, amax
+    for i in range(nhq, zero_idx + 1):
+        start = zero_idx - i
+        stop = zero_idx + i + 1
+        t = float(edges[stop])
+        size = stop - start
+        sliced = onp.zeros(size)
+        sliced[1:] = hist[start + 1:stop]
+        p = sliced.copy()
+        p[0] = hist[:start + 1].sum()
+        p[-1] = hist[stop - 1:].sum()
+        # merge sliced into num_quantized_bins, expand back as q
+        nm = size // num_quantized_bins
+        q = onp.zeros(size)
+        lim = num_quantized_bins * nm
+        merged = sliced[:lim].reshape(num_quantized_bins, nm).sum(axis=1)
+        merged[-1] += sliced[lim:].sum()
+        for j in range(num_quantized_bins):
+            s0 = j * nm
+            s1 = size if j == num_quantized_bins - 1 else (j + 1) * nm
+            seg = sliced[s0:s1]
+            norm = int((seg != 0).sum())
+            if norm:
+                q[s0:s1] = onp.where(p[s0:s1] != 0, merged[j] / norm, 0.0)
+        ps = _smooth_distribution(p)
+        qs = _smooth_distribution(q)
+        if ps is None or qs is None:
+            continue
+        pn = ps / ps.sum()
+        qn = qs / qs.sum()
+        div = float(onp.sum(pn * onp.log(pn / qn)))
+        if best_div is None or div < best_div:
+            best_div, best_t = div, t
+    return -best_t, best_t

@@ -52,6 +52,10 @@ def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False):
             raise MXNetError(f"op {name!r} registered twice")
         _REGISTRY[name] = op
         for a in aliases:
+            if a in _REGISTRY:
+                raise MXNetError(
+                    f"op alias {a!r} already registered (by "
+                    f"{_REGISTRY[a].name!r})")
             _REGISTRY[a] = op
         return fn
 
@@ -59,6 +63,10 @@ def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False):
 
 
 def alias(existing: str, new: str) -> None:
+    if new in _REGISTRY and _REGISTRY[new] is not _REGISTRY[existing]:
+        raise MXNetError(
+            f"op alias {new!r} already registered (by "
+            f"{_REGISTRY[new].name!r})")
     _REGISTRY[new] = _REGISTRY[existing]
 
 

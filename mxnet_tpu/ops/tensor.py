@@ -499,12 +499,6 @@ def _full_like(a, *, fill_value):
     return jnp.full_like(a, fill_value)
 
 
-@register("arange_like")
-def _arange_like(a, *, start=0.0, step=1.0, axis=None):
-    n = a.size if axis is None else a.shape[axis]
-    return start + step * jnp.arange(n, dtype=a.dtype)
-
-
 @register("shape_array")
 def _shape_array(a):
     return jnp.array(a.shape, dtype=jnp.int64)

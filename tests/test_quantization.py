@@ -1,0 +1,151 @@
+"""INT8 quantization tests.
+
+Parity model: tests/python/quantization/test_quantization.py in the
+reference (quantize/dequantize roundtrip, quantized conv/FC vs fp32
+reference within tolerance, calibration)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu.ops.registry import invoke
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.contrib.quantization import quantize_net
+from mxnet_tpu.ops.quantization import calibrate_minmax, calibrate_entropy
+
+
+def test_quantize_dequantize_roundtrip():
+    rng = onp.random.RandomState(0)
+    x = rng.uniform(-3, 3, (4, 16)).astype("float32")
+    q, mn, mxr = invoke("_contrib_quantize_v2", [nd.array(x)])
+    assert q.asnumpy().dtype == onp.int8
+    back = invoke("_contrib_dequantize", [q, mn, mxr])
+    onp.testing.assert_allclose(back.asnumpy(), x, atol=3.0 / 127 + 1e-6)
+
+
+def test_quantize_with_calib_range():
+    x = onp.array([[-1.0, 0.5, 2.0]], "float32")
+    q, mn, mxr = invoke("_contrib_quantize_v2", [nd.array(x)],
+                        min_calib_range=-2.0, max_calib_range=2.0)
+    onp.testing.assert_allclose(mn.asnumpy(), -2.0)
+    onp.testing.assert_allclose(mxr.asnumpy(), 2.0)
+    onp.testing.assert_allclose(q.asnumpy(), [[-64, 32, 127]])
+
+
+def test_quantized_fc_matches_fp32():
+    rng = onp.random.RandomState(1)
+    x = rng.uniform(-1, 1, (8, 32)).astype("float32")
+    w = rng.uniform(-1, 1, (16, 32)).astype("float32")
+    b = rng.uniform(-1, 1, (16,)).astype("float32")
+    qx, xmn, xmx = invoke("_contrib_quantize_v2", [nd.array(x)])
+    qw, wmn, wmx = invoke("_contrib_quantize_v2", [nd.array(w)])
+    qb, bmn, bmx = invoke("_contrib_quantize_v2", [nd.array(b)])
+    out, omn, omx = invoke(
+        "_contrib_quantized_fully_connected",
+        [qx, qw, xmn, xmx, wmn, wmx, qb, bmn, bmx], num_hidden=16)
+    ref = x @ w.T + b
+    onp.testing.assert_allclose(out.asnumpy(), ref, atol=0.15)
+    assert abs(out.asnumpy() - ref).mean() < 0.02
+
+
+def test_quantized_conv_matches_fp32():
+    rng = onp.random.RandomState(2)
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    w = rng.uniform(-1, 1, (4, 3, 3, 3)).astype("float32")
+    qx, xmn, xmx = invoke("_contrib_quantize_v2", [nd.array(x)])
+    qw, wmn, wmx = invoke("_contrib_quantize_v2", [nd.array(w)])
+    out, _, _ = invoke(
+        "_contrib_quantized_conv",
+        [qx, qw, xmn, xmx, wmn, wmx],
+        kernel=(3, 3), num_filter=4, pad=(1, 1), no_bias=True)
+    ref = invoke("Convolution",
+                 [nd.array(x), nd.array(w), None],
+                 kernel=(3, 3), num_filter=4, pad=(1, 1), no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(), atol=0.3)
+    assert abs(out.asnumpy() - ref.asnumpy()).mean() < 0.05
+
+
+def test_quantized_pooling_and_flatten():
+    rng = onp.random.RandomState(3)
+    x = (rng.uniform(-1, 1, (1, 2, 4, 4)) * 127).astype("int8")
+    mn, mxr = nd.array(onp.array(-1.0, "f4")), nd.array(onp.array(1.0, "f4"))
+    out, omn, omx = invoke("_contrib_quantized_pooling",
+                           [nd.NDArray(x), mn, mxr],
+                           kernel=(2, 2), stride=(2, 2), pool_type="max")
+    ref = x.reshape(1, 2, 2, 2, 2, 2).max(axis=(3, 5))
+    onp.testing.assert_array_equal(out.asnumpy(), ref)
+    fl, _, _ = invoke("_contrib_quantized_flatten", [out, omn, omx])
+    assert fl.shape == (1, 8)
+
+
+def test_requantize():
+    acc = onp.array([2 ** 28, -(2 ** 27)], "int32")
+    q, mn, mxr = invoke("_contrib_requantize",
+                        [nd.NDArray(acc),
+                         nd.array(onp.array(-1.0, "f4")),
+                         nd.array(onp.array(1.0, "f4"))])
+    assert q.asnumpy().dtype == onp.int8
+    assert q.asnumpy()[0] == 127  # largest magnitude maps to 127
+
+
+def test_calibration_modes():
+    rng = onp.random.RandomState(4)
+    samples = [rng.randn(1000).astype("f4") for _ in range(4)]
+    mn, mx_ = calibrate_minmax(samples)
+    assert mn < -2 and mx_ > 2
+    emn, emx = calibrate_entropy(samples)
+    assert 0 < emx <= max(abs(mn), mx_) + 1e-6
+    assert emn == -emx
+
+
+def test_quantize_net_end_to_end():
+    rng = onp.random.RandomState(5)
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.Dense(10))
+    net.initialize(init=mx.initializer.Xavier())
+    x = rng.uniform(-1, 1, (2, 3, 8, 8)).astype("float32")
+    ref = net(nd.array(x)).asnumpy()
+    qnet = quantize_net(net, calib_data=[nd.array(x)], calib_mode="naive")
+    got = qnet(nd.array(x)).asnumpy()
+    # int8 quantization error budget: outputs should agree closely
+    assert abs(got - ref).mean() < 0.05 * (abs(ref).mean() + 1)
+    from mxnet_tpu.contrib.quantization import QuantizedDense, QuantizedConv2D
+    kinds = [type(c) for c in qnet]
+    assert QuantizedConv2D in kinds and QuantizedDense in kinds
+
+
+def test_quantize_net_entropy_mode():
+    rng = onp.random.RandomState(6)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier())
+    x = rng.randn(16, 8).astype("float32")
+    ref = net(nd.array(x)).asnumpy()
+    qnet = quantize_net(net, calib_data=[nd.array(x)], calib_mode="entropy")
+    got = qnet(nd.array(x)).asnumpy()
+    assert abs(got - ref).mean() < 0.1 * (abs(ref).mean() + 1)
+
+
+def test_quantize_net_requires_calib():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(2))
+    net.initialize()
+    with pytest.raises(mx.MXNetError):
+        quantize_net(net)
+
+
+def test_quantize_net_hybridized():
+    rng = onp.random.RandomState(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(6, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    x = rng.uniform(-1, 1, (4, 5)).astype("float32")
+    ref = net(nd.array(x)).asnumpy()   # builds the cached graph
+    qnet = quantize_net(net, calib_data=[nd.array(x)], calib_mode="naive")
+    from mxnet_tpu.contrib.quantization import QuantizedDense
+    assert all(isinstance(c, QuantizedDense) for c in qnet)
+    got = qnet(nd.array(x)).asnumpy()
+    assert not onp.array_equal(got, ref)  # actually re-quantized output
+    assert abs(got - ref).mean() < 0.05 * (abs(ref).mean() + 1)

@@ -311,3 +311,54 @@ def test_count_sketch():
     out = nd.contrib.count_sketch(nd.array(data), nd.array(h), nd.array(s),
                                   out_dim=3).asnumpy()
     assert_almost_equal(out, onp.array([[1., 0., 1.], [1., 0., 1.]]))
+
+
+def test_box_nms_topk_ignores_invalid():
+    # two high-score background rows must not consume topk slots
+    # (reference filters invalid boxes before sorting/topk)
+    data = onp.array([[
+        [0, 0.9, 0.0, 0.0, 0.1, 0.1],
+        [0, 0.8, 0.5, 0.5, 0.6, 0.6],
+        [1, 0.6, 0.2, 0.2, 0.3, 0.3],
+        [1, 0.5, 0.7, 0.7, 0.8, 0.8],
+    ]], onp.float32)
+    out = mx.ops.invoke("_contrib_box_nms", [nd.array(data)],
+                 overlap_thresh=0.5, topk=2, coord_start=2, score_index=1,
+                 id_index=0, background_id=0)
+    got = out.asnumpy()[0]
+    kept = got[got[:, 0] >= 0]
+    assert kept.shape[0] == 2
+    onp.testing.assert_allclose(sorted(kept[:, 1]), [0.5, 0.6])
+
+
+def test_multibox_target_shared_best_anchor():
+    # two gts whose best anchor is the same: greedy must give each gt
+    # its own anchor (reference multibox_target.cc greedy matching)
+    anchors = onp.array([[[0.0, 0.0, 0.4, 0.4],
+                          [0.05, 0.05, 0.45, 0.45],
+                          [0.6, 0.6, 0.9, 0.9]]], onp.float32)
+    # both gt boxes overlap anchor 0 best; anchor 1 second-best
+    label = onp.array([[[0, 0.0, 0.0, 0.38, 0.38],
+                        [1, 0.02, 0.02, 0.42, 0.42]]], onp.float32)
+    cls_pred = onp.zeros((1, 3, 3), onp.float32)
+    lt, lm, ct = mx.ops.invoke("_contrib_MultiBoxTarget",
+                        [nd.array(anchors), nd.array(label),
+                         nd.array(cls_pred)], overlap_threshold=0.95)
+    c = ct.asnumpy()[0]
+    # both class 1 (=gt cls 0 + 1) and class 2 assigned, to distinct anchors
+    assert set(c[:2]) == {1.0, 2.0}, c
+
+
+def test_boolean_mask_backward():
+    from mxnet_tpu import autograd as ag
+    x = nd.array(onp.arange(12, dtype="float32").reshape(4, 3))
+    m = nd.array(onp.array([1, 0, 1, 0], "float32"))
+    x.attach_grad()
+    with ag.record():
+        y = nd.contrib.boolean_mask(x, m)
+        s = (y * 2).sum()
+    s.backward()
+    assert y.shape == (2, 3)
+    expect = onp.zeros((4, 3), "float32")
+    expect[[0, 2]] = 2.0
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect)
