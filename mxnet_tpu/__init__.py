@@ -39,5 +39,11 @@ from .util import is_np_array, set_np, reset_np  # noqa: E402
 from . import runtime  # noqa: E402
 from . import operator  # noqa: E402
 from . import contrib  # noqa: E402
+from . import callback  # noqa: E402
+from . import visualization  # noqa: E402
+from . import library  # noqa: E402
+from . import rtc  # noqa: E402
+from . import subgraph  # noqa: E402
+from .visualization import print_summary, plot_network  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402

@@ -29,12 +29,9 @@ __all__ = ["quantize_net", "QuantizedDense", "QuantizedConv2D"]
 
 
 def _quantize_param(arr):
-    """Per-tensor symmetric int8 quantization of a weight/bias array."""
-    a = arr.asnumpy()
-    mn, mx = float(a.min()), float(a.max())
-    q, qmn, qmx = invoke("_contrib_quantize_v2", [arr],
-                         min_calib_range=mn, max_calib_range=mx)
-    return q, qmn, qmx
+    """Per-tensor symmetric int8 quantization of a weight/bias array
+    (range derived on-device by quantize_v2's data-range fallback)."""
+    return invoke("_contrib_quantize_v2", [arr])
 
 
 class QuantizedDense(HybridBlock):

@@ -140,6 +140,7 @@ class Trainer:
         if self._update_on_kvstore and self._kvstore is not None:
             return  # weights already updated server-side during pushpull
         updater = self._updaters[0]
+        live = []
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue
@@ -147,7 +148,19 @@ class Trainer:
                 if ignore_stale_grad:
                     continue
                 raise MXNetError(f"parameter {param.name} has no gradient")
-            updater(i, param.grad(), param.data())
+            live.append((i, param))
+        agg = getattr(self._optimizer, "aggregate_num", 0)
+        if agg and agg > 1:
+            # fused multi-tensor updates, `aggregate_num` params per
+            # XLA call (parity: reference multi_sgd aggregation)
+            for c in range(0, len(live), agg):
+                chunk = live[c:c + agg]
+                updater.update_multi([i for i, _ in chunk],
+                                     [p.grad() for _, p in chunk],
+                                     [p.data() for _, p in chunk])
+        else:
+            for i, param in live:
+                updater(i, param.grad(), param.data())
 
     # -- optimizer state persistence (parity: save_states/load_states) -----
     def save_states(self, fname):

@@ -11,6 +11,8 @@ from .base import KVStoreBase, TestStore, create
 from .kvstore import KVStore
 from .gradient_compression import GradientCompression
 from . import dist  # registers DistKVStore
+from . import p3store  # registers P3StoreDist
+from .p3store import P3StoreDist
 
 __all__ = ["KVStoreBase", "KVStore", "TestStore", "create",
            "GradientCompression"]

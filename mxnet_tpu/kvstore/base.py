@@ -88,6 +88,9 @@ def create(name: str = "local", **kwargs) -> KVStoreBase:
                 "device", "nccl"):
         klass = _KV_REGISTRY["kvstore"]
         return klass(name)
+    if name in ("p3", "p3store_dist") or name.startswith("p3"):
+        klass = _KV_REGISTRY["p3storedist"]
+        return klass()
     if name.startswith("dist"):
         klass = _KV_REGISTRY["distkvstore"]
         return klass(name)

@@ -1,0 +1,86 @@
+"""Runtime extension-library loading.
+
+Parity: python/mxnet/library.py ``load`` → C++ ``MXLoadLib``
+(include/mxnet/lib_api.h: external ops / partitioners / passes loaded
+from a compiled library at runtime).  The TPU-native extension unit is a
+Python module (ops are pure jax/pallas functions, so "native" custom
+kernels arrive as Pallas code, not a C ABI): ``load(path)`` imports the
+file and calls its ``register_ops(registry)`` hook; loading a compiled
+``.so`` routes through ctypes and expects the C symbol
+``mxnet_tpu_lib_version`` — the same handshake idea as lib_api.h's
+``initialize(int version)``.
+"""
+from __future__ import annotations
+
+import ctypes
+import importlib.util
+import os
+import sys
+
+from .base import MXNetError
+
+__all__ = ["load", "loaded_libraries"]
+
+_LOADED: dict = {}
+
+
+def loaded_libraries():
+    return dict(_LOADED)
+
+
+def load(path, verbose=True):
+    """Load an extension library of custom ops (parity: library.py:load).
+
+    - ``.py`` file: imported; its ``register_ops(registry_module)``
+      function is called with :mod:`mxnet_tpu.ops.registry` so it can
+      ``@register`` ops, which immediately appear in ``mx.nd``/``mx.sym``.
+    - ``.so`` file: opened with ctypes; must export
+      ``int mxnet_tpu_lib_version(void)`` (handshake, parity:
+      lib_api.h initialize()).  Host-side helpers in the library can
+      then be wrapped by an accompanying ``.py``.
+    """
+    if not os.path.exists(path):
+        raise MXNetError(f"library not found: {path}")
+    path = os.path.abspath(path)
+    if path in _LOADED:
+        return _LOADED[path]
+
+    if path.endswith(".py"):
+        name = "mxnet_tpu_ext_" + os.path.basename(path)[:-3]
+        spec = importlib.util.spec_from_file_location(name, path)
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[name] = mod
+        spec.loader.exec_module(mod)
+        if not hasattr(mod, "register_ops"):
+            raise MXNetError(
+                f"{path} is not an mxnet_tpu extension: missing "
+                "register_ops(registry)")
+        from .ops import registry
+        before = set(registry.list_ops())
+        mod.register_ops(registry)
+        new_ops = sorted(set(registry.list_ops()) - before)
+        # regenerate the generated namespaces so the new ops are callable
+        # (mx.np lifts jax.numpy, not the registry, so it is unaffected)
+        from . import ndarray as _nd
+        _nd.populate_namespace(vars(_nd))
+        from . import symbol as _sym
+        from .symbol.register import populate_namespace as _sym_pop
+        _sym_pop(vars(_sym), new_ops)
+        _LOADED[path] = mod
+        if verbose:
+            print(f"loaded library {path}: ops {new_ops}")
+        return mod
+
+    if path.endswith(".so") or path.endswith(".dylib"):
+        lib = ctypes.CDLL(path, ctypes.RTLD_LOCAL)
+        if not hasattr(lib, "mxnet_tpu_lib_version"):
+            raise MXNetError(
+                f"{path} does not export mxnet_tpu_lib_version() "
+                "(see lib_api parity note)")
+        version = lib.mxnet_tpu_lib_version()
+        _LOADED[path] = lib
+        if verbose:
+            print(f"loaded native library {path} (version {version})")
+        return lib
+
+    raise MXNetError(f"unsupported library type: {path}")

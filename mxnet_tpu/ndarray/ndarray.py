@@ -492,7 +492,10 @@ def save(fname: str, data):
     else:
         raise MXNetError("save: data must be NDArray, list, or dict")
     arrays = {n: p.asnumpy() for n, p in zip(names, payload)}
-    onp.savez(fname, **arrays)
+    # write to the exact filename (np.savez appends .npz to bare paths;
+    # the reference's NDArray::Save writes the given name verbatim)
+    with open(fname, "wb") as f:
+        onp.savez(f, **arrays)
 
 
 def load(fname: str):

@@ -239,3 +239,48 @@ def dcasgd_update(weight, grad, prev_weight, *, lr, lamda=0.04, wd=0.0,
     g = _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd)
     comp = g + lamda * g * g * (weight - prev_weight)
     return weight - lr * comp, weight
+
+
+@register("lans_update", multi_out=True)
+def lans_update(weight, grad, mean, var, *, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-6, t=1, wd=0.0, rescale_grad=1.0,
+                clip_gradient=-1.0, lower_bound=-1.0, upper_bound=-1.0):
+    """LANS — Nesterov LAMB with per-layer normalized gradient (parity:
+    src/operator/contrib/multi_lans.cc kernels Step1/Step2)."""
+    g = grad * rescale_grad
+    g = g / jnp.maximum(jnp.linalg.norm(g), 1e-12)
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    mh = m / (1 - beta1 ** t)
+    vh = jnp.sqrt(v / (1 - beta2 ** t)) + epsilon
+    tm = mh / vh + wd * weight
+    tg = g / vh + wd * weight
+    r1 = jnp.linalg.norm(weight)
+    if lower_bound is not None and lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    r2m = jnp.linalg.norm(tm)
+    r2g = jnp.linalg.norm(tg)
+    rm = jnp.where((r1 > 0) & (r2m > 0), r1 / r2m, 1.0) * beta1
+    rg = jnp.where((r1 > 0) & (r2g > 0), r1 / r2g, 1.0) * (1 - beta1)
+    w = weight - lr * rm * tm - lr * rg * tg
+    return w, m, v
+
+
+@register("group_adagrad_update", multi_out=True)
+def group_adagrad_update(weight, grad, history, *, lr, epsilon=1e-5,
+                         rescale_grad=1.0, clip_gradient=-1.0, wd=0.0):
+    """Group AdaGrad — one accumulated scalar per output row (parity:
+    src/operator/contrib/optimizer_op-inl.h GroupAdagradDnsRspKernel:
+    history[row] += mean_j(g[row,j]^2); w -= lr*g/(sqrt(h)+eps))."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    axes = tuple(range(1, g.ndim))
+    h = history + (jnp.mean(jnp.square(g), axis=axes, keepdims=True)
+                   if axes else jnp.square(g))
+    w = weight - lr * g / (jnp.sqrt(h) + epsilon)
+    return w, h

@@ -65,6 +65,35 @@ def _jitted_update_nolr(op_name: str, static_params: Tuple[Tuple[str, Any], ...]
     return jax.jit(step)
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_multi_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...],
+                         shapes: Tuple, n_state: int, uses_lr: bool):
+    """One jitted function applying the update to a whole tensor group —
+    the XLA-native analogue of the reference's multi-tensor kernels."""
+    base_fn = _reg.get(op_name).fn
+    static = dict(static_params)
+    per = 2 + n_state
+
+    def apply_all(lr, wd, flat):
+        outs = []
+        for i in range(0, len(flat), per):
+            kw = dict(static, wd=wd)
+            if uses_lr:
+                kw["lr"] = lr
+            o = base_fn(*flat[i:i + per], **kw)
+            outs.extend(o if isinstance(o, (tuple, list)) else (o,))
+        return tuple(outs)
+
+    if uses_lr:
+        def step(lr, wd, *flat):
+            return apply_all(lr, wd, flat)
+    else:
+        def step(wd, *flat):
+            return apply_all(None, wd, flat)
+
+    return jax.jit(step)
+
+
 class Optimizer:
     """Base optimizer (parity: optimizer.py Optimizer).
 
@@ -160,10 +189,11 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         """Apply one update (parity: Optimizer.update).  Mutates weight and
         state NDArrays by rebinding their buffers."""
+        # static_params reads the pre-bump count (t = count+1 = this step)
+        params = dict(self.static_params(index))
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         arrays = [weight._data, grad._data] + [s._data for s in state]
-        params = dict(self.static_params(index))
         params.setdefault("rescale_grad", float(self.rescale_grad))
         params.setdefault(
             "clip_gradient",
@@ -188,6 +218,59 @@ class Optimizer:
             weight._rebind(master._data.astype(weight._data.dtype))
         else:
             self.update(index, weight, grad, state)
+
+    def update_multi(self, indices, weights, grads, states):
+        """Aggregated multi-tensor update: one XLA executable updates a
+        whole group of parameters (parity: the reference's fused
+        multi_sgd_update/multi_lamb aggregation, optimizer_op.cc:313,
+        multi_lamb.cc; enabled via ``aggregate_num``).
+
+        Falls back to per-tensor updates when per-index lr/wd or static
+        params diverge (lr_mult/wd_mult users)."""
+        if type(self).update is not Optimizer.update or (
+                self.multi_precision
+                and any(w.dtype == onp.float16 for w in weights)):
+            # subclass customizes the scalar path (e.g. Adam folds bias
+            # correction into lr) or fp16 master-weight handling is
+            # needed: keep numerics identical, skip fusion
+            for i, w, g, s in zip(indices, weights, grads, states):
+                self.update_multi_precision(i, w, g, s)
+            return
+        keys = {tuple(sorted(self.static_params(i).items()))
+                for i in indices}
+        lrwds = [(self._get_lr(i), self._get_wd(i)) for i in indices]
+        if len(keys) != 1 or len(set(lrwds)) != 1:
+            for i, w, g, s in zip(indices, weights, grads, states):
+                self.update(i, w, g, s)
+            return
+        for i in indices:
+            self._update_count(i)
+        # recompute post-bump so lr_scheduler sees the same num_update as
+        # the per-tensor path
+        lr, wd = self._get_lr(indices[0]), self._get_wd(indices[0])
+        params = dict(keys.pop())
+        params.setdefault("rescale_grad", float(self.rescale_grad))
+        params.setdefault(
+            "clip_gradient",
+            float(self.clip_gradient) if self.clip_gradient is not None
+            else -1.0)
+        key = tuple(sorted(params.items()))
+        n_state = len(states[0])
+        flat = []
+        for w, g, s in zip(weights, grads, states):
+            flat.append(w._data)
+            flat.append(g._data)
+            flat.extend(x._data for x in s)
+        shapes = tuple((tuple(w.shape), str(w.dtype)) for w in weights)
+        fn = _jitted_multi_update(self.op_name, key, shapes, n_state,
+                                  self.uses_lr)
+        out = fn(jnp.float32(lr), jnp.float32(wd), *flat) if self.uses_lr \
+            else fn(jnp.float32(wd), *flat)
+        per = 1 + n_state
+        for gi, (w, s) in enumerate(zip(weights, states)):
+            w._rebind(out[gi * per])
+            for si, st in enumerate(s):
+                st._rebind(out[gi * per + 1 + si])
 
 
 # --------------------------------------------------------------------------
@@ -269,9 +352,19 @@ class Adam(Optimizer):
 
 @register
 class AdamW(Adam):
-    def __init__(self, learning_rate=0.001, **kwargs):
+    """Parity: src/operator/contrib/adamw.cc — decoupled weight decay
+    w -= eta*(lr*m/(sqrt(v)+eps) + wd*w)."""
+
+    def __init__(self, learning_rate=0.001, eta=1.0, **kwargs):
         super().__init__(learning_rate=learning_rate, **kwargs)
+        self.eta = eta
         self.op_name = "adamw_update"
+
+    def static_params(self, index):
+        p = dict(super().static_params(index))
+        p.pop("t", None)   # adamw op has no bias correction (reference)
+        p["eta"] = self.eta
+        return p
 
 
 @register
@@ -326,20 +419,31 @@ class Nadam(Optimizer):
         super().__init__(learning_rate=learning_rate, **kwargs)
         self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
         self.schedule_decay = schedule_decay
-        self.m_schedule = 1.0
+        self._msched: Dict[Any, Tuple[int, float]] = {}
         self.op_name = "nadam_update"
 
     def create_state(self, index, weight):
         return self._zeros_state(weight, 2)
 
     def static_params(self, index):
+        # per-index momentum schedule, pure across repeated calls at the
+        # same step (update_multi probes static_params before applying).
+        # The op multiplies by f(t) itself, so pass prod_{i<t} f(i).
         t = self._index_update_count.get(index, 0) + 1
-        mt = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
-        self.m_schedule = self.m_schedule * mt
+        cached_t, cached_v = self._msched.get(index, (0, 1.0))
+        if cached_t != t:
+            if cached_t == t - 1:
+                v, start = cached_v, max(t - 1, 1)
+            else:
+                v, start = 1.0, 1
+            for i in range(start, t):
+                v *= self.beta1 * (1.0 - 0.5 * 0.96
+                                   ** (i * self.schedule_decay))
+            self._msched[index] = (t, v)
         return {"beta1": self.beta1, "beta2": self.beta2,
                 "epsilon": self.epsilon, "t": t,
                 "schedule_decay": self.schedule_decay,
-                "m_schedule": self.m_schedule}
+                "m_schedule": self._msched[index][1]}
 
 
 @register
@@ -535,6 +639,17 @@ class Updater:
         self.optimizer.update_multi_precision(index, weight, grad,
                                               self.states[index])
 
+    def update_multi(self, indices, grads, weights):
+        """Aggregated update of a parameter group (see
+        Optimizer.update_multi)."""
+        for index, weight in zip(indices, weights):
+            if index not in self.states:
+                self.states[index] = \
+                    self.optimizer.create_state_multi_precision(index, weight)
+                self.states_synced[index] = True
+        self.optimizer.update_multi(indices, weights, grads,
+                                    [self.states[i] for i in indices])
+
     def get_states(self, dump_optimizer=False):
         import pickle
         state_np = {k: tuple(s.asnumpy() for s in v)
@@ -555,3 +670,49 @@ class Updater:
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+@register
+class LANS(Optimizer):
+    """Parity: src/operator/contrib/multi_lans.cc (_multi_lans_update);
+    python surface mirrors optimizer/lans.py."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound, self.upper_bound = lower_bound, upper_bound
+        self.op_name = "lans_update"
+
+    def create_state(self, index, weight):
+        return self._zeros_state(weight, 2)
+
+    def static_params(self, index):
+        t = self._index_update_count.get(index, 0) + 1
+        return {"beta1": self.beta1, "beta2": self.beta2,
+                "epsilon": self.epsilon, "t": t,
+                "lower_bound": float(self.lower_bound)
+                if self.lower_bound is not None else -1.0,
+                "upper_bound": float(self.upper_bound)
+                if self.upper_bound is not None else -1.0}
+
+
+@register
+class GroupAdaGrad(Optimizer):
+    """Parity: src/operator/contrib/optimizer_op.cc
+    (_contrib_group_adagrad_update)."""
+
+    def __init__(self, learning_rate=0.01, epsilon=1e-5, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        if self.wd:
+            raise MXNetError("GroupAdaGrad does not support weight decay "
+                             "(parity: reference group_adagrad)")
+        self.epsilon = epsilon
+        self.op_name = "group_adagrad_update"
+
+    def create_state(self, index, weight):
+        shape = (weight.shape[0],) + (1,) * (len(weight.shape) - 1)
+        return (NDArray(jnp.zeros(shape, weight.dtype)),)
+
+    def static_params(self, index):
+        return {"epsilon": self.epsilon}

@@ -1,0 +1,84 @@
+"""Runtime kernel compilation.
+
+Parity: python/mxnet/rtc.py — ``CudaModule``/``CudaKernel`` compile CUDA
+C source with NVRTC at runtime (src/common/rtc.cc) and launch on
+NDArrays.  The TPU-native analogue compiles **Pallas** source at
+runtime: ``PallasModule(source)`` executes the source (which defines
+kernel functions operating on ``pl.Ref``s), and ``get_kernel`` wraps one
+of them with ``pl.pallas_call`` into a launchable accepting NDArrays.
+
+Example::
+
+    src = '''
+    def axpy(x_ref, y_ref, o_ref):
+        o_ref[...] = 2.0 * x_ref[...] + y_ref[...]
+    '''
+    mod = rtc.PallasModule(src)
+    k = mod.get_kernel("axpy", num_inputs=2)
+    out = k.launch([a, b], out_shape=a.shape, out_dtype=a.dtype)
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, np_dtype
+
+__all__ = ["PallasModule", "PallasKernel"]
+
+
+class PallasKernel:
+    """One launchable kernel (parity: rtc.py CudaKernel)."""
+
+    def __init__(self, fn, name: str, num_inputs: int):
+        self._fn = fn
+        self._name = name
+        self._num_inputs = num_inputs
+
+    def launch(self, args: Sequence, out_shape, out_dtype="float32",
+               grid: Optional[tuple] = None, interpret: Optional[bool] = None):
+        """Run the kernel on NDArray args → NDArray (parity:
+        CudaKernel.launch; grid maps to the pallas grid)."""
+        from jax.experimental import pallas as pl
+        from .ndarray import NDArray
+        from .ops.registry import apply_jax
+
+        if len(args) != self._num_inputs:
+            raise MXNetError(
+                f"kernel {self._name} expects {self._num_inputs} inputs, "
+                f"got {len(args)}")
+        if interpret is None:
+            # pallas TPU lowering needs a TPU backend; interpret
+            # elsewhere so the same source runs in tests on CPU
+            interpret = jax.default_backend() != "tpu"
+        out = jax.ShapeDtypeStruct(tuple(out_shape), np_dtype(out_dtype))
+        call = pl.pallas_call(
+            self._fn, out_shape=out,
+            grid=grid if grid is not None else (),
+            interpret=interpret)
+        return apply_jax(lambda *xs: call(*xs), list(args))
+
+
+class PallasModule:
+    """Runtime-compiled module of Pallas kernels (parity: rtc.py
+    CudaModule over NVRTC; here `exec` of Pallas/JAX source)."""
+
+    def __init__(self, source: str, options=(), exports=()):
+        self._namespace: dict = {"jnp": jnp, "jax": jax}
+        try:
+            from jax.experimental import pallas as pl
+            self._namespace["pl"] = pl
+        except ImportError:
+            pass
+        try:
+            exec(compile(source, "<pallas-rtc>", "exec"), self._namespace)
+        except SyntaxError as e:
+            raise MXNetError(f"PallasModule compile error: {e}") from e
+
+    def get_kernel(self, name: str, num_inputs: int = 1) -> PallasKernel:
+        if name not in self._namespace or not callable(
+                self._namespace[name]):
+            raise MXNetError(f"kernel {name!r} not defined in module source")
+        return PallasKernel(self._namespace[name], name, num_inputs)

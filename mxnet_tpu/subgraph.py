@@ -1,0 +1,290 @@
+"""Subgraph partitioning framework.
+
+Parity: src/operator/subgraph/ — ``SubgraphProperty`` /
+``SubgraphSelector`` (subgraph_property.h:86,145), the registry macros
+(:560-566), ``build_subgraph.cc``, and the Python-facing
+``sym.optimize_for(backend)`` / ``MX_REGISTER_SUBGRAPH_*``.
+
+TPU-native: a matched region of the Symbol DAG is collapsed into one
+``_subgraph_exec`` node that lowers the region as a single jittable
+callable — XLA then fuses it as one unit (the analogue of the
+reference's MKLDNN/TensorRT fused subgraph ops).  Custom backends
+register a property with a selector, exactly like the reference's
+``SubgraphProperty::CreateSubgraphSelector``.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+from .ops.registry import register as _register_op
+from .symbol.symbol import Symbol, _Node, _topo_nodes
+
+__all__ = ["SubgraphSelector", "SubgraphProperty",
+           "register_subgraph_backend", "get_backend", "list_backends",
+           "partition"]
+
+
+class SubgraphSelector:
+    """Decides which nodes join a subgraph (parity:
+    subgraph_property.h:86 SubgraphSelector)."""
+
+    def select(self, node) -> bool:
+        """Can ``node`` start a new subgraph?"""
+        return False
+
+    def select_input(self, node, input_node) -> bool:
+        """Grow the subgraph from ``node`` to its producer?"""
+        return self.select(input_node)
+
+    def select_output(self, node, output_node) -> bool:
+        """Grow the subgraph from ``node`` to its consumer?"""
+        return self.select(output_node)
+
+    def reset(self):
+        pass
+
+
+class SubgraphProperty:
+    """A partitioning backend (parity: subgraph_property.h:252)."""
+
+    name = "base"
+
+    def create_selector(self) -> SubgraphSelector:
+        raise NotImplementedError
+
+    def min_subgraph_size(self) -> int:
+        return 2
+
+
+_BACKENDS: Dict[str, SubgraphProperty] = {}
+
+
+def register_subgraph_backend(name: str):
+    """Parity: MXNET_REGISTER_SUBGRAPH_BACKEND/PROPERTY macros."""
+
+    def deco(prop_cls):
+        prop = prop_cls() if isinstance(prop_cls, type) else prop_cls
+        prop.name = name
+        _BACKENDS[name] = prop
+        return prop_cls
+
+    return deco
+
+
+def get_backend(name: str) -> SubgraphProperty:
+    if name not in _BACKENDS:
+        raise MXNetError(
+            f"unknown subgraph backend {name!r}; known: {sorted(_BACKENDS)}")
+    return _BACKENDS[name]
+
+
+def list_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+@_register_op("_subgraph_exec", multi_out=True)
+def _subgraph_exec(*inputs, subgraph_fn=None, n_outputs=1):
+    """Execute a collapsed subgraph as one fused unit (parity: the
+    generated subgraph op of build_subgraph.cc)."""
+    outs = subgraph_fn(list(inputs))
+    return tuple(outs) if n_outputs > 1 else outs[0]
+
+
+def _region_from(start: _Node, selector: SubgraphSelector,
+                 assigned: set, consumers: Dict[int, List[_Node]]):
+    """Grow a region from ``start`` along input/output edges, keeping it
+    acyclic-by-construction (only whole producer/consumer moves)."""
+    region = {id(start): start}
+    frontier = [start]
+    while frontier:
+        node = frontier.pop()
+        for src, _ in node.inputs:
+            if (id(src) not in region and id(src) not in assigned
+                    and not src.is_var
+                    and selector.select_input(node, src)):
+                region[id(src)] = src
+                frontier.append(src)
+        for cons in consumers.get(id(node), []):
+            if (id(cons) not in region and id(cons) not in assigned
+                    and selector.select_output(node, cons)):
+                region[id(cons)] = cons
+                frontier.append(cons)
+    return region
+
+
+def partition(symbol: Symbol, backend: str, **options) -> Symbol:
+    """Partition ``symbol``'s graph with ``backend``'s property
+    (parity: sym.optimize_for → BuildSubgraph pass).
+
+    Matched regions become ``_subgraph_exec`` nodes whose params hold a
+    lowered callable over the region — one jit unit per subgraph.
+    """
+    prop = get_backend(backend)
+    out_nodes = [o[0] for o in symbol._outputs]
+    order = _topo_nodes(out_nodes)
+    consumers: Dict[int, List[_Node]] = {}
+    for n in order:
+        for src, _ in n.inputs:
+            consumers.setdefault(id(src), []).append(n)
+
+    assigned: set = set()
+    regions = []
+    for node in order:
+        if node.is_var or id(node) in assigned:
+            continue
+        selector = prop.create_selector()
+        if not selector.select(node):
+            continue
+        region = _region_from(node, selector, assigned, consumers)
+        if len(region) >= prop.min_subgraph_size() \
+                and _is_convex(region, consumers):
+            assigned.update(region.keys())
+            regions.append(region)
+
+    if not regions:
+        return symbol
+
+    # build replacement graph bottom-up
+    replacement: Dict[int, _Node] = {}
+    fused_slot: Dict[int, int] = {}
+
+    def rebuilt(node: _Node) -> _Node:
+        return replacement.get(id(node), node)
+
+    for ri, region in enumerate(regions):
+        rnodes = [n for n in order if id(n) in region]
+        # external inputs: edges from outside the region (in first-use order)
+        ext_inputs: List = []
+        seen = set()
+        for n in rnodes:
+            for src, i in n.inputs:
+                if id(src) not in region and (id(src), i) not in seen:
+                    seen.add((id(src), i))
+                    ext_inputs.append((src, i))
+        # region outputs: nodes consumed outside (or graph outputs)
+        graph_out_ids = {id(o) for o in out_nodes}
+        outs = []
+        for n in rnodes:
+            used_outside = any(id(c) not in region
+                               for c in consumers.get(id(n), []))
+            if used_outside or id(n) in graph_out_ids:
+                outs.append(n)
+
+        sub_fn = _lower_region(rnodes, ext_inputs, outs, region)
+        fused_inputs = []
+        for s, i in ext_inputs:
+            if id(s) in replacement:   # produced by an earlier fused region
+                fused_inputs.append((rebuilt(s), fused_slot.get(id(s), 0)))
+            else:
+                fused_inputs.append((s, i))
+        fused = _Node("_subgraph_exec",
+                      f"{prop.name}_subgraph{ri}",
+                      {"subgraph_fn": sub_fn, "n_outputs": len(outs)},
+                      fused_inputs,
+                      num_outputs=len(outs))
+        for oi, n in enumerate(outs):
+            replacement[id(n)] = fused
+            fused_slot[id(n)] = oi
+
+    # rewrite the full graph with region nodes replaced
+    memo: Dict[int, _Node] = {}
+
+    def rewrite(node: _Node) -> _Node:
+        if id(node) in memo:
+            return memo[id(node)]
+        if id(node) in replacement:
+            new = replacement[id(node)]
+            memo[id(node)] = new
+            return new
+        if node.is_var:
+            memo[id(node)] = node
+            return node
+        new_inputs = []
+        for src, i in node.inputs:
+            rsrc = rewrite(src)
+            if rsrc is not src and id(src) in replacement:
+                i = fused_slot.get(id(src), 0)
+            new_inputs.append((rsrc, i))
+        new = _Node(node.op_name, node.name, node.params, new_inputs,
+                    node.num_outputs)
+        memo[id(node)] = new
+        return new
+
+    new_outputs = []
+    for node, i in symbol._outputs:
+        rnode = rewrite(node)
+        if rnode is not node and id(node) in replacement:
+            i = fused_slot.get(id(node), 0)
+        new_outputs.append((rnode, i))
+    return Symbol(new_outputs)
+
+
+def _is_convex(region, consumers) -> bool:
+    """No path from a region node out through external nodes and back in
+    (otherwise collapsing creates a cycle — the reference's selector
+    convexity requirement, build_subgraph.cc)."""
+    # nodes outside the region reachable downstream from the region
+    frontier = [c for n in region.values()
+                for c in consumers.get(id(n), []) if id(c) not in region]
+    seen = set()
+    while frontier:
+        n = frontier.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if id(n) in region:
+            return False
+        for c in consumers.get(id(n), []):
+            if id(c) in region:
+                return False
+            frontier.append(c)
+    return True
+
+
+def _lower_region(rnodes, ext_inputs, outs, region):
+    """Build a callable evaluating the region from its external inputs."""
+    from .ops import registry as _reg
+
+    def sub_fn(arrays):
+        vals = {}
+        for (src, i), a in zip(ext_inputs, arrays):
+            vals[(id(src), i)] = a
+        for n in rnodes:
+            ins = [vals[(id(s), i)] for s, i in n.inputs]
+            op = _reg.get(n.op_name)
+            out = op.fn(*ins, **n.params)
+            outs_list = list(out) if isinstance(out, (tuple, list)) else [out]
+            for oi, o in enumerate(outs_list):
+                vals[(id(n), oi)] = o
+        return [vals[(id(n), 0)] for n in outs]
+
+    return sub_fn
+
+
+# -- default backend: elementwise fusion (parity: the default property
+#    v1/v2, and the spirit of pointwise_fusion_pass.cc) -------------------
+
+_ELEMWISE = {
+    "elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div",
+    "Activation", "relu", "sigmoid", "tanh", "exp", "log", "sqrt",
+    "square", "negative", "_plus_scalar", "_minus_scalar", "_mul_scalar",
+    "_div_scalar", "_power_scalar", "clip", "abs",
+}
+
+
+def _is_elemwise(op_name: str) -> bool:
+    if op_name.startswith("_scalar_wrap:"):
+        op_name = op_name.split(":", 1)[1]
+    return op_name in _ELEMWISE
+
+
+class _ElemwiseSelector(SubgraphSelector):
+    def select(self, node):
+        return _is_elemwise(node.op_name)
+
+
+@register_subgraph_backend("default")
+class _DefaultProperty(SubgraphProperty):
+    def create_selector(self):
+        return _ElemwiseSelector()

@@ -18,8 +18,12 @@ __all__ = ["make_sym_func", "populate_namespace"]
 def make_sym_func(name: str):
     op = _reg.get(name)
     sig = inspect.signature(op.fn)
+    positional = [p for p in sig.parameters.values()
+                  if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                  and p.name not in ("args", "kwargs")]
 
     def sym_func(*args, name=None, **kwargs):
+        from .symbol import Variable, _auto_name
         inputs = [a for a in args if isinstance(a, Symbol)]
         extras = [a for a in args
                   if not isinstance(a, Symbol) and a is not None]
@@ -31,6 +35,25 @@ def make_sym_func(name: str):
         for k, v in list(kwargs.items()):
             if isinstance(v, list):
                 kwargs[k] = tuple(v)
+        # auto-create variables for unprovided parameter inputs, like the
+        # reference symbol composer (fc1 → fc1_weight/fc1_bias); inputs
+        # with a None default are optional and honor the no_bias flag
+        if inputs and len(inputs) < len(positional):
+            node_name = name or _auto_name(op.name)
+            name = node_name
+            kw_defaults = {p.name: p.default
+                           for p in sig.parameters.values()
+                           if p.kind == p.KEYWORD_ONLY}
+            no_bias = kwargs.get("no_bias",
+                                 kw_defaults.get("no_bias", False))
+            for p in positional[len(inputs):]:
+                if p.default is inspect.Parameter.empty:
+                    inputs.append(Variable(f"{node_name}_{p.name}"))
+                elif p.default is None and p.name == "bias" and not no_bias:
+                    # optional bias input: created unless no_bias (user
+                    # kwarg or the op's own default, e.g. Deconvolution
+                    # defaults no_bias=True), like the reference composer
+                    inputs.append(Variable(f"{node_name}_{p.name}"))
         return _apply(op.name, inputs, name=name, **kwargs)
 
     sym_func.__name__ = name

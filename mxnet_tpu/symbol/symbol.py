@@ -218,20 +218,76 @@ class Symbol:
         return fn
 
     def infer_shape(self, **kwargs):
-        """Infer output shapes from argument shapes via jax.eval_shape
-        (parity: symbol.infer_shape)."""
+        """Infer output shapes from argument shapes (parity:
+        symbol.infer_shape — *partial* inference: parameter shapes
+        omitted from kwargs are derived from data flow with per-op rules,
+        the analogue of each reference op's FInferShape filling unknown
+        weight dims)."""
         args = self.list_arguments()
+        known = {n: tuple(kwargs[n]) for n in args if n in kwargs}
+        if len(known) < len(args):
+            known = self._infer_missing_arg_shapes(known)
         structs = []
         for name in args:
-            if name not in kwargs:
-                raise MXNetError(f"infer_shape: missing shape for {name!r}")
-            structs.append(jax.ShapeDtypeStruct(tuple(kwargs[name]),
-                                                jnp.float32))
+            if name not in known:
+                raise MXNetError(f"infer_shape: cannot infer shape for "
+                                 f"{name!r}; pass it explicitly")
+            structs.append(jax.ShapeDtypeStruct(known[name], jnp.float32))
         fn = self._lower(args)
         outs = jax.eval_shape(lambda a: fn(a), structs)
         arg_shapes = [tuple(s.shape) for s in structs]
         out_shapes = [tuple(o.shape) for o in outs]
         return arg_shapes, out_shapes, []
+
+    def infer_shape_partial(self, **kwargs):
+        """Best-effort variant returning None for arguments it cannot
+        infer (parity: symbol.infer_shape_partial)."""
+        try:
+            return self.infer_shape(**kwargs)
+        except Exception:   # jax.eval_shape raises raw TypeError/ValueError
+            args = self.list_arguments()
+            known = self._infer_missing_arg_shapes(
+                {n: tuple(kwargs[n]) for n in args if n in kwargs})
+            return ([known.get(n) for n in args], None, [])
+
+    def _infer_missing_arg_shapes(self, known):
+        """Forward pass deriving parameter shapes from data shapes — the
+        same rules each layer's deferred init uses (gluon Dense/_Conv
+        _finish_deferred)."""
+        known = dict(known)
+        order = _topo_nodes([o[0] for o in self._outputs])
+        shapes: Dict[int, Any] = {}   # id(node) -> list of out shapes
+        for node in order:
+            if node.is_var:
+                if node.name in known:
+                    shapes[id(node)] = [known[node.name]]
+                continue
+            in_shapes = []
+            for pos, (src, i) in enumerate(node.inputs):
+                lst = shapes.get(id(src))
+                s = lst[i] if lst and i < len(lst) else (
+                    lst[0] if lst else None)
+                if s is None and src.is_var:
+                    s = _param_shape_rule(node.op_name, pos,
+                                          in_shapes[0] if in_shapes else None,
+                                          node.params)
+                    if s is not None:
+                        known[src.name] = s
+                        shapes[id(src)] = [s]
+                in_shapes.append(s)
+            if any(s is None for s in in_shapes):
+                continue
+            try:
+                op = _reg.get(node.op_name)
+                structs = [jax.ShapeDtypeStruct(s, jnp.float32)
+                           for s in in_shapes]
+                out = jax.eval_shape(
+                    lambda *a: op.fn(*a, **node.params), *structs)
+                outs = list(out) if isinstance(out, (tuple, list)) else [out]
+                shapes[id(node)] = [tuple(o.shape) for o in outs]
+            except Exception:
+                continue
+        return known
 
     def infer_type(self, **kwargs):
         args = self.list_arguments()
@@ -268,6 +324,12 @@ class Symbol:
                  for n, s in zip(arg_names, arg_shapes)} \
             if grad_req != "null" else None
         return self.bind(ctx, args, grads, grad_req)
+
+    def optimize_for(self, backend: str, **options) -> "Symbol":
+        """Partition the graph with a registered subgraph backend
+        (parity: sym.optimize_for → build_subgraph pass)."""
+        from ..subgraph import partition
+        return partition(self, backend, **options)
 
     # -- serialization -----------------------------------------------------
     def tojson(self) -> str:
@@ -407,3 +469,60 @@ def load_json(json_str: str) -> Symbol:
 def load(fname: str) -> Symbol:
     with open(fname) as f:
         return load_json(f.read())
+
+
+def _param_shape_rule(op_name, pos, data_shape, params):
+    """Derive a parameter input's shape from the op's data shape +
+    static params (parity: the FInferShape of each reference op filling
+    unknown weight dims; mirrors gluon deferred-init rules)."""
+    if data_shape is None:
+        return None
+    p = params
+
+    def _prod(xs):
+        out = 1
+        for x in xs:
+            out *= x
+        return out
+
+    if op_name == "FullyConnected":
+        nh = p.get("num_hidden")
+        flatten = p.get("flatten", True)
+        if pos == 1:
+            return (nh, _prod(data_shape[1:]) if flatten
+                    else data_shape[-1])
+        if pos == 2:
+            return (nh,)
+    elif op_name == "Convolution":
+        nf = p.get("num_filter")
+        k = tuple(p.get("kernel", ()))
+        g = p.get("num_group", 1)
+        layout = p.get("layout") or "NCHW"
+        c_last = layout.endswith("C")
+        cin = data_shape[-1] if c_last else data_shape[1]
+        if pos == 1:
+            # weight layout follows _conv_dnums: OI+spatial for NC-first,
+            # O+spatial+I for C-last
+            return ((nf,) + k + (cin // g,)) if c_last \
+                else ((nf, cin // g) + k)
+        if pos == 2:
+            return (nf,)
+    elif op_name == "Deconvolution":
+        nf = p.get("num_filter")
+        k = tuple(p.get("kernel", ()))
+        g = p.get("num_group", 1)
+        cin = data_shape[1]
+        if pos == 1:
+            return (cin, nf // g) + k
+        if pos == 2:
+            return (nf,)
+    elif op_name in ("BatchNorm", "InstanceNorm"):
+        c = data_shape[p.get("axis", 1)]
+        return (c,)
+    elif op_name == "LayerNorm":
+        c = data_shape[p.get("axis", -1)]
+        return (c,)
+    elif op_name == "Embedding":
+        if pos == 1:
+            return (p.get("input_dim"), p.get("output_dim"))
+    return None

@@ -1,0 +1,100 @@
+"""visualization / callback / library / rtc tests."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import sym
+
+
+def _mlp_symbol():
+    data = sym.Variable("data")
+    fc1 = sym.FullyConnected(data, name="fc1", num_hidden=16)
+    act = sym.Activation(fc1, name="relu1", act_type="relu")
+    fc2 = sym.FullyConnected(act, name="fc2", num_hidden=4)
+    return sym.softmax(fc2, name="out")
+
+
+def test_print_summary(capsys):
+    s = _mlp_symbol()
+    total = mx.print_summary(s, shape={"data": (1, 8)})
+    out = capsys.readouterr().out
+    assert "fc1" in out and "FullyConnected" in out
+    # fc1: 8*16+16, fc2: 16*4+4
+    assert total == (8 * 16 + 16) + (16 * 4 + 4)
+
+
+def test_plot_network_dot():
+    s = _mlp_symbol()
+    dot = mx.plot_network(s, title="mlp")
+    assert dot.startswith('digraph "mlp"')
+    assert "FullyConnected" in dot and "->" in dot
+
+
+def test_speedometer_and_logging(caplog):
+    from mxnet_tpu.callback import Speedometer, BatchEndParam
+    from mxnet_tpu.gluon.metric import Accuracy
+    sp = Speedometer(batch_size=4, frequent=2, auto_reset=False)
+    metric = Accuracy()
+    metric.update(nd.array(onp.array([0.0, 1.0])),
+                  nd.array(onp.array([[0.9, 0.1], [0.1, 0.9]])))
+    with caplog.at_level(logging.INFO):
+        for i in range(1, 5):
+            sp(BatchEndParam(epoch=0, nbatch=i, eval_metric=metric))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_do_checkpoint(tmp_path):
+    from mxnet_tpu.callback import do_checkpoint
+    from mxnet_tpu.gluon import nn
+    net = nn.Dense(2)
+    net.initialize()
+    net(nd.ones((1, 3)))
+    cb = do_checkpoint(str(tmp_path / "model"), period=1)
+    cb(0, net)
+    assert os.path.exists(tmp_path / "model-0001.params")
+
+
+def test_library_load_python_extension(tmp_path):
+    ext = tmp_path / "my_ext.py"
+    ext.write_text(
+        "import jax.numpy as jnp\n"
+        "def register_ops(registry):\n"
+        "    @registry.register('my_plus3')\n"
+        "    def _plus3(x):\n"
+        "        return x + 3.0\n")
+    mx.library.load(str(ext), verbose=False)
+    out = mx.ops.invoke("my_plus3", [nd.ones((2,))])
+    onp.testing.assert_allclose(out.asnumpy(), [4.0, 4.0])
+    # now exposed on the generated nd namespace too
+    assert hasattr(nd, "my_plus3")
+
+
+def test_library_load_missing_file():
+    with pytest.raises(mx.MXNetError):
+        mx.library.load("/nonexistent/lib.py")
+
+
+def test_rtc_pallas_module():
+    src = (
+        "def axpy(x_ref, y_ref, o_ref):\n"
+        "    o_ref[...] = 2.0 * x_ref[...] + y_ref[...]\n"
+    )
+    mod = mx.rtc.PallasModule(src)
+    k = mod.get_kernel("axpy", num_inputs=2)
+    a = nd.array(onp.arange(8, dtype="f4"))
+    b = nd.ones((8,))
+    out = k.launch([a, b], out_shape=(8,), out_dtype="float32")
+    onp.testing.assert_allclose(out.asnumpy(),
+                                2 * onp.arange(8, dtype="f4") + 1)
+
+
+def test_rtc_errors():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.PallasModule("def broken(:\n    pass")
+    mod = mx.rtc.PallasModule("def k(o_ref):\n    o_ref[...] = 1.0")
+    with pytest.raises(mx.MXNetError):
+        mod.get_kernel("nope")

@@ -1,0 +1,109 @@
+"""mx.np namespace conformance — sampled functions against host numpy.
+
+Parity model: tests/python/unittest/test_numpy_interoperability.py in
+the reference (protocol conformance over the numpy surface)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+
+
+rng = onp.random.RandomState(0)
+A = rng.randn(4, 5).astype("f4")
+B = rng.randn(4, 5).astype("f4")
+V = rng.randn(7).astype("f4")
+
+
+def _chk(m_out, n_out, rtol=1e-5, atol=1e-6):
+    m = m_out.asnumpy() if hasattr(m_out, "asnumpy") else onp.asarray(m_out)
+    onp.testing.assert_allclose(m, n_out, rtol=rtol, atol=atol)
+
+
+@pytest.mark.parametrize("name,args", [
+    ("pad", (V, 2)),
+    ("insert", (V, 2, 9.0)),
+    ("delete", (V, 2)),
+    ("percentile", (A, 30.0)),
+    ("quantile", (A, 0.3)),
+    ("median", (A,)),
+    ("average", (A,)),
+    ("interp", (onp.array([0.5, 1.5], "f4"), onp.arange(4.0),
+                onp.arange(4.0) * 2)),
+    ("kron", (A[:2, :2], B[:2, :2])),
+    ("cross", (A[:, :3], B[:, :3])),
+    ("trace", (A,)),
+    ("polyval", (onp.array([1.0, -2.0, 1.0], "f4"), V)),
+    ("cov", (A,)),
+    ("corrcoef", (A,)),
+    ("gradient", (V,)),
+    ("diff", (V,)),
+    ("ediff1d", (V,)),
+    ("unique", (onp.array([1, 2, 2, 3], "f4"),)),
+    ("bincount", (onp.array([0, 1, 1, 3]),)),
+    ("searchsorted", (onp.sort(V), onp.array([0.0], "f4"))),
+    ("tile", (A, 2)),
+    ("repeat", (A, 2)),
+    ("rot90", (A,)),
+    ("flipud", (A,)),
+    ("roll", (A, 1)),
+    ("take_along_axis", (A, onp.argsort(A, axis=1), 1)),
+    ("isclose", (A, A + 1e-8)),
+    ("hanning", (8,)),
+    ("hamming", (8,)),
+    ("blackman", (8,)),
+    ("vander", (V,)),
+    ("select", ([V > 0, V <= 0], [V, -V])),
+    ("einsum", ("ij,ij->i", A, B)),
+    ("in1d", (onp.array([1.0, 5.0], "f4"), onp.array([1.0, 2.0], "f4"))),
+])
+def test_np_function_matches_numpy(name, args):
+    m_args = [mnp.array(a) if isinstance(a, onp.ndarray)
+              and a.dtype != onp.bool_ else a for a in args]
+    m_out = getattr(mnp, name)(*m_args)
+    n_out = getattr(onp, name)(*args)
+    if isinstance(m_out, (list, tuple)):
+        for mo, no in zip(m_out, n_out):
+            _chk(mo, no)
+    else:
+        _chk(m_out, onp.asarray(n_out))
+
+
+def test_np_linalg_sampled():
+    M = (A @ A.T + 5 * onp.eye(4)).astype("f4")
+    _chk(mnp.linalg.inv(mnp.array(M)), onp.linalg.inv(M), rtol=1e-3)
+    _chk(mnp.linalg.det(mnp.array(M)), onp.linalg.det(M), rtol=1e-4)
+    _chk(mnp.linalg.norm(mnp.array(A)), onp.linalg.norm(A), rtol=1e-5)
+    L = mnp.linalg.cholesky(mnp.array(M)).asnumpy()
+    onp.testing.assert_allclose(L @ L.T, M, rtol=1e-4, atol=1e-4)
+    w, v = mnp.linalg.eigh(mnp.array(M))
+    onp.testing.assert_allclose(
+        sorted(w.asnumpy()), sorted(onp.linalg.eigvalsh(M)), rtol=1e-4)
+
+
+def test_np_fft_roundtrip():
+    x = V
+    out = mnp.fft.ifft(mnp.fft.fft(mnp.array(x)))
+    onp.testing.assert_allclose(out.asnumpy().real, x, atol=1e-5)
+
+
+def test_np_random_sampled():
+    mx.random.seed(5)
+    s = mnp.random.normal(0, 1, size=(20000,))
+    assert abs(float(s.asnumpy().mean())) < 0.03
+    s = mnp.random.beta(2.0, 3.0, size=(20000,))
+    assert abs(float(s.asnumpy().mean()) - 0.4) < 0.02
+    p = mnp.random.permutation(10)
+    assert sorted(p.asnumpy().tolist()) == list(range(10))
+    r = mnp.random.randint(0, 5, size=(1000,))
+    assert set(onp.unique(r.asnumpy())) <= {0, 1, 2, 3, 4}
+
+
+def test_np_autograd_through_lifted_fn():
+    from mxnet_tpu import autograd as ag
+    x = mnp.array(A)
+    x.attach_grad()
+    with ag.record():
+        y = mnp.einsum("ij,ij->", x, x)
+    y.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(), 2 * A, rtol=1e-5)

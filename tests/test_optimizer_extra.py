@@ -1,0 +1,168 @@
+"""LANS / AdamW / GroupAdaGrad + aggregated multi-tensor update tests.
+
+Parity model: tests/python/unittest/test_optimizer.py (numpy
+re-implementation oracle per optimizer)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+from mxnet_tpu import optimizer as opt
+
+
+def _lans_numpy(w, g, m, v, lr, b1, b2, eps, wd, t):
+    g = g / max(onp.linalg.norm(g), 1e-12)
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mh = m / (1 - b1 ** t)
+    vh = onp.sqrt(v / (1 - b2 ** t)) + eps
+    tm = mh / vh + wd * w
+    tg = g / vh + wd * w
+    r1 = onp.linalg.norm(w)
+    r2m, r2g = onp.linalg.norm(tm), onp.linalg.norm(tg)
+    rm = (r1 / r2m if r1 > 0 and r2m > 0 else 1.0) * b1
+    rg = (r1 / r2g if r1 > 0 and r2g > 0 else 1.0) * (1 - b1)
+    return w - lr * rm * tm - lr * rg * tg, m, v
+
+
+def test_lans_matches_numpy():
+    rng = onp.random.RandomState(0)
+    w = rng.randn(6, 4).astype("f4")
+    g = rng.randn(6, 4).astype("f4")
+    o = opt.create("lans", learning_rate=0.01, wd=0.1)
+    wnd, gnd = nd.array(w), nd.array(g)
+    state = o.create_state(0, wnd)
+    m = onp.zeros_like(w)
+    v = onp.zeros_like(w)
+    ww = w.copy()
+    for t in range(1, 4):
+        o.update(0, wnd, gnd, state)
+        ww, m, v = _lans_numpy(ww, g, m, v, 0.01, 0.9, 0.999, 1e-6, 0.1, t)
+    onp.testing.assert_allclose(wnd.asnumpy(), ww, rtol=2e-4, atol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    # with lr=0 and eta=1: AdamW still decays weights by wd (decoupled);
+    # plain Adam with lr=0 would not move at all
+    w = onp.ones((3,), "f4")
+    g = onp.ones((3,), "f4")
+    o = opt.create("adamw", learning_rate=0.0, wd=0.1)
+    wnd, gnd = nd.array(w), nd.array(g)
+    state = o.create_state(0, wnd)
+    o.update(0, wnd, gnd, state)
+    onp.testing.assert_allclose(wnd.asnumpy(), w - 0.1 * w, rtol=1e-6)
+
+
+def test_group_adagrad():
+    rng = onp.random.RandomState(1)
+    w = rng.randn(4, 8).astype("f4")
+    g = rng.randn(4, 8).astype("f4")
+    o = opt.create("groupadagrad", learning_rate=0.1)
+    wnd, gnd = nd.array(w), nd.array(g)
+    state = o.create_state(0, wnd)
+    assert state[0].shape == (4, 1)
+    o.update(0, wnd, gnd, state)
+    h = (g * g).mean(axis=1, keepdims=True)
+    ref = w - 0.1 * g / (onp.sqrt(h) + 1e-5)
+    onp.testing.assert_allclose(wnd.asnumpy(), ref, rtol=1e-5)
+    onp.testing.assert_allclose(state[0].asnumpy(), h, rtol=1e-5)
+
+
+def _run_trainer(agg):
+    from mxnet_tpu.gluon import nn, Trainer, loss as gloss
+    from mxnet_tpu import autograd as ag
+    onp.random.seed(2)
+    mx.random.seed(2)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize(init=mx.initializer.Xavier())
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 0.5, "momentum": 0.9, "wd": 1e-3,
+                  "aggregate_num": agg})
+    L = gloss.SoftmaxCrossEntropyLoss()
+    X = onp.random.RandomState(3).randn(16, 5).astype("f4")
+    y = (X.sum(1) > 0).astype("f4")
+    for _ in range(5):
+        with ag.record():
+            l = L(net(nd.array(X)), nd.array(y)).mean()
+        l.backward()
+        tr.step(16)
+    return [p.data().asnumpy() for p in net.collect_params().values()]
+
+
+def test_aggregated_update_matches_sequential():
+    seq = _run_trainer(agg=0)
+    fused = _run_trainer(agg=4)
+    for a, b in zip(seq, fused):
+        onp.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_lans_adamw_registered_names():
+    assert isinstance(opt.create("lans"), opt.optimizer.LANS)
+    assert isinstance(opt.create("adamw"), opt.AdamW)
+
+
+def test_aggregated_fp16_multi_precision():
+    rng = onp.random.RandomState(4)
+    w = rng.randn(4, 3).astype("float16")
+    g = rng.randn(4, 3).astype("float16")
+    o = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                   multi_precision=True, aggregate_num=4)
+    u = opt.get_updater(o)
+    wnd = [nd.array(w), nd.array(w + 1)]
+    gnd = [nd.array(g), nd.array(g)]
+    u.update_multi([0, 1], gnd, wnd)
+    # reference path: plain per-index updater, same settings
+    o2 = opt.create("sgd", learning_rate=0.1, momentum=0.9,
+                    multi_precision=True)
+    u2 = opt.get_updater(o2)
+    w2 = [nd.array(w), nd.array(w + 1)]
+    for i in range(2):
+        u2(i, gnd[i], w2[i])
+    for a, b in zip(wnd, w2):
+        onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-3)
+        assert a.asnumpy().dtype == onp.float16
+
+
+def test_nadam_aggregated_schedule_consistent():
+    rng = onp.random.RandomState(5)
+    ws = [rng.randn(3, 2).astype("f4") for _ in range(2)]
+    gs = [rng.randn(3, 2).astype("f4") for _ in range(2)]
+    o1 = opt.create("nadam", learning_rate=0.01)
+    u1 = opt.get_updater(o1)
+    o2 = opt.create("nadam", learning_rate=0.01, aggregate_num=2)
+    u2 = opt.get_updater(o2)
+    w1 = [nd.array(w) for w in ws]
+    w2 = [nd.array(w) for w in ws]
+    for step in range(3):
+        for i in range(2):
+            u1(i, nd.array(gs[i]), w1[i])
+        u2.update_multi([0, 1], [nd.array(g) for g in gs], w2)
+    for a, b in zip(w1, w2):
+        onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+
+
+def test_nadam_matches_reference_formula():
+    # numpy oracle of the reference Nadam (nadam.py): m_schedule is the
+    # product of f(1)..f(t-1) entering step t; the kernel applies f(t)
+    b1, b2, eps, decay, lr = 0.9, 0.999, 1e-8, 0.004, 0.1
+    w, g = 1.0, 0.5
+    o = opt.create("nadam", learning_rate=lr)
+    wnd, gnd = nd.array(onp.array([w], "f4")), nd.array(onp.array([g], "f4"))
+    state = o.create_state(0, wnd)
+    m = v = 0.0
+    msched = 1.0
+    for t in range(1, 4):
+        o.update(0, wnd, gnd, state)
+        mt = b1 * (1 - 0.5 * 0.96 ** (t * decay))
+        mt1 = b1 * (1 - 0.5 * 0.96 ** ((t + 1) * decay))
+        ms = msched * mt
+        ms1 = ms * mt1
+        gp = g / (1 - ms)
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mp = m / (1 - ms1)
+        vp = v / (1 - b2 ** t)
+        mbar = (1 - mt) * gp + mt1 * mp
+        w = w - lr * mbar / (vp ** 0.5 + eps)
+        msched = ms
+    onp.testing.assert_allclose(wnd.asnumpy(), [w], rtol=1e-5)

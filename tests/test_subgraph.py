@@ -1,0 +1,78 @@
+"""Subgraph partitioning tests (parity model:
+tests/python/unittest/test_subgraph_op.py)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd, sym
+from mxnet_tpu.subgraph import (SubgraphProperty, SubgraphSelector,
+                                register_subgraph_backend, list_backends)
+
+
+def _count_ops(s, op_name):
+    import json
+    nodes = json.loads(s.tojson())["nodes"]
+    return sum(1 for n in nodes if n["op"] == op_name)
+
+
+def test_default_backend_fuses_elemwise_chain():
+    a = sym.Variable("a")
+    b = sym.Variable("b")
+    out = sym.Activation((a + b) * 2.0, act_type="relu") + 1.0
+    p = out.optimize_for("default")
+    assert _count_ops(p, "_subgraph_exec") == 1
+    # numerics identical
+    av = onp.random.RandomState(0).randn(3, 4).astype("f4")
+    bv = onp.random.RandomState(1).randn(3, 4).astype("f4")
+    ref = out.eval(a=nd.array(av), b=nd.array(bv))[0].asnumpy()
+    got = p.eval(a=nd.array(av), b=nd.array(bv))[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_partition_keeps_nonselected_ops():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    out = sym.Activation(fc, act_type="relu") + 1.0
+    p = out.optimize_for("default")
+    # FullyConnected must survive outside the fused node
+    assert _count_ops(p, "FullyConnected") == 1
+    assert _count_ops(p, "_subgraph_exec") == 1
+    av = onp.random.RandomState(2).randn(2, 3).astype("f4")
+    w = onp.random.RandomState(3).randn(4, 3).astype("f4")
+    bias = onp.zeros(4, "f4")
+    kw = dict(data=nd.array(av), fc_weight=nd.array(w),
+              fc_bias=nd.array(bias))
+    onp.testing.assert_allclose(p.eval(**kw)[0].asnumpy(),
+                                out.eval(**kw)[0].asnumpy(), rtol=1e-6)
+
+
+def test_custom_backend_registration():
+    class FCSelector(SubgraphSelector):
+        def select(self, node):
+            return node.op_name in ("FullyConnected", "Activation")
+
+    @register_subgraph_backend("fc_fuse_test")
+    class FCProp(SubgraphProperty):
+        def create_selector(self):
+            return FCSelector()
+
+    assert "fc_fuse_test" in list_backends()
+    data = sym.Variable("data")
+    out = sym.Activation(sym.FullyConnected(data, name="fc", num_hidden=3),
+                         act_type="relu")
+    p = out.optimize_for("fc_fuse_test")
+    assert _count_ops(p, "_subgraph_exec") == 1
+    assert _count_ops(p, "FullyConnected") == 0
+
+
+def test_unknown_backend_raises():
+    a = sym.Variable("a")
+    with pytest.raises(mx.MXNetError):
+        (a + 1.0).optimize_for("nope")
+
+
+def test_partition_no_match_is_identity():
+    data = sym.Variable("data")
+    out = sym.FullyConnected(data, name="fc", num_hidden=4)
+    p = out.optimize_for("default")
+    assert _count_ops(p, "_subgraph_exec") == 0

@@ -156,7 +156,7 @@ def test_symbol_block(tmp_path):
     pfile = str(tmp_path / "m-0000.params")
     net_sym.save(sfile)
     mx.nd.save(pfile, {"fc_weight": mx.nd.array(wn)})
-    blk2 = SymbolBlock.imports(sfile, ["data"], pfile + ".npz")
+    blk2 = SymbolBlock.imports(sfile, ["data"], pfile)
     onp.testing.assert_allclose(blk2(x).asnumpy(), expect, rtol=1e-5)
 
 
@@ -177,3 +177,31 @@ def test_symbol_block_grads():
     loss.backward()
     g = blk.collect_params()["w"].grad()
     onp.testing.assert_allclose(g.asnumpy(), onp.tile(x.asnumpy(), (3, 1)))
+
+
+def test_sym_auto_param_variables():
+    data = sym.Variable("data")
+    fc = sym.FullyConnected(data, name="fc", num_hidden=4)
+    assert fc.list_arguments() == ["data", "fc_weight", "fc_bias"]
+    conv = sym.Convolution(sym.Variable("x"), name="c0", kernel=(3, 3),
+                           num_filter=2, no_bias=True)
+    assert conv.list_arguments() == ["x", "c0_weight"]
+    # Deconvolution defaults no_bias=True in its signature: no bias var
+    dc = sym.Deconvolution(sym.Variable("y"), name="d0", kernel=(2, 2),
+                           num_filter=2)
+    assert dc.list_arguments() == ["y", "d0_weight"]
+
+
+def test_sym_partial_shape_inference():
+    data = sym.Variable("data")
+    net = sym.FullyConnected(data, name="fc1", num_hidden=8)
+    net = sym.Activation(net, act_type="relu")
+    net = sym.FullyConnected(net, name="fc2", num_hidden=3)
+    arg_shapes, out_shapes, _ = net.infer_shape(data=(2, 5))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d["fc1_weight"] == (8, 5)
+    assert d["fc2_weight"] == (3, 8)
+    assert out_shapes == [(2, 3)]
+    # partial variant never raises
+    shapes, _, _ = net.infer_shape_partial(data=(2, 5))
+    assert shapes[0] == (2, 5)

@@ -1,0 +1,80 @@
+"""P3 store, launcher, and bandwidth tool tests."""
+import os
+import subprocess
+import sys
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import ndarray as nd
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_p3_store_sliced_pushpull(monkeypatch):
+    monkeypatch.setenv("MXNET_KVSTORE_SLICE_THRESHOLD", "1000")
+    kv = mx.kv.create("p3store_dist")
+    assert kv.type == "p3store_dist"
+    rng = onp.random.RandomState(0)
+    v = rng.randn(70, 50).astype("f4")   # 3500 elems -> 4 slices
+    val = nd.array(v)
+    kv.init("w0", val)
+    out = nd.zeros(v.shape)
+    kv.pushpull("w0", val, out=out, priority=-3)
+    # single process: all-reduce over 1 worker == identity
+    onp.testing.assert_allclose(out.asnumpy(), v, rtol=1e-6)
+    assert kv._slice_threshold == 1000
+
+
+def test_p3_create_aliases():
+    kv = mx.kv.create("p3")
+    assert kv.type == "p3store_dist"
+
+
+def test_launch_local_spawns_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "out = sys.argv[1]\n"
+        "rank = os.environ['DMLC_WORKER_ID']\n"
+        "n = os.environ['DMLC_NUM_WORKER']\n"
+        "addr = os.environ['MXNET_COORDINATOR_ADDR']\n"
+        "open(os.path.join(out, f'rank{rank}.txt'), 'w')"
+        ".write(f'{rank}/{n}@{addr}')\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", "--",
+         sys.executable, str(script), str(tmp_path)],
+        capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode()
+    assert (tmp_path / "rank0.txt").read_text().startswith("0/2@")
+    assert (tmp_path / "rank1.txt").read_text().startswith("1/2@")
+
+
+def test_bandwidth_measure_mesh():
+    sys.path.insert(0, os.path.join(ROOT, "tools", "bandwidth"))
+    try:
+        import measure
+        r = measure.measure(size_mb=1.0, repeat=2)
+    finally:
+        sys.path.pop(0)
+    assert r["devices"] >= 1
+    assert r["alg_bw_GBps"] > 0
+
+
+def test_rec2idx_tool(tmp_path):
+    from mxnet_tpu import recordio
+    rec = str(tmp_path / "data.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(5):
+        w.write(f"record-{i}".encode())
+    w.close()
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "rec2idx.py"), rec],
+        capture_output=True, timeout=60)
+    assert r.returncode == 0, r.stderr.decode()
+    idx = (tmp_path / "data.idx").read_text().strip().splitlines()
+    assert len(idx) == 5
+    # idx positions let a reader seek directly
+    w = recordio.MXIndexedRecordIO(str(tmp_path / "data.idx"), rec, "r")
+    assert w.read_idx(3) == b"record-3"

@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Distributed job launcher.
+
+Parity: tools/launch.py (dmlc-tracker: --launcher local/ssh/mpi/sge/yarn
+spawning scheduler+servers+workers with the DMLC_* env protocol).
+TPU-native: the PS roles dissolve; the launcher starts N worker
+processes, each with the env `jax.distributed.initialize` needs —
+process 0 doubles as the coordinator.  `--launcher local` forks local
+processes (the multi-process test rig, parity:
+tests/nightly/test_distributed_training-gpu.sh); `--launcher ssh`
+starts workers over ssh; on real Cloud TPU pods, prefer
+`gcloud compute tpus tpu-vm ssh --worker=all` with the same env.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+
+
+def build_env(rank: int, args) -> dict:
+    env = dict(os.environ)
+    env.update({
+        "MXNET_COORDINATOR_ADDR": f"{args.host}:{args.port}",
+        "DMLC_NUM_WORKER": str(args.num_workers),
+        "DMLC_WORKER_ID": str(rank),
+        # legacy names some scripts read:
+        "DMLC_ROLE": "worker",
+        "DMLC_PS_ROOT_URI": args.host,
+        "DMLC_PS_ROOT_PORT": str(args.port),
+    })
+    return env
+
+
+def launch_local(args, command):
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            p = subprocess.Popen(command, env=build_env(rank, args))
+            procs.append(p)
+        code = 0
+        for p in procs:
+            code = p.wait() or code
+        return code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+def launch_ssh(args, command):
+    hosts = []
+    with open(args.hostfile) as f:
+        for line in f:
+            h = line.strip()
+            if h:
+                hosts.append(h)
+    if len(hosts) < args.num_workers:
+        raise SystemExit(f"hostfile has {len(hosts)} hosts, "
+                         f"need {args.num_workers}")
+    procs = []
+    for rank in range(args.num_workers):
+        env = build_env(rank, args)
+        env_prefix = " ".join(
+            f"{k}={v}" for k, v in env.items()
+            if k.startswith(("DMLC_", "MXNET_", "JAX_", "XLA_")))
+        remote = f"cd {os.getcwd()} && {env_prefix} {' '.join(command)}"
+        procs.append(subprocess.Popen(["ssh", "-o",
+                                       "StrictHostKeyChecking=no",
+                                       hosts[rank], remote]))
+    code = 0
+    for p in procs:
+        code = p.wait() or code
+    return code
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="number of worker processes")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("--host", default="127.0.0.1",
+                    help="coordinator address (process 0's host)")
+    ap.add_argument("--port", type=int, default=9123)
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    command = args.command
+    if command and command[0] == "--":
+        command = command[1:]
+    if args.launcher == "local":
+        sys.exit(launch_local(args, command))
+    if args.hostfile is None:
+        ap.error("ssh launcher needs --hostfile")
+    sys.exit(launch_ssh(args, command))
+
+
+if __name__ == "__main__":
+    main()

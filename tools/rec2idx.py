@@ -1,0 +1,40 @@
+#!/usr/bin/env python
+"""Regenerate the .idx file for a RecordIO file.
+
+Parity: tools/rec2idx.py (IndexCreator over dmlc recordio).  Uses the
+native recordio reader (src_native/recordio.cc via mxnet_tpu.recordio).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("record", help="path to .rec file")
+    ap.add_argument("idx_out", nargs="?", default=None,
+                    help="output .idx path (default: <record>.idx)")
+    args = ap.parse_args()
+    from mxnet_tpu import recordio
+    idx_path = args.idx_out or (os.path.splitext(args.record)[0] + ".idx")
+    reader = recordio.MXRecordIO(args.record, "r")
+    with open(idx_path, "w") as f:
+        i = 0
+        while True:
+            pos = reader.tell()
+            rec = reader.read()
+            if rec is None:
+                break
+            f.write(f"{i}\t{pos}\n")
+            i += 1
+    reader.close()
+    print(f"wrote {i} entries to {idx_path}")
+
+
+if __name__ == "__main__":
+    main()
