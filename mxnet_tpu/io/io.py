@@ -209,8 +209,12 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Background-thread prefetcher (parity: io.py PrefetchingIter over the
-    C++ threaded prefetcher, src/io/iter_prefetcher.h)."""
+    """Prefetcher scheduled on the native C++ dependency engine (parity:
+    io.py PrefetchingIter over the C++ threaded prefetcher,
+    src/io/iter_prefetcher.h): fetch tasks are engine ops serialized by a
+    mutable variable (exclusive access to the base iterator, ordered),
+    running on the engine's worker pool.  Falls back to a Python thread
+    if the native engine cannot load."""
 
     def __init__(self, iters, rename_data=None, rename_label=None,
                  prefetch_depth=2):
@@ -224,9 +228,33 @@ class PrefetchingIter(DataIter):
         self._queue: _queue.Queue = _queue.Queue(maxsize=prefetch_depth)
         self._thread = None
         self._stop = threading.Event()
+        try:
+            from ..engine import native_engine
+            self._engine = native_engine()
+            self._iter_var = self._engine.new_var()
+        except Exception:
+            self._engine = None
         self._start()
 
+    def _fetch_one(self):
+        if self._stop.is_set() or self._done:
+            return
+        try:
+            batch = self.iter.next()
+        except StopIteration:
+            self._done = True
+            self._queue.put(None)
+            return
+        self._queue.put(batch)
+
     def _start(self):
+        self._done = False
+        if self._engine is not None:
+            for _ in range(self._depth):
+                self._engine.push(self._fetch_one,
+                                  mutable_vars=[self._iter_var])
+            return
+
         def run():
             while not self._stop.is_set():
                 try:
@@ -240,13 +268,27 @@ class PrefetchingIter(DataIter):
 
     def reset(self):
         self._stop.set()
-        try:
+        if self._engine is not None:
+            # drain so in-flight fetch tasks can't block on a full queue
             while True:
-                self._queue.get_nowait()
-        except _queue.Empty:
-            pass
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+                try:
+                    self._queue.get_nowait()
+                except _queue.Empty:
+                    if self._engine is not None:
+                        self._engine.wait_for_var(self._iter_var)
+                    try:
+                        self._queue.get_nowait()
+                        continue
+                    except _queue.Empty:
+                        break
+        else:
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except _queue.Empty:
+                pass
+            if self._thread is not None:
+                self._thread.join(timeout=5)
         self._stop.clear()
         self.iter.reset()
         self._start()
@@ -255,6 +297,9 @@ class PrefetchingIter(DataIter):
         batch = self._queue.get()
         if batch is None:
             raise StopIteration
+        if self._engine is not None and not self._done:
+            # refill: one consumed → schedule one more fetch
+            self._engine.push(self._fetch_one, mutable_vars=[self._iter_var])
         return batch
 
     def iter_next(self):

@@ -41,11 +41,17 @@ class SPMDTrainer:
     def __init__(self, net, loss_fn: Callable, optimizer="sgd",
                  optimizer_params: Optional[dict] = None,
                  mesh: Optional[Mesh] = None, batch_axis: int = 0,
-                 donate: bool = True):
+                 donate: bool = True, dtype: Optional[str] = None):
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
         self.batch_axis = batch_axis
+        # mixed precision (parity: AMP bf16 — master weights stay f32,
+        # forward/backward compute in bf16 on the MXU; bf16 needs no loss
+        # scaling on TPU, SURVEY.md §7 stage 7)
+        self.amp_dtype = (jnp.bfloat16
+                          if dtype in ("bfloat16", "bf16", "float16")
+                          else None)
         self.optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self._params = net.collect_params()
         self._pkeys = list(self._params.keys())
@@ -77,18 +83,28 @@ class SPMDTrainer:
         params = [self._params[k] for k in pkeys]
         cell = {"aux": []}
 
+        amp = self.amp_dtype
+
         def step(key, lr, wd, p_arrays, opt_state, data, label):
             def loss_of(p_list):
                 tc = _TraceContext(key)
                 saved = [p._data for p in params]
+                if amp is not None:
+                    p_list = [a.astype(amp) if jnp.issubdtype(
+                        a.dtype, jnp.floating) else a for a in p_list]
+                    d_in = data.astype(amp) if jnp.issubdtype(
+                        data.dtype, jnp.floating) else data
+                else:
+                    d_in = data
                 try:
                     for p, a in zip(params, p_list):
                         p._data = NDArray(a)
                     with _trace_scope(tc), ag.pause(train_mode=True):
-                        out = net.forward(NDArray(data))
+                        out = net.forward(NDArray(d_in))
                         loss = loss_fn(out, NDArray(label))
                     cell["aux"] = list(tc.aux)
-                    return loss._data.mean(), tuple(v for _, v in tc.aux)
+                    return (loss._data.astype(jnp.float32).mean(),
+                            tuple(v for _, v in tc.aux))
                 finally:
                     for p, s in zip(params, saved):
                         p._data = s
