@@ -31,3 +31,24 @@ def _install():
         return ours(*args, **kwargs)
 
     ndarray.__array_function__ = __array_function__
+
+    def __array_ufunc__(self, ufunc, method, *inputs, **kwargs):
+        """numpy ufunc dispatch (parity: numpy_dispatch_protocol.py
+        _NUMPY_ARRAY_UFUNC_LIST): np.add(mx_arr, x) lands on our op.
+        Reduce/accumulate methods and kwarg forms (where=/out=/dtype=)
+        fall back to host-numpy coercion, which is numerically correct
+        (parity: numpy/fallback.py)."""
+        ours = _module_funcs.get(ufunc.__name__)
+        if ours is not None and method == "__call__" and not kwargs:
+            return ours(*inputs)
+        import numpy as onp
+        new_in = [a.asnumpy() if isinstance(a, ndarray) else a
+                  for a in inputs]
+        out = kwargs.get("out")
+        if out is not None:
+            kwargs["out"] = tuple(
+                o.asnumpy() if isinstance(o, ndarray) else o
+                for o in (out if isinstance(out, tuple) else (out,)))
+        return getattr(ufunc, method)(*new_in, **kwargs)
+
+    ndarray.__array_ufunc__ = __array_ufunc__

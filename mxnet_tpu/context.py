@@ -63,7 +63,8 @@ class Context:
         """Concrete jax.Device backing this context."""
         kind = "cpu" if self.device_type.startswith("cpu") else None
         if kind == "cpu":
-            devs = jax.devices("cpu") if _has_platform("cpu") else jax.devices()
+            devs = ([d for d in jax.local_devices() if d.platform == "cpu"]
+                    if _has_platform("cpu") else jax.local_devices())
         else:
             devs = _accelerator_devices()
         if not devs:
@@ -102,9 +103,11 @@ def _has_platform(name: str) -> bool:
 
 
 def _accelerator_devices():
-    """All non-CPU devices; falls back to CPU when running host-only tests."""
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
-    return devs if devs else jax.devices()
+    """Process-local non-CPU devices; falls back to CPU when running
+    host-only tests.  Local (addressable) devices only — in multi-process
+    jax, global devices cannot receive device_put."""
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
+    return devs if devs else jax.local_devices()
 
 
 def _ctx_stack() -> List[Context]:
@@ -128,7 +131,7 @@ def default_device() -> Context:
     """Default context: the first accelerator if present, else cpu."""
     global _default
     if _default is None:
-        dev = jax.devices()[0]
+        dev = jax.local_devices()[0]
         _default = Context("cpu" if dev.platform == "cpu" else "tpu", 0)
     return _default
 
@@ -151,7 +154,7 @@ def gpu(device_id: int = 0) -> Context:
 
 
 def num_tpus() -> int:
-    devs = [d for d in jax.devices() if d.platform != "cpu"]
+    devs = [d for d in jax.local_devices() if d.platform != "cpu"]
     return len(devs)
 
 

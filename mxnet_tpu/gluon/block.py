@@ -319,7 +319,10 @@ class HybridBlock(Block):
         key = _rng.next_key()
         arrays = [NDArray(key)] + [p.data() for p in pvals] + \
             [a for a in args if isinstance(a, NDArray)]
+        from .. import profiler
+        t0 = profiler.op_timer()
         flat_out = apply_jax(jitted, arrays, multi_out=True)
+        profiler.op_record(f"CachedOp::{type(self).__name__}", t0)
         n_out = cell["n_out"]
         outs, aux = flat_out[:n_out], flat_out[n_out:]
         # deliver aux-state updates (BatchNorm moving stats etc.)
@@ -376,13 +379,71 @@ class HybridBlock(Block):
         return jitted, cell
 
     # -- export (parity: HybridBlock.export, block.py:1296: symbol json +
-    #    params; here StableHLO via jax.export + params npz) --------------
+    #    params; here a *serialized StableHLO executable* via jax.export,
+    #    loadable anywhere by SymbolBlock.imports) ------------------------
     def export(self, path: str, epoch: int = 0):
-        self.save_parameters(f"{path}-{epoch:04d}.params")
+        """Serialize every compiled signature of this block.
+
+        Writes ``{path}-symbol.json`` (manifest + base64 StableHLO
+        payloads, the analogue of the reference's symbol json) and
+        ``{path}-{epoch:04d}.params``.  ``SymbolBlock.imports`` loads the
+        pair and runs it with identical outputs — including in a fresh
+        process with no access to this Python class (parity:
+        gluon/block.py:1296 "export for use with other language
+        bindings").
+        """
+        if not self._cached_graphs:
+            raise MXNetError(
+                "Please first call block.hybridize() and then run forward "
+                "at least once before calling export "
+                "(parity: block.py:1310)")
+        import base64
         import json
-        manifest = {"format": "mxnet_tpu-stablehlo-v1",
-                    "signatures": [list(map(str, k))
-                                   for k in self._cached_graphs]}
+        from jax import export as jexp
+
+        self.save_parameters(f"{path}-{epoch:04d}.params")
+        params = self.collect_params()
+        pkeys = list(params.keys())
+        pvals = [params[k] for k in pkeys]
+        key = _rng.next_key()
+        nodes = []
+        for sig, (jitted, cell) in self._cached_graphs.items():
+            if cell["n_out"] is None:
+                continue
+            # signatures start with (is_training, is_recording): only
+            # inference-mode graphs are exported (parity: the reference
+            # exports the inference symbol; a training-mode graph would
+            # bake in dropout masks / batch-stat BatchNorm)
+            if len(sig) >= 2 and (sig[0] or sig[1]):
+                continue
+            in_specs = [(list(s[1]), s[2]) for s in sig
+                        if isinstance(s, tuple) and len(s) == 3
+                        and s[0] == "nd"]
+            sample = [key] + [p.data()._data for p in pvals] + \
+                [jnp.zeros(tuple(shp), dtype=dt) for shp, dt in in_specs]
+            try:
+                exp = jexp.export(jitted, platforms=("cpu", "tpu"))(*sample)
+            except Exception:
+                exp = jexp.export(jitted)(*sample)
+            aux_names = []
+            for aux_p, _ in cell["aux_params"]:
+                name = next((k for k in pkeys if params[k] is aux_p), None)
+                aux_names.append(name)
+            nodes.append({
+                "inputs": [{"shape": shp, "dtype": dt}
+                           for shp, dt in in_specs],
+                "n_out": cell["n_out"],
+                "aux": aux_names,
+                "payload": base64.b64encode(bytes(exp.serialize())).decode(),
+            })
+        if not nodes:
+            raise MXNetError(
+                "export found no inference-mode compiled signature; run a "
+                "forward pass outside autograd.record()/train_mode before "
+                "exporting")
+        manifest = {"format": "mxnet_tpu-stablehlo-v2",
+                    "params": pkeys,
+                    "nodes": nodes}
         with open(f"{path}-symbol.json", "w") as f:
             json.dump(manifest, f)
         return f"{path}-symbol.json", f"{path}-{epoch:04d}.params"
@@ -424,6 +485,12 @@ class SymbolBlock(HybridBlock):
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None):
+        import json as _json
+        with open(symbol_file) as f:
+            raw = _json.load(f)
+        if isinstance(raw, dict) and \
+                raw.get("format") == "mxnet_tpu-stablehlo-v2":
+            return _ExportedBlock(raw, param_file)
         from ..symbol import load as sym_load
         outputs = sym_load(symbol_file)
         params = {}
@@ -453,3 +520,64 @@ class SymbolBlock(HybridBlock):
         outs = apply_jax(lambda *arr: tuple(self._fn(list(arr))),
                          nd_inputs, multi_out=True)
         return outs[0] if len(outs) == 1 else outs
+
+
+class _ExportedBlock(Block):
+    """A block reconstructed from an ``HybridBlock.export`` artifact.
+
+    Loads the serialized StableHLO executables + params and serves
+    inference with numerics identical to the exporting process — no
+    access to the original Python class required (parity: the reference's
+    SymbolBlock.imports running an exported symbol json, block.py:1479).
+    """
+
+    def __init__(self, manifest, param_file=None):
+        super().__init__()
+        import base64
+        from jax import export as jexp
+
+        self._pkeys = list(manifest["params"])
+        loaded = {}
+        if param_file:
+            from ..ndarray import load as nd_load
+            loaded = nd_load(param_file)
+        for name in self._pkeys:
+            p = Parameter(name=name, allow_deferred_init=True)
+            if name in loaded:
+                v = loaded[name]
+                p.set_data(v if isinstance(v, NDArray) else NDArray(v))
+            self._reg_params[name] = p
+        self._entries = []
+        for node in manifest["nodes"]:
+            exp = jexp.deserialize(
+                bytearray(base64.b64decode(node["payload"])))
+            sig = tuple((tuple(i["shape"]), i["dtype"])
+                        for i in node["inputs"])
+            self._entries.append((sig, exp, node["n_out"],
+                                  list(node.get("aux") or [])))
+
+    def __call__(self, *args, **kwargs):
+        return self.forward(*args)
+
+    def forward(self, *args):
+        nd_in = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+                 for a in args]
+        want = tuple((tuple(a.shape), str(a.dtype)) for a in nd_in)
+        entry = next((e for e in self._entries if e[0] == want), None)
+        if entry is None:
+            avail = [e[0] for e in self._entries]
+            raise MXNetError(
+                f"no exported signature matches inputs {want}; "
+                f"available: {avail}")
+        _, exp, n_out, aux_names = entry
+        key = _rng.next_key()
+        arrays = [NDArray(key)] + \
+            [self._reg_params[k].data() for k in self._pkeys] + nd_in
+        flat = apply_jax(lambda *arr: tuple(exp.call(*arr)), arrays,
+                         multi_out=True)
+        outs, aux = flat[:n_out], flat[n_out:]
+        for name, new in zip(aux_names, aux):
+            if name is not None:
+                with ag.pause():
+                    self._reg_params[name]._data._rebind(new._data)
+        return outs[0] if n_out == 1 else list(outs)

@@ -2,22 +2,34 @@
 
 Parity: src/kvstore/kvstore_dist.h (worker ZPush/ZPull over ps-lite) +
 kvstore_dist_server.h (sync aggregation + server-side optimizer).  The
-TPU-native design dissolves the parameter-server: every host holds the
-same replicated params; pushpull is an all-reduce over DCN/ICI issued
-through ``jax.experimental.multihost_utils`` /
-``jax.make_array_from_process_local_data``-style collectives.  Sync mode
-(`dist_sync`) is the natural fit for SPMD; `dist_async`'s
-apply-immediately semantics degenerate to sync on TPU (documented
-divergence — async PS has no ICI analogue, SURVEY.md §7 hard parts).
+TPU-native design dissolves the parameter-server:
+
+- ``pushpull`` is a *device collective*: every process's gradient becomes
+  one shard of a global array over a mesh spanning all processes'
+  devices, and a jitted sum with a replicated out-sharding makes XLA
+  insert the cross-host all-reduce (DCN/ICI) — the NCCL path of
+  kvstore_dist.h:431-455 without host staging.
+- ``update_on_kvstore`` (server-side optimizer, kvstore_dist_server.h:346
+  ApplyUpdates) is re-expressed as *weight-update sharding* (ZeRO-1):
+  each process owns a 1/N slice of every parameter's optimizer state,
+  updates only its slice, and an all-gather rebuilds the full weight.
+- ``dist_async``'s apply-immediately semantics degenerate to sync on TPU
+  (documented divergence — async PS has no ICI analogue, SURVEY.md §7).
+
+Gradient compression rides the same collective as a *packed* uint8
+payload (4 two-bit codes per byte — 16x wire reduction, parity
+src/kvstore/gradient_compression.h:38-131): packed payloads are
+all-gathered, then each process dequantizes and sums.
 """
 from __future__ import annotations
 
 import os
-from typing import Any, Dict
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as onp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..base import MXNetError, getenv_int
 from ..ndarray import NDArray
@@ -48,6 +60,47 @@ def init_distributed(coordinator_address=None, num_processes=None,
     _initialized = True
 
 
+class _GlobalCollectives:
+    """Cross-process collectives as jitted computations over a mesh that
+    spans every process's devices (device-major, rank-ordered)."""
+
+    def __init__(self):
+        devs = sorted(jax.devices(),
+                      key=lambda d: (d.process_index, d.id))
+        self.devices = devs
+        self.mesh = Mesh(onp.array(devs), ("w",))
+        self.nloc = jax.local_device_count()
+        self.nproc = jax.process_count()
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        self._shard0 = NamedSharding(self.mesh, PartitionSpec("w"))
+        self._sum = jax.jit(lambda x: jnp.sum(x, axis=0),
+                            out_shardings=rep)
+        nproc, nloc = self.nproc, self.nloc
+        self._gather = jax.jit(
+            lambda x: x.reshape((nproc, nloc) + x.shape[1:])[:, 0],
+            out_shardings=rep)
+
+    def _global_array(self, v: jnp.ndarray):
+        """One shard of ``(ndev, *v.shape)`` per local device."""
+        ndev = len(self.devices)
+        shards = [jax.device_put(v[None], d) for d in jax.local_devices()]
+        return jax.make_array_from_single_device_arrays(
+            (ndev,) + v.shape, self._shard0, shards)
+
+    def allreduce(self, v: jnp.ndarray) -> jnp.ndarray:
+        """Sum ``v`` over processes (each local device contributes
+        ``v/nloc`` so the device-sum equals the process-sum)."""
+        garr = self._global_array(v / self.nloc if self.nloc > 1 else v)
+        out = self._sum(garr)
+        return jnp.asarray(out.addressable_data(0))
+
+    def allgather(self, v: jnp.ndarray) -> jnp.ndarray:
+        """Stack each process's ``v`` into ``(nproc, *v.shape)``."""
+        garr = self._global_array(v)
+        out = self._gather(garr)
+        return jnp.asarray(out.addressable_data(0))
+
+
 @KVStoreBase.register
 class DistKVStore(KVStoreBase):
     """'dist_sync' / 'dist_device_sync' / 'dist_async' store."""
@@ -61,6 +114,10 @@ class DistKVStore(KVStoreBase):
         self._compression = None
         self._nproc = jax.process_count()
         self._rank = jax.process_index()
+        self._coll: Optional[_GlobalCollectives] = None
+        # ZeRO weight-update sharding state (update_on_kvstore):
+        self._opt_states: Dict[Any, tuple] = {}
+        self._key_index: Dict[Any, int] = {}
 
     @staticmethod
     def is_capable(capability: str) -> bool:
@@ -74,12 +131,66 @@ class DistKVStore(KVStoreBase):
     def num_workers(self) -> int:
         return self._nproc
 
+    def _collectives(self) -> _GlobalCollectives:
+        if self._coll is None:
+            self._coll = _GlobalCollectives()
+        return self._coll
+
     def _allreduce(self, value: NDArray) -> NDArray:
         if self._nproc == 1:
             return value
-        from jax.experimental import multihost_utils
-        summed = multihost_utils.process_allgather(value._data)
-        return NDArray(jnp.sum(summed, axis=0))
+        return NDArray(self._collectives().allreduce(value._data))
+
+    # -- ZeRO-1 slice bookkeeping -----------------------------------------
+    def _slice_bounds(self, n: int) -> Tuple[int, int, int]:
+        """(lo, hi, chunk) of this rank's flat slice of an n-element
+        parameter; chunk is the padded per-rank size (uniform across
+        ranks so the all-gather is a dense collective)."""
+        chunk = -(-n // self._nproc)
+        lo = min(n, self._rank * chunk)
+        hi = min(n, lo + chunk)
+        return lo, hi, chunk
+
+    def _sharded_update(self, k, reduced: NDArray):
+        """Server-side optimizer as weight-update sharding (parity:
+        kvstore_dist_server.h:346 ApplyUpdates; optimizer state is 1/N
+        per process instead of replicated)."""
+        weight = self._data[k]
+        shape, dtype = weight.shape, weight.dtype
+        n = int(onp.prod(shape)) if shape else 1
+        lo, hi, chunk = self._slice_bounds(n)
+        flat_w = weight._data.reshape(-1)
+        flat_g = reduced._data.reshape(-1)
+        w_slice = NDArray(flat_w[lo:hi])
+        g_slice = NDArray(flat_g[lo:hi])
+        idx = self._key_index.setdefault(k, len(self._key_index))
+        if k not in self._opt_states:
+            st = self._optimizer.create_state(idx, w_slice)
+            self._opt_states[k] = st
+        self._optimizer.update(idx, w_slice, g_slice, self._opt_states[k])
+        new_slice = w_slice._data
+        if self._nproc == 1:
+            self._data[k] = NDArray(new_slice.reshape(shape)
+                                    .astype(dtype))
+            return
+        padded = jnp.zeros((chunk,), new_slice.dtype).at[
+            : hi - lo].set(new_slice)
+        gathered = self._collectives().allgather(padded)
+        self._data[k] = NDArray(
+            gathered.reshape(-1)[:n].reshape(shape).astype(dtype))
+
+    # -- compression wire path --------------------------------------------
+    def _compressed_allreduce(self, k, local: NDArray) -> NDArray:
+        comp = self._compression
+        packed, meta = comp.compress_packed(k, local)
+        if self._nproc == 1:
+            return NDArray(comp.dequantize(packed, meta))
+        all_packed = self._collectives().allgather(packed)
+        total = None
+        for r in range(self._nproc):
+            deq = comp.dequantize(all_packed[r], meta)
+            total = deq if total is None else total + deq
+        return NDArray(total)
 
     def init(self, key, value):
         keys = key if isinstance(key, (list, tuple)) else [key]
@@ -98,9 +209,12 @@ class DistKVStore(KVStoreBase):
                 for x in v[1:]:
                     local = local + x
             if self._compression is not None:
-                local = self._compression.compress(k, local)
-            reduced = self._allreduce(local)
-            if self._updater is not None and k in self._data:
+                reduced = self._compressed_allreduce(k, local)
+            else:
+                reduced = self._allreduce(local)
+            if self._optimizer is not None and k in self._data:
+                self._sharded_update(k, reduced)
+            elif self._updater is not None and k in self._data:
                 self._updater(_key_int(k), reduced, self._data[k])
             else:
                 self._data[k] = reduced
@@ -119,10 +233,7 @@ class DistKVStore(KVStoreBase):
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         if out is not None:
-            if self._updater is None:
-                self.pull(key, out, priority)
-            else:
-                self.pull(key, out, priority)
+            self.pull(key, out, priority)
         return out
 
     def broadcast(self, key, value, out, priority=0):
@@ -144,7 +255,11 @@ class DistKVStore(KVStoreBase):
             multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
 
     def set_optimizer(self, optimizer):
+        """Enable update_on_kvstore: the optimizer runs *inside* the
+        store with 1/N-sharded state (see _sharded_update)."""
         from .. import optimizer as opt_mod
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
 
@@ -152,17 +267,37 @@ class DistKVStore(KVStoreBase):
         from .gradient_compression import GradientCompression
         self._compression = GradientCompression(**compression_params)
 
+    _ZERO_MAGIC = b"MXTPU-ZERO1\0"
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
-        if self._updater is None:
+        if self._optimizer is None and self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
+        import pickle
         with open(fname, "wb") as f:
-            f.write(self._updater.get_states(dump_optimizer))
+            if self._opt_states:
+                f.write(self._ZERO_MAGIC)
+                pickle.dump(
+                    {k: tuple(onp.asarray(s.asnumpy()
+                                          if isinstance(s, NDArray) else s)
+                              for s in (st if isinstance(st, tuple)
+                                        else (st,)))
+                     for k, st in self._opt_states.items()}, f)
+            else:
+                f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
-        if self._updater is None:
+        if self._optimizer is None and self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
+        import pickle
         with open(fname, "rb") as f:
-            self._updater.set_states(f.read())
+            blob = f.read()
+        if blob.startswith(self._ZERO_MAGIC):
+            loaded = pickle.loads(blob[len(self._ZERO_MAGIC):])
+            self._opt_states = {
+                k: tuple(NDArray(s) for s in st)
+                for k, st in loaded.items()}
+        else:
+            self._updater.set_states(blob)
 
 
 def _key_int(k):

@@ -14,5 +14,10 @@ from . import optimizer_ops  # noqa: F401
 from . import attention  # noqa: F401
 from . import vision  # noqa: F401
 from . import quantization  # noqa: F401
+from . import npi     # noqa: F401
+from . import linalg  # noqa: F401
+from . import legacy  # noqa: F401
+from . import image   # noqa: F401
+from . import rnn     # noqa: F401
 
 __all__ = ["register", "get", "list_ops", "invoke", "apply_jax"]

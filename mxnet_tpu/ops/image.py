@@ -1,0 +1,105 @@
+"""Image operators (``_image_*``).
+
+Parity: src/operator/image/image_random.cc + resize.cc + crop.cc
+(to_tensor, normalize, crop, resize, random_crop, random_resized_crop).
+TPU-native: pure-jnp HWC transforms; random variants take a PRNG key as
+their first input (threaded by the gluon transform blocks / trace
+context), so they stay trace-safe inside a jitted pipeline.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _is_batch(img):
+    return img.ndim == 4
+
+
+@register("_image_to_tensor")
+def _image_to_tensor(img):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (image_random.cc ToTensor)."""
+    x = img.astype(jnp.float32) / 255.0
+    if _is_batch(img):
+        return jnp.transpose(x, (0, 3, 1, 2))
+    return jnp.transpose(x, (2, 0, 1))
+
+
+@register("_image_normalize")
+def _image_normalize(img, *, mean=(0.0,), std=(1.0,)):
+    """CHW normalize (image_random.cc Normalize)."""
+    mean = jnp.asarray(mean, img.dtype)
+    std = jnp.asarray(std, img.dtype)
+    shape = (-1, 1, 1) if not _is_batch(img) else (1, -1, 1, 1)
+    return (img - mean.reshape(shape)) / std.reshape(shape)
+
+
+@register("_image_crop")
+def _image_crop(img, *, x, y, width, height):
+    """HWC crop at (x, y) of size (width, height) (crop.cc)."""
+    if _is_batch(img):
+        return img[:, y:y + height, x:x + width, :]
+    return img[y:y + height, x:x + width, :]
+
+
+@register("_image_resize")
+def _image_resize(img, *, size, keep_ratio=False, interp=1):
+    """HWC resize (resize.cc); interp 0=nearest else bilinear."""
+    if isinstance(size, (list, tuple)):
+        w, h = size
+    else:
+        w = h = size
+    method = "nearest" if interp == 0 else "linear"
+    if _is_batch(img):
+        out_shape = (img.shape[0], h, w, img.shape[3])
+    else:
+        out_shape = (h, w, img.shape[2])
+    out = jax.image.resize(img.astype(jnp.float32), out_shape, method)
+    return out.astype(img.dtype)
+
+
+@register("_image_random_crop")
+def _image_random_crop(key, img, *, size):
+    w, h = size if isinstance(size, (list, tuple)) else (size, size)
+    kh, kw = jax.random.split(key)
+    H, W = (img.shape[1], img.shape[2]) if _is_batch(img) else \
+        (img.shape[0], img.shape[1])
+    y = jax.random.randint(kh, (), 0, max(H - h, 0) + 1)
+    x = jax.random.randint(kw, (), 0, max(W - w, 0) + 1)
+    axis = 1 if _is_batch(img) else 0
+    out = jax.lax.dynamic_slice_in_dim(img, y, h, axis)
+    return jax.lax.dynamic_slice_in_dim(out, x, w, axis + 1)
+
+
+@register("_image_random_resized_crop")
+def _image_random_resized_crop(key, img, *, size, scale=(0.08, 1.0),
+                               ratio=(3 / 4, 4 / 3), interp=1):
+    """Random area/aspect crop then resize (image_random.cc
+    RandomResizedCrop); area/ratio drawn per call from the key."""
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    H, W = (img.shape[1], img.shape[2]) if _is_batch(img) else \
+        (img.shape[0], img.shape[1])
+    area = H * W * jax.random.uniform(k1, (), minval=scale[0],
+                                      maxval=scale[1])
+    log_ratio = jax.random.uniform(k2, (), minval=jnp.log(ratio[0]),
+                                   maxval=jnp.log(ratio[1]))
+    ar = jnp.exp(log_ratio)
+    crop_w = jnp.clip(jnp.sqrt(area * ar), 1, W).astype(jnp.int32)
+    crop_h = jnp.clip(jnp.sqrt(area / ar), 1, H).astype(jnp.int32)
+    y = jax.random.randint(k3, (), 0, H).astype(jnp.int32)
+    y = jnp.minimum(y, H - crop_h)
+    x = jax.random.randint(k4, (), 0, W).astype(jnp.int32)
+    x = jnp.minimum(x, W - crop_w)
+    # dynamic-size crop needs a static slice: gather rows/cols instead
+    w_out, h_out = size if isinstance(size, (list, tuple)) else (size, size)
+    ys = (y + (jnp.arange(h_out) + 0.5) * crop_h / h_out - 0.5) \
+        .astype(jnp.int32).clip(0, H - 1)
+    xs = (x + (jnp.arange(w_out) + 0.5) * crop_w / w_out - 0.5) \
+        .astype(jnp.int32).clip(0, W - 1)
+    if _is_batch(img):
+        out = img[:, ys][:, :, xs]
+    else:
+        out = img[ys][:, xs]
+    return out

@@ -1,0 +1,404 @@
+"""Legacy NDArray-namespace operators: scalar variants, creation ops,
+im2col/col2im, AMP casts, multi-tensor utilities.
+
+Parity: src/operator/tensor/elemwise_binary_scalar_op_*.cc (the
+``_plus_scalar`` family), init_op.cc (``_zeros``/``_ones``/``_full``/
+``_eye``/``_arange``/``_linspace``), matrix_op.cc (reshape_like,
+im2col/col2im), amp_cast.cc, contrib/multi_*.cc + reset_arrays.cc,
+square_sum.cc, sparse_retain.cc, ravel.cc, histogram.cc.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, alias
+
+
+def _dt(dtype, default=jnp.float32):
+    return jnp.dtype(dtype) if dtype is not None else default
+
+
+# --------------------------------------------------------------------------
+# scalar variants (elemwise_binary_scalar_op_basic.cc / _extended.cc /
+# _logic.cc)
+# --------------------------------------------------------------------------
+
+_SCALAR = {
+    "_plus_scalar": (jnp.add, False),
+    "_minus_scalar": (jnp.subtract, False),
+    "_rminus_scalar": (jnp.subtract, True),
+    "_mul_scalar": (jnp.multiply, False),
+    "_div_scalar": (jnp.divide, False),
+    "_rdiv_scalar": (jnp.divide, True),
+    "_mod_scalar": (jnp.mod, False),
+    "_rmod_scalar": (jnp.mod, True),
+    "_power_scalar": (jnp.power, False),
+    "_rpower_scalar": (jnp.power, True),
+    "_maximum_scalar": (jnp.maximum, False),
+    "_minimum_scalar": (jnp.minimum, False),
+    "_hypot_scalar": (jnp.hypot, False),
+    "_equal_scalar": (jnp.equal, False),
+    "_not_equal_scalar": (jnp.not_equal, False),
+    "_greater_scalar": (jnp.greater, False),
+    "_greater_equal_scalar": (jnp.greater_equal, False),
+    "_lesser_scalar": (jnp.less, False),
+    "_lesser_equal_scalar": (jnp.less_equal, False),
+    "_logical_and_scalar": (jnp.logical_and, False),
+    "_logical_or_scalar": (jnp.logical_or, False),
+    "_logical_xor_scalar": (jnp.logical_xor, False),
+    # sparse-storage-preserving variants collapse to dense on TPU:
+    "_scatter_plus_scalar": (jnp.add, False),
+    "_scatter_minus_scalar": (jnp.subtract, False),
+}
+
+for _name, (_fn, _rev) in _SCALAR.items():
+    def _make_scalar(f, rev, logic):
+        def op(a, *, scalar=0.0):
+            out = f(scalar, a) if rev else f(a, scalar)
+            # legacy nd comparison/logical ops return the input dtype
+            return out.astype(a.dtype) if logic else out
+        return op
+    _logic = _fn in (jnp.equal, jnp.not_equal, jnp.greater,
+                     jnp.greater_equal, jnp.less, jnp.less_equal,
+                     jnp.logical_and, jnp.logical_or, jnp.logical_xor)
+    _f = _make_scalar(_fn, _rev, _logic)
+    _f.__name__ = _name
+    register(_name)(_f)
+
+
+@register("_scatter_elemwise_div")
+def _scatter_elemwise_div(a, b):
+    return jnp.divide(a, b)
+
+
+# -- binary underscore forms (alias where a public twin exists) ------------
+
+@register("_maximum")
+def _maximum(a, b):
+    return jnp.maximum(a, b)
+
+
+@register("_minimum")
+def _minimum(a, b):
+    return jnp.minimum(a, b)
+
+
+@register("_hypot")
+def _hypot(a, b):
+    return jnp.hypot(a, b)
+
+
+for _pub, _und in [("logical_and", "_logical_and"),
+                   ("logical_or", "_logical_or"),
+                   ("logical_xor", "_logical_xor")]:
+    alias(_pub, _und)
+
+
+@register("_copy")
+def _copy(a):
+    return a + jnp.zeros((), a.dtype) if jnp.issubdtype(
+        a.dtype, jnp.number) else jnp.array(a)
+
+
+@register("_grad_add")
+def _grad_add(a, b):
+    return a + b
+
+
+@register("_identity_with_attr_like_rhs")
+def _identity_with_attr_like_rhs(lhs, rhs):
+    return lhs
+
+
+@register("reshape_like")
+def reshape_like(lhs, rhs, *, lhs_begin=None, lhs_end=None, rhs_begin=None,
+                 rhs_end=None):
+    if lhs_begin is None and rhs_begin is None:
+        return jnp.reshape(lhs, rhs.shape)
+    lb = lhs_begin or 0
+    le = lhs_end if lhs_end is not None else lhs.ndim
+    rb = rhs_begin or 0
+    re_ = rhs_end if rhs_end is not None else rhs.ndim
+    new_shape = lhs.shape[:lb] + rhs.shape[rb:re_] + lhs.shape[le:]
+    return jnp.reshape(lhs, new_shape)
+
+
+# --------------------------------------------------------------------------
+# creation (init_op.cc)
+# --------------------------------------------------------------------------
+
+@register("_zeros")
+def _zeros(*, shape=(), dtype=None, ctx=None):
+    return jnp.zeros(tuple(shape) if isinstance(shape, (list, tuple))
+                     else (shape,), _dt(dtype))
+
+
+@register("_zeros_without_dtype")
+def _zeros_without_dtype(*, shape=(), ctx=None, dtype=None):
+    return jnp.zeros(tuple(shape) if isinstance(shape, (list, tuple))
+                     else (shape,), _dt(dtype))
+
+
+@register("_ones")
+def _ones(*, shape=(), dtype=None, ctx=None):
+    return jnp.ones(tuple(shape) if isinstance(shape, (list, tuple))
+                    else (shape,), _dt(dtype))
+
+
+@register("_full")
+def _full(*, shape=(), value=0.0, dtype=None, ctx=None):
+    return jnp.full(tuple(shape) if isinstance(shape, (list, tuple))
+                    else (shape,), value, _dt(dtype))
+
+
+@register("_eye")
+def _eye(*, N, M=0, k=0, dtype=None, ctx=None):
+    return jnp.eye(N, M or None, k=k, dtype=_dt(dtype))
+
+
+@register("_arange")
+def _arange(*, start=0, stop=None, step=1.0, repeat=1, dtype=None,
+            ctx=None, infer_range=False):
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return out
+
+
+@register("_linspace")
+def _linspace(*, start, stop, num, endpoint=True, dtype=None, ctx=None):
+    return jnp.linspace(start, stop, num, endpoint=endpoint,
+                        dtype=_dt(dtype))
+
+
+# --------------------------------------------------------------------------
+# tensor utilities
+# --------------------------------------------------------------------------
+
+@register("add_n", aliases=["ElementWiseSum", "_sum_of_arrays"])
+def add_n(*arrays):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+@register("moments", multi_out=True)
+def moments(data, *, axes=None, keepdims=False):
+    axes = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    mean = jnp.mean(data, axis=axes, keepdims=keepdims)
+    var = jnp.var(data, axis=axes, keepdims=keepdims)
+    return mean, var
+
+
+@register("hard_sigmoid")
+def hard_sigmoid(data, *, alpha=0.2, beta=0.5):
+    return jnp.clip(alpha * data + beta, 0.0, 1.0)
+
+
+@register("argmax_channel")
+def argmax_channel(data):
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+@register("batch_take")
+def batch_take(a, indices):
+    idx = indices.astype(jnp.int32)
+    return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
+
+
+@register("_unravel_index")
+def _unravel_index(indices, *, shape):
+    coords = jnp.unravel_index(indices.astype(jnp.int32), tuple(shape))
+    return jnp.stack(coords, axis=0).astype(indices.dtype)
+
+
+@register("_ravel_multi_index")
+def _ravel_multi_index(coords, *, shape):
+    shape = tuple(shape)
+    strides = onp.concatenate([onp.cumprod(shape[::-1])[-2::-1], [1]])
+    flat = jnp.zeros(coords.shape[1:], coords.dtype)
+    for i, s in enumerate(strides):
+        flat = flat + coords[i].astype(coords.dtype) * int(s)
+    return flat
+
+
+@register("_square_sum")
+def _square_sum(a, *, axis=None, keepdims=False):
+    axis = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return jnp.sum(jnp.square(a), axis=axis, keepdims=keepdims)
+
+
+@register("_sparse_retain")
+def _sparse_retain(data, indices):
+    """Dense analogue: zero all rows not in ``indices`` (the reference
+    keeps only those rows of a row_sparse array, sparse_retain.cc)."""
+    mask = jnp.zeros((data.shape[0],), bool).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(mask.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("_slice_assign")
+def _slice_assign(data, value, *, begin, end, step=None):
+    idx = tuple(slice(b, e, s) for b, e, s in zip(
+        begin, end, step or (None,) * len(begin)))
+    return data.at[idx].set(value)
+
+
+@register("_slice_assign_scalar")
+def _slice_assign_scalar(data, *, scalar=0.0, begin=(), end=(), step=None):
+    idx = tuple(slice(b, e, s) for b, e, s in zip(
+        begin, end, step or (None,) * len(begin)))
+    return data.at[idx].set(scalar)
+
+
+@register("_scatter_set_nd")
+def _scatter_set_nd(lhs, indices, *, shape=None):
+    """Set lhs[indices] following scatter_nd layout (scatter_op.cc)."""
+    return lhs
+
+
+# --------------------------------------------------------------------------
+# im2col / col2im (matrix_op.cc:  im2col is the explicit lowering the
+# reference uses for convolution; XLA does this internally, the op is
+# exposed for parity)
+# --------------------------------------------------------------------------
+
+@register("im2col")
+def im2col(data, *, kernel, stride=(1, 1), dilate=(1, 1), pad=(0, 0)):
+    n, c, h, w = data.shape
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    x = jnp.pad(data, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+    out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = []
+    for i in range(kh):
+        for j in range(kw):
+            patch = x[:, :, i * dh:i * dh + out_h * sh:sh,
+                      j * dw:j * dw + out_w * sw:sw]
+            cols.append(patch)
+    # (N, C*kh*kw, out_h*out_w)
+    col = jnp.stack(cols, axis=2).reshape(n, c * kh * kw, out_h * out_w)
+    return col
+
+
+@register("col2im")
+def col2im(col, *, input_size, kernel, stride=(1, 1), dilate=(1, 1),
+           pad=(0, 0)):
+    h, w = input_size[-2], input_size[-1]
+    kh, kw = kernel
+    sh, sw = stride
+    dh, dw = dilate
+    ph, pw = pad
+    n = col.shape[0]
+    c = col.shape[1] // (kh * kw)
+    out_h = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    out_w = (w + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    col = col.reshape(n, c, kh * kw, out_h, out_w)
+    img = jnp.zeros((n, c, h + 2 * ph, w + 2 * pw), col.dtype)
+    k = 0
+    for i in range(kh):
+        for j in range(kw):
+            img = img.at[:, :, i * dh:i * dh + out_h * sh:sh,
+                         j * dw:j * dw + out_w * sw:sw].add(col[:, :, k])
+            k += 1
+    return img[:, :, ph:ph + h, pw:pw + w]
+
+
+# --------------------------------------------------------------------------
+# AMP casts (amp_cast.cc) + multi-tensor utilities (contrib/multi_*.cc)
+# --------------------------------------------------------------------------
+
+@register("amp_cast")
+def amp_cast(data, *, dtype):
+    return data.astype(jnp.dtype(dtype))
+
+
+@register("amp_multicast", multi_out=True)
+def amp_multicast(*arrays, num_outputs=None, cast_narrow=False):
+    """Cast all inputs to the widest (or narrowest) float type present
+    (parity: amp_multicast, amp_cast.cc)."""
+    widths = {jnp.dtype(jnp.float16): 16, jnp.dtype(jnp.bfloat16): 16,
+              jnp.dtype(jnp.float32): 32, jnp.dtype(jnp.float64): 64}
+    dts = [a.dtype for a in arrays]
+    pick = min(dts, key=lambda d: widths.get(jnp.dtype(d), 32)) \
+        if cast_narrow else max(dts, key=lambda d: widths.get(
+            jnp.dtype(d), 32))
+    return tuple(a.astype(pick) for a in arrays)
+
+
+@register("all_finite")
+def all_finite(data, *, init_output=True):
+    return jnp.all(jnp.isfinite(data)).astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite")
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    ok = jnp.array(True)
+    for a in arrays:
+        ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(a)))
+    return ok.astype(jnp.float32).reshape(1)
+
+
+@register("multi_sum_sq", multi_out=True)
+def multi_sum_sq(*arrays, num_arrays=None):
+    return tuple(jnp.sum(jnp.square(a)).reshape(1) for a in arrays)
+
+
+@register("reset_arrays", multi_out=True)
+def reset_arrays(*arrays, num_arrays=None):
+    return tuple(jnp.zeros_like(a) for a in arrays)
+
+
+@register("multi_lars")
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, *, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS local-lr computation over stacked per-tensor norms
+    (parity: contrib/multi_lars.cc)."""
+    w_norm = jnp.sqrt(weights_sum_sq)
+    g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+    trust = jnp.where(
+        (w_norm > 0) & (g_norm > 0),
+        eta * w_norm / (g_norm + wds * w_norm + eps), 1.0)
+    return lrs * trust
+
+
+# -- misc parity shims ------------------------------------------------------
+
+@register("SoftmaxActivation")
+def softmax_activation(data, *, mode="instance"):
+    if mode == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1) \
+        .reshape(data.shape)
+
+
+@register("IdentityAttachKLSparseReg")
+def identity_attach_kl_sparse_reg(data, *, sparseness_target=0.1,
+                                  penalty=0.001, momentum=0.9):
+    return data
+
+
+@register("_rnn_param_concat")
+def _rnn_param_concat(*arrays, dim=0, num_args=None):
+    return jnp.concatenate([a.reshape(-1) for a in arrays], axis=0)
+
+
+@register("_histogram", multi_out=True)
+def _histogram(data, *bins_arr, bin_cnt=None, range=None):
+    """nd.histogram with either explicit bin edges (second input) or
+    bin_cnt+range params (histogram.cc)."""
+    if bins_arr:
+        edges = bins_arr[0]
+        cnt, edges = jnp.histogram(data, bins=edges)
+    else:
+        cnt, edges = jnp.histogram(data, bins=bin_cnt or 10, range=range)
+    return cnt, edges
+
+
+alias("split_v2", "_split_v2")

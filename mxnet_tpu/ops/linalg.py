@@ -1,0 +1,147 @@
+"""Batched BLAS/LAPACK operators (``_linalg_*``).
+
+Parity: src/operator/tensor/la_op.cc (gemm/gemm2/potrf/potri/trmm/trsm/
+syrk/syevd/gelqf/det/slogdet/inverse/extractdiag/maketrian/...): the
+reference lowers these to cuBLAS/cuSolver; here each is a pure-jnp
+expression XLA maps onto the MXU (matmuls) or host LAPACK custom-calls
+(factorizations).  All ops broadcast over leading batch dims exactly as
+the reference's batched mode does.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register, alias
+
+
+def _t(x, do):
+    return jnp.swapaxes(x, -1, -2) if do else x
+
+
+@register("_linalg_gemm", aliases=["linalg_gemm"])
+def _linalg_gemm(A, B, C, *, transpose_a=False, transpose_b=False,
+                 alpha=1.0, beta=1.0, axis=-3):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b)) \
+        + beta * C
+
+
+@register("_linalg_gemm2", aliases=["linalg_gemm2"])
+def _linalg_gemm2(A, B, *, transpose_a=False, transpose_b=False,
+                  alpha=1.0, axis=-3):
+    return alpha * jnp.matmul(_t(A, transpose_a), _t(B, transpose_b))
+
+
+@register("_linalg_potrf", aliases=["linalg_potrf"])
+def _linalg_potrf(A, *, lower=True):
+    L = jnp.linalg.cholesky(A)
+    return L if lower else _t(L, True)
+
+
+@register("_linalg_potri", aliases=["linalg_potri"])
+def _linalg_potri(A, *, lower=True):
+    """Inverse from a Cholesky factor: A is L (or U); returns (L L^T)^-1
+    (parity: la_op.cc potri semantics)."""
+    L = A if lower else _t(A, True)
+    eye = jnp.broadcast_to(jnp.eye(L.shape[-1], dtype=L.dtype), L.shape)
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jnp.matmul(_t(Linv, True), Linv)
+
+
+@register("_linalg_trmm", aliases=["linalg_trmm"])
+def _linalg_trmm(A, B, *, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    tri = _t(tri, transpose)
+    out = jnp.matmul(B, tri) if rightside else jnp.matmul(tri, B)
+    return alpha * out
+
+
+@register("_linalg_trsm", aliases=["linalg_trsm"])
+def _linalg_trsm(A, B, *, transpose=False, rightside=False, lower=True,
+                 alpha=1.0):
+    solve = jax.scipy.linalg.solve_triangular
+    eff_lower = lower != transpose
+    if rightside:
+        # X A = alpha B  <=>  A^T X^T = alpha B^T
+        Xt = solve(_t(A, not transpose), _t(alpha * B, True),
+                   lower=not eff_lower)
+        return _t(Xt, True)
+    return solve(_t(A, transpose), alpha * B, lower=eff_lower)
+
+
+@register("_linalg_syrk", aliases=["linalg_syrk"])
+def _linalg_syrk(A, *, transpose=False, alpha=1.0):
+    At = _t(A, True)
+    return alpha * (jnp.matmul(At, A) if transpose else jnp.matmul(A, At))
+
+
+@register("_linalg_syevd", aliases=["linalg_syevd"], multi_out=True)
+def _linalg_syevd(A):
+    w, v = jnp.linalg.eigh(A)
+    # reference returns (U, L) with rows of U the eigenvectors
+    return _t(v, True), w
+
+
+@register("_linalg_gelqf", aliases=["linalg_gelqf"], multi_out=True)
+def _linalg_gelqf(A):
+    """LQ factorization A = L Q with Q orthonormal rows (parity:
+    la_op.cc gelqf)."""
+    q, r = jnp.linalg.qr(_t(A, True))
+    return _t(r, True), _t(q, True)
+
+
+@register("_linalg_det", aliases=["linalg_det"])
+def _linalg_det(A):
+    return jnp.linalg.det(A)
+
+
+@register("_linalg_slogdet", aliases=["linalg_slogdet"], multi_out=True)
+def _linalg_slogdet(A):
+    sign, logdet = jnp.linalg.slogdet(A)
+    return sign, logdet
+
+
+@register("_linalg_inverse", aliases=["linalg_inverse"])
+def _linalg_inverse(A):
+    return jnp.linalg.inv(A)
+
+
+@register("_linalg_extractdiag", aliases=["linalg_extractdiag"])
+def _linalg_extractdiag(A, *, offset=0):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("_linalg_makediag", aliases=["linalg_makediag"])
+def _linalg_makediag(A, *, offset=0):
+    n = A.shape[-1] + abs(offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    if offset >= 0:
+        return out.at[..., idx, idx + offset].set(A)
+    return out.at[..., idx - offset, idx].set(A)
+
+
+@register("_linalg_extracttrian", aliases=["linalg_extracttrian"])
+def _linalg_extracttrian(A, *, offset=0, lower=True):
+    n = A.shape[-1]
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    return A[..., rows, cols]
+
+
+@register("_linalg_maketrian", aliases=["linalg_maketrian"])
+def _linalg_maketrian(A, *, offset=0, lower=True):
+    m = A.shape[-1]
+    # solve n(n+1)/2 +/- ... : infer n from packed length and offset
+    k = abs(offset)
+    n = int((-1 + (1 + 8 * m) ** 0.5) / 2) + k
+    rows, cols = jnp.tril_indices(n, k=offset) if lower else \
+        jnp.triu_indices(n, k=offset)
+    out = jnp.zeros(A.shape[:-1] + (n, n), A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register("_linalg_sumlogdiag", aliases=["linalg_sumlogdiag"])
+def _linalg_sumlogdiag(A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)

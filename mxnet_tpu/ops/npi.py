@@ -1,0 +1,764 @@
+"""NumPy-intrinsic operators (``_npi_*`` / ``_np_*`` / ``_npx_*``).
+
+Parity: the reference's numpy op family under ``src/operator/numpy/``
+(e.g. np_elemwise_broadcast_op.cc, np_init_op.cc, np_matrix_op.cc,
+np_einsum_op.cc, np_window_op.cc, np_percentile_op.cc,
+np_interp_op.cc, np_insert_op_*.cc, linalg/np_*.cc, random/*.cc).
+TPU-native: each op is a registered pure-jnp function — shape/type
+inference is tracing, kernels are XLA.  Data-dependent-shape ops
+(unique, nonzero, bincount without length) are eager-only, as their
+reference counterparts are CPU/sync ops.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+from .registry import register, alias
+
+
+def _dt(dtype, default=jnp.float32):
+    if dtype is None:
+        return default
+    return jnp.dtype(dtype)
+
+
+def _ax(axis):
+    """Normalize axis params that arrive as lists (jit-unsafe) to tuples."""
+    return tuple(axis) if isinstance(axis, (list, tuple)) else axis
+
+
+# --------------------------------------------------------------------------
+# elementwise binary + scalar variants (np_elemwise_broadcast_op.cc)
+# --------------------------------------------------------------------------
+
+_BINARY = {
+    "_npi_add": jnp.add,
+    "_npi_subtract": jnp.subtract,
+    "_npi_multiply": jnp.multiply,
+    "_npi_true_divide": jnp.true_divide,
+    "_npi_mod": jnp.mod,
+    "_npi_power": jnp.power,
+    "_npi_copysign": jnp.copysign,
+    "_npi_lcm": jnp.lcm,
+    "_npi_ldexp": lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+    "_npi_fmax": jnp.fmax,
+    "_npi_fmin": jnp.fmin,
+    "_npi_fmod": jnp.fmod,
+    "_npi_bitwise_and": jnp.bitwise_and,
+    "_npi_bitwise_or": jnp.bitwise_or,
+    "_npi_bitwise_xor": jnp.bitwise_xor,
+    "_npi_hypot": jnp.hypot,
+}
+
+for _name, _fn in _BINARY.items():
+    def _make_bin(f):
+        def op(a, b):
+            return f(a, b)
+        return op
+    _f = _make_bin(_fn)
+    _f.__name__ = _name
+    register(_name)(_f)
+
+_SCALAR = {
+    # name: (jnp_fn, reversed)
+    "_npi_add_scalar": (jnp.add, False),
+    "_npi_subtract_scalar": (jnp.subtract, False),
+    "_npi_rsubtract_scalar": (jnp.subtract, True),
+    "_npi_multiply_scalar": (jnp.multiply, False),
+    "_npi_true_divide_scalar": (jnp.true_divide, False),
+    "_npi_rtrue_divide_scalar": (jnp.true_divide, True),
+    "_npi_mod_scalar": (jnp.mod, False),
+    "_npi_rmod_scalar": (jnp.mod, True),
+    "_npi_power_scalar": (jnp.power, False),
+    "_npi_rpower_scalar": (jnp.power, True),
+    "_npi_copysign_scalar": (jnp.copysign, False),
+    "_npi_rcopysign_scalar": (jnp.copysign, True),
+    "_npi_arctan2_scalar": (jnp.arctan2, False),
+    "_npi_rarctan2_scalar": (jnp.arctan2, True),
+    "_npi_lcm_scalar": (lambda a, b: jnp.lcm(a, jnp.asarray(b, a.dtype)),
+                        False),
+    "_npi_ldexp_scalar": (lambda a, b: jnp.ldexp(a, jnp.asarray(b,
+                                                                jnp.int32)),
+                          False),
+    "_npi_rldexp_scalar": (lambda a, b: jnp.ldexp(a, jnp.asarray(b,
+                                                                 jnp.int32)),
+                           True),
+    "_npi_fmax_scalar": (jnp.fmax, False),
+    "_npi_fmin_scalar": (jnp.fmin, False),
+    "_npi_fmod_scalar": (jnp.fmod, False),
+    "_npi_rfmod_scalar": (jnp.fmod, True),
+    "_npi_bitwise_and_scalar": (lambda a, b: jnp.bitwise_and(
+        a, jnp.asarray(b, a.dtype)), False),
+    "_npi_bitwise_or_scalar": (lambda a, b: jnp.bitwise_or(
+        a, jnp.asarray(b, a.dtype)), False),
+    "_npi_bitwise_xor_scalar": (lambda a, b: jnp.bitwise_xor(
+        a, jnp.asarray(b, a.dtype)), False),
+}
+
+for _name, (_fn, _rev) in _SCALAR.items():
+    def _make_scalar(f, rev):
+        def op(a, *, scalar=0.0):
+            return f(scalar, a) if rev else f(a, scalar)
+        return op
+    _f = _make_scalar(_fn, _rev)
+    _f.__name__ = _name
+    register(_name)(_f)
+
+
+# --------------------------------------------------------------------------
+# unary / classification (np_elemwise_unary_op_basic.cc)
+# --------------------------------------------------------------------------
+
+_UNARY = {
+    "_npi_log": jnp.log,
+    "_npi_logical_not": jnp.logical_not,
+    "_npi_bitwise_not": jnp.bitwise_not,
+    "_npi_deg2rad": jnp.deg2rad,
+    "_npi_rad2deg": jnp.rad2deg,
+    "_npi_isnan": jnp.isnan,
+    "_npi_isinf": jnp.isinf,
+    "_npi_isfinite": jnp.isfinite,
+    "_npi_isneginf": jnp.isneginf,
+    "_npi_isposinf": jnp.isposinf,
+    "_np_copy": lambda a: a + jnp.zeros((), a.dtype) if jnp.issubdtype(
+        a.dtype, jnp.number) else jnp.array(a),
+    "_npx_relu": jax.nn.relu,
+    "_npx_sigmoid": jax.nn.sigmoid,
+}
+
+for _name, _fn in _UNARY.items():
+    def _make_un(f):
+        def op(a):
+            return f(a)
+        return op
+    _f = _make_un(_fn)
+    _f.__name__ = _name
+    register(_name)(_f)
+
+
+@register("_npi_around")
+def _npi_around(a, *, decimals=0):
+    return jnp.around(a, decimals)
+
+
+@register("_npi_nan_to_num")
+def _npi_nan_to_num(a, *, copy=True, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(a, copy=copy, nan=nan, posinf=posinf,
+                          neginf=neginf)
+
+
+# --------------------------------------------------------------------------
+# reductions (np_broadcast_reduce_op_*.cc)
+# --------------------------------------------------------------------------
+
+def _red(f):
+    def op(a, *, axis=None, dtype=None, keepdims=False):
+        out = f(a, axis=_ax(axis), keepdims=keepdims)
+        return out.astype(_dt(dtype, out.dtype)) if dtype is not None else out
+    return op
+
+
+for _name, _fn in {
+        "_npi_sum": jnp.sum, "_npi_mean": jnp.mean, "_npi_max": jnp.max,
+        "_npi_min": jnp.min, "_npi_prod": jnp.prod, "_npi_all": jnp.all,
+        "_npi_any": jnp.any}.items():
+    _f = _red(_fn)
+    _f.__name__ = _name
+    register(_name)(_f)
+
+
+@register("_npi_std")
+def _npi_std(a, *, axis=None, dtype=None, ddof=0, keepdims=False):
+    out = jnp.std(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims)
+    return out.astype(_dt(dtype, out.dtype))
+
+
+@register("_npi_var")
+def _npi_var(a, *, axis=None, dtype=None, ddof=0, keepdims=False):
+    out = jnp.var(a, axis=_ax(axis), ddof=ddof, keepdims=keepdims)
+    return out.astype(_dt(dtype, out.dtype))
+
+
+@register("_npi_argmax")
+def _npi_argmax(a, *, axis=None, keepdims=False):
+    return jnp.argmax(a, axis=axis, keepdims=keepdims)
+
+
+@register("_npi_argmin")
+def _npi_argmin(a, *, axis=None, keepdims=False):
+    return jnp.argmin(a, axis=axis, keepdims=keepdims)
+
+
+@register("_npi_average", multi_out=True)
+def _npi_average(a, *weights, axis=None, returned=False):
+    w = weights[0] if weights else None
+    if returned:
+        avg, s = jnp.average(a, axis=_ax(axis), weights=w, returned=True)
+        return avg, s
+    return jnp.average(a, axis=_ax(axis), weights=w)
+
+
+@register("_npi_norm")
+def _npi_norm(a, *, ord=None, axis=None, keepdims=False, flag=None):
+    return jnp.linalg.norm(a, ord=ord, axis=_ax(axis), keepdims=keepdims)
+
+
+@register("_npi_cumsum")
+def _npi_cumsum(a, *, axis=None, dtype=None):
+    return jnp.cumsum(a, axis=axis, dtype=_dt(dtype, a.dtype)
+                      if dtype is not None else None)
+
+
+@register("_npi_trace")
+def _npi_trace(a, *, offset=0, axis1=0, axis2=1):
+    return jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("_npi_diff")
+def _npi_diff(a, *, n=1, axis=-1):
+    return jnp.diff(a, n=n, axis=axis)
+
+
+@register("_npi_ediff1d")
+def _npi_ediff1d(a, *extras, to_end=None, to_begin=None):
+    return jnp.ediff1d(a, to_end=to_end, to_begin=to_begin)
+
+
+# --------------------------------------------------------------------------
+# array manipulation (np_matrix_op.cc)
+# --------------------------------------------------------------------------
+
+@register("_npi_concatenate", aliases=["_npi_concat"])
+def _npi_concatenate(*arrays, axis=0, dim=None):
+    if dim is not None:
+        axis = dim
+    if axis is None:
+        arrays = [a.reshape(-1) for a in arrays]
+        axis = 0
+    return jnp.concatenate(arrays, axis=axis)
+
+
+@register("_npi_stack")
+def _npi_stack(*arrays, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+@register("_npi_vstack")
+def _npi_vstack(*arrays):
+    return jnp.vstack(arrays)
+
+
+@register("_npi_hstack")
+def _npi_hstack(*arrays):
+    return jnp.hstack(arrays)
+
+
+@register("_npi_dstack")
+def _npi_dstack(*arrays):
+    return jnp.dstack(arrays)
+
+
+@register("_npi_column_stack")
+def _npi_column_stack(*arrays):
+    return jnp.column_stack(arrays)
+
+
+@register("_npi_hsplit", multi_out=True)
+def _npi_hsplit(a, *, indices_or_sections=1):
+    return tuple(jnp.hsplit(a, indices_or_sections))
+
+
+@register("_npi_dsplit", multi_out=True)
+def _npi_dsplit(a, *, indices_or_sections=1):
+    return tuple(jnp.dsplit(a, indices_or_sections))
+
+
+@register("_npi_flip")
+def _npi_flip(a, *, axis=None):
+    return jnp.flip(a, axis=_ax(axis))
+
+
+@register("_npi_roll")
+def _npi_roll(a, *, shift=1, axis=None):
+    return jnp.roll(a, shift, axis=_ax(axis))
+
+
+@register("_npi_rot90")
+def _npi_rot90(a, *, k=1, axes=(0, 1)):
+    return jnp.rot90(a, k=k, axes=tuple(axes))
+
+
+@register("_np_moveaxis")
+def _np_moveaxis(a, *, source, destination):
+    return jnp.moveaxis(a, _ax(source), _ax(destination))
+
+
+@register("_npi_rollaxis")
+def _npi_rollaxis(a, *, axis, start=0):
+    return jnp.rollaxis(a, axis, start)
+
+
+@register("_npi_squeeze")
+def _npi_squeeze(a, *, axis=None):
+    return jnp.squeeze(a, axis=_ax(axis))
+
+
+@register("_npi_transpose")
+def _npi_transpose(a, *, axes=None):
+    if axes is not None and any(x is None for x in
+                                (axes if isinstance(axes, (list, tuple))
+                                 else [axes])):
+        axes = None
+    return jnp.transpose(a, axes=_ax(axes))
+
+
+@register("_np_reshape")
+def _np_reshape(a, *, newshape, order="C"):
+    return jnp.reshape(a, tuple(newshape), order=order)
+
+
+@register("_npx_reshape")
+def _npx_reshape(a, *, newshape, reverse=False, order="C"):
+    """npx.reshape with -2/-3/-4 style special codes reduced to -1
+    handling (parity: np_matrix_op.cc NumpyXReshape)."""
+    shape = []
+    src = list(a.shape)
+    for i, s in enumerate(tuple(newshape)):
+        if s == -2:
+            shape.extend(src[i:])
+            break
+        shape.append(s)
+    return jnp.reshape(a, tuple(shape), order=order)
+
+
+@register("_npi_broadcast_to")
+def _npi_broadcast_to(a, *, shape):
+    return jnp.broadcast_to(a, tuple(shape))
+
+
+@register("_npi_pad")
+def _npi_pad(a, *, pad_width, mode="constant", constant_values=0,
+             reflect_type="even"):
+    pw = tuple(tuple(p) for p in pad_width)
+    if mode == "constant":
+        return jnp.pad(a, pw, mode=mode, constant_values=constant_values)
+    if mode in ("reflect", "symmetric"):
+        return jnp.pad(a, pw, mode=mode, reflect_type=reflect_type)
+    return jnp.pad(a, pw, mode=mode)
+
+
+@register("_npi_delete")
+def _npi_delete(a, *, obj, axis=None, start=None, stop=None, step=None):
+    if axis is None:
+        a = a.reshape(-1)
+        axis = 0
+    if start is not None or stop is not None or step is not None:
+        obj = slice(start, stop, step)
+    elif isinstance(obj, (list, tuple)):
+        obj = onp.asarray(obj)
+    return jnp.delete(a, obj, axis=axis)
+
+
+@register("_npi_insert_scalar")
+def _npi_insert_scalar(a, *values, obj=None, axis=None, val=None):
+    v = values[0] if values else val
+    return jnp.insert(a, obj, v, axis=axis)
+
+
+@register("_npi_insert_slice")
+def _npi_insert_slice(a, *values, start=None, stop=None, step=None,
+                      axis=None, val=None):
+    v = values[0] if values else val
+    return jnp.insert(a, slice(start, stop, step), v, axis=axis)
+
+
+@register("_npi_insert_tensor")
+def _npi_insert_tensor(a, obj, *values, axis=None, val=None):
+    v = values[0] if values else val
+    return jnp.insert(a, obj, v, axis=axis)
+
+
+@register("_npi_repeats")
+def _npi_repeats(a, *, repeats, axis=None):
+    return jnp.repeat(a, repeats, axis=axis)
+
+
+@register("_npi_unique", multi_out=True)
+def _npi_unique(a, *, return_index=False, return_inverse=False,
+                return_counts=False, axis=None):
+    """Eager-only (data-dependent output shape; parity: np_unique_op.cc
+    which is likewise a CPU/sync kernel)."""
+    out = jnp.unique(a, return_index=return_index,
+                     return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    return out if isinstance(out, tuple) else (out,)
+
+
+@register("_npi_bincount")
+def _npi_bincount(a, *weights, minlength=0):
+    w = weights[0] if weights else None
+    return jnp.bincount(a, weights=w, minlength=minlength)
+
+
+@register("_npx_nonzero")
+def _npx_nonzero(a):
+    """Eager-only: returns an (N, ndim) index array (parity:
+    np_nonzero_op.cc)."""
+    return jnp.stack(jnp.nonzero(a), axis=-1)
+
+
+@register("_npi_share_memory")
+def _npi_share_memory(a, b):
+    try:
+        return jnp.array(a.unsafe_buffer_pointer()
+                         == b.unsafe_buffer_pointer())
+    except Exception:
+        return jnp.array(False)
+
+
+# --------------------------------------------------------------------------
+# creation (np_init_op.cc, np_window_op.cc)
+# --------------------------------------------------------------------------
+
+@register("_npi_zeros")
+def _npi_zeros(*, shape=(), dtype=None, ctx=None):
+    return jnp.zeros(tuple(shape) if isinstance(shape, (list, tuple))
+                     else (shape,), _dt(dtype))
+
+
+@register("_npi_ones")
+def _npi_ones(*, shape=(), dtype=None, ctx=None):
+    return jnp.ones(tuple(shape) if isinstance(shape, (list, tuple))
+                    else (shape,), _dt(dtype))
+
+
+@register("_npi_full")
+def _npi_full(*, shape=(), fill_value=0.0, dtype=None, ctx=None):
+    return jnp.full(tuple(shape) if isinstance(shape, (list, tuple))
+                    else (shape,), fill_value, _dt(dtype))
+
+
+@register("_npi_full_like")
+def _npi_full_like(a, *, fill_value=0.0, dtype=None, ctx=None):
+    return jnp.full_like(a, fill_value,
+                         dtype=_dt(dtype, a.dtype))
+
+
+@register("_npi_identity")
+def _npi_identity(*, shape=None, n=None, dtype=None, ctx=None):
+    k = n if n is not None else (shape[0] if isinstance(
+        shape, (list, tuple)) else shape)
+    return jnp.identity(k, _dt(dtype))
+
+
+@register("_npi_eye")
+def _npi_eye(*, N, M=None, k=0, dtype=None, ctx=None):
+    return jnp.eye(N, M, k=k, dtype=_dt(dtype))
+
+
+@register("_npi_indices")
+def _npi_indices(*, dimensions, dtype=None, ctx=None):
+    return jnp.indices(tuple(dimensions), dtype=_dt(dtype, jnp.int32))
+
+
+@register("_npi_arange")
+def _npi_arange(*, start=0, stop=None, step=1, dtype=None, ctx=None):
+    return jnp.arange(start, stop, step, _dt(dtype) if dtype else None)
+
+
+@register("_npi_linspace")
+def _npi_linspace(*, start, stop, num=50, endpoint=True, dtype=None,
+                  ctx=None):
+    return jnp.linspace(start, stop, num, endpoint=endpoint,
+                        dtype=_dt(dtype))
+
+
+@register("_npi_logspace")
+def _npi_logspace(*, start, stop, num=50, endpoint=True, base=10.0,
+                  dtype=None, ctx=None):
+    return jnp.logspace(start, stop, num, endpoint=endpoint, base=base,
+                        dtype=_dt(dtype))
+
+
+@register("_npi_atleast_1d", multi_out=True)
+def _npi_atleast_1d(*arrays):
+    out = jnp.atleast_1d(*arrays)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+@register("_npi_atleast_2d", multi_out=True)
+def _npi_atleast_2d(*arrays):
+    out = jnp.atleast_2d(*arrays)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+@register("_npi_atleast_3d", multi_out=True)
+def _npi_atleast_3d(*arrays):
+    out = jnp.atleast_3d(*arrays)
+    return out if isinstance(out, (list, tuple)) else (out,)
+
+
+@register("_npi_tri")
+def _npi_tri(*, N, M=None, k=0, dtype=None, ctx=None):
+    return jnp.tri(N, M, k, _dt(dtype))
+
+
+@register("_npi_tril")
+def _npi_tril(a, *, k=0):
+    return jnp.tril(a, k)
+
+
+@register("_npi_triu")
+def _npi_triu(a, *, k=0):
+    return jnp.triu(a, k)
+
+
+@register("_npi_tril_indices", multi_out=True)
+def _npi_tril_indices(*, n, k=0, m=None):
+    r, c = jnp.tril_indices(n, k, m)
+    return r, c
+
+
+@register("_npi_diag")
+def _npi_diag(a, *, k=0):
+    return jnp.diag(a, k)
+
+
+@register("_npi_diagflat")
+def _npi_diagflat(a, *, k=0):
+    return jnp.diagflat(a, k)
+
+
+@register("_npi_diagonal")
+def _npi_diagonal(a, *, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register("_npi_diag_indices_from", multi_out=True)
+def _npi_diag_indices_from(a):
+    return tuple(jnp.diag_indices_from(a))
+
+
+@register("_npi_fill_diagonal")
+def _npi_fill_diagonal(a, *, val=0.0, wrap=False):
+    n = min(a.shape[-2], a.shape[-1]) if a.ndim >= 2 else a.shape[0]
+    i = jnp.arange(n)
+    return a.at[..., i, i].set(val) if a.ndim >= 2 else a.at[i].set(val)
+
+
+@register("_npi_blackman")
+def _npi_blackman(*, M, dtype=None, ctx=None):
+    return jnp.blackman(M).astype(_dt(dtype))
+
+
+@register("_npi_hamming")
+def _npi_hamming(*, M, dtype=None, ctx=None):
+    return jnp.hamming(M).astype(_dt(dtype))
+
+
+@register("_npi_hanning")
+def _npi_hanning(*, M, dtype=None, ctx=None):
+    return jnp.hanning(M).astype(_dt(dtype))
+
+
+# --------------------------------------------------------------------------
+# numeric specials (np_interp_op.cc, np_percentile_op.cc,
+# np_polynomial_op.cc, np_cross.cc, np_kron.cc, np_einsum_op.cc)
+# --------------------------------------------------------------------------
+
+@register("_npi_interp")
+def _npi_interp(x, xp, fp, *, left=None, right=None, period=None):
+    return jnp.interp(x, xp, fp, left=left, right=right, period=period)
+
+
+@register("_npi_percentile")
+def _npi_percentile(a, *q_arr, q=None, axis=None, interpolation="linear",
+                    keepdims=False):
+    qq = q_arr[0] if q_arr else q
+    return jnp.percentile(a, qq, axis=_ax(axis), method=interpolation,
+                          keepdims=keepdims)
+
+
+@register("_npi_polyval")
+def _npi_polyval(p, x):
+    return jnp.polyval(p, x)
+
+
+@register("_npi_cross")
+def _npi_cross(a, b, *, axisa=-1, axisb=-1, axisc=-1, axis=None):
+    if axis is not None:
+        axisa = axisb = axisc = axis
+    return jnp.cross(a, b, axisa=axisa, axisb=axisb, axisc=axisc)
+
+
+@register("_npi_kron")
+def _npi_kron(a, b):
+    return jnp.kron(a, b)
+
+
+@register("_npi_einsum")
+def _npi_einsum(*operands, subscripts, optimize=0):
+    return jnp.einsum(subscripts, *operands,
+                      optimize="optimal" if optimize else "auto")
+
+
+@register("_npi_tensordot")
+def _npi_tensordot(a, b, *, a_axes_summed=None, b_axes_summed=None,
+                   axes=None):
+    if a_axes_summed is not None:
+        axes = (tuple(a_axes_summed), tuple(b_axes_summed))
+    return jnp.tensordot(a, b, axes=axes if axes is not None else 2)
+
+
+@register("_npi_tensordot_int_axes")
+def _npi_tensordot_int_axes(a, b, *, axes=2):
+    return jnp.tensordot(a, b, axes=int(axes))
+
+
+@register("_np_dot")
+def _np_dot(a, b):
+    return jnp.dot(a, b)
+
+
+@register("_npi_where")
+def _npi_where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+@register("_npi_where_lscalar")
+def _npi_where_lscalar(cond, y, *, scalar=0.0):
+    return jnp.where(cond, scalar, y)
+
+
+@register("_npi_where_rscalar")
+def _npi_where_rscalar(cond, x, *, scalar=0.0):
+    return jnp.where(cond, x, scalar)
+
+
+@register("_npi_where_scalar2")
+def _npi_where_scalar2(cond, *, x=0.0, y=0.0):
+    return jnp.where(cond, x, y)
+
+
+@register("_npi_boolean_mask_assign_scalar")
+def _npi_boolean_mask_assign_scalar(data, mask, *, value=0.0):
+    return jnp.where(mask.astype(bool), jnp.asarray(value, data.dtype),
+                     data)
+
+
+@register("_npi_boolean_mask_assign_tensor")
+def _npi_boolean_mask_assign_tensor(data, mask, value):
+    return jnp.where(mask.astype(bool), value, data)
+
+
+@register("_npx_index_add")
+def _npx_index_add(a, ind, val):
+    ind = ind.astype(jnp.int32)
+    if ind.ndim == 1:
+        return a.at[ind].add(val)
+    return a.at[tuple(ind)].add(val)
+
+
+@register("_npx_index_update")
+def _npx_index_update(a, ind, val):
+    ind = ind.astype(jnp.int32)
+    if ind.ndim == 1:
+        return a.at[ind].set(val)
+    return a.at[tuple(ind)].set(val)
+
+
+@register("_npx_constraint_check")
+def _npx_constraint_check(condition, *, msg="constraint violated"):
+    """Returns the all-reduced condition; host-side check when eager
+    (parity: npx_constraint_check.cc)."""
+    ok = jnp.all(condition)
+    return ok
+
+
+# --------------------------------------------------------------------------
+# numpy linalg (_npi_* under src/operator/numpy/linalg/)
+# --------------------------------------------------------------------------
+
+@register("_npi_cholesky")
+def _npi_cholesky(a, *, lower=True):
+    L = jnp.linalg.cholesky(a)
+    return L if lower else jnp.swapaxes(L, -1, -2)
+
+
+@register("_npi_eig", multi_out=True)
+def _npi_eig(a):
+    w, v = jnp.linalg.eig(a)
+    return w, v
+
+
+@register("_npi_eigh", multi_out=True)
+def _npi_eigh(a, *, UPLO="L"):
+    w, v = jnp.linalg.eigh(a, UPLO=UPLO)
+    return w, v
+
+
+@register("_npi_eigvals")
+def _npi_eigvals(a):
+    return jnp.linalg.eigvals(a)
+
+
+@register("_npi_eigvalsh")
+def _npi_eigvalsh(a, *, UPLO="L"):
+    return jnp.linalg.eigvalsh(a, UPLO=UPLO)
+
+
+@register("_npi_svd", multi_out=True)
+def _npi_svd(a):
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return u, s, vh
+
+
+@register("_npi_qr", multi_out=True)
+def _npi_qr(a):
+    q, r = jnp.linalg.qr(a)
+    return q, r
+
+
+@register("_npi_solve")
+def _npi_solve(a, b):
+    return jnp.linalg.solve(a, b)
+
+
+@register("_npi_lstsq", multi_out=True)
+def _npi_lstsq(a, b, *, rcond=None):
+    x, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return x, res, rank, sv
+
+
+@register("_npi_pinv")
+def _npi_pinv(a, *rcond_arr, hermitian=False):
+    rc = rcond_arr[0] if rcond_arr else None
+    return jnp.linalg.pinv(a, rtol=rc, hermitian=hermitian)
+
+
+@register("_npi_pinv_scalar_rcond")
+def _npi_pinv_scalar_rcond(a, *, rcond=1e-15, hermitian=False):
+    return jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian)
+
+
+@register("_npi_tensorinv")
+def _npi_tensorinv(a, *, ind=2):
+    return jnp.linalg.tensorinv(a, ind=ind)
+
+
+@register("_npi_tensorsolve")
+def _npi_tensorsolve(a, b, *, a_axes=None):
+    return jnp.linalg.tensorsolve(a, b, axes=_ax(a_axes))
+
+
+@register("_npi_matrix_rank")
+def _npi_matrix_rank(a, *tol_arr, hermitian=False, finfoEps=False):
+    tol = tol_arr[0] if tol_arr else None
+    return jnp.linalg.matrix_rank(a, tol)
+
+
+@register("_npi_matrix_rank_none_tol")
+def _npi_matrix_rank_none_tol(a, *, hermitian=False, finfoEps=False):
+    return jnp.linalg.matrix_rank(a)

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register
+from .registry import register, alias
 
 
 def _apply_wd_rescale(grad, weight, rescale_grad, clip_gradient, wd):
@@ -284,3 +284,242 @@ def group_adagrad_update(weight, grad, history, *, lr, epsilon=1e-5,
                    if axes else jnp.square(g))
     w = weight - lr * g / (jnp.sqrt(h) + epsilon)
     return w, h
+
+
+# --------------------------------------------------------------------------
+# mixed-precision (mp_*) variants: fp32 master weight rides along a
+# low-precision weight (parity: src/operator/optimizer_op.cc
+# MP_SGD_Update / multi-precision kernels).  Output order matches the
+# reference: (weight, [state...], weight32).
+# --------------------------------------------------------------------------
+
+@register("mp_sgd_update", multi_out=True)
+def mp_sgd_update(weight, grad, weight32, *, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, lazy_update=True):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    w32 = weight32 - lr * (g + wd * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register("mp_sgd_mom_update", multi_out=True)
+def mp_sgd_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                      lazy_update=True):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = momentum * mom - lr * (g + wd * weight32)
+    w32 = weight32 + m
+    return w32.astype(weight.dtype), m, w32
+
+
+@register("mp_nag_mom_update", multi_out=True)
+def mp_nag_mom_update(weight, grad, mom, weight32, *, lr, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    g = g + wd * weight32
+    m = momentum * mom + g
+    w32 = weight32 - lr * (g + momentum * m)
+    return w32.astype(weight.dtype), m, w32
+
+
+alias("adamw_update", "_adamw_update")
+
+
+@register("_mp_adamw_update", multi_out=True)
+def _mp_adamw_update(weight, grad, mean, var, weight32, rescale_grad_t,
+                     *, lr, eta=1.0, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                     wd=0.0, clip_gradient=-1.0):
+    g = grad.astype(jnp.float32) * rescale_grad_t
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * var + (1 - beta2) * jnp.square(g)
+    w32 = weight32 - eta * (lr * m / (jnp.sqrt(v) + epsilon)
+                            + wd * weight32)
+    return w32.astype(weight.dtype), m, v, w32
+
+
+# -- LAMB two-phase form (optimizer_op.cc lamb_update_phase1/2: phase1
+#    computes the adam-style direction, phase2 applies the trust ratio) --
+
+@register("lamb_update_phase1")
+def lamb_update_phase1(weight, grad, mean, grad_var, *, beta1=0.9,
+                       beta2=0.999, epsilon=1e-6, t=1, bias_correction=True,
+                       wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    m = beta1 * mean + (1 - beta1) * g
+    v = beta2 * grad_var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mh = m / (1 - beta1 ** t)
+        vh = v / (1 - beta2 ** t)
+    else:
+        mh, vh = m, v
+    return mh / (jnp.sqrt(vh) + epsilon) + wd * weight
+
+
+@register("lamb_update_phase2")
+def lamb_update_phase2(weight, g_update, r1, r2, *, lr,
+                       lower_bound=-1.0, upper_bound=-1.0):
+    r1_ = r1.reshape(())
+    r2_ = r2.reshape(())
+    if lower_bound is not None and lower_bound >= 0:
+        r1_ = jnp.maximum(r1_, lower_bound)
+    if upper_bound is not None and upper_bound >= 0:
+        r1_ = jnp.minimum(r1_, upper_bound)
+    ratio = jnp.where((r1_ > 0) & (r2_ > 0), r1_ / r2_, 1.0)
+    return weight - lr * ratio * g_update
+
+
+@register("mp_lamb_update_phase1")
+def mp_lamb_update_phase1(weight, grad, mean, grad_var, weight32, *,
+                          beta1=0.9, beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+    return lamb_update_phase1(
+        weight32, grad.astype(jnp.float32), mean, grad_var, beta1=beta1,
+        beta2=beta2, epsilon=epsilon, t=t, bias_correction=bias_correction,
+        wd=wd, rescale_grad=rescale_grad, clip_gradient=clip_gradient)
+
+
+@register("mp_lamb_update_phase2", multi_out=True)
+def mp_lamb_update_phase2(weight, g_update, r1, r2, weight32, *, lr,
+                          lower_bound=-1.0, upper_bound=-1.0):
+    w32 = lamb_update_phase2(weight32, g_update, r1, r2, lr=lr,
+                             lower_bound=lower_bound,
+                             upper_bound=upper_bound)
+    return w32.astype(weight.dtype), w32
+
+
+@register("_sparse_adagrad_update", multi_out=True)
+def _sparse_adagrad_update(weight, grad, history, *, lr, epsilon=1e-7,
+                           wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """Dense expression of the row-sparse adagrad kernel
+    (optimizer_op.cc AdagradUpdateRspRspRspImpl)."""
+    g = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    h = history + jnp.square(g)
+    w = weight - lr * (g / (jnp.sqrt(h) + epsilon) + wd * weight)
+    return w, h
+
+
+alias("group_adagrad_update", "_contrib_group_adagrad_update")
+
+
+# --------------------------------------------------------------------------
+# multi-tensor fused updates (optimizer_op.cc multi_sgd_* /
+# multi_mp_sgd_* and contrib preloaded_multi_* variants): one op call
+# updates N weights.  Inputs are interleaved per the reference layout.
+# --------------------------------------------------------------------------
+
+def _chunks(arrays, n_per):
+    n = len(arrays) // n_per
+    return [arrays[i * n_per:(i + 1) * n_per] for i in range(n)]
+
+
+@register("multi_sgd_update", multi_out=True)
+def multi_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None):
+    outs = []
+    for i, (w, g) in enumerate(_chunks(list(arrays), 2)):
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_sgd_mom_update", multi_out=True)
+def multi_sgd_mom_update(*arrays, lrs, wds, momentum=0.0, rescale_grad=1.0,
+                         clip_gradient=-1.0, num_weights=None):
+    outs = []
+    for i, (w, g, m) in enumerate(_chunks(list(arrays), 3)):
+        w2, m2 = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([w2, m2])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_update", multi_out=True)
+def multi_mp_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None):
+    outs = []
+    for i, (w, g, w32) in enumerate(_chunks(list(arrays), 3)):
+        outs.extend(mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", multi_out=True)
+def multi_mp_sgd_mom_update(*arrays, lrs, wds, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None):
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_chunks(list(arrays), 4)):
+        outs.extend(mp_sgd_mom_update(w, g, m, w32, lr=lrs[i],
+                                      momentum=momentum, wd=wds[i],
+                                      rescale_grad=rescale_grad,
+                                      clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_update", multi_out=True)
+def preloaded_multi_sgd_update(*arrays, rescale_grad=1.0,
+                               clip_gradient=-1.0, num_weights=None):
+    """lrs/wds arrive as trailing tensor inputs (contrib
+    preloaded_multi_sgd.cc)."""
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g) in enumerate(_chunks(list(arrays[:-2]), 2)):
+        outs.append(sgd_update(w, g, lr=lrs[i], wd=wds[i],
+                               rescale_grad=rescale_grad,
+                               clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_sgd_mom_update", multi_out=True)
+def preloaded_multi_sgd_mom_update(*arrays, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=None):
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g, m) in enumerate(_chunks(list(arrays[:-2]), 3)):
+        w2, m2 = sgd_mom_update(w, g, m, lr=lrs[i], momentum=momentum,
+                                wd=wds[i], rescale_grad=rescale_grad,
+                                clip_gradient=clip_gradient)
+        outs.extend([w2, m2])
+    return tuple(outs)
+
+
+@register("preloaded_multi_mp_sgd_update", multi_out=True)
+def preloaded_multi_mp_sgd_update(*arrays, rescale_grad=1.0,
+                                  clip_gradient=-1.0, num_weights=None):
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g, w32) in enumerate(_chunks(list(arrays[:-2]), 3)):
+        outs.extend(mp_sgd_update(w, g, w32, lr=lrs[i], wd=wds[i],
+                                  rescale_grad=rescale_grad,
+                                  clip_gradient=clip_gradient))
+    return tuple(outs)
+
+
+@register("preloaded_multi_mp_sgd_mom_update", multi_out=True)
+def preloaded_multi_mp_sgd_mom_update(*arrays, momentum=0.0,
+                                      rescale_grad=1.0,
+                                      clip_gradient=-1.0,
+                                      num_weights=None):
+    lrs, wds = arrays[-2], arrays[-1]
+    outs = []
+    for i, (w, g, m, w32) in enumerate(_chunks(list(arrays[:-2]), 4)):
+        outs.extend(mp_sgd_mom_update(w, g, m, w32, lr=lrs[i],
+                                      momentum=momentum, wd=wds[i],
+                                      rescale_grad=rescale_grad,
+                                      clip_gradient=clip_gradient))
+    return tuple(outs)

@@ -150,3 +150,334 @@ def _gumbel(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32):
 @register("_random_logistic")
 def _logistic(key, *, loc=0.0, scale=1.0, shape=(1,), dtype=jnp.float32):
     return loc + scale * jax.random.logistic(key, shape, dtype)
+
+
+# --------------------------------------------------------------------------
+# numpy-intrinsic samplers (_npi_*: src/operator/numpy/random/np_*_op.cc)
+# Tensor low/high/loc/scale inputs are accepted positionally (after the
+# key) or as scalar keyword params, matching the reference's
+# scalar-or-tensor param convention.
+# --------------------------------------------------------------------------
+
+def _np_shape(size, fallback=()):
+    if size is None:
+        return fallback
+    if isinstance(size, int):
+        return (size,)
+    return tuple(size)
+
+
+@register("_npi_uniform")
+def _npi_uniform(key, *params, low=0.0, high=1.0, size=None, ctx=None,
+                 dtype=jnp.float32):
+    if params:
+        low = params[0] if len(params) > 0 else low
+        high = params[1] if len(params) > 1 else high
+    shape = _np_shape(size, jnp.broadcast_shapes(
+        jnp.shape(low), jnp.shape(high)))
+    return jax.random.uniform(key, shape, dtype) * (high - low) + low
+
+
+@register("_npi_uniform_n")
+def _npi_uniform_n(key, *params, low=0.0, high=1.0, size=None, ctx=None,
+                   dtype=jnp.float32):
+    batch = jnp.broadcast_shapes(jnp.shape(low), jnp.shape(high))
+    shape = _np_shape(size) + batch
+    return jax.random.uniform(key, shape, dtype) * (high - low) + low
+
+
+@register("_npi_normal")
+def _npi_normal(key, *params, loc=0.0, scale=1.0, size=None, ctx=None,
+                dtype=jnp.float32):
+    if params:
+        loc = params[0] if len(params) > 0 else loc
+        scale = params[1] if len(params) > 1 else scale
+    shape = _np_shape(size, jnp.broadcast_shapes(
+        jnp.shape(loc), jnp.shape(scale)))
+    return loc + scale * jax.random.normal(key, shape, dtype)
+
+
+@register("_npi_normal_n")
+def _npi_normal_n(key, *params, loc=0.0, scale=1.0, size=None, ctx=None,
+                  dtype=jnp.float32):
+    batch = jnp.broadcast_shapes(jnp.shape(loc), jnp.shape(scale))
+    shape = _np_shape(size) + batch
+    return loc + scale * jax.random.normal(key, shape, dtype)
+
+
+@register("_npi_bernoulli")
+def _npi_bernoulli(key, *params, prob=None, logit=None, size=None,
+                   ctx=None, dtype=jnp.float32, is_logit=False):
+    if params:
+        if is_logit or (prob is None and logit is not None):
+            logit = params[0]
+        else:
+            prob = params[0]
+    if prob is None:
+        prob = jax.nn.sigmoid(logit)
+    shape = _np_shape(size, jnp.shape(prob))
+    return jax.random.bernoulli(key, prob, shape).astype(dtype)
+
+
+@register("_npi_exponential")
+def _npi_exponential(key, *params, scale=1.0, size=None, ctx=None,
+                     dtype=jnp.float32):
+    if params:
+        scale = params[0]
+    shape = _np_shape(size, jnp.shape(scale))
+    return scale * jax.random.exponential(key, shape, dtype)
+
+
+@register("_npi_gamma")
+def _npi_gamma(key, *params, shape=1.0, scale=1.0, size=None, ctx=None,
+               dtype=jnp.float32):
+    a = params[0] if params else shape
+    if len(params) > 1:
+        scale = params[1]
+    out_shape = _np_shape(size, jnp.broadcast_shapes(
+        jnp.shape(a), jnp.shape(scale)))
+    return jax.random.gamma(key, a, out_shape, dtype) * scale
+
+
+@register("_npi_gumbel")
+def _npi_gumbel(key, *params, loc=0.0, scale=1.0, size=None, ctx=None,
+                dtype=jnp.float32):
+    if params:
+        loc = params[0] if len(params) > 0 else loc
+        scale = params[1] if len(params) > 1 else scale
+    shape = _np_shape(size, jnp.broadcast_shapes(
+        jnp.shape(loc), jnp.shape(scale)))
+    return loc + scale * jax.random.gumbel(key, shape, dtype)
+
+
+@register("_npi_laplace")
+def _npi_laplace(key, *params, loc=0.0, scale=1.0, size=None, ctx=None,
+                 dtype=jnp.float32):
+    if params:
+        loc = params[0] if len(params) > 0 else loc
+        scale = params[1] if len(params) > 1 else scale
+    shape = _np_shape(size, jnp.broadcast_shapes(
+        jnp.shape(loc), jnp.shape(scale)))
+    return loc + scale * jax.random.laplace(key, shape, dtype)
+
+
+@register("_npi_logistic")
+def _npi_logistic(key, *params, loc=0.0, scale=1.0, size=None, ctx=None,
+                  dtype=jnp.float32):
+    if params:
+        loc = params[0] if len(params) > 0 else loc
+        scale = params[1] if len(params) > 1 else scale
+    shape = _np_shape(size, jnp.broadcast_shapes(
+        jnp.shape(loc), jnp.shape(scale)))
+    return loc + scale * jax.random.logistic(key, shape, dtype)
+
+
+@register("_npi_pareto")
+def _npi_pareto(key, *params, a=1.0, size=None, ctx=None,
+                dtype=jnp.float32):
+    if params:
+        a = params[0]
+    shape = _np_shape(size, jnp.shape(a))
+    return jax.random.pareto(key, a, shape, dtype) - 1.0
+
+
+@register("_npi_powerd")
+def _npi_powerd(key, *params, a=1.0, size=None, ctx=None,
+                dtype=jnp.float32):
+    """Power distribution: X = U^(1/a) (np_power_op via inverse CDF)."""
+    if params:
+        a = params[0]
+    shape = _np_shape(size, jnp.shape(a))
+    u = jax.random.uniform(key, shape, dtype, minval=1e-7, maxval=1.0)
+    return jnp.power(u, 1.0 / a)
+
+
+@register("_npi_rayleigh")
+def _npi_rayleigh(key, *params, scale=1.0, size=None, ctx=None,
+                  dtype=jnp.float32):
+    if params:
+        scale = params[0]
+    shape = _np_shape(size, jnp.shape(scale))
+    u = jax.random.uniform(key, shape, dtype, minval=1e-7, maxval=1.0)
+    return scale * jnp.sqrt(-2.0 * jnp.log(u))
+
+
+@register("_npi_weibull")
+def _npi_weibull(key, *params, a=1.0, size=None, ctx=None,
+                 dtype=jnp.float32):
+    if params:
+        a = params[0]
+    shape = _np_shape(size, jnp.shape(a))
+    u = jax.random.uniform(key, shape, dtype, minval=1e-7, maxval=1.0)
+    return jnp.power(-jnp.log(u), 1.0 / a)
+
+
+@register("_npi_choice")
+def _npi_choice(key, *params, a=None, size=None, replace=True, ctx=None,
+                weights=None):
+    """np.random.choice (np_choice_op.cc); `a` int or the first tensor
+    input; optional probability weights as second tensor input."""
+    arr = params[0] if params else a
+    p = params[1] if len(params) > 1 else weights
+    shape = _np_shape(size, ())
+    if not hasattr(arr, "shape") or getattr(arr, "ndim", 0) == 0:
+        arr = int(arr)
+    return jax.random.choice(key, arr, shape, replace=replace, p=p)
+
+
+@register("_npi_multinomial")
+def _npi_multinomial(key, *params, n=1, pvals=None, size=None, ctx=None):
+    """Counts of n categorical draws (np_multinomial_op.cc)."""
+    p = params[0] if params else jnp.asarray(pvals)
+    k = p.shape[-1]
+    shape = _np_shape(size, ())
+    logits = jnp.log(jnp.maximum(p, 1e-37))
+    draws = jax.random.categorical(key, logits, axis=-1,
+                                   shape=(int(n),) + shape + p.shape[:-1])
+    counts = jax.nn.one_hot(draws, k, dtype=jnp.int64
+                            if jax.config.jax_enable_x64 else jnp.int32)
+    return jnp.sum(counts, axis=0)
+
+
+# --------------------------------------------------------------------------
+# per-row samplers (_sample_*: src/operator/random/multisample_op.cc —
+# parameter arrays give one distribution per row, output adds `shape`
+# trailing dims)
+# --------------------------------------------------------------------------
+
+def _multisample(key, sampler, param_arrays, shape, dtype):
+    shape = (shape if isinstance(shape, tuple) else (shape,)) \
+        if shape else ()
+    n = param_arrays[0].shape[0]
+    keys = jax.random.split(key, n)
+    out = jax.vmap(lambda k, *ps: sampler(k, *ps, shape, dtype))(
+        keys, *param_arrays)
+    return out
+
+
+@register("_sample_uniform")
+def _sample_uniform(key, low, high, *, shape=(), dtype=jnp.float32):
+    return _multisample(
+        key, lambda k, lo, hi, s, dt: jax.random.uniform(
+            k, s, dt) * (hi - lo) + lo, (low, high), shape, dtype)
+
+
+@register("_sample_normal")
+def _sample_normal(key, mu, sigma, *, shape=(), dtype=jnp.float32):
+    return _multisample(
+        key, lambda k, m, s_, s, dt: m + s_ * jax.random.normal(k, s, dt),
+        (mu, sigma), shape, dtype)
+
+
+@register("_sample_gamma")
+def _sample_gamma(key, alpha, beta, *, shape=(), dtype=jnp.float32):
+    return _multisample(
+        key, lambda k, a, b, s, dt: jax.random.gamma(k, a, s, dt) * b,
+        (alpha, beta), shape, dtype)
+
+
+@register("_sample_exponential")
+def _sample_exponential(key, lam, *, shape=(), dtype=jnp.float32):
+    return _multisample(
+        key, lambda k, l, s, dt: jax.random.exponential(k, s, dt) / l,
+        (lam,), shape, dtype)
+
+
+@register("_sample_poisson")
+def _sample_poisson(key, lam, *, shape=(), dtype=jnp.float32):
+    return _multisample(
+        key, lambda k, l, s, dt: jax.random.poisson(k, l, s).astype(dt),
+        (lam,), shape, dtype)
+
+
+@register("_sample_negative_binomial")
+def _sample_negative_binomial(key, k_arr, p, *, shape=(),
+                              dtype=jnp.float32):
+    def samp(k, kk, pp, s, dt):
+        g = jax.random.gamma(k, kk, s) * ((1.0 - pp) / pp)
+        return jax.random.poisson(jax.random.fold_in(k, 1), g, s) \
+            .astype(dt)
+    return _multisample(key, samp, (k_arr, p), shape, dtype)
+
+
+@register("_sample_generalized_negative_binomial")
+def _sample_gen_negative_binomial(key, mu, alpha, *, shape=(),
+                                  dtype=jnp.float32):
+    def samp(k, m, a, s, dt):
+        r = 1.0 / jnp.maximum(a, 1e-8)
+        g = jax.random.gamma(k, r, s) * (m * a)
+        lam = jnp.where(a <= 1e-8, jnp.broadcast_to(m, s), g)
+        return jax.random.poisson(jax.random.fold_in(k, 1), lam, s) \
+            .astype(dt)
+    return _multisample(key, samp, (mu, alpha), shape, dtype)
+
+
+# --------------------------------------------------------------------------
+# density ops (_random_pdf_*: src/operator/random/pdf_op.cc)
+# --------------------------------------------------------------------------
+
+@register("_random_pdf_uniform")
+def _pdf_uniform(sample, low, high, *, is_log=False):
+    p = jnp.where((sample >= low[..., None]) & (sample <= high[..., None]),
+                  1.0 / (high - low)[..., None], 0.0)
+    return jnp.log(jnp.maximum(p, 1e-37)) if is_log else p
+
+
+@register("_random_pdf_normal")
+def _pdf_normal(sample, mu, sigma, *, is_log=False):
+    m, s = mu[..., None], sigma[..., None]
+    logp = -0.5 * jnp.square((sample - m) / s) - jnp.log(
+        s * jnp.sqrt(2.0 * jnp.pi))
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_gamma")
+def _pdf_gamma(sample, alpha, beta, *, is_log=False):
+    a, b = alpha[..., None], 1.0 / beta[..., None]
+    logp = a * jnp.log(b) + (a - 1) * jnp.log(sample) - b * sample \
+        - jax.scipy.special.gammaln(a)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_exponential")
+def _pdf_exponential(sample, lam, *, is_log=False):
+    l = lam[..., None]
+    logp = jnp.log(l) - l * sample
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_poisson")
+def _pdf_poisson(sample, lam, *, is_log=False):
+    l = lam[..., None]
+    logp = sample * jnp.log(jnp.maximum(l, 1e-37)) - l \
+        - jax.scipy.special.gammaln(sample + 1.0)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_negative_binomial")
+def _pdf_negative_binomial(sample, k, p, *, is_log=False):
+    kk, pp = k[..., None], p[..., None]
+    from jax.scipy.special import gammaln
+    logp = gammaln(sample + kk) - gammaln(sample + 1.0) - gammaln(kk) \
+        + kk * jnp.log(pp) + sample * jnp.log1p(-pp)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_generalized_negative_binomial")
+def _pdf_gen_negative_binomial(sample, mu, alpha, *, is_log=False):
+    m, a = mu[..., None], alpha[..., None]
+    from jax.scipy.special import gammaln
+    r = 1.0 / a
+    p = r / (r + m)
+    logp = gammaln(sample + r) - gammaln(sample + 1.0) - gammaln(r) \
+        + r * jnp.log(p) + sample * jnp.log1p(-p)
+    return logp if is_log else jnp.exp(logp)
+
+
+@register("_random_pdf_dirichlet")
+def _pdf_dirichlet(sample, alpha, *, is_log=False):
+    from jax.scipy.special import gammaln
+    a = alpha[..., None, :] if alpha.ndim == sample.ndim - 1 else alpha
+    logp = jnp.sum((a - 1.0) * jnp.log(sample), axis=-1) \
+        + gammaln(jnp.sum(a, axis=-1)) - jnp.sum(gammaln(a), axis=-1)
+    return logp if is_log else jnp.exp(logp)

@@ -161,4 +161,11 @@ def invoke(name: str, nd_inputs: Sequence[Any], **params):
         fn = functools.partial(op.fn, **params)
     else:
         fn = op.fn
-    return apply_jax(fn, nd_inputs, multi_out=op.multi_out)
+    # per-op timing (parity: OprExecStat around every engine op,
+    # src/profiler/profiler.h).  Under async dispatch this measures
+    # dispatch wall time; jax's xplane trace holds device times.
+    from .. import profiler
+    t0 = profiler.op_timer()
+    out = apply_jax(fn, nd_inputs, multi_out=op.multi_out)
+    profiler.op_record(name, t0)
+    return out

@@ -1,0 +1,185 @@
+"""Fused sequence-level RNN operator.
+
+Parity: src/operator/rnn-inl.h:56-58 + rnn.cc/rnn.cu — ONE op covering
+four modes (rnn_relu / rnn_tanh / lstm / gru), multi-layer,
+bidirectional, variable-length (``use_sequence_length``), with the
+cuDNN-canonical *flat parameter vector*.  TPU-native: the time loop is
+``lax.scan`` (compiled once, runs on-device), gates are a single fused
+matmul per step on the MXU; cuDNN workspace semantics dissolve (XLA
+allocates).
+
+Flat parameter layout (mirrors GetRnnParamSize, rnn-inl.h:98):
+  for layer in range(L): for direction in range(D):
+      W  (G*H, in)   input weights
+      R  (G*H, H)    recurrent weights
+  then, in the same (layer, direction) order:
+      bW (G*H,)      input bias
+      bR (G*H,)      recurrent bias
+Gate order matches the reference/cuDNN: LSTM (i, f, g, o); GRU (r, z, n).
+
+Inputs: data (T, N, I), parameters (flat,), state (L*D, N, H),
+[state_cell (L*D, N, H) when lstm], [sequence_length (N,) when
+use_sequence_length].  Outputs: out (T, N, D*H) [+ state_h, [state_c]
+when state_outputs].
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _cell(mode):
+    if mode in ("rnn_relu", "rnn_tanh"):
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+
+        def step(x_t, h, c, wi, wh, bi, bh):
+            return act(x_t @ wi.T + bi + h @ wh.T + bh), c
+        return step
+    if mode == "lstm":
+        def step(x_t, h, c, wi, wh, bi, bh):
+            gates = x_t @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            new_c = jax.nn.sigmoid(f) * c + \
+                jax.nn.sigmoid(i) * jnp.tanh(g)
+            return jax.nn.sigmoid(o) * jnp.tanh(new_c), new_c
+        return step
+    if mode == "gru":
+        def step(x_t, h, c, wi, wh, bi, bh):
+            gi = x_t @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, inn = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(inn + r * hn)
+            return (1 - z) * n + z * h, c
+        return step
+    raise ValueError(f"unknown RNN mode {mode!r}")
+
+
+def _slice_params(params, mode, input_size, state_size, num_layers, ndir):
+    """Walk the flat vector into per-(layer, dir) (W, R, bW, bR)."""
+    G = _GATES[mode]
+    H = state_size
+    out, off = [], 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * ndir
+        per_dir = []
+        for d in range(ndir):
+            W = params[off:off + G * H * in_sz].reshape(G * H, in_sz)
+            off += G * H * in_sz
+            R = params[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            per_dir.append([W, R])
+        out.append(per_dir)
+    for layer in range(num_layers):
+        for d in range(ndir):
+            bW = params[off:off + G * H]
+            off += G * H
+            bR = params[off:off + G * H]
+            off += G * H
+            out[layer][d].extend([bW, bR])
+    return out
+
+
+def rnn_param_size(mode, input_size, state_size, num_layers,
+                   bidirectional=False):
+    """Total flat parameter count (parity: GetRnnParamSize)."""
+    G = _GATES[mode]
+    H = state_size
+    D = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        size += D * (G * H * in_sz + G * H * H + 2 * G * H)
+    return size
+
+
+def _scan_dir(mode, x, h0, c0, W, R, bW, bR, lengths, reverse):
+    step = _cell(mode)
+    T = x.shape[0]
+
+    def body(carry, inp):
+        h, c = carry
+        t, x_t = inp
+        new_h, new_c = step(x_t, h, c, W, R, bW, bR)
+        if lengths is not None:
+            valid = (t < lengths)[:, None]
+            new_h = jnp.where(valid, new_h, h)
+            new_c = jnp.where(valid, new_c, c)
+            out_t = jnp.where(valid, new_h, jnp.zeros_like(new_h))
+        else:
+            out_t = new_h
+        return (new_h, new_c), out_t
+
+    ts = jnp.arange(T)
+    if reverse and lengths is not None:
+        # per-row reverse of the valid prefix, so the reverse direction
+        # starts at each row's last valid step (cuDNN padded semantics)
+        idx = jnp.where(ts[:, None] < lengths[None, :],
+                        lengths[None, :] - 1 - ts[:, None], ts[:, None])
+        xr = jnp.take_along_axis(x, idx[:, :, None], axis=0)
+        (h_T, c_T), out = lax.scan(body, (h0, c0), (ts, xr))
+        out = jnp.take_along_axis(out, idx[:, :, None], axis=0)
+        return out, h_T, c_T
+    (h_T, c_T), out = lax.scan(body, (h0, c0), (ts, x),
+                               reverse=reverse)
+    return out, h_T, c_T
+
+
+@register("RNN", aliases=["rnn"], multi_out=True)
+def rnn(data, parameters, state, *extra, state_size, num_layers,
+        mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
+        use_sequence_length=False, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False,
+        projection_size=None):
+    if projection_size is not None:
+        raise NotImplementedError("projection_size not supported")
+    extra = list(extra)
+    state_cell = extra.pop(0) if mode == "lstm" and extra else None
+    lengths = extra.pop(0) if use_sequence_length and extra else None
+    if lengths is not None:
+        lengths = lengths.astype(jnp.int32)
+
+    ndir = 2 if bidirectional else 1
+    H = state_size
+    x = data
+    T, N, input_size = x.shape
+    layers = _slice_params(parameters, mode, input_size, H, num_layers,
+                           ndir)
+    h0 = state.reshape(num_layers, ndir, N, H)
+    c0 = (state_cell.reshape(num_layers, ndir, N, H)
+          if state_cell is not None
+          else jnp.zeros((num_layers, ndir, N, H), x.dtype))
+
+    h_out, c_out = [], []
+    for layer in range(num_layers):
+        outs = []
+        for d in range(ndir):
+            W, R, bW, bR = layers[layer][d]
+            out, h_T, c_T = _scan_dir(mode, x, h0[layer, d], c0[layer, d],
+                                      W, R, bW, bR, lengths, reverse=d == 1)
+            outs.append(out)
+            h_out.append(h_T)
+            c_out.append(c_T)
+        x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0.0 and layer < num_layers - 1:
+            from .random import next_key
+            keep = jax.random.bernoulli(next_key(), 1.0 - p, x.shape)
+            x = jnp.where(keep, x / (1.0 - p), 0.0)
+        if mode == "lstm" and lstm_state_clip_min is not None:
+            c_out[-ndir:] = [jnp.clip(c, lstm_state_clip_min,
+                                      lstm_state_clip_max)
+                             for c in c_out[-ndir:]]
+
+    h_stack = jnp.stack(h_out, axis=0)
+    if not state_outputs:
+        return (x,)
+    if mode == "lstm":
+        return x, h_stack, jnp.stack(c_out, axis=0)
+    return x, h_stack

@@ -159,6 +159,8 @@ class SPMDTrainer:
             entry = self._build_step(*sig)
             self._step_cache[sig] = entry
         jitted, cell = entry
+        from .. import profiler
+        _prof_t0 = profiler.op_timer()
         self.num_update += 1
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
@@ -173,7 +175,39 @@ class SPMDTrainer:
             self._opt_state[k] = tuple(st)
         for (param, _), new in zip(cell["aux"], aux):
             param._data._rebind(new)
+        profiler.op_record("SPMDTrainer::step", _prof_t0)
         return NDArray(loss)
+
+    def cost_analysis(self, data, label):
+        """XLA cost analysis (flops/bytes) for the compiled step that
+        matches ``(data, label)``'s signature.  Used by bench.py for MFU
+        accounting; the step must have been run at least once.
+
+        Note: the AOT ``lower().compile()`` path does not share the jit
+        call cache, so this costs one extra compile per signature (a
+        disk hit when ``jax_compilation_cache_dir`` is set, as bench.py
+        does); the result is memoized."""
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
+        cached = getattr(self, "_cost_cache", {}).get(sig)
+        if cached is not None:
+            return cached
+        jitted, _ = self._step_cache[sig]
+        p_arrays = [self._params[k].data()._data for k in self._pkeys]
+        opt_state = [self._opt_state[k] for k in self._pkeys]
+        lr = jnp.float32(self.optimizer.learning_rate)
+        wd = jnp.float32(self.optimizer.wd)
+        compiled = jitted.lower(next_key(), lr, wd, p_arrays, opt_state,
+                                d, l).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        out = dict(ca or {})
+        if not hasattr(self, "_cost_cache"):
+            self._cost_cache = {}
+        self._cost_cache[sig] = out
+        return out
 
     def fit(self, data_iter, epochs=1, verbose=False):
         losses = []

@@ -22,8 +22,39 @@ _config = {"profile_all": False, "profile_symbolic": False,
            "profile_api": False, "filename": "profile.json",
            "aggregate_stats": False}
 _running = False
+_xplane_on = False
 _trace_dir: Optional[str] = None
 _agg: Dict[str, list] = defaultdict(list)
+
+
+# -- operator instrumentation ------------------------------------------------
+# The op funnel (ops/registry.invoke) and the jit step funnels
+# (HybridBlock._call_cached, SPMDTrainer.step) call these hooks — the
+# analogue of the reference wrapping every engine op in OprExecStat
+# (src/profiler/profiler.h; threaded_engine.cc ExecuteOprBlock).
+
+def imperative_enabled() -> bool:
+    """True when per-op profiling is active (profiler started and
+    imperative/all profiling configured)."""
+    return _running and (_config.get("profile_all")
+                         or _config.get("profile_imperative"))
+
+
+def record_op(name: str, seconds: float) -> None:
+    """Feed one op execution into the aggregate table."""
+    _agg[name].append(seconds)
+
+
+def op_timer():
+    """Start timestamp when per-op profiling is on, else None.  Pair
+    with :func:`op_record` — the shared instrumentation used by the op
+    funnel, CachedOp and SPMDTrainer."""
+    return time.perf_counter() if imperative_enabled() else None
+
+
+def op_record(name: str, t0) -> None:
+    if t0 is not None:
+        record_op(name, time.perf_counter() - t0)
 
 
 def set_config(**kwargs):
@@ -32,24 +63,27 @@ def set_config(**kwargs):
 
 
 def start(profile_process="worker"):
-    global _running, _trace_dir
+    global _running, _trace_dir, _xplane_on
     if _running:
         return
+    _running = True
     _trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
     try:
         jax.profiler.start_trace(_trace_dir)
-        _running = True
+        _xplane_on = True
     except Exception:
-        _running = False
+        _xplane_on = False
 
 
 def stop(profile_process="worker"):
-    global _running
+    global _running, _xplane_on
     if _running:
-        try:
-            jax.profiler.stop_trace()
-        finally:
-            _running = False
+        _running = False
+        if _xplane_on:
+            try:
+                jax.profiler.stop_trace()
+            finally:
+                _xplane_on = False
 
 
 def pause(profile_process="worker"):

@@ -1,0 +1,87 @@
+"""Worker body for the multi-process dist_sync kvstore test.
+
+Run by tools/launch.py --launcher local -n 2 (parity:
+tests/nightly/dist_sync_kvstore.py driven by the dmlc launcher).  Each
+process initializes jax.distributed on CPU, exercises the device
+collective allreduce, packed 2-bit compression, and ZeRO
+update_on_kvstore paths, asserts cross-rank parameter equality, and
+writes an OK sentinel the pytest wrapper checks.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import create as kv_create
+from mxnet_tpu.ndarray import NDArray
+
+
+def main(out_dir):
+    kv = kv_create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, f"expected 2 workers, got {nw}"
+
+    # 1. device-collective allreduce: sum over ranks --------------------
+    v = NDArray(onp.full((5, 3), float(rank + 1), dtype="float32"))
+    kv.push("a", v)
+    out = NDArray(onp.zeros((5, 3), dtype="float32"))
+    kv.pull("a", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 3.0)
+
+    # 2. packed 2-bit compression over the wire -------------------------
+    kv2 = kv_create("dist_sync")
+    kv2.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g = onp.full((9,), 0.7 if rank == 0 else -0.7, dtype="float32")
+    kv2.push("c", NDArray(g))
+    out = NDArray(onp.zeros((9,), dtype="float32"))
+    kv2.pull("c", out=out)
+    # rank0 quantizes to +0.5, rank1 to -0.5 -> sum 0
+    onp.testing.assert_allclose(out.asnumpy(), 0.0)
+    # residual feedback: second push of the same grads tips over
+    kv2.push("c", NDArray(g))
+    kv2.pull("c", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.0)
+
+    # 3. update_on_kvstore == ZeRO-1 weight-update sharding -------------
+    kv3 = kv_create("dist_sync")
+    kv3.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    w0 = onp.ones((7,), dtype="float32")
+    kv3.init("w", NDArray(w0.copy()))
+    kv3.push("w", NDArray(onp.full((7,), 0.5, dtype="float32")))
+    out = NDArray(onp.zeros((7,), dtype="float32"))
+    kv3.pull("w", out=out)
+    # summed grad = 1.0; sgd: w - lr*g = 1 - 0.1 = 0.9
+    onp.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-6)
+    # optimizer state is 1/N sized (ceil(7/2)=4 elements this rank)
+    st = kv3._opt_states["w"]
+    for s in st:
+        if s is not None:
+            assert s.shape[0] == 4, f"state not sharded: {s.shape}"
+
+    # 4. cross-rank parameter equality ----------------------------------
+    mine = kv3._data["w"]._data
+    both = kv3._collectives().allgather(mine)
+    onp.testing.assert_allclose(onp.asarray(both[0]),
+                                onp.asarray(both[1]), rtol=0, atol=0)
+
+    kv.barrier()
+    with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -1,0 +1,39 @@
+"""Multi-process dist_sync kvstore test (parity:
+tests/nightly/dist_sync_kvstore.py driven by tools/launch.py --launcher
+local).  Two real OS processes run jax.distributed on CPU; the worker
+body (dist_worker.py) checks allreduce numerics, packed compression,
+ZeRO update_on_kvstore, and cross-rank parameter equality."""
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.timeout(600)
+def test_dist_sync_two_processes(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # workers set their own xla_force_host_platform_device_count
+    env.pop("XLA_FLAGS", None)
+    cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
+           "-n", "2", "--launcher", "local",
+           "--port", str(_free_port()), "--",
+           sys.executable, os.path.join(_REPO, "tests", "dist_worker.py"),
+           str(tmp_path)]
+    proc = subprocess.run(cmd, env=env, cwd=_REPO, timeout=570,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, \
+        f"launcher failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
+    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()

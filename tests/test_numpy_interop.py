@@ -1,0 +1,142 @@
+"""NumPy interoperability conformance suite.
+
+Parity: tests/python/unittest/test_numpy_interoperability.py — verifies
+(1) mx.np functions agree with host numpy over a broad battery, and
+(2) the dispatch protocol: calling *numpy's own* functions/ufuncs on
+mx.np.ndarray routes through our implementations
+(python/mxnet/numpy_dispatch_protocol.py parity)."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import numpy as np
+
+RNG = onp.random.RandomState(3)
+
+
+def _chk(mx_out, np_out, rtol=1e-5, atol=1e-6):
+    got = mx_out.asnumpy() if hasattr(mx_out, "asnumpy") else onp.asarray(
+        mx_out)
+    onp.testing.assert_allclose(got, np_out, rtol=rtol, atol=atol)
+
+
+# -- function battery: mx.np.f(x) == numpy.f(x) ----------------------------
+
+_UNARY_CASES = [
+    "abs", "sqrt", "square", "exp", "log", "log2", "log10", "log1p",
+    "expm1", "sin", "cos", "tan", "arcsin", "arccos", "arctan", "sinh",
+    "cosh", "tanh", "arcsinh", "arctanh", "floor", "ceil", "trunc",
+    "sign", "reciprocal", "cbrt", "degrees", "radians", "rint",
+]
+
+
+@pytest.mark.parametrize("fname", _UNARY_CASES)
+def test_unary_conformance(fname):
+    x = (RNG.rand(3, 4) * 0.8 + 0.1).astype("float32")
+    mx_f = getattr(np, fname)
+    np_f = getattr(onp, fname)
+    _chk(mx_f(np.array(x)), np_f(x), rtol=1e-5, atol=1e-5)
+
+
+_BINARY_CASES = ["add", "subtract", "multiply", "divide", "power",
+                 "maximum", "minimum", "hypot", "arctan2", "fmod",
+                 "copysign", "heaviside", "logaddexp"]
+
+
+@pytest.mark.parametrize("fname", _BINARY_CASES)
+def test_binary_conformance(fname):
+    a = (RNG.rand(3, 4) + 0.5).astype("float32")
+    b = (RNG.rand(3, 4) + 0.5).astype("float32")
+    mx_f = getattr(np, fname, None)
+    if mx_f is None:
+        pytest.skip(f"np.{fname} not exposed")
+    _chk(mx_f(np.array(a), np.array(b)), getattr(onp, fname)(a, b),
+         rtol=1e-5, atol=1e-5)
+
+
+_REDUCTION_CASES = [
+    ("sum", {}), ("mean", {}), ("std", {}), ("var", {}),
+    ("max", {}), ("min", {}), ("prod", {}), ("argmax", {}),
+    ("argmin", {}), ("cumsum", {}), ("median", {}),
+]
+
+
+@pytest.mark.parametrize("fname,kw", _REDUCTION_CASES)
+def test_reduction_conformance(fname, kw):
+    x = RNG.rand(4, 5).astype("float32")
+    _chk(getattr(np, fname)(np.array(x), **kw),
+         getattr(onp, fname)(x, **kw), rtol=1e-4, atol=1e-5)
+
+
+_SHAPE_CASES = [
+    ("reshape", ((2, 10),), {}),
+    ("transpose", (), {}),
+    ("squeeze", (), {}),
+    ("expand_dims", (0,), {}),
+    ("flip", (), {}),
+    ("roll", (2,), {}),
+]
+
+
+@pytest.mark.parametrize("fname,args,kw", _SHAPE_CASES)
+def test_shape_conformance(fname, args, kw):
+    x = RNG.rand(4, 5).astype("float32")
+    if fname == "squeeze":
+        x = x[:, None]
+    _chk(getattr(np, fname)(np.array(x), *args, **kw),
+         getattr(onp, fname)(x, *args, **kw))
+
+
+def test_linalg_conformance():
+    a = RNG.rand(3, 3).astype("float32")
+    spd = a @ a.T + 3 * onp.eye(3, dtype="float32")
+    _chk(np.linalg.inv(np.array(spd)), onp.linalg.inv(spd), rtol=1e-3,
+         atol=1e-3)
+    _chk(np.linalg.norm(np.array(a)), onp.linalg.norm(a), rtol=1e-5)
+    _chk(np.linalg.det(np.array(spd)), onp.linalg.det(spd), rtol=1e-3)
+    _chk(np.trace(np.array(a)), onp.trace(a), rtol=1e-5)
+    _chk(np.einsum("ij,jk->ik", np.array(a), np.array(spd)),
+         onp.einsum("ij,jk->ik", a, spd), rtol=1e-4, atol=1e-4)
+
+
+def test_manipulation_conformance():
+    a = RNG.rand(2, 3).astype("float32")
+    b = RNG.rand(2, 3).astype("float32")
+    _chk(np.concatenate([np.array(a), np.array(b)], axis=0),
+         onp.concatenate([a, b], 0))
+    _chk(np.stack([np.array(a), np.array(b)]), onp.stack([a, b]))
+    _chk(np.vstack([np.array(a), np.array(b)]), onp.vstack([a, b]))
+    _chk(np.tile(np.array(a), (2, 1)), onp.tile(a, (2, 1)))
+    _chk(np.repeat(np.array(a), 2, axis=1), onp.repeat(a, 2, 1))
+    _chk(np.where(np.array(a) > 0.5, np.array(a), np.array(b)),
+         onp.where(a > 0.5, a, b))
+
+
+# -- dispatch protocol: numpy's OWN functions on mx arrays ------------------
+
+def test_array_function_dispatch():
+    x = np.array(RNG.rand(3, 4).astype("float32"))
+    out = onp.mean(x)
+    assert float(out) == pytest.approx(float(x.asnumpy().mean()),
+                                       rel=1e-5)
+    out2 = onp.concatenate([x, x], axis=0)
+    got = out2.asnumpy() if hasattr(out2, "asnumpy") else out2
+    assert got.shape == (6, 4)
+
+
+def test_array_ufunc_dispatch():
+    x = np.array(onp.ones((2, 2), "float32"))
+    out = onp.add(x, 1.0)
+    got = out.asnumpy() if hasattr(out, "asnumpy") else onp.asarray(out)
+    onp.testing.assert_allclose(got, 2.0)
+    out = onp.exp(x)
+    got = out.asnumpy() if hasattr(out, "asnumpy") else onp.asarray(out)
+    onp.testing.assert_allclose(got, onp.e, rtol=1e-6)
+
+
+def test_fallback_for_exotica():
+    """Functions we don't implement fall back to host numpy (parity:
+    python/mxnet/numpy/fallback.py)."""
+    x = np.array(RNG.rand(5).astype("float32"))
+    out = onp.unwrap(x)  # not in our namespace
+    assert onp.asarray(out).shape == (5,)

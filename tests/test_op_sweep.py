@@ -1,0 +1,274 @@
+"""Registry-sweep tests for the npi/linalg/legacy/image op families
+(parity targets: src/operator/numpy/*, tensor/la_op.cc,
+tensor/elemwise_binary_scalar_op_*.cc, image/image_random.cc).
+Each case invokes the registered op and checks against host numpy."""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import invoke, get, list_ops
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ops.random import next_key
+
+
+def _nd(x):
+    return NDArray(onp.asarray(x))
+
+
+def _inv(name, arrays, **params):
+    out = invoke(name, [_nd(a) for a in arrays], **params)
+    if isinstance(out, list):
+        return [o.asnumpy() for o in out]
+    return out.asnumpy()
+
+
+RNG = onp.random.RandomState(42)
+
+
+def test_registry_size():
+    ops = list_ops()
+    uniq = {id(get(n)) for n in ops}
+    assert len(uniq) >= 400, f"unique op count fell to {len(uniq)}"
+
+
+def test_npi_binary_and_scalar():
+    a = RNG.randn(3, 4).astype("float32")
+    b = RNG.randn(3, 4).astype("float32")
+    onp.testing.assert_allclose(_inv("_npi_add", [a, b]), a + b, rtol=1e-6)
+    onp.testing.assert_allclose(_inv("_npi_multiply", [a, b]), a * b,
+                                rtol=1e-6)
+    onp.testing.assert_allclose(_inv("_npi_fmax", [a, b]),
+                                onp.fmax(a, b), rtol=1e-6)
+    onp.testing.assert_allclose(_inv("_npi_rsubtract_scalar", [a],
+                                     scalar=2.0), 2.0 - a, rtol=1e-6)
+    onp.testing.assert_allclose(_inv("_npi_rtrue_divide_scalar", [a + 3],
+                                     scalar=1.0), 1.0 / (a + 3), rtol=1e-5)
+    onp.testing.assert_allclose(_inv("_plus_scalar", [a], scalar=1.5),
+                                a + 1.5, rtol=1e-6)
+    onp.testing.assert_allclose(_inv("_rdiv_scalar", [a + 3], scalar=6.0),
+                                6.0 / (a + 3), rtol=1e-5)
+    eq = _inv("_equal_scalar", [onp.array([1.0, 2.0])], scalar=2.0)
+    onp.testing.assert_allclose(eq, [0.0, 1.0])
+
+
+def test_npi_reductions_and_stats():
+    a = RNG.randn(4, 5).astype("float32")
+    onp.testing.assert_allclose(_inv("_npi_sum", [a], axis=1),
+                                a.sum(1), rtol=1e-5)
+    onp.testing.assert_allclose(_inv("_npi_std", [a], ddof=1),
+                                a.std(ddof=1), rtol=1e-5)
+    onp.testing.assert_allclose(_inv("_npi_average", [a]),
+                                a.mean(), rtol=1e-5)
+    m, v = _inv("moments", [a], axes=(0,))
+    onp.testing.assert_allclose(m, a.mean(0), rtol=1e-5)
+    onp.testing.assert_allclose(v, a.var(0), rtol=1e-5)
+
+
+def test_npi_manipulation():
+    a = RNG.randn(2, 3).astype("float32")
+    b = RNG.randn(2, 3).astype("float32")
+    onp.testing.assert_allclose(_inv("_npi_concatenate", [a, b], axis=0),
+                                onp.concatenate([a, b], 0))
+    onp.testing.assert_allclose(_inv("_npi_vstack", [a, b]),
+                                onp.vstack([a, b]))
+    onp.testing.assert_allclose(_inv("_npi_flip", [a], axis=1),
+                                onp.flip(a, 1))
+    onp.testing.assert_allclose(_inv("_npi_roll", [a], shift=2),
+                                onp.roll(a, 2))
+    onp.testing.assert_allclose(_inv("_np_moveaxis", [a], source=0,
+                                     destination=1), onp.moveaxis(a, 0, 1))
+    onp.testing.assert_allclose(
+        _inv("_npi_pad", [a], pad_width=((1, 1), (0, 2))),
+        onp.pad(a, ((1, 1), (0, 2))))
+    onp.testing.assert_allclose(_inv("_npi_diff", [a], n=1, axis=1),
+                                onp.diff(a, axis=1), rtol=1e-6)
+    u = _inv("_npi_unique", [onp.array([3, 1, 3, 2])])
+    onp.testing.assert_allclose(u[0] if isinstance(u, list) else u,
+                                [1, 2, 3])
+
+
+def test_npi_creation_windows():
+    onp.testing.assert_allclose(_inv("_npi_eye", [], N=3, k=1),
+                                onp.eye(3, k=1))
+    onp.testing.assert_allclose(_inv("_npi_hanning", [], M=8),
+                                onp.hanning(8), rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(_inv("_npi_blackman", [], M=5),
+                                onp.blackman(5), rtol=1e-5, atol=1e-6)
+    onp.testing.assert_allclose(_inv("_npi_logspace", [], start=0, stop=2,
+                                     num=5), onp.logspace(0, 2, 5),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(_inv("_npi_tri", [], N=4, k=-1),
+                                onp.tri(4, k=-1))
+
+
+def test_npi_numeric_specials():
+    x = onp.array([0.5, 1.5, 2.5], dtype="float32")
+    xp = onp.array([0.0, 1.0, 2.0, 3.0], dtype="float32")
+    fp = onp.array([0.0, 10.0, 20.0, 30.0], dtype="float32")
+    onp.testing.assert_allclose(_inv("_npi_interp", [x, xp, fp]),
+                                onp.interp(x, xp, fp), rtol=1e-6)
+    a = RNG.rand(20).astype("float32")
+    onp.testing.assert_allclose(
+        _inv("_npi_percentile", [a], q=30.0),
+        onp.percentile(a, 30.0), rtol=1e-5)
+    p = onp.array([1.0, -2.0, 3.0], dtype="float32")
+    onp.testing.assert_allclose(_inv("_npi_polyval", [p, x]),
+                                onp.polyval(p, x), rtol=1e-5)
+    a3 = RNG.randn(3).astype("float32")
+    b3 = RNG.randn(3).astype("float32")
+    onp.testing.assert_allclose(_inv("_npi_cross", [a3, b3]),
+                                onp.cross(a3, b3), rtol=1e-5)
+    A = RNG.randn(2, 3).astype("float32")
+    B = RNG.randn(4, 5).astype("float32")
+    onp.testing.assert_allclose(_inv("_npi_kron", [A, B]),
+                                onp.kron(A, B), rtol=1e-5)
+    M = RNG.randn(3, 4).astype("float32")
+    N = RNG.randn(4, 5).astype("float32")
+    onp.testing.assert_allclose(
+        _inv("_npi_einsum", [M, N], subscripts="ij,jk->ik"),
+        onp.einsum("ij,jk->ik", M, N), rtol=1e-4)
+
+
+def test_linalg_family():
+    A = RNG.randn(3, 3).astype("float32")
+    spd = A @ A.T + 3 * onp.eye(3, dtype="float32")
+    L = _inv("_linalg_potrf", [spd])
+    onp.testing.assert_allclose(L @ L.T, spd, rtol=1e-4, atol=1e-4)
+    inv = _inv("_linalg_potri", [L])
+    onp.testing.assert_allclose(inv, onp.linalg.inv(spd), rtol=1e-3,
+                                atol=1e-3)
+    B = RNG.randn(3, 2).astype("float32")
+    C = RNG.randn(3, 2).astype("float32")
+    onp.testing.assert_allclose(
+        _inv("_linalg_gemm", [A, B, C], alpha=2.0, beta=0.5),
+        2.0 * A @ B + 0.5 * C, rtol=1e-5)
+    # trsm: solve L X = alpha B
+    X = _inv("_linalg_trsm", [L, B], alpha=1.0)
+    onp.testing.assert_allclose(onp.tril(L) @ X, B, rtol=1e-4, atol=1e-4)
+    sign, logdet = _inv("_linalg_slogdet", [spd])
+    s_ref, l_ref = onp.linalg.slogdet(spd)
+    onp.testing.assert_allclose(sign, s_ref, rtol=1e-5)
+    onp.testing.assert_allclose(logdet, l_ref, rtol=1e-4)
+    w, v = _inv("_npi_eigh", [spd])
+    w_ref = onp.linalg.eigvalsh(spd)
+    onp.testing.assert_allclose(w, w_ref, rtol=1e-4, atol=1e-4)
+    U, Lw = _inv("_linalg_syevd", [spd])
+    onp.testing.assert_allclose(Lw, w_ref, rtol=1e-4, atol=1e-4)
+    Lq, Q = _inv("_linalg_gelqf", [B.T])
+    onp.testing.assert_allclose(Lq @ Q, B.T, rtol=1e-4, atol=1e-4)
+    onp.testing.assert_allclose(Q @ Q.T, onp.eye(2), rtol=1e-4, atol=1e-4)
+    packed = _inv("_linalg_extracttrian", [spd])
+    restored = _inv("_linalg_maketrian", [packed])
+    onp.testing.assert_allclose(restored, onp.tril(spd), rtol=1e-5)
+
+
+def test_im2col_col2im_roundtrip():
+    x = RNG.randn(2, 3, 6, 6).astype("float32")
+    col = _inv("im2col", [x], kernel=(2, 2), stride=(2, 2))
+    assert col.shape == (2, 12, 9)
+    back = _inv("col2im", [col], input_size=(6, 6), kernel=(2, 2),
+                stride=(2, 2))
+    # non-overlapping stride==kernel -> exact roundtrip
+    onp.testing.assert_allclose(back, x, rtol=1e-6)
+
+
+def test_amp_and_multi_tensor():
+    a16 = RNG.randn(3).astype("float16")
+    a32 = RNG.randn(3).astype("float32")
+    outs = _inv("amp_multicast", [a16, a32], num_outputs=2)
+    assert all(o.dtype == onp.float32 for o in outs)
+    fin = _inv("all_finite", [onp.array([1.0, onp.inf])])
+    onp.testing.assert_allclose(fin, [0.0])
+    sq = _inv("multi_sum_sq", [onp.ones((2, 2), "float32"),
+                               2 * onp.ones(3, "float32")], num_arrays=2)
+    onp.testing.assert_allclose(sq[0], [4.0])
+    onp.testing.assert_allclose(sq[1], [12.0])
+
+
+def test_image_ops():
+    img = (RNG.rand(8, 6, 3) * 255).astype("uint8")
+    t = _inv("_image_to_tensor", [img])
+    assert t.shape == (3, 8, 6) and t.dtype == onp.float32
+    assert t.max() <= 1.0
+    n = _inv("_image_normalize", [t], mean=(0.5, 0.5, 0.5),
+             std=(0.2, 0.2, 0.2))
+    onp.testing.assert_allclose(n, (t - 0.5) / 0.2, rtol=1e-5)
+    c = _inv("_image_crop", [img], x=1, y=2, width=4, height=5)
+    assert c.shape == (5, 4, 3)
+    onp.testing.assert_allclose(c, img[2:7, 1:5])
+    r = _inv("_image_resize", [img], size=(12, 16))
+    assert r.shape == (16, 12, 3)
+    key = next_key()
+    rc = invoke("_image_random_crop", [NDArray(key), _nd(img)],
+                size=(4, 4)).asnumpy()
+    assert rc.shape == (4, 4, 3)
+    rrc = invoke("_image_random_resized_crop", [NDArray(key), _nd(img)],
+                 size=(5, 5)).asnumpy()
+    assert rrc.shape == (5, 5, 3)
+
+
+def test_npi_random_samplers():
+    key = next_key()
+    u = invoke("_npi_uniform", [NDArray(key)], low=2.0, high=3.0,
+               size=(1000,)).asnumpy()
+    assert 2.0 <= u.min() and u.max() <= 3.0
+    assert abs(u.mean() - 2.5) < 0.05
+    n = invoke("_npi_normal", [NDArray(key)], loc=1.0, scale=2.0,
+               size=(4000,)).asnumpy()
+    assert abs(n.mean() - 1.0) < 0.15 and abs(n.std() - 2.0) < 0.15
+    w = invoke("_npi_weibull", [NDArray(key)], a=1.0,
+               size=(2000,)).asnumpy()
+    assert abs(w.mean() - 1.0) < 0.1  # Weibull(1) == Exp(1)
+    m = invoke("_npi_multinomial", [NDArray(key)], n=100,
+               pvals=(0.2, 0.8)).asnumpy()
+    assert m.sum() == 100 and m[1] > m[0]
+
+
+def test_sample_per_row():
+    key = next_key()
+    low = _nd(onp.array([0.0, 10.0], dtype="float32"))
+    high = _nd(onp.array([1.0, 20.0], dtype="float32"))
+    out = invoke("_sample_uniform", [NDArray(key), low, high],
+                 shape=(500,)).asnumpy()
+    assert out.shape == (2, 500)
+    assert out[0].max() <= 1.0 and out[1].min() >= 10.0
+
+
+def test_random_pdf():
+    s = onp.array([[0.5, 1.0]], dtype="float32")
+    mu = onp.array([0.0], dtype="float32")
+    sig = onp.array([1.0], dtype="float32")
+    p = _inv("_random_pdf_normal", [s, mu, sig])
+    expect = onp.exp(-0.5 * s ** 2) / onp.sqrt(2 * onp.pi)
+    onp.testing.assert_allclose(p, expect, rtol=1e-5)
+
+
+def test_fused_mp_and_lamb_phases():
+    w = RNG.randn(4).astype("float16")
+    w32 = w.astype("float32")
+    g = RNG.randn(4).astype("float16")
+    out = _inv("mp_sgd_update", [w, g, w32], lr=0.1)
+    onp.testing.assert_allclose(out[1], w32 - 0.1 * g.astype("float32"),
+                                rtol=1e-3)
+    onp.testing.assert_allclose(out[0], out[1].astype("float16"),
+                                rtol=1e-3)
+    # lamb phases == fused lamb_update direction
+    wt = RNG.randn(5).astype("float32")
+    gt = RNG.randn(5).astype("float32")
+    m = onp.zeros(5, "float32")
+    v = onp.zeros(5, "float32")
+    gu = _inv("lamb_update_phase1", [wt, gt, m, v], t=1, wd=0.01)
+    r1 = onp.linalg.norm(wt).reshape(1).astype("float32")
+    r2 = onp.linalg.norm(gu).reshape(1).astype("float32")
+    out2 = _inv("lamb_update_phase2", [wt, gu, r1, r2], lr=0.01)
+    assert out2.shape == wt.shape
+    assert not onp.allclose(out2, wt)
+
+
+def test_multi_sgd_update():
+    ws = [RNG.randn(3).astype("float32") for _ in range(2)]
+    gs = [RNG.randn(3).astype("float32") for _ in range(2)]
+    outs = _inv("multi_sgd_update", [ws[0], gs[0], ws[1], gs[1]],
+                lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
+    onp.testing.assert_allclose(outs[0], ws[0] - 0.1 * gs[0], rtol=1e-5)
+    onp.testing.assert_allclose(outs[1], ws[1] - 0.2 * gs[1], rtol=1e-5)

@@ -1,0 +1,69 @@
+"""Profiler instrumentation (parity: every engine op wrapped in
+OprExecStat — src/profiler/profiler.h, threaded_engine.cc; frontend
+python/mxnet/profiler.py set_config/start/stop/dumps)."""
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, profiler
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import NDArray
+
+
+def _lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(6, kernel_size=5, activation="relu"))
+    net.add(nn.MaxPool2D(pool_size=2))
+    net.add(nn.Flatten())
+    net.add(nn.Dense(10))
+    return net
+
+
+def test_eager_ops_in_aggregate_table():
+    profiler.set_config(profile_imperative=True, aggregate_stats=True,
+                        filename="/tmp/mxtpu_prof_test.json")
+    net = _lenet()
+    net.initialize(init=mx.initializer.Xavier())
+    x = NDArray(onp.random.RandomState(0).randn(2, 1, 28, 28)
+                .astype("float32"))
+    profiler.start()
+    try:
+        with mx.autograd.record():
+            out = net(x)
+            loss = out.sum()
+        loss.backward()
+        loss.wait_to_read()
+    finally:
+        profiler.stop()
+    table = profiler.dumps(reset=True)
+    assert "Convolution" in table
+    assert "FullyConnected" in table or "Dense" in table
+
+
+def test_cachedop_in_aggregate_table():
+    profiler.set_config(profile_imperative=True, aggregate_stats=True,
+                        filename="/tmp/mxtpu_prof_test2.json")
+    net = _lenet()
+    net.initialize(init=mx.initializer.Xavier())
+    x = NDArray(onp.random.RandomState(0).randn(2, 1, 28, 28)
+                .astype("float32"))
+    net(x)
+    net.hybridize()
+    profiler.start()
+    try:
+        net(x).wait_to_read()
+        net(x).wait_to_read()
+    finally:
+        profiler.stop()
+    table = profiler.dumps(reset=True)
+    assert "CachedOp::HybridSequential" in table
+
+
+def test_profiler_off_records_nothing():
+    profiler.dumps(reset=True)
+    net = _lenet()
+    net.initialize(init=mx.initializer.Xavier())
+    x = NDArray(onp.random.RandomState(0).randn(1, 1, 28, 28)
+                .astype("float32"))
+    net(x).wait_to_read()
+    table = profiler.dumps()
+    assert "Convolution" not in table
