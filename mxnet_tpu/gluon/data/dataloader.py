@@ -10,6 +10,8 @@ optional fast path the C++ pipeline provides — see src_native/ io).
 from __future__ import annotations
 
 import multiprocessing
+import os
+import weakref
 from typing import Any, Callable, Optional
 
 import numpy as onp
@@ -34,7 +36,60 @@ def default_batchify_fn(data):
     return NDArray(arr)
 
 
-default_mp_batchify_fn = default_batchify_fn
+def default_mp_batchify_fn(data):
+    """Worker-side batchify: stacks to host numpy only, never touching the
+    device runtime (parity: dataloader.py default_mp_batchify_fn, which
+    batches into shared-memory NDArrays — here the invariant is instead
+    "no JAX in worker processes", since a forked child inheriting an
+    initialized XLA backend is the deadlock class the reference guards
+    with pthread_atfork in src/initialize.cc:70-97)."""
+    if isinstance(data[0], NDArray):
+        return onp.stack([d.asnumpy() for d in data])
+    if isinstance(data[0], (tuple, list)):
+        return tuple(default_mp_batchify_fn(list(x)) for x in zip(*data))
+    arr = onp.asarray(data)
+    if arr.dtype == onp.float64:
+        arr = arr.astype(onp.float32)
+    return arr
+
+
+_FORK_GUARD_DONE = False
+
+
+def _install_fork_guard():
+    """Drain the async engine before any fork so no dispatch thread is
+    mid-flight in the parent (parity: src/initialize.cc:70-97, which
+    pauses the engine around fork via pthread_atfork)."""
+    global _FORK_GUARD_DONE
+    if _FORK_GUARD_DONE:
+        return
+    _FORK_GUARD_DONE = True
+
+    def _quiesce():
+        try:
+            from ... import engine
+            engine.wait_all()
+        except Exception:
+            pass
+
+    os.register_at_fork(before=_quiesce)
+
+
+def _mp_context():
+    """Pick the worker start method. Default is fork — spawn would
+    re-import ``__main__`` and break plain user scripts without a main
+    guard (and interactive sessions entirely).  Fork is made safe the
+    way the reference makes it safe (src/initialize.cc:70-97): the
+    engine is drained immediately before every fork, and worker-side
+    batchify never touches the device runtime (numpy-only), so children
+    never enter the XLA backend they inherited.  Set
+    ``MXNET_MP_START_METHOD=spawn`` (or forkserver) to override."""
+    method = os.environ.get("MXNET_MP_START_METHOD", "")
+    if method not in ("fork", "spawn", "forkserver"):
+        method = "fork"
+    if method == "fork":
+        _install_fork_guard()
+    return multiprocessing.get_context(method)
 
 
 def _worker_fn(dataset, batchify_fn, indices):
@@ -75,7 +130,12 @@ class DataLoader:
                              "mutually exclusive with batch_sampler")
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
-        self._batchify_fn = batchify_fn or default_batchify_fn
+        if batchify_fn is not None:
+            self._batchify_fn = batchify_fn
+        elif self._num_workers > 0 and not thread_pool:
+            self._batchify_fn = default_mp_batchify_fn
+        else:
+            self._batchify_fn = default_batchify_fn
         self._prefetch = max(0, prefetch or 2 * self._num_workers)
         self._thread_pool = thread_pool
         self._timeout = timeout
@@ -87,8 +147,10 @@ class DataLoader:
                 from multiprocessing.pool import ThreadPool
                 self._pool = ThreadPool(self._num_workers)
             else:
-                ctx = multiprocessing.get_context("fork")
-                self._pool = ctx.Pool(self._num_workers)
+                self._pool = _mp_context().Pool(self._num_workers)
+            # weakref.finalize runs before interpreter teardown (unlike
+            # __del__ on a module-global loader), so workers die cleanly
+            weakref.finalize(self, _shutdown_pool, self._pool)
         return self._pool
 
     def __iter__(self):
@@ -123,7 +185,16 @@ class DataLoader:
 
     def __del__(self):
         if self._pool is not None:
-            self._pool.terminate()
+            _shutdown_pool(self._pool)
+            self._pool = None
+
+
+def _shutdown_pool(pool):
+    try:
+        pool.terminate()
+        pool.join()
+    except Exception:
+        pass
 
 
 def _rewrap(x):
