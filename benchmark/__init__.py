@@ -1,0 +1,1 @@
+"""Operator micro-benchmark package (parity: benchmark/opperf)."""

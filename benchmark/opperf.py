@@ -1,0 +1,363 @@
+"""Registry-driven operator micro-benchmark harness.
+
+Parity: ``benchmark/opperf`` in the reference (opperf.py
+run_all_mxnet_operator_benchmarks + utils/benchmark_utils.py
+run_performance_test) re-designed for the TPU build: instead of 18
+hand-curated category modules, the harness walks the live op registry
+(`mxnet_tpu.ops.registry`), synthesizes default inputs per op from a
+small rules table with a probing fallback, and times
+
+- **eager forward** — the `invoke` funnel, device-synced per call
+  (what the reference's engine-push timing measures), and
+- **jit forward** — the same fn under `jax.jit`, steady-state (the
+  regime real training runs in; no reference analogue, TPU-specific),
+- **eager forward+backward** — tape + vjp, where the op is
+  differentiable.
+
+Usage::
+
+    python -m benchmark.opperf                     # every benchmarkable op
+    python -m benchmark.opperf --ops exp,dot,Convolution
+    python -m benchmark.opperf --runs 50 --warmup 10 --output-json r.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+
+__all__ = ["default_inputs", "benchmark_op", "run_op_benchmarks",
+           "benchmarkable_ops", "format_table"]
+
+_RNG = onp.random.RandomState(17)
+
+
+def _nd(shape, dtype="float32", positive=False, low=None, high=None):
+    import mxnet_tpu as mx
+    if dtype in ("int32", "int64"):
+        arr = _RNG.randint(low if low is not None else 0,
+                           high if high is not None else 8,
+                           size=shape).astype(dtype)
+    else:
+        arr = _RNG.uniform(0.5 if positive else -1.0, 1.0,
+                           size=shape).astype(dtype)
+    return mx.nd.array(arr)
+
+
+# --------------------------------------------------------------------------
+# default-input rules (parity: opperf/utils/op_registry_utils.py
+# DEFAULTS_INPUTS — here a pattern table instead of a per-op dict)
+# --------------------------------------------------------------------------
+
+# Each rule: (regex on op name, builder() -> (inputs, params)).
+# First match wins.  Shapes are modest so the sweep finishes on small
+# hosts; pass --large for reference-opperf-sized tensors.
+_SMALL = {"vec": (1024,), "mat": (64, 64), "batch4d": (4, 8, 16, 16),
+          "gemm": (64, 64)}
+_LARGE = {"vec": (2 ** 20,), "mat": (1024, 1024),
+          "batch4d": (32, 3, 224, 224), "gemm": (1024, 1024)}
+_SHAPES = dict(_SMALL)
+
+
+def _rule_conv():
+    x = _nd(_SHAPES["batch4d"])
+    c = x.shape[1]
+    w = _nd((16, c, 3, 3))
+    b = _nd((16,))
+    return [x, w, b], {"kernel": (3, 3), "num_filter": 16}
+
+
+def _rule_deconv():
+    x = _nd(_SHAPES["batch4d"])
+    c = x.shape[1]
+    w = _nd((c, 16, 3, 3))
+    return [x, w], {"kernel": (3, 3), "num_filter": 16, "no_bias": True}
+
+
+def _rule_fc():
+    x = _nd(_SHAPES["gemm"])
+    w = _nd((128, x.shape[1]))
+    b = _nd((128,))
+    return [x, w, b], {"num_hidden": 128}
+
+
+def _rule_pool():
+    return [_nd(_SHAPES["batch4d"])], {"kernel": (2, 2), "pool_type": "max",
+                                       "stride": (2, 2)}
+
+
+def _rule_bn():
+    x = _nd(_SHAPES["batch4d"])
+    c = x.shape[1]
+    one, zero = _nd((c,), positive=True), _nd((c,))
+    return [x, one, zero, zero, one], {}
+
+
+def _rule_norm_affine():
+    x = _nd(_SHAPES["mat"])
+    return [x, _nd((x.shape[-1],), positive=True), _nd((x.shape[-1],))], {}
+
+
+def _rule_rmsnorm():
+    x = _nd(_SHAPES["mat"])
+    return [x, _nd((x.shape[-1],), positive=True)], {}
+
+
+def _rule_embedding():
+    return [_nd((32, 16), dtype="int32", high=100), _nd((100, 32))], \
+        {"input_dim": 100, "output_dim": 32}
+
+
+def _rule_act():
+    return [_nd(_SHAPES["mat"])], {"act_type": "relu"}
+
+
+def _rule_gemm():
+    return [_nd(_SHAPES["gemm"]), _nd(_SHAPES["gemm"])], {}
+
+
+def _rule_lrn():
+    return [_nd(_SHAPES["batch4d"])], {"nsize": 3}
+
+
+def _rule_unary():
+    return [_nd(_SHAPES["vec"], positive=True)], {}
+
+
+def _rule_binary():
+    return [_nd(_SHAPES["vec"], positive=True),
+            _nd(_SHAPES["vec"], positive=True)], {}
+
+
+_RULES: List[Tuple[str, Callable]] = [
+    (r"^(Convolution|convolution|DeformableConvolution)$", _rule_conv),
+    (r"^(Deconvolution|deconvolution)$", _rule_deconv),
+    (r"^(FullyConnected|fully_connected)$", _rule_fc),
+    (r"^(Pooling|pooling)$", _rule_pool),
+    (r"^(BatchNorm|batch_norm|SyncBatchNorm)$", _rule_bn),
+    (r"^(LayerNorm|layer_norm|GroupNorm|group_norm|InstanceNorm)$",
+     _rule_norm_affine),
+    (r"^(RMSNorm|rms_norm)$", _rule_rmsnorm),
+    (r"^(Embedding|embedding)$", _rule_embedding),
+    (r"^(Activation|activation)$", _rule_act),
+    (r"^(dot|batch_dot|_npi_matmul|_npi_dot)$", _rule_gemm),
+    (r"^LRN$", _rule_lrn),
+    (r"^(adaptive_avg_pool2d|BilinearResize2D|UpSampling|L2Normalization"
+     r"|Flatten|flatten)$", lambda: ([_nd(_SHAPES["batch4d"])], {})),
+    (r"^(softmax|log_softmax|softmin)$",
+     lambda: ([_nd(_SHAPES["mat"])], {})),
+]
+
+# ops that need stateful/special handling and are covered by the macro
+# benchmarks instead (bench.py / tests) — excluded from the sweep
+_SKIP = re.compile(
+    r"^(_backward|_foreach|_while_loop|_cond|_cached_op|RNN|rnn"
+    r"|Dropout|dropout|_npi_.*(seed|key)|Custom|_rtc"
+    r"|IdentityAttachKLSparseReg|MakeLoss|BlockGrad"
+    r"|_contrib_(count_sketch|fft|ifft))")
+
+
+def benchmarkable_ops() -> List[str]:
+    """Unique op names (canonical, no aliases) eligible for the sweep."""
+    from mxnet_tpu.ops import registry
+    seen, out = set(), []
+    for name in registry.list_ops():
+        op = registry.get(name)
+        if op.name != name or id(op) in seen:   # alias row
+            continue
+        seen.add(id(op))
+        if _SKIP.match(name):
+            continue
+        out.append(name)
+    return out
+
+
+def default_inputs(op_name: str):
+    """(inputs, params) for an op: rules table, then probing fallback.
+
+    Returns None if no synthesized inputs run the op successfully.
+    """
+    from mxnet_tpu.ops import registry
+    for pat, builder in _RULES:
+        if re.match(pat, op_name):
+            try:
+                inputs, params = builder()
+                registry.invoke(op_name, inputs, **params)
+                return inputs, params
+            except Exception:
+                return None
+    # probe: unary, binary, ternary on float vecs; then int vec (indices)
+    candidates = [
+        lambda: ([_nd(_SHAPES["vec"], positive=True)], {}),
+        lambda: ([_nd(_SHAPES["mat"], positive=True)], {}),
+        lambda: (_rule_binary()[0], {}),
+        lambda: ([_nd(_SHAPES["vec"], positive=True)] * 3, {}),
+        lambda: ([_nd(_SHAPES["vec"], dtype="int32")], {}),
+    ]
+    for cand in candidates:
+        try:
+            inputs, params = cand()
+            out = registry.invoke(op_name, inputs, **params)
+            del out
+            return inputs, params
+        except Exception:
+            continue
+    return None
+
+
+def _sync(out):
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    for o in outs:
+        o.wait_to_read()
+
+
+def _time_loop(fn, warmup: int, runs: int) -> float:
+    for _ in range(warmup):
+        fn()
+    samples = []
+    for _ in range(runs):
+        t0 = time.perf_counter()
+        fn()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    return samples[len(samples) // 2] * 1e3     # median, ms
+
+
+def benchmark_op(op_name: str, warmup: int = 3, runs: int = 10,
+                 slow_ms: float = 25.0) -> Optional[Dict]:
+    """Benchmark one op; returns a result row or None if not runnable.
+
+    Ops whose eager forward exceeds ``slow_ms`` get the eager number
+    only — compiling + differentiating a pathological op would dominate
+    the whole sweep's wall-clock (e.g. box_nms through vjp).
+    """
+    import jax
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ops import registry
+    import functools
+
+    synth = default_inputs(op_name)
+    if synth is None:
+        return None
+    inputs, params = synth
+    op = registry.get(op_name)
+
+    def eager():
+        _sync(registry.invoke(op_name, inputs, **params))
+
+    fwd_ms = _time_loop(eager, warmup, runs)
+    if fwd_ms > slow_ms:
+        return {"op": op_name, "inputs": [tuple(x.shape) for x in inputs],
+                "fwd_eager_ms": round(fwd_ms, 4), "fwd_jit_ms": None,
+                "fwd_bwd_ms": None}
+
+    # jit steady-state on the raw arrays (the training regime)
+    fn = functools.partial(op.fn, **params) if params else op.fn
+    arrays = [x._data for x in inputs]
+    jfn = jax.jit(fn)
+    try:
+        jax.block_until_ready(jfn(*arrays))     # compile outside the clock
+
+        def jitted():
+            jax.block_until_ready(jfn(*arrays))
+
+        jit_ms = _time_loop(jitted, warmup, runs)
+    except Exception:
+        jit_ms = None
+
+    # forward+backward where differentiable
+    bwd_ms = None
+    try:
+        for x in inputs:
+            if "float" in str(x.dtype):
+                x.attach_grad()
+
+        def train_step():
+            with autograd.record():
+                out = registry.invoke(op_name, inputs, **params)
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                head = outs[0]
+            head.backward()
+            _sync(head)
+
+        bwd_ms = _time_loop(train_step, warmup, runs)
+    except Exception:
+        bwd_ms = None
+
+    return {"op": op_name,
+            "inputs": [tuple(x.shape) for x in inputs],
+            "fwd_eager_ms": round(fwd_ms, 4),
+            "fwd_jit_ms": round(jit_ms, 4) if jit_ms is not None else None,
+            "fwd_bwd_ms": round(bwd_ms, 4) if bwd_ms is not None else None}
+
+
+def run_op_benchmarks(ops: Optional[Sequence[str]] = None, warmup: int = 3,
+                      runs: int = 10, large: bool = False,
+                      verbose: bool = False) -> List[Dict]:
+    """Sweep ops (default: all benchmarkable); returns result rows.
+
+    Parity: run_all_mxnet_operator_benchmarks (opperf.py:57).
+    """
+    global _SHAPES
+    _SHAPES = dict(_LARGE if large else _SMALL)
+    names = list(ops) if ops else benchmarkable_ops()
+    rows, skipped = [], []
+    for name in names:
+        if verbose:
+            print(f"{name:40s} ", end="", flush=True)
+        row = benchmark_op(name, warmup=warmup, runs=runs)
+        if row is None:
+            skipped.append(name)
+            if verbose:
+                print("(no default inputs)")
+            continue
+        rows.append(row)
+        if verbose:
+            print(f"{row['fwd_eager_ms']:>9.3f} ms eager")
+    if skipped and verbose:
+        print(f"# no default inputs for {len(skipped)} ops: "
+              f"{', '.join(skipped[:20])}{' …' if len(skipped) > 20 else ''}")
+    return rows
+
+
+def format_table(rows: List[Dict]) -> str:
+    hdr = (f"{'op':40s} {'fwd eager(ms)':>14s} {'fwd jit(ms)':>12s} "
+           f"{'fwd+bwd(ms)':>12s}  inputs")
+    lines = [hdr, "-" * len(hdr)]
+    for r in sorted(rows, key=lambda r: -r["fwd_eager_ms"]):
+        jit = f"{r['fwd_jit_ms']:.4f}" if r["fwd_jit_ms"] is not None else "-"
+        bwd = f"{r['fwd_bwd_ms']:.4f}" if r["fwd_bwd_ms"] is not None else "-"
+        lines.append(f"{r['op']:40s} {r['fwd_eager_ms']:>14.4f} {jit:>12s} "
+                     f"{bwd:>12s}  {r['inputs']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--ops", default="",
+                   help="comma-separated op names (default: all)")
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--runs", type=int, default=10)
+    p.add_argument("--large", action="store_true",
+                   help="reference-opperf-sized tensors")
+    p.add_argument("--output-json", default="",
+                   help="write result rows as JSON")
+    args = p.parse_args(argv)
+
+    ops = [s for s in args.ops.split(",") if s] or None
+    rows = run_op_benchmarks(ops=ops, warmup=args.warmup, runs=args.runs,
+                             large=args.large, verbose=True)
+    print(format_table(rows))
+    if args.output_json:
+        with open(args.output_json, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(f"# wrote {len(rows)} rows to {args.output_json}")
+    return rows
+
+
+if __name__ == "__main__":
+    main()

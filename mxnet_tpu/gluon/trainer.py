@@ -8,6 +8,8 @@ with `dist_*` kvstores the push/pull maps to jax.distributed collectives.
 """
 from __future__ import annotations
 
+import os
+
 from typing import Dict, List, Optional, Sequence
 
 from ..base import MXNetError
@@ -79,8 +81,14 @@ class Trainer:
             self._distributed = "dist" in getattr(self._kvstore, "type", "")
             uok = config["update_on_kvstore"]
             if uok is None:
-                uok = bool(self._distributed) and \
-                    self._kvstore.has_capability("optimizer")
+                # parity: MXNET_UPDATE_ON_KVSTORE (env_var.md; read in
+                # python/mxnet/gluon/trainer.py _init_kvstore)
+                env = os.environ.get("MXNET_UPDATE_ON_KVSTORE")
+                if env is not None:
+                    uok = env == "1"
+                else:
+                    uok = bool(self._distributed) and \
+                        self._kvstore.has_capability("optimizer")
             if uok and not self._kvstore.has_capability("optimizer"):
                 uok = False
             self._update_on_kvstore = uok

@@ -35,6 +35,20 @@ __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "cast_storage", "retain", "dot", "add", "where_rows"]
 
 
+def _log_storage_fallback(what: str):
+    """Parity: MXNET_STORAGE_FALLBACK_LOG_VERBOSE (env_var.md) — warn
+    when a sparse array is densified to run an op that has no sparse
+    kernel (the reference's "operator fallback to dense" log,
+    src/executor/infer_graph_attr_pass.cc storage fallback)."""
+    import os
+    if os.environ.get("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "0") not in \
+            ("0", ""):
+        import warnings
+        warnings.warn(
+            f"storage fallback: {what} densified (generated dense output "
+            f"instead of sparse)", stacklevel=3)
+
+
 class BaseSparseNDArray:
     """Common surface shared by both sparse storage types."""
 
@@ -105,6 +119,7 @@ class RowSparseNDArray(BaseSparseNDArray):
         return int(self.data.shape[0])
 
     def todense(self) -> NDArray:
+        _log_storage_fallback("row_sparse")
         out = jnp.zeros(self.shape, self.dtype)
         if self.nnz:
             out = out.at[self.indices].set(self.data)
@@ -147,6 +162,7 @@ class CSRNDArray(BaseSparseNDArray):
         return int(self.data.shape[0])
 
     def todense(self) -> NDArray:
+        _log_storage_fallback("csr")
         rows, cols = self.shape
         counts = self.indptr[1:] - self.indptr[:-1]
         row_ids = jnp.repeat(jnp.arange(rows), counts,

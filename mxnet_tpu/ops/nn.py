@@ -20,6 +20,19 @@ from .registry import register
 
 # -- helpers ---------------------------------------------------------------
 
+def _safe_acc(x):
+    """Upcast low-precision inputs to f32 for accumulation when
+    ``MXNET_SAFE_ACCUMULATION=1`` (parity: the reference's safe-
+    accumulation switch in softmax/norm kernels, env_var.md; read at
+    trace time, so under jit it is a compile-time constant like the
+    reference's kernel-launch-time read)."""
+    import os
+    if os.environ.get("MXNET_SAFE_ACCUMULATION", "0") == "1" and \
+            x.dtype in (jnp.bfloat16, jnp.float16):
+        return x.astype(jnp.float32), x.dtype
+    return x, None
+
+
 def _tup(v, n) -> Tuple[int, ...]:
     if v is None:
         return (1,) * n
@@ -243,6 +256,9 @@ def _leaky_relu(x, gamma=None, *, act_type="leaky", slope=0.25,
 @register("softmax")
 def _softmax(x, length=None, *, axis=-1, temperature=None, use_length=False,
              dtype=None):
+    x, low = _safe_acc(x)
+    if dtype is None and low is not None:
+        dtype = low
     if temperature and temperature != 1.0:
         x = x / temperature
     if use_length and length is not None:
@@ -260,6 +276,9 @@ def _softmax(x, length=None, *, axis=-1, temperature=None, use_length=False,
 
 @register("log_softmax")
 def _log_softmax(x, *, axis=-1, temperature=None, dtype=None):
+    x, low = _safe_acc(x)
+    if dtype is None and low is not None:
+        dtype = low
     if temperature and temperature != 1.0:
         x = x / temperature
     out = jax.nn.log_softmax(x, axis=axis)
@@ -313,8 +332,11 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
 
 @register("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(x, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
-    mean = jnp.mean(x, axis=axis, keepdims=True)
-    var = jnp.var(x, axis=axis, keepdims=True)
+    xa, low = _safe_acc(x)
+    mean = jnp.mean(xa, axis=axis, keepdims=True)
+    var = jnp.var(xa, axis=axis, keepdims=True)
+    if low is not None:
+        mean, var = mean.astype(low), var.astype(low)
     xn = (x - mean) * lax.rsqrt(var + eps)
     shape = [1] * x.ndim
     shape[axis] = -1

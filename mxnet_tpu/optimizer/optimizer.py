@@ -10,6 +10,7 @@ schedule changes never trigger recompilation.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any, Dict, Optional, Tuple
 
 import numpy as onp
@@ -118,6 +119,11 @@ class Optimizer:
         self.wd = wd
         self.clip_gradient = clip_gradient
         self.multi_precision = multi_precision
+        if aggregate_num == 0:
+            # parity: MXNET_OPTIMIZER_AGGREGATION_SIZE (env_var.md;
+            # read in python/mxnet/gluon/trainer.py)
+            aggregate_num = int(os.environ.get(
+                "MXNET_OPTIMIZER_AGGREGATION_SIZE", "0"))
         self.aggregate_num = aggregate_num
         self.param_dict = param_dict or {}
         self.idx2name = param_idx2name or {}

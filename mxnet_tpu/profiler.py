@@ -195,3 +195,12 @@ class Counter:
 
 def scope(name="<unk>:"):
     return _Scope(name)
+
+
+# parity: MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE
+# (docs .../env_var.md; src/profiler/profiler.cc reads them at init)
+if os.environ.get("MXNET_PROFILER_AUTOSTART", "0") == "1":
+    mode = os.environ.get("MXNET_PROFILER_MODE", "")
+    set_config(profile_all=(mode != "symbolic"), profile_symbolic=True,
+               aggregate_stats=True)
+    start()

@@ -13,6 +13,7 @@ be saved and re-loaded by name.
 """
 from __future__ import annotations
 
+import os
 import json
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -312,7 +313,17 @@ class Symbol:
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, **kwargs):
         from .executor import Executor
-        return Executor(self, ctx, args, args_grad, grad_req)
+        sym = self
+        # parity: MXNET_SUBGRAPH_BACKEND (env_var.md) — partition at
+        # bind time with the named backend, as build_subgraph does in
+        # src/executor/graph_executor.cc Init
+        backend = os.environ.get("MXNET_SUBGRAPH_BACKEND", "")
+        if backend and backend != "NONE":
+            try:
+                sym = self.optimize_for(backend)
+            except MXNetError:
+                pass  # unknown backend: bind unpartitioned, like the ref
+        return Executor(sym, ctx, args, args_grad, grad_req)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
         from ..ndarray import NDArray

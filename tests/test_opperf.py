@@ -1,0 +1,115 @@
+"""opperf micro-bench harness + env-var knob tests.
+
+Parity: benchmark/opperf (runner correctness, not timing numbers) and
+a handful of env_var.md knobs that exist in the TPU build.
+"""
+import os
+import sys
+import warnings
+
+import numpy as onp
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark import opperf
+
+
+def test_benchmark_single_ops():
+    rows = opperf.run_op_benchmarks(
+        ops=["exp", "dot", "FullyConnected"], warmup=1, runs=2)
+    assert {r["op"] for r in rows} == {"exp", "dot", "FullyConnected"}
+    for r in rows:
+        assert r["fwd_eager_ms"] > 0
+        assert r["fwd_jit_ms"] is not None and r["fwd_jit_ms"] > 0
+        assert r["inputs"]
+    # FullyConnected is differentiable → must have a fwd+bwd number
+    fc = next(r for r in rows if r["op"] == "FullyConnected")
+    assert fc["fwd_bwd_ms"] is not None
+
+
+def test_default_inputs_probing():
+    # rules table
+    assert opperf.default_inputs("Convolution") is not None
+    # probing fallback: plain binary op with no explicit rule
+    assert opperf.default_inputs("broadcast_add") is not None
+    # unknown op → None, not a crash
+    assert opperf.default_inputs("_no_such_op_xyz") is None
+
+
+def test_benchmarkable_ops_dedups_aliases():
+    names = opperf.benchmarkable_ops()
+    assert len(names) == len(set(names))
+    assert "FullyConnected" in names
+    assert "fully_connected" not in names     # alias row collapsed
+    assert not any(n.startswith("_backward") for n in names)
+    assert len(names) > 300
+
+
+def test_format_table():
+    rows = opperf.run_op_benchmarks(ops=["exp"], warmup=0, runs=1)
+    table = opperf.format_table(rows)
+    assert "exp" in table and "fwd eager(ms)" in table
+
+
+# -- env knobs -------------------------------------------------------------
+
+def test_safe_accumulation_softmax(monkeypatch):
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import registry
+    import mxnet_tpu as mx
+    x = mx.nd.array(onp.random.randn(4, 64).astype(onp.float32)) \
+        .astype("bfloat16")
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "1")
+    out = registry.invoke("softmax", [x])
+    assert out.dtype == onp.dtype("bfloat16") or str(out.dtype) == "bfloat16"
+    s = out.asnumpy().astype(onp.float32).sum(axis=-1)
+    onp.testing.assert_allclose(s, onp.ones(4), rtol=2e-2)
+
+
+def test_storage_fallback_log(monkeypatch):
+    from mxnet_tpu.ndarray import sparse
+    monkeypatch.setenv("MXNET_STORAGE_FALLBACK_LOG_VERBOSE", "1")
+    rs = sparse.row_sparse_array(
+        (onp.ones((2, 3), onp.float32), onp.array([0, 2])), shape=(4, 3))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        rs.todense()
+    assert any("storage fallback" in str(w.message) for w in rec)
+    monkeypatch.delenv("MXNET_STORAGE_FALLBACK_LOG_VERBOSE")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        rs.todense()
+    assert not any("storage fallback" in str(w.message) for w in rec)
+
+
+def test_optimizer_aggregation_env(monkeypatch):
+    import mxnet_tpu as mx
+    monkeypatch.setenv("MXNET_OPTIMIZER_AGGREGATION_SIZE", "4")
+    opt = mx.optimizer.create("sgd", learning_rate=0.1)
+    assert opt.aggregate_num == 4
+    opt2 = mx.optimizer.create("sgd", learning_rate=0.1, aggregate_num=2)
+    assert opt2.aggregate_num == 2
+
+
+def test_update_on_kvstore_env(monkeypatch):
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, Trainer
+    net = nn.Dense(2)
+    net.initialize()
+    _ = net(mx.nd.array(onp.ones((1, 3), onp.float32)))
+    monkeypatch.setenv("MXNET_UPDATE_ON_KVSTORE", "0")
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    tr._init_kvstore()
+    assert tr._update_on_kvstore is False
+
+
+def test_subgraph_backend_env(monkeypatch):
+    import mxnet_tpu as mx
+    sym = mx.sym
+    x = sym.var("x")
+    y = sym.exp(x + 1.0)
+    monkeypatch.setenv("MXNET_SUBGRAPH_BACKEND", "default")
+    ex = y.bind(args={"x": mx.nd.array(onp.zeros(3, onp.float32))})
+    out = ex.forward()[0].asnumpy()
+    onp.testing.assert_allclose(out, onp.e * onp.ones(3), rtol=1e-5)
