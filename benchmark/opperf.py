@@ -272,9 +272,9 @@ def benchmark_op(op_name: str, warmup: int = 3, runs: int = 10,
     # forward+backward where differentiable
     bwd_ms = None
     try:
-        for x in inputs:
-            if "float" in str(x.dtype):
-                x.attach_grad()
+        grad_inputs = [x for x in inputs if "float" in str(x.dtype)]
+        for x in grad_inputs:
+            x.attach_grad()
 
         def train_step():
             with autograd.record():
@@ -282,7 +282,11 @@ def benchmark_op(op_name: str, warmup: int = 3, runs: int = 10,
                 outs = out if isinstance(out, (list, tuple)) else [out]
                 head = outs[0]
             head.backward()
-            _sync(head)
+            # block on the *gradients* — syncing only the head would let
+            # the async backward escape the clock
+            for x in grad_inputs:
+                if x.grad is not None:
+                    x.grad.wait_to_read()
 
         bwd_ms = _time_loop(train_step, warmup, runs)
     except Exception:
@@ -337,6 +341,14 @@ def format_table(rows: List[Dict]) -> str:
 
 
 def main(argv=None):
+    import os
+    # honor JAX_PLATFORMS even where sitecustomize force-registers a
+    # backend via jax.config (see tests/conftest.py for the same dance)
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+        jax.config.update("jax_platforms", want)
+
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--ops", default="",
                    help="comma-separated op names (default: all)")

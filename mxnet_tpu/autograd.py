@@ -122,23 +122,27 @@ class _Node:
 
 class _OpRecord:
     __slots__ = ("fn", "saved_inputs", "in_nodes", "out_nodes", "multi_out",
-                 "consumed")
+                 "consumed", "out_specs")
 
-    def __init__(self, fn, saved_inputs, in_nodes, out_nodes, multi_out):
+    def __init__(self, fn, saved_inputs, in_nodes, out_nodes, multi_out,
+                 out_specs=None):
         self.fn = fn
         self.saved_inputs = saved_inputs
         self.in_nodes = in_nodes
         self.out_nodes = out_nodes
         self.multi_out = multi_out
         self.consumed = False
+        self.out_specs = out_specs    # [(shape, dtype)] of the outputs
 
 
 def _tape() -> List[_OpRecord]:
     return _st().tape
 
 
-def _record(fn, in_nodes, saved_inputs, out_nodes, multi_out):
-    rec = _OpRecord(fn, saved_inputs, in_nodes, out_nodes, multi_out)
+def _record(fn, in_nodes, saved_inputs, out_nodes, multi_out,
+            out_specs=None):
+    rec = _OpRecord(fn, saved_inputs, in_nodes, out_nodes, multi_out,
+                    out_specs)
     for n in out_nodes:
         n.producer = rec
     _tape().append(rec)
@@ -155,7 +159,8 @@ def record_apply(fn: Callable, nd_inputs: Sequence[Any], nd_outputs: Sequence[An
     """
     _record(fn, [x._ensure_node() for x in nd_inputs],
             [x._data for x in nd_inputs],
-            [o._new_node() for o in nd_outputs], multi_out)
+            [o._new_node() for o in nd_outputs], multi_out,
+            out_specs=[(o.shape, o.dtype) for o in nd_outputs])
 
 
 def mark_variables(variables, gradients, grad_reqs="write") -> None:
@@ -264,32 +269,66 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     return collected
 
 
+# jitted-backward cache: (stable fn, n_in, multi_out) → (_JitEntry, bwd).
+# Keyed on the op registry's cached partials (registry._STABLE_FNS), whose
+# identity persists across steps — so the vjp of each op traces/compiles
+# once and every later eager backward replays the compiled transpose
+# (forward is rematerialized *inside* the compiled program: same
+# FLOPs-for-HBM trade as before, without per-step retracing).  The key
+# owns the fn, so no id-reuse hazard.
+_BWD_JIT: dict = {}
+
+
+def _make_bwd(fn, n_in, multi):
+    """The one vjp-replay closure (args = saved inputs ++ cotangents),
+    shared by the eager and jitted backward paths so they can't
+    diverge."""
+    def bwd(*args):
+        _, vjp_fn = jax.vjp(fn, *args[:n_in])
+        cts = args[n_in:]
+        return vjp_fn(tuple(cts) if multi else cts[0])
+
+    return bwd
+
+
+def _get_jitted_bwd(rec: _OpRecord):
+    from .ops import registry
+
+    if rec.fn not in registry._STABLE_FNS:
+        return None
+    # env-numerics participates in the key: a no-params op caches the bare
+    # op.fn under both env settings, so fn identity alone would replay a
+    # backward traced under the other setting
+    key = (rec.fn, len(rec.saved_inputs), rec.multi_out,
+           registry._env_numerics_key())
+    cached = _BWD_JIT.get(key)
+    if cached is None:
+        bwd = _make_bwd(rec.fn, len(rec.saved_inputs), rec.multi_out)
+        cached = _BWD_JIT[key] = (registry._JitEntry(bwd), bwd)
+    return cached
+
+
 def _apply_vjp(rec: _OpRecord, out_grads, create_graph: bool):
     """Compute input cotangents for one record and accumulate into in_nodes."""
     from .ndarray import NDArray
 
     fn, saved = rec.fn, rec.saved_inputs
-    out_specs = None
+    out_specs = rec.out_specs
     filled = []
     for i, g in enumerate(out_grads):
         if g is None:
             if out_specs is None:
-                out_specs = jax.eval_shape(fn, *saved)
+                specs = jax.eval_shape(fn, *saved)
                 if not rec.multi_out:
-                    out_specs = (out_specs,)
-            z = jnp.zeros(out_specs[i].shape, out_specs[i].dtype)
+                    specs = (specs,)
+                out_specs = [(s.shape, s.dtype) for s in specs]
+            z = jnp.zeros(*out_specs[i])
             filled.append(NDArray(z) if create_graph else z)
         else:
             filled.append(g)
 
     n_in = len(saved)
-
-    def bwd(*args):
-        ins = args[:n_in]
-        cts = args[n_in:]
-        _, vjp_fn = jax.vjp(fn, *ins)
-        ct = tuple(cts) if rec.multi_out else cts[0]
-        return vjp_fn(ct)
+    bwd = _make_bwd(fn, n_in, rec.multi_out)
 
     if create_graph:
         ct_nodes = [g._ensure_node() for g in filled]
@@ -302,7 +341,13 @@ def _apply_vjp(rec: _OpRecord, out_grads, create_graph: bool):
         for node, nd in zip(rec.in_nodes, out_nd):
             _accumulate(node, nd, True)
     else:
-        grads = bwd(*saved, *[_ct_data(g) for g in filled])
+        args = [*saved, *[_ct_data(g) for g in filled]]
+        cached = _get_jitted_bwd(rec)
+        if cached is not None:
+            jentry, eager_bwd = cached
+            grads = jentry.run(eager_bwd, args)
+        else:
+            grads = bwd(*args)
         for node, g in zip(rec.in_nodes, grads):
             _accumulate(node, g, False)
 

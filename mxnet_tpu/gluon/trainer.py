@@ -85,7 +85,12 @@ class Trainer:
                 # python/mxnet/gluon/trainer.py _init_kvstore)
                 env = os.environ.get("MXNET_UPDATE_ON_KVSTORE")
                 if env is not None:
-                    uok = env == "1"
+                    try:
+                        uok = bool(int(env))
+                    except ValueError:
+                        raise MXNetError(
+                            f"invalid MXNET_UPDATE_ON_KVSTORE={env!r}; "
+                            f"expected an integer") from None
                 else:
                     uok = bool(self._distributed) and \
                         self._kvstore.has_capability("optimizer")

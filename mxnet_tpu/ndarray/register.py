@@ -13,7 +13,6 @@ from typing import Any, Dict
 
 from ..ops import registry as _reg
 from ..ops.registry import apply_jax
-import functools
 
 __all__ = ["make_op_func", "populate_namespace"]
 
@@ -61,8 +60,7 @@ def make_op_func(name: str):
         for k, v in list(kwargs.items()):
             if isinstance(v, list):
                 kwargs[k] = tuple(v)
-        fn = functools.partial(op.fn, **kwargs) if kwargs else op.fn
-        result = apply_jax(fn, inputs, multi_out=op.multi_out)
+        result = _reg.dispatch(op, inputs, kwargs)
         if out is not None:
             outs = result if isinstance(result, list) else [result]
             targets = out if isinstance(out, (list, tuple)) else [out]

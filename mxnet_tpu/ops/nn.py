@@ -332,15 +332,19 @@ def _batch_norm(x, gamma, beta, moving_mean, moving_var, *, eps=1e-3,
 
 @register("LayerNorm", aliases=("layer_norm",))
 def _layer_norm(x, gamma, beta, *, axis=-1, eps=1e-5, output_mean_var=False):
+    # safe accumulation: the whole normalization runs in f32, only the
+    # outputs are cast back (casting the statistics early would rounder
+    # away the benefit)
     xa, low = _safe_acc(x)
     mean = jnp.mean(xa, axis=axis, keepdims=True)
     var = jnp.var(xa, axis=axis, keepdims=True)
-    if low is not None:
-        mean, var = mean.astype(low), var.astype(low)
-    xn = (x - mean) * lax.rsqrt(var + eps)
+    xn = (xa - mean) * lax.rsqrt(var + eps)
     shape = [1] * x.ndim
     shape[axis] = -1
     out = xn * gamma.reshape(shape) + beta.reshape(shape)
+    if low is not None:
+        out = out.astype(low)
+        mean, var = mean.astype(low), var.astype(low)
     if output_mean_var:
         return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
     return out

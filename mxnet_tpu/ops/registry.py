@@ -11,6 +11,7 @@ op modules from the C registry (python/mxnet/ndarray/register.py:115-277).
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from ..base import MXNetError
@@ -24,7 +25,8 @@ _REGISTRY: Dict[str, "Operator"] = {}
 class Operator:
     """One registered op: name + pure jax ``fn(*arrays, **params)``."""
 
-    __slots__ = ("name", "fn", "multi_out", "aliases", "doc")
+    __slots__ = ("name", "fn", "multi_out", "aliases", "doc",
+                 "_partials", "_jits")
 
     def __init__(self, name: str, fn: Callable, multi_out: bool = False,
                  aliases: Sequence[str] = ()):
@@ -33,6 +35,8 @@ class Operator:
         self.multi_out = multi_out
         self.aliases = tuple(aliases)
         self.doc = fn.__doc__
+        self._partials: Dict[Any, Callable] = {}   # params-key → partial
+        self._jits: Dict[Any, "_JitEntry"] = {}    # params-key → jit entry
 
     def __repr__(self):
         return f"<Operator {self.name}>"
@@ -114,19 +118,21 @@ _capture_stack: List[CaptureScope] = []
 
 
 def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
-              record: Optional[bool] = None):
+              record: Optional[bool] = None, jentry=None):
     """Run a pure jax function on NDArrays, wrap outputs, record on tape.
 
     This is the one funnel every op call goes through — the analogue of
     InvokeOp → PushFCompute → engine (imperative_utils.h:448): jax's async
     dispatch replaces the engine push; the tape hook replaces RecordOp.
+    ``jentry`` (from `invoke`) replays a cached compiled executable
+    instead of eager op-by-op dispatch.
     """
     from .. import autograd
     from ..ndarray import NDArray
     from .. import engine
 
     arrays = [x._data for x in nd_inputs]
-    out = fn(*arrays)
+    out = jentry.run(fn, arrays) if jentry is not None else fn(*arrays)
     multi = multi_out or isinstance(out, (tuple, list))
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
     nd_outs = [NDArray(o) for o in outs]
@@ -149,6 +155,124 @@ def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
     return nd_outs if multi else nd_outs[0]
 
 
+# --------------------------------------------------------------------------
+# eager-dispatch caches.  The reference's eager path pays one engine push
+# per op; ours pays one XLA executable replay: `invoke` caches the bound
+# partial per (op, static-params) and wraps it in `jax.jit`, so a steady-
+# state eager loop dispatches compiled programs instead of re-tracing
+# composite jnp graphs op-by-op.  The cached partial's identity is stable,
+# which is what lets autograd jit-cache the matching backward (see
+# autograd._get_jitted_bwd).
+# --------------------------------------------------------------------------
+
+_MAX_JIT_SIGS = 8       # distinct shape-signatures before giving up on jit
+
+
+class _JitEntry:
+    """A jitted execution wrapper with failure/retrace guards."""
+
+    __slots__ = ("jfn", "disabled", "sigs")
+
+    def __init__(self, fn):
+        import jax
+        self.jfn = jax.jit(fn)
+        self.disabled = False
+        self.sigs = set()
+
+    def run(self, fn, arrays):
+        """Execute via jit when healthy, falling back (and latching off)
+        when the op can't trace — e.g. data-dependent output shapes — or
+        keeps retracing under changing shapes.  A call where the eager
+        re-run *also* raises is a user/input error: re-raise without
+        latching, so one bad call doesn't demote the op forever."""
+        if not self.disabled:
+            sig = tuple((a.shape, str(a.dtype)) for a in arrays)
+            if sig not in self.sigs:
+                if len(self.sigs) >= _MAX_JIT_SIGS:
+                    self.disabled = True
+                    return fn(*arrays)
+                self.sigs.add(sig)
+            try:
+                return self.jfn(*arrays)
+            except Exception:
+                out = fn(*arrays)       # raises through on input errors
+                self.disabled = True    # jit-specific failure, eager works
+                return out
+        return fn(*arrays)
+
+
+def _params_key(params: dict):
+    """Hashable cache key for static params, or None if unhashable."""
+    def conv(v):
+        if isinstance(v, list):
+            v = tuple(conv(x) for x in v)
+        elif isinstance(v, dict):
+            v = tuple(sorted((k, conv(x)) for k, x in v.items()))
+        hash(v)
+        return v
+
+    try:
+        return tuple(sorted((k, conv(v)) for k, v in params.items()))
+    except TypeError:
+        return None
+
+
+# fns whose identity is stable across calls (registered op fns and cached
+# partials) — autograd keys its backward jit cache on these.  A WeakSet so
+# a cleared/po-GC'd partial stops counting as stable (no id reuse hazard).
+_STABLE_FNS = weakref.WeakSet()
+
+_MAX_PARTIALS = 64      # per-op cap on cached (params → partial) entries
+
+
+def _env_numerics_key():
+    """Env switches that ops read at trace time (currently
+    MXNET_SAFE_ACCUMULATION, see ops/nn.py _safe_acc) participate in the
+    cache key, so toggling them is honored instead of replaying a stale
+    compiled executable."""
+    import os
+    return os.environ.get("MXNET_SAFE_ACCUMULATION", "0") == "1"
+
+
+def bound_fn(op: Operator, params: dict):
+    """(fn, jit-entry) for an op with static params bound — the shared
+    entry of both funnels (`invoke` and the generated `mx.nd.*`
+    wrappers).  The partial is cached per (op, params, env-numerics) so
+    its identity is stable; unhashable params — or an op hammered with
+    loop-varying params — fall back to an uncached partial."""
+    pkey = _params_key(params) if params else ()
+    if pkey is None:                      # unhashable params: no caching
+        return functools.partial(op.fn, **params), None
+    key = (pkey, _env_numerics_key())
+    fn = op._partials.get(key)
+    if fn is None:
+        if len(op._partials) >= _MAX_PARTIALS:
+            # params vary per call (e.g. slice indices in a loop): caching
+            # would leak one compiled executable per value
+            return (functools.partial(op.fn, **params) if params
+                    else op.fn), None
+        fn = functools.partial(op.fn, **params) if params else op.fn
+        op._partials[key] = fn
+        _STABLE_FNS.add(fn)
+    jentry = op._jits.get(key)
+    if jentry is None:
+        jentry = op._jits[key] = _JitEntry(fn)
+    return fn, jentry
+
+
+def dispatch(op: Operator, nd_inputs: Sequence[Any], params: dict):
+    """The one eager funnel: bind params, time the op (parity:
+    OprExecStat around every engine op, src/profiler/profiler.h — under
+    async dispatch this measures dispatch wall time; jax's xplane trace
+    holds device times), execute via the jit cache."""
+    fn, jentry = bound_fn(op, params)
+    from .. import profiler
+    t0 = profiler.op_timer()
+    out = apply_jax(fn, nd_inputs, multi_out=op.multi_out, jentry=jentry)
+    profiler.op_record(op.name, t0)
+    return out
+
+
 def invoke(name: str, nd_inputs: Sequence[Any], **params):
     """Invoke a registered op by name on NDArray inputs.
 
@@ -156,16 +280,4 @@ def invoke(name: str, nd_inputs: Sequence[Any], **params):
     no-bias Convolution's bias).
     """
     op = get(name)
-    nd_inputs = [x for x in nd_inputs if x is not None]
-    if params:
-        fn = functools.partial(op.fn, **params)
-    else:
-        fn = op.fn
-    # per-op timing (parity: OprExecStat around every engine op,
-    # src/profiler/profiler.h).  Under async dispatch this measures
-    # dispatch wall time; jax's xplane trace holds device times.
-    from .. import profiler
-    t0 = profiler.op_timer()
-    out = apply_jax(fn, nd_inputs, multi_out=op.multi_out)
-    profiler.op_record(name, t0)
-    return out
+    return dispatch(op, [x for x in nd_inputs if x is not None], params)

@@ -1,0 +1,154 @@
+"""Eager-dispatch compile caches.
+
+Parity intent: the reference's eager path costs one engine push per op
+(imperative_utils.h:448); ours replays cached XLA executables.  These
+tests pin the cache mechanics — entries engage, data-dependent ops latch
+off, numerics are unchanged — not wall-clock numbers (machines vary).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ops import registry
+
+
+def test_forward_jit_cache_engages():
+    op = registry.get("softmax")
+    op._jits.clear(); op._partials.clear()
+    x = mx.nd.array(onp.random.randn(4, 8).astype(onp.float32))
+    a = registry.invoke("softmax", [x], axis=-1)
+    b = registry.invoke("softmax", [x], axis=-1)
+    key = (registry._params_key({"axis": -1}), registry._env_numerics_key())
+    assert key in op._jits and not op._jits[key].disabled
+    assert op._partials[key] is not None
+    onp.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6)
+    # same params → one cache entry; different params → second entry
+    registry.invoke("softmax", [x], axis=0)
+    assert len(op._jits) == 2
+
+
+def test_jit_numerics_match_eager():
+    op = registry.get("LayerNorm")
+    op._jits.clear(); op._partials.clear()
+    x = onp.random.randn(4, 16).astype(onp.float32)
+    g = onp.random.rand(16).astype(onp.float32) + 0.5
+    b = onp.random.randn(16).astype(onp.float32)
+    got = registry.invoke(
+        "LayerNorm", [mx.nd.array(x), mx.nd.array(g), mx.nd.array(b)]
+    ).asnumpy()
+    ref = op.fn(x, g, b)      # direct eager call, no jit wrapper
+    onp.testing.assert_allclose(got, onp.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_retrace_guard_latches_off():
+    op = registry.get("relu")
+    op._jits.clear(); op._partials.clear()
+    # exceed the signature budget with many distinct shapes
+    for n in range(registry._MAX_JIT_SIGS + 2):
+        x = mx.nd.array(onp.ones(n + 1, onp.float32))
+        registry.invoke("relu", [x])
+    entry = op._jits[((), registry._env_numerics_key())]
+    assert entry.disabled
+    # op still works after latching off
+    out = registry.invoke("relu", [mx.nd.array(onp.array([-1.0, 2.0],
+                                                         onp.float32))])
+    onp.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+
+
+def test_backward_jit_cache_engages_and_matches():
+    autograd._BWD_JIT.clear()
+    x = mx.nd.array(onp.random.randn(8, 4).astype(onp.float32))
+    x.attach_grad()
+    w = mx.nd.array(onp.random.randn(4, 3).astype(onp.float32))
+    w.attach_grad()
+    grads = []
+    for _ in range(2):
+        with autograd.record():
+            y = mx.nd.dot(x, w)
+            z = mx.nd.sum(mx.nd.relu(y))
+        z.backward()
+        grads.append((x.grad.asnumpy().copy(), w.grad.asnumpy().copy()))
+    assert len(autograd._BWD_JIT) >= 2      # dot + relu/sum backwards cached
+    onp.testing.assert_allclose(grads[0][0], grads[1][0], rtol=1e-6)
+    onp.testing.assert_allclose(grads[0][1], grads[1][1], rtol=1e-6)
+    # reference numerics: d(sum(relu(xw)))/dw = x^T @ (xw > 0)
+    xw = grads[0]
+    xn, wn = x.asnumpy(), w.asnumpy()
+    mask = (xn @ wn > 0).astype(onp.float32)
+    onp.testing.assert_allclose(xw[1], xn.T @ mask, rtol=1e-4, atol=1e-5)
+
+
+def test_env_numerics_toggle_not_frozen(monkeypatch):
+    """Toggling MXNET_SAFE_ACCUMULATION after a cached compile must take
+    effect — the env switch participates in the cache key."""
+    op = registry.get("softmax")
+    op._jits.clear(); op._partials.clear()
+    x = mx.nd.array(onp.random.randn(2, 8).astype(onp.float32)) \
+        .astype("bfloat16")
+    monkeypatch.delenv("MXNET_SAFE_ACCUMULATION", raising=False)
+    registry.invoke("softmax", [x])
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "1")
+    registry.invoke("softmax", [x])
+    assert len(op._jits) == 2     # two distinct compiled entries
+
+
+def test_env_numerics_toggle_backward_cache(monkeypatch):
+    """The backward jit cache must also key on the env-numerics switch —
+    a no-params op caches the bare op.fn under both env settings."""
+    autograd._BWD_JIT.clear()
+    x = mx.nd.array(onp.random.randn(2, 8).astype(onp.float32)) \
+        .astype("bfloat16")
+    x.attach_grad()
+    monkeypatch.delenv("MXNET_SAFE_ACCUMULATION", raising=False)
+    with autograd.record():
+        y = registry.invoke("log_softmax", [x])
+    y.backward()
+    n0 = len(autograd._BWD_JIT)
+    monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "1")
+    with autograd.record():
+        y = registry.invoke("log_softmax", [x])
+    y.backward()
+    assert len(autograd._BWD_JIT) > n0   # distinct entry per env setting
+
+
+def test_jit_failure_on_user_error_does_not_latch():
+    """A bad call (shape error) must raise and NOT permanently demote the
+    op to eager dispatch."""
+    op = registry.get("dot")
+    op._jits.clear(); op._partials.clear()
+    a = mx.nd.array(onp.ones((2, 3), onp.float32))
+    b = mx.nd.array(onp.ones((4, 5), onp.float32))
+    with pytest.raises(Exception):
+        registry.invoke("dot", [a, b])    # inner dims mismatch
+    key = ((), registry._env_numerics_key())
+    assert key in op._jits and not op._jits[key].disabled
+    good = registry.invoke("dot", [a, mx.nd.array(
+        onp.ones((3, 2), onp.float32))])
+    onp.testing.assert_allclose(good.asnumpy(), 3 * onp.ones((2, 2)))
+    assert not op._jits[key].disabled
+
+
+def test_partials_cache_capped():
+    """Loop-varying params must not leak one compiled executable per
+    value."""
+    op = registry.get("slice_axis")
+    if op is None:
+        pytest.skip("slice_axis not registered")
+    op._jits.clear(); op._partials.clear()
+    x = mx.nd.array(onp.arange(200, dtype=onp.float32))
+    for i in range(registry._MAX_PARTIALS + 10):
+        registry.invoke("slice_axis", [x], axis=0, begin=i, end=i + 1)
+    assert len(op._partials) <= registry._MAX_PARTIALS
+    assert len(op._jits) <= registry._MAX_PARTIALS
+
+
+def test_unhashable_params_fall_back():
+    # array-valued param can't key the cache; invoke must still work
+    op = registry.get("relu")
+    x = mx.nd.array(onp.array([-1.0, 1.0], onp.float32))
+    out = registry.invoke("relu", [x])   # baseline sanity
+    onp.testing.assert_allclose(out.asnumpy(), [0.0, 1.0])
+    assert registry._params_key({"a": onp.zeros(3)}) is None
+    assert registry._params_key({"a": [1, 2], "b": "x"}) == \
+        (("a", (1, 2)), ("b", "x"))
