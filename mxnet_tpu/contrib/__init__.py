@@ -1,2 +1,12 @@
-"""mx.contrib — quantization and other contrib frontends."""
+"""mx.contrib — quantization, onnx and other contrib frontends."""
 from . import quantization  # noqa: F401
+
+
+def __getattr__(name):
+    # onnx is lazy: it needs google.protobuf, which is not a core
+    # dependency of the package (parity: the reference's contrib.onnx
+    # also imports the onnx package only on use)
+    if name == "onnx":
+        import importlib
+        return importlib.import_module(".onnx", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,10 @@
+"""ONNX interop (parity: python/mxnet/contrib/onnx).
+
+Serialization uses a protoc-generated subset of the public ONNX schema
+(onnx.proto → onnx_pb2.py, committed); no external onnx package needed.
+"""
+from .mx2onnx import export_model
+from .onnx2mx import import_model, import_to_gluon, get_model_metadata
+
+__all__ = ["export_model", "import_model", "import_to_gluon",
+           "get_model_metadata"]

@@ -1,0 +1,420 @@
+"""Symbol-graph → ONNX exporter.
+
+Parity: python/mxnet/contrib/onnx/mx2onnx (export_model.py,
+export_onnx.py MXNetGraph.create_onnx_graph_proto, _op_translations.py).
+The TPU build's Symbol graph is a DAG of registry-op nodes
+(symbol/symbol.py _Node), so export is one topological walk with a
+per-op translation table; serialization rides the protoc-generated
+subset schema in onnx_pb2.py (field numbers per the public ONNX spec).
+
+Opset 12 is declared: axes stay attributes on Reduce*, keeping the
+emitted graphs self-inverse with onnx2mx.py and readable by standard
+runtimes.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from ...base import MXNetError
+from . import onnx_pb2 as P
+
+__all__ = ["export_model"]
+
+_OPSET = 12
+_DTYPE2ONNX = {
+    onp.dtype("float32"): P.TensorProto.FLOAT,
+    onp.dtype("float64"): P.TensorProto.DOUBLE,
+    onp.dtype("float16"): P.TensorProto.FLOAT16,
+    onp.dtype("int32"): P.TensorProto.INT32,
+    onp.dtype("int64"): P.TensorProto.INT64,
+    onp.dtype("int8"): P.TensorProto.INT8,
+    onp.dtype("uint8"): P.TensorProto.UINT8,
+    onp.dtype("bool"): P.TensorProto.BOOL,
+}
+
+
+def _tup(v, n=2):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, int):
+        return (v,) * n
+    t = tuple(int(x) for x in v)
+    return t if len(t) == n else t * n
+
+
+class _Ctx:
+    """Accumulates the graph being built; helpers for the translators."""
+
+    def __init__(self, graph: P.GraphProto, dtype):
+        self.graph = graph
+        self.dtype = onp.dtype(dtype)
+        self._const_n = 0
+
+    def add_node(self, op_type: str, inputs: Sequence[str],
+                 outputs: Sequence[str], name: str = "", **attrs):
+        node = self.graph.node.add()
+        node.op_type = op_type
+        node.name = name or outputs[0]
+        node.input.extend(inputs)
+        node.output.extend(outputs)
+        for k, v in attrs.items():
+            a = node.attribute.add()
+            a.name = k
+            if isinstance(v, float):
+                a.type = P.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, bool) or isinstance(v, int):
+                a.type = P.AttributeProto.INT
+                a.i = int(v)
+            elif isinstance(v, str):
+                a.type = P.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (tuple, list)):
+                if v and isinstance(v[0], float):
+                    a.type = P.AttributeProto.FLOATS
+                    a.floats.extend(v)
+                else:
+                    a.type = P.AttributeProto.INTS
+                    a.ints.extend(int(x) for x in v)
+            else:
+                raise MXNetError(f"onnx export: bad attr {k}={v!r}")
+        return node
+
+    def add_initializer(self, name: str, array: onp.ndarray):
+        t = self.graph.initializer.add()
+        t.name = name
+        arr = onp.ascontiguousarray(array)
+        if arr.dtype not in _DTYPE2ONNX:
+            raise MXNetError(f"onnx export: unsupported dtype {arr.dtype}")
+        t.data_type = _DTYPE2ONNX[arr.dtype]
+        t.dims.extend(arr.shape)
+        t.raw_data = arr.tobytes()
+        return name
+
+    def const(self, value, dtype=None, name_hint="const"):
+        self._const_n += 1
+        name = f"__{name_hint}_{self._const_n}"
+        return self.add_initializer(
+            name, onp.asarray(value, dtype or self.dtype))
+
+
+# --------------------------------------------------------------------------
+# translation table: mxnet op name → fn(ctx, node, ins, out) emitting nodes
+# (parity: mx2onnx/_op_translations.py, one @mx_op.register per op)
+# --------------------------------------------------------------------------
+
+_TRANSLATORS: Dict[str, "callable"] = {}
+
+
+def register(*names):
+    def deco(fn):
+        for n in names:
+            _TRANSLATORS[n] = fn
+        return fn
+    return deco
+
+
+@register("Convolution", "convolution")
+def _conv(ctx, node, ins, out):
+    p = node.params
+    k = _tup(p["kernel"], len(p["kernel"]) if not isinstance(p["kernel"], int)
+             else 2)
+    nd = len(k)
+    pad = _tup(p.get("pad"), nd) if p.get("pad") else (0,) * nd
+    ctx.add_node("Conv", ins, [out], name=node.name,
+                 kernel_shape=k, strides=_tup(p.get("stride"), nd),
+                 dilations=_tup(p.get("dilate"), nd),
+                 pads=tuple(pad) * 2, group=int(p.get("num_group", 1)))
+
+
+@register("Deconvolution")
+def _deconv(ctx, node, ins, out):
+    p = node.params
+    k = _tup(p["kernel"])
+    nd = len(k)
+    pad = _tup(p.get("pad"), nd) if p.get("pad") else (0,) * nd
+    ctx.add_node("ConvTranspose", ins, [out], name=node.name,
+                 kernel_shape=k, strides=_tup(p.get("stride"), nd),
+                 dilations=_tup(p.get("dilate"), nd),
+                 pads=tuple(pad) * 2, group=int(p.get("num_group", 1)))
+
+
+@register("FullyConnected", "fully_connected")
+def _fc(ctx, node, ins, out):
+    p = node.params
+    data = ins[0]
+    if p.get("flatten", True):
+        flat = out + "_flat"
+        ctx.add_node("Flatten", [data], [flat], axis=1)
+        data = flat
+    if len(ins) == 3:
+        ctx.add_node("Gemm", [data, ins[1], ins[2]], [out], name=node.name,
+                     alpha=1.0, beta=1.0, transA=0, transB=1)
+    else:
+        ctx.add_node("Gemm", [data, ins[1]], [out], name=node.name,
+                     alpha=1.0, beta=1.0, transA=0, transB=1)
+
+
+@register("Activation", "activation")
+def _act(ctx, node, ins, out):
+    act = node.params["act_type"]
+    op = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "softrelu": "Softplus", "softsign": "Softsign"}.get(act)
+    if op is None:
+        raise MXNetError(f"onnx export: Activation act_type={act}")
+    ctx.add_node(op, ins, [out], name=node.name)
+
+
+@register("LeakyReLU")
+def _leaky(ctx, node, ins, out):
+    act = node.params.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.add_node("LeakyRelu", ins, [out], name=node.name,
+                     alpha=float(node.params.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.add_node("Elu", ins, [out], name=node.name,
+                     alpha=float(node.params.get("slope", 0.25)))
+    elif act == "prelu":
+        ctx.add_node("PRelu", ins, [out], name=node.name)
+    else:
+        raise MXNetError(f"onnx export: LeakyReLU act_type={act}")
+
+
+@register("Pooling", "pooling")
+def _pool(ctx, node, ins, out):
+    p = node.params
+    ptype = p.get("pool_type", "max")
+    if p.get("global_pool"):
+        op = {"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}.get(ptype)
+        if op is None:
+            raise MXNetError(f"onnx export: global pool_type={ptype}")
+        ctx.add_node(op, ins, [out], name=node.name)
+        return
+    k = _tup(p["kernel"])
+    nd = len(k)
+    pad = _tup(p.get("pad"), nd) if p.get("pad") else (0,) * nd
+    op = {"max": "MaxPool", "avg": "AveragePool"}.get(ptype)
+    if op is None:
+        raise MXNetError(f"onnx export: pool_type={ptype}")
+    attrs = dict(kernel_shape=k, strides=_tup(p.get("stride"), nd),
+                 pads=tuple(pad) * 2)
+    if op == "AveragePool":
+        attrs["count_include_pad"] = int(
+            p.get("count_include_pad", True))
+    ctx.add_node(op, ins, [out], name=node.name, **attrs)
+
+
+@register("BatchNorm", "batch_norm")
+def _bn(ctx, node, ins, out):
+    ctx.add_node("BatchNormalization", ins, [out], name=node.name,
+                 epsilon=float(node.params.get("eps", 1e-3)),
+                 momentum=float(node.params.get("momentum", 0.9)))
+
+
+@register("softmax")
+def _softmax(ctx, node, ins, out):
+    ctx.add_node("Softmax", ins, [out], name=node.name,
+                 axis=int(node.params.get("axis", -1)))
+
+
+@register("log_softmax")
+def _log_softmax(ctx, node, ins, out):
+    ctx.add_node("LogSoftmax", ins, [out], name=node.name,
+                 axis=int(node.params.get("axis", -1)))
+
+
+@register("Flatten", "flatten")
+def _flatten(ctx, node, ins, out):
+    ctx.add_node("Flatten", ins, [out], name=node.name, axis=1)
+
+
+@register("Reshape", "reshape")
+def _reshape(ctx, node, ins, out):
+    shape = ctx.const(node.params["shape"], onp.int64, "shape")
+    ctx.add_node("Reshape", [ins[0], shape], [out], name=node.name)
+
+
+@register("transpose")
+def _transpose(ctx, node, ins, out):
+    ctx.add_node("Transpose", ins, [out], name=node.name,
+                 perm=tuple(int(a) for a in node.params["axes"]))
+
+
+@register("Concat", "concat")
+def _concat(ctx, node, ins, out):
+    ctx.add_node("Concat", ins, [out], name=node.name,
+                 axis=int(node.params.get("dim", 1)))
+
+
+@register("Dropout", "dropout")
+def _dropout(ctx, node, ins, out):
+    # inference graphs: identity (parity: reference exports Dropout and
+    # runtimes treat it as identity outside training)
+    ctx.add_node("Identity", ins[:1], [out], name=node.name)
+
+
+@register("LRN")
+def _lrn(ctx, node, ins, out):
+    p = node.params
+    ctx.add_node("LRN", ins, [out], name=node.name,
+                 size=int(p["nsize"]), alpha=float(p.get("alpha", 1e-4)),
+                 beta=float(p.get("beta", 0.75)),
+                 bias=float(p.get("knorm", 2.0)))
+
+
+@register("dot")
+def _dot(ctx, node, ins, out):
+    ctx.add_node("MatMul", ins, [out], name=node.name)
+
+
+@register("ElementWiseSum", "add_n")
+def _sum(ctx, node, ins, out):
+    ctx.add_node("Sum", ins, [out], name=node.name)
+
+
+_BINARY = {"elemwise_add": "Add", "broadcast_add": "Add",
+           "elemwise_sub": "Sub", "broadcast_sub": "Sub",
+           "elemwise_mul": "Mul", "broadcast_mul": "Mul",
+           "elemwise_div": "Div", "broadcast_div": "Div",
+           "broadcast_power": "Pow", "broadcast_maximum": "Max",
+           "broadcast_minimum": "Min"}
+for _mx, _ox in _BINARY.items():
+    def _bin(ctx, node, ins, out, _ox=_ox):
+        ctx.add_node(_ox, ins, [out], name=node.name)
+    _TRANSLATORS[_mx] = _bin
+
+_UNARY = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+          "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+          "negative": "Neg", "floor": "Floor", "ceil": "Ceil",
+          "erf": "Erf", "sign": "Sign", "reciprocal": "Reciprocal",
+          "identity": "Identity", "BlockGrad": "Identity",
+          "softsign": "Softsign"}
+for _mx, _ox in _UNARY.items():
+    def _un(ctx, node, ins, out, _ox=_ox):
+        ctx.add_node(_ox, ins, [out], name=node.name)
+    _TRANSLATORS[_mx] = _un
+
+_SCALAR = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+           "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+           "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+           "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True)}
+for _mx, (_ox, _rev) in _SCALAR.items():
+    def _sc(ctx, node, ins, out, _ox=_ox, _rev=_rev):
+        c = ctx.const(node.params["scalar"], name_hint="scalar")
+        args = [c, ins[0]] if _rev else [ins[0], c]
+        ctx.add_node(_ox, args, [out], name=node.name)
+    _TRANSLATORS[_mx] = _sc
+
+
+def _scalar_wrap(ctx, node, ins, out):
+    """Generic handler for symbol.py's `_scalar_wrap:<base>` nodes."""
+    base = node.op_name.split(":", 1)[1]
+    ox = _BINARY.get(base)
+    if ox is None:
+        raise MXNetError(f"onnx export: scalar-wrapped op {base!r}")
+    c = ctx.const(node.params["__scalar__"], name_hint="scalar")
+    rev = node.params.get("__reverse__", False)
+    ctx.add_node(ox, [c, ins[0]] if rev else [ins[0], c], [out],
+                 name=node.name)
+
+
+_REDUCE = {"mean": "ReduceMean", "sum": "ReduceSum", "max": "ReduceMax",
+           "min": "ReduceMin", "prod": "ReduceProd"}
+for _mx, _ox in _REDUCE.items():
+    def _red(ctx, node, ins, out, _ox=_ox):
+        p = node.params
+        attrs = {"keepdims": int(bool(p.get("keepdims", False)))}
+        ax = p.get("axis")
+        if ax is not None:
+            attrs["axes"] = (ax,) if isinstance(ax, int) else tuple(ax)
+        ctx.add_node(_ox, ins, [out], name=node.name, **attrs)
+    _TRANSLATORS[_mx] = _red
+
+
+# --------------------------------------------------------------------------
+# driver (parity: MXNetGraph.create_onnx_graph_proto, export_onnx.py:70)
+# --------------------------------------------------------------------------
+
+def export_model(sym, params: Dict, input_shape: Sequence,
+                 input_type=onp.float32, onnx_file_path: str = "model.onnx",
+                 verbose: bool = False) -> str:
+    """Export a Symbol graph + params to an ONNX file.
+
+    Parity: contrib/onnx/mx2onnx/export_model.py export_model (same
+    signature).  `params` maps variable name → NDArray/ndarray (arg and
+    aux merged, as the reference accepts).
+    """
+    from ...symbol.symbol import Symbol, _topo_nodes
+    from ...ndarray import NDArray
+
+    if not isinstance(sym, Symbol):
+        raise MXNetError("onnx export expects a Symbol (symbol-free gluon "
+                         "blocks export via HybridBlock.export / StableHLO)")
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    dtype = onp.dtype(input_type)
+
+    model = P.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    model.producer_version = "2.0"
+    op = model.opset_import.add()
+    op.version = _OPSET
+    graph = model.graph
+    graph.name = getattr(sym, "name", "mxnet_tpu_graph")
+    ctx = _Ctx(graph, dtype)
+
+    nodes = _topo_nodes([o[0] for o in sym._outputs])
+    input_shapes = list(input_shape)
+    n_data = 0
+    for node in nodes:
+        if node.is_var:
+            if node.name in params:
+                arr = params[node.name]
+                arr = arr.asnumpy() if isinstance(arr, NDArray) else \
+                    onp.asarray(arr)
+                ctx.add_initializer(node.name, arr)
+            else:
+                if n_data >= len(input_shapes):
+                    raise MXNetError(
+                        f"onnx export: no input_shape for data variable "
+                        f"{node.name!r} (got {len(input_shapes)} shapes)")
+                vi = graph.input.add()
+                vi.name = node.name
+                tt = vi.type.tensor_type
+                tt.elem_type = _DTYPE2ONNX[dtype]
+                for d in input_shapes[n_data]:
+                    tt.shape.dim.add().dim_value = int(d)
+                n_data += 1
+            continue
+        ins = []
+        for src, idx in node.inputs:
+            if idx != 0:
+                raise MXNetError(
+                    "onnx export: tapping a non-primary output of a "
+                    f"multi-output op ({src.name}[{idx}]) is unsupported")
+            ins.append(src.name)
+        if node.op_name.startswith("_scalar_wrap:"):
+            _scalar_wrap(ctx, node, ins, node.name)
+            continue
+        tr = _TRANSLATORS.get(node.op_name)
+        if tr is None:
+            raise MXNetError(
+                f"onnx export: no translation for op {node.op_name!r} "
+                f"(supported: {sorted(set(_TRANSLATORS))})")
+        tr(ctx, node, ins, node.name)
+        if verbose:
+            print(f"[onnx-export] {node.op_name} {node.name}")
+
+    for out_node, idx in sym._outputs:
+        vo = graph.output.add()
+        vo.name = out_node.name
+        vo.type.tensor_type.elem_type = _DTYPE2ONNX[dtype]
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    if verbose:
+        print(f"[onnx-export] wrote {onnx_file_path} "
+              f"({len(graph.node)} nodes)")
+    return onnx_file_path

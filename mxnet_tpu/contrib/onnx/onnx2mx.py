@@ -1,0 +1,362 @@
+"""ONNX → Symbol-graph importer.
+
+Parity: python/mxnet/contrib/onnx/onnx2mx (import_model.py,
+import_onnx.py GraphProto.from_onnx, _op_translations.py,
+import_to_gluon.py).  Reads the protoc-generated subset schema
+(onnx_pb2.py); initializers become arg/aux params (BatchNorm running
+stats → aux, matching the reference's split), graph inputs become data
+variables, and each node maps back through the inverse of the
+mx2onnx translation table.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as onp
+
+from ...base import MXNetError
+from . import onnx_pb2 as P
+
+__all__ = ["import_model", "import_to_gluon", "get_model_metadata"]
+
+_ONNX2DTYPE = {
+    P.TensorProto.FLOAT: onp.dtype("float32"),
+    P.TensorProto.DOUBLE: onp.dtype("float64"),
+    P.TensorProto.FLOAT16: onp.dtype("float16"),
+    P.TensorProto.INT32: onp.dtype("int32"),
+    P.TensorProto.INT64: onp.dtype("int64"),
+    P.TensorProto.INT8: onp.dtype("int8"),
+    P.TensorProto.UINT8: onp.dtype("uint8"),
+    P.TensorProto.BOOL: onp.dtype("bool"),
+}
+
+
+def _tensor_to_numpy(t: P.TensorProto) -> onp.ndarray:
+    dtype = _ONNX2DTYPE.get(t.data_type)
+    if dtype is None:
+        raise MXNetError(f"onnx import: unsupported tensor dtype "
+                         f"{t.data_type}")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return onp.frombuffer(t.raw_data, dtype=dtype).reshape(shape).copy()
+    if t.float_data:
+        return onp.asarray(t.float_data, onp.float32).astype(dtype) \
+            .reshape(shape)
+    if t.int64_data:
+        return onp.asarray(t.int64_data, onp.int64).astype(dtype) \
+            .reshape(shape)
+    if t.int32_data:
+        return onp.asarray(t.int32_data, onp.int32).astype(dtype) \
+            .reshape(shape)
+    if t.double_data:
+        return onp.asarray(t.double_data, onp.float64).astype(dtype) \
+            .reshape(shape)
+    return onp.zeros(shape, dtype)
+
+
+def _attrs(node: P.NodeProto) -> Dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.FLOAT:
+            out[a.name] = a.f
+        elif a.type == P.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = tuple(a.floats)
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = tuple(int(i) for i in a.ints)
+        elif a.type == P.AttributeProto.TENSOR:
+            out[a.name] = _tensor_to_numpy(a.t)
+    return out
+
+
+def _pair(pads):
+    """ONNX pads [b0,b1,...,e0,e1,...] → symmetric mxnet pad or raise."""
+    n = len(pads) // 2
+    begin, end = pads[:n], pads[n:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError(f"onnx import: asymmetric pads {pads} unsupported")
+    return tuple(begin)
+
+
+class _Importer:
+    def __init__(self, model: P.ModelProto):
+        from ...symbol.symbol import Variable
+        self.model = model
+        g = model.graph
+        self.consts: Dict[str, onp.ndarray] = {
+            t.name: _tensor_to_numpy(t) for t in g.initializer}
+        self.sym_map: Dict[str, object] = {}
+        self.used_consts: set = set()    # consumed as attrs (Reshape shape)
+        self.data_names: List[str] = []
+        for vi in g.input:
+            if vi.name not in self.consts:
+                self.data_names.append(vi.name)
+                self.sym_map[vi.name] = Variable(vi.name)
+
+    def _sym(self, name: str):
+        from ...symbol.symbol import Variable
+        s = self.sym_map.get(name)
+        if s is None:
+            if name not in self.consts:
+                raise MXNetError(f"onnx import: undefined value {name!r}")
+            s = self.sym_map[name] = Variable(name)
+        return s
+
+    def _apply(self, op, inputs, name, **params):
+        from ...symbol.symbol import _apply
+        return _apply(op, inputs, name=name, **params)
+
+    def run(self):
+        g = self.model.graph
+        for node in g.node:
+            self._convert(node)
+        outs = []
+        for vo in g.output:
+            outs.append(self._sym(vo.name))
+        from ...symbol.symbol import Group
+        sym = outs[0] if len(outs) == 1 else Group(outs)
+        arg_params, aux_params = {}, {}
+        for name, arr in self.consts.items():
+            if name in self.used_consts:
+                continue
+            if name in self._aux_names:
+                aux_params[name] = arr
+            else:
+                arg_params[name] = arr
+        return sym, arg_params, aux_params
+
+    _aux_names: set
+
+    def _convert(self, node: P.NodeProto):
+        op = node.op_type
+        at = _attrs(node)
+        ins = list(node.input)
+        out = node.output[0]
+        name = node.name or out
+        fn = getattr(self, "_cv_" + op, None)
+        if fn is not None:
+            sym = fn(node, at, ins, name)
+        elif op in _SIMPLE:
+            mx_op, param_fn = _SIMPLE[op]
+            sym = self._apply(mx_op, [self._sym(i) for i in ins], name,
+                              **(param_fn(at) if param_fn else {}))
+        else:
+            raise MXNetError(
+                f"onnx import: unsupported op {op!r} "
+                f"(supported: {sorted(set(_SIMPLE) | _METHOD_OPS)})")
+        self.sym_map[out] = sym
+
+    # -- structured converters ---------------------------------------------
+    def _cv_Conv(self, node, at, ins, name):
+        k = at["kernel_shape"]
+        w = self.consts.get(ins[1])
+        if w is None:
+            raise MXNetError("onnx import: Conv weight must be an "
+                             "initializer")
+        params = dict(kernel=tuple(k), num_filter=int(w.shape[0]),
+                      stride=tuple(at.get("strides", (1,) * len(k))),
+                      dilate=tuple(at.get("dilations", (1,) * len(k))),
+                      num_group=int(at.get("group", 1)))
+        if at.get("pads"):
+            params["pad"] = _pair(at["pads"])
+        if len(ins) == 2:
+            params["no_bias"] = True
+        return self._apply("Convolution", [self._sym(i) for i in ins],
+                           name, **params)
+
+    def _cv_ConvTranspose(self, node, at, ins, name):
+        k = at["kernel_shape"]
+        w = self.consts.get(ins[1])
+        if w is None:
+            raise MXNetError("onnx import: ConvTranspose weight must be an "
+                             "initializer")
+        params = dict(kernel=tuple(k), num_filter=int(w.shape[1]),
+                      stride=tuple(at.get("strides", (1,) * len(k))),
+                      dilate=tuple(at.get("dilations", (1,) * len(k))),
+                      num_group=int(at.get("group", 1)))
+        if at.get("pads"):
+            params["pad"] = _pair(at["pads"])
+        if len(ins) == 2:
+            params["no_bias"] = True
+        return self._apply("Deconvolution", [self._sym(i) for i in ins],
+                           name, **params)
+
+    def _cv_Gemm(self, node, at, ins, name):
+        if at.get("alpha", 1.0) != 1.0 or at.get("beta", 1.0) != 1.0 \
+                or at.get("transA", 0):
+            raise MXNetError("onnx import: general Gemm (alpha/beta/transA) "
+                             "unsupported")
+        w = self.consts.get(ins[1])
+        if w is None:
+            raise MXNetError("onnx import: Gemm weight must be an "
+                             "initializer")
+        if not at.get("transB", 0):
+            # store transposed so FullyConnected's (out,in) layout holds
+            self.consts[ins[1]] = onp.ascontiguousarray(w.T)
+            w = self.consts[ins[1]]
+        params = dict(num_hidden=int(w.shape[0]), flatten=False)
+        if len(ins) == 2:
+            params["no_bias"] = True
+        return self._apply("FullyConnected", [self._sym(i) for i in ins],
+                           name, **params)
+
+    def _cv_BatchNormalization(self, node, at, ins, name):
+        # running mean/var are aux params (parity: onnx2mx import_onnx
+        # aux split)
+        self._aux_names.update(ins[3:5])
+        return self._apply(
+            "BatchNorm", [self._sym(i) for i in ins], name,
+            eps=float(at.get("epsilon", 1e-5)),
+            momentum=float(at.get("momentum", 0.9)))
+
+    def _cv_Reshape(self, node, at, ins, name):
+        shape = self.consts.get(ins[1])
+        if shape is None:
+            raise MXNetError("onnx import: dynamic Reshape unsupported")
+        self.used_consts.add(ins[1])
+        return self._apply("Reshape", [self._sym(ins[0])], name,
+                           shape=tuple(int(s) for s in shape))
+
+    def _cv_MaxPool(self, node, at, ins, name):
+        return self._pool(at, ins, name, "max", False)
+
+    def _cv_AveragePool(self, node, at, ins, name):
+        return self._pool(at, ins, name, "avg", False)
+
+    def _cv_GlobalMaxPool(self, node, at, ins, name):
+        return self._pool(at, ins, name, "max", True)
+
+    def _cv_GlobalAveragePool(self, node, at, ins, name):
+        return self._pool(at, ins, name, "avg", True)
+
+    def _pool(self, at, ins, name, ptype, global_pool):
+        params = dict(pool_type=ptype, global_pool=global_pool)
+        if not global_pool:
+            k = at["kernel_shape"]
+            params["kernel"] = tuple(k)
+            params["stride"] = tuple(at.get("strides", (1,) * len(k)))
+            if at.get("pads"):
+                params["pad"] = _pair(at["pads"])
+            if ptype == "avg":
+                params["count_include_pad"] = bool(
+                    at.get("count_include_pad", 1))
+        return self._apply("Pooling", [self._sym(ins[0])], name, **params)
+
+    def _cv_Constant(self, node, at, ins, name):
+        from ...symbol.symbol import Variable
+        self.consts[node.output[0]] = at["value"]
+        return Variable(node.output[0])
+
+    def _cv_Dropout(self, node, at, ins, name):
+        return self._sym(ins[0])    # identity at inference
+
+    def _cv_Identity(self, node, at, ins, name):
+        return self._sym(ins[0])
+
+
+_METHOD_OPS = {"Conv", "ConvTranspose", "Gemm", "BatchNormalization",
+               "Reshape", "MaxPool", "AveragePool", "GlobalMaxPool",
+               "GlobalAveragePool", "Constant", "Dropout", "Identity"}
+
+# op → (mxnet op, params-from-attrs fn)
+_SIMPLE = {
+    "Relu": ("relu", None),
+    "Sigmoid": ("sigmoid", None),
+    "Tanh": ("tanh", None),
+    "Softplus": ("Activation", lambda at: {"act_type": "softrelu"}),
+    "Softsign": ("softsign", None),
+    "Exp": ("exp", None), "Log": ("log", None), "Sqrt": ("sqrt", None),
+    "Abs": ("abs", None), "Neg": ("negative", None),
+    "Floor": ("floor", None), "Ceil": ("ceil", None), "Erf": ("erf", None),
+    "Sign": ("sign", None), "Reciprocal": ("reciprocal", None),
+    "Add": ("broadcast_add", None), "Sub": ("broadcast_sub", None),
+    "Mul": ("broadcast_mul", None), "Div": ("broadcast_div", None),
+    "Pow": ("broadcast_power", None),
+    "Max": ("broadcast_maximum", None), "Min": ("broadcast_minimum", None),
+    "MatMul": ("dot", None),
+    "Sum": ("ElementWiseSum", None),
+    "Flatten": ("Flatten", None),
+    "Transpose": ("transpose", lambda at: {"axes": at["perm"]}),
+    "Concat": ("Concat", lambda at: {"dim": at.get("axis", 1)}),
+    "Softmax": ("softmax", lambda at: {"axis": at.get("axis", -1)}),
+    "LogSoftmax": ("log_softmax", lambda at: {"axis": at.get("axis", -1)}),
+    "LeakyRelu": ("LeakyReLU",
+                  lambda at: {"act_type": "leaky",
+                              "slope": at.get("alpha", 0.01)}),
+    "Elu": ("LeakyReLU", lambda at: {"act_type": "elu",
+                                     "slope": at.get("alpha", 1.0)}),
+    "PRelu": ("LeakyReLU", lambda at: {"act_type": "prelu"}),
+    "LRN": ("LRN", lambda at: {"nsize": at["size"],
+                               "alpha": at.get("alpha", 1e-4),
+                               "beta": at.get("beta", 0.75),
+                               "knorm": at.get("bias", 2.0)}),
+    "ReduceMean": ("mean", lambda at: {"axis": at.get("axes"),
+                                       "keepdims": bool(at.get("keepdims",
+                                                               1))}),
+    "ReduceSum": ("sum", lambda at: {"axis": at.get("axes"),
+                                     "keepdims": bool(at.get("keepdims",
+                                                             1))}),
+    "ReduceMax": ("max", lambda at: {"axis": at.get("axes"),
+                                     "keepdims": bool(at.get("keepdims",
+                                                             1))}),
+    "ReduceMin": ("min", lambda at: {"axis": at.get("axes"),
+                                     "keepdims": bool(at.get("keepdims",
+                                                             1))}),
+}
+
+
+def _load(model_file) -> P.ModelProto:
+    model = P.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    return model
+
+
+def import_model(model_file: str):
+    """Import an ONNX file → (sym, arg_params, aux_params).
+
+    Parity: contrib/onnx/onnx2mx/import_model.py import_model (same
+    signature/return); params are NDArrays.
+    """
+    from ...ndarray import NDArray
+
+    imp = _Importer(_load(model_file))
+    imp._aux_names = set()
+    sym, args, auxs = imp.run()
+    return (sym, {k: NDArray(v) for k, v in args.items()},
+            {k: NDArray(v) for k, v in auxs.items()})
+
+
+def get_model_metadata(model_file: str) -> Dict:
+    """Input/output names+shapes of an ONNX file (parity:
+    import_model.py get_model_metadata)."""
+    model = _load(model_file)
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def info(vs):
+        out = []
+        for vi in vs:
+            if vi.name in inits:
+                continue
+            dims = tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+            out.append((vi.name, dims))
+        return out
+
+    return {"input_tensor_data": info(g.input),
+            "output_tensor_data": info(g.output)}
+
+
+def import_to_gluon(model_file: str, ctx=None):
+    """Import an ONNX file as a gluon SymbolBlock (parity:
+    contrib/onnx/onnx2mx/import_to_gluon.py)."""
+    from ...gluon.block import SymbolBlock
+
+    sym, args, auxs = import_model(model_file)
+    imp_inputs = get_model_metadata(model_file)["input_tensor_data"]
+    params = dict(args)
+    params.update(auxs)
+    return SymbolBlock(sym, [n for n, _ in imp_inputs], params=params)

@@ -24,10 +24,10 @@ def _safe_acc(x):
     """Upcast low-precision inputs to f32 for accumulation when
     ``MXNET_SAFE_ACCUMULATION=1`` (parity: the reference's safe-
     accumulation switch in softmax/norm kernels, env_var.md; read at
-    trace time, so under jit it is a compile-time constant like the
-    reference's kernel-launch-time read)."""
-    import os
-    if os.environ.get("MXNET_SAFE_ACCUMULATION", "0") == "1" and \
+    trace time — the dispatch cache keys on the switch via the same
+    shared helper, so toggling it is honored)."""
+    from .registry import safe_accumulation_enabled
+    if safe_accumulation_enabled() and \
             x.dtype in (jnp.bfloat16, jnp.float16):
         return x.astype(jnp.float32), x.dtype
     return x, None

@@ -25,16 +25,19 @@ _REGISTRY: Dict[str, "Operator"] = {}
 class Operator:
     """One registered op: name + pure jax ``fn(*arrays, **params)``."""
 
-    __slots__ = ("name", "fn", "multi_out", "aliases", "doc",
+    __slots__ = ("name", "fn", "multi_out", "aliases", "doc", "impure",
                  "_partials", "_jits")
 
     def __init__(self, name: str, fn: Callable, multi_out: bool = False,
-                 aliases: Sequence[str] = ()):
+                 aliases: Sequence[str] = (), impure: bool = False):
         self.name = name
         self.fn = fn
         self.multi_out = multi_out
         self.aliases = tuple(aliases)
         self.doc = fn.__doc__
+        # impure: fn draws host-side state (e.g. a PRNG key) per call, so
+        # caching/jitting it would freeze that state into the executable
+        self.impure = impure
         self._partials: Dict[Any, Callable] = {}   # params-key → partial
         self._jits: Dict[Any, "_JitEntry"] = {}    # params-key → jit entry
 
@@ -42,16 +45,20 @@ class Operator:
         return f"<Operator {self.name}>"
 
 
-def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False):
+def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False,
+             impure: bool = False):
     """Decorator registering a pure jax function as an op.
 
     The function signature is ``fn(*arrays, **params)`` where arrays are
     jax.Array positional args and params are keyword-only static attrs
-    (parity: dmlc::Parameter per-op param structs).
+    (parity: dmlc::Parameter per-op param structs).  ``impure`` marks fns
+    that draw host-side state (PRNG keys) per call — they are never
+    cached or jitted by the eager dispatch funnel.
     """
 
     def deco(fn: Callable):
-        op = Operator(name, fn, multi_out=multi_out, aliases=aliases)
+        op = Operator(name, fn, multi_out=multi_out, aliases=aliases,
+                      impure=impure)
         if name in _REGISTRY:
             raise MXNetError(f"op {name!r} registered twice")
         _REGISTRY[name] = op
@@ -187,17 +194,19 @@ class _JitEntry:
         latching, so one bad call doesn't demote the op forever."""
         if not self.disabled:
             sig = tuple((a.shape, str(a.dtype)) for a in arrays)
-            if sig not in self.sigs:
-                if len(self.sigs) >= _MAX_JIT_SIGS:
-                    self.disabled = True
-                    return fn(*arrays)
-                self.sigs.add(sig)
+            fresh = sig not in self.sigs
+            if fresh and len(self.sigs) >= _MAX_JIT_SIGS:
+                self.disabled = True
+                return fn(*arrays)
             try:
-                return self.jfn(*arrays)
+                out = self.jfn(*arrays)
             except Exception:
                 out = fn(*arrays)       # raises through on input errors
                 self.disabled = True    # jit-specific failure, eager works
                 return out
+            if fresh:                   # only successful sigs burn budget
+                self.sigs.add(sig)
+            return out
         return fn(*arrays)
 
 
@@ -225,13 +234,19 @@ _STABLE_FNS = weakref.WeakSet()
 _MAX_PARTIALS = 64      # per-op cap on cached (params → partial) entries
 
 
-def _env_numerics_key():
-    """Env switches that ops read at trace time (currently
-    MXNET_SAFE_ACCUMULATION, see ops/nn.py _safe_acc) participate in the
-    cache key, so toggling them is honored instead of replaying a stale
-    compiled executable."""
+def safe_accumulation_enabled() -> bool:
+    """The MXNET_SAFE_ACCUMULATION switch — the single parse point,
+    shared by the ops that honor it (ops/nn.py _safe_acc) and the cache
+    keys below, so the two can't drift."""
     import os
     return os.environ.get("MXNET_SAFE_ACCUMULATION", "0") == "1"
+
+
+def _env_numerics_key():
+    """Env switches that ops read at trace time participate in the cache
+    key, so toggling them is honored instead of replaying a stale
+    compiled executable."""
+    return safe_accumulation_enabled()
 
 
 def bound_fn(op: Operator, params: dict):
@@ -240,6 +255,9 @@ def bound_fn(op: Operator, params: dict):
     wrappers).  The partial is cached per (op, params, env-numerics) so
     its identity is stable; unhashable params — or an op hammered with
     loop-varying params — fall back to an uncached partial."""
+    if op.impure:   # per-call host state (PRNG): never cache or jit
+        return (functools.partial(op.fn, **params) if params
+                else op.fn), None
     pkey = _params_key(params) if params else ()
     if pkey is None:                      # unhashable params: no caching
         return functools.partial(op.fn, **params), None

@@ -132,17 +132,28 @@ def _scan_dir(mode, x, h0, c0, W, R, bW, bR, lengths, reverse):
     return out, h_T, c_T
 
 
-@register("RNN", aliases=["rnn"], multi_out=True)
+@register("RNN", aliases=["rnn"], multi_out=True, impure=True)
 def rnn(data, parameters, state, *extra, state_size, num_layers,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
         use_sequence_length=False, lstm_state_clip_min=None,
         lstm_state_clip_max=None, lstm_state_clip_nan=False,
         projection_size=None):
+    """Fused multi-layer (bi)directional RNN (parity: rnn-inl.h:56).
+
+    ``extra`` packs the optional array inputs in order: ``state_cell``
+    (lstm), ``sequence_length`` (use_sequence_length=True), and — when
+    inter-layer dropout ``p>0`` — an explicit PRNG ``dropout_key``.
+    Passing the key makes the op a pure function (forward and backward
+    see the same mask; jit-safe); without it a fresh global key is drawn
+    per call, which is why the op is registered ``impure`` (never
+    cached/jitted by the eager funnel).
+    """
     if projection_size is not None:
         raise NotImplementedError("projection_size not supported")
     extra = list(extra)
     state_cell = extra.pop(0) if mode == "lstm" and extra else None
     lengths = extra.pop(0) if use_sequence_length and extra else None
+    dropout_key = extra.pop(0) if extra else None
     if lengths is not None:
         lengths = lengths.astype(jnp.int32)
 
@@ -169,8 +180,12 @@ def rnn(data, parameters, state, *extra, state_size, num_layers,
             c_out.append(c_T)
         x = outs[0] if ndir == 1 else jnp.concatenate(outs, axis=-1)
         if p > 0.0 and layer < num_layers - 1:
-            from .random import next_key
-            keep = jax.random.bernoulli(next_key(), 1.0 - p, x.shape)
+            if dropout_key is not None:
+                key = jax.random.fold_in(dropout_key, layer)
+            else:
+                from .random import next_key
+                key = next_key()
+            keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
             x = jnp.where(keep, x / (1.0 - p), 0.0)
         if mode == "lstm" and lstm_state_clip_min is not None:
             c_out[-ndir:] = [jnp.clip(c, lstm_state_clip_min,

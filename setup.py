@@ -29,5 +29,6 @@ setup(
     package_data={"mxnet_tpu": ["lib/*.so"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy", "ml_dtypes"],
+    extras_require={"onnx": ["protobuf>=3.19"]},
     cmdclass={"build_py": BuildWithNative},
 )

@@ -1,0 +1,211 @@
+"""ONNX export/import round trips.
+
+Parity: python/mxnet/contrib/onnx (mx2onnx + onnx2mx) and the
+reference's onnx integration tests (tests/python-pytest/onnx).  No onnx
+package exists in the image, so fidelity is established by round trip:
+export → re-import → identical numerics, plus wire-level checks through
+the generated protobuf schema.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.contrib import onnx as mx_onnx
+
+
+def _mlp_sym():
+    x = mx.sym.var("data")
+    w1, b1 = mx.sym.var("fc1_weight"), mx.sym.var("fc1_bias")
+    w2, b2 = mx.sym.var("fc2_weight"), mx.sym.var("fc2_bias")
+    h = mx.sym.FullyConnected(x, w1, b1, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    out = mx.sym.FullyConnected(h, w2, b2, num_hidden=4, name="fc2")
+    return mx.sym.softmax(out, axis=-1, name="prob")
+
+
+def _mlp_params(rng):
+    return {
+        "fc1_weight": mx.nd.array(rng.randn(16, 8).astype(onp.float32) * .1),
+        "fc1_bias": mx.nd.array(rng.randn(16).astype(onp.float32) * .1),
+        "fc2_weight": mx.nd.array(rng.randn(4, 16).astype(onp.float32) * .1),
+        "fc2_bias": mx.nd.array(rng.randn(4).astype(onp.float32) * .1),
+    }
+
+
+def test_mlp_round_trip(tmp_path):
+    rng = onp.random.RandomState(0)
+    sym = _mlp_sym()
+    params = _mlp_params(rng)
+    x = rng.randn(2, 8).astype(onp.float32)
+
+    ref = sym.bind(args={**params, "data": mx.nd.array(x)}).forward()[0] \
+        .asnumpy()
+
+    path = str(tmp_path / "mlp.onnx")
+    mx_onnx.export_model(sym, params, [(2, 8)], onnx_file_path=path)
+
+    sym2, args2, aux2 = mx_onnx.import_model(path)
+    assert not aux2
+    got = sym2.bind(args={**args2, "data": mx.nd.array(x)}).forward()[0] \
+        .asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_convnet_round_trip(tmp_path):
+    rng = onp.random.RandomState(1)
+    x = mx.sym.var("data")
+    w = mx.sym.var("conv_weight")
+    b = mx.sym.var("conv_bias")
+    g, be = mx.sym.var("bn_gamma"), mx.sym.var("bn_beta")
+    mm, mv = mx.sym.var("bn_mean"), mx.sym.var("bn_var")
+    c = mx.sym.Convolution(x, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                           name="conv")
+    c = mx.sym.BatchNorm(c, g, be, mm, mv, eps=1e-5, name="bn",
+                         use_global_stats=True)
+    c = mx.sym.Activation(c, act_type="relu", name="act")
+    c = mx.sym.Pooling(c, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="pool")
+    c = mx.sym.Flatten(c, name="flat")
+
+    params = {
+        "conv_weight": mx.nd.array(rng.randn(4, 3, 3, 3)
+                                   .astype(onp.float32) * .2),
+        "conv_bias": mx.nd.array(rng.randn(4).astype(onp.float32) * .1),
+        "bn_gamma": mx.nd.array(rng.rand(4).astype(onp.float32) + .5),
+        "bn_beta": mx.nd.array(rng.randn(4).astype(onp.float32) * .1),
+        "bn_mean": mx.nd.array(rng.randn(4).astype(onp.float32) * .1),
+        "bn_var": mx.nd.array(rng.rand(4).astype(onp.float32) + .5),
+    }
+    xin = rng.randn(2, 3, 8, 8).astype(onp.float32)
+    ref = c.bind(args={**params, "data": mx.nd.array(xin)}).forward()[0] \
+        .asnumpy()
+
+    path = str(tmp_path / "conv.onnx")
+    mx_onnx.export_model(c, params, [(2, 3, 8, 8)], onnx_file_path=path)
+    sym2, args2, aux2 = mx_onnx.import_model(path)
+    # BN running stats come back as aux (reference split)
+    assert set(aux2) == {"bn_mean", "bn_var"}
+    ex = sym2.bind(args={**args2, **aux2, "data": mx.nd.array(xin)})
+    got = ex.forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_scalar_and_elemwise_round_trip(tmp_path):
+    rng = onp.random.RandomState(2)
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    y = (a * 2.0 + b) / 3.0 - 1.0
+    y = mx.sym.exp(y) + mx.sym.sqrt(mx.sym.abs(y))
+    an = rng.rand(3, 4).astype(onp.float32)
+    bn = rng.rand(3, 4).astype(onp.float32)
+    ref = y.bind(args={"a": mx.nd.array(an), "b": mx.nd.array(bn)}) \
+        .forward()[0].asnumpy()
+
+    path = str(tmp_path / "ew.onnx")
+    mx_onnx.export_model(y, {}, [(3, 4), (3, 4)], onnx_file_path=path)
+    sym2, args2, _ = mx_onnx.import_model(path)
+    got = sym2.bind(args={**args2, "a": mx.nd.array(an),
+                          "b": mx.nd.array(bn)}).forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_metadata(tmp_path):
+    path = str(tmp_path / "mlp.onnx")
+    mx_onnx.export_model(_mlp_sym(), _mlp_params(onp.random.RandomState(0)),
+                         [(2, 8)], onnx_file_path=path)
+    meta = mx_onnx.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (2, 8))]
+    assert [n for n, _ in meta["output_tensor_data"]] == ["prob"]
+
+
+def test_import_to_gluon(tmp_path):
+    rng = onp.random.RandomState(3)
+    params = _mlp_params(rng)
+    path = str(tmp_path / "mlp.onnx")
+    mx_onnx.export_model(_mlp_sym(), params, [(2, 8)], onnx_file_path=path)
+    net = mx_onnx.import_to_gluon(path)
+    x = rng.randn(5, 8).astype(onp.float32)
+    got = net(mx.nd.array(x)).asnumpy()
+    ref = _mlp_sym().bind(args={**params, "data": mx.nd.array(x)}) \
+        .forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_unsupported_op_errors(tmp_path):
+    x = mx.sym.var("data")
+    y = mx.sym._internal_apply("ROIAlign", [x, x],
+                               pooled_size=(2, 2), spatial_scale=1.0) \
+        if hasattr(mx.sym, "_internal_apply") else None
+    if y is None:
+        from mxnet_tpu.symbol.symbol import _apply
+        y = _apply("ROIAlign", [x, x], pooled_size=(2, 2),
+                   spatial_scale=1.0)
+    with pytest.raises(MXNetError, match="no translation"):
+        mx_onnx.export_model(y, {}, [(1, 3, 4, 4), (1, 5)],
+                             onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_wire_format_is_spec_compliant(tmp_path):
+    """The emitted bytes must follow the public ONNX field numbering —
+    checked by decoding the raw protobuf wire format by hand (no
+    dependence on our own generated schema)."""
+    path = str(tmp_path / "mlp.onnx")
+    mx_onnx.export_model(_mlp_sym(), _mlp_params(onp.random.RandomState(0)),
+                         [(2, 8)], onnx_file_path=path)
+    blob = open(path, "rb").read()
+
+    def fields(buf):
+        """Top-level (field_no, wire_type, payload) triples."""
+        out, i = [], 0
+        while i < len(buf):
+            tag, n = 0, 0
+            while True:
+                byte = buf[i + n]
+                tag |= (byte & 0x7F) << (7 * n)
+                n += 1
+                if not byte & 0x80:
+                    break
+            i += n
+            fno, wt = tag >> 3, tag & 7
+            if wt == 0:                      # varint
+                v, n = 0, 0
+                while True:
+                    byte = buf[i + n]
+                    v |= (byte & 0x7F) << (7 * n)
+                    n += 1
+                    if not byte & 0x80:
+                        break
+                i += n
+                out.append((fno, wt, v))
+            elif wt == 2:                    # length-delimited
+                ln, n = 0, 0
+                while True:
+                    byte = buf[i + n]
+                    ln |= (byte & 0x7F) << (7 * n)
+                    n += 1
+                    if not byte & 0x80:
+                        break
+                i += n
+                out.append((fno, wt, buf[i:i + ln]))
+                i += ln
+            elif wt == 5:
+                out.append((fno, wt, buf[i:i + 4])); i += 4
+            elif wt == 1:
+                out.append((fno, wt, buf[i:i + 8])); i += 8
+            else:
+                raise AssertionError(f"wire type {wt}")
+        return out
+
+    top = fields(blob)
+    by_no = {f: (w, p) for f, w, p in top}
+    assert by_no[1] == (0, 8)                      # ir_version = 8
+    assert by_no[2][1] == b"mxnet_tpu"             # producer_name
+    assert 7 in by_no and by_no[7][0] == 2         # graph submessage
+    graph = fields(by_no[7][1])
+    node_ops = [dict((f, p) for f, w, p in fields(p))[4]
+                for f, w, p in graph if f == 1]    # NodeProto.op_type = 4
+    assert b"Gemm" in node_ops and b"Softmax" in node_ops
+    init_names = [dict((f, p) for f, w, p in fields(p)).get(8)
+                  for f, w, p in graph if f == 5]  # TensorProto.name = 8
+    assert b"fc1_weight" in init_names

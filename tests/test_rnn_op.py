@@ -161,3 +161,32 @@ def test_rnn_bucketing_variable_lengths():
         onp.testing.assert_allclose(final[id(s)],
                                     outs[1].asnumpy()[0, 0],
                                     rtol=1e-5, atol=1e-5)
+
+
+def test_rnn_dropout_key_deterministic():
+    """With an explicit dropout_key the op is a pure function: same key →
+    same mask (forward/backward consistency); different key → different
+    output (ops/rnn.py dropout_key input)."""
+    import jax
+    rng = onp.random.RandomState(5)
+    T, N, I, H, L = 4, 2, 3, 5, 2
+    x = rng.randn(T, N, I).astype(onp.float32)
+    sizes = rnn_param_size("rnn_tanh", I, H, L, 1)
+    flat = rng.randn(sizes).astype(onp.float32) * 0.1
+    h0 = onp.zeros((L, N, H), onp.float32)
+    k1 = jax.random.PRNGKey(0)
+    k2 = jax.random.PRNGKey(1)
+    outs_a = invoke("RNN", [NDArray(x), NDArray(flat), NDArray(h0),
+                            NDArray(k1)], state_size=H, num_layers=L,
+                    mode="rnn_tanh", p=0.5)
+    outs_b = invoke("RNN", [NDArray(x), NDArray(flat), NDArray(h0),
+                            NDArray(k1)], state_size=H, num_layers=L,
+                    mode="rnn_tanh", p=0.5)
+    outs_c = invoke("RNN", [NDArray(x), NDArray(flat), NDArray(h0),
+                            NDArray(k2)], state_size=H, num_layers=L,
+                    mode="rnn_tanh", p=0.5)
+    a = (outs_a[0] if isinstance(outs_a, list) else outs_a).asnumpy()
+    b = (outs_b[0] if isinstance(outs_b, list) else outs_b).asnumpy()
+    c = (outs_c[0] if isinstance(outs_c, list) else outs_c).asnumpy()
+    onp.testing.assert_allclose(a, b)
+    assert abs(a - c).max() > 1e-6
