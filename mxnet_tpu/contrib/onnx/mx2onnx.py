@@ -366,6 +366,17 @@ def export_model(sym, params: Dict, input_shape: Sequence,
     ctx = _Ctx(graph, dtype)
 
     nodes = _topo_nodes([o[0] for o in sym._outputs])
+    # fix_gamma pre-pass: a BatchNorm with fix_gamma (mxnet default True)
+    # computes with gamma := 1, but ONNX BN always applies the scale
+    # input — export ones for those gammas so runtimes match (parity:
+    # mx2onnx _op_translations convert_batchnorm)
+    ones_vars = set()
+    for node in nodes:
+        if node.op_name in ("BatchNorm", "batch_norm") and \
+                node.params.get("fix_gamma", True) and len(node.inputs) > 1:
+            src, _ = node.inputs[1]
+            if src.is_var:
+                ones_vars.add(src.name)
     input_shapes = list(input_shape)
     n_data = 0
     for node in nodes:
@@ -374,6 +385,8 @@ def export_model(sym, params: Dict, input_shape: Sequence,
                 arr = params[node.name]
                 arr = arr.asnumpy() if isinstance(arr, NDArray) else \
                     onp.asarray(arr)
+                if node.name in ones_vars:
+                    arr = onp.ones_like(arr)
                 ctx.add_initializer(node.name, arr)
             else:
                 if n_data >= len(input_shapes):
@@ -408,6 +421,10 @@ def export_model(sym, params: Dict, input_shape: Sequence,
             print(f"[onnx-export] {node.op_name} {node.name}")
 
     for out_node, idx in sym._outputs:
+        if idx != 0:
+            raise MXNetError(
+                "onnx export: graph output taps a non-primary output of "
+                f"a multi-output op ({out_node.name}[{idx}]) — unsupported")
         vo = graph.output.add()
         vo.name = out_node.name
         vo.type.tensor_type.elem_type = _DTYPE2ONNX[dtype]

@@ -85,6 +85,13 @@ class _Importer:
     def __init__(self, model: P.ModelProto):
         from ...symbol.symbol import Variable
         self.model = model
+        self._transposed: set = set()
+        for ops in model.opset_import:
+            if ops.domain in ("", "ai.onnx") and ops.version > 12:
+                raise MXNetError(
+                    f"onnx import: opset {ops.version} unsupported (max "
+                    f"12 — newer opsets move attributes like ReduceSum "
+                    f"axes into inputs); re-export with opset_version=12")
         g = model.graph
         self.consts: Dict[str, onp.ndarray] = {
             t.name: _tensor_to_numpy(t) for t in g.initializer}
@@ -193,8 +200,10 @@ class _Importer:
         if w is None:
             raise MXNetError("onnx import: Gemm weight must be an "
                              "initializer")
-        if not at.get("transB", 0):
-            # store transposed so FullyConnected's (out,in) layout holds
+        if not at.get("transB", 0) and ins[1] not in self._transposed:
+            # store transposed so FullyConnected's (out,in) layout holds;
+            # once only — the initializer may be shared by several Gemms
+            self._transposed.add(ins[1])
             self.consts[ins[1]] = onp.ascontiguousarray(w.T)
             w = self.consts[ins[1]]
         params = dict(num_hidden=int(w.shape[0]), flatten=False)
@@ -205,12 +214,13 @@ class _Importer:
 
     def _cv_BatchNormalization(self, node, at, ins, name):
         # running mean/var are aux params (parity: onnx2mx import_onnx
-        # aux split)
+        # aux split).  ONNX BN always applies the scale input, so
+        # fix_gamma must be off (mxnet's default True would zero it out).
         self._aux_names.update(ins[3:5])
         return self._apply(
             "BatchNorm", [self._sym(i) for i in ins], name,
             eps=float(at.get("epsilon", 1e-5)),
-            momentum=float(at.get("momentum", 0.9)))
+            momentum=float(at.get("momentum", 0.9)), fix_gamma=False)
 
     def _cv_Reshape(self, node, at, ins, name):
         shape = self.consts.get(ins[1])

@@ -471,13 +471,17 @@ class SymbolBlock(HybridBlock):
         self._symbol = outputs
         self._input_names = [i if isinstance(i, str) else i.name
                              for i in inputs]
-        self._arg_names = outputs.list_arguments()
+        # aux states (BatchNorm running stats) become grad_req="null"
+        # Parameters, like the reference's SymbolBlock aux handling
+        aux_names = outputs.list_auxiliary_states()
+        self._arg_names = outputs.list_arguments() + aux_names
         self._fn = outputs._lower(self._arg_names)
         params = params or {}
         for name in self._arg_names:
             if name in self._input_names:
                 continue
-            p = Parameter(name=name, allow_deferred_init=True)
+            p = Parameter(name=name, allow_deferred_init=True,
+                          grad_req="null" if name in aux_names else "write")
             if name in params:
                 v = params[name]
                 p.set_data(v if isinstance(v, NDArray) else NDArray(v))

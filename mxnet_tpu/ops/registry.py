@@ -36,7 +36,9 @@ class Operator:
         self.aliases = tuple(aliases)
         self.doc = fn.__doc__
         # impure: fn draws host-side state (e.g. a PRNG key) per call, so
-        # caching/jitting it would freeze that state into the executable
+        # caching/jitting it would freeze that state into the executable.
+        # May be a callable(params) → bool when purity depends on params
+        # (e.g. RNN is pure when inter-layer dropout is off).
         self.impure = impure
         self._partials: Dict[Any, Callable] = {}   # params-key → partial
         self._jits: Dict[Any, "_JitEntry"] = {}    # params-key → jit entry
@@ -255,7 +257,8 @@ def bound_fn(op: Operator, params: dict):
     wrappers).  The partial is cached per (op, params, env-numerics) so
     its identity is stable; unhashable params — or an op hammered with
     loop-varying params — fall back to an uncached partial."""
-    if op.impure:   # per-call host state (PRNG): never cache or jit
+    imp = op.impure(params) if callable(op.impure) else op.impure
+    if imp:         # per-call host state (PRNG): never cache or jit
         return (functools.partial(op.fn, **params) if params
                 else op.fn), None
     pkey = _params_key(params) if params else ()

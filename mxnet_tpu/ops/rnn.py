@@ -132,7 +132,8 @@ def _scan_dir(mode, x, h0, c0, W, R, bW, bR, lengths, reverse):
     return out, h_T, c_T
 
 
-@register("RNN", aliases=["rnn"], multi_out=True, impure=True)
+@register("RNN", aliases=["rnn"], multi_out=True,
+          impure=lambda params: params.get("p", 0.0) > 0.0)
 def rnn(data, parameters, state, *extra, state_size, num_layers,
         mode="lstm", bidirectional=False, p=0.0, state_outputs=False,
         use_sequence_length=False, lstm_state_clip_min=None,
@@ -145,8 +146,9 @@ def rnn(data, parameters, state, *extra, state_size, num_layers,
     inter-layer dropout ``p>0`` — an explicit PRNG ``dropout_key``.
     Passing the key makes the op a pure function (forward and backward
     see the same mask; jit-safe); without it a fresh global key is drawn
-    per call, which is why the op is registered ``impure`` (never
-    cached/jitted by the eager funnel).
+    per call, which is why the op registers as ``impure`` whenever
+    ``p>0`` (the eager funnel then never caches/jits it; with ``p=0``
+    it caches normally).
     """
     if projection_size is not None:
         raise NotImplementedError("projection_size not supported")

@@ -20,19 +20,30 @@ __all__ = ["Executor"]
 
 
 class Executor:
-    def __init__(self, symbol, ctx, args, args_grad, grad_req="write"):
+    def __init__(self, symbol, ctx, args, args_grad, grad_req="write",
+                 aux_states=None):
         from ..ndarray import NDArray
 
         self._symbol = symbol
         self._ctx = ctx
         self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
         if isinstance(args, (list, tuple)):
             args = dict(zip(self._arg_names, args))
-        if args is None or set(self._arg_names) - set(args):
-            missing = set(self._arg_names) - set(args or {})
+        args = dict(args or {})
+        if isinstance(aux_states, (list, tuple)):
+            aux_states = dict(zip(self._aux_names, aux_states))
+        # aux values may arrive via either dict (the reference accepts
+        # both at bind); split them by name
+        aux = {n: args.pop(n) for n in self._aux_names if n in args}
+        aux.update(aux_states or {})
+        missing = (set(self._arg_names) - set(args)) | \
+            (set(self._aux_names) - set(aux))
+        if missing:
             raise MXNetError(f"bind: missing arguments {sorted(missing)}")
         self._args: Dict[str, NDArray] = {n: args[n]
                                           for n in self._arg_names}
+        self._aux: Dict[str, NDArray] = {n: aux[n] for n in self._aux_names}
         if isinstance(args_grad, (list, tuple)):
             args_grad = dict(zip(self._arg_names, args_grad))
         self._args_grad = args_grad
@@ -42,7 +53,8 @@ class Executor:
             grad_req = dict(zip(self._arg_names, grad_req))
         self._grad_req = grad_req
 
-        fn = symbol._lower(self._arg_names)
+        self._all_names = self._arg_names + self._aux_names
+        fn = symbol._lower(self._all_names)
         self._fwd = jax.jit(lambda arrays: fn(arrays))
         self._vjp = None
         self.outputs: List[NDArray] = []
@@ -66,23 +78,41 @@ class Executor:
         return [self._args_grad.get(n) for n in self._arg_names]
 
     @property
+    def aux_dict(self):
+        return dict(self._aux)
+
+    @property
     def aux_arrays(self):
-        return []
+        return [self._aux[n] for n in self._aux_names]
 
     def copy_params_from(self, arg_params, aux_params=None):
         for n, v in arg_params.items():
             if n in self._args:
                 self._args[n]._rebind(v._data)
+        for n, v in (aux_params or {}).items():
+            if n in self._aux:
+                self._aux[n]._rebind(v._data)
 
     def forward(self, is_train: bool = False, **kwargs):
         from ..ndarray import NDArray
         for n, v in kwargs.items():
-            if n not in self._args:
+            if n in self._aux:
+                self._aux[n] = v if isinstance(v, NDArray) else NDArray(v)
+            elif n in self._args:
+                self._args[n] = v if isinstance(v, NDArray) else NDArray(v)
+            else:
                 raise MXNetError(f"forward: unknown argument {n!r}")
-            self._args[n] = v if isinstance(v, NDArray) else NDArray(v)
-        arrays = [self._args[n]._data for n in self._arg_names]
+        arrays = [self._args[n]._data for n in self._arg_names] + \
+            [self._aux[n]._data for n in self._aux_names]
         if is_train:
-            outs, vjp_fn = jax.vjp(lambda a: self._fwd(a), arrays)
+            # vjp over the argument slice only: aux states are mutable,
+            # non-differentiable inputs (parity: FMutateInputs take no
+            # gradient)
+            n_args = len(self._arg_names)
+            aux_arrays = arrays[n_args:]
+            outs, vjp_fn = jax.vjp(
+                lambda a: self._fwd(list(a) + aux_arrays),
+                arrays[:n_args])
             self._vjp = vjp_fn
         else:
             outs = self._fwd(arrays)

@@ -98,7 +98,8 @@ class Symbol:
 
     # -- graph introspection (parity: list_arguments/list_outputs) ---------
     def list_arguments(self) -> List[str]:
-        return [n.name for n in self._var_nodes()]
+        aux = set(self.list_auxiliary_states())
+        return [n.name for n in self._var_nodes() if n.name not in aux]
 
     def list_outputs(self) -> List[str]:
         out = []
@@ -109,10 +110,22 @@ class Symbol:
         return out
 
     def list_inputs(self) -> List[str]:
-        return self.list_arguments()
+        return [n.name for n in self._var_nodes()]
 
     def list_auxiliary_states(self) -> List[str]:
-        return []  # aux states ride the Parameter mechanism in gluon
+        """Variables consumed at an op's mutable-input positions
+        (parity: FMutateInputs — e.g. BatchNorm's moving_mean/var,
+        batch_norm.cc).  They take no gradient and are updated by the op
+        itself."""
+        aux, seen = [], set()
+        for node in _topo_nodes([o[0] for o in self._outputs]):
+            for pos in _AUX_INPUT_POS.get(node.op_name, ()):
+                if pos < len(node.inputs):
+                    src, _ = node.inputs[pos]
+                    if src.is_var and src.name not in seen:
+                        seen.add(src.name)
+                        aux.append(src.name)
+        return aux
 
     def _var_nodes(self) -> List[_Node]:
         return [n for n in _topo_nodes([o[0] for o in self._outputs])
@@ -225,20 +238,22 @@ class Symbol:
         the analogue of each reference op's FInferShape filling unknown
         weight dims)."""
         args = self.list_arguments()
-        known = {n: tuple(kwargs[n]) for n in args if n in kwargs}
-        if len(known) < len(args):
+        auxs = self.list_auxiliary_states()
+        names = args + auxs
+        known = {n: tuple(kwargs[n]) for n in names if n in kwargs}
+        if len(known) < len(names):
             known = self._infer_missing_arg_shapes(known)
-        structs = []
-        for name in args:
+        structs = {}
+        for name in names:
             if name not in known:
                 raise MXNetError(f"infer_shape: cannot infer shape for "
                                  f"{name!r}; pass it explicitly")
-            structs.append(jax.ShapeDtypeStruct(known[name], jnp.float32))
-        fn = self._lower(args)
-        outs = jax.eval_shape(lambda a: fn(a), structs)
-        arg_shapes = [tuple(s.shape) for s in structs]
+            structs[name] = jax.ShapeDtypeStruct(known[name], jnp.float32)
+        fn = self._lower(names)
+        outs = jax.eval_shape(lambda a: fn(a), [structs[n] for n in names])
         out_shapes = [tuple(o.shape) for o in outs]
-        return arg_shapes, out_shapes, []
+        return ([tuple(structs[n].shape) for n in args], out_shapes,
+                [tuple(structs[n].shape) for n in auxs])
 
     def infer_shape_partial(self, **kwargs):
         """Best-effort variant returning None for arguments it cannot
@@ -247,9 +262,11 @@ class Symbol:
             return self.infer_shape(**kwargs)
         except Exception:   # jax.eval_shape raises raw TypeError/ValueError
             args = self.list_arguments()
+            auxs = self.list_auxiliary_states()
             known = self._infer_missing_arg_shapes(
-                {n: tuple(kwargs[n]) for n in args if n in kwargs})
-            return ([known.get(n) for n in args], None, [])
+                {n: tuple(kwargs[n]) for n in args + auxs if n in kwargs})
+            return ([known.get(n) for n in args], None,
+                    [known.get(n) for n in auxs])
 
     def _infer_missing_arg_shapes(self, known):
         """Forward pass deriving parameter shapes from data shapes — the
@@ -298,7 +315,7 @@ class Symbol:
 
     def eval(self, ctx=None, **kwargs):
         from ..ndarray import NDArray
-        args = self.list_arguments()
+        args = self.list_arguments() + self.list_auxiliary_states()
         fn = self._lower(args)
         arrays = []
         for name in args:
@@ -323,18 +340,22 @@ class Symbol:
                 sym = self.optimize_for(backend)
             except MXNetError:
                 pass  # unknown backend: bind unpartitioned, like the ref
-        return Executor(sym, ctx, args, args_grad, grad_req)
+        return Executor(sym, ctx, args, args_grad, grad_req,
+                        aux_states=aux_states)
 
     def simple_bind(self, ctx=None, grad_req="write", **shapes):
         from ..ndarray import NDArray
         arg_names = self.list_arguments()
-        arg_shapes, _, _ = self.infer_shape(**shapes)
+        aux_names = self.list_auxiliary_states()
+        arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
         args = {n: NDArray(onp.zeros(s, "float32"))
                 for n, s in zip(arg_names, arg_shapes)}
         grads = {n: NDArray(onp.zeros(s, "float32"))
                  for n, s in zip(arg_names, arg_shapes)} \
             if grad_req != "null" else None
-        return self.bind(ctx, args, grads, grad_req)
+        aux = {n: NDArray(onp.zeros(s, "float32"))
+               for n, s in zip(aux_names, aux_shapes)}
+        return self.bind(ctx, args, grads, grad_req, aux_states=aux)
 
     def optimize_for(self, backend: str, **options) -> "Symbol":
         """Partition the graph with a registered subgraph backend
@@ -460,26 +481,58 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(outs)
 
 
+_KNOWN_FORMATS = ("mxnet_tpu-symbol-v1",)
+
+
+def _parse_legacy_attr(v):
+    """Reference symbol json stores every attr as a string ("(3, 3)",
+    "True", "2") — parse back to Python values (parity:
+    src/nnvm/legacy_json_util.cc attribute upgrade)."""
+    if not isinstance(v, str):
+        return v
+    import ast
+    try:
+        return ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
 def load_json(json_str: str) -> Symbol:
     payload = json.loads(json_str)
+    fmt = (payload.get("attrs") or {}).get("format")
+    legacy = fmt is None        # reference-produced json has no format tag
+    if not legacy and fmt not in _KNOWN_FORMATS:
+        raise MXNetError(
+            f"unknown symbol json format {fmt!r}; this build reads "
+            f"{_KNOWN_FORMATS} and legacy (reference) symbol json")
     nodes: List[_Node] = []
     for spec in payload["nodes"]:
         if spec["op"] == "null":
             node = _Node(None, spec["name"])
         else:
-            params = _from_json_attrs(spec.get("attrs", {}))
+            # older reference json stores attrs under "param"/"attr"
+            raw = spec.get("attrs", spec.get("attr", spec.get("param", {})))
+            if legacy:
+                params = {k: _parse_legacy_attr(v) for k, v in raw.items()}
+            else:
+                params = _from_json_attrs(raw)
             if spec["op"].startswith("_scalar_wrap:"):
                 _ensure_scalar_wrap(spec["op"])
             node = _Node(spec["op"], spec["name"], params)
-        node.inputs = [(nodes[i], oi) for i, oi, _ in spec["inputs"]]
+        node.inputs = [(nodes[i], oi) for i, oi, *_ in spec["inputs"]]
         nodes.append(node)
-    heads = [(nodes[i], oi) for i, oi, _ in payload["heads"]]
+    heads = [(nodes[i], oi) for i, oi, *_ in payload["heads"]]
     return Symbol(heads)
 
 
 def load(fname: str) -> Symbol:
     with open(fname) as f:
         return load_json(f.read())
+
+
+# mutable-input positions per op (parity: FMutateInputs registrations)
+_AUX_INPUT_POS = {"BatchNorm": (3, 4), "batch_norm": (3, 4),
+                  "SyncBatchNorm": (3, 4)}
 
 
 def _param_shape_rule(op_name, pos, data_shape, params):
@@ -536,4 +589,11 @@ def _param_shape_rule(op_name, pos, data_shape, params):
     elif op_name == "Embedding":
         if pos == 1:
             return (p.get("input_dim"), p.get("output_dim"))
+    elif op_name in ("SoftmaxOutput", "softmax_output",
+                     "LinearRegressionOutput", "LogisticRegressionOutput",
+                     "MAERegressionOutput"):
+        if pos == 1:    # label: batch-shaped (class dim dropped for
+            # SoftmaxOutput, parity: softmax_output.cc FInferShape)
+            return (data_shape[0],) if op_name.startswith("Softmax") \
+                else tuple(data_shape)
     return None

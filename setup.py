@@ -29,6 +29,6 @@ setup(
     package_data={"mxnet_tpu": ["lib/*.so"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy", "ml_dtypes"],
-    extras_require={"onnx": ["protobuf>=3.19"]},
+    extras_require={"onnx": ["protobuf>=3.20"]},
     cmdclass={"build_py": BuildWithNative},
 )

@@ -209,3 +209,45 @@ def test_wire_format_is_spec_compliant(tmp_path):
     init_names = [dict((f, p) for f, w, p in fields(p)).get(8)
                   for f, w, p in graph if f == 5]  # TensorProto.name = 8
     assert b"fc1_weight" in init_names
+
+
+def test_batchnorm_gamma_semantics(tmp_path):
+    """fix_gamma=False round-trips the real gamma; fix_gamma=True (mxnet
+    default) exports ones so ONNX runtimes (which always apply scale)
+    match mxnet numerics."""
+    rng = onp.random.RandomState(4)
+    for fix_gamma in (False, True):
+        x = mx.sym.var("data")
+        g, be = mx.sym.var("g"), mx.sym.var("b")
+        mm, mv = mx.sym.var("m"), mx.sym.var("v")
+        y = mx.sym.BatchNorm(x, g, be, mm, mv, fix_gamma=fix_gamma,
+                             use_global_stats=True, name="bn")
+        params = {"g": mx.nd.array(onp.full(3, 2.0, onp.float32)),
+                  "b": mx.nd.array(onp.zeros(3, onp.float32)),
+                  "m": mx.nd.array(onp.zeros(3, onp.float32)),
+                  "v": mx.nd.array(onp.ones(3, onp.float32))}
+        xin = rng.randn(2, 3, 4, 4).astype(onp.float32)
+        ref = y.bind(args={**params, "data": mx.nd.array(xin)}) \
+            .forward()[0].asnumpy()
+        path = str(tmp_path / f"bn{fix_gamma}.onnx")
+        mx_onnx.export_model(y, params, [(2, 3, 4, 4)],
+                             onnx_file_path=path)
+        sym2, args2, aux2 = mx_onnx.import_model(path)
+        got = sym2.bind(args={**args2, **aux2, "data": mx.nd.array(xin)}) \
+            .forward()[0].asnumpy()
+        onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+        # the exported gamma itself must reflect the semantics
+        gamma = args2["g"].asnumpy()
+        expect = onp.ones(3) if fix_gamma else onp.full(3, 2.0)
+        onp.testing.assert_allclose(gamma, expect)
+
+
+def test_opset_13_rejected(tmp_path):
+    from mxnet_tpu.contrib.onnx import onnx_pb2 as P
+    m = P.ModelProto(); m.ir_version = 8
+    ops = m.opset_import.add(); ops.version = 13
+    m.graph.name = "g"
+    path = str(tmp_path / "new.onnx")
+    open(path, "wb").write(m.SerializeToString())
+    with pytest.raises(MXNetError, match="opset 13 unsupported"):
+        mx_onnx.import_model(path)

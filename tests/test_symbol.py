@@ -1,4 +1,6 @@
 """Symbol API tests (parity model: tests/python/unittest/test_symbol.py)."""
+import json
+
 import numpy as onp
 import pytest
 
@@ -205,3 +207,92 @@ def test_sym_partial_shape_inference():
     # partial variant never raises
     shapes, _, _ = net.infer_shape_partial(data=(2, 5))
     assert shapes[0] == (2, 5)
+
+
+def test_aux_states_split():
+    """BatchNorm running stats are auxiliary states: excluded from
+    list_arguments, no gradient, visible via aux_arrays (parity:
+    FMutateInputs + executor aux handling)."""
+    x = mx.sym.var("data")
+    g, b = mx.sym.var("gamma"), mx.sym.var("beta")
+    mm, mv = mx.sym.var("mean"), mx.sym.var("var")
+    y = mx.sym.BatchNorm(x, g, b, mm, mv, use_global_stats=True,
+                         fix_gamma=False, name="bn")
+    assert y.list_auxiliary_states() == ["mean", "var"]
+    assert "mean" not in y.list_arguments()
+    arg_shapes, out_shapes, aux_shapes = y.infer_shape(data=(2, 4, 8, 8))
+    assert aux_shapes == [(4,), (4,)]
+    assert out_shapes[0] == (2, 4, 8, 8)
+
+    args = {n: mx.nd.array(onp.random.rand(*s).astype(onp.float32) + 0.5)
+            for n, s in zip(y.list_arguments(), arg_shapes)}
+    aux = {n: mx.nd.array(onp.random.rand(*s).astype(onp.float32) + 0.5)
+           for n, s in zip(y.list_auxiliary_states(), aux_shapes)}
+    grads = {n: mx.nd.array(onp.zeros(s, onp.float32))
+             for n, s in zip(y.list_arguments(), arg_shapes)}
+    ex = y.bind(args=args, args_grad=grads, aux_states=aux)
+    assert len(ex.aux_arrays) == 2
+    out = ex.forward(is_train=True)[0]
+    ex.backward(mx.nd.array(onp.ones(out.shape, onp.float32)))
+    # gradient flowed to gamma but aux took none (no aux in grad dict)
+    assert abs(grads["gamma"].asnumpy()).sum() > 0
+    assert set(ex.grad_dict) == set(args)
+
+    # simple_bind allocates aux automatically
+    ex2 = y.simple_bind(data=(2, 4, 8, 8))
+    assert len(ex2.aux_arrays) == 2
+
+
+def test_load_legacy_reference_json():
+    """Reference-produced symbol json (stringified attrs, no format tag)
+    loads and runs (parity: legacy_json_util.cc)."""
+    legacy = {
+        "nodes": [
+            {"op": "null", "name": "data", "inputs": []},
+            {"op": "null", "name": "w", "inputs": []},
+            {"op": "null", "name": "b", "inputs": []},
+            {"op": "FullyConnected", "name": "fc",
+             "attrs": {"num_hidden": "4", "flatten": "True"},
+             "inputs": [[0, 0, 0], [1, 0, 0], [2, 0, 0]]},
+            {"op": "Activation", "name": "act",
+             "attr": {"act_type": "relu"},     # older key spelling
+             "inputs": [[3, 0, 0]]},
+        ],
+        "arg_nodes": [0, 1, 2],
+        "heads": [[4, 0, 0]],
+        "attrs": {"mxnet_version": ["int", 10700]},
+    }
+    sym = mx.sym.load_json(json.dumps(legacy))
+    assert sym.list_arguments() == ["data", "w", "b"]
+    rng = onp.random.RandomState(0)
+    out = sym.eval(data=mx.nd.array(rng.randn(2, 3).astype(onp.float32)),
+                   w=mx.nd.array(rng.randn(4, 3).astype(onp.float32)),
+                   b=mx.nd.array(rng.randn(4).astype(onp.float32)))[0]
+    assert out.shape == (2, 4)
+    assert (out.asnumpy() >= 0).all()
+
+
+def test_load_json_unknown_format():
+    bad = {"nodes": [], "arg_nodes": [], "heads": [],
+           "attrs": {"format": "mxnet_tpu-symbol-v99"}}
+    with pytest.raises(MXNetError, match="unknown symbol json format"):
+        mx.sym.load_json(json.dumps(bad))
+
+
+REF_JSON = ("/root/reference/tests/python/mkl/data/"
+            "test_mkldnn_test_mkldnn_model_model1.json")
+
+
+@pytest.mark.skipif(not __import__("os").path.exists(REF_JSON),
+                    reason="reference checkout not present")
+def test_load_real_reference_model_json():
+    """An actual reference-produced model json (VGG-style convnet,
+    stringified attrs) loads, infers shapes, binds and runs."""
+    sym = mx.sym.load(REF_JSON)
+    assert len(sym.list_arguments()) > 30
+    _, out_shapes, _ = sym.infer_shape(data=(1, 3, 32, 32))
+    assert out_shapes == [(1, 1000)]
+    ex = sym.simple_bind(data=(1, 3, 32, 32), grad_req="null")
+    out = ex.forward(data=mx.nd.array(
+        onp.random.rand(1, 3, 32, 32).astype(onp.float32)))[0]
+    onp.testing.assert_allclose(out.asnumpy().sum(), 1.0, rtol=1e-5)
