@@ -47,3 +47,8 @@ from . import subgraph  # noqa: E402
 from .visualization import print_summary, plot_network  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402
+
+# large-tensor (int64) switch at import (parity: the reference's
+# MXNET_USE_INT64_TENSOR_SIZE build flag; here a runtime env toggle)
+if base.getenv_bool("MXNET_INT64_TENSOR_SIZE"):
+    util.set_large_tensor(True)

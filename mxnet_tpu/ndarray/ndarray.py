@@ -39,10 +39,14 @@ def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
     was_numpy = isinstance(data, onp.ndarray)
     np_arr = onp.asarray(data, dtype=np_dtype(dtype) if dtype is not None else None)
     if dtype is None:
-        if np_arr.dtype == onp.float64:
-            np_arr = np_arr.astype(onp.float32)
-        elif not was_numpy:
+        if not was_numpy:
             # python lists/scalars default to float32 (MXNet default dtype)
+            np_arr = np_arr.astype(onp.float32)
+        elif np_arr.dtype == onp.float64 and not jax.config.jax_enable_x64:
+            # without the x64/large-tensor switch jax would truncate f64
+            # anyway (with a warning); do it explicitly.  With the switch
+            # on (util.set_large_tensor) f64 is preserved, like the
+            # reference keeps numpy float64 input as float64.
             np_arr = np_arr.astype(onp.float32)
     dev = (ctx or current_context()).jax_device
     return jax.device_put(jnp.asarray(np_arr), dev)

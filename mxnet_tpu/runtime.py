@@ -28,7 +28,9 @@ class Features(dict):
             "CPU": True,
             "BF16": True,
             "F16C": True,
-            "INT64_TENSOR_SIZE": True,
+            # reflects the live switch (util.set_large_tensor /
+            # MXNET_INT64_TENSOR_SIZE), like the reference's build flag
+            "INT64_TENSOR_SIZE": bool(jax.config.jax_enable_x64),
             "JIT": True,          # CachedOp == XLA jit
             "PALLAS": _has_pallas(),
             "DIST_KVSTORE": True,  # jax.distributed backend

@@ -12,7 +12,8 @@ import functools
 import threading
 
 __all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "np_array",
-           "np_shape", "use_np", "set_np_shape", "getenv", "setenv"]
+           "np_shape", "use_np", "set_np_shape", "getenv", "setenv",
+           "set_large_tensor", "is_large_tensor_enabled"]
 
 _state = threading.local()
 
@@ -95,3 +96,23 @@ def setenv(name, value):
         os.environ.pop(name, None)
     else:
         os.environ[name] = value
+
+
+# -- large-tensor (int64) support ------------------------------------------
+# Parity: the reference's MXNET_USE_INT64_TENSOR_SIZE build flag
+# (libinfo.cc INT64_TENSOR_SIZE; tests/nightly/test_large_array.py).
+# The TPU build switches at runtime: jax's x64 mode widens index/shape
+# arithmetic and preserves int64/float64 dtypes end-to-end.
+
+def set_large_tensor(active: bool) -> bool:
+    """Enable/disable 64-bit tensor support; returns the previous
+    setting.  Also honored at import via MXNET_INT64_TENSOR_SIZE=1."""
+    import jax
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", bool(active))
+    return prev
+
+
+def is_large_tensor_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)

@@ -1,0 +1,89 @@
+"""int64 large-tensor support.
+
+Parity: tests/nightly/test_large_array.py (the reference's
+MXNET_USE_INT64_TENSOR_SIZE build).  Real >2^31-element arrays don't fit
+CI, so these tests assert the *mechanism*: with the switch on, int64
+dtypes and >int32-range values survive end-to-end (creation, arithmetic,
+indexing, reduction, argmax); with it off, jax's default int32 world is
+unchanged.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import util
+
+
+@pytest.fixture()
+def large_tensor():
+    prev = util.set_large_tensor(True)
+    yield
+    util.set_large_tensor(prev)
+
+
+BIG = 2 ** 40 + 7      # far outside int32
+
+
+def test_switch_reflected_in_runtime(large_tensor):
+    assert util.is_large_tensor_enabled()
+    feats = mx.runtime.Features()
+    assert feats.is_enabled("INT64_TENSOR_SIZE")
+
+
+def test_int64_values_survive(large_tensor):
+    x = mx.nd.array(onp.array([BIG, BIG + 1], onp.int64))
+    assert str(x.dtype) == "int64"
+    got = x.asnumpy()
+    assert got.dtype == onp.int64
+    assert got[0] == BIG and got[1] == BIG + 1
+    # arithmetic stays wide
+    y = (x + 1).asnumpy()
+    assert y[0] == BIG + 1
+
+
+def test_int64_reduction_and_index(large_tensor):
+    x = mx.nd.array(onp.full(5, 2 ** 31, onp.int64))
+    s = mx.nd.sum(x).asnumpy()
+    assert int(s) == 5 * 2 ** 31          # would wrap in int32
+    idx = mx.nd.array(onp.array([0, 3], onp.int64))
+    base = mx.nd.array(onp.arange(8, dtype=onp.int64) * BIG)
+    taken = base[idx].asnumpy()
+    assert taken[1] == 3 * BIG
+
+
+def test_float64_supported(large_tensor):
+    x = mx.nd.array(onp.array([1e-300, 1.0], onp.float64))
+    assert str(x.dtype) == "float64"
+    assert x.asnumpy()[0] == 1e-300       # would flush to 0 in f32
+
+
+def test_argmax_on_int64(large_tensor):
+    x = mx.nd.array(onp.array([1, BIG, 3], onp.int64))
+    assert int(mx.nd.argmax(x, axis=0).asnumpy()) == 1
+
+
+def test_default_mode_unchanged():
+    assert not util.is_large_tensor_enabled()
+    x = mx.nd.array(onp.array([1, 2], onp.int64))
+    # without the switch jax truncates to int32 — documented default
+    assert str(x.dtype) == "int32"
+
+
+def test_env_switch():
+    """MXNET_INT64_TENSOR_SIZE=1 enables the mode at import."""
+    import subprocess, sys, os
+    code = ("import os; os.environ['JAX_PLATFORMS']='cpu';"
+            "import sys; sys.path.insert(0, %r);"
+            "import jax; jax.config.update('jax_platforms','cpu');"
+            "import mxnet_tpu as mx;"
+            "assert mx.util.is_large_tensor_enabled();"
+            "import numpy as onp;"
+            "x = mx.nd.array(onp.array([2**40], onp.int64));"
+            "assert int(x.asnumpy()[0]) == 2**40;"
+            "print('env switch OK')") % os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, MXNET_INT64_TENSOR_SIZE="1",
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=240)
+    assert "env switch OK" in out.stdout, out.stderr[-2000:]
