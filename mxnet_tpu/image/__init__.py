@@ -1,13 +1,22 @@
 """mx.image — image loading + augmenters.
 
 Parity: python/mxnet/image/ (imread/imdecode/imresize, CreateAugmenter,
-ImageIter) over src/operator/image/.  cv2 is optional; PIL/numpy
-fallbacks keep it working in minimal environments.
+ImageIter; detection.py DetAugmenter family + ImageDetIter) over
+src/operator/image/ and src/io/image_det_aug_default.cc.  cv2 is
+optional; PIL/numpy fallbacks keep it working in minimal environments.
 """
 from .image import (imread, imdecode, imresize, resize_short, fixed_crop,
                     center_crop, random_crop, color_normalize, ImageIter,
                     CreateAugmenter, Augmenter)
+from .detection import (DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
+                        DetRandomCropAug, DetRandomPadAug,
+                        DetRandomSelectAug, CreateDetAugmenter,
+                        CreateMultiRandCropAugmenter, ImageDetIter)
 
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "ImageIter",
-           "CreateAugmenter", "Augmenter"]
+           "CreateAugmenter", "Augmenter",
+           "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+           "CreateDetAugmenter", "CreateMultiRandCropAugmenter",
+           "ImageDetIter"]

@@ -1,0 +1,464 @@
+"""Detection-task image augmenters + iterator.
+
+Parity: python/mxnet/image/detection.py (DetAugmenter family,
+CreateDetAugmenter, ImageDetIter) and the native default augmenter
+(src/io/image_det_aug_default.cc).  Host-side numpy throughout — this is
+the CPU input pipeline; tensors enter the device world per batch.
+
+Label convention (reference parity): a raw record label is
+``[header_width, obj_width, ...header..., obj0..., obj1...]`` and each
+object row is ``[class_id, xmin, ymin, xmax, ymax, ...]`` with corners
+normalized to [0, 1].
+"""
+from __future__ import annotations
+
+import random as pyrandom
+from typing import List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from .image import (Augmenter, CreateAugmenter, DataBatch, DataDesc,
+                    ImageIter, fixed_crop, imresize)
+
+__all__ = ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+           "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+           "CreateDetAugmenter", "CreateMultiRandCropAugmenter",
+           "ImageDetIter"]
+
+
+def _areas(boxes: onp.ndarray) -> onp.ndarray:
+    return onp.maximum(0, boxes[:, 3] - boxes[:, 1]) * \
+        onp.maximum(0, boxes[:, 2] - boxes[:, 0])
+
+
+class DetAugmenter:
+    """Base detection augmenter: ``aug(img, label) -> (img, label)``
+    (parity: detection.py DetAugmenter)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        return [type(self).__name__, self._kwargs]
+
+    def __call__(self, src: NDArray, label: onp.ndarray):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Lift an image-only augmenter into the detection chain (labels
+    pass through) — parity: DetBorrowAug."""
+
+    def __init__(self, augmenter: Augmenter):
+        super().__init__(augmenter=type(augmenter).__name__)
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Flip image and box x-coordinates with probability p."""
+
+    def __init__(self, p: float = 0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        if pyrandom.random() < self.p:
+            src = NDArray(onp.ascontiguousarray(src.asnumpy()[:, ::-1]))
+            label = label.copy()
+            tmp = 1.0 - label[:, 1]
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = tmp
+        return src, label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly apply one augmenter from a list (or none, with
+    skip_prob) — parity: DetRandomSelectAug."""
+
+    def __init__(self, aug_list: Sequence[DetAugmenter], skip_prob=0.0):
+        super().__init__(skip_prob=skip_prob)
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if self.aug_list and pyrandom.random() >= self.skip_prob:
+            src, label = pyrandom.choice(self.aug_list)(src, label)
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Constrained random crop: the crop must cover ≥min_object_covered
+    of some box; boxes shrunk below min_eject_coverage of their original
+    area are dropped (parity: DetRandomCropAug + the kOverlap emit mode
+    of image_det_aug_default.cc)."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 min_eject_coverage=0.3, max_attempts=50):
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(min_object_covered=min_object_covered,
+                         aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range,
+                         min_eject_coverage=min_eject_coverage,
+                         max_attempts=max_attempts)
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.min_eject_coverage = min_eject_coverage
+        self.max_attempts = max_attempts
+        self.enabled = (0 < area_range[0] <= area_range[1] and
+                        0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        crop = self._propose(label, h, w)
+        if crop is not None:
+            x, y, cw, ch, label = crop
+            src = fixed_crop(src, x, y, cw, ch, None)
+        return src, label
+
+    def _satisfies(self, label, x1, y1, x2, y2, width, height):
+        if (x2 - x1) * (y2 - y1) < 2:
+            return False
+        boxes = label[:, 1:5]
+        areas = _areas(label[:, 1:])
+        valid = areas * width * height > 2
+        if not valid.any():
+            return False
+        b = boxes[valid]
+        ix1 = onp.maximum(b[:, 0], x1 / width)
+        iy1 = onp.maximum(b[:, 1], y1 / height)
+        ix2 = onp.minimum(b[:, 2], x2 / width)
+        iy2 = onp.minimum(b[:, 3], y2 / height)
+        inter = onp.maximum(0, ix2 - ix1) * onp.maximum(0, iy2 - iy1)
+        cov = inter / areas[valid]
+        cov = cov[cov > 0]
+        return cov.size > 0 and cov.min() > self.min_object_covered
+
+    def _adjust(self, label, x, y, cw, ch, height, width):
+        out = label.copy()
+        out[:, (1, 3)] = (out[:, (1, 3)] - x / width) * (width / cw)
+        out[:, (2, 4)] = (out[:, (2, 4)] - y / height) * (height / ch)
+        out[:, 1:5] = onp.clip(out[:, 1:5], 0, 1)
+        cov = _areas(out[:, 1:]) * (cw / width) * (ch / height) / \
+            onp.maximum(_areas(label[:, 1:]), 1e-12)
+        keep = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2]) & \
+            (cov > self.min_eject_coverage)
+        if not keep.any():
+            return None
+        return out[keep]
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            lo = int(round((min_area / ratio) ** 0.5))
+            hi = min(int(round((max_area / ratio) ** 0.5)),
+                     int(width / ratio), height)
+            if lo > hi:
+                continue
+            ch = pyrandom.randint(lo, hi)
+            cw = int(round(ch * ratio))
+            if not (min_area * 0.99 <= cw * ch <= max_area * 1.01 and
+                    cw <= width and ch <= height):
+                continue
+            y = pyrandom.randint(0, max(0, height - ch))
+            x = pyrandom.randint(0, max(0, width - cw))
+            if self._satisfies(label, x, y, x + cw, y + ch, width, height):
+                new_label = self._adjust(label, x, y, cw, ch, height, width)
+                if new_label is not None:
+                    return x, y, cw, ch, new_label
+        return None
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Pad the image into a larger random canvas; boxes rescale into the
+    canvas (parity: DetRandomPadAug)."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(128, 128, 128)):
+        if not isinstance(pad_val, (tuple, list)):
+            pad_val = (pad_val,)
+        if not isinstance(aspect_ratio_range, (tuple, list)):
+            aspect_ratio_range = (aspect_ratio_range, aspect_ratio_range)
+        if not isinstance(area_range, (tuple, list)):
+            area_range = (area_range, area_range)
+        super().__init__(aspect_ratio_range=aspect_ratio_range,
+                         area_range=area_range, max_attempts=max_attempts,
+                         pad_val=pad_val)
+        self.pad_val = pad_val
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.enabled = (area_range[1] > 1.0 and
+                        0 < aspect_ratio_range[0] <= aspect_ratio_range[1])
+
+    def __call__(self, src, label):
+        height, width = src.shape[0], src.shape[1]
+        pad = self._propose(label, height, width)
+        if pad is not None:
+            x, y, pw, ph, label = pad
+            img = src.asnumpy()
+            canvas = onp.empty((ph, pw, img.shape[2]), img.dtype)
+            canvas[...] = onp.asarray(self.pad_val, img.dtype)
+            canvas[y:y + height, x:x + width] = img
+            src = NDArray(canvas)
+        return src, label
+
+    def _propose(self, label, height, width):
+        if not self.enabled or height <= 0 or width <= 0:
+            return None
+        min_area = self.area_range[0] * height * width
+        max_area = self.area_range[1] * height * width
+        for _ in range(self.max_attempts):
+            ratio = pyrandom.uniform(*self.aspect_ratio_range)
+            lo = max(int(round((min_area / ratio) ** 0.5)), height,
+                     int(round(width / ratio)))
+            hi = int(round((max_area / ratio) ** 0.5))
+            if lo > hi:
+                continue
+            ph = pyrandom.randint(lo, hi)
+            pw = int(round(ph * ratio))
+            if (ph - height) < 2 or (pw - width) < 2:
+                continue
+            y = pyrandom.randint(0, max(0, ph - height))
+            x = pyrandom.randint(0, max(0, pw - width))
+            out = label.copy()
+            out[:, (1, 3)] = (out[:, (1, 3)] * width + x) / pw
+            out[:, (2, 4)] = (out[:, (2, 4)] * height + y) / ph
+            return x, y, pw, ph, out
+        return None
+
+
+class _DetResizeAug(DetAugmenter):
+    """Force-resize to the target shape (labels are normalized, so they
+    pass through) — the kForce resize mode of image_det_aug_default.cc."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src, label):
+        return imresize(src, self.size[0], self.size[1],
+                        self.interp), label
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0.0):
+    """Several DetRandomCropAug variants behind one random selector
+    (parity: CreateMultiRandCropAugmenter)."""
+    def listify(p):
+        return p if isinstance(p, list) else [p]
+
+    cols = [listify(min_object_covered), listify(aspect_ratio_range),
+            listify(area_range), listify(min_eject_coverage),
+            listify(max_attempts)]
+    n = max(len(c) for c in cols)
+    cols = [c * n if len(c) == 1 else c for c in cols]
+    if any(len(c) != n for c in cols):
+        raise MXNetError("CreateMultiRandCropAugmenter: list parameters "
+                         "must share one length")
+    augs = [DetRandomCropAug(moc, arr, ar, mec, ma)
+            for moc, arr, ar, mec, ma in zip(*cols)]
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
+                       rand_gray=0, rand_mirror=False, mean=None, std=None,
+                       brightness=0, contrast=0, saturation=0, pca_noise=0,
+                       hue=0, inter_method=2, min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), min_eject_coverage=0.3,
+                       max_attempts=50, pad_val=(127, 127, 127)):
+    """The standard detection chain (parity: CreateDetAugmenter)."""
+    auglist: List[DetAugmenter] = []
+    if resize > 0:
+        from .image import ResizeAug
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop_area = (area_range[0], min(1.0, area_range[1]))
+        auglist.append(CreateMultiRandCropAugmenter(
+            min_object_covered, aspect_ratio_range, crop_area,
+            min_eject_coverage, max_attempts, skip_prob=1 - rand_crop))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    if rand_pad > 0:
+        pad_area = (max(1.0, area_range[0]), max(1.0, area_range[1]))
+        pad = DetRandomPadAug(aspect_ratio_range, pad_area, max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([pad], skip_prob=1 - rand_pad))
+    # force resize to the network input size
+    auglist.append(_DetResizeAug((data_shape[2], data_shape[1]),
+                                 inter_method))
+    color = CreateAugmenter(data_shape, mean=mean, std=std,
+                            brightness=brightness, contrast=contrast,
+                            saturation=saturation, hue=hue,
+                            pca_noise=pca_noise, rand_gray=rand_gray)
+    for aug in color:
+        name = type(aug).__name__
+        if name in ("CastAug", "ColorNormalizeAug"):
+            auglist.append(DetBorrowAug(aug))
+    return auglist
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: yields padded (B, max_objects, obj_width)
+    labels next to image batches (parity: ImageDetIter)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root="", shuffle=False,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="label", **kwargs):
+        # .lst parsing is det-specific (multi-column labels) — handle it
+        # here, not in the scalar-label base parser
+        super().__init__(batch_size, data_shape, path_imgrec=path_imgrec,
+                         path_imglist=None, path_root=path_root,
+                         shuffle=shuffle, aug_list=[], imglist=imglist)
+        if path_imglist:
+            import os as _os
+            with open(path_imglist) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = onp.asarray([float(x) for x in parts[1:-1]],
+                                        onp.float32)
+                    self._records.append(
+                        ("file", _os.path.join(path_root, parts[-1]),
+                         label))
+            self._order = list(range(len(self._records)))
+            self.reset()
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **kwargs)
+        self.label_name = label_name
+        self.data_name = data_name
+        self.label_shape = self._estimate_label_shape()
+
+    # -- label parsing (parity: ImageDetIter._parse_label) -----------------
+    @staticmethod
+    def _parse_label(raw) -> onp.ndarray:
+        if isinstance(raw, NDArray):
+            raw = raw.asnumpy()
+        raw = onp.asarray(raw, onp.float32).ravel()
+        if raw.size < 7:
+            raise MXNetError(f"det label too short: size {raw.size} "
+                             "(need [header_w, obj_w, ..., 1+ object])")
+        header_width = int(raw[0])
+        obj_width = int(raw[1])
+        if obj_width < 5:
+            raise MXNetError(f"det object width {obj_width} < 5")
+        if (raw.size - header_width) % obj_width != 0:
+            raise MXNetError(
+                f"det label size {raw.size} inconsistent with header "
+                f"{header_width} + objects of width {obj_width}")
+        out = raw[header_width:].reshape(-1, obj_width)
+        valid = (out[:, 3] > out[:, 1]) & (out[:, 4] > out[:, 2])
+        if not valid.any():
+            raise MXNetError("sample with no valid det label")
+        return out[valid]
+
+    def _estimate_label_shape(self):
+        max_count, width = 0, 5
+        for i in range(len(self._records)):
+            label = self._parse_label(self._read_raw_label(i))
+            max_count = max(max_count, label.shape[0])
+            width = label.shape[1]
+        return (max_count, width)
+
+    def _read_raw_label(self, i):
+        # header-only read: no image decode during the label-shape scan
+        kind, src, extra = self._records[i]
+        from ..recordio import unpack
+        if kind == "rec":
+            header, _ = unpack(src.read_idx(extra))
+            return onp.asarray(header.label)
+        if kind == "raw":
+            header, _ = unpack(src)
+            return onp.asarray(header.label)
+        return onp.asarray(extra)     # list/file entry: label held inline
+
+    def _read_one_det(self, i):
+        kind, src, extra = self._records[self._order[i]]
+        from ..recordio import unpack_img
+        if kind == "rec":
+            header, img = unpack_img(src.read_idx(extra))
+            return NDArray(img), onp.asarray(header.label)
+        if kind == "raw":
+            header, img = unpack_img(src)
+            return NDArray(img), onp.asarray(header.label)
+        if kind == "file":
+            from .image import imread
+            return imread(src), onp.asarray(extra)
+        return NDArray(src), onp.asarray(extra)
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self.label_shape)]
+
+    def next(self):
+        if self.cur >= len(self._records):
+            raise StopIteration
+        datas, labels = [], []
+        max_obj, width = self.label_shape
+        read_cur, pad = self.cur, 0
+        for _ in range(self.batch_size):
+            if read_cur >= len(self._records):
+                read_cur = 0    # pad the final batch by wraparound
+            img, raw = self._read_one_det(read_cur)
+            read_cur += 1
+            self.cur += 1
+            if self.cur > len(self._records):
+                pad += 1
+            label = self._parse_label(raw)
+            for aug in self.auglist:
+                img, label = aug(img, label)
+            arr = img.asnumpy()
+            if arr.ndim == 3 and arr.shape[-1] in (1, 3):
+                arr = arr.transpose(2, 0, 1)
+            datas.append(arr.astype(onp.float32))
+            padded = onp.full((max_obj, width), -1.0, onp.float32)
+            n = min(label.shape[0], max_obj)
+            padded[:n] = label[:n]
+            labels.append(padded)
+        return DataBatch(data=[NDArray(onp.stack(datas))],
+                         label=[NDArray(onp.stack(labels))], pad=pad)
+
+    def draw_next(self, color=None, thickness=2):
+        """Debug helper: yield images with boxes burned in (parity:
+        ImageDetIter.draw_next, simplified)."""
+        batch = self.next()
+        imgs = batch.data[0].asnumpy().transpose(0, 2, 3, 1).copy()
+        labels = batch.label[0].asnumpy()
+        h, w = imgs.shape[1], imgs.shape[2]
+        for img, lab in zip(imgs, labels):
+            for row in lab:
+                if row[0] < 0:
+                    continue
+                x1, y1, x2, y2 = (row[1] * w, row[2] * h,
+                                  row[3] * w, row[4] * h)
+                val = color or 255
+                x1, y1 = max(int(x1), 0), max(int(y1), 0)
+                x2 = min(int(x2), w - 1)
+                y2 = min(int(y2), h - 1)
+                img[y1:y1 + thickness, x1:x2] = val
+                img[max(y2 - thickness, 0):y2, x1:x2] = val
+                img[y1:y2, x1:x1 + thickness] = val
+                img[y1:y2, max(x2 - thickness, 0):x2] = val
+            yield img

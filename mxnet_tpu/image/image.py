@@ -288,11 +288,15 @@ class ImageIter(DataIter):
         if self.cur >= len(self._records):
             raise StopIteration
         datas, labels = [], []
+        read_cur, pad = self.cur, 0
         for _ in range(self.batch_size):
-            if self.cur >= len(self._records):
-                self.cur = 0  # pad by wraparound
-            img, label = self._read_one(self.cur)
+            if read_cur >= len(self._records):
+                read_cur = 0    # pad the final batch by wraparound
+            img, label = self._read_one(read_cur)
+            read_cur += 1
             self.cur += 1
+            if self.cur > len(self._records):
+                pad += 1        # this sample is padding, not fresh data
             for aug in self.auglist:
                 img = aug(img)
             arr = img.asnumpy()
@@ -300,8 +304,10 @@ class ImageIter(DataIter):
                 arr = arr.transpose(2, 0, 1)  # HWC -> CHW
             datas.append(arr)
             labels.append(label)
+        # cur past the end ⇒ epoch over; next call raises StopIteration
         return DataBatch([NDArray(onp.stack(datas))],
-                         [NDArray(onp.asarray(labels, dtype=onp.float32))])
+                         [NDArray(onp.asarray(labels, dtype=onp.float32))],
+                         pad=pad)
 
     def iter_next(self):
         return self.cur < len(self._records)

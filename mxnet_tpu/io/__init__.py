@@ -7,6 +7,8 @@ from .io import (DataIter, DataBatch, DataDesc, NDArrayIter, CSVIter,
                  ResizeIter, PrefetchingIter)
 from . import native
 from .native import ImageRecordIter
+from .libsvm import LibSVMIter
 
 __all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "native"]
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "native",
+           "LibSVMIter"]

@@ -1,0 +1,138 @@
+"""LibSVM-format sparse data iterator.
+
+Parity: src/io/iter_libsvm.cc (LibSVMIter): parses ``label
+[idx:val ...]`` text into CSR batches.  The TPU build keeps batches as
+CSRNDArray on the host — sparse is an eager/storage format here (see
+ndarray/sparse.py); models densify or use sparse dot at the point of
+use.  The reference's sparse prefetcher (iter_sparse_prefetcher.h) has
+no analogue because the whole file is parsed into memory up front —
+batch slicing is O(view), so there is nothing to prefetch.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+from ..ndarray.sparse import CSRNDArray
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["LibSVMIter"]
+
+
+def _parse_libsvm(path: str, indptr, indices, values, labels,
+                  label_width: int):
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            head, feats = [], []
+            for tok in parts:
+                (feats if ":" in tok else head).append(tok)
+            if len(head) < label_width:
+                raise MXNetError(
+                    f"libsvm line has {len(head)} labels, expected "
+                    f">= {label_width}: {line[:60]!r}")
+            labels.append([float(x) for x in head[:label_width]])
+            for tok in feats:
+                idx, val = tok.split(":", 1)
+                indices.append(int(idx))
+                values.append(float(val))
+            indptr.append(len(indices))
+
+
+class LibSVMIter(DataIter):
+    """Iterator over libsvm text data yielding CSR batches.
+
+    ``data_libsvm``: path to the data file; ``data_shape``: feature
+    dimension (int or 1-tuple); optional ``label_libsvm``/``label_shape``
+    stream multi-dimensional labels from a second file (parity:
+    iter_libsvm.cc param struct).
+    """
+
+    def __init__(self, data_libsvm: str, data_shape, batch_size: int,
+                 label_libsvm: Optional[str] = None, label_shape=None,
+                 round_batch: bool = True, **kwargs):
+        super().__init__(batch_size)
+        if isinstance(data_shape, (tuple, list)):
+            data_shape = int(data_shape[0])
+        self.data_shape = int(data_shape)
+        indptr, indices, values, labels = [0], [], [], []
+        _parse_libsvm(data_libsvm, indptr, indices, values, labels, 1)
+        if not labels:
+            raise MXNetError(f"libsvm: no data rows in {data_libsvm!r}")
+        if label_libsvm is not None:
+            if isinstance(label_shape, (tuple, list)):
+                label_shape = int(label_shape[0])
+            with open(label_libsvm) as f:
+                rows = [ln.strip() for ln in f if ln.strip()]
+            lab = onp.zeros((len(rows), int(label_shape or 1)), onp.float32)
+            for r, line in enumerate(rows):
+                for tok in line.split():
+                    if ":" in tok:
+                        idx, val = tok.split(":", 1)
+                        lab[r, int(idx)] = float(val)
+                    else:
+                        lab[r, 0] = float(tok)
+            self._labels = lab
+        else:
+            self._labels = onp.asarray(labels, onp.float32)[:, 0]
+        self._indptr = onp.asarray(indptr, onp.int64)
+        self._indices = onp.asarray(indices, onp.int32)
+        self._values = onp.asarray(values, onp.float32)
+        self.num_rows = len(self._indptr) - 1
+        if self._labels.shape[0] != self.num_rows:
+            raise MXNetError(
+                f"libsvm: {self.num_rows} data rows but "
+                f"{self._labels.shape[0]} labels")
+        if self._indices.size and \
+                int(self._indices.max()) >= self.data_shape:
+            raise MXNetError(
+                f"libsvm: feature index {int(self._indices.max())} out of "
+                f"range for data_shape {self.data_shape}")
+        self.round_batch = round_batch
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size, self.data_shape))]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._labels.ndim == 1 else \
+            (self.batch_size, self._labels.shape[1])
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        self.cur = 0
+
+    def _slice(self, start: int, stop: int) -> CSRNDArray:
+        lo, hi = self._indptr[start], self._indptr[stop]
+        return CSRNDArray(self._values[lo:hi], self._indices[lo:hi],
+                          self._indptr[start:stop + 1] - lo,
+                          (stop - start, self.data_shape))
+
+    def next(self) -> DataBatch:
+        if self.cur >= self.num_rows:
+            raise StopIteration
+        stop = min(self.cur + self.batch_size, self.num_rows)
+        pad = self.batch_size - (stop - self.cur)
+        if pad and self.round_batch:
+            # wrap around to fill the final batch (parity: round_batch)
+            head = self._slice(self.cur, stop)
+            tail = self._slice(0, pad)
+            data = onp.vstack([head.todense().asnumpy(),
+                               tail.todense().asnumpy()])
+            from ..ndarray.sparse import array as sparse_array
+            batch_data = sparse_array(data, stype="csr")
+            label = onp.concatenate([self._labels[self.cur:stop],
+                                     self._labels[:pad]])
+        else:
+            batch_data = self._slice(self.cur, stop)
+            label = self._labels[self.cur:stop]
+        self.cur = stop
+        return DataBatch(data=[batch_data], label=[NDArray(label)], pad=pad)

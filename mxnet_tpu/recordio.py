@@ -133,10 +133,17 @@ IndexedRecordIO = MXIndexedRecordIO  # short alias used by gluon.data
 
 
 def pack(header: IRHeader, s: bytes) -> bytes:
-    """Pack an IRHeader + payload (parity: recordio.pack)."""
+    """Pack an IRHeader + payload (parity: recordio.pack).  A vector
+    label is stored inline: flag = label length, scalar slot = 0, label
+    floats prepended to the payload — the inverse of :func:`unpack`."""
     header = IRHeader(*header)
-    payload = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
-                          header.id2)
+    label = header.label
+    if isinstance(label, (list, tuple)) or getattr(label, "ndim", 0) != 0:
+        label = onp.asarray(label, onp.float32).ravel()
+        header = header._replace(flag=label.size, label=0.0)
+        s = label.tobytes() + s
+    payload = struct.pack(_IR_FORMAT, header.flag, float(header.label),
+                          header.id, header.id2)
     return payload + s
 
 

@@ -1,0 +1,264 @@
+"""Detection augmenters + ImageDetIter + LibSVMIter.
+
+Parity: python/mxnet/image/detection.py tests
+(tests/python/unittest/test_image.py TestImageDetIter) and
+src/io/iter_libsvm.cc (tests/python/unittest/test_io.py test_LibSVMIter).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.image import (CreateDetAugmenter, DetHorizontalFlipAug,
+                             DetRandomCropAug, DetRandomPadAug,
+                             ImageDetIter)
+from mxnet_tpu.io import LibSVMIter
+from mxnet_tpu.ndarray import NDArray
+
+
+def _det_label(boxes):
+    """[header_w=2, obj_w=5, objects...] raw label vector."""
+    flat = [2.0, 5.0]
+    for b in boxes:
+        flat.extend(b)
+    return onp.asarray(flat, onp.float32)
+
+
+def _imglist(n=6, hw=32):
+    rng = onp.random.RandomState(0)
+    out = []
+    for i in range(n):
+        img = rng.randint(0, 255, (hw, hw, 3), onp.uint8)
+        k = 1 + i % 3
+        boxes = [[i % 4, 0.1, 0.1, 0.6, 0.7]] * k
+        out.append((_det_label(boxes), img))
+    return out
+
+
+def test_parse_label_and_iter_shapes():
+    it = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                      imglist=_imglist(hw=16), aug_list=[])
+    assert it.label_shape == (3, 5)
+    batch = it.next()
+    assert batch.data[0].shape == (2, 3, 16, 16)
+    assert batch.label[0].shape == (2, 3, 5)
+    lab = batch.label[0].asnumpy()
+    # first sample has 1 object, rest padded with -1
+    assert lab[0, 0, 0] >= 0 and (lab[0, 1:] == -1).all()
+
+
+def test_full_epoch_and_reset():
+    it = ImageDetIter(batch_size=3, data_shape=(3, 16, 16),
+                      imglist=_imglist(6, hw=16), aug_list=[])
+    n = sum(1 for _ in it)
+    assert n == 2
+    it.reset()
+    assert sum(1 for _ in it) == 2
+
+
+def test_det_hflip_boxes():
+    aug = DetHorizontalFlipAug(p=1.0)
+    img = NDArray(onp.arange(2 * 4 * 3, dtype=onp.uint8).reshape(2, 4, 3))
+    label = onp.asarray([[0, 0.1, 0.2, 0.4, 0.8]], onp.float32)
+    out_img, out_label = aug(img, label)
+    onp.testing.assert_allclose(out_label[0, 1], 0.6, rtol=1e-6)
+    onp.testing.assert_allclose(out_label[0, 3], 0.9, rtol=1e-6)
+    onp.testing.assert_allclose(out_img.asnumpy(),
+                                img.asnumpy()[:, ::-1])
+
+
+def test_det_random_crop_keeps_constraint():
+    rng = onp.random.RandomState(1)
+    aug = DetRandomCropAug(min_object_covered=0.5,
+                           area_range=(0.5, 1.0), max_attempts=30)
+    img = NDArray(rng.randint(0, 255, (64, 64, 3), onp.uint8))
+    label = onp.asarray([[1, 0.3, 0.3, 0.7, 0.7]], onp.float32)
+    for _ in range(5):
+        out_img, out_label = aug(img, label)
+        assert out_label.shape[1] == 5
+        assert (out_label[:, 1:5] >= 0).all()
+        assert (out_label[:, 1:5] <= 1).all()
+        assert (out_label[:, 3] > out_label[:, 1]).all()
+
+
+def test_det_random_pad_rescales_boxes():
+    rng = onp.random.RandomState(2)
+    aug = DetRandomPadAug(area_range=(1.5, 2.5), max_attempts=50)
+    img = NDArray(rng.randint(0, 255, (32, 32, 3), onp.uint8))
+    label = onp.asarray([[0, 0.0, 0.0, 1.0, 1.0]], onp.float32)
+    out_img, out_label = aug(img, label)
+    if out_img.shape != img.shape:        # pad proposal accepted
+        area = (out_label[0, 3] - out_label[0, 1]) * \
+            (out_label[0, 4] - out_label[0, 2])
+        assert area < 1.0                 # original image is now a subregion
+
+
+def test_create_det_augmenter_runs():
+    augs = CreateDetAugmenter((3, 24, 24), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True, mean=True, std=True)
+    rng = onp.random.RandomState(3)
+    img = NDArray(rng.randint(0, 255, (48, 40, 3), onp.uint8))
+    label = onp.asarray([[0, 0.2, 0.2, 0.8, 0.8],
+                         [1, 0.4, 0.1, 0.9, 0.6]], onp.float32)
+    for _ in range(4):
+        im, lab = img, label
+        for aug in augs:
+            im, lab = aug(im, lab)
+        assert im.shape[0] == 24 and im.shape[1] == 24
+        assert lab.shape[1] == 5
+
+
+def test_bad_det_label_errors():
+    with pytest.raises(MXNetError, match="too short"):
+        ImageDetIter._parse_label(onp.asarray([2.0, 5.0], onp.float32))
+    with pytest.raises(MXNetError, match="inconsistent"):
+        ImageDetIter._parse_label(
+            onp.asarray([2, 5, 0, .1, .1, .5, .6, .7], onp.float32))
+
+
+# -- LibSVM ---------------------------------------------------------------
+
+def _write_libsvm(tmp_path, lines, name="data.svm"):
+    path = str(tmp_path / name)
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def test_libsvm_iter(tmp_path):
+    path = _write_libsvm(tmp_path, [
+        "1 0:0.5 3:1.5",
+        "0 1:2.0",
+        "1 0:1.0 2:3.0 3:4.0",
+        "0 # all-zero row with comment",
+    ])
+    it = LibSVMIter(data_libsvm=path, data_shape=(4,), batch_size=2,
+                    round_batch=False)
+    batches = list(it)
+    assert len(batches) == 2
+    d0 = batches[0].data[0].todense().asnumpy()
+    onp.testing.assert_allclose(d0, [[0.5, 0, 0, 1.5], [0, 2.0, 0, 0]])
+    onp.testing.assert_allclose(batches[0].label[0].asnumpy(), [1.0, 0.0])
+    d1 = batches[1].data[0].todense().asnumpy()
+    onp.testing.assert_allclose(d1[1], onp.zeros(4))
+    assert batches[0].data[0].stype == "csr"
+
+
+def test_libsvm_round_batch(tmp_path):
+    path = _write_libsvm(tmp_path, ["1 0:1", "2 1:1", "3 2:1"])
+    it = LibSVMIter(data_libsvm=path, data_shape=3, batch_size=2,
+                    round_batch=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[1].pad == 1
+    # wrapped row is row 0
+    onp.testing.assert_allclose(
+        batches[1].data[0].todense().asnumpy()[1], [1, 0, 0])
+
+
+def test_libsvm_label_file(tmp_path):
+    dpath = _write_libsvm(tmp_path, ["0 0:1", "0 1:1"])
+    lpath = _write_libsvm(tmp_path, ["0:0.5 2:0.25", "1:1.0"], "lab.svm")
+    it = LibSVMIter(data_libsvm=dpath, data_shape=2, batch_size=2,
+                    label_libsvm=lpath, label_shape=3)
+    b = next(iter(it))
+    onp.testing.assert_allclose(b.label[0].asnumpy(),
+                                [[0.5, 0, 0.25], [0, 1.0, 0]])
+
+
+def test_libsvm_errors(tmp_path):
+    path = _write_libsvm(tmp_path, ["1 9:1.0"])
+    with pytest.raises(MXNetError, match="out of range"):
+        LibSVMIter(data_libsvm=path, data_shape=4, batch_size=1)
+
+
+def test_recordio_vector_label_round_trip(tmp_path):
+    """pack/unpack with a vector label (flag path) — the det .rec flow."""
+    from mxnet_tpu import recordio
+    label = onp.array([2, 5, 1, .1, .2, .6, .9], onp.float32)
+    hdr = recordio.IRHeader(flag=0, label=label, id=7, id2=0)
+    blob = recordio.pack(hdr, b"payload")
+    hdr2, payload = recordio.unpack(blob)
+    assert payload == b"payload"
+    assert hdr2.flag == label.size and hdr2.id == 7
+    onp.testing.assert_allclose(onp.asarray(hdr2.label), label)
+
+
+def test_imagedetiter_from_rec(tmp_path):
+    from mxnet_tpu import recordio
+    rng = onp.random.RandomState(9)
+    rec_path = str(tmp_path / "det.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    for i in range(4):
+        img = rng.randint(0, 255, (20, 20, 3), onp.uint8)
+        label = _det_label([[i, 0.2, 0.2, 0.8, 0.8]])
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, quality=95))
+    w.close()
+    it = ImageDetIter(batch_size=2, data_shape=(3, 20, 20),
+                      path_imgrec=rec_path, aug_list=[])
+    b = it.next()
+    assert b.label[0].shape == (2, 1, 5)
+    onp.testing.assert_allclose(b.label[0].asnumpy()[:, 0, 0], [0, 1])
+
+
+def test_wraparound_pad_and_epoch_end():
+    """A non-divisible dataset yields ceil(n/bs) batches, the final one
+    reporting its pad count — not endless duplicate batches."""
+    it = ImageDetIter(batch_size=2, data_shape=(3, 16, 16),
+                      imglist=_imglist(5, hw=16), aug_list=[])
+    batches = list(it)
+    assert len(batches) == 3
+    assert [b.pad for b in batches] == [0, 0, 1]
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_imageiter_wraparound_pad():
+    from mxnet_tpu.image import ImageIter
+    rng = onp.random.RandomState(0)
+    imglist = [(float(i), rng.randint(0, 255, (8, 8, 3), onp.uint8))
+               for i in range(5)]
+    it = ImageIter(batch_size=2, data_shape=(3, 8, 8), imglist=imglist,
+                   aug_list=[])
+    batches = list(it)
+    assert len(batches) == 3
+    assert [b.pad for b in batches] == [0, 0, 1]
+
+
+def test_imagedetiter_from_lst(tmp_path):
+    import cv2
+    rng = onp.random.RandomState(1)
+    lines = []
+    for i in range(3):
+        img = rng.randint(0, 255, (16, 16, 3), onp.uint8)
+        fname = f"img{i}.jpg"
+        cv2.imwrite(str(tmp_path / fname), img)
+        lines.append("\t".join(
+            [str(i), "2", "5", str(i % 2), "0.1", "0.1", "0.8", "0.9",
+             fname]))
+    lst = str(tmp_path / "det.lst")
+    open(lst, "w").write("\n".join(lines) + "\n")
+    it = ImageDetIter(batch_size=3, data_shape=(3, 16, 16),
+                      path_imglist=lst, path_root=str(tmp_path),
+                      aug_list=[])
+    b = it.next()
+    assert b.label[0].shape == (3, 1, 5)
+    onp.testing.assert_allclose(b.label[0].asnumpy()[:, 0, 0], [0, 1, 0])
+
+
+def test_recordio_pack_list_label():
+    from mxnet_tpu import recordio
+    blob = recordio.pack(recordio.IRHeader(0, [2.0, 5.0, 1, .1, .2, .6,
+                                               .9], 3, 0), b"x")
+    hdr, payload = recordio.unpack(blob)
+    assert payload == b"x" and hdr.flag == 7
+    onp.testing.assert_allclose(onp.asarray(hdr.label)[:2], [2.0, 5.0])
+
+
+def test_libsvm_empty_file(tmp_path):
+    path = _write_libsvm(tmp_path, ["# nothing here"])
+    with pytest.raises(MXNetError, match="no data rows"):
+        LibSVMIter(data_libsvm=path, data_shape=4, batch_size=1)
