@@ -1,0 +1,136 @@
+"""Horovod / BytePS kvstore adapters.
+
+Parity: python/mxnet/kvstore/horovod.py and byteps.py — thin shims that
+delegate broadcast/pushpull to the external communication library when
+it is installed.  On TPU pods the native path is the `dist_*` stores
+(XLA collectives over ICI/DCN, kvstore/dist.py); these adapters exist
+so launch scripts written against `mx.kv.create('horovod')` keep
+working wherever those libraries provide a backend (e.g. CPU/GPU
+clusters), and fail with a clear message when they don't.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+from .base import KVStoreBase
+
+__all__ = ["Horovod", "BytePS"]
+
+
+def _import_or_raise(module: str, store: str, hint: str):
+    import importlib
+    try:
+        return importlib.import_module(module)
+    except ImportError as e:
+        raise MXNetError(
+            f"kvstore {store!r} requires the {module.split('.')[0]!r} "
+            f"package, which is not installed ({e}). {hint}") from e
+
+
+@KVStoreBase.register
+class Horovod(KVStoreBase):
+    """Allreduce-style backend over horovod (parity: kvstore/horovod.py).
+
+    No parameter-server semantics: pushpull is a ring allreduce keyed by
+    tensor name, broadcast ships rank 0's value everywhere.
+    """
+
+    type = "horovod"
+
+    def __init__(self):
+        self._hvd = _import_or_raise(
+            "horovod.mxnet", "horovod",
+            "On TPU use kv.create('dist_sync') instead — it rides XLA "
+            "collectives over ICI/DCN.")
+        self._hvd.init()
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return False    # no server-side optimizer
+
+    def broadcast(self, key, value, out, priority=0):
+        if isinstance(value, list):
+            value = value[0]    # replicas hold the same tensor
+        outs = out if isinstance(out, list) else [out]
+        res = self._hvd.broadcast(tensor=value, root_rank=0,
+                                  name=str(key), priority=priority)
+        for o in outs:
+            o[:] = res
+
+    def pushpull(self, key, value, out=None, priority=0):
+        # list-valued tensors allreduce per element (parity:
+        # kvstore/horovod.py accepts single or lists)
+        values = value if isinstance(value, list) else [value]
+        results = [self._hvd.allreduce(v, average=False,
+                                       name=f"{key}_{i}" if i else str(key),
+                                       priority=priority)
+                   for i, v in enumerate(values)]
+        if out is None:
+            for v, r in zip(values, results):
+                v[:] = r
+        else:
+            outs = out if isinstance(out, list) else [out]
+            for o, r in zip(outs, results):
+                o[:] = r
+
+    @property
+    def rank(self) -> int:
+        return self._hvd.rank()
+
+    @property
+    def num_workers(self) -> int:
+        return self._hvd.size()
+
+    @property
+    def local_rank(self) -> int:
+        return self._hvd.local_rank()
+
+
+@KVStoreBase.register
+class BytePS(KVStoreBase):
+    """Push-pull backend over byteps (parity: kvstore/byteps.py)."""
+
+    type = "byteps"
+
+    def __init__(self):
+        self._bps = _import_or_raise(
+            "byteps.mxnet", "byteps",
+            "On TPU use kv.create('dist_async')/'dist_sync' instead.")
+        self._bps.init()
+        self._declared = set()
+
+    @staticmethod
+    def is_capable(capability: str) -> bool:
+        return False
+
+    def _declare(self, key):
+        if key not in self._declared:
+            self._bps.byteps_declare_tensor(str(key))
+            self._declared.add(key)
+
+    def broadcast(self, key, value, out, priority=0):
+        self._declare(key)
+        outs = out if isinstance(out, list) else [out]
+        self._bps.byteps_push_pull(value, version=0, priority=priority,
+                                   name=str(key), is_average=False)
+        for o in outs:
+            o[:] = value
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self._declare(key)
+        self._bps.byteps_push_pull(value, version=0, priority=priority,
+                                   name=str(key), is_average=False)
+        if out is not None:
+            for o in (out if isinstance(out, list) else [out]):
+                o[:] = value
+
+    @property
+    def rank(self) -> int:
+        return self._bps.rank()
+
+    @property
+    def num_workers(self) -> int:
+        return self._bps.size()
+
+    @property
+    def local_rank(self) -> int:
+        return self._bps.local_rank()

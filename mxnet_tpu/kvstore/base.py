@@ -94,6 +94,8 @@ def create(name: str = "local", **kwargs) -> KVStoreBase:
     if name.startswith("dist"):
         klass = _KV_REGISTRY["distkvstore"]
         return klass(name)
+    if name in ("horovod", "byteps"):
+        from . import adapters  # registers on import  # noqa: F401
     if name in _KV_REGISTRY:
         return _KV_REGISTRY[name](**kwargs)
     raise MXNetError(f"unknown kvstore type {name!r}")

@@ -1,0 +1,107 @@
+"""Pipeline parallelism: GPipe-style stage execution over a mesh axis.
+
+TPU-native design (no reference counterpart to translate: the
+reference's "model parallelism" is per-layer ctx placement,
+`group2ctxs` in graph_executor.cc — a host-scheduled form the compiler
+replaces here): stages live one-per-device along a ``pp`` mesh axis,
+microbatches stream through, and stage outputs hop to the next device
+with `lax.ppermute` (XLA collective-permute over ICI).  Expressed so
+`jax.grad` differentiates straight through — the transpose of ppermute
+is the reverse ppermute, so the backward pipeline runs automatically in
+the opposite direction.
+
+Layout: stage parameters are stacked on a leading axis sharded over
+``pp``; inside `shard_map` each device sees only its own stage's
+params.  The schedule is the classic GPipe fill-drain: with S stages
+and M microbatches the loop runs S+M-1 ticks at 1/S bubble overhead.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec
+from jax import shard_map
+
+__all__ = ["gpipe_apply", "pipeline_forward"]
+
+
+def gpipe_apply(stage_fn: Callable, n_stages: int, axis_name: str = "pp"):
+    """Build the per-device pipeline body; call inside shard_map.
+
+    ``stage_fn(stage_params, x) -> y`` is one stage's computation; every
+    stage must map shape (mb, ...) -> (mb, ...) identically (uniform
+    pipelines — the GPipe assumption).
+
+    Returns ``apply(stage_params, x_microbatches)`` where
+    ``stage_params`` is this device's stage slice and
+    ``x_microbatches`` has shape (M, mb, ...).  The result is the
+    last stage's outputs, (M, mb, ...), replicated over the axis.
+    """
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def apply(stage_params, x_mb):
+        idx = lax.axis_index(axis_name)
+        M = x_mb.shape[0]
+        carry = jnp.zeros_like(x_mb[0])
+        out = jnp.zeros_like(x_mb)
+        for t in range(n_stages + M - 1):
+            feed = x_mb[min(t, M - 1)]
+            inp = jnp.where(idx == 0, feed, carry)
+            y = stage_fn(stage_params, inp)
+            # collect on the last stage: at tick t it finishes
+            # microbatch t-(S-1)
+            m = t - (n_stages - 1)
+            if m >= 0:
+                write = jnp.where(idx == n_stages - 1, y, out[m])
+                out = out.at[m].set(write)
+            carry = lax.ppermute(y, axis_name, perm)
+        # replicate the collected outputs (they live on the last stage)
+        mask = (idx == n_stages - 1).astype(out.dtype)
+        return lax.psum(out * mask, axis_name)
+
+    return apply
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params, x, mesh: Mesh,
+                     n_microbatches: int, axis_name: str = "pp",
+                     batch_axis_name: Optional[str] = "dp"):
+    """Run a full pipeline forward over a mesh (convenience wrapper).
+
+    ``stacked_params``: pytree whose leaves have a leading stage axis of
+    size mesh.shape[axis_name] (sharded over it).  ``x``: (B, ...) batch
+    — split into ``n_microbatches`` along axis 0; if the mesh also has
+    ``batch_axis_name``, the batch dim is additionally sharded over it
+    (dp×pp).  Returns (B, ...) outputs with the same sharding as x.
+    """
+    S = mesh.shape[axis_name]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"pipeline_forward: param leading (stage) axis "
+                f"{leaf.shape[0]} != pp mesh size {S} — one stage per "
+                f"device (stack multiple layers inside stage_fn instead)")
+    body = gpipe_apply(stage_fn, S, axis_name)
+    dp = (batch_axis_name
+          if batch_axis_name and batch_axis_name in mesh.axis_names
+          else None)
+    n_dp = mesh.shape[dp] if dp else 1
+    if x.shape[0] % (n_dp * n_microbatches):
+        raise ValueError(
+            f"pipeline_forward: batch {x.shape[0]} not divisible by "
+            f"dp({n_dp}) x n_microbatches({n_microbatches})")
+
+    def full(params, xb):
+        # shard_map keeps the sharded stage axis at local size 1 — drop it
+        local = jax.tree.map(lambda a: a[0], params)
+        M = n_microbatches
+        xmb = xb.reshape((M, xb.shape[0] // M) + xb.shape[1:])
+        out = body(local, xmb)
+        return out.reshape(xb.shape[0:1] + out.shape[2:])
+
+    pspec = jax.tree.map(lambda _: PartitionSpec(axis_name), stacked_params)
+    xspec = PartitionSpec(dp)
+    return shard_map(full, mesh=mesh, in_specs=(pspec, xspec),
+                     out_specs=xspec, check_vma=False)(stacked_params, x)

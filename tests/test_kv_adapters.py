@@ -1,0 +1,32 @@
+"""Horovod/BytePS kvstore adapter registration (parity:
+python/mxnet/kvstore/horovod.py, byteps.py).  Neither library exists in
+the image, so these tests pin the registry dispatch and the actionable
+error message pointing at the TPU-native dist stores.
+"""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+@pytest.mark.parametrize("name,pkg", [("horovod", "horovod"),
+                                      ("byteps", "byteps")])
+def test_adapter_create_errors_actionably(name, pkg):
+    with pytest.raises(MXNetError) as ei:
+        mx.kv.create(name)
+    msg = str(ei.value)
+    assert pkg in msg and "dist_" in msg     # names the fix
+
+
+def test_adapters_registered():
+    from mxnet_tpu.kvstore import adapters
+    from mxnet_tpu.kvstore.base import _KV_REGISTRY
+    assert _KV_REGISTRY["horovod"] is adapters.Horovod
+    assert _KV_REGISTRY["byteps"] is adapters.BytePS
+    assert adapters.Horovod.type == "horovod"
+    assert not adapters.Horovod.is_capable("optimizer")
+
+
+def test_unknown_store_still_errors():
+    with pytest.raises(MXNetError, match="unknown kvstore"):
+        mx.kv.create("definitely_not_a_store")

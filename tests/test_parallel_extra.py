@@ -1,0 +1,160 @@
+"""Multichip training evidence: pipeline parallelism, ring attention in
+a training step, and compile-level scaling efficiency.
+
+Addresses the round-1 gap ("no pp/sp training test, no ring-attention-
+in-a-training-step test, no scaling-efficiency measurement") on the
+8-device virtual CPU mesh (conftest).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as onp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from mxnet_tpu.parallel import (make_mesh, pipeline_forward,
+                                ring_self_attention)
+
+
+def _stage_fn(params, x):
+    w, b = params
+    return jax.nn.relu(x @ w + b)
+
+
+def _stacked_params(rng, S, H):
+    w = rng.randn(S, H, H).astype(onp.float32) * 0.3
+    b = rng.randn(S, H).astype(onp.float32) * 0.1
+    return (jnp.asarray(w), jnp.asarray(b))
+
+
+def _sequential(params, x):
+    w, b = params
+    for s in range(w.shape[0]):
+        x = jax.nn.relu(x @ w[s] + b[s])
+    return x
+
+
+def test_gpipe_forward_matches_sequential():
+    S, H, B, M = 4, 8, 16, 4
+    rng = onp.random.RandomState(0)
+    mesh = make_mesh({"pp": S})
+    params = _stacked_params(rng, S, H)
+    x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    got = pipeline_forward(_stage_fn, params, x, mesh, n_microbatches=M,
+                           batch_axis_name=None)
+    ref = _sequential(params, x)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_gpipe_training_step_matches_sequential_grads():
+    """jax.grad straight through the pipeline (backward runs the ring in
+    reverse) must match the sequential model's gradients."""
+    S, H, B, M = 4, 6, 8, 2
+    rng = onp.random.RandomState(1)
+    mesh = make_mesh({"pp": S})
+    params = _stacked_params(rng, S, H)
+    x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    y = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+
+    def pp_loss(p):
+        out = pipeline_forward(_stage_fn, p, x, mesh, n_microbatches=M,
+                               batch_axis_name=None)
+        return jnp.mean((out - y) ** 2)
+
+    def seq_loss(p):
+        return jnp.mean((_sequential(p, x) - y) ** 2)
+
+    l_pp, g_pp = jax.value_and_grad(pp_loss)(params)
+    l_seq, g_seq = jax.value_and_grad(seq_loss)(params)
+    onp.testing.assert_allclose(float(l_pp), float(l_seq), rtol=1e-5)
+    for a, b in zip(g_pp, g_seq):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=1e-4, atol=1e-5)
+
+
+def test_gpipe_dp_x_pp():
+    """dp2 x pp4: batch sharded over dp while stages stream over pp."""
+    S, H, B, M = 4, 4, 16, 2
+    rng = onp.random.RandomState(2)
+    mesh = make_mesh({"dp": 2, "pp": S})
+    params = _stacked_params(rng, S, H)
+    x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    got = pipeline_forward(_stage_fn, params, x, mesh, n_microbatches=M)
+    ref = _sequential(params, x)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+
+def test_ring_attention_inside_training_step():
+    """Train one step where the forward runs ring attention over an sp
+    axis; gradients must match the dense single-device attention."""
+    B, H, S, D, NSP = 2, 2, 16, 4, 4
+    rng = onp.random.RandomState(3)
+    mesh = make_mesh({"sp": NSP})
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    wo = jnp.asarray(rng.randn(D, D).astype(onp.float32))
+
+    def ring_loss(w):
+        o = ring_self_attention(q, k, v, mesh)
+        return jnp.mean((o @ w) ** 2)
+
+    def dense_loss(w):
+        s = (q @ jnp.swapaxes(k, -1, -2)) / (D ** 0.5)
+        o = jax.nn.softmax(s, axis=-1) @ v
+        return jnp.mean((o @ w) ** 2)
+
+    l_r, g_r = jax.value_and_grad(ring_loss)(wo)
+    l_d, g_d = jax.value_and_grad(dense_loss)(wo)
+    onp.testing.assert_allclose(float(l_r), float(l_d), rtol=1e-4)
+    onp.testing.assert_allclose(onp.asarray(g_r), onp.asarray(g_d),
+                                rtol=1e-3, atol=1e-5)
+
+
+def test_dp_scaling_efficiency_compile_level():
+    """Per-device FLOPs must scale ~1/N under dp sharding — the
+    compile-level scaling-efficiency check that virtual (1-core) devices
+    can actually measure."""
+    H, B = 64, 64
+
+    def loss(w, x):
+        return jnp.mean(jax.nn.relu(x @ w) ** 2)
+
+    w = jnp.ones((H, H), jnp.float32)
+    x = jnp.ones((B, H), jnp.float32)
+
+    def flops_with_mesh(n):
+        mesh = make_mesh({"dp": n})
+        xs = jax.device_put(
+            x, NamedSharding(mesh, PartitionSpec("dp")))
+        ws = jax.device_put(w, NamedSharding(mesh, PartitionSpec()))
+        compiled = jax.jit(jax.grad(loss)).lower(ws, xs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+
+    f1 = flops_with_mesh(1)
+    f8 = flops_with_mesh(8)
+    if f1 <= 0 or f8 <= 0:
+        pytest.skip("cost_analysis reports no flops on this backend")
+    # cost_analysis reports per-device program flops under SPMD
+    ratio = f1 / f8
+    assert ratio > 4.0, f"dp8 per-device flops only {ratio:.1f}x smaller"
+
+
+def test_pipeline_validation_errors():
+    import pytest as _pytest
+    rng = onp.random.RandomState(0)
+    mesh = make_mesh({"pp": 4})
+    bad = (jnp.asarray(rng.randn(8, 4, 4).astype(onp.float32)),
+           jnp.asarray(rng.randn(8, 4).astype(onp.float32)))
+    x = jnp.ones((8, 4), jnp.float32)
+    with _pytest.raises(ValueError, match="stage"):
+        pipeline_forward(_stage_fn, bad, x, mesh, n_microbatches=2,
+                         batch_axis_name=None)
+    good = _stacked_params(rng, 4, 4)
+    with _pytest.raises(ValueError, match="divisible"):
+        pipeline_forward(_stage_fn, good, jnp.ones((10, 4), jnp.float32),
+                         mesh, n_microbatches=4, batch_axis_name=None)
