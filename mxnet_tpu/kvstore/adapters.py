@@ -57,20 +57,15 @@ class Horovod(KVStoreBase):
             o[:] = res
 
     def pushpull(self, key, value, out=None, priority=0):
-        # list-valued tensors allreduce per element (parity:
-        # kvstore/horovod.py accepts single or lists)
+        # a list value holds per-device replicas of one tensor (parity:
+        # kvstore/horovod.py) — allreduce once, write everywhere
         values = value if isinstance(value, list) else [value]
-        results = [self._hvd.allreduce(v, average=False,
-                                       name=f"{key}_{i}" if i else str(key),
-                                       priority=priority)
-                   for i, v in enumerate(values)]
-        if out is None:
-            for v, r in zip(values, results):
-                v[:] = r
-        else:
-            outs = out if isinstance(out, list) else [out]
-            for o, r in zip(outs, results):
-                o[:] = r
+        res = self._hvd.allreduce(values[0], average=False, name=str(key),
+                                  priority=priority)
+        targets = values if out is None else \
+            (out if isinstance(out, list) else [out])
+        for t in targets:
+            t[:] = res
 
     @property
     def rank(self) -> int:
@@ -108,6 +103,8 @@ class BytePS(KVStoreBase):
             self._declared.add(key)
 
     def broadcast(self, key, value, out, priority=0):
+        if isinstance(value, list):
+            value = value[0]
         self._declare(key)
         outs = out if isinstance(out, list) else [out]
         self._bps.byteps_push_pull(value, version=0, priority=priority,
@@ -116,12 +113,15 @@ class BytePS(KVStoreBase):
             o[:] = value
 
     def pushpull(self, key, value, out=None, priority=0):
+        values = value if isinstance(value, list) else [value]
+        value = values[0]
         self._declare(key)
         self._bps.byteps_push_pull(value, version=0, priority=priority,
                                    name=str(key), is_average=False)
-        if out is not None:
-            for o in (out if isinstance(out, list) else [out]):
-                o[:] = value
+        for t in (values if out is None else
+                  (out if isinstance(out, list) else [out])):
+            if t is not value:
+                t[:] = value
 
     @property
     def rank(self) -> int:

@@ -1,0 +1,199 @@
+"""Contrib ops rounding out the registry: FFT, Hawkes-process
+likelihood, straight-through estimators, edge_id, index_add.
+
+Parity targets in src/operator/contrib/: fft-inl.h / ifft-inl.h (cuFFT
+interleaved layout), hawkes_ll.cc, stes_op.cc (round_ste/sign_ste),
+edge_id (dgl_graph.cc), index_add.cc.  TPU-first notes: FFT lowers to
+XLA's native fft HLO; the Hawkes recurrence is a lax.scan over the
+sequence axis (vectorized over batch/marks with one-hot masking instead
+of the reference's per-sample scalar loop); STEs are jax.custom_vjp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+
+
+# -- FFT (parity: contrib/fft-inl.h — interleaved re/im last axis) ---------
+
+def _interleave(c):
+    out = jnp.stack([c.real, c.imag], axis=-1)
+    return out.reshape(c.shape[:-1] + (2 * c.shape[-1],))
+
+
+def _deinterleave(x):
+    d = x.shape[-1] // 2
+    pairs = x.reshape(x.shape[:-1] + (d, 2))
+    return lax.complex(pairs[..., 0], pairs[..., 1])
+
+
+@register("_contrib_fft", aliases=("fft",))
+def _contrib_fft(x, *, compute_size=128):
+    """Real (..., d) → interleaved complex (..., 2d) FFT along the last
+    axis.  ``compute_size`` (reference sub-batch size for cuFFT plans)
+    is accepted and ignored — XLA plans the whole batch."""
+    c = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+    return _interleave(c).astype(x.dtype)
+
+
+@register("_contrib_ifft", aliases=("ifft",))
+def _contrib_ifft(x, *, compute_size=128):
+    """Interleaved complex (..., 2d) → real (..., d) inverse FFT,
+    unscaled like the reference (output = ifft(x) * d)."""
+    c = _deinterleave(x.astype(jnp.float32))
+    d = c.shape[-1]
+    return (jnp.fft.ifft(c, axis=-1).real * d).astype(x.dtype)
+
+
+# -- straight-through estimators (parity: contrib/stes_op.cc) --------------
+
+@jax.custom_vjp
+def _round_ste_fn(x):
+    return jnp.round(x)
+
+
+def _round_ste_fwd(x):
+    return jnp.round(x), None
+
+
+def _ste_bwd(_, ct):
+    return (ct,)
+
+
+_round_ste_fn.defvjp(_round_ste_fwd, _ste_bwd)
+
+
+@jax.custom_vjp
+def _sign_ste_fn(x):
+    return jnp.sign(x)
+
+
+def _sign_ste_fwd(x):
+    return jnp.sign(x), None
+
+
+_sign_ste_fn.defvjp(_sign_ste_fwd, _ste_bwd)
+
+
+@register("_contrib_round_ste", aliases=("round_ste",))
+def _contrib_round_ste(x):
+    """round with identity (straight-through) gradient."""
+    return _round_ste_fn(x)
+
+
+@register("_contrib_sign_ste", aliases=("sign_ste",))
+def _contrib_sign_ste(x):
+    """sign with identity (straight-through) gradient."""
+    return _sign_ste_fn(x)
+
+
+# -- index_add (parity: contrib/index_add.cc) ------------------------------
+
+@register("_contrib_index_add", aliases=("index_add",))
+def _contrib_index_add(data, indices, updates):
+    """Scatter-add ``updates`` rows into ``data`` at ``indices`` along
+    axis 0 (duplicate indices accumulate)."""
+    return data.at[indices.astype(jnp.int32)].add(updates)
+
+
+# -- edge_id (parity: dgl_graph.cc EdgeID on CSR adjacency) ----------------
+
+@register("_contrib_edge_id", aliases=("edge_id",))
+def _contrib_edge_id(indptr, indices, data, u, v):
+    """Edge data lookup on a CSR adjacency: for each (u[i], v[i]) pair
+    return data[e] of the edge u→v, or -1 when absent.  Columns within
+    a row are sorted (CSR convention), so each query is a binary search
+    over its row slice — O(queries · log max_degree), like the
+    reference's per-row search (dgl_graph.cc EdgeID)."""
+    u = u.astype(jnp.int32)
+    v = v.astype(jnp.int32)
+
+    def one(ui, vi):
+        lo, hi = indptr[ui], indptr[ui + 1]
+
+        def cond(state):
+            l, h = state
+            return l < h
+
+        def body(state):
+            l, h = state
+            mid = (l + h) // 2
+            go_right = indices[mid] < vi
+            return (jnp.where(go_right, mid + 1, l),
+                    jnp.where(go_right, h, mid))
+
+        l, _ = lax.while_loop(cond, body, (lo, hi))
+        found = (l < hi) & (indices[jnp.minimum(l, indices.shape[0] - 1)]
+                            == vi)
+        e = jnp.minimum(l, indices.shape[0] - 1)
+        return jnp.where(found, data[e], jnp.asarray(-1.0, data.dtype))
+
+    return jax.vmap(one)(u, v)
+
+
+# -- Hawkes process log likelihood (parity: contrib/hawkes_ll.cc) ----------
+
+@register("_contrib_hawkesll", aliases=("hawkesll",), multi_out=True)
+def _contrib_hawkesll(lda, alpha, beta, state, lags, marks, valid_length,
+                      max_time):
+    """Joint log likelihood of K independent univariate Hawkes processes
+    (conditional intensity λ_k + α_k β_k Σ exp(-β_k Δt)).
+
+    Shapes: lda (N,K), alpha (K,), beta (K,), state (N,K) — the decay
+    memory s_k(0) —, lags/marks (N,T) left-aligned ragged sequences,
+    valid_length (N,), max_time (N,).  Returns (loglike (N,),
+    out_state (N,K) = s_k(max_time)).  The reference's per-sample C
+    loop becomes one lax.scan over T with one-hot mark masking.
+    """
+    N, K = lda.shape
+    T = lags.shape[1]
+    f32 = jnp.float32
+    lda = lda.astype(f32)
+    alpha = alpha.astype(f32)
+    beta = beta.astype(f32)
+    lags = lags.astype(f32)
+    marks = marks.astype(jnp.int32)
+    vlen = valid_length.astype(jnp.int32)
+    mt = max_time.astype(f32)
+
+    def step(carry, inp):
+        ll, t, s, last = carry          # (N,), (N,), (N,K), (N,K)
+        lag_j, mark_j, active = inp     # (N,), (N,), (N,)
+        oh = jax.nn.one_hot(mark_j, K, dtype=f32)           # (N,K)
+        t_new = t + lag_j
+        d = t_new - jnp.sum(last * oh, axis=1)              # Δt since the
+        b = beta[mark_j]                                    # mark's last
+        ed = jnp.exp(-b * d)
+        s_ci = jnp.sum(s * oh, axis=1)
+        mu_ci = jnp.sum(lda * oh, axis=1)
+        a = alpha[mark_j]
+        lam = mu_ci + a * b * s_ci * ed
+        comp = mu_ci * d + a * s_ci * (1.0 - ed)
+        # padded steps can have lam == 0 (e.g. out-of-range padding
+        # marks → empty one-hot): select before log so 0·(-inf) can't
+        # poison the masked accumulate with nan
+        contrib = jnp.where(active,
+                            jnp.log(jnp.where(active, lam, 1.0)) - comp,
+                            0.0)
+        act = active.astype(f32)
+        ll = ll + act * contrib
+        upd = act[:, None] * oh
+        s = s * (1 - upd) + upd * (1.0 + s_ci * ed)[:, None]
+        last = last * (1 - upd) + upd * t_new[:, None]
+        t = jnp.where(active, t_new, t)
+        return (ll, t, s, last), None
+
+    init = (jnp.zeros((N,), f32), jnp.zeros((N,), f32),
+            state.astype(f32), jnp.zeros((N, K), f32))
+    steps = (lags.T, marks.T,
+             (jnp.arange(T)[:, None] < vlen[None, :]))
+    (ll, _, s, last), _ = lax.scan(step, init, steps)
+
+    # remaining compensators over (last event, max_time] + state decay
+    d = mt[:, None] - last                                   # (N,K)
+    ed = jnp.exp(-beta[None, :] * d)
+    ll = ll - jnp.sum(lda * d + alpha[None, :] * s * (1.0 - ed), axis=1)
+    return ll, s * ed

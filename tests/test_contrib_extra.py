@@ -1,0 +1,166 @@
+"""Contrib extras: fft/ifft, STEs, index_add, edge_id, hawkesll.
+
+Parity: src/operator/contrib/{fft,ifft}-inl.h (interleaved layout,
+tests/python/gpu/test_operator_gpu.py check_fft), stes_op.cc,
+index_add.cc, dgl_graph.cc EdgeID, hawkes_ll.cc.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ops.registry import invoke
+
+
+def test_fft_matches_numpy_interleaved():
+    rng = onp.random.RandomState(0)
+    x = rng.randn(3, 8).astype(onp.float32)
+    out = invoke("_contrib_fft", [NDArray(x)]).asnumpy()
+    ref = onp.fft.fft(x, axis=-1)
+    inter = onp.zeros((3, 16), onp.float32)
+    inter[:, 0::2] = ref.real
+    inter[:, 1::2] = ref.imag
+    onp.testing.assert_allclose(out, inter, rtol=1e-4, atol=1e-4)
+
+
+def test_ifft_unscaled_round_trip():
+    rng = onp.random.RandomState(1)
+    x = rng.randn(2, 6).astype(onp.float32)
+    freq = invoke("_contrib_fft", [NDArray(x)])
+    back = invoke("_contrib_ifft", [freq]).asnumpy()
+    # reference convention: ifft unscaled → fft∘ifft = d * identity
+    onp.testing.assert_allclose(back, x * 6, rtol=1e-4, atol=1e-4)
+
+
+def test_round_sign_ste_gradients():
+    x = NDArray(onp.array([-1.4, 0.3, 2.6], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = invoke("_contrib_round_ste", [x])
+        z = mx.nd.sum(y * y)
+    z.backward()
+    onp.testing.assert_allclose(y.asnumpy(), [-1.0, 0.0, 3.0])
+    # straight-through: dz/dx = 2*round(x) (identity through round)
+    onp.testing.assert_allclose(x.grad.asnumpy(), [-2.0, 0.0, 6.0])
+
+    x2 = NDArray(onp.array([-0.5, 0.0, 0.7], onp.float32))
+    x2.attach_grad()
+    with autograd.record():
+        s = invoke("_contrib_sign_ste", [x2])
+        z2 = mx.nd.sum(s * NDArray(onp.array([1., 2., 3.], onp.float32)))
+    z2.backward()
+    onp.testing.assert_allclose(s.asnumpy(), [-1.0, 0.0, 1.0])
+    onp.testing.assert_allclose(x2.grad.asnumpy(), [1.0, 2.0, 3.0])
+
+
+def test_index_add_accumulates_duplicates():
+    data = NDArray(onp.zeros((4, 2), onp.float32))
+    idx = NDArray(onp.array([1, 1, 3], onp.int32))
+    upd = NDArray(onp.ones((3, 2), onp.float32))
+    out = invoke("_contrib_index_add", [data, idx, upd]).asnumpy()
+    onp.testing.assert_allclose(out, [[0, 0], [2, 2], [0, 0], [1, 1]])
+
+
+def test_edge_id():
+    # graph: 0->1 (e0), 0->2 (e1), 2->0 (e2)
+    indptr = NDArray(onp.array([0, 2, 2, 3], onp.int32))
+    indices = NDArray(onp.array([1, 2, 0], onp.int32))
+    data = NDArray(onp.array([10., 20., 30.], onp.float32))
+    u = NDArray(onp.array([0, 0, 2, 1], onp.int32))
+    v = NDArray(onp.array([2, 1, 0, 0], onp.int32))
+    out = invoke("_contrib_edge_id", [indptr, indices, data, u, v]).asnumpy()
+    onp.testing.assert_allclose(out, [20., 10., 30., -1.])
+
+
+def _hawkes_reference(lda, alpha, beta, state, lags, marks, vlen, mt):
+    """Direct numpy port of the reference kernel loop (hawkes_ll-inl.h
+    hawkesll_forward + compensator)."""
+    N, K = lda.shape
+    ll = onp.zeros(N)
+    out_state = state.copy().astype(onp.float64)
+    last = onp.zeros((N, K))
+    for i in range(N):
+        t = 0.0
+        for j in range(int(vlen[i])):
+            ci = int(marks[i, j])
+            t += lags[i, j]
+            d = t - last[i, ci]
+            ed = onp.exp(-beta[ci] * d)
+            lam = lda[i, ci] + alpha[ci] * beta[ci] * out_state[i, ci] * ed
+            comp = lda[i, ci] * d + alpha[ci] * out_state[i, ci] * (1 - ed)
+            ll[i] += onp.log(lam) - comp
+            out_state[i, ci] = 1 + out_state[i, ci] * ed
+            last[i, ci] = t
+        for k in range(K):
+            d = mt[i] - last[i, k]
+            ed = onp.exp(-beta[k] * d)
+            ll[i] -= lda[i, k] * d + alpha[k] * out_state[i, k] * (1 - ed)
+            out_state[i, k] *= ed
+    return ll, out_state
+
+
+def test_hawkesll_matches_reference_loop():
+    rng = onp.random.RandomState(2)
+    N, T, K = 4, 5, 3
+    lda = rng.rand(N, K).astype(onp.float32) + 1.0
+    alpha = (rng.rand(K).astype(onp.float32) * 0.5)
+    beta = rng.rand(K).astype(onp.float32) + 0.5
+    state = rng.rand(N, K).astype(onp.float32)
+    lags = rng.rand(N, T).astype(onp.float32) + 0.1
+    marks = rng.randint(0, K, (N, T)).astype(onp.int32)
+    vlen = onp.array([1, 3, 5, 0], onp.float32)
+    mt = onp.full((N,), 100.0, onp.float32)
+
+    ll, out_state = invoke(
+        "_contrib_hawkesll",
+        [NDArray(lda), NDArray(alpha), NDArray(beta), NDArray(state),
+         NDArray(lags), NDArray(marks), NDArray(vlen), NDArray(mt)])
+    ref_ll, ref_state = _hawkes_reference(lda, alpha, beta, state, lags,
+                                          marks, vlen, mt)
+    onp.testing.assert_allclose(ll.asnumpy(), ref_ll, rtol=1e-4)
+    onp.testing.assert_allclose(out_state.asnumpy(), ref_state, rtol=1e-4,
+                                atol=1e-6)
+
+
+def test_hawkesll_gradients_flow():
+    rng = onp.random.RandomState(3)
+    N, T, K = 2, 4, 2
+    lda = NDArray(rng.rand(N, K).astype(onp.float32) + 1.0)
+    alpha = NDArray(rng.rand(K).astype(onp.float32) * 0.5)
+    beta = NDArray(rng.rand(K).astype(onp.float32) + 0.5)
+    state = NDArray(onp.zeros((N, K), onp.float32))
+    lags = NDArray(rng.rand(N, T).astype(onp.float32) + 0.1)
+    marks = NDArray(rng.randint(0, K, (N, T)).astype(onp.int32))
+    vlen = NDArray(onp.full((N,), T, onp.float32))
+    mt = NDArray(onp.full((N,), 10.0, onp.float32))
+    for p in (lda, alpha, beta):
+        p.attach_grad()
+    with autograd.record():
+        ll, _ = invoke("_contrib_hawkesll",
+                       [lda, alpha, beta, state, lags, marks, vlen, mt])
+        obj = mx.nd.sum(ll)
+    obj.backward()
+    assert onp.isfinite(lda.grad.asnumpy()).all()
+    assert abs(lda.grad.asnumpy()).sum() > 0
+    assert abs(beta.grad.asnumpy()).sum() > 0
+
+
+def test_hawkesll_padding_marks_no_nan():
+    """Out-of-range padding marks beyond valid_length must not poison
+    the loglike with nan (0 * -inf guard)."""
+    N, T, K = 2, 4, 2
+    lda = NDArray(onp.ones((N, K), onp.float32))
+    alpha = NDArray(onp.full(K, 0.3, onp.float32))
+    beta = NDArray(onp.ones(K, onp.float32))
+    state = NDArray(onp.zeros((N, K), onp.float32))
+    lags = NDArray(onp.ones((N, T), onp.float32))
+    marks = onp.zeros((N, T), onp.int32)
+    marks[:, 2:] = -1                     # padding convention
+    vlen = NDArray(onp.full(N, 2.0, onp.float32))
+    mt = NDArray(onp.full(N, 10.0, onp.float32))
+    ll, st = invoke("_contrib_hawkesll",
+                    [lda, alpha, beta, state, lags, NDArray(marks),
+                     vlen, mt])
+    assert onp.isfinite(ll.asnumpy()).all()
+    assert onp.isfinite(st.asnumpy()).all()
