@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# CI entrypoint (parity: ci/docker/runtime_functions.sh — one script of
+# named build/test functions).  Usage: ci/run.sh <function> [args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+build_native() {      # build the C++ runtime pieces (engine, io)
+    make -C src_native
+}
+
+unit_tests() {        # full suite on the 8-device virtual CPU mesh
+    python -m pytest tests/ -x -q "$@"
+}
+
+quick_tests() {       # smoke slice for fast iteration
+    python -m pytest tests/test_ndarray.py tests/test_autograd.py \
+        tests/test_gluon.py tests/test_symbol.py -q "$@"
+}
+
+multichip_dryrun() {  # dp/tp/pp/sp/ep shardings on virtual devices
+    python -c "import __graft_entry__ as g; g.dryrun_multichip(${1:-8})"
+}
+
+opperf_smoke() {      # operator micro-bench sanity (CPU)
+    JAX_PLATFORMS=cpu python -m benchmark.opperf \
+        --ops exp,dot,Convolution,FullyConnected,softmax --runs 3 --warmup 1
+}
+
+bench() {             # the driver benchmark (real TPU when present)
+    python bench.py
+}
+
+sanitize() {          # import + compile sanity, no test run
+    python -c "import mxnet_tpu; print('import OK', mxnet_tpu.__version__)"
+    python -m compileall -q mxnet_tpu benchmark tools
+}
+
+"$@"

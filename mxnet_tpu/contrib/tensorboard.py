@@ -1,0 +1,50 @@
+"""TensorBoard logging bridge.
+
+Parity: python/mxnet/contrib/tensorboard.py (LogMetricsCallback over
+mxboard).  The TPU build delegates to any available SummaryWriter —
+mxboard if present, else torch.utils.tensorboard (in the standard
+image) — and fails with an actionable message otherwise.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["LogMetricsCallback"]
+
+
+def _summary_writer(logging_dir):
+    try:
+        from mxboard import SummaryWriter        # reference's backend
+        return SummaryWriter(logging_dir)
+    except ImportError:
+        pass
+    try:
+        from torch.utils.tensorboard import SummaryWriter
+        return SummaryWriter(logging_dir)
+    except ImportError as e:
+        raise MXNetError(
+            "LogMetricsCallback needs a SummaryWriter backend: install "
+            "mxboard (`pip install mxboard`) or tensorboard "
+            f"({e})") from e
+
+
+class LogMetricsCallback:
+    """Batch/epoch-end callback writing eval-metric scalars as
+    TensorBoard events (parity: contrib/tensorboard.py:25)."""
+
+    def __init__(self, logging_dir: str, prefix: str | None = None):
+        self.prefix = prefix
+        self.summary_writer = _summary_writer(logging_dir)
+
+    def __call__(self, param):
+        if getattr(param, "eval_metric", None) is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            if self.prefix is not None:
+                name = f"{self.prefix}-{name}"
+            self.summary_writer.add_scalar(
+                name, value, global_step=getattr(param, "epoch", 0))
+        self.summary_writer.flush()
+
+    def close(self):
+        self.summary_writer.close()

@@ -67,11 +67,72 @@ class KVStoreBase:
     def barrier(self):
         pass
 
+    def send_command_to_servers(self, head, body=""):
+        """Broadcast a (head, body) command to the server role (parity:
+        kvstore.h:440 SendCommandToServers — used e.g. for server-side
+        profiler control).  In the TPU build the PS role is dissolved
+        into every process, so the default applies the command locally;
+        dist stores synchronize it across processes."""
+        _run_server_command(head, body)
+
+    def get_num_dead_node(self, node_id=0, timeout=60) -> int:
+        """Failure-detection probe (parity: kvstore.h:408 ps-lite
+        heartbeats).  jax.distributed has no heartbeat API — a dead
+        process surfaces as a collective error and checkpoint/resume is
+        the recovery story (SURVEY §5) — so a reachable store reports 0."""
+        return 0
+
     def save_optimizer_states(self, fname, dump_optimizer=False):
         raise NotImplementedError
 
     def load_optimizer_states(self, fname):
         raise NotImplementedError
+
+
+# server-command dispatch (parity: kvstore_dist_server.h CommandHandle):
+# head → handler(body).  Built-ins cover server-side profiler control
+# the way tests/nightly/test_server_profiling.py drives it.
+_COMMANDS: Dict[str, Any] = {}
+
+
+def register_server_command(head: str):
+    def deco(fn):
+        _COMMANDS[head] = fn
+        return fn
+    return deco
+
+
+def _run_server_command(head, body):
+    handler = _COMMANDS.get(str(head))
+    if handler is None:
+        raise MXNetError(f"unknown server command {head!r}; "
+                         f"known: {sorted(_COMMANDS)}")
+    handler(body)
+
+
+@register_server_command("profiler_set_config")
+def _cmd_profiler_config(body):
+    import json as _json
+    from .. import profiler
+    profiler.set_config(**(_json.loads(body) if body else {}))
+
+
+@register_server_command("profiler_start")
+def _cmd_profiler_start(body):
+    from .. import profiler
+    profiler.start()
+
+
+@register_server_command("profiler_stop")
+def _cmd_profiler_stop(body):
+    from .. import profiler
+    profiler.stop()
+
+
+@register_server_command("profiler_dump")
+def _cmd_profiler_dump(body):
+    from .. import profiler
+    profiler.dump()
 
 
 def create(name: str = "local", **kwargs) -> KVStoreBase:

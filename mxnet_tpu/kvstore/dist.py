@@ -254,6 +254,18 @@ class DistKVStore(KVStoreBase):
             from jax.experimental import multihost_utils
             multihost_utils.sync_global_devices("mxnet_tpu.kvstore.barrier")
 
+    def send_command_to_servers(self, head, body=""):
+        """Apply the command on the local process's server shard — in
+        the dissolved-PS design every process holds 1/N of the server
+        state, so the command reaches "its" server locally (parity:
+        kvstore_dist_server.h CommandHandle).  Deliberately NOT a
+        collective: the reference API is routinely called from rank 0
+        only, and a hidden barrier would deadlock that pattern.  To
+        command every shard, call on every rank (e.g. outside a rank
+        guard)."""
+        from .base import _run_server_command
+        _run_server_command(head, body)
+
     def set_optimizer(self, optimizer):
         """Enable update_on_kvstore: the optimizer runs *inside* the
         store with 1/N-sharded state (see _sharded_update)."""

@@ -52,6 +52,29 @@ def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
     return jax.device_put(jnp.asarray(np_arr), dev)
 
 
+# traced-scalar twins of the *_scalar ops for operator sugar: the
+# scalar rides as a device argument (one compiled executable serves
+# every value) instead of a static param (which would compile per
+# value).  One Operator instance per name → stable fn identity, so the
+# dispatch funnel's forward/backward caches and the profiler all engage.
+_SUGAR_OPS: dict = {}
+
+
+def _scalar_sugar_op(sname: str):
+    op = _SUGAR_OPS.get(sname)
+    if op is None:
+        from ..ops.legacy import scalar_ufunc
+        f, rev, logic = scalar_ufunc(sname)
+
+        def fn(x, s, _f=f, _rev=rev, _logic=logic):
+            out = _f(s, x) if _rev else _f(x, s)
+            return out.astype(x.dtype) if _logic else out
+
+        fn.__name__ = sname
+        op = _SUGAR_OPS[sname] = _reg.Operator(sname, fn)
+    return op
+
+
 class NDArray:
     """Multi-dimensional array on a device, with autograd hooks.
 
@@ -243,12 +266,43 @@ class NDArray:
         return apply_jax(lambda d: d[key], [self])
 
     # -- arithmetic --------------------------------------------------------
+    # scalar sugar routes through the registered *_scalar ops so it hits
+    # the same dispatch funnel as named ops (profiler hook + compiled-
+    # executable cache), exactly like the reference's scalar op rewrite
+    # (python/mxnet/ndarray/ndarray.py _ufunc_helper)
+    _SCALAR_OPS = {
+        ("elemwise_add", False): "_plus_scalar",
+        ("elemwise_add", True): "_plus_scalar",
+        ("elemwise_sub", False): "_minus_scalar",
+        ("elemwise_sub", True): "_rminus_scalar",
+        ("elemwise_mul", False): "_mul_scalar",
+        ("elemwise_mul", True): "_mul_scalar",
+        ("elemwise_div", False): "_div_scalar",
+        ("elemwise_div", True): "_rdiv_scalar",
+        ("broadcast_mod", False): "_mod_scalar",
+        ("broadcast_mod", True): "_rmod_scalar",
+        ("broadcast_power", False): "_power_scalar",
+        ("broadcast_power", True): "_rpower_scalar",
+        ("broadcast_equal", False): "_equal_scalar",
+        ("broadcast_not_equal", False): "_not_equal_scalar",
+        ("broadcast_greater", False): "_greater_scalar",
+        ("broadcast_greater_equal", False): "_greater_equal_scalar",
+        ("broadcast_lesser", False): "_lesser_scalar",
+        ("broadcast_lesser_equal", False): "_lesser_equal_scalar",
+    }
+
     def _binop(self, other, name, reverse=False):
         if isinstance(other, NDArray):
             a, b = (other, self) if reverse else (self, other)
             return invoke(name, [a, b])
         if isinstance(other, (numbers.Number, onp.number)):
             c = other
+            if not isinstance(c, bool):
+                sname = self._SCALAR_OPS.get((name, bool(reverse)))
+                if sname is not None:
+                    op = _scalar_sugar_op(sname)
+                    s = NDArray(jnp.asarray(c, self._data.dtype))
+                    return _reg.dispatch(op, [self, s], {})
             op = _reg.get(name).fn
             if reverse:
                 return apply_jax(lambda x: op(jnp.asarray(c, x.dtype)

@@ -53,6 +53,17 @@ _SCALAR = {
     "_scatter_minus_scalar": (jnp.subtract, False),
 }
 
+def scalar_ufunc(name):
+    """(ufunc, reversed, returns_input_dtype) for a ``*_scalar`` op —
+    lets the NDArray operator sugar build traced-scalar twins of these
+    ops (ndarray.py _binop) without duplicating the table."""
+    f, rev = _SCALAR[name]
+    logic = f in (jnp.equal, jnp.not_equal, jnp.greater,
+                  jnp.greater_equal, jnp.less, jnp.less_equal,
+                  jnp.logical_and, jnp.logical_or, jnp.logical_xor)
+    return f, rev, logic
+
+
 for _name, (_fn, _rev) in _SCALAR.items():
     def _make_scalar(f, rev, logic):
         def op(a, *, scalar=0.0):

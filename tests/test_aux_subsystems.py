@@ -1,0 +1,90 @@
+"""Auxiliary subsystems: tensorboard bridge, kvstore server commands,
+failure-detection probe (SURVEY §5 parity).
+"""
+import glob
+import json
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+
+
+def test_tensorboard_callback_writes_events(tmp_path):
+    pytest.importorskip("torch.utils.tensorboard")
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from mxnet_tpu.callback import BatchEndParam
+    from mxnet_tpu.gluon.metric import Accuracy
+
+    metric = Accuracy()
+    metric.update(mx.nd.array(onp.array([0, 1], onp.float32)),
+                  mx.nd.array(onp.array([[.9, .1], [.2, .8]], onp.float32)))
+    cb = LogMetricsCallback(str(tmp_path / "logs"), prefix="train")
+    cb(BatchEndParam(epoch=3, nbatch=1, eval_metric=metric, locals=None))
+    cb.close()
+    events = glob.glob(str(tmp_path / "logs" / "events.out.tfevents.*"))
+    assert events and os.path.getsize(events[0]) > 0
+
+
+def test_server_command_profiler_roundtrip():
+    from mxnet_tpu import profiler
+    kv = mx.kv.create("local")
+    kv.send_command_to_servers("profiler_set_config",
+                               json.dumps({"profile_all": True,
+                                           "aggregate_stats": True}))
+    kv.send_command_to_servers("profiler_start")
+    _ = (mx.nd.array(onp.ones(4, onp.float32)) * 2).asnumpy()
+    kv.send_command_to_servers("profiler_stop")
+    table = profiler.dumps(reset=True)
+    assert "_mul_scalar" in table or "Profile Statistics" in table
+
+
+def test_server_command_unknown_errors():
+    kv = mx.kv.create("local")
+    with pytest.raises(MXNetError, match="unknown server command"):
+        kv.send_command_to_servers("no_such_command")
+
+
+def test_get_num_dead_node():
+    kv = mx.kv.create("local")
+    assert kv.get_num_dead_node(node_id=0, timeout=1) == 0
+
+
+def test_custom_server_command_registration():
+    from mxnet_tpu.kvstore.base import register_server_command
+    seen = {}
+
+    @register_server_command("test_cmd_xyz")
+    def _h(body):
+        seen["body"] = body
+
+    kv = mx.kv.create("local")
+    kv.send_command_to_servers("test_cmd_xyz", "payload")
+    assert seen == {"body": "payload"}
+
+
+def test_scalar_sugar_hits_profiler_and_cache():
+    """x*2+1 routes through the registered scalar ops: profiled and
+    compile-cached like named ops (was a raw-lambda blind spot)."""
+    from mxnet_tpu import profiler
+    from mxnet_tpu.ops import registry
+    profiler.set_config(profile_all=True, aggregate_stats=True)
+    profiler.start()
+    x = mx.nd.array(onp.ones(4, onp.float32))
+    ((x * 2) + 1).wait_to_read()
+    profiler.stop()
+    table = profiler.dumps(reset=True)
+    assert "_mul_scalar" in table and "_plus_scalar" in table
+    # the scalar is a traced argument: many values, ONE cache entry
+    from mxnet_tpu.ndarray.ndarray import _SUGAR_OPS
+    op = _SUGAR_OPS["_mul_scalar"]
+    before = len(op._partials)
+    for i in range(20):
+        _ = x * (1.0 + i * 0.1)
+    assert len(op._partials) == max(before, 1)
+    # int arrays keep their dtype (scalar cast to array dtype)
+    xi = mx.nd.array(onp.array([1, 2], onp.int32))
+    assert str((xi * 2).dtype) == "int32"
+    onp.testing.assert_allclose((xi * 2).asnumpy(), [2, 4])
