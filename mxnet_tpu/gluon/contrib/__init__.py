@@ -1,5 +1,6 @@
 """gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
 from . import estimator
+from . import nn
 from .layers import (SyncBatchNorm, PixelShuffle1D, PixelShuffle2D,
                      PixelShuffle3D, HybridConcurrent, Concurrent, Identity)
 from . import rnn_cells

@@ -1,0 +1,8 @@
+"""gluon.contrib.nn — reference-path re-export of the contrib layers
+(parity: python/mxnet/gluon/contrib/nn/basic_layers.py)."""
+from ..layers import (Concurrent, HybridConcurrent, Identity,
+                      PixelShuffle1D, PixelShuffle2D, PixelShuffle3D,
+                      SyncBatchNorm)
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity", "PixelShuffle1D",
+           "PixelShuffle2D", "PixelShuffle3D", "SyncBatchNorm"]

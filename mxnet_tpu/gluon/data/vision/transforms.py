@@ -17,10 +17,13 @@ from ....ops.registry import apply_jax
 from ...block import Block, HybridBlock
 from ...nn import Sequential
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+__all__ = ["Compose", "HybridCompose", "Cast", "ToTensor", "Normalize",
+           "Resize", "CenterCrop", "CropResize", "RandomCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
            "RandomBrightness", "RandomContrast", "RandomSaturation",
-           "RandomLighting", "RandomColorJitter"]
+           "RandomLighting", "RandomColorJitter", "RandomApply",
+           "HybridRandomApply", "RandomGray", "RandomHue", "Rotate",
+           "RandomRotation"]
 
 
 class Compose(Sequential):
@@ -226,3 +229,235 @@ class RandomColorJitter(HybridBlock):
         for t in ts:
             x = t(x)
         return x
+
+
+def _resize_method(interpolation):
+    """cv2-style interp code → jax.image.resize method."""
+    return "nearest" if interpolation == 0 else "linear"
+
+
+class HybridCompose(Compose):
+    """Parity: transforms.HybridCompose — a Compose that hybridizes its
+    chain (the jit/CachedOp path)."""
+
+    def __init__(self, transforms):
+        super().__init__(transforms)
+        self.hybridize()
+
+
+class RandomApply(Block):
+    """Apply ``transform`` with probability p (parity: RandomApply)."""
+
+    def __init__(self, transforms, p=0.5):
+        super().__init__()
+        self.transforms = transforms
+        self.p = p
+
+    def forward(self, x):
+        if pyrandom.random() < self.p:
+            return self.transforms(x)
+        return x
+
+
+class HybridRandomApply(RandomApply):
+    """Parity: HybridRandomApply.  The choice stays host-side (the
+    reference uses sym.random.uniform + where; here transforms run
+    eagerly between jit steps, so a host coin is the same semantics)."""
+
+
+class RandomCrop(Block):
+    """Random crop with optional padding (parity: RandomCrop over
+    image random_crop + copyMakeBorder).  Sources smaller than the crop
+    upsample first, like the reference's random_crop."""
+
+    def __init__(self, size, pad=None, pad_value=0, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else tuple(size)
+        if pad is not None and not isinstance(pad, int) and len(pad) != 4:
+            raise ValueError(
+                f"RandomCrop pad must be an int or a 4-tuple (t,b,l,r), "
+                f"got {pad!r}")
+        self._pad = (pad,) * 4 if isinstance(pad, int) else pad
+        self._pad_value = pad_value
+        self._interp = _resize_method(interpolation)
+
+    def forward(self, x):
+        w, h = self._size
+        if self._pad:
+            t, b, l, r = self._pad
+            pads = [(0, 0)] * (x.ndim - 3) + [(t, b), (l, r), (0, 0)]
+            x = apply_jax(lambda a: jnp.pad(
+                a, pads, constant_values=self._pad_value), [x])
+        H, W = x.shape[-3], x.shape[-2]
+        if H < h or W < w:      # upsample small sources, then crop
+            scale = max(h / H, w / W)
+            nh, nw = max(h, int(round(H * scale))), \
+                max(w, int(round(W * scale)))
+            interp = self._interp
+
+            def up(a):
+                import jax
+                out = jax.image.resize(
+                    a.astype(jnp.float32),
+                    a.shape[:-3] + (nh, nw, a.shape[-1]), interp)
+                return out.astype(a.dtype) if jnp.issubdtype(
+                    a.dtype, jnp.floating) else jnp.clip(
+                    out, 0, 255).astype(a.dtype)
+            x = apply_jax(up, [x])
+            H, W = nh, nw
+        y0 = pyrandom.randint(0, max(H - h, 0)) if H > h else 0
+        x0 = pyrandom.randint(0, max(W - w, 0)) if W > w else 0
+        return apply_jax(lambda a: a[..., y0:y0 + h, x0:x0 + w, :], [x])
+
+
+class CropResize(HybridBlock):
+    """Fixed crop then resize (parity: transforms.CropResize)."""
+
+    def __init__(self, x0, y0, width, height, size=None, interpolation=1):
+        super().__init__()
+        self._box = (int(x0), int(y0), int(width), int(height))
+        self._size = ((size, size) if isinstance(size, int)
+                      else tuple(size) if size else None)
+        self._interp = _resize_method(interpolation)
+
+    def forward(self, x):
+        import jax
+        x0, y0, w, h = self._box
+        size = self._size
+
+        def fn(a):
+            crop = a[..., y0:y0 + h, x0:x0 + w, :]
+            if size is None:
+                return crop
+            ow, oh = size
+            return jax.image.resize(
+                crop.astype(jnp.float32),
+                crop.shape[:-3] + (oh, ow, crop.shape[-1]), self._interp)
+        return apply_jax(fn, [x])
+
+
+class RandomGray(Block):
+    """Convert to 3-channel grayscale with probability p (parity:
+    transforms.RandomGray)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if pyrandom.random() >= self.p:
+            return x
+
+        def fn(a):
+            lum = (0.299 * a[..., 0] + 0.587 * a[..., 1]
+                   + 0.114 * a[..., 2]).astype(a.dtype)
+            return jnp.stack([lum, lum, lum], axis=-1)
+        return apply_jax(fn, [x])
+
+
+class RandomHue(Block):
+    """Random hue jitter in [max(0,1-hue), 1+hue] (parity: RandomHue
+    over image random_hue — the reference's fast YIQ-rotation
+    approximation)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        alpha = pyrandom.uniform(max(0.0, 1 - self._h), 1 + self._h)
+        import math
+        u = math.cos(alpha * math.pi)
+        w = math.sin(alpha * math.pi)
+        t_yiq = jnp.asarray([[0.299, 0.587, 0.114],
+                             [0.596, -0.274, -0.321],
+                             [0.211, -0.523, 0.311]], jnp.float32)
+        t_rgb = jnp.asarray([[1.0, 0.956, 0.621],
+                             [1.0, -0.272, -0.647],
+                             [1.0, -1.107, 1.705]], jnp.float32)
+        rot = jnp.asarray([[1.0, 0.0, 0.0],
+                           [0.0, u, -w],
+                           [0.0, w, u]], jnp.float32)
+        m = t_rgb @ rot @ t_yiq
+
+        def fn(a):
+            out = jnp.einsum("...c,kc->...k", a.astype(jnp.float32), m)
+            return out.astype(a.dtype) if jnp.issubdtype(
+                a.dtype, jnp.floating) else jnp.clip(out, 0, 255).astype(
+                a.dtype)
+        return apply_jax(fn, [x])
+
+
+class Rotate(HybridBlock):
+    """Rotate by a fixed angle in degrees (parity: transforms.Rotate
+    over image imrotate; bilinear sampling, zeros outside)."""
+
+    def __init__(self, rotation_degrees, zoom_in=False, zoom_out=False):
+        super().__init__()
+        self._deg = rotation_degrees
+        self._zoom_in, self._zoom_out = zoom_in, zoom_out
+
+    def forward(self, x):
+        return _rotate(x, self._deg, self._zoom_in, self._zoom_out)
+
+
+class RandomRotation(Block):
+    """Uniform random rotation from [lo, hi] degrees (parity:
+    transforms.RandomRotation)."""
+
+    def __init__(self, angle_limits, zoom_in=False, zoom_out=False,
+                 rotate_with_proba=1.0):
+        super().__init__()
+        self._limits = tuple(angle_limits)
+        self._p = rotate_with_proba
+        self._zoom_in, self._zoom_out = zoom_in, zoom_out
+
+    def forward(self, x):
+        if pyrandom.random() >= self._p:
+            return x
+        return _rotate(x, pyrandom.uniform(*self._limits),
+                       self._zoom_in, self._zoom_out)
+
+
+def _rotate(x, degrees, zoom_in=False, zoom_out=False):
+    """Bilinear rotation about the image center (HWC or NHWC).
+    zoom_in scales so no fill pixels remain visible; zoom_out scales so
+    the whole source fits the canvas (parity: image.imrotate)."""
+    import math
+
+    rad = math.radians(degrees)
+    c, s = math.cos(rad), math.sin(rad)
+    if zoom_in and zoom_out:
+        raise ValueError("zoom_in and zoom_out are mutually exclusive")
+    k = abs(c) + abs(s)
+    zoom = (1.0 / k) if zoom_in else (k if zoom_out else 1.0)
+    c, s = c * zoom, s * zoom
+    H, W = x.shape[-3], x.shape[-2]
+
+    def fn(a):
+        yy = jnp.arange(H, dtype=jnp.float32) - (H - 1) / 2.0
+        xx = jnp.arange(W, dtype=jnp.float32) - (W - 1) / 2.0
+        gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
+        # inverse-rotate output coords into source space
+        sx = c * gx + s * gy + (W - 1) / 2.0
+        sy = -s * gx + c * gy + (H - 1) / 2.0
+        x0 = jnp.floor(sx); y0 = jnp.floor(sy)
+        wx = sx - x0; wy = sy - y0
+
+        af = a.astype(jnp.float32)
+
+        def samplef(yi, xi):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yi = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            v = af[..., yi, xi, :]
+            return v * inb[..., None]
+
+        out = (samplef(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+               + samplef(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+               + samplef(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+               + samplef(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+        return out.astype(a.dtype) if jnp.issubdtype(
+            a.dtype, jnp.floating) else jnp.clip(out, 0, 255).astype(a.dtype)
+
+    return apply_jax(fn, [x])

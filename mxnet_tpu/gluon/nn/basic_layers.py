@@ -19,6 +19,7 @@ from ..block import Block, HybridBlock, current_trace
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "BatchNormReLU",
            "SyncBatchNorm", "Embedding", "Flatten", "LayerNorm", "GroupNorm",
            "InstanceNorm", "Lambda", "HybridLambda", "Identity", "Activation",
            "LeakyReLU", "PReLU", "ELU", "SELU", "GELU", "Swish", "SiLU",
@@ -219,6 +220,16 @@ class BatchNorm(HybridBlock):
     def __repr__(self):
         return f"BatchNorm(axis={self._axis}, momentum={self._momentum}, " \
                f"eps={self._epsilon})"
+
+
+class BatchNormReLU(BatchNorm):
+    """Fused BatchNorm+ReLU (parity: nn.BatchNormReLU,
+    basic_layers.py).  On TPU the fusion is XLA's: relu composes onto
+    the normalization in the same kernel under jit."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return invoke("relu", [out])
 
 
 class SyncBatchNorm(BatchNorm):

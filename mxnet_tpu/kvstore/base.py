@@ -71,8 +71,9 @@ class KVStoreBase:
         """Broadcast a (head, body) command to the server role (parity:
         kvstore.h:440 SendCommandToServers — used e.g. for server-side
         profiler control).  In the TPU build the PS role is dissolved
-        into every process, so the default applies the command locally;
-        dist stores synchronize it across processes."""
+        into every process, so the command applies to the local
+        process's server shard; call on every rank to command every
+        shard (it is NOT a collective — see DistKVStore)."""
         _run_server_command(head, body)
 
     def get_num_dead_node(self, node_id=0, timeout=60) -> int:

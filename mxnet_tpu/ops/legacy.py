@@ -71,10 +71,7 @@ for _name, (_fn, _rev) in _SCALAR.items():
             # legacy nd comparison/logical ops return the input dtype
             return out.astype(a.dtype) if logic else out
         return op
-    _logic = _fn in (jnp.equal, jnp.not_equal, jnp.greater,
-                     jnp.greater_equal, jnp.less, jnp.less_equal,
-                     jnp.logical_and, jnp.logical_or, jnp.logical_xor)
-    _f = _make_scalar(_fn, _rev, _logic)
+    _f = _make_scalar(*scalar_ufunc(_name))
     _f.__name__ = _name
     register(_name)(_f)
 

@@ -1,0 +1,105 @@
+"""New vision transforms (parity: gluon/data/vision/transforms/)."""
+import math
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.data.vision import transforms as T
+
+
+def _img(h=12, w=10, seed=0):
+    return mx.nd.array(onp.random.RandomState(seed)
+                       .randint(0, 255, (h, w, 3)).astype(onp.uint8))
+
+
+def test_random_crop_shape_and_pad():
+    out = T.RandomCrop(8)( _img())
+    assert out.shape == (8, 8, 3)
+    out = T.RandomCrop(16, pad=4)(_img())
+    assert out.shape == (16, 16, 3)
+
+
+def test_crop_resize_exact():
+    img = _img()
+    out = T.CropResize(2, 3, 6, 5)(img)
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   img.asnumpy()[3:8, 2:8])
+    out2 = T.CropResize(2, 3, 6, 5, size=(4, 4))(img)
+    assert out2.shape == (4, 4, 3)
+
+
+def test_random_gray_luminance():
+    img = _img()
+    out = T.RandomGray(1.0)(img).asnumpy()
+    assert out.shape == img.shape
+    onp.testing.assert_allclose(out[..., 0], out[..., 1])
+    onp.testing.assert_allclose(out[..., 1], out[..., 2])
+    # p=0 is identity
+    onp.testing.assert_array_equal(T.RandomGray(0.0)(img).asnumpy(),
+                                   img.asnumpy())
+
+
+def test_rotate_90_exact():
+    """90° rotation of a square image must permute pixels exactly (up
+    to the bilinear grid, which is exact at 90°)."""
+    img = mx.nd.array(onp.arange(5 * 5 * 3)
+                      .reshape(5, 5, 3).astype(onp.float32))
+    out = T.Rotate(90)(img).asnumpy()
+    ref = onp.rot90(img.asnumpy(), k=-1, axes=(0, 1))
+    onp.testing.assert_allclose(out, ref, atol=1e-3)
+
+
+def test_rotate_zero_identity():
+    img = _img()
+    onp.testing.assert_allclose(T.Rotate(0)(img).asnumpy(),
+                                img.asnumpy(), atol=1e-3)
+
+
+def test_random_rotation_bounds_and_proba():
+    img = _img()
+    out = T.RandomRotation((-10, 10))(img)
+    assert out.shape == img.shape
+    same = T.RandomRotation((-10, 10), rotate_with_proba=0.0)(img)
+    onp.testing.assert_array_equal(same.asnumpy(), img.asnumpy())
+
+
+def test_random_hue_preserves_gray():
+    """Hue rotation fixes the luma axis: a gray image is (nearly)
+    unchanged."""
+    img = mx.nd.array(onp.full((6, 6, 3), 100, onp.float32))
+    out = T.RandomHue(0.5)(img).asnumpy()
+    onp.testing.assert_allclose(out, img.asnumpy(), rtol=0.02, atol=1.5)
+
+
+def test_apply_and_compose():
+    img = _img()
+    chain = T.Compose([T.RandomApply(T.RandomGray(1.0), p=1.0),
+                       T.ToTensor()])
+    out = chain(img)
+    assert out.shape == (3, 12, 10)
+    hc = T.HybridCompose([T.ToTensor(), T.Normalize(0.5, 0.5)])
+    out2 = hc(img)
+    assert out2.shape == (3, 12, 10)
+
+
+def test_random_crop_upsamples_small_source():
+    out = T.RandomCrop(32)(_img(20, 20))
+    assert out.shape == (32, 32, 3)
+
+
+def test_random_crop_bad_pad_errors():
+    with pytest.raises(ValueError, match="4-tuple"):
+        T.RandomCrop(8, pad=(2, 4))
+
+
+def test_rotate_zoom_modes():
+    img = mx.nd.array(onp.full((16, 16, 3), 200, onp.float32))
+    # zoom_in: no fill pixels → all values stay near 200
+    zi = T.Rotate(45, zoom_in=True)(img).asnumpy()
+    assert zi.min() > 150
+    # no zoom: corners are zero-filled
+    nz = T.Rotate(45)(img).asnumpy()
+    assert nz.min() < 1.0
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        T.Rotate(30, zoom_in=True, zoom_out=True)(img)
