@@ -1,6 +1,9 @@
 """gluon.data.vision (parity: python/mxnet/gluon/data/vision/)."""
-from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, ImageFolderDataset
+from .datasets import (MNIST, FashionMNIST, CIFAR10, CIFAR100,
+                       ImageFolderDataset, ImageRecordDataset,
+                       ImageListDataset)
 from . import transforms
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
-           "ImageFolderDataset", "transforms"]
+           "ImageFolderDataset", "ImageRecordDataset", "ImageListDataset",
+           "transforms"]

@@ -16,10 +16,11 @@ from typing import Optional
 
 import numpy as onp
 
-from ...data.dataset import Dataset
+from ...data.dataset import Dataset, RecordFileDataset
 from ....ndarray import NDArray
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageListDataset",
            "ImageFolderDataset"]
 
 
@@ -179,3 +180,63 @@ class ImageFolderDataset(Dataset):
         if self._transform is not None:
             return self._transform(data, label)
         return data, label
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Dataset over a .rec of packed images (parity: datasets.py
+    ImageRecordDataset): item = (image NDArray, label)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ....image.image import imdecode
+        from ....recordio import unpack
+        record = super().__getitem__(idx)
+        header, payload = unpack(record)
+        label = header.label
+        # imdecode handles the BGR->RGB flip (reference parity:
+        # ImageRecordDataset returns RGB via image.imdecode)
+        img = imdecode(payload, flag=self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+
+class ImageListDataset(Dataset):
+    """Dataset over an explicit [(path-or-array, label), ...] list or a
+    .lst file (parity: datasets.py ImageListDataset)."""
+
+    def __init__(self, root=".", imglist=None, flag=1):
+        self._flag = flag
+        self._items = []
+        if isinstance(imglist, str):
+            with open(imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 3:
+                        continue
+                    label = [float(x) for x in parts[1:-1]]
+                    label = label[0] if len(label) == 1 else onp.asarray(
+                        label, onp.float32)
+                    self._items.append(
+                        (os.path.join(root, parts[-1]), label))
+        else:
+            # reference convention: each entry is [label, path-or-image]
+            for entry in (imglist or []):
+                label, src = entry[0], entry[1]
+                if isinstance(src, str):
+                    src = os.path.join(root, src)
+                self._items.append((src, label))
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        from ....image.image import imread
+        src, label = self._items[idx]
+        img = imread(src, self._flag) if isinstance(src, str) \
+            else NDArray(onp.asarray(src))
+        return img, label

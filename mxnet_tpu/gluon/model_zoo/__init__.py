@@ -1,5 +1,10 @@
-"""gluon.model_zoo (parity: python/mxnet/gluon/model_zoo/)."""
+"""gluon.model_zoo (parity: python/mxnet/gluon/model_zoo/; transformer
+is the TPU build's addition — the long-context flagship family)."""
 from . import vision
 from .vision import get_model
+from . import transformer
+from .transformer import (MultiHeadAttention, TransformerBlock,
+                          TransformerLM, get_transformer_lm)
 
-__all__ = ["vision", "get_model"]
+__all__ = ["vision", "get_model", "transformer", "MultiHeadAttention",
+           "TransformerBlock", "TransformerLM", "get_transformer_lm"]

@@ -1,0 +1,134 @@
+"""Transformer language-model family.
+
+TPU-native flagship for the long-context capability (SURVEY §5: the
+reference has no transformer models — its contrib ops
+`interleaved_matmul_*` exist for external toolkits like gluonnlp; this
+module is the in-tree model family those toolkits would have built).
+Attention rides the Pallas flash kernel (`multi_head_attention` op with
+causal masking); sequence parallelism composes via
+`parallel.ring_self_attention` and tensor parallelism via
+`Parameter.shard` on the projection weights.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as onp
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ops.registry import invoke
+from .. import nn
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["MultiHeadAttention", "TransformerBlock", "TransformerLM",
+           "get_transformer_lm"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Self-attention layer over the fused `multi_head_attention` op
+    (Pallas flash kernel underneath)."""
+
+    def __init__(self, units, num_heads, causal=False, use_flash=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by num_heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._causal = causal
+        self._flash = use_flash
+        self.qkv = nn.Dense(3 * units, use_bias=True, flatten=False)
+        self.out_proj = nn.Dense(units, use_bias=True, flatten=False)
+
+    def forward(self, x):
+        qkv = self.qkv(x)
+        u = self._units
+        q = qkv.slice_axis(axis=-1, begin=0, end=u)
+        k = qkv.slice_axis(axis=-1, begin=u, end=2 * u)
+        v = qkv.slice_axis(axis=-1, begin=2 * u, end=3 * u)
+        attn = invoke("multi_head_attention", [q, k, v],
+                      num_heads=self._heads, causal=self._causal,
+                      use_flash=self._flash)
+        return self.out_proj(attn)
+
+
+class TransformerBlock(HybridBlock):
+    """Pre-LN transformer block: LN→MHA→residual, LN→FFN(GELU)→residual."""
+
+    def __init__(self, units, num_heads, ffn_ratio=4, causal=True,
+                 dropout=0.0, use_flash=True, **kwargs):
+        super().__init__(**kwargs)
+        self.ln1 = nn.LayerNorm()
+        self.attn = MultiHeadAttention(units, num_heads, causal=causal,
+                                       use_flash=use_flash)
+        self.ln2 = nn.LayerNorm()
+        self.ffn1 = nn.Dense(ffn_ratio * units, flatten=False)
+        self.act = nn.GELU()
+        self.ffn2 = nn.Dense(units, flatten=False)
+        self.drop = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        h = self.attn(self.ln1(x))
+        if self.drop is not None:
+            h = self.drop(h)
+        x = x + h
+        h = self.ffn2(self.act(self.ffn1(self.ln2(x))))
+        if self.drop is not None:
+            h = self.drop(h)
+        return x + h
+
+
+class TransformerLM(HybridBlock):
+    """Decoder-only (causal) transformer LM.
+
+    Input (B, S) int token ids → logits (B, S, vocab).  Learned
+    positional embeddings; weight-tied output head optional.
+    """
+
+    def __init__(self, vocab_size, units=256, num_layers=4, num_heads=4,
+                 max_len=1024, ffn_ratio=4, dropout=0.0, tie_weights=False,
+                 use_flash=True, **kwargs):
+        super().__init__(**kwargs)
+        self._max_len = max_len
+        from ... import initializer
+        self.embed = nn.Embedding(vocab_size, units)
+        self.pos_embed = Parameter(
+            name="pos_embed", shape=(max_len, units),
+            init=initializer.Normal(0.02))
+        self.blocks = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.blocks.add(TransformerBlock(units, num_heads,
+                                             ffn_ratio=ffn_ratio,
+                                             causal=True, dropout=dropout,
+                                             use_flash=use_flash))
+        self.ln_f = nn.LayerNorm()
+        self._tied = tie_weights
+        if not tie_weights:
+            self.head = nn.Dense(vocab_size, use_bias=False, flatten=False)
+
+    def forward(self, tokens):
+        S = tokens.shape[-1]
+        if S > self._max_len:
+            raise MXNetError(f"sequence length {S} exceeds max_len "
+                             f"{self._max_len}")
+        x = self.embed(tokens)
+        pos = self.pos_embed.data().slice_axis(axis=0, begin=0, end=S)
+        x = x + pos.reshape((1, S, -1))
+        x = self.blocks(x)
+        x = self.ln_f(x)
+        if self._tied:
+            w = self.embed.weight.data()
+            return invoke("dot", [x.reshape((-1, x.shape[-1])), w],
+                          transpose_b=True).reshape(
+                tokens.shape + (w.shape[0],))
+        return self.head(x)
+
+
+def get_transformer_lm(vocab_size, units=256, num_layers=4, num_heads=4,
+                       **kwargs) -> TransformerLM:
+    """Factory (model-zoo style)."""
+    return TransformerLM(vocab_size, units=units, num_layers=num_layers,
+                         num_heads=num_heads, **kwargs)

@@ -49,6 +49,19 @@ class MXRecordIO:
             self._fp.close()
             self._fp = None
 
+    # pickling reopens the file in the target process (parity:
+    # recordio.py __getstate__/__setstate__ — required by multi-worker
+    # DataLoader, which pickles datasets holding readers)
+    def __getstate__(self):
+        if self.flag == "w":
+            raise MXNetError("cannot pickle a writable MXRecordIO")
+        state = {k: v for k, v in self.__dict__.items() if k != "_fp"}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self.open()
+
     def __del__(self):
         try:
             self.close()
