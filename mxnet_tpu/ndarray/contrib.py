@@ -255,6 +255,15 @@ def boolean_mask(data, index, axis: int = 0):
     return _apply(lambda d: _jnp.take(d, sel, axis=ax), [data])
 
 
+from .dgl import (dgl_csr_neighbor_uniform_sample,          # noqa: E402
+                  dgl_csr_neighbor_non_uniform_sample, dgl_subgraph,
+                  dgl_adjacency, dgl_graph_compact)
+
+__all__ += ["dgl_csr_neighbor_uniform_sample",
+            "dgl_csr_neighbor_non_uniform_sample", "dgl_subgraph",
+            "dgl_adjacency", "dgl_graph_compact"]
+
+
 def _populate_contrib():
     from ..ops import registry as _reg
     from .register import make_op_func

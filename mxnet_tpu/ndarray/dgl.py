@@ -1,0 +1,302 @@
+"""DGL graph operators (neighbor sampling / induced subgraph / adjacency /
+compaction).
+
+Parity: src/operator/contrib/dgl_graph.cc —
+``_contrib_dgl_csr_neighbor_uniform_sample`` (:761),
+``_contrib_dgl_csr_neighbor_non_uniform_sample`` (:866),
+``_contrib_dgl_subgraph`` (:1146), ``_contrib_dgl_adjacency`` (:1407),
+``_contrib_dgl_graph_compact`` (:1582).
+
+TPU-first notes: graph sampling is data-dependent, pointer-chasing host
+work that *feeds* the accelerator (the sampled blocks become dense
+gather/scatter + matmul on device) — the reference likewise runs these
+only as CPU FComputeEx kernels over CSR storage.  Our sparse storage is
+eager host-side (see ndarray/sparse.py), so these ops are vectorized
+numpy over (indptr, indices, data), keeping the reference's exact output
+contract: sampled-vertex arrays of length ``max_num_vertices+1`` whose
+last element is the true count, per-subgraph CSRs with rows in
+sorted-sampled-vertex order, and layer/probability side outputs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as onp
+
+from ..base import MXNetError
+from .ndarray import NDArray
+from .sparse import CSRNDArray
+
+__all__ = ["dgl_csr_neighbor_uniform_sample",
+           "dgl_csr_neighbor_non_uniform_sample",
+           "dgl_subgraph", "dgl_adjacency", "dgl_graph_compact"]
+
+
+def _csr_parts(csr: CSRNDArray):
+    if not isinstance(csr, CSRNDArray):
+        raise MXNetError("dgl ops expect a CSRNDArray graph, got "
+                         f"{type(csr).__name__}")
+    return (onp.asarray(csr.indptr, onp.int64),
+            onp.asarray(csr.indices, onp.int64),
+            onp.asarray(csr.data))
+
+
+def _as_1d_int(arr) -> onp.ndarray:
+    a = arr.asnumpy() if hasattr(arr, "asnumpy") else onp.asarray(arr)
+    return onp.asarray(a, onp.int64).reshape(-1)
+
+
+def _sample_subgraph(indptr, indices, data, seeds, probability,
+                     num_hops, num_neighbor, max_num_vertices, rng):
+    """BFS-sample one subgraph; returns (verts, layers, sub_csr parts,
+    num real vertices).  Mirrors SampleSubgraph (dgl_graph.cc:539-723):
+    dedup seeds at layer 0, expand each queued vertex whose layer <
+    num_hops by sampling ≤ num_neighbor of its out-edges, stop growing
+    once max_num_vertices distinct vertices are collected, then emit
+    vertices sorted ascending with rows of the sub-CSR in that order."""
+    if len(seeds) > max_num_vertices:
+        raise MXNetError("max_num_vertices must be >= number of seeds")
+    visited = {}
+    queue: List[tuple] = []
+    for s in seeds:
+        s = int(s)
+        if s not in visited:
+            visited[s] = 0
+            queue.append((s, 0))
+    neigh = {}
+    idx = 0
+    # Every queued vertex below the hop limit gets its neighbors sampled;
+    # the vertex budget only gates *adding* new vertices to the frontier
+    # (the reference's inner-loop break, dgl_graph.cc:630-642 — sampled
+    # edges are recorded even when their endpoint no longer fits).
+    while idx < len(queue):
+        vid, lvl = queue[idx]
+        idx += 1
+        if lvl >= num_hops:
+            continue
+        lo, hi = int(indptr[vid]), int(indptr[vid + 1])
+        cols = indices[lo:hi]
+        vals = data[lo:hi]
+        deg = hi - lo
+        if deg > num_neighbor:
+            if probability is None:
+                sel = onp.sort(rng.choice(deg, num_neighbor, replace=False))
+                scols, svals = cols[sel], vals[sel]
+            else:
+                p = onp.asarray(probability, onp.float64)[cols]
+                tot = p.sum()
+                if tot <= 0:
+                    raise MXNetError("probability mass of neighbors is 0")
+                sel = rng.choice(deg, num_neighbor, replace=False, p=p / tot)
+                # reference sorts sampled vertices and edges independently
+                # after heap sampling (GetNonUniformSample,
+                # dgl_graph.cc:507-520)
+                scols = onp.sort(cols[sel])
+                svals = onp.sort(vals[sel])
+        else:
+            scols, svals = cols, vals
+        neigh[vid] = (scols, svals)
+        for c in scols:
+            c = int(c)
+            if len(visited) >= max_num_vertices:
+                break
+            if c not in visited:
+                visited[c] = lvl + 1
+                queue.append((c, lvl + 1))
+
+    order = sorted(visited)
+    n = len(order)
+    verts = onp.zeros(max_num_vertices + 1, onp.int64)
+    layers = onp.zeros(max_num_vertices, onp.int64)
+    verts[:n] = order
+    verts[max_num_vertices] = n
+    layers[:n] = [visited[v] for v in order]
+
+    out_indptr = onp.zeros(max_num_vertices + 1, onp.int64)
+    cols_l, vals_l = [], []
+    for i, v in enumerate(order):
+        if v in neigh:
+            sc, sv = neigh[v]
+            cols_l.append(sc)
+            vals_l.append(sv)
+            out_indptr[i + 1] = out_indptr[i] + len(sc)
+        else:
+            out_indptr[i + 1] = out_indptr[i]
+    out_indptr[n + 1:] = out_indptr[n]
+    out_cols = (onp.concatenate(cols_l).astype(onp.int64) if cols_l
+                else onp.zeros(0, onp.int64))
+    out_vals = (onp.concatenate(vals_l) if vals_l
+                else onp.zeros(0, data.dtype))
+    return verts, layers, (out_vals, out_cols, out_indptr), n
+
+
+def _make_rng(seed=None):
+    if seed is None:
+        # derive host entropy from the global key chain so mx.random.seed
+        # makes sampling reproducible (parity: kRandom resource seeding)
+        import jax
+        from ..ops.random import next_key
+        seed = int(onp.asarray(
+            jax.random.key_data(next_key())).ravel()[-1]) & 0x7FFFFFFF
+    return onp.random.RandomState(seed)
+
+
+def dgl_csr_neighbor_uniform_sample(csr, *seed_arrays, num_args=None,
+                                    num_hops=1, num_neighbor=2,
+                                    max_num_vertices=100, seed=None):
+    """Uniform neighbor sampling over a CSR graph (parity:
+    dgl_graph.cc:761).  Returns, for S seed arrays, a flat list
+    ``[verts]*S + [sub_csr]*S + [layer]*S`` where each ``verts`` is
+    int64 of length ``max_num_vertices+1`` (last element = true vertex
+    count), ``sub_csr`` has shape ``(max_num_vertices, graph.shape[1])``
+    with rows in sorted-vertex order, and ``layer`` gives each vertex's
+    BFS layer."""
+    indptr, indices, data = _csr_parts(csr)
+    if num_args is not None and num_args != len(seed_arrays) + 1:
+        raise MXNetError("num_args must equal 1 + number of seed arrays")
+    rng = _make_rng(seed)
+    verts_out, csr_out, layer_out = [], [], []
+    for sarr in seed_arrays:
+        verts, layers, (v, c, p), _ = _sample_subgraph(
+            indptr, indices, data, _as_1d_int(sarr), None,
+            num_hops, num_neighbor, max_num_vertices, rng)
+        verts_out.append(NDArray(verts))
+        csr_out.append(CSRNDArray(v, c, p,
+                                  (max_num_vertices, csr.shape[1])))
+        layer_out.append(NDArray(layers))
+    return verts_out + csr_out + layer_out
+
+
+def dgl_csr_neighbor_non_uniform_sample(csr, probability, *seed_arrays,
+                                        num_args=None, num_hops=1,
+                                        num_neighbor=2,
+                                        max_num_vertices=100, seed=None):
+    """Non-uniform (per-vertex probability) neighbor sampling (parity:
+    dgl_graph.cc:866).  Output layout is
+    ``[verts]*S + [sub_csr]*S + [prob]*S + [layer]*S`` where ``prob``
+    holds the sampling probability of each sampled vertex."""
+    indptr, indices, data = _csr_parts(csr)
+    if num_args is not None and num_args != len(seed_arrays) + 2:
+        raise MXNetError("num_args must equal 2 + number of seed arrays")
+    prob = onp.asarray(
+        probability.asnumpy() if hasattr(probability, "asnumpy")
+        else probability, onp.float32).reshape(-1)
+    rng = _make_rng(seed)
+    verts_out, csr_out, prob_out, layer_out = [], [], [], []
+    for sarr in seed_arrays:
+        verts, layers, (v, c, p), n = _sample_subgraph(
+            indptr, indices, data, _as_1d_int(sarr), prob,
+            num_hops, num_neighbor, max_num_vertices, rng)
+        sp = onp.zeros(max_num_vertices, onp.float32)
+        sp[:n] = prob[verts[:n]]
+        verts_out.append(NDArray(verts))
+        csr_out.append(CSRNDArray(v, c, p,
+                                  (max_num_vertices, csr.shape[1])))
+        prob_out.append(NDArray(sp))
+        layer_out.append(NDArray(layers))
+    return verts_out + csr_out + prob_out + layer_out
+
+
+def dgl_subgraph(graph, *vertex_arrays, num_args=None,
+                 return_mapping=False):
+    """Induced subgraph(s) for sorted vertex lists (parity:
+    dgl_graph.cc:1146 GetSubgraph).  Vertices are renumbered
+    0..len(v)-1; edge data in the primary output is the *new* edge id
+    (dense row-major order); with ``return_mapping`` a second CSR per
+    input carries the original edge ids."""
+    indptr, indices, data = _csr_parts(graph)
+    if num_args is not None and num_args != len(vertex_arrays) + 1:
+        raise MXNetError("num_args must equal 1 + number of vertex arrays")
+    subs, maps = [], []
+    for varr in vertex_arrays:
+        vids = _as_1d_int(varr)
+        if not onp.all(vids[:-1] <= vids[1:]):
+            raise MXNetError("the input vertex list has to be sorted")
+        old2new = {int(v): i for i, v in enumerate(vids)}
+        n = len(vids)
+        out_indptr = onp.zeros(n + 1, onp.int64)
+        cols_l, eids_l = [], []
+        for i, v in enumerate(vids):
+            lo, hi = int(indptr[v]), int(indptr[v + 1])
+            row_cols = indices[lo:hi]
+            keep = [j for j, c in enumerate(row_cols) if int(c) in old2new]
+            cols_l.append(onp.asarray(
+                [old2new[int(row_cols[j])] for j in keep], onp.int64))
+            eids_l.append(data[lo:hi][keep])
+            out_indptr[i + 1] = out_indptr[i] + len(keep)
+        cols = (onp.concatenate(cols_l).astype(onp.int64) if cols_l
+                else onp.zeros(0, onp.int64))
+        orig = (onp.concatenate(eids_l) if eids_l
+                else onp.zeros(0, data.dtype))
+        new_ids = onp.arange(len(cols), dtype=data.dtype)
+        subs.append(CSRNDArray(new_ids, cols, out_indptr, (n, n)))
+        if return_mapping:
+            maps.append(CSRNDArray(orig, cols.copy(), out_indptr.copy(),
+                                   (n, n)))
+    return subs + maps if return_mapping else subs
+
+
+def dgl_adjacency(csr):
+    """CSR of edge ids → CSR adjacency of float32 ones (parity:
+    dgl_graph.cc:1407)."""
+    indptr, indices, data = _csr_parts(csr)
+    return CSRNDArray(onp.ones(len(data), onp.float32), indices.copy(),
+                      indptr.copy(), csr.shape)
+
+
+def dgl_graph_compact(*graph_data, graph_sizes, return_mapping=False,
+                      num_args=None):
+    """Compact sampler-produced CSRs (parity: dgl_graph.cc:1582
+    CompactSubgraph): drop trailing empty rows and renumber columns by
+    each graph's sampled-vertex list.
+
+    Inputs are ``g0..g{S-1}, vids0..vids{S-1}`` where each ``vids`` is
+    the sampler's vertex output (last element = true count, which must
+    equal the corresponding ``graph_sizes`` entry).  Primary outputs
+    hold new edge ids 0..nnz-1; with ``return_mapping`` the second set
+    keeps the input CSR's edge values (the reference declares this
+    output but leaves it unwritten — we fill it with the original
+    values, the useful contract)."""
+    if num_args is not None and num_args != len(graph_data):
+        raise MXNetError("num_args must equal number of graph_data inputs")
+    if len(graph_data) % 2 != 0:
+        raise MXNetError("graph_data must be graphs followed by vid arrays")
+    num_g = len(graph_data) // 2
+    sizes = ([int(s) for s in graph_sizes]
+             if isinstance(graph_sizes, (list, tuple, onp.ndarray))
+             else [int(graph_sizes)] * num_g)
+    if len(sizes) != num_g:
+        raise MXNetError("graph_sizes must have one entry per graph")
+    outs, maps = [], []
+    for i in range(num_g):
+        indptr, indices, data = _csr_parts(graph_data[i])
+        vids = _as_1d_int(graph_data[i + num_g])
+        size = sizes[i]
+        if int(vids[-1]) != size:
+            raise MXNetError(
+                f"graph_sizes[{i}]={size} does not match the vertex "
+                f"count {int(vids[-1])} recorded in the vid array")
+        id_map = {int(v): j for j, v in enumerate(vids[:size])}
+        new_indptr = indptr[:size + 1].copy()
+        nnz = int(new_indptr[-1])
+        try:
+            new_cols = onp.asarray([id_map[int(c)] for c in indices[:nnz]],
+                                   onp.int64)
+        except KeyError as e:
+            # the sampler records edges whose endpoint no longer fit the
+            # vertex budget (see dgl.py _sample_subgraph); such a graph
+            # cannot be compacted — reference CHECK-fails the same way
+            # (dgl_graph.cc:1498 CHECK(it != id_map.end()))
+            raise MXNetError(
+                f"graph {i} has an edge to vertex {e.args[0]} that is "
+                "not in its sampled-vertex list (sampling was truncated "
+                "by max_num_vertices); raise max_num_vertices so all "
+                "edge endpoints fit, or drop these edges before "
+                "compacting") from None
+        outs.append(CSRNDArray(onp.arange(nnz, dtype=onp.int64), new_cols,
+                               new_indptr, (size, size)))
+        if return_mapping:
+            maps.append(CSRNDArray(
+                onp.asarray(data[:nnz], onp.int64), new_cols.copy(),
+                new_indptr.copy(), (size, size)))
+    return outs + maps if return_mapping else outs

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as onp
 from jax import lax
 
+from ..base import MXNetError
 from .registry import register
 
 __all__ = []  # ops are exposed through the registry / nd namespaces
@@ -783,3 +784,182 @@ def _count_sketch(data, h, s, *, out_dim, processing_batch_size=32):
     vals = data * ss[None, :]
     out = jnp.zeros((data.shape[0], out_dim), data.dtype)
     return out.at[:, hh].add(vals)
+
+
+# -- RPN proposal generation (parity: contrib/proposal.cc,
+#    contrib/multi_proposal.cc) ---------------------------------------------
+
+def _rpn_base_anchors(stride, ratios, scales):
+    """Faster-RCNN base anchors (parity: proposal-inl.h:183-211
+    _MakeAnchor/_Transform): ratio-then-scale enumeration with the
+    legacy floor/round arithmetic, centered on the stride-1 window."""
+    import numpy as _np
+    ctr = 0.5 * (stride - 1.0)
+    out = []
+    size = float(stride) * float(stride)
+    for r in ratios:
+        size_ratio = _np.floor(size / r)
+        for s in scales:
+            w = _np.floor(_np.sqrt(size_ratio) + 0.5) * s
+            h = _np.floor((w / s * r) + 0.5) * s
+            out.append([ctr - 0.5 * (w - 1), ctr - 0.5 * (h - 1),
+                        ctr + 0.5 * (w - 1), ctr + 0.5 * (h - 1)])
+    return _np.asarray(out, _np.float32)
+
+
+def _proposal_one(fg_score, deltas, im_info, anchors, *, stride, pre_n,
+                  post_n, out_n, thresh, min_size, iou_loss):
+    """One image's RPN proposals, fully on-device with static shapes.
+
+    fg_score (A,H,W) foreground scores, deltas (4A,H,W), im_info (3,)
+    = [height, width, scale].  Follows proposal.cc Forward: enumerate
+    shifted anchors (index order h·W·A + w·A + a), bbox-transform +
+    clip, kill padded rows/cols and too-small boxes by score=-1, sort,
+    greedy NMS with the legacy +1 pixel convention, emit post_n rois
+    (wrapping kept indices when fewer survive — proposal.cc:405-419)."""
+    A, H, W = fg_score.shape
+    im_h, im_w, im_scale = im_info[0], im_info[1], im_info[2]
+
+    xs = jnp.arange(W, dtype=jnp.float32) * stride
+    ys = jnp.arange(H, dtype=jnp.float32) * stride
+    shift = jnp.stack(
+        [xs[None, :, None] + jnp.zeros((H, 1, 1)),
+         ys[:, None, None] + jnp.zeros((1, W, 1)),
+         xs[None, :, None] + jnp.zeros((H, 1, 1)),
+         ys[:, None, None] + jnp.zeros((1, W, 1))], axis=-1)   # (H,W,1,4)
+    boxes = anchors[None, None, :, :] + shift                  # (H,W,A,4)
+
+    d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1)       # (H,W,A,4)
+    if iou_loss:
+        # IoUTransformInv (proposal.cc:93-130): additive corner offsets
+        pred = boxes + d
+    else:
+        # BBoxTransformInv (proposal.cc:37-91)
+        w = boxes[..., 2] - boxes[..., 0] + 1.0
+        h = boxes[..., 3] - boxes[..., 1] + 1.0
+        cx = boxes[..., 0] + 0.5 * (w - 1.0)
+        cy = boxes[..., 1] + 0.5 * (h - 1.0)
+        pcx = d[..., 0] * w + cx
+        pcy = d[..., 1] * h + cy
+        pw = jnp.exp(d[..., 2]) * w
+        ph = jnp.exp(d[..., 3]) * h
+        pred = jnp.stack([pcx - 0.5 * (pw - 1.0), pcy - 0.5 * (ph - 1.0),
+                          pcx + 0.5 * (pw - 1.0), pcy + 0.5 * (ph - 1.0)],
+                         axis=-1)
+    hi = jnp.stack([im_w - 1.0, im_h - 1.0, im_w - 1.0, im_h - 1.0])
+    pred = jnp.clip(pred, 0.0, hi)
+
+    score = fg_score.transpose(1, 2, 0)                        # (H,W,A)
+    # kill predictions from padded feature rows/cols
+    real_h = jnp.floor(im_h / stride)
+    real_w = jnp.floor(im_w / stride)
+    pad = ((jnp.arange(H, dtype=jnp.float32)[:, None, None] >= real_h) |
+           (jnp.arange(W, dtype=jnp.float32)[None, :, None] >= real_w))
+    score = jnp.where(pad, -1.0, score)
+    # FilterBox (proposal.cc:146-159): too-small boxes -> score -1,
+    # box expanded by min_size/2
+    msz = min_size * im_scale
+    iw = pred[..., 2] - pred[..., 0] + 1.0
+    ih = pred[..., 3] - pred[..., 1] + 1.0
+    small = (iw < msz) | (ih < msz)
+    grow = jnp.stack([-msz / 2, -msz / 2, msz / 2, msz / 2])
+    pred = jnp.where(small[..., None], pred + grow, pred)
+    score = jnp.where(small, -1.0, score)
+
+    flat_boxes = pred.reshape(-1, 4)
+    flat_score = score.reshape(-1)
+    order = jnp.argsort(-flat_score, stable=True)[:pre_n]
+    b = flat_boxes[order]
+    s = flat_score[order]
+
+    # greedy NMS, legacy +1 area convention (proposal.cc:214-266)
+    area = (b[:, 2] - b[:, 0] + 1.0) * (b[:, 3] - b[:, 1] + 1.0)
+    xx1 = jnp.maximum(b[:, None, 0], b[None, :, 0])
+    yy1 = jnp.maximum(b[:, None, 1], b[None, :, 1])
+    xx2 = jnp.minimum(b[:, None, 2], b[None, :, 2])
+    yy2 = jnp.minimum(b[:, None, 3], b[None, :, 3])
+    inter = (jnp.maximum(0.0, xx2 - xx1 + 1.0) *
+             jnp.maximum(0.0, yy2 - yy1 + 1.0))
+    iou = inter / (area[:, None] + area[None, :] - inter)
+
+    n = b.shape[0]
+
+    def body(i, keep):
+        sup = (iou[i] > thresh) & (jnp.arange(n) > i) & keep[i]
+        return jnp.where(sup, False, keep)
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    out_size = jnp.minimum(keep.sum(), post_n)
+    kept_first = jnp.argsort(~keep, stable=True)               # kept, in order
+    # rows beyond out_size wrap around kept boxes (proposal.cc:405-419) —
+    # the output always holds out_n real boxes, never zero padding
+    sel = kept_first[jnp.arange(out_n) % jnp.maximum(out_size, 1)]
+    return b[sel], s[sel]
+
+
+@register("_contrib_Proposal", aliases=("Proposal",))
+def _proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+              feature_stride=16, output_score=False, iou_loss=False):
+    """Generate region proposals via RPN (parity: proposal.cc:461,
+    single image).  Output is (rpn_post_nms_top_n, 5) rois
+    [batch_idx, x1, y1, x2, y2]; with ``output_score`` also the
+    (rpn_post_nms_top_n, 1) scores (NumVisibleOutputs parity)."""
+    B, twoA, H, W = cls_prob.shape
+    if B != 1:
+        raise MXNetError(
+            "Proposal supports a single image per call (got batch "
+            f"{B}); use MultiProposal for batched input")
+    A = twoA // 2
+    count = A * H * W
+    pre_n = rpn_pre_nms_top_n if rpn_pre_nms_top_n > 0 else count
+    pre_n = min(pre_n, count)
+    post_n = min(rpn_post_nms_top_n, pre_n)
+    anchors = jnp.asarray(_rpn_base_anchors(feature_stride, ratios, scales))
+    boxes, scores = _proposal_one(
+        cls_prob[0, A:].astype(jnp.float32),
+        bbox_pred[0].astype(jnp.float32),
+        im_info[0].astype(jnp.float32), anchors,
+        stride=float(feature_stride), pre_n=pre_n, post_n=post_n,
+        out_n=rpn_post_nms_top_n, thresh=float(threshold),
+        min_size=float(rpn_min_size), iou_loss=iou_loss)
+    rois = jnp.concatenate([jnp.zeros((rpn_post_nms_top_n, 1)), boxes],
+                           axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois
+
+
+@register("_contrib_MultiProposal", aliases=("MultiProposal",))
+def _multi_proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
+                    rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                    scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                    feature_stride=16, output_score=False, iou_loss=False):
+    """Batched RPN proposals (parity: multi_proposal.cc): per-image
+    Proposal vmapped over the batch, rois tagged with their batch
+    index; output (B·rpn_post_nms_top_n, 5), plus (…, 1) scores when
+    ``output_score``."""
+    B, twoA, H, W = cls_prob.shape
+    A = twoA // 2
+    count = A * H * W
+    pre_n = rpn_pre_nms_top_n if rpn_pre_nms_top_n > 0 else count
+    pre_n = min(pre_n, count)
+    post_n = min(rpn_post_nms_top_n, pre_n)
+    anchors = jnp.asarray(_rpn_base_anchors(feature_stride, ratios, scales))
+
+    def one(sc, dl, info):
+        return _proposal_one(
+            sc.astype(jnp.float32), dl.astype(jnp.float32),
+            info.astype(jnp.float32), anchors,
+            stride=float(feature_stride), pre_n=pre_n, post_n=post_n,
+            out_n=rpn_post_nms_top_n, thresh=float(threshold),
+            min_size=float(rpn_min_size), iou_loss=iou_loss)
+
+    boxes, scores = jax.vmap(one)(cls_prob[:, A:], bbox_pred, im_info)
+    bidx = jnp.repeat(jnp.arange(B, dtype=jnp.float32),
+                      rpn_post_nms_top_n)
+    rois = jnp.concatenate([bidx[:, None], boxes.reshape(-1, 4)], axis=1)
+    if output_score:
+        return rois, scores.reshape(-1, 1)
+    return rois

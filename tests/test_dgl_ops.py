@@ -1,0 +1,198 @@
+"""DGL graph operator tests.
+
+Parity model: src/operator/contrib/dgl_graph.cc docstring examples +
+tests/python/unittest/test_dgl_graph.py-style invariants (deterministic
+when num_neighbor >= max degree, structural checks otherwise).
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.ndarray import contrib as ndc
+
+
+def _complete_graph():
+    # 5-vertex complete digraph minus self loops, edge values 1..20
+    # (the dgl_graph.cc:761 docstring example)
+    data = onp.arange(1, 21, dtype=onp.int64)
+    indices = onp.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                         0, 1, 2, 4, 0, 1, 2, 3], dtype=onp.int64)
+    indptr = onp.array([0, 4, 8, 12, 16, 20], dtype=onp.int64)
+    return sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def test_uniform_sample_full_degree_deterministic():
+    g = _complete_graph()
+    seed = mx.nd.array(onp.array([0, 1, 2, 3, 4], onp.int64))
+    out = ndc.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=1, num_neighbor=4, max_num_vertices=5)
+    verts, sub, layer = out[0], out[1], out[2]
+    v = verts.asnumpy()
+    assert v[-1] == 5
+    assert list(v[:5]) == [0, 1, 2, 3, 4]
+    # num_neighbor >= degree: every edge kept, sub graph == original
+    onp.testing.assert_array_equal(sub.todense().asnumpy(),
+                                   g.todense().asnumpy())
+    onp.testing.assert_array_equal(layer.asnumpy(), onp.zeros(5))
+
+
+def test_uniform_sample_structure():
+    g = _complete_graph()
+    seed = mx.nd.array(onp.array([0], onp.int64))
+    out = ndc.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=2, num_neighbor=2, max_num_vertices=5, seed=0)
+    verts, sub, layer = out[0], out[1], out[2]
+    v = verts.asnumpy()
+    n = int(v[-1])
+    assert 1 <= n <= 5
+    vs = v[:n]
+    assert list(vs) == sorted(set(vs))
+    assert 0 in vs
+    lay = layer.asnumpy()[:n]
+    assert lay[list(vs).index(0)] == 0
+    assert lay.max() <= 2
+    # each sampled row has at most num_neighbor edges, into valid columns
+    ip = onp.asarray(sub.indptr)
+    deg = ip[1:] - ip[:-1]
+    assert deg.max() <= 2
+    # all edge values must come from the parent graph
+    dense = sub.todense().asnumpy()
+    parent = g.todense().asnumpy()
+    nz = dense.nonzero()
+    for r, c in zip(*nz):
+        assert dense[r, c] == parent[vs[r], c]
+
+
+def test_uniform_sample_multiple_seed_arrays():
+    g = _complete_graph()
+    s1 = mx.nd.array(onp.array([0, 1], onp.int64))
+    s2 = mx.nd.array(onp.array([3], onp.int64))
+    out = ndc.dgl_csr_neighbor_uniform_sample(
+        g, s1, s2, num_hops=1, num_neighbor=4, max_num_vertices=5)
+    assert len(out) == 6  # [verts]*2 + [csr]*2 + [layer]*2
+    assert int(out[0].asnumpy()[-1]) == 5   # seeds 0,1 + all their nbrs
+    assert int(out[1].asnumpy()[-1]) == 5
+
+
+def test_non_uniform_sample():
+    g = _complete_graph()
+    prob = mx.nd.array(onp.array([.9, .8, .2, .4, .1], onp.float32))
+    seed = mx.nd.array(onp.array([0, 1, 2, 3, 4], onp.int64))
+    out = ndc.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, seed, num_hops=1, num_neighbor=4, max_num_vertices=5)
+    assert len(out) == 4
+    verts, sub, p, layer = out
+    assert int(verts.asnumpy()[-1]) == 5
+    onp.testing.assert_allclose(p.asnumpy(),
+                                [.9, .8, .2, .4, .1], rtol=1e-6)
+    onp.testing.assert_array_equal(sub.todense().asnumpy(),
+                                   g.todense().asnumpy())
+
+
+def test_non_uniform_sample_prefers_high_prob():
+    g = _complete_graph()
+    # vertex 4 has (near-)zero probability: it should (almost) never be
+    # sampled from full-degree rows when only 1 neighbor is taken
+    prob = mx.nd.array(onp.array([.5, .5, .5, .5, 1e-9], onp.float32))
+    seed = mx.nd.array(onp.array([0], onp.int64))
+    hits = 0
+    for s in range(10):
+        out = ndc.dgl_csr_neighbor_non_uniform_sample(
+            g, prob, seed, num_hops=1, num_neighbor=1,
+            max_num_vertices=5, seed=s)
+        vs = out[0].asnumpy()
+        n = int(vs[-1])
+        if 4 in vs[:n]:
+            hits += 1
+    assert hits == 0
+
+
+def test_subgraph():
+    # dgl_graph.cc:1146 docstring example
+    x = onp.array([[1, 0, 0, 2],
+                   [3, 0, 4, 0],
+                   [0, 5, 0, 0],
+                   [0, 6, 7, 0]], onp.int64)
+    g = sparse.csr_matrix(x)
+    v = mx.nd.array(onp.array([0, 1, 2], onp.int64))
+    sub, mapping = ndc.dgl_subgraph(g, v, return_mapping=True)
+    # original edge values restricted to rows/cols {0,1,2}
+    onp.testing.assert_array_equal(mapping.todense().asnumpy(),
+                                   [[1, 0, 0],
+                                    [3, 0, 4],
+                                    [0, 5, 0]])
+    # new edge ids are dense row-major 0..n-1
+    onp.testing.assert_array_equal(onp.asarray(sub.data), [0, 1, 2, 3])
+    onp.testing.assert_array_equal(onp.asarray(sub.indptr),
+                                   onp.asarray(mapping.indptr))
+    onp.testing.assert_array_equal(onp.asarray(sub.indices),
+                                   onp.asarray(mapping.indices))
+
+
+def test_subgraph_requires_sorted():
+    g = _complete_graph()
+    v = mx.nd.array(onp.array([2, 0], onp.int64))
+    with pytest.raises(Exception):
+        ndc.dgl_subgraph(g, v)
+
+
+def test_adjacency():
+    g = _complete_graph()
+    adj = ndc.dgl_adjacency(g)
+    assert adj.dtype == onp.float32
+    d = adj.todense().asnumpy()
+    onp.testing.assert_array_equal(d, (g.todense().asnumpy() != 0))
+
+
+def test_graph_compact():
+    g = _complete_graph()
+    seed = mx.nd.array(onp.array([0, 1, 2], onp.int64))
+    out = ndc.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=1, num_neighbor=4, max_num_vertices=6, seed=1)
+    verts, sub = out[0], out[1]
+    n = int(verts.asnumpy()[-1])
+    compact, mapping = ndc.dgl_graph_compact(
+        sub, verts, graph_sizes=(n,), return_mapping=True)
+    assert compact.shape == (n, n)
+    # compacted columns renumbered into [0, n)
+    assert onp.asarray(compact.indices).max() < n
+    # mapping keeps the original (parent-graph) edge values
+    vs = verts.asnumpy()[:n]
+    md = mapping.todense().asnumpy()
+    parent = g.todense().asnumpy()
+    for r in range(n):
+        for c in range(n):
+            if md[r, c]:
+                assert md[r, c] == parent[vs[r], vs[c]]
+
+
+def test_seeded_reproducible():
+    g = _complete_graph()
+    seed = mx.nd.array(onp.array([0], onp.int64))
+    a = ndc.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=2, num_neighbor=2, max_num_vertices=5, seed=7)
+    b = ndc.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=2, num_neighbor=2, max_num_vertices=5, seed=7)
+    onp.testing.assert_array_equal(a[0].asnumpy(), b[0].asnumpy())
+    onp.testing.assert_array_equal(a[1].todense().asnumpy(),
+                                   b[1].todense().asnumpy())
+
+
+def test_graph_compact_truncated_sampling_raises():
+    # 10-vertex ring: budget-truncated sampling leaves edges to
+    # out-of-budget vertices; compact must raise a clear MXNetError
+    import mxnet_tpu.ndarray.sparse as sp
+    n = 10
+    indptr = onp.arange(0, 2 * n + 1, 2, dtype=onp.int64)
+    indices = onp.stack([(onp.arange(n) + 1) % n,
+                         (onp.arange(n) + 2) % n], 1).ravel().astype(onp.int64)
+    data = onp.arange(1, 2 * n + 1, dtype=onp.int64)
+    g = sp.csr_matrix((data, indices, indptr), shape=(n, n))
+    seed = mx.nd.array(onp.array([0], onp.int64))
+    out = ndc.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_hops=2, num_neighbor=3, max_num_vertices=3, seed=0)
+    verts, sub = out[0], out[1]
+    cnt = int(verts.asnumpy()[-1])
+    with pytest.raises(Exception, match="max_num_vertices"):
+        ndc.dgl_graph_compact(sub, verts, graph_sizes=(cnt,))

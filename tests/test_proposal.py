@@ -1,0 +1,220 @@
+"""RPN Proposal / MultiProposal tests.
+
+Oracle: a direct numpy transcription of proposal.cc Forward (anchor
+enumeration -> bbox transform -> clip -> filter -> sort -> greedy NMS
+with the legacy +1 convention -> wrap-fill), matching
+tests/python/gpu/test_operator_gpu.py-style consistency checking.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import contrib as ndc
+
+
+def _np_base_anchors(stride, ratios, scales):
+    ctr = 0.5 * (stride - 1.0)
+    out = []
+    size = stride * stride
+    for r in ratios:
+        sr = onp.floor(size / r)
+        for s in scales:
+            w = onp.floor(onp.sqrt(sr) + 0.5) * s
+            h = onp.floor((w / s * r) + 0.5) * s
+            out.append([ctr - 0.5 * (w - 1), ctr - 0.5 * (h - 1),
+                        ctr + 0.5 * (w - 1), ctr + 0.5 * (h - 1)])
+    return onp.asarray(out, onp.float32)
+
+
+def _np_proposal(cls_prob, bbox_pred, im_info, *, stride, scales, ratios,
+                 pre_n, post_n, thresh, min_size):
+    A = cls_prob.shape[1] // 2
+    H, W = cls_prob.shape[2], cls_prob.shape[3]
+    anchors = _np_base_anchors(stride, ratios, scales)
+    im_h, im_w, im_scale = im_info
+    props = onp.zeros((H * W * A, 5), onp.float32)
+    for h in range(H):
+        for w in range(W):
+            for a in range(A):
+                idx = h * (W * A) + w * A + a
+                box = anchors[a] + onp.array(
+                    [w * stride, h * stride, w * stride, h * stride],
+                    onp.float32)
+                bw = box[2] - box[0] + 1
+                bh = box[3] - box[1] + 1
+                cx = box[0] + 0.5 * (bw - 1)
+                cy = box[1] + 0.5 * (bh - 1)
+                dx, dy, dw, dh = bbox_pred[0, a * 4:(a + 1) * 4, h, w]
+                pcx, pcy = dx * bw + cx, dy * bh + cy
+                pw, ph = onp.exp(dw) * bw, onp.exp(dh) * bh
+                x1 = pcx - 0.5 * (pw - 1)
+                y1 = pcy - 0.5 * (ph - 1)
+                x2 = pcx + 0.5 * (pw - 1)
+                y2 = pcy + 0.5 * (ph - 1)
+                x1 = min(max(x1, 0), im_w - 1)
+                y1 = min(max(y1, 0), im_h - 1)
+                x2 = min(max(x2, 0), im_w - 1)
+                y2 = min(max(y2, 0), im_h - 1)
+                sc = cls_prob[0, A + a, h, w]
+                if h >= int(im_h / stride) or w >= int(im_w / stride):
+                    sc = -1.0
+                msz = min_size * im_scale
+                iw, ih = x2 - x1 + 1, y2 - y1 + 1
+                if iw < msz or ih < msz:
+                    x1 -= msz / 2
+                    y1 -= msz / 2
+                    x2 += msz / 2
+                    y2 += msz / 2
+                    sc = -1.0
+                props[idx] = [x1, y1, x2, y2, sc]
+    order = onp.argsort(-props[:, 4], kind="stable")[:pre_n]
+    dets = props[order]
+    # greedy nms (+1 convention)
+    area = (dets[:, 2] - dets[:, 0] + 1) * (dets[:, 3] - dets[:, 1] + 1)
+    suppressed = onp.zeros(len(dets), bool)
+    keep = []
+    for i in range(len(dets)):
+        if suppressed[i]:
+            continue
+        if len(keep) >= post_n:
+            break
+        keep.append(i)
+        xx1 = onp.maximum(dets[i, 0], dets[i + 1:, 0])
+        yy1 = onp.maximum(dets[i, 1], dets[i + 1:, 1])
+        xx2 = onp.minimum(dets[i, 2], dets[i + 1:, 2])
+        yy2 = onp.minimum(dets[i, 3], dets[i + 1:, 3])
+        inter = (onp.maximum(0, xx2 - xx1 + 1) *
+                 onp.maximum(0, yy2 - yy1 + 1))
+        ovr = inter / (area[i] + area[i + 1:] - inter)
+        suppressed[i + 1:] |= ovr > thresh
+    out = onp.zeros((post_n, 5), onp.float32)
+    out_score = onp.zeros((post_n, 1), onp.float32)
+    for i in range(post_n):
+        src = keep[i] if i < len(keep) else keep[i % len(keep)]
+        out[i, 1:] = dets[src, :4]
+        out_score[i, 0] = dets[src, 4]
+    return out, out_score
+
+
+def _random_inputs(rng, A=3, H=4, W=5):
+    cls_prob = rng.uniform(0, 1, (1, 2 * A, H, W)).astype(onp.float32)
+    bbox_pred = rng.uniform(-0.3, 0.3, (1, 4 * A, H, W)).astype(onp.float32)
+    im_info = onp.array([[H * 16.0, W * 16.0, 1.0]], onp.float32)
+    return cls_prob, bbox_pred, im_info
+
+
+SCALES = (8.0, 16.0)
+RATIOS = (0.5, 1.0, 2.0)
+
+
+def test_proposal_matches_numpy_oracle():
+    rng = onp.random.RandomState(0)
+    A = len(SCALES) * len(RATIOS)
+    cls_prob = rng.uniform(0, 1, (1, 2 * A, 4, 5)).astype(onp.float32)
+    bbox_pred = rng.uniform(-0.3, 0.3, (1, 4 * A, 4, 5)).astype(onp.float32)
+    im_info = onp.array([[64.0, 80.0, 1.0]], onp.float32)
+    kw = dict(rpn_pre_nms_top_n=40, rpn_post_nms_top_n=10, threshold=0.7,
+              rpn_min_size=4, scales=SCALES, ratios=RATIOS,
+              feature_stride=16)
+    rois, scores = ndc.Proposal(mx.nd.array(cls_prob),
+                                mx.nd.array(bbox_pred),
+                                mx.nd.array(im_info), output_score=True,
+                                **kw)
+    exp_rois, exp_scores = _np_proposal(
+        cls_prob, bbox_pred, im_info[0], stride=16, scales=SCALES,
+        ratios=RATIOS, pre_n=40, post_n=10, thresh=0.7, min_size=4)
+    onp.testing.assert_allclose(rois.asnumpy(), exp_rois,
+                                rtol=1e-4, atol=1e-3)
+    onp.testing.assert_allclose(scores.asnumpy(), exp_scores,
+                                rtol=1e-4, atol=1e-4)
+
+
+def test_proposal_output_shape_defaults():
+    rng = onp.random.RandomState(1)
+    A = len(SCALES) * len(RATIOS)
+    cls_prob, bbox_pred, im_info = _random_inputs(rng, A=A)
+    rois, scores = ndc.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_post_nms_top_n=8, scales=SCALES, ratios=RATIOS,
+        output_score=True)
+    assert rois.shape == (8, 5)
+    assert scores.shape == (8, 1)
+    r = rois.asnumpy()
+    onp.testing.assert_array_equal(r[:, 0], onp.zeros(8))
+    # boxes inside image bounds
+    assert (r[:, 1] >= -8).all() and (r[:, 3] <= 80 + 8).all()
+
+
+def test_multi_proposal_matches_per_image_proposal():
+    rng = onp.random.RandomState(2)
+    A = len(SCALES) * len(RATIOS)
+    B, H, W = 3, 4, 4
+    cls_prob = rng.uniform(0, 1, (B, 2 * A, H, W)).astype(onp.float32)
+    bbox_pred = rng.uniform(-0.2, 0.2, (B, 4 * A, H, W)).astype(onp.float32)
+    im_info = onp.tile(onp.array([[64.0, 64.0, 1.0]], onp.float32), (B, 1))
+    kw = dict(rpn_pre_nms_top_n=30, rpn_post_nms_top_n=6, threshold=0.6,
+              rpn_min_size=4, scales=SCALES, ratios=RATIOS,
+              feature_stride=16, output_score=True)
+    rois, scores = ndc.MultiProposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        **kw)
+    assert rois.shape == (B * 6, 5)
+    assert scores.shape == (B * 6, 1)
+    r = rois.asnumpy()
+    for b in range(B):
+        sub_rois, sub_scores = ndc.Proposal(
+            mx.nd.array(cls_prob[b:b + 1]), mx.nd.array(bbox_pred[b:b + 1]),
+            mx.nd.array(im_info[b:b + 1]), **kw)
+        blk = r[b * 6:(b + 1) * 6]
+        onp.testing.assert_array_equal(blk[:, 0], onp.full(6, b))
+        onp.testing.assert_allclose(blk[:, 1:], sub_rois.asnumpy()[:, 1:],
+                                    rtol=1e-5, atol=1e-5)
+        onp.testing.assert_allclose(scores.asnumpy()[b * 6:(b + 1) * 6],
+                                    sub_scores.asnumpy(), rtol=1e-5,
+                                    atol=1e-5)
+
+
+def test_proposal_single_output_by_default():
+    rng = onp.random.RandomState(3)
+    A = len(SCALES) * len(RATIOS)
+    cls_prob, bbox_pred, im_info = _random_inputs(rng, A=A)
+    out = ndc.Proposal(mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+                       mx.nd.array(im_info), rpn_post_nms_top_n=5,
+                       scales=SCALES, ratios=RATIOS)
+    # output_score=False -> single NDArray (NumVisibleOutputs parity)
+    assert not isinstance(out, (list, tuple))
+    assert out.shape == (5, 5)
+
+
+def test_proposal_rejects_batched_input():
+    rng = onp.random.RandomState(4)
+    A = len(SCALES) * len(RATIOS)
+    cls_prob = rng.uniform(0, 1, (2, 2 * A, 4, 4)).astype(onp.float32)
+    bbox_pred = rng.uniform(-0.2, 0.2, (2, 4 * A, 4, 4)).astype(onp.float32)
+    im_info = onp.tile(onp.array([[64.0, 64.0, 1.0]], onp.float32), (2, 1))
+    with pytest.raises(Exception, match="MultiProposal"):
+        ndc.Proposal(mx.nd.array(cls_prob), mx.nd.array(bbox_pred),
+                     mx.nd.array(im_info), scales=SCALES, ratios=RATIOS)
+
+
+def test_proposal_wraps_when_few_anchors():
+    # anchor count (A*H*W = 24) < rpn_post_nms_top_n: rows wrap around
+    # kept boxes (proposal.cc:405-419), never zero padding
+    rng = onp.random.RandomState(5)
+    A = len(SCALES) * len(RATIOS)
+    cls_prob = rng.uniform(0.1, 1, (1, 2 * A, 2, 2)).astype(onp.float32)
+    bbox_pred = rng.uniform(-0.1, 0.1, (1, 4 * A, 2, 2)).astype(onp.float32)
+    im_info = onp.array([[32.0, 32.0, 1.0]], onp.float32)
+    rois, scores = ndc.Proposal(
+        mx.nd.array(cls_prob), mx.nd.array(bbox_pred), mx.nd.array(im_info),
+        rpn_post_nms_top_n=50, rpn_min_size=1, scales=SCALES, ratios=RATIOS,
+        output_score=True)
+    r = rois.asnumpy()
+    assert r.shape == (50, 5)
+    # every row is a real box: width/height >= 1 pixel and non-degenerate
+    w = r[:, 3] - r[:, 1]
+    h = r[:, 4] - r[:, 2]
+    assert (w > 0).all() and (h > 0).all()
+    # wrapped rows repeat earlier kept boxes (cycle length = #kept <= 24)
+    first = r[0]
+    assert any(onp.allclose(first, row) for row in r[1:])
