@@ -1,0 +1,75 @@
+"""Extension module registering the native gemm custom op.
+
+Parity: the reference pairs a compiled lib (gemm_lib.cc via lib_api.h)
+with ``mx.library.load`` (`MXLoadLib`); here the .py is the extension
+unit (mxnet_tpu/library.py contract): ``register_ops(registry)`` wires
+the C kernels into the op registry so ``mx.nd.my_gemm`` appears, works
+inside jit (via ``jax.pure_callback``), and differentiates (via
+``jax.custom_vjp`` calling the native backward).
+
+Usage:
+    mx.library.load(".../libgemm_ext.so")    # handshake + symbols
+    mx.library.load(".../gemm_ext.py")       # registers my_gemm
+"""
+import ctypes
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as onp
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.abspath(__file__))
+    path = os.path.join(here, "libgemm_ext.so")
+    if not os.path.exists(path):
+        raise RuntimeError(
+            "build the native lib first: g++ -O2 -fPIC -shared "
+            "gemm_lib.cc -o libgemm_ext.so")
+    return ctypes.CDLL(path)
+
+
+def register_ops(registry):
+    lib = _find_lib()
+    lib.my_gemm_forward.argtypes = [ctypes.c_void_p] * 3 + [ctypes.c_int] * 3
+    lib.my_gemm_backward.argtypes = [ctypes.c_void_p] * 5 + [ctypes.c_int] * 3
+
+    def host_fwd(a, b):
+        a = onp.ascontiguousarray(a, onp.float32)
+        b = onp.ascontiguousarray(b, onp.float32)
+        n, k = a.shape
+        m = b.shape[1]
+        c = onp.empty((n, m), onp.float32)
+        lib.my_gemm_forward(a.ctypes.data, b.ctypes.data, c.ctypes.data,
+                            n, k, m)
+        return c
+
+    def host_bwd(dc, a, b):
+        dc = onp.ascontiguousarray(dc, onp.float32)
+        a = onp.ascontiguousarray(a, onp.float32)
+        b = onp.ascontiguousarray(b, onp.float32)
+        n, k = a.shape
+        m = b.shape[1]
+        da = onp.empty((n, k), onp.float32)
+        db = onp.empty((k, m), onp.float32)
+        lib.my_gemm_backward(dc.ctypes.data, a.ctypes.data, b.ctypes.data,
+                             da.ctypes.data, db.ctypes.data, n, k, m)
+        return da, db
+
+    @jax.custom_vjp
+    def my_gemm(a, b):
+        spec = jax.ShapeDtypeStruct((a.shape[0], b.shape[1]), jnp.float32)
+        return jax.pure_callback(host_fwd, spec, a, b)
+
+    def fwd(a, b):
+        return my_gemm(a, b), (a, b)
+
+    def bwd(res, dc):
+        a, b = res
+        specs = (jax.ShapeDtypeStruct(a.shape, jnp.float32),
+                 jax.ShapeDtypeStruct(b.shape, jnp.float32))
+        return tuple(jax.pure_callback(host_bwd, specs, dc, a, b))
+
+    my_gemm.defvjp(fwd, bwd)
+
+    registry.register("my_gemm")(my_gemm)

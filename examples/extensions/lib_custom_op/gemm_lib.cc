@@ -1,0 +1,47 @@
+// Sample native custom-op extension library.
+//
+// Parity: example/extensions/lib_custom_op/gemm_lib.cc in the reference
+// (a C-ABI gemm with forward + backward loaded at runtime via MXLoadLib /
+// lib_api.h).  The TPU-native extension contract (mxnet_tpu/library.py):
+//   - export  int mxnet_tpu_lib_version(void)   (handshake)
+//   - export plain C kernels; the companion .py wraps them with
+//     jax.pure_callback + custom_vjp and registers the op.
+// Device compute stays jax/Pallas; a C++ kernel like this is host-side
+// custom compute (the analogue of the reference's CPU FCompute).
+//
+// Build:  g++ -O2 -fPIC -shared gemm_lib.cc -o libgemm_ext.so
+
+extern "C" {
+
+int mxnet_tpu_lib_version() { return 1; }
+
+// C = A(n,k) @ B(k,m)
+void my_gemm_forward(const float* A, const float* B, float* C,
+                     int n, int k, int m) {
+  for (int i = 0; i < n; ++i) {
+    for (int j = 0; j < m; ++j) {
+      float acc = 0.f;
+      for (int kk = 0; kk < k; ++kk) acc += A[i * k + kk] * B[kk * m + j];
+      C[i * m + j] = acc;
+    }
+  }
+}
+
+// dA = dC(n,m) @ B^T(m,k);  dB = A^T(k,n) @ dC(n,m)
+void my_gemm_backward(const float* dC, const float* A, const float* B,
+                      float* dA, float* dB, int n, int k, int m) {
+  for (int i = 0; i < n; ++i)
+    for (int kk = 0; kk < k; ++kk) {
+      float acc = 0.f;
+      for (int j = 0; j < m; ++j) acc += dC[i * m + j] * B[kk * m + j];
+      dA[i * k + kk] = acc;
+    }
+  for (int kk = 0; kk < k; ++kk)
+    for (int j = 0; j < m; ++j) {
+      float acc = 0.f;
+      for (int i = 0; i < n; ++i) acc += A[i * k + kk] * dC[i * m + j];
+      dB[kk * m + j] = acc;
+    }
+}
+
+}  // extern "C"

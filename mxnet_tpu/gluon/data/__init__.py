@@ -1,10 +1,11 @@
 """gluon.data (parity: python/mxnet/gluon/data/)."""
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
-                      IntervalSampler, FilterSampler)
+                      IntervalSampler, FilterSampler, BucketSampler)
 from .dataloader import DataLoader, default_batchify_fn
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
            "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
-           "IntervalSampler", "FilterSampler", "DataLoader", "vision"]
+           "IntervalSampler", "FilterSampler", "BucketSampler", "DataLoader",
+           "vision"]

@@ -92,16 +92,90 @@ def _mp_context():
     return multiprocessing.get_context(method)
 
 
-def _worker_fn(dataset, batchify_fn, indices):
-    batch = batchify_fn([dataset[i] for i in indices])
-    # return numpy to cross the process boundary
-    def to_np(x):
-        if isinstance(x, NDArray):
-            return x.asnumpy()
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    if isinstance(x, tuple):
+        return tuple(_to_np(e) for e in x)
+    return x
+
+
+# -- shared-memory batch hand-off ------------------------------------------
+# Parity: CPUSharedStorageManager + the DataLoader ForkingPickler path
+# (src/storage/cpu_shared_storage_manager.h, gluon/data/dataloader.py:28-138):
+# workers place batch tensors in POSIX shared memory and send only a
+# (name, layout) descriptor through the pipe, so large batches are never
+# pickled through the result queue.  The parent maps the segment,
+# uploads straight from the mapped view, then unlinks.
+
+def _shm_pack(batch):
+    from multiprocessing import shared_memory, resource_tracker
+    leaves = []
+
+    def collect(x):
+        if isinstance(x, onp.ndarray):
+            leaves.append(x)
+            return ("__a__", len(leaves) - 1)
         if isinstance(x, tuple):
-            return tuple(to_np(e) for e in x)
+            return tuple(collect(e) for e in x)
         return x
-    return to_np(batch)
+
+    tree = collect(batch)
+    total = sum(a.nbytes for a in leaves)
+    if total == 0:
+        return ("__shm__", None, [], tree)
+    shm = shared_memory.SharedMemory(create=True, size=total)
+    # the parent owns the segment's lifetime: unregister it from this
+    # worker's resource tracker so worker exit doesn't unlink/warn
+    try:
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:
+        pass
+    metas = []
+    off = 0
+    for a in leaves:
+        a = onp.ascontiguousarray(a)
+        dst = onp.ndarray(a.shape, dtype=a.dtype, buffer=shm.buf,
+                          offset=off)
+        onp.copyto(dst, a)
+        metas.append((off, a.shape, str(a.dtype)))
+        off += a.nbytes
+    name = shm.name
+    shm.close()
+    return ("__shm__", name, metas, tree)
+
+
+def _shm_unpack(payload):
+    from multiprocessing import shared_memory
+    _, name, metas, tree = payload
+    shm = shared_memory.SharedMemory(name=name) if name else None
+    try:
+        def rebuild(x):
+            if isinstance(x, tuple):
+                if len(x) == 2 and x[0] == "__a__":
+                    off, shape, dtype = metas[x[1]]
+                    view = onp.ndarray(shape, dtype=dtype, buffer=shm.buf,
+                                       offset=off)
+                    # one owned host copy before the segment is
+                    # unlinked — the runtime may alias (zero-copy) the
+                    # buffer we hand it, so it must not live in the
+                    # about-to-be-freed segment
+                    return NDArray(onp.array(view))
+                return tuple(rebuild(e) for e in x)
+            return x
+
+        return rebuild(tree)
+    finally:
+        if shm is not None:
+            shm.close()
+            shm.unlink()
+
+
+def _worker_fn(dataset, batchify_fn, indices, use_shm=False):
+    batch = _to_np(batchify_fn([dataset[i] for i in indices]))
+    if use_shm:
+        return _shm_pack(batch)
+    return batch
 
 
 class DataLoader:
@@ -110,9 +184,18 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, use_shared_mem=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
+        # shared-memory hand-off is the default for process workers
+        # (parity: the reference's shm ForkingPickler fast path); set
+        # MXNET_DATALOADER_SHM=0 or use_shared_mem=False to fall back to
+        # pipe pickling
+        if use_shared_mem is None:
+            use_shared_mem = os.environ.get(
+                "MXNET_DATALOADER_SHM", "1") not in ("0", "false", "off")
+        self._use_shm = bool(use_shared_mem) and num_workers > 0 \
+            and not thread_pool
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("batch_size required when batch_sampler "
@@ -136,7 +219,8 @@ class DataLoader:
             self._batchify_fn = default_mp_batchify_fn
         else:
             self._batchify_fn = default_batchify_fn
-        self._prefetch = max(0, prefetch or 2 * self._num_workers)
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * self._num_workers)
         self._thread_pool = thread_pool
         self._timeout = timeout
         self._pool = None
@@ -169,16 +253,40 @@ class DataLoader:
             except StopIteration:
                 return False
             pending.append(pool.apply_async(
-                _worker_fn, (self._dataset, self._batchify_fn, indices)))
+                _worker_fn, (self._dataset, self._batchify_fn, indices,
+                             self._use_shm)))
             return True
 
         for _ in range(self._prefetch + 1):
             if not submit():
                 break
-        while pending:
-            result = pending.pop(0).get(self._timeout)
-            submit()
-            yield _rewrap(result)
+        try:
+            while pending:
+                result = pending.pop(0).get(self._timeout)
+                submit()
+                if (isinstance(result, tuple) and len(result) == 4
+                        and result[0] == "__shm__"):
+                    yield _shm_unpack(result)
+                else:
+                    yield _rewrap(result)
+        finally:
+            # consumer stopped early (break/exception/GeneratorExit):
+            # drain in-flight results and unlink their shm segments,
+            # which the workers deliberately disowned (_shm_pack)
+            for fut in pending:
+                try:
+                    result = fut.get(self._timeout)
+                except Exception:
+                    continue
+                if (isinstance(result, tuple) and len(result) == 4
+                        and result[0] == "__shm__" and result[1]):
+                    try:
+                        from multiprocessing import shared_memory
+                        seg = shared_memory.SharedMemory(name=result[1])
+                        seg.close()
+                        seg.unlink()
+                    except Exception:
+                        pass
 
     def __len__(self):
         return len(self._batch_sampler)

@@ -296,7 +296,6 @@ def dgl_graph_compact(*graph_data, graph_sizes, return_mapping=False,
         outs.append(CSRNDArray(onp.arange(nnz, dtype=onp.int64), new_cols,
                                new_indptr, (size, size)))
         if return_mapping:
-            maps.append(CSRNDArray(
-                onp.asarray(data[:nnz], onp.int64), new_cols.copy(),
-                new_indptr.copy(), (size, size)))
+            maps.append(CSRNDArray(data[:nnz].copy(), new_cols.copy(),
+                                   new_indptr.copy(), (size, size)))
     return outs + maps if return_mapping else outs

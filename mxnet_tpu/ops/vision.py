@@ -911,24 +911,14 @@ def _proposal(cls_prob, bbox_pred, im_info, *, rpn_pre_nms_top_n=6000,
         raise MXNetError(
             "Proposal supports a single image per call (got batch "
             f"{B}); use MultiProposal for batched input")
-    A = twoA // 2
-    count = A * H * W
-    pre_n = rpn_pre_nms_top_n if rpn_pre_nms_top_n > 0 else count
-    pre_n = min(pre_n, count)
-    post_n = min(rpn_post_nms_top_n, pre_n)
-    anchors = jnp.asarray(_rpn_base_anchors(feature_stride, ratios, scales))
-    boxes, scores = _proposal_one(
-        cls_prob[0, A:].astype(jnp.float32),
-        bbox_pred[0].astype(jnp.float32),
-        im_info[0].astype(jnp.float32), anchors,
-        stride=float(feature_stride), pre_n=pre_n, post_n=post_n,
-        out_n=rpn_post_nms_top_n, thresh=float(threshold),
-        min_size=float(rpn_min_size), iou_loss=iou_loss)
-    rois = jnp.concatenate([jnp.zeros((rpn_post_nms_top_n, 1)), boxes],
-                           axis=1)
-    if output_score:
-        return rois, scores.reshape(-1, 1)
-    return rois
+    # B==1 restriction aside, Proposal IS MultiProposal (batch index 0)
+    return _multi_proposal(
+        cls_prob, bbox_pred, im_info,
+        rpn_pre_nms_top_n=rpn_pre_nms_top_n,
+        rpn_post_nms_top_n=rpn_post_nms_top_n, threshold=threshold,
+        rpn_min_size=rpn_min_size, scales=scales, ratios=ratios,
+        feature_stride=feature_stride, output_score=output_score,
+        iou_loss=iou_loss)
 
 
 @register("_contrib_MultiProposal", aliases=("MultiProposal",))

@@ -77,10 +77,17 @@ class SPMDTrainer:
         return NamedSharding(self.mesh, PartitionSpec(*spec))
 
     # -- compiled step -----------------------------------------------------
-    def _build_step(self, data_shape, data_dtype, label_shape, label_dtype):
+    def _make_step_fn(self):
+        """The raw (un-jitted) step function + its aux-discovery cell.
+
+        Shared by the single-step jit and the fused multi-step scan
+        (``run_steps``).  BatchNorm-style aux state (running stats) is
+        folded into ``new_params`` so a device-side loop threads the
+        updated stats into the next iteration."""
         net, loss_fn, opt = self.net, self.loss_fn, self.optimizer
         pkeys = self._pkeys
         params = [self._params[k] for k in pkeys]
+        pindex = {id(p): i for i, p in enumerate(params)}
         cell = {"aux": []}
 
         amp = self.amp_dtype
@@ -134,18 +141,65 @@ class SPMDTrainer:
                 outs = out if isinstance(out, tuple) else (out,)
                 new_params.append(outs[0])
                 new_state.append(tuple(outs[1:]))
+            # fold traced aux updates (BN running stats) into new_params
+            # so they flow through the step output — a scanned step sees
+            # iteration i's stats at iteration i+1
+            for (pobj, _), v in zip(cell["aux"], aux):
+                idx = pindex.get(id(pobj))
+                if idx is not None:
+                    new_params[idx] = v.astype(p_arrays[idx].dtype)
             return new_params, new_state, loss_val, aux
 
+        return step, cell, params
+
+    def _state_shardings(self, params):
         p_shardings = [self._param_sharding(p) for p in params]
         s_shardings = [tuple(self._param_sharding(p) for _ in st)
-                       for p, st in zip(params,
-                                        (self._opt_state[k] for k in pkeys))]
+                       for p, st in zip(
+                           params,
+                           (self._opt_state[k] for k in self._pkeys))]
+        return p_shardings, s_shardings
+
+    def _build_step(self, data_shape, data_dtype, label_shape, label_dtype):
+        step, cell, params = self._make_step_fn()
+        p_shardings, s_shardings = self._state_shardings(params)
         rep = NamedSharding(self.mesh, PartitionSpec())
         in_shardings = (rep, rep, rep, p_shardings, s_shardings,
                         self._batch_sharding(len(data_shape)),
                         self._batch_sharding(len(label_shape)))
         donate = (3, 4) if self._donate else ()
         jitted = jax.jit(step, in_shardings=in_shardings,
+                         donate_argnums=donate)
+        return jitted, cell
+
+    def _build_multi(self, data_shape, data_dtype, label_shape, label_dtype,
+                     n_steps):
+        """Fused multi-step: ``n_steps`` full train steps inside ONE
+        executable via lax.scan — the engine-bulking idea
+        (MXNET_EXEC_BULK_EXEC_*, SURVEY.md §3.3) taken to its XLA-native
+        limit.  One launch per n steps amortizes dispatch/launch
+        latency; lr/wd are held fixed across the fused window."""
+        step, cell, params = self._make_step_fn()
+
+        def many(key, lr, wd, p_arrays, opt_state, data, label):
+            def body(carry, _):
+                key, p, s = carry
+                key, sub = jax.random.split(key)
+                new_p, new_s, loss, _aux = step(sub, lr, wd, p, s,
+                                                data, label)
+                return (key, new_p, new_s), loss
+            (key, p, s), losses = jax.lax.scan(
+                body, (key, list(p_arrays), list(opt_state)), None,
+                length=n_steps)
+            return p, s, losses
+
+        p_shardings, s_shardings = self._state_shardings(params)
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        in_shardings = (rep, rep, rep, p_shardings, s_shardings,
+                        self._batch_sharding(len(data_shape)),
+                        self._batch_sharding(len(label_shape)))
+        donate = (3, 4) if self._donate else ()
+        jitted = jax.jit(many, in_shardings=in_shardings,
                          donate_argnums=donate)
         return jitted, cell
 
@@ -169,16 +223,56 @@ class SPMDTrainer:
         opt_state = [self._opt_state[k] for k in self._pkeys]
         new_p, new_s, loss, aux = jitted(next_key(), lr, wd, p_arrays,
                                          opt_state, d, l)
+        self._fold_back(new_p, new_s, cell, aux)
+        profiler.op_record("SPMDTrainer::step", _prof_t0)
+        return NDArray(loss)
+
+    def _fold_back(self, new_p, new_s, cell, aux=None):
+        covered = set()
         for k, w, st in zip(self._pkeys, new_p, new_s):
             with ag.pause():
                 self._params[k].data()._rebind(w)
             self._opt_state[k] = tuple(st)
-        for (param, _), new in zip(cell["aux"], aux):
-            param._data._rebind(new)
-        profiler.op_record("SPMDTrainer::step", _prof_t0)
-        return NDArray(loss)
+            covered.add(id(self._params[k]))
+        # aux params outside collect_params (none in practice) still get
+        # their traced update; covered ones already flowed through new_p
+        # in the step's own dtype discipline
+        if aux is not None:
+            for (param, _), new in zip(cell["aux"], aux):
+                if id(param) not in covered:
+                    param._data._rebind(new)
 
-    def cost_analysis(self, data, label):
+    def run_steps(self, data, label, n_steps: int):
+        """Run ``n_steps`` fused training steps in ONE device program
+        (lax.scan) on the same batch signature; returns the per-step
+        losses as an (n_steps,) NDArray.
+
+        This is the device-side training loop: one launch per window, so
+        per-step dispatch/launch latency is amortized away — the XLA
+        analogue of the reference executing a whole bulked segment as a
+        single engine op (cached_op.cc:499-513).  lr/wd are frozen for
+        the window; ``num_update`` advances by ``n_steps``."""
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        sig = (d.shape, str(d.dtype), l.shape, str(l.dtype), int(n_steps))
+        entry = self._step_cache.get(sig)
+        if entry is None:
+            entry = self._build_multi(d.shape, str(d.dtype), l.shape,
+                                      str(l.dtype), int(n_steps))
+            self._step_cache[sig] = entry
+        jitted, cell = entry
+        self.num_update += int(n_steps)
+        self.optimizer.num_update = self.num_update
+        lr = jnp.float32(self.optimizer.learning_rate)
+        wd = jnp.float32(self.optimizer.wd)
+        p_arrays = [self._params[k].data()._data for k in self._pkeys]
+        opt_state = [self._opt_state[k] for k in self._pkeys]
+        new_p, new_s, losses = jitted(next_key(), lr, wd, p_arrays,
+                                      opt_state, d, l)
+        self._fold_back(new_p, new_s, cell)
+        return NDArray(losses)
+
+    def cost_analysis(self, data, label, n_steps=None):
         """XLA cost analysis (flops/bytes) for the compiled step that
         matches ``(data, label)``'s signature.  Used by bench.py for MFU
         accounting; the step must have been run at least once.
@@ -190,6 +284,8 @@ class SPMDTrainer:
         d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
+        if n_steps is not None:
+            sig = sig + (int(n_steps),)
         cached = getattr(self, "_cost_cache", {}).get(sig)
         if cached is not None:
             return cached

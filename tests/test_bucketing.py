@@ -1,0 +1,111 @@
+"""Bucketing: variable-length training via BucketSampler + per-bucket
+jit signatures.
+
+Parity: the reference's bucketing story (io.BucketSentenceIter +
+BucketingModule docs, example/rnn/bucketing — SURVEY §5): batches are
+padded only to their bucket's length and each bucket's executor is
+compiled once.  Here HybridBlock's per-signature jit cache is the
+BucketingModule.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, rnn
+from mxnet_tpu.gluon.data import (ArrayDataset, BucketSampler, DataLoader,
+                                  SimpleDataset)
+from mxnet_tpu.ndarray import NDArray
+
+
+def test_bucket_sampler_grouping():
+    lengths = [3, 5, 9, 2, 7, 4, 8, 1, 6, 10]
+    bs = BucketSampler(lengths, batch_size=2, bucket_keys=[4, 8, 12],
+                       shuffle=False)
+    assert bs.bucket_keys == [4, 8, 12]
+    batches = list(bs)
+    assert sum(len(b) for b in batches) == 10
+    for batch in batches:
+        keys = {bs.bucket_of(i) for i in batch}
+        assert len(keys) == 1   # a batch never mixes buckets
+    # every sample's length fits its bucket key
+    for batch in batches:
+        k = bs.bucket_of(batch[0])
+        for i in batch:
+            assert lengths[i] <= k
+
+
+def test_bucket_sampler_drops_overlong():
+    lengths = [2, 3, 50]
+    bs = BucketSampler(lengths, batch_size=1, bucket_keys=[4],
+                       shuffle=False)
+    got = sorted(i for b in bs for i in b)
+    assert got == [0, 1]
+
+
+def test_bucket_sampler_quantile_keys():
+    rng = onp.random.RandomState(0)
+    lengths = rng.randint(1, 40, size=100)
+    bs = BucketSampler(lengths, batch_size=8, num_buckets=4)
+    assert 1 <= len(bs.bucket_keys) <= 4
+    assert max(bs.bucket_keys) >= lengths.max()  # top quantile covers max
+    assert sum(len(b) for b in bs) == 100
+
+
+def test_variable_length_training_one_compile_per_bucket():
+    rng = onp.random.RandomState(0)
+    V, H, N = 12, 16, 40
+    lengths = rng.randint(2, 11, size=N)
+    seqs = [rng.randint(1, V, size=ln) for ln in lengths]
+
+    sampler = BucketSampler(lengths, batch_size=4, bucket_keys=[5, 10],
+                            shuffle=True, last_batch="discard", seed=1)
+
+    class BucketBatchify:
+        """Pad each batch to its bucket length (not the global max)."""
+
+        def __init__(self, sampler):
+            self.sampler = sampler
+
+        def __call__(self, items):
+            idxs = [i for i, _ in items]
+            arrs = [a for _, a in items]
+            k = self.sampler.bucket_of(idxs[0])
+            x = onp.zeros((len(arrs), k), "float32")
+            for r, a in enumerate(arrs):
+                x[r, :len(a)] = a
+            return NDArray(x)
+
+    ds = SimpleDataset([(i, seqs[i]) for i in range(N)])
+
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(V, 8),
+            rnn.LSTM(H),
+            nn.Dense(V, flatten=False))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    dl = DataLoader(ds, batch_sampler=sampler,
+                    batchify_fn=BucketBatchify(sampler))
+    shapes_seen = set()
+    losses = []
+    for _ in range(3):
+        for batch in dl:
+            shapes_seen.add(batch.shape)
+            with autograd.record():
+                out = net(batch)                       # (B, T, V)
+                loss = loss_fn(out[:, :-1], batch[:, 1:])
+            loss.backward()
+            trainer.step(batch.shape[0])
+            losses.append(float(loss.asnumpy().mean()))
+    # exactly one padded shape (jit signature) per non-empty bucket
+    assert shapes_seen == {(4, 5), (4, 10)}
+    # the per-signature CachedOp cache holds one entry per bucket
+    cache = getattr(net, "_cached_graph_cache", None) or \
+        getattr(net, "_jit_cache", None)
+    if cache is not None:
+        assert len(cache) >= 2
+    assert onp.mean(losses[-4:]) < onp.mean(losses[:4])

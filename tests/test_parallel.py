@@ -124,3 +124,48 @@ def test_trainer_with_kvstore_device():
         loss = (net(x) ** 2).sum()
     loss.backward()
     trainer.step(2)  # should not raise
+
+
+def test_run_steps_matches_sequential_steps():
+    """Fused multi-step (lax.scan) == n sequential step() calls."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, 3, padding=1), nn.BatchNorm(),
+                nn.Activation("relu"), nn.GlobalAvgPool2D(), nn.Dense(3))
+        net.initialize(init=mx.initializer.Xavier())
+        net(NDArray(onp.zeros((1, 2, 8, 8), onp.float32)))
+        return net
+
+    rng = onp.random.RandomState(0)
+    data = rng.randn(8, 2, 8, 8).astype("float32")
+    label = rng.randint(0, 3, size=(8,)).astype("float32")
+
+    mx.random.seed(0)
+    net_a = build()
+    mx.random.seed(0)
+    net_b = build()
+
+    kw = dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              mesh=make_mesh({"dp": -1}))
+    tr_a = SPMDTrainer(net_a, gloss.SoftmaxCrossEntropyLoss(), **kw)
+    tr_b = SPMDTrainer(net_b, gloss.SoftmaxCrossEntropyLoss(), **kw)
+
+    seq_losses = [float(tr_a.step(data, label).asnumpy()) for _ in range(3)]
+    fused = tr_b.run_steps(data, label, 3).asnumpy()
+
+    onp.testing.assert_allclose(fused, seq_losses, rtol=1e-5, atol=1e-6)
+    pa = net_a.collect_params()
+    pb = net_b.collect_params()
+    for k in pa:
+        onp.testing.assert_allclose(pa[k].data().asnumpy(),
+                                    pb[k].data().asnumpy(),
+                                    rtol=1e-5, atol=1e-6,
+                                    err_msg=f"param {k} diverged "
+                                            "(incl. BN running stats)")
