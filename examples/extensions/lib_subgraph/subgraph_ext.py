@@ -1,0 +1,46 @@
+"""Extension registering a custom subgraph backend + a custom op.
+
+Parity: example/extensions/lib_subgraph (custom SubgraphProperty loaded
+via MXLoadLib) — here the extension unit is a Python module
+(mxnet_tpu/library.py contract): ``register_ops`` may register ops AND
+subgraph backends/passes.  The backend below fuses chains of
+activation-family ops into single subgraph nodes (the role the
+reference's ``myProp`` selector plays in lib_subgraph/subgraph_lib.cc).
+
+Usage::
+
+    mx.library.load(".../subgraph_ext.py")
+    partitioned = mx.subgraph.partition(sym, "my_act_fuser")
+"""
+
+ACT_OPS = {"relu", "sigmoid", "tanh", "softsign", "Activation"}
+
+
+def register_ops(registry):
+    import jax.numpy as jnp
+    from mxnet_tpu.subgraph import (SubgraphProperty, SubgraphSelector,
+                                    register_subgraph_backend)
+
+    @registry.register("my_scaled_silu")
+    def my_scaled_silu(x, *, scale=1.0):
+        """Custom op shipped by this extension (usable standalone or
+        inside partitioned subgraphs)."""
+        return scale * x * jnp.asarray(1.0) / (1.0 + jnp.exp(-x))
+
+    class ActChainSelector(SubgraphSelector):
+        def select(self, node):
+            return node.op_name in ACT_OPS
+
+        def select_input(self, node, input_node):
+            return input_node.op_name in ACT_OPS
+
+        def select_output(self, node, output_node):
+            return output_node.op_name in ACT_OPS
+
+    @register_subgraph_backend("my_act_fuser")
+    class ActFuserProperty(SubgraphProperty):
+        def create_selector(self):
+            return ActChainSelector()
+
+        def min_subgraph_size(self):
+            return 2

@@ -1,0 +1,103 @@
+"""LeNet on MNIST with Gluon (parity: example/gluon/mnist/mnist.py).
+
+Runs on one TPU chip (or CPU with JAX_PLATFORMS=cpu).  Uses the local
+MNIST files if present under ``--data-dir``, else a synthetic stand-in
+so the example is runnable in a sealed environment.
+
+    python examples/gluon/mnist.py --epochs 2 --batch-size 128
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+
+def build_lenet():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, 5, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, 5, activation="relu"),
+            nn.MaxPool2D(2, 2),
+            nn.Flatten(),
+            nn.Dense(500, activation="relu"),
+            nn.Dense(10))
+    return net
+
+
+def load_data(data_dir, n_synth=2048):
+    try:
+        from mxnet_tpu.gluon.data.vision import MNIST
+        train = MNIST(root=data_dir, train=True)
+        X = onp.stack([onp.asarray(train[i][0]).reshape(1, 28, 28)
+                       for i in range(len(train))]).astype("float32") / 255
+        Y = onp.array([train[i][1] for i in range(len(train))], "float32")
+        return X, Y
+    except Exception:
+        print("MNIST files not found; using a synthetic stand-in")
+        rng = onp.random.RandomState(0)
+        Y = rng.randint(0, 10, size=n_synth).astype("float32")
+        X = rng.rand(n_synth, 1, 28, 28).astype("float32") * 0.1
+        for i, y in enumerate(Y.astype(int)):   # separable classes
+            X[i, 0, y * 2:(y + 1) * 2 + 2, :] += 0.8
+        return X, Y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch-size", type=int, default=128)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.02)
+    ap.add_argument("--data-dir", default=os.path.expanduser("~/.mxnet"))
+    ap.add_argument("--hybridize", action="store_true", default=True)
+    args = ap.parse_args()
+
+    X, Y = load_data(args.data_dir)
+    n_train = int(len(X) * 0.9)
+    train_dl = DataLoader(ArrayDataset(X[:n_train], Y[:n_train]),
+                          batch_size=args.batch_size, shuffle=True,
+                          last_batch="discard")
+    val_dl = DataLoader(ArrayDataset(X[n_train:], Y[n_train:]),
+                        batch_size=args.batch_size)
+
+    net = build_lenet()
+    net.initialize(init=mx.initializer.Xavier())
+    if args.hybridize:
+        net.hybridize(static_alloc=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gluon.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        total_loss = 0.0
+        batches = 0
+        for data, label in train_dl:
+            with autograd.record():
+                out = net(data)
+                loss = loss_fn(out, label)
+            loss.backward()
+            trainer.step(data.shape[0])
+            total_loss += float(loss.asnumpy().mean())
+            batches += 1
+            metric.update([label], [out])
+        _, train_acc = metric.get()
+        metric.reset()
+        for data, label in val_dl:
+            metric.update([label], [net(data)])
+        _, val_acc = metric.get()
+        print(f"epoch {epoch}: loss {total_loss / max(batches, 1):.4f} "
+              f"train-acc {train_acc:.3f} val-acc {val_acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -5,8 +5,12 @@ from . import quantization  # noqa: F401
 def __getattr__(name):
     # onnx is lazy: it needs google.protobuf, which is not a core
     # dependency of the package (parity: the reference's contrib.onnx
-    # also imports the onnx package only on use)
+    # also imports the onnx package only on use); torch_bridge is lazy
+    # on torch the same way (parity: plugin/torch)
     if name == "onnx":
         import importlib
         return importlib.import_module(".onnx", __name__)
+    if name == "torch_bridge":
+        import importlib
+        return importlib.import_module(".torch_bridge", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -122,9 +122,9 @@ def _shm_pack(batch):
 
     tree = collect(batch)
     total = sum(a.nbytes for a in leaves)
-    if total == 0:
-        return ("__shm__", None, [], tree)
-    shm = shared_memory.SharedMemory(create=True, size=total)
+    # size >= 1 even when every leaf is empty: zero-size leaves still
+    # need their (shape, dtype) metas for reconstruction
+    shm = shared_memory.SharedMemory(create=True, size=max(total, 1))
     # the parent owns the segment's lifetime: unregister it from this
     # worker's resource tracker so worker exit doesn't unlink/warn
     try:
@@ -272,7 +272,11 @@ class DataLoader:
         finally:
             # consumer stopped early (break/exception/GeneratorExit):
             # drain in-flight results and unlink their shm segments,
-            # which the workers deliberately disowned (_shm_pack)
+            # which the workers deliberately disowned (_shm_pack).
+            # Without shm there is nothing to clean up — don't stall
+            # the caller's early exit on in-flight batches.
+            if not self._use_shm:
+                pending = []
             for fut in pending:
                 try:
                     result = fut.get(self._timeout)

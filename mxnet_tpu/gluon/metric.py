@@ -15,8 +15,9 @@ import numpy as onp
 from ..base import MXNetError
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
-           "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy",
+           "F1", "Fbeta", "MCC", "PCC", "MAE", "MSE", "RMSE", "CrossEntropy",
            "NegativeLogLikelihood", "Perplexity", "PearsonCorrelation",
+           "BinaryAccuracy", "MeanPairwiseDistance", "MeanCosineSimilarity",
            "Loss", "CustomMetric", "create", "np"]
 
 _METRIC_REGISTRY: Dict[str, type] = {}
@@ -384,3 +385,121 @@ def np(numpy_feval, name="custom", allow_extra_outputs=False):
     feval.__name__ = getattr(numpy_feval, "__name__", name)
     return CustomMetric(feval, name=feval.__name__,
                         allow_extra_outputs=allow_extra_outputs)
+
+
+@register
+class Fbeta(F1):
+    """F-beta of a binary classification (parity: metric.py:815 Fbeta):
+    (1+β²)·P·R / (β²·P + R)."""
+
+    def __init__(self, name="fbeta", beta=1.0, average="macro", **kwargs):
+        self.beta = float(beta)
+        super().__init__(name=name, average=average, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_tolist(labels), _tolist(preds)):
+            self._stats.update(_as_np(label), _as_np(pred))
+        p, r = self._stats.precision, self._stats.recall
+        b2 = self.beta * self.beta
+        d = b2 * p + r
+        self.sum_metric = (1 + b2) * p * r / d if d else 0.0
+        self.num_inst = 1 if self._stats.total else 0
+
+
+@register
+class BinaryAccuracy(EvalMetric):
+    """Thresholded binary/multilabel accuracy (parity: metric.py:876)."""
+
+    def __init__(self, name="binary_accuracy", threshold=0.5, **kwargs):
+        self.threshold = threshold
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_tolist(labels), _tolist(preds)):
+            label = _as_np(label).astype(onp.int64).reshape(-1)
+            pred = (_as_np(pred) > self.threshold).astype(
+                onp.int64).reshape(-1)
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += int(pred.size)
+
+
+@register
+class MeanPairwiseDistance(EvalMetric):
+    """Mean per-sample p-norm distance (parity: metric.py:1197)."""
+
+    def __init__(self, name="mpd", p=2, **kwargs):
+        self.p = p
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_tolist(labels), _tolist(preds)):
+            label = _as_np(label).reshape(_as_np(label).shape[0], -1)
+            pred = _as_np(pred).reshape(pred.shape[0], -1)
+            dis = ((onp.abs(label - pred) ** self.p).sum(axis=-1)
+                   ) ** (1.0 / self.p)
+            self.sum_metric += float(dis.sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class MeanCosineSimilarity(EvalMetric):
+    """Mean cosine similarity along the last axis (parity:
+    metric.py:1263)."""
+
+    def __init__(self, name="cos_sim", eps=1e-8, **kwargs):
+        self.eps = eps
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds):
+        for label, pred in zip(_tolist(labels), _tolist(preds)):
+            label = _as_np(label).astype(onp.float64)
+            pred = _as_np(pred).astype(onp.float64)
+            num = (label * pred).sum(axis=-1)
+            den = onp.maximum(
+                onp.linalg.norm(label, axis=-1)
+                * onp.linalg.norm(pred, axis=-1), self.eps)
+            sim = num / den
+            self.sum_metric += float(sim.sum())
+            self.num_inst += int(sim.size)
+
+
+@register
+class PCC(EvalMetric):
+    """Multiclass Pearson correlation via the confusion matrix —
+    reduces to MCC for 2 classes (parity: metric.py:1651)."""
+
+    def __init__(self, name="pcc", **kwargs):
+        self._cm = onp.zeros((2, 2), onp.float64)
+        super().__init__(name, **kwargs)
+
+    def reset(self):
+        self._cm = onp.zeros((2, 2), onp.float64)
+        super().reset()
+
+    def _grow(self, n):
+        if n > self._cm.shape[0]:
+            cm = onp.zeros((n, n), onp.float64)
+            k = self._cm.shape[0]
+            cm[:k, :k] = self._cm
+            self._cm = cm
+
+    def update(self, labels, preds):
+        for label, pred in zip(_tolist(labels), _tolist(preds)):
+            label = _as_np(label).astype(onp.int64).reshape(-1)
+            pred = _as_np(pred)
+            pred_label = (pred.argmax(axis=-1) if pred.ndim > 1
+                          else (pred > 0.5)).astype(onp.int64).reshape(-1)
+            n = int(max(label.max(initial=0),
+                        pred_label.max(initial=0))) + 1
+            self._grow(n)
+            onp.add.at(self._cm, (label, pred_label), 1)
+        cm = self._cm
+        t = cm.sum(axis=1)   # true occurrences
+        p = cm.sum(axis=0)   # predicted occurrences
+        n = cm.sum()
+        cov_tp = (cm.diagonal().sum() * n - (t * p).sum())
+        cov_tt = (n * n - (t * t).sum())
+        cov_pp = (n * n - (p * p).sum())
+        d = math.sqrt(cov_tt * cov_pp)
+        self.sum_metric = cov_tp / d if d else 0.0
+        self.num_inst = 1 if n else 0

@@ -4,11 +4,13 @@ Parity: python/mxnet/io/io.py (DataIter :179, NDArrayIter :490,
 MXDataIter :799) + DataBatch/DataDesc.
 """
 from .io import (DataIter, DataBatch, DataDesc, NDArrayIter, CSVIter,
-                 ResizeIter, PrefetchingIter)
+                 ResizeIter, PrefetchingIter, MNISTIter)
 from . import native
-from .native import ImageRecordIter
+from .native import (ImageRecordIter, ImageRecordUInt8Iter,
+                     ImageRecordInt8Iter)
 from .libsvm import LibSVMIter
 
 __all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "native",
+           "ResizeIter", "PrefetchingIter", "MNISTIter", "ImageRecordIter",
+           "ImageRecordUInt8Iter", "ImageRecordInt8Iter", "native",
            "LibSVMIter"]

@@ -18,7 +18,7 @@ from ..base import MXNetError
 from ..ndarray import NDArray
 
 __all__ = ["DataIter", "DataBatch", "DataDesc", "NDArrayIter", "CSVIter",
-           "ResizeIter", "PrefetchingIter"]
+           "ResizeIter", "PrefetchingIter", "MNISTIter"]
 
 
 class DataDesc(namedtuple("DataDesc", ["name", "shape"])):
@@ -304,3 +304,70 @@ class PrefetchingIter(DataIter):
 
     def iter_next(self):
         return True
+
+
+class MNISTIter(DataIter):
+    """Iterator over the original MNIST idx files (parity:
+    src/io/iter_mnist.cc MNISTIter): reads idx3-ubyte images +
+    idx1-ubyte labels, optional shuffle/flat/silent, scales pixels
+    to [0,1] like the reference.
+    """
+
+    def __init__(self, image, label, batch_size=128, shuffle=False,
+                 flat=False, silent=False, seed=0, part_index=0,
+                 num_parts=1, **kwargs):
+        super().__init__(batch_size)
+        import gzip
+        import struct
+
+        def opener(path):
+            return gzip.open(path, "rb") if path.endswith(".gz") \
+                else open(path, "rb")
+
+        with opener(image) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise MXNetError(f"{image} is not an idx3-ubyte file")
+            X = onp.frombuffer(f.read(n * rows * cols), onp.uint8)
+            X = X.reshape(n, rows, cols).astype("float32") / 255.0
+        with opener(label) as f:
+            magic, n2 = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise MXNetError(f"{label} is not an idx1-ubyte file")
+            Y = onp.frombuffer(f.read(n2), onp.uint8).astype("float32")
+        if n != n2:
+            raise MXNetError("image/label counts differ")
+        # multi-part reading (parity: part_index/num_parts fields)
+        X = X[part_index::num_parts]
+        Y = Y[part_index::num_parts]
+        if shuffle:
+            perm = onp.random.RandomState(seed).permutation(len(X))
+            X, Y = X[perm], Y[perm]
+        X = X.reshape(len(X), -1) if flat else X[:, None, :, :]
+        if not silent:
+            print(f"MNISTIter: load {len(X)} images, shuffle={shuffle}, "
+                  f"flat={flat}")
+        self._X, self._Y = X, Y
+        self._cursor = 0
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._X.shape[1:])]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor + self.batch_size > len(self._X):
+            raise StopIteration
+        i = self._cursor
+        self._cursor += self.batch_size
+        return DataBatch(
+            data=[NDArray(self._X[i:i + self.batch_size])],
+            label=[NDArray(self._Y[i:i + self.batch_size])], pad=0,
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)

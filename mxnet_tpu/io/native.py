@@ -236,3 +236,36 @@ class ImageRecordIter:
             self.close()
         except Exception:
             pass
+
+
+class ImageRecordUInt8Iter(ImageRecordIter):
+    """ImageRecordIter yielding raw uint8 pixels (parity:
+    ImageRecordUInt8Iter, iter_image_recordio_2.cc:908): no
+    mean/std normalization, data dtype uint8 — the int8/uint8
+    quantized-inference input path."""
+
+    _out_dtype = onp.uint8
+    _offset = 0
+
+    def __init__(self, *args, **kwargs):
+        for k in ("mean_r", "mean_g", "mean_b"):
+            kwargs.pop(k, None)
+        for k in ("std_r", "std_g", "std_b"):
+            kwargs.pop(k, None)
+        super().__init__(*args, **kwargs)
+
+    def __next__(self):
+        batch = super().__next__()
+        from ..ndarray import NDArray
+        batch.data = [NDArray((onp.clip(d.asnumpy(), 0, 255)
+                               + self._offset).astype(self._out_dtype))
+                      for d in batch.data]
+        return batch
+
+
+class ImageRecordInt8Iter(ImageRecordUInt8Iter):
+    """Signed-int8 variant (parity: ImageRecordInt8Iter,
+    iter_image_recordio_2.cc:925): pixels shifted into [-128, 127]."""
+
+    _out_dtype = onp.int8
+    _offset = -128

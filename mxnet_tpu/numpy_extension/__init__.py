@@ -3,8 +3,10 @@ the `_npx_*` op namespace: nn ops with numpy arrays, sequence ops,
 set_np/reset_np re-exports)."""
 from __future__ import annotations
 
-from ..util import set_np, reset_np, is_np_array, use_np  # noqa: F401
-from ..context import cpu, gpu, tpu, num_gpus, num_tpus  # noqa: F401
+from ..util import (set_np, reset_np, is_np_array, is_np_shape,  # noqa: F401
+                    use_np)
+from ..context import (cpu, gpu, tpu, num_gpus, num_tpus,  # noqa: F401
+                       current_context)
 from ..ndarray.register import make_op_func as _make
 from ..ops import registry as _reg
 
@@ -24,11 +26,13 @@ _NPX_OPS = {
     "leaky_relu": "LeakyReLU",
     "softmax": "softmax",
     "log_softmax": "log_softmax",
-    "masked_softmax": "softmax",
+    "masked_softmax": "masked_softmax",
+    "masked_log_softmax": "masked_log_softmax",
     "topk": "topk",
     "pick": "pick",
     "one_hot": "one_hot",
-    "rnn": None,
+    "rnn": "RNN",
+    "batch_dot": "batch_dot",
     "sequence_mask": "SequenceMask",
     "smooth_l1": "smooth_l1",
     "gamma": "gamma",

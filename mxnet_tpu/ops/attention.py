@@ -306,6 +306,18 @@ def masked_softmax(x, mask=None, *, axis=-1, temperature=1.0):
     return p
 
 
+@register("masked_log_softmax", aliases=("_npx_masked_log_softmax",))
+def masked_log_softmax(x, mask=None, *, axis=-1, temperature=1.0):
+    """Log-softmax with additive masking; masked positions yield -inf
+    (parity: _npx_masked_log_softmax, src/operator/nn/softmax.cc)."""
+    if mask is not None:
+        x = jnp.where(mask.astype(bool), x, _NEG_INF)
+    out = jax.nn.log_softmax(x / temperature, axis=axis)
+    if mask is not None:
+        out = jnp.where(mask.astype(bool), out, -jnp.inf)
+    return out
+
+
 # --------------------------------------------------------------------------
 # contrib transformer parity ops (semantics per transformer.cc describe())
 # --------------------------------------------------------------------------

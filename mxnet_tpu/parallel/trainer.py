@@ -261,16 +261,62 @@ class SPMDTrainer:
                                       str(l.dtype), int(n_steps))
             self._step_cache[sig] = entry
         jitted, cell = entry
-        self.num_update += int(n_steps)
-        self.optimizer.num_update = self.num_update
+        # read lr/wd BEFORE advancing num_update — matching what the
+        # first of n sequential step() calls would use (the whole fused
+        # window trains at the window-entry schedule point)
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
+        self.num_update += int(n_steps)
+        self.optimizer.num_update = self.num_update
         p_arrays = [self._params[k].data()._data for k in self._pkeys]
         opt_state = [self._opt_state[k] for k in self._pkeys]
         new_p, new_s, losses = jitted(next_key(), lr, wd, p_arrays,
                                       opt_state, d, l)
         self._fold_back(new_p, new_s, cell)
         return NDArray(losses)
+
+    def predict(self, data):
+        """Jitted inference forward on the training mesh (params stay
+        sharded; the batch is dp-sharded like in ``step``).  Fills the
+        gap users hit right after SPMD training: an eager ``net(x)``
+        would collide single-device inputs with mesh-committed params."""
+        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
+        sig = ("predict", d.shape, str(d.dtype))
+        entry = self._step_cache.get(sig)
+        if entry is None:
+            net = self.net
+            params = [self._params[k] for k in self._pkeys]
+            amp = self.amp_dtype
+            key0 = next_key()   # fetched outside the trace; eval mode
+                                # draws no entropy in practice
+
+            def fwd(p_arrays, x):
+                from ..gluon.block import _TraceContext, _trace_scope
+                tc = _TraceContext(key0)
+                saved = [p._data for p in params]
+                if amp is not None:
+                    p_arrays = [a.astype(amp) if jnp.issubdtype(
+                        a.dtype, jnp.floating) else a for a in p_arrays]
+                    x = x.astype(amp) if jnp.issubdtype(
+                        x.dtype, jnp.floating) else x
+                try:
+                    for p, a in zip(params, p_arrays):
+                        p._data = NDArray(a)
+                    with _trace_scope(tc), ag.pause(train_mode=False):
+                        out = net.forward(NDArray(x))
+                    return out._data.astype(jnp.float32)
+                finally:
+                    for p, s in zip(params, saved):
+                        p._data = s
+
+            p_shardings, _ = self._state_shardings(params)
+            jitted = jax.jit(fwd, in_shardings=(
+                p_shardings, self._batch_sharding(d.ndim)))
+            entry = (jitted, None)
+            self._step_cache[sig] = entry
+        jitted, _ = entry
+        p_arrays = [self._params[k].data()._data for k in self._pkeys]
+        return NDArray(jitted(p_arrays, d))
 
     def cost_analysis(self, data, label, n_steps=None):
         """XLA cost analysis (flops/bytes) for the compiled step that

@@ -98,3 +98,37 @@ def test_rtc_errors():
     mod = mx.rtc.PallasModule("def k(o_ref):\n    o_ref[...] = 1.0")
     with pytest.raises(mx.MXNetError):
         mod.get_kernel("nope")
+
+
+def test_mnist_iter():
+    """MNISTIter over idx files (parity: src/io/iter_mnist.cc)."""
+    import gzip
+    import os
+    import struct
+    import tempfile
+
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    d = tempfile.mkdtemp()
+    X = (onp.arange(20 * 28 * 28) % 256).astype(onp.uint8)
+    Y = (onp.arange(20) % 10).astype(onp.uint8)
+    with open(os.path.join(d, "img"), "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 20, 28, 28))
+        f.write(X.tobytes())
+    with gzip.open(os.path.join(d, "lab.gz"), "wb") as f:
+        f.write(struct.pack(">II", 2049, 20))
+        f.write(Y.tobytes())
+
+    it = mx.io.MNISTIter(image=os.path.join(d, "img"),
+                         label=os.path.join(d, "lab.gz"),
+                         batch_size=8, shuffle=True, silent=True)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].data[0].shape == (8, 1, 28, 28)
+    flat = mx.io.MNISTIter(image=os.path.join(d, "img"),
+                           label=os.path.join(d, "lab.gz"),
+                           batch_size=4, flat=True, silent=True)
+    b = next(iter(flat))
+    assert b.data[0].shape == (4, 784)
+    assert float(b.data[0].asnumpy().max()) <= 1.0

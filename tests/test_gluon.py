@@ -320,3 +320,51 @@ def test_dataloader_shm_no_leak_on_early_exit():
     time.sleep(0.3)
     after = set(glob.glob("/dev/shm/psm_*"))
     assert after - before == set(), f"leaked shm: {after - before}"
+
+
+def test_metric_extended_set():
+    """Fbeta/BinaryAccuracy/MeanPairwiseDistance/MeanCosineSimilarity/PCC
+    against the reference docstring examples (metric.py:815-1700)."""
+    import numpy as onp
+    from mxnet_tpu.gluon import metric as M
+
+    fb = M.Fbeta(beta=2)
+    fb.update([mx.nd.array([0., 1., 1.])],
+              [mx.nd.array([[0.3, 0.7], [0., 1.], [0.4, 0.6]])])
+    assert abs(fb.get()[1] - 0.9090909090909091) < 1e-9
+
+    ba = M.BinaryAccuracy(threshold=0.6)
+    ba.update([mx.nd.array([0., 1., 0.])], [mx.nd.array([0.7, 1, 0.55])])
+    assert abs(ba.get()[1] - 2 / 3) < 1e-9
+
+    mpd = M.MeanPairwiseDistance()
+    mpd.update([mx.nd.array([[1., 0.], [4., 2.]])],
+               [mx.nd.array([[1., 2.], [3., 4.]])])
+    assert abs(mpd.get()[1] - 2.1180338859558105) < 1e-6
+
+    cs = M.MeanCosineSimilarity()
+    cs.update([mx.nd.array([[1., 0.]])], [mx.nd.array([[1., 0.]])])
+    assert abs(cs.get()[1] - 1.0) < 1e-9
+
+    # PCC reduces to MCC on binary problems
+    pcc, mcc = M.PCC(), M.MCC()
+    lab = mx.nd.array([0., 1., 1., 0., 1.])
+    pred = mx.nd.array([[0.8, 0.2], [0.3, 0.7], [0.6, 0.4],
+                        [0.9, 0.1], [0.2, 0.8]])
+    pcc.update([lab], [pred])
+    mcc.update([lab], [pred])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+
+    # registry round trip
+    assert isinstance(M.create("fbeta"), M.Fbeta)
+    assert isinstance(M.create("pcc"), M.PCC)
+
+
+def test_dataloader_shm_empty_leaves():
+    """Zero-size array leaves round-trip through the shm hand-off."""
+    import numpy as onp
+    from mxnet_tpu.gluon.data.dataloader import _shm_pack, _shm_unpack
+    out = _shm_unpack(_shm_pack((onp.zeros((2, 0), onp.float32),
+                                 onp.zeros((0,), onp.int64))))
+    assert out[0].shape == (2, 0)
+    assert out[1].shape == (0,)

@@ -78,3 +78,36 @@ def test_load_rejects_bad_so(tmp_path, gemm_ext):
                     "-o", str(bad)], check=True)
     with pytest.raises(Exception, match="mxnet_tpu_lib_version"):
         mx.library.load(str(bad), verbose=False)
+
+
+def test_subgraph_extension_backend():
+    """Extension module registering a custom op + subgraph backend
+    (parity: example/extensions/lib_subgraph)."""
+    import numpy as onp
+    from mxnet_tpu import subgraph as sg
+    from mxnet_tpu import symbol as sym_mod
+
+    path = os.path.join(os.path.dirname(EXT_DIR), "lib_subgraph",
+                        "subgraph_ext.py")
+    mx.library.load(path, verbose=False)
+
+    # custom op registered and callable
+    out = mx.nd.my_scaled_silu(mx.nd.array(onp.array([0.0, 1.0])),
+                               scale=2.0)
+    exp = 2.0 * onp.array([0.0, 1.0]) / (1 + onp.exp(-onp.array([0., 1.])))
+    onp.testing.assert_allclose(out.asnumpy(), exp, rtol=1e-6)
+
+    # backend registered and partitions an activation chain
+    assert "my_act_fuser" in sg.list_backends()
+    x = sym_mod.Variable("x")
+    y = sym_mod.relu(sym_mod.sigmoid(sym_mod.relu(x)))
+    part = sg.partition(y, "my_act_fuser")
+    ops = [n.op_name for n in part.all_nodes() if not n.is_var] \
+        if hasattr(part, "all_nodes") else None
+    # partitioned graph still evaluates identically
+    xin = onp.linspace(-2, 2, 8).astype("float32")
+    ref = y.eval(x=mx.nd.array(xin))[0].asnumpy()
+    got = part.eval(x=mx.nd.array(xin))[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+    if ops is not None:
+        assert "_subgraph_exec" in ops

@@ -172,3 +172,27 @@ def test_corrupt_record_compaction(tmp_path):
     labels = [float(x) for x in b.label[0].asnumpy()[:n_valid]]
     assert 99.0 not in labels
     it.close()
+
+
+def test_image_record_uint8_int8_iters(tmp_path):
+    """Quantized-input variants (parity: ImageRecordUInt8Iter /
+    ImageRecordInt8Iter, iter_image_recordio_2.cc:908,925)."""
+    import numpy as onp
+    from mxnet_tpu.io import ImageRecordInt8Iter, ImageRecordUInt8Iter
+
+    path = _make_rec(tmp_path, n=8)
+    it8 = ImageRecordUInt8Iter(path_imgrec=path, batch_size=4,
+                               data_shape=(3, 32, 32))
+    b = next(it8)
+    assert b.data[0].dtype == onp.uint8
+    arr = b.data[0].asnumpy()
+    assert arr.min() >= 0 and arr.max() <= 255
+    it8.close()
+
+    iti8 = ImageRecordInt8Iter(path_imgrec=path, batch_size=4,
+                               data_shape=(3, 32, 32))
+    b = next(iti8)
+    assert b.data[0].dtype == onp.int8
+    arr = b.data[0].asnumpy().astype(onp.int32)
+    assert arr.min() >= -128 and arr.max() <= 127
+    iti8.close()

@@ -107,3 +107,19 @@ def test_np_autograd_through_lifted_fn():
         y = mnp.einsum("ij,ij->", x, x)
     y.backward()
     onp.testing.assert_allclose(x.grad.asnumpy(), 2 * A, rtol=1e-5)
+
+
+def test_npx_masked_and_extras():
+    """npx masked_(log_)softmax honor the mask; rnn/batch_dot exposed
+    (parity: _npx_* registrations)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    x = mx.nd.array(onp.array([[1., 2., 3.]]))
+    m = mx.nd.array(onp.array([[1, 1, 0]]))
+    a = mx.npx.masked_softmax(x, m).asnumpy()
+    assert a[0, 2] == 0 and abs(a[0, :2].sum() - 1) < 1e-6
+    lo = mx.npx.masked_log_softmax(x, m).asnumpy()
+    assert lo[0, 2] == -onp.inf
+    for name in ("rnn", "batch_dot", "is_np_shape", "current_context"):
+        assert hasattr(mx.npx, name), name

@@ -1,0 +1,77 @@
+"""Torch interop bridge tests (parity: plugin/torch TorchModule)."""
+import numpy as onp
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.contrib.torch_bridge import (TorchOp, from_torch, to_torch,
+                                            wrap_module)
+
+
+def test_tensor_round_trip():
+    a = mx.nd.array(onp.arange(6, dtype=onp.float32).reshape(2, 3))
+    t = to_torch(a)
+    assert isinstance(t, torch.Tensor)
+    onp.testing.assert_array_equal(t.numpy(), a.asnumpy())
+    back = from_torch(t * 2)
+    onp.testing.assert_array_equal(back.asnumpy(), a.asnumpy() * 2)
+
+
+def test_torch_op_forward():
+    op = TorchOp(lambda x: torch.nn.functional.softplus(x))
+    x = onp.linspace(-2, 2, 12).astype(onp.float32).reshape(3, 4)
+    out = op(mx.nd.array(x))
+    onp.testing.assert_allclose(out.asnumpy(), onp.log1p(onp.exp(x)),
+                                rtol=1e-5)
+
+
+def test_torch_op_gradient():
+    op = TorchOp(lambda a, b: (a * b).sum() * torch.ones(()),
+                 output_shape_fn=lambda *shapes: ())
+    # scalar-output op: check dL/da = b, dL/db = a
+    rng = onp.random.RandomState(0)
+    a = mx.nd.array(rng.randn(3, 3).astype(onp.float32))
+    b = mx.nd.array(rng.randn(3, 3).astype(onp.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        out = op(a, b)
+    out.backward()
+    onp.testing.assert_allclose(a.grad.asnumpy(),
+                                b.asnumpy() * onp.ones((1, 1)), rtol=1e-5)
+    onp.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy(), rtol=1e-5)
+
+
+def test_torch_op_gradient_shape_fn():
+    op = TorchOp(lambda x: torch.tanh(x) * 3.0)
+    x = mx.nd.array(onp.array([[0.5, -0.5]], onp.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = op(x)
+        loss = y.sum()
+    loss.backward()
+    expect = 3.0 * (1 - onp.tanh(x.asnumpy()) ** 2)
+    onp.testing.assert_allclose(x.grad.asnumpy(), expect, rtol=1e-5)
+
+
+def test_wrap_module_feature_extractor():
+    mod = torch.nn.Sequential(torch.nn.Linear(4, 3), torch.nn.ReLU())
+    with torch.no_grad():
+        mod[0].weight.fill_(0.5)
+        mod[0].bias.zero_()
+    op = wrap_module(mod, output_shape_fn=lambda s: (s[0], 3))
+    x = onp.ones((2, 4), onp.float32)
+    out = op(mx.nd.array(x))
+    onp.testing.assert_allclose(out.asnumpy(), onp.full((2, 3), 2.0),
+                                rtol=1e-5)
+
+
+def test_torch_op_inside_jit():
+    import jax
+    import jax.numpy as jnp
+    op = TorchOp(lambda x: x * 2 + 1)
+    fn = op._op
+    out = jax.jit(fn)(jnp.ones((2, 2)))
+    onp.testing.assert_allclose(onp.asarray(out), onp.full((2, 2), 3.0))
