@@ -45,7 +45,7 @@ def run_spmd(args):
     from mxnet_tpu.parallel import make_mesh, SPMDTrainer
     from mxnet_tpu.ndarray import NDArray
 
-    net = get_resnet(1, 20, classes=10, thumbnail=True)
+    net = get_resnet(1, 18, classes=10, thumbnail=True)
     net.initialize(init=mx.initializer.Xavier())
     net(NDArray(onp.zeros((1, 3, 32, 32), "float32")))
     trainer = SPMDTrainer(net, gluon.loss.SoftmaxCrossEntropyLoss(),
@@ -70,7 +70,7 @@ def run_dist(args):
     rank, nworker = kv.rank, kv.num_workers
     print(f"worker {rank}/{nworker} up")
 
-    net = get_resnet(1, 20, classes=10, thumbnail=True)
+    net = get_resnet(1, 18, classes=10, thumbnail=True)
     net.initialize(init=mx.initializer.Xavier())
     trainer = gluon.Trainer(net.collect_params(), "sgd",
                             {"learning_rate": args.lr, "momentum": 0.9},

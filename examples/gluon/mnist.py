@@ -57,7 +57,8 @@ def main():
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--lr", type=float, default=0.02)
     ap.add_argument("--data-dir", default=os.path.expanduser("~/.mxnet"))
-    ap.add_argument("--hybridize", action="store_true", default=True)
+    ap.add_argument("--hybridize", action=argparse.BooleanOptionalAction,
+                    default=True)
     args = ap.parse_args()
 
     X, Y = load_data(args.data_dir)

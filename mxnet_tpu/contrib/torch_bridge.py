@@ -79,9 +79,9 @@ class TorchOp:
                 out, ts, torch.from_numpy(onp.asarray(dout, order="C")),
                 allow_unused=True)
             return tuple(
-                onp.zeros(t.shape, dout.dtype) if g is None
-                else onp.asarray(g.numpy(), order="C") for t, g in
-                zip(ts, gs))
+                onp.zeros(a.shape, a.dtype) if g is None
+                else onp.asarray(g.numpy(), order="C") for a, g in
+                zip(arrays, gs))
 
         @jax.custom_vjp
         def op(*arrays):

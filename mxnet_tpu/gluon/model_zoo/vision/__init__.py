@@ -21,8 +21,18 @@ for _mod in (_resnet, _alexnet, _vgg, _mobilenet, _squeezenet, _densenet,
 
 
 def get_model(name, **kwargs):
-    """Parity: vision.get_model."""
+    """Parity: vision.get_model (model_zoo/vision/__init__.py:112) —
+    accepts both this package's underscore spellings and the
+    reference's dotted ones ('squeezenet1.0', 'mobilenetv2_1.0',
+    'inceptionv3')."""
     name = name.lower()
+    if name not in _models:
+        # reference spellings: dots for versions, 'inceptionv3',
+        # 'mobilenetv2_*' without the underscore after v2
+        alias = (name.replace(".", "_")
+                 .replace("mobilenetv2_", "mobilenet_v2_")
+                 .replace("inceptionv3", "inception_v3"))
+        name = alias if alias in _models else name
     if name not in _models:
         raise MXNetError(
             f"model {name!r} not found; available: {sorted(_models)}")

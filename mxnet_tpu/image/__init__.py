@@ -6,16 +6,28 @@ src/operator/image/ and src/io/image_det_aug_default.cc.  cv2 is
 optional; PIL/numpy fallbacks keep it working in minimal environments.
 """
 from .image import (imread, imdecode, imresize, resize_short, fixed_crop,
-                    center_crop, random_crop, color_normalize, ImageIter,
-                    CreateAugmenter, Augmenter)
+                    center_crop, random_crop, color_normalize, scale_down,
+                    random_size_crop, ImageIter, CreateAugmenter, Augmenter,
+                    ResizeAug, ForceResizeAug, CenterCropAug, RandomCropAug,
+                    RandomSizedCropAug, HorizontalFlipAug, CastAug,
+                    ColorNormalizeAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, HueJitterAug,
+                    ColorJitterAug, LightingAug, RandomGrayAug,
+                    SequentialAug, RandomOrderAug)
 from .detection import (DetAugmenter, DetBorrowAug, DetHorizontalFlipAug,
                         DetRandomCropAug, DetRandomPadAug,
                         DetRandomSelectAug, CreateDetAugmenter,
                         CreateMultiRandCropAugmenter, ImageDetIter)
 
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
-           "center_crop", "random_crop", "color_normalize", "ImageIter",
-           "CreateAugmenter", "Augmenter",
+           "center_crop", "random_crop", "color_normalize", "scale_down",
+           "random_size_crop", "ImageIter", "CreateAugmenter", "Augmenter",
+           "ResizeAug", "ForceResizeAug", "CenterCropAug", "RandomCropAug",
+           "RandomSizedCropAug", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "RandomGrayAug", "SequentialAug",
+           "RandomOrderAug",
            "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
            "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
            "CreateDetAugmenter", "CreateMultiRandCropAugmenter",

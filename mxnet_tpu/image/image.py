@@ -10,6 +10,7 @@ import random as pyrandom
 from typing import List, Optional
 
 import numpy as onp
+import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray import NDArray
@@ -186,6 +187,221 @@ class ColorNormalizeAug(Augmenter):
         return color_normalize(src, NDArray(self.mean), NDArray(self.std))
 
 
+def scale_down(src_size, size):
+    """Scale size down so it fits in src_size, keeping aspect ratio
+    (parity: image.py scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    """Random-area/aspect crop then resize (parity: image.py
+    random_size_crop — the inception-style crop)."""
+    h, w = src.shape[0], src.shape[1]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (onp.log(ratio[0]), onp.log(ratio[1]))
+        new_ratio = onp.exp(pyrandom.uniform(*log_ratio))
+        new_w = int(round(onp.sqrt(target_area * new_ratio)))
+        new_h = int(round(onp.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = pyrandom.randint(0, w - new_w)
+            y0 = pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    # fallback: center crop (parity behavior)
+    return center_crop(src, size, interp)
+
+
+class ForceResizeAug(Augmenter):
+    """Force-resize to exact (w, h), ignoring aspect (parity:
+    image.py ForceResizeAug)."""
+
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomSizedCropAug(Augmenter):
+    """Inception-style random area/aspect crop (parity: image.py
+    RandomSizedCropAug)."""
+
+    def __init__(self, size, area, ratio, interp=2):
+        super().__init__(size=size, area=area, ratio=ratio)
+        self.size = size
+        self.area = area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-b, b) (parity: image.py BrightnessJitterAug)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.brightness, self.brightness)
+        return NDArray(src._data * alpha)
+
+
+_GRAY = onp.array([0.299, 0.587, 0.114], onp.float32)
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the mean gray level (parity: image.py
+    ContrastJitterAug)."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.contrast, self.contrast)
+        x = src._data
+        gray = (x * jnp.asarray(_GRAY)).sum()
+        gray = (3.0 * (1.0 - alpha) / x.size) * gray
+        return NDArray(x * alpha + gray)
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend with the per-pixel gray image (parity: image.py
+    SaturationJitterAug)."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + pyrandom.uniform(-self.saturation, self.saturation)
+        x = src._data
+        gray = (x * jnp.asarray(_GRAY)).sum(axis=-1, keepdims=True)
+        return NDArray(x * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue via the YIQ linear approximation (parity: image.py
+    HueJitterAug)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = pyrandom.uniform(-self.hue, self.hue)
+        u = onp.cos(alpha * onp.pi)
+        w = onp.sin(alpha * onp.pi)
+        bt = onp.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], onp.float32)
+        tyiq = onp.array([[0.299, 0.587, 0.114],
+                          [0.596, -0.274, -0.321],
+                          [0.211, -0.523, 0.311]], onp.float32)
+        ityiq = onp.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], onp.float32)
+        t = onp.dot(onp.dot(ityiq, bt), tyiq)
+        return NDArray(jnp.dot(src._data, jnp.asarray(t.T)))
+
+
+class ColorJitterAug(Augmenter):
+    """Random order of brightness/contrast/saturation jitter (parity:
+    image.py ColorJitterAug, a RandomOrderAug of the three jitters)."""
+
+    def __init__(self, brightness, contrast, saturation):
+        super().__init__(brightness=brightness, contrast=contrast,
+                         saturation=saturation)
+        augs = []
+        if brightness > 0:
+            augs.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            augs.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            augs.append(SaturationJitterAug(saturation))
+        self._order = RandomOrderAug(augs)
+
+    def __call__(self, src):
+        return self._order(src)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise (parity: image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = onp.asarray(eigval, onp.float32)
+        self.eigvec = onp.asarray(eigvec, onp.float32)
+
+    def __call__(self, src):
+        alpha = onp.random.normal(0, self.alphastd, size=(3,))
+        rgb = onp.dot(self.eigvec * alpha, self.eigval)
+        return NDArray(src._data + jnp.asarray(rgb.astype(onp.float32)))
+
+
+class RandomGrayAug(Augmenter):
+    """Randomly convert to 3-channel gray (parity: image.py
+    RandomGrayAug)."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self._mat = onp.array([[0.21, 0.21, 0.21],
+                               [0.72, 0.72, 0.72],
+                               [0.07, 0.07, 0.07]], onp.float32)
+
+    def __call__(self, src):
+        if pyrandom.random() < self.p:
+            return NDArray(jnp.dot(src._data, jnp.asarray(self._mat)))
+        return src
+
+
+class SequentialAug(Augmenter):
+    """Apply augmenters in order (parity: image.py SequentialAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for t in self.ts:
+            src = t(src)
+        return src
+
+
+class RandomOrderAug(Augmenter):
+    """Apply augmenters in random order (parity: image.py
+    RandomOrderAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        order = list(self.ts)
+        pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -196,13 +412,30 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = onp.array([55.46, 4.794, 1.148])
+        eigvec = onp.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = onp.array([123.68, 116.28, 103.53])
     if std is True:

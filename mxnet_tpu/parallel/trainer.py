@@ -41,11 +41,18 @@ class SPMDTrainer:
     def __init__(self, net, loss_fn: Callable, optimizer="sgd",
                  optimizer_params: Optional[dict] = None,
                  mesh: Optional[Mesh] = None, batch_axis: int = 0,
-                 donate: bool = True, dtype: Optional[str] = None):
+                 donate: bool = True, dtype: Optional[str] = None,
+                 remat: bool = False):
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
         self.batch_axis = batch_axis
+        # rematerialization: recompute the forward during backward
+        # instead of keeping activations live — trades FLOPs for HBM
+        # (the jax.checkpoint knob the build targets for long-context /
+        # big-batch training; the reference has no equivalent because
+        # its engine frees activations eagerly per-op)
+        self.remat = bool(remat)
         # mixed precision (parity: AMP bf16 — master weights stay f32,
         # forward/backward compute in bf16 on the MXU; bf16 needs no loss
         # scaling on TPU, SURVEY.md §7 stage 7)
@@ -116,8 +123,10 @@ class SPMDTrainer:
                     for p, s in zip(params, saved):
                         p._data = s
 
+            grad_target = (jax.checkpoint(loss_of) if self.remat
+                           else loss_of)
             (loss_val, aux), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(list(p_arrays))
+                grad_target, has_aux=True)(list(p_arrays))
 
             new_params, new_state = [], []
             for k, w, g, st in zip(pkeys, p_arrays, grads, opt_state):
@@ -168,7 +177,12 @@ class SPMDTrainer:
                         self._batch_sharding(len(data_shape)),
                         self._batch_sharding(len(label_shape)))
         donate = (3, 4) if self._donate else ()
+        # pin outputs to the declared state shardings: without this,
+        # GSPMD may hand back e.g. a bias sharded like the matmul it
+        # feeds, and the next call's replicated in_sharding rejects it
+        out_shardings = (p_shardings, s_shardings, rep, rep)
         jitted = jax.jit(step, in_shardings=in_shardings,
+                         out_shardings=out_shardings,
                          donate_argnums=donate)
         return jitted, cell
 
@@ -200,6 +214,7 @@ class SPMDTrainer:
                         self._batch_sharding(len(label_shape)))
         donate = (3, 4) if self._donate else ()
         jitted = jax.jit(many, in_shardings=in_shardings,
+                         out_shardings=(p_shardings, s_shardings, rep),
                          donate_argnums=donate)
         return jitted, cell
 

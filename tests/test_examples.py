@@ -1,0 +1,60 @@
+"""Smoke tests for the worked examples (parity: the reference's
+tests/tutorials CI job — examples must stay runnable)."""
+import importlib.util
+import os
+import sys
+
+import numpy as onp
+import pytest
+
+EX = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+def _load(relpath, name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(EX, relpath))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_mnist_example_trains(monkeypatch, capsys):
+    m = _load("gluon/mnist.py", "mnist_example")
+    monkeypatch.setattr(sys, "argv", ["mnist.py", "--epochs", "1",
+                                      "--batch-size", "32"])
+    orig = m.load_data
+    monkeypatch.setattr(m, "load_data", lambda d: orig(d, n_synth=96))
+    m.main()
+    out = capsys.readouterr().out
+    assert "epoch 0" in out and "train-acc" in out and "val-acc" in out
+
+
+def test_bucketing_example_runs(monkeypatch, capsys):
+    m = _load("rnn/bucketing.py", "bucketing_example")
+    monkeypatch.setattr(sys, "argv", ["bucketing.py", "--epochs", "1",
+                                      "--batch-size", "8",
+                                      "--hidden", "16"])
+    orig = m.synthetic_corpus
+    monkeypatch.setattr(m, "synthetic_corpus",
+                        lambda **kw: orig(n=48, vocab=32))
+    m.main()
+    out = capsys.readouterr().out
+    assert "buckets:" in out and "perplexity" in out
+
+
+def test_cifar_dist_example_spmd(monkeypatch, capsys):
+    m = _load("distributed_training/cifar10_dist.py", "cifar_example")
+    monkeypatch.setattr(sys, "argv", ["cifar10_dist.py", "--epochs", "1",
+                                      "--batch-size", "16"])
+    monkeypatch.setattr(m, "synthetic_cifar", _tiny_cifar)
+    m.main()
+    out = capsys.readouterr().out
+    assert "epoch 0: loss" in out
+
+
+def _tiny_cifar(n=32):
+    rng = onp.random.RandomState(0)
+    X = rng.rand(n, 3, 32, 32).astype("float32")
+    Y = rng.randint(0, 10, size=n).astype("float32")
+    return X, Y

@@ -368,3 +368,12 @@ def test_dataloader_shm_empty_leaves():
                                  onp.zeros((0,), onp.int64))))
     assert out[0].shape == (2, 0)
     assert out[1].shape == (0,)
+
+
+def test_model_zoo_reference_spellings():
+    """get_model accepts the reference's dotted names
+    (model_zoo/vision/__init__.py:112)."""
+    from mxnet_tpu.gluon.model_zoo.vision import get_model
+    for n in ("squeezenet1.0", "inceptionv3", "mobilenet1.0",
+              "mobilenetv2_0.5"):
+        assert get_model(n) is not None

@@ -169,3 +169,44 @@ def test_run_steps_matches_sequential_steps():
                                     rtol=1e-5, atol=1e-6,
                                     err_msg=f"param {k} diverged "
                                             "(incl. BN running stats)")
+
+
+def test_remat_matches_plain_step():
+    """remat=True (jax.checkpoint) must be numerically identical."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="relu"), nn.BatchNorm(),
+                nn.Dense(3))
+        net.initialize(init=mx.initializer.Xavier())
+        net(NDArray(onp.zeros((1, 6), onp.float32)))
+        return net
+
+    rng = onp.random.RandomState(0)
+    data = rng.randn(8, 6).astype("float32")
+    label = rng.randint(0, 3, size=(8,)).astype("float32")
+    kw = dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              mesh=make_mesh({"dp": -1}))
+
+    mx.random.seed(0)
+    a = build()
+    mx.random.seed(0)
+    b = build()
+    ta = SPMDTrainer(a, gloss.SoftmaxCrossEntropyLoss(), **kw)
+    tb = SPMDTrainer(b, gloss.SoftmaxCrossEntropyLoss(), remat=True, **kw)
+    for _ in range(3):
+        la = ta.step(data, label)
+        lb = tb.step(data, label)
+        onp.testing.assert_allclose(la.asnumpy(), lb.asnumpy(),
+                                    rtol=1e-6, atol=1e-7)
+    pa, pb = a.collect_params(), b.collect_params()
+    for k in pa:
+        onp.testing.assert_allclose(pa[k].data().asnumpy(),
+                                    pb[k].data().asnumpy(),
+                                    rtol=1e-6, atol=1e-7)

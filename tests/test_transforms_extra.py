@@ -103,3 +103,33 @@ def test_rotate_zoom_modes():
     assert nz.min() < 1.0
     with pytest.raises(ValueError, match="mutually exclusive"):
         T.Rotate(30, zoom_in=True, zoom_out=True)(img)
+
+
+def test_legacy_image_augmenter_family():
+    """mx.image legacy augmenters (parity: python/mxnet/image/image.py
+    jitter/lighting/gray/sized-crop family)."""
+    import numpy as onp
+    from mxnet_tpu import image as I
+    from mxnet_tpu.ndarray import NDArray
+
+    img = NDArray(onp.random.RandomState(0).rand(40, 32, 3)
+                  .astype("float32"))
+    augs = I.CreateAugmenter((3, 24, 24), resize=28, rand_crop=True,
+                             rand_resize=True, rand_mirror=True,
+                             brightness=0.1, contrast=0.1, saturation=0.1,
+                             hue=0.1, pca_noise=0.05, rand_gray=0.3,
+                             mean=True, std=True)
+    x = img
+    for a in augs:
+        x = a(x)
+    assert x.shape == (24, 24, 3)
+
+    assert I.SequentialAug([I.ForceResizeAug((16, 16)),
+                            I.CastAug()])(img).shape == (16, 16, 3)
+    out = I.RandomOrderAug([I.BrightnessJitterAug(0.1),
+                            I.ContrastJitterAug(0.1)])(img)
+    assert out.shape == img.shape
+    assert I.scale_down((20, 20), (30, 15)) == (20, 10)
+    crop, box = I.random_size_crop(img, (16, 16), (0.3, 1.0),
+                                   (0.75, 1.333))
+    assert crop.shape == (16, 16, 3)
