@@ -1,6 +1,9 @@
 """gluon.contrib (parity: python/mxnet/gluon/contrib/)."""
 from . import estimator
 from . import nn
+from . import cnn
+from . import data
+from .cnn import DeformableConvolution, ModulatedDeformableConvolution
 from .layers import (SyncBatchNorm, PixelShuffle1D, PixelShuffle2D,
                      PixelShuffle3D, HybridConcurrent, Concurrent, Identity)
 from . import rnn_cells
@@ -10,7 +13,8 @@ from .rnn_cells import (Conv1DRNNCell, Conv2DRNNCell, Conv3DRNNCell,
                         Conv1DGRUCell, Conv2DGRUCell, Conv3DGRUCell,
                         VariationalDropoutCell, LSTMPCell)
 
-__all__ = ["estimator", "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
+__all__ = ["estimator", "cnn", "data", "DeformableConvolution",
+           "ModulatedDeformableConvolution", "SyncBatchNorm", "PixelShuffle1D", "PixelShuffle2D",
            "PixelShuffle3D", "HybridConcurrent", "Concurrent", "Identity",
            "Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
            "Conv1DLSTMCell", "Conv2DLSTMCell", "Conv3DLSTMCell",

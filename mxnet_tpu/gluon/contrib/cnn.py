@@ -1,0 +1,131 @@
+"""gluon.contrib.cnn — deformable convolution layers.
+
+Parity: python/mxnet/gluon/contrib/cnn/conv_layers.py
+(DeformableConvolution, ModulatedDeformableConvolution): a standard
+conv branch predicts per-tap offsets (and, for DCNv2, sigmoid masks),
+then the deformable kernel samples the input at those offsets.  Both
+lower to the registered ops `_contrib_DeformableConvolution` /
+`_contrib_ModulatedDeformableConvolution` (ops/vision.py).
+"""
+from __future__ import annotations
+
+from ... import initializer as init_mod
+from ...base import MXNetError
+from ...ops.registry import invoke
+from ..block import HybridBlock
+from ..parameter import Parameter
+
+__all__ = ["DeformableConvolution", "ModulatedDeformableConvolution"]
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+class DeformableConvolution(HybridBlock):
+    """Deformable conv v1 layer (parity: contrib.cnn
+    DeformableConvolution)."""
+
+    _mask = False
+
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, use_bias=True, in_channels=0,
+                 activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        self._kernel = _pair(kernel_size)
+        self._strides = _pair(strides)
+        self._padding = _pair(padding)
+        self._dilation = _pair(dilation)
+        self._groups = groups
+        self._ndg = num_deformable_group
+        self._use_bias = use_bias
+        self._offset_use_bias = offset_use_bias
+        self._act = activation
+        kh, kw = self._kernel
+        per_tap = 3 if self._mask else 2
+        self._offset_channels = per_tap * num_deformable_group * kh * kw
+
+        self.weight = Parameter(
+            shape=(channels, in_channels // groups if in_channels else 0,
+                   kh, kw),
+            init=weight_initializer, allow_deferred_init=True)
+        if use_bias:
+            self.bias = Parameter(shape=(channels,),
+                                  init=init_mod.create(bias_initializer))
+        else:
+            self.bias = None
+        self.offset_weight = Parameter(
+            shape=(self._offset_channels,
+                   in_channels if in_channels else 0, kh, kw),
+            init=init_mod.create(offset_weight_initializer),
+            allow_deferred_init=True)
+        if offset_use_bias:
+            self.offset_bias = Parameter(
+                shape=(self._offset_channels,),
+                init=init_mod.create(offset_bias_initializer))
+        else:
+            self.offset_bias = None
+
+    def _finish_deferred(self, x):
+        C = x.shape[1]
+        if self.weight._deferred_init is not None:
+            self.weight._finish_deferred_init(
+                (self._channels, C // self._groups) + self._kernel)
+        if self.offset_weight._deferred_init is not None:
+            self.offset_weight._finish_deferred_init(
+                (self._offset_channels, C) + self._kernel)
+
+    def forward(self, x):
+        self._finish_deferred(x)
+        conv_kw = dict(kernel=self._kernel, stride=self._strides,
+                       pad=self._padding, dilate=self._dilation)
+        offset_all = invoke(
+            "Convolution",
+            [x, self.offset_weight.data(),
+             self.offset_bias.data() if self.offset_bias is not None
+             else None],
+            num_filter=self._offset_channels, num_group=1,
+            no_bias=self.offset_bias is None, **conv_kw)
+        kh, kw = self._kernel
+        n_off = 2 * self._ndg * kh * kw
+        if self._mask:
+            offset = offset_all.slice_axis(axis=1, begin=0, end=n_off)
+            mask = invoke("sigmoid", [offset_all.slice_axis(
+                axis=1, begin=n_off, end=None)])
+            out = invoke(
+                "_contrib_ModulatedDeformableConvolution",
+                [x, offset, mask, self.weight.data(),
+                 self.bias.data() if self.bias is not None else None],
+                num_filter=self._channels, num_group=self._groups,
+                num_deformable_group=self._ndg,
+                no_bias=self.bias is None, **conv_kw)
+        else:
+            out = invoke(
+                "_contrib_DeformableConvolution",
+                [x, offset_all, self.weight.data(),
+                 self.bias.data() if self.bias is not None else None],
+                num_filter=self._channels, num_group=self._groups,
+                num_deformable_group=self._ndg,
+                no_bias=self.bias is None, **conv_kw)
+        if self._act:
+            out = invoke("Activation", [out], act_type=self._act)
+        return out
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._channels}, "
+                f"kernel_size={self._kernel}, "
+                f"num_deformable_group={self._ndg})")
+
+
+class ModulatedDeformableConvolution(DeformableConvolution):
+    """Deformable conv v2 (parity: contrib.cnn
+    ModulatedDeformableConvolution): adds a sigmoid modulation mask per
+    kernel tap."""
+
+    _mask = True

@@ -1,0 +1,14 @@
+"""gluon.contrib.data (parity: python/mxnet/gluon/contrib/data/):
+IntervalSampler, WikiText corpora, bbox-aware vision transforms and
+loaders."""
+from ...data.sampler import IntervalSampler
+from .text import WikiText2, WikiText103, Vocabulary
+from .vision import (ImageBboxRandomFlipLeftRight, ImageBboxCrop,
+                     ImageBboxRandomCropWithConstraints,
+                     ImageBboxRandomExpand, ImageBboxResize,
+                     ImageDataLoader, ImageBboxDataLoader)
+
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103", "Vocabulary",
+           "ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader"]

@@ -1,0 +1,112 @@
+"""Text language-modeling datasets.
+
+Parity: python/mxnet/gluon/contrib/data/text.py (WikiText2 :104,
+WikiText103 :142): word-level corpora sliced into fixed-length
+(data, label) pairs with label = data shifted by one, '<eos>' appended
+per line.  This build runs with zero egress, so the tokens files must
+already exist under ``root`` (wiki.{train,valid,test}.tokens — place
+them there manually); a clear error says so otherwise.
+"""
+from __future__ import annotations
+
+import os
+from collections import Counter
+from typing import Dict, List, Optional
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ndarray import NDArray
+from ...data.dataset import Dataset
+
+__all__ = ["WikiText2", "WikiText103", "Vocabulary"]
+
+EOS_TOKEN = "<eos>"
+UNK_TOKEN = "<unk>"
+
+
+class Vocabulary:
+    """Minimal word vocabulary (parity: contrib.text.vocab.Vocabulary as
+    used by the WikiText datasets): most-frequent-first indexing with an
+    unknown token at index 0."""
+
+    def __init__(self, counter: Optional[Counter] = None,
+                 unknown_token: str = UNK_TOKEN):
+        self.unknown_token = unknown_token
+        self.idx_to_token: List[str] = [unknown_token]
+        self.token_to_idx: Dict[str, int] = {unknown_token: 0}
+        if counter:
+            for tok, _ in sorted(counter.items(),
+                                 key=lambda kv: (-kv[1], kv[0])):
+                if tok not in self.token_to_idx:
+                    self.token_to_idx[tok] = len(self.idx_to_token)
+                    self.idx_to_token.append(tok)
+
+    def __len__(self):
+        return len(self.idx_to_token)
+
+    def to_indices(self, tokens: List[str]) -> List[int]:
+        return [self.token_to_idx.get(t, 0) for t in tokens]
+
+    def to_tokens(self, indices: List[int]) -> List[str]:
+        return [self.idx_to_token[i] for i in indices]
+
+
+class _WikiText(Dataset):
+    _files = {"train": "wiki.train.tokens",
+              "validation": "wiki.valid.tokens",
+              "test": "wiki.test.tokens"}
+
+    def __init__(self, root, name, segment="train", vocab=None, seq_len=35):
+        if segment not in self._files:
+            raise MXNetError(f"segment must be one of {list(self._files)}")
+        self._root = os.path.expanduser(root)
+        self._seq_len = seq_len
+        path = os.path.join(self._root, self._files[segment])
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"{path} not found. This environment has no network "
+                f"egress; download the {name} tokens files elsewhere and "
+                f"place them under {self._root}")
+        with open(path, "r", encoding="utf8") as f:
+            content = f.read()
+        lines = [ln.strip().split() for ln in content.splitlines()]
+        tokens: List[str] = []
+        for ln in lines:
+            if ln:
+                tokens.extend(ln)
+                tokens.append(EOS_TOKEN)
+        if vocab is None:
+            vocab = Vocabulary(Counter(tokens))
+        self.vocabulary = vocab
+        idx = onp.asarray(vocab.to_indices(tokens), onp.int32)
+        data, label = idx[:-1], idx[1:]
+        n = (len(data) // seq_len) * seq_len
+        self._data = data[:n].reshape(-1, seq_len)
+        self._label = label[:n].reshape(-1, seq_len)
+
+    def __getitem__(self, i):
+        return NDArray(self._data[i]), NDArray(self._label[i])
+
+    def __len__(self):
+        return len(self._data)
+
+
+class WikiText2(_WikiText):
+    """Parity: contrib.data.text.WikiText2 (local files only)."""
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        root = root or os.path.join(
+            os.environ.get("MXNET_HOME", os.path.expanduser("~/.mxnet")),
+            "datasets", "wikitext-2")
+        super().__init__(root, "wikitext-2", segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """Parity: contrib.data.text.WikiText103 (local files only)."""
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        root = root or os.path.join(
+            os.environ.get("MXNET_HOME", os.path.expanduser("~/.mxnet")),
+            "datasets", "wikitext-103")
+        super().__init__(root, "wikitext-103", segment, vocab, seq_len)

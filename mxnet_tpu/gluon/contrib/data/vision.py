@@ -1,0 +1,272 @@
+"""gluon.contrib.data vision: bbox-aware transforms + data loaders.
+
+Parity: python/mxnet/gluon/contrib/data/vision/transforms/bbox/bbox.py
+(ImageBboxRandomFlipLeftRight :34, ImageBboxCrop :90,
+ImageBboxRandomCropWithConstraints :160, ImageBboxRandomExpand :255,
+ImageBboxResize :297) and vision/dataloader.py (ImageDataLoader /
+ImageBboxDataLoader).  Images are HWC NDArrays; bboxes (N, 4+) with
+corner coords in columns 0-3 and extra attributes passed through.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as onp
+
+from ....base import MXNetError
+from ....ndarray import NDArray
+from ...block import Block
+from ...data import DataLoader
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader"]
+
+
+def _np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else onp.asarray(x)
+
+
+def _check_bbox(bbox):
+    b = _np(bbox)
+    if b.ndim != 2 or b.shape[1] < 4:
+        raise MXNetError("bbox must be (N, 4+)")
+    return b
+
+
+def _bbox_crop(bbox, crop, allow_outside_center=False):
+    """Crop bboxes to region (x, y, w, h); drop empties (parity:
+    gluon/contrib/data/vision/transforms/bbox/utils.py bbox_crop)."""
+    x0, y0, w, h = crop
+    b = bbox.copy()
+    b[:, 0] = onp.clip(b[:, 0], x0, x0 + w) - x0
+    b[:, 1] = onp.clip(b[:, 1], y0, y0 + h) - y0
+    b[:, 2] = onp.clip(b[:, 2], x0, x0 + w) - x0
+    b[:, 3] = onp.clip(b[:, 3], y0, y0 + h) - y0
+    keep = (b[:, 2] > b[:, 0]) & (b[:, 3] > b[:, 1])
+    if not allow_outside_center:
+        cx = (bbox[:, 0] + bbox[:, 2]) / 2
+        cy = (bbox[:, 1] + bbox[:, 3]) / 2
+        keep &= ((cx >= x0) & (cx <= x0 + w) & (cy >= y0)
+                 & (cy <= y0 + h))
+    return b[keep]
+
+
+def _bbox_iou_with_region(bbox, region):
+    x0, y0, w, h = region
+    x1, y1 = x0 + w, y0 + h
+    ix0 = onp.maximum(bbox[:, 0], x0)
+    iy0 = onp.maximum(bbox[:, 1], y0)
+    ix1 = onp.minimum(bbox[:, 2], x1)
+    iy1 = onp.minimum(bbox[:, 3], y1)
+    inter = onp.clip(ix1 - ix0, 0, None) * onp.clip(iy1 - iy0, 0, None)
+    area_b = (bbox[:, 2] - bbox[:, 0]) * (bbox[:, 3] - bbox[:, 1])
+    union = area_b + w * h - inter
+    return inter / onp.maximum(union, 1e-12)
+
+
+class ImageBboxRandomFlipLeftRight(Block):
+    """Flip image + boxes horizontally with probability p (parity:
+    bbox.py:34)."""
+
+    def __init__(self, p=0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, img, bbox):
+        b = _check_bbox(bbox)
+        if self.p <= 0 or (self.p < 1 and pyrandom.random() > self.p):
+            return img, bbox
+        arr = _np(img)[:, ::-1]
+        width = arr.shape[1]
+        nb = b.copy()
+        nb[:, 0] = width - b[:, 2]
+        nb[:, 2] = width - b[:, 0]
+        return NDArray(arr.copy()), NDArray(nb)
+
+
+class ImageBboxCrop(Block):
+    """Fixed crop (x, y, w, h) of image + boxes (parity: bbox.py:90)."""
+
+    def __init__(self, crop, allow_outside_center=False):
+        super().__init__()
+        if len(crop) != 4:
+            raise MXNetError("crop must be (x_min, y_min, width, height)")
+        self._crop = tuple(int(c) for c in crop)
+        self._allow = allow_outside_center
+
+    def forward(self, img, bbox):
+        b = _check_bbox(bbox)
+        x0, y0, w, h = self._crop
+        arr = _np(img)
+        if x0 + w >= arr.shape[1] or y0 + h >= arr.shape[0]:
+            return img, bbox
+        new_img = arr[y0:y0 + h, x0:x0 + w]
+        return NDArray(new_img.copy()), NDArray(
+            _bbox_crop(b, self._crop, self._allow))
+
+
+class ImageBboxRandomCropWithConstraints(Block):
+    """SSD-style min-IoU random crop (parity: bbox.py:160)."""
+
+    def __init__(self, p=0.5, min_scale=0.3, max_scale=1,
+                 max_aspect_ratio=2, constraints=None, max_trial=50):
+        super().__init__()
+        self.p = p
+        self._min_scale = min_scale
+        self._max_scale = max_scale
+        self._max_ar = max_aspect_ratio
+        self._constraints = constraints or (
+            (0.1, None), (0.3, None), (0.5, None), (0.7, None),
+            (0.9, None), (None, 1))
+        self._max_trial = max_trial
+
+    def forward(self, img, bbox):
+        if pyrandom.random() > self.p:
+            return img, bbox
+        b = _check_bbox(bbox)
+        arr = _np(img)
+        H, W = arr.shape[0], arr.shape[1]
+        candidates = []
+        for min_iou, max_iou in self._constraints:
+            lo = -onp.inf if min_iou is None else min_iou
+            hi = onp.inf if max_iou is None else max_iou
+            for _ in range(self._max_trial):
+                scale = pyrandom.uniform(self._min_scale, self._max_scale)
+                ar = pyrandom.uniform(
+                    max(1 / self._max_ar, scale * scale),
+                    min(self._max_ar, 1 / (scale * scale)))
+                cw = int(W * scale * onp.sqrt(ar))
+                ch = int(H * scale / onp.sqrt(ar))
+                if cw > W or ch > H or cw <= 0 or ch <= 0:
+                    continue
+                cx = pyrandom.randint(0, W - cw)
+                cy = pyrandom.randint(0, H - ch)
+                region = (cx, cy, cw, ch)
+                iou = _bbox_iou_with_region(b, region)
+                if len(iou) == 0 or (iou.min() >= lo and iou.max() <= hi):
+                    candidates.append(region)
+                    break
+        if not candidates:
+            return img, bbox
+        region = candidates[pyrandom.randint(0, len(candidates) - 1)]
+        nb = _bbox_crop(b, region, allow_outside_center=False)
+        if len(nb) == 0:
+            return img, bbox
+        x0, y0, w, h = region
+        return NDArray(arr[y0:y0 + h, x0:x0 + w].copy()), NDArray(nb)
+
+
+class ImageBboxRandomExpand(Block):
+    """Place the image on a larger filled canvas, shifting boxes
+    (parity: bbox.py:255)."""
+
+    def __init__(self, p=0.5, max_ratio=4, fill=0, keep_ratio=True):
+        super().__init__()
+        self.p = p
+        self._max_ratio = max_ratio
+        self._fill = fill
+        self._keep_ratio = keep_ratio
+
+    def forward(self, img, bbox):
+        if self._max_ratio <= 1 or pyrandom.random() > self.p:
+            return img, bbox
+        b = _check_bbox(bbox)
+        arr = _np(img)
+        H, W, C = arr.shape
+        rx = pyrandom.uniform(1, self._max_ratio)
+        ry = rx if self._keep_ratio else \
+            pyrandom.uniform(1, self._max_ratio)
+        nw, nh = int(W * rx), int(H * ry)
+        ox = pyrandom.randint(0, nw - W)
+        oy = pyrandom.randint(0, nh - H)
+        canvas = onp.empty((nh, nw, C), arr.dtype)
+        fill = onp.asarray(self._fill, arr.dtype)
+        canvas[...] = fill.reshape(1, 1, -1) if fill.ndim else fill
+        canvas[oy:oy + H, ox:ox + W] = arr
+        nb = b.copy()
+        nb[:, 0] += ox
+        nb[:, 1] += oy
+        nb[:, 2] += ox
+        nb[:, 3] += oy
+        return NDArray(canvas), NDArray(nb)
+
+
+class ImageBboxResize(Block):
+    """Resize image to (width, height), scaling boxes (parity:
+    bbox.py:297)."""
+
+    def __init__(self, width, height, interp=1):
+        super().__init__()
+        self._size = (int(width), int(height))
+        self._interp = interp
+
+    def forward(self, img, bbox):
+        from ....image import imresize
+        b = _check_bbox(bbox)
+        arr = _np(img)
+        H, W = arr.shape[0], arr.shape[1]
+        interp = pyrandom.randint(0, 5) if self._interp == -1 \
+            else self._interp
+        new_img = imresize(NDArray(arr), self._size[0], self._size[1],
+                           interp)
+        sx = self._size[0] / W
+        sy = self._size[1] / H
+        nb = b.copy().astype(onp.float64)
+        nb[:, 0] *= sx
+        nb[:, 2] *= sx
+        nb[:, 1] *= sy
+        nb[:, 3] *= sy
+        return new_img, NDArray(nb.astype(b.dtype if
+                                          b.dtype.kind == "f" else "float32"))
+
+
+class ImageDataLoader(DataLoader):
+    """DataLoader applying an image transform pipeline to sample[0]
+    (parity: contrib/data/vision/dataloader.py ImageDataLoader)."""
+
+    def __init__(self, dataset, batch_size=None, transform=None, **kwargs):
+        if transform is not None:
+            dataset = dataset.transform_first(transform) \
+                if hasattr(dataset, "transform_first") else dataset
+        super().__init__(dataset, batch_size=batch_size, **kwargs)
+
+
+class ImageBboxDataLoader(DataLoader):
+    """DataLoader for (image, bbox) datasets applying joint transforms
+    (parity: contrib/data/vision/dataloader.py ImageBboxDataLoader).
+
+    ``bbox_transform`` takes (img, bbox) and returns (img, bbox); the
+    batchify pads bbox arrays to the batch's max box count with -1 rows
+    (standard detection padding)."""
+
+    def __init__(self, dataset, batch_size=None, bbox_transform=None,
+                 batchify_fn=None, **kwargs):
+        self._bbox_transform = bbox_transform
+        if batchify_fn is None:
+            batchify_fn = self._pad_batchify
+        if bbox_transform is not None:
+            base = dataset
+
+            class _T:
+                def __len__(self_inner):
+                    return len(base)
+
+                def __getitem__(self_inner, i):
+                    img, bbox = base[i][0], base[i][1]
+                    return bbox_transform(img, bbox)
+
+            dataset = _T()
+        super().__init__(dataset, batch_size=batch_size,
+                         batchify_fn=batchify_fn, **kwargs)
+
+    @staticmethod
+    def _pad_batchify(samples):
+        imgs = onp.stack([_np(s[0]) for s in samples])
+        max_n = max(_np(s[1]).shape[0] for s in samples)
+        width = max(_np(s[1]).shape[1] for s in samples)
+        boxes = onp.full((len(samples), max_n, width), -1.0, onp.float32)
+        for i, s in enumerate(samples):
+            b = _np(s[1])
+            boxes[i, :b.shape[0], :b.shape[1]] = b
+        return NDArray(imgs), NDArray(boxes)

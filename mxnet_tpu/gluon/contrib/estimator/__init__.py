@@ -1,11 +1,13 @@
 """gluon.contrib.estimator (parity: gluon/contrib/estimator/)."""
-from .estimator import Estimator
+from .estimator import Estimator, BatchProcessor
 from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
                             BatchBegin, BatchEnd, StoppingHandler,
                             MetricHandler, ValidationHandler, LoggingHandler,
-                            CheckpointHandler, EarlyStoppingHandler)
+                            CheckpointHandler, EarlyStoppingHandler,
+                            GradientUpdateHandler)
 
-__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
-           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
-           "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler"]
+__all__ = ["Estimator", "BatchProcessor", "TrainBegin", "TrainEnd",
+           "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
+           "StoppingHandler", "MetricHandler", "ValidationHandler",
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "GradientUpdateHandler"]

@@ -12,14 +12,16 @@ from ...trainer import Trainer
 from ... import metric as metric_mod
 from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
                             BatchBegin, BatchEnd, StoppingHandler,
-                            MetricHandler, LoggingHandler)
+                            MetricHandler, LoggingHandler,
+                            GradientUpdateHandler)
 
 __all__ = ["Estimator"]
 
 
 class Estimator:
     def __init__(self, net, loss, train_metrics=None, trainer=None,
-                 context=None, val_metrics=None):
+                 context=None, val_metrics=None, batch_processor=None):
+        self.batch_processor = batch_processor or BatchProcessor()
         self.net = net
         self.loss = loss
         self.train_metrics = train_metrics or [metric_mod.Accuracy()]
@@ -35,11 +37,15 @@ class Estimator:
     def evaluate(self, val_data, batch_axis=0):
         for metric in self.val_metrics:
             metric.reset()
+        from ...metric import Loss as LossMetric
         for batch in val_data:
-            data, label = batch[0], batch[1]
-            pred = self.net(data)
+            _, label, pred, loss = self.batch_processor.evaluate_batch(
+                self, batch, batch_axis)
             for metric in self.val_metrics:
-                metric.update([label], [pred])
+                if isinstance(metric, LossMetric):
+                    metric.update(0, loss)
+                else:
+                    metric.update([label], [pred])
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
@@ -50,6 +56,10 @@ class Estimator:
         stopper = StoppingHandler(epochs, batches)
         handlers.append(stopper)
         handlers.append(MetricHandler(self.train_metrics))
+        if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
+            handlers.append(GradientUpdateHandler())
+        # highest priority first at batch end (update before metrics)
+        handlers.sort(key=lambda h: getattr(h, "priority", 0))
         train_begin = [h for h in handlers if isinstance(h, TrainBegin)]
         epoch_begin = [h for h in handlers if isinstance(h, EpochBegin)]
         batch_begin = [h for h in handlers if isinstance(h, BatchBegin)]
@@ -65,16 +75,13 @@ class Estimator:
             for batch in train_data:
                 for h in batch_begin:
                     h.batch_begin(self)
-                data, label = batch[0], batch[1]
+                data, label, pred, loss = \
+                    self.batch_processor.fit_batch(self, batch, batch_axis)
                 bs = data.shape[batch_axis]
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(bs)
                 stop = False
                 for h in batch_end:
-                    if h.batch_end(self, pred=pred, label=label, loss=loss):
+                    if h.batch_end(self, pred=pred, label=label, loss=loss,
+                                   batch_size=bs):
                         stop = True
                 if stop or stopper.stop_training:
                     break
@@ -85,3 +92,26 @@ class Estimator:
         for h in train_end:
             h.train_end(self)
         return self
+
+
+class BatchProcessor:
+    """Per-batch fit/evaluate logic (parity: estimator/batch_processor.py
+    BatchProcessor): subclass and override to customize how a batch is
+    split, run, and differentiated (the Estimator calls these hooks)."""
+
+    def evaluate_batch(self, estimator, val_batch, batch_axis=0):
+        data, label = val_batch[0], val_batch[1]
+        pred = estimator.net(data)
+        loss = estimator.loss(pred, label)
+        return data, label, pred, loss
+
+    def fit_batch(self, estimator, train_batch, batch_axis=0):
+        data, label = train_batch[0], train_batch[1]
+        with autograd.record():
+            pred = estimator.net(data)
+            loss = estimator.loss(pred, label)
+        loss.backward()
+        return data, label, pred, loss
+
+
+__all__.append("BatchProcessor")

@@ -8,7 +8,7 @@ import logging
 import time
 
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
-           "BatchEnd", "StoppingHandler", "MetricHandler",
+           "BatchEnd", "StoppingHandler", "GradientUpdateHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
            "EarlyStoppingHandler"]
 
@@ -196,3 +196,17 @@ class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
             if self.wait >= self.patience:
                 self.stop_training = True
         return self.stop_training
+
+
+class GradientUpdateHandler(BatchEnd):
+    """Applies the optimizer step at batch end (parity:
+    event_handler.py GradientUpdateHandler): keeping the update in a
+    handler lets users reorder or replace it (e.g. gradient
+    accumulation) without touching the fit loop."""
+
+    def __init__(self, priority=-2000):
+        self.priority = priority
+
+    def batch_end(self, estimator, *args, **kwargs):
+        estimator.trainer.step(kwargs.get("batch_size", 1))
+        return False

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...base import MXNetError
 from ...ndarray import NDArray
 from ...ops.registry import apply_jax
 from ... import initializer as init_mod
@@ -61,13 +62,17 @@ def _cell_step(mode):
     raise ValueError(mode)
 
 
-def _scan_layer(mode, x_tnc, h0, c0, wi, wh, bi, bh, reverse=False):
-    """One direction of one layer: scan over T (x: (T, N, C))."""
+def _scan_layer(mode, x_tnc, h0, c0, wi, wh, bi, bh, reverse=False,
+                wp=None):
+    """One direction of one layer: scan over T (x: (T, N, C)); ``wp``
+    is the LSTMP projection matrix (P, H) when projection is on."""
     step = _cell_step(mode)
 
     def body(carry, x_t):
         h, c = carry
         new_h, new_c = step(x_t, h, c, wi, wh, bi, bh)
+        if wp is not None:
+            new_h = new_h @ wp.T
         return (new_h, new_c), new_h
 
     (h_T, c_T), out = lax.scan(body, (h0, c0), x_tnc, reverse=reverse)
@@ -79,9 +84,13 @@ class _RNNLayer(HybridBlock):
                  dropout=0.0, bidirectional=False, input_size=0,
                  i2h_weight_initializer=None, h2h_weight_initializer=None,
                  i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
-                 dtype="float32", use_sequence_length=False, **kwargs):
+                 dtype="float32", use_sequence_length=False,
+                 projection_size=None, h2r_weight_initializer=None,
+                 **kwargs):
         super().__init__(**kwargs)
         assert layout in ("TNC", "NTC")
+        if projection_size is not None and mode != "lstm":
+            raise MXNetError("projection_size is LSTM-only")
         self._mode = mode
         self._hidden_size = hidden_size
         self._num_layers = num_layers
@@ -90,17 +99,24 @@ class _RNNLayer(HybridBlock):
         self._dir = 2 if bidirectional else 1
         self._input_size = input_size
         self._use_sequence_length = use_sequence_length
+        self._projection_size = projection_size
+        hp = projection_size if projection_size else hidden_size
         ng = _GATES[mode]
         for layer in range(num_layers):
             for d, prefix in enumerate(["l", "r"][:self._dir]):
-                in_sz = input_size if layer == 0 else hidden_size * self._dir
+                in_sz = input_size if layer == 0 else hp * self._dir
                 setattr(self, f"{prefix}{layer}_i2h_weight", Parameter(
                     shape=(ng * hidden_size, in_sz if in_sz else 0),
                     dtype=dtype, init=i2h_weight_initializer,
                     allow_deferred_init=True))
                 setattr(self, f"{prefix}{layer}_h2h_weight", Parameter(
-                    shape=(ng * hidden_size, hidden_size), dtype=dtype,
+                    shape=(ng * hidden_size, hp), dtype=dtype,
                     init=h2h_weight_initializer, allow_deferred_init=True))
+                if projection_size is not None:
+                    setattr(self, f"{prefix}{layer}_h2r_weight", Parameter(
+                        shape=(projection_size, hidden_size), dtype=dtype,
+                        init=h2r_weight_initializer,
+                        allow_deferred_init=True))
                 setattr(self, f"{prefix}{layer}_i2h_bias", Parameter(
                     shape=(ng * hidden_size,), dtype=dtype,
                     init=init_mod.create(i2h_bias_initializer),
@@ -112,8 +128,9 @@ class _RNNLayer(HybridBlock):
 
     def state_info(self, batch_size=0):
         num = self._num_layers * self._dir
+        hp = self._projection_size or self._hidden_size
         if self._mode == "lstm":
-            return [{"shape": (num, batch_size, self._hidden_size)},
+            return [{"shape": (num, batch_size, hp)},
                     {"shape": (num, batch_size, self._hidden_size)}]
         return [{"shape": (num, batch_size, self._hidden_size)}]
 
@@ -125,14 +142,17 @@ class _RNNLayer(HybridBlock):
     def _finish_deferred(self, x):
         in_size = x.shape[-1]
         ng = _GATES[self._mode]
+        hp = self._projection_size or self._hidden_size
         for layer in range(self._num_layers):
             for prefix in ["l", "r"][:self._dir]:
                 w = getattr(self, f"{prefix}{layer}_i2h_weight")
                 if w._deferred_init is not None:
-                    sz = in_size if layer == 0 \
-                        else self._hidden_size * self._dir
+                    sz = in_size if layer == 0 else hp * self._dir
                     w._finish_deferred_init((ng * self._hidden_size, sz))
-                for suffix in ("h2h_weight", "i2h_bias", "h2h_bias"):
+                suffixes = ["h2h_weight", "i2h_bias", "h2h_bias"]
+                if self._projection_size is not None:
+                    suffixes.append("h2r_weight")
+                for suffix in suffixes:
                     p = getattr(self, f"{prefix}{layer}_{suffix}")
                     if p._deferred_init is not None:
                         p._finish_deferred_init(None)
@@ -159,11 +179,16 @@ class _RNNLayer(HybridBlock):
             from ...ops.random import next_key
             key = NDArray(next_key())
 
+        proj = self._projection_size is not None
+        per_cell = 5 if proj else 4
         weights = []
         for layer in range(nl):
             for prefix in ["l", "r"][:nd]:
-                for suffix in ("i2h_weight", "h2h_weight", "i2h_bias",
-                               "h2h_bias"):
+                suffixes = ["i2h_weight", "h2h_weight", "i2h_bias",
+                            "h2h_bias"]
+                if proj:
+                    suffixes.append("h2r_weight")
+                for suffix in suffixes:
                     weights.append(getattr(self,
                                            f"{prefix}{layer}_{suffix}").data())
 
@@ -180,18 +205,23 @@ class _RNNLayer(HybridBlock):
             if ntc:
                 xx = jnp.swapaxes(xx, 0, 1)  # -> TNC
             h0_all = st[0]
-            c0_all = st[1] if has_c else jnp.zeros_like(st[0])
+            if has_c:
+                c0_all = st[1]
+            else:
+                c0_all = jnp.zeros_like(st[0])
             out = xx
             h_list, c_list = [], []
             for layer in range(nl):
                 dir_outs = []
                 for d in range(nd):
                     sidx = layer * nd + d
-                    base = (layer * nd + d) * 4
-                    wi, wh, bi, bh = ws[base:base + 4]
+                    base = (layer * nd + d) * per_cell
+                    cellws = ws[base:base + per_cell]
+                    wi, wh, bi, bh = cellws[:4]
+                    wp = cellws[4] if proj else None
                     o, h_T, c_T = _scan_layer(
                         mode, out, h0_all[sidx], c0_all[sidx], wi, wh, bi, bh,
-                        reverse=(d == 1))
+                        reverse=(d == 1), wp=wp)
                     dir_outs.append(o)
                     h_list.append(h_T)
                     c_list.append(c_T)

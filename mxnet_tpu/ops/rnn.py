@@ -41,12 +41,15 @@ def _cell(mode):
             return act(x_t @ wi.T + bi + h @ wh.T + bh), c
         return step
     if mode == "lstm":
-        def step(x_t, h, c, wi, wh, bi, bh):
+        def step(x_t, h, c, wi, wh, bi, bh, wp=None):
             gates = x_t @ wi.T + bi + h @ wh.T + bh
             i, f, g, o = jnp.split(gates, 4, axis=-1)
             new_c = jax.nn.sigmoid(f) * c + \
                 jax.nn.sigmoid(i) * jnp.tanh(g)
-            return jax.nn.sigmoid(o) * jnp.tanh(new_c), new_c
+            new_h = jax.nn.sigmoid(o) * jnp.tanh(new_c)
+            if wp is not None:   # LSTMP: project hidden H -> P
+                new_h = new_h @ wp.T
+            return new_h, new_c
         return step
     if mode == "gru":
         def step(x_t, h, c, wi, wh, bi, bh):
@@ -62,20 +65,34 @@ def _cell(mode):
     raise ValueError(f"unknown RNN mode {mode!r}")
 
 
-def _slice_params(params, mode, input_size, state_size, num_layers, ndir):
-    """Walk the flat vector into per-(layer, dir) (W, R, bW, bR)."""
+def _slice_params(params, mode, input_size, state_size, num_layers, ndir,
+                  proj_size=None):
+    """Walk the flat vector into per-(layer, dir) (W, R[, Wp], bW, bR).
+
+    With LSTMP (``proj_size``): recurrent weights read the projected
+    hidden (G*H, P) and a projection matrix Wp (P, H) follows R for
+    each (layer, dir) — parity: GetRnnParamSize's projection branch
+    (rnn-inl.h:98-128)."""
     G = _GATES[mode]
     H = state_size
+    P = proj_size if proj_size is not None else H
     out, off = [], 0
     for layer in range(num_layers):
-        in_sz = input_size if layer == 0 else H * ndir
+        in_sz = input_size if layer == 0 else P * ndir
         per_dir = []
         for d in range(ndir):
             W = params[off:off + G * H * in_sz].reshape(G * H, in_sz)
             off += G * H * in_sz
-            R = params[off:off + G * H * H].reshape(G * H, H)
-            off += G * H * H
-            per_dir.append([W, R])
+            R = params[off:off + G * H * P].reshape(G * H, P)
+            off += G * H * P
+            entry = [W, R]
+            if proj_size is not None:
+                Wp = params[off:off + P * H].reshape(P, H)
+                off += P * H
+                entry.append(Wp)
+            else:
+                entry.append(None)
+            per_dir.append(entry)
         out.append(per_dir)
     for layer in range(num_layers):
         for d in range(ndir):
@@ -88,26 +105,32 @@ def _slice_params(params, mode, input_size, state_size, num_layers, ndir):
 
 
 def rnn_param_size(mode, input_size, state_size, num_layers,
-                   bidirectional=False):
-    """Total flat parameter count (parity: GetRnnParamSize)."""
+                   bidirectional=False, projection_size=None):
+    """Total flat parameter count (parity: GetRnnParamSize,
+    rnn-inl.h:98 — incl. the LSTMP projection branch)."""
     G = _GATES[mode]
     H = state_size
+    P = projection_size if projection_size is not None else H
     D = 2 if bidirectional else 1
     size = 0
     for layer in range(num_layers):
-        in_sz = input_size if layer == 0 else H * D
-        size += D * (G * H * in_sz + G * H * H + 2 * G * H)
+        in_sz = input_size if layer == 0 else P * D
+        size += D * (G * H * in_sz + G * H * P + 2 * G * H)
+        if projection_size is not None:
+            size += D * P * H
     return size
 
 
-def _scan_dir(mode, x, h0, c0, W, R, bW, bR, lengths, reverse):
+def _scan_dir(mode, x, h0, c0, W, R, bW, bR, lengths, reverse, Wp=None):
     step = _cell(mode)
     T = x.shape[0]
 
     def body(carry, inp):
         h, c = carry
         t, x_t = inp
-        new_h, new_c = step(x_t, h, c, W, R, bW, bR)
+        new_h, new_c = (step(x_t, h, c, W, R, bW, bR, Wp)
+                        if mode == "lstm"
+                        else step(x_t, h, c, W, R, bW, bR))
         if lengths is not None:
             valid = (t < lengths)[:, None]
             new_h = jnp.where(valid, new_h, h)
@@ -150,8 +173,8 @@ def rnn(data, parameters, state, *extra, state_size, num_layers,
     ``p>0`` (the eager funnel then never caches/jits it; with ``p=0``
     it caches normally).
     """
-    if projection_size is not None:
-        raise NotImplementedError("projection_size not supported")
+    if projection_size is not None and mode != "lstm":
+        raise ValueError("projection_size is LSTM-only (rnn-inl.h CHECK)")
     extra = list(extra)
     state_cell = extra.pop(0) if mode == "lstm" and extra else None
     lengths = extra.pop(0) if use_sequence_length and extra else None
@@ -161,11 +184,12 @@ def rnn(data, parameters, state, *extra, state_size, num_layers,
 
     ndir = 2 if bidirectional else 1
     H = state_size
+    P = projection_size if projection_size is not None else H
     x = data
     T, N, input_size = x.shape
     layers = _slice_params(parameters, mode, input_size, H, num_layers,
-                           ndir)
-    h0 = state.reshape(num_layers, ndir, N, H)
+                           ndir, projection_size)
+    h0 = state.reshape(num_layers, ndir, N, P)
     c0 = (state_cell.reshape(num_layers, ndir, N, H)
           if state_cell is not None
           else jnp.zeros((num_layers, ndir, N, H), x.dtype))
@@ -174,9 +198,10 @@ def rnn(data, parameters, state, *extra, state_size, num_layers,
     for layer in range(num_layers):
         outs = []
         for d in range(ndir):
-            W, R, bW, bR = layers[layer][d]
+            W, R, Wp, bW, bR = layers[layer][d]
             out, h_T, c_T = _scan_dir(mode, x, h0[layer, d], c0[layer, d],
-                                      W, R, bW, bR, lengths, reverse=d == 1)
+                                      W, R, bW, bR, lengths,
+                                      reverse=d == 1, Wp=Wp)
             outs.append(out)
             h_out.append(h_T)
             c_out.append(c_T)

@@ -635,11 +635,11 @@ def _correlation(data1, data2, *, kernel_size=1, max_displacement=1,
 # bilinear sampling points of an ordinary convolution.
 # --------------------------------------------------------------------------
 
-@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
-def _deformable_convolution(data, offset, weight, bias=None, *, kernel,
-                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
-                            num_filter=None, num_group=1,
-                            num_deformable_group=1, no_bias=False, **_ig):
+def _deform_conv_impl(data, offset, mask, weight, bias, kernel, stride,
+                      dilate, pad, num_group, num_deformable_group):
+    """Shared deformable-conv core (v1: mask=None; v2/DCNv2: per-tap
+    modulation mask).  im2col by bilinear gather at offset taps, then a
+    grouped matmul on the MXU."""
     kh, kw = kernel
     sh, sw = stride if isinstance(stride, (tuple, list)) else (stride,) * 2
     dh, dw = dilate if isinstance(dilate, (tuple, list)) else (dilate,) * 2
@@ -653,9 +653,11 @@ def _deformable_convolution(data, offset, weight, bias=None, *, kernel,
     oy = jnp.arange(Ho) * sh - ph
     ox = jnp.arange(Wo) * sw - pw
 
-    def per_image(img, off):
+    def per_image(img, off, mk):
         # off: (dg*kh*kw*2, Ho, Wo) — per kernel tap (y, x) offset pairs
         off = off.reshape(dg, kh * kw, 2, Ho, Wo)
+        if mk is not None:
+            mk = mk.reshape(dg, kh * kw, Ho, Wo)
         groups = []
         for g in range(dg):
             taps = []
@@ -664,12 +666,18 @@ def _deformable_convolution(data, offset, weight, bias=None, *, kernel,
                     kk = ki * kw + kj
                     y = (oy[:, None] + ki * dh) + off[g, kk, 0]   # (Ho, Wo)
                     x = (ox[None, :] + kj * dw) + off[g, kk, 1]
-                    taps.append(_bilinear_gather(
-                        img[g * cpg:(g + 1) * cpg], y, x))  # (cpg, Ho, Wo)
+                    val = _bilinear_gather(
+                        img[g * cpg:(g + 1) * cpg], y, x)  # (cpg, Ho, Wo)
+                    if mk is not None:
+                        val = val * mk[g, kk][None]
+                    taps.append(val)
             groups.append(jnp.stack(taps, axis=1))     # (cpg, K², Ho, Wo)
         return jnp.concatenate(groups, axis=0)         # (C, K², Ho, Wo)
 
-    col = jax.vmap(per_image)(data, offset)            # (N, C, K², Ho, Wo)
+    if mask is None:
+        col = jax.vmap(lambda i, o: per_image(i, o, None))(data, offset)
+    else:
+        col = jax.vmap(per_image)(data, offset, mask)   # (N, C, K², Ho, Wo)
     w = weight.reshape(weight.shape[0], -1)            # (O, C/g*K²)
     O = weight.shape[0]
     og = O // num_group
@@ -683,6 +691,33 @@ def _deformable_convolution(data, offset, weight, bias=None, *, kernel,
     if bias is not None:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+@register("_contrib_DeformableConvolution", aliases=("DeformableConvolution",))
+def _deformable_convolution(data, offset, weight, bias=None, *, kernel,
+                            stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                            num_filter=None, num_group=1,
+                            num_deformable_group=1, no_bias=False, **_ig):
+    """Deformable conv v1 (parity: contrib/deformable_convolution.cc)."""
+    return _deform_conv_impl(data, offset, None, weight, bias, kernel,
+                             stride, dilate, pad, num_group,
+                             num_deformable_group)
+
+
+@register("_contrib_ModulatedDeformableConvolution",
+          aliases=("ModulatedDeformableConvolution",))
+def _modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                      *, kernel, stride=(1, 1),
+                                      dilate=(1, 1), pad=(0, 0),
+                                      num_filter=None, num_group=1,
+                                      num_deformable_group=1,
+                                      no_bias=False, **_ig):
+    """DCNv2 (parity: contrib/modulated_deformable_convolution.cc):
+    sampled taps scaled by a learned per-tap modulation mask
+    (dg*kh*kw, Ho, Wo); the gluon layer applies the sigmoid."""
+    return _deform_conv_impl(data, offset, mask, weight, bias, kernel,
+                             stride, dilate, pad, num_group,
+                             num_deformable_group)
 
 
 # --------------------------------------------------------------------------

@@ -366,6 +366,36 @@ class SPMDTrainer:
         self._cost_cache[sig] = out
         return out
 
+    def save_states(self, fname):
+        """Checkpoint optimizer state + step counter (parity: Trainer
+        .save_states / kvstore get_states).  Sharded state is gathered
+        to host — on a multi-host mesh call on every process; rank 0's
+        file is authoritative (identical contents by construction)."""
+        import pickle
+        blob = {
+            "num_update": self.num_update,
+            "opt_state": {k: tuple(onp.asarray(jax.device_get(s))
+                                   for s in st)
+                          for k, st in self._opt_state.items()},
+        }
+        with open(fname, "wb") as f:
+            pickle.dump(blob, f)
+
+    def load_states(self, fname):
+        """Restore optimizer state saved by :meth:`save_states`; arrays
+        are re-placed under each parameter's declared sharding."""
+        import pickle
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self.num_update = int(blob["num_update"])
+        self.optimizer.num_update = self.num_update
+        for k, st in blob["opt_state"].items():
+            if k not in self._opt_state:
+                raise MXNetError(f"unknown optimizer-state key {k!r}")
+            shd = self._param_sharding(self._params[k])
+            self._opt_state[k] = tuple(
+                jax.device_put(jnp.asarray(s), shd) for s in st)
+
     def fit(self, data_iter, epochs=1, verbose=False):
         losses = []
         for _ in range(epochs):

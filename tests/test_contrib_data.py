@@ -1,0 +1,105 @@
+"""gluon.contrib.data tests: bbox transforms, loaders, WikiText."""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.contrib import data as cdata
+from mxnet_tpu.ndarray import NDArray
+
+
+def _img_bbox():
+    img = NDArray(onp.arange(40 * 30 * 3, dtype=onp.float32)
+                  .reshape(40, 30, 3))
+    bbox = NDArray(onp.array([[5., 10., 20., 30., 1.],
+                              [0., 0., 8., 8., 2.]], onp.float32))
+    return img, bbox
+
+
+def test_bbox_flip():
+    img, bbox = _img_bbox()
+    t = cdata.ImageBboxRandomFlipLeftRight(p=1.0)
+    ni, nb = t(img, bbox)
+    onp.testing.assert_array_equal(ni.asnumpy(), img.asnumpy()[:, ::-1])
+    b = nb.asnumpy()
+    # x-coords mirrored around width=30, attrs intact
+    onp.testing.assert_allclose(b[0, [0, 2]], [30 - 20, 30 - 5])
+    assert b[0, 4] == 1 and b[1, 4] == 2
+    # p=0: identity
+    same_i, same_b = cdata.ImageBboxRandomFlipLeftRight(p=0)(img, bbox)
+    onp.testing.assert_array_equal(same_i.asnumpy(), img.asnumpy())
+
+
+def test_bbox_crop():
+    img, bbox = _img_bbox()
+    t = cdata.ImageBboxCrop((4, 8, 20, 25))   # x, y, w, h
+    ni, nb = t(img, bbox)
+    assert ni.shape == (25, 20, 3)
+    b = nb.asnumpy()
+    # first box center (12.5, 20) inside crop -> kept, clipped + shifted
+    assert len(b) >= 1
+    onp.testing.assert_allclose(b[0, :4], [5 - 4, 10 - 8, 20 - 4, 30 - 8])
+
+
+def test_bbox_resize():
+    img, bbox = _img_bbox()
+    t = cdata.ImageBboxResize(60, 80)   # width, height: 2x both
+    ni, nb = t(img, bbox)
+    assert ni.shape == (80, 60, 3)
+    onp.testing.assert_allclose(nb.asnumpy()[0, :4],
+                                [10., 20., 40., 60.], rtol=1e-5)
+
+
+def test_bbox_expand():
+    img, bbox = _img_bbox()
+    t = cdata.ImageBboxRandomExpand(p=1.0, max_ratio=2, fill=7)
+    ni, nb = t(img, bbox)
+    H, W = ni.shape[0], ni.shape[1]
+    assert H >= 40 and W >= 30
+    b = nb.asnumpy()
+    # box size preserved under pure translation
+    onp.testing.assert_allclose(b[:, 2] - b[:, 0],
+                                bbox.asnumpy()[:, 2] - bbox.asnumpy()[:, 0])
+
+
+def test_bbox_random_crop_with_constraints():
+    img, bbox = _img_bbox()
+    t = cdata.ImageBboxRandomCropWithConstraints(p=1.0, max_trial=10)
+    ni, nb = t(img, bbox)
+    assert ni.shape[2] == 3
+    assert nb.asnumpy().shape[1] == 5
+
+
+def test_image_bbox_dataloader_pads():
+    imgs = [onp.zeros((8, 8, 3), onp.float32)] * 3
+    boxes = [onp.zeros((i + 1, 5), onp.float32) for i in range(3)]
+
+    class DS:
+        def __len__(self):
+            return 3
+
+        def __getitem__(self, i):
+            return imgs[i], boxes[i]
+
+    dl = cdata.ImageBboxDataLoader(DS(), batch_size=3)
+    bimgs, bboxes = next(iter(dl))
+    assert bimgs.shape == (3, 8, 8, 3)
+    assert bboxes.shape == (3, 3, 5)
+    assert (bboxes.asnumpy()[0, 1:] == -1).all()   # padded rows
+
+
+def test_wikitext_local_files(tmp_path):
+    root = tmp_path / "wikitext-2"
+    root.mkdir()
+    (root / "wiki.train.tokens").write_text(
+        "the quick brown fox\n\njumps over the lazy dog\n")
+    ds = cdata.WikiText2(root=str(root), segment="train", seq_len=3)
+    assert len(ds) >= 2
+    d, l = ds[0]
+    assert d.shape == (3,) and l.shape == (3,)
+    # label is data shifted by one
+    onp.testing.assert_array_equal(ds._data[0][1:], ds._label[0][:-1])
+    assert len(ds.vocabulary) > 5
+    with pytest.raises(Exception, match="egress"):
+        cdata.WikiText2(root=str(tmp_path / "missing"))

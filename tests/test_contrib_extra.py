@@ -164,3 +164,46 @@ def test_hawkesll_padding_marks_no_nan():
                      vlen, mt])
     assert onp.isfinite(ll.asnumpy()).all()
     assert onp.isfinite(st.asnumpy()).all()
+
+
+def test_deformable_convolution_layers():
+    """gluon.contrib.cnn Deformable/ModulatedDeformableConvolution
+    (parity: contrib/cnn/conv_layers.py): zero offsets reduce to a
+    plain convolution; DCNv2's zero mask logits scale taps by 0.5."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib.cnn import (
+        DeformableConvolution, ModulatedDeformableConvolution)
+    from mxnet_tpu.ndarray import NDArray
+
+    x = NDArray(onp.random.RandomState(0).randn(2, 4, 9, 9)
+                .astype("float32"))
+    dc = DeformableConvolution(6, kernel_size=3, padding=1,
+                               num_deformable_group=2)
+    dc.initialize(init=mx.initializer.Xavier())
+    out = dc(x)
+    assert out.shape == (2, 6, 9, 9)
+    conv = nn.Conv2D(6, 3, padding=1, in_channels=4)
+    conv.initialize()
+    conv.weight.set_data(dc.weight.data())
+    conv.bias.set_data(dc.bias.data())
+    onp.testing.assert_allclose(out.asnumpy(), conv(x).asnumpy(),
+                                rtol=1e-4, atol=1e-4)
+
+    mdc = ModulatedDeformableConvolution(6, kernel_size=3, padding=1)
+    mdc.initialize(init=mx.initializer.Xavier())
+    out2 = mdc(x)
+    conv2 = nn.Conv2D(6, 3, padding=1, in_channels=4)
+    conv2.initialize()
+    conv2.weight.set_data(mdc.weight.data())
+    conv2.bias.set_data(mdc.bias.data())
+    b = mdc.bias.data().asnumpy().reshape(1, -1, 1, 1)
+    ref = 0.5 * (conv2(x).asnumpy() - b) + b
+    onp.testing.assert_allclose(out2.asnumpy(), ref, rtol=1e-4, atol=1e-4)
+
+    with autograd.record():
+        loss = dc(x).sum()
+    loss.backward()
+    assert dc.offset_weight.grad() is not None

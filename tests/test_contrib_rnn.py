@@ -97,3 +97,41 @@ def test_lstmp_cell():
         loss = sum(o.sum() for o in outs)
     loss.backward()
     assert float(abs(cell.h2r_weight.grad()).sum().asnumpy()) > 0
+
+
+def test_gluon_lstm_projection():
+    """gluon.rnn.LSTM(projection_size=...) — LSTMP layer (parity:
+    gluon/rnn/rnn_layer.py projection_size + h2r_weight)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import rnn
+    from mxnet_tpu.ndarray import NDArray
+
+    T, N, I, H, P = 6, 4, 5, 8, 3
+    lstm = rnn.LSTM(H, num_layers=2, projection_size=P)
+    lstm.initialize(init=mx.initializer.Xavier())
+    x = NDArray(onp.random.RandomState(0).randn(T, N, I)
+                .astype("float32"))
+    out = lstm(x)
+    assert out.shape == (T, N, P)
+
+    # with explicit states: h uses P, c uses H
+    states = lstm.begin_state(batch_size=N)
+    assert states[0].shape == (2, N, P)
+    assert states[1].shape == (2, N, H)
+    out, new_states = lstm(x, states)
+    assert new_states[0].shape == (2, N, P)
+    assert new_states[1].shape == (2, N, H)
+
+    # gradients flow through the projection matrices
+    with autograd.record():
+        y = lstm(x).sum()
+    y.backward()
+    g = lstm.l0_h2r_weight.grad()
+    assert float(onp.abs(g.asnumpy()).sum()) > 0
+
+    # projection is LSTM-only
+    import pytest
+    with pytest.raises(Exception, match="LSTM-only"):
+        rnn.GRU(4, projection_size=2)

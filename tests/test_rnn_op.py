@@ -190,3 +190,59 @@ def test_rnn_dropout_key_deterministic():
     c = (outs_c[0] if isinstance(outs_c, list) else outs_c).asnumpy()
     onp.testing.assert_allclose(a, b)
     assert abs(a - c).max() > 1e-6
+
+
+def test_lstm_projection():
+    """LSTMP (parity: rnn-inl.h projection_size branch): hidden is
+    projected H->P each step; oracle = explicit per-step numpy loop."""
+    import numpy as onp
+    from mxnet_tpu.ops.rnn import rnn_param_size
+    from mxnet_tpu.ops.registry import get
+
+    T, N, I, H, P = 5, 3, 4, 6, 2
+    rng = onp.random.RandomState(0)
+    nparam = rnn_param_size("lstm", I, H, 1, projection_size=P)
+    params = rng.uniform(-0.4, 0.4, nparam).astype("float32")
+    x = rng.randn(T, N, I).astype("float32")
+    h0 = onp.zeros((1, N, P), "float32")
+    c0 = onp.zeros((1, N, H), "float32")
+
+    fn = get("RNN").fn
+    out, hT, cT = fn(x, params, h0, c0, state_size=H, num_layers=1,
+                     mode="lstm", state_outputs=True, projection_size=P)
+    assert out.shape == (T, N, P)
+    assert hT.shape == (1, N, P) and cT.shape == (1, N, H)
+
+    # numpy oracle
+    off = 0
+    W = params[off:off + 4 * H * I].reshape(4 * H, I); off += 4 * H * I
+    R = params[off:off + 4 * H * P].reshape(4 * H, P); off += 4 * H * P
+    Wp = params[off:off + P * H].reshape(P, H); off += P * H
+    bW = params[off:off + 4 * H]; off += 4 * H
+    bR = params[off:off + 4 * H]; off += 4 * H
+    assert off == nparam
+
+    def sig(v): return 1 / (1 + onp.exp(-v))
+    h = onp.zeros((N, P)); c = onp.zeros((N, H))
+    outs = []
+    for t in range(T):
+        g = x[t] @ W.T + bW + h @ R.T + bR
+        i, f, gg, o = onp.split(g, 4, axis=-1)
+        c = sig(f) * c + sig(i) * onp.tanh(gg)
+        h = (sig(o) * onp.tanh(c)) @ Wp.T
+        outs.append(h)
+    onp.testing.assert_allclose(onp.asarray(out), onp.stack(outs),
+                                rtol=1e-5, atol=1e-5)
+    onp.testing.assert_allclose(onp.asarray(hT)[0], h, rtol=1e-5,
+                                atol=1e-5)
+
+
+def test_lstm_projection_rejects_other_modes():
+    import numpy as onp
+    import pytest
+    from mxnet_tpu.ops.registry import get
+    fn = get("RNN").fn
+    with pytest.raises(ValueError, match="LSTM-only"):
+        fn(onp.zeros((2, 1, 3), "float32"), onp.zeros((10,), "float32"),
+           onp.zeros((1, 1, 4), "float32"), state_size=4, num_layers=1,
+           mode="gru", projection_size=2)
