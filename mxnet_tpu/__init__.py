@@ -47,6 +47,14 @@ from . import subgraph  # noqa: E402
 from .visualization import print_summary, plot_network  # noqa: E402
 from . import io  # noqa: E402
 from . import image  # noqa: E402
+from . import attribute  # noqa: E402
+from .attribute import AttrScope  # noqa: E402
+from . import name  # noqa: E402
+from . import model  # noqa: E402
+from . import error  # noqa: E402
+from . import registry  # noqa: E402
+from . import log  # noqa: E402
+from . import executor  # noqa: E402
 
 # large-tensor (int64) switch at import (parity: the reference's
 # MXNET_USE_INT64_TENSOR_SIZE build flag; here a runtime env toggle)

@@ -1,0 +1,54 @@
+"""Typed framework errors.
+
+Parity: python/mxnet/error.py — MXNetError subclasses registered by
+name so error payloads can be re-raised as their specific type
+(``register_error``); standard Python errors are registered under their
+own names like the reference does.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["MXNetError", "register_error", "InternalError",
+           "get_error_type"]
+
+_ERROR_TYPES = {}
+
+
+def register_error(name_or_cls=None, cls=None):
+    """Register an error type by name (parity: base.register_error).
+
+    Usable as ``@register_error`` on an MXNetError subclass, or as
+    ``register_error("ValueError", ValueError)``.
+    """
+    if isinstance(name_or_cls, str):
+        _ERROR_TYPES[name_or_cls] = cls
+        return cls
+
+    def deco(klass):
+        _ERROR_TYPES[klass.__name__] = klass
+        return klass
+
+    if name_or_cls is None:
+        return deco
+    return deco(name_or_cls)
+
+
+def get_error_type(name):
+    return _ERROR_TYPES.get(name)
+
+
+register = register_error
+
+
+@register_error
+class InternalError(MXNetError):
+    """Framework-internal invariant violation (parity: error.py:31)."""
+
+
+register_error("ValueError", ValueError)
+register_error("TypeError", TypeError)
+register_error("AttributeError", AttributeError)
+register_error("IndexError", IndexError)
+register_error("NotImplementedError", NotImplementedError)
+register_error("MXNetError", MXNetError)

@@ -1,0 +1,10 @@
+"""mx.executor — public Executor alias.
+
+Parity: python/mxnet/executor.py (Executor wrapper over CachedOp); the
+implementation lives with the Symbol API (symbol/executor.py — a
+jit-backed executor), re-exported here under the reference's module
+path.
+"""
+from .symbol.executor import Executor
+
+__all__ = ["Executor"]

@@ -99,6 +99,8 @@ class ImageBboxCrop(Block):
         b = _check_bbox(bbox)
         x0, y0, w, h = self._crop
         arr = _np(img)
+        # parity quirk kept on purpose: the reference no-ops when the
+        # crop touches or exceeds the image edge (bbox.py:130 uses >=)
         if x0 + w >= arr.shape[1] or y0 + h >= arr.shape[0]:
             return img, bbox
         new_img = arr[y0:y0 + h, x0:x0 + w]
@@ -227,8 +229,20 @@ class ImageDataLoader(DataLoader):
 
     def __init__(self, dataset, batch_size=None, transform=None, **kwargs):
         if transform is not None:
-            dataset = dataset.transform_first(transform) \
-                if hasattr(dataset, "transform_first") else dataset
+            if hasattr(dataset, "transform_first"):
+                dataset = dataset.transform_first(transform)
+            else:
+                base = dataset
+
+                class _T:
+                    def __len__(self_inner):
+                        return len(base)
+
+                    def __getitem__(self_inner, i):
+                        sample = base[i]
+                        return (transform(sample[0]),) + tuple(sample[1:])
+
+                dataset = _T()
         super().__init__(dataset, batch_size=batch_size, **kwargs)
 
 
@@ -253,8 +267,8 @@ class ImageBboxDataLoader(DataLoader):
                     return len(base)
 
                 def __getitem__(self_inner, i):
-                    img, bbox = base[i][0], base[i][1]
-                    return bbox_transform(img, bbox)
+                    sample = base[i]
+                    return bbox_transform(sample[0], sample[1])
 
             dataset = _T()
         super().__init__(dataset, batch_size=batch_size,

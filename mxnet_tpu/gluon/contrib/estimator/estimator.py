@@ -37,15 +37,11 @@ class Estimator:
     def evaluate(self, val_data, batch_axis=0):
         for metric in self.val_metrics:
             metric.reset()
-        from ...metric import Loss as LossMetric
+        from .event_handler import update_metrics
         for batch in val_data:
             _, label, pred, loss = self.batch_processor.evaluate_batch(
                 self, batch, batch_axis)
-            for metric in self.val_metrics:
-                if isinstance(metric, LossMetric):
-                    metric.update(0, loss)
-                else:
-                    metric.update([label], [pred])
+            update_metrics(self.val_metrics, [label], [pred], loss)
         return {m.get()[0]: m.get()[1] for m in self.val_metrics}
 
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,

@@ -68,6 +68,18 @@ class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
         return self.stop_training
 
 
+def update_metrics(metrics, label, pred, loss):
+    """Feed one batch's results to metrics — Loss metrics consume the
+    actual loss, the rest (label, pred) (shared by MetricHandler and
+    Estimator.evaluate)."""
+    from ...metric import Loss as LossMetric
+    for metric in metrics:
+        if isinstance(metric, LossMetric):
+            metric.update(0, loss)
+        else:
+            metric.update(label, pred)
+
+
 class MetricHandler(EpochBegin, BatchEnd):
     def __init__(self, metrics, priority=-1000):
         self.metrics = metrics or []
@@ -78,15 +90,8 @@ class MetricHandler(EpochBegin, BatchEnd):
             metric.reset()
 
     def batch_end(self, estimator, *args, **kwargs):
-        pred = kwargs.get("pred")
-        label = kwargs.get("label")
-        loss = kwargs.get("loss")
-        from ...metric import Loss as LossMetric
-        for metric in self.metrics:
-            if isinstance(metric, LossMetric):
-                metric.update(0, loss)
-            else:
-                metric.update(label, pred)
+        update_metrics(self.metrics, kwargs.get("label"),
+                       kwargs.get("pred"), kwargs.get("loss"))
 
 
 class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):

@@ -31,27 +31,37 @@ class MultiHeadAttention(HybridBlock):
     (Pallas flash kernel underneath)."""
 
     def __init__(self, units, num_heads, causal=False, use_flash=True,
-                 **kwargs):
+                 num_kv_heads=None, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by num_heads "
                              f"{num_heads}")
+        if num_kv_heads is not None and num_heads % num_kv_heads:
+            raise MXNetError(f"num_heads {num_heads} not divisible by "
+                             f"num_kv_heads {num_kv_heads}")
         self._units = units
         self._heads = num_heads
+        self._kv_heads = num_kv_heads
         self._causal = causal
         self._flash = use_flash
-        self.qkv = nn.Dense(3 * units, use_bias=True, flatten=False)
+        hkv = num_kv_heads if num_kv_heads is not None else num_heads
+        kv_units = (units // num_heads) * hkv
+        self._kv_units = kv_units
+        # one fused projection: [q | k | v] with GQA-sized k/v
+        self.qkv = nn.Dense(units + 2 * kv_units, use_bias=True,
+                            flatten=False)
         self.out_proj = nn.Dense(units, use_bias=True, flatten=False)
 
     def forward(self, x):
         qkv = self.qkv(x)
-        u = self._units
+        u, kvu = self._units, self._kv_units
         q = qkv.slice_axis(axis=-1, begin=0, end=u)
-        k = qkv.slice_axis(axis=-1, begin=u, end=2 * u)
-        v = qkv.slice_axis(axis=-1, begin=2 * u, end=3 * u)
+        k = qkv.slice_axis(axis=-1, begin=u, end=u + kvu)
+        v = qkv.slice_axis(axis=-1, begin=u + kvu, end=u + 2 * kvu)
         attn = invoke("multi_head_attention", [q, k, v],
                       num_heads=self._heads, causal=self._causal,
-                      use_flash=self._flash)
+                      use_flash=self._flash,
+                      num_kv_heads=self._kv_heads)
         return self.out_proj(attn)
 
 

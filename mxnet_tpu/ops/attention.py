@@ -246,11 +246,29 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
                     block_q=512, block_k=512):
-    """Flash attention on (B, H, S, D) (or (BH, S, D)) arrays."""
+    """Flash attention on (B, H, S, D) (or (BH, S, D)) arrays.
+
+    Supports grouped-query attention (GQA/MQA): ``k``/``v`` may carry
+    fewer heads ``Hkv`` than ``q`` as long as ``H % Hkv == 0`` — each
+    group of ``H // Hkv`` query heads attends to one shared KV head
+    (MQA is ``Hkv == 1``).  KV heads are broadcast across the group
+    before the kernel; the flash tiling itself is unchanged.
+    """
     squeeze = q.ndim == 3
     if squeeze:
         q, k, v = q[None], k[None], v[None]
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    if v.shape[1] != hkv:
+        raise ValueError("k and v must have the same head count")
+    if hkv != h:
+        if hkv <= 0 or h % hkv != 0:
+            raise ValueError(
+                f"GQA requires q heads ({h}) divisible by kv heads "
+                f"({hkv})")
+        group = h // hkv
+        k = jnp.repeat(k, group, axis=1)
+        v = jnp.repeat(v, group, axis=1)
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, k.shape[2], d)
@@ -385,16 +403,25 @@ def _imm_encdec_valatt(keys_values, attention, *, heads):
 
 @register("multi_head_attention", aliases=("_npx_multi_head_attention",))
 def _multi_head_attention(q, k, v, *, num_heads, causal=False,
-                          use_flash=True):
-    """(B, S, E) inputs pre-projected; splits heads, attends, re-merges."""
+                          use_flash=True, num_kv_heads=None):
+    """(B, S, E) inputs pre-projected; splits heads, attends, re-merges.
+
+    ``num_kv_heads`` enables grouped-query attention: k/v carry
+    ``num_kv_heads * head_dim`` features and are shared across query
+    groups (MQA with num_kv_heads=1)."""
     b, sq, e = q.shape
     hd = e // num_heads
-    def split(x):
-        return jnp.transpose(x.reshape(b, x.shape[1], num_heads, hd),
+    hkv = num_kv_heads if num_kv_heads is not None else num_heads
+
+    def split(x, heads):
+        return jnp.transpose(x.reshape(b, x.shape[1], heads, hd),
                              (0, 2, 1, 3))
-    qh, kh, vh = split(q), split(k), split(v)
+    qh, kh, vh = split(q, num_heads), split(k, hkv), split(v, hkv)
     if use_flash:
         out = flash_attention(qh, kh, vh, causal=causal)
     else:
+        if hkv != num_heads:
+            kh = jnp.repeat(kh, num_heads // hkv, axis=1)
+            vh = jnp.repeat(vh, num_heads // hkv, axis=1)
         out = attention_reference(qh, kh, vh, causal=causal)
     return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, e)

@@ -1,0 +1,82 @@
+"""Generic class registry helpers.
+
+Parity: python/mxnet/registry.py — ``get_register_func`` /
+``get_alias_func`` / ``get_create_func`` build per-base-class
+registries (the mechanism behind ``mx.init.register``,
+``mx.optimizer.register`` and string-based ``create``).
+"""
+from __future__ import annotations
+
+import json
+import warnings
+
+from .base import MXNetError
+
+__all__ = ["get_register_func", "get_alias_func", "get_create_func"]
+
+_REGISTRIES = {}
+
+
+def _registry(base_class, nickname):
+    return _REGISTRIES.setdefault((base_class, nickname), {})
+
+
+def get_register_func(base_class, nickname):
+    """Build a ``register(klass, name=None)`` decorator for
+    ``base_class`` (parity: registry.py get_register_func)."""
+    reg = _registry(base_class, nickname)
+
+    def register(klass, name=None):
+        if not issubclass(klass, base_class):
+            raise MXNetError(
+                f"can only register subclasses of "
+                f"{base_class.__name__}, got {klass}")
+        key = (name or klass.__name__).lower()
+        if key in reg and reg[key] is not klass:
+            warnings.warn(f"registry {nickname}: overriding {key} "
+                          f"({reg[key]} -> {klass})")
+        reg[key] = klass
+        return klass
+
+    register.__doc__ = f"Register a {nickname} class."
+    return register
+
+
+def get_alias_func(base_class, nickname):
+    reg = _registry(base_class, nickname)
+
+    def alias(*aliases):
+        def deco(klass):
+            for a in aliases:
+                reg[a.lower()] = klass
+            return klass
+        return deco
+
+    return alias
+
+
+def get_create_func(base_class, nickname):
+    """Build ``create(spec, *args, **kwargs)`` accepting an instance, a
+    name, or a json ``[name, kwargs]`` string (parity: registry.py
+    get_create_func)."""
+    reg = _registry(base_class, nickname)
+
+    def create(*args, **kwargs):
+        if args and isinstance(args[0], base_class):
+            return args[0]
+        if not args or not isinstance(args[0], str):
+            raise MXNetError(f"{nickname} create expects a name or "
+                             f"instance")
+        name, rest = args[0], args[1:]
+        if name.startswith("["):
+            spec = json.loads(name)
+            name, kw = spec[0], (spec[1] if len(spec) > 1 else {})
+            kwargs = {**kw, **kwargs}
+        key = name.lower()
+        if key not in reg:
+            raise MXNetError(
+                f"unknown {nickname} {name!r}; registered: "
+                f"{sorted(reg)}")
+        return reg[key](*rest, **kwargs)
+
+    return create

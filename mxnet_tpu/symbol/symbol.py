@@ -26,28 +26,32 @@ from ..ops import registry as _reg
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
-_UNNAMED_COUNT: Dict[str, int] = {}
-
-
 def _auto_name(op_name: str) -> str:
-    base = op_name.lower().lstrip("_")
-    i = _UNNAMED_COUNT.get(base, 0)
-    _UNNAMED_COUNT[base] = i + 1
-    return f"{base}{i}"
+    # every auto name flows through the active NameManager (parity:
+    # name.py NameManager): the default manager at the stack bottom
+    # plays the global counter's role, a freshly entered manager
+    # restarts numbering, and Prefix prepends
+    from .. import name as _name_mod
+    return _name_mod.current().get(None, op_name.lower().lstrip("_"))
 
 
 class _Node:
     """One graph node: a free variable or an op application."""
 
-    __slots__ = ("op_name", "name", "params", "inputs", "num_outputs")
+    __slots__ = ("op_name", "name", "params", "inputs", "num_outputs",
+                 "attrs")
 
     def __init__(self, op_name: Optional[str], name: str,
                  params: Optional[dict] = None,
                  inputs: Optional[List[Tuple["_Node", int]]] = None,
-                 num_outputs: int = 1):
+                 num_outputs: int = 1, attrs: Optional[dict] = None):
         self.op_name = op_name          # None → variable ("null" op)
         self.name = name
         self.params = dict(params or {})
+        # user attributes merged from the active AttrScope (parity:
+        # attribute.py AttrScope applied at symbol creation)
+        from .. import attribute as _attr
+        self.attrs = _attr.current().get(attrs)
         self.inputs = list(inputs or [])
         self.num_outputs = num_outputs
 
@@ -146,6 +150,15 @@ class Symbol:
     def attr_dict(self):
         return {n.name: {k: str(v) for k, v in n.params.items()}
                 for n in _topo_nodes([o[0] for o in self._outputs])}
+
+    def attr(self, key):
+        """User attribute lookup on this symbol's head node (parity:
+        symbol.attr)."""
+        return self._outputs[0][0].attrs.get(key)
+
+    def list_attr(self):
+        """User attributes of the head node (parity: symbol.list_attr)."""
+        return dict(self._outputs[0][0].attrs)
 
     # -- composition (parity: symbol call substitution) --------------------
     def __call__(self, **kwargs):
@@ -467,8 +480,16 @@ def _probe_num_outputs(op) -> int:
     return 1  # multi-out ops report 1 head; outputs split lazily on index
 
 
-def Variable(name: str, shape=None, dtype=None, **kwargs) -> Symbol:
-    return Symbol([(_Node(None, name), 0)])
+def Variable(name: str, shape=None, dtype=None, attrs=None,
+             **kwargs) -> Symbol:
+    for k, v in kwargs.items():
+        if not isinstance(v, str):
+            raise ValueError(
+                f"Attribute {k}={v!r}: attributes need to be strings "
+                "(parity: symbol.Variable)")
+    merged = dict(attrs or {})
+    merged.update(kwargs)
+    return Symbol([(_Node(None, name, attrs=merged), 0)])
 
 
 var = Variable

@@ -136,3 +136,41 @@ def test_multi_head_attention_op():
     assert out.shape == (b, s, e)
     onp.testing.assert_allclose(out.asnumpy(), ref.asnumpy(),
                                 rtol=2e-4, atol=2e-4)
+
+
+def test_flash_attention_gqa():
+    """Grouped-query attention: Hkv < H with shared KV heads matches
+    the reference computed with explicitly repeated heads; MQA is the
+    Hkv=1 case."""
+    import numpy as onp
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.attention import (attention_reference,
+                                         flash_attention)
+
+    rng = onp.random.RandomState(0)
+    B, H, HKV, S, D = 2, 8, 2, 64, 16
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, HKV, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, HKV, S, D).astype("float32"))
+
+    out = flash_attention(q, k, v, causal=True, block_q=32, block_k=32)
+    kr = jnp.repeat(k, H // HKV, axis=1)
+    vr = jnp.repeat(v, H // HKV, axis=1)
+    ref = attention_reference(q, kr, vr, causal=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+
+    # MQA: single shared KV head
+    k1 = k[:, :1]
+    v1 = v[:, :1]
+    out1 = flash_attention(q, k1, v1, block_q=32, block_k=32)
+    ref1 = attention_reference(q, jnp.repeat(k1, H, axis=1),
+                               jnp.repeat(v1, H, axis=1))
+    onp.testing.assert_allclose(onp.asarray(out1), onp.asarray(ref1),
+                                rtol=2e-4, atol=2e-4)
+
+    # invalid grouping rejected
+    import pytest
+    k3 = jnp.asarray(rng.randn(B, 3, S, D).astype("float32"))
+    with pytest.raises(ValueError, match="divisible"):
+        flash_attention(q, k3, k3)

@@ -28,12 +28,14 @@ def test_tensorboard_callback_writes_events(tmp_path):
     assert events and os.path.getsize(events[0]) > 0
 
 
-def test_server_command_profiler_roundtrip():
+def test_server_command_profiler_roundtrip(tmp_path):
     from mxnet_tpu import profiler
     kv = mx.kv.create("local")
     kv.send_command_to_servers("profiler_set_config",
                                json.dumps({"profile_all": True,
-                                           "aggregate_stats": True}))
+                                           "aggregate_stats": True,
+                                           "filename": str(
+                                               tmp_path / "prof.json")}))
     kv.send_command_to_servers("profiler_start")
     _ = (mx.nd.array(onp.ones(4, onp.float32)) * 2).asnumpy()
     kv.send_command_to_servers("profiler_stop")

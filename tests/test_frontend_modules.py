@@ -1,0 +1,119 @@
+"""Top-level frontend module parity: name/attribute/model/error/
+registry/log (reference python/mxnet/*.py siblings)."""
+import logging
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import symbol as sym
+
+
+def test_attr_scope_and_symbol_attr():
+    with mx.AttrScope(ctx_group="stage1"):
+        x = sym.Variable("x")
+        with mx.AttrScope(lr_mult="2"):      # nested scopes merge
+            y = sym.relu(x)
+    assert x.attr("ctx_group") == "stage1"
+    assert y.attr("ctx_group") == "stage1"
+    assert y.attr("lr_mult") == "2"
+    z = sym.Variable("z", mood="calm")
+    assert z.attr("mood") == "calm"
+    with pytest.raises(ValueError):
+        mx.AttrScope(bad=3)
+
+
+def test_name_prefix():
+    from mxnet_tpu import name as name_mod
+    with name_mod.Prefix("enc_"):
+        s = sym.tanh(sym.Variable("a"))
+    assert s._outputs[0][0].name.startswith("enc_")
+    mgr = name_mod.NameManager()
+    assert mgr.get(None, "conv") == "conv0"
+    assert mgr.get(None, "conv") == "conv1"
+    assert mgr.get("explicit", "conv") == "explicit"
+
+
+def test_model_checkpoint_roundtrip(tmp_path):
+    x = sym.Variable("data")
+    w = sym.Variable("w")
+    net = sym.relu(sym.dot(x, w))
+    arg = {"w": mx.nd.array(onp.eye(3, dtype=onp.float32))}
+    aux = {"stat": mx.nd.array(onp.ones(2, onp.float32))}
+    prefix = str(tmp_path / "ckpt")
+    mx.model.save_checkpoint(prefix, 7, net, arg, aux)
+    assert os.path.exists(prefix + "-symbol.json")
+    assert os.path.exists(prefix + "-0007.params")
+    s2, arg2, aux2 = mx.model.load_checkpoint(prefix, 7)
+    onp.testing.assert_array_equal(arg2["w"].asnumpy(),
+                                   arg["w"].asnumpy())
+    onp.testing.assert_array_equal(aux2["stat"].asnumpy(),
+                                   aux["stat"].asnumpy())
+    xin = onp.random.RandomState(0).randn(2, 3).astype("float32")
+    ref = net.eval(data=mx.nd.array(xin), w=arg["w"])[0].asnumpy()
+    got = s2.eval(data=mx.nd.array(xin), w=arg2["w"])[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_error_registry():
+    from mxnet_tpu import error
+    assert error.get_error_type("ValueError") is ValueError
+    assert issubclass(error.InternalError, mx.MXNetError)
+    assert error.get_error_type("InternalError") is error.InternalError
+
+    @error.register_error
+    class MyError(mx.MXNetError):
+        pass
+
+    assert error.get_error_type("MyError") is MyError
+
+
+def test_generic_registry():
+    from mxnet_tpu import registry
+
+    class Base:
+        def __init__(self, v=0):
+            self.v = v
+
+    reg = registry.get_register_func(Base, "thing")
+    alias = registry.get_alias_func(Base, "thing")
+    create = registry.get_create_func(Base, "thing")
+
+    @reg
+    @alias("alt")
+    class Foo(Base):
+        pass
+
+    assert isinstance(create("foo"), Foo)
+    assert isinstance(create("alt"), Foo)
+    assert create('["foo", {"v": 5}]').v == 5
+    inst = Foo()
+    assert create(inst) is inst
+    with pytest.raises(mx.MXNetError):
+        create("nope")
+
+
+def test_get_logger(tmp_path):
+    logf = str(tmp_path / "x.log")
+    lg = mx.log.get_logger("mxtpu_test_logger", filename=logf,
+                           level=mx.log.INFO)
+    lg.info("hello-from-test")
+    for h in lg.handlers:
+        h.flush()
+    assert "hello-from-test" in open(logf).read()
+
+
+def test_name_manager_scope_resets_counter():
+    from mxnet_tpu import name as name_mod
+    with name_mod.NameManager():
+        a = sym.tanh(sym.Variable("v1"))
+    with name_mod.NameManager():
+        b = sym.tanh(sym.Variable("v2"))
+    # fresh managers restart numbering: both heads get the same auto name
+    assert a._outputs[0][0].name == b._outputs[0][0].name
+
+
+def test_variable_rejects_non_string_attr():
+    with pytest.raises(ValueError, match="string"):
+        sym.Variable("w", lr_mult=2)

@@ -152,3 +152,32 @@ def test_spmd_trainer_dp_x_tp_matches_replicated():
     ref = train(build(5), make_mesh({"dp": 4}), shard_tp=False)
     tp = train(build(5), make_mesh({"dp": 2, "tp": 2}), shard_tp=True)
     onp.testing.assert_allclose(tp, ref, rtol=2e-4, atol=2e-5)
+
+
+def test_multi_head_attention_gqa_block():
+    """MultiHeadAttention(num_kv_heads=...) — GQA projections with
+    shared KV heads; flash and reference paths agree."""
+    from mxnet_tpu.gluon.model_zoo.transformer import MultiHeadAttention
+    from mxnet_tpu.ndarray import NDArray
+
+    rng = onp.random.RandomState(0)
+    x = NDArray(rng.randn(2, 16, 32).astype("float32"))
+    mx.random.seed(0)
+    att = MultiHeadAttention(32, 8, causal=True, num_kv_heads=2,
+                             use_flash=True)
+    att.initialize(init=mx.initializer.Xavier())
+    out = att(x)
+    assert out.shape == (2, 16, 32)
+    # kv projection is group-sized: units + 2 * (units/heads * kv_heads)
+    assert att.qkv.weight.shape[0] == 32 + 2 * (32 // 8) * 2
+
+    att_ref = MultiHeadAttention(32, 8, causal=True, num_kv_heads=2,
+                                 use_flash=False)
+    att_ref.initialize()
+    # copy params by position
+    pa = list(att.collect_params().values())
+    pb = list(att_ref.collect_params().values())
+    for a, b in zip(pa, pb):
+        b.set_data(a.data())
+    onp.testing.assert_allclose(att_ref(x).asnumpy(), out.asnumpy(),
+                                rtol=2e-4, atol=2e-4)
