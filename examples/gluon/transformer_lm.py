@@ -1,0 +1,81 @@
+"""Train a small causal Transformer LM and generate from it.
+
+Flagship TPU-native path: SPMDTrainer (one compiled train step, flash
+attention) + device-side autoregressive decoding (generate = one jitted
+lax.scan).
+
+    python examples/gluon/transformer_lm.py --steps 60
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo.transformer import get_transformer_lm
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+
+def corpus_batch(rng, batch, seq, vocab):
+    """Deterministic next-token structure: t+1 = (3t + 1) mod vocab."""
+    x = onp.empty((batch, seq + 1), onp.int32)
+    x[:, 0] = rng.randint(1, vocab, size=batch)
+    for i in range(1, seq + 1):
+        x[:, i] = (x[:, i - 1] * 3 + 1) % vocab
+    return x
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--seq-len", type=int, default=32)
+    ap.add_argument("--vocab", type=int, default=64)
+    ap.add_argument("--units", type=int, default=64)
+    ap.add_argument("--layers", type=int, default=2)
+    args = ap.parse_args()
+
+    net = get_transformer_lm(args.vocab, units=args.units,
+                             num_layers=args.layers, num_heads=4,
+                             max_len=args.seq_len + 16)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 4), onp.int32)))
+
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def lm_loss(logits, labels):
+        return ce(logits.reshape((-1, args.vocab)),
+                  labels.reshape((-1,)))
+
+    trainer = SPMDTrainer(net, lm_loss, optimizer="adam",
+                          optimizer_params={"learning_rate": 3e-3},
+                          mesh=make_mesh({"dp": -1}))
+
+    rng = onp.random.RandomState(0)
+    for step in range(args.steps):
+        batch = corpus_batch(rng, args.batch_size, args.seq_len,
+                             args.vocab)
+        loss = trainer.step(batch[:, :-1],
+                            batch[:, 1:].astype("float32"))
+        if step % 10 == 0 or step == args.steps - 1:
+            print(f"step {step}: loss {float(loss.asnumpy()):.4f}")
+
+    prompt = corpus_batch(rng, 1, 4, args.vocab)[:, :4]
+    out = net.generate(prompt, 12, temperature=0)
+    got = out.asnumpy()[0]
+    expect = list(prompt[0])
+    for _ in range(12):
+        expect.append((expect[-1] * 3 + 1) % args.vocab)
+    correct = int((got == onp.asarray(expect)).sum()) - 4
+    print(f"greedy continuation: {got.tolist()}")
+    print(f"matches the true sequence on {correct}/12 generated tokens")
+
+
+if __name__ == "__main__":
+    main()

@@ -240,7 +240,10 @@ class ImageDataLoader(DataLoader):
 
                     def __getitem__(self_inner, i):
                         sample = base[i]
-                        return (transform(sample[0]),) + tuple(sample[1:])
+                        if isinstance(sample, tuple):
+                            return ((transform(sample[0]),)
+                                    + tuple(sample[1:]))
+                        return transform(sample)
 
                 dataset = _T()
         super().__init__(dataset, batch_size=batch_size, **kwargs)

@@ -410,3 +410,62 @@ def _histogram(data, *bins_arr, bin_cnt=None, range=None):
 
 
 alias("split_v2", "_split_v2")
+
+
+# -- regression output ops (parity: src/operator/regression_output-inl.h:
+#    forward is identity/sigmoid; backward INJECTS grad_scale/num_output *
+#    BackwardOp(out, label) into data regardless of the incoming
+#    cotangent — classic terminal "output" op semantics) ------------------
+
+def _make_regression_output(fwd_fn, bwd_fn):
+    def op(data, label, *, grad_scale=1.0):
+        @jax.custom_vjp
+        def f(d, lb):
+            return fwd_fn(d)
+
+        def fwd(d, lb):
+            return fwd_fn(d), (fwd_fn(d), lb)
+
+        def bwd(res, g):
+            out, lb = res
+            num_output = lb.size // lb.shape[0] if lb.ndim else 1
+            scale = grad_scale / num_output
+            dd = bwd_fn(out, lb.reshape(out.shape)) * scale
+            return dd.astype(out.dtype), jnp.zeros_like(lb)
+
+        f.defvjp(fwd, bwd)
+        return f(data, label)
+    return op
+
+
+register("LinearRegressionOutput", aliases=("linear_regression_output",))(
+    _make_regression_output(lambda d: d, lambda o, l: o - l))
+register("MAERegressionOutput", aliases=("mae_regression_output",))(
+    _make_regression_output(lambda d: d, lambda o, l: jnp.sign(o - l)))
+register("LogisticRegressionOutput",
+         aliases=("logistic_regression_output",))(
+    _make_regression_output(jax.nn.sigmoid, lambda o, l: o - l))
+
+
+@register("Crop")
+def _crop(*inputs, num_args=None, offset=(0, 0), h_w=(0, 0),
+          center_crop=False):
+    """Legacy spatial crop (parity: src/operator/crop.cc): crop input 0
+    (N, C, H, W) to the size of input 1 (crop_like) or to ``h_w``;
+    ``center_crop`` centers the window, else ``offset`` = (y, x)."""
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = h_w
+        if th <= 0 or tw <= 0:
+            raise ValueError("Crop needs a crop_like input or h_w")
+    H, W = data.shape[2], data.shape[3]
+    if center_crop:
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = offset
+    if y0 + th > H or x0 + tw > W:
+        raise ValueError(f"crop window ({y0}:{y0+th}, {x0}:{x0+tw}) "
+                         f"exceeds input ({H}, {W})")
+    return data[:, :, y0:y0 + th, x0:x0 + tw]

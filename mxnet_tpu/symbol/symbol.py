@@ -181,6 +181,7 @@ class Symbol:
             new = _Node(node.op_name, node.name, node.params,
                         [edge(n, i) for n, i in node.inputs],
                         node.num_outputs)
+            new.attrs = dict(node.attrs)   # not the ambient AttrScope
             memo[id(node)] = new
             return new
 
@@ -382,12 +383,15 @@ class Symbol:
         idx = {id(n): i for i, n in enumerate(nodes)}
         out_nodes = []
         for n in nodes:
-            out_nodes.append({
+            spec = {
                 "op": "null" if n.is_var else n.op_name,
                 "name": n.name,
                 "attrs": _json_attrs(n.params),
                 "inputs": [[idx[id(src)], i, 0] for src, i in n.inputs],
-            })
+            }
+            if n.attrs:
+                spec["user_attrs"] = dict(n.attrs)
+            out_nodes.append(spec)
         payload = {
             "nodes": out_nodes,
             "arg_nodes": [i for i, n in enumerate(nodes) if n.is_var],
@@ -451,7 +455,10 @@ def _apply(op_name: str, inputs: List[Symbol], name: Optional[str] = None,
         params["__reverse__"] = bool(reverse)
         op_name = "_scalar_wrap:" + op_name
         _ensure_scalar_wrap(op_name)
-    node = _Node(op_name, name or _auto_name(op_name.split(":")[-1]),
+    from .. import name as _name_mod
+    node_name = _name_mod.current().get(
+        name, op_name.split(":")[-1].lower().lstrip("_"))
+    node = _Node(op_name, node_name,
                  params, [(s._outputs[0][0], s._outputs[0][1])
                           for s in inputs],
                  num_outputs=1)
@@ -482,13 +489,13 @@ def _probe_num_outputs(op) -> int:
 
 def Variable(name: str, shape=None, dtype=None, attrs=None,
              **kwargs) -> Symbol:
-    for k, v in kwargs.items():
+    merged = dict(attrs or {})
+    merged.update(kwargs)
+    for k, v in merged.items():
         if not isinstance(v, str):
             raise ValueError(
                 f"Attribute {k}={v!r}: attributes need to be strings "
                 "(parity: symbol.Variable)")
-    merged = dict(attrs or {})
-    merged.update(kwargs)
     return Symbol([(_Node(None, name, attrs=merged), 0)])
 
 
@@ -540,6 +547,9 @@ def load_json(json_str: str) -> Symbol:
             if spec["op"].startswith("_scalar_wrap:"):
                 _ensure_scalar_wrap(spec["op"])
             node = _Node(spec["op"], spec["name"], params)
+        # restore saved user attrs verbatim — never the load-time
+        # ambient AttrScope
+        node.attrs = dict(spec.get("user_attrs", {}))
         node.inputs = [(nodes[i], oi) for i, oi, *_ in spec["inputs"]]
         nodes.append(node)
     heads = [(nodes[i], oi) for i, oi, *_ in payload["heads"]]

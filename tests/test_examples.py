@@ -58,3 +58,17 @@ def _tiny_cifar(n=32):
     X = rng.rand(n, 3, 32, 32).astype("float32")
     Y = rng.randint(0, 10, size=n).astype("float32")
     return X, Y
+
+
+def test_transformer_lm_example(monkeypatch, capsys):
+    m = _load("gluon/transformer_lm.py", "tlm_example")
+    monkeypatch.setattr(sys, "argv", ["transformer_lm.py", "--steps", "30",
+                                      "--batch-size", "16",
+                                      "--seq-len", "16", "--units", "32",
+                                      "--layers", "1"])
+    m.main()
+    out = capsys.readouterr().out
+    assert "greedy continuation" in out
+    matched = int(out.strip().splitlines()[-1].split("on ")[1]
+                  .split("/")[0])
+    assert matched >= 6   # the deterministic corpus is learnable

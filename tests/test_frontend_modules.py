@@ -117,3 +117,30 @@ def test_name_manager_scope_resets_counter():
 def test_variable_rejects_non_string_attr():
     with pytest.raises(ValueError, match="string"):
         sym.Variable("w", lr_mult=2)
+
+
+def test_attrs_survive_compose_and_serialization(tmp_path):
+    with mx.AttrScope(ctx_group="stage2"):
+        x = sym.Variable("x")
+        y = sym.relu(x)
+    # compose keeps original attrs (not the ambient scope)
+    z = y(x=sym.Variable("x2"))
+    assert z.attr("ctx_group") == "stage2"
+    # serialization round-trips attrs
+    f = str(tmp_path / "s.json")
+    y.save(f)
+    with mx.AttrScope(ctx_group="WRONG"):
+        y2 = sym.load(f)
+    assert y2.attr("ctx_group") == "stage2"
+
+
+def test_prefix_applies_to_explicit_names():
+    from mxnet_tpu import name as name_mod
+    with name_mod.Prefix("net1_"):
+        s = sym.relu(sym.Variable("d"), name="act")
+    assert s._outputs[0][0].name == "net1_act"
+
+
+def test_variable_attrs_dict_validated():
+    with pytest.raises(ValueError, match="string"):
+        sym.Variable("w", attrs={"lr_mult": 2})

@@ -272,3 +272,53 @@ def test_multi_sgd_update():
                 lrs=(0.1, 0.2), wds=(0.0, 0.0), num_weights=2)
     onp.testing.assert_allclose(outs[0], ws[0] - 0.1 * gs[0], rtol=1e-5)
     onp.testing.assert_allclose(outs[1], ws[1] - 0.2 * gs[1], rtol=1e-5)
+
+
+def test_regression_output_ops():
+    """Linear/MAE/Logistic RegressionOutput (parity:
+    regression_output-inl.h): identity/sigmoid forward, injected
+    (out - label) * grad_scale/num_output backward."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+
+    d = mx.nd.array(onp.array([[1., 2.], [3., 4.]]))
+    lb = mx.nd.array(onp.array([[0., 1.], [2., 2.]]))
+    d.attach_grad()
+    with autograd.record():
+        out = mx.nd.LinearRegressionOutput(d, lb, grad_scale=2.0)
+    out.backward()
+    onp.testing.assert_allclose(d.grad.asnumpy(),
+                                (d.asnumpy() - lb.asnumpy()) * 2.0 / 2)
+    onp.testing.assert_array_equal(out.asnumpy(), d.asnumpy())
+
+    with autograd.record():
+        out = mx.nd.MAERegressionOutput(d, lb)
+    out.backward()
+    onp.testing.assert_allclose(
+        d.grad.asnumpy(), onp.sign(d.asnumpy() - lb.asnumpy()) / 2)
+
+    d2 = mx.nd.array(onp.array([[0.0, 1.0]]))
+    l2 = mx.nd.array(onp.array([[1.0, 0.0]]))
+    d2.attach_grad()
+    with autograd.record():
+        out = mx.nd.LogisticRegressionOutput(d2, l2)
+    out.backward()
+    sig = 1 / (1 + onp.exp(-d2.asnumpy()))
+    onp.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-6)
+    onp.testing.assert_allclose(d2.grad.asnumpy(),
+                                (sig - l2.asnumpy()) / 2, rtol=1e-6)
+
+
+def test_legacy_crop_op():
+    import numpy as onp
+    import mxnet_tpu as mx
+
+    x = mx.nd.array(onp.arange(48, dtype=onp.float32).reshape(1, 1, 6, 8))
+    like = mx.nd.array(onp.zeros((1, 1, 4, 4), onp.float32))
+    c = mx.nd.Crop(x, like, num_args=2, center_crop=True)
+    onp.testing.assert_array_equal(c.asnumpy(),
+                                   x.asnumpy()[:, :, 1:5, 2:6])
+    c2 = mx.nd.Crop(x, h_w=(2, 2), offset=(1, 3), num_args=1)
+    onp.testing.assert_array_equal(c2.asnumpy(),
+                                   x.asnumpy()[:, :, 1:3, 3:5])

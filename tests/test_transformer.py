@@ -181,3 +181,37 @@ def test_multi_head_attention_gqa_block():
         b.set_data(a.data())
     onp.testing.assert_allclose(att_ref(x).asnumpy(), out.asnumpy(),
                                 rtol=2e-4, atol=2e-4)
+
+
+def test_generate_device_side_decode():
+    """generate(): one-jit lax.scan decode — greedy deterministic,
+    matches per-step eager argmax decoding exactly."""
+    from mxnet_tpu.gluon.model_zoo.transformer import generate
+    from mxnet_tpu.ndarray import NDArray
+
+    mx.random.seed(0)
+    net = _lm(units=32, layers=1)
+    net(_toks(onp.random.RandomState(0), 1, 8))
+    prompt = onp.array([[3, 7, 11]], onp.int32)
+
+    out = generate(net, prompt, max_new_tokens=5, temperature=0)
+    arr = out.asnumpy()
+    assert arr.shape == (1, 8)
+    onp.testing.assert_array_equal(arr[0, :3], prompt[0])
+
+    # oracle: eager greedy loop re-running the full forward per step
+    seq = list(prompt[0])
+    for _ in range(5):
+        logits = net(NDArray(onp.asarray([seq], onp.int32))).asnumpy()
+        seq.append(int(logits[0, -1].argmax()))
+    onp.testing.assert_array_equal(arr[0], seq)
+
+    # sampling path runs and respects the prompt
+    out2 = generate(net, prompt, max_new_tokens=4, temperature=1.0,
+                    top_k=5, seed=0)
+    assert out2.shape == (1, 7)
+    onp.testing.assert_array_equal(out2.asnumpy()[0, :3], prompt[0])
+    # seeded sampling is reproducible
+    out3 = generate(net, prompt, max_new_tokens=4, temperature=1.0,
+                    top_k=5, seed=0)
+    onp.testing.assert_array_equal(out2.asnumpy(), out3.asnumpy())
