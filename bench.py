@@ -184,6 +184,30 @@ def _infer_bench(dtype, batch):
     return batch / batch_t
 
 
+def _devices_or_die(timeout_s=180):
+    """jax.devices() with a watchdog: a wedged tunnel must fail fast
+    (observed: the axon relay can hang device init indefinitely), not
+    stall the whole bench run."""
+    import threading
+    import jax
+    box = {}
+
+    def probe():
+        try:
+            box["devices"] = jax.devices()
+        except Exception as e:          # pragma: no cover
+            box["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "devices" not in box:
+        raise SystemExit(
+            f"bench: TPU backend failed to initialize within {timeout_s}s "
+            f"({box.get('error', 'device init hang — tunnel wedged?')})")
+    return box["devices"]
+
+
 def main():
     import jax
     # persistent compilation cache: repeat bench runs become disk hits
@@ -193,7 +217,7 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
-    dev = jax.devices()[0]
+    dev = _devices_or_die()[0]
     kind = getattr(dev, "device_kind", str(dev))
     peak = _peak_flops(kind)
 
