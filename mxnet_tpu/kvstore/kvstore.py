@@ -45,6 +45,12 @@ class KVStore(KVStoreBase):
             return acc
         return value
 
+    @staticmethod
+    def _densify(value):
+        """Row-sparse pushes merge through their dense form (parity:
+        kvstore_local.h sparse reduce; the store keeps dense weights)."""
+        return value.todense() if hasattr(value, "todense") else value
+
     def init(self, key, value):
         keys = key if isinstance(key, (list, tuple)) else [key]
         vals = value if isinstance(value, (list, tuple)) else [value]
@@ -56,6 +62,10 @@ class KVStore(KVStoreBase):
         if len(keys) == 1:
             value = [value]
         for k, v in zip(keys, value):
+            if isinstance(v, (list, tuple)):
+                v = [self._densify(x) for x in v]
+            else:
+                v = self._densify(v)
             reduced = self._reduce(v)
             if self._updater is not None:
                 if k not in self._data:
@@ -78,6 +88,44 @@ class KVStore(KVStoreBase):
                     val.copyto(t)
         return out
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only ``row_ids`` rows of a key as RowSparseNDArray(s)
+        (parity: kvstore.py:176 row_sparse_pull — the sparse-embedding
+        training path; each out slot may use distinct row_ids)."""
+        import numpy as onp
+        from ..ndarray.sparse import RowSparseNDArray
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys = key if isinstance(key, (list, tuple)) else [key]
+        outs = (out if isinstance(out, (list, tuple))
+                else [out] * len(keys))
+        rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        if not (len(keys) == len(outs) == len(rids)):
+            raise MXNetError("row_sparse_pull: keys/out/row_ids length "
+                             "mismatch")
+        results = []
+        for k, o, r in zip(keys, outs, rids):
+            val = self._data[k]
+            dense = (val.todense() if hasattr(val, "todense")
+                     else val).asnumpy()
+            ridx = onp.unique(onp.asarray(
+                r.asnumpy() if hasattr(r, "asnumpy") else r,
+                onp.int64).reshape(-1))
+            rsp = RowSparseNDArray(dense[ridx], ridx, dense.shape)
+            if o is not None:
+                # fill the caller's buffer in place (the reference
+                # contract: pre-allocated RowSparseNDArray outs)
+                o.data = rsp.data
+                o.indices = rsp.indices
+                o._shape = tuple(dense.shape)
+                o._dtype = rsp.dtype
+            results.append(rsp)
+        if out is None:
+            return results[0] if len(results) == 1 else results
+        return out
+
     def pushpull(self, key, value, out=None, priority=0):
         if self._updater is not None:
             # server-side optimizer: push applies update, pull returns weight
@@ -91,6 +139,10 @@ class KVStore(KVStoreBase):
         if len(keys) == 1:
             vals = [value]
         for k, v in zip(keys, vals):
+            if isinstance(v, (list, tuple)):
+                v = [self._densify(x) for x in v]
+            else:
+                v = self._densify(v)
             self._data[k] = self._reduce(v)
         if out is not None:
             self.pull(key, out, priority)

@@ -72,3 +72,15 @@ def test_transformer_lm_example(monkeypatch, capsys):
     matched = int(out.strip().splitlines()[-1].split("on ")[1]
                   .split("/")[0])
     assert matched >= 6   # the deterministic corpus is learnable
+
+
+def test_quantization_example(monkeypatch, capsys):
+    m = _load("quantization/quantize_model.py", "quant_example")
+    monkeypatch.setattr(sys, "argv", ["quantize_model.py",
+                                      "--calib-mode", "naive",
+                                      "--calib-batches", "2"])
+    m.main()
+    out = capsys.readouterr().out
+    assert "top-1 agreement" in out
+    agree = float(out.split("agreement ")[1].rstrip("%\n")) / 100
+    assert agree >= 0.7

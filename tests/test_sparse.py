@@ -132,3 +132,78 @@ def test_sparse_errors():
         sparse.zeros("bogus", (2, 2))
     with pytest.raises(MXNetError):
         sparse.row_sparse_array((onp.ones((2, 3)), [0]), shape=(4, 3))
+
+
+def test_kvstore_row_sparse_pull_and_push():
+    """row_sparse_pull returns only the requested rows; RowSparse pushes
+    merge through the dense store (parity: kvstore.py:176,
+    kvstore_local.h sparse reduce)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray, row_sparse_array
+
+    kv = mx.kv.create("local")
+    W = onp.arange(12, dtype=onp.float32).reshape(4, 3)
+    kv.init("emb", mx.nd.array(W))
+    out = kv.row_sparse_pull(
+        "emb", row_ids=mx.nd.array(onp.array([2, 0, 2], onp.float32)))
+    assert isinstance(out, RowSparseNDArray)
+    onp.testing.assert_array_equal(onp.asarray(out.indices), [0, 2])
+    onp.testing.assert_array_equal(onp.asarray(out.data), W[[0, 2]])
+
+    g = row_sparse_array((onp.ones((1, 3), onp.float32),
+                          onp.array([1])), shape=(4, 3))
+    kv.push("emb", g)
+    got = mx.nd.zeros((4, 3))
+    kv.pull("emb", out=got)
+    exp = onp.zeros((4, 3), onp.float32)
+    exp[1] = 1
+    onp.testing.assert_array_equal(got.asnumpy(), exp)
+
+
+def test_kvstore_row_sparse_pull_out_buffers_and_multi_key():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+    kv = mx.kv.create("local")
+    A = onp.arange(12, dtype=onp.float32).reshape(4, 3)
+    B = -onp.arange(6, dtype=onp.float32).reshape(2, 3)
+    kv.init(["a", "b"], [mx.nd.array(A), mx.nd.array(B)])
+
+    # caller-provided out buffers are filled in place
+    o = RowSparseNDArray(onp.zeros((1, 3), onp.float32),
+                         onp.array([0]), (4, 3))
+    ret = kv.row_sparse_pull("a", out=o,
+                             row_ids=mx.nd.array(onp.array([3., 1.])))
+    assert ret is o
+    onp.testing.assert_array_equal(onp.asarray(o.indices), [1, 3])
+    onp.testing.assert_array_equal(onp.asarray(o.data), A[[1, 3]])
+
+    # multi-key pull with out=None returns one result per key
+    res = kv.row_sparse_pull(
+        ["a", "b"],
+        row_ids=[mx.nd.array(onp.array([0.])),
+                 mx.nd.array(onp.array([1.]))])
+    assert len(res) == 2
+    onp.testing.assert_array_equal(onp.asarray(res[0].data), A[[0]])
+    onp.testing.assert_array_equal(onp.asarray(res[1].data), B[[1]])
+
+
+def test_kvstore_pushpull_row_sparse():
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.sparse import row_sparse_array
+
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((4, 3)))
+    g1 = row_sparse_array((onp.ones((1, 3), onp.float32),
+                           onp.array([0])), shape=(4, 3))
+    g2 = row_sparse_array((2 * onp.ones((1, 3), onp.float32),
+                           onp.array([2])), shape=(4, 3))
+    out = mx.nd.zeros((4, 3))
+    kv.pushpull("w", [g1, g2], out=out)
+    exp = onp.zeros((4, 3), onp.float32)
+    exp[0] = 1
+    exp[2] = 2
+    onp.testing.assert_array_equal(out.asnumpy(), exp)
