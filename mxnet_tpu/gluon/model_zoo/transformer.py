@@ -31,7 +31,7 @@ class MultiHeadAttention(HybridBlock):
     (Pallas flash kernel underneath)."""
 
     def __init__(self, units, num_heads, causal=False, use_flash=True,
-                 num_kv_heads=None, **kwargs):
+                 num_kv_heads=None, ring_mesh=None, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by num_heads "
@@ -44,6 +44,11 @@ class MultiHeadAttention(HybridBlock):
         self._kv_heads = num_kv_heads
         self._causal = causal
         self._flash = use_flash
+        # sequence parallelism: when a mesh with an "sp" axis is given,
+        # attention runs as ring attention over that axis (sequence
+        # shards exchange K/V blocks by collective-permute) — the
+        # long-context training path (parallel/ring_attention.py)
+        self._ring_mesh = ring_mesh
         hkv = num_kv_heads if num_kv_heads is not None else num_heads
         kv_units = (units // num_heads) * hkv
         self._kv_units = kv_units
@@ -58,11 +63,34 @@ class MultiHeadAttention(HybridBlock):
         q = qkv.slice_axis(axis=-1, begin=0, end=u)
         k = qkv.slice_axis(axis=-1, begin=u, end=u + kvu)
         v = qkv.slice_axis(axis=-1, begin=u + kvu, end=u + 2 * kvu)
-        attn = invoke("multi_head_attention", [q, k, v],
-                      num_heads=self._heads, causal=self._causal,
-                      use_flash=self._flash,
-                      num_kv_heads=self._kv_heads)
+        if self._ring_mesh is not None:
+            attn = self._ring_forward(q, k, v)
+        else:
+            attn = invoke("multi_head_attention", [q, k, v],
+                          num_heads=self._heads, causal=self._causal,
+                          use_flash=self._flash,
+                          num_kv_heads=self._kv_heads)
         return self.out_proj(attn)
+
+    def _ring_forward(self, q, k, v):
+        import jax.numpy as jnp
+        from ...ops.registry import apply_jax
+        from ...parallel import ring_self_attention
+
+        heads, causal, mesh = self._heads, self._causal, self._ring_mesh
+        hkv = self._kv_heads if self._kv_heads is not None else heads
+
+        def fn(qa, ka, va):
+            from ...ops.attention import merge_heads, split_heads
+            # GQA: the SMALL (hkv-head) K/V enter the ring — the ring
+            # body broadcasts per block, so ppermute traffic stays
+            # hkv/heads of the naive pre-expanded form
+            out = ring_self_attention(
+                split_heads(qa, heads), split_heads(ka, hkv),
+                split_heads(va, hkv), mesh, causal=causal)
+            return merge_heads(out)
+
+        return apply_jax(fn, [q, k, v])
 
 
 class TransformerBlock(HybridBlock):

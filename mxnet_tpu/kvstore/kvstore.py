@@ -97,6 +97,10 @@ class KVStore(KVStoreBase):
         if row_ids is None:
             raise MXNetError("row_sparse_pull requires row_ids")
         keys = key if isinstance(key, (list, tuple)) else [key]
+        if out is not None and not isinstance(out, (list, tuple)) \
+                and len(keys) > 1:
+            raise MXNetError("row_sparse_pull: multiple keys need one "
+                             "out buffer per key")
         outs = (out if isinstance(out, (list, tuple))
                 else [out] * len(keys))
         rids = row_ids if isinstance(row_ids, (list, tuple)) else [row_ids]
@@ -108,11 +112,14 @@ class KVStore(KVStoreBase):
         results = []
         for k, o, r in zip(keys, outs, rids):
             val = self._data[k]
-            dense = (val.todense() if hasattr(val, "todense")
-                     else val).asnumpy()
+            dense = self._densify(val).asnumpy()
             ridx = onp.unique(onp.asarray(
                 r.asnumpy() if hasattr(r, "asnumpy") else r,
                 onp.int64).reshape(-1))
+            if len(ridx) and (ridx[0] < 0 or ridx[-1] >= dense.shape[0]):
+                raise MXNetError(
+                    f"row_sparse_pull: row_ids out of range for key "
+                    f"{k!r} with {dense.shape[0]} rows")
             rsp = RowSparseNDArray(dense[ridx], ridx, dense.shape)
             if o is not None:
                 # fill the caller's buffer in place (the reference

@@ -401,6 +401,18 @@ def _imm_encdec_valatt(keys_values, attention, *, heads):
 
 # -- multi-head attention convenience op (flash-backed) --------------------
 
+def split_heads(x, heads):
+    """(B, S, heads*hd) → (B, heads, S, hd)."""
+    b, s_, e = x.shape
+    return jnp.transpose(x.reshape(b, s_, heads, e // heads), (0, 2, 1, 3))
+
+
+def merge_heads(x):
+    """(B, H, S, hd) → (B, S, H*hd)."""
+    b, h, s_, hd = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b, s_, h * hd)
+
+
 @register("multi_head_attention", aliases=("_npx_multi_head_attention",))
 def _multi_head_attention(q, k, v, *, num_heads, causal=False,
                           use_flash=True, num_kv_heads=None):
@@ -409,14 +421,9 @@ def _multi_head_attention(q, k, v, *, num_heads, causal=False,
     ``num_kv_heads`` enables grouped-query attention: k/v carry
     ``num_kv_heads * head_dim`` features and are shared across query
     groups (MQA with num_kv_heads=1)."""
-    b, sq, e = q.shape
-    hd = e // num_heads
     hkv = num_kv_heads if num_kv_heads is not None else num_heads
-
-    def split(x, heads):
-        return jnp.transpose(x.reshape(b, x.shape[1], heads, hd),
-                             (0, 2, 1, 3))
-    qh, kh, vh = split(q, num_heads), split(k, hkv), split(v, hkv)
+    qh, kh, vh = (split_heads(q, num_heads), split_heads(k, hkv),
+                  split_heads(v, hkv))
     if use_flash:
         out = flash_attention(qh, kh, vh, causal=causal)
     else:
@@ -424,4 +431,4 @@ def _multi_head_attention(q, k, v, *, num_heads, causal=False,
             kh = jnp.repeat(kh, num_heads // hkv, axis=1)
             vh = jnp.repeat(vh, num_heads // hkv, axis=1)
         out = attention_reference(qh, kh, vh, causal=causal)
-    return jnp.transpose(out, (0, 2, 1, 3)).reshape(b, sq, e)
+    return merge_heads(out)

@@ -34,12 +34,20 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                    sm_scale: Optional[float] = None):
     """Per-shard ring attention body; call inside shard_map/pjit.
 
-    q, k, v: (B, H, S_local, D) — this device's sequence shard.
+    q: (B, H, S_local, D); k, v: (B, Hkv, S_local, D) — this device's
+    sequence shard.  GQA/MQA: with Hkv < H the SMALL K/V blocks rotate
+    around the ring (minimal collective-permute traffic) and are
+    broadcast to the query groups only at each local block update.
     Returns the local output shard (B, H, S_local, D).
     """
     n = lax.psum(1, axis_name)
     my = lax.axis_index(axis_name)
     b, h, sq, d = q.shape
+    hkv = k.shape[1]
+    if hkv <= 0 or h % hkv:
+        raise ValueError(f"q heads ({h}) not divisible by kv heads "
+                         f"({hkv})")
+    group = h // hkv
     sk = k.shape[2]
     scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
 
@@ -61,7 +69,9 @@ def ring_attention(q, k, v, axis_name: str = "sp", causal: bool = False,
                 kpos = (kv_idx * sk
                         + lax.broadcasted_iota(jnp.int32, (b, h, sq, sk), 3))
                 mask = qpos >= kpos
-            return online_block_update(o, m, l, q32, kc, vc, scale, mask)
+            ke = jnp.repeat(kc, group, axis=1) if group > 1 else kc
+            ve = jnp.repeat(vc, group, axis=1) if group > 1 else vc
+            return online_block_update(o, m, l, q32, ke, ve, scale, mask)
 
         if causal:
             # shards strictly above the diagonal contribute nothing —
@@ -87,6 +97,13 @@ def ring_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """shard_map wrapper: shards the sequence axis of (B,H,S,D) over
     ``axis_name`` and runs ring attention across the mesh."""
     spec = PartitionSpec(None, None, axis_name, None)
+    # place inputs onto the mesh first: under jit this is a sharding
+    # constraint; eagerly (e.g. a deferred-init warm-up forward) it
+    # moves the single-device array onto the mesh so shard_map accepts
+    # it either way
+    sh = jax.sharding.NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(q, sh), jax.device_put(k, sh),
+               jax.device_put(v, sh))
     fn = functools.partial(ring_attention, axis_name=axis_name,
                            causal=causal, sm_scale=sm_scale)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

@@ -42,11 +42,15 @@ class SPMDTrainer:
                  optimizer_params: Optional[dict] = None,
                  mesh: Optional[Mesh] = None, batch_axis: int = 0,
                  donate: bool = True, dtype: Optional[str] = None,
-                 remat: bool = False):
+                 remat: bool = False, seq_axis: Optional[int] = None):
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
         self.batch_axis = batch_axis
+        # sequence parallelism: shard this data axis over the mesh's
+        # "sp" axis (ring attention inside the model exchanges K/V
+        # between the sequence shards)
+        self.seq_axis = seq_axis
         # rematerialization: recompute the forward during backward
         # instead of keeping activations live — trades FLOPs for HBM
         # (the jax.checkpoint knob the build targets for long-context /
@@ -81,6 +85,10 @@ class SPMDTrainer:
         spec = [None] * ndim
         if "dp" in self.mesh.axis_names:
             spec[self.batch_axis] = "dp"
+        if (self.seq_axis is not None and "sp" in self.mesh.axis_names
+                and self.seq_axis < ndim
+                and self.seq_axis != self.batch_axis):
+            spec[self.seq_axis] = "sp"
         return NamedSharding(self.mesh, PartitionSpec(*spec))
 
     # -- compiled step -----------------------------------------------------

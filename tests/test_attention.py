@@ -174,3 +174,25 @@ def test_flash_attention_gqa():
     k3 = jnp.asarray(rng.randn(B, 3, S, D).astype("float32"))
     with pytest.raises(ValueError, match="divisible"):
         flash_attention(q, k3, k3)
+
+
+def test_ring_attention_gqa_small_kv_traffic_path():
+    """GQA through the ring: hkv < H K/V rotate un-expanded and match
+    the pre-expanded reference."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel import make_mesh, ring_self_attention
+    from mxnet_tpu.ops.attention import attention_reference
+
+    mesh = make_mesh({"sp": 4})
+    rng = onp.random.RandomState(0)
+    B, H, HKV, S, D = 2, 4, 2, 16, 8
+    q = jnp.asarray(rng.randn(B, H, S, D).astype("float32"))
+    k = jnp.asarray(rng.randn(B, HKV, S, D).astype("float32"))
+    v = jnp.asarray(rng.randn(B, HKV, S, D).astype("float32"))
+    out = ring_self_attention(q, k, v, mesh, causal=True)
+    ref = attention_reference(q, jnp.repeat(k, H // HKV, axis=1),
+                              jnp.repeat(v, H // HKV, axis=1),
+                              causal=True)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)

@@ -207,3 +207,27 @@ def test_kvstore_pushpull_row_sparse():
     exp[0] = 1
     exp[2] = 2
     onp.testing.assert_array_equal(out.asnumpy(), exp)
+
+
+def test_kvstore_row_sparse_pull_validation():
+    import numpy as onp
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.array(onp.zeros((4, 3), "float32")))
+    with pytest.raises(MXNetError, match="out of range"):
+        kv.row_sparse_pull("w", row_ids=mx.nd.array(
+            onp.array([-1.0])))
+    with pytest.raises(MXNetError, match="out of range"):
+        kv.row_sparse_pull("w", row_ids=mx.nd.array(
+            onp.array([7.0])))
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    o = RowSparseNDArray(onp.zeros((1, 3), "float32"),
+                         onp.array([0]), (4, 3))
+    kv.init("w2", mx.nd.array(onp.ones((4, 3), "float32")))
+    with pytest.raises(MXNetError, match="one ""out buffer per key"):
+        kv.row_sparse_pull(["w", "w2"], out=o,
+                           row_ids=[mx.nd.array(onp.array([0.0])),
+                                    mx.nd.array(onp.array([1.0]))])
