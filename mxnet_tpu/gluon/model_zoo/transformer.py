@@ -23,7 +23,8 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["MultiHeadAttention", "TransformerBlock", "TransformerLM",
-           "get_transformer_lm", "generate"]
+           "get_transformer_lm", "generate", "VisionTransformer",
+           "get_vit"]
 
 
 class MultiHeadAttention(HybridBlock):
@@ -275,3 +276,57 @@ def generate(net, prompt, max_new_tokens, *, temperature=1.0, top_k=0,
         jitted = cache[sig] = jax.jit(decode)
     out = jitted(p_arrays, buf0, key0)
     return NDArray(out)
+
+
+class VisionTransformer(HybridBlock):
+    """ViT classifier (patch embedding + non-causal transformer encoder
+    + CLS head) — rounds out the model-zoo transformer family on the
+    vision side; attention rides the same Pallas flash kernel.
+
+    Input (B, C, H, W) → logits (B, classes).
+    """
+
+    def __init__(self, image_size=224, patch_size=16, classes=1000,
+                 units=384, num_layers=6, num_heads=6, ffn_ratio=4,
+                 dropout=0.0, in_channels=3, **kwargs):
+        super().__init__(**kwargs)
+        if image_size % patch_size:
+            raise MXNetError("image_size must be divisible by patch_size")
+        self._patch = patch_size
+        self._np = (image_size // patch_size) ** 2
+        from ... import initializer
+        # patch embedding as a strided conv (the standard ViT stem)
+        self.patch_embed = nn.Conv2D(units, kernel_size=patch_size,
+                                     strides=patch_size,
+                                     in_channels=in_channels)
+        self.cls_token = Parameter(name="cls_token", shape=(1, 1, units),
+                                   init=initializer.Normal(0.02))
+        self.pos_embed = Parameter(name="pos_embed",
+                                   shape=(1, self._np + 1, units),
+                                   init=initializer.Normal(0.02))
+        self.blocks = nn.HybridSequential()
+        for _ in range(num_layers):
+            self.blocks.add(TransformerBlock(units, num_heads,
+                                             ffn_ratio=ffn_ratio,
+                                             causal=False,
+                                             dropout=dropout))
+        self.ln = nn.LayerNorm()
+        self.head = nn.Dense(classes)
+
+    def forward(self, x):
+        p = self.patch_embed(x)                       # (B, E, H', W')
+        B, E = p.shape[0], p.shape[1]
+        tokens = p.reshape((B, E, -1)).transpose((0, 2, 1))  # (B, N, E)
+        cls = self.cls_token.data().broadcast_to((B, 1, E))
+        tokens = invoke("concat", [cls, tokens], dim=1)
+        tokens = tokens + self.pos_embed.data()
+        tokens = self.blocks(tokens)
+        tokens = self.ln(tokens)
+        return self.head(tokens.slice_axis(axis=1, begin=0, end=1)
+                         .reshape((B, E)))
+
+
+def get_vit(image_size=224, patch_size=16, classes=1000, **kwargs):
+    """Factory (model-zoo style)."""
+    return VisionTransformer(image_size=image_size, patch_size=patch_size,
+                             classes=classes, **kwargs)
