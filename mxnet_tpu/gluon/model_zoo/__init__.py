@@ -4,7 +4,9 @@ from . import vision
 from .vision import get_model
 from . import transformer
 from .transformer import (MultiHeadAttention, TransformerBlock,
-                          TransformerLM, get_transformer_lm)
+                          TransformerLM, get_transformer_lm,
+                          VisionTransformer, get_vit, generate)
 
 __all__ = ["vision", "get_model", "transformer", "MultiHeadAttention",
-           "TransformerBlock", "TransformerLM", "get_transformer_lm"]
+           "TransformerBlock", "TransformerLM", "get_transformer_lm",
+           "VisionTransformer", "get_vit", "generate"]
