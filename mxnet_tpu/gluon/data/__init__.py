@@ -3,9 +3,10 @@ from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .sampler import (Sampler, SequentialSampler, RandomSampler, BatchSampler,
                       IntervalSampler, FilterSampler, BucketSampler)
 from .dataloader import DataLoader, default_batchify_fn
+from . import batchify
 from . import vision
 
 __all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset",
            "Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
            "IntervalSampler", "FilterSampler", "BucketSampler", "DataLoader",
-           "vision"]
+           "batchify", "vision"]

@@ -377,3 +377,43 @@ def test_model_zoo_reference_spellings():
     for n in ("squeezenet1.0", "inceptionv3", "mobilenet1.0",
               "mobilenetv2_0.5"):
         assert get_model(n) is not None
+
+
+def test_batchify_functions():
+    """gluon.data.batchify Stack/Pad/Append/Group/AsList (parity:
+    batchify.py docstring examples)."""
+    import numpy as onp
+    from mxnet_tpu.gluon.data import batchify as B
+
+    out = B.Pad()([[1, 2, 3, 4], [4, 5, 6], [8, 2]])
+    onp.testing.assert_array_equal(out.asnumpy(),
+                                   [[1, 2, 3, 4], [4, 5, 6, 0],
+                                    [8, 2, 0, 0]])
+    out = B.Pad(val=-1, round_to=4)([[1, 2, 3], [4]])
+    assert out.shape == (2, 4)
+    assert out.asnumpy()[1, 1] == -1
+
+    st = B.Stack()([onp.ones((2, 2)), onp.zeros((2, 2))])
+    assert st.shape == (2, 2, 2)
+
+    ap = B.Append()([onp.ones(3), onp.zeros(2)])
+    assert [a.shape for a in ap] == [(1, 3), (1, 2)]
+
+    g = B.Group(B.Stack(), B.Pad(val=0), B.AsList())
+    imgs, labels, names = g([
+        (onp.ones((2, 2)), [1, 2], "a"),
+        (onp.zeros((2, 2)), [3], "b"),
+    ])
+    assert imgs.shape == (2, 2, 2)
+    onp.testing.assert_array_equal(labels.asnumpy(), [[1, 2], [3, 0]])
+    assert names == ["a", "b"]
+
+    # end to end through a DataLoader
+    from mxnet_tpu.gluon.data import DataLoader, SimpleDataset
+    ds = SimpleDataset([(onp.ones((2,)), [1, 2, 3]),
+                        (onp.zeros((2,)), [9])])
+    dl = DataLoader(ds, batch_size=2,
+                    batchify_fn=B.Group(B.Stack(), B.Pad(val=-1)))
+    x, y = next(iter(dl))
+    assert x.shape == (2, 2) and y.shape == (2, 3)
+    assert y.asnumpy()[1, 1] == -1
