@@ -323,3 +323,25 @@ def test_image_record_dataset_rgb_and_workers(tmp_path):
     for bx, by in loader:
         seen += bx.shape[0]
     assert seen == 4
+
+
+def test_image_det_record_iter_factory(tmp_path):
+    """mx.io.ImageDetRecordIter factory (parity:
+    iter_image_det_recordio.cc): record file + augmenter kwargs."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import recordio
+    rng = onp.random.RandomState(11)
+    rec_path = str(tmp_path / "det2.rec")
+    w = recordio.MXRecordIO(rec_path, "w")
+    for i in range(4):
+        img = rng.randint(0, 255, (24, 24, 3), onp.uint8)
+        label = _det_label([[i, 0.1, 0.1, 0.9, 0.9]])
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, quality=95))
+    w.close()
+    it = mx.io.ImageDetRecordIter(path_imgrec=rec_path, batch_size=2,
+                                  data_shape=(3, 24, 24),
+                                  rand_mirror=True)
+    b = it.next()
+    assert b.data[0].shape == (2, 3, 24, 24)
+    assert b.label[0].shape[0] == 2
