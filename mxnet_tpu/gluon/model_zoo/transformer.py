@@ -23,8 +23,8 @@ from ..block import HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["MultiHeadAttention", "TransformerBlock", "TransformerLM",
-           "get_transformer_lm", "generate", "VisionTransformer",
-           "get_vit"]
+           "get_transformer_lm", "generate", "generate_cached",
+           "VisionTransformer", "get_vit"]
 
 
 class MultiHeadAttention(HybridBlock):
@@ -199,6 +199,50 @@ def _lm_apply(net, p_arrays, pvals, tokens):
             p._data = s
 
 
+def _prep_prompt(net, prompt, max_new_tokens):
+    arr = (prompt.asnumpy() if isinstance(prompt, NDArray)
+           else onp.asarray(prompt)).astype(onp.int32)
+    if arr.ndim == 1:
+        arr = arr[None]
+    B, P = arr.shape
+    L = P + int(max_new_tokens)
+    if L > net._max_len:
+        raise MXNetError(f"prompt + max_new_tokens = {L} exceeds "
+                         f"max_len {net._max_len}")
+    return arr, B, P, L
+
+
+def _decode_key(seed):
+    import jax
+    from ...ops.random import next_key
+    return (jax.random.PRNGKey(seed) if seed is not None else next_key())
+
+
+def _sample_logits(logits, key, greedy, temperature, top_k):
+    """One sampling decision; returns (token, next_key)."""
+    import jax
+    import jax.numpy as jnp
+    if greedy:
+        return jnp.argmax(logits, axis=-1), key
+    lt = logits / jnp.maximum(temperature, 1e-6)
+    if top_k and top_k > 0:
+        kth = jnp.sort(lt, axis=-1)[:, -top_k][:, None]
+        lt = jnp.where(lt < kth, -jnp.inf, lt)
+    key_next, sub = jax.random.split(key)
+    return jax.random.categorical(sub, lt, axis=-1), key_next
+
+
+def _jit_cached(net, sig, build):
+    cache = getattr(net, "_gen_cache", None)
+    if cache is None:
+        cache = net._gen_cache = {}
+    fn = cache.get(sig)
+    if fn is None:
+        import jax
+        fn = cache[sig] = jax.jit(build())
+    return fn
+
+
 def generate(net, prompt, max_new_tokens, *, temperature=1.0, top_k=0,
              seed=None):
     """Autoregressive decoding as ONE device-side program.
@@ -220,60 +264,38 @@ def generate(net, prompt, max_new_tokens, *, temperature=1.0, top_k=0,
     from jax import lax
     from ...ops.random import next_key
 
-    prompt_arr = (prompt.asnumpy() if isinstance(prompt, NDArray)
-                  else onp.asarray(prompt)).astype(onp.int32)
-    if prompt_arr.ndim == 1:
-        prompt_arr = prompt_arr[None]
-    B, P = prompt_arr.shape
-    L = P + int(max_new_tokens)
-    if L > net._max_len:
-        raise MXNetError(f"prompt + max_new_tokens = {L} exceeds "
-                         f"max_len {net._max_len}")
-
+    prompt_arr, B, P, L = _prep_prompt(net, prompt, max_new_tokens)
     params = net.collect_params()
     pvals = [params[k] for k in params]
     p_arrays = [p.data()._data for p in pvals]
-    key0 = (jax.random.PRNGKey(seed) if seed is not None
-            else next_key())
+    key0 = _decode_key(seed)
     greedy = temperature == 0 or top_k == 1
 
-    def decode(p_list, buf, key):
-        def body(carry, t):
-            buf, key = carry
-            logits = _lm_apply(net, p_list, pvals, buf)     # (B, L, V)
-            logit_t = jnp.take_along_axis(
-                logits, t.reshape(1, 1, 1).astype(jnp.int32)
-                .repeat(B, 0), axis=1)[:, 0]                # (B, V)
-            if greedy:
-                nxt = jnp.argmax(logit_t, axis=-1)
-                key_next = key
-            else:
-                lt = logit_t / jnp.maximum(temperature, 1e-6)
-                if top_k and top_k > 0:
-                    kth = jnp.sort(lt, axis=-1)[:, -top_k][:, None]
-                    lt = jnp.where(lt < kth, -jnp.inf, lt)
-                key_next, sub = jax.random.split(key)
-                nxt = jax.random.categorical(sub, lt, axis=-1)
-            nxt = nxt.astype(buf.dtype)
-            buf = lax.dynamic_update_slice_in_dim(
-                buf, nxt[:, None], t + 1, axis=1)
-            return (buf, key_next), nxt
+    def build():
+        def decode(p_list, buf, key):
+            def body(carry, t):
+                buf, key = carry
+                logits = _lm_apply(net, p_list, pvals, buf)  # (B, L, V)
+                logit_t = jnp.take_along_axis(
+                    logits, t.reshape(1, 1, 1).astype(jnp.int32)
+                    .repeat(B, 0), axis=1)[:, 0]             # (B, V)
+                nxt, key = _sample_logits(logit_t, key, greedy,
+                                          temperature, top_k)
+                buf = lax.dynamic_update_slice_in_dim(
+                    buf, nxt.astype(buf.dtype)[:, None], t + 1, axis=1)
+                return (buf, key), nxt
 
-        ts = jnp.arange(P - 1, L - 1)
-        (buf, _), _ = lax.scan(body, (buf, key), ts)
-        return buf
+            ts = jnp.arange(P - 1, L - 1)
+            (buf, _), _ = lax.scan(body, (buf, key), ts)
+            return buf
+        return decode
 
     buf0 = jnp.zeros((B, L), jnp.int32)
     buf0 = buf0.at[:, :P].set(jnp.asarray(prompt_arr))
-    # cache the compiled decode per signature — jit is keyed on function
-    # identity, so a fresh closure per call would retrace every time
-    cache = getattr(net, "_gen_cache", None)
-    if cache is None:
-        cache = net._gen_cache = {}
-    sig = (B, L, P, bool(greedy), float(temperature), int(top_k))
-    jitted = cache.get(sig)
-    if jitted is None:
-        jitted = cache[sig] = jax.jit(decode)
+    # jit is keyed on function identity — cache per signature so repeat
+    # calls reuse the compiled decode
+    jitted = _jit_cached(net, (B, L, P, bool(greedy), float(temperature),
+                               int(top_k)), build)
     out = jitted(p_arrays, buf0, key0)
     return NDArray(out)
 
@@ -330,3 +352,138 @@ def get_vit(image_size=224, patch_size=16, classes=1000, **kwargs):
     """Factory (model-zoo style)."""
     return VisionTransformer(image_size=image_size, patch_size=patch_size,
                              classes=classes, **kwargs)
+
+
+def _extract_lm_weights(net):
+    """Pull the TransformerLM parameters into a flat pytree for the
+    cached-decode path (standard MHA blocks only)."""
+    blocks = []
+    for blk in net.blocks._children.values():
+        att = blk.attn
+        if att._kv_heads is not None or att._ring_mesh is not None:
+            raise MXNetError("cached decode supports standard MHA blocks")
+        blocks.append(dict(
+            ln1=(blk.ln1.gamma.data()._data, blk.ln1.beta.data()._data),
+            qkv=(att.qkv.weight.data()._data, att.qkv.bias.data()._data),
+            out=(att.out_proj.weight.data()._data,
+                 att.out_proj.bias.data()._data),
+            ln2=(blk.ln2.gamma.data()._data, blk.ln2.beta.data()._data),
+            ffn1=(blk.ffn1.weight.data()._data, blk.ffn1.bias.data()._data),
+            ffn2=(blk.ffn2.weight.data()._data,
+                  blk.ffn2.bias.data()._data)))
+    head_w = (net.embed.weight.data()._data if net._tied
+              else net.head.weight.data()._data)
+    return dict(
+        embed=net.embed.weight.data()._data,
+        pos=net.pos_embed.data()._data,
+        blocks=blocks,
+        ln_f=(net.ln_f.gamma.data()._data, net.ln_f.beta.data()._data),
+        head=head_w)
+
+
+def generate_cached(net, prompt, max_new_tokens, *, temperature=1.0,
+                    top_k=0, seed=None):
+    """KV-cached autoregressive decoding: ONE ``lax.scan`` over token
+    positions where each step costs O(L) attention against per-layer
+    K/V caches (vs :func:`generate`'s O(L²) re-forward per token).
+
+    Prefill and decode share the same step body — prompt positions
+    stream through the caches first, then sampling takes over; greedy
+    results match :func:`generate` exactly (same math, cached).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from ...ops.random import next_key
+
+    prompt_arr, B, P, L = _prep_prompt(net, prompt, max_new_tokens)
+    w = _extract_lm_weights(net)
+    heads_per_block = [blk.attn._heads
+                       for blk in net.blocks._children.values()]
+    key0 = _decode_key(seed)
+    greedy = temperature == 0 or top_k == 1
+
+    def ln(x, g, b, eps=1e-5):
+        mu = x.mean(-1, keepdims=True)
+        var = ((x - mu) ** 2).mean(-1, keepdims=True)
+        return (x - mu) * lax.rsqrt(var + eps) * g + b
+
+    def decode(w, buf, key):
+        E = w["embed"].shape[1]
+        caches = []
+        for H in heads_per_block:
+            hd = E // H
+            caches.append((jnp.zeros((B, H, L, hd), jnp.float32),
+                           jnp.zeros((B, H, L, hd), jnp.float32)))
+
+        def body(carry, t):
+            buf, caches, key = carry
+            tok = lax.dynamic_slice_in_dim(buf, t, 1, axis=1)  # (B,1)
+            x = w["embed"][tok[:, 0]][:, None, :] \
+                + lax.dynamic_slice_in_dim(w["pos"], t, 1, 0)[None]
+            new_caches = []
+            for blk, H, (ck, cv) in zip(w["blocks"], heads_per_block,
+                                        caches):
+                hd = E // H
+                h = ln(x, *blk["ln1"])
+                qkv = h @ blk["qkv"][0].T + blk["qkv"][1]
+                q, k, v = jnp.split(qkv, 3, axis=-1)
+
+                def sh(z):
+                    return jnp.transpose(z.reshape(B, 1, H, hd),
+                                         (0, 2, 1, 3))
+                qh, kh, vh = sh(q), sh(k), sh(v)
+                ck = lax.dynamic_update_slice(ck, kh, (0, 0, t, 0))
+                cv = lax.dynamic_update_slice(cv, vh, (0, 0, t, 0))
+                scores = jnp.einsum("bhqd,bhkd->bhqk", qh, ck) \
+                    / jnp.sqrt(jnp.float32(hd))
+                pos = jnp.arange(L)
+                scores = jnp.where(pos[None, None, None, :] <= t,
+                                   scores, -1e30)
+                attn = jax.nn.softmax(scores, axis=-1)
+                ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, cv)
+                ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(B, 1, E)
+                x = x + (ctx @ blk["out"][0].T + blk["out"][1])
+                h = ln(x, *blk["ln2"])
+                h = h @ blk["ffn1"][0].T + blk["ffn1"][1]
+                h = jax.nn.gelu(h, approximate=False)
+                x = x + (h @ blk["ffn2"][0].T + blk["ffn2"][1])
+                new_caches.append((ck, cv))
+            xo = ln(x, *w["ln_f"])
+            logits = (xo @ w["head"].T)[:, 0]            # (B, V)
+            write = (t + 1 >= P) & (t + 1 < L)
+
+            def sample(key):
+                return _sample_logits(logits, key, greedy, temperature,
+                                      top_k)
+
+            def keep(key):
+                # prefill steps neither sample nor consume entropy —
+                # the key stream stays aligned with generate()'s
+                return jnp.zeros((B,), jnp.int32), key
+
+            nxt, key_next = lax.cond(write, sample, keep, key)
+            # write the sampled token at t+1 ONLY in the decode region
+            # (t >= P-1); prompt positions keep their given tokens
+            cur = lax.dynamic_slice_in_dim(buf, jnp.minimum(t + 1, L - 1),
+                                           1, axis=1)
+            upd = jnp.where(write, nxt[:, None].astype(buf.dtype), cur)
+            buf = lax.dynamic_update_slice_in_dim(
+                buf, upd, jnp.minimum(t + 1, L - 1), axis=1)
+            return (buf, new_caches, key_next), None
+
+        (buf, _, _), _ = lax.scan(body, (buf, caches, key),
+                                  jnp.arange(L - 1))
+        return buf
+
+    buf0 = jnp.zeros((B, L), jnp.int32)
+    buf0 = buf0.at[:, :P].set(jnp.asarray(prompt_arr))
+    jitted = _jit_cached(net, ("cached", B, L, P, bool(greedy),
+                               float(temperature), int(top_k)),
+                         lambda: decode)
+    out = jitted(w, buf0, key0)
+    return NDArray(out)
+
+
+TransformerLM.generate_cached = (
+    lambda self, prompt, n, **kw: generate_cached(self, prompt, n, **kw))

@@ -20,19 +20,10 @@ def ImageDetRecordIter(path_imgrec, batch_size, data_shape, shuffle=False,
                        aug_list=None, **kwargs):
     """Detection record iterator (parity: the C++ ImageDetRecordIter,
     src/io/iter_image_det_recordio.cc): thin factory over
-    image.ImageDetIter reading packed detection records; augmenter
-    kwargs go through CreateDetAugmenter."""
-    from ..image.detection import CreateDetAugmenter, ImageDetIter
-    if aug_list is None and kwargs:
-        aug_keys = ("resize", "rand_crop", "rand_pad", "rand_mirror",
-                    "mean", "std", "brightness", "contrast", "saturation",
-                    "pca_noise", "hue", "inter_method", "min_object_covered",
-                    "aspect_ratio_range", "area_range", "min_eject_coverage",
-                    "max_attempts", "pad_val")
-        aug_kwargs = {k: v for k, v in kwargs.items() if k in aug_keys}
-        if aug_kwargs:
-            aug_list = CreateDetAugmenter(data_shape, **aug_kwargs)
-        kwargs = {k: v for k, v in kwargs.items() if k not in aug_keys}
+    image.ImageDetIter reading packed detection records — augmenter
+    kwargs flow to CreateDetAugmenter inside ImageDetIter when no
+    explicit aug_list is given."""
+    from ..image.detection import ImageDetIter
     return ImageDetIter(batch_size=batch_size, data_shape=data_shape,
                         path_imgrec=path_imgrec, shuffle=shuffle,
                         aug_list=aug_list, **kwargs)
