@@ -1,0 +1,74 @@
+"""Cross-dtype kernel-oracle sweep.
+
+Parity: the reference's de-facto kernel oracle — check_consistency
+running one op across ctx/dtype lists (test_utils.py:1486, used heavily
+by tests/python/gpu/test_operator_gpu.py).  Here the axis is dtype:
+every op in the curated core set must produce bf16 results within
+bf16-appropriate tolerance of its fp32 results — the guard for the
+bf16 (MXU) training regime.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops.registry import get
+
+RTOL, ATOL = 2e-2, 2e-2   # bf16 has ~3 decimal digits
+
+
+def _run(name, arrays, **params):
+    fn = get(name).fn
+    out = fn(*arrays, **params)
+    return out[0] if isinstance(out, (tuple, list)) else out
+
+
+CASES = [
+    ("relu", [(4, 8)], {}),
+    ("sigmoid", [(4, 8)], {}),
+    ("tanh", [(4, 8)], {}),
+    ("softmax", [(4, 8)], {}),
+    ("log_softmax", [(4, 8)], {}),
+    ("exp", [(4, 8)], {}),
+    ("sqrt", [(4, 8)], {"_abs": True}),
+    ("broadcast_add", [(4, 8), (1, 8)], {}),
+    ("broadcast_mul", [(4, 8), (1, 8)], {}),
+    ("dot", [(4, 6), (6, 5)], {}),
+    ("batch_dot", [(2, 4, 6), (2, 6, 5)], {}),
+    ("sum", [(4, 8)], {}),
+    ("mean", [(4, 8)], {}),
+    ("max", [(4, 8)], {}),
+    ("FullyConnected", [(4, 6), (5, 6), (5,)], {"num_hidden": 5}),
+    ("Convolution", [(2, 3, 8, 8), (4, 3, 3, 3), (4,)],
+     {"kernel": (3, 3), "num_filter": 4}),
+    ("Pooling", [(2, 3, 8, 8)], {"kernel": (2, 2), "pool_type": "max",
+                                 "stride": (2, 2)}),
+    ("LayerNorm", [(4, 8), (8,), (8,)], {}),
+    ("Activation", [(4, 8)], {"act_type": "relu"}),
+    ("transpose", [(4, 6)], {}),
+    ("concat", [(4, 3), (4, 5)], {"dim": 1}),
+    ("clip", [(4, 8)], {"a_min": -0.5, "a_max": 0.5}),
+    ("flash_attention", [(2, 2, 16, 8), (2, 2, 16, 8), (2, 2, 16, 8)],
+     {"causal": True}),
+]
+
+
+@pytest.mark.parametrize("name,shapes,params",
+                         CASES, ids=[c[0] for c in CASES])
+def test_bf16_consistent_with_fp32(name, shapes, params):
+    import jax.numpy as jnp
+    params = dict(params)
+    take_abs = params.pop("_abs", False)
+    rng = onp.random.RandomState(0)
+    arrays32 = []
+    for shp in shapes:
+        a = rng.randn(*shp).astype("float32") * 0.5
+        if take_abs:
+            a = onp.abs(a)
+        arrays32.append(jnp.asarray(a))
+    out32 = onp.asarray(_run(name, arrays32, **params), onp.float64)
+    arrays16 = [a.astype(jnp.bfloat16) for a in arrays32]
+    out16 = onp.asarray(_run(name, arrays16, **params)
+                        .astype(jnp.float32), onp.float64)
+    assert out16.shape == out32.shape
+    onp.testing.assert_allclose(out16, out32, rtol=RTOL, atol=ATOL,
+                                err_msg=f"{name}: bf16 diverges from fp32")
