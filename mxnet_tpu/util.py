@@ -12,8 +12,14 @@ import functools
 import threading
 
 __all__ = ["is_np_array", "is_np_shape", "set_np", "reset_np", "np_array",
-           "np_shape", "use_np", "set_np_shape", "getenv", "setenv",
-           "set_large_tensor", "is_large_tensor_enabled"]
+           "np_shape", "use_np", "use_np_shape", "use_np_array",
+           "set_np_shape", "set_np_default_dtype", "is_np_default_dtype",
+           "np_default_dtype", "use_np_default_dtype", "get_gpu_count",
+           "get_gpu_memory", "get_cuda_compute_capability", "set_module",
+           "np_ufunc_legal_option", "wrap_np_unary_func",
+           "wrap_np_binary_func", "default_array", "numpy_fallback",
+           "getenv", "setenv", "set_large_tensor",
+           "is_large_tensor_enabled"]
 
 _state = threading.local()
 
@@ -43,10 +49,11 @@ def set_np(shape: bool = True, array: bool = True, dtype: bool = False) -> None:
     st = _st()
     st.np_shape = bool(shape)
     st.np_array = bool(array)
+    set_np_default_dtype(bool(dtype))
 
 
 def reset_np() -> None:
-    set_np(shape=True, array=False)
+    set_np(shape=True, array=False, dtype=False)
 
 
 class _NpScope:
@@ -56,7 +63,10 @@ class _NpScope:
     def __enter__(self):
         st = _st()
         self._old = (st.np_shape, st.np_array)
-        st.np_shape, st.np_array = self._shape, self._array
+        if self._shape is not None:
+            st.np_shape = self._shape
+        if self._array is not None:
+            st.np_array = self._array
         return self
 
     def __exit__(self, *exc):
@@ -104,15 +114,215 @@ def setenv(name, value):
 # The TPU build switches at runtime: jax's x64 mode widens index/shape
 # arithmetic and preserves int64/float64 dtypes end-to-end.
 
+# x64 is one global jax flag with two independent owners (large-tensor
+# mode and np-default-dtype mode): track each reason and OR them so
+# toggling one never silently cancels the other
+_X64_REASONS = {"large_tensor": False, "np_dtype": False}
+
+
+def _sync_x64():
+    import jax
+    jax.config.update("jax_enable_x64", any(_X64_REASONS.values()))
+
+
 def set_large_tensor(active: bool) -> bool:
     """Enable/disable 64-bit tensor support; returns the previous
     setting.  Also honored at import via MXNET_INT64_TENSOR_SIZE=1."""
-    import jax
-    prev = jax.config.jax_enable_x64
-    jax.config.update("jax_enable_x64", bool(active))
+    prev = _X64_REASONS["large_tensor"]
+    _X64_REASONS["large_tensor"] = bool(active)
+    _sync_x64()
     return prev
 
 
 def is_large_tensor_enabled() -> bool:
+    return _X64_REASONS["large_tensor"]
+
+
+# -- reference util.py long tail -------------------------------------------
+
+def use_np_shape(func):
+    """Decorator form of np_shape scope (parity: util.use_np_shape);
+    numpy shape semantics are native here, so this only sets the flag."""
+    if isinstance(func, type):
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NpScope(shape=True, array=None):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def use_np_array(func):
+    """Decorator form of np_array scope (parity: util.use_np_array)."""
+    if isinstance(func, type):
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NpScope(shape=None, array=True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def set_np_default_dtype(is_np_default=True) -> bool:
+    """float64-by-default numpy semantics (parity:
+    util.set_np_default_dtype).  The default dtype rides jax's x64 mode
+    (process-global, like the behavior it controls); large-tensor mode
+    holds an independent claim on x64 (see _X64_REASONS)."""
+    prev = _X64_REASONS["np_dtype"]
+    _X64_REASONS["np_dtype"] = bool(is_np_default)
+    _sync_x64()
+    return prev
+
+
+def is_np_default_dtype() -> bool:
+    return _X64_REASONS["np_dtype"]
+
+
+class _NpDtypeScope:
+    def __init__(self, active):
+        self._active = active
+
+    def __enter__(self):
+        self._prev = set_np_default_dtype(self._active)
+        return self
+
+    def __exit__(self, *exc):
+        set_np_default_dtype(self._prev)
+        return False
+
+
+def np_default_dtype(active=True):
+    """Scope form (parity: util.np_default_dtype)."""
+    return _NpDtypeScope(active)
+
+
+def use_np_default_dtype(func):
+    """Decorator form (parity: util.use_np_default_dtype)."""
+    if isinstance(func, type):
+        return func
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        with _NpDtypeScope(True):
+            return func(*args, **kwargs)
+    return wrapper
+
+
+def get_gpu_count() -> int:
+    """Accelerator count (parity: util.get_gpu_count — 'gpu' means
+    'the accelerator' throughout this build)."""
+    from .context import num_tpus
+    return num_tpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    """(free, total) accelerator memory in bytes when the backend
+    exposes it, else (0, 0) (parity: util.get_gpu_memory)."""
     import jax
-    return bool(jax.config.jax_enable_x64)
+    try:
+        dev = jax.devices()[gpu_dev_id]
+        stats = dev.memory_stats() or {}
+        total = stats.get("bytes_limit", 0)
+        used = stats.get("bytes_in_use", 0)
+        return (total - used, total)
+    except Exception:
+        return (0, 0)
+
+
+def get_cuda_compute_capability(ctx=None):
+    """No CUDA in this build (parity signature: util.py) — raises the
+    same ValueError the reference raises for non-GPU contexts."""
+    raise ValueError(
+        "get_cuda_compute_capability is CUDA-specific; this build runs "
+        "on TPU (see docs/MIGRATION.md)")
+
+
+def set_module(module):
+    """Decorator setting __module__ for doc purposes (parity:
+    util.set_module)."""
+    def deco(obj):
+        if module is not None:
+            obj.__module__ = module
+        return obj
+    return deco
+
+
+def np_ufunc_legal_option(key, value):
+    """Whether a ufunc kwarg is supported (parity:
+    util.np_ufunc_legal_option)."""
+    if key == "where":
+        return value is True
+    if key == "casting":
+        return value in ("no", "equiv", "safe", "same_kind", "unsafe")
+    if key == "order":
+        return isinstance(value, str) or value is None
+    if key in ("dtype", "out", "subok"):
+        return True
+    return False
+
+
+def wrap_np_unary_func(func):
+    """Validate numpy-ufunc kwargs then call (parity:
+    util.wrap_np_unary_func)."""
+    @functools.wraps(func)
+    def wrapper(x, out=None, **kwargs):
+        for k, v in kwargs.items():
+            if not np_ufunc_legal_option(k, v):
+                raise TypeError(f"{func.__name__} does not support "
+                                f"{k}={v!r}")
+        res = func(x)
+        if out is not None:
+            out[:] = res
+            return out
+        return res
+    return wrapper
+
+
+def wrap_np_binary_func(func):
+    """Binary variant of :func:`wrap_np_unary_func`."""
+    @functools.wraps(func)
+    def wrapper(a, b, out=None, **kwargs):
+        for k, v in kwargs.items():
+            if not np_ufunc_legal_option(k, v):
+                raise TypeError(f"{func.__name__} does not support "
+                                f"{k}={v!r}")
+        res = func(a, b)
+        if out is not None:
+            out[:] = res
+            return out
+        return res
+    return wrapper
+
+
+def default_array(source_array, ctx=None, dtype=None):
+    """Create an array honoring the np_array mode (parity:
+    util.default_array)."""
+    if is_np_array():
+        from . import numpy as _np
+        return _np.array(source_array, dtype=dtype, ctx=ctx)
+    from .ndarray import NDArray
+    import numpy as _onp
+    return NDArray(_onp.asarray(source_array, dtype=dtype), ctx=ctx)
+
+
+def numpy_fallback(func):
+    """Mark/wrap an op that falls back to host numpy (parity:
+    numpy_op_fallback.py): executes eagerly on host, returns NDArray."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        import numpy as _onp
+        from .ndarray import NDArray
+
+        def to_np(x):
+            return x.asnumpy() if hasattr(x, "asnumpy") else x
+        out = func(*[to_np(a) for a in args],
+                   **{k: to_np(v) for k, v in kwargs.items()})
+        if isinstance(out, _onp.ndarray):
+            return NDArray(out)
+        if isinstance(out, tuple):
+            return tuple(NDArray(o) if isinstance(o, _onp.ndarray) else o
+                         for o in out)
+        return out
+    return wrapper

@@ -144,3 +144,72 @@ def test_prefix_applies_to_explicit_names():
 def test_variable_attrs_dict_validated():
     with pytest.raises(ValueError, match="string"):
         sym.Variable("w", attrs={"lr_mult": 2})
+
+
+def test_util_long_tail():
+    """util.py parity long tail: decorators, np-default-dtype scope,
+    accelerator introspection, ufunc wrappers, numpy_fallback."""
+    import numpy as onp
+    from mxnet_tpu import util as U
+
+    @U.use_np_shape
+    def f():
+        return U.is_np_shape()
+    assert f() is True
+
+    @U.use_np_array
+    def g():
+        return U.is_np_array()
+    assert g() is True and U.is_np_array() is False
+
+    with U.np_default_dtype(True):
+        import jax.numpy as jnp
+        assert U.is_np_default_dtype()
+        assert jnp.asarray([1.0]).dtype == jnp.float64
+    assert not U.is_np_default_dtype()
+
+    assert U.get_gpu_count() >= 0
+    with pytest.raises(ValueError):
+        U.get_cuda_compute_capability()
+
+    wrapped = U.wrap_np_binary_func(lambda a, b: a + b)
+    onp.testing.assert_array_equal(wrapped(onp.ones(2), onp.ones(2)),
+                                   2 * onp.ones(2))
+    with pytest.raises(TypeError):
+        wrapped(onp.ones(2), onp.ones(2), casting="bogus")
+    with pytest.raises(TypeError):
+        wrapped(onp.ones(2), onp.ones(2), where=False)
+
+    @U.numpy_fallback
+    def host_op(a):
+        return onp.cumprod(a)
+    r = host_op(mx.nd.array(onp.array([1., 2., 3.])))
+    onp.testing.assert_array_equal(r.asnumpy(), [1, 2, 6])
+
+
+def test_x64_owners_independent():
+    """np_default_dtype and large-tensor mode own x64 independently —
+    toggling one must not cancel the other."""
+    from mxnet_tpu import util as U
+    import jax
+
+    U.set_large_tensor(True)
+    try:
+        with U.np_default_dtype(True):
+            pass
+        # scope exit must not kill large-tensor mode
+        assert U.is_large_tensor_enabled()
+        assert jax.config.jax_enable_x64
+    finally:
+        U.set_large_tensor(False)
+    assert not jax.config.jax_enable_x64
+
+    # set_np forwards dtype (reference contract)
+    U.set_np(dtype=True)
+    assert U.is_np_default_dtype()
+    U.reset_np()
+    assert not U.is_np_default_dtype()
+
+    # reference-legal casting values accepted
+    assert U.np_ufunc_legal_option("casting", "safe")
+    assert U.np_ufunc_legal_option("order", "F")
