@@ -11,6 +11,7 @@ from typing import Callable, Optional, Sequence
 import numpy as onp
 
 from .context import Context, cpu, current_context
+from .base import MXNetError
 from .ndarray import NDArray
 from . import autograd
 
@@ -110,3 +111,252 @@ def check_consistency(fn: Callable, inputs: Sequence[onp.ndarray],
         onp.testing.assert_allclose(ref, r.astype(onp.float64),
                                     rtol=rtol, atol=atol)
     return results
+
+
+# -- reference test_utils long tail ----------------------------------------
+
+def assert_allclose(a, b, rtol=1e-5, atol=1e-6):
+    """Alias of assert_almost_equal with numpy arg order (parity:
+    test_utils.assert_allclose)."""
+    assert_almost_equal(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=1e-5, atol=1e-6):
+    """Compare ignoring positions where EITHER side is NaN (parity:
+    test_utils.assert_almost_equal_ignore_nan)."""
+    a = _as_np(a).copy()
+    b = _as_np(b).copy()
+    nan = onp.isnan(a) | onp.isnan(b)
+    a[nan] = 0
+    b[nan] = 0
+    assert_almost_equal(a, b, rtol=rtol, atol=atol)
+
+
+def assert_almost_equal_with_err(a, b, rtol=1e-5, atol=1e-6, etol=0.0):
+    """Allow an ``etol`` fraction of elements to violate the tolerance
+    (parity: test_utils.assert_almost_equal_with_err)."""
+    a = _as_np(a)
+    b = _as_np(b)
+    bad = ~onp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=True)
+    frac = bad.sum() / max(bad.size, 1)
+    if frac > etol:
+        raise AssertionError(
+            f"{frac:.4%} of elements exceed tolerance (etol={etol:.4%})")
+
+
+def assert_exception(fn, exception_type, *args, **kwargs):
+    """fn(*args) must raise exception_type (parity:
+    test_utils.assert_exception)."""
+    try:
+        fn(*args, **kwargs)
+    except exception_type:
+        return
+    raise AssertionError(f"did not raise {exception_type.__name__}")
+
+
+def effective_dtype(a):
+    """The dtype comparisons should use (parity:
+    test_utils.effective_dtype — bf16/f16 math on accelerators compares
+    at reduced precision; None means float32 defaults)."""
+    if a is None:
+        return onp.dtype(onp.float32)
+    dt = onp.dtype(getattr(a, "dtype", a))
+    if dt in (onp.float16,) or str(dt) == "bfloat16":
+        return onp.dtype(onp.float16)
+    return dt
+
+
+_RTOLS = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-4,
+          onp.dtype(onp.float64): 1e-7}
+_ATOLS = {onp.dtype(onp.float16): 1e-2, onp.dtype(onp.float32): 1e-5,
+          onp.dtype(onp.float64): 1e-9}
+
+
+def default_rtols():
+    return dict(_RTOLS)
+
+
+def default_atols():
+    return dict(_ATOLS)
+
+
+def get_rtol(a=None, rtol=None):
+    if rtol is not None:
+        return rtol
+    return _RTOLS.get(effective_dtype(a), 1e-4)
+
+
+def get_atol(a=None, atol=None):
+    if atol is not None:
+        return atol
+    return _ATOLS.get(effective_dtype(a), 1e-5)
+
+
+def get_tolerance(a, rtol=None, atol=None):
+    return get_rtol(a, rtol), get_atol(a, atol)
+
+
+get_tols = get_tolerance
+
+
+def default_dtype():
+    from .util import is_np_default_dtype
+    return onp.float64 if is_np_default_dtype() else onp.float32
+
+
+def default_numeric_eps():
+    return 1e-3
+
+
+def check_symbolic_forward(sym, inputs, expected, rtol=1e-4, atol=1e-5,
+                           aux_states=None, ctx=None):
+    """Bind a symbol, run forward, compare against expected arrays
+    (parity: test_utils.check_symbolic_forward).  Input dtypes are
+    preserved (int index arrays stay int; x64 stays x64)."""
+    args = sym.list_arguments()
+    auxs = sym.list_auxiliary_states()
+    kwargs = {}
+    ins = list(inputs)
+    for name in args:
+        kwargs[name] = NDArray(onp.asarray(_as_np(ins.pop(0))))
+    aux_vals = list(aux_states or [])
+    for name in auxs:
+        kwargs[name] = NDArray(onp.asarray(_as_np(aux_vals.pop(0))))
+    outs = sym.eval(**kwargs)
+    for o, e in zip(outs, expected if isinstance(expected, (list, tuple))
+                    else [expected]):
+        assert_almost_equal(o, e, rtol=rtol, atol=atol)
+    return outs
+
+
+def check_symbolic_backward(sym, inputs, out_grads, expected, rtol=1e-4,
+                            atol=1e-5, grad_req="write", ctx=None):
+    """Check a symbol's input gradients under the given head gradients
+    (parity: test_utils.check_symbolic_backward) — gradients come from
+    ``jax.vjp`` over the symbol's lowered function (the Executor's own
+    backward path)."""
+    import jax
+    import jax.numpy as jnp
+    args = sym.list_arguments()
+    auxs = sym.list_auxiliary_states()
+    if auxs:
+        raise MXNetError("check_symbolic_backward: symbols with aux "
+                         "states are not differentiable through this "
+                         "oracle; test via the gluon layer instead")
+    fn = sym._lower(args)
+    arrays = [jnp.asarray(onp.asarray(_as_np(x))) for x in inputs]
+    outs, vjp = jax.vjp(lambda arrs: fn(arrs), arrays)
+    ogs = out_grads if isinstance(out_grads, (list, tuple)) else [out_grads]
+    cot = [jnp.asarray(onp.asarray(_as_np(g))) for g in ogs]
+    (grads,) = vjp(type(outs)(cot) if isinstance(outs, (list, tuple))
+                   else cot[0])
+    exp = (expected if isinstance(expected, (list, tuple))
+           else [expected])
+    out_nd = []
+    for g, e in zip(grads, exp):
+        if e is not None:
+            assert_almost_equal(g, e, rtol=rtol, atol=atol)
+        out_nd.append(NDArray(g))
+    return out_nd
+
+
+def check_speed(fn, *args, n=20, warmup=2, **kwargs):
+    """Average wall time of fn over n runs (parity:
+    test_utils.check_speed)."""
+    import time
+    out = None
+    for _ in range(warmup):
+        out = fn(*args, **kwargs)
+    if hasattr(out, "wait_to_read"):
+        out.wait_to_read()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args, **kwargs)
+    if hasattr(out, "wait_to_read"):
+        out.wait_to_read()
+    return (time.perf_counter() - t0) / n
+
+
+def compare_ndarray_tuple(t1, t2, rtol=1e-5, atol=1e-6):
+    """Recursively compare (possibly nested) tuples of arrays (parity:
+    test_utils.compare_ndarray_tuple)."""
+    if t1 is None or t2 is None:
+        return
+    if isinstance(t1, (list, tuple)):
+        for a, b in zip(t1, t2):
+            compare_ndarray_tuple(a, b, rtol, atol)
+        return
+    assert_almost_equal(t1, t2, rtol=rtol, atol=atol)
+
+
+def compare_optimizer(opt1, opt2, shapes=((4, 5),), dtype="float32",
+                      rtol=1e-4, atol=1e-5, ntests=3):
+    """Two optimizers must produce identical updates on identical
+    weight/grad streams (parity: test_utils.compare_optimizer)."""
+    rng = onp.random.RandomState(0)
+    for shape in shapes:
+        w0 = rng.uniform(-1, 1, shape).astype(dtype)
+        w1, w2 = NDArray(w0.copy()), NDArray(w0.copy())
+        s1 = opt1.create_state(0, w1)
+        s2 = opt2.create_state(0, w2)
+        for _ in range(ntests):
+            g = rng.uniform(-1, 1, shape).astype(dtype)
+            opt1.update(0, w1, NDArray(g.copy()), s1)
+            opt2.update(0, w2, NDArray(g.copy()), s2)
+            compare_ndarray_tuple(tuple(s1), tuple(s2), rtol, atol)
+            assert_almost_equal(w1, w2, rtol=rtol, atol=atol)
+
+
+def create_vector(size, dtype="int64") -> NDArray:
+    """0..size-1 vector (parity: test_utils.create_vector — the
+    large-tensor test constructor)."""
+    return NDArray(onp.arange(size, dtype=dtype))
+
+
+def create_2d_tensor(rows, columns, dtype="int64") -> NDArray:
+    """Row-index-valued 2-D tensor (parity:
+    test_utils.create_2d_tensor)."""
+    return NDArray(onp.arange(rows, dtype=dtype)[:, None]
+                   * onp.ones((1, columns), dtype))
+
+
+def chi_square_check(generator, buckets, probs, nsamples=1_000_000):
+    """Chi-square goodness-of-fit of a sampler against expected bucket
+    probabilities (parity: test_utils.chi_square_check)."""
+    import scipy.stats as ss
+    samples = _as_np(generator(nsamples)).reshape(-1)
+    counts = onp.zeros(len(buckets))
+    for i, bk in enumerate(buckets):
+        if isinstance(bk, (tuple, list)):
+            counts[i] = ((samples >= bk[0]) & (samples < bk[1])).sum()
+        else:
+            counts[i] = (samples == bk).sum()
+    expected = onp.asarray(probs) * samples.size
+    stat, pval = ss.chisquare(counts, expected)
+    return stat, pval
+
+
+def gen_buckets_probs_with_ppf(ppf, nbuckets):
+    """Equal-probability buckets from a distribution's ppf (parity:
+    test_utils.gen_buckets_probs_with_ppf)."""
+    edges = [ppf(i / nbuckets) for i in range(nbuckets + 1)]
+    buckets = [(edges[i], edges[i + 1]) for i in range(nbuckets)]
+    probs = [1.0 / nbuckets] * nbuckets
+    return buckets, probs
+
+
+def discard_stderr():
+    """Context manager silencing stderr (parity:
+    test_utils.discard_stderr)."""
+    import contextlib
+    import io
+    return contextlib.redirect_stderr(io.StringIO())
+
+
+def download(url, fname=None, dirname=None, overwrite=False,
+             retries=5):
+    """This environment has no network egress (parity signature:
+    test_utils.download) — raises with guidance instead of hanging."""
+    raise MXNetError(
+        f"download({url!r}): no network egress in this environment; "
+        "place the file locally and pass its path instead")

@@ -76,11 +76,11 @@ class _NpScope:
 
 
 def np_array(active: bool = True) -> _NpScope:
-    return _NpScope(shape=_st().np_shape, array=active)
+    return _NpScope(shape=None, array=active)
 
 
 def np_shape(active: bool = True) -> _NpScope:
-    return _NpScope(shape=active, array=_st().np_array)
+    return _NpScope(shape=active, array=None)
 
 
 def use_np(func):

@@ -213,3 +213,71 @@ def test_x64_owners_independent():
     # reference-legal casting values accepted
     assert U.np_ufunc_legal_option("casting", "safe")
     assert U.np_ufunc_legal_option("order", "F")
+
+
+def test_test_utils_long_tail():
+    """test_utils parity long tail: symbolic fwd/bwd oracles, optimizer
+    comparator, tolerance helpers, chi-square sampler check."""
+    import numpy as onp
+    import scipy.stats as ss
+    from mxnet_tpu import test_utils as TU
+
+    x = sym.Variable("x")
+    y = sym.Variable("y")
+    z = x * y + x
+    a = onp.array([[1., 2.], [3., 4.]], onp.float32)
+    b = onp.array([[2., 2.], [2., 2.]], onp.float32)
+    TU.check_symbolic_forward(z, [a, b], [a * b + a])
+    TU.check_symbolic_backward(z, [a, b], [onp.ones_like(a)],
+                               [b + 1, a])
+
+    TU.compare_optimizer(
+        mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9),
+        mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9))
+
+    TU.assert_almost_equal_ignore_nan(onp.array([1., onp.nan]),
+                                      onp.array([1., 5.]))
+    TU.assert_almost_equal_with_err(onp.array([1., 1.5]),
+                                    onp.array([1., 1.0]), etol=0.6)
+    TU.assert_exception(lambda: 1 / 0, ZeroDivisionError)
+    assert TU.get_rtol(onp.float16(1)) == 1e-2
+    assert TU.create_2d_tensor(3, 4).asnumpy()[2, 1] == 2
+
+    buckets, probs = TU.gen_buckets_probs_with_ppf(ss.norm.ppf, 5)
+    _, pval = TU.chi_square_check(
+        lambda n: onp.random.RandomState(0).randn(n), buckets, probs,
+        nsamples=20000)
+    assert pval > 0.01
+
+    with pytest.raises(mx.MXNetError, match="egress"):
+        TU.download("http://example.com/x")
+
+
+def test_test_utils_fix_regressions():
+    """Regression guard for review findings: None tolerances, NaN-equal
+    with_err, warmup=0 speed, dtype preservation, stale scope snapshot."""
+    import numpy as onp
+    from mxnet_tpu import test_utils as TU
+    from mxnet_tpu import util as U
+
+    assert TU.get_rtol() == 1e-4 and TU.get_atol() == 1e-5
+    TU.assert_almost_equal_with_err(onp.array([onp.nan, 1.0]),
+                                    onp.array([onp.nan, 1.0]), etol=0.0)
+    assert TU.check_speed(lambda: 1, warmup=0, n=2) >= 0
+
+    # integer inputs keep their dtype through the symbolic oracle
+    e = sym.Variable("emb")
+    idx = sym.Variable("idx")
+    take = sym.take(e, idx)
+    emb = onp.arange(6, dtype=onp.float32).reshape(3, 2)
+    ids = onp.array([2, 0], onp.int32)
+    TU.check_symbolic_forward(take, [emb, ids], [emb[[2, 0]]])
+
+    # scope construction must not snapshot the other flag
+    scope = U.np_array(True)
+    prev = U.set_np_shape(False)
+    try:
+        with scope:
+            assert U.is_np_shape() is False   # not reverted by scope
+    finally:
+        U.set_np_shape(prev)
