@@ -42,7 +42,8 @@ class SPMDTrainer:
                  optimizer_params: Optional[dict] = None,
                  mesh: Optional[Mesh] = None, batch_axis: int = 0,
                  donate: bool = True, dtype: Optional[str] = None,
-                 remat: bool = False, seq_axis: Optional[int] = None):
+                 remat: bool = False, seq_axis: Optional[int] = None,
+                 micro_batches: int = 1):
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
@@ -57,6 +58,15 @@ class SPMDTrainer:
         # big-batch training; the reference has no equivalent because
         # its engine frees activations eagerly per-op)
         self.remat = bool(remat)
+        # gradient accumulation: split each step's batch into k
+        # micro-batches scanned sequentially, averaging gradients —
+        # activations live for one micro-batch at a time (the HBM lever
+        # for big effective batches; composes with remat).  BatchNorm
+        # batch statistics are per-micro-batch, like any accumulation
+        # scheme's.
+        if micro_batches < 1:
+            raise MXNetError("micro_batches must be >= 1")
+        self.micro_batches = int(micro_batches)
         # mixed precision (parity: AMP bf16 — master weights stay f32,
         # forward/backward compute in bf16 on the MXU; bf16 needs no loss
         # scaling on TPU, SURVEY.md §7 stage 7)
@@ -83,7 +93,7 @@ class SPMDTrainer:
 
     def _batch_sharding(self, ndim):
         spec = [None] * ndim
-        if "dp" in self.mesh.axis_names:
+        if "dp" in self.mesh.axis_names and self.batch_axis < ndim:
             spec[self.batch_axis] = "dp"
         if (self.seq_axis is not None and "sp" in self.mesh.axis_names
                 and self.seq_axis < ndim
@@ -133,8 +143,55 @@ class SPMDTrainer:
 
             grad_target = (jax.checkpoint(loss_of) if self.remat
                            else loss_of)
-            (loss_val, aux), grads = jax.value_and_grad(
-                grad_target, has_aux=True)(list(p_arrays))
+            n_micro = self.micro_batches
+            if n_micro == 1:
+                (loss_val, aux), grads = jax.value_and_grad(
+                    grad_target, has_aux=True)(list(p_arrays))
+            else:
+                saved_batch = (data, label)
+                ba = self.batch_axis
+
+                def split_mb(x):
+                    # arrays of lower rank (e.g. (B,) labels beside
+                    # time-major (T, B, F) data) batch on axis 0
+                    ax = ba if ba < x.ndim else 0
+                    if x.shape[ax] % n_micro:
+                        raise MXNetError(
+                            f"batch {x.shape[ax]} (axis {ax}) not "
+                            f"divisible by micro_batches={n_micro}")
+                    # micro chunks along the batch axis, scan dim in
+                    # front
+                    moved = jnp.moveaxis(x, ax, 0)
+                    moved = moved.reshape(
+                        (n_micro, moved.shape[0] // n_micro)
+                        + moved.shape[1:])
+                    return jnp.moveaxis(moved, 1, ax + 1)
+
+                dmb = split_mb(data)
+                lmb = split_mb(label)
+
+                def micro(acc, mb):
+                    d, l = mb
+                    # rebind the closed-over batch for this micro-step
+                    nonlocal data, label
+                    data, label = d, l
+                    (lv, aux), g = jax.value_and_grad(
+                        grad_target, has_aux=True)(list(p_arrays))
+                    acc = [a + gi for a, gi in zip(acc, g)]
+                    return acc, (lv, aux)
+
+                zero = [jnp.zeros(a.shape,
+                                  a.dtype if jnp.issubdtype(
+                                      a.dtype, jnp.floating)
+                                  else jnp.float32)
+                        for a in p_arrays]
+                gsum, (losses, aux_stack) = jax.lax.scan(
+                    micro, zero, (dmb, lmb))
+                grads = [g / n_micro for g in gsum]
+                loss_val = losses.mean()
+                # BN-style aux keeps the LAST micro-batch's update
+                aux = jax.tree_util.tree_map(lambda x: x[-1], aux_stack)
+                data, label = saved_batch
 
             new_params, new_state = [], []
             for k, w, g, st in zip(pkeys, p_arrays, grads, opt_state):

@@ -15,9 +15,18 @@ from .base import MXNetError
 from .ndarray import NDArray
 from . import autograd
 
-__all__ = ["default_context", "assert_almost_equal", "almost_equal",
-           "check_numeric_gradient", "check_consistency", "rand_ndarray",
-           "same", "rand_shape_nd"]
+__all__ = ["default_context", "same", "almost_equal",
+           "assert_almost_equal", "assert_allclose",
+           "assert_almost_equal_ignore_nan", "assert_almost_equal_with_err",
+           "assert_exception", "rand_ndarray", "rand_shape_nd",
+           "check_numeric_gradient", "check_consistency",
+           "check_symbolic_forward", "check_symbolic_backward",
+           "check_speed", "compare_ndarray_tuple", "compare_optimizer",
+           "create_vector", "create_2d_tensor", "chi_square_check",
+           "gen_buckets_probs_with_ppf", "discard_stderr", "download",
+           "effective_dtype", "default_rtols", "default_atols",
+           "get_rtol", "get_atol", "get_tolerance", "get_tols",
+           "default_dtype", "default_numeric_eps"]
 
 
 def default_context() -> Context:

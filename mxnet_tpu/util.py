@@ -71,7 +71,11 @@ class _NpScope:
 
     def __exit__(self, *exc):
         st = _st()
-        st.np_shape, st.np_array = self._old
+        # restore only the flags this scope actually set
+        if self._shape is not None:
+            st.np_shape = self._old[0]
+        if self._array is not None:
+            st.np_array = self._old[1]
         return False
 
 
