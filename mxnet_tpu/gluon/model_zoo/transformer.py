@@ -98,11 +98,13 @@ class TransformerBlock(HybridBlock):
     """Pre-LN transformer block: LN→MHA→residual, LN→FFN(GELU)→residual."""
 
     def __init__(self, units, num_heads, ffn_ratio=4, causal=True,
-                 dropout=0.0, use_flash=True, **kwargs):
+                 dropout=0.0, use_flash=True, num_kv_heads=None,
+                 **kwargs):
         super().__init__(**kwargs)
         self.ln1 = nn.LayerNorm()
         self.attn = MultiHeadAttention(units, num_heads, causal=causal,
-                                       use_flash=use_flash)
+                                       use_flash=use_flash,
+                                       num_kv_heads=num_kv_heads)
         self.ln2 = nn.LayerNorm()
         self.ffn1 = nn.Dense(ffn_ratio * units, flatten=False)
         self.act = nn.GELU()
@@ -129,7 +131,7 @@ class TransformerLM(HybridBlock):
 
     def __init__(self, vocab_size, units=256, num_layers=4, num_heads=4,
                  max_len=1024, ffn_ratio=4, dropout=0.0, tie_weights=False,
-                 use_flash=True, **kwargs):
+                 use_flash=True, num_kv_heads=None, **kwargs):
         super().__init__(**kwargs)
         self._max_len = max_len
         from ... import initializer
@@ -142,7 +144,8 @@ class TransformerLM(HybridBlock):
             self.blocks.add(TransformerBlock(units, num_heads,
                                              ffn_ratio=ffn_ratio,
                                              causal=True, dropout=dropout,
-                                             use_flash=use_flash))
+                                             use_flash=use_flash,
+                                             num_kv_heads=num_kv_heads))
         self.ln_f = nn.LayerNorm()
         self._tied = tie_weights
         if not tie_weights:
@@ -356,12 +359,12 @@ def get_vit(image_size=224, patch_size=16, classes=1000, **kwargs):
 
 def _extract_lm_weights(net):
     """Pull the TransformerLM parameters into a flat pytree for the
-    cached-decode path (standard MHA blocks only)."""
+    cached-decode path (standard and GQA/MQA MHA blocks; ring-mesh
+    blocks decode like plain ones — sequence parallelism is a training
+    concern)."""
     blocks = []
     for blk in net.blocks._children.values():
         att = blk.attn
-        if att._kv_heads is not None or att._ring_mesh is not None:
-            raise MXNetError("cached decode supports standard MHA blocks")
         blocks.append(dict(
             ln1=(blk.ln1.gamma.data()._data, blk.ln1.beta.data()._data),
             qkv=(att.qkv.weight.data()._data, att.qkv.bias.data()._data),
@@ -400,6 +403,8 @@ def generate_cached(net, prompt, max_new_tokens, *, temperature=1.0,
     w = _extract_lm_weights(net)
     heads_per_block = [blk.attn._heads
                        for blk in net.blocks._children.values()]
+    kv_heads_per_block = [blk.attn._kv_heads or blk.attn._heads
+                          for blk in net.blocks._children.values()]
     key0 = _decode_key(seed)
     greedy = temperature == 0 or top_k == 1
 
@@ -411,10 +416,11 @@ def generate_cached(net, prompt, max_new_tokens, *, temperature=1.0,
     def decode(w, buf, key):
         E = w["embed"].shape[1]
         caches = []
-        for H in heads_per_block:
+        for H, HKV in zip(heads_per_block, kv_heads_per_block):
             hd = E // H
-            caches.append((jnp.zeros((B, H, L, hd), jnp.float32),
-                           jnp.zeros((B, H, L, hd), jnp.float32)))
+            # GQA: the cache stores only the hkv shared heads
+            caches.append((jnp.zeros((B, HKV, L, hd), jnp.float32),
+                           jnp.zeros((B, HKV, L, hd), jnp.float32)))
 
         def body(carry, t):
             buf, caches, key = carry
@@ -422,26 +428,34 @@ def generate_cached(net, prompt, max_new_tokens, *, temperature=1.0,
             x = w["embed"][tok[:, 0]][:, None, :] \
                 + lax.dynamic_slice_in_dim(w["pos"], t, 1, 0)[None]
             new_caches = []
-            for blk, H, (ck, cv) in zip(w["blocks"], heads_per_block,
-                                        caches):
+            for blk, H, HKV, (ck, cv) in zip(w["blocks"],
+                                             heads_per_block,
+                                             kv_heads_per_block, caches):
                 hd = E // H
+                kvu = hd * HKV
                 h = ln(x, *blk["ln1"])
                 qkv = h @ blk["qkv"][0].T + blk["qkv"][1]
-                q, k, v = jnp.split(qkv, 3, axis=-1)
+                q = qkv[..., :E]
+                k = qkv[..., E:E + kvu]
+                v = qkv[..., E + kvu:E + 2 * kvu]
 
-                def sh(z):
-                    return jnp.transpose(z.reshape(B, 1, H, hd),
+                def sh(z, heads):
+                    return jnp.transpose(z.reshape(B, 1, heads, hd),
                                          (0, 2, 1, 3))
-                qh, kh, vh = sh(q), sh(k), sh(v)
+                qh, kh, vh = sh(q, H), sh(k, HKV), sh(v, HKV)
                 ck = lax.dynamic_update_slice(ck, kh, (0, 0, t, 0))
                 cv = lax.dynamic_update_slice(cv, vh, (0, 0, t, 0))
-                scores = jnp.einsum("bhqd,bhkd->bhqk", qh, ck) \
+                cke, cve = ck, cv
+                if HKV != H:
+                    cke = jnp.repeat(ck, H // HKV, axis=1)
+                    cve = jnp.repeat(cv, H // HKV, axis=1)
+                scores = jnp.einsum("bhqd,bhkd->bhqk", qh, cke) \
                     / jnp.sqrt(jnp.float32(hd))
                 pos = jnp.arange(L)
                 scores = jnp.where(pos[None, None, None, :] <= t,
                                    scores, -1e30)
                 attn = jax.nn.softmax(scores, axis=-1)
-                ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, cv)
+                ctx = jnp.einsum("bhqk,bhkd->bhqd", attn, cve)
                 ctx = jnp.transpose(ctx, (0, 2, 1, 3)).reshape(B, 1, E)
                 x = x + (ctx @ blk["out"][0].T + blk["out"][1])
                 h = ln(x, *blk["ln2"])

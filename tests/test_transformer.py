@@ -345,3 +345,20 @@ def test_generate_seeded_sampling_cached_matches_uncached():
     b = net.generate_cached(prompt, 6, temperature=1.0, top_k=8,
                             seed=42).asnumpy()
     onp.testing.assert_array_equal(a, b)
+
+
+def test_generate_cached_gqa():
+    """Cached decode through GQA blocks: matches the full re-forward
+    decode exactly (cache stores only hkv shared heads)."""
+    from mxnet_tpu.gluon.model_zoo.transformer import get_transformer_lm
+    from mxnet_tpu.ndarray import NDArray
+
+    mx.random.seed(2)
+    net = get_transformer_lm(50, units=32, num_layers=2, num_heads=4,
+                             num_kv_heads=2, max_len=24, use_flash=False)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 4), onp.int32)))
+    prompt = onp.array([[5, 9, 2]], onp.int32)
+    a = net.generate(prompt, 6, temperature=0).asnumpy()
+    b = net.generate_cached(prompt, 6, temperature=0).asnumpy()
+    onp.testing.assert_array_equal(a, b)
