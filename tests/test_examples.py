@@ -84,3 +84,11 @@ def test_quantization_example(monkeypatch, capsys):
     assert "top-1 agreement" in out
     agree = float(out.split("agreement ")[1].rstrip("%\n")) / 100
     assert agree >= 0.7
+
+
+def test_multi_axis_example():
+    m = _load("parallel/multi_axis.py", "multi_axis_example")
+    m.dp_tp_training()
+    m.gpipe()
+    m.ring_sp()
+    m.moe_ep()
