@@ -362,3 +362,36 @@ def test_micro_batch_respects_batch_axis():
     lb = tb.step(data, label)
     onp.testing.assert_allclose(la.asnumpy(), lb.asnumpy(), rtol=1e-5,
                                 atol=1e-6)
+
+
+def test_run_steps_composes_with_micro_batches():
+    """Fused multi-step windows and gradient accumulation compose:
+    run_steps over a micro_batches trainer matches the plain one."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    def build():
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+        net.initialize(init=mx.initializer.Xavier())
+        net(NDArray(onp.zeros((1, 4), onp.float32)))
+        return net
+
+    rng = onp.random.RandomState(0)
+    data = rng.randn(8, 4).astype("float32")
+    label = rng.randint(0, 3, size=(8,)).astype("float32")
+    kw = dict(optimizer="sgd", optimizer_params={"learning_rate": 0.1},
+              mesh=make_mesh({"dp": 2}))
+    mx.random.seed(0)
+    a = build()
+    mx.random.seed(0)
+    b = build()
+    ta = SPMDTrainer(a, gloss.SoftmaxCrossEntropyLoss(), **kw)
+    tb = SPMDTrainer(b, gloss.SoftmaxCrossEntropyLoss(),
+                     micro_batches=2, **kw)
+    la = ta.run_steps(data, label, 3).asnumpy()
+    lb = tb.run_steps(data, label, 3).asnumpy()
+    onp.testing.assert_allclose(la, lb, rtol=1e-5, atol=1e-6)
