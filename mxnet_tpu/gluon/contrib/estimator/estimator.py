@@ -54,7 +54,8 @@ class Estimator:
         handlers.append(MetricHandler(self.train_metrics))
         if not any(isinstance(h, GradientUpdateHandler) for h in handlers):
             handlers.append(GradientUpdateHandler())
-        # highest priority first at batch end (update before metrics)
+        # lowest priority value runs first (reference convention:
+        # GradientUpdateHandler -2000 runs before MetricHandler -1000)
         handlers.sort(key=lambda h: getattr(h, "priority", 0))
         train_begin = [h for h in handlers if isinstance(h, TrainBegin)]
         epoch_begin = [h for h in handlers if isinstance(h, EpochBegin)]

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import multiprocessing
 import os
+import threading
 import weakref
 from typing import Any, Callable, Optional
 
@@ -277,20 +278,44 @@ class DataLoader:
             # the caller's early exit on in-flight batches.
             if not self._use_shm:
                 pending = []
-            for fut in pending:
-                try:
-                    result = fut.get(self._timeout)
-                except Exception:
-                    continue
-                if (isinstance(result, tuple) and len(result) == 4
-                        and result[0] == "__shm__" and result[1]):
+            if pending:
+                # Drain synchronously with a short per-future timeout so
+                # a plain `break` returns promptly (bounded by
+                # ~0.5s x prefetch, not timeout x prefetch) while still
+                # unlinking segments before pool teardown can race us.
+                # Stragglers get a best-effort daemon-thread drain.
+                def _unlink(result):
+                    if (isinstance(result, tuple) and len(result) == 4
+                            and result[0] == "__shm__" and result[1]):
+                        try:
+                            from multiprocessing import shared_memory
+                            seg = shared_memory.SharedMemory(
+                                name=result[1])
+                            seg.close()
+                            seg.unlink()
+                        except Exception:
+                            pass
+
+                stragglers = []
+                for fut in pending:
                     try:
-                        from multiprocessing import shared_memory
-                        seg = shared_memory.SharedMemory(name=result[1])
-                        seg.close()
-                        seg.unlink()
+                        _unlink(fut.get(0.5))
+                    except multiprocessing.TimeoutError:
+                        stragglers.append(fut)
                     except Exception:
                         pass
+                if stragglers:
+                    timeout = self._timeout
+
+                    def _drain_stragglers():
+                        for fut in stragglers:
+                            try:
+                                _unlink(fut.get(timeout))
+                            except Exception:
+                                pass
+
+                    threading.Thread(target=_drain_stragglers,
+                                     daemon=True).start()
 
     def __len__(self):
         return len(self._batch_sampler)

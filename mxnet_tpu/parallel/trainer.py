@@ -435,31 +435,50 @@ class SPMDTrainer:
         """Checkpoint optimizer state + step counter (parity: Trainer
         .save_states / kvstore get_states).  Sharded state is gathered
         to host — on a multi-host mesh call on every process; rank 0's
-        file is authoritative (identical contents by construction)."""
-        import pickle
-        blob = {
-            "num_update": self.num_update,
-            "opt_state": {k: tuple(onp.asarray(jax.device_get(s))
-                                   for s in st)
-                          for k, st in self._opt_state.items()},
-        }
+        file is authoritative (identical contents by construction).
+
+        Format: numpy .npz with a JSON header under ``__header__`` and
+        one entry per state slot named ``<param>::<slot>`` — no pickle,
+        so untrusted checkpoints cannot execute code on load."""
+        import json
+        arrays = {}
+        slots = {}
+        for k, st in self._opt_state.items():
+            slots[k] = len(st)
+            for i, s in enumerate(st):
+                arrays[f"{k}::{i}"] = onp.asarray(jax.device_get(s))
+        header = json.dumps({"format": "mxnet_tpu-trainer-states-v1",
+                             "num_update": self.num_update,
+                             "slots": slots})
+        arrays["__header__"] = onp.frombuffer(
+            header.encode("utf-8"), dtype=onp.uint8)
         with open(fname, "wb") as f:
-            pickle.dump(blob, f)
+            onp.savez(f, **arrays)
 
     def load_states(self, fname):
         """Restore optimizer state saved by :meth:`save_states`; arrays
-        are re-placed under each parameter's declared sharding."""
-        import pickle
-        with open(fname, "rb") as f:
-            blob = pickle.load(f)
-        self.num_update = int(blob["num_update"])
-        self.optimizer.num_update = self.num_update
-        for k, st in blob["opt_state"].items():
-            if k not in self._opt_state:
-                raise MXNetError(f"unknown optimizer-state key {k!r}")
-            shd = self._param_sharding(self._params[k])
-            self._opt_state[k] = tuple(
-                jax.device_put(jnp.asarray(s), shd) for s in st)
+        are re-placed under each parameter's declared sharding.  Only
+        the .npz format written by :meth:`save_states` is accepted
+        (``allow_pickle=False`` — loading never executes code)."""
+        import json
+        with onp.load(fname, allow_pickle=False) as z:
+            if "__header__" not in z:
+                raise MXNetError(
+                    f"{fname}: not a mxnet_tpu trainer-states file")
+            header = json.loads(bytes(z["__header__"]).decode("utf-8"))
+            if header.get("format") != "mxnet_tpu-trainer-states-v1":
+                raise MXNetError(
+                    f"{fname}: unknown trainer-states format "
+                    f"{header.get('format')!r}")
+            self.num_update = int(header["num_update"])
+            self.optimizer.num_update = self.num_update
+            for k, n in header["slots"].items():
+                if k not in self._opt_state:
+                    raise MXNetError(f"unknown optimizer-state key {k!r}")
+                shd = self._param_sharding(self._params[k])
+                self._opt_state[k] = tuple(
+                    jax.device_put(jnp.asarray(z[f"{k}::{i}"]), shd)
+                    for i in range(int(n)))
 
     def fit(self, data_iter, epochs=1, verbose=False):
         losses = []

@@ -487,10 +487,21 @@ def _probe_num_outputs(op) -> int:
     return 1  # multi-out ops report 1 head; outputs split lazily on index
 
 
+# kwargs the reference's sym.var() accepts directly and stringifies into
+# __dunder__ attrs (parity: python/mxnet/symbol/symbol.py var())
+_VAR_KNOWN_KWARGS = ("lr_mult", "wd_mult", "init", "stype",
+                     "profiler_scope")
+
+
 def Variable(name: str, shape=None, dtype=None, attrs=None,
              **kwargs) -> Symbol:
     merged = dict(attrs or {})
-    merged.update(kwargs)
+    for k, v in kwargs.items():
+        if k in _VAR_KNOWN_KWARGS or (k.startswith("__") and k.endswith("__")):
+            key = k if k.startswith("__") else f"__{k}__"
+            merged[key] = v if isinstance(v, str) else str(v)
+        else:
+            merged[k] = v
     for k, v in merged.items():
         if not isinstance(v, str):
             raise ValueError(
