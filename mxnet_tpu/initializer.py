@@ -86,6 +86,13 @@ class Initializer:
             return arr
         raise MXNetError("Initializer.__call__ expects (name, NDArray)")
 
+    def dumps(self) -> str:
+        """JSON form ``'["name", {kwargs}]'`` (parity: reference
+        Initializer.dumps, python/mxnet/initializer.py) — the format
+        stored in ``__init__`` attrs and parsed back by ``create``."""
+        import json
+        return json.dumps([type(self).__name__.lower(), self._kwargs])
+
     def __repr__(self):
         return f"{type(self).__name__}({self._kwargs})"
 

@@ -443,13 +443,21 @@ class SPMDTrainer:
         import json
         arrays = {}
         slots = {}
+        dtypes = {}
         for k, st in self._opt_state.items():
             slots[k] = len(st)
+            dtypes[k] = []
             for i, s in enumerate(st):
-                arrays[f"{k}::{i}"] = onp.asarray(jax.device_get(s))
+                d = onp.asarray(jax.device_get(s))
+                dtypes[k].append(str(d.dtype))
+                if d.dtype.kind not in "biufc":
+                    # ml_dtypes (bfloat16, fp8) save as raw void in npz;
+                    # store the bit pattern as uint of the same width
+                    d = d.view(onp.dtype(f"u{d.dtype.itemsize}"))
+                arrays[f"{k}::{i}"] = d
         header = json.dumps({"format": "mxnet_tpu-trainer-states-v1",
                              "num_update": self.num_update,
-                             "slots": slots})
+                             "slots": slots, "dtypes": dtypes})
         arrays["__header__"] = onp.frombuffer(
             header.encode("utf-8"), dtype=onp.uint8)
         with open(fname, "wb") as f:
@@ -472,12 +480,22 @@ class SPMDTrainer:
                     f"{header.get('format')!r}")
             self.num_update = int(header["num_update"])
             self.optimizer.num_update = self.num_update
+            dtypes = header.get("dtypes", {})
+
+            def _restore(k, i):
+                raw = z[f"{k}::{i}"]
+                want = dtypes.get(k, [None] * 99)[i]
+                if want is not None and str(raw.dtype) != want:
+                    import ml_dtypes  # noqa: F401 (registers dtype names)
+                    raw = raw.view(onp.dtype(want))
+                return raw
+
             for k, n in header["slots"].items():
                 if k not in self._opt_state:
                     raise MXNetError(f"unknown optimizer-state key {k!r}")
                 shd = self._param_sharding(self._params[k])
                 self._opt_state[k] = tuple(
-                    jax.device_put(jnp.asarray(z[f"{k}::{i}"]), shd)
+                    jax.device_put(jnp.asarray(_restore(k, i)), shd)
                     for i in range(int(n)))
 
     def fit(self, data_iter, epochs=1, verbose=False):

@@ -499,7 +499,10 @@ def Variable(name: str, shape=None, dtype=None, attrs=None,
     for k, v in kwargs.items():
         if k in _VAR_KNOWN_KWARGS or (k.startswith("__") and k.endswith("__")):
             key = k if k.startswith("__") else f"__{k}__"
-            merged[key] = v if isinstance(v, str) else str(v)
+            if hasattr(v, "dumps"):  # Initializer → its JSON form
+                merged[key] = v.dumps()
+            else:
+                merged[key] = v if isinstance(v, str) else str(v)
         else:
             merged[k] = v
     for k, v in merged.items():
