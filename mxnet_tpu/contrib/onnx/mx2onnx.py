@@ -7,9 +7,11 @@ The TPU build's Symbol graph is a DAG of registry-op nodes
 per-op translation table; serialization rides the protoc-generated
 subset schema in onnx_pb2.py (field numbers per the public ONNX spec).
 
-Opset 12 is declared: axes stay attributes on Reduce*, keeping the
-emitted graphs self-inverse with onnx2mx.py and readable by standard
-runtimes.
+Opset 12 is the default; ``opset_version=13`` moves ReduceSum /
+Squeeze / Unsqueeze axes into inputs per the spec.  Export-time shape
+inference (jax.eval_shape over the same registry lowerings that
+execute the graph) powers the translators that need ranks or static
+shapes (SwapAxis, Crop, zeros_like, multi_head_attention, ...).
 """
 from __future__ import annotations
 
@@ -47,10 +49,49 @@ def _tup(v, n=2):
 class _Ctx:
     """Accumulates the graph being built; helpers for the translators."""
 
-    def __init__(self, graph: P.GraphProto, dtype):
+    def __init__(self, graph: P.GraphProto, dtype, opset: int = _OPSET,
+                 params: Optional[Dict] = None,
+                 shapes: Optional[Dict] = None):
         self.graph = graph
         self.dtype = onp.dtype(dtype)
+        self.opset = opset
+        self.params = params or {}     # var name → numpy value
+        self.shapes = shapes or {}     # node name → primary output shape
         self._const_n = 0
+
+    def shape_of(self, name: str):
+        s = self.shapes.get(name)
+        if s is None:
+            raise MXNetError(
+                f"onnx export: shape of {name!r} could not be inferred "
+                "(required by this op's translation)")
+        return s
+
+    def tmp(self, hint="t"):
+        self._const_n += 1
+        return f"__{hint}_{self._const_n}"
+
+    def reduce_axes(self, op_type, ins, out, name, axes, keepdims):
+        """Emit a Reduce* node, honoring the opset-13 move of
+        ReduceSum's axes into an input."""
+        attrs = {"keepdims": int(bool(keepdims))}
+        if axes is None:
+            self.add_node(op_type, ins, [out], name=name, **attrs)
+        elif self.opset >= 13 and op_type == "ReduceSum":
+            ax = self.const(list(axes), onp.int64, "axes")
+            self.add_node(op_type, [ins[0], ax], [out], name=name, **attrs)
+        else:
+            self.add_node(op_type, ins, [out], name=name,
+                          axes=tuple(axes), **attrs)
+
+    def sqz(self, op_type, ins, out, name, axes):
+        """Squeeze/Unsqueeze with axes as attr (≤12) or input (13+)."""
+        if self.opset >= 13:
+            ax = self.const(list(axes), onp.int64, "axes")
+            self.add_node(op_type, [ins[0], ax], [out], name=name)
+        else:
+            self.add_node(op_type, ins, [out], name=name,
+                          axes=tuple(axes))
 
     def add_node(self, op_type: str, inputs: Sequence[str],
                  outputs: Sequence[str], name: str = "", **attrs):
@@ -75,6 +116,9 @@ class _Ctx:
                 if v and isinstance(v[0], float):
                     a.type = P.AttributeProto.FLOATS
                     a.floats.extend(v)
+                elif v and isinstance(v[0], str):
+                    a.type = P.AttributeProto.STRINGS
+                    a.strings.extend(s.encode() for s in v)
                 else:
                     a.type = P.AttributeProto.INTS
                     a.ints.extend(int(x) for x in v)
@@ -165,21 +209,6 @@ def _act(ctx, node, ins, out):
     if op is None:
         raise MXNetError(f"onnx export: Activation act_type={act}")
     ctx.add_node(op, ins, [out], name=node.name)
-
-
-@register("LeakyReLU")
-def _leaky(ctx, node, ins, out):
-    act = node.params.get("act_type", "leaky")
-    if act == "leaky":
-        ctx.add_node("LeakyRelu", ins, [out], name=node.name,
-                     alpha=float(node.params.get("slope", 0.25)))
-    elif act == "elu":
-        ctx.add_node("Elu", ins, [out], name=node.name,
-                     alpha=float(node.params.get("slope", 0.25)))
-    elif act == "prelu":
-        ctx.add_node("PRelu", ins, [out], name=node.name)
-    else:
-        raise MXNetError(f"onnx export: LeakyReLU act_type={act}")
 
 
 @register("Pooling", "pooling")
@@ -302,7 +331,12 @@ _SCALAR = {"_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
            "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True)}
 for _mx, (_ox, _rev) in _SCALAR.items():
     def _sc(ctx, node, ins, out, _ox=_ox, _rev=_rev):
-        c = ctx.const(node.params["scalar"], name_hint="scalar")
+        # symbol graphs carry the scalar as a param; traced (deferred
+        # compute) graphs carry it as a second const input
+        if "scalar" in node.params:
+            c = ctx.const(node.params["scalar"], name_hint="scalar")
+        else:
+            c = ins[1]
         args = [c, ins[0]] if _rev else [ins[0], c]
         ctx.add_node(_ox, args, [out], name=node.name)
     _TRANSLATORS[_mx] = _sc
@@ -325,34 +359,775 @@ _REDUCE = {"mean": "ReduceMean", "sum": "ReduceSum", "max": "ReduceMax",
 for _mx, _ox in _REDUCE.items():
     def _red(ctx, node, ins, out, _ox=_ox):
         p = node.params
-        attrs = {"keepdims": int(bool(p.get("keepdims", False)))}
         ax = p.get("axis")
         if ax is not None:
-            attrs["axes"] = (ax,) if isinstance(ax, int) else tuple(ax)
-        ctx.add_node(_ox, ins, [out], name=node.name, **attrs)
+            ax = (ax,) if isinstance(ax, int) else tuple(ax)
+        ctx.reduce_axes(_ox, ins, out, node.name, ax,
+                        p.get("keepdims", False))
     _TRANSLATORS[_mx] = _red
+
+
+# -- trig / further unaries -------------------------------------------------
+
+_UNARY2 = {"sin": "Sin", "cos": "Cos", "tan": "Tan", "arcsin": "Asin",
+           "arccos": "Acos", "arctan": "Atan", "sinh": "Sinh",
+           "cosh": "Cosh", "arcsinh": "Asinh", "arccosh": "Acosh",
+           "arctanh": "Atanh", "round": "Round", "rint": "Round"}
+for _mx, _ox in _UNARY2.items():
+    def _un2(ctx, node, ins, out, _ox=_ox):
+        ctx.add_node(_ox, ins, [out], name=node.name)
+    _TRANSLATORS[_mx] = _un2
+
+
+@register("square")
+def _square(ctx, node, ins, out):
+    ctx.add_node("Mul", [ins[0], ins[0]], [out], name=node.name)
+
+
+@register("rsqrt")
+def _rsqrt(ctx, node, ins, out):
+    t = ctx.tmp("sqrt")
+    ctx.add_node("Sqrt", ins, [t])
+    ctx.add_node("Reciprocal", [t], [out], name=node.name)
+
+
+@register("log1p")
+def _log1p(ctx, node, ins, out):
+    one = ctx.const(1.0, name_hint="one")
+    t = ctx.tmp("add1")
+    ctx.add_node("Add", [ins[0], one], [t])
+    ctx.add_node("Log", [t], [out], name=node.name)
+
+
+@register("expm1")
+def _expm1(ctx, node, ins, out):
+    one = ctx.const(1.0, name_hint="one")
+    t = ctx.tmp("exp")
+    ctx.add_node("Exp", ins, [t])
+    ctx.add_node("Sub", [t, one], [out], name=node.name)
+
+
+@register("hard_sigmoid")
+def _hard_sigmoid(ctx, node, ins, out):
+    ctx.add_node("HardSigmoid", ins, [out], name=node.name,
+                 alpha=float(node.params.get("alpha", 0.2)),
+                 beta=float(node.params.get("beta", 0.5)))
+
+
+def _gelu_erf(ctx, x_name, out, name):
+    """0.5 · x · (1 + erf(x / √2)) (parity: mx2onnx convert_gelu)."""
+    inv_sqrt2 = ctx.const(1.0 / onp.sqrt(2.0), name_hint="invsqrt2")
+    half = ctx.const(0.5, name_hint="half")
+    one = ctx.const(1.0, name_hint="one")
+    t1, t2, t3, t4 = (ctx.tmp("gelu") for _ in range(4))
+    ctx.add_node("Mul", [x_name, inv_sqrt2], [t1])
+    ctx.add_node("Erf", [t1], [t2])
+    ctx.add_node("Add", [t2, one], [t3])
+    ctx.add_node("Mul", [x_name, t3], [t4])
+    ctx.add_node("Mul", [t4, half], [out], name=name)
+
+
+# extend the LeakyReLU family with gelu/selu via re-registration
+@register("LeakyReLU")
+def _leaky2(ctx, node, ins, out):
+    act = node.params.get("act_type", "leaky")
+    if act == "leaky":
+        ctx.add_node("LeakyRelu", ins, [out], name=node.name,
+                     alpha=float(node.params.get("slope", 0.25)))
+    elif act == "elu":
+        ctx.add_node("Elu", ins, [out], name=node.name,
+                     alpha=float(node.params.get("slope", 0.25)))
+    elif act == "prelu":
+        ctx.add_node("PRelu", ins, [out], name=node.name)
+    elif act == "selu":
+        ctx.add_node("Selu", ins, [out], name=node.name)
+    elif act == "gelu":
+        _gelu_erf(ctx, ins[0], out, node.name)
+    else:
+        raise MXNetError(f"onnx export: LeakyReLU act_type={act}")
+
+
+# -- comparisons / logical (mx float semantics ↔ onnx bool ops) -------------
+
+def _cmp_out_cast(ctx, bool_name, out, name):
+    ctx.add_node("Cast", [bool_name], [out], name=name,
+                 to=int(_DTYPE2ONNX[ctx.dtype]))
+
+
+_CMP = {"broadcast_equal": "Equal", "broadcast_greater": "Greater",
+        "broadcast_lesser": "Less",
+        "broadcast_greater_equal": "GreaterOrEqual",
+        "broadcast_lesser_equal": "LessOrEqual"}
+for _mx, _ox in _CMP.items():
+    def _cmp(ctx, node, ins, out, _ox=_ox):
+        b = ctx.tmp("cmp")
+        ctx.add_node(_ox, ins, [b])
+        _cmp_out_cast(ctx, b, out, node.name)
+    _TRANSLATORS[_mx] = _cmp
+
+
+@register("broadcast_not_equal")
+def _neq(ctx, node, ins, out):
+    b, n = ctx.tmp("eq"), ctx.tmp("not")
+    ctx.add_node("Equal", ins, [b])
+    ctx.add_node("Not", [b], [n])
+    _cmp_out_cast(ctx, n, out, node.name)
+
+
+_LOGICAL = {"logical_and": "And", "logical_or": "Or",
+            "logical_xor": "Xor", "broadcast_logical_and": "And",
+            "broadcast_logical_or": "Or", "broadcast_logical_xor": "Xor"}
+for _mx, _ox in _LOGICAL.items():
+    def _logi(ctx, node, ins, out, _ox=_ox):
+        bs = []
+        for i in ins:
+            b = ctx.tmp("b")
+            ctx.add_node("Cast", [i], [b], to=int(P.TensorProto.BOOL))
+            bs.append(b)
+        r = ctx.tmp("l")
+        ctx.add_node(_ox, bs, [r])
+        _cmp_out_cast(ctx, r, out, node.name)
+    _TRANSLATORS[_mx] = _logi
+
+
+@register("logical_not")
+def _lnot(ctx, node, ins, out):
+    b, r = ctx.tmp("b"), ctx.tmp("n")
+    ctx.add_node("Cast", ins, [b], to=int(P.TensorProto.BOOL))
+    ctx.add_node("Not", [b], [r])
+    _cmp_out_cast(ctx, r, out, node.name)
+
+
+@register("broadcast_mod")
+def _mod(ctx, node, ins, out):
+    ctx.add_node("Mod", ins, [out], name=node.name, fmod=1)
+
+
+@register("where")
+def _where(ctx, node, ins, out):
+    b = ctx.tmp("cond")
+    ctx.add_node("Cast", [ins[0]], [b], to=int(P.TensorProto.BOOL))
+    ctx.add_node("Where", [b, ins[1], ins[2]], [out], name=node.name)
+
+
+# -- shape / indexing -------------------------------------------------------
+
+@register("slice_axis")
+def _slice_axis(ctx, node, ins, out):
+    p = node.params
+    end = p.get("end")
+    starts = ctx.const([int(p["begin"])], onp.int64, "starts")
+    ends = ctx.const([int(end) if end is not None else (1 << 62)],
+                     onp.int64, "ends")
+    axes = ctx.const([int(p["axis"])], onp.int64, "axes")
+    ctx.add_node("Slice", [ins[0], starts, ends, axes], [out],
+                 name=node.name)
+
+
+@register("slice")
+def _slice(ctx, node, ins, out):
+    p = node.params
+    begin = [int(b) if b is not None else 0 for b in p["begin"]]
+    end = [int(e) if e is not None else (1 << 62) for e in p["end"]]
+    n = len(begin)
+    inputs = [ins[0],
+              ctx.const(begin, onp.int64, "starts"),
+              ctx.const(end, onp.int64, "ends"),
+              ctx.const(list(range(n)), onp.int64, "axes")]
+    if p.get("step"):
+        inputs.append(ctx.const(
+            [int(s) if s is not None else 1 for s in p["step"]],
+            onp.int64, "steps"))
+    ctx.add_node("Slice", inputs, [out], name=node.name)
+
+
+@register("Crop")
+def _crop(ctx, node, ins, out):
+    p = node.params
+    shp = ctx.shape_of(node.inputs[0][0].name)
+    if len(ins) == 2:
+        like = ctx.shape_of(node.inputs[1][0].name)
+        h, w = like[2], like[3]
+    else:
+        h, w = p["h_w"]
+    if p.get("center_crop"):
+        y0 = (shp[2] - h) // 2
+        x0 = (shp[3] - w) // 2
+    else:
+        y0, x0 = p.get("offset", (0, 0))
+    starts = ctx.const([int(y0), int(x0)], onp.int64, "starts")
+    ends = ctx.const([int(y0 + h), int(x0 + w)], onp.int64, "ends")
+    axes = ctx.const([2, 3], onp.int64, "axes")
+    ctx.add_node("Slice", [ins[0], starts, ends, axes], [out],
+                 name=node.name)
+
+
+@register("clip")
+def _clip(ctx, node, ins, out):
+    p = node.params
+    inputs = [ins[0]]
+    lo, hi = p.get("a_min"), p.get("a_max")
+    inputs.append(ctx.const(float(lo), name_hint="min") if lo is not None
+                  else "")
+    if hi is not None:
+        inputs.append(ctx.const(float(hi), name_hint="max"))
+    while inputs and inputs[-1] == "":
+        inputs.pop()
+    ctx.add_node("Clip", inputs, [out], name=node.name)
+
+
+@register("expand_dims")
+def _expand_dims(ctx, node, ins, out):
+    ctx.sqz("Unsqueeze", ins, out, node.name,
+            [int(node.params["axis"])])
+
+
+@register("squeeze")
+def _squeeze(ctx, node, ins, out):
+    ax = node.params.get("axis")
+    if ax is None:
+        shp = ctx.shape_of(node.inputs[0][0].name)
+        ax = [i for i, d in enumerate(shp) if d == 1]
+    elif isinstance(ax, int):
+        ax = [ax]
+    ctx.sqz("Squeeze", ins, out, node.name, [int(a) for a in ax])
+
+
+@register("Cast", "cast")
+def _cast(ctx, node, ins, out):
+    to = _DTYPE2ONNX.get(onp.dtype(node.params["dtype"]))
+    if to is None:
+        raise MXNetError(
+            f"onnx export: Cast dtype {node.params['dtype']!r}")
+    ctx.add_node("Cast", ins, [out], name=node.name, to=int(to))
+
+
+@register("Embedding")
+def _embedding(ctx, node, ins, out):
+    # mx Embedding(data=indices, weight); ONNX Gather(weight, indices).
+    # float indices must become ints for Gather.
+    idx = ctx.tmp("idx")
+    ctx.add_node("Cast", [ins[0]], [idx], to=int(P.TensorProto.INT64))
+    ctx.add_node("Gather", [ins[1], idx], [out], name=node.name, axis=0)
+
+
+@register("take")
+def _take(ctx, node, ins, out):
+    idx = ctx.tmp("idx")
+    ctx.add_node("Cast", [ins[1]], [idx], to=int(P.TensorProto.INT64))
+    ctx.add_node("Gather", [ins[0], idx], [out], name=node.name,
+                 axis=int(node.params.get("axis", 0)))
+
+
+@register("tile")
+def _tile(ctx, node, ins, out):
+    reps = node.params["reps"]
+    reps = (reps,) if isinstance(reps, int) else tuple(reps)
+    r = ctx.const([int(x) for x in reps], onp.int64, "reps")
+    ctx.add_node("Tile", [ins[0], r], [out], name=node.name)
+
+
+@register("Pad")
+def _pad(ctx, node, ins, out):
+    p = node.params
+    pw = [int(x) for x in p.get("pad_width", ())]
+    n = len(pw) // 2
+    begins = pw[0::2]
+    ends = pw[1::2]
+    pads = ctx.const(begins + ends, onp.int64, "pads")
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect"}.get(p.get("mode", "constant"))
+    if mode is None:
+        raise MXNetError(f"onnx export: Pad mode {p.get('mode')!r}")
+    inputs = [ins[0], pads]
+    if mode == "constant":
+        inputs.append(ctx.const(float(p.get("constant_value", 0.0)),
+                                name_hint="padval"))
+    ctx.add_node("Pad", inputs, [out], name=node.name, mode=mode)
+
+
+@register("stack")
+def _stack(ctx, node, ins, out):
+    axis = int(node.params.get("axis", 0))
+    exp = []
+    for i in ins:
+        t = ctx.tmp("unsq")
+        ctx.sqz("Unsqueeze", [i], t, t, [axis])
+        exp.append(t)
+    ctx.add_node("Concat", exp, [out], name=node.name, axis=axis)
+
+
+@register("SwapAxis", "swapaxes")
+def _swapaxes(ctx, node, ins, out):
+    rank = len(ctx.shape_of(node.inputs[0][0].name))
+    d1 = int(node.params.get("dim1", 0)) % rank
+    d2 = int(node.params.get("dim2", 0)) % rank
+    perm = list(range(rank))
+    perm[d1], perm[d2] = perm[d2], perm[d1]
+    ctx.add_node("Transpose", ins, [out], name=node.name,
+                 perm=tuple(perm))
+
+
+@register("depth_to_space")
+def _d2s(ctx, node, ins, out):
+    ctx.add_node("DepthToSpace", ins, [out], name=node.name,
+                 blocksize=int(node.params["block_size"]))
+
+
+@register("space_to_depth")
+def _s2d(ctx, node, ins, out):
+    ctx.add_node("SpaceToDepth", ins, [out], name=node.name,
+                 blocksize=int(node.params["block_size"]))
+
+
+@register("shape_array")
+def _shape_array(ctx, node, ins, out):
+    ctx.add_node("Shape", ins, [out], name=node.name)
+
+
+@register("size_array")
+def _size_array(ctx, node, ins, out):
+    ctx.add_node("Size", ins, [out], name=node.name)
+
+
+@register("zeros_like")
+def _zeros_like(ctx, node, ins, out):
+    # static shapes (TPU-first): bake the known shape as an initializer
+    shp = ctx.shape_of(node.inputs[0][0].name)
+    c = ctx.const(onp.zeros(shp, ctx.dtype), name_hint="zeros")
+    ctx.add_node("Identity", [c], [out], name=node.name)
+
+
+@register("ones_like")
+def _ones_like(ctx, node, ins, out):
+    shp = ctx.shape_of(node.inputs[0][0].name)
+    c = ctx.const(onp.ones(shp, ctx.dtype), name_hint="ones")
+    ctx.add_node("Identity", [c], [out], name=node.name)
+
+
+@register("argmax")
+def _argmax(ctx, node, ins, out):
+    _arg_reduce(ctx, node, ins, out, "ArgMax")
+
+
+@register("argmin")
+def _argmin(ctx, node, ins, out):
+    _arg_reduce(ctx, node, ins, out, "ArgMin")
+
+
+def _arg_reduce(ctx, node, ins, out, op):
+    p = node.params
+    t = ctx.tmp("arg")
+    ax = p.get("axis")
+    ctx.add_node(op, ins, [t], axis=int(ax) if ax is not None else 0,
+                 keepdims=int(bool(p.get("keepdims", False))))
+    _cmp_out_cast(ctx, t, out, node.name)   # mx returns float dtype
+
+
+@register("topk")
+def _topk(ctx, node, ins, out):
+    p = node.params
+    if p.get("ret_typ", "indices") not in ("value", "indices"):
+        raise MXNetError("onnx export: topk ret_typ must be value or "
+                         "indices")
+    k = ctx.const([int(p.get("k", 1))], onp.int64, "k")
+    vals, idxs = ctx.tmp("topv"), ctx.tmp("topi")
+    ctx.add_node("TopK", [ins[0], k], [vals, idxs], name=node.name,
+                 axis=int(p.get("axis", -1)),
+                 largest=int(not p.get("is_ascend", False)), sorted=1)
+    if p.get("ret_typ", "indices") == "value":
+        ctx.add_node("Identity", [vals], [out])
+    else:
+        _cmp_out_cast(ctx, idxs, out, node.name + "_cast")
+
+
+@register("norm")
+def _norm(ctx, node, ins, out):
+    p = node.params
+    if int(p.get("ord", 2)) != 2:
+        raise MXNetError("onnx export: norm supports ord=2 only")
+    ax = p.get("axis")
+    if ax is not None:
+        ax = (ax,) if isinstance(ax, int) else tuple(ax)
+        ctx.add_node("ReduceL2", ins, [out], name=node.name,
+                     axes=ax, keepdims=int(bool(p.get("keepdims", False))))
+    else:
+        ctx.add_node("ReduceL2", ins, [out], name=node.name,
+                     keepdims=int(bool(p.get("keepdims", False))))
+
+
+@register("batch_dot")
+def _batch_dot(ctx, node, ins, out):
+    p = node.params
+    a, b = ins
+    if p.get("transpose_a"):
+        t = ctx.tmp("ta")
+        ctx.add_node("Transpose", [a], [t], perm=(0, 2, 1))
+        a = t
+    if p.get("transpose_b"):
+        t = ctx.tmp("tb")
+        ctx.add_node("Transpose", [b], [t], perm=(0, 2, 1))
+        b = t
+    ctx.add_node("MatMul", [a, b], [out], name=node.name)
+
+
+# -- normalization ----------------------------------------------------------
+
+@register("LayerNorm")
+def _layernorm(ctx, node, ins, out):
+    """x̂·γ+β decomposed over ReduceMean (parity: convert_layer_norm)."""
+    p = node.params
+    axis = int(p.get("axis", -1))
+    eps = ctx.const(float(p.get("eps", 1e-5)), name_hint="eps")
+    mu, xc, var, sd, xn, sc = (ctx.tmp("ln") for _ in range(6))
+    ctx.reduce_axes("ReduceMean", [ins[0]], mu, mu, (axis,), True)
+    ctx.add_node("Sub", [ins[0], mu], [xc])
+    sq = ctx.tmp("ln")
+    ctx.add_node("Mul", [xc, xc], [sq])
+    ctx.reduce_axes("ReduceMean", [sq], var, var, (axis,), True)
+    ve = ctx.tmp("ln")
+    ctx.add_node("Add", [var, eps], [ve])
+    ctx.add_node("Sqrt", [ve], [sd])
+    ctx.add_node("Div", [xc, sd], [xn])
+    ctx.add_node("Mul", [xn, ins[1]], [sc])
+    ctx.add_node("Add", [sc, ins[2]], [out], name=node.name)
+
+
+@register("InstanceNorm")
+def _instancenorm(ctx, node, ins, out):
+    ctx.add_node("InstanceNormalization", ins, [out], name=node.name,
+                 epsilon=float(node.params.get("eps", 1e-3)))
+
+
+@register("L2Normalization")
+def _l2norm(ctx, node, ins, out):
+    p = node.params
+    mode = p.get("mode", "instance")
+    rank = len(ctx.shape_of(node.inputs[0][0].name))
+    if mode == "channel":
+        axes = (1,)
+    elif mode == "instance":
+        axes = tuple(range(1, rank))
+    elif mode == "spatial":
+        axes = tuple(range(2, rank))
+    else:
+        raise MXNetError(f"onnx export: L2Normalization mode {mode!r}")
+    eps = ctx.const(float(p.get("eps", 1e-10)), name_hint="eps")
+    sq, ss, se, sd = (ctx.tmp("l2") for _ in range(4))
+    ctx.add_node("Mul", [ins[0], ins[0]], [sq])
+    ctx.reduce_axes("ReduceSum", [sq], ss, ss, axes, True)
+    ctx.add_node("Add", [ss, eps], [se])
+    ctx.add_node("Sqrt", [se], [sd])
+    ctx.add_node("Div", [ins[0], sd], [out], name=node.name)
+
+
+@register("SoftmaxOutput")
+def _softmax_output(ctx, node, ins, out):
+    # inference: plain softmax over the trailing dim (the label input
+    # is a training-only artifact)
+    ctx.add_node("Softmax", ins[:1], [out], name=node.name, axis=-1)
+
+
+@register("SoftmaxActivation")
+def _softmax_activation(ctx, node, ins, out):
+    axis = 1 if node.params.get("mode", "instance") == "channel" else -1
+    ctx.add_node("Softmax", ins, [out], name=node.name, axis=axis)
+
+
+# -- image / detection ------------------------------------------------------
+
+@register("UpSampling")
+def _upsampling(ctx, node, ins, out):
+    p = node.params
+    if p.get("sample_type", "nearest") != "nearest":
+        raise MXNetError("onnx export: UpSampling supports nearest only "
+                         "(bilinear rides _contrib_BilinearResize2D)")
+    s = float(p.get("scale", 2))
+    scales = ctx.const([1.0, 1.0, s, s], onp.float32, "scales")
+    roi = ctx.const([], onp.float32, "roi")
+    ctx.add_node("Resize", [ins[0], roi, scales], [out], name=node.name,
+                 mode="nearest", nearest_mode="floor",
+                 coordinate_transformation_mode="asymmetric")
+
+
+@register("_contrib_BilinearResize2D")
+def _bilinear_resize(ctx, node, ins, out):
+    p = node.params
+    shp = ctx.shape_of(node.inputs[0][0].name)
+    if p.get("mode", "size") != "size" or p.get("height") is None:
+        raise MXNetError("onnx export: BilinearResize2D needs "
+                         "mode='size' with height/width")
+    sizes = ctx.const([int(shp[0]), int(shp[1]),
+                       int(p["height"]), int(p["width"])],
+                      onp.int64, "sizes")
+    roi = ctx.const([], onp.float32, "roi")
+    scales = ctx.const([], onp.float32, "scales")
+    mode = ("align_corners" if p.get("align_corners", True)
+            else "half_pixel")
+    ctx.add_node("Resize", [ins[0], roi, scales, sizes], [out],
+                 name=node.name, mode="linear",
+                 coordinate_transformation_mode=mode)
+
+
+@register("ROIPooling")
+def _roipool(ctx, node, ins, out):
+    p = node.params
+    ps = p["pooled_size"]
+    ps = (ps, ps) if isinstance(ps, int) else tuple(ps)
+    ctx.add_node("MaxRoiPool", ins, [out], name=node.name,
+                 pooled_shape=ps,
+                 spatial_scale=float(p.get("spatial_scale", 1.0)))
+
+
+@register("ROIAlign", "_contrib_ROIAlign")
+def _roialign(ctx, node, ins, out):
+    p = node.params
+    if p.get("position_sensitive"):
+        raise MXNetError("onnx export: position-sensitive ROIAlign "
+                         "unsupported")
+    ps = p["pooled_size"]
+    ps = (ps, ps) if isinstance(ps, int) else tuple(ps)
+    # mx rois (N,5) [batch_idx,x1,y1,x2,y2] → onnx rois (N,4) + idx (N,)
+    s1 = ctx.const([1], onp.int64, "starts")
+    s5 = ctx.const([5], onp.int64, "ends")
+    s0 = ctx.const([0], onp.int64, "starts")
+    e1 = ctx.const([1], onp.int64, "ends")
+    ax1 = ctx.const([1], onp.int64, "axes")
+    boxes, bidx_c, bidx_s, bidx = (ctx.tmp("roi") for _ in range(4))
+    ctx.add_node("Slice", [ins[1], s1, s5, ax1], [boxes])
+    ctx.add_node("Slice", [ins[1], s0, e1, ax1], [bidx_c])
+    ctx.sqz("Squeeze", [bidx_c], bidx_s, bidx_s, [1])
+    ctx.add_node("Cast", [bidx_s], [bidx], to=int(P.TensorProto.INT64))
+    ctx.add_node("RoiAlign", [ins[0], boxes, bidx], [out], name=node.name,
+                 output_height=int(ps[0]), output_width=int(ps[1]),
+                 spatial_scale=float(p.get("spatial_scale", 1.0)),
+                 sampling_ratio=max(0, int(p.get("sample_ratio", -1))))
+
+
+# -- attention / RNN --------------------------------------------------------
+
+@register("multi_head_attention")
+def _mha(ctx, node, ins, out):
+    """Scaled-dot attention decomposed to MatMul/Softmax; the causal
+    mask is baked as a static (S,S) initializer (shapes are known at
+    export — the TPU build is static-shape anyway)."""
+    p = node.params
+    H = int(p["num_heads"])
+    hkv = p.get("num_kv_heads") or H
+    if hkv != H:
+        raise MXNetError("onnx export: GQA multi_head_attention "
+                         "(num_kv_heads != num_heads) unsupported")
+    q_shape = ctx.shape_of(node.inputs[0][0].name)
+    k_shape = ctx.shape_of(node.inputs[1][0].name)
+    E = q_shape[-1]
+    S, Sk = q_shape[1], k_shape[1]
+    D = E // H
+    split = ctx.const([0, 0, H, -1], onp.int64, "shape")
+    qh, kh, vh = (ctx.tmp("mha") for _ in range(3))
+    for src, dst, perm in ((ins[0], qh, (0, 2, 1, 3)),
+                           (ins[1], kh, (0, 2, 3, 1)),
+                           (ins[2], vh, (0, 2, 1, 3))):
+        r = ctx.tmp("mha")
+        ctx.add_node("Reshape", [src, split], [r])
+        ctx.add_node("Transpose", [r], [dst], perm=perm)
+    scores, scaled = ctx.tmp("mha"), ctx.tmp("mha")
+    ctx.add_node("MatMul", [qh, kh], [scores])
+    scale = ctx.const(1.0 / onp.sqrt(D), name_hint="scale")
+    ctx.add_node("Mul", [scores, scale], [scaled])
+    att_in = scaled
+    if p.get("causal"):
+        mask = onp.triu(onp.full((S, Sk), -1e9, onp.float32), k=1)
+        m = ctx.const(mask, onp.float32, "causal_mask")
+        masked = ctx.tmp("mha")
+        ctx.add_node("Add", [scaled, m], [masked])
+        att_in = masked
+    att, ctxh, tr = ctx.tmp("mha"), ctx.tmp("mha"), ctx.tmp("mha")
+    ctx.add_node("Softmax", [att_in], [att], axis=-1)
+    ctx.add_node("MatMul", [att, vh], [ctxh])
+    ctx.add_node("Transpose", [ctxh], [tr], perm=(0, 2, 1, 3))
+    merge = ctx.const([0, 0, -1], onp.int64, "shape")
+    ctx.add_node("Reshape", [tr, merge], [out], name=node.name)
+
+
+def _rnn_gate_perm(mode, H):
+    """Row permutation mx gate order → onnx gate order."""
+    if mode == "lstm":     # (i,f,g,o) → (i,o,f,c)
+        order = [0, 3, 1, 2]
+    elif mode == "gru":    # (r,z,n) → (z,r,n)
+        order = [1, 0, 2]
+    else:
+        order = [0]
+    idx = []
+    for g in order:
+        idx.extend(range(g * H, (g + 1) * H))
+    return onp.asarray(idx)
+
+
+@register("RNN")
+def _rnn(ctx, node, ins, out):
+    """Fused RNN → ONNX LSTM/GRU/RNN, one node per layer.
+
+    The flat cuDNN-layout parameter vector (ops/rnn.py module doc;
+    parity rnn-inl.h:98 GetRnnParamSize) must be an initializer — it is
+    unpacked at export time into the per-layer W/R/B tensors ONNX
+    expects, with gate reorder (i,f,g,o)→(i,o,f,c) for LSTM and
+    (r,z,n)→(z,r,n) for GRU."""
+    p = node.params
+    mode = p.get("mode", "lstm")
+    if p.get("use_sequence_length") or p.get("projection_size"):
+        raise MXNetError("onnx export: RNN with sequence_length / "
+                         "projection unsupported")
+    H = int(p["state_size"])
+    L = int(p["num_layers"])
+    bidir = bool(p.get("bidirectional", False))
+    D = 2 if bidir else 1
+    G = {"lstm": 4, "gru": 3, "rnn_relu": 1, "rnn_tanh": 1}[mode]
+    onnx_op = {"lstm": "LSTM", "gru": "GRU",
+               "rnn_relu": "RNN", "rnn_tanh": "RNN"}[mode]
+    pname = node.inputs[1][0].name
+    flat = ctx.params.get(pname)
+    if flat is None:
+        raise MXNetError("onnx export: RNN parameters must be an "
+                         "initializer (a traced/arg param)")
+    flat = onp.asarray(flat, onp.float32).ravel()
+    in_shape = ctx.shape_of(node.inputs[0][0].name)
+    I = in_shape[-1]
+    perm = _rnn_gate_perm(mode, H)
+
+    # walk the flat vector exactly as ops/rnn.py _slice_params does
+    Ws, Rs, Bs = [], [], []
+    off = 0
+    for layer in range(L):
+        in_sz = I if layer == 0 else H * D
+        W_l, R_l = [], []
+        for d in range(D):
+            W = flat[off:off + G * H * in_sz].reshape(G * H, in_sz)
+            off += G * H * in_sz
+            R = flat[off:off + G * H * H].reshape(G * H, H)
+            off += G * H * H
+            W_l.append(W[perm])
+            R_l.append(R[perm])
+        Ws.append(W_l)
+        Rs.append(R_l)
+    for layer in range(L):
+        B_l = []
+        for d in range(D):
+            bW = flat[off:off + G * H]
+            off += G * H
+            bR = flat[off:off + G * H]
+            off += G * H
+            B_l.append(onp.concatenate([bW[perm], bR[perm]]))
+        Bs.append(B_l)
+
+    state_name = node.inputs[2][0].name
+    h0 = ctx.params.get(state_name)
+    c0 = None
+    if mode == "lstm" and len(node.inputs) > 3:
+        c0 = ctx.params.get(node.inputs[3][0].name)
+
+    x = ins[0]
+    for layer in range(L):
+        W = ctx.const(onp.stack(Ws[layer]), onp.float32, "rnn_W")
+        R = ctx.const(onp.stack(Rs[layer]), onp.float32, "rnn_R")
+        B = ctx.const(onp.stack(Bs[layer]), onp.float32, "rnn_B")
+        inputs = [x, W, R, B, ""]
+        if h0 is not None:
+            h_l = onp.asarray(h0)[layer * D:(layer + 1) * D]
+            inputs.append(ctx.const(h_l, onp.float32, "rnn_h0"))
+        if mode == "lstm":
+            while len(inputs) < 6:
+                inputs.append("")
+            if c0 is not None:
+                c_l = onp.asarray(c0)[layer * D:(layer + 1) * D]
+                inputs.append(ctx.const(c_l, onp.float32, "rnn_c0"))
+        while inputs and inputs[-1] == "":
+            inputs.pop()
+        y4 = ctx.tmp("rnn_y")
+        attrs = dict(hidden_size=H,
+                     direction="bidirectional" if bidir else "forward")
+        if mode == "rnn_relu":
+            attrs["activations"] = ("Relu",) * D
+        if mode == "gru":
+            attrs["linear_before_reset"] = 1
+        ctx.add_node(onnx_op, inputs, [y4], **attrs)
+        # Y is (T, D, B, H) → (T, B, D*H)
+        tr = ctx.tmp("rnn_t")
+        ctx.add_node("Transpose", [y4], [tr], perm=(0, 2, 1, 3))
+        merge = ctx.const([0, 0, -1], onp.int64, "shape")
+        is_last = layer == L - 1
+        nxt = out if is_last else ctx.tmp("rnn_x")
+        ctx.add_node("Reshape", [tr, merge], [nxt],
+                     name=node.name if is_last else nxt)
+        x = nxt
 
 
 # --------------------------------------------------------------------------
 # driver (parity: MXNetGraph.create_onnx_graph_proto, export_onnx.py:70)
 # --------------------------------------------------------------------------
 
+def _infer_node_shapes(nodes, np_params: Dict, input_shapes, dtype):
+    """name → primary-output shape for every graph node, via
+    jax.eval_shape over the same registry lowerings that execute the
+    graph (the exporter's analogue of the reference's nnvm InferShape
+    pass feeding _op_translations)."""
+    import jax
+
+    from ...ops import registry as _reg
+
+    shapes: Dict[str, tuple] = {}
+    dtypes: Dict[str, onp.dtype] = {}
+    n_data = 0
+    for node in nodes:
+        if node.is_var:
+            if node.name in np_params:
+                arr = np_params[node.name]
+                shapes[node.name] = tuple(arr.shape)
+                dtypes[node.name] = arr.dtype
+            elif n_data < len(input_shapes):
+                shapes[node.name] = tuple(input_shapes[n_data])
+                dtypes[node.name] = dtype
+                n_data += 1
+            continue
+        try:
+            op = _reg.get(node.op_name)
+            fn, _ = _reg.bound_fn(op, node.params)
+            ins = [jax.ShapeDtypeStruct(shapes[src.name],
+                                        dtypes[src.name])
+                   for src, _ in node.inputs]
+            out = jax.eval_shape(fn, *ins)
+            outs = out if isinstance(out, (list, tuple)) else [out]
+            shapes[node.name] = tuple(outs[0].shape)
+            dtypes[node.name] = outs[0].dtype
+        except Exception:
+            pass    # translators that need this shape raise clearly
+    return shapes
+
+
 def export_model(sym, params: Dict, input_shape: Sequence,
                  input_type=onp.float32, onnx_file_path: str = "model.onnx",
-                 verbose: bool = False) -> str:
+                 verbose: bool = False,
+                 opset_version: Optional[int] = None) -> str:
     """Export a Symbol graph + params to an ONNX file.
 
     Parity: contrib/onnx/mx2onnx/export_model.py export_model (same
-    signature).  `params` maps variable name → NDArray/ndarray (arg and
-    aux merged, as the reference accepts).
+    signature + opset_version as in the reference's mx2onnx v2 API).
+    `params` maps variable name → NDArray/ndarray (arg and aux merged,
+    as the reference accepts).  Opsets 12 (default) and 13 are
+    emitted.
     """
     from ...symbol.symbol import Symbol, _topo_nodes
     from ...ndarray import NDArray
 
     if not isinstance(sym, Symbol):
-        raise MXNetError("onnx export expects a Symbol (symbol-free gluon "
-                         "blocks export via HybridBlock.export / StableHLO)")
+        raise MXNetError("onnx export expects a Symbol (trace gluon "
+                         "blocks via mx.sym.trace(block, *inputs))")
+    opset = int(opset_version) if opset_version is not None else _OPSET
+    if opset not in (12, 13):
+        raise MXNetError(f"onnx export: opset_version {opset} "
+                         "unsupported (12 or 13)")
     params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+    np_params = {k: (v.asnumpy() if isinstance(v, NDArray)
+                     else onp.asarray(v)) for k, v in params.items()}
     dtype = onp.dtype(input_type)
 
     model = P.ModelProto()
@@ -360,12 +1135,13 @@ def export_model(sym, params: Dict, input_shape: Sequence,
     model.producer_name = "mxnet_tpu"
     model.producer_version = "2.0"
     op = model.opset_import.add()
-    op.version = _OPSET
+    op.version = opset
     graph = model.graph
     graph.name = getattr(sym, "name", "mxnet_tpu_graph")
-    ctx = _Ctx(graph, dtype)
 
     nodes = _topo_nodes([o[0] for o in sym._outputs])
+    shapes = _infer_node_shapes(nodes, np_params, list(input_shape), dtype)
+    ctx = _Ctx(graph, dtype, opset=opset, params=np_params, shapes=shapes)
     # fix_gamma pre-pass: a BatchNorm with fix_gamma (mxnet default True)
     # computes with gamma := 1, but ONNX BN always applies the scale
     # input — export ones for those gammas so runtimes match (parity:
@@ -381,10 +1157,8 @@ def export_model(sym, params: Dict, input_shape: Sequence,
     n_data = 0
     for node in nodes:
         if node.is_var:
-            if node.name in params:
-                arr = params[node.name]
-                arr = arr.asnumpy() if isinstance(arr, NDArray) else \
-                    onp.asarray(arr)
+            if node.name in np_params:
+                arr = np_params[node.name]
                 if node.name in ones_vars:
                     arr = onp.ones_like(arr)
                 ctx.add_initializer(node.name, arr)

@@ -67,6 +67,8 @@ def _attrs(node: P.NodeProto) -> Dict:
             out[a.name] = tuple(a.floats)
         elif a.type == P.AttributeProto.INTS:
             out[a.name] = tuple(int(i) for i in a.ints)
+        elif a.type == P.AttributeProto.STRINGS:
+            out[a.name] = tuple(s.decode() for s in a.strings)
         elif a.type == P.AttributeProto.TENSOR:
             out[a.name] = _tensor_to_numpy(a.t)
     return out
@@ -86,12 +88,13 @@ class _Importer:
         from ...symbol.symbol import Variable
         self.model = model
         self._transposed: set = set()
+        # opset-13's attrs-to-inputs moves are detected per node by
+        # presence (_axes_of), so only the ceiling is enforced here
         for ops in model.opset_import:
-            if ops.domain in ("", "ai.onnx") and ops.version > 12:
+            if ops.domain in ("", "ai.onnx") and ops.version > 13:
                 raise MXNetError(
-                    f"onnx import: opset {ops.version} unsupported (max "
-                    f"12 — newer opsets move attributes like ReduceSum "
-                    f"axes into inputs); re-export with opset_version=12")
+                    f"onnx import: opset {ops.version} unsupported "
+                    f"(max 13); re-export with opset_version<=13")
         g = model.graph
         self.consts: Dict[str, onp.ndarray] = {
             t.name: _tensor_to_numpy(t) for t in g.initializer}
@@ -137,6 +140,14 @@ class _Importer:
 
     _aux_names: set
 
+    def _const_in(self, name, what):
+        """A converter consumed input `name` as a static value."""
+        v = self.consts.get(name)
+        if v is None:
+            raise MXNetError(f"onnx import: {what} must be an initializer")
+        self.used_consts.add(name)
+        return v
+
     def _convert(self, node: P.NodeProto):
         op = node.op_type
         at = _attrs(node)
@@ -154,7 +165,12 @@ class _Importer:
             raise MXNetError(
                 f"onnx import: unsupported op {op!r} "
                 f"(supported: {sorted(set(_SIMPLE) | _METHOD_OPS)})")
-        self.sym_map[out] = sym
+        if isinstance(sym, (list, tuple)):
+            for o_name, s in zip(node.output, sym):
+                if o_name:
+                    self.sym_map[o_name] = s
+        else:
+            self.sym_map[out] = sym
 
     # -- structured converters ---------------------------------------------
     def _cv_Conv(self, node, at, ins, name):
@@ -266,10 +282,291 @@ class _Importer:
     def _cv_Identity(self, node, at, ins, name):
         return self._sym(ins[0])
 
+    def _cv_Cast(self, node, at, ins, name):
+        dt = _ONNX2DTYPE.get(at["to"])
+        if dt is None:
+            raise MXNetError(f"onnx import: Cast to {at['to']} "
+                             "unsupported")
+        return self._apply("Cast", [self._sym(ins[0])], name,
+                           dtype=str(dt))
+
+    def _cv_Gather(self, node, at, ins, name):
+        return self._apply("take", [self._sym(i) for i in ins], name,
+                           axis=int(at.get("axis", 0)))
+
+    def _cv_Clip(self, node, at, ins, name):
+        lo = hi = None
+        if len(ins) > 1 and ins[1]:
+            lo = float(onp.asarray(
+                self._const_in(ins[1], "Clip min")).ravel()[0])
+        if len(ins) > 2 and ins[2]:
+            hi = float(onp.asarray(
+                self._const_in(ins[2], "Clip max")).ravel()[0])
+        if "min" in at:             # opset<11 attr form
+            lo = float(at["min"])
+        if "max" in at:
+            hi = float(at["max"])
+        return self._apply("clip", [self._sym(ins[0])], name,
+                           a_min=lo, a_max=hi)
+
+    def _axes_of(self, at, ins, pos):
+        """Squeeze/Unsqueeze/ReduceSum axes: attr (≤12) or input (13)."""
+        if "axes" in at:
+            return [int(a) for a in at["axes"]]
+        if len(ins) > pos and ins[pos]:
+            return [int(a) for a in
+                    onp.atleast_1d(self._const_in(ins[pos], "axes"))]
+        return None
+
+    def _cv_Unsqueeze(self, node, at, ins, name):
+        axes = self._axes_of(at, ins, 1)
+        sym = self._sym(ins[0])
+        for i, ax in enumerate(sorted(axes)):
+            sym = self._apply("expand_dims", [sym],
+                              name if i == len(axes) - 1 else f"{name}_{i}",
+                              axis=int(ax))
+        return sym
+
+    def _cv_Squeeze(self, node, at, ins, name):
+        axes = self._axes_of(at, ins, 1)
+        return self._apply("squeeze", [self._sym(ins[0])], name,
+                           axis=tuple(axes) if axes else None)
+
+    def _cv_ReduceSum(self, node, at, ins, name):
+        axes = self._axes_of(at, ins, 1)
+        return self._apply("sum", [self._sym(ins[0])], name,
+                           axis=tuple(axes) if axes else None,
+                           keepdims=bool(at.get("keepdims", 1)))
+
+    def _cv_Slice(self, node, at, ins, name):
+        if len(ins) == 1:           # opset<10 attr form
+            starts = [int(s) for s in at["starts"]]
+            ends = [int(e) for e in at["ends"]]
+            axes = [int(a) for a in at.get("axes",
+                                           range(len(starts)))]
+            steps = [1] * len(starts)
+        else:
+            starts = [int(s) for s in
+                      onp.atleast_1d(self._const_in(ins[1], "starts"))]
+            ends = [int(e) for e in
+                    onp.atleast_1d(self._const_in(ins[2], "ends"))]
+            axes = ([int(a) for a in
+                     onp.atleast_1d(self._const_in(ins[3], "axes"))]
+                    if len(ins) > 3 and ins[3]
+                    else list(range(len(starts))))
+            steps = ([int(s) for s in
+                      onp.atleast_1d(self._const_in(ins[4], "steps"))]
+                     if len(ins) > 4 and ins[4] else [1] * len(starts))
+        sym = self._sym(ins[0])
+        big = 1 << 60
+        for i, (ax, b, e, st) in enumerate(zip(axes, starts, ends, steps)):
+            nm = name if i == len(axes) - 1 else f"{name}_{i}"
+            if st != 1:
+                n = ax + 1
+                begin = [None] * n
+                end = [None] * n
+                step = [None] * n
+                begin[ax], end[ax], step[ax] = b, \
+                    (None if e >= big else e), st
+                sym = self._apply("slice", [sym], nm, begin=tuple(begin),
+                                  end=tuple(end), step=tuple(step))
+            else:
+                sym = self._apply("slice_axis", [sym], nm, axis=ax,
+                                  begin=b, end=None if e >= big else e)
+        return sym
+
+    def _cv_Tile(self, node, at, ins, name):
+        reps = tuple(int(r) for r in
+                     onp.atleast_1d(self._const_in(ins[1], "Tile reps")))
+        return self._apply("tile", [self._sym(ins[0])], name, reps=reps)
+
+    def _cv_Pad(self, node, at, ins, name):
+        if len(ins) > 1:
+            pads = [int(x) for x in
+                    onp.atleast_1d(self._const_in(ins[1], "pads"))]
+            val = (float(onp.asarray(
+                self._const_in(ins[2], "pad value")).ravel()[0])
+                if len(ins) > 2 and ins[2] else 0.0)
+        else:                       # opset<11 attr form
+            pads = [int(x) for x in at["pads"]]
+            val = float(at.get("value", 0.0))
+        n = len(pads) // 2
+        pad_width = []
+        for i in range(n):
+            pad_width.extend([pads[i], pads[n + i]])
+        return self._apply("Pad", [self._sym(ins[0])], name,
+                           mode=at.get("mode", "constant"),
+                           pad_width=tuple(pad_width),
+                           constant_value=val)
+
+    def _cv_TopK(self, node, at, ins, name):
+        k = int(onp.asarray(self._const_in(ins[1], "TopK k")).ravel()[0])
+        both = self._apply("topk", [self._sym(ins[0])], name,
+                           k=k, axis=int(at.get("axis", -1)),
+                           ret_typ="both",
+                           is_ascend=not bool(at.get("largest", 1)))
+        idxs = self._apply("Cast", [both[1]], name + "_ic",
+                           dtype="int64")
+        return [both[0], idxs]
+
+    def _cv_ArgMax(self, node, at, ins, name):
+        return self._arg(at, ins, name, "argmax")
+
+    def _cv_ArgMin(self, node, at, ins, name):
+        return self._arg(at, ins, name, "argmin")
+
+    def _arg(self, at, ins, name, op):
+        sym = self._apply(op, [self._sym(ins[0])], name + "_f",
+                          axis=int(at.get("axis", 0)),
+                          keepdims=bool(at.get("keepdims", 1)))
+        return self._apply("Cast", [sym], name, dtype="int64")
+
+    def _cv_ConstantOfShape(self, node, at, ins, name):
+        from ...symbol.symbol import Variable
+        shape = tuple(int(s) for s in
+                      onp.atleast_1d(self._const_in(ins[0], "shape")))
+        v = at.get("value")
+        fill = (onp.asarray(v).ravel()[0] if v is not None else
+                onp.float32(0))
+        self.consts[node.output[0]] = onp.full(
+            shape, fill, onp.asarray(fill).dtype)
+        return Variable(node.output[0])
+
+    def _cv_Expand(self, node, at, ins, name):
+        shape = tuple(int(s) for s in
+                      onp.atleast_1d(self._const_in(ins[1], "shape")))
+        return self._apply("broadcast_to", [self._sym(ins[0])], name,
+                           shape=shape)
+
+    def _cv_Resize(self, node, at, ins, name):
+        mode = at.get("mode", "nearest")
+        if mode == "nearest":
+            scales = onp.atleast_1d(
+                self._const_in(ins[2], "Resize scales"))
+            if len(scales) != 4 or scales[2] != scales[3] \
+                    or scales[2] != int(scales[2]):
+                raise MXNetError("onnx import: Resize expects uniform "
+                                 "integer HW scales")
+            return self._apply("UpSampling", [self._sym(ins[0])], name,
+                               scale=int(scales[2]),
+                               sample_type="nearest")
+        if mode == "linear":
+            sizes = onp.atleast_1d(self._const_in(ins[3], "Resize sizes"))
+            ct = at.get("coordinate_transformation_mode", "half_pixel")
+            return self._apply(
+                "_contrib_BilinearResize2D", [self._sym(ins[0])], name,
+                height=int(sizes[2]), width=int(sizes[3]),
+                align_corners=(ct == "align_corners"))
+        raise MXNetError(f"onnx import: Resize mode {mode!r}")
+
+    def _cv_MaxRoiPool(self, node, at, ins, name):
+        return self._apply("ROIPooling", [self._sym(i) for i in ins],
+                           name, pooled_size=tuple(at["pooled_shape"]),
+                           spatial_scale=float(at.get("spatial_scale",
+                                                      1.0)))
+
+    def _cv_RoiAlign(self, node, at, ins, name):
+        # recompose mx rois (N,5): concat(batch_idx, boxes)
+        idx_f = self._apply("Cast", [self._sym(ins[2])], name + "_if",
+                            dtype="float32")
+        idx_e = self._apply("expand_dims", [idx_f], name + "_ie", axis=1)
+        rois = self._apply("Concat", [idx_e, self._sym(ins[1])],
+                           name + "_rois", dim=1)
+        sr = int(at.get("sampling_ratio", 0))
+        return self._apply(
+            "ROIAlign", [self._sym(ins[0]), rois], name,
+            pooled_size=(int(at["output_height"]),
+                         int(at["output_width"])),
+            spatial_scale=float(at.get("spatial_scale", 1.0)),
+            sample_ratio=sr if sr > 0 else -1)
+
+    # -- recurrent --------------------------------------------------------
+    _RNN_MODES = {"LSTM": ("lstm", 4), "GRU": ("gru", 3), "RNN": (None, 1)}
+
+    def _cv_LSTM(self, node, at, ins, name):
+        return self._rnn_import(node, at, ins, name, "LSTM")
+
+    def _cv_GRU(self, node, at, ins, name):
+        if not at.get("linear_before_reset", 0):
+            raise MXNetError("onnx import: GRU with linear_before_reset"
+                             "=0 unsupported (mx GRU applies reset after "
+                             "the recurrent linear)")
+        return self._rnn_import(node, at, ins, name, "GRU")
+
+    def _cv_RNN(self, node, at, ins, name):
+        return self._rnn_import(node, at, ins, name, "RNN")
+
+    def _rnn_import(self, node, at, ins, name, kind):
+        """ONNX LSTM/GRU/RNN → fused mx RNN op + layout restore.
+
+        Inverse of mx2onnx _rnn: gate rows reorder back to the cuDNN
+        order, W/R/B repack into the flat parameter vector, and the mx
+        (T,B,D*H) output is reshaped to ONNX's (T,D,B,H) Y layout so
+        downstream nodes compose unchanged."""
+        H = int(at["hidden_size"])
+        bidir = at.get("direction", "forward") == "bidirectional"
+        D = 2 if bidir else 1
+        if at.get("direction") == "reverse":
+            raise MXNetError("onnx import: reverse-direction RNN "
+                             "unsupported")
+        if kind == "RNN":
+            acts = at.get("activations", ("Tanh",) * D)
+            mode = {"Tanh": "rnn_tanh", "Relu": "rnn_relu"}.get(acts[0])
+            if mode is None:
+                raise MXNetError(f"onnx import: RNN activation "
+                                 f"{acts[0]!r} unsupported")
+            G = 1
+        else:
+            mode = kind.lower()
+            G = 4 if kind == "LSTM" else 3
+        from ...contrib.onnx.mx2onnx import _rnn_gate_perm
+        perm = _rnn_gate_perm(mode, H)
+        inv = onp.empty_like(perm)
+        inv[perm] = onp.arange(len(perm))
+        W = onp.asarray(self._const_in(ins[1], f"{kind} W"), onp.float32)
+        R = onp.asarray(self._const_in(ins[2], f"{kind} R"), onp.float32)
+        B = (onp.asarray(self._const_in(ins[3], f"{kind} B"), onp.float32)
+             if len(ins) > 3 and ins[3]
+             else onp.zeros((D, 2 * G * H), onp.float32))
+        pieces = [x for d in range(D)
+                  for x in (W[d][inv].ravel(), R[d][inv].ravel())]
+        pieces += [x for d in range(D)
+                   for x in (B[d][:G * H][inv], B[d][G * H:][inv])]
+        flat = onp.concatenate(pieces)
+        pname = name + "_parameters"
+        self.consts[pname] = flat
+        h0_name = ins[5] if len(ins) > 5 and ins[5] else None
+        if h0_name is None:
+            raise MXNetError("onnx import: RNN without initial_h "
+                             "unsupported (batch size unknown)")
+        h0 = self._const_in(h0_name, "initial_h")
+        self.consts[h0_name + "_state"] = onp.asarray(h0, onp.float32)
+        from ...symbol.symbol import Variable
+        rnn_ins = [self._sym(ins[0]), Variable(pname),
+                   Variable(h0_name + "_state")]
+        if kind == "LSTM":
+            c0_name = ins[6] if len(ins) > 6 and ins[6] else None
+            if c0_name is None:
+                raise MXNetError("onnx import: LSTM without initial_c "
+                                 "unsupported")
+            c0 = self._const_in(c0_name, "initial_c")
+            self.consts[c0_name + "_state"] = onp.asarray(c0, onp.float32)
+            rnn_ins.append(Variable(c0_name + "_state"))
+        y = self._apply("RNN", rnn_ins, name + "_y", state_size=H,
+                        num_layers=1, mode=mode, bidirectional=bidir)
+        # (T,B,D*H) → (T,B,D,H) → (T,D,B,H) = ONNX Y
+        r = self._apply("reshape", [y], name + "_r",
+                        shape=(0, 0, D, H))
+        return self._apply("transpose", [r], name, axes=(0, 2, 1, 3))
+
 
 _METHOD_OPS = {"Conv", "ConvTranspose", "Gemm", "BatchNormalization",
                "Reshape", "MaxPool", "AveragePool", "GlobalMaxPool",
-               "GlobalAveragePool", "Constant", "Dropout", "Identity"}
+               "GlobalAveragePool", "Constant", "Dropout", "Identity",
+               "Cast", "Gather", "Clip", "Unsqueeze", "Squeeze",
+               "ReduceSum", "Slice", "Tile", "Pad", "TopK", "ArgMax",
+               "ArgMin", "ConstantOfShape", "Expand", "Resize",
+               "MaxRoiPool", "RoiAlign", "LSTM", "GRU", "RNN"}
 
 # op → (mxnet op, params-from-attrs fn)
 _SIMPLE = {
@@ -286,7 +583,8 @@ _SIMPLE = {
     "Mul": ("broadcast_mul", None), "Div": ("broadcast_div", None),
     "Pow": ("broadcast_power", None),
     "Max": ("broadcast_maximum", None), "Min": ("broadcast_minimum", None),
-    "MatMul": ("dot", None),
+    # numpy semantics (batched for rank>2) — exactly ONNX MatMul's
+    "MatMul": ("matmul", None),
     "Sum": ("ElementWiseSum", None),
     "Flatten": ("Flatten", None),
     "Transpose": ("transpose", lambda at: {"axes": at["perm"]}),
@@ -306,15 +604,50 @@ _SIMPLE = {
     "ReduceMean": ("mean", lambda at: {"axis": at.get("axes"),
                                        "keepdims": bool(at.get("keepdims",
                                                                1))}),
-    "ReduceSum": ("sum", lambda at: {"axis": at.get("axes"),
-                                     "keepdims": bool(at.get("keepdims",
-                                                             1))}),
     "ReduceMax": ("max", lambda at: {"axis": at.get("axes"),
                                      "keepdims": bool(at.get("keepdims",
                                                              1))}),
     "ReduceMin": ("min", lambda at: {"axis": at.get("axes"),
                                      "keepdims": bool(at.get("keepdims",
                                                              1))}),
+    "ReduceProd": ("prod", lambda at: {"axis": at.get("axes"),
+                                       "keepdims": bool(at.get("keepdims",
+                                                               1))}),
+    "ReduceL2": ("norm", lambda at: {"ord": 2, "axis": at.get("axes"),
+                                     "keepdims": bool(at.get("keepdims",
+                                                             1))}),
+    # trig / further unaries
+    "Sin": ("sin", None), "Cos": ("cos", None), "Tan": ("tan", None),
+    "Asin": ("arcsin", None), "Acos": ("arccos", None),
+    "Atan": ("arctan", None), "Sinh": ("sinh", None),
+    "Cosh": ("cosh", None), "Asinh": ("arcsinh", None),
+    "Acosh": ("arccosh", None), "Atanh": ("arctanh", None),
+    "Round": ("round", None),
+    "HardSigmoid": ("hard_sigmoid",
+                    lambda at: {"alpha": at.get("alpha", 0.2),
+                                "beta": at.get("beta", 0.5)}),
+    "Selu": ("LeakyReLU", lambda at: {"act_type": "selu"}),
+    # comparisons / logical (mx float ↔ onnx bool ride explicit Casts)
+    "Equal": ("broadcast_equal", None),
+    "Greater": ("broadcast_greater", None),
+    "Less": ("broadcast_lesser", None),
+    "GreaterOrEqual": ("broadcast_greater_equal", None),
+    "LessOrEqual": ("broadcast_lesser_equal", None),
+    "And": ("broadcast_logical_and", None),
+    "Or": ("broadcast_logical_or", None),
+    "Xor": ("broadcast_logical_xor", None),
+    "Not": ("logical_not", None),
+    "Where": ("where", None),
+    "Mod": ("broadcast_mod", None),
+    "DepthToSpace": ("depth_to_space",
+                     lambda at: {"block_size": at["blocksize"]}),
+    "SpaceToDepth": ("space_to_depth",
+                     lambda at: {"block_size": at["blocksize"]}),
+    "Shape": ("shape_array", None),
+    "Size": ("size_array", None),
+    "InstanceNormalization": ("InstanceNorm",
+                              lambda at: {"eps": at.get("epsilon",
+                                                        1e-5)}),
 }
 
 

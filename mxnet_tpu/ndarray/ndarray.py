@@ -81,7 +81,7 @@ class NDArray:
     Parity: mx.nd.NDArray (python/mxnet/ndarray/ndarray.py).
     """
 
-    __slots__ = ("_data", "_node", "_grad", "__weakref__")
+    __slots__ = ("_data", "_node", "_grad", "_dc_sym", "__weakref__")
 
     def __init__(self, data, ctx: Optional[Context] = None, dtype=None):
         self._data = _as_jax(data, ctx, dtype)

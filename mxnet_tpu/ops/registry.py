@@ -291,7 +291,69 @@ def dispatch(op: Operator, nd_inputs: Sequence[Any], params: dict):
     t0 = profiler.op_timer()
     out = apply_jax(fn, nd_inputs, multi_out=op.multi_out, jentry=jentry)
     profiler.op_record(op.name, t0)
+    if _dc_stack:
+        _dc_record(op, nd_inputs, params, out)
     return out
+
+
+# --------------------------------------------------------------------------
+# deferred-compute symbol tracing (parity: python/mxnet/_deferred_compute.py
+# and the imperative deferred-compute mode, src/imperative/imperative.cc
+# DCInfo): while a DCScope is active, every eager dispatch ALSO records a
+# Symbol graph node onto its output NDArrays, so one imperative gluon
+# forward yields the full Symbol graph — the route by which any model-zoo
+# network reaches sym.bind / symbol json / ONNX export.
+# --------------------------------------------------------------------------
+
+class DCScope:
+    """Record the symbol graph of every op dispatched while active."""
+
+    def __init__(self):
+        self.captured: dict = {}   # generated var name → NDArray constant
+        self.touched: list = []    # every NDArray tagged under this scope
+        self._n = 0
+
+    def __enter__(self):
+        _dc_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _dc_stack.pop()
+        return False
+
+    def _var(self, nd, hint="const"):
+        from ..symbol.symbol import _Node
+        self._n += 1
+        name = f"__dc_{hint}_{self._n}"
+        ref = (_Node(None, name), 0)
+        nd._dc_sym = ref
+        self.captured[name] = nd
+        self.touched.append(nd)
+        return ref
+
+
+_dc_stack: List["DCScope"] = []
+
+
+def _dc_record(op: Operator, nd_inputs, params: dict, out):
+    from ..symbol.symbol import _Node
+    scope = _dc_stack[-1]
+    in_refs = []
+    for x in nd_inputs:
+        ref = getattr(x, "_dc_sym", None)
+        if ref is None:
+            # an array computed outside the scope (constants, position
+            # tables, scalar sugar): capture it as a named initializer
+            ref = scope._var(x)
+        in_refs.append(ref)
+    outs = list(out) if isinstance(out, (list, tuple)) else [out]
+    scope._n += 1
+    base = op.name.split(":")[-1].lower().lstrip("_") or "op"
+    node = _Node(op.name, f"{base}{scope._n}", dict(params), in_refs,
+                 num_outputs=len(outs))
+    for i, o in enumerate(outs):
+        o._dc_sym = (node, i)
+        scope.touched.append(o)
 
 
 def invoke(name: str, nd_inputs: Sequence[Any], **params):

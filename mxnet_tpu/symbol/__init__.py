@@ -3,11 +3,11 @@
 Parity: python/mxnet/symbol/.  Symbols compose the same registered ops
 as ``mx.nd``; binding lowers the graph to one jitted XLA executable.
 """
-from .symbol import Symbol, Variable, var, Group, load, load_json
+from .symbol import Symbol, Variable, var, Group, load, load_json, trace
 from .executor import Executor
 from .register import populate_namespace, make_sym_func
 
 populate_namespace(globals())
 
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
-           "Executor"]
+           "trace", "Executor"]

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from ..base import MXNetError, np_dtype
 from ..ops import registry as _reg
 
-__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json",
+           "trace"]
 
 def _auto_name(op_name: str) -> str:
     # every auto name flows through the active NameManager (parity:
@@ -514,6 +515,79 @@ def Variable(name: str, shape=None, dtype=None, attrs=None,
 
 
 var = Variable
+
+
+def trace(block, *inputs):
+    """Trace one imperative gluon forward into a Symbol graph.
+
+    Returns ``(sym, arg_params, aux_params)``.  Runs ``block(*inputs)``
+    under a deferred-compute scope (parity: the reference's deferred
+    compute tracing, python/mxnet/_deferred_compute.py + Imperative
+    DCInfo, src/imperative/imperative.cc): every eager op dispatch also
+    records a graph node, so any model-zoo network — written purely
+    imperatively — yields the Symbol graph that sym.bind, symbol json,
+    and ONNX export consume.  Aux params (``grad_req == 'null'``, e.g.
+    BatchNorm running stats) are split out as the reference does.
+    """
+    from .. import autograd as ag
+    from ..base import MXNetError as _Err
+    from ..ndarray import NDArray
+    from ..ops import registry as _dcr
+
+    nd_in = [x if isinstance(x, NDArray) else NDArray(x) for x in inputs]
+    was_active = bool(getattr(block, "_active", False))
+    if was_active:
+        block.hybridize(False)   # cached graphs bypass the dispatch funnel
+    with ag.pause(train_mode=False):
+        block(*nd_in)            # finish any deferred init eagerly
+    params = dict(block.collect_params().items())
+
+    def _tag(nd, name):
+        nd._dc_sym = (_Node(None, name), 0)
+        scope.touched.append(nd)
+
+    scope = _dcr.DCScope()
+    try:
+        with scope:
+            for k, p in params.items():
+                _tag(p.data(), k)
+            for i, x in enumerate(nd_in):
+                _tag(x, "data" if len(nd_in) == 1 else f"data{i}")
+            with ag.pause(train_mode=False):
+                out = block(*nd_in)
+        outs = list(out) if isinstance(out, (list, tuple)) else [out]
+        refs = []
+        for o in outs:
+            ref = getattr(o, "_dc_sym", None)
+            if ref is None:
+                raise _Err(
+                    "symbol.trace: a block output was not produced by "
+                    "registry ops — nothing was recorded for it")
+            refs.append(ref)
+        sym = Symbol(refs)
+        used = {n.name for n in _topo_nodes([r[0] for r in refs])
+                if n.is_var}
+        arg_params, aux_params = {}, {}
+        for k, p in params.items():
+            if k in used:
+                dst = aux_params if p.grad_req == "null" else arg_params
+                dst[k] = p.data()
+        for k, nd in scope.captured.items():
+            if k in used:
+                arg_params[k] = nd
+        return sym, arg_params, aux_params
+    finally:
+        if was_active:
+            block.hybridize(True)
+        # clear EVERY tag laid down under this scope — including op
+        # outputs a block may have cached on itself — so a later trace
+        # never splices this trace's dead subgraph into its own
+        for nd in scope.touched:
+            try:
+                del nd._dc_sym
+            except AttributeError:
+                pass
+        scope.touched.clear()
 
 
 def Group(symbols: Sequence[Symbol]) -> Symbol:

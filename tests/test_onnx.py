@@ -133,16 +133,11 @@ def test_import_to_gluon(tmp_path):
 
 
 def test_unsupported_op_errors(tmp_path):
+    from mxnet_tpu.symbol.symbol import _apply
     x = mx.sym.var("data")
-    y = mx.sym._internal_apply("ROIAlign", [x, x],
-                               pooled_size=(2, 2), spatial_scale=1.0) \
-        if hasattr(mx.sym, "_internal_apply") else None
-    if y is None:
-        from mxnet_tpu.symbol.symbol import _apply
-        y = _apply("ROIAlign", [x, x], pooled_size=(2, 2),
-                   spatial_scale=1.0)
+    y = _apply("MultiBoxPrior", [x], sizes=(1.0,), ratios=(1.0,))
     with pytest.raises(MXNetError, match="no translation"):
-        mx_onnx.export_model(y, {}, [(1, 3, 4, 4), (1, 5)],
+        mx_onnx.export_model(y, {}, [(1, 3, 4, 4)],
                              onnx_file_path=str(tmp_path / "x.onnx"))
 
 
@@ -242,12 +237,35 @@ def test_batchnorm_gamma_semantics(tmp_path):
         onp.testing.assert_allclose(gamma, expect)
 
 
-def test_opset_13_rejected(tmp_path):
+def test_opset_14_rejected(tmp_path):
     from mxnet_tpu.contrib.onnx import onnx_pb2 as P
     m = P.ModelProto(); m.ir_version = 8
-    ops = m.opset_import.add(); ops.version = 13
+    ops = m.opset_import.add(); ops.version = 14
     m.graph.name = "g"
     path = str(tmp_path / "new.onnx")
     open(path, "wb").write(m.SerializeToString())
-    with pytest.raises(MXNetError, match="opset 13 unsupported"):
+    with pytest.raises(MXNetError, match="opset 14 unsupported"):
         mx_onnx.import_model(path)
+
+
+def test_opset_13_round_trip(tmp_path):
+    """Opset 13 moves ReduceSum/Squeeze/Unsqueeze axes into inputs —
+    both directions must honor it."""
+    x = mx.sym.var("data")
+    y = mx.sym.sum(x, axis=1, keepdims=True) if hasattr(mx.sym, "sum") \
+        else None
+    if y is None:
+        from mxnet_tpu.symbol.symbol import _apply
+        y = _apply("sum", [x], axis=1, keepdims=True)
+    from mxnet_tpu.symbol.symbol import _apply
+    y = _apply("expand_dims", [y], axis=0)
+    y = _apply("squeeze", [y], axis=0)
+    path = str(tmp_path / "o13.onnx")
+    mx_onnx.export_model(y, {}, [(2, 3)], onnx_file_path=path,
+                         opset_version=13)
+    xin = onp.random.RandomState(0).randn(2, 3).astype(onp.float32)
+    ref = y.bind(args={"data": mx.nd.array(xin)}).forward()[0].asnumpy()
+    sym2, args2, _ = mx_onnx.import_model(path)
+    got = sym2.bind(args={**args2, "data": mx.nd.array(xin)}) \
+        .forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)

@@ -296,3 +296,27 @@ def test_load_real_reference_model_json():
     out = ex.forward(data=mx.nd.array(
         onp.random.rand(1, 3, 32, 32).astype(onp.float32)))[0]
     onp.testing.assert_allclose(out.asnumpy().sum(), 1.0, rtol=1e-5)
+
+
+def test_trace_twice_is_clean():
+    """A second trace of the same block must not inherit stale graph
+    tags from the first (deferred-compute scope cleanup)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.symbol.symbol import _topo_nodes
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(5, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = mx.nd.array(onp.random.RandomState(0)
+                    .randn(2, 4).astype("float32"))
+    s1, a1, _ = mx.sym.trace(net, x)
+    s2, a2, _ = mx.sym.trace(net, x)
+    n1 = _topo_nodes([o[0] for o in s1._outputs])
+    n2 = _topo_nodes([o[0] for o in s2._outputs])
+    assert len(n1) == len(n2)
+    assert sorted(a1) == sorted(a2)
+    r1 = s1.bind(args={**a1, "data": x}).forward()[0].asnumpy()
+    r2 = s2.bind(args={**a2, "data": x}).forward()[0].asnumpy()
+    onp.testing.assert_allclose(r1, r2)
