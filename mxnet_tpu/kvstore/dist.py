@@ -13,8 +13,24 @@ TPU-native design dissolves the parameter-server:
   ApplyUpdates) is re-expressed as *weight-update sharding* (ZeRO-1):
   each process owns a 1/N slice of every parameter's optimizer state,
   updates only its slice, and an all-gather rebuilds the full weight.
-- ``dist_async``'s apply-immediately semantics degenerate to sync on TPU
-  (documented divergence — async PS has no ICI analogue, SURVEY.md §7).
+- ``dist_async`` (apply-immediately, kvstore_dist_server.h:337-346
+  DataHandleDefault → ApplyUpdates) is re-expressed as *stale
+  synchronous parallel* over the ZeRO shards: a push applies the LOCAL
+  gradient to this rank's own weight shard immediately — no collective,
+  no barrier — and every ``MXNET_ASYNC_STALENESS_BOUND``-th push call
+  (default 16) is a fused all-gather rendezvous reconciling the shards.
+  Between rendezvous, reads of other ranks' shards are at most K pushes
+  stale.  Documented divergence from the reference's fully
+  uncoordinated async PS: like every collective-based store here
+  (dist_sync included), ranks must make the SAME TOTAL number of push
+  calls — what async relaxes is the rendezvous frequency (1 in K push
+  calls instead of every one), so ranks run uncoordinated within each
+  K-window.  Call :meth:`reconcile` on every rank after the last push
+  to flush the tail window before checkpoint/eval.
+- ``push`` batches keys: every key in one call rides ONE fused
+  collective per dtype (parity: the NCCL store's key batching,
+  src/kvstore/kvstore_nccl.h:62) — one dispatch+transfer per step, not
+  per parameter.
 
 Gradient compression rides the same collective as a *packed* uint8
 payload (4 two-bit codes per byte — 16x wire reduction, parity
@@ -118,6 +134,11 @@ class DistKVStore(KVStoreBase):
         # ZeRO weight-update sharding state (update_on_kvstore):
         self._opt_states: Dict[Any, tuple] = {}
         self._key_index: Dict[Any, int] = {}
+        # dist_async: SSP slack + push counter (see module doc)
+        self._async = name == "dist_async"
+        self._staleness_bound = max(
+            1, getenv_int("MXNET_ASYNC_STALENESS_BOUND", 16))
+        self._async_pushes = 0
 
     @staticmethod
     def is_capable(capability: str) -> bool:
@@ -151,33 +172,102 @@ class DistKVStore(KVStoreBase):
         hi = min(n, lo + chunk)
         return lo, hi, chunk
 
-    def _sharded_update(self, k, reduced: NDArray):
-        """Server-side optimizer as weight-update sharding (parity:
-        kvstore_dist_server.h:346 ApplyUpdates; optimizer state is 1/N
-        per process instead of replicated)."""
+    def _update_own_slice(self, k, grad_flat) -> tuple:
+        """Run the optimizer on this rank's 1/N slice of key ``k``;
+        returns (new_slice, shape, dtype, n, lo, hi, chunk)."""
         weight = self._data[k]
         shape, dtype = weight.shape, weight.dtype
         n = int(onp.prod(shape)) if shape else 1
         lo, hi, chunk = self._slice_bounds(n)
         flat_w = weight._data.reshape(-1)
-        flat_g = reduced._data.reshape(-1)
         w_slice = NDArray(flat_w[lo:hi])
-        g_slice = NDArray(flat_g[lo:hi])
+        g_slice = NDArray(grad_flat[lo:hi])
         idx = self._key_index.setdefault(k, len(self._key_index))
         if k not in self._opt_states:
-            st = self._optimizer.create_state(idx, w_slice)
-            self._opt_states[k] = st
-        self._optimizer.update(idx, w_slice, g_slice, self._opt_states[k])
-        new_slice = w_slice._data
-        if self._nproc == 1:
-            self._data[k] = NDArray(new_slice.reshape(shape)
-                                    .astype(dtype))
-            return
-        padded = jnp.zeros((chunk,), new_slice.dtype).at[
-            : hi - lo].set(new_slice)
-        gathered = self._collectives().allgather(padded)
-        self._data[k] = NDArray(
-            gathered.reshape(-1)[:n].reshape(shape).astype(dtype))
+            self._opt_states[k] = self._optimizer.create_state(idx,
+                                                               w_slice)
+        self._optimizer.update(idx, w_slice, g_slice,
+                               self._opt_states[k])
+        return w_slice._data, shape, dtype, n, lo, hi, chunk
+
+    def _gather_shards(self, items):
+        """ONE fused all-gather (per dtype) rebuilding full weights
+        from per-rank slices.  ``items``: list of
+        (k, new_slice, shape, dtype, n, lo, hi, chunk)."""
+        from .. import profiler
+
+        by_dtype: Dict[str, list] = {}
+        for it in items:
+            by_dtype.setdefault(str(it[1].dtype), []).append(it)
+        for group in by_dtype.values():
+            padded = []
+            for (_, sl, shape, dtype, n, lo, hi, chunk) in group:
+                padded.append(jnp.zeros((chunk,), sl.dtype)
+                              .at[: hi - lo].set(sl))
+            cat = jnp.concatenate(padded) if len(padded) > 1 else padded[0]
+            t0 = profiler.op_timer()
+            gathered = self._collectives().allgather(cat)   # (nproc, tot)
+            profiler.op_record("kvstore_fused_allgather", t0)
+            off = 0
+            for (k, sl, shape, dtype, n, lo, hi, chunk) in group:
+                full = gathered[:, off:off + chunk].reshape(-1)[:n]
+                self._data[k] = NDArray(full.reshape(shape).astype(dtype))
+                off += chunk
+
+    def _sharded_update_batch(self, kv):
+        """Server-side optimizer as weight-update sharding (parity:
+        kvstore_dist_server.h:346 ApplyUpdates; optimizer state is 1/N
+        per process instead of replicated).  All keys of a push share
+        one fused all-gather."""
+        items = []
+        for k, reduced in kv:
+            sl, shape, dtype, n, lo, hi, chunk = self._update_own_slice(
+                k, reduced._data.reshape(-1))
+            if self._nproc == 1:
+                self._data[k] = NDArray(sl.reshape(shape).astype(dtype))
+            else:
+                items.append((k, sl, shape, dtype, n, lo, hi, chunk))
+        if items:
+            self._gather_shards(items)
+
+    # -- dist_async: SSP over the ZeRO shards ------------------------------
+    def _async_apply(self, kv):
+        """Apply-on-push with the LOCAL gradient, own shard only — no
+        collective, no barrier (parity: kvstore_dist_server.h:337-346
+        DataHandleDefault applying each arriving push immediately)."""
+        for k, local in kv:
+            sl, shape, dtype, n, lo, hi, _ = self._update_own_slice(
+                k, local._data.reshape(-1))
+            flat = self._data[k]._data.reshape(-1).at[lo:hi].set(sl)
+            self._data[k] = NDArray(flat.reshape(shape).astype(dtype))
+        self._async_pushes += 1
+        if self._nproc > 1 and \
+                self._async_pushes % self._staleness_bound == 0:
+            self._async_reconcile()
+
+    def reconcile(self):
+        """Force the bounded-staleness rendezvous now (collective —
+        call on every rank).  Use after the final push of a training
+        run so the tail window (pushes % K ≠ 0) doesn't leave replicas
+        diverged at checkpoint/eval time.  No-op for sync stores and
+        single-process runs."""
+        if self._async and self._nproc > 1 and self._opt_states:
+            self._async_reconcile()
+
+    def _async_reconcile(self):
+        """Bounded-staleness rendezvous: every rank contributes its
+        fresh shard of every async-updated key in one fused all-gather;
+        afterwards all replicas are identical again."""
+        items = []
+        for k in self._opt_states:
+            weight = self._data[k]
+            shape, dtype = weight.shape, weight.dtype
+            n = int(onp.prod(shape)) if shape else 1
+            lo, hi, chunk = self._slice_bounds(n)
+            sl = weight._data.reshape(-1)[lo:hi]
+            items.append((k, sl, shape, dtype, n, lo, hi, chunk))
+        if items:
+            self._gather_shards(items)
 
     # -- compression wire path --------------------------------------------
     def _compressed_allreduce(self, k, local: NDArray) -> NDArray:
@@ -198,26 +288,68 @@ class DistKVStore(KVStoreBase):
         for k, v in zip(keys, vals):
             self._data[k] = v.copy()
 
+    def _batched_allreduce(self, kv):
+        """All keys of one push ride ONE fused sum collective per dtype
+        (parity: kvstore_nccl.h:62 key batching)."""
+        from .. import profiler
+
+        if self._nproc == 1:
+            return kv
+        by_dtype: Dict[str, list] = {}
+        for i, (k, v) in enumerate(kv):
+            by_dtype.setdefault(str(v.dtype), []).append(i)
+        out = list(kv)
+        for idxs in by_dtype.values():
+            flats = [kv[i][1]._data.reshape(-1) for i in idxs]
+            cat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            t0 = profiler.op_timer()
+            red = self._collectives().allreduce(cat)
+            profiler.op_record("kvstore_fused_allreduce", t0)
+            off = 0
+            for i in idxs:
+                k, v = kv[i]
+                n = int(onp.prod(v.shape)) if v.shape else 1
+                out[i] = (k, NDArray(red[off:off + n].reshape(v.shape)
+                                     .astype(v.dtype)))
+                off += n
+        return out
+
     def push(self, key, value, priority=0):
         keys = key if isinstance(key, (list, tuple)) else [key]
         if len(keys) == 1:
             value = [value]
+        kv = []
         for k, v in zip(keys, value):
             local = v
             if isinstance(v, (list, tuple)):
                 local = v[0]
                 for x in v[1:]:
                     local = local + x
-            if self._compression is not None:
-                reduced = self._compressed_allreduce(k, local)
-            else:
-                reduced = self._allreduce(local)
-            if self._optimizer is not None and k in self._data:
-                self._sharded_update(k, reduced)
-            elif self._updater is not None and k in self._data:
-                self._updater(_key_int(k), reduced, self._data[k])
-            else:
-                self._data[k] = reduced
+            kv.append((k, local))
+
+        if self._async and self._optimizer is not None and \
+                all(k in self._data for k, _ in kv):
+            self._async_apply(kv)       # no collective here
+            return
+
+        if self._compression is not None:
+            reduced_kv = [(k, self._compressed_allreduce(k, v))
+                          for k, v in kv]
+        else:
+            reduced_kv = self._batched_allreduce(kv)
+
+        if self._optimizer is not None:
+            batch = [(k, r) for k, r in reduced_kv if k in self._data]
+            rest = [(k, r) for k, r in reduced_kv if k not in self._data]
+            self._sharded_update_batch(batch)
+            for k, r in rest:
+                self._data[k] = r
+        else:
+            for k, r in reduced_kv:
+                if self._updater is not None and k in self._data:
+                    self._updater(_key_int(k), r, self._data[k])
+                else:
+                    self._data[k] = r
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = key if isinstance(key, (list, tuple)) else [key]
