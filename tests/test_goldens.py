@@ -1,0 +1,84 @@
+"""Golden-artifact backwards compatibility.
+
+Parity: tests/nightly/model_backwards_compatibility_check/ — the
+committed artifacts under tests/goldens/ were written by
+tools/make_goldens.py at a fixed point in time; these tests load them
+with TODAY'S code.  If a (de)serialization format changes
+incompatibly, these fail loudly — regenerate the goldens only for an
+intentional, documented format change.
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import NDArray
+
+GOLD = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+def _expected():
+    z = onp.load(os.path.join(GOLD, "expected.npz"))
+    return z["x"], z["y"]
+
+
+def _build_uninit():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    return net
+
+
+def test_golden_ndarray_load():
+    d = mx.nd.load(os.path.join(GOLD, "arrays.ndarray"))
+    x, _ = _expected()
+    onp.testing.assert_allclose(d["a"].asnumpy(), x)
+    onp.testing.assert_allclose(d["b"].asnumpy(), x.T)
+
+
+def test_golden_params_load():
+    x, y = _expected()
+    net = _build_uninit()
+    net.load_parameters(os.path.join(GOLD, "mlp.params"))
+    got = net(NDArray(x)).asnumpy()
+    onp.testing.assert_allclose(got, y, rtol=1e-5, atol=1e-6)
+
+
+def test_golden_trainer_states_load():
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    net = _build_uninit()
+    net.load_parameters(os.path.join(GOLD, "mlp.params"))
+    tr = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1,
+                                       "momentum": 0.9},
+                     mesh=make_mesh({"dp": 1}))
+    tr.load_states(os.path.join(GOLD, "trainer.states"))
+    assert tr.num_update == 1
+    assert set(tr._opt_state) == set(tr._pkeys)
+    for st in tr._opt_state.values():
+        assert len(st) >= 1       # momentum slot present
+
+
+def test_golden_symbol_json_load():
+    x, y = _expected()
+    sym = mx.sym.load(os.path.join(GOLD, "mlp-symbol.json"))
+    net = _build_uninit()
+    net.load_parameters(os.path.join(GOLD, "mlp.params"))
+    args = {k: p.data() for k, p in net.collect_params().items()}
+    got = sym.bind(args={**args, "data": NDArray(x)}) \
+        .forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, y, rtol=1e-5, atol=1e-6)
+
+
+def test_golden_onnx_load():
+    x, y = _expected()
+    from mxnet_tpu.contrib import onnx as mx_onnx
+    sym, args, auxs = mx_onnx.import_model(
+        os.path.join(GOLD, "mlp.onnx"))
+    got = sym.bind(args={**args, **auxs, "data": NDArray(x)}) \
+        .forward()[0].asnumpy()
+    onp.testing.assert_allclose(got, y, rtol=1e-5, atol=1e-6)

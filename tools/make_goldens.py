@@ -1,0 +1,85 @@
+"""Regenerate the golden compatibility artifacts under tests/goldens/.
+
+Parity: tests/nightly/model_backwards_compatibility_check/ — the
+reference trains tiny models on old releases and asserts today's code
+still loads them.  Here the goldens are COMMITTED artifacts in every
+on-disk format the framework writes; tests/test_goldens.py loads each
+one and checks numerics, so any format change breaks loudly instead of
+silently orphaning users' saved models.
+
+Run me ONLY when a format change is intentional — then re-commit the
+goldens and bump the format notes in docs/PARITY.md.
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import loss as gloss, nn
+from mxnet_tpu.ndarray import NDArray
+
+OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tests", "goldens")
+
+
+def build_net():
+    mx.random.seed(42)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 4), onp.float32)))
+    return net
+
+
+def main():
+    os.makedirs(OUT, exist_ok=True)
+    rng = onp.random.RandomState(0)
+    x = rng.randn(2, 4).astype("float32")
+
+    # 1. ndarray save (dict form)
+    mx.nd.save(os.path.join(OUT, "arrays.ndarray"),
+               {"a": mx.nd.array(x), "b": mx.nd.array(x.T)})
+
+    net = build_net()
+
+    # 3. trainer optimizer states (npz v1)
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+    tr = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                     optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1,
+                                       "momentum": 0.9},
+                     mesh=make_mesh({"dp": 1}))
+    tr.step(x, onp.zeros((2,), "float32"))
+    tr.save_states(os.path.join(OUT, "trainer.states"))
+
+    # 2. gluon save_parameters (post-step, matching expected.npz)
+    net.save_parameters(os.path.join(OUT, "mlp.params"))
+
+    # 4. symbol json (traced graph)
+    sym, args, auxs = mx.sym.trace(net, mx.nd.array(x))
+    sym.save(os.path.join(OUT, "mlp-symbol.json"))
+
+    # 5. ONNX file (opset 12)
+    from mxnet_tpu.contrib import onnx as mx_onnx
+    mx_onnx.export_model(sym, {**args, **auxs}, [(2, 4)],
+                         onnx_file_path=os.path.join(OUT, "mlp.onnx"))
+
+    # expected forward output for the saved params + input
+    ref = net(mx.nd.array(x)).asnumpy()
+    onp.savez(os.path.join(OUT, "expected.npz"), x=x, y=ref)
+    print("goldens written to", OUT)
+    print("expected y:", ref)
+
+
+if __name__ == "__main__":
+    main()
