@@ -28,6 +28,7 @@ peak bf16 FLOP/s (by device kind).
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as onp
@@ -184,6 +185,102 @@ def _infer_bench(dtype, batch):
     return batch / batch_t
 
 
+def _make_rec(path, n=512, hw=IMAGE):
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import native
+
+    rng = onp.random.RandomState(0)
+    blobs = [rng.randint(0, 255, (hw, hw, 3), onp.uint8)
+             for _ in range(8)]
+    with native.NativeRecordWriter(path) as w:
+        for i in range(n):
+            hdr = recordio.IRHeader(flag=0, label=float(i % 10), id=i,
+                                    id2=0)
+            w.write(recordio.pack_img(hdr, blobs[i % 8], quality=90))
+    return path
+
+
+def _pipeline_bench(path, batch=64):
+    """Uncontended native input-pipeline rate (decode+augment+batch;
+    reference baseline 3,000 img/s, note_data_loading.md:181)."""
+    from mxnet_tpu.io import native
+
+    it = native.ImageRecordIter(
+        path, batch_size=batch, data_shape=(3, IMAGE, IMAGE),
+        rand_mirror=True, rand_crop=True,
+        preprocess_threads=min(8, os.cpu_count() or 4),
+        prefetch_buffer=4)
+    for _ in it:        # warm-up epoch (thread spin-up, page cache)
+        pass
+    best = 0.0
+    for _ in range(3):
+        it.reset()
+        t0 = time.perf_counter()
+        seen = 0
+        for b in it:
+            seen += b.data[0].shape[0] - b.pad
+        best = max(best, seen / (time.perf_counter() - t0))
+    it.close()
+    return best
+
+
+def _train_bench_datafed(path, dtype, batch, window=8, windows=3):
+    """Data-FED training rate: ImageRecordIter batches staged into
+    (window, batch, ...) arrays, trained via run_steps(per_step_data=
+    True) — one transfer + one launch per window.  End-to-end img/s
+    including decode/augment/staging; the delta vs the synthetic-tensor
+    row is the input-pipeline cost (round-1 'can the framework feed the
+    chip' question)."""
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.io import native
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    net = get_resnet(1, 50, classes=1000)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 3, IMAGE, IMAGE), onp.float32)))
+    trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                          optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.05,
+                                            "momentum": 0.9, "wd": 1e-4},
+                          mesh=make_mesh({"dp": -1}), dtype=dtype)
+
+    it = native.ImageRecordIter(
+        path, batch_size=batch, data_shape=(3, IMAGE, IMAGE),
+        rand_mirror=True, rand_crop=True,
+        preprocess_threads=min(8, os.cpu_count() or 4),
+        prefetch_buffer=4)
+
+    def next_window():
+        ds, ls = [], []
+        while len(ds) < window:
+            for b in it:
+                ds.append(b.data[0].asnumpy())
+                ls.append(b.label[0].asnumpy().astype("float32"))
+                if len(ds) == window:
+                    break
+            else:
+                it.reset()
+        return (jnp.asarray(onp.stack(ds)), jnp.asarray(onp.stack(ls)))
+
+    # warm-up: compile + first transfer
+    d, l = next_window()
+    _materialize(trainer.run_steps(d, l, window,
+                                   per_step_data=True)._data)
+    t0 = time.perf_counter()
+    for _ in range(windows):
+        d, l = next_window()
+        _materialize(trainer.run_steps(d, l, window,
+                                       per_step_data=True)._data)
+    dt = time.perf_counter() - t0
+    it.close()
+    return windows * window * batch / dt
+
+
 def _devices_or_die(timeout_s=180):
     """jax.devices() with a watchdog: a wedged tunnel must fail fast
     (observed: the axon relay can hang device init indefinitely), not
@@ -226,6 +323,21 @@ def main():
     infer32 = _infer_bench("float32", INFER_BS)
     infer16 = _infer_bench("bfloat16", INFER_BS)
 
+    # feed-the-chip: pipeline-only rate + data-FED training rate
+    pipe_img_s = datafed_img_s = None
+    import shutil
+    import tempfile
+    tmp = tempfile.mkdtemp()
+    try:
+        rec = _make_rec(os.path.join(tmp, "bench.rec"))
+        pipe_img_s = _pipeline_bench(rec)
+        datafed_img_s = _train_bench_datafed(rec, "bfloat16",
+                                             TRAIN_BS_BF16)
+    except Exception as e:      # pragma: no cover
+        print(f"# datafed bench skipped: {e}", flush=True)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
     extra = {
         "device_kind": kind,
         "train_fp32_bs%d_img_s" % TRAIN_BS_FP32: round(fp32_img_s, 2),
@@ -238,6 +350,10 @@ def main():
         "infer_fp32_vs_v100_1233": round(infer32 / INFER_BASE_FP32, 3),
         "infer_bf16_bs%d_img_s" % INFER_BS: round(infer16, 2),
         "infer_bf16_vs_v100_fp16_2355": round(infer16 / INFER_BASE_FP16, 3),
+        "pipeline_img_s_vs_ref_3000": (round(pipe_img_s, 1)
+                                       if pipe_img_s else None),
+        "train_bf16_datafed_img_s": (round(datafed_img_s, 2)
+                                     if datafed_img_s else None),
         "method_note": "marginal (slope) timing over fused device-side "
                        "windows with device_get sync — steady-state "
                        "per-step rate; launch/tunnel latency excluded",

@@ -251,32 +251,46 @@ class SPMDTrainer:
                          donate_argnums=donate)
         return jitted, cell
 
+    def _window_sharding(self, ndim):
+        """Sharding for a (n_steps, batch, ...) window: the leading
+        step axis is replicated, batch/seq axes shift right by one."""
+        inner = self._batch_sharding(ndim - 1)
+        return NamedSharding(self.mesh,
+                             PartitionSpec(None, *inner.spec))
+
     def _build_multi(self, data_shape, data_dtype, label_shape, label_dtype,
-                     n_steps):
+                     n_steps, per_step_data=False):
         """Fused multi-step: ``n_steps`` full train steps inside ONE
         executable via lax.scan — the engine-bulking idea
         (MXNET_EXEC_BULK_EXEC_*, SURVEY.md §3.3) taken to its XLA-native
         limit.  One launch per n steps amortizes dispatch/launch
-        latency; lr/wd are held fixed across the fused window."""
+        latency; lr/wd are held fixed across the fused window.
+
+        ``per_step_data``: data/label carry a leading ``n_steps`` axis
+        and the scan consumes one batch per step — the data-fed window
+        (input pipeline → device once per window, not per step)."""
         step, cell, params = self._make_step_fn()
 
         def many(key, lr, wd, p_arrays, opt_state, data, label):
-            def body(carry, _):
+            def body(carry, xs):
                 key, p, s = carry
+                d, l = (data, label) if xs is None else xs
                 key, sub = jax.random.split(key)
-                new_p, new_s, loss, _aux = step(sub, lr, wd, p, s,
-                                                data, label)
+                new_p, new_s, loss, _aux = step(sub, lr, wd, p, s, d, l)
                 return (key, new_p, new_s), loss
             (key, p, s), losses = jax.lax.scan(
-                body, (key, list(p_arrays), list(opt_state)), None,
-                length=n_steps)
+                body, (key, list(p_arrays), list(opt_state)),
+                (data, label) if per_step_data else None,
+                length=None if per_step_data else n_steps)
             return p, s, losses
 
         p_shardings, s_shardings = self._state_shardings(params)
         rep = NamedSharding(self.mesh, PartitionSpec())
+        shard_of = (self._window_sharding if per_step_data
+                    else self._batch_sharding)
         in_shardings = (rep, rep, rep, p_shardings, s_shardings,
-                        self._batch_sharding(len(data_shape)),
-                        self._batch_sharding(len(label_shape)))
+                        shard_of(len(data_shape)),
+                        shard_of(len(label_shape)))
         donate = (3, 4) if self._donate else ()
         jitted = jax.jit(many, in_shardings=in_shardings,
                          out_shardings=(p_shardings, s_shardings, rep),
@@ -322,23 +336,37 @@ class SPMDTrainer:
                 if id(param) not in covered:
                     param._data._rebind(new)
 
-    def run_steps(self, data, label, n_steps: int):
+    def run_steps(self, data, label, n_steps: int,
+                  per_step_data: bool = False):
         """Run ``n_steps`` fused training steps in ONE device program
-        (lax.scan) on the same batch signature; returns the per-step
-        losses as an (n_steps,) NDArray.
+        (lax.scan); returns the per-step losses as an (n_steps,)
+        NDArray.
 
         This is the device-side training loop: one launch per window, so
         per-step dispatch/launch latency is amortized away — the XLA
         analogue of the reference executing a whole bulked segment as a
         single engine op (cached_op.cc:499-513).  lr/wd are frozen for
-        the window; ``num_update`` advances by ``n_steps``."""
+        the window; ``num_update`` advances by ``n_steps``.
+
+        With ``per_step_data=True``, ``data``/``label`` carry a leading
+        ``n_steps`` axis and the scan consumes one REAL batch per step —
+        the feed-the-chip window: stage a whole window of input-pipeline
+        batches onto the device in one transfer, then train through them
+        in one launch."""
         d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
-        sig = (d.shape, str(d.dtype), l.shape, str(l.dtype), int(n_steps))
+        if per_step_data and (d.shape[0] != n_steps
+                              or l.shape[0] != n_steps):
+            raise MXNetError(
+                f"run_steps(per_step_data=True): leading axis must be "
+                f"n_steps={n_steps}, got data {d.shape} label {l.shape}")
+        sig = (d.shape, str(d.dtype), l.shape, str(l.dtype), int(n_steps),
+               bool(per_step_data))
         entry = self._step_cache.get(sig)
         if entry is None:
             entry = self._build_multi(d.shape, str(d.dtype), l.shape,
-                                      str(l.dtype), int(n_steps))
+                                      str(l.dtype), int(n_steps),
+                                      per_step_data=per_step_data)
             self._step_cache[sig] = entry
         jitted, cell = entry
         # read lr/wd BEFORE advancing num_update — matching what the
@@ -411,7 +439,7 @@ class SPMDTrainer:
         l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
         if n_steps is not None:
-            sig = sig + (int(n_steps),)
+            sig = sig + (int(n_steps), False)
         cached = getattr(self, "_cost_cache", {}).get(sig)
         if cached is not None:
             return cached

@@ -462,3 +462,45 @@ def test_trainer_states_rejects_foreign_file(tmp_path):
     onp.savez(str(bad), foo=onp.zeros(3))
     with pytest.raises(MXNetError):
         tr.load_states(str(bad))
+
+
+def test_run_steps_per_step_data_matches_sequential():
+    """The data-fed window (per_step_data=True) must train exactly as
+    n sequential step() calls on the same batches."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    def build():
+        mx.random.seed(3)
+        net = nn.Dense(3)
+        net.initialize(init=mx.initializer.Xavier())
+        net(NDArray(onp.zeros((1, 4), onp.float32)))
+        return net
+
+    rng = onp.random.RandomState(0)
+    W, B = 5, 8
+    data = rng.randn(W, B, 4).astype("float32")
+    label = rng.randint(0, 3, (W, B)).astype("float32")
+    kw = dict(optimizer="sgd",
+              optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+              mesh=make_mesh({"dp": -1}))
+
+    a = build()
+    ta = SPMDTrainer(a, gloss.SoftmaxCrossEntropyLoss(), **kw)
+    seq_losses = [float(ta.step(data[i], label[i]).asnumpy())
+                  for i in range(W)]
+
+    b = build()
+    tb = SPMDTrainer(b, gloss.SoftmaxCrossEntropyLoss(), **kw)
+    win_losses = tb.run_steps(data, label, W, per_step_data=True).asnumpy()
+
+    onp.testing.assert_allclose(win_losses, seq_losses, rtol=1e-5,
+                                atol=1e-6)
+    pa, pb = a.collect_params(), b.collect_params()
+    for k in pa:
+        onp.testing.assert_allclose(pa[k].data().asnumpy(),
+                                    pb[k].data().asnumpy(),
+                                    rtol=1e-5, atol=1e-6)
