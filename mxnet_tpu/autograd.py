@@ -284,9 +284,15 @@ def _make_bwd(fn, n_in, multi):
     shared by the eager and jitted backward paths so they can't
     diverge."""
     def bwd(*args):
-        _, vjp_fn = jax.vjp(fn, *args[:n_in])
+        out, vjp_fn = jax.vjp(fn, *args[:n_in])
         cts = args[n_in:]
-        return vjp_fn(tuple(cts) if multi else cts[0])
+        if multi:
+            # cotangents must match the primal output's pytree exactly
+            # (some multi-out ops return lists, others tuples)
+            ct = jax.tree.unflatten(jax.tree.structure(out), list(cts))
+        else:
+            ct = cts[0]
+        return vjp_fn(ct)
 
     return bwd
 
