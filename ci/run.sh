@@ -35,4 +35,20 @@ sanitize() {          # import + compile sanity, no test run
     python -m compileall -q mxnet_tpu benchmark tools
 }
 
+nightly() {           # slower second-tier pass rerun in isolation
+    # (parity: tests/nightly/ + the reference's CI matrix)
+    sanitize
+    # large-tensor x64 switch on
+    MXNET_INT64_TENSOR_SIZE=1 python -m pytest tests/test_large_tensor.py \
+        tests/test_ndarray.py -q
+    # 2-process distributed kvstore (sync + SSP async + fused batching)
+    python -m pytest tests/test_dist_kvstore.py -q
+    # golden-artifact backwards compatibility
+    python -m pytest tests/test_goldens.py -q
+    # eager dispatch + whole-step-compile regression guards
+    python -m pytest tests/test_eager_dispatch.py -q
+    # multichip dryrun with numerics assertions
+    multichip_dryrun 8
+}
+
 "$@"

@@ -10,7 +10,8 @@ from ..ops.random import next_key, seed  # noqa: F401
 from . import ndarray
 
 __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
-           "shuffle", "permutation", "gamma", "beta", "exponential",
+           "shuffle", "permutation", "gamma", "beta", "dirichlet",
+           "exponential",
            "poisson", "multinomial", "multivariate_normal", "logistic",
            "gumbel", "laplace", "rayleigh", "pareto", "power", "weibull",
            "chisquare", "f", "lognormal", "binomial", "geometric"]
@@ -82,6 +83,16 @@ def gamma(shape, scale=1.0, size=None, dtype=None, ctx=None, out=None):
 def beta(a, b, size=None, dtype=None, ctx=None):
     return ndarray(jax.random.beta(next_key(), a, b, _shape(size),
                                    np_dtype(dtype or "float32")))
+
+
+def dirichlet(alpha, size=None, dtype=None, ctx=None):
+    """Dirichlet sampler (parity: np.random.dirichlet /
+    _npi_dirichlet, np_random_dirichlet_op.cc)."""
+    a = jnp.asarray(getattr(alpha, "_data", alpha),
+                    np_dtype(dtype or "float32"))
+    batch = None if size is None else _shape(size)
+    return ndarray(jax.random.dirichlet(next_key(), a, batch,
+                                        np_dtype(dtype or "float32")))
 
 
 def exponential(scale=1.0, size=None, dtype=None, ctx=None, out=None):

@@ -239,6 +239,20 @@ def _npi_gamma(key, *params, shape=1.0, scale=1.0, size=None, ctx=None,
     return jax.random.gamma(key, a, out_shape, dtype) * scale
 
 
+@register("_npi_dirichlet")
+def _npi_dirichlet(key, *params, alpha=None, size=None, ctx=None,
+                   dtype=jnp.float32):
+    """Dirichlet sampler (parity: np_random_dirichlet_op.cc;
+    jax.random.dirichlet over the trailing concentration axis)."""
+    a = jnp.asarray(params[0] if params else alpha, dtype)
+    if a.ndim < 1:
+        raise ValueError("dirichlet: alpha must be at least 1-d")
+    batch = None if size is None else (
+        (size,) if isinstance(size, int) else tuple(size))
+    out = jax.random.dirichlet(key, a, batch, dtype)
+    return out
+
+
 @register("_npi_gumbel")
 def _npi_gumbel(key, *params, loc=0.0, scale=1.0, size=None, ctx=None,
                 dtype=jnp.float32):

@@ -114,9 +114,17 @@ def test_name_manager_scope_resets_counter():
     assert a._outputs[0][0].name == b._outputs[0][0].name
 
 
-def test_variable_rejects_non_string_attr():
+def test_variable_known_kwargs_stringify():
+    # the reference's var() accepts lr_mult/wd_mult/init/stype and
+    # stringifies them into __dunder__ attrs; unknown non-string attrs
+    # still raise
+    v = sym.Variable("w", lr_mult=2)
+    assert v.list_attr().get("__lr_mult__") == "2"
+    import mxnet_tpu as _mx
+    v2 = sym.Variable("w2", init=_mx.initializer.Zero())
+    assert v2.list_attr().get("__init__") == '["zero", {}]'
     with pytest.raises(ValueError, match="string"):
-        sym.Variable("w", lr_mult=2)
+        sym.Variable("w3", my_custom_attr=2)
 
 
 def test_attrs_survive_compose_and_serialization(tmp_path):
