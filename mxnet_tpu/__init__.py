@@ -7,6 +7,25 @@ reference: ``mx.nd``, ``mx.np``, ``mx.autograd``, ``mx.gluon``,
 """
 __version__ = "0.1.0"
 
+import os as _os
+
+# Some environments (e.g. a sitecustomize that force-registers an
+# accelerator backend) override the user's JAX_PLATFORMS at interpreter
+# start — both the jax config AND the env var itself (it exports its
+# own platform name).  Re-assert the user's explicit choice so
+# ``JAX_PLATFORMS=cpu python script.py`` means what it says, but leave
+# the injector's own value alone (re-asserting it would also clobber
+# later programmatic jax.config.update("jax_platforms", ...) calls).
+_want_platform = _os.environ.get("JAX_PLATFORMS")
+if _want_platform and "axon" not in _want_platform:
+    import jax as _jax
+    if (_jax.config.jax_platforms or "") != _want_platform:
+        _jax.config.update("jax_platforms", _want_platform)
+        from jax._src import xla_bridge as _xb
+        if _xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends as _cb
+            _cb()
+
 from .base import MXNetError
 from .context import (Context, cpu, tpu, gpu, cpu_pinned, current_context,
                       num_gpus, num_tpus)

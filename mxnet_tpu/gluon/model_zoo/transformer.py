@@ -32,7 +32,8 @@ class MultiHeadAttention(HybridBlock):
     (Pallas flash kernel underneath)."""
 
     def __init__(self, units, num_heads, causal=False, use_flash=True,
-                 num_kv_heads=None, ring_mesh=None, **kwargs):
+                 num_kv_heads=None, ring_mesh=None, sp_mode="ring",
+                 **kwargs):
         super().__init__(**kwargs)
         if units % num_heads:
             raise MXNetError(f"units {units} not divisible by num_heads "
@@ -46,10 +47,15 @@ class MultiHeadAttention(HybridBlock):
         self._causal = causal
         self._flash = use_flash
         # sequence parallelism: when a mesh with an "sp" axis is given,
-        # attention runs as ring attention over that axis (sequence
-        # shards exchange K/V blocks by collective-permute) — the
-        # long-context training path (parallel/ring_attention.py)
+        # attention runs context-parallel over that axis.  sp_mode
+        # picks the scheme: "ring" (K/V blocks rotate by
+        # collective-permute, parallel/ring_attention.py) or "ulysses"
+        # (two all-to-alls re-shard sequence<->heads,
+        # parallel/ulysses.py) — the long-context training path
         self._ring_mesh = ring_mesh
+        if sp_mode not in ("ring", "ulysses"):
+            raise MXNetError(f"sp_mode {sp_mode!r}: 'ring' or 'ulysses'")
+        self._sp_mode = sp_mode
         hkv = num_kv_heads if num_kv_heads is not None else num_heads
         kv_units = (units // num_heads) * hkv
         self._kv_units = kv_units
@@ -74,19 +80,21 @@ class MultiHeadAttention(HybridBlock):
         return self.out_proj(attn)
 
     def _ring_forward(self, q, k, v):
-        import jax.numpy as jnp
         from ...ops.registry import apply_jax
-        from ...parallel import ring_self_attention
+        from ...parallel import ring_self_attention, ulysses_self_attention
 
         heads, causal, mesh = self._heads, self._causal, self._ring_mesh
         hkv = self._kv_heads if self._kv_heads is not None else heads
+        sp_attn = (ring_self_attention if self._sp_mode == "ring"
+                   else ulysses_self_attention)
 
         def fn(qa, ka, va):
             from ...ops.attention import merge_heads, split_heads
             # GQA: the SMALL (hkv-head) K/V enter the ring — the ring
             # body broadcasts per block, so ppermute traffic stays
-            # hkv/heads of the naive pre-expanded form
-            out = ring_self_attention(
+            # hkv/heads of the naive pre-expanded form (ulysses expands
+            # K/V only when hkv doesn't divide the axis size)
+            out = sp_attn(
                 split_heads(qa, heads), split_heads(ka, hkv),
                 split_heads(va, hkv), mesh, causal=causal)
             return merge_heads(out)

@@ -236,3 +236,111 @@ def test_switch_moe_expert_parallel_compiles_and_matches():
 
     g = jax.jit(jax.grad(loss))(tuple(sharded), xs)
     assert all(onp.isfinite(onp.asarray(gi)).all() for gi in g)
+
+
+def test_ulysses_matches_dense():
+    """Ulysses all-to-all attention == dense single-device attention,
+    forward + gradient, causal and non-causal."""
+    from mxnet_tpu.parallel import ulysses_self_attention
+
+    B, H, S, D, NSP = 2, 4, 16, 4, 4
+    rng = onp.random.RandomState(5)
+    mesh = make_mesh({"sp": NSP})
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    wo = jnp.asarray(rng.randn(D, D).astype(onp.float32))
+
+    for causal in (False, True):
+        # differentiate wrt q AND wo so gradients flow through BOTH
+        # all-to-alls (their transpose rules), not just downstream
+        def uly_loss(qq, w):
+            o = ulysses_self_attention(qq, k, v, mesh, causal=causal)
+            return jnp.mean((o @ w) ** 2)
+
+        def dense_loss(qq, w):
+            s = (qq @ jnp.swapaxes(k, -1, -2)) / (D ** 0.5)
+            if causal:
+                m = jnp.tril(jnp.ones((S, S), bool))
+                s = jnp.where(m, s, -1e30)
+            o = jax.nn.softmax(s, axis=-1) @ v
+            return jnp.mean((o @ w) ** 2)
+
+        l_u, (gq_u, gw_u) = jax.value_and_grad(
+            uly_loss, argnums=(0, 1))(q, wo)
+        l_d, (gq_d, gw_d) = jax.value_and_grad(
+            dense_loss, argnums=(0, 1))(q, wo)
+        onp.testing.assert_allclose(float(l_u), float(l_d), rtol=1e-4)
+        onp.testing.assert_allclose(onp.asarray(gq_u),
+                                    onp.asarray(gq_d),
+                                    rtol=1e-3, atol=1e-5)
+        onp.testing.assert_allclose(onp.asarray(gw_u),
+                                    onp.asarray(gw_d),
+                                    rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("H,HKV,NSP", [
+    (4, 2, 4),    # hkv % p != 0: pre-expanded path
+    (8, 4, 4),    # hkv % p == 0, group 2: small-K/V a2a + local repeat
+    (4, 4, 4),    # MHA (no grouping)
+    (8, 2, 2),    # group 4, small axis
+])
+def test_ulysses_gqa_expand(H, HKV, NSP):
+    """GQA K/V must match the dense GQA reference on both the
+    pre-expanded and the small-K/V-all-to-all paths."""
+    from mxnet_tpu.parallel import ulysses_self_attention
+
+    B, S, D = 1, 8 * NSP, 4
+    rng = onp.random.RandomState(6)
+    mesh = make_mesh({"sp": NSP})
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(onp.float32))
+    k = jnp.asarray(rng.randn(B, HKV, S, D).astype(onp.float32))
+    v = jnp.asarray(rng.randn(B, HKV, S, D).astype(onp.float32))
+
+    got = ulysses_self_attention(q, k, v, mesh)
+    ke = jnp.repeat(k, H // HKV, axis=1)
+    ve = jnp.repeat(v, H // HKV, axis=1)
+    s = (q @ jnp.swapaxes(ke, -1, -2)) / (D ** 0.5)
+    want = jax.nn.softmax(s, axis=-1) @ ve
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(want),
+                                rtol=1e-4, atol=1e-5)
+
+
+def test_ulysses_bad_head_counts_raise():
+    from mxnet_tpu.parallel import ulysses_self_attention
+
+    mesh = make_mesh({"sp": 4})
+    q = jnp.zeros((1, 4, 8, 4), jnp.float32)
+    bad_kv = jnp.zeros((1, 3, 8, 4), jnp.float32)
+    with pytest.raises(Exception, match="not divisible by kv heads"):
+        ulysses_self_attention(q, bad_kv, bad_kv, mesh)
+    q6 = jnp.zeros((1, 6, 8, 4), jnp.float32)
+    with pytest.raises(Exception, match="not divisible by axis"):
+        ulysses_self_attention(q6, q6, q6, mesh)
+
+
+def test_mha_sp_mode_ulysses_matches_ring():
+    """MultiHeadAttention(sp_mode='ulysses') trains to the same loss
+    as sp_mode='ring' and as the dense single-device layer."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import MultiHeadAttention
+    from mxnet_tpu.ndarray import NDArray
+
+    B, S, U, H, NSP = 2, 16, 8, 4, 4
+    rng = onp.random.RandomState(7)
+    x = rng.randn(B, S, U).astype("float32")
+    mesh = make_mesh({"sp": NSP})
+
+    outs = {}
+    for mode, m in (("dense", None), ("ring", mesh), ("ulysses", mesh)):
+        mx.random.seed(11)
+        kw = dict(causal=True, use_flash=False)
+        if m is not None:
+            kw.update(ring_mesh=m, sp_mode=mode)
+        mha = MultiHeadAttention(U, H, **kw)
+        mha.initialize(init=mx.initializer.Xavier())
+        outs[mode] = mha(NDArray(x)).asnumpy()
+    onp.testing.assert_allclose(outs["ring"], outs["dense"],
+                                rtol=1e-4, atol=1e-5)
+    onp.testing.assert_allclose(outs["ulysses"], outs["dense"],
+                                rtol=1e-4, atol=1e-5)
