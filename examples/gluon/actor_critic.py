@@ -1,0 +1,169 @@
+"""Actor-critic reinforcement learning on a built-in pole environment.
+
+Parity: example/gluon/actor_critic — one network with a policy head
+and a value head, trained by advantage actor-critic.  The environment
+is a self-contained cart-pole-style balancing task (no gym in this
+image): a pole angle/velocity pair, push left/right, episode ends when
+|angle| exceeds the limit.
+
+Shows the imperative strength of the gluon API: sampling actions from
+the policy INSIDE the episode loop, then one autograd.record pass over
+the collected episode.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.ndarray import NDArray
+
+
+MAX_STEPS = 200
+
+
+class PoleEnv:
+    """Minimal pole balancing: state (angle, angular velocity)."""
+
+    LIMIT = 0.6
+    DT = 0.05
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.reset()
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 2).astype("float32")
+        return self.s.copy()
+
+    def step(self, action):
+        a, w = self.s
+        torque = 0.35 if action == 1 else -0.35
+        w = w + (onp.sin(a) * 2.0 + torque) * self.DT
+        a = a + w * self.DT
+        self.s = onp.asarray([a, w], "float32")
+        done = abs(a) > self.LIMIT
+        # shaped reward: staying alive is good, staying UPRIGHT is
+        # better — gives the critic a gradient before the first fall
+        r = 1.0 - abs(a) / self.LIMIT
+        return self.s.copy(), float(r), bool(done)
+
+
+class ActorCritic(mx.gluon.HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.trunk = nn.Dense(64, activation="relu")
+        self.policy = nn.Dense(2)
+        self.value = nn.Dense(1)
+
+    def forward(self, x):
+        h = self.trunk(x)
+        return self.policy(h), self.value(h)
+
+
+def run_episode(env, net, rng, max_steps=MAX_STEPS):
+    states, actions, rewards = [], [], []
+    s = env.reset()
+    done = False
+    for _ in range(max_steps):
+        logits, _ = net(NDArray(s[None]))
+        z = logits.asnumpy()[0]
+        p = onp.exp(z - z.max())          # stable softmax
+        p = p / p.sum()
+        a = rng.choice(2, p=p)
+        states.append(s)
+        actions.append(a)
+        s, r, done = env.step(a)
+        rewards.append(r)
+        if done:
+            break
+    # bootstrap value for a time-limit cutoff: surviving to the cap is
+    # NOT a terminal state — without this, long (good) episodes look
+    # low-return at the tail and the policy unlearns balancing
+    tail = 0.0
+    if not done:
+        _, v = net(NDArray(s[None]))
+        tail = float(v.asnumpy()[0, 0])
+    return states, actions, rewards, tail
+
+
+def train(episodes=300, gamma=0.99, lr=1e-2, seed=0, verbose=True):
+    mx.random.seed(seed)
+    rng = onp.random.RandomState(seed)
+    env = PoleEnv(onp.random.RandomState(seed + 1))
+    net = ActorCritic()
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 2), "float32")))
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": lr})
+    lengths = []
+    for ep in range(episodes):
+        states, actions, rewards, tail = run_episode(env, net, rng)
+        # discounted returns (bootstrapped at a non-terminal cutoff)
+        G, ret = tail, []
+        for r in reversed(rewards):
+            G = r + gamma * G
+            ret.append(G)
+        ret.reverse()
+        # pad the episode to max_steps with a validity mask: STATIC
+        # shapes mean one compiled executable for every episode (the
+        # TPU way — variable shapes would retrace per episode length)
+        T, cap = len(states), MAX_STEPS
+        S_np = onp.zeros((cap, 2), "float32")
+        S_np[:T] = onp.asarray(states, "float32")
+        A_np = onp.zeros((cap,), "float32")
+        A_np[:T] = onp.asarray(actions, "float32")
+        R_np = onp.zeros((cap, 1), "float32")
+        R_np[:T, 0] = onp.asarray(ret, "float32")
+        M_np = onp.zeros((cap,), "float32")
+        M_np[:T] = 1.0
+        S, Rt = NDArray(S_np), NDArray(R_np)
+        mask = NDArray(M_np)
+        n_valid = float(T)
+        with autograd.record():
+            logits, values = net(S)
+            logp = mx.nd.log_softmax(logits, axis=-1)
+            chosen = mx.nd.pick(logp, NDArray(A_np), axis=-1)
+            adv = (Rt - values).detach().reshape((-1,))
+            # normalize advantages over the VALID steps; entropy bonus
+            # keeps exploration alive (standard A2C stabilizers)
+            a_np = adv.asnumpy()[:T]
+            a_norm = onp.zeros((cap,), "float32")
+            a_norm[:T] = (a_np - a_np.mean()) / (a_np.std() + 1e-6)
+            adv = NDArray(a_norm)
+            policy_loss = -(chosen * adv * mask).sum() / n_valid
+            value_loss = (((values - Rt).reshape((-1,)) * mask) ** 2
+                          ).sum() / n_valid
+            entropy = (-(logp.exp() * logp).sum(axis=-1) * mask
+                       ).sum() / n_valid
+            loss = policy_loss + 0.5 * value_loss - 0.01 * entropy
+        loss.backward()
+        trainer.step(1)
+        lengths.append(len(rewards))
+        if verbose and ep % 25 == 0:
+            avg = onp.mean(lengths[-25:])
+            print(f"episode {ep}: length {len(rewards)} "
+                  f"(avg25 {avg:.1f})")
+    return net, lengths
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--episodes", type=int, default=300)
+    args = p.parse_args(argv)
+    net, lengths = train(episodes=args.episodes)
+    early = onp.mean(lengths[:20])
+    late = onp.mean(lengths[-20:])
+    print(f"episode length: first20 {early:.1f} -> last20 {late:.1f}")
+
+
+if __name__ == "__main__":
+    main()

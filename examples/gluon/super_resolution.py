@@ -1,0 +1,108 @@
+"""Super-resolution with a sub-pixel (depth_to_space) CNN.
+
+Parity: example/gluon/super_resolution — the ESPCN idea: convolutions
+in low-resolution space, then one `depth_to_space` (PixelShuffle)
+rearranges r^2 channels into an r-times-larger image.  Synthetic data
+(random smooth images downsampled 2x) keeps it self-contained; PSNR
+against bicubic-free naive upsampling shows the gain.
+
+TPU notes: depth_to_space is a pure layout op XLA fuses for free; the
+whole net is conv work on the MXU at LOW resolution — the reason this
+architecture maps well to TPU.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ops import registry as _ops
+
+R = 2          # upscale factor
+LO = 16        # low-res size
+
+
+class SubPixelSR(mx.gluon.HybridBlock):
+    def __init__(self, r=R, **kwargs):
+        super().__init__(**kwargs)
+        self.r = r
+        self.body = nn.HybridSequential()
+        self.body.add(nn.Conv2D(32, 5, padding=2, activation="relu"),
+                      nn.Conv2D(16, 3, padding=1, activation="relu"),
+                      nn.Conv2D(r * r, 3, padding=1))
+
+    def forward(self, x):
+        y = self.body(x)
+        return _ops.invoke("depth_to_space", [y], block_size=self.r)
+
+
+def smooth_images(rng, n, hw):
+    """Random smooth fields: superposition of a few low-freq waves."""
+    yy, xx = onp.mgrid[0:hw, 0:hw] / hw
+    img = onp.zeros((n, hw, hw))
+    for _ in range(4):
+        fx, fy = rng.randint(1, 4, 2)
+        ph = rng.rand(n, 1, 1) * 6.28
+        img += onp.sin(2 * onp.pi * (fx * xx + fy * yy) + ph)
+    img = (img - img.min()) / (onp.ptp(img) + 1e-9)
+    return img[:, None].astype("float32")
+
+
+def make_pairs(rng, n):
+    hi = smooth_images(rng, n, LO * R)
+    lo = hi.reshape(n, 1, LO, R, LO, R).mean((3, 5))
+    return lo.astype("float32"), hi
+
+
+def psnr(a, b):
+    mse = float(onp.mean((a - b) ** 2))
+    return 10 * onp.log10(1.0 / max(mse, 1e-12))
+
+
+def train(iters=200, batch=16, lr=1e-3, seed=0, verbose=True):
+    mx.random.seed(seed)
+    rng = onp.random.RandomState(seed)
+    net = SubPixelSR()
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 1, LO, LO), "float32")))
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": lr})
+    l2 = gloss.L2Loss()
+    losses = []
+    for i in range(iters):
+        lo, hi = make_pairs(rng, batch)
+        with autograd.record():
+            out = net(NDArray(lo))
+            loss = l2(out, NDArray(hi)).mean()
+        loss.backward()
+        trainer.step(1)
+        losses.append(float(loss.asnumpy()))
+        if verbose and i % 50 == 0:
+            print(f"iter {i}: loss {losses[-1]:.5f}")
+    return net, losses
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=200)
+    args = p.parse_args(argv)
+    net, losses = train(iters=args.iters)
+    rng = onp.random.RandomState(123)
+    lo, hi = make_pairs(rng, 32)
+    sr = net(NDArray(lo)).asnumpy()
+    naive = onp.repeat(onp.repeat(lo, R, 2), R, 3)
+    print(f"PSNR: subpixel {psnr(sr, hi):.2f} dB vs nearest-repeat "
+          f"{psnr(naive, hi):.2f} dB")
+
+
+if __name__ == "__main__":
+    main()
