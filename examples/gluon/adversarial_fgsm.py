@@ -1,0 +1,99 @@
+"""FGSM adversarial examples: gradients with respect to the INPUT.
+
+Parity: example/adversary — train a small classifier, then perturb
+test images along the sign of the input gradient
+(x' = x + eps * sign(dL/dx)) and watch accuracy collapse while the
+perturbation stays imperceptibly small.
+
+The operative API: ``x.attach_grad()`` + ``autograd.record`` makes the
+data a differentiable leaf, exactly like a parameter — the backward
+pass fills ``x.grad``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from mxnet_tpu.ndarray import NDArray
+
+
+def synth_digits(rng, n):
+    """10-class 8x8 'digits': class k lights row k with noise."""
+    y = rng.randint(0, 10, n)
+    x = rng.randn(n, 1, 8, 8).astype("float32") * 0.6
+    for i in range(n):
+        x[i, 0, y[i] % 8, :] += 1.0
+        if y[i] >= 8:
+            x[i, 0, :, y[i] % 8] += 1.0
+    return x, y.astype("float32")
+
+
+def build():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(16, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2), nn.Flatten(),
+            nn.Dense(64, activation="relu"), nn.Dense(10))
+    return net
+
+
+def train(iters=150, batch=64, lr=5e-3, seed=0, verbose=True):
+    mx.random.seed(seed)
+    rng = onp.random.RandomState(seed)
+    net = build()
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 1, 8, 8), "float32")))
+    trainer = Trainer(net.collect_params(), "adam",
+                      {"learning_rate": lr})
+    ce = gloss.SoftmaxCrossEntropyLoss()
+    for i in range(iters):
+        x, y = synth_digits(rng, batch)
+        with autograd.record():
+            loss = ce(net(NDArray(x)), NDArray(y)).mean()
+        loss.backward()
+        trainer.step(1)
+        if verbose and i % 50 == 0:
+            print(f"iter {i}: loss {float(loss.asnumpy()):.4f}")
+    return net
+
+
+def accuracy(net, x, y):
+    pred = net(NDArray(x)).asnumpy().argmax(-1)
+    return float((pred == y).mean())
+
+
+def fgsm(net, x, y, eps):
+    """x + eps * sign(dL/dx) (parity: example/adversary FGSM cell)."""
+    ce = gloss.SoftmaxCrossEntropyLoss()
+    xv = NDArray(x)
+    xv.attach_grad()
+    with autograd.record():
+        loss = ce(net(xv), NDArray(y)).mean()
+    loss.backward()
+    return x + eps * onp.sign(xv.grad.asnumpy())
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=150)
+    p.add_argument("--eps", type=float, default=0.5)
+    args = p.parse_args(argv)
+    net = train(iters=args.iters)
+    rng = onp.random.RandomState(99)
+    x, y = synth_digits(rng, 512)
+    clean = accuracy(net, x, y)
+    adv = accuracy(net, fgsm(net, x, y, args.eps), y)
+    print(f"accuracy: clean {clean:.3f} -> adversarial(eps={args.eps}) "
+          f"{adv:.3f}")
+
+
+if __name__ == "__main__":
+    main()

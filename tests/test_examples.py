@@ -172,3 +172,30 @@ def test_actor_critic_example():
     assert probs[-0.3] > probs[0.3] + 0.2, probs
     assert onp.mean(lengths[-30:]) > onp.mean(lengths[:30]) * 0.9, \
         (onp.mean(lengths[:30]), onp.mean(lengths[-30:]))
+
+
+def test_fgsm_example():
+    """Input-gradient attack collapses accuracy while training was
+    clean (parity: example/adversary)."""
+    m = _load("gluon/adversarial_fgsm.py", "fgsm_example")
+    net = m.train(iters=80, verbose=False)
+    rng = onp.random.RandomState(99)
+    x, y = m.synth_digits(rng, 256)
+    clean = m.accuracy(net, x, y)
+    adv = m.accuracy(net, m.fgsm(net, x, y, 0.5), y)
+    assert clean > 0.8, clean
+    assert adv < clean - 0.3, (clean, adv)
+
+
+def test_vae_example():
+    """ELBO decreases and reconstructions beat the mean-image baseline
+    (parity: example/autoencoder via gluon.probability)."""
+    m = _load("gluon/vae.py", "vae_example")
+    net, hist = m.train(iters=150, verbose=False)
+    assert hist[-1] < hist[0] * 0.5, (hist[0], hist[-1])
+    rng = onp.random.RandomState(1)
+    x = m.manifold_images(rng, 128)
+    recon, _ = net(m.NDArray(x))
+    mse = float(onp.mean((recon.asnumpy() - x) ** 2))
+    base = float(onp.mean((x - x.mean(0)) ** 2))
+    assert mse < base * 0.7, (mse, base)

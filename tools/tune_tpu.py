@@ -1,0 +1,188 @@
+"""One-command on-chip tuning sweep — run when the TPU tunnel is up.
+
+Feeds VERDICT's round-3 perf item: once real hardware is reachable,
+sweep the knobs that set the bf16 MFU ceiling and print JSON
+recommendations to bake into bench.py / model defaults:
+
+1. flash-attention block sizes (block_q x block_k) on a training-shaped
+   attention problem;
+2. ResNet-50 bf16 fused-window training step over candidate batch
+   sizes (MXU utilization vs HBM pressure);
+3. buffer donation on/off for the training window.
+
+All timings use bench.py's tunnel-honest methodology: fused device-side
+windows, device_get sync, marginal (slope) rate between two window
+lengths — see bench.py's module doc for why anything else lies here.
+
+Usage:  python tools/tune_tpu.py [--quick]
+"""
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp
+
+import bench  # the methodology lives there; reuse, don't re-derive
+
+
+def tune_flash_blocks(quick=False):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from mxnet_tpu.ops.attention import flash_attention
+
+    B, H, S, D = 4, 16, 4096, 128
+    q = jnp.asarray(onp.random.RandomState(0)
+                    .randn(B, H, S, D).astype("float32")).astype(
+                        jnp.bfloat16)
+    sizes = [256, 512, 1024] if not quick else [512, 1024]
+    rows = []
+    for bq, bk in itertools.product(sizes, sizes):
+        if bq > S or bk > S:
+            continue
+
+        def run(n, bq=bq, bk=bk):
+            def loop(x):
+                def body(acc, i):
+                    xi = x * (1 + i.astype(x.dtype) * 1e-6)
+                    o = flash_attention(xi, xi, xi, causal=True,
+                                        block_q=bq, block_k=bk)
+                    return acc + o.astype(jnp.float32).sum(), None
+                acc, _ = lax.scan(body, jnp.float32(0), jnp.arange(n))
+                return acc
+            bench._materialize(jax.jit(loop)(q))
+
+        try:
+            t = bench._marginal(run)
+        except Exception as e:
+            print(f"# flash {bq}x{bk} failed: {e}", flush=True)
+            continue
+        # causal flash ≈ half the dense FLOPs: 2 matmuls, S^2/2 each
+        flops = 2 * 2 * B * H * S * S * D / 2
+        rows.append({"block_q": bq, "block_k": bk,
+                     "ms": round(t * 1e3, 3),
+                     "tflops": round(flops / t / 1e12, 1)})
+        print(f"# flash {bq}x{bk}: {rows[-1]['ms']} ms "
+              f"{rows[-1]['tflops']} TFLOP/s", flush=True)
+    best = min(rows, key=lambda r: r["ms"]) if rows else None
+    return {"sweep": rows, "best": best}
+
+
+def _train_step_rate(bs, donate=True):
+    """bf16 fused-window training rate at batch ``bs`` (bench.py's
+    model + methodology), returning (img_s, mfu or None)."""
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    net = get_resnet(1, 50, classes=1000)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 3, bench.IMAGE, bench.IMAGE),
+                          onp.float32)))
+    trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                          optimizer="sgd",
+                          optimizer_params={"learning_rate": 0.05,
+                                            "momentum": 0.9,
+                                            "wd": 1e-4},
+                          mesh=make_mesh({"dp": -1}),
+                          dtype="bfloat16", donate=donate)
+    rng = onp.random.RandomState(0)
+    data = NDArray(jnp.asarray(
+        rng.randn(bs, 3, bench.IMAGE, bench.IMAGE).astype("float32")))
+    label = NDArray(jnp.asarray(
+        rng.randint(0, 1000, size=(bs,)).astype("float32")))
+
+    def run(n):
+        bench._materialize(trainer.run_steps(data, label, n)._data)
+
+    step_t = bench._marginal(run)
+    mfu = None
+    try:
+        ca = trainer.cost_analysis(data, label, n_steps=bench.N1)
+        if ca.get("flops"):
+            import jax
+            dev = jax.devices()[0]
+            peak = bench._peak_flops(getattr(dev, "device_kind",
+                                             str(dev)))
+            if peak:
+                mfu = (ca["flops"] / bench.N1) / step_t / peak
+    except Exception:
+        pass
+    return bs / step_t, mfu
+
+
+def tune_train_batch(quick=False):
+    rows = []
+    for bs in ([128, 256] if quick else [128, 256, 384, 512]):
+        try:
+            img_s, mfu = _train_step_rate(bs)
+        except Exception as e:
+            print(f"# bs {bs} failed: {e}", flush=True)
+            continue
+        rows.append({"batch": bs, "img_s": round(img_s, 1),
+                     "mfu": round(mfu, 4) if mfu else None})
+        print(f"# train bf16 bs={bs}: {rows[-1]['img_s']} img/s "
+              f"mfu {rows[-1]['mfu']}", flush=True)
+    best = max(rows, key=lambda r: r["img_s"]) if rows else None
+    return {"sweep": rows, "best": best}
+
+
+def tune_donation(quick=False, bs=256):
+    """Sweep #3: buffer donation on/off for the fused train window —
+    donation lets XLA alias param/state buffers in place (HBM
+    headroom), occasionally at the cost of a layout copy."""
+    rows = []
+    for donate in (True, False):
+        try:
+            img_s, mfu = _train_step_rate(bs, donate=donate)
+        except Exception as e:
+            print(f"# donate={donate} failed: {e}", flush=True)
+            continue
+        rows.append({"donate": donate, "img_s": round(img_s, 1),
+                     "mfu": round(mfu, 4) if mfu else None})
+        print(f"# donate={donate}: {rows[-1]['img_s']} img/s",
+              flush=True)
+    best = max(rows, key=lambda r: r["img_s"]) if rows else None
+    return {"sweep": rows, "best": best}
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--skip-flash", action="store_true")
+    p.add_argument("--skip-train", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          "/tmp/mxnet_tpu_jax_cache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+    except Exception:
+        pass
+    dev = bench._devices_or_die()[0]
+    out = {"device": getattr(dev, "device_kind", str(dev))}
+    if not args.skip_flash:
+        out["flash"] = tune_flash_blocks(args.quick)
+    if not args.skip_train:
+        out["train"] = tune_train_batch(args.quick)
+        out["donation"] = tune_donation(args.quick)
+    print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    main()
