@@ -57,6 +57,8 @@ class _Ctx:
         self.opset = opset
         self.params = params or {}     # var name → numpy value
         self.shapes = shapes or {}     # node name → primary output shape
+        self.var_uses: Dict[str, int] = {}   # var name → consumer count
+        self.skip_init: set = set()    # params fully baked by translators
         self._const_n = 0
 
     def shape_of(self, name: str):
@@ -904,6 +906,150 @@ def _roialign(ctx, node, ins, out):
                  sampling_ratio=max(0, int(p.get("sample_ratio", -1))))
 
 
+@register("one_hot")
+def _one_hot(ctx, node, ins, out):
+    p = node.params
+    depth = ctx.const([int(p["depth"])], onp.int64, "depth")
+    vals = ctx.const([float(p.get("off_value", 0.0)),
+                      float(p.get("on_value", 1.0))],
+                     ctx.dtype, "onoff")
+    idx = ctx.tmp("oh")
+    ctx.add_node("Cast", [ins[0]], [idx], to=int(P.TensorProto.INT64))
+    ctx.add_node("OneHot", [idx, depth, vals], [out], name=node.name,
+                 axis=-1)
+
+
+@register("gather_nd")
+def _gather_nd(ctx, node, ins, out):
+    # mx gather_nd indices are (M, ...) leading; ONNX GatherND wants
+    # them trailing.  Constant indices are baked pre-transposed (the
+    # importable form); graph-input indices get Transpose+Cast nodes
+    # (valid for external runtimes).
+    src = node.inputs[1][0]
+    if src.is_var and src.name in ctx.params:
+        arr = onp.asarray(ctx.params[src.name])
+        c = ctx.const(onp.ascontiguousarray(onp.moveaxis(arr, 0, -1))
+                      .astype(onp.int64), onp.int64, "gnd_idx")
+        if ctx.var_uses.get(src.name, 0) == 1:
+            # fully baked into the transposed copy — don't also emit
+            # the original as an (unconsumed) initializer
+            ctx.skip_init.add(src.name)
+        ctx.add_node("GatherND", [ins[0], c], [out], name=node.name)
+        return
+    idx_shape = ctx.shape_of(src.name)
+    perm = tuple(list(range(1, len(idx_shape))) + [0])
+    t, c = ctx.tmp("gnd"), ctx.tmp("gnd")
+    ctx.add_node("Transpose", [ins[1]], [t], perm=perm)
+    ctx.add_node("Cast", [t], [c], to=int(P.TensorProto.INT64))
+    ctx.add_node("GatherND", [ins[0], c], [out], name=node.name)
+
+
+@register("reverse")
+def _reverse(ctx, node, ins, out):
+    ax = node.params.get("axis", 0)
+    axes = [ax] if isinstance(ax, int) else list(ax)
+    rank = len(ctx.shape_of(node.inputs[0][0].name))
+    axes = [a % rank for a in axes]     # importer needs them positive
+    big = 1 << 62
+    starts = ctx.const([-1] * len(axes), onp.int64, "starts")
+    ends = ctx.const([-big] * len(axes), onp.int64, "ends")
+    axs = ctx.const([int(a) for a in axes], onp.int64, "axes")
+    steps = ctx.const([-1] * len(axes), onp.int64, "steps")
+    ctx.add_node("Slice", [ins[0], starts, ends, axs, steps], [out],
+                 name=node.name)
+
+
+@register("broadcast_hypot")
+def _hypot(ctx, node, ins, out):
+    a2, b2, s = ctx.tmp("hy"), ctx.tmp("hy"), ctx.tmp("hy")
+    ctx.add_node("Mul", [ins[0], ins[0]], [a2])
+    ctx.add_node("Mul", [ins[1], ins[1]], [b2])
+    ctx.add_node("Add", [a2, b2], [s])
+    ctx.add_node("Sqrt", [s], [out], name=node.name)
+
+
+@register("log2")
+def _log2(ctx, node, ins, out):
+    t = ctx.tmp("lg")
+    c = ctx.const(1.0 / onp.log(2.0), name_hint="invln2")
+    ctx.add_node("Log", ins, [t])
+    ctx.add_node("Mul", [t, c], [out], name=node.name)
+
+
+@register("log10")
+def _log10(ctx, node, ins, out):
+    t = ctx.tmp("lg")
+    c = ctx.const(1.0 / onp.log(10.0), name_hint="invln10")
+    ctx.add_node("Log", ins, [t])
+    ctx.add_node("Mul", [t, c], [out], name=node.name)
+
+
+@register("smooth_l1")
+def _smooth_l1(ctx, node, ins, out):
+    """|x| - 0.5/σ² for |x| > 1/σ², else 0.5·σ²·x² (parity:
+    smooth_l1 op; σ rides the ``scalar`` param)."""
+    sigma = float(node.params.get("scalar", 1.0))
+    s2 = sigma * sigma
+    ad, sq, small, large = (ctx.tmp("sl1") for _ in range(4))
+    ctx.add_node("Abs", ins, [ad])
+    ctx.add_node("Mul", [ins[0], ins[0]], [sq])
+    half_s2 = ctx.const(0.5 * s2, name_hint="halfs2")
+    ctx.add_node("Mul", [sq, half_s2], [small])
+    off = ctx.const(0.5 / s2, name_hint="invs2")
+    ctx.add_node("Sub", [ad, off], [large])
+    thresh = ctx.const(1.0 / s2, name_hint="thresh")
+    b = ctx.tmp("sl1")
+    ctx.add_node("Less", [ad, thresh], [b])
+    ctx.add_node("Where", [b, small, large], [out], name=node.name)
+
+
+@register("RMSNorm")
+def _rmsnorm(ctx, node, ins, out):
+    """x·γ/√(mean(x²)+eps) decomposed over ReduceMean."""
+    p = node.params
+    eps = ctx.const(float(p.get("eps", 1e-6)), name_hint="eps")
+    sq, ms, me, sd, xn = (ctx.tmp("rms") for _ in range(5))
+    ctx.add_node("Mul", [ins[0], ins[0]], [sq])
+    ctx.reduce_axes("ReduceMean", [sq], ms, ms,
+                    (int(p.get("axis", -1)),), True)
+    ctx.add_node("Add", [ms, eps], [me])
+    ctx.add_node("Sqrt", [me], [sd])
+    ctx.add_node("Div", [ins[0], sd], [xn])
+    if len(ins) > 1:
+        ctx.add_node("Mul", [xn, ins[1]], [out], name=node.name)
+    else:
+        ctx.add_node("Identity", [xn], [out], name=node.name)
+
+
+@register("GroupNorm")
+def _groupnorm(ctx, node, ins, out):
+    """Reshape to (N, G, C/G·H, W) → InstanceNormalization over the
+    group pseudo-channels → reshape back → per-channel affine
+    (parity: convert_groupnorm's reshape trick)."""
+    p = node.params
+    G = int(p.get("num_groups", 1))
+    shp = ctx.shape_of(node.inputs[0][0].name)
+    N, C = shp[0], shp[1]
+    rest = int(onp.prod(shp[2:])) if len(shp) > 2 else 1
+    to_g = ctx.const([int(N), G, (C // G) * rest], onp.int64, "shape")
+    back = ctx.const([int(s) for s in shp], onp.int64, "shape")
+    ones = ctx.const(onp.ones((G,), ctx.dtype), name_hint="gn_ones")
+    zeros = ctx.const(onp.zeros((G,), ctx.dtype), name_hint="gn_zeros")
+    r1, n1, r2 = (ctx.tmp("gn") for _ in range(3))
+    ctx.add_node("Reshape", [ins[0], to_g], [r1])
+    ctx.add_node("InstanceNormalization", [r1, ones, zeros], [n1],
+                 epsilon=float(p.get("eps", 1e-5)))
+    ctx.add_node("Reshape", [n1, back], [r2])
+    # per-channel gamma/beta broadcast over (C, 1, 1, ...)
+    pshape = ctx.const([1, int(C)] + [1] * (len(shp) - 2), onp.int64,
+                       "shape")
+    g_r, b_r, sc = (ctx.tmp("gn") for _ in range(3))
+    ctx.add_node("Reshape", [ins[1], pshape], [g_r])
+    ctx.add_node("Reshape", [ins[2], pshape], [b_r])
+    ctx.add_node("Mul", [r2, g_r], [sc])
+    ctx.add_node("Add", [sc, b_r], [out], name=node.name)
+
+
 # -- attention / RNN --------------------------------------------------------
 
 @register("multi_head_attention")
@@ -1153,15 +1299,21 @@ def export_model(sym, params: Dict, input_shape: Sequence,
             src, _ = node.inputs[1]
             if src.is_var:
                 ones_vars.add(src.name)
+    for node in nodes:
+        if node.is_var:
+            continue
+        for src, _ in node.inputs:
+            if src.is_var:
+                ctx.var_uses[src.name] = ctx.var_uses.get(src.name,
+                                                          0) + 1
+
     input_shapes = list(input_shape)
     n_data = 0
+    param_vars = []
     for node in nodes:
         if node.is_var:
             if node.name in np_params:
-                arr = np_params[node.name]
-                if node.name in ones_vars:
-                    arr = onp.ones_like(arr)
-                ctx.add_initializer(node.name, arr)
+                param_vars.append(node.name)
             else:
                 if n_data >= len(input_shapes):
                     raise MXNetError(
@@ -1193,6 +1345,16 @@ def export_model(sym, params: Dict, input_shape: Sequence,
         tr(ctx, node, ins, node.name)
         if verbose:
             print(f"[onnx-export] {node.op_name} {node.name}")
+
+    # initializers go in AFTER the translators, which may have fully
+    # baked a param (skip_init) into a converted constant
+    for pname in param_vars:
+        if pname in ctx.skip_init:
+            continue
+        arr = np_params[pname]
+        if pname in ones_vars:
+            arr = onp.ones_like(arr)
+        ctx.add_initializer(pname, arr)
 
     for out_node, idx in sym._outputs:
         if idx != 0:

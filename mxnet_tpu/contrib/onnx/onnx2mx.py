@@ -362,12 +362,16 @@ class _Importer:
         for i, (ax, b, e, st) in enumerate(zip(axes, starts, ends, steps)):
             nm = name if i == len(axes) - 1 else f"{name}_{i}"
             if st != 1:
+                if ax < 0:
+                    raise MXNetError(
+                        "onnx import: strided Slice with negative axes "
+                        "unsupported (re-export normalizes them)")
                 n = ax + 1
                 begin = [None] * n
                 end = [None] * n
                 step = [None] * n
                 begin[ax], end[ax], step[ax] = b, \
-                    (None if e >= big else e), st
+                    (None if abs(e) >= big else e), st
                 sym = self._apply("slice", [sym], nm, begin=tuple(begin),
                                   end=tuple(end), step=tuple(step))
             else:
@@ -431,6 +435,34 @@ class _Importer:
         self.consts[node.output[0]] = onp.full(
             shape, fill, onp.asarray(fill).dtype)
         return Variable(node.output[0])
+
+    def _cv_OneHot(self, node, at, ins, name):
+        if int(at.get("axis", -1)) != -1:
+            raise MXNetError("onnx import: OneHot axis != -1 "
+                             "unsupported")
+        depth = int(onp.asarray(
+            self._const_in(ins[1], "OneHot depth")).ravel()[0])
+        vals = onp.asarray(self._const_in(ins[2], "OneHot values"))
+        return self._apply("one_hot", [self._sym(ins[0])], name,
+                           depth=depth, off_value=float(vals[0]),
+                           on_value=float(vals[1]))
+
+    def _cv_GatherND(self, node, at, ins, name):
+        if at.get("batch_dims", 0):
+            raise MXNetError("onnx import: GatherND batch_dims "
+                             "unsupported")
+        # mx gather_nd wants the index-tuple axis LEADING; invert the
+        # exporter's pre-transposed constant form
+        c = self.consts.get(ins[1])
+        if c is None:
+            raise MXNetError("onnx import: GatherND with non-initializer"
+                             " indices unsupported")
+        self.used_consts.add(ins[1])
+        self.consts[ins[1] + "_T"] = onp.ascontiguousarray(
+            onp.moveaxis(onp.asarray(c), -1, 0).astype(onp.float32))
+        from ...symbol.symbol import Variable
+        idx = Variable(ins[1] + "_T")
+        return self._apply("gather_nd", [self._sym(ins[0]), idx], name)
 
     def _cv_Expand(self, node, at, ins, name):
         shape = tuple(int(s) for s in

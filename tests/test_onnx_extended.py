@@ -258,3 +258,52 @@ def test_exporter_count():
     grow 17 → ~60)."""
     from mxnet_tpu.contrib.onnx.mx2onnx import _TRANSLATORS
     assert len(_TRANSLATORS) >= 60, len(_TRANSLATORS)
+
+
+@pytest.mark.parametrize("build,shapes,data", [
+    (lambda x: _apply("one_hot", [x], depth=5),
+     [(4,)], {"data": onp.array([0, 2, 4, 1], "float32")}),
+    (lambda x: _apply("reverse", [x], axis=1), [(3, 4)], {"data": X34}),
+    (lambda x: _apply("log2", [x]), [(3, 4)],
+     {"data": onp.abs(X34) + 0.5}),
+    (lambda x: _apply("log10", [x]), [(3, 4)],
+     {"data": onp.abs(X34) + 0.5}),
+    (lambda x: _apply("smooth_l1", [x], scalar=1.0), [(3, 4)],
+     {"data": X34 * 2}),
+])
+def test_more_unary_round_trips(tmp_path, build, shapes, data):
+    x = mx.sym.var("data")
+    _round_trip(tmp_path, build(x), {}, shapes, data)
+
+
+def test_hypot_round_trip(tmp_path):
+    a, b = mx.sym.var("a"), mx.sym.var("b")
+    y = _apply("broadcast_hypot", [a, b])
+    _round_trip(tmp_path, y, {}, [(3, 4), (3, 4)],
+                {"a": X34, "b": -X34 + 0.5})
+
+
+def test_gather_nd_round_trip(tmp_path):
+    x = mx.sym.var("data")
+    idx = mx.sym.var("indices")
+    y = _apply("gather_nd", [x, idx])
+    params = {"indices": onp.array([[0, 1, 2], [1, 3, 0]], "float32")}
+    _round_trip(tmp_path, y, params, [(3, 4)], {"data": X34})
+
+
+def test_rmsnorm_round_trip(tmp_path):
+    x, g = mx.sym.var("data"), mx.sym.var("gamma")
+    y = _apply("RMSNorm", [x, g], axis=-1, eps=1e-6)
+    params = {"gamma": rng.rand(4).astype("float32") + 0.5}
+    _round_trip(tmp_path, y, params, [(3, 4)], {"data": X34})
+
+
+def test_groupnorm_round_trip(tmp_path):
+    x = mx.sym.var("data")
+    g, b = mx.sym.var("gamma"), mx.sym.var("beta")
+    y = _apply("GroupNorm", [x, g, b], num_groups=2, eps=1e-5)
+    params = {"gamma": rng.rand(4).astype("float32") + 0.5,
+              "beta": rng.randn(4).astype("float32") * 0.1}
+    data = rng.randn(2, 4, 3, 3).astype("float32")
+    _round_trip(tmp_path, y, params, [(2, 4, 3, 3)], {"data": data},
+                rtol=1e-3, atol=1e-4)
