@@ -199,3 +199,15 @@ def test_vae_example():
     mse = float(onp.mean((recon.asnumpy() - x) ** 2))
     base = float(onp.mean((x - x.mean(0)) ** 2))
     assert mse < base * 0.7, (mse, base)
+
+
+def test_multi_task_example():
+    """One backward through the sum of two heads' losses trains both
+    (parity: example/multi-task)."""
+    m = _load("gluon/multi_task.py", "multi_task_example")
+    net = m.train(iters=100, verbose=False)
+    rng = onp.random.RandomState(99)
+    x, yd, yp = m.synth_digits(rng, 256)
+    acc_d, acc_p = m.accuracies(net, x, yd, yp)
+    assert acc_d > 0.7, acc_d
+    assert acc_p > 0.8, acc_p

@@ -217,10 +217,12 @@ def test_generate_device_side_decode():
     onp.testing.assert_array_equal(out2.asnumpy(), out3.asnumpy())
 
 
-def test_sequence_parallel_ring_attention_training():
-    """Long-context path end to end: MultiHeadAttention(ring_mesh=...)
-    + SPMDTrainer(seq_axis=1) trains with the sequence axis sharded
-    over 'sp'; numerics match the replicated (flashless) run."""
+@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+def test_sequence_parallel_training(sp_mode):
+    """Long-context path end to end: MultiHeadAttention(ring_mesh=...,
+    sp_mode=...) + SPMDTrainer(seq_axis=1) trains with the sequence
+    axis sharded over 'sp' under BOTH context-parallel schemes;
+    numerics match the replicated (flashless) run."""
     import jax.numpy as jnp
     from mxnet_tpu.gluon.model_zoo.transformer import MultiHeadAttention
     from mxnet_tpu.gluon import nn as gnn
@@ -238,7 +240,8 @@ def test_sequence_parallel_ring_attention_training():
         net = gnn.HybridSequential()
         net.add(gnn.Embedding(V, E),
                 MultiHeadAttention(E, 4, causal=True, use_flash=False,
-                                   ring_mesh=ring_mesh),
+                                   ring_mesh=ring_mesh,
+                                   sp_mode=sp_mode),
                 gnn.Dense(V, flatten=False))
         net.initialize(init=mx.initializer.Xavier())
         net(NDArray(onp.zeros((1, S), onp.int32)))
