@@ -211,3 +211,27 @@ def test_multi_task_example():
     acc_d, acc_p = m.accuracies(net, x, yd, yp)
     assert acc_d > 0.7, acc_d
     assert acc_p > 0.8, acc_p
+
+
+def test_lstm_crf_example():
+    """CRF forward-algorithm NLL trains; Viterbi decode is accurate on
+    the transition-structured task (parity: example/gluon/lstm_crf)."""
+    m = _load("gluon/lstm_crf.py", "lstm_crf_example")
+    net, losses = m.train(iters=80, verbose=False)
+    assert losses[-1] < losses[0] * 0.3, (losses[0], losses[-1])
+    rng = onp.random.RandomState(9)
+    words, tags = m.synth_data(rng, 128)
+    acc = float((net.viterbi(words) == tags).mean())
+    assert acc > 0.8, acc
+
+
+def test_matrix_factorization_example():
+    """MF beats the global-mean baseline by 2x RMSE (parity:
+    example/recommenders)."""
+    m = _load("gluon/matrix_factorization.py", "mf_example")
+    net = m.train(iters=200, verbose=False)
+    rng = onp.random.RandomState(0)
+    u, i, r = m.synth_ratings(rng, 2048)
+    base = float(onp.sqrt(onp.mean((r - r.mean()) ** 2)))
+    assert m.rmse(net, u, i, r) < base * 0.5, (m.rmse(net, u, i, r),
+                                              base)
