@@ -1,0 +1,21 @@
+"""Shared bootstrap for multi-process dist worker bodies.
+
+IMPORT FIRST, before jax: forces the CPU platform and a 2-device
+virtual host so jax.distributed workers behave identically across
+every worker script.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))

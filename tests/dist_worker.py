@@ -10,18 +10,8 @@ writes an OK sentinel the pytest wrapper checks.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = \
-        (_flags + " --xla_force_host_platform_device_count=2").strip()
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
-
-sys.path.insert(0, os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _dist_bootstrap  # noqa: F401 (must run before jax users)
 
 import numpy as onp
 

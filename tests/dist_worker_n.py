@@ -1,0 +1,63 @@
+"""Worker body for the N-process (N>2) dist kvstore test.
+
+A lighter sibling of dist_worker.py checking the rank-count-generic
+paths: allreduce over N ranks, ZeRO slice bookkeeping with an UNEVEN
+tail (7 elements over 3 ranks → 3/3/1), and fused multi-key batching.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _dist_bootstrap  # noqa: F401 (must run before jax users)
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import create as kv_create
+from mxnet_tpu.ndarray import NDArray
+
+
+def main(out_dir):
+    kv = kv_create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw >= 3, f"expected >=3 workers, got {nw}"
+
+    # allreduce over N ranks
+    v = NDArray(onp.full((4,), float(rank + 1), dtype="float32"))
+    kv.push("a", v)
+    out = NDArray(onp.zeros((4,), dtype="float32"))
+    kv.pull("a", out=out)
+    want = nw * (nw + 1) / 2.0
+    onp.testing.assert_allclose(out.asnumpy(), want)
+
+    # ZeRO slicing with an uneven tail: 7 elems over N ranks
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1, momentum=0.9))
+    kv.init("w", NDArray(onp.ones((7,), dtype="float32")))
+    kv.push("w", NDArray(onp.full((7,), 1.0 / nw, dtype="float32")))
+    out = NDArray(onp.zeros((7,), dtype="float32"))
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), 0.9, rtol=1e-6)
+    chunk = -(-7 // nw)
+    lo = min(7, rank * chunk)
+    hi = min(7, lo + chunk)
+    for s in kv._opt_states["w"]:
+        if s is not None and hasattr(s, "shape"):
+            assert s.shape[0] == hi - lo, (rank, s.shape, lo, hi)
+
+    # multi-key batched push at N ranks
+    keys = ["k0", "k1"]
+    vals = [NDArray(onp.full((3 + i,), float(rank + 1), "float32"))
+            for i in range(2)]
+    kv.push(keys, vals)
+    outs = [NDArray(onp.zeros((3 + i,), "float32")) for i in range(2)]
+    kv.pull(keys, out=outs)
+    for o in outs:
+        onp.testing.assert_allclose(o.asnumpy(), want)
+
+    kv.barrier()
+    with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

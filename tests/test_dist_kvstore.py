@@ -21,19 +21,31 @@ def _free_port():
     return port
 
 
-@pytest.mark.timeout(600)
-def test_dist_sync_two_processes(tmp_path):
+def _run_launcher(n, worker, tmp_path):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     # workers set their own xla_force_host_platform_device_count
     env.pop("XLA_FLAGS", None)
     cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
-           "-n", "2", "--launcher", "local",
+           "-n", str(n), "--launcher", "local",
            "--port", str(_free_port()), "--",
-           sys.executable, os.path.join(_REPO, "tests", "dist_worker.py"),
+           sys.executable, os.path.join(_REPO, "tests", worker),
            str(tmp_path)]
     proc = subprocess.run(cmd, env=env, cwd=_REPO, timeout=570,
                           capture_output=True, text=True)
     assert proc.returncode == 0, \
         f"launcher failed\nstdout:\n{proc.stdout}\nstderr:\n{proc.stderr}"
-    assert (tmp_path / "ok_0").exists() and (tmp_path / "ok_1").exists()
+    for r in range(n):
+        assert (tmp_path / f"ok_{r}").exists()
+
+
+@pytest.mark.timeout(600)
+def test_dist_sync_two_processes(tmp_path):
+    _run_launcher(2, "dist_worker.py", tmp_path)
+
+
+@pytest.mark.timeout(600)
+def test_dist_sync_three_processes(tmp_path):
+    """Rank-count-generic paths at N=3: allreduce, uneven ZeRO tail
+    (7 elems -> 3/3/1 slices), fused multi-key batching."""
+    _run_launcher(3, "dist_worker_n.py", tmp_path)
