@@ -138,6 +138,42 @@ def main(out_dir):
     for s in a_sharded:
         assert s.shape[0] == 3, f"state not sharded: {s.shape}"
 
+    # 7. the USER path: gluon.Trainer(kvstore="dist_sync") ------------
+    # per-rank data shards, one Trainer per process — grads allreduce
+    # through the store, params stay identical across ranks
+    from mxnet_tpu import autograd
+    from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+
+    kv7 = kv_create("dist_sync")
+    mx.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 6), onp.float32)))
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.1, "momentum": 0.9},
+                      kvstore=kv7)
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    rng7 = onp.random.RandomState(200 + rank)   # per-rank stream
+    first = last = None
+    for step in range(20):
+        X = NDArray(rng7.randn(8, 6).astype("float32"))
+        Y = NDArray(rng7.randint(0, 3, (8,)).astype("float32"))
+        with autograd.record():
+            loss = loss_fn(net(X), Y).mean()
+        loss.backward()
+        trainer.step(1 * nw)
+        v = float(loss.asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first, (first, last)
+    # parameters identical across ranks after dist training
+    for k, p in net.collect_params().items():
+        both = kv7._collectives().allgather(p.data()._data)
+        onp.testing.assert_allclose(onp.asarray(both[0]),
+                                    onp.asarray(both[1]),
+                                    rtol=0, atol=0)
+
     kv.barrier()
     with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
         f.write("ok")

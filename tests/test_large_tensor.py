@@ -7,13 +7,11 @@ dtypes and >int32-range values survive end-to-end (creation, arithmetic,
 indexing, reduction, argmax); with it off, jax's default int32 world is
 unchanged.
 """
-import os
-
 import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import util
+from mxnet_tpu import base, util
 
 
 @pytest.fixture()
@@ -65,8 +63,7 @@ def test_argmax_on_int64(large_tensor):
 
 
 @pytest.mark.skipif(
-    __import__("mxnet_tpu.base", fromlist=["getenv_bool"])
-    .getenv_bool("MXNET_INT64_TENSOR_SIZE"),
+    base.getenv_bool("MXNET_INT64_TENSOR_SIZE"),
     reason="nightly runs the suite WITH x64 enabled; default-mode "
            "assertion only applies to the default config")
 def test_default_mode_unchanged():
