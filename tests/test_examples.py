@@ -235,3 +235,18 @@ def test_matrix_factorization_example():
     base = float(onp.sqrt(onp.mean((r - r.mean()) ** 2)))
     assert m.rmse(net, u, i, r) < base * 0.5, (m.rmse(net, u, i, r),
                                               base)
+
+
+def test_embedding_learning_example():
+    """Triplet-loss embedding: 1-NN accuracy in the learned space
+    beats raw-input 1-NN (parity: example/gluon/embedding_learning)."""
+    m = _load("gluon/embedding_learning.py", "embed_example")
+    net = m.train(iters=120, verbose=False)
+    rng = onp.random.RandomState(50)
+    xt, yt = m.synth_points(rng, 256)
+    xq, yq = m.synth_points(rng, 128)
+    raw = m.nn_accuracy(xt, yt, xq, yq)
+    et = net(m.NDArray(xt)).asnumpy()
+    eq = m.NDArray(xq)
+    emb = m.nn_accuracy(et, yt, net(eq).asnumpy(), yq)
+    assert emb > raw + 0.05, (raw, emb)
