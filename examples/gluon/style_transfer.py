@@ -1,0 +1,117 @@
+"""Neural style transfer by optimizing the INPUT image.
+
+Parity: example/gluon/style_transfer — the classic Gatys formulation:
+freeze a conv feature extractor, then run the optimizer on the IMAGE
+pixels to match a content target (deep features) and a style target
+(Gram matrices of shallow features).  A small random-weight conv
+pyramid serves as the extractor — random filters are a known-good
+texture basis, which keeps this example download-free.
+
+The operative API is the same as FGSM's: ``x.attach_grad()`` makes the
+image a differentiable leaf; here a full Adam loop runs on it.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import NDArray
+
+HW = 32
+
+
+def build_extractor(seed=0):
+    """3-level random conv pyramid; returns per-level feature maps."""
+    mx.random.seed(seed)
+    levels = []
+    for ch in (8, 16, 32):
+        blk = nn.HybridSequential()
+        blk.add(nn.Conv2D(ch, 3, padding=1), nn.Activation("tanh"),
+                nn.AvgPool2D(2))
+        blk.initialize(init=mx.initializer.Xavier())
+        levels.append(blk)
+    x = NDArray(onp.zeros((1, 3, HW, HW), "float32"))
+    for blk in levels:
+        x = blk(x)          # finish deferred init
+    return levels
+
+
+def features(levels, x):
+    out = []
+    for blk in levels:
+        x = blk(x)
+        out.append(x)
+    return out
+
+
+def gram(f):
+    B, C = f.shape[0], f.shape[1]
+    m = f.reshape((B, C, -1))
+    n = m.shape[2]
+    return mx.nd.batch_dot(m, m, transpose_b=True) / n
+
+
+def synth_images(rng):
+    """Content: centered blob; style: diagonal stripes."""
+    yy, xx = onp.mgrid[0:HW, 0:HW] / HW
+    content = onp.exp(-(((xx - .5) ** 2 + (yy - .5) ** 2) / 0.05))
+    content = onp.stack([content, 0.3 * content, 1 - content])
+    stripes = 0.5 + 0.5 * onp.sin((xx + yy) * 20)
+    style = onp.stack([stripes, 1 - stripes, stripes * 0.5])
+    return (content[None].astype("float32"),
+            style[None].astype("float32"))
+
+
+def transfer(levels, content, style, iters=60, lr=0.05,
+             style_w=50.0, verbose=True):
+    c_feats = [f.detach() for f in features(levels, NDArray(content))]
+    s_grams = [gram(f).detach()
+               for f in features(levels, NDArray(style))]
+    img = NDArray(content.copy())
+    img.attach_grad()
+    # simple Adam on the pixels
+    m = onp.zeros_like(content)
+    v = onp.zeros_like(content)
+    hist = []
+    for it in range(iters):
+        with autograd.record():
+            fs = features(levels, img)
+            closs = ((fs[-1] - c_feats[-1]) ** 2).mean()
+            sloss = sum(((gram(f) - g) ** 2).mean()
+                        for f, g in zip(fs, s_grams))
+            loss = closs + style_w * sloss
+        loss.backward()
+        g = img.grad.asnumpy()
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        step = lr * m / (onp.sqrt(v) + 1e-8)
+        img = NDArray(onp.clip(img.asnumpy() - step, 0, 1))
+        img.attach_grad()
+        hist.append(float(loss.asnumpy()))
+        if verbose and it % 20 == 0:
+            print(f"iter {it}: loss {hist[-1]:.5f}")
+    return img.asnumpy(), hist
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=60)
+    args = p.parse_args(argv)
+    levels = build_extractor()
+    rng = onp.random.RandomState(0)
+    content, style = synth_images(rng)
+    out, hist = transfer(levels, content, style, iters=args.iters)
+    print(f"loss {hist[0]:.4f} -> {hist[-1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

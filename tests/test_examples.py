@@ -250,3 +250,17 @@ def test_embedding_learning_example():
     eq = m.NDArray(xq)
     emb = m.nn_accuracy(et, yt, net(eq).asnumpy(), yq)
     assert emb > raw + 0.05, (raw, emb)
+
+
+def test_style_transfer_example():
+    """Input-pixel optimization: combined content+style loss decreases
+    (parity: example/gluon/style_transfer)."""
+    m = _load("gluon/style_transfer.py", "style_example")
+    levels = m.build_extractor()
+    rng = onp.random.RandomState(0)
+    content, style = m.synth_images(rng)
+    out, hist = m.transfer(levels, content, style, iters=30,
+                           verbose=False)
+    assert hist[-1] < hist[0] * 0.8, (hist[0], hist[-1])
+    assert out.shape == content.shape
+    assert (out >= 0).all() and (out <= 1).all()
