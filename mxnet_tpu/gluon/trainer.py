@@ -96,6 +96,15 @@ class Trainer:
                         self._kvstore.has_capability("optimizer")
             if uok and not self._kvstore.has_capability("optimizer"):
                 uok = False
+            if getattr(self._kvstore, "type", "") == "p3store_dist":
+                # P3's sliced pushpull has no server-side optimizer
+                # path (parity: the reference P3 is a gradient
+                # propagation store; updates stay worker-side)
+                if config["update_on_kvstore"]:
+                    raise MXNetError(
+                        "p3store_dist has no server-side optimizer "
+                        "path; use update_on_kvstore=False")
+                uok = False
             self._update_on_kvstore = uok
             if self._compression_params:
                 self._kvstore.set_gradient_compression(self._compression_params)
@@ -134,14 +143,37 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
+        from ..kvstore.dist import DistKVStore
+        from ..kvstore.kvstore import KVStore
+        from ..kvstore.p3store import P3StoreDist
+        if isinstance(self._kvstore, P3StoreDist) or \
+                not isinstance(self._kvstore, (KVStore, DistKVStore)):
+            # P3 slices + priority-schedules per key — keep per-key
+            # calls so its own scheduling stays in charge.  Adapter
+            # stores (horovod/byteps) interpret a list value as
+            # per-device replicas of ONE key, so they also stay on
+            # the per-key path.
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null" and param._grad is not None:
+                    out = (param.data() if self._update_on_kvstore
+                           else param.grad())
+                    self._kvstore.pushpull(str(i), param.grad(),
+                                           out=out, priority=-i)
+            return
+        # ONE pushpull for every parameter: dist stores fuse all keys
+        # into a single collective per dtype (kvstore/dist.py
+        # _batched_allreduce — parity: kvstore_nccl.h:62 key batching).
+        # Under dist_async this also makes the SSP staleness bound
+        # count optimizer STEPS (one batched push call per step).
+        keys, grads, outs = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req != "null" and param._grad is not None:
-                if self._update_on_kvstore:
-                    self._kvstore.pushpull(str(i), param.grad(),
-                                           out=param.data())
-                else:
-                    self._kvstore.pushpull(str(i), param.grad(),
-                                           out=param.grad())
+                keys.append(str(i))
+                grads.append(param.grad())
+                outs.append(param.data() if self._update_on_kvstore
+                            else param.grad())
+        if keys:
+            self._kvstore.pushpull(keys, grads, out=outs)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:

@@ -20,7 +20,8 @@ TPU-native design dissolves the parameter-server:
   no barrier — and every ``MXNET_ASYNC_STALENESS_BOUND``-th push call
   (default 16) is a fused all-gather rendezvous reconciling the shards.
   Between rendezvous, reads of other ranks' shards are at most K pushes
-  stale.  Documented divergence from the reference's fully
+  stale (gluon ``Trainer`` makes ONE batched push call per optimizer
+  step, so for it K counts optimizer steps).  Documented divergence from the reference's fully
   uncoordinated async PS: like every collective-based store here
   (dist_sync included), ranks must make the SAME TOTAL number of push
   calls — what async relaxes is the rendezvous frequency (1 in K push
