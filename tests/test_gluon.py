@@ -417,3 +417,49 @@ def test_batchify_functions():
     x, y = next(iter(dl))
     assert x.shape == (2, 2) and y.shape == (2, 3)
     assert y.asnumpy()[1, 1] == -1
+
+
+def test_concurrent_inference_threads():
+    """Concurrent forward calls from multiple Python threads on one
+    hybridized net return correct results (parity:
+    example/multi_threaded_inference — C++ threaded CachedOp; here
+    jit replays are thread-safe and release the GIL on device work)."""
+    import threading
+
+    import numpy as onp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ndarray import NDArray
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.initializer.Xavier())
+    net.hybridize()
+    warm = onp.zeros((4, 8), "float32")
+    net(NDArray(warm))          # compile once up front
+
+    rng = onp.random.RandomState(0)
+    batches = [rng.randn(4, 8).astype("float32") for _ in range(16)]
+    want = [net(NDArray(b)).asnumpy() for b in batches]
+
+    results = [None] * len(batches)
+    errors = []
+
+    def worker(tid):
+        try:
+            for i in range(tid, len(batches), 4):
+                results[i] = net(NDArray(batches[i])).asnumpy()
+        except Exception as e:      # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    for got, ref in zip(results, want):
+        onp.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
