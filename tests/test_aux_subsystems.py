@@ -90,3 +90,13 @@ def test_scalar_sugar_hits_profiler_and_cache():
     xi = mx.nd.array(onp.array([1, 2], onp.int32))
     assert str((xi * 2).dtype) == "int32"
     onp.testing.assert_allclose((xi * 2).asnumpy(), [2, 4])
+
+
+def test_kvstore_reconcile_noop_on_sync():
+    """reconcile() is a safe no-op for sync stores and single-process
+    runs (the async tail-flush API must not deadlock elsewhere)."""
+    from mxnet_tpu.kvstore import create
+    kv = create("dist_sync")
+    kv.reconcile()      # nproc==1 in-process: must simply return
+    kva = create("dist_async")
+    kva.reconcile()

@@ -504,3 +504,24 @@ def test_run_steps_per_step_data_matches_sequential():
         onp.testing.assert_allclose(pa[k].data().asnumpy(),
                                     pb[k].data().asnumpy(),
                                     rtol=1e-5, atol=1e-6)
+
+
+def test_run_steps_per_step_data_validates_leading_axis():
+    import numpy as onp
+    import pytest
+    import mxnet_tpu as mx
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import make_mesh, SPMDTrainer
+
+    net = nn.Dense(2)
+    net.initialize()
+    net(NDArray(onp.zeros((1, 3), onp.float32)))
+    tr = SPMDTrainer(net, gloss.L2Loss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1},
+                     mesh=make_mesh({"dp": -1}))
+    data = onp.zeros((4, 8, 3), "float32")
+    label = onp.zeros((4, 8, 2), "float32")
+    with pytest.raises(MXNetError, match="leading axis"):
+        tr.run_steps(data, label, 5, per_step_data=True)
