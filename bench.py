@@ -318,10 +318,19 @@ def main():
     kind = getattr(dev, "device_kind", str(dev))
     peak = _peak_flops(kind)
 
+    # phase markers ride stderr-style comment lines so a run killed
+    # mid-compile still shows how far it got
+    print(f"# bench: device {kind}, starting fp32 train", flush=True)
     fp32_img_s, _ = _train_bench(None, TRAIN_BS_FP32)
+    print(f"# bench: fp32 {fp32_img_s:.1f} img/s; starting bf16 train",
+          flush=True)
     bf16_img_s, bf16_flops_s = _train_bench("bfloat16", TRAIN_BS_BF16)
+    print(f"# bench: bf16 {bf16_img_s:.1f} img/s; starting inference",
+          flush=True)
     infer32 = _infer_bench("float32", INFER_BS)
     infer16 = _infer_bench("bfloat16", INFER_BS)
+    print("# bench: inference done; starting feed-the-chip rows",
+          flush=True)
 
     # feed-the-chip: pipeline-only rate + data-FED training rate
     pipe_img_s = datafed_img_s = None
