@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 import numpy as onp
@@ -58,10 +59,67 @@ def _peak_flops(kind: str):
     return None
 
 
+# -- stall watchdog ----------------------------------------------------------
+# The axon tunnel can wedge MID-RUN, not just at device init (observed
+# round 3: a run got through every compile, then the relay stopped
+# responding during the timed windows; a trivial matmul from a second
+# process hung too).  Every completed device round-trip bumps the
+# heartbeat; a monitor thread emits whatever has been MEASURED SO FAR
+# as the one JSON line and exits if the heartbeat goes stale.  Partial
+# numbers beat none.
+
+RESULTS: dict = {}
+_HEART = {"t": time.monotonic(), "phase": "init"}
+_STALL_S = float(os.environ.get("MXNET_TPU_BENCH_STALL_TIMEOUT", "900"))
+
+
+def _beat(phase=None):
+    _HEART["t"] = time.monotonic()
+    if phase is not None:
+        _HEART["phase"] = phase
+        print(f"# bench: {phase}", flush=True)
+
+
+def _emit(error=None):
+    """Print the single JSON line from whatever is in RESULTS."""
+    headline = RESULTS.get("train_bf16_bs%d_img_s" % TRAIN_BS_BF16)
+    extra = dict(RESULTS)
+    if error:
+        extra["error"] = error
+    out = {
+        "metric": "resnet50_train_bf16_bs%d_images_per_sec"
+                  % TRAIN_BS_BF16,
+        "value": round(headline, 2) if headline else None,
+        "unit": "images/sec/chip",
+        "vs_baseline": (round(headline / TRAIN_BASE_FP32, 3)
+                        if headline else None),
+        "extra": extra,
+    }
+    print(json.dumps(out), flush=True)
+
+
+def _start_watchdog():
+    def monitor():
+        while True:
+            time.sleep(15)
+            stale = time.monotonic() - _HEART["t"]
+            if stale > _STALL_S:
+                _emit(error=f"stalled >{int(stale)}s in phase "
+                            f"'{_HEART['phase']}' — tunnel wedged; "
+                            f"partial results only")
+                # headline measured -> usable run despite the stall
+                os._exit(0 if RESULTS.get(
+                    "train_bf16_bs%d_img_s" % TRAIN_BS_BF16) else 2)
+
+    threading.Thread(target=monitor, daemon=True).start()
+
+
 def _materialize(x):
     """Full synchronization: fetch a value derived from x."""
     import jax
-    return jax.device_get(x)
+    val = jax.device_get(x)
+    _beat()            # a completed device round-trip = liveness
+    return val
 
 
 def _marginal(run, n1=N1, n2=N2, reps=REPS):
@@ -98,11 +156,14 @@ def _train_bench(dtype, batch):
                                             "momentum": 0.9, "wd": 1e-4},
                           mesh=make_mesh({"dp": -1}), dtype=dtype)
 
-    rng = onp.random.RandomState(0)
-    data = NDArray(jnp.asarray(
-        rng.randn(batch, 3, IMAGE, IMAGE).astype("float32")))
-    label = NDArray(jnp.asarray(
-        rng.randint(0, 1000, size=(batch,)).astype("float32")))
+    # synthetic batch generated ON DEVICE (a host->device transfer of
+    # bs=256 fp32 imagenet is ~154 MB through the flaky tunnel)
+    import jax
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    data = NDArray(jax.random.normal(
+        k1, (batch, 3, IMAGE, IMAGE), jnp.float32))
+    label = NDArray(jax.random.randint(
+        k2, (batch,), 0, 1000).astype(jnp.float32))
 
     def run(n):
         losses = trainer.run_steps(data, label, n)
@@ -158,8 +219,8 @@ def _infer_bench(dtype, batch):
             for p, s in zip(pvals, saved):
                 p._data = s
 
-    x = jnp.asarray(onp.random.RandomState(0)
-                    .randn(batch, 3, IMAGE, IMAGE).astype("float32"))
+    x = jax.random.normal(jax.random.PRNGKey(0),
+                          (batch, 3, IMAGE, IMAGE), jnp.float32)
     if dtype != "float32":
         x = x.astype(jnp.dtype(dtype))
 
@@ -299,9 +360,10 @@ def _devices_or_die(timeout_s=180):
     t.start()
     t.join(timeout_s)
     if "devices" not in box:
-        raise SystemExit(
-            f"bench: TPU backend failed to initialize within {timeout_s}s "
-            f"({box.get('error', 'device init hang — tunnel wedged?')})")
+        msg = (f"TPU backend failed to initialize within {timeout_s}s "
+               f"({box.get('error', 'device init hang — tunnel wedged?')})")
+        _emit(error=msg)        # keep the one-JSON-line contract
+        raise SystemExit(f"bench: {msg}")
     return box["devices"]
 
 
@@ -314,69 +376,65 @@ def main():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
+    _start_watchdog()
     dev = _devices_or_die()[0]
     kind = getattr(dev, "device_kind", str(dev))
     peak = _peak_flops(kind)
+    RESULTS["device_kind"] = kind
+    RESULTS["method_note"] = (
+        "marginal (slope) timing over fused device-side windows with "
+        "device_get sync — steady-state per-step rate; launch/tunnel "
+        "latency excluded")
+    RESULTS["baseline_note"] = (
+        "vs_baseline anchors the bf16 headline to the only published "
+        "training row (1xV100 fp32 343 img/s); ref fp16 roughly "
+        "doubles V100 (perf.md:199-211)")
 
-    # phase markers ride stderr-style comment lines so a run killed
-    # mid-compile still shows how far it got
-    print(f"# bench: device {kind}, starting fp32 train", flush=True)
-    fp32_img_s, _ = _train_bench(None, TRAIN_BS_FP32)
-    print(f"# bench: fp32 {fp32_img_s:.1f} img/s; starting bf16 train",
-          flush=True)
+    # every row lands in RESULTS the moment it's measured, so a
+    # mid-run tunnel wedge still emits everything measured so far
+    _beat(f"device {kind}, starting bf16 train (headline)")
     bf16_img_s, bf16_flops_s = _train_bench("bfloat16", TRAIN_BS_BF16)
-    print(f"# bench: bf16 {bf16_img_s:.1f} img/s; starting inference",
-          flush=True)
-    infer32 = _infer_bench("float32", INFER_BS)
-    infer16 = _infer_bench("bfloat16", INFER_BS)
-    print("# bench: inference done; starting feed-the-chip rows",
-          flush=True)
+    RESULTS["train_bf16_bs%d_img_s" % TRAIN_BS_BF16] = round(bf16_img_s, 2)
+    if bf16_flops_s:
+        RESULTS["train_bf16_tflops"] = round(bf16_flops_s / 1e12, 2)
+        if peak:
+            RESULTS["train_bf16_mfu"] = round(bf16_flops_s / peak, 4)
 
-    # feed-the-chip: pipeline-only rate + data-FED training rate
-    pipe_img_s = datafed_img_s = None
+    _beat(f"bf16 {bf16_img_s:.1f} img/s; starting fp32 train")
+    fp32_img_s, _ = _train_bench(None, TRAIN_BS_FP32)
+    RESULTS["train_fp32_bs%d_img_s" % TRAIN_BS_FP32] = round(fp32_img_s, 2)
+    RESULTS["train_fp32_vs_v100_343"] = round(fp32_img_s / TRAIN_BASE_FP32,
+                                              3)
+
+    _beat(f"fp32 {fp32_img_s:.1f} img/s; starting inference")
+    infer32 = _infer_bench("float32", INFER_BS)
+    RESULTS["infer_fp32_bs%d_img_s" % INFER_BS] = round(infer32, 2)
+    RESULTS["infer_fp32_vs_v100_1233"] = round(infer32 / INFER_BASE_FP32, 3)
+    infer16 = _infer_bench("bfloat16", INFER_BS)
+    RESULTS["infer_bf16_bs%d_img_s" % INFER_BS] = round(infer16, 2)
+    RESULTS["infer_bf16_vs_v100_fp16_2355"] = round(
+        infer16 / INFER_BASE_FP16, 3)
+
+    _beat("inference done; starting feed-the-chip rows")
     import shutil
     import tempfile
+    RESULTS["pipeline_img_s_vs_ref_3000"] = None
+    RESULTS["train_bf16_datafed_img_s"] = None
     tmp = tempfile.mkdtemp()
     try:
         rec = _make_rec(os.path.join(tmp, "bench.rec"))
         pipe_img_s = _pipeline_bench(rec)
+        RESULTS["pipeline_img_s_vs_ref_3000"] = round(pipe_img_s, 1)
         datafed_img_s = _train_bench_datafed(rec, "bfloat16",
                                              TRAIN_BS_BF16)
+        RESULTS["train_bf16_datafed_img_s"] = round(datafed_img_s, 2)
     except Exception as e:      # pragma: no cover
+        RESULTS["datafed_skipped"] = str(e)
         print(f"# datafed bench skipped: {e}", flush=True)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
-    extra = {
-        "device_kind": kind,
-        "train_fp32_bs%d_img_s" % TRAIN_BS_FP32: round(fp32_img_s, 2),
-        "train_fp32_vs_v100_343": round(fp32_img_s / TRAIN_BASE_FP32, 3),
-        "train_bf16_tflops": (round(bf16_flops_s / 1e12, 2)
-                              if bf16_flops_s else None),
-        "train_bf16_mfu": (round(bf16_flops_s / peak, 4)
-                           if bf16_flops_s and peak else None),
-        "infer_fp32_bs%d_img_s" % INFER_BS: round(infer32, 2),
-        "infer_fp32_vs_v100_1233": round(infer32 / INFER_BASE_FP32, 3),
-        "infer_bf16_bs%d_img_s" % INFER_BS: round(infer16, 2),
-        "infer_bf16_vs_v100_fp16_2355": round(infer16 / INFER_BASE_FP16, 3),
-        "pipeline_img_s_vs_ref_3000": (round(pipe_img_s, 1)
-                                       if pipe_img_s else None),
-        "train_bf16_datafed_img_s": (round(datafed_img_s, 2)
-                                     if datafed_img_s else None),
-        "method_note": "marginal (slope) timing over fused device-side "
-                       "windows with device_get sync — steady-state "
-                       "per-step rate; launch/tunnel latency excluded",
-        "baseline_note": "vs_baseline anchors the bf16 headline to the only"
-                         " published training row (1xV100 fp32 343 img/s);"
-                         " ref fp16 roughly doubles V100 (perf.md:199-211)",
-    }
-    print(json.dumps({
-        "metric": "resnet50_train_bf16_bs%d_images_per_sec" % TRAIN_BS_BF16,
-        "value": round(bf16_img_s, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(bf16_img_s / TRAIN_BASE_FP32, 3),
-        "extra": extra,
-    }))
+    _emit()
 
 
 if __name__ == "__main__":

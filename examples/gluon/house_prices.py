@@ -1,0 +1,157 @@
+"""Kaggle house-prices style tabular regression (parity:
+example/gluon/house_prices — feature standardization, one-hot
+categoricals, an MLP trained on log-price with k-fold cross
+validation).
+
+Runs on a synthetic tabular dataset with known structure (numeric +
+categorical features, multiplicative price formation) so the smoke
+test needs no Kaggle download; --csv accepts a real train.csv.
+
+    python examples/gluon/house_prices.py --epochs 40
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import NDArray
+
+N_NUM, N_CAT, CAT_CARD = 8, 3, 4
+
+
+def synth_table(n=800, seed=0):
+    """Numeric features + categoricals; log-price is a linear function
+    of standardized numerics plus per-category offsets + noise."""
+    rng = onp.random.RandomState(seed)
+    num = rng.randn(n, N_NUM).astype("float32")
+    cat = rng.randint(0, CAT_CARD, size=(n, N_CAT))
+    w = rng.randn(N_NUM) * 0.3
+    offs = rng.randn(N_CAT, CAT_CARD) * 0.2
+    logp = 12.0 + num @ w + sum(offs[j, cat[:, j]]
+                                for j in range(N_CAT))
+    logp += rng.randn(n) * 0.05
+    price = onp.exp(logp).astype("float32")
+    return num, cat, price
+
+
+def featurize(num, cat):
+    """Standardize numerics (NaN -> 0 post-standardize, like the
+    reference's fillna(0) after (x-mean)/std) and one-hot the
+    categoricals."""
+    mu = onp.nanmean(num, 0)
+    sd = onp.nanstd(num, 0) + 1e-8
+    z = onp.nan_to_num((num - mu) / sd)   # NaN -> 0 AFTER standardize
+    hots = [onp.eye(CAT_CARD, dtype="float32")[cat[:, j]]
+            for j in range(cat.shape[1])]
+    return onp.concatenate([z] + hots, axis=1).astype("float32")
+
+
+def log_rmse(net, x, y):
+    """Competition metric: RMSE between log(pred) and log(label),
+    with preds clipped to >= 1."""
+    with autograd.predict_mode():
+        p = net(NDArray(x)).asnumpy().reshape(-1)
+    p = onp.clip(p, 1.0, None)
+    return float(onp.sqrt(onp.mean((onp.log(p) - onp.log(y)) ** 2)))
+
+
+def build_net(hidden=64):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(hidden, activation="relu"),
+            nn.Dropout(0.1),
+            nn.Dense(1))
+    return net
+
+
+def train_fold(x_tr, y_tr, x_va, y_va, epochs=40, lr=5.0, wd=0.05,
+               batch=64, hidden=64, verbose=False):
+    net = build_net(hidden)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(x_tr[:1]))
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": lr / 100.0, "wd": wd})
+    loss_fn = gluon.loss.L2Loss()
+    # train on log-price: multiplicative errors weigh equally
+    ylog = onp.log(y_tr).astype("float32")
+    n = len(x_tr)
+    rng = onp.random.RandomState(0)
+    for epoch in range(epochs):
+        perm = rng.permutation(n)
+        for s in range(0, n - batch + 1, batch):
+            idx = perm[s:s + batch]
+            xb, yb = NDArray(x_tr[idx]), NDArray(ylog[idx])
+            with autograd.record():
+                out = net(xb).reshape((-1,))
+                loss = loss_fn(out, yb)
+            loss.backward()
+            trainer.step(batch)
+        if verbose and epoch % 10 == 0:
+            print(f"  epoch {epoch}: "
+                  f"val-log-rmse {_fold_metric(net, x_va, y_va):.4f}",
+                  flush=True)
+    return net
+
+
+def _fold_metric(net, x_va, y_va):
+    with autograd.predict_mode():
+        p = net(NDArray(x_va)).asnumpy().reshape(-1)
+    return float(onp.sqrt(onp.mean((p - onp.log(y_va)) ** 2)))
+
+
+def k_fold(x, y, k=4, **kw):
+    """k-fold CV over (x, y); returns mean val log-rmse (net predicts
+    log-price, so the metric compares in log space directly)."""
+    n = len(x)
+    fold = n // k
+    scores = []
+    for i in range(k):
+        lo, hi = i * fold, (i + 1) * fold
+        x_va, y_va = x[lo:hi], y[lo:hi]
+        x_tr = onp.concatenate([x[:lo], x[hi:]])
+        y_tr = onp.concatenate([y[:lo], y[hi:]])
+        net = train_fold(x_tr, y_tr, x_va, y_va, **kw)
+        scores.append(_fold_metric(net, x_va, y_va))
+    return float(onp.mean(scores)), net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=40)
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--csv", type=str, default=None,
+                    help="optional real train.csv (numeric cols only)")
+    args = ap.parse_args()
+
+    if args.csv:
+        import csv
+
+        with open(args.csv) as f:
+            rows = list(csv.DictReader(f))
+        cols = [c for c in rows[0] if c not in ("Id", "SalePrice")]
+        num = onp.array([[float(r[c]) if r[c].replace(
+            ".", "", 1).lstrip("-").isdigit() else onp.nan
+            for c in cols] for r in rows], "float32")
+        y = onp.array([float(r["SalePrice"]) for r in rows], "float32")
+        x = featurize(num, onp.zeros((len(rows), N_CAT), int))
+    else:
+        numf, cat, y = synth_table()
+        x = featurize(numf, cat)
+
+    score, _ = k_fold(x, y, k=args.k, epochs=args.epochs,
+                      hidden=args.hidden, verbose=True)
+    print(f"{args.k}-fold mean val log-rmse: {score:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,96 @@
+"""Profiler walkthrough (parity: example/profiler/ — the reference
+ships profiler_matmul.py / profiler_ndarray.py / profiler_imageiter.py
+showing set_config + start/stop + dump around three workloads; this
+demo does all three against the TPU-native profiler: the op funnel is
+instrumented, so the aggregate table fills on ordinary eager work, and
+scoped Task/Frame objects mark user phases).
+
+    python examples/profiler/profiler_demo.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.ndarray import NDArray
+
+
+def profile_matmul(n=256, reps=20):
+    """Phase 1: repeated matmuls under a profiler Task scope."""
+    a = NDArray(onp.random.RandomState(0).randn(n, n).astype("float32"))
+    with profiler.Task("matmul-phase"):
+        out = a
+        for _ in range(reps):
+            out = mx.nd.dot(out, a)
+            out = out / mx.nd.norm(out)
+        out.wait_to_read()
+
+
+def profile_ndarray(reps=50):
+    """Phase 2: small-op soup — broadcast, reduce, slice, concat."""
+    rng = onp.random.RandomState(1)
+    x = NDArray(rng.randn(64, 64).astype("float32"))
+    with profiler.Task("ndarray-phase"):
+        for _ in range(reps):
+            y = (x + 1.5) * x
+            z = mx.nd.concat(y[:32], y[32:], dim=1)
+            s = z.sum(axis=0)
+            s.wait_to_read()
+
+
+def profile_dataiter(n=128):
+    """Phase 3: the input pipeline (record pack + iterate)."""
+    from mxnet_tpu import recordio
+    from mxnet_tpu.io import native
+
+    import shutil
+
+    tmp = tempfile.mkdtemp()
+    try:
+        rec = os.path.join(tmp, "prof.rec")
+        rng = onp.random.RandomState(2)
+        with native.NativeRecordWriter(rec) as w:
+            for i in range(n):
+                img = rng.randint(0, 255, (64, 64, 3), onp.uint8)
+                w.write(recordio.pack_img(
+                    recordio.IRHeader(0, float(i % 10), i, 0), img,
+                    quality=80))
+        with profiler.Task("dataiter-phase"):
+            it = native.ImageRecordIter(rec, batch_size=32,
+                                        data_shape=(3, 56, 56),
+                                        rand_crop=True,
+                                        preprocess_threads=2)
+            seen = 0
+            for b in it:
+                seen += b.data[0].shape[0] - b.pad
+            it.close()
+        return seen
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main():
+    profiler.set_config(aggregate_stats=True, profile_imperative=True)
+    profiler.start()
+    profile_matmul()
+    profile_ndarray()
+    n = profile_dataiter()
+    profiler.stop()
+    table = profiler.dumps(reset=True)
+    print(table)
+    assert "matmul-phase" in table or "dot" in table, \
+        "profiler table should show the matmul phase"
+    print(f"profiled 3 phases ({n} images through the pipeline); "
+          f"aggregate table above")
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,8 @@ from . import data
 from . import rnn
 from . import model_zoo
 from . import contrib
+from . import utils
 
 __all__ = ["Block", "HybridBlock", "SymbolBlock", "Parameter", "Constant",
            "ParameterDict", "Trainer", "nn", "loss", "metric", "data", "rnn",
-           "model_zoo", "contrib"]
+           "model_zoo", "contrib", "utils"]

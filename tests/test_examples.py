@@ -264,3 +264,88 @@ def test_style_transfer_example():
     assert hist[-1] < hist[0] * 0.8, (hist[0], hist[-1])
     assert out.shape == content.shape
     assert (out >= 0).all() and (out <= 1).all()
+
+
+def test_word_language_model_example():
+    """LSTM LM with tied weights + truncated BPTT halves perplexity
+    vs the uniform floor (parity: example/gluon/word_language_model)."""
+    m = _load("gluon/word_language_model.py", "wlm_example")
+    net, hist = m.train(epochs=5, batch_size=16, bptt=16, hidden=48,
+                        layers=1, dropout=0.0,
+                        corpus=m.synth_corpus(6000), verbose=False)
+    assert hist[-1] < 55.0, hist          # uniform floor is ~96
+    assert hist[-1] < hist[0] * 0.7, hist
+
+
+def test_house_prices_example():
+    """Tabular MLP regression beats the predict-the-mean baseline on
+    log-rmse (parity: example/gluon/house_prices)."""
+    m = _load("gluon/house_prices.py", "hp_example")
+    num, cat, y = m.synth_table(400)
+    x = m.featurize(num, cat)
+    score, _ = m.k_fold(x, y, k=2, epochs=25)
+    base = float(onp.sqrt(onp.mean(
+        (onp.log(y) - onp.log(y).mean()) ** 2)))
+    assert score < base * 0.75, (score, base)
+
+
+def test_sn_gan_example():
+    """Spectral-norm GAN pulls generated samples onto the mode ring
+    (parity: example/gluon/sn_gan)."""
+    m = _load("gluon/sn_gan.py", "sngan_example")
+    gen, disc = m.train(iters=700, verbose=False)
+    hit, dist = m.mode_coverage(gen)
+    assert hit >= 3, (hit, dist)          # multiple modes, no collapse
+    assert dist < 1.2, (hit, dist)        # near the ring (init ~2.0)
+
+
+def test_binary_rbm_example():
+    """CD-1 RBM: free energy separates data from matched-rate noise
+    and reconstructions are close (parity:
+    example/restricted-boltzmann-machine)."""
+    m = _load("gluon/binary_rbm.py", "rbm_example")
+    rbm = m.train(iters=300, verbose=False)
+    rng = onp.random.RandomState(123)
+    data = m.bars_batch(rng, 128)
+    noise = (rng.rand(128, m.VIS) < data.mean()).astype("float32")
+    fd = rbm.free_energy(m.NDArray(data)).mean()
+    fn = rbm.free_energy(m.NDArray(noise)).mean()
+    assert fd < fn - 2.0, (fd, fn)
+    rec = rbm.reconstruct(m.NDArray(data))
+    assert ((rec - data) ** 2).mean() < 0.08
+
+
+def test_profiler_example():
+    """Profiler demo produces an aggregate table with per-op rows
+    (parity: example/profiler)."""
+    m = _load("profiler/profiler_demo.py", "profiler_example")
+    m.main()
+
+
+def test_amp_model_conversion_example():
+    """bf16-converted model-zoo net agrees with fp32 on top-1 (parity:
+    example/automatic-mixed-precision/amp_model_conversion.py)."""
+    m = _load("amp/amp_model_conversion.py", "amp_conv_example")
+    top, delta, dtypes = m.convert_and_compare(verbose=False)
+    assert top >= 0.9, (top, delta)
+    assert dtypes.get("bfloat16", 0) > 0, dtypes
+
+
+def test_multi_threaded_inference_example():
+    """N threads share one compiled executable and match the
+    single-thread outputs exactly (parity:
+    example/multi_threaded_inference)."""
+    m = _load("multi_threaded_inference/multi_threaded_inference.py",
+              "mti_example")
+    rng = onp.random.RandomState(0)
+    batches = [rng.randn(4, 3, 32, 32).astype("float32")
+               for _ in range(6)]
+    net = m.build()
+    from mxnet_tpu import autograd
+    with autograd.predict_mode():
+        ref = {i: net(m.NDArray(b)).asnumpy()
+               for i, b in enumerate(batches)}
+    res = m.serve(net, batches, n_threads=3)
+    assert len(res) == 6
+    worst = max(float(onp.abs(res[i] - ref[i]).max()) for i in res)
+    assert worst < 1e-5, worst

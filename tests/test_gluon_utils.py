@@ -1,0 +1,94 @@
+"""gluon.utils parity tests (reference python/mxnet/gluon/utils.py:
+split_data:41, split_and_load:87, clip_global_norm:117,
+check_sha1:179, shape_is_known:430)."""
+import hashlib
+import os
+import tempfile
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import utils as gutils
+from mxnet_tpu.ndarray import NDArray
+
+
+def test_split_data_even():
+    x = NDArray(onp.arange(12, dtype="float32").reshape(6, 2))
+    parts = gutils.split_data(x, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    onp.testing.assert_array_equal(
+        onp.concatenate([p.asnumpy() for p in parts]), x.asnumpy())
+
+
+def test_split_data_uneven_and_axis():
+    x = NDArray(onp.arange(14, dtype="float32").reshape(2, 7))
+    with pytest.raises(ValueError):
+        gutils.split_data(x, 3, batch_axis=1)
+    parts = gutils.split_data(x, 3, batch_axis=1, even_split=False)
+    assert [p.shape[1] for p in parts] == [3, 2, 2]
+    onp.testing.assert_array_equal(
+        onp.concatenate([p.asnumpy() for p in parts], axis=1),
+        x.asnumpy())
+
+
+def test_split_and_load_devices():
+    x = onp.arange(8, dtype="float32").reshape(4, 2)
+    out = gutils.split_and_load(x, [mx.cpu(0), mx.cpu(0)])
+    assert [o.shape for o in out] == [(2, 2), (2, 2)]
+    onp.testing.assert_array_equal(
+        onp.concatenate([o.asnumpy() for o in out]), x)
+
+
+def test_clip_global_norm_rescales_in_place():
+    a = NDArray(onp.full((3, 3), 2.0, "float32"))
+    b = NDArray(onp.full((2,), 2.0, "float32"))
+    arrays = [a, b]
+    total = float(onp.sqrt(4.0 * 11))
+    norm = gutils.clip_global_norm(arrays, 1.0)
+    assert abs(norm - total) < 1e-4
+    new_norm = float(onp.sqrt(sum(
+        (x.asnumpy() ** 2).sum() for x in arrays)))
+    assert abs(new_norm - 1.0) < 1e-4
+    # below the threshold: no rescale
+    norm2 = gutils.clip_global_norm(arrays, 10.0)
+    assert abs(norm2 - 1.0) < 1e-4
+    assert abs(float(onp.sqrt(sum(
+        (x.asnumpy() ** 2).sum() for x in arrays))) - 1.0) < 1e-4
+
+
+def test_clip_global_norm_warns_on_nonfinite():
+    a = NDArray(onp.array([onp.inf, 1.0], "float32"))
+    with pytest.warns(UserWarning):
+        gutils.clip_global_norm([a], 1.0)
+
+
+def test_check_sha1(tmp_path):
+    p = os.path.join(tmp_path, "f.bin")
+    with open(p, "wb") as f:
+        f.write(b"payload")
+    good = hashlib.sha1(b"payload").hexdigest()
+    assert gutils.check_sha1(p, good)
+    assert not gutils.check_sha1(p, "0" * 40)
+
+
+def test_download_cached_file_short_circuits(tmp_path):
+    p = os.path.join(tmp_path, "cached.bin")
+    with open(p, "wb") as f:
+        f.write(b"x")
+    # existing file + no hash -> returned without any network touch
+    assert gutils.download("http://invalid.test/cached.bin",
+                           path=p) == p
+
+
+def test_shape_is_known():
+    assert gutils.shape_is_known((1, 2, 3))
+    assert not gutils.shape_is_known((1, -1))
+    assert not gutils.shape_is_known(None)
+    assert not gutils.shape_is_known((None, 2))
+
+
+def test_hook_handle_exported():
+    from mxnet_tpu.gluon.block import _HookHandle
+
+    assert gutils.HookHandle is _HookHandle
