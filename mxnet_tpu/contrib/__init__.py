@@ -1,5 +1,9 @@
-"""mx.contrib — quantization, onnx and other contrib frontends."""
+"""mx.contrib — quantization, onnx, text and other contrib
+frontends."""
+from . import autograd  # noqa: F401
+from . import io  # noqa: F401
 from . import quantization  # noqa: F401
+from . import text  # noqa: F401
 
 
 def __getattr__(name):

@@ -1,0 +1,87 @@
+"""Legacy contrib autograd API.
+
+Parity: python/mxnet/contrib/autograd.py — the pre-`mx.autograd`
+surface (set_is_training:30, train_section:72, test_section:86,
+mark_variables:100, backward:121, compute_gradient:156,
+grad_and_loss:161, grad:193), kept as thin shims over the modern
+``mxnet_tpu.autograd`` tape.
+"""
+from __future__ import annotations
+
+import functools
+
+from .. import autograd as _ag
+from ..ndarray import NDArray
+
+__all__ = ["set_is_training", "train_section", "test_section",
+           "mark_variables", "backward", "compute_gradient",
+           "grad_and_loss", "grad"]
+
+
+def set_is_training(is_train):
+    """Set the global train/test mode; returns the previous mode."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    return prev
+
+
+def train_section():
+    """Scope in which executed code runs in training mode."""
+    return _ag.train_mode()
+
+
+def test_section():
+    """Scope in which executed code runs in inference mode."""
+    return _ag.predict_mode()
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Attach gradient buffers to ``variables`` (tape leaves)."""
+    return _ag.mark_variables(variables, gradients, grad_reqs)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    """Backprop from ``outputs`` into the marked variables."""
+    return _ag.backward(outputs, head_grads=out_grads,
+                        retain_graph=retain_graph)
+
+
+def compute_gradient(outputs):
+    """Legacy alias of :func:`backward` (parity: autograd.py:156)."""
+    return backward(outputs)
+
+
+def grad_and_loss(func, argnum=None):
+    """Wrap ``func`` to return ``(gradients, outputs)`` wrt its array
+    arguments (or the ``argnum``-selected subset)."""
+
+    @functools.wraps(func)
+    def wrapped(*args):
+        idxs = (range(len(args)) if argnum is None
+                else ([argnum] if isinstance(argnum, int) else argnum))
+        variables = [args[i] for i in idxs]
+        for x in variables:
+            if not isinstance(x, NDArray):
+                raise TypeError(
+                    "type of autograd input should NDArray.")
+        grads = [NDArray(x._data * 0) for x in variables]
+        mark_variables(variables, grads)
+        with train_section():
+            with _ag.record():
+                outputs = func(*args)
+        _ag.backward([outputs] if isinstance(outputs, NDArray)
+                     else list(outputs))
+        return grads, outputs
+
+    return wrapped
+
+
+def grad(func, argnum=None):
+    """Like :func:`grad_and_loss` but returning only the gradients."""
+    wrapped = grad_and_loss(func, argnum)
+
+    @functools.wraps(func)
+    def only_grads(*args):
+        return wrapped(*args)[0]
+
+    return only_grads

@@ -1,0 +1,76 @@
+"""Contrib IO: adapt a gluon ``DataLoader`` to the legacy ``DataIter``
+protocol.
+
+Parity: python/mxnet/contrib/io.py:24 (DataLoaderIter) — last batches
+shorter than ``batch_size`` are zero-padded with ``pad`` reporting the
+fill, exactly like the record iterators.
+"""
+from __future__ import annotations
+
+import numpy as onp
+
+from ..io.io import DataDesc, DataIter
+from ..ndarray import NDArray
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a ``gluon.data.DataLoader`` yielding ``(data, label)``
+    pairs as a legacy ``DataIter`` (provide_data/provide_label,
+    reset/iter_next/getdata/getlabel/getpad)."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        super().__init__()
+        self._loader = loader
+        self._iter = iter(loader)
+        data, label = self._peek()
+        self.batch_size = data.shape[0]
+        self.dtype = dtype
+        self.provide_data = [DataDesc(data_name, tuple(data.shape))]
+        self.provide_label = [DataDesc(label_name, tuple(label.shape))]
+        self._current = None
+        self.reset()
+
+    def _peek(self):
+        first = next(self._iter)
+        self._first = first
+        return first
+
+    def reset(self):
+        self._iter = iter(self._loader)
+        self._current = None
+
+    def iter_next(self):
+        try:
+            self._current = next(self._iter)
+        except StopIteration:
+            self._current = None
+        return self._current is not None
+
+    def _padded(self, arr):
+        arr = arr.asnumpy() if isinstance(arr, NDArray) else \
+            onp.asarray(arr)
+        arr = arr.astype(self.dtype)
+        pad = self.getpad()
+        if pad:
+            full = onp.zeros((self.batch_size,) + arr.shape[1:],
+                             self.dtype)
+            full[: arr.shape[0]] = arr
+            arr = full
+        return [NDArray(arr)]
+
+    def getdata(self):
+        return self._padded(self._current[0])
+
+    def getlabel(self):
+        return self._padded(self._current[1])
+
+    def getpad(self):
+        n = (self._current[0].shape[0] if self._current is not None
+             else self.batch_size)
+        return self.batch_size - n
+
+    def getindex(self):
+        return None

@@ -1,0 +1,11 @@
+"""Text utilities: vocabulary, token embeddings, counting helpers.
+
+Parity: python/mxnet/contrib/text/ (vocab.py:28 Vocabulary,
+embedding.py:133 _TokenEmbedding + GloVe:481/FastText:553/
+CustomEmbedding:635/CompositeEmbedding:677, utils.py
+count_tokens_from_str).
+"""
+from . import embedding
+from . import utils
+from . import vocab
+from .vocab import Vocabulary

@@ -1,0 +1,331 @@
+"""Token embeddings: file loading, lookup, registry.
+
+Parity: python/mxnet/contrib/text/embedding.py (_TokenEmbedding:133,
+GloVe:481, FastText:553, CustomEmbedding:635, CompositeEmbedding:677,
+register:40, create:63, get_pretrained_file_names:90).
+
+TPU-native notes: the embedding table lives as one device array
+(``idx_to_vec``); lookups are a single ``take`` — feeding it straight
+into ``gluon.nn.Embedding.weight`` keeps the whole pipeline on-device.
+Pretrained-file *download* is API-complete but requires egress; loading
+from a local file path works everywhere and is what the tests
+exercise.
+"""
+from __future__ import annotations
+
+import io
+import logging
+import os
+from collections import Counter
+from typing import Dict, List, Optional, Sequence
+
+import numpy as onp
+
+from . import vocab as _vocab
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(embedding_cls):
+    """Register a ``_TokenEmbedding`` subclass under its lowercase
+    class name (parity: embedding.py:40)."""
+    name = embedding_cls.__name__.lower()
+    _REGISTRY[name] = embedding_cls
+    return embedding_cls
+
+
+def create(embedding_name, **kwargs):
+    """Create a registered embedding by name, e.g.
+    ``create('glove', pretrained_file_name=..., vocabulary=...)``."""
+    name = embedding_name.lower()
+    if name not in _REGISTRY:
+        raise KeyError(
+            f"Cannot find `embedding_name` {embedding_name}. Valid "
+            f"embedding names: {', '.join(sorted(_REGISTRY))}")
+    return _REGISTRY[name](**kwargs)
+
+
+def get_pretrained_file_names(embedding_name=None):
+    """Known pretrained file names, per embedding or as a dict."""
+    if embedding_name is not None:
+        name = embedding_name.lower()
+        if name not in _REGISTRY:
+            raise KeyError(
+                f"Cannot find `embedding_name` {embedding_name}. Valid "
+                f"embedding names: {', '.join(sorted(_REGISTRY))}")
+        return list(_REGISTRY[name].pretrained_file_names)
+    return {n: list(c.pretrained_file_names)
+            for n, c in _REGISTRY.items()}
+
+
+class _TokenEmbedding(_vocab.Vocabulary):
+    """A vocabulary whose every index also has an embedding vector.
+
+    Built either from a pretrained file (vocabulary = file tokens) or
+    for an existing :class:`~.vocab.Vocabulary` via
+    ``_build_embedding_for_vocabulary``.
+    """
+
+    pretrained_file_names: Sequence[str] = ()
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None
+
+    # -- loading ----------------------------------------------------------
+    def _load_embedding(self, pretrained_file_path, elem_delim=" ",
+                        init_unknown_vec=onp.zeros, encoding="utf-8"):
+        """Parse ``token<delim>v1<delim>v2...`` lines; tokens become
+        the vocabulary (after index 0 = unknown), vectors the table."""
+        pretrained_file_path = os.path.expanduser(pretrained_file_path)
+        if not os.path.isfile(pretrained_file_path):
+            raise ValueError(
+                f"`pretrained_file_path` must be a valid path to the "
+                f"pre-trained token embedding file: "
+                f"{pretrained_file_path}")
+        tokens: List[str] = []
+        vectors: List[onp.ndarray] = []
+        seen = set(self._token_to_idx)
+        with io.open(pretrained_file_path, "r",
+                     encoding=encoding) as f:
+            for line_num, line in enumerate(f, 1):
+                elems = line.rstrip().split(elem_delim)
+                if len(elems) <= 2:
+                    # fastText-style header line "n dim" (or junk)
+                    logging.warning(
+                        "line %d in %s: unexpected data format, "
+                        "skipped", line_num, pretrained_file_path)
+                    continue
+                token, vec = elems[0], elems[1:]
+                if token in seen:
+                    logging.warning(
+                        "line %d in %s: duplicate token %s, skipped",
+                        line_num, pretrained_file_path, token)
+                    continue
+                try:
+                    arr = onp.asarray(vec, dtype="float32")
+                except ValueError:
+                    logging.warning(
+                        "line %d in %s: non-numeric vector, skipped",
+                        line_num, pretrained_file_path)
+                    continue
+                if self._vec_len and arr.size != self._vec_len:
+                    logging.warning(
+                        "line %d in %s: inconsistent vector length, "
+                        "skipped", line_num, pretrained_file_path)
+                    continue
+                self._vec_len = self._vec_len or arr.size
+                seen.add(token)
+                tokens.append(token)
+                vectors.append(arr)
+        if not vectors:
+            raise ValueError(
+                f"no valid embedding vectors found in "
+                f"{pretrained_file_path}")
+        for t in tokens:
+            self._token_to_idx[t] = len(self._idx_to_token)
+            self._idx_to_token.append(t)
+        table = onp.empty((len(self._idx_to_token), self._vec_len),
+                          "float32")
+        n_special = len(self._idx_to_token) - len(tokens)
+        table[:n_special] = init_unknown_vec((self._vec_len,))
+        table[n_special:] = onp.stack(vectors)
+        from ...ndarray import NDArray
+
+        self._idx_to_vec = NDArray(table)
+
+    def _build_embedding_for_vocabulary(self, vocabulary):
+        """Re-index this embedding's vectors onto an external
+        vocabulary (tokens missing from the file get the unknown
+        vector, row 0)."""
+        if vocabulary is None:
+            return
+        src = self._idx_to_vec.asnumpy()
+        # missing tokens get the UNKNOWN vector (row 0 = whatever
+        # init_unknown_vec produced), not hard zeros
+        rows = onp.tile(src[0], (len(vocabulary), 1)).astype("float32")
+        for i, tok in enumerate(vocabulary.idx_to_token):
+            j = self._token_to_idx.get(tok)
+            if j is not None:
+                rows[i] = src[j]
+        from ...ndarray import NDArray
+
+        self._idx_to_vec = NDArray(rows)
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+
+    # -- lookup -----------------------------------------------------------
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        """Vectors for token(s); unknown tokens get row 0.  With
+        ``lower_case_backup``, miss -> retry with ``token.lower()``."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            idxs = [self._token_to_idx.get(
+                t, self._token_to_idx.get(t.lower(), 0)) for t in toks]
+        else:
+            idxs = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec.asnumpy()[onp.asarray(idxs)]
+        from ...ndarray import NDArray
+
+        return NDArray(vecs[0] if single else vecs)
+
+    def update_token_vectors(self, tokens, new_vectors):
+        """Overwrite rows for known ``tokens`` (ValueError on unknown
+        tokens, matching the reference)."""
+        from ...ndarray import NDArray
+
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        if not toks:
+            raise ValueError("`tokens` must not be empty")
+        new = (new_vectors.asnumpy()
+               if isinstance(new_vectors, NDArray)
+               else onp.asarray(new_vectors, "float32"))
+        new = new.reshape(len(toks), self._vec_len)
+        table = self._idx_to_vec.asnumpy().copy()
+        for t, row in zip(toks, new):
+            if t not in self._token_to_idx:
+                raise ValueError(
+                    f"Token {t} is unknown. To update the embedding "
+                    f"vector for an unknown token, please specify it "
+                    f"explicitly as the `unknown_token` "
+                    f"{self.unknown_token}.")
+            table[self._token_to_idx[t]] = row
+        self._idx_to_vec = NDArray(table)
+
+    # -- download plumbing (egress-gated) ---------------------------------
+    @classmethod
+    def _check_pretrained_file_names(cls, pretrained_file_name):
+        if pretrained_file_name not in cls.pretrained_file_names:
+            raise KeyError(
+                f"Cannot find pretrained file {pretrained_file_name} "
+                f"for {cls.__name__.lower()}. Valid files: "
+                f"{', '.join(cls.pretrained_file_names)}")
+
+    @classmethod
+    def _get_pretrained_file(cls, embedding_root, pretrained_file_name):
+        """Download (and cache) a pretrained file; requires egress."""
+        from ...gluon.utils import download
+
+        cls._check_pretrained_file_names(pretrained_file_name)
+        url = cls._url_format.format(pretrained_file_name)
+        root = os.path.expanduser(embedding_root)
+        os.makedirs(root, exist_ok=True)
+        return download(url, os.path.join(root, pretrained_file_name))
+
+
+@register
+class GloVe(_TokenEmbedding):
+    """GloVe embeddings (file format: ``token v1 ... vN`` per line).
+
+    Parity: embedding.py:481.  Pass a local ``pretrained_file_path``
+    to skip the download.
+    """
+
+    pretrained_file_names = (
+        "glove.42B.300d.txt", "glove.6B.50d.txt", "glove.6B.100d.txt",
+        "glove.6B.200d.txt", "glove.6B.300d.txt", "glove.840B.300d.txt",
+        "glove.twitter.27B.25d.txt", "glove.twitter.27B.50d.txt",
+        "glove.twitter.27B.100d.txt", "glove.twitter.27B.200d.txt")
+    _url_format = "https://apache-mxnet.s3-accelerate.amazonaws.com/" \
+                  "gluon/embeddings/glove/{}"
+
+    def __init__(self, pretrained_file_name="glove.840B.300d.txt",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=onp.zeros, vocabulary=None,
+                 pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            pretrained_file_path = self._get_pretrained_file(
+                embedding_root, pretrained_file_name)
+        self._load_embedding(pretrained_file_path, " ",
+                             init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class FastText(_TokenEmbedding):
+    """fastText .vec embeddings (first line is a ``count dim`` header,
+    skipped by the loader).  Parity: embedding.py:553."""
+
+    pretrained_file_names = (
+        "wiki.en.vec", "wiki.simple.vec", "crawl-300d-2M.vec")
+    _url_format = "https://apache-mxnet.s3-accelerate.amazonaws.com/" \
+                  "gluon/embeddings/fasttext/{}"
+
+    def __init__(self, pretrained_file_name="wiki.simple.vec",
+                 embedding_root=os.path.join("~", ".mxnet", "embeddings"),
+                 init_unknown_vec=onp.zeros, vocabulary=None,
+                 pretrained_file_path=None, **kwargs):
+        super().__init__(**kwargs)
+        if pretrained_file_path is None:
+            pretrained_file_path = self._get_pretrained_file(
+                embedding_root, pretrained_file_name)
+        self._load_embedding(pretrained_file_path, " ",
+                             init_unknown_vec)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+@register
+class CustomEmbedding(_TokenEmbedding):
+    """User-provided embedding file with a custom element delimiter.
+    Parity: embedding.py:635."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ",
+                 encoding="utf-8", init_unknown_vec=onp.zeros,
+                 vocabulary=None, **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding(pretrained_file_path, elem_delim,
+                             init_unknown_vec, encoding)
+        self._build_embedding_for_vocabulary(vocabulary)
+
+
+class CompositeEmbedding(_TokenEmbedding):
+    """Concatenate several embeddings under one vocabulary.
+    Parity: embedding.py:677."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        if not isinstance(vocabulary, _vocab.Vocabulary):
+            raise TypeError(
+                "`vocabulary` must be an instance of Vocabulary.")
+        if isinstance(token_embeddings, _TokenEmbedding):
+            token_embeddings = [token_embeddings]
+        super().__init__()
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._unknown_token = vocabulary.unknown_token
+        self._reserved_tokens = vocabulary.reserved_tokens
+
+        parts = []
+        for emb in token_embeddings:
+            emb = _copy_embedding(emb)
+            emb._build_embedding_for_vocabulary(vocabulary)
+            parts.append(emb.idx_to_vec.asnumpy())
+        table = onp.concatenate(parts, axis=1)
+        self._vec_len = table.shape[1]
+        from ...ndarray import NDArray
+
+        self._idx_to_vec = NDArray(table)
+
+
+def _copy_embedding(emb):
+    """Shallow working copy so re-indexing onto a vocabulary does not
+    mutate the caller's embedding."""
+    import copy
+
+    out = copy.copy(emb)
+    out._idx_to_token = list(emb._idx_to_token)
+    out._token_to_idx = dict(emb._token_to_idx)
+    return out

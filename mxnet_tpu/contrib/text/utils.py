@@ -1,0 +1,27 @@
+"""Text processing helpers.
+
+Parity: python/mxnet/contrib/text/utils.py (count_tokens_from_str).
+"""
+from __future__ import annotations
+
+import re
+from collections import Counter
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in ``source_str``, splitting sequences on
+    ``seq_delim`` and tokens on ``token_delim`` (both regexes).
+
+    Returns ``counter_to_update`` updated in place, or a fresh
+    ``collections.Counter`` when it is None.
+    """
+    source_str = filter(
+        None, re.split(token_delim + "|" + seq_delim, source_str))
+    counter = (Counter() if counter_to_update is None
+               else counter_to_update)
+    if to_lower:
+        counter.update(t.lower() for t in source_str)
+    else:
+        counter.update(source_str)
+    return counter

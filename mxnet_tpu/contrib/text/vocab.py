@@ -1,0 +1,103 @@
+"""Indexed vocabulary for text tokens.
+
+Parity: python/mxnet/contrib/text/vocab.py:28 — indexing rules match
+the reference: the unknown token gets index 0, reserved tokens follow,
+then counter keys sorted by frequency (ties broken alphabetically),
+capped by ``most_freq_count`` and floored by ``min_freq``.
+"""
+from __future__ import annotations
+
+from collections import Counter
+from typing import List, Optional, Sequence
+
+
+class Vocabulary:
+    """Token <-> index bijection built from a ``collections.Counter``.
+
+    Index 0 is ``unknown_token``; ``reserved_tokens`` (must not repeat
+    or contain the unknown token) take the next indices; remaining
+    counter keys are indexed by descending frequency, alphabetically
+    within a frequency tie.
+    """
+
+    def __init__(self, counter: Optional[Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None):
+        if min_freq < 1:
+            raise ValueError("`min_freq` must be set to a positive value.")
+        if reserved_tokens is not None:
+            reserved_set = set(reserved_tokens)
+            if unknown_token in reserved_set:
+                raise ValueError("`reserved_tokens` must not contain the "
+                                 "`unknown_token`.")
+            if len(reserved_set) != len(reserved_tokens):
+                raise ValueError("`reserved_tokens` must not contain "
+                                 "duplicate reserved tokens.")
+
+        self._unknown_token = unknown_token
+        self._reserved_tokens = (list(reserved_tokens)
+                                 if reserved_tokens is not None else None)
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter_keys(counter, most_freq_count, min_freq)
+
+    def _index_counter_keys(self, counter, most_freq_count, min_freq):
+        assert isinstance(counter, Counter), \
+            "`counter` must be an instance of collections.Counter."
+        skip = {self._unknown_token} | set(self._reserved_tokens or [])
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        budget = (most_freq_count if most_freq_count is not None
+                  else len(pairs))
+        for token, freq in pairs:
+            if budget <= 0 or freq < min_freq:
+                break
+            if token in skip:
+                continue
+            self._token_to_idx[token] = len(self._idx_to_token)
+            self._idx_to_token.append(token)
+            budget -= 1
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    def __contains__(self, token):
+        return token in self._token_to_idx
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Token(s) -> index/indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        out = [self._token_to_idx.get(t, 0) for t in toks]
+        return out[0] if single else out
+
+    def to_tokens(self, indices):
+        """Index/indices -> token(s); out-of-range raises ValueError."""
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        out: List[str] = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise ValueError(
+                    f"Token index {i} in the provided `indices` is "
+                    f"invalid.")
+            out.append(self._idx_to_token[i])
+        return out[0] if single else out

@@ -6,9 +6,13 @@ from .text import WikiText2, WikiText103, Vocabulary
 from .vision import (ImageBboxRandomFlipLeftRight, ImageBboxCrop,
                      ImageBboxRandomCropWithConstraints,
                      ImageBboxRandomExpand, ImageBboxResize,
-                     ImageDataLoader, ImageBboxDataLoader)
+                     ImageDataLoader, ImageBboxDataLoader,
+                     DatasetImageDataLoader, DatasetImageBboxDataLoader,
+                     create_image_augment, create_bbox_augment)
 
 __all__ = ["IntervalSampler", "WikiText2", "WikiText103", "Vocabulary",
            "ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
            "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
-           "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader"]
+           "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader",
+           "DatasetImageDataLoader", "DatasetImageBboxDataLoader",
+           "create_image_augment", "create_bbox_augment"]

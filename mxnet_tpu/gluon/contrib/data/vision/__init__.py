@@ -1,0 +1,21 @@
+"""Contrib vision data: bbox-aware transforms, augmentation-pipeline
+factories, and image/bbox data loaders.
+
+Parity: python/mxnet/gluon/contrib/data/vision/ — transforms/bbox
+(ImageBbox* blocks, transforms.py here) and dataloader.py
+(create_image_augment:34, ImageDataLoader:140,
+create_bbox_augment:246, ImageBboxDataLoader:364).
+"""
+from .transforms import (DatasetImageBboxDataLoader,
+                         DatasetImageDataLoader, ImageBboxCrop,
+                         ImageBboxRandomCropWithConstraints,
+                         ImageBboxRandomExpand,
+                         ImageBboxRandomFlipLeftRight, ImageBboxResize)
+from .dataloader import (ImageBboxDataLoader, ImageDataLoader,
+                         create_bbox_augment, create_image_augment)
+
+__all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
+           "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
+           "ImageBboxResize", "create_image_augment", "ImageDataLoader",
+           "create_bbox_augment", "ImageBboxDataLoader",
+           "DatasetImageDataLoader", "DatasetImageBboxDataLoader"]

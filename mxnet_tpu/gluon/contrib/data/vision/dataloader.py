@@ -1,0 +1,259 @@
+"""Augmentation-pipeline factories and image/bbox data loaders.
+
+Parity: python/mxnet/gluon/contrib/data/vision/dataloader.py —
+``create_image_augment`` (:34) assembles the classic record-iter
+augmentation chain out of ``gluon.data.vision.transforms`` blocks;
+``ImageDataLoader`` (:140) / ``ImageBboxDataLoader`` (:364) wrap a
+record/list dataset + the augment + a ``DataLoader`` so the legacy
+``ImageRecordIter``/``ImageDetRecordIter`` experience is available as
+a gluon loader.
+
+TPU-native: augmentation runs in DataLoader workers on host (numpy /
+eager image ops); the produced batches are dense, fixed-shape tensors
+ready for one device transfer per batch — bbox labels are padded to
+the batch max with -1, the detection stack's ignore value.
+"""
+from __future__ import annotations
+
+import random as pyrandom
+
+import numpy as onp
+
+from .....ndarray import NDArray
+from ....data import DataLoader
+from ....data.vision import transforms
+from ....data.vision.datasets import (ImageListDataset,
+                                      ImageRecordDataset)
+
+__all__ = ["create_image_augment", "ImageDataLoader",
+           "create_bbox_augment", "ImageBboxDataLoader"]
+
+
+def create_image_augment(data_shape, resize=0, rand_crop=False,
+                         rand_resize=False, rand_mirror=False,
+                         mean=None, std=None, brightness=0, contrast=0,
+                         saturation=0, hue=0, pca_noise=0, rand_gray=0,
+                         inter_method=1, dtype="float32"):
+    """Build the standard augmentation chain as one transform block:
+    resize -> (random|random-resized|center) crop -> flip -> color
+    jitter -> pca lighting -> gray -> ToTensor -> Normalize -> Cast.
+    """
+    if inter_method == 10:      # "random interpolation"
+        inter_method = pyrandom.randint(0, 4)
+    chain = []
+    if resize > 0:
+        chain.append(transforms.Resize(resize, keep_ratio=True,
+                                       interpolation=inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        if not rand_crop:
+            raise ValueError("`rand_resize` requires `rand_crop`.")
+        chain.append(transforms.RandomResizedCrop(
+            crop_size, interpolation=inter_method))
+    elif rand_crop:
+        chain.append(transforms.RandomCrop(
+            crop_size, interpolation=inter_method))
+    else:
+        chain.append(transforms.CenterCrop(crop_size,
+                                           interpolation=inter_method))
+    if rand_mirror:
+        chain.append(transforms.RandomFlipLeftRight())
+    chain.append(transforms.Cast())
+    if brightness or contrast or saturation or hue:
+        chain.append(transforms.RandomColorJitter(
+            brightness, contrast, saturation, hue))
+    if pca_noise > 0:
+        chain.append(transforms.RandomLighting(pca_noise))
+    if rand_gray > 0:
+        chain.append(transforms.RandomGray(rand_gray))
+    # ToTensor rescales to [0, 1], so the ImageNet constants must be
+    # on the SAME scale (123.68/255 etc.) — 0-255-scale constants
+    # after ToTensor would collapse every image to ~-2.1
+    if mean is True:
+        mean = [0.485, 0.456, 0.406]
+    if std is True:
+        std = [0.229, 0.224, 0.225]
+    chain.append(transforms.ToTensor())
+    if mean is not None or std is not None:
+        chain.append(transforms.Normalize(
+            mean if mean is not None else 0.0,
+            std if std is not None else 1.0))
+    chain.append(transforms.Cast(dtype))
+    return transforms.Compose(chain)
+
+
+class ImageDataLoader:
+    """Classification image loader: .rec / .lst / in-memory list in,
+    augmented dense batches out (parity: dataloader.py:140)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", shuffle=False,
+                 flag=1, aug_list=None, imglist=None, num_workers=0,
+                 last_batch="keep", **aug_kwargs):
+        if len(data_shape) != 3 or data_shape[0] != 3:
+            raise ValueError(
+                f"data_shape must be (3, H, W), got {data_shape}")
+        if path_imgrec:
+            dataset = ImageRecordDataset(path_imgrec, flag=flag)
+        elif path_imglist or imglist:
+            dataset = ImageListDataset(root=path_root,
+                                       imglist=path_imglist or imglist,
+                                       flag=flag)
+        else:
+            raise ValueError(
+                "one of path_imgrec, path_imglist or imglist is "
+                "required")
+        if aug_list is None:
+            augment = create_image_augment(data_shape, **aug_kwargs)
+        elif isinstance(aug_list, (list, tuple)):
+            augment = transforms.Compose(list(aug_list))
+        else:
+            augment = aug_list
+        self._dataset = dataset.transform_first(augment)
+        self._loader = DataLoader(self._dataset, batch_size=batch_size,
+                                  shuffle=shuffle,
+                                  num_workers=num_workers,
+                                  last_batch=last_batch)
+
+    def __iter__(self):
+        return iter(self._loader)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def create_bbox_augment(data_shape, rand_crop=0, rand_pad=0,
+                        rand_gray=0, rand_mirror=False, mean=None,
+                        std=None, brightness=0, contrast=0,
+                        saturation=0, hue=0, pca_noise=0,
+                        max_aspect_ratio=2,
+                        area_range=(0.05, 3.0), max_attempts=50,
+                        pad_val=(127, 127, 127), dtype="float32"):
+    """Detection augmentation as one callable ``(img, label) ->
+    (img, label)`` built over the det-augmenter family
+    (image/detection.py), followed by ToTensor/Normalize/Cast on the
+    image (parity: dataloader.py:246)."""
+    from .....image.detection import CreateDetAugmenter
+
+    dets = CreateDetAugmenter(
+        data_shape, rand_crop=rand_crop, rand_pad=rand_pad,
+        rand_gray=rand_gray, rand_mirror=rand_mirror,
+        brightness=brightness, contrast=contrast,
+        saturation=saturation, hue=hue, pca_noise=pca_noise,
+        aspect_ratio_range=(1.0 / max_aspect_ratio, max_aspect_ratio),
+        area_range=area_range, max_attempts=max_attempts,
+        pad_val=pad_val)
+    if mean is True:
+        mean = [0.485, 0.456, 0.406]      # [0,1] scale: after ToTensor
+    if std is True:
+        std = [0.229, 0.224, 0.225]
+    tail = [transforms.ToTensor()]
+    if mean is not None or std is not None:
+        tail.append(transforms.Normalize(
+            mean if mean is not None else 0.0,
+            std if std is not None else 1.0))
+    tail.append(transforms.Cast(dtype))
+    tail = transforms.Compose(tail)
+
+    def augment(img, label):
+        data = img if isinstance(img, NDArray) else \
+            NDArray(onp.asarray(img))
+        lab = onp.asarray(label, onp.float32)
+        for aug in dets:
+            data, lab = aug(data, lab)
+        return tail(data), lab
+
+    return augment
+
+
+class ImageBboxDataLoader:
+    """Detection loader: det-.rec / .lst in, (image batch, padded
+    bbox-label batch) out (parity: dataloader.py:364).  Labels are
+    ``(B, max_objects, 5)`` padded with -1."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, path_root=".", shuffle=False,
+                 flag=1, aug_list=None, imglist=None, num_workers=0,
+                 last_batch="keep", coord_normalized=True,
+                 **aug_kwargs):
+        from .....image.detection import ImageDetIter
+
+        # reuse the det iterator's record/list parsing + label layout,
+        # drive it as a random-access dataset
+        self._it = ImageDetIter(
+            batch_size=1, data_shape=data_shape,
+            path_imgrec=path_imgrec, path_imglist=path_imglist,
+            path_root=path_root, shuffle=False, imglist=imglist,
+            aug_list=[])
+        if aug_list is None:
+            self._augment = create_bbox_augment(data_shape,
+                                                **aug_kwargs)
+        elif isinstance(aug_list, (list, tuple)):
+            dets = list(aug_list)
+
+            def _chain(img, label):
+                lab = onp.asarray(label, onp.float32)
+                data = img if isinstance(img, NDArray) else \
+                    NDArray(onp.asarray(img))
+                for aug in dets:
+                    data, lab = aug(data, lab)
+                return data, lab
+
+            self._augment = _chain
+        else:
+            self._augment = aug_list
+        self._batch_size = batch_size
+        self._shuffle = shuffle
+        # det augmenters operate in normalized [0,1] bbox space; with
+        # coord_normalized=False, pixel-coordinate labels are divided
+        # by the source image size on read (emitted labels are then
+        # normalized, like the reference's BboxLabelTransform)
+        self._coord_normalized = coord_normalized
+        self._last_batch = last_batch
+        if num_workers:
+            import warnings
+
+            warnings.warn(
+                "ImageBboxDataLoader runs host augmentation inline; "
+                "num_workers is ignored", stacklevel=2)
+
+    def _items(self):
+        idxs = list(range(len(self._it._records)))
+        if self._shuffle:
+            pyrandom.shuffle(idxs)
+        return idxs
+
+    def __iter__(self):
+        batch_imgs, batch_labels = [], []
+        for i in self._items():
+            img, raw = self._it._read_one_det(i)
+            label = self._it._parse_label(raw)
+            if not self._coord_normalized:
+                h, w = img.shape[0], img.shape[1]
+                label = label.copy()
+                label[:, 1] /= w
+                label[:, 3] /= w
+                label[:, 2] /= h
+                label[:, 4] /= h
+            img_t, lab = self._augment(img, label)
+            batch_imgs.append(img_t.asnumpy())
+            batch_labels.append(onp.asarray(lab, onp.float32))
+            if len(batch_imgs) == self._batch_size:
+                yield self._emit(batch_imgs, batch_labels)
+                batch_imgs, batch_labels = [], []
+        if batch_imgs and self._last_batch != "discard":
+            yield self._emit(batch_imgs, batch_labels)
+
+    def _emit(self, imgs, labels):
+        max_obj = max(l.shape[0] for l in labels)
+        width = labels[0].shape[1]
+        lab = onp.full((len(labels), max_obj, width), -1.0, "float32")
+        for i, l in enumerate(labels):
+            lab[i, : l.shape[0]] = l
+        return NDArray(onp.stack(imgs)), NDArray(lab)
+
+    def __len__(self):
+        n = len(self._it._records)
+        if self._last_batch == "discard":
+            return n // self._batch_size
+        return (n + self._batch_size - 1) // self._batch_size

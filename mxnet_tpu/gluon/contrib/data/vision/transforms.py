@@ -13,14 +13,15 @@ import random as pyrandom
 
 import numpy as onp
 
-from ....base import MXNetError
-from ....ndarray import NDArray
-from ...block import Block
-from ...data import DataLoader
+from .....base import MXNetError
+from .....ndarray import NDArray
+from ....block import Block
+from ....data import DataLoader
 
 __all__ = ["ImageBboxRandomFlipLeftRight", "ImageBboxCrop",
            "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
-           "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader"]
+           "ImageBboxResize", "DatasetImageDataLoader",
+           "DatasetImageBboxDataLoader"]
 
 
 def _np(x):
@@ -204,7 +205,7 @@ class ImageBboxResize(Block):
         self._interp = interp
 
     def forward(self, img, bbox):
-        from ....image import imresize
+        from .....image import imresize
         b = _check_bbox(bbox)
         arr = _np(img)
         H, W = arr.shape[0], arr.shape[1]
@@ -223,9 +224,10 @@ class ImageBboxResize(Block):
                                           b.dtype.kind == "f" else "float32"))
 
 
-class ImageDataLoader(DataLoader):
-    """DataLoader applying an image transform pipeline to sample[0]
-    (parity: contrib/data/vision/dataloader.py ImageDataLoader)."""
+class DatasetImageDataLoader(DataLoader):
+    """DataLoader applying an image transform pipeline to sample[0] of
+    an existing dataset (convenience variant; the reference-parity
+    path-based ImageDataLoader lives in dataloader.py)."""
 
     def __init__(self, dataset, batch_size=None, transform=None, **kwargs):
         if transform is not None:
@@ -249,9 +251,10 @@ class ImageDataLoader(DataLoader):
         super().__init__(dataset, batch_size=batch_size, **kwargs)
 
 
-class ImageBboxDataLoader(DataLoader):
-    """DataLoader for (image, bbox) datasets applying joint transforms
-    (parity: contrib/data/vision/dataloader.py ImageBboxDataLoader).
+class DatasetImageBboxDataLoader(DataLoader):
+    """DataLoader for existing (image, bbox) datasets applying joint
+    transforms (convenience variant; the reference-parity path-based
+    ImageBboxDataLoader lives in dataloader.py).
 
     ``bbox_transform`` takes (img, bbox) and returns (img, bbox); the
     batchify pads bbox arrays to the batch's max box count with -1 rows

@@ -43,7 +43,7 @@ class SPMDTrainer:
                  mesh: Optional[Mesh] = None, batch_axis: int = 0,
                  donate: bool = True, dtype: Optional[str] = None,
                  remat: bool = False, seq_axis: Optional[int] = None,
-                 micro_batches: int = 1):
+                 micro_batches: int = 1, zero_stage: int = 0):
         self.net = net
         self.loss_fn = loss_fn
         self.mesh = mesh or default_mesh()
@@ -67,6 +67,20 @@ class SPMDTrainer:
         if micro_batches < 1:
             raise MXNetError("micro_batches must be >= 1")
         self.micro_batches = int(micro_batches)
+        # ZeRO-style memory sharding over the dp axis (the GSPMD
+        # re-expression of the reference's server-held optimizer state,
+        # kvstore_dist_server.h ApplyUpdates, and of ZeRO/FSDP):
+        #   0 — off: params and optimizer state replicated across dp.
+        #   1/2 — optimizer state sharded over dp; GSPMD turns the
+        #       update into reduce-scatter(grad) -> sharded update ->
+        #       all-gather(weight), so stages 1 and 2 coincide here.
+        #   3 — FSDP: master params ALSO sharded over dp; each use in
+        #       the forward all-gathers just-in-time.
+        # Per-parameter TP shardings (Parameter.shard) take precedence;
+        # tensors with no dp-divisible axis stay replicated.
+        if zero_stage not in (0, 1, 2, 3):
+            raise MXNetError("zero_stage must be 0, 1, 2 or 3")
+        self.zero_stage = int(zero_stage)
         # mixed precision (parity: AMP bf16 — master weights stay f32,
         # forward/backward compute in bf16 on the MXU; bf16 needs no loss
         # scaling on TPU, SURVEY.md §7 stage 7)
@@ -87,9 +101,39 @@ class SPMDTrainer:
         self.num_update = 0
 
     # -- sharding ----------------------------------------------------------
+    def _zero_spec(self, param):
+        """PartitionSpec sharding ``param``'s largest dp-divisible axis
+        over 'dp', or None when nothing divides (small biases etc. stay
+        replicated — their memory is negligible)."""
+        if "dp" not in self.mesh.axis_names:
+            return None
+        ndp = self.mesh.shape["dp"]
+        if ndp <= 1:
+            return None
+        shape = param.shape
+        best = None
+        for ax, dim in enumerate(shape or ()):
+            if dim % ndp == 0 and (best is None or dim > shape[best]):
+                best = ax
+        if best is None:
+            return None
+        spec = [None] * len(shape)
+        spec[best] = "dp"
+        return PartitionSpec(*spec)
+
     def _param_sharding(self, param):
-        spec = param._sharding or PartitionSpec()
-        return NamedSharding(self.mesh, spec)
+        spec = param._sharding
+        if spec is None and self.zero_stage >= 3:
+            spec = self._zero_spec(param)
+        return NamedSharding(self.mesh, spec or PartitionSpec())
+
+    def _opt_state_sharding(self, param):
+        """Optimizer-state sharding: follows the param (TP etc.), plus
+        the ZeRO dp-shard for otherwise-replicated params."""
+        spec = param._sharding
+        if spec is None and self.zero_stage >= 1:
+            spec = self._zero_spec(param)
+        return NamedSharding(self.mesh, spec or PartitionSpec())
 
     def _batch_sharding(self, ndim):
         spec = [None] * ndim
@@ -228,7 +272,7 @@ class SPMDTrainer:
 
     def _state_shardings(self, params):
         p_shardings = [self._param_sharding(p) for p in params]
-        s_shardings = [tuple(self._param_sharding(p) for _ in st)
+        s_shardings = [tuple(self._opt_state_sharding(p) for _ in st)
                        for p, st in zip(
                            params,
                            (self._opt_state[k] for k in self._pkeys))]
@@ -297,10 +341,23 @@ class SPMDTrainer:
                          donate_argnums=donate)
         return jitted, cell
 
+    @staticmethod
+    def _put(arr, sharding):
+        """Reshard ``arr`` onto ``sharding`` if it is committed
+        elsewhere (an NDArray input is committed to one device at
+        construction; jit with in_shardings rejects the mismatch
+        rather than auto-resharding).  No-op when already placed."""
+        cur = getattr(arr, "sharding", None)
+        if cur == sharding or not getattr(arr, "_committed", False):
+            return arr
+        return jax.device_put(arr, sharding)
+
     def step(self, data, label, batch_size: Optional[int] = None):
         """One training step; returns the (device) loss as NDArray."""
         d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        d = self._put(d, self._batch_sharding(d.ndim))
+        l = self._put(l, self._batch_sharding(l.ndim))
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
         entry = self._step_cache.get(sig)
         if entry is None:
@@ -313,13 +370,26 @@ class SPMDTrainer:
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
         self.optimizer.num_update = self.num_update
-        p_arrays = [self._params[k].data()._data for k in self._pkeys]
-        opt_state = [self._opt_state[k] for k in self._pkeys]
+        p_arrays, opt_state = self._gather_state()
         new_p, new_s, loss, aux = jitted(next_key(), lr, wd, p_arrays,
                                          opt_state, d, l)
         self._fold_back(new_p, new_s, cell, aux)
         profiler.op_record("SPMDTrainer::step", _prof_t0)
         return NDArray(loss)
+
+    def _gather_state(self):
+        """Current param/opt-state arrays, resharded onto the step's
+        declared shardings where needed (first call after eager init
+        or load: everything is committed to one device)."""
+        p_arrays, opt_state = [], []
+        for k in self._pkeys:
+            p = self._params[k]
+            p_arrays.append(self._put(p.data()._data,
+                                      self._param_sharding(p)))
+            shd = self._opt_state_sharding(p)
+            opt_state.append(tuple(self._put(a, shd)
+                                   for a in self._opt_state[k]))
+        return p_arrays, opt_state
 
     def _fold_back(self, new_p, new_s, cell, aux=None):
         covered = set()
@@ -355,6 +425,10 @@ class SPMDTrainer:
         in one launch."""
         d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
         l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
+        shard_of = (self._window_sharding if per_step_data
+                    else self._batch_sharding)
+        d = self._put(d, shard_of(d.ndim))
+        l = self._put(l, shard_of(l.ndim))
         if per_step_data and (d.shape[0] != n_steps
                               or l.shape[0] != n_steps):
             raise MXNetError(
@@ -376,8 +450,7 @@ class SPMDTrainer:
         wd = jnp.float32(self.optimizer.wd)
         self.num_update += int(n_steps)
         self.optimizer.num_update = self.num_update
-        p_arrays = [self._params[k].data()._data for k in self._pkeys]
-        opt_state = [self._opt_state[k] for k in self._pkeys]
+        p_arrays, opt_state = self._gather_state()
         new_p, new_s, losses = jitted(next_key(), lr, wd, p_arrays,
                                       opt_state, d, l)
         self._fold_back(new_p, new_s, cell)
@@ -423,7 +496,10 @@ class SPMDTrainer:
             entry = (jitted, None)
             self._step_cache[sig] = entry
         jitted, _ = entry
-        p_arrays = [self._params[k].data()._data for k in self._pkeys]
+        d = self._put(d, self._batch_sharding(d.ndim))
+        p_arrays = [self._put(self._params[k].data()._data,
+                              self._param_sharding(self._params[k]))
+                    for k in self._pkeys]
         return NDArray(jitted(p_arrays, d))
 
     def cost_analysis(self, data, label, n_steps=None):
@@ -521,7 +597,7 @@ class SPMDTrainer:
             for k, n in header["slots"].items():
                 if k not in self._opt_state:
                     raise MXNetError(f"unknown optimizer-state key {k!r}")
-                shd = self._param_sharding(self._params[k])
+                shd = self._opt_state_sharding(self._params[k])
                 self._opt_state[k] = tuple(
                     jax.device_put(jnp.asarray(_restore(k, i)), shd)
                     for i in range(int(n)))

@@ -82,7 +82,7 @@ def test_image_bbox_dataloader_pads():
         def __getitem__(self, i):
             return imgs[i], boxes[i]
 
-    dl = cdata.ImageBboxDataLoader(DS(), batch_size=3)
+    dl = cdata.DatasetImageBboxDataLoader(DS(), batch_size=3)
     bimgs, bboxes = next(iter(dl))
     assert bimgs.shape == (3, 8, 8, 3)
     assert bboxes.shape == (3, 3, 5)

@@ -1,0 +1,222 @@
+"""contrib.text parity tests (reference python/mxnet/contrib/text/:
+vocab.py:28, embedding.py:133/481/553/635/677, utils.py;
+reference test model: tests/python/unittest/test_contrib_text.py).
+Also covers contrib.autograd (contrib/autograd.py) and contrib.io
+(contrib/io.py:24 DataLoaderIter)."""
+import os
+from collections import Counter
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+from mxnet_tpu.ndarray import NDArray
+
+
+def _counter():
+    return text.utils.count_tokens_from_str(
+        "a b b c c c\nd d d d unk")
+
+
+def test_count_tokens_from_str():
+    c = _counter()
+    assert c["c"] == 3 and c["b"] == 2 and c["a"] == 1 and c["d"] == 4
+    c2 = text.utils.count_tokens_from_str("A a\nB b", to_lower=True)
+    assert c2["a"] == 2 and c2["b"] == 2
+    # update an existing counter in place
+    c3 = text.utils.count_tokens_from_str("a", counter_to_update=c2)
+    assert c3 is c2 and c3["a"] == 3
+
+
+def test_vocabulary_indexing_rules():
+    v = text.Vocabulary(_counter(), most_freq_count=None, min_freq=1,
+                        unknown_token="<unk>", reserved_tokens=["<pad>"])
+    assert v.idx_to_token[0] == "<unk>"
+    assert v.idx_to_token[1] == "<pad>"
+    # frequency order d(4) c(3) b(2), ties alphabetical: a, unk
+    assert v.idx_to_token[2:] == ["d", "c", "b", "a", "unk"]
+    assert v.to_indices("d") == 2
+    assert v.to_indices(["b", "nope"]) == [4, 0]
+    assert v.to_tokens([2, 3]) == ["d", "c"]
+    with pytest.raises(ValueError):
+        v.to_tokens(len(v))
+    assert "d" in v and "nope" not in v
+
+
+def test_vocabulary_caps_and_floors():
+    v = text.Vocabulary(_counter(), most_freq_count=2, min_freq=2)
+    # only d and c fit the cap; b (freq 2) is cut by most_freq_count
+    assert v.idx_to_token == ["<unk>", "d", "c"]
+    v2 = text.Vocabulary(_counter(), min_freq=3)
+    assert set(v2.idx_to_token) == {"<unk>", "d", "c"}
+    with pytest.raises(ValueError):
+        text.Vocabulary(min_freq=0)
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<unk>"])
+    with pytest.raises(ValueError):
+        text.Vocabulary(reserved_tokens=["<pad>", "<pad>"])
+
+
+def _write_embedding(path, elem_delim=" ", header=False):
+    lines = []
+    if header:
+        lines.append("3 4")
+    lines += [elem_delim.join(["alpha", "1", "2", "3", "4"]),
+              elem_delim.join(["beta", "5", "6", "7", "8"]),
+              elem_delim.join(["gamma", "9", "10", "11", "12"])]
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return path
+
+
+def test_custom_embedding_loads_file(tmp_path):
+    p = _write_embedding(os.path.join(tmp_path, "emb.txt"))
+    emb = text.embedding.CustomEmbedding(p)
+    assert emb.vec_len == 4
+    assert len(emb) == 4            # <unk> + 3 tokens
+    v = emb.get_vecs_by_tokens("beta")
+    onp.testing.assert_array_equal(v.asnumpy(), [5, 6, 7, 8])
+    # unknown token -> row 0 (init_unknown_vec=zeros)
+    z = emb.get_vecs_by_tokens(["nope", "alpha"])
+    onp.testing.assert_array_equal(z.asnumpy()[0], onp.zeros(4))
+    onp.testing.assert_array_equal(z.asnumpy()[1], [1, 2, 3, 4])
+    # lower-case backup
+    u = emb.get_vecs_by_tokens(["ALPHA"], lower_case_backup=True)
+    onp.testing.assert_array_equal(u.asnumpy()[0], [1, 2, 3, 4])
+
+
+def test_embedding_header_and_bad_lines_skipped(tmp_path):
+    p = os.path.join(tmp_path, "emb.vec")
+    with open(p, "w") as f:
+        f.write("3 4\n")                      # fastText header
+        f.write("alpha 1 2 3 4\n")
+        f.write("alpha 9 9 9 9\n")            # duplicate -> skipped
+        f.write("beta 5 6 7\n")               # bad length -> skipped
+        f.write("gamma x y z w\n")            # non-numeric -> skipped
+    emb = text.embedding.CustomEmbedding(p)
+    assert len(emb) == 2 and emb.vec_len == 4
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("alpha").asnumpy(), [1, 2, 3, 4])
+
+
+def test_update_token_vectors(tmp_path):
+    p = _write_embedding(os.path.join(tmp_path, "emb.txt"))
+    emb = text.embedding.CustomEmbedding(p)
+    emb.update_token_vectors("alpha", NDArray(
+        onp.full(4, 7.0, "float32")))
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("alpha").asnumpy(), onp.full(4, 7.0))
+    with pytest.raises(ValueError):
+        emb.update_token_vectors("nope", onp.zeros(4, "float32"))
+
+
+def test_embedding_for_external_vocabulary(tmp_path):
+    p = _write_embedding(os.path.join(tmp_path, "emb.txt"))
+    vocab = text.Vocabulary(Counter(
+        {"beta": 3, "delta": 2, "alpha": 1}))
+    emb = text.embedding.CustomEmbedding(p, vocabulary=vocab)
+    assert emb.idx_to_token == vocab.idx_to_token
+    assert emb.idx_to_vec.shape == (len(vocab), 4)
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("beta").asnumpy(), [5, 6, 7, 8])
+    # delta is not in the file -> unknown (zero) vector
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("delta").asnumpy(), onp.zeros(4))
+
+
+def test_composite_embedding(tmp_path):
+    p1 = _write_embedding(os.path.join(tmp_path, "e1.txt"))
+    p2 = os.path.join(tmp_path, "e2.txt")
+    with open(p2, "w") as f:
+        f.write("alpha 0.5 0.5\nbeta 1.5 1.5\n")
+    e1 = text.embedding.CustomEmbedding(p1)
+    e2 = text.embedding.CustomEmbedding(p2)
+    vocab = text.Vocabulary(Counter({"alpha": 2, "beta": 1}))
+    comp = text.embedding.CompositeEmbedding(vocab, [e1, e2])
+    assert comp.vec_len == 6
+    got = comp.get_vecs_by_tokens("alpha").asnumpy()
+    onp.testing.assert_array_equal(got, [1, 2, 3, 4, 0.5, 0.5])
+    # source embeddings untouched by the re-indexing
+    assert len(e1) == 4
+
+
+def test_registry_create_and_names():
+    names = text.embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+    assert "glove.6B.50d.txt" in \
+        text.embedding.get_pretrained_file_names("glove")
+    with pytest.raises(KeyError):
+        text.embedding.create("not_an_embedding")
+    with pytest.raises(KeyError):
+        text.embedding.get_pretrained_file_names("nope")
+
+
+def test_create_custom_via_registry(tmp_path):
+    p = _write_embedding(os.path.join(tmp_path, "emb.txt"))
+    emb = text.embedding.create("customembedding",
+                                pretrained_file_path=p)
+    assert emb.vec_len == 4
+
+
+def test_glove_from_local_path_and_gluon_embedding(tmp_path):
+    """The intended composition: load vectors, seed nn.Embedding."""
+    p = _write_embedding(os.path.join(tmp_path, "glove.txt"))
+    emb = text.embedding.GloVe(pretrained_file_path=p)
+    from mxnet_tpu.gluon import nn
+
+    layer = nn.Embedding(len(emb), emb.vec_len)
+    layer.initialize()
+    layer.weight.set_data(emb.idx_to_vec)
+    out = layer(NDArray(onp.asarray(
+        emb.to_indices(["alpha", "gamma"]), "float32")))
+    onp.testing.assert_array_equal(
+        out.asnumpy(), [[1, 2, 3, 4], [9, 10, 11, 12]])
+
+
+def test_contrib_autograd_shims():
+    from mxnet_tpu.contrib import autograd as cag
+
+    def f(x, y):
+        return x * y + x
+
+    x = NDArray(onp.asarray([2.0, 3.0], "float32"))
+    y = NDArray(onp.asarray([4.0, 5.0], "float32"))
+    grads, out = cag.grad_and_loss(f)(x, y)
+    onp.testing.assert_allclose(grads[0].asnumpy(), [5.0, 6.0])
+    onp.testing.assert_allclose(grads[1].asnumpy(), [2.0, 3.0])
+    only = cag.grad(f, argnum=0)(x, y)
+    onp.testing.assert_allclose(only[0].asnumpy(), [5.0, 6.0])
+    prev = cag.set_is_training(True)
+    cag.set_is_training(prev)
+    with cag.train_section():
+        from mxnet_tpu import autograd as ag
+        assert ag.is_training()
+    with cag.test_section():
+        from mxnet_tpu import autograd as ag
+        assert not ag.is_training()
+
+
+def test_contrib_dataloader_iter():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    x = onp.arange(20, dtype="float32").reshape(10, 2)
+    y = onp.arange(10, dtype="float32")
+    ds = ArrayDataset(NDArray(x), NDArray(y))
+    dl = DataLoader(ds, batch_size=4, last_batch="keep")
+    it = DataLoaderIter(dl)
+    assert it.batch_size == 4
+    seen, pads = 0, []
+    it.reset()
+    while it.iter_next():
+        d = it.getdata()[0]
+        l = it.getlabel()[0]
+        assert d.shape == (4, 2) and l.shape == (4,)
+        pads.append(it.getpad())
+        seen += 4 - it.getpad()
+    assert seen == 10
+    assert pads == [0, 0, 2]
+    # reset + second epoch
+    it.reset()
+    assert it.iter_next()

@@ -1,0 +1,162 @@
+"""gluon.contrib.data.vision path-based loaders (parity:
+python/mxnet/gluon/contrib/data/vision/dataloader.py:34,140,246,364).
+"""
+import os
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import recordio
+from mxnet_tpu.gluon.contrib.data.vision import (
+    ImageBboxDataLoader, ImageDataLoader, create_bbox_augment,
+    create_image_augment)
+from mxnet_tpu.ndarray import NDArray
+
+
+@pytest.fixture(scope="module")
+def cls_rec(tmp_path_factory):
+    """12 tiny classification records."""
+    root = tmp_path_factory.mktemp("clsrec")
+    path = os.path.join(root, "cls.rec")
+    rng = onp.random.RandomState(0)
+    w = recordio.IndexedRecordIO(os.path.join(root, "cls.idx"), path,
+                                 "w")
+    for i in range(12):
+        img = rng.randint(0, 255, (40, 48, 3), onp.uint8)
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i % 3), i, 0), img, quality=90))
+    w.close()
+    return path
+
+
+@pytest.fixture(scope="module")
+def det_rec(tmp_path_factory):
+    """8 detection records, 1-2 normalized boxes each."""
+    root = tmp_path_factory.mktemp("detrec")
+    path = os.path.join(root, "det.rec")
+    rng = onp.random.RandomState(1)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(8):
+        img = rng.randint(0, 255, (40, 48, 3), onp.uint8)
+        n = 1 + i % 2
+        objs = []
+        for _ in range(n):
+            x0, y0 = rng.uniform(0, 0.5, 2)
+            objs += [float(i % 3), x0, y0,
+                     x0 + rng.uniform(0.2, 0.4),
+                     y0 + rng.uniform(0.2, 0.4)]
+        label = onp.asarray([2, 5] + objs, onp.float32)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, quality=90))
+    w.close()
+    return path
+
+
+def test_create_image_augment_shapes():
+    aug = create_image_augment((3, 28, 28), resize=32, rand_crop=True,
+                               rand_mirror=True, mean=True, std=True,
+                               brightness=0.1, pca_noise=0.05,
+                               rand_gray=0.2)
+    img = NDArray(onp.random.RandomState(0).randint(
+        0, 255, (40, 48, 3), onp.uint8))
+    out = aug(img)
+    assert out.shape == (3, 28, 28)
+    assert str(out.dtype) == "float32"
+    # normalized output: roughly centered AND image content intact
+    # (catches 0-255-scale constants applied after ToTensor, which
+    # collapse everything to a near-constant ~-2.1)
+    a = out.asnumpy()
+    assert abs(float(a.mean())) < 3.0
+    assert float(a.std()) > 0.3, a.std()
+
+
+def test_image_dataloader_from_rec(cls_rec):
+    dl = ImageDataLoader(4, (3, 28, 28), path_imgrec=cls_rec,
+                         shuffle=True, rand_crop=True,
+                         rand_mirror=True)
+    assert len(dl) == 3
+    seen = 0
+    for data, label in dl:
+        assert data.shape == (4, 3, 28, 28)
+        assert label.shape == (4,)
+        seen += data.shape[0]
+    assert seen == 12
+
+
+def test_image_dataloader_requires_source():
+    with pytest.raises(ValueError):
+        ImageDataLoader(4, (3, 28, 28))
+
+
+def test_bbox_augment_keeps_boxes_valid():
+    aug = create_bbox_augment((3, 32, 32), rand_crop=0.5, rand_pad=0.5,
+                              rand_mirror=True)
+    rng = onp.random.RandomState(0)
+    img = NDArray(rng.randint(0, 255, (40, 48, 3), onp.uint8))
+    label = onp.asarray([[0, 0.1, 0.1, 0.6, 0.7],
+                         [1, 0.3, 0.2, 0.9, 0.8]], onp.float32)
+    out_img, out_lab = aug(img, label)
+    assert out_img.shape == (3, 32, 32)
+    assert out_lab.ndim == 2 and out_lab.shape[1] == 5
+    assert (out_lab[:, 3] > out_lab[:, 1]).all()
+    assert (out_lab[:, 4] > out_lab[:, 2]).all()
+
+
+def test_image_bbox_dataloader(det_rec):
+    dl = ImageBboxDataLoader(3, (3, 32, 32), path_imgrec=det_rec,
+                             rand_mirror=True)
+    assert len(dl) == 3                # 8 records, last kept
+    batches = list(dl)
+    assert len(batches) == 3
+    data, labels = batches[0]
+    assert data.shape == (3, 3, 32, 32)
+    assert labels.ndim == 3 and labels.shape[2] == 5
+    # padding rows are -1
+    flat = labels.asnumpy()
+    assert ((flat[:, :, 0] == -1) | (flat[:, :, 0] >= 0)).all()
+    # last (short) batch keeps remaining 2 records
+    assert batches[-1][0].shape[0] == 2
+
+
+def test_image_bbox_dataloader_discard(det_rec):
+    dl = ImageBboxDataLoader(3, (3, 32, 32), path_imgrec=det_rec,
+                             last_batch="discard")
+    assert len(dl) == 2
+    assert sum(1 for _ in dl) == 2
+
+
+def test_image_dataloader_aug_list_of_transforms(cls_rec):
+    """aug_list may be a LIST of transforms (reference API shape)."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+
+    dl = ImageDataLoader(4, (3, 28, 28), path_imgrec=cls_rec,
+                         aug_list=[T.Resize((28, 28)), T.ToTensor()])
+    data, label = next(iter(dl))
+    assert data.shape == (4, 3, 28, 28)
+
+
+def test_bbox_dataloader_pixel_coords(det_rec, tmp_path):
+    """coord_normalized=False divides pixel-coordinate labels by the
+    image size before augmentation."""
+    import os
+
+    from mxnet_tpu import recordio
+
+    path = os.path.join(tmp_path, "px.rec")
+    rng = onp.random.RandomState(5)
+    w = recordio.MXRecordIO(path, "w")
+    for i in range(3):
+        img = rng.randint(0, 255, (40, 48, 3), onp.uint8)
+        # pixel coords on a 48x40 image
+        label = onp.asarray([2, 5, 0.0, 5.0, 4.0, 30.0, 36.0],
+                            onp.float32)
+        w.write(recordio.pack_img(
+            recordio.IRHeader(0, label, i, 0), img, quality=90))
+    w.close()
+    dl = ImageBboxDataLoader(3, (3, 32, 32), path_imgrec=path,
+                             coord_normalized=False)
+    _, labels = next(iter(dl))
+    lab = labels.asnumpy()
+    valid = lab[lab[:, :, 0] >= 0]
+    assert (valid[:, 1:] <= 1.0 + 1e-6).all(), valid

@@ -349,3 +349,11 @@ def test_multi_threaded_inference_example():
     assert len(res) == 6
     worst = max(float(onp.abs(res[i] - ref[i]).max()) for i in res)
     assert worst < 1e-5, worst
+
+
+def test_tree_lstm_example():
+    """Child-sum Tree-LSTM learns boolean-tree evaluation, which
+    bag-of-tokens cannot (parity: example/gluon/tree_lstm)."""
+    m = _load("gluon/tree_lstm.py", "tree_lstm_example")
+    net = m.train(iters=300, verbose=False)
+    assert m.accuracy(net, n=60) > 0.8

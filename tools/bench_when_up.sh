@@ -1,0 +1,25 @@
+#!/bin/bash
+# Probe the TPU tunnel every ~20 min; when it answers, run the full
+# bench (stall-watchdogged) and the quick tuning sweep, then exit.
+# Logs to /tmp/tunnel_probe_loop.log; bench output lands in
+# /tmp/bench_when_up.json for inspection/commit.
+cd "$(dirname "$0")/.." || exit 1
+LOG=/tmp/tunnel_probe_loop.log
+while true; do
+    echo "$(date -u +%H:%M:%S) probing" >> "$LOG"
+    if timeout 120 python -c "import jax, jax.numpy as jnp; jnp.ones((64,64)).sum().block_until_ready()" >> "$LOG" 2>&1; then
+        echo "$(date -u +%H:%M:%S) TUNNEL UP — running bench" >> "$LOG"
+        timeout 3600 python bench.py > /tmp/bench_when_up.json 2>&1
+        rc=$?
+        echo "$(date -u +%H:%M:%S) bench rc=$rc" >> "$LOG"
+        if [ $rc -eq 0 ]; then
+            timeout 2400 python tools/tune_tpu.py --quick \
+                > /tmp/tune_when_up.json 2>&1
+            echo "$(date -u +%H:%M:%S) tune rc=$?" >> "$LOG"
+            exit 0
+        fi
+    else
+        echo "$(date -u +%H:%M:%S) probe failed/hung" >> "$LOG"
+    fi
+    sleep 1200
+done
