@@ -1,0 +1,161 @@
+"""Generic image-classification training CLI (parity:
+example/gluon/image_classification.py — train any model-zoo
+architecture on a chosen dataset with hybridize/eager mode, an
+lr-step schedule, checkpointing and resume; re-expressed over
+SPMDTrainer or the eager gluon Trainer).
+
+    python examples/gluon/image_classification.py --model resnet18_v1 \
+        --dataset synthetic --epochs 2 --mode hybrid
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import metric as gmetric
+from mxnet_tpu.gluon.model_zoo import vision as models
+from mxnet_tpu.ndarray import NDArray
+
+
+def get_data(dataset, batch_size, num_workers=0, data_dir=None):
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.vision import (CIFAR10, MNIST,
+                                             FashionMNIST, transforms)
+
+    if dataset == "mnist":
+        cls, shape, classes = MNIST, (1, 28, 28), 10
+    elif dataset == "fashion-mnist":
+        cls, shape, classes = FashionMNIST, (1, 28, 28), 10
+    elif dataset == "cifar10":
+        cls, shape, classes = CIFAR10, (3, 32, 32), 10
+    elif dataset == "synthetic":
+        rng = onp.random.RandomState(0)
+        n, shape, classes = 256, (3, 32, 32), 10
+        x = rng.randn(n, *shape).astype("float32")
+        w = rng.randn(int(onp.prod(shape)), classes)
+        y = (x.reshape(n, -1) @ w).argmax(-1).astype("float32")
+        ds = gluon.data.ArrayDataset(NDArray(x), NDArray(y))
+        dl = DataLoader(ds, batch_size, shuffle=True,
+                        last_batch="discard")
+        return dl, dl, shape, classes
+    else:
+        raise ValueError(f"unknown dataset {dataset}")
+
+    aug = transforms.Compose([transforms.ToTensor()])
+    kw = {"root": data_dir} if data_dir else {}
+    train = cls(train=True, **kw).transform_first(aug)
+    val = cls(train=False, **kw).transform_first(aug)
+    return (DataLoader(train, batch_size, shuffle=True,
+                       num_workers=num_workers, last_batch="discard"),
+            DataLoader(val, batch_size, num_workers=num_workers),
+            shape, classes)
+
+
+def evaluate(net, loader, dtype="float32"):
+    acc = gmetric.Accuracy()
+    with autograd.predict_mode():
+        for x, y in loader:
+            if dtype == "bfloat16":
+                x = x.astype("bfloat16")   # same precision path as training
+            acc.update(y, net(x))
+    return acc.get()[1]
+
+
+def train(args):
+    mx.random.seed(args.seed)
+    train_dl, val_dl, shape, classes = get_data(
+        args.dataset, args.batch_size, args.num_workers, args.data_dir)
+
+    kwargs = {"classes": classes}
+    if "resnet" in args.model and shape[-1] < 64:
+        kwargs["thumbnail"] = True
+    net = models.get_model(args.model, **kwargs)
+    net.initialize(init=mx.initializer.Xavier(magnitude=2.24))
+    net(NDArray(onp.zeros((1,) + shape, "float32")))
+    if args.resume:
+        net.load_parameters(args.resume)
+    if args.mode == "hybrid":
+        net.hybridize()
+    if args.dtype == "bfloat16":
+        from mxnet_tpu import amp
+
+        amp.convert_model(net, "bfloat16")
+
+    lr_steps = [int(s) for s in args.lr_steps.split(",") if s]
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr,
+                             "momentum": args.momentum,
+                             "wd": args.wd})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = gmetric.Accuracy()
+    hist = []
+
+    for epoch in range(args.start_epoch, args.epochs):
+        if epoch in lr_steps:
+            trainer.set_learning_rate(
+                trainer.learning_rate * args.lr_factor)
+            print(f"lr -> {trainer.learning_rate:g}")
+        metric.reset()
+        t0 = time.time()
+        n_seen = 0
+        for x, y in train_dl:
+            if args.dtype == "bfloat16":
+                x = x.astype("bfloat16")
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update(y, out)
+            n_seen += x.shape[0]
+        name, train_acc = metric.get()
+        hist.append(train_acc)
+        print(f"epoch {epoch}: train-{name} {train_acc:.3f} "
+              f"({n_seen / (time.time() - t0):.0f} img/s)", flush=True)
+        if args.save_frequency and (epoch + 1) % args.save_frequency == 0:
+            net.save_parameters(
+                f"{args.prefix or args.model}-{epoch:04d}.params")
+    val_acc = evaluate(net, val_dl, args.dtype)
+    print(f"final val-accuracy {val_acc:.3f}")
+    return net, val_acc, hist
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Train a model for image classification.")
+    p.add_argument("--dataset", default="synthetic",
+                   choices=["mnist", "fashion-mnist", "cifar10",
+                            "synthetic"])
+    p.add_argument("--data-dir", default=None)
+    p.add_argument("--model", default="resnet18_v1")
+    p.add_argument("--mode", default="hybrid",
+                   choices=["hybrid", "imperative"])
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--num-workers", "-j", type=int, default=0)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--momentum", type=float, default=0.9)
+    p.add_argument("--wd", type=float, default=1e-4)
+    p.add_argument("--lr-factor", type=float, default=0.1)
+    p.add_argument("--lr-steps", default="")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--seed", type=int, default=123)
+    p.add_argument("--start-epoch", type=int, default=0)
+    p.add_argument("--resume", default="")
+    p.add_argument("--prefix", default="")
+    p.add_argument("--save-frequency", type=int, default=0)
+    return p.parse_args(argv)
+
+
+if __name__ == "__main__":
+    train(parse_args())

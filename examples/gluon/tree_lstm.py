@@ -83,8 +83,9 @@ class ChildSumTreeLSTMCell(gluon.Block):
         o = mx.nd.sigmoid(iou[:, self.hidden: 2 * self.hidden])
         u = mx.nd.tanh(iou[:, 2 * self.hidden:])
         c = i * u
+        fx = self.f_x(x) if child_states else None
         for h_k, c_k in child_states:
-            f_k = mx.nd.sigmoid(self.f_x(x) + self.f_h(h_k))
+            f_k = mx.nd.sigmoid(fx + self.f_h(h_k))
             c = c + f_k * c_k
         h = o * mx.nd.tanh(c)
         return h, c

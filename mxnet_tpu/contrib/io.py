@@ -34,9 +34,7 @@ class DataLoaderIter(DataIter):
         self.reset()
 
     def _peek(self):
-        first = next(self._iter)
-        self._first = first
-        return first
+        return next(self._iter)
 
     def reset(self):
         self._iter = iter(self._loader)

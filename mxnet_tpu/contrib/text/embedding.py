@@ -86,6 +86,7 @@ class _TokenEmbedding(_vocab.Vocabulary):
                 f"{pretrained_file_path}")
         tokens: List[str] = []
         vectors: List[onp.ndarray] = []
+        unk_vec = None
         seen = set(self._token_to_idx)
         with io.open(pretrained_file_path, "r",
                      encoding=encoding) as f:
@@ -98,6 +99,14 @@ class _TokenEmbedding(_vocab.Vocabulary):
                         "skipped", line_num, pretrained_file_path)
                     continue
                 token, vec = elems[0], elems[1:]
+                if token == self._unknown_token:
+                    # a trained unknown vector in the file installs as
+                    # row 0 (reference: loaded_unknown_vec)
+                    try:
+                        unk_vec = onp.asarray(vec, dtype="float32")
+                    except ValueError:
+                        pass
+                    continue
                 if token in seen:
                     logging.warning(
                         "line %d in %s: duplicate token %s, skipped",
@@ -130,6 +139,8 @@ class _TokenEmbedding(_vocab.Vocabulary):
                           "float32")
         n_special = len(self._idx_to_token) - len(tokens)
         table[:n_special] = init_unknown_vec((self._vec_len,))
+        if unk_vec is not None and unk_vec.size == self._vec_len:
+            table[0] = unk_vec
         table[n_special:] = onp.stack(vectors)
         from ...ndarray import NDArray
 

@@ -3,6 +3,7 @@ IntervalSampler, WikiText corpora, bbox-aware vision transforms and
 loaders."""
 from ...data.sampler import IntervalSampler
 from .text import WikiText2, WikiText103, Vocabulary
+from . import audio
 from .vision import (ImageBboxRandomFlipLeftRight, ImageBboxCrop,
                      ImageBboxRandomCropWithConstraints,
                      ImageBboxRandomExpand, ImageBboxResize,
@@ -15,4 +16,4 @@ __all__ = ["IntervalSampler", "WikiText2", "WikiText103", "Vocabulary",
            "ImageBboxRandomCropWithConstraints", "ImageBboxRandomExpand",
            "ImageBboxResize", "ImageDataLoader", "ImageBboxDataLoader",
            "DatasetImageDataLoader", "DatasetImageBboxDataLoader",
-           "create_image_augment", "create_bbox_augment"]
+           "create_image_augment", "create_bbox_augment", "audio"]

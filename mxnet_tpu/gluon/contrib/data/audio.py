@@ -1,0 +1,240 @@
+"""Audio datasets and device-side feature transforms.
+
+Parity: example/gluon/audio/transforms.py (MFCC, Scale, PadTrim,
+MEL) and example/gluon/audio/urban_sounds/datasets.py
+(AudioFolderDataset) — the reference computes features on host via
+librosa; here the whole front end (framing, Hann window, rFFT power
+spectrum, mel filterbank, log, DCT-II) is jnp inside HybridBlocks, so
+spectrograms/MFCCs run ON DEVICE as matmuls + FFT and fuse into the
+model's first layers.  WAV loading uses the stdlib ``wave`` module
+(PCM 8/16/32-bit), no external DSP dependency.
+"""
+from __future__ import annotations
+
+import os
+import wave
+from typing import List, Optional, Tuple
+
+import numpy as onp
+
+from ....ndarray import NDArray
+from ....ops.registry import apply_jax
+from ...block import HybridBlock
+from ...data.dataset import Dataset
+
+__all__ = ["read_wav", "AudioFolderDataset", "Scale", "PadTrim",
+           "MelSpectrogram", "MFCC"]
+
+
+def read_wav(path):
+    """Read a PCM .wav file -> (float32 mono waveform in [-1, 1],
+    sample_rate)."""
+    with wave.open(path, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        width = f.getsampwidth()
+        ch = f.getnchannels()
+        raw = f.readframes(n)
+    if width == 2:
+        x = onp.frombuffer(raw, "<i2").astype("float32") / 32768.0
+    elif width == 4:
+        x = onp.frombuffer(raw, "<i4").astype("float32") / 2147483648.0
+    elif width == 1:
+        x = (onp.frombuffer(raw, "u1").astype("float32") - 128.0) / 128.0
+    else:
+        raise ValueError(f"unsupported wav sample width {width}")
+    if ch > 1:
+        x = x.reshape(-1, ch).mean(axis=1)
+    return x, sr
+
+
+class AudioFolderDataset(Dataset):
+    """``root/label/*.wav`` layout -> (waveform NDArray, label index)
+    (parity: urban_sounds/datasets.py AudioFolderDataset; also accepts
+    the reference's ``train.csv`` two-column file-to-label mode via
+    ``train_csv``)."""
+
+    def __init__(self, root, train_csv=None, skip_header=True):
+        self._items: List[Tuple[str, int]] = []
+        self.synsets: List[str] = []
+        root = os.path.expanduser(root)
+        if train_csv:
+            mapping = {}
+            with open(train_csv) as f:
+                rows = [ln.strip().split(",") for ln in f if ln.strip()]
+            if skip_header and rows:
+                rows = rows[1:]
+            for lineno, row in enumerate(rows, 2 if skip_header else 1):
+                if len(row) < 2:
+                    raise ValueError(
+                        f"{train_csv}:{lineno}: need at least "
+                        f"filename,class columns, got {row!r}")
+                # first column = file name, last = class (matches both
+                # a plain 2-column file and UrbanSound8K-style metadata)
+                mapping[row[0]] = row[-1]
+            for label in sorted(set(mapping.values())):
+                self.synsets.append(label)
+            for fname, label in mapping.items():
+                p = os.path.join(root, fname)
+                if not fname.endswith(".wav"):
+                    p += ".wav"
+                self._items.append((p, self.synsets.index(label)))
+        else:
+            for label in sorted(os.listdir(root)):
+                d = os.path.join(root, label)
+                if not os.path.isdir(d):
+                    continue
+                wavs = [fn for fn in sorted(os.listdir(d))
+                        if fn.endswith(".wav")]
+                if not wavs:        # metadata/empty dirs are not classes
+                    continue
+                self.synsets.append(label)
+                for fn in wavs:
+                    self._items.append((os.path.join(d, fn),
+                                        len(self.synsets) - 1))
+
+    def __len__(self):
+        return len(self._items)
+
+    def __getitem__(self, idx):
+        path, label = self._items[idx]
+        x, _sr = read_wav(path)
+        return NDArray(x), label
+
+
+class Scale(HybridBlock):
+    """Divide the waveform by a constant (parity: transforms.Scale)."""
+
+    def __init__(self, scale_factor=2 ** 31, **kwargs):
+        super().__init__(**kwargs)
+        if scale_factor == 0:
+            raise ValueError("scale_factor must be non-zero")
+        self._s = float(scale_factor)
+
+    def forward(self, x):
+        return x / self._s
+
+
+class PadTrim(HybridBlock):
+    """Pad with ``fill_value`` or trim to exactly ``max_len`` samples
+    (parity: transforms.PadTrim)."""
+
+    def __init__(self, max_len, fill_value=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._max_len = int(max_len)
+        self._fill = float(fill_value)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        max_len, fill = self._max_len, self._fill
+
+        def fn(a):
+            n = a.shape[-1]
+            if n >= max_len:
+                return a[..., :max_len]
+            pad = [(0, 0)] * (a.ndim - 1) + [(0, max_len - n)]
+            return jnp.pad(a, pad, constant_values=fill)
+
+        return apply_jax(fn, [x])
+
+
+def _mel_filterbank(n_mels, n_fft, sr, fmin=0.0, fmax=None):
+    """Triangular mel filterbank matrix (n_mels, n_fft//2+1) —
+    precomputed host-side once, then a constant in the program."""
+    fmax = fmax or sr / 2.0
+
+    def hz_to_mel(f):
+        return 2595.0 * onp.log10(1.0 + f / 700.0)
+
+    def mel_to_hz(m):
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+
+    mels = onp.linspace(hz_to_mel(fmin), hz_to_mel(fmax), n_mels + 2)
+    hz = mel_to_hz(mels)
+    bins = onp.floor((n_fft + 1) * hz / sr).astype(int)
+    fb = onp.zeros((n_mels, n_fft // 2 + 1), "float32")
+    for i in range(n_mels):
+        l, c, r = bins[i], bins[i + 1], bins[i + 2]
+        for k in range(l, c):
+            if c > l:
+                fb[i, k] = (k - l) / (c - l)
+        for k in range(c, r):
+            if r > c:
+                fb[i, k] = (r - k) / (r - c)
+    return fb
+
+
+def _dct_matrix(n_out, n_in):
+    """Orthonormal DCT-II matrix (n_out, n_in) — MFCC's final rotation
+    as one matmul (MXU-friendly)."""
+    k = onp.arange(n_in)
+    m = onp.cos(onp.pi / n_in * (k + 0.5)[None, :]
+                * onp.arange(n_out)[:, None])
+    m *= onp.sqrt(2.0 / n_in)
+    m[0] *= onp.sqrt(0.5)
+    return m.astype("float32")
+
+
+class MelSpectrogram(HybridBlock):
+    """Waveform (..., T) -> log-mel spectrogram (..., frames, n_mels),
+    entirely on device: frame -> Hann window -> |rFFT|^2 -> mel
+    filterbank matmul -> log (parity: transforms.MEL, but device-side
+    instead of librosa-on-host)."""
+
+    def __init__(self, sampling_rate=22050, n_fft=512, hop=256,
+                 n_mels=40, **kwargs):
+        super().__init__(**kwargs)
+        self._sr = sampling_rate
+        self._n_fft = n_fft
+        self._hop = hop
+        self._n_mels = n_mels
+        self._fb = _mel_filterbank(n_mels, n_fft, sampling_rate)
+        self._win = onp.hanning(n_fft).astype("float32")
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        n_fft, hop = self._n_fft, self._hop
+        fb, win = jnp.asarray(self._fb), jnp.asarray(self._win)
+
+        def fn(a):
+            n = a.shape[-1]
+            if n < n_fft:
+                # zero-pad short clips to one full frame — jnp gather
+                # would otherwise silently clamp out-of-range indices
+                pad = [(0, 0)] * (a.ndim - 1) + [(0, n_fft - n)]
+                a = jnp.pad(a, pad)
+                n = n_fft
+            frames = 1 + (n - n_fft) // hop
+            idx = (onp.arange(frames)[:, None] * hop
+                   + onp.arange(n_fft)[None, :])
+            framed = a[..., idx] * win          # (..., frames, n_fft)
+            spec = jnp.fft.rfft(framed, axis=-1)
+            power = jnp.abs(spec) ** 2
+            mel = power @ fb.T                  # (..., frames, n_mels)
+            return jnp.log(mel + 1e-6)
+
+        return apply_jax(fn, [x])
+
+
+class MFCC(HybridBlock):
+    """Waveform -> MFCCs (..., frames, num_mfcc): log-mel + DCT-II
+    matmul (parity: transforms.MFCC)."""
+
+    def __init__(self, sampling_rate=22050, num_mfcc=20, n_fft=512,
+                 hop=256, n_mels=40, **kwargs):
+        super().__init__(**kwargs)
+        self._mel = MelSpectrogram(sampling_rate, n_fft, hop, n_mels)
+        self._dct = _dct_matrix(num_mfcc, n_mels)
+
+    def forward(self, x):
+        import jax.numpy as jnp
+
+        logmel = self._mel(x)
+        dct = jnp.asarray(self._dct)
+
+        def fn(a):
+            return a @ dct.T
+
+        return apply_jax(fn, [logmel])

@@ -180,6 +180,10 @@ class ImageBboxDataLoader:
 
         # reuse the det iterator's record/list parsing + label layout,
         # drive it as a random-access dataset
+        if flag != 1:
+            raise ValueError(
+                "ImageBboxDataLoader decodes color records (flag=1); "
+                "grayscale detection records are not supported")
         self._it = ImageDetIter(
             batch_size=1, data_shape=data_shape,
             path_imgrec=path_imgrec, path_imglist=path_imglist,

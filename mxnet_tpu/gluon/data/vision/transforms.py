@@ -222,6 +222,8 @@ class RandomColorJitter(HybridBlock):
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def forward(self, x):
         ts = list(self._ts)

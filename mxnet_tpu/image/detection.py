@@ -312,7 +312,12 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
                             pca_noise=pca_noise, rand_gray=rand_gray)
     for aug in color:
         name = type(aug).__name__
-        if name in ("CastAug", "ColorNormalizeAug"):
+        # borrow every label-invariant image augmenter — color jitter,
+        # lighting and gray included (geometry augs stay det-aware)
+        if name in ("CastAug", "ColorNormalizeAug",
+                    "BrightnessJitterAug", "ContrastJitterAug",
+                    "SaturationJitterAug", "HueJitterAug",
+                    "ColorJitterAug", "LightingAug", "RandomGrayAug"):
             auglist.append(DetBorrowAug(aug))
     return auglist
 

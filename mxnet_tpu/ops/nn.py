@@ -10,6 +10,7 @@ matmuls; everything is rank-polymorphic over 1D/2D/3D spatial dims
 """
 from __future__ import annotations
 
+import os
 from typing import Sequence, Tuple
 
 import jax
@@ -71,7 +72,19 @@ def _convolution(x, weight, bias=None, *, kernel, stride=None, dilate=None,
     n = len(kernel)
     stride, dilate = _tup(stride, n), _tup(dilate, n)
     pad = _tup(pad, n) if pad is not None else (0,) * n
-    dnums = _conv_dnums(n, layout)
+    # MXNET_TPU_CONV_LAYOUT=NHWC: compute logically-NCHW 2-D convs in
+    # the TPU-native channels-last layout (transpose in/out; weights
+    # stay OIHW — lax dimension_numbers handle the mixed spec).  XLA
+    # usually picks good layouts itself; this knob makes the choice
+    # explicit and sweepable (tools/tune_tpu.py).  Read at trace time.
+    force_nhwc = (n == 2 and (layout is None or layout == "NCHW")
+                  and os.environ.get("MXNET_TPU_CONV_LAYOUT", "")
+                  .upper() == "NHWC")
+    if force_nhwc:
+        x = jnp.transpose(x, (0, 2, 3, 1))
+        dnums = ("NHWC", "OIHW", "NHWC")
+    else:
+        dnums = _conv_dnums(n, layout)
     out = lax.conv_general_dilated(
         x, weight,
         window_strides=stride,
@@ -84,6 +97,8 @@ def _convolution(x, weight, bias=None, *, kernel, stride=None, dilate=None,
             out = out + bias
         else:
             out = out + bias.reshape((1, -1) + (1,) * n)
+    if force_nhwc:
+        out = jnp.transpose(out, (0, 3, 1, 2))
     return out
 
 

@@ -41,11 +41,37 @@ def create(name, **kwargs) -> "Optimizer":
     return _OPT_REGISTRY[name](**kwargs)
 
 
+def _lowp_guard(base_fn):
+    """Run one update group in f32, casting outputs back to each
+    input's dtype.  Low-precision (bf16/fp16) params would otherwise
+    be silently PROMOTED to f32 by the strong f32 lr/wd scalars —
+    and computing the update in f32 before casting back also gives
+    master-quality arithmetic for low-precision storage (the
+    reference's mp_* kernels' discipline, applied generally)."""
+
+    def guarded(*arrays, **kw):
+        lowp = any(a.dtype in (jnp.bfloat16, jnp.float16)
+                   for a in arrays)
+        if not lowp:
+            return base_fn(*arrays, **kw)
+        a32 = [a.astype(jnp.float32) if jnp.issubdtype(
+            a.dtype, jnp.floating) else a for a in arrays]
+        out = base_fn(*a32, **kw)
+        outs = out if isinstance(out, (tuple, list)) else (out,)
+        # outputs are (weight, *states) = dtypes of arrays[0], [2:]
+        dts = [arrays[0].dtype] + [a.dtype for a in arrays[2:]]
+        res = tuple(o.astype(dt) if jnp.issubdtype(
+            dt, jnp.floating) else o for o, dt in zip(outs, dts))
+        return res if len(res) > 1 else res[0]
+
+    return guarded
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...],
                    n_arrays: int):
     """jit-compiled update kernel; lr and wd are dynamic scalar args."""
-    base_fn = _reg.get(op_name).fn
+    base_fn = _lowp_guard(_reg.get(op_name).fn)
     static = dict(static_params)
 
     def step(lr, wd, *arrays):
@@ -57,7 +83,7 @@ def _jitted_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...],
 @functools.lru_cache(maxsize=None)
 def _jitted_update_nolr(op_name: str, static_params: Tuple[Tuple[str, Any], ...],
                         n_arrays: int):
-    base_fn = _reg.get(op_name).fn
+    base_fn = _lowp_guard(_reg.get(op_name).fn)
     static = dict(static_params)
 
     def step(wd, *arrays):
@@ -71,7 +97,7 @@ def _jitted_multi_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...
                          shapes: Tuple, n_state: int, uses_lr: bool):
     """One jitted function applying the update to a whole tensor group —
     the XLA-native analogue of the reference's multi-tensor kernels."""
-    base_fn = _reg.get(op_name).fn
+    base_fn = _lowp_guard(_reg.get(op_name).fn)
     static = dict(static_params)
     per = 2 + n_state
 

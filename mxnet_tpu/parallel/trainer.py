@@ -249,7 +249,8 @@ class SPMDTrainer:
                 sp.setdefault("clip_gradient",
                               float(opt.clip_gradient)
                               if opt.clip_gradient is not None else -1.0)
-                fn = _reg.get(opt.op_name).fn
+                from ..optimizer.optimizer import _lowp_guard
+                fn = _lowp_guard(_reg.get(opt.op_name).fn)
                 eff_lr = lr * param.lr_mult
                 eff_wd = wd * param.wd_mult
                 if opt.uses_lr:

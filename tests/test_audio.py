@@ -1,0 +1,147 @@
+"""Audio data + device-side feature transforms (parity:
+example/gluon/audio/transforms.py, urban_sounds/datasets.py)."""
+import os
+import wave
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu.gluon.contrib.data import audio
+from mxnet_tpu.ndarray import NDArray
+
+SR = 8000
+
+
+def _tone(freq, n=SR, amp=0.8):
+    t = onp.arange(n) / SR
+    return (onp.sin(2 * onp.pi * freq * t) * amp).astype("float32")
+
+
+def _write(path, x, width=2, ch=1):
+    with wave.open(path, "wb") as f:
+        f.setnchannels(ch)
+        f.setsampwidth(width)
+        f.setframerate(SR)
+        if width == 2:
+            pcm = (onp.clip(x, -1, 1) * 32000).astype("<i2")
+        elif width == 1:
+            pcm = ((onp.clip(x, -1, 1) * 127) + 128).astype("u1")
+        else:
+            pcm = (onp.clip(x, -1, 1) * 2e9).astype("<i4")
+        if ch == 2:
+            pcm = onp.stack([pcm, pcm], -1)
+        f.writeframes(pcm.tobytes())
+
+
+def test_read_wav_widths_and_stereo(tmp_path):
+    x = _tone(440)
+    for width in (1, 2, 4):
+        p = os.path.join(tmp_path, f"w{width}.wav")
+        _write(p, x, width=width)
+        y, sr = audio.read_wav(p)
+        assert sr == SR and y.shape == (SR,)
+        # correlation with the original tone stays high
+        c = onp.corrcoef(x, y)[0, 1]
+        assert c > 0.99, (width, c)
+    p = os.path.join(tmp_path, "stereo.wav")
+    _write(p, x, ch=2)
+    y, _ = audio.read_wav(p)
+    assert y.shape == (SR,)
+
+
+def test_audio_folder_dataset(tmp_path):
+    for label, freq in [("hi", 2000), ("lo", 200)]:
+        os.makedirs(os.path.join(tmp_path, label))
+        for i in range(2):
+            _write(os.path.join(tmp_path, label, f"{i}.wav"),
+                   _tone(freq))
+    ds = audio.AudioFolderDataset(tmp_path)
+    assert len(ds) == 4
+    assert ds.synsets == ["hi", "lo"]
+    wav, lab = ds[0]
+    assert wav.shape == (SR,) and lab in (0, 1)
+
+
+def test_audio_folder_dataset_train_csv(tmp_path):
+    _write(os.path.join(tmp_path, "a.wav"), _tone(500))
+    _write(os.path.join(tmp_path, "b.wav"), _tone(1500))
+    csv = os.path.join(tmp_path, "train.csv")
+    with open(csv, "w") as f:
+        f.write("ID,Class\na,dog\nb,siren\n")
+    ds = audio.AudioFolderDataset(tmp_path, train_csv=csv)
+    assert len(ds) == 2 and set(ds.synsets) == {"dog", "siren"}
+
+
+def test_pad_trim_and_scale():
+    x = NDArray(onp.ones(100, "float32"))
+    assert audio.PadTrim(60)(x).shape == (60,)
+    padded = audio.PadTrim(150, fill_value=-1.0)(x)
+    assert padded.shape == (150,)
+    assert float(padded.asnumpy()[-1]) == -1.0
+    assert float(audio.Scale(2.0)(x).asnumpy()[0]) == 0.5
+    with pytest.raises(ValueError):
+        audio.Scale(0)
+
+
+def test_mel_spectrogram_peaks_at_tone_frequency():
+    ms = audio.MelSpectrogram(sampling_rate=SR, n_fft=256, hop=128,
+                              n_mels=32)
+    lo = ms(NDArray(_tone(300))).asnumpy().mean(0)
+    hi = ms(NDArray(_tone(3000))).asnumpy().mean(0)
+    # energy centroid (in mel-bin index) must move up with frequency
+    bins = onp.arange(32)
+    w_lo = onp.exp(lo) / onp.exp(lo).sum()
+    w_hi = onp.exp(hi) / onp.exp(hi).sum()
+    assert (bins * w_hi).sum() > (bins * w_lo).sum() + 3
+
+
+def test_mfcc_shapes_and_determinism():
+    m = audio.MFCC(sampling_rate=SR, num_mfcc=13, n_fft=256, hop=128,
+                   n_mels=32)
+    x = NDArray(_tone(440))
+    a = m(x).asnumpy()
+    b = m(x).asnumpy()
+    assert a.shape[1] == 13
+    onp.testing.assert_array_equal(a, b)
+    # batched input: leading axes pass through
+    xb = NDArray(onp.stack([_tone(440), _tone(880)]))
+    ab = m(xb).asnumpy()
+    assert ab.shape[0] == 2 and ab.shape[2] == 13
+    # different tones produce different cepstra
+    assert onp.abs(ab[0] - ab[1]).mean() > 0.1
+
+
+def test_mel_short_clip_zero_padded():
+    """Clips shorter than n_fft are zero-padded, not gather-clamped."""
+    ms = audio.MelSpectrogram(sampling_rate=SR, n_fft=256, hop=128,
+                              n_mels=16)
+    short = ms(NDArray(_tone(440, n=100))).asnumpy()
+    assert short.shape == (1, 16)
+    # equivalent to explicitly zero-padding to one frame
+    padded = onp.zeros(256, "float32")
+    padded[:100] = _tone(440, n=100)
+    ref = ms(NDArray(padded)).asnumpy()
+    onp.testing.assert_allclose(short, ref, rtol=1e-5)
+
+
+def test_audio_folder_skips_empty_dirs(tmp_path):
+    os.makedirs(os.path.join(tmp_path, "metadata"))
+    os.makedirs(os.path.join(tmp_path, "tone"))
+    _write(os.path.join(tmp_path, "tone", "a.wav"), _tone(440))
+    ds = audio.AudioFolderDataset(tmp_path)
+    assert ds.synsets == ["tone"]
+
+
+def test_train_csv_extra_columns_and_bad_rows(tmp_path):
+    _write(os.path.join(tmp_path, "x.wav"), _tone(440))
+    csv = os.path.join(tmp_path, "meta.csv")
+    with open(csv, "w") as f:
+        f.write("slice_file_name,fsID,start,end,class\n")
+        f.write("x,1001,0.0,1.0,dog_bark\n")
+    ds = audio.AudioFolderDataset(tmp_path, train_csv=csv)
+    assert ds.synsets == ["dog_bark"] and len(ds) == 1
+    bad = os.path.join(tmp_path, "bad.csv")
+    with open(bad, "w") as f:
+        f.write("a,b\nonlyonefield\n")
+    with pytest.raises(ValueError):
+        audio.AudioFolderDataset(tmp_path, train_csv=bad)

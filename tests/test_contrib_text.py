@@ -220,3 +220,16 @@ def test_contrib_dataloader_iter():
     # reset + second epoch
     it.reset()
     assert it.iter_next()
+
+
+def test_unknown_token_vector_from_file(tmp_path):
+    """A trained '<unk>' row in the file installs as row 0 instead of
+    being dropped as a duplicate."""
+    p = os.path.join(tmp_path, "unk.txt")
+    with open(p, "w") as f:
+        f.write("<unk> 9 9 9 9\nalpha 1 2 3 4\n")
+    emb = text.embedding.CustomEmbedding(p)
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("never-seen").asnumpy(), [9, 9, 9, 9])
+    onp.testing.assert_array_equal(
+        emb.get_vecs_by_tokens("alpha").asnumpy(), [1, 2, 3, 4])

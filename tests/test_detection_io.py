@@ -345,3 +345,19 @@ def test_image_det_record_iter_factory(tmp_path):
     b = it.next()
     assert b.data[0].shape == (2, 3, 24, 24)
     assert b.label[0].shape[0] == 2
+
+
+def test_det_augmenter_borrows_color_jitter():
+    """CreateDetAugmenter includes the label-invariant color jitter
+    augmenters (brightness/contrast/... are not silent no-ops)."""
+    from mxnet_tpu.image.detection import CreateDetAugmenter
+
+    augs = CreateDetAugmenter((3, 32, 32), brightness=0.3,
+                              contrast=0.3, saturation=0.3, hue=0.1,
+                              pca_noise=0.05, rand_gray=0.2)
+    names = [getattr(a, "augmenter", None) and
+             type(a.augmenter).__name__ or type(a).__name__
+             for a in augs]
+    joined = ",".join(str(n) for n in names)
+    assert "Jitter" in joined or "ColorJitter" in joined, names
+    assert "LightingAug" in joined and "RandomGrayAug" in joined, names

@@ -357,3 +357,23 @@ def test_tree_lstm_example():
     m = _load("gluon/tree_lstm.py", "tree_lstm_example")
     net = m.train(iters=300, verbose=False)
     assert m.accuracy(net, n=60) > 0.8
+
+
+def test_audio_classification_example():
+    """Device-side MFCC front end separates tones/chirps/noise
+    (parity: example/gluon/audio/urban_sounds)."""
+    m = _load("gluon/audio_classification.py", "audio_example")
+    _, acc = m.train(epochs=6, verbose=False)
+    assert acc > 0.7, acc
+
+
+def test_image_classification_cli_example():
+    """Generic training CLI runs end to end and learns (parity:
+    example/gluon/image_classification.py)."""
+    m = _load("gluon/image_classification.py", "imgcls_example")
+    args = m.parse_args(["--model", "resnet18_v1", "--dataset",
+                         "synthetic", "--epochs", "3",
+                         "--batch-size", "32"])
+    net, _val, hist = m.train(args)
+    assert hist[-1] > hist[0] + 0.05, hist
+    assert hist[-1] > 0.15, hist

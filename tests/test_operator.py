@@ -241,3 +241,46 @@ def test_clip_norm_misc():
                         onp.sqrt((x ** 2).sum()), rtol=1e-4)
     assert_almost_equal(nd.norm(nd.array(x), axis=1),
                         onp.sqrt((x ** 2).sum(1)), rtol=1e-4)
+
+
+def test_conv_nhwc_env_path_matches_nchw(monkeypatch):
+    """MXNET_TPU_CONV_LAYOUT=NHWC computes the same result as a direct
+    NCHW lax reference (the knob only changes layout, never numerics).
+    Fresh (unseen) shapes force a genuine NHWC-path compile — same
+    shapes through the funnel twice would replay the cached
+    executable and compare it to itself."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+
+    def lax_ref(x, w, b, stride, pad, groups=1):
+        out = lax.conv_general_dilated(
+            jnp.asarray(x), jnp.asarray(w), window_strides=stride,
+            padding=[(p, p) for p in pad],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups)
+        if b is not None:
+            out = out + jnp.asarray(b).reshape(1, -1, 1, 1)
+        return onp.asarray(out)
+
+    rng = onp.random.RandomState(0)
+    monkeypatch.setenv("MXNET_TPU_CONV_LAYOUT", "NHWC")
+    x = rng.randn(2, 3, 13, 13).astype("float32")
+    w = rng.randn(8, 3, 3, 3).astype("float32")
+    b = rng.randn(8).astype("float32")
+    got = mx.nd.Convolution(
+        NDArray(x), NDArray(w), NDArray(b), kernel=(3, 3),
+        stride=(2, 2), pad=(1, 1), num_filter=8).asnumpy()
+    onp.testing.assert_allclose(
+        got, lax_ref(x, w, b, (2, 2), (1, 1)), rtol=2e-5, atol=2e-5)
+    # grouped conv through the forced-NHWC path
+    xg = rng.randn(2, 6, 9, 9).astype("float32")
+    wg = rng.randn(6, 2, 3, 3).astype("float32")
+    got_g = mx.nd.Convolution(
+        NDArray(xg), NDArray(wg), kernel=(3, 3), num_filter=6,
+        num_group=3, no_bias=True).asnumpy()
+    onp.testing.assert_allclose(
+        got_g, lax_ref(xg, wg, None, (1, 1), (0, 0), groups=3),
+        rtol=2e-5, atol=2e-5)

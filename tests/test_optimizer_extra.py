@@ -166,3 +166,32 @@ def test_nadam_matches_reference_formula():
         w = w - lr * mbar / (vp ** 0.5 + eps)
         msched = ms
     onp.testing.assert_allclose(wnd.asnumpy(), [w], rtol=1e-5)
+
+
+def test_update_preserves_low_precision_dtype():
+    """bf16 params must stay bf16 through eager Trainer steps — the
+    strong f32 lr/wd scalars must not promote the weight (regression:
+    mobilenet bf16 CLI broke on the SECOND batch after step 1 silently
+    rebound f32 weights)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ndarray import NDArray
+
+    for opt, kw in [("sgd", {"learning_rate": 0.1, "momentum": 0.9}),
+                    ("adam", {"learning_rate": 1e-3})]:
+        net = nn.Dense(4)
+        net.initialize()
+        net(NDArray(onp.ones((2, 3), "float32")))
+        for p in net.collect_params().values():
+            p.cast("bfloat16")
+        tr = gluon.Trainer(net.collect_params(), opt, kw)
+        for _ in range(2):
+            with autograd.record():
+                loss = net(NDArray(onp.ones((2, 3), "float32")
+                                   .astype("bfloat16"))).sum()
+            loss.backward()
+            tr.step(1)
+        for k, p in net.collect_params().items():
+            assert str(p.data()._data.dtype) == "bfloat16", (opt, k)
+            assert str(p.dtype) == "bfloat16", (opt, k)

@@ -139,6 +139,29 @@ def tune_train_batch(quick=False):
     return {"sweep": rows, "best": best}
 
 
+def tune_conv_layout(quick=False, bs=256):
+    """Sweep #4 (VERDICT r2 weak #1): NCHW (XLA-chosen layouts) vs the
+    explicit NHWC compute path (MXNET_TPU_CONV_LAYOUT=NHWC) for the
+    ResNet-50 bf16 training step."""
+    rows = []
+    for mode in ("", "NHWC"):
+        os.environ["MXNET_TPU_CONV_LAYOUT"] = mode
+        try:
+            img_s, mfu = _train_step_rate(bs)
+        except Exception as e:
+            print(f"# layout={mode or 'NCHW'} failed: {e}", flush=True)
+            continue
+        finally:
+            os.environ.pop("MXNET_TPU_CONV_LAYOUT", None)
+        rows.append({"layout": mode or "NCHW",
+                     "img_s": round(img_s, 1),
+                     "mfu": round(mfu, 4) if mfu else None})
+        print(f"# conv layout {rows[-1]['layout']}: "
+              f"{rows[-1]['img_s']} img/s", flush=True)
+    best = max(rows, key=lambda r: r["img_s"]) if rows else None
+    return {"sweep": rows, "best": best}
+
+
 def tune_donation(quick=False, bs=256):
     """Sweep #3: buffer donation on/off for the fused train window —
     donation lets XLA alias param/state buffers in place (HBM
@@ -180,6 +203,7 @@ def main(argv=None):
     if not args.skip_train:
         out["train"] = tune_train_batch(args.quick)
         out["donation"] = tune_donation(args.quick)
+        out["conv_layout"] = tune_conv_layout(args.quick)
     print(json.dumps(out))
     return out
 
