@@ -246,6 +246,51 @@ def _infer_bench(dtype, batch):
     return batch / batch_t
 
 
+def _transformer_bench(dtype="bfloat16", batch=8, seq=2048,
+                       units=512, layers=8, heads=8, vocab=32000):
+    """Transformer-LM training rate (tokens/s + MFU): decoder-only LM
+    with the Pallas flash-attention kernel, trained via the same fused
+    run_steps windows as the ResNet rows.  A GPT-2-medium-ish shape
+    sized for one chip; covers the long-context/transformer capability
+    the SURVEY adds beyond the reference."""
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerLM
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    net = TransformerLM(vocab, units=units, num_layers=layers,
+                        num_heads=heads, max_len=seq, tie_weights=True)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 8), onp.float32)))
+    trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                          optimizer="adam",
+                          optimizer_params={"learning_rate": 3e-4},
+                          mesh=make_mesh({"dp": -1}), dtype=dtype)
+    k1, k2 = jax.random.split(jax.random.PRNGKey(0))
+    data = NDArray(jax.random.randint(
+        k1, (batch, seq), 0, vocab).astype(jnp.float32))
+    label = NDArray(jax.random.randint(
+        k2, (batch, seq), 0, vocab).astype(jnp.float32))
+
+    def run(n):
+        _materialize(trainer.run_steps(data, label, n)._data)
+
+    step_t = _marginal(run, n1=2, n2=8)
+    tok_s = batch * seq / step_t
+    flops_s = None
+    try:
+        ca = trainer.cost_analysis(data, label, n_steps=2)
+        if ca.get("flops"):
+            flops_s = (ca["flops"] / 2) / step_t
+    except Exception:
+        pass
+    return tok_s, flops_s
+
+
 def _make_rec(path, n=512, hw=IMAGE):
     from mxnet_tpu import recordio
     from mxnet_tpu.io import native
@@ -414,6 +459,21 @@ def main():
     RESULTS["infer_bf16_bs%d_img_s" % INFER_BS] = round(infer16, 2)
     RESULTS["infer_bf16_vs_v100_fp16_2355"] = round(
         infer16 / INFER_BASE_FP16, 3)
+
+    if not os.environ.get("MXNET_TPU_BENCH_SKIP_TRANSFORMER"):
+        _beat("starting transformer-LM row")
+        try:
+            tok_s, tf_flops_s = _transformer_bench()
+            RESULTS["transformer_lm_bf16_tok_s"] = round(tok_s, 1)
+            if tf_flops_s:
+                RESULTS["transformer_lm_bf16_tflops"] = round(
+                    tf_flops_s / 1e12, 2)
+                if peak:
+                    RESULTS["transformer_lm_bf16_mfu"] = round(
+                        tf_flops_s / peak, 4)
+        except Exception as e:      # pragma: no cover
+            RESULTS["transformer_skipped"] = str(e)
+            print(f"# transformer bench skipped: {e}", flush=True)
 
     _beat("inference done; starting feed-the-chip rows")
     import shutil

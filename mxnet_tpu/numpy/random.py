@@ -14,7 +14,8 @@ __all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
            "exponential",
            "poisson", "multinomial", "multivariate_normal", "logistic",
            "gumbel", "laplace", "rayleigh", "pareto", "power", "weibull",
-           "chisquare", "f", "lognormal", "binomial", "geometric"]
+           "chisquare", "f", "lognormal", "binomial", "geometric",
+           "t", "standard_t", "negative_binomial"]
 
 
 def _shape(size):
@@ -179,3 +180,23 @@ def binomial(n, p, size=None, dtype=None, ctx=None, out=None):
 def geometric(p, size=None, ctx=None):
     u = jax.random.uniform(next_key(), _shape(size), minval=1e-7, maxval=1.0)
     return ndarray(jnp.ceil(jnp.log(u) / jnp.log1p(-p)).astype(jnp.int64))
+
+
+def t(df, size=None, ctx=None):
+    """Student's t samples: N(0,1) / sqrt(chi2(df)/df) (parity:
+    numpy.random.standard_t / reference _npi random surface)."""
+    z = jax.random.normal(next_key(), _shape(size))
+    chi2 = 2.0 * jax.random.gamma(jax.random.fold_in(next_key(), 1),
+                                  df / 2.0, _shape(size))
+    return ndarray(z / jnp.sqrt(chi2 / df))
+
+
+standard_t = t
+
+
+def negative_binomial(n, p, size=None, dtype=None, ctx=None, out=None):
+    """NB(n, p) via the gamma-Poisson mixture (parity:
+    src/operator/random negative-binomial sampler)."""
+    lam = jax.random.gamma(next_key(), n, _shape(size)) * (1.0 - p) / p
+    return ndarray(jax.random.poisson(
+        jax.random.fold_in(next_key(), 1), lam).astype(jnp.int64))

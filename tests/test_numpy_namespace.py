@@ -123,3 +123,29 @@ def test_npx_masked_and_extras():
     assert lo[0, 2] == -onp.inf
     for name in ("rnn", "batch_dot", "is_np_shape", "current_context"):
         assert hasattr(mx.npx, name), name
+
+
+def test_numpy_long_tail_additions():
+    """fix/unwrap/geomspace/fromfunction/trapz/round_/real_if_close +
+    random.t/negative_binomial (the last absentees vs the reference
+    numpy surface)."""
+    onp.testing.assert_array_equal(
+        mx.np.fix(mx.np.array([-1.7, 1.7])).asnumpy(), [-1.0, 1.0])
+    onp.testing.assert_allclose(
+        mx.np.geomspace(1, 8, 4).asnumpy(), [1, 2, 4, 8], rtol=1e-5)
+    assert float(mx.np.trapz(mx.np.array([0.0, 1.0, 2.0]))) == 2.0
+    onp.testing.assert_array_equal(
+        mx.np.round_(mx.np.array([1.4, 1.6])).asnumpy(), [1.0, 2.0])
+    seq = onp.unwrap([0.0, 3.0, 6.0, 9.0])
+    onp.testing.assert_allclose(
+        mx.np.unwrap(mx.np.array([0.0, 3.0, 6.0, 9.0])).asnumpy(),
+        seq, rtol=1e-6)
+    ff = mx.np.fromfunction(lambda i, j: i + j, (2, 2))
+    onp.testing.assert_array_equal(ff.asnumpy(), [[0, 1], [1, 2]])
+
+    mx.random.seed(0)
+    s = mx.np.random.t(5.0, size=(2000,)).asnumpy()
+    assert abs(s.mean()) < 0.2           # symmetric around 0
+    nb = mx.np.random.negative_binomial(4, 0.5, size=(2000,)).asnumpy()
+    assert abs(nb.mean() - 4.0) < 0.6    # E = n(1-p)/p = 4
+    assert (nb >= 0).all() and nb.dtype.kind == "i"
