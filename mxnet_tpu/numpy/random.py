@@ -199,4 +199,5 @@ def negative_binomial(n, p, size=None, dtype=None, ctx=None, out=None):
     src/operator/random negative-binomial sampler)."""
     lam = jax.random.gamma(next_key(), n, _shape(size)) * (1.0 - p) / p
     return ndarray(jax.random.poisson(
-        jax.random.fold_in(next_key(), 1), lam).astype(jnp.int64))
+        jax.random.fold_in(next_key(), 1), lam).astype(
+            np_dtype(dtype or "int64")))

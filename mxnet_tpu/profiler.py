@@ -15,7 +15,8 @@ from typing import Dict, Optional
 import jax
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
-           "Task", "Frame", "Event", "Counter", "Marker", "scope"]
+           "Task", "Frame", "Event", "Counter", "Marker", "scope",
+           "device_memory_info", "device_memory_summary"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
@@ -195,6 +196,39 @@ class Counter:
 
 def scope(name="<unk>:"):
     return _Scope(name)
+
+
+# -- device memory introspection (parity: the GPU memory profiler,
+#    src/profiler/storage_profiler.cc + MXGetGPUMemoryInformation64;
+#    TPU-native: XLA's per-device allocator stats) -----------------------
+
+def device_memory_info(device=None):
+    """Per-device allocator stats: dict with bytes_in_use,
+    peak_bytes_in_use, bytes_limit (+ raw fields), or {} where the
+    backend exposes none (CPU).  `util.get_gpu_memory` is the
+    (free, total) view over the same stats."""
+    dev = device or jax.devices()[0]
+    try:
+        return dict(dev.memory_stats() or {})
+    except Exception:
+        return {}
+
+
+def device_memory_summary():
+    """One line per device: in-use / peak / limit (MiB)."""
+    lines = ["Device memory:"]
+    for d in jax.devices():
+        st = device_memory_info(d)
+        if not st:
+            lines.append(f"  {d}: (no allocator stats on this backend)")
+            continue
+        mib = 1024 * 1024
+        lines.append(
+            f"  {d}: in-use "
+            f"{st.get('bytes_in_use', 0) / mib:.1f} MiB, peak "
+            f"{st.get('peak_bytes_in_use', 0) / mib:.1f} MiB, limit "
+            f"{st.get('bytes_limit', 0) / mib:.1f} MiB")
+    return "\n".join(lines)
 
 
 # parity: MXNET_PROFILER_AUTOSTART / MXNET_PROFILER_MODE

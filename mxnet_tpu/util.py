@@ -223,16 +223,18 @@ def get_gpu_count() -> int:
 
 def get_gpu_memory(gpu_dev_id=0):
     """(free, total) accelerator memory in bytes when the backend
-    exposes it, else (0, 0) (parity: util.get_gpu_memory)."""
+    exposes it, else (0, 0) (parity: util.get_gpu_memory; the raw
+    per-device dict is profiler.device_memory_info)."""
     import jax
+
+    from . import profiler
     try:
-        dev = jax.devices()[gpu_dev_id]
-        stats = dev.memory_stats() or {}
-        total = stats.get("bytes_limit", 0)
-        used = stats.get("bytes_in_use", 0)
-        return (total - used, total)
+        stats = profiler.device_memory_info(jax.devices()[gpu_dev_id])
     except Exception:
         return (0, 0)
+    total = stats.get("bytes_limit", 0)
+    used = stats.get("bytes_in_use", 0)
+    return (total - used, total)
 
 
 def get_cuda_compute_capability(ctx=None):

@@ -67,3 +67,14 @@ def test_profiler_off_records_nothing():
     net(x).wait_to_read()
     table = profiler.dumps()
     assert "Convolution" not in table
+
+
+def test_device_memory_summary():
+    """Memory introspection (parity: storage_profiler /
+    MXGetGPUMemoryInformation64): summary renders one line per device
+    and info returns a dict (possibly empty on CPU)."""
+    from mxnet_tpu import profiler
+
+    s = profiler.device_memory_summary()
+    assert s.startswith("Device memory:")
+    assert isinstance(profiler.device_memory_info(), dict)
