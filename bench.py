@@ -44,6 +44,25 @@ INFER_BS = 128
 N1, N2 = 4, 24          # fused-window sizes for marginal timing
 REPS = 3
 
+# MXNET_TPU_BENCH_DRYRUN=1: run EVERY row end to end at toy scale on
+# whatever backend is available (CPU included) — validates the whole
+# bench program without a TPU, so a driver run can only fail on the
+# tunnel, never on a bench bug.  Numbers produced this way are tagged
+# and meaningless as perf.
+def _envbool(name):
+    return os.environ.get(name, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+DRYRUN = _envbool("MXNET_TPU_BENCH_DRYRUN")
+if DRYRUN:
+    IMAGE = 32
+    TRAIN_BS_FP32 = 4
+    TRAIN_BS_BF16 = 4
+    INFER_BS = 4
+    N1, N2 = 2, 4
+    REPS = 1
+
 # peak bf16 FLOP/s per chip, by device_kind substring (public specs)
 _PEAKS = [
     ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
@@ -414,6 +433,14 @@ def _devices_or_die(timeout_s=180):
 
 def main():
     import jax
+    if DRYRUN:
+        # force the CPU backend past the container's sitecustomize
+        # axon override (same dance as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge as _xb
+        if _xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends
+            clear_backends()
     # persistent compilation cache: repeat bench runs become disk hits
     try:
         jax.config.update("jax_compilation_cache_dir",
@@ -426,6 +453,8 @@ def main():
     kind = getattr(dev, "device_kind", str(dev))
     peak = _peak_flops(kind)
     RESULTS["device_kind"] = kind
+    if DRYRUN:
+        RESULTS["dryrun"] = True   # toy shapes; numbers meaningless
     RESULTS["method_note"] = (
         "marginal (slope) timing over fused device-side windows with "
         "device_get sync — steady-state per-step rate; launch/tunnel "
@@ -463,7 +492,9 @@ def main():
     if not os.environ.get("MXNET_TPU_BENCH_SKIP_TRANSFORMER"):
         _beat("starting transformer-LM row")
         try:
-            tok_s, tf_flops_s = _transformer_bench()
+            tok_s, tf_flops_s = (_transformer_bench(
+                batch=2, seq=64, units=32, layers=1, heads=2,
+                vocab=128) if DRYRUN else _transformer_bench())
             RESULTS["transformer_lm_bf16_tok_s"] = round(tok_s, 1)
             if tf_flops_s:
                 RESULTS["transformer_lm_bf16_tflops"] = round(
@@ -482,11 +513,13 @@ def main():
     RESULTS["train_bf16_datafed_img_s"] = None
     tmp = tempfile.mkdtemp()
     try:
-        rec = _make_rec(os.path.join(tmp, "bench.rec"))
+        rec = _make_rec(os.path.join(tmp, "bench.rec"),
+                        n=64 if DRYRUN else 512)
         pipe_img_s = _pipeline_bench(rec)
         RESULTS["pipeline_img_s_vs_ref_3000"] = round(pipe_img_s, 1)
-        datafed_img_s = _train_bench_datafed(rec, "bfloat16",
-                                             TRAIN_BS_BF16)
+        datafed_img_s = _train_bench_datafed(
+            rec, "bfloat16", TRAIN_BS_BF16,
+            window=2 if DRYRUN else 8, windows=1 if DRYRUN else 3)
         RESULTS["train_bf16_datafed_img_s"] = round(datafed_img_s, 2)
     except Exception as e:      # pragma: no cover
         RESULTS["datafed_skipped"] = str(e)

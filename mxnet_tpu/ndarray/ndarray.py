@@ -25,7 +25,8 @@ from ..ops.registry import apply_jax, invoke
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "concat", "stack", "waitall", "save", "load",
-           "from_numpy", "from_dlpack"]
+           "from_numpy", "from_dlpack", "to_dlpack_for_read",
+           "to_dlpack_for_write"]
 
 
 def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
@@ -165,6 +166,14 @@ class NDArray:
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read
+
+    # DLPack protocol: delegate to the backing jax.Array so
+    # torch.from_dlpack(nd) / np.from_dlpack(nd) work directly
+    def __dlpack__(self, *args, **kwargs):
+        return self._data.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._data.__dlpack_device__()
 
     def asnumpy(self) -> onp.ndarray:
         return onp.asarray(jax.device_get(self._data))
@@ -534,8 +543,49 @@ def from_numpy(a, zero_copy=False):
     return NDArray(a)
 
 
-def from_dlpack(capsule):
-    return NDArray(jnp.from_dlpack(capsule))
+class _DLPackHandle:
+    """Exchange handle speaking the modern DLPack protocol.  Both
+    ``torch.from_dlpack`` and ``numpy.from_dlpack`` consume it, and
+    unlike a raw one-shot capsule it can also report its device."""
+
+    __slots__ = ("_arr",)
+
+    def __init__(self, arr):
+        self._arr = arr
+
+    def __dlpack__(self, *args, **kwargs):
+        return self._arr.__dlpack__(*args, **kwargs)
+
+    def __dlpack_device__(self):
+        return self._arr.__dlpack_device__()
+
+
+def from_dlpack(obj):
+    """Import a DLPack-capable array (torch/numpy tensor or a handle
+    from :func:`to_dlpack_for_read` — the modern ``__dlpack__``
+    protocol).  Raw one-shot capsules carry no device information and
+    are rejected with a clear error."""
+    if hasattr(obj, "__dlpack__"):
+        return NDArray(jnp.from_dlpack(obj))
+    raise TypeError(
+        "from_dlpack needs an object with __dlpack__/__dlpack_device__ "
+        "(a torch/numpy array or a to_dlpack_for_read handle), not a "
+        "raw capsule")
+
+
+def to_dlpack_for_read(arr: "NDArray"):
+    """DLPack handle for the (synchronized) buffer (parity:
+    mx.nd.to_dlpack_for_read over MXNDArrayToDLPack)."""
+    arr.wait_to_read()
+    return _DLPackHandle(arr._data)
+
+
+def to_dlpack_for_write(arr: "NDArray"):
+    """Parity: to_dlpack_for_write.  XLA buffers are immutable, so
+    writes through the handle cannot alias back; consumers that
+    mutate must re-import with from_dlpack (documented divergence)."""
+    arr.wait_to_read()
+    return _DLPackHandle(arr._data)
 
 
 # -- serialization (parity: NDArray::Save/Load, src/ndarray/ndarray.cc:1679;

@@ -48,6 +48,15 @@ _NPX_OPS = {
     "ctc_loss": "CTCLoss",
     "sigmoid": "sigmoid",
     "relu": "relu",
+    "batch_flatten": "Flatten",
+    "multibox_prior": "MultiBoxPrior",
+    "multibox_target": "MultiBoxTarget",
+    "multibox_detection": "MultiBoxDetection",
+    "box_iou": "box_iou",
+    "box_nms": "box_nms",
+    "roi_align": "ROIAlign",
+    "index_add": "index_add",
+    "index_update": "_npx_index_update",
 }
 
 for _npx_name, _op_name in _NPX_OPS.items():
@@ -72,3 +81,26 @@ def load(fname):
 def save(fname, data):
     from ..ndarray import save as _s
     return _s(fname, data)
+
+
+def seed(seed_state):
+    """Parity: npx.random seeding alias of mx.random.seed."""
+    from ..ops.random import seed as _seed
+    _seed(seed_state)
+
+
+# control flow rides the contrib implementations (parity: npx.foreach/
+# while_loop/cond over src/operator/control_flow.cc)
+def foreach(body, data, init_states):
+    from ..ndarray.contrib import foreach as _f
+    return _f(body, data, init_states)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    from ..ndarray.contrib import while_loop as _w
+    return _w(cond, func, loop_vars, max_iterations=max_iterations)
+
+
+def cond(pred, then_func, else_func):
+    from ..ndarray.contrib import cond as _c
+    return _c(pred, then_func, else_func)

@@ -149,3 +149,27 @@ def test_numpy_long_tail_additions():
     nb = mx.np.random.negative_binomial(4, 0.5, size=(2000,)).asnumpy()
     assert abs(nb.mean() - 4.0) < 0.6    # E = n(1-p)/p = 4
     assert (nb >= 0).all() and nb.dtype.kind == "i"
+
+
+def test_npx_surface_completions():
+    """npx long-tail names route to the registry/control-flow ops
+    (parity: mx.npx surface)."""
+    from mxnet_tpu.ndarray import NDArray
+
+    x = NDArray(onp.ones((2, 3, 4), "float32"))
+    assert mx.npx.batch_flatten(x).shape == (2, 12)
+    a = NDArray(onp.asarray([[0, 0, 2, 2]], "float32"))
+    b = NDArray(onp.asarray([[1, 1, 3, 3]], "float32"))
+    iou = float(mx.npx.box_iou(a, b).asnumpy().ravel()[0])
+    assert abs(iou - 1.0 / 7.0) < 1e-5
+    mx.npx.seed(0)
+    out, states = mx.npx.foreach(
+        lambda d, s: (d + s[0], [s[0] + 1]),
+        NDArray(onp.ones((3, 2), "float32")),
+        [NDArray(onp.zeros((2,), "float32"))])
+    assert out.shape == (3, 2)
+    onp.testing.assert_array_equal(states[0].asnumpy(), [3.0, 3.0])
+    for name in ["multibox_prior", "multibox_target",
+                 "multibox_detection", "roi_align", "box_nms",
+                 "while_loop", "cond", "index_add", "index_update"]:
+        assert callable(getattr(mx.npx, name)), name

@@ -75,3 +75,31 @@ def test_torch_op_inside_jit():
     fn = op._op
     out = jax.jit(fn)(jnp.ones((2, 2)))
     onp.testing.assert_allclose(onp.asarray(out), onp.full((2, 2), 3.0))
+
+
+def test_dlpack_protocol_roundtrip():
+    """NDArray speaks DLPack both ways (parity: mx.nd.to_dlpack_for_*
+    / from_dlpack over MXNDArray*DLPack)."""
+    import torch
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray, from_dlpack
+
+    x = NDArray(onp.arange(6, dtype="float32").reshape(2, 3))
+    t = torch.from_dlpack(x)
+    onp.testing.assert_array_equal(t.numpy(), x.asnumpy())
+    back = from_dlpack(torch.full((2, 2), 3.0))
+    onp.testing.assert_array_equal(back.asnumpy(),
+                                   onp.full((2, 2), 3.0, "float32"))
+    onp.testing.assert_array_equal(onp.from_dlpack(x), x.asnumpy())
+    # handle round trip: export -> re-import through our own pair,
+    # and through torch
+    handle = mx.nd.to_dlpack_for_read(x)
+    back2 = from_dlpack(handle)
+    onp.testing.assert_array_equal(back2.asnumpy(), x.asnumpy())
+    t2 = torch.from_dlpack(mx.nd.to_dlpack_for_write(x))
+    onp.testing.assert_array_equal(t2.numpy(), x.asnumpy())
+    import pytest
+
+    with pytest.raises(TypeError):
+        from_dlpack(x._data.__dlpack__())   # raw capsule rejected
