@@ -13,6 +13,11 @@ from . import random
 from . import linalg
 from . import contrib
 from . import sparse
+from . import image
+from .sparse import cast_storage
+from .random import shuffle
+import sys as _sys
+op = _sys.modules[__name__]   # parity: mx.nd.op aliases the op namespace
 
 populate_namespace(globals())
 

@@ -1,0 +1,46 @@
+"""mx.nd.image — image op namespace.
+
+Parity: python/mxnet/ndarray/image.py (generated `_image_*` bindings
+exposed under short names: mx.nd.image.to_tensor/normalize/crop/
+resize/random_crop/random_resized_crop over src/operator/image/).
+The random variants draw entropy from the global key chain like every
+other random op.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..ops import registry as _reg
+from ..ops.random import next_key
+from ..ops.registry import apply_jax
+from .register import make_op_func
+
+__all__ = ["to_tensor", "normalize", "crop", "resize", "random_crop",
+           "random_resized_crop"]
+
+to_tensor = make_op_func("_image_to_tensor")
+normalize = make_op_func("_image_normalize")
+crop = make_op_func("_image_crop")
+resize = make_op_func("_image_resize")
+
+
+def _random_image_op(op_name, img, **params):
+    """Key-drawing image op: record=False keeps the fresh PRNG key out
+    of autograd tapes / deferred-compute graphs (same convention as
+    ndarray/random.py shuffle/multinomial — a recorded key would
+    replay the identical 'random' transform on export)."""
+    from .ndarray import NDArray
+
+    fn = functools.partial(_reg.get(op_name).fn, **params)
+    return apply_jax(lambda k, d: fn(k, d),
+                     [NDArray(next_key()), img], record=False)
+
+
+def random_crop(img, size, **kwargs):
+    return _random_image_op("_image_random_crop", img, size=size,
+                            **kwargs)
+
+
+def random_resized_crop(img, size, **kwargs):
+    return _random_image_op("_image_random_resized_crop", img,
+                            size=size, **kwargs)

@@ -217,13 +217,13 @@ def batch_take(a, indices):
     return jnp.take_along_axis(a, idx[:, None], axis=1)[:, 0]
 
 
-@register("_unravel_index")
+@register("_unravel_index", aliases=("unravel_index",))
 def _unravel_index(indices, *, shape):
     coords = jnp.unravel_index(indices.astype(jnp.int32), tuple(shape))
     return jnp.stack(coords, axis=0).astype(indices.dtype)
 
 
-@register("_ravel_multi_index")
+@register("_ravel_multi_index", aliases=("ravel_multi_index",))
 def _ravel_multi_index(coords, *, shape):
     shape = tuple(shape)
     strides = onp.concatenate([onp.cumprod(shape[::-1])[-2::-1], [1]])

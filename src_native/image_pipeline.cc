@@ -56,9 +56,16 @@ void jpeg_err_exit(j_common_ptr cinfo) {
   longjmp(err->jb, 1);
 }
 
-// Decode JPEG to RGB8; returns false on corrupt input.
+// Decode JPEG to RGB8; returns false on corrupt input.  With
+// target_w/target_h > 0, decode directly at reduced scale in the DCT
+// domain (libjpeg scale_denom ∈ {1,2,4,8}) when the source is much
+// larger than the target — the classic downscale fast path (OpenCV
+// IMREAD_REDUCED / the reference's cv::resize-after-decode, but the
+// skipped pixels are never even IDCT'd).  The chosen scale always
+// keeps both dims >= the target so the bilinear pass stays a
+// downscale.
 bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
-                int* w, int* h) {
+                int* w, int* h, int target_w = 0, int target_h = 0) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.mgr);
@@ -75,6 +82,19 @@ bool DecodeJpeg(const uint8_t* data, size_t len, std::vector<uint8_t>* out,
     return false;
   }
   cinfo.out_color_space = JCS_RGB;
+  if (target_w > 0 && target_h > 0) {
+    unsigned denom = 1;
+    for (unsigned d = 2; d <= 8; d *= 2) {
+      unsigned sw = (cinfo.image_width + d - 1) / d;
+      unsigned sh = (cinfo.image_height + d - 1) / d;
+      if (sw >= unsigned(target_w) && sh >= unsigned(target_h))
+        denom = d;
+      else
+        break;
+    }
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = denom;
+  }
   jpeg_start_decompress(&cinfo);
   *w = cinfo.output_width;
   *h = cinfo.output_height;
@@ -285,7 +305,13 @@ struct Pipeline {
     }
 
     int w = 0, h = 0;
-    if (!DecodeJpeg(img, img_len, rgb, &w, &h)) return false;
+    // DCT-scaled decode only on the pure-resize path: random crop
+    // samples a fixed-pixel window of the FULL-res image, and scaled
+    // decode would change that augmentation's statistics
+    int hint_w = rand_crop ? 0 : width;
+    int hint_h = rand_crop ? 0 : height;
+    if (!DecodeJpeg(img, img_len, rgb, &w, &h, hint_w, hint_h))
+      return false;
     int tw = width, th = height;
     const uint8_t* src = rgb->data();
     int sw = w, sh = h;

@@ -132,3 +132,37 @@ def test_mnist_iter():
     b = next(iter(flat))
     assert b.data[0].shape == (4, 784)
     assert float(b.data[0].asnumpy().max()) <= 1.0
+
+
+def test_nd_image_namespace_and_aliases():
+    """mx.nd.image short names + shuffle/cast_storage/unravel/ravel/op
+    aliases (parity: python/mxnet/ndarray/image.py and the public op
+    namespace)."""
+    from mxnet_tpu.ndarray import NDArray
+
+    rng = onp.random.RandomState(0)
+    img = NDArray(rng.randint(0, 255, (10, 12, 3), onp.uint8))
+    t = mx.nd.image.to_tensor(img)
+    assert t.shape == (3, 10, 12) and str(t.dtype) == "float32"
+    nrm = mx.nd.image.normalize(t, mean=(0.5, 0.5, 0.5),
+                                std=(0.5, 0.5, 0.5))
+    assert nrm.shape == t.shape
+    assert mx.nd.image.resize(img, size=(8, 6)).shape == (6, 8, 3)
+    assert mx.nd.image.crop(img, x=1, y=2, width=5, height=4).shape \
+        == (4, 5, 3)
+    assert mx.nd.image.random_crop(img, size=(6, 5)).shape == (5, 6, 3)
+    assert mx.nd.image.random_resized_crop(img, size=(6, 6)).shape \
+        == (6, 6, 3)
+
+    x = NDArray(onp.arange(10, dtype="float32"))
+    assert sorted(mx.nd.shuffle(x).asnumpy().tolist()) == \
+        list(range(10))
+    ui = mx.nd.unravel_index(NDArray(onp.asarray([5.0])), shape=(2, 3))
+    assert ui.asnumpy().ravel().tolist() == [1.0, 2.0]
+    rmi = mx.nd.ravel_multi_index(
+        NDArray(onp.asarray([[1.0], [2.0]])), shape=(2, 3))
+    assert float(rmi.asnumpy()[0]) == 5.0
+    sp = mx.nd.cast_storage(NDArray(onp.eye(3, dtype="float32")),
+                            "row_sparse")
+    assert type(sp).__name__ == "RowSparseNDArray"
+    assert mx.nd.op.relu is mx.nd.relu

@@ -196,3 +196,38 @@ def test_image_record_uint8_int8_iters(tmp_path):
     arr = b.data[0].asnumpy().astype(onp.int32)
     assert arr.min() >= -128 and arr.max() <= 127
     iti8.close()
+
+
+def test_scaled_decode_matches_full_resize(tmp_path):
+    """DCT-scaled decode (rand_crop=False fast path) matches a true
+    reference downscale of the SOURCE array within JPEG tolerance —
+    a wrong scale_denom choice (e.g. decode below target + upscale)
+    blows past the bound."""
+    import jax
+
+    # smooth gradient image: locally linear, so any correct downscale
+    # agrees closely and an upscale-from-112 smears detectably
+    yy, xx = onp.mgrid[0:896, 0:896]
+    img = onp.stack([(xx / 3.5) % 256, (yy / 3.5) % 256,
+                     ((xx + yy) / 7.0) % 256], -1).astype(onp.uint8)
+    rec = os.path.join(tmp_path, "grad.rec")
+    w = native.NativeRecordWriter(rec)
+    for i in range(2):
+        w.write(recordio.pack_img(recordio.IRHeader(0, 0.0, i, 0),
+                                  img, quality=95))
+    w.close()
+
+    it = native.ImageRecordIter(rec, batch_size=2,
+                                data_shape=(3, 224, 224),
+                                preprocess_threads=1)
+    b = next(iter(it))
+    it.close()
+    fast = b.data[0].asnumpy()          # scaled decode active
+    assert fast.shape == (2, 3, 224, 224)
+
+    ref = onp.asarray(jax.image.resize(
+        img.astype("float32"), (224, 224, 3), "linear"))
+    # CHW + BGR: pack_img stores cv2-convention BGR (MXNet rec format)
+    ref = onp.moveaxis(ref, -1, 0)[::-1]
+    err = onp.abs(fast[0] - ref).mean()
+    assert err < 3.0, err               # JPEG + filter-phase tolerance
