@@ -244,8 +244,22 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+def _flash_block_default(which, fallback=512):
+    """Tunable default block size (MXNET_TPU_FLASH_BLOCK_Q/_K) so
+    tools/tune_tpu.py results can be applied without code changes.
+    Read per call site at trace time."""
+    import os
+
+    try:
+        v = int(os.environ.get(f"MXNET_TPU_FLASH_BLOCK_{which}",
+                               fallback))
+    except ValueError:
+        return fallback
+    return v if v > 0 else fallback
+
+
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
-                    block_q=512, block_k=512):
+                    block_q=None, block_k=None):
     """Flash attention on (B, H, S, D) (or (BH, S, D)) arrays.
 
     Supports grouped-query attention (GQA/MQA): ``k``/``v`` may carry
@@ -254,6 +268,10 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     (MQA is ``Hkv == 1``).  KV heads are broadcast across the group
     before the kernel; the flash tiling itself is unchanged.
     """
+    if block_q is None:
+        block_q = _flash_block_default("Q")
+    if block_k is None:
+        block_k = _flash_block_default("K")
     squeeze = q.ndim == 3
     if squeeze:
         q, k, v = q[None], k[None], v[None]
@@ -280,7 +298,8 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
 
 
 register("flash_attention", aliases=("_npx_flash_attention",))(
-    lambda q, k, v, causal=False, sm_scale=None, block_q=512, block_k=512:
+    lambda q, k, v, causal=False, sm_scale=None, block_q=None,
+    block_k=None:
     flash_attention(q, k, v, causal=causal, sm_scale=sm_scale,
                     block_q=block_q, block_k=block_k))
 

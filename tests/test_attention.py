@@ -196,3 +196,19 @@ def test_ring_attention_gqa_small_kv_traffic_path():
                               causal=True)
     onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
                                 rtol=2e-4, atol=2e-4)
+
+
+def test_flash_block_env_defaults(monkeypatch):
+    """MXNET_TPU_FLASH_BLOCK_Q/_K set the default tile sizes (the
+    tune_tpu sweep's delivery mechanism); invalid values fall back."""
+    from mxnet_tpu.ops.attention import _flash_block_default
+
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_Q", "256")
+    monkeypatch.setenv("MXNET_TPU_FLASH_BLOCK_K", "oops")
+    assert _flash_block_default("Q") == 256
+    assert _flash_block_default("K") == 512
+    # and the kernel still runs under an override
+    q = jnp.asarray(onp.random.RandomState(0)
+                    .randn(1, 2, 128, 16).astype("float32"))
+    out = flash_attention(q, q, q, causal=True)
+    assert out.shape == q.shape
