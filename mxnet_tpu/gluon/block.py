@@ -140,6 +140,23 @@ class Block:
         from .. import initializer as init_mod
         params = self.collect_params()
         default = init if init is not None else init_mod.Uniform()
+        if isinstance(default, init_mod.Load):
+            # Load matches by the hierarchical collect_params path (the
+            # framework's canonical parameter naming — init-time short
+            # names like "weight" are ambiguous across layers)
+            for path, p in params.items():
+                per = (init_mod._FixedArray(default.param[path])
+                       if path in default.param
+                       else default.default_init)
+                if per is None:
+                    from ..base import MXNetError
+                    raise MXNetError(
+                        f"Cannot initialize {path}: not found in "
+                        f"loaded params and no default initializer "
+                        f"provided")
+                p.initialize(init=per, ctx=ctx, default_init=per,
+                             force_reinit=force_reinit)
+            return
         for p in params.values():
             p.initialize(init=None, ctx=ctx, default_init=default,
                          force_reinit=force_reinit)

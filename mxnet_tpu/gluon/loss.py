@@ -4,6 +4,7 @@ Parity: python/mxnet/gluon/loss.py (15+ losses incl. CTC, Triplet, SDML).
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..ndarray import NDArray
@@ -14,7 +15,7 @@ __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "CTCLoss", "HuberLoss", "HingeLoss",
            "SquaredHingeLoss", "LogisticLoss", "TripletLoss",
-           "PoissonNLLLoss", "CosineEmbeddingLoss"]
+           "PoissonNLLLoss", "CosineEmbeddingLoss", "SDMLLoss"]
 
 
 def _reshape_like(x, y):
@@ -283,4 +284,41 @@ class CosineEmbeddingLoss(Loss):
             ls = l.reshape(-1)
             return jnp.where(ls == 1, 1.0 - cos, jnp.maximum(0.0, cos - m))
         loss = apply_jax(fn, [input1, input2, label])
+        return _apply_weighting(loss, self._weight, sample_weight)
+
+
+class SDMLLoss(Loss):
+    """Batchwise Smoothed Deep Metric Learning loss (parity:
+    gluon/loss.py:934 — Bonadiman et al. 2019): aligned pairs
+    (x1[i], x2[i]) are positives, every other row in the minibatch is
+    a smoothed negative; the loss is KL between a label-smoothed
+    identity distribution and the softmax over pairwise (negative)
+    euclidean distances, computed in both directions as one fused
+    device program."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0,
+                 batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smooth = float(smoothing_parameter)
+
+    def forward(self, x1, x2, sample_weight=None):
+        smooth = self._smooth
+
+        def fn(a, b):
+            n = a.shape[0]
+            # pairwise euclidean distances (n, n)
+            d = jnp.sqrt(jnp.sum(
+                (a[:, None, :] - b[None, :, :]) ** 2, axis=-1) + 1e-12)
+            logits = -d
+            # label-smoothed identity targets
+            eye = jnp.eye(n)
+            targets = eye * (1.0 - smooth) + (1.0 - eye) * (
+                smooth / jnp.maximum(n - 1, 1))
+            logp12 = jax.nn.log_softmax(logits, axis=1)
+            logp21 = jax.nn.log_softmax(logits.T, axis=1)
+            kl = -(targets * logp12).sum(axis=1) \
+                 - (targets * logp21).sum(axis=1)
+            return kl / 2.0
+
+        loss = apply_jax(fn, [x1, x2])
         return _apply_weighting(loss, self._weight, sample_weight)

@@ -503,3 +503,12 @@ class PCC(EvalMetric):
         d = math.sqrt(cov_tt * cov_pp)
         self.sum_metric = cov_tp / d if d else 0.0
         self.num_inst = 1 if n else 0
+
+
+# legacy framework-bridge metrics are Loss aliases (parity:
+# metric.py Torch/Caffe — mean of a scalar loss output); registered so
+# metric.create("torch"/"caffe") works like the reference
+Torch = Loss
+Caffe = Loss
+_METRIC_REGISTRY["torch"] = Loss
+_METRIC_REGISTRY["caffe"] = Loss

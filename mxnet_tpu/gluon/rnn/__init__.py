@@ -1,9 +1,12 @@
 """gluon.rnn (parity: python/mxnet/gluon/rnn/)."""
-from .rnn_cell import (RecurrentCell, RNNCell, LSTMCell, GRUCell,
-                       SequentialRNNCell, BidirectionalCell, DropoutCell,
-                       ResidualCell, ZoneoutCell)
-from .rnn_layer import RNN, LSTM, GRU
+from .rnn_cell import (BidirectionalCell, DropoutCell, GRUCell,
+                       HybridRecurrentCell, HybridSequentialRNNCell,
+                       LSTMCell, ModifierCell, RecurrentCell, RNNCell,
+                       ResidualCell, SequentialRNNCell, ZoneoutCell)
+from .rnn_layer import GRU, LSTM, RNN
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
-           "ResidualCell", "ZoneoutCell", "RNN", "LSTM", "GRU"]
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell",
+           "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "HybridSequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "ModifierCell", "RNN", "LSTM", "GRU"]

@@ -17,9 +17,11 @@ from ... import initializer as init_mod
 from ..block import HybridBlock
 from ..parameter import Parameter
 
-__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
-           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
-           "ResidualCell", "ZoneoutCell"]
+__all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell",
+           "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "HybridSequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ResidualCell", "ZoneoutCell",
+           "ModifierCell"]
 
 
 class RecurrentCell(HybridBlock):
@@ -313,3 +315,13 @@ class BidirectionalCell(RecurrentCell):
                        use_sequence_length=valid_length is not None, axis=axis)
         out = invoke("concat", [l_out, r_out], dim=2)
         return out, l_states + r_states
+
+
+# every cell here is hybrid-capable by construction (the funnel traces
+# them like any HybridBlock), so the reference's Hybrid* split
+# collapses to aliases (parity: rnn_cell.py HybridRecurrentCell,
+# HybridSequentialRNNCell); ModifierCell is the public name of the
+# wrapper base (parity: rnn_cell.py ModifierCell)
+HybridRecurrentCell = RecurrentCell
+HybridSequentialRNNCell = SequentialRNNCell
+ModifierCell = _ModifierCell

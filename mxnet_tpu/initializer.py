@@ -7,6 +7,7 @@ pattern).
 from __future__ import annotations
 
 import math
+import logging
 import re
 from typing import Callable, Dict, Optional
 
@@ -17,7 +18,7 @@ import jax.numpy as jnp
 from .base import MXNetError
 from .ops import random as _rng
 
-__all__ = ["Initializer", "register", "create", "Zero", "One", "Constant",
+__all__ = ["Initializer", "register", "create", "Load", "Zero", "One", "Constant",
            "Uniform", "Normal", "Orthogonal", "Xavier", "MSRAPrelu",
            "Bilinear", "LSTMBias", "InitDesc", "Mixed"]
 
@@ -251,6 +252,67 @@ class LSTMBias(Initializer):
         n = shape[0] // 4
         b[n:2 * n] = self.forget_bias
         return jnp.asarray(b, dtype)
+
+
+class _FixedArray(Initializer):
+    """Initialize to one specific array (Load's per-parameter worker:
+    bypasses the name-based constant short-circuits)."""
+
+    def __init__(self, value):
+        super().__init__()
+        self._value = value
+
+    def init_array(self, name, shape, dtype):
+        data = self._value._data if hasattr(self._value, "_data") \
+            else self._value
+        if tuple(shape) != tuple(data.shape):
+            raise MXNetError(
+                f"Parameter {name} cannot be initialized from "
+                f"loading: shape {tuple(shape)} vs loaded "
+                f"{tuple(data.shape)}")
+        return jnp.asarray(data, dtype)
+
+
+class Load(Initializer):
+    """Initialize parameters from a saved file or name->NDArray dict;
+    names matching entries (with any ``arg:``/``aux:`` prefix dropped)
+    load — INCLUDING bias/gamma/running-stat names, which override the
+    base class's constant defaults — the rest fall to ``default_init``
+    (parity: initializer.py:316 Load)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .ndarray import load as _load
+            param = _load(param)
+        if not isinstance(param, dict):
+            raise MXNetError("Load expects a file name or a dict")
+        self.param = {}
+        for name, arr in param.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            self.param[key] = arr
+        self.default_init = (create(default_init)
+                             if default_init is not None else None)
+        self.verbose = verbose
+
+    def init_array(self, name, shape, dtype):
+        key = str(name)
+        if key in self.param:
+            src = self.param[key]
+            if tuple(shape) != tuple(src.shape):
+                raise MXNetError(
+                    f"Parameter {key} cannot be initialized from "
+                    f"loading: shape {tuple(shape)} vs loaded "
+                    f"{tuple(src.shape)}")
+            if self.verbose:
+                logging.info("Initialized %s by loading", key)
+            data = src._data if hasattr(src, "_data") else src
+            return jnp.asarray(data, dtype)
+        if self.default_init is None:
+            raise MXNetError(
+                f"Cannot initialize {key}: not found in loaded params "
+                f"and no default initializer provided")
+        return self.default_init.init_array(name, shape, dtype)
 
 
 class Mixed:

@@ -3,10 +3,12 @@ reference; consolidated here over the optimizer-update ops in
 mxnet_tpu/ops/optimizer_ops.py)."""
 from .optimizer import (Optimizer, Updater, create, register, get_updater,
                         SGD, NAG, Adam, AdamW, AdaGrad, AdaDelta, Adamax,
-                        Nadam, RMSProp, FTML, FTRL, LAMB, LARS, Signum,
+                        Nadam, RMSProp, FTML, FTRL, LAMB, LANS, LARS, Signum,
                         SGLD, DCASGD, Test)
 
 __all__ = ["Optimizer", "Updater", "create", "register", "get_updater",
            "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta", "Adamax",
-           "Nadam", "RMSProp", "FTML", "FTRL", "LAMB", "LARS", "Signum",
+           "Nadam", "RMSProp", "FTML", "FTRL", "Ftrl", "LAMB", "LANS", "LARS", "Signum",
            "SGLD", "DCASGD", "Test"]
+
+Ftrl = FTRL      # reference spelling (optimizer/ftrl.py)

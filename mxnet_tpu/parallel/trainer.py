@@ -629,9 +629,12 @@ class SPMDTrainer:
                 json.dump(meta, f)
         final = os.path.join(directory, tag)
         backup = os.path.join(directory, f"{tag}.old")
-        if os.path.exists(backup):
-            shutil.rmtree(backup)
         if os.path.exists(final):
+            # clear stale backup only when a live 'final' still covers
+            # us, then move it aside; if a prior crash left ONLY the
+            # backup, it stays untouched until the new publish lands
+            if os.path.exists(backup):
+                shutil.rmtree(backup)
             os.replace(final, backup)   # keep the old one until...
         os.replace(tmp, final)          # ...the new one is in place
         if os.path.exists(backup):

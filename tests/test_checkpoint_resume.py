@@ -139,3 +139,21 @@ def test_fit_skip_counts_only_fit_batches(tmp_path):
     # resumed fit skips exactly the 2 fit-consumed batches and trains
     # the remaining 2: total updates = 4 (from ckpt) + 2
     assert tr2.num_update == 6
+
+
+def test_publish_survives_backup_only_state(tmp_path):
+    """Re-publishing from the degraded only-.old state never deletes
+    the surviving checkpoint before the new one lands."""
+    import shutil
+
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    tr.save_checkpoint(tmp_path)
+    os.replace(os.path.join(tmp_path, "latest"),
+               os.path.join(tmp_path, "latest.old"))   # crash window
+    tr.step(d, l)
+    tr.save_checkpoint(tmp_path)        # must not drop latest.old first
+    meta = _trainer(seed=3).load_checkpoint(tmp_path)
+    assert meta and meta["num_update"] == 2
+    assert not os.path.exists(os.path.join(tmp_path, "latest.old"))

@@ -463,3 +463,56 @@ def test_concurrent_inference_threads():
     assert not errors, errors
     for got, ref in zip(results, want):
         onp.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-7)
+
+
+def test_sdml_loss_and_name_parity():
+    """SDMLLoss (gluon/loss.py:934) separates aligned pairs from
+    decorrelated ones; Ftrl/LANS/Torch/Caffe/Load/rnn-alias names
+    resolve (reference spellings)."""
+    from mxnet_tpu.gluon import loss as L, metric as M, rnn
+    import mxnet_tpu.initializer as I
+    import mxnet_tpu.optimizer as O
+
+    rng = onp.random.RandomState(0)
+    emb = rng.randn(8, 16).astype("float32")
+    sd = L.SDMLLoss(smoothing_parameter=0.3)
+    good = float(sd(nd.NDArray(emb), nd.NDArray(
+        emb + 0.01 * rng.randn(8, 16).astype("float32")))
+        .asnumpy().mean())
+    bad = float(sd(nd.NDArray(emb), nd.NDArray(
+        rng.randn(8, 16).astype("float32"))).asnumpy().mean())
+    assert good < bad
+
+    assert O.Ftrl is O.FTRL and callable(O.LANS)
+    assert M.Torch is M.Loss and M.Caffe is M.Loss
+    assert rnn.HybridRecurrentCell is rnn.RecurrentCell
+    assert rnn.HybridSequentialRNNCell is rnn.SequentialRNNCell
+    assert rnn.ModifierCell.__name__.endswith("ModifierCell")
+
+    assert isinstance(M.create("torch"), M.Loss)
+    assert isinstance(M.create("caffe"), M.Loss)
+
+    # Load initializer round-trips saved params (arg:/aux: stripped),
+    # INCLUDING bias/BN names that default initializers short-circuit
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4, in_units=3), gluon.nn.BatchNorm())
+    net.initialize()
+    net(nd.NDArray(onp.ones((2, 3), "float32")))
+    rng2 = onp.random.RandomState(3)
+    for p in net.collect_params().values():   # make every value nonzero
+        p.set_data(nd.NDArray(
+            rng2.randn(*p.shape).astype("float32")))
+    params = {"arg:" + k: p.data()
+              for k, p in net.collect_params().items()}
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(4, in_units=3), gluon.nn.BatchNorm())
+    net2.initialize(init=I.Load(params, default_init=I.Zero()))
+    net2(nd.NDArray(onp.ones((2, 3), "float32")))
+    for k in net.collect_params():
+        onp.testing.assert_allclose(
+            net2.collect_params()[k].data().asnumpy(),
+            net.collect_params()[k].data().asnumpy(), rtol=1e-6,
+            err_msg=k)
+    with pytest.raises(mx.base.MXNetError):
+        I.Load({}, default_init=None)("w", net.collect_params()[
+            list(net.collect_params())[0]].data())
