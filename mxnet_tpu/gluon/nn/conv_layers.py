@@ -26,6 +26,22 @@ def _tup(v, n):
     return tuple(v)
 
 
+def _conv_wshape(op_name, channels_last, cin_arg, channels, groups,
+                 kernel):
+    """Weight shape for conv/deconv in either layout family (weight
+    layout follows the data layout, reference convention):
+    Convolution:   (O, I/g, *k)  /  (O, *k, I/g) channels-last
+    Deconvolution: (I, O/g, *k)  /  (I, *k, O/g) channels-last
+    ``cin_arg`` is I/g for Convolution, I for Deconvolution."""
+    if op_name == "Convolution":
+        first, second = channels, cin_arg
+    else:
+        first, second = cin_arg, channels // groups
+    if channels_last:
+        return (first,) + tuple(kernel) + (second,)
+    return (first, second) + tuple(kernel)
+
+
 class _Conv(HybridBlock):
     def __init__(self, channels, kernel_size, strides, padding, dilation,
                  groups, layout, in_channels=0, activation=None, use_bias=True,
@@ -44,12 +60,12 @@ class _Conv(HybridBlock):
         self._activation = activation
         self._op_name = op_name
         self._adj = adj
-        if op_name == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0) \
-                + kernel_size
-        else:  # Deconvolution: (in, out/g, *k)
-            wshape = (in_channels if in_channels else 0, channels // groups) \
-                + kernel_size
+        channels_last = bool(layout) and layout.endswith("C")
+        cin_arg = ((in_channels // groups if in_channels else 0)
+                   if op_name == "Convolution"
+                   else (in_channels if in_channels else 0))
+        wshape = _conv_wshape(op_name, channels_last, cin_arg,
+                              channels, groups, kernel_size)
         self.weight = Parameter(shape=wshape, dtype=dtype,
                                 init=weight_initializer,
                                 allow_deferred_init=True)
@@ -58,14 +74,14 @@ class _Conv(HybridBlock):
                               allow_deferred_init=True) if use_bias else None
 
     def _finish_deferred(self, x):
-        cin = x.shape[1 if not self._layout or not self._layout.endswith("C")
-                      else -1]
+        channels_last = bool(self._layout) and self._layout.endswith("C")
+        cin = x.shape[-1 if channels_last else 1]
         if self.weight._deferred_init is not None:
-            if self._op_name == "Convolution":
-                shape = (self._channels, cin // self._groups) + self._kernel
-            else:
-                shape = (cin, self._channels // self._groups) + self._kernel
-            self.weight._finish_deferred_init(shape)
+            cin_arg = (cin // self._groups
+                       if self._op_name == "Convolution" else cin)
+            self.weight._finish_deferred_init(_conv_wshape(
+                self._op_name, channels_last, cin_arg, self._channels,
+                self._groups, self._kernel))
         if self.bias is not None and self.bias._deferred_init is not None:
             self.bias._finish_deferred_init((self._channels,))
 

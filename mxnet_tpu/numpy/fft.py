@@ -25,6 +25,17 @@ rfft = _lift(_jnp.fft.rfft)
 irfft = _lift(_jnp.fft.irfft)
 fftshift = _lift(_jnp.fft.fftshift)
 ifftshift = _lift(_jnp.fft.ifftshift)
+hfft = _lift(_jnp.fft.hfft)
+ihfft = _lift(_jnp.fft.ihfft)
+
+
+def fftfreq(n, d=1.0):
+    return _NDArray(_jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0):
+    return _NDArray(_jnp.fft.rfftfreq(n, d))
+
 
 __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn", "rfft", "irfft",
-           "fftshift", "ifftshift"]
+           "fftshift", "ifftshift", "hfft", "ihfft", "fftfreq", "rfftfreq"]

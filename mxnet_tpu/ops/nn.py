@@ -112,27 +112,66 @@ def _deconvolution(x, weight, bias=None, *, kernel, stride=None, dilate=None,
     stride, dilate = _tup(stride, n), _tup(dilate, n)
     pad = _tup(pad, n) if pad is not None else (0,) * n
     adj = _tup(adj, n) if adj is not None else (0,) * n
+    if target_shape:
+        # target_shape overrides pad/adj to hit the requested output
+        # exactly (parity: deconvolution-inl.h DeconvolutionParam —
+        # out = (i-1)*s - 2p + d*(k-1) + 1 + adj, solved for p, adj)
+        tgt = _tup(target_shape, n)
+        spatial_in = (x.shape[2:2 + n]
+                      if not (layout and layout.endswith("C"))
+                      else x.shape[1:1 + n])
+        new_pad, new_adj = [], []
+        for i in range(n):
+            nopad = ((spatial_in[i] - 1) * stride[i]
+                     + dilate[i] * (kernel[i] - 1) + 1)
+            excess = nopad - tgt[i]
+            if excess < 0:
+                raise ValueError(
+                    f"Deconvolution target_shape {tgt} larger than "
+                    f"the maximum unpadded output for input "
+                    f"{tuple(spatial_in)}")
+            a = excess % 2
+            new_pad.append((excess + a) // 2)
+            new_adj.append(a)
+        pad, adj = tuple(new_pad), tuple(new_adj)
     g = num_group
     cin = weight.shape[0]
-    og = weight.shape[1]
-    # (I, O/g, *k) -> (g*O/g, I/g, *k) with spatial flip: gradient-of-conv form
-    w = weight.reshape((g, cin // g, og) + tuple(weight.shape[2:]))
-    w = jnp.swapaxes(w, 1, 2).reshape((g * og, cin // g) + tuple(weight.shape[2:]))
-    w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+    channels_last = bool(layout) and layout.endswith("C")
+    if channels_last:
+        # weight follows the data layout (reference convention):
+        # (I, *k, O/g) -> (g*O/g, *k, I/g) with spatial flip
+        og = weight.shape[-1]
+        ksp = tuple(weight.shape[1:-1])
+        w = weight.reshape((g, cin // g) + ksp + (og,))
+        w = jnp.moveaxis(w, -1, 1)            # (g, O/g, I/g, *k)
+        w = w.reshape((g * og, cin // g) + ksp)
+        w = jnp.moveaxis(w, 1, -1)            # (g*O/g, *k, I/g)
+        w = jnp.flip(w, axis=tuple(range(1, 1 + n)))
+    else:
+        og = weight.shape[1]
+        # (I, O/g, *k) -> (g*O/g, I/g, *k) with spatial flip
+        w = weight.reshape((g, cin // g, og) + tuple(weight.shape[2:]))
+        w = jnp.swapaxes(w, 1, 2).reshape(
+            (g * og, cin // g) + tuple(weight.shape[2:]))
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
     padding = []
     for i in range(n):
         lo = dilate[i] * (kernel[i] - 1) - pad[i]
         padding.append((lo, lo + adj[i]))
+    dnums = _conv_dnums(n, layout)
     out = lax.conv_general_dilated(
         x, w,
         window_strides=(1,) * n,
         padding=padding,
         lhs_dilation=stride,
         rhs_dilation=dilate,
-        dimension_numbers=_conv_dnums(n, layout),
+        dimension_numbers=dnums,
         feature_group_count=g)
     if bias is not None:
-        out = out + bias.reshape((1, -1) + (1,) * n)
+        if dnums[2].endswith("C"):
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * n)
     return out
 
 
@@ -142,6 +181,17 @@ def _deconvolution(x, weight, bias=None, *, kernel, stride=None, dilate=None,
 def _pooling(x, *, kernel=(), pool_type="max", global_pool=False, stride=None,
              pad=None, pooling_convention="valid", count_include_pad=True,
              p_value=2, cudnn_off=False, layout=None, **_ignored):
+    # channels-last layouts (NWC/NHWC/NDHWC): normalize to
+    # channels-first for the window math, restore on the way out
+    channels_last = bool(layout) and layout.endswith("C")
+    if channels_last:
+        out = _pooling(jnp.moveaxis(x, -1, 1), kernel=kernel,
+                       pool_type=pool_type, global_pool=global_pool,
+                       stride=stride, pad=pad,
+                       pooling_convention=pooling_convention,
+                       count_include_pad=count_include_pad,
+                       p_value=p_value, layout=None)
+        return jnp.moveaxis(out, 1, -1)
     nsp = x.ndim - 2
     if global_pool:
         axes = tuple(range(2, x.ndim))

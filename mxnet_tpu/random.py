@@ -11,7 +11,12 @@ from .ndarray.random import (uniform, normal, randn, gamma, exponential,
                              multinomial, bernoulli, shuffle, laplace,
                              rayleigh, gumbel, logistic)
 
-__all__ = ["seed", "uniform", "normal", "randn", "gamma", "exponential",
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "gamma", "exponential",
            "poisson", "negative_binomial", "generalized_negative_binomial",
            "randint", "multinomial", "bernoulli", "shuffle", "laplace",
            "rayleigh", "gumbel", "logistic", "next_key", "current_key"]
+
+
+def rand(*shape, **kwargs):
+    """Uniform [0, 1) samples (parity: mx.random / numpy rand)."""
+    return uniform(0.0, 1.0, shape=shape or (1,), **kwargs)

@@ -173,3 +173,19 @@ def test_npx_surface_completions():
                  "multibox_detection", "roi_align", "box_nms",
                  "while_loop", "cond", "index_add", "index_update"]:
         assert callable(getattr(mx.npx, name)), name
+
+
+def test_fft_and_random_tail():
+    """fft long tail (fftfreq/rfftfreq/hfft/ihfft) + mx.random.rand."""
+    onp.testing.assert_allclose(mx.np.fft.fftfreq(4).asnumpy(),
+                                onp.fft.fftfreq(4), rtol=1e-6)
+    onp.testing.assert_allclose(mx.np.fft.rfftfreq(5).asnumpy(),
+                                onp.fft.rfftfreq(5), rtol=1e-6)
+    x = onp.asarray([1.0, 2.0, 3.0])
+    onp.testing.assert_allclose(
+        mx.np.fft.hfft(mx.np.array(x)).asnumpy(), onp.fft.hfft(x),
+        rtol=1e-5, atol=1e-5)
+    r = mx.random.rand(3, 2)
+    assert r.shape == (3, 2)
+    a = r.asnumpy()
+    assert (a >= 0).all() and (a < 1).all()

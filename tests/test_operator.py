@@ -284,3 +284,91 @@ def test_conv_nhwc_env_path_matches_nchw(monkeypatch):
     onp.testing.assert_allclose(
         got_g, lax_ref(xg, wg, None, (1, 1), (0, 0), groups=3),
         rtol=2e-5, atol=2e-5)
+
+
+def test_channels_last_pooling_and_deconv():
+    """NHWC/NWC layouts through Pooling and Deconvolution match the
+    channels-first reference (regression: NHWC pooling reduced the
+    wrong axes)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(2, 10, 10, 3).astype("float32")
+    got = mx.nd.Pooling(NDArray(x), kernel=(2, 2), stride=(2, 2),
+                        pool_type="max", layout="NHWC").asnumpy()
+    ref = mx.nd.Pooling(NDArray(onp.transpose(x, (0, 3, 1, 2))),
+                        kernel=(2, 2), stride=(2, 2),
+                        pool_type="max").asnumpy()
+    onp.testing.assert_allclose(got, onp.transpose(ref, (0, 2, 3, 1)),
+                                rtol=1e-6)
+    gavg = mx.nd.Pooling(NDArray(x), pool_type="avg", global_pool=True,
+                         layout="NHWC").asnumpy()
+    onp.testing.assert_allclose(gavg.reshape(2, 3), x.mean((1, 2)),
+                                rtol=1e-5)
+    # deconv: channels-last weights follow the data layout
+    # ((I, *k, O/g) for NWC; (I, O/g, *k) channels-first)
+    xs = rng.randn(2, 8, 4).astype("float32")      # NWC
+    w_nwc = rng.randn(4, 3, 5).astype("float32")   # (in, k, out)
+    b = rng.randn(5).astype("float32")
+    got_d = mx.nd.Deconvolution(NDArray(xs), NDArray(w_nwc),
+                                NDArray(b), kernel=(3,), num_filter=5,
+                                no_bias=False, layout="NWC").asnumpy()
+    ref_d = mx.nd.Deconvolution(
+        NDArray(onp.transpose(xs, (0, 2, 1))),
+        NDArray(onp.transpose(w_nwc, (0, 2, 1))), NDArray(b),
+        kernel=(3,), num_filter=5, no_bias=False).asnumpy()
+    onp.testing.assert_allclose(got_d,
+                                onp.transpose(ref_d, (0, 2, 1)),
+                                rtol=1e-4, atol=1e-4)
+    # conv: NHWC layout kwarg expects (O, *k, I) weights — asymmetric
+    # kernel catches axis misinterpretation
+    xh = rng.randn(2, 9, 9, 3).astype("float32")
+    w_oihw = rng.randn(8, 3, 2, 4).astype("float32")
+    got_c = mx.nd.Convolution(
+        NDArray(xh), NDArray(onp.transpose(w_oihw, (0, 2, 3, 1))),
+        kernel=(2, 4), num_filter=8, no_bias=True,
+        layout="NHWC").asnumpy()
+    ref_c = mx.nd.Convolution(
+        NDArray(onp.transpose(xh, (0, 3, 1, 2))), NDArray(w_oihw),
+        kernel=(2, 4), num_filter=8, no_bias=True).asnumpy()
+    onp.testing.assert_allclose(got_c,
+                                onp.transpose(ref_c, (0, 2, 3, 1)),
+                                rtol=1e-4, atol=1e-4)
+    # and the gluon layer allocates layout-consistent weights: a
+    # training-shaped forward matches a transposed NCHW twin
+    from mxnet_tpu.gluon import nn as gnn
+    mx.random.seed(11)
+    lay = gnn.Conv2D(6, (2, 3), layout="NHWC", in_channels=3)
+    lay.initialize()
+    out_l = lay(NDArray(xh))
+    assert lay.weight.shape == (6, 2, 3, 3)    # (O, kH, kW, I)
+    assert out_l.shape == (2, 8, 7, 6)
+
+
+def test_deconv_target_shape():
+    """target_shape overrides the deconv output size by inferring adj
+    (parity: DeconvolutionParam)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(1, 4, 8).astype("float32")       # NCW
+    w = rng.randn(4, 5, 3).astype("float32")
+    out = mx.nd.Deconvolution(NDArray(x), NDArray(w), kernel=(3,),
+                              stride=(2,), num_filter=5,
+                              target_shape=(15,)).asnumpy()
+    assert out.shape == (1, 5, 15)
+    # default formula gives 17; 15 is valid because adj range is [0, s)
+    out17 = mx.nd.Deconvolution(NDArray(x), NDArray(w), kernel=(3,),
+                                stride=(2,), num_filter=5).asnumpy()
+    assert out17.shape == (1, 5, 17)
+    # odd excess exercises the adj remainder
+    out16 = mx.nd.Deconvolution(NDArray(x), NDArray(w), kernel=(3,),
+                                stride=(2,), num_filter=5,
+                                target_shape=(16,)).asnumpy()
+    assert out16.shape == (1, 5, 16)
+    with pytest.raises(Exception):
+        mx.nd.Deconvolution(NDArray(x), NDArray(w), kernel=(3,),
+                            stride=(2,), num_filter=5,
+                            target_shape=(30,))
