@@ -797,6 +797,14 @@ def _layernorm(ctx, node, ins, out):
 
 @register("InstanceNorm")
 def _instancenorm(ctx, node, ins, out):
+    axis = int(node.params.get("axis", 1))
+    if axis != 1:
+        # ONNX InstanceNormalization hardcodes channel axis 1; a
+        # silent export would normalize the wrong axes
+        raise NotImplementedError(
+            f"ONNX export of InstanceNorm(axis={axis}) is not "
+            f"supported — transpose to channels-first (axis=1) "
+            f"before export")
     ctx.add_node("InstanceNormalization", ins, [out], name=node.name,
                  epsilon=float(node.params.get("eps", 1e-3)))
 

@@ -327,6 +327,7 @@ class InstanceNorm(HybridBlock):
                  in_channels=0, **kwargs):
         super().__init__(**kwargs)
         self._epsilon = epsilon
+        self._axis = axis
         self.gamma = Parameter(shape=(in_channels,),
                                init=init_mod.create(gamma_initializer),
                                allow_deferred_init=True,
@@ -337,12 +338,12 @@ class InstanceNorm(HybridBlock):
                               grad_req="write" if center else "null")
 
     def forward(self, x):
-        c = x.shape[1]
+        c = x.shape[self._axis % x.ndim]
         for p in (self.gamma, self.beta):
             if p._deferred_init is not None:
                 p._finish_deferred_init((c,))
         return invoke("InstanceNorm", [x, self.gamma.data(), self.beta.data()],
-                      eps=self._epsilon)
+                      eps=self._epsilon, axis=self._axis)
 
 
 class Lambda(Block):

@@ -576,12 +576,19 @@ def _l2_normalization(data, *, eps=1e-10, mode="instance"):
 
 
 @register("InstanceNorm")
-def _instance_norm(data, gamma, beta, *, eps=1e-3):
-    axes = tuple(range(2, data.ndim))
+def _instance_norm(data, gamma, beta, *, eps=1e-3, axis=1):
+    ax = axis % data.ndim
+    # normalize over every non-batch, non-channel axis.  MXNet parity:
+    # the gluon layer swapaxes(1, axis) then reduces axes 2.., so the
+    # excluded pair for axis=0 is {0, 1} (dim 0 = channel, dim 1 =
+    # batch), otherwise {0, axis}.
+    excluded = {0, 1} if ax == 0 else {0, ax}
+    axes = tuple(i for i in range(data.ndim) if i not in excluded)
     mean = jnp.mean(data, axis=axes, keepdims=True)
     var = jnp.var(data, axis=axes, keepdims=True)
     xn = (data - mean) / jnp.sqrt(var + eps)
-    shape = (1, -1) + (1,) * (data.ndim - 2)
+    shape = [1] * data.ndim
+    shape[ax] = -1
     return xn * gamma.reshape(shape) + beta.reshape(shape)
 
 

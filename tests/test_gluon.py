@@ -516,3 +516,18 @@ def test_sdml_loss_and_name_parity():
     with pytest.raises(mx.base.MXNetError):
         I.Load({}, default_init=None)("w", net.collect_params()[
             list(net.collect_params())[0]].data())
+
+
+def test_instance_norm_channels_last_axis():
+    """InstanceNorm(axis=-1/3) normalizes the right axes (regression:
+    the op hardcoded channel axis 1)."""
+    rng = onp.random.RandomState(0)
+    x = rng.randn(4, 6, 6, 3).astype("float32")
+    last = nn.InstanceNorm(axis=3, in_channels=3)
+    last.initialize()
+    first = nn.InstanceNorm(axis=1, in_channels=3)
+    first.initialize()
+    out = last(nd.NDArray(x)).asnumpy()
+    ref = first(nd.NDArray(onp.transpose(x, (0, 3, 1, 2)))).asnumpy()
+    onp.testing.assert_allclose(out, onp.transpose(ref, (0, 2, 3, 1)),
+                                rtol=1e-4, atol=1e-5)
