@@ -22,6 +22,39 @@ op = _sys.modules[__name__]   # parity: mx.nd.op aliases the op namespace
 populate_namespace(globals())
 
 # reference-compat names
+def Dropout(data, *args, p=0.5, mode="training", axes=(), key=None,
+            **kwargs):
+    """Eager Dropout with the reference's mode semantics: "training"
+    applies only under autograd.record(train_mode=True), "always"
+    applies unconditionally.  Positional args follow the reference
+    signature ``Dropout(data, p, mode, axes)``; an NDArray in the
+    first positional slot is accepted as an explicit PRNG ``key``
+    (the engine-supplied RNG resource is otherwise a key drawn from
+    the global chain)."""
+    from .. import autograd as ag
+    from ..ops.random import next_key
+    from ..ops.registry import invoke
+
+    pos = list(args)
+    if pos and isinstance(pos[0], NDArray):
+        key = pos.pop(0)
+    for name, val in zip(("p", "mode", "axes"), pos):
+        if name == "p":
+            p = val
+        elif name == "mode":
+            mode = val
+        else:
+            axes = val
+    if p <= 0 or (mode != "always" and not ag.is_training()):
+        return data
+    if key is None:
+        key = NDArray(next_key())
+    return invoke("Dropout", [data, key], p=p, axes=tuple(axes))
+
+
+dropout = Dropout
+
+
 def zeros_like(a):  # noqa: F811 — registry version takes NDArray only too
     from ..ops.registry import invoke
     return invoke("zeros_like", [a])

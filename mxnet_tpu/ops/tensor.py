@@ -476,6 +476,14 @@ def _topk(a, *, k=1, axis=-1, ret_typ="indices", is_ascend=False, dtype="float32
         return vals
     if ret_typ == "both":
         return (vals, idx.astype(np_dtype(dtype)))
+    if ret_typ == "mask":
+        # input-shaped 0/1 mask marking the top-k entries along axis,
+        # in the INPUT's dtype (parity: ordering_op ret_typ=mask; the
+        # dtype param governs only index outputs).  Scatter via
+        # put_along_axis — no O(n*k) one_hot intermediate.
+        return jnp.put_along_axis(
+            jnp.zeros(a.shape, a.dtype), idx.astype(jnp.int32),
+            jnp.asarray(1, a.dtype), axis=ax, inplace=False)
     return idx.astype(np_dtype(dtype))
 
 

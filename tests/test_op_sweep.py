@@ -322,3 +322,16 @@ def test_legacy_crop_op():
     c2 = mx.nd.Crop(x, h_w=(2, 2), offset=(1, 3), num_args=1)
     onp.testing.assert_array_equal(c2.asnumpy(),
                                    x.asnumpy()[:, :, 1:3, 3:5])
+
+
+def test_topk_mask_shape_and_positions():
+    """ret_typ='mask' returns an input-shaped 0/1 mask (regression:
+    it returned the (.., k) index shape)."""
+    x = onp.asarray([[3.0, 1.0, 2.0, 5.0], [0.0, -1.0, 4.0, 2.0]],
+                    "float32")
+    m = _inv("topk", [x], k=2, ret_typ="mask", axis=1)
+    assert m.shape == x.shape
+    assert m.sum(1).tolist() == [2.0, 2.0]
+    assert m[0, 3] == 1 and m[0, 0] == 1
+    m0 = _inv("topk", [x], k=1, ret_typ="mask", axis=0)
+    assert m0.shape == x.shape and m0.sum(0).tolist() == [1.0] * 4

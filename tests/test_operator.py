@@ -372,3 +372,23 @@ def test_deconv_target_shape():
         mx.nd.Deconvolution(NDArray(x), NDArray(w), kernel=(3,),
                             stride=(2,), num_filter=5,
                             target_shape=(30,))
+
+
+def test_eager_dropout_modes():
+    """mx.nd.Dropout works standalone: identity in inference,
+    stochastic under record(), unconditional with mode='always'
+    (regression: the raw binding lacked the PRNG key)."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import NDArray
+
+    import mxnet_tpu as mx
+
+    ones = NDArray(onp.ones((1000,), "float32"))
+    d = mx.nd.Dropout(ones, p=0.5, mode="always").asnumpy()
+    assert 0.35 < float((d == 0).mean()) < 0.65
+    assert (d[d != 0] == 2.0).all()          # inverted scaling
+    assert (mx.nd.Dropout(ones, p=0.5).asnumpy() == 1).all()
+    with autograd.record():
+        y = mx.nd.Dropout(ones, p=0.5)
+    z = float((y.asnumpy() == 0).mean())
+    assert 0.3 < z < 0.7
