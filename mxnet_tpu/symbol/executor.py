@@ -39,6 +39,22 @@ class Executor:
         aux.update(aux_states or {})
         missing = (set(self._arg_names) - set(args)) | \
             (set(self._aux_names) - set(aux))
+        # PRNG-key inputs of stochastic ops (Dropout etc.) are the
+        # engine RNG resource in the reference — auto-supplied from
+        # the global chain (derived from the GRAPH, not name patterns)
+        # and refreshed on every forward
+        self._key_args = sorted(set(symbol.list_prng_keys())
+                                & set(self._arg_names + self._aux_names))
+        if self._key_args:
+            from ..ndarray import NDArray as _ND
+            from ..ops.random import next_key
+
+            for n in self._key_args:
+                if n in self._arg_names:
+                    args.setdefault(n, _ND(next_key()))
+                else:
+                    aux.setdefault(n, _ND(next_key()))
+            missing -= set(self._key_args)
         if missing:
             raise MXNetError(f"bind: missing arguments {sorted(missing)}")
         self._args: Dict[str, NDArray] = {n: args[n]
@@ -54,8 +70,10 @@ class Executor:
         self._grad_req = grad_req
 
         self._all_names = self._arg_names + self._aux_names
-        fn = symbol._lower(self._all_names)
+        fn = symbol._lower(self._all_names, is_train=True)
         self._fwd = jax.jit(lambda arrays: fn(arrays))
+        fn_eval = symbol._lower(self._all_names, is_train=False)
+        self._fwd_eval = jax.jit(lambda arrays: fn_eval(arrays))
         self._vjp = None
         self.outputs: List[NDArray] = []
 
@@ -102,20 +120,36 @@ class Executor:
                 self._args[n] = v if isinstance(v, NDArray) else NDArray(v)
             else:
                 raise MXNetError(f"forward: unknown argument {n!r}")
+        # refresh PRNG keys on EVERY forward (fresh masks per call —
+        # also for mode="always" stochastic inference, e.g. MC dropout)
+        from ..ops.random import next_key
+        for n in getattr(self, "_key_args", ()):
+            tgt = self._args if n in self._args else self._aux
+            tgt[n] = NDArray(next_key())
         arrays = [self._args[n]._data for n in self._arg_names] + \
             [self._aux[n]._data for n in self._aux_names]
         if is_train:
-            # vjp over the argument slice only: aux states are mutable,
-            # non-differentiable inputs (parity: FMutateInputs take no
-            # gradient)
+            # vjp over the differentiable argument slice only: aux
+            # states AND PRNG keys are non-differentiable inputs
+            # (parity: FMutateInputs / engine resources get no grad)
             n_args = len(self._arg_names)
+            keyset = set(self._key_args)
+            diff_idx = [i for i, n in enumerate(self._arg_names)
+                        if n not in keyset]
+            self._diff_idx = diff_idx
             aux_arrays = arrays[n_args:]
-            outs, vjp_fn = jax.vjp(
-                lambda a: self._fwd(list(a) + aux_arrays),
-                arrays[:n_args])
+            full = list(arrays[:n_args])
+
+            def run(diff_arrays):
+                buf = list(full)
+                for i, a in zip(diff_idx, diff_arrays):
+                    buf[i] = a
+                return self._fwd(buf + aux_arrays)
+
+            outs, vjp_fn = jax.vjp(run, [arrays[i] for i in diff_idx])
             self._vjp = vjp_fn
         else:
-            outs = self._fwd(arrays)
+            outs = self._fwd_eval(arrays)
             self._vjp = None
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
@@ -131,11 +165,19 @@ class Executor:
                 out_grads = [out_grads]
             cots = [g._data if isinstance(g, NDArray) else jnp.asarray(g)
                     for g in out_grads]
-        (grads,) = self._vjp(list(cots))
+        (diff_grads,) = self._vjp(list(cots))
+        # re-expand to the full argument list: PRNG keys get zeros
+        grads = [jnp.zeros(self._args[n].shape, self._args[n].dtype)
+                 if n in set(self._key_args) else None
+                 for n in self._arg_names]
+        for i, g in zip(self._diff_idx, diff_grads):
+            grads[i] = g
         if self._args_grad is not None:
+            keyset = set(self._key_args)
             for name, g in zip(self._arg_names, grads):
                 req = self._grad_req.get(name, "write")
-                if req == "null" or name not in self._args_grad:
+                if (req == "null" or name not in self._args_grad
+                        or name in keyset):
                     continue
                 tgt = self._args_grad[name]
                 if req == "add":

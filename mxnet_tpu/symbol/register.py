@@ -48,7 +48,12 @@ def make_sym_func(name: str):
                                  kw_defaults.get("no_bias", False))
             for p in positional[len(inputs):]:
                 if p.default is inspect.Parameter.empty:
-                    inputs.append(Variable(f"{node_name}_{p.name}"))
+                    # PRNG-key inputs are marked so bind/infer_shape
+                    # can auto-supply them (the engine RNG resource)
+                    attrs = ({"__prng_key__": "1"}
+                             if p.name == "key" else None)
+                    inputs.append(Variable(f"{node_name}_{p.name}",
+                                           attrs=attrs))
                 elif p.default is None and p.name == "bias" and not no_bias:
                     # optional bias input: created unless no_bias (user
                     # kwarg or the op's own default, e.g. Deconvolution

@@ -224,8 +224,13 @@ class Symbol:
     __hash__ = object.__hash__
 
     # -- evaluation --------------------------------------------------------
-    def _lower(self, arg_names: List[str]):
-        """Build fn(list-of-arrays) -> list-of-output-arrays."""
+    def _lower(self, arg_names: List[str], is_train: bool = True):
+        """Build fn(list-of-arrays) -> list-of-output-arrays.
+
+        ``is_train=False`` lowers the inference graph: train-only
+        stochastic ops (Dropout with mode != "always") become identity
+        — the executor analogue of the reference threading is_train
+        into op runtimes."""
         order = _topo_nodes([o[0] for o in self._outputs])
         pos = {name: i for i, name in enumerate(arg_names)}
 
@@ -238,6 +243,11 @@ class Symbol:
                     vals[id(node)] = [arg_arrays[pos[node.name]]]
                 else:
                     ins = [vals[id(n)][i] for n, i in node.inputs]
+                    if (not is_train and node.op_name == "Dropout"
+                            and node.params.get("mode",
+                                                "training") != "always"):
+                        vals[id(node)] = [ins[0]]
+                        continue
                     op = _reg.get(node.op_name)
                     out = op.fn(*ins, **node.params)
                     vals[id(node)] = list(out) if isinstance(
@@ -245,6 +255,13 @@ class Symbol:
             return [vals[id(n)][i] for n, i in self._outputs]
 
         return fn
+
+    def list_prng_keys(self) -> List[str]:
+        """Names of auto-created PRNG-key variables (marked at symbol
+        composition; the engine RNG resource in the reference)."""
+        order = _topo_nodes([o[0] for o in self._outputs])
+        return [n.name for n in order
+                if n.is_var and n.attrs.get("__prng_key__")]
 
     def infer_shape(self, **kwargs):
         """Infer output shapes from argument shapes (parity:
@@ -258,8 +275,13 @@ class Symbol:
         known = {n: tuple(kwargs[n]) for n in names if n in kwargs}
         if len(known) < len(names):
             known = self._infer_missing_arg_shapes(known)
+        keyset = set(self.list_prng_keys())
         structs = {}
         for name in names:
+            if name in keyset:
+                structs[name] = jax.ShapeDtypeStruct((2,), jnp.uint32)
+                known.setdefault(name, (2,))
+                continue
             if name not in known:
                 raise MXNetError(f"infer_shape: cannot infer shape for "
                                  f"{name!r}; pass it explicitly")
@@ -363,10 +385,13 @@ class Symbol:
         arg_names = self.list_arguments()
         aux_names = self.list_auxiliary_states()
         arg_shapes, _, aux_shapes = self.infer_shape(**shapes)
+        keyset = set(self.list_prng_keys())
         args = {n: NDArray(onp.zeros(s, "float32"))
-                for n, s in zip(arg_names, arg_shapes)}
+                for n, s in zip(arg_names, arg_shapes)
+                if n not in keyset}       # keys: auto-supplied at bind
         grads = {n: NDArray(onp.zeros(s, "float32"))
-                 for n, s in zip(arg_names, arg_shapes)} \
+                 for n, s in zip(arg_names, arg_shapes)
+                 if n not in keyset} \
             if grad_req != "null" else None
         aux = {n: NDArray(onp.zeros(s, "float32"))
                for n, s in zip(aux_names, aux_shapes)}

@@ -320,3 +320,54 @@ def test_trace_twice_is_clean():
     r1 = s1.bind(args={**a1, "data": x}).forward()[0].asnumpy()
     r2 = s2.bind(args={**a2, "data": x}).forward()[0].asnumpy()
     onp.testing.assert_allclose(r1, r2)
+
+
+def test_symbolic_dropout_train_eval_and_keys():
+    from mxnet_tpu.ndarray import NDArray
+    """sym.Dropout binds without an explicit key (auto-supplied RNG,
+    refreshed per training forward), is identity at inference, and
+    mode='always' applies at inference too (parity: the reference
+    threads is_train into op runtimes)."""
+    x = mx.sym.var("x")
+    ones = NDArray(onp.ones((1000,), "float32"))
+    ex = mx.sym.Dropout(x, p=0.5).bind(None, {"x": ones})
+    assert (ex.forward(is_train=False)[0].asnumpy() == 1).all()
+    t1 = ex.forward(is_train=True)[0].asnumpy()
+    t2 = ex.forward(is_train=True)[0].asnumpy()
+    assert 0.35 < (t1 == 0).mean() < 0.65
+    assert (t1 != t2).any()
+    ex2 = mx.sym.Dropout(x, p=0.5, mode="always").bind(
+        None, {"x": ones})
+    assert 0.35 < (ex2.forward(is_train=False)[0].asnumpy()
+                   == 0).mean() < 0.65
+
+
+def test_symbolic_prng_keys_are_structural():
+    """Key handling is graph-derived: a user variable named *_key is
+    still a required argument; keys are excluded from gradients
+    (grad_req='add' works); simple_bind auto-handles dropout keys;
+    MC-dropout (mode='always') draws fresh masks per inference call."""
+    from mxnet_tpu.ndarray import NDArray
+
+    ones = NDArray(onp.ones((1000,), "float32"))
+    x = mx.sym.var("x")
+    ex = mx.sym.Dropout(x, p=0.5).bind(
+        None, {"x": ones},
+        args_grad={"x": NDArray(onp.zeros(1000, "float32"))},
+        grad_req="add")
+    ex.forward(is_train=True)
+    ex.backward(NDArray(onp.ones(1000, "float32")))   # no float0 crash
+
+    ex2 = mx.sym.Dropout(x, p=0.5, mode="always").bind(None,
+                                                       {"x": ones})
+    a = ex2.forward(is_train=False)[0].asnumpy()
+    b = ex2.forward(is_train=False)[0].asnumpy()
+    assert (a != b).any()
+
+    z = mx.sym.FullyConnected(mx.sym.var("att_key"), num_hidden=4)
+    with pytest.raises(mx.base.MXNetError):
+        z.bind(None, {})
+
+    ex3 = mx.sym.Dropout(mx.sym.var("x"), p=0.5).simple_bind(
+        None, x=(8,))
+    assert ex3.forward(is_train=True)[0].shape == (8,)
