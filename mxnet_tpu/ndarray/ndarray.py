@@ -421,6 +421,29 @@ class NDArray:
     def clip(self, a_min=None, a_max=None):
         return invoke("clip", [self], a_min=a_min, a_max=a_max)
 
+    def nansum(self, axis=None, keepdims=False):
+        return invoke("nansum", [self], axis=axis, keepdims=keepdims)
+
+    def nanprod(self, axis=None, keepdims=False):
+        return invoke("nanprod", [self], axis=axis, keepdims=keepdims)
+
+    def round(self): return invoke("round", [self])
+    def rint(self): return invoke("rint", [self])
+    def fix(self): return invoke("fix", [self])
+    def floor(self): return invoke("floor", [self])
+    def ceil(self): return invoke("ceil", [self])
+    def trunc(self): return invoke("trunc", [self])
+    def diag(self, k=0): return invoke("diag", [self], k=k)
+
+    def pad(self, mode="constant", pad_width=None, constant_value=0.0):
+        return invoke("pad", [self], mode=mode,
+                      pad_width=tuple(pad_width or ()),
+                      constant_value=constant_value)
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke("split", [self], num_outputs=num_outputs,
+                      axis=axis, squeeze_axis=squeeze_axis)
+
     def abs(self): return invoke("abs", [self])
     def exp(self): return invoke("exp", [self])
     def log(self): return invoke("log", [self])

@@ -445,7 +445,7 @@ def _rms_norm(x, gamma, *, axis=-1, eps=1e-6):
 
 # -- dropout (parity: src/operator/nn/dropout.cc).  Takes the PRNG key as an
 #    array input — TPU-first: stateless randomness threads through jit.
-@register("Dropout", aliases=("dropout",))
+@register("Dropout", aliases=("dropout",), train_identity=True)
 def _dropout(x, key, *, p=0.5, mode="training", axes=(), **_ignored):
     if p <= 0.0:
         return x

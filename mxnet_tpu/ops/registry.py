@@ -26,14 +26,19 @@ class Operator:
     """One registered op: name + pure jax ``fn(*arrays, **params)``."""
 
     __slots__ = ("name", "fn", "multi_out", "aliases", "doc", "impure",
-                 "_partials", "_jits")
+                 "train_identity", "_partials", "_jits")
 
     def __init__(self, name: str, fn: Callable, multi_out: bool = False,
-                 aliases: Sequence[str] = (), impure: bool = False):
+                 aliases: Sequence[str] = (), impure: bool = False,
+                 train_identity: bool = False):
         self.name = name
         self.fn = fn
         self.multi_out = multi_out
         self.aliases = tuple(aliases)
+        # train_identity: op is identity at inference unless its
+        # ``mode`` param says "always" (Dropout-style) — symbol
+        # executors lower the eval graph from this flag
+        self.train_identity = bool(train_identity)
         self.doc = fn.__doc__
         # impure: fn draws host-side state (e.g. a PRNG key) per call, so
         # caching/jitting it would freeze that state into the executable.
@@ -48,7 +53,7 @@ class Operator:
 
 
 def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False,
-             impure: bool = False):
+             impure: bool = False, train_identity: bool = False):
     """Decorator registering a pure jax function as an op.
 
     The function signature is ``fn(*arrays, **params)`` where arrays are
@@ -60,7 +65,7 @@ def register(name: str, aliases: Sequence[str] = (), multi_out: bool = False,
 
     def deco(fn: Callable):
         op = Operator(name, fn, multi_out=multi_out, aliases=aliases,
-                      impure=impure)
+                      impure=impure, train_identity=train_identity)
         if name in _REGISTRY:
             raise MXNetError(f"op {name!r} registered twice")
         _REGISTRY[name] = op
@@ -126,6 +131,26 @@ class CaptureScope:
 _capture_stack: List[CaptureScope] = []
 
 
+_NP_NDARRAY_CLS = None
+
+
+def _np_flavor_of(nd_inputs):
+    """mx.np.ndarray when any input carries the numpy flavor — op
+    outputs keep it (parity: mx.np functions return mx.np.ndarray,
+    numpy/multiarray.py), else None (base NDArray)."""
+    global _NP_NDARRAY_CLS
+    if _NP_NDARRAY_CLS is None:
+        try:
+            from ..numpy import ndarray as _npnd
+        except ImportError:          # numpy package mid-import
+            return None
+        _NP_NDARRAY_CLS = _npnd
+    for x in nd_inputs:
+        if isinstance(x, _NP_NDARRAY_CLS):
+            return _NP_NDARRAY_CLS
+    return None
+
+
 def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
               record: Optional[bool] = None, jentry=None):
     """Run a pure jax function on NDArrays, wrap outputs, record on tape.
@@ -144,7 +169,8 @@ def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
     out = jentry.run(fn, arrays) if jentry is not None else fn(*arrays)
     multi = multi_out or isinstance(out, (tuple, list))
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
-    nd_outs = [NDArray(o) for o in outs]
+    out_cls = _np_flavor_of(nd_inputs) or NDArray
+    nd_outs = [out_cls(o) for o in outs]
 
     if _capture_stack:
         scope = _capture_stack[-1]

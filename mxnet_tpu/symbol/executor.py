@@ -45,6 +45,11 @@ class Executor:
         # and refreshed on every forward
         self._key_args = sorted(set(symbol.list_prng_keys())
                                 & set(self._arg_names + self._aux_names))
+        self._keyset = set(self._key_args)
+        # keys the USER pinned at bind stay fixed (reproducible masks);
+        # only auto-supplied ones refresh per forward
+        self._auto_keys = {n for n in self._key_args
+                           if n not in args and n not in aux}
         if self._key_args:
             from ..ndarray import NDArray as _ND
             from ..ops.random import next_key
@@ -54,7 +59,7 @@ class Executor:
                     args.setdefault(n, _ND(next_key()))
                 else:
                     aux.setdefault(n, _ND(next_key()))
-            missing -= set(self._key_args)
+            missing -= self._keyset
         if missing:
             raise MXNetError(f"bind: missing arguments {sorted(missing)}")
         self._args: Dict[str, NDArray] = {n: args[n]
@@ -120,10 +125,11 @@ class Executor:
                 self._args[n] = v if isinstance(v, NDArray) else NDArray(v)
             else:
                 raise MXNetError(f"forward: unknown argument {n!r}")
-        # refresh PRNG keys on EVERY forward (fresh masks per call —
-        # also for mode="always" stochastic inference, e.g. MC dropout)
+        # refresh AUTO-supplied PRNG keys on every forward (fresh
+        # masks per call — also for mode="always" MC-dropout
+        # inference); keys pinned at bind or passed this call stay put
         from ..ops.random import next_key
-        for n in getattr(self, "_key_args", ()):
+        for n in getattr(self, "_auto_keys", ()) - set(kwargs):
             tgt = self._args if n in self._args else self._aux
             tgt[n] = NDArray(next_key())
         arrays = [self._args[n]._data for n in self._arg_names] + \
@@ -133,9 +139,8 @@ class Executor:
             # states AND PRNG keys are non-differentiable inputs
             # (parity: FMutateInputs / engine resources get no grad)
             n_args = len(self._arg_names)
-            keyset = set(self._key_args)
             diff_idx = [i for i, n in enumerate(self._arg_names)
-                        if n not in keyset]
+                        if n not in self._keyset]
             self._diff_idx = diff_idx
             aux_arrays = arrays[n_args:]
             full = list(arrays[:n_args])
@@ -168,16 +173,15 @@ class Executor:
         (diff_grads,) = self._vjp(list(cots))
         # re-expand to the full argument list: PRNG keys get zeros
         grads = [jnp.zeros(self._args[n].shape, self._args[n].dtype)
-                 if n in set(self._key_args) else None
+                 if n in self._keyset else None
                  for n in self._arg_names]
         for i, g in zip(self._diff_idx, diff_grads):
             grads[i] = g
         if self._args_grad is not None:
-            keyset = set(self._key_args)
             for name, g in zip(self._arg_names, grads):
                 req = self._grad_req.get(name, "write")
                 if (req == "null" or name not in self._args_grad
-                        or name in keyset):
+                        or name in self._keyset):
                     continue
                 tgt = self._args_grad[name]
                 if req == "add":

@@ -243,12 +243,12 @@ class Symbol:
                     vals[id(node)] = [arg_arrays[pos[node.name]]]
                 else:
                     ins = [vals[id(n)][i] for n, i in node.inputs]
-                    if (not is_train and node.op_name == "Dropout"
+                    op = _reg.get(node.op_name)
+                    if (not is_train and op.train_identity
                             and node.params.get("mode",
                                                 "training") != "always"):
                         vals[id(node)] = [ins[0]]
                         continue
-                    op = _reg.get(node.op_name)
                     out = op.fn(*ins, **node.params)
                     vals[id(node)] = list(out) if isinstance(
                         out, (tuple, list)) else [out]
@@ -352,11 +352,16 @@ class Symbol:
 
     def eval(self, ctx=None, **kwargs):
         from ..ndarray import NDArray
+        from ..ops.random import next_key
         args = self.list_arguments() + self.list_auxiliary_states()
+        keyset = set(self.list_prng_keys())
         fn = self._lower(args)
         arrays = []
         for name in args:
             if name not in kwargs:
+                if name in keyset:   # auto-supplied engine RNG
+                    arrays.append(next_key())
+                    continue
                 raise MXNetError(f"eval: missing argument {name!r}")
             v = kwargs[name]
             arrays.append(v._data if isinstance(v, NDArray)

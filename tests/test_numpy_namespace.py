@@ -189,3 +189,32 @@ def test_fft_and_random_tail():
     assert r.shape == (3, 2)
     a = r.asnumpy()
     assert (a >= 0).all() and (a < 1).all()
+
+
+def test_np_ndarray_method_tail_and_type_flavor():
+    """np-array methods (std/ravel/any/all/trace/...) exist and op
+    outputs PRESERVE the np flavor (parity: mx.np functions return
+    mx.np.ndarray, numpy/multiarray.py)."""
+    a = mx.np.array([[1.0, 2.0], [3.0, 4.0]])
+    assert type(a + a) is mx.np.ndarray
+    assert type(a > 0) is mx.np.ndarray
+    assert type(a.sum()) is mx.np.ndarray
+    assert abs(float(a.std()) - onp.asarray([[1, 2], [3, 4]]).std()) \
+        < 1e-6
+    assert a.ravel().shape == (4,)
+    assert bool((a > 0).all()) and bool((a > 3).any())
+    assert not bool((a > 4).any())
+    assert float(a.trace()) == 5.0
+    assert a.diagonal().asnumpy().tolist() == [1.0, 4.0]
+    assert float(a.ptp()) == 3.0
+    assert isinstance(a.tobytes(), bytes)
+    assert a.round().asnumpy().tolist() == [[1, 2], [3, 4]]
+    # base nd arrays keep the base type
+    c = mx.nd.array([1.0]) + mx.nd.array([1.0])
+    assert type(c).__name__ == "NDArray"
+    # nd method tail
+    b = mx.nd.array([[1.5, -2.5]])
+    assert b.round().asnumpy().tolist() == [[2.0, -2.0]]
+    assert b.floor().asnumpy().tolist() == [[1.0, -3.0]]
+    parts = mx.nd.array(onp.ones((2, 4), "float32")).split(2, axis=1)
+    assert [p.shape for p in parts] == [(2, 2), (2, 2)]

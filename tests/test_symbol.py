@@ -371,3 +371,26 @@ def test_symbolic_prng_keys_are_structural():
     ex3 = mx.sym.Dropout(mx.sym.var("x"), p=0.5).simple_bind(
         None, x=(8,))
     assert ex3.forward(is_train=True)[0].shape == (8,)
+
+
+def test_prng_key_pinning_and_eval():
+    """Pinned keys reproduce masks; auto keys refresh; eval()
+    auto-supplies keys like bind."""
+    import jax
+
+    from mxnet_tpu.ndarray import NDArray
+
+    ones = NDArray(onp.ones((1000,), "float32"))
+    symb = mx.sym.Dropout(mx.sym.var("x"), p=0.5)
+    out = symb.eval(x=ones)[0].asnumpy()
+    assert 0.35 < (out == 0).mean() < 0.65
+    kn = symb.list_prng_keys()[0]
+    pinned = symb.bind(None, {"x": ones,
+                              kn: NDArray(jax.random.PRNGKey(7))})
+    a = pinned.forward(is_train=True)[0].asnumpy()
+    b = pinned.forward(is_train=True)[0].asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    auto = symb.bind(None, {"x": ones})
+    c = auto.forward(is_train=True)[0].asnumpy()
+    d = auto.forward(is_train=True)[0].asnumpy()
+    assert (c != d).any()
