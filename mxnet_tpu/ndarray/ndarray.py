@@ -622,7 +622,28 @@ def save(fname: str, data):
         payload, names = list(data.values()), [f"__dict__:{k}" for k in data]
     else:
         raise MXNetError("save: data must be NDArray, list, or dict")
-    arrays = {n: p.asnumpy() for n, p in zip(names, payload)}
+    arrays = {}
+    dtype_tags = {}
+    for n, p in zip(names, payload):
+        a = p.asnumpy()
+        if a.dtype.kind == "V":
+            # ml_dtypes (bfloat16 etc.): numpy has no native tag, so
+            # store the raw bytes viewed as uint and remember the name
+            dtype_tags[n] = str(p.dtype)
+            a = a.view(onp.uint16 if a.dtype.itemsize == 2
+                       else onp.uint8)
+        arrays[n] = a
+    if dtype_tags:
+        import json as _json
+
+        arrays["__dtypes__"] = onp.frombuffer(
+            _json.dumps(dtype_tags).encode(), dtype=onp.uint8)
+    if not payload:
+        # disambiguate empty containers (an npz with no payload keys
+        # would otherwise load as {})
+        kind = "list" if isinstance(data, (list, tuple)) else "dict"
+        arrays["__empty__"] = onp.frombuffer(kind.encode(),
+                                             dtype=onp.uint8)
     # write to the exact filename (np.savez appends .npz to bare paths;
     # the reference's NDArray::Save writes the given name verbatim)
     with open(fname, "wb") as f:
@@ -636,13 +657,32 @@ def load(fname: str):
             fname = fname + ".npz"
     with onp.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
+        dtype_tags = {}
+        if "__empty__" in z:
+            kind = bytes(z["__empty__"]).decode()
+            return [] if kind == "list" else {}
+        if "__dtypes__" in z:
+            import json as _json
+
+            dtype_tags = _json.loads(bytes(z["__dtypes__"]).decode())
+            keys = [k for k in keys if k != "__dtypes__"]
+
+        def restore(k):
+            a = z[k]
+            tag = dtype_tags.get(k)
+            if tag is not None:
+                import ml_dtypes  # noqa: F401 (registers dtype names)
+
+                a = a.view(onp.dtype(tag))
+            return NDArray(a)
+
         if keys and keys[0].startswith("__single__"):
-            return NDArray(z[keys[0]])
+            return restore(keys[0])
         if keys and keys[0].startswith("__list__"):
             order = sorted(keys, key=lambda k: int(k.split(":", 1)[1]))
-            return [NDArray(z[k]) for k in order]
+            return [restore(k) for k in order]
         out = {}
         for k in keys:
             name = k.split(":", 1)[1] if ":" in k else k
-            out[name] = NDArray(z[k])
+            out[name] = restore(k)
         return out
