@@ -88,6 +88,13 @@ def nested_flatten(obj):
 # Block
 # --------------------------------------------------------------------------
 
+def _walk_blocks(root):
+    """Yield every block in the tree (shared blocks once per slot)."""
+    yield root
+    for child in root._children.values():
+        yield from _walk_blocks(child)
+
+
 class Block:
     """Base class for all layers/models (parity: gluon/block.py:201)."""
 
@@ -234,13 +241,74 @@ class Block:
         raise NotImplementedError
 
     def summary(self, *inputs):
-        params = self.collect_params()
-        total = sum(int(onp.prod(p.shape)) for p in params.values()
-                    if p.shape is not None and all(s > 0 for s in p.shape))
-        lines = [f"{type(self).__name__}: {len(params)} parameters, "
-                 f"{total} elements"]
-        for k, p in params.items():
-            lines.append(f"  {k}: {p.shape} {p.dtype}")
+        """Per-layer summary table via forward hooks (parity:
+        block.py summary — layer type, output shape, param count,
+        trainable/shared totals), printed for one forward pass over
+        ``inputs``."""
+        rows = []
+        handles = []
+        seen_params = set()
+
+        def make_hook(blk, path):
+            def hook(_blk, _in, out):
+                first = out[0] if isinstance(out, (tuple, list)) else out
+                shape = tuple(getattr(first, "shape", ()) or ())
+                n_params = 0
+                shared = 0
+                for p in blk._reg_params.values():
+                    n = (int(onp.prod(p.shape))
+                         if p.shape is not None else 0)
+                    if id(p) in seen_params:
+                        shared += n
+                    else:
+                        seen_params.add(id(p))
+                        n_params += n
+                rows.append((path or type(blk).__name__,
+                             type(blk).__name__, shape, n_params,
+                             shared))
+            return hook
+
+        visited = set()
+
+        def attach(blk, path):
+            if id(blk) not in visited:   # shared blocks hook once
+                visited.add(id(blk))
+                handles.append(blk.register_forward_hook(
+                    make_hook(blk, path)))
+            for name, child in blk._children.items():
+                attach(child, f"{path}.{name}" if path else name)
+
+        attach(self, "")
+        # the cached-op fast path bypasses child __call__ (and so the
+        # hooks): run the summary forward with hybridization suspended
+        hybrid_state = [(b, b._active) for b in
+                        {id(b): b for b in _walk_blocks(self)}.values()
+                        if hasattr(b, "_active")]
+        try:
+            for b, _ in hybrid_state:
+                b._active = False
+            with ag.pause(train_mode=False):
+                self(*inputs)
+        finally:
+            for b, was in hybrid_state:
+                b._active = was
+            for h in handles:
+                h.detach()
+
+        w = 34
+        header = (f"{'Layer (type)':<{w}}{'Output Shape':<20}"
+                  f"{'Param #':<10}{'Shared #':<10}")
+        sep = "-" * len(header)
+        lines = [sep, header, "=" * len(header)]
+        total = tot_shared = 0
+        for path, cls, shape, n, sh in rows:
+            label = f"{path} ({cls})"
+            lines.append(f"{label:<{w}}{str(shape):<20}{n:<10}{sh:<10}")
+            total += n
+            tot_shared += sh
+        lines += ["=" * len(header),
+                  f"Total params: {total}",
+                  f"Shared params: {tot_shared}", sep]
         print("\n".join(lines))
 
     def __repr__(self):

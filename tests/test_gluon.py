@@ -531,3 +531,24 @@ def test_instance_norm_channels_last_axis():
     ref = first(nd.NDArray(onp.transpose(x, (0, 3, 1, 2)))).asnumpy()
     onp.testing.assert_allclose(out, onp.transpose(ref, (0, 2, 3, 1)),
                                 rtol=1e-4, atol=1e-5)
+
+
+def test_block_summary_table(capsys):
+    """summary() prints a per-layer table with output shapes, param
+    counts and shared-param accounting (parity: block.py summary)."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+    net.initialize()
+    net.summary(nd.NDArray(onp.ones((2, 4), "float32")))
+    out = capsys.readouterr().out
+    assert "Layer (type)" in out and "Total params: 58" in out
+    assert "(2, 8)" in out and "(2, 2)" in out
+
+    shared = nn.Dense(4, in_units=4)
+    shared.initialize()
+    seq = nn.HybridSequential()
+    seq.add(shared, shared)
+    seq.summary(nd.NDArray(onp.ones((1, 4), "float32")))
+    out = capsys.readouterr().out
+    assert "Total params: 20" in out
+    assert "Shared params: 20" in out
