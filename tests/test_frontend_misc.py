@@ -166,3 +166,27 @@ def test_nd_image_namespace_and_aliases():
                             "row_sparse")
     assert type(sp).__name__ == "RowSparseNDArray"
     assert mx.nd.op.relu is mx.nd.relu
+
+
+def test_parse_log_tool():
+    """tools/parse_log.py extracts reference-style and example-style
+    metric lines into a table (parity: tools/parse_log.py)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "parse_log", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "parse_log.py"))
+    m = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(m)
+    lines = ["INFO Epoch[0] Train-accuracy=0.5\n",
+             "INFO Epoch[0] Validation-accuracy=0.45\n",
+             "INFO Epoch[0] Time cost=12.3\n",
+             "epoch 1: train-accuracy 0.61 (50 img/s)\n"]
+    rows, cols = m.parse(lines, ["accuracy"])
+    assert rows[0]["train-accuracy"] == 0.5
+    assert rows[0]["val-accuracy"] == 0.45
+    assert rows[0]["time"] == 12.3
+    assert rows[1]["train-accuracy"] == 0.61
+    md = m.render_markdown(rows, cols)
+    assert md.startswith("| epoch |") and "0.61" in md

@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Run one test repeatedly to estimate flakiness (parity:
+tools/flakiness_checker.py — the reference reruns a named pytest
+test N times with fresh seeds and reports the failure rate)."""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Re-run a test to check for flakiness")
+    ap.add_argument("test", help="pytest node id, e.g. "
+                    "tests/test_gluon.py::test_dense")
+    ap.add_argument("-n", "--num-trials", type=int, default=20)
+    ap.add_argument("-s", "--seed", type=int, default=None,
+                    help="fixed MXNET_TEST_SEED (default: vary)")
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args(argv)
+
+    failures = 0
+    for trial in range(args.num_trials):
+        env = dict(os.environ)
+        env["MXNET_TEST_SEED"] = str(
+            args.seed if args.seed is not None else trial * 9973 + 7)
+        proc = subprocess.run(
+            [sys.executable, "-m", "pytest", args.test, "-x", "-q"],
+            capture_output=not args.verbose, env=env)
+        status = "PASS" if proc.returncode == 0 else "FAIL"
+        if proc.returncode != 0:
+            failures += 1
+        print(f"trial {trial + 1}/{args.num_trials} "
+              f"(seed {env['MXNET_TEST_SEED']}): {status}", flush=True)
+    rate = failures / args.num_trials
+    print(f"\n{failures}/{args.num_trials} failures "
+          f"({rate:.0%} flaky)")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+#!/usr/bin/env python
+"""Parse a training log into a markdown table (parity:
+tools/parse_log.py — extracts per-epoch train/validation metrics and
+epoch time from `Epoch[N] ...metric=value` lines; also understands
+this repo's example output style `epoch N: train-metric value`)."""
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+
+def parse(lines, metric_names):
+    pats = []
+    for s in metric_names:
+        pats.append(("train-" + s, re.compile(
+            r".*Epoch\[(\d+)\].*Train-" + s + r".*=([.\d]+)")))
+        pats.append(("val-" + s, re.compile(
+            r".*Epoch\[(\d+)\].*Validation-" + s + r".*=([.\d]+)")))
+        # repo example style: "epoch 3: train-accuracy 0.91 ..."
+        pats.append(("train-" + s, re.compile(
+            r".*epoch (\d+):.*train-" + s + r"\s+([.\d]+)")))
+        pats.append(("val-" + s, re.compile(
+            r".*epoch (\d+):.*val-" + s + r"\s+([.\d]+)")))
+    pats.append(("time", re.compile(
+        r".*Epoch\[(\d+)\].*Time.*=([.\d]+)")))
+
+    rows: dict = {}
+    cols: list = []
+    for line in lines:
+        for name, pat in pats:
+            m = pat.match(line)
+            if m:
+                epoch, val = int(m.group(1)), float(m.group(2))
+                rows.setdefault(epoch, {})[name] = val
+                if name not in cols:
+                    cols.append(name)
+    return rows, cols
+
+
+def render_markdown(rows, cols):
+    out = ["| epoch | " + " | ".join(cols) + " |",
+           "| --- |" + " --- |" * len(cols)]
+    for epoch in sorted(rows):
+        cells = [f"{rows[epoch].get(c, '')}" for c in cols]
+        out.append(f"| {epoch} | " + " | ".join(cells) + " |")
+    return "\n".join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Parse a training log into a table")
+    ap.add_argument("logfile", nargs=1)
+    ap.add_argument("--format", default="markdown",
+                    choices=["markdown", "none"])
+    ap.add_argument("--metric-names", nargs="+", default=["accuracy"])
+    args = ap.parse_args(argv)
+    with open(args.logfile[0]) as f:
+        rows, cols = parse(f.readlines(), args.metric_names)
+    if not rows:
+        print("no metric lines found", file=sys.stderr)
+        return 1
+    if args.format == "markdown":
+        print(render_markdown(rows, cols))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
