@@ -27,8 +27,11 @@ import pytest
 @pytest.fixture(autouse=True)
 def _seed_everything():
     """Deterministic seeds per test (parity: with_seed() decorator,
-    tests/python/unittest/common.py:163)."""
-    _onp.random.seed(0)
+    tests/python/unittest/common.py:163; MXNET_TEST_SEED overrides,
+    which is what tools/flakiness_checker.py varies)."""
+    import os
+    seed = int(os.environ.get("MXNET_TEST_SEED", "0"))
+    _onp.random.seed(seed)
     import mxnet_tpu as mx
-    mx.random.seed(0)
+    mx.random.seed(seed)
     yield

@@ -185,6 +185,13 @@ def test_parse_log_tool():
              "epoch 1: train-accuracy 0.61 (50 img/s)\n"]
     rows, cols = m.parse(lines, ["accuracy"])
     assert rows[0]["train-accuracy"] == 0.5
+    # multi-metric lines: value captured for the NAMED metric, not the
+    # last number on the line; metacharacter names don't crash
+    r2, _ = m.parse(["INFO Epoch[0] Train-accuracy=0.5 lr=0.001\n"],
+                    ["accuracy"])
+    assert r2[0]["train-accuracy"] == 0.5
+    r3, _ = m.parse([], ["top_k(5"])
+    assert r3 == {}
     assert rows[0]["val-accuracy"] == 0.45
     assert rows[0]["time"] == 12.3
     assert rows[1]["train-accuracy"] == 0.61

@@ -85,7 +85,10 @@ def check_backend(timeout_s=60):
 def main():
     check_python()
     check_os()
-    check_framework()
+    try:
+        check_framework()
+    except Exception as e:      # keep going: backend info still prints
+        print("framework import FAILED:", repr(e))
     check_backend()
 
 

@@ -20,6 +20,8 @@ def main(argv=None):
                     help="fixed MXNET_TEST_SEED (default: vary)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args(argv)
+    if args.num_trials < 1:
+        ap.error("--num-trials must be >= 1")
 
     failures = 0
     for trial in range(args.num_trials):

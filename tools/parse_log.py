@@ -12,18 +12,22 @@ import sys
 
 def parse(lines, metric_names):
     pats = []
-    for s in metric_names:
-        pats.append(("train-" + s, re.compile(
-            r".*Epoch\[(\d+)\].*Train-" + s + r".*=([.\d]+)")))
-        pats.append(("val-" + s, re.compile(
-            r".*Epoch\[(\d+)\].*Validation-" + s + r".*=([.\d]+)")))
+    for raw in metric_names:
+        s = re.escape(raw)
+        # the value is captured DIRECTLY after the metric name — a
+        # greedy gap there would grab the last number on multi-metric
+        # lines (Speedometer tab-joins several name=value pairs)
+        pats.append(("train-" + raw, re.compile(
+            r".*Epoch\[(\d+)\].*?Train-" + s + r"=([.\d]+)")))
+        pats.append(("val-" + raw, re.compile(
+            r".*Epoch\[(\d+)\].*?Validation-" + s + r"=([.\d]+)")))
         # repo example style: "epoch 3: train-accuracy 0.91 ..."
-        pats.append(("train-" + s, re.compile(
-            r".*epoch (\d+):.*train-" + s + r"\s+([.\d]+)")))
-        pats.append(("val-" + s, re.compile(
-            r".*epoch (\d+):.*val-" + s + r"\s+([.\d]+)")))
+        pats.append(("train-" + raw, re.compile(
+            r".*epoch (\d+):.*?train-" + s + r"\s+([.\d]+)")))
+        pats.append(("val-" + raw, re.compile(
+            r".*epoch (\d+):.*?val-" + s + r"\s+([.\d]+)")))
     pats.append(("time", re.compile(
-        r".*Epoch\[(\d+)\].*Time.*=([.\d]+)")))
+        r".*Epoch\[(\d+)\].*?Time[^=]*=([.\d]+)")))
 
     rows: dict = {}
     cols: list = []
