@@ -421,45 +421,5 @@ class RandomRotation(Block):
                        self._zoom_in, self._zoom_out)
 
 
-def _rotate(x, degrees, zoom_in=False, zoom_out=False):
-    """Bilinear rotation about the image center (HWC or NHWC).
-    zoom_in scales so no fill pixels remain visible; zoom_out scales so
-    the whole source fits the canvas (parity: image.imrotate)."""
-    import math
+from ....image.image import _rotate  # noqa: E402 — canonical home
 
-    rad = math.radians(degrees)
-    c, s = math.cos(rad), math.sin(rad)
-    if zoom_in and zoom_out:
-        raise ValueError("zoom_in and zoom_out are mutually exclusive")
-    k = abs(c) + abs(s)
-    zoom = (1.0 / k) if zoom_in else (k if zoom_out else 1.0)
-    c, s = c * zoom, s * zoom
-    H, W = x.shape[-3], x.shape[-2]
-
-    def fn(a):
-        yy = jnp.arange(H, dtype=jnp.float32) - (H - 1) / 2.0
-        xx = jnp.arange(W, dtype=jnp.float32) - (W - 1) / 2.0
-        gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
-        # inverse-rotate output coords into source space
-        sx = c * gx + s * gy + (W - 1) / 2.0
-        sy = -s * gx + c * gy + (H - 1) / 2.0
-        x0 = jnp.floor(sx); y0 = jnp.floor(sy)
-        wx = sx - x0; wy = sy - y0
-
-        af = a.astype(jnp.float32)
-
-        def samplef(yi, xi):
-            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
-            yi = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
-            xi = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
-            v = af[..., yi, xi, :]
-            return v * inb[..., None]
-
-        out = (samplef(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
-               + samplef(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
-               + samplef(y0 + 1, x0) * (wy * (1 - wx))[..., None]
-               + samplef(y0 + 1, x0 + 1) * (wy * wx)[..., None])
-        return out.astype(a.dtype) if jnp.issubdtype(
-            a.dtype, jnp.floating) else jnp.clip(out, 0, 255).astype(a.dtype)
-
-    return apply_jax(fn, [x])

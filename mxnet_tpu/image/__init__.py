@@ -5,7 +5,8 @@ ImageIter; detection.py DetAugmenter family + ImageDetIter) over
 src/operator/image/ and src/io/image_det_aug_default.cc.  cv2 is
 optional; PIL/numpy fallbacks keep it working in minimal environments.
 """
-from .image import (imread, imdecode, imresize, resize_short, fixed_crop,
+from .image import (imread, imdecode, imresize, imrotate, copyMakeBorder,
+                    resize_short, fixed_crop,
                     center_crop, random_crop, color_normalize, scale_down,
                     random_size_crop, ImageIter, CreateAugmenter, Augmenter,
                     ResizeAug, ForceResizeAug, CenterCropAug, RandomCropAug,
@@ -31,4 +32,4 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
            "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
            "CreateDetAugmenter", "CreateMultiRandCropAugmenter",
-           "ImageDetIter"]
+           "ImageDetIter", "imrotate", "copyMakeBorder"]

@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..io.io import DataIter, DataBatch, DataDesc
+from ..ops.registry import apply_jax
 
 __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "ImageIter",
@@ -544,3 +545,91 @@ class ImageIter(DataIter):
 
     def iter_next(self):
         return self.cur < len(self._records)
+
+
+def _rotate(x, degrees, zoom_in=False, zoom_out=False):
+    """Bilinear rotation about the image center (HWC or NHWC).
+    zoom_in scales so no fill pixels remain visible; zoom_out scales so
+    the whole source fits the canvas (parity: image.imrotate)."""
+    import math
+
+    rad = math.radians(degrees)
+    c, s = math.cos(rad), math.sin(rad)
+    if zoom_in and zoom_out:
+        raise ValueError("zoom_in and zoom_out are mutually exclusive")
+    k = abs(c) + abs(s)
+    zoom = (1.0 / k) if zoom_in else (k if zoom_out else 1.0)
+    c, s = c * zoom, s * zoom
+    H, W = x.shape[-3], x.shape[-2]
+
+    def fn(a):
+        yy = jnp.arange(H, dtype=jnp.float32) - (H - 1) / 2.0
+        xx = jnp.arange(W, dtype=jnp.float32) - (W - 1) / 2.0
+        gy, gx = jnp.meshgrid(yy, xx, indexing="ij")
+        # inverse-rotate output coords into source space
+        sx = c * gx + s * gy + (W - 1) / 2.0
+        sy = -s * gx + c * gy + (H - 1) / 2.0
+        x0 = jnp.floor(sx); y0 = jnp.floor(sy)
+        wx = sx - x0; wy = sy - y0
+
+        af = a.astype(jnp.float32)
+
+        def samplef(yi, xi):
+            inb = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+            yi = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+            v = af[..., yi, xi, :]
+            return v * inb[..., None]
+
+        out = (samplef(y0, x0) * ((1 - wy) * (1 - wx))[..., None]
+               + samplef(y0, x0 + 1) * ((1 - wy) * wx)[..., None]
+               + samplef(y0 + 1, x0) * (wy * (1 - wx))[..., None]
+               + samplef(y0 + 1, x0 + 1) * (wy * wx)[..., None])
+        return out.astype(a.dtype) if jnp.issubdtype(
+            a.dtype, jnp.floating) else jnp.clip(out, 0, 255).astype(a.dtype)
+
+    return apply_jax(fn, [x])
+
+
+def imrotate(src, rotation_degrees, zoom_in=False, zoom_out=False):
+    """Rotate an HWC/NHWC image by ``rotation_degrees`` about its
+    center (parity: image.imrotate — bilinear sampling; zoom_in crops
+    so no fill pixels show, zoom_out fits the whole source)."""
+    return _rotate(src, rotation_degrees, zoom_in, zoom_out)
+
+
+def copyMakeBorder(src, top, bot, left, right, type=0, value=0.0,  # noqa: A002
+                   values=None):
+    """Pad the H/W axes of an HWC (or NHWC) image with a constant
+    border (parity: image.copyMakeBorder / cv2 signature).  Only
+    ``type=0`` (BORDER_CONSTANT) is implemented; ``values`` gives a
+    per-channel fill color."""
+    from ..ops.registry import apply_jax
+    import jax.numpy as jnp
+
+    if type != 0:
+        raise NotImplementedError(
+            "copyMakeBorder: only type=0 (BORDER_CONSTANT) is "
+            "implemented")
+
+    def fn(a):
+        h_ax, w_ax = a.ndim - 3, a.ndim - 2
+        pads = [(0, 0)] * a.ndim
+        pads[h_ax] = (int(top), int(bot))
+        pads[w_ax] = (int(left), int(right))
+        if values is not None:
+            # per-channel fill: pad with zeros, then overwrite the
+            # border region channel-wise
+            out = jnp.pad(a, pads)
+            fill = jnp.asarray(values, a.dtype).reshape(
+                (1,) * (a.ndim - 1) + (-1,))
+            mask = jnp.zeros(out.shape[h_ax:w_ax + 1], bool)
+            mask = mask.at[int(top):mask.shape[0] - int(bot),
+                           int(left):mask.shape[1] - int(right)].set(
+                               True)
+            mask = mask.reshape(
+                (1,) * (a.ndim - 3) + mask.shape + (1,))
+            return jnp.where(mask, out, fill.astype(a.dtype))
+        return jnp.pad(a, pads, constant_values=value)
+
+    return apply_jax(fn, [src])

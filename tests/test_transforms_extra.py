@@ -133,3 +133,32 @@ def test_legacy_image_augmenter_family():
     crop, box = I.random_size_crop(img, (16, 16), (0.3, 1.0),
                                    (0.75, 1.333))
     assert crop.shape == (16, 16, 3)
+
+
+def test_imrotate_and_copymakeborder():
+    """mx.image.imrotate / copyMakeBorder (parity: image.py imrotate,
+    copyMakeBorder)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import NDArray
+
+    pat = onp.zeros((5, 5, 1), "float32")
+    pat[0, :, 0] = 1.0                      # top row lit
+    r = mx.image.imrotate(NDArray(pat), 180).asnumpy()
+    assert r.shape == (5, 5, 1)
+    assert r[-1, :, 0].sum() > r[0, :, 0].sum()   # row moved to bottom
+    img = NDArray(onp.ones((4, 6, 3), "float32"))
+    b = mx.image.copyMakeBorder(img, 1, 2, 3, 4, 0, 9.0)  # positional
+    assert b.shape == (7, 13, 3)
+    a = b.asnumpy()
+    assert a[0, 0, 0] == 9.0 and a[3, 5, 0] == 1.0
+    # per-channel fill + NHWC batch pads H/W, not N
+    b2 = mx.image.copyMakeBorder(img, 1, 1, 1, 1,
+                                 values=(1.0, 2.0, 3.0)).asnumpy()
+    assert b2[0, 0].tolist() == [1.0, 2.0, 3.0]
+    assert b2[2, 2].tolist() == [1.0, 1.0, 1.0]
+    bb = mx.image.copyMakeBorder(
+        NDArray(onp.ones((2, 4, 6, 3), "float32")), 1, 1, 2, 2,
+        value=5.0)
+    assert bb.shape == (2, 6, 10, 3)
+    with pytest.raises(NotImplementedError):
+        mx.image.copyMakeBorder(img, 1, 1, 1, 1, 1)
