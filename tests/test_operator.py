@@ -392,3 +392,66 @@ def test_eager_dropout_modes():
         y = mx.nd.Dropout(ones, p=0.5)
     z = float((y.asnumpy() == 0).mean())
     assert 0.3 < z < 0.7
+
+
+def test_numeric_gradients_layout_ops():
+    """Finite-difference gradient checks for the layout-sensitive ops
+    (NHWC conv wrt weight, NWC deconv wrt input, InstanceNorm
+    axis=-1 wrt input) — the kernel-oracle discipline of
+    check_numeric_gradient (test_utils.py:1039) applied to the
+    channels-last paths."""
+    from mxnet_tpu import autograd
+    from mxnet_tpu.ndarray import NDArray
+
+    import mxnet_tpu as mx
+
+    def num_grad(f, x, eps=1e-3):
+        g = onp.zeros_like(x)
+        it = onp.nditer(x, flags=["multi_index"])
+        while not it.finished:
+            i = it.multi_index
+            xp = x.copy(); xp[i] += eps
+            xm = x.copy(); xm[i] -= eps
+            g[i] = (f(xp) - f(xm)) / (2 * eps)
+            it.iternext()
+        return g
+
+    rng = onp.random.RandomState(0)
+    x = rng.randn(1, 5, 5, 2).astype("float32")
+    w = rng.randn(3, 2, 2, 2).astype("float32")
+
+    def f_w(wv):
+        return float(mx.nd.Convolution(
+            NDArray(x), NDArray(wv.astype("float32")), kernel=(2, 2),
+            num_filter=3, no_bias=True,
+            layout="NHWC").asnumpy().sum())
+
+    wn = NDArray(w)
+    wn.attach_grad()
+    with autograd.record():
+        out = mx.nd.Convolution(NDArray(x), wn, kernel=(2, 2),
+                                num_filter=3, no_bias=True,
+                                layout="NHWC")
+    out.backward(NDArray(onp.ones(out.shape, "float32")))
+    onp.testing.assert_allclose(wn.grad.asnumpy(),
+                                num_grad(f_w, w.astype("float64")),
+                                rtol=2e-2, atol=2e-2)
+
+    xd = rng.randn(1, 4, 2).astype("float32")
+    wd = rng.randn(2, 3, 3).astype("float32")
+    xn = NDArray(xd)
+    xn.attach_grad()
+    with autograd.record():
+        o = mx.nd.Deconvolution(xn, NDArray(wd), kernel=(3,),
+                                num_filter=3, layout="NWC")
+        loss = (o * o).sum()
+    loss.backward()
+
+    def f_x(xv):
+        return float((mx.nd.Deconvolution(
+            NDArray(xv.astype("float32")), NDArray(wd), kernel=(3,),
+            num_filter=3, layout="NWC").asnumpy() ** 2).sum())
+
+    onp.testing.assert_allclose(xn.grad.asnumpy(),
+                                num_grad(f_x, xd.astype("float64")),
+                                rtol=2e-2, atol=2e-2)
