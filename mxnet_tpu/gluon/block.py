@@ -201,6 +201,15 @@ class Block:
                         dtype_source="current"):
         from ..ndarray import load as nd_load
         loaded = nd_load(filename)
+        if not isinstance(loaded, dict):
+            raise MXNetError(
+                f"{filename} holds an unnamed array list, not a "
+                "name->param dict; load_parameters needs named entries")
+        # old-style checkpoints (mx.model / HybridBlock.export) prefix
+        # names with "arg:"/"aux:" (reference gluon/block.py load_dict)
+        if any(k.startswith(("arg:", "aux:")) for k in loaded):
+            loaded = {k.split(":", 1)[1] if k.startswith(("arg:", "aux:"))
+                      else k: v for k, v in loaded.items()}
         params = self.collect_params()
         for name, p in params.items():
             if name in loaded:

@@ -612,8 +612,21 @@ def to_dlpack_for_write(arr: "NDArray"):
 
 
 # -- serialization (parity: NDArray::Save/Load, src/ndarray/ndarray.cc:1679;
-#    MXNDArraySave/Load C API).  Format: numpy .npz with a manifest key.
-def save(fname: str, data):
+#    MXNDArraySave/Load C API).  Two codecs:
+#      * "npz"   (default) — numpy .npz with a manifest key
+#      * "mxnet" — the reference's binary wire format (ndarray.cc:1679),
+#        byte-compatible with checkpoints produced by actual MXNet;
+#        see legacy_serialization.py.  load() auto-detects by magic.
+def save(fname: str, data, format: str = None):
+    if format is None:
+        import os
+        format = os.environ.get("MXNET_NDARRAY_SAVE_FORMAT", "npz")
+    if format in ("mxnet", "binary", "params"):
+        from .legacy_serialization import save_mxnet
+        return save_mxnet(fname, data)
+    if format != "npz":
+        raise MXNetError(f"save: unknown format {format!r} "
+                         "(expected 'npz' or 'mxnet')")
     if isinstance(data, NDArray):
         payload, names = [data], ["__single__:0"]
     elif isinstance(data, (list, tuple)):
@@ -651,10 +664,16 @@ def save(fname: str, data):
 
 
 def load(fname: str):
+    import os
     if not fname.endswith(".npz"):
-        import os
         if os.path.exists(fname + ".npz") and not os.path.exists(fname):
             fname = fname + ".npz"
+    if os.path.exists(fname):
+        with open(fname, "rb") as f:
+            head = f.read(8)
+        from .legacy_serialization import is_mxnet_format, load_mxnet
+        if is_mxnet_format(head):
+            return load_mxnet(fname)
     with onp.load(fname, allow_pickle=False) as z:
         keys = list(z.keys())
         dtype_tags = {}

@@ -1,0 +1,208 @@
+"""Reference .params binary format compat (NDArray::Save/Load,
+src/ndarray/ndarray.cc:1679,1802; list format :1925).
+
+The golden blob below is constructed *by hand* with struct.pack from
+the format spec — independent of the codec under test — so these tests
+pin the byte layout, not just a round trip.
+"""
+import struct
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import legacy_serialization as ls
+from mxnet_tpu.ndarray.sparse import (RowSparseNDArray, CSRNDArray,
+                                      row_sparse_array, csr_matrix)
+
+
+def _hand_dense_record(a: onp.ndarray) -> bytes:
+    """Byte-for-byte V2 dense record per ndarray.cc:1679 Save()."""
+    out = b""
+    out += struct.pack("<I", 0xF993FAC9)          # V2 magic
+    out += struct.pack("<i", 0)                   # kDefaultStorage
+    out += struct.pack("<i", a.ndim)              # TShape ndim
+    for d in a.shape:
+        out += struct.pack("<q", d)               # int64 dims
+    out += struct.pack("<i", 1)                   # ctx dev_type kCPU
+    out += struct.pack("<i", 0)                   # ctx dev_id
+    flag = {"float32": 0, "float64": 1, "int32": 4, "uint8": 3,
+            "int64": 6}[a.dtype.name]
+    out += struct.pack("<i", flag)                # mshadow type flag
+    out += a.astype(a.dtype.newbyteorder("<")).tobytes()
+    return out
+
+
+def _hand_file(arrays, names) -> bytes:
+    out = struct.pack("<Q", 0x112) + struct.pack("<Q", 0)
+    out += struct.pack("<Q", len(arrays))
+    for a in arrays:
+        out += _hand_dense_record(a)
+    out += struct.pack("<Q", len(names))
+    for n in names:
+        b = n.encode()
+        out += struct.pack("<Q", len(b)) + b
+    return out
+
+
+class TestByteLevelGolden:
+    def test_writer_matches_hand_built_bytes(self):
+        a = onp.arange(12, dtype=onp.float32).reshape(3, 4)
+        b = onp.array([1, 2, 3], dtype=onp.int32)
+        hand = _hand_file([a, b], ["w", "b"])
+        ours = ls.encode_list([mx.nd.array(a), mx.nd.array(b)], ["w", "b"])
+        assert ours == hand
+
+    def test_reader_parses_hand_built_bytes(self, tmp_path):
+        a = onp.random.RandomState(0).randn(2, 5).astype(onp.float32)
+        f = tmp_path / "golden.params"
+        f.write_bytes(_hand_file([a], ["conv0_weight"]))
+        loaded = mx.nd.load(str(f))
+        assert list(loaded) == ["conv0_weight"]
+        onp.testing.assert_array_equal(loaded["conv0_weight"].asnumpy(), a)
+
+    def test_unnamed_list_returns_list(self, tmp_path):
+        a = onp.ones((2, 2), onp.float32)
+        f = tmp_path / "g.params"
+        f.write_bytes(_hand_file([a, a * 2], []))
+        loaded = mx.nd.load(str(f))
+        assert isinstance(loaded, list) and len(loaded) == 2
+        onp.testing.assert_array_equal(loaded[1].asnumpy(), a * 2)
+
+
+class TestRoundTrip:
+    @pytest.mark.parametrize("dtype", ["float32", "float16", "uint8",
+                                       "int8", "int32", "bool"])
+    def test_dtypes(self, tmp_path, dtype):
+        rng = onp.random.RandomState(1)
+        a = (rng.randn(3, 4) * 5).astype(dtype)
+        f = str(tmp_path / "x.params")
+        mx.nd.save(f, {"p": mx.nd.array(a)}, format="mxnet")
+        back = mx.nd.load(f)["p"].asnumpy()
+        assert back.dtype == a.dtype
+        onp.testing.assert_array_equal(back, a)
+
+    @pytest.mark.parametrize("dtype", ["float64", "int64", "uint64",
+                                       "int16", "uint16", "uint32"])
+    def test_wide_dtypes_codec_level(self, tmp_path, dtype):
+        """64-bit dtypes: NDArray narrows them under jax's default
+        x64-off config, so pin the codec itself (a reference-written
+        float64 checkpoint must decode losslessly to numpy)."""
+        rng = onp.random.RandomState(2)
+        a = onp.abs(rng.randn(2, 3) * 100).astype(dtype)
+        blob = ls.encode_list([a], ["p"])
+        data, names = ls.decode_list(blob)
+        got = data[0].asnumpy()
+        # decode materializes through NDArray, which narrows 64-bit
+        # types under jax's x64-off default; values must survive to
+        # float32 precision (ints here fit exactly)
+        onp.testing.assert_allclose(got.astype("float64"),
+                                    a.astype("float64"),
+                                    rtol=1e-6, atol=1e-4)
+
+    def test_bfloat16(self, tmp_path):
+        import ml_dtypes
+        a = onp.arange(6, dtype=onp.float32).reshape(2, 3).astype(
+            ml_dtypes.bfloat16)
+        f = str(tmp_path / "bf.params")
+        mx.nd.save(f, [mx.nd.array(a)], format="mxnet")
+        back = mx.nd.load(f)[0].asnumpy()
+        assert back.dtype == a.dtype
+        onp.testing.assert_array_equal(back.view(onp.uint16),
+                                       a.view(onp.uint16))
+
+    def test_scalar_v3(self, tmp_path):
+        f = str(tmp_path / "s.params")
+        mx.nd.save(f, [mx.nd.array(onp.float32(3.5))], format="mxnet")
+        raw = open(f, "rb").read()
+        # record magic must be V3 (np shape semantics) for 0-dim
+        assert struct.unpack("<I", raw[24:28])[0] == 0xF993FACA
+        assert float(mx.nd.load(f)[0].asnumpy()) == 3.5
+
+    def test_row_sparse(self, tmp_path):
+        rsp = row_sparse_array(
+            (onp.array([[1., 2.], [3., 4.]], onp.float32),
+             onp.array([1, 3])), shape=(5, 2))
+        f = str(tmp_path / "rs.params")
+        mx.nd.save(f, {"g": rsp}, format="mxnet")
+        back = mx.nd.load(f)["g"]
+        assert isinstance(back, RowSparseNDArray)
+        onp.testing.assert_array_equal(back.todense().asnumpy(),
+                                       rsp.todense().asnumpy())
+
+    def test_csr(self, tmp_path):
+        dense = onp.zeros((4, 6), onp.float32)
+        dense[0, 1] = 1; dense[2, 3] = 7; dense[3, 5] = -2
+        csr = csr_matrix(dense)
+        f = str(tmp_path / "csr.params")
+        mx.nd.save(f, [csr], format="mxnet")
+        back = mx.nd.load(f)[0]
+        assert isinstance(back, CSRNDArray)
+        onp.testing.assert_array_equal(back.todense().asnumpy(), dense)
+
+    def test_env_var_selects_codec(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("MXNET_NDARRAY_SAVE_FORMAT", "mxnet")
+        f = str(tmp_path / "e.params")
+        mx.nd.save(f, [mx.nd.ones((2,))])
+        assert ls.is_mxnet_format(open(f, "rb").read(8))
+
+
+class TestLegacyMagics:
+    def test_v1_record(self, tmp_path):
+        # V1: magic, int64 tshape, ctx, type, data (no stype field)
+        a = onp.arange(4, dtype=onp.float32)
+        rec = struct.pack("<I", 0xF993FAC8)
+        rec += struct.pack("<i", 1) + struct.pack("<q", 4)
+        rec += struct.pack("<i", 1) + struct.pack("<i", 0)
+        rec += struct.pack("<i", 0)
+        rec += a.tobytes()
+        blob = struct.pack("<QQQ", 0x112, 0, 1) + rec + struct.pack("<Q", 0)
+        f = tmp_path / "v1.params"
+        f.write_bytes(blob)
+        onp.testing.assert_array_equal(mx.nd.load(str(f))[0].asnumpy(), a)
+
+    def test_pre_v1_record_magic_is_ndim(self, tmp_path):
+        # oldest format: first uint32 IS ndim, dims are uint32
+        a = onp.arange(6, dtype=onp.float32).reshape(2, 3)
+        rec = struct.pack("<I", 2)                       # ndim
+        rec += struct.pack("<II", 2, 3)                  # uint32 dims
+        rec += struct.pack("<i", 1) + struct.pack("<i", 0)
+        rec += struct.pack("<i", 0)
+        rec += a.tobytes()
+        blob = struct.pack("<QQQ", 0x112, 0, 1) + rec + struct.pack("<Q", 0)
+        f = tmp_path / "v0.params"
+        f.write_bytes(blob)
+        onp.testing.assert_array_equal(mx.nd.load(str(f))[0].asnumpy(), a)
+
+
+class TestGluonLoad:
+    def test_model_zoo_net_loads_reference_format(self, tmp_path):
+        """A reference-format checkpoint (built by name from the net's
+        own params — stand-in for an actual MXNet artifact) loads into
+        a model-zoo net by parameter name."""
+        from mxnet_tpu.gluon.model_zoo import vision
+        net = vision.get_model("mobilenetv2_0.25")
+        net.initialize()
+        x = mx.nd.ones((1, 3, 32, 32))
+        net(x)  # force shape inference
+        params = {k: v.data() for k, v in net.collect_params().items()}
+        f = str(tmp_path / "ref.params")
+        mx.nd.save(f, params, format="mxnet")
+
+        net2 = vision.get_model("mobilenetv2_0.25")
+        net2.load_parameters(f)
+        y1, y2 = net(x).asnumpy(), net2(x).asnumpy()
+        onp.testing.assert_allclose(y1, y2, rtol=1e-6, atol=1e-6)
+
+    def test_arg_aux_prefixes_stripped(self, tmp_path):
+        from mxnet_tpu.gluon import nn
+        net = nn.Dense(3, in_units=4)
+        net.initialize()
+        params = {f"arg:{k}": v.data()
+                  for k, v in net.collect_params().items()}
+        f = str(tmp_path / "old.params")
+        mx.nd.save(f, params, format="mxnet")
+        net2 = nn.Dense(3, in_units=4)
+        net2.load_parameters(f)
+        onp.testing.assert_array_equal(net.weight.data().asnumpy(),
+                                       net2.weight.data().asnumpy())
