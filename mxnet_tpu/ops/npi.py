@@ -42,7 +42,9 @@ _BINARY = {
     "_npi_power": jnp.power,
     "_npi_copysign": jnp.copysign,
     "_npi_lcm": jnp.lcm,
-    "_npi_ldexp": lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+    # float semantics per the reference (mshadow_op ldexp = a*2^b on
+    # floats, grad wrt b = a*2^b*ln2) — NOT numpy's int-exponent ldexp
+    "_npi_ldexp": lambda a, b: a * jnp.exp2(b),
     "_npi_fmax": jnp.fmax,
     "_npi_fmin": jnp.fmin,
     "_npi_fmod": jnp.fmod,
@@ -79,12 +81,10 @@ _SCALAR = {
     "_npi_rarctan2_scalar": (jnp.arctan2, True),
     "_npi_lcm_scalar": (lambda a, b: jnp.lcm(a, jnp.asarray(b, a.dtype)),
                         False),
-    "_npi_ldexp_scalar": (lambda a, b: jnp.ldexp(a, jnp.asarray(b,
-                                                                jnp.int32)),
-                          False),
-    "_npi_rldexp_scalar": (lambda a, b: jnp.ldexp(a, jnp.asarray(b,
-                                                                 jnp.int32)),
-                           True),
+    "_npi_ldexp_scalar": (lambda a, b: a * jnp.exp2(jnp.asarray(
+                              b, a.dtype)), False),
+    # reversed: fn(scalar_mantissa, array_exponent)
+    "_npi_rldexp_scalar": (lambda s_, a: s_ * jnp.exp2(a), True),
     "_npi_fmax_scalar": (jnp.fmax, False),
     "_npi_fmin_scalar": (jnp.fmin, False),
     "_npi_fmod_scalar": (jnp.fmod, False),

@@ -66,15 +66,18 @@ def rand_shape_nd(ndim, dim=10):
 
 def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
                            eps: float = 1e-3, rtol: float = 1e-2,
-                           atol: float = 1e-3):
+                           atol: float = 1e-3, train_mode: bool = False):
     """Finite-difference gradient check of a scalar-output function.
 
     ``fn(*inputs)`` returns an NDArray; its sum is the objective.
+    ``train_mode`` holds the autograd train flag fixed across BOTH the
+    analytic backward and the finite-difference evals so mode-sensitive
+    ops (BatchNorm batch-stats path) compare like with like.
     Parity: test_utils.py:1039 check_numeric_gradient.
     """
     for x in inputs:
         x.attach_grad()
-    with autograd.record():
+    with autograd.record(train_mode=train_mode):
         out = fn(*inputs)
         loss = out.sum()
     loss.backward()
@@ -89,11 +92,11 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence[NDArray],
             orig = flat[j]
             flat[j] = orig + eps
             x._rebind(NDArray(x_np.astype(x.dtype))._data)
-            with autograd.pause():
+            with autograd.pause(train_mode=train_mode):
                 f_pos = float(fn(*inputs).sum().asscalar())
             flat[j] = orig - eps
             x._rebind(NDArray(x_np.astype(x.dtype))._data)
-            with autograd.pause():
+            with autograd.pause(train_mode=train_mode):
                 f_neg = float(fn(*inputs).sum().asscalar())
             flat[j] = orig
             x._rebind(NDArray(x_np.astype(x.dtype))._data)

@@ -1,0 +1,57 @@
+"""Triage runner for the fd-gradient sweep catalog: runs every spec,
+prints PASS/FAIL/ERROR per op plus a summary, without stopping at the
+first failure.  Used to iterate on tests/grad_sweep_specs.py; the
+enforcing test is tests/test_grad_sweep.py.
+
+Usage: JAX_PLATFORMS=cpu python tools/grad_sweep_triage.py [name ...]
+"""
+import os
+import sys
+import time
+import traceback
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from grad_sweep_specs import SPECS  # noqa: E402
+
+
+def main():
+    import test_grad_sweep as tgs  # noqa: E402
+    only = sys.argv[1:]
+    names = only if only else sorted(SPECS)
+    results = {}
+    t0 = time.time()
+    for i, name in enumerate(names):
+        start = time.time()
+        try:
+            tgs.run_spec(name, SPECS[name])
+            results[name] = ("PASS", "")
+        except BaseException as e:
+            kind = "FAIL" if isinstance(e, AssertionError) else "ERROR"
+            msg = str(e).split("\n")
+            brief = next((l for l in msg if l.strip()), "")[:200]
+            if kind == "ERROR":
+                brief = f"{type(e).__name__}: {brief}"
+            results[name] = (kind, brief)
+        dt = time.time() - start
+        status = results[name][0]
+        if status != "PASS" or dt > 5:
+            print(f"[{i+1}/{len(names)}] {name}: {status} "
+                  f"({dt:.1f}s) {results[name][1]}", flush=True)
+    print(f"\n== done in {time.time()-t0:.0f}s ==")
+    for kind in ("ERROR", "FAIL"):
+        bad = [n for n, (k, _) in results.items() if k == kind]
+        print(f"{kind}: {len(bad)}")
+        for n in bad:
+            print(f"  {n}: {results[n][1]}")
+    npass = sum(1 for k, _ in results.values() if k == "PASS")
+    print(f"PASS: {npass}/{len(names)}")
+
+
+if __name__ == "__main__":
+    main()
