@@ -122,10 +122,10 @@ class _Node:
 
 class _OpRecord:
     __slots__ = ("fn", "saved_inputs", "in_nodes", "out_nodes", "multi_out",
-                 "consumed", "out_specs")
+                 "consumed", "out_specs", "sparse_bwd")
 
     def __init__(self, fn, saved_inputs, in_nodes, out_nodes, multi_out,
-                 out_specs=None):
+                 out_specs=None, sparse_bwd=None):
         self.fn = fn
         self.saved_inputs = saved_inputs
         self.in_nodes = in_nodes
@@ -133,6 +133,9 @@ class _OpRecord:
         self.multi_out = multi_out
         self.consumed = False
         self.out_specs = out_specs    # [(shape, dtype)] of the outputs
+        # optional op-provided backward producing row_sparse cotangents
+        # (parity: backward storage inference — SparseEmbeddingOpBackward)
+        self.sparse_bwd = sparse_bwd
 
 
 def _tape() -> List[_OpRecord]:
@@ -140,9 +143,9 @@ def _tape() -> List[_OpRecord]:
 
 
 def _record(fn, in_nodes, saved_inputs, out_nodes, multi_out,
-            out_specs=None):
+            out_specs=None, sparse_bwd=None):
     rec = _OpRecord(fn, saved_inputs, in_nodes, out_nodes, multi_out,
-                    out_specs)
+                    out_specs, sparse_bwd)
     for n in out_nodes:
         n.producer = rec
     _tape().append(rec)
@@ -150,7 +153,7 @@ def _record(fn, in_nodes, saved_inputs, out_nodes, multi_out,
 
 
 def record_apply(fn: Callable, nd_inputs: Sequence[Any], nd_outputs: Sequence[Any],
-                 multi_out: bool) -> None:
+                 multi_out: bool, sparse_bwd=None) -> None:
     """Append one executed op to the tape.
 
     ``fn(*arrays)`` must be the pure jax function that produced
@@ -160,7 +163,8 @@ def record_apply(fn: Callable, nd_inputs: Sequence[Any], nd_outputs: Sequence[An
     _record(fn, [x._ensure_node() for x in nd_inputs],
             [x._data for x in nd_inputs],
             [o._new_node() for o in nd_outputs], multi_out,
-            out_specs=[(o.shape, o.dtype) for o in nd_outputs])
+            out_specs=[(o.shape, o.dtype) for o in nd_outputs],
+            sparse_bwd=sparse_bwd)
 
 
 def mark_variables(variables, gradients, grad_reqs="write") -> None:
@@ -256,12 +260,28 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
         seen.add(id(node))
         if node.grad_array is not None and node.out_grad is not None \
                 and node.grad_req != "null":
+            from .ndarray.sparse import RowSparseNDArray, merge
             buf = node.grad_array
-            g = _ct_data(node.out_grad)
-            if node.grad_req == "add":
-                buf._data = buf._data + g
+            og = node.out_grad
+            if isinstance(buf, RowSparseNDArray):
+                # grad_stype='row_sparse' buffer: keep grads sparse
+                if not isinstance(og, RowSparseNDArray):
+                    raise MXNetError(
+                        "parameter has grad_stype='row_sparse' but a "
+                        "dense gradient flowed into it; only ops with a "
+                        "sparse backward (Embedding(sparse_grad=True)) "
+                        "may feed a row_sparse grad buffer")
+                if node.grad_req == "add" and buf.nnz:
+                    og = merge(buf, og)
+                buf.data, buf.indices = og.data, og.indices
             else:
-                buf._data = g
+                if isinstance(og, RowSparseNDArray):
+                    og = og.todense()
+                g = _ct_data(og)
+                if node.grad_req == "add":
+                    buf._data = buf._data + g
+                else:
+                    buf._data = g
         node.out_grad = None
 
     if not retain_graph:
@@ -319,6 +339,17 @@ def _apply_vjp(rec: _OpRecord, out_grads, create_graph: bool):
     from .ndarray import NDArray
 
     fn, saved = rec.fn, rec.saved_inputs
+
+    if rec.sparse_bwd is not None and not create_graph:
+        # op supplies its own backward emitting row_sparse cotangents
+        # at nnz cost (never materializing the dense vocab-sized grad)
+        cts = [None if g is None else _ct_data(g) for g in out_grads]
+        grads = rec.sparse_bwd(saved, cts)
+        for node, g in zip(rec.in_nodes, grads):
+            if g is not None:
+                _accumulate(node, g, False)
+        return
+
     out_specs = rec.out_specs
     filled = []
     for i, g in enumerate(out_grads):
@@ -364,7 +395,21 @@ def _accumulate(node: _Node, g, create_graph: bool):
     elif create_graph:
         node.out_grad = _recorded_add(node.out_grad, g)
     else:
-        node.out_grad = node.out_grad + g
+        node.out_grad = _ct_sum(node.out_grad, g)
+
+
+def _ct_sum(a, b):
+    """Sum two cotangents, either of which may be row_sparse."""
+    from .ndarray.sparse import RowSparseNDArray, merge
+    a_sp = isinstance(a, RowSparseNDArray)
+    b_sp = isinstance(b, RowSparseNDArray)
+    if a_sp and b_sp:
+        return merge(a, b)
+    if a_sp:
+        return a.todense()._data + b
+    if b_sp:
+        return a + b.todense()._data
+    return a + b
 
 
 def _recorded_add(a, b):
@@ -406,7 +451,11 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         if g is None:
             raise MXNetError("one of the variables is not differentiably "
                              "connected to the heads")
-        out = g if isinstance(g, NDArray) else NDArray(g)
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(g, (NDArray, RowSparseNDArray)):
+            out = g  # row_sparse cotangents pass through as containers
+        else:
+            out = NDArray(g)
         results.append(out)
         n.grad_array, n.grad_req, n.out_grad = ga, gr, og
     return results if not single else results[0] if len(results) == 1 else results

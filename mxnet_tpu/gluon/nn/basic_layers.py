@@ -250,12 +250,21 @@ class Embedding(HybridBlock):
         super().__init__(**kwargs)
         self._input_dim = input_dim
         self._output_dim = output_dim
-        self.weight = Parameter(shape=(input_dim, output_dim), dtype=dtype,
-                                init=weight_initializer)
+        self._sparse_grad = bool(sparse_grad)
+        # sparse_grad=True: weight gradients arrive as row_sparse
+        # (indices, values) pairs at nnz cost and the optimizer applies
+        # lazy row updates (parity: nn.Embedding sparse_grad →
+        # grad_stype='row_sparse', gluon/nn/basic_layers.py Embedding)
+        self.weight = Parameter(
+            shape=(input_dim, output_dim), dtype=dtype,
+            init=weight_initializer,
+            grad_stype="row_sparse" if sparse_grad else "default")
 
     def forward(self, x):
         return invoke("Embedding", [x, self.weight.data()],
-                      input_dim=self._input_dim, output_dim=self._output_dim)
+                      input_dim=self._input_dim,
+                      output_dim=self._output_dim,
+                      sparse_grad=self._sparse_grad)
 
     def __repr__(self):
         return f"Embedding({self._input_dim} -> {self._output_dim})"

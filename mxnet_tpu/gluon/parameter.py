@@ -52,6 +52,12 @@ class Parameter:
         self.allow_deferred_init = allow_deferred_init
         self.grad_req = grad_req if differentiable else "null"
         self._differentiable = differentiable
+        if stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError(f"invalid parameter stype {stype!r}")
+        if grad_stype not in ("default", "row_sparse", "csr"):
+            raise MXNetError(f"invalid parameter grad_stype {grad_stype!r}")
+        self._stype = stype
+        self._grad_stype = grad_stype
         self._data: Optional[NDArray] = None
         self._grad: Optional[NDArray] = None
         self._deferred_init: Optional[Tuple[Any, Any]] = None  # (init, ctx)
@@ -131,7 +137,18 @@ class Parameter:
         if self.grad_req == "null":
             self._grad = None
             return
-        self._grad = NDArray(jnp.zeros(self._data.shape, self._data.dtype))
+        if self._grad_stype == "row_sparse":
+            # sparse gradient buffer: starts empty (0 live rows); filled
+            # by ops with a sparse backward — Embedding(sparse_grad=True)
+            # (parity: Parameter grad_stype, gluon/parameter.py:47)
+            from ..ndarray.sparse import RowSparseNDArray
+            shape = self._data.shape
+            self._grad = RowSparseNDArray(
+                jnp.zeros((0,) + tuple(shape[1:]), self._data.dtype),
+                jnp.zeros((0,), jnp.int32), shape)
+        else:
+            self._grad = NDArray(jnp.zeros(self._data.shape,
+                                           self._data.dtype))
         ag.mark_variables([self._data_nd()], [self._grad], self.grad_req)
 
     # -- access ------------------------------------------------------------
@@ -171,8 +188,16 @@ class Parameter:
         return [self._data.context]
 
     def zero_grad(self):
-        if self._grad is not None:
-            self._grad._rebind(jnp.zeros(self._grad.shape, self._grad.dtype))
+        if self._grad is None:
+            return
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(self._grad, RowSparseNDArray):
+            self._grad.data = jnp.zeros(
+                (0,) + tuple(self._grad.shape[1:]), self._grad.dtype)
+            self._grad.indices = jnp.zeros((0,), jnp.int32)
+        else:
+            self._grad._rebind(jnp.zeros(self._grad.shape,
+                                         self._grad.dtype))
 
     def set_data(self, data):
         if isinstance(data, NDArray):

@@ -57,16 +57,28 @@ class KVStore(KVStoreBase):
         for k, v in zip(keys, vals):
             self._data[k] = v.copy()
 
+    @staticmethod
+    def _is_rsp(v):
+        from ..ndarray.sparse import RowSparseNDArray
+        return isinstance(v, RowSparseNDArray)
+
     def push(self, key, value, priority=0):
         keys = key if isinstance(key, (list, tuple)) else [key]
         if len(keys) == 1:
             value = [value]
         for k, v in zip(keys, value):
             if isinstance(v, (list, tuple)):
-                v = [self._densify(x) for x in v]
+                if all(self._is_rsp(x) for x in v):
+                    # sparse aggregation at nnz cost — never densified
+                    # (parity: comm.h:104 ReduceRowSparse)
+                    from ..ndarray.sparse import reduce_list
+                    reduced = reduce_list(list(v))
+                else:
+                    reduced = self._reduce([self._densify(x) for x in v])
+            elif self._is_rsp(v):
+                reduced = v
             else:
-                v = self._densify(v)
-            reduced = self._reduce(v)
+                reduced = self._reduce(self._densify(v))
             if self._updater is not None:
                 if k not in self._data:
                     self._data[k] = reduced.copy()
@@ -147,10 +159,16 @@ class KVStore(KVStoreBase):
             vals = [value]
         for k, v in zip(keys, vals):
             if isinstance(v, (list, tuple)):
-                v = [self._densify(x) for x in v]
+                if all(self._is_rsp(x) for x in v):
+                    from ..ndarray.sparse import reduce_list
+                    self._data[k] = reduce_list(list(v))
+                else:
+                    self._data[k] = self._reduce(
+                        [self._densify(x) for x in v])
+            elif self._is_rsp(v):
+                self._data[k] = v
             else:
-                v = self._densify(v)
-            self._data[k] = self._reduce(v)
+                self._data[k] = self._reduce(self._densify(v))
         if out is not None:
             self.pull(key, out, priority)
         return out

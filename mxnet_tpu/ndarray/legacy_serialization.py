@@ -135,17 +135,22 @@ class _Reader:
 
 
 def _num_aux(stype: int) -> int:
-    return {K_DEFAULT_STORAGE: 0, K_ROW_SPARSE_STORAGE: 1,
-            K_CSR_STORAGE: 2}.get(stype, 0)
+    try:
+        return {K_DEFAULT_STORAGE: 0, K_ROW_SPARSE_STORAGE: 1,
+                K_CSR_STORAGE: 2}[stype]
+    except KeyError:
+        raise MXNetError(
+            f"invalid NDArray file format (unknown storage type "
+            f"{stype})") from None
 
 
-def encode_ndarray(arr, w: Optional[_Writer] = None) -> bytes:
+def encode_ndarray(arr) -> bytes:
     """Serialize one array in the reference wire format.  Accepts a
     dense NDArray, RowSparseNDArray, or CSRNDArray."""
     from .ndarray import NDArray
     from .sparse import RowSparseNDArray, CSRNDArray
 
-    out = w if w is not None else _Writer()
+    out = _Writer()
 
     if isinstance(arr, RowSparseNDArray):
         values = onp.ascontiguousarray(onp.asarray(arr.data.asnumpy()
@@ -201,7 +206,7 @@ def encode_ndarray(arr, w: Optional[_Writer] = None) -> bytes:
             out.raw(a.tobytes())
         else:
             out.raw(a.astype(a.dtype.newbyteorder("<")).tobytes())
-    return out.getvalue() if w is None else b""
+    return out.getvalue()
 
 
 def decode_ndarray(r: _Reader):

@@ -91,7 +91,18 @@ class BaseSparseNDArray:
         if isinstance(other, NDArray):
             other._rebind(self.todense()._data)
             return other
+        if isinstance(other, type(self)):
+            for attr in ("data", "indices", "indptr"):
+                if hasattr(self, attr):
+                    setattr(other, attr, getattr(self, attr))
+            other._shape = tuple(self.shape)
+            other._dtype = self.dtype
+            return other
         raise MXNetError("copyto: unsupported target for sparse")
+
+    def copy(self):
+        import copy as _copy
+        return _copy.copy(self)
 
 
 class RowSparseNDArray(BaseSparseNDArray):
@@ -328,15 +339,7 @@ def add(lhs, rhs):
     """Elementwise add across storage types."""
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs,
                                                         RowSparseNDArray):
-        if lhs.shape != rhs.shape:
-            raise MXNetError("add: shape mismatch")
-        idx = onp.union1d(onp.asarray(lhs.indices), onp.asarray(rhs.indices))
-        data = onp.zeros((len(idx),) + lhs.shape[1:],
-                         onp.result_type(lhs.dtype, rhs.dtype))
-        for src in (lhs, rhs):
-            pos = onp.searchsorted(idx, onp.asarray(src.indices))
-            onp.add.at(data, pos, onp.asarray(src.data))
-        return RowSparseNDArray(data, idx.astype(onp.int32), lhs.shape)
+        return merge(lhs, rhs)  # device-side union + segment_sum
     a = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
     b = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
     return a + b
@@ -393,3 +396,127 @@ def adagrad_update(weight: NDArray, grad: RowSparseNDArray, history: NDArray,
     step = lr * g / (jnp.sqrt(h_rows) + epsilon)
     weight._rebind(weight._data.at[rows].add(-step))
     return weight
+
+
+# --------------------------------------------------------------------------
+# row-sparse gradient plumbing: merge (grad accumulation / kvstore
+# aggregation) and jit-compiled lazy optimizer kernels at nnz cost.
+# Parity: sparse gradient aggregation (src/kvstore/comm.h:104 CommCPU
+# ReduceRowSparse) and the row_sparse optimizer kernels
+# (src/operator/optimizer_op.cc:299,509,649,858 storage dispatch).
+# --------------------------------------------------------------------------
+
+def merge(a: RowSparseNDArray, b: RowSparseNDArray) -> RowSparseNDArray:
+    """Sum two row_sparse arrays at O(nnz log nnz) cost, never
+    materializing the dense shape (gradient accumulation / multi-device
+    reduce)."""
+    if tuple(a.shape) != tuple(b.shape):
+        raise MXNetError(
+            f"row_sparse merge: shape mismatch {a.shape} vs {b.shape}")
+    rows = jnp.concatenate([a.indices, b.indices])
+    vals = jnp.concatenate([a.data, b.data])
+    uniq = jnp.unique(rows)                       # eager: nnz is data-dep
+    inv = jnp.searchsorted(uniq, rows)
+    summed = jax.ops.segment_sum(vals, inv, num_segments=int(uniq.shape[0]))
+    return RowSparseNDArray(summed, uniq, a.shape)
+
+
+def reduce_list(values) -> RowSparseNDArray:
+    """Reduce a list of row_sparse values (kvstore multi-device push)."""
+    acc = values[0]
+    for v in values[1:]:
+        acc = merge(acc, v)
+    return acc
+
+
+# jit cache for the lazy update kernels: ONE jax.jit wrapper per
+# (kind, static hyperparams); jax's own signature cache compiles per
+# (vocab, dim, nnz) shape as batches with new nnz appear.  Weight/state
+# buffers are donated — the update is in-place in HBM, cost O(nnz*dim)
+# compute + O(vocab) aliased buffer, with no dense gradient ever built.
+_LAZY_JITS: dict = {}
+
+
+def _lazy_kernel(kind: str, statics: tuple):
+    key = (kind, statics)
+    fn = _LAZY_JITS.get(key)
+    if fn is not None:
+        return fn
+    st = dict(statics)
+    rescale = st.get("rescale_grad", 1.0)
+    clip = st.get("clip_gradient", -1.0)
+
+    def prep(g, w_rows, wd):
+        g = g * rescale
+        if clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        return g + wd * w_rows
+
+    if kind == "sgd_update":
+        def raw(lr, wd, w, vals, rows):
+            w_rows = w[rows]
+            g = prep(vals, w_rows, wd)
+            return (w.at[rows].set(w_rows - lr * g),)
+        donate = (2,)
+    elif kind == "sgd_mom_update":
+        mom_c = st.get("momentum", 0.0)
+
+        def raw(lr, wd, w, vals, rows, mom):
+            w_rows = w[rows]
+            g = prep(vals, w_rows, wd)
+            m_rows = mom_c * mom[rows] - lr * g
+            return (w.at[rows].add(m_rows), mom.at[rows].set(m_rows))
+        donate = (2, 5)
+    elif kind == "adagrad_update":
+        eps = st.get("epsilon", 1e-7)
+
+        def raw(lr, wd, w, vals, rows, hist):
+            w_rows = w[rows]
+            g = prep(vals, w_rows, wd)
+            h_rows = hist[rows] + g * g
+            step = lr * g / (jnp.sqrt(h_rows) + eps)
+            return (w.at[rows].add(-step), hist.at[rows].set(h_rows))
+        donate = (2, 5)
+    elif kind == "adam_update":
+        b1 = st.get("beta1", 0.9)
+        b2 = st.get("beta2", 0.999)
+        eps = st.get("epsilon", 1e-8)
+
+        # bias correction is folded into lr by the CALLER (host-side,
+        # like the dense Adam path) so the step count isn't a static
+        # that would recompile the kernel every iteration
+        def raw(lr, wd, w, vals, rows, mean, var):
+            w_rows = w[rows]
+            g = prep(vals, w_rows, wd)
+            m_rows = b1 * mean[rows] + (1 - b1) * g
+            v_rows = b2 * var[rows] + (1 - b2) * g * g
+            step = lr * m_rows / (jnp.sqrt(v_rows) + eps)
+            return (w.at[rows].add(-step), mean.at[rows].set(m_rows),
+                    var.at[rows].set(v_rows))
+        donate = (2, 5, 6)
+    else:
+        raise MXNetError(f"no row_sparse kernel for {kind!r}")
+
+    fn = jax.jit(raw, donate_argnums=donate)
+    _LAZY_JITS[key] = fn
+    return fn
+
+
+_LAZY_SUPPORTED = {"sgd_update", "sgd_mom_update", "adagrad_update",
+                   "adam_update"}
+
+
+def lazy_apply(kind: str, lr: float, wd: float, weight: NDArray,
+               grad: RowSparseNDArray, states, statics: dict):
+    """Run one jitted lazy update touching only grad.indices rows.
+    Mutates weight/state NDArrays by rebinding.  Returns False when the
+    optimizer has no sparse kernel (caller densifies)."""
+    if kind not in _LAZY_SUPPORTED:
+        return False
+    fn = _lazy_kernel(kind, tuple(sorted(statics.items())))
+    outs = fn(jnp.float32(lr), jnp.float32(wd), weight._data, grad.data,
+              grad.indices, *[s._data for s in states])
+    weight._rebind(outs[0])
+    for s, new in zip(states, outs[1:]):
+        s._rebind(new)
+    return True

@@ -151,8 +151,15 @@ def _np_flavor_of(nd_inputs):
     return None
 
 
+# ops whose recorded backward can produce row_sparse cotangents for
+# some inputs (parity: FInferStorageType returning kRowSparseStorage
+# for backward outputs — Embedding's SparseEmbeddingOpBackward).
+# name → factory(params) → None | callable(saved, out_cts) → [ct|None]
+_SPARSE_GRAD_BWD: Dict[str, Callable] = {}
+
+
 def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
-              record: Optional[bool] = None, jentry=None):
+              record: Optional[bool] = None, jentry=None, sparse_bwd=None):
     """Run a pure jax function on NDArrays, wrap outputs, record on tape.
 
     This is the one funnel every op call goes through — the analogue of
@@ -181,7 +188,8 @@ def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
 
     should_record = autograd.is_recording() if record is None else record
     if should_record:
-        autograd.record_apply(fn, list(nd_inputs), nd_outs, multi_out=multi)
+        autograd.record_apply(fn, list(nd_inputs), nd_outs, multi_out=multi,
+                              sparse_bwd=sparse_bwd)
 
     if engine.naive_mode():
         for o in nd_outs:
@@ -313,9 +321,12 @@ def dispatch(op: Operator, nd_inputs: Sequence[Any], params: dict):
     async dispatch this measures dispatch wall time; jax's xplane trace
     holds device times), execute via the jit cache."""
     fn, jentry = bound_fn(op, params)
+    sparse_hook = _SPARSE_GRAD_BWD.get(op.name)
+    sparse_bwd = sparse_hook(params) if sparse_hook is not None else None
     from .. import profiler
     t0 = profiler.op_timer()
-    out = apply_jax(fn, nd_inputs, multi_out=op.multi_out, jentry=jentry)
+    out = apply_jax(fn, nd_inputs, multi_out=op.multi_out, jentry=jentry,
+                    sparse_bwd=sparse_bwd)
     profiler.op_record(op.name, t0)
     if _dc_stack:
         _dc_record(op, nd_inputs, params, out)

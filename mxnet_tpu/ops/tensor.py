@@ -13,7 +13,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .registry import register, alias
+from .registry import register, alias, _SPARSE_GRAD_BWD
 
 # --------------------------------------------------------------------------
 # elementwise binary (+ broadcast_* aliases: the reference distinguishes
@@ -569,6 +569,38 @@ def _sequence_reverse(data, sequence_length=None, *, use_sequence_length=False,
 def _embedding(data, weight, *, input_dim=None, output_dim=None, dtype=None,
                sparse_grad=False):
     return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+def _embedding_sparse_bwd_factory(params):
+    """sparse_grad=True: the weight cotangent is built as a row_sparse
+    (indices, values) pair at O(lookups·dim) cost — the dense
+    vocab-sized gradient is never materialized (parity: Embedding's
+    backward storage inference, indexing_op.h SparseEmbeddingOpBackward;
+    TPU expression: unique + segment_sum instead of AddTakeGrad)."""
+    if not params.get("sparse_grad"):
+        return None
+
+    def bwd(saved, cts):
+        from ..ndarray.sparse import RowSparseNDArray
+
+        data, weight = saved
+        ct = cts[0]
+        if ct is None:
+            return [None, None]
+        dim = weight.shape[-1]
+        idx_flat = jnp.ravel(data).astype(jnp.int32)
+        ct_flat = jnp.reshape(ct, (idx_flat.shape[0], dim))
+        rows = jnp.unique(idx_flat)          # eager-only: nnz is data-dep
+        inv = jnp.searchsorted(rows, idx_flat)
+        vals = jax.ops.segment_sum(ct_flat, inv,
+                                   num_segments=int(rows.shape[0]))
+        return [None,
+                RowSparseNDArray(vals, rows, tuple(weight.shape))]
+
+    return bwd
+
+
+_SPARSE_GRAD_BWD["Embedding"] = _embedding_sparse_bwd_factory
 
 
 @register("L2Normalization")

@@ -221,6 +221,9 @@ class Optimizer:
     def update(self, index, weight, grad, state):
         """Apply one update (parity: Optimizer.update).  Mutates weight and
         state NDArrays by rebinding their buffers."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            return self._update_rsp(index, weight, grad, state)
         # static_params reads the pre-bump count (t = count+1 = this step)
         params = dict(self.static_params(index))
         self._update_count(index)
@@ -242,10 +245,46 @@ class Optimizer:
         for s, new in zip(state, outs[1:]):
             s._rebind(new)
 
+    def _update_rsp(self, index, weight, grad, state):
+        """Row-sparse gradient: lazy update touching only the gradient's
+        live rows, inside one jitted kernel at O(nnz·dim) cost (parity:
+        the row_sparse optimizer kernels, optimizer_op.cc:299,509,649,
+        858 and sgd.py lazy_update).  Optimizers without a sparse kernel
+        — or lazy_update=False — densify (the reference's std_update
+        path) with the storage-fallback log."""
+        from ..ndarray.sparse import (lazy_apply, _log_storage_fallback,
+                                      _LAZY_SUPPORTED)
+        lazy = getattr(self, "lazy_update", True)
+        kind = self.op_name
+        if lazy and kind in _LAZY_SUPPORTED:
+            statics = dict(self.static_params(index))
+            statics["rescale_grad"] = float(self.rescale_grad)
+            if self.clip_gradient is not None:
+                statics["clip_gradient"] = float(self.clip_gradient)
+            lr, wd = self._get_lr(index), self._get_wd(index)
+            if kind == "adam_update":
+                # fold bias correction into lr, like the dense path
+                t = self._index_update_count.get(index, 0) + 1
+                lr = lr * (1.0 - self.beta2 ** t) ** 0.5 \
+                    / (1.0 - self.beta1 ** t)
+            self._update_count(index)
+            lazy_apply(kind, lr, wd, weight, grad, list(state), statics)
+            return
+        _log_storage_fallback(f"{kind} has no lazy row_sparse kernel"
+                              if kind not in _LAZY_SUPPORTED
+                              else f"{kind} with lazy_update=False")
+        self.update(index, weight, grad.todense(), state)
+
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == onp.float16:
+            from ..ndarray.sparse import RowSparseNDArray
             master, sub_state = state[0], state[1:]
-            grad32 = NDArray(grad._data.astype(jnp.float32))
+            if isinstance(grad, RowSparseNDArray):
+                grad32 = RowSparseNDArray(
+                    grad.data.astype(jnp.float32), grad.indices,
+                    grad.shape)
+            else:
+                grad32 = NDArray(grad._data.astype(jnp.float32))
             self.update(index, master, grad32, sub_state)
             weight._rebind(master._data.astype(weight._data.dtype))
         else:
@@ -259,6 +298,13 @@ class Optimizer:
 
         Falls back to per-tensor updates when per-index lr/wd or static
         params diverge (lr_mult/wd_mult users)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if any(isinstance(g, RowSparseNDArray) for g in grads):
+            # sparse grads take the per-tensor lazy path (through the
+            # multi-precision wrapper so fp16 master weights still work)
+            for i, w, g, s in zip(indices, weights, grads, states):
+                self.update_multi_precision(i, w, g, s)
+            return
         if type(self).update is not Optimizer.update or (
                 self.multi_precision
                 and any(w.dtype == onp.float16 for w in weights)):
@@ -360,6 +406,9 @@ class Adam(Optimizer):
                 "epsilon": self.epsilon}
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            return self._update_rsp(index, weight, grad, state)
         # bias correction folded into lr (parity: adam.py step computation)
         t = self._index_update_count.get(index, 0) + 1
         coef1 = 1.0 - self.beta1 ** t

@@ -1,0 +1,227 @@
+"""Row-sparse end-to-end: Embedding sparse gradients, lazy optimizer
+updates at nnz cost, kvstore sparse aggregation.
+
+Parity: Embedding sparse_grad (gluon/nn/basic_layers.py), row_sparse
+optimizer kernels (src/operator/optimizer_op.cc:299,509,649,858),
+sgd.py lazy_update (:36,78), sparse gradient aggregation
+(src/kvstore/comm.h:104).
+"""
+import numpy as onp
+import pytest
+
+import jax
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ndarray.sparse import (RowSparseNDArray, merge, reduce_list,
+                                      _lazy_kernel)
+
+VOCAB, DIM = 50, 4
+
+
+def _ids(*vals):
+    return nd.array(onp.array(vals, "float32"))
+
+
+class TestEmbeddingSparseGrad:
+    def test_grad_is_row_sparse_and_matches_dense(self):
+        rng = onp.random.RandomState(3)
+        w0 = rng.randn(VOCAB, DIM).astype("float32")
+        ids = _ids(3, 7, 3, 12)
+
+        dense = nn.Embedding(VOCAB, DIM)
+        dense.initialize()
+        dense.weight.set_data(nd.array(w0))
+        with autograd.record():
+            (dense(ids) * 2.0).sum().backward()
+        g_dense = dense.weight.grad().asnumpy()
+
+        sparse = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+        sparse.initialize()
+        sparse.weight.set_data(nd.array(w0))
+        with autograd.record():
+            (sparse(ids) * 2.0).sum().backward()
+        g = sparse.weight.grad()
+        assert isinstance(g, RowSparseNDArray)
+        # only the looked-up rows are live (3 unique of 50)
+        assert sorted(onp.asarray(g.indices).tolist()) == [3, 7, 12]
+        onp.testing.assert_allclose(g.todense().asnumpy(), g_dense,
+                                    rtol=1e-6)
+
+    def test_repeated_ids_accumulate(self):
+        emb = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+        emb.initialize()
+        ids = _ids(5, 5, 5)
+        with autograd.record():
+            emb(ids).sum().backward()
+        g = emb.weight.grad()
+        assert g.nnz == 1
+        onp.testing.assert_allclose(onp.asarray(g.data)[0],
+                                    onp.full((DIM,), 3.0), rtol=1e-6)
+
+    def test_grad_add_req_merges(self):
+        emb = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+        emb.initialize()
+        emb.weight.grad_req = "add"
+        emb.weight._init_grad()
+        with autograd.record():
+            emb(_ids(1, 2)).sum().backward()
+        with autograd.record():
+            emb(_ids(2, 4)).sum().backward()
+        g = emb.weight.grad()
+        assert sorted(onp.asarray(g.indices).tolist()) == [1, 2, 4]
+        dense = g.todense().asnumpy()
+        onp.testing.assert_allclose(dense[2], onp.full((DIM,), 2.0))
+        onp.testing.assert_allclose(dense[1], onp.full((DIM,), 1.0))
+
+
+class TestLazyOptimizerNumerics:
+    """Sparse lazy update == dense update restricted to live rows."""
+
+    def _run_pair(self, opt_name, steps=3, **opt_kw):
+        rng = onp.random.RandomState(11)
+        w0 = rng.randn(VOCAB, DIM).astype("float32")
+        batches = [(3, 9, 3), (9, 21, 0), (3, 0, 48)]
+
+        results = {}
+        for mode in ("dense", "sparse"):
+            emb = nn.Embedding(VOCAB, DIM, sparse_grad=(mode == "sparse"))
+            emb.initialize()
+            emb.weight.set_data(nd.array(w0))
+            trainer = gluon.Trainer(emb.collect_params(), opt_name,
+                                    dict(opt_kw), kvstore=None)
+            for b in batches[:steps]:
+                with autograd.record():
+                    loss = (emb(_ids(*b)) ** 2).sum()
+                loss.backward()
+                trainer.step(1)
+            results[mode] = emb.weight.data().asnumpy()
+        return results
+
+    def test_sgd(self):
+        r = self._run_pair("sgd", learning_rate=0.1)
+        onp.testing.assert_allclose(r["sparse"], r["dense"], rtol=1e-5,
+                                    atol=1e-6)
+
+    def test_adagrad(self):
+        r = self._run_pair("adagrad", learning_rate=0.1)
+        onp.testing.assert_allclose(r["sparse"], r["dense"], rtol=1e-5,
+                                    atol=1e-6)
+
+    def test_adam_touched_rows_match(self):
+        # adam's dense update moves EVERY row each step (stale momentum),
+        # so lazy==dense only on rows touched every step — the defining
+        # semantic difference of lazy_update (reference sgd.py:36 doc)
+        rng = onp.random.RandomState(12)
+        w0 = rng.randn(VOCAB, DIM).astype("float32")
+        for mode in ("dense", "sparse"):
+            emb = nn.Embedding(VOCAB, DIM, sparse_grad=(mode == "sparse"))
+            emb.initialize()
+            emb.weight.set_data(nd.array(w0))
+            trainer = gluon.Trainer(emb.collect_params(), "adam",
+                                    {"learning_rate": 0.05}, kvstore=None)
+            for _ in range(3):
+                with autograd.record():
+                    loss = (emb(_ids(4, 4, 17)) ** 2).sum()
+                loss.backward()
+                trainer.step(1)
+            if mode == "dense":
+                ref = emb.weight.data().asnumpy()
+            else:
+                got = emb.weight.data().asnumpy()
+        onp.testing.assert_allclose(got[[4, 17]], ref[[4, 17]], rtol=1e-5,
+                                    atol=1e-6)
+        # untouched rows must be bit-identical to the init in sparse mode
+        untouched = [i for i in range(VOCAB) if i not in (4, 17)]
+        onp.testing.assert_array_equal(got[untouched], w0[untouched])
+
+    def test_momentum_lazy_vs_std(self):
+        """lazy_update=False densifies: momentum decays on ALL rows."""
+        rng = onp.random.RandomState(13)
+        w0 = rng.randn(VOCAB, DIM).astype("float32")
+        outs = {}
+        for lazy in (True, False):
+            emb = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+            emb.initialize()
+            emb.weight.set_data(nd.array(w0))
+            trainer = gluon.Trainer(
+                emb.collect_params(), "sgd",
+                {"learning_rate": 0.1, "momentum": 0.9,
+                 "lazy_update": lazy}, kvstore=None)
+            for b in [(2, 5), (5, 9), (9, 2)]:
+                with autograd.record():
+                    (emb(_ids(*b)) ** 2).sum().backward()
+                trainer.step(1)
+            outs[lazy] = emb.weight.data().asnumpy()
+        # both touched row 5 at steps 0/1 but not step 2: std momentum
+        # keeps moving it at step 2, lazy freezes it -> must differ
+        assert not onp.allclose(outs[True][5], outs[False][5])
+
+
+class TestNnzCost:
+    def test_flops_scale_with_nnz_not_vocab(self):
+        """Cost-analysis FLOPs of the compiled lazy kernel are O(nnz·dim),
+        far below one dense vocab-sized update (VERDICT r3 item 3)."""
+        vocab, dim, nnz = 1024, 64, 8
+        import jax.numpy as jnp
+        statics = (("clip_gradient", -1.0), ("rescale_grad", 1.0))
+        fn = _lazy_kernel("sgd_update", statics)
+        lowered = jax.jit(
+            lambda lr, wd, w, vals, rows: fn(lr, wd, w, vals, rows)
+        ).lower(jnp.float32(0.1), jnp.float32(0.0),
+                jax.ShapeDtypeStruct((vocab, dim), jnp.float32),
+                jax.ShapeDtypeStruct((nnz, dim), jnp.float32),
+                jax.ShapeDtypeStruct((nnz,), jnp.int32))
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        dense_flops = 3.0 * vocab * dim  # one mul + add + wd pass, dense
+        assert 0 < flops < dense_flops / 4, (
+            f"lazy kernel flops {flops} not << dense {dense_flops}")
+
+
+class TestKVStoreSparse:
+    def test_merge_and_reduce(self):
+        a = RowSparseNDArray(onp.ones((2, 3), "float32"), [1, 4], (6, 3))
+        b = RowSparseNDArray(2 * onp.ones((2, 3), "float32"), [4, 5],
+                             (6, 3))
+        m = merge(a, b)
+        dense = m.todense().asnumpy()
+        assert sorted(onp.asarray(m.indices).tolist()) == [1, 4, 5]
+        onp.testing.assert_allclose(dense[4], onp.full((3,), 3.0))
+        r = reduce_list([a, b, a])
+        onp.testing.assert_allclose(
+            r.todense().asnumpy(),
+            a.todense().asnumpy() * 2 + b.todense().asnumpy())
+
+    def test_kvstore_sparse_push_pull(self):
+        kv = mx.kv.create("device")
+        a = RowSparseNDArray(onp.ones((2, 3), "float32"), [0, 2], (5, 3))
+        b = RowSparseNDArray(onp.ones((1, 3), "float32"), [2], (5, 3))
+        kv.init("g", nd.zeros((5, 3)))
+        kv.push("g", [a, b])
+        out = nd.zeros((5, 3))
+        kv.pull("g", out=out)
+        expect = onp.zeros((5, 3), "float32")
+        expect[0] = 1
+        expect[2] = 2
+        onp.testing.assert_allclose(out.asnumpy(), expect)
+
+    def test_trainer_through_kvstore_matches_no_kvstore(self):
+        rng = onp.random.RandomState(17)
+        w0 = rng.randn(VOCAB, DIM).astype("float32")
+        outs = {}
+        for kvs in (None, "device"):
+            emb = nn.Embedding(VOCAB, DIM, sparse_grad=True)
+            emb.initialize()
+            emb.weight.set_data(nd.array(w0))
+            trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                                    {"learning_rate": 0.1}, kvstore=kvs)
+            for b in [(1, 2), (2, 3)]:
+                with autograd.record():
+                    (emb(_ids(*b)) ** 2).sum().backward()
+                trainer.step(1)
+            outs[kvs] = emb.weight.data().asnumpy()
+        onp.testing.assert_allclose(outs["device"], outs[None], rtol=1e-6)
