@@ -227,6 +227,50 @@ def _time_loop(fn, warmup: int, runs: int) -> float:
     return samples[len(samples) // 2] * 1e3     # median, ms
 
 
+def measure_device_time(op_name: str, runs: int = 10) -> Optional[Dict]:
+    """Per-op DEVICE time via an xplane capture around the jitted replay
+    (parity: the reference profiler's aggregate device-time table,
+    aggregate_stats.cc — dispatch wall time says nothing about the
+    kernel under async dispatch)."""
+    import functools
+    import shutil
+    import tempfile
+
+    import jax
+    from mxnet_tpu import xplane
+    from mxnet_tpu.ops import registry
+
+    synth = default_inputs(op_name)
+    if synth is None:
+        return None
+    inputs, params = synth
+    op = registry.get(op_name)
+    fn = functools.partial(op.fn, **params) if params else op.fn
+    arrays = [x._data for x in inputs]
+    jfn = jax.jit(fn)
+    try:
+        jax.block_until_ready(jfn(*arrays))    # compile outside the trace
+    except Exception:
+        return None
+    tmp = tempfile.mkdtemp(prefix="opperf_xplane_")
+    try:
+        jax.profiler.start_trace(tmp)
+        for _ in range(runs):
+            jax.block_until_ready(jfn(*arrays))
+        jax.profiler.stop_trace()
+        table = xplane.device_op_table(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if not table:
+        return None
+    total_us = sum(r["total_us"] for r in table.values())
+    return {"op": op_name, "dev_us_per_call": round(total_us / runs, 3),
+            "kernels": {k: round(v["total_us"] / runs, 3)
+                        for k, v in sorted(table.items(),
+                                           key=lambda kv: -kv[1]["total_us"])
+                        [:8]}}
+
+
 def benchmark_op(op_name: str, warmup: int = 3, runs: int = 10,
                  slow_ms: float = 25.0) -> Optional[Dict]:
     """Benchmark one op; returns a result row or None if not runnable.
@@ -444,6 +488,8 @@ def format_table(rows: List[Dict]) -> str:
 
 def main(argv=None):
     import os
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
     # honor JAX_PLATFORMS even where sitecustomize force-registers a
     # backend via jax.config (see tests/conftest.py for the same dance)
     want = os.environ.get("JAX_PLATFORMS")
@@ -464,7 +510,25 @@ def main(argv=None):
                    help="measure eager dispatch overhead + LeNet "
                         "eager-vs-hybrid step ratio instead of the "
                         "op sweep")
+    p.add_argument("--device-time", action="store_true",
+                   help="report per-op DEVICE time from an xplane "
+                        "capture (kernel truth) instead of wall time")
     args = p.parse_args(argv)
+
+    if args.device_time:
+        ops = [s for s in args.ops.split(",") if s] or \
+            ["dot", "Convolution", "softmax", "elemwise_add"]
+        rows = []
+        for name in ops:
+            row = measure_device_time(name, runs=args.runs)
+            if row:
+                rows.append(row)
+                print(f"{row['op']:<24}{row['dev_us_per_call']:>12.1f} "
+                      f"us/call (device)")
+        if args.output_json:
+            with open(args.output_json, "w") as f:
+                json.dump(rows, f, indent=1)
+        return rows
 
     if args.dispatch:
         ov = measure_dispatch_overhead(runs=max(args.runs, 50))

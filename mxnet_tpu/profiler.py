@@ -101,17 +101,42 @@ def dump(finished=True, profile_process="worker"):
     with open(_config["filename"], "w") as f:
         import json
         json.dump({"traceEvents": _dump_agg_events(),
-                   "xplane_dir": _trace_dir}, f)
+                   "xplane_dir": _trace_dir,
+                   "device_op_table": device_op_table()}, f)
 
 
-def dumps(reset=False):
-    """Return aggregate stats as a printable table (parity: dumps)."""
-    lines = ["Profile Statistics:",
+def device_op_table():
+    """Per-op DEVICE-time aggregates parsed from the captured xplane
+    trace: {op: {count, total_us, avg_us}} (parity: the reference's
+    in-memory aggregate table, src/profiler/aggregate_stats.cc).
+    Empty dict when no trace was captured."""
+    if _trace_dir is None:
+        return {}
+    from . import xplane
+    try:
+        return xplane.device_op_table(_trace_dir)
+    except Exception:
+        return {}
+
+
+def dumps(reset=False, device=True):
+    """Return aggregate stats as a printable table (parity: dumps,
+    profiler.py:460 / DumpProfile).  Host dispatch times first; when an
+    xplane trace was captured, a device-time per-op table follows — the
+    device numbers are the kernel truth (dispatch wall time says
+    nothing about a 4 ms kernel under async dispatch)."""
+    lines = ["Profile Statistics (host dispatch):",
              f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"]
     for name, times in sorted(_agg.items()):
         total = sum(times) * 1e3
         lines.append(f"{name:<40}{len(times):>8}{total:>12.3f}"
                      f"{total / max(len(times), 1):>12.3f}")
+    if device:
+        dev = device_op_table()
+        if dev:
+            from . import xplane
+            lines.append("")
+            lines.append(xplane.format_table(dev))
     if reset:
         _agg.clear()
     return "\n".join(lines)

@@ -1,0 +1,233 @@
+"""Minimal XPlane (TensorBoard profile) reader.
+
+Parses the ``*.xplane.pb`` protobuf written by ``jax.profiler`` with a
+self-contained protobuf wire-format decoder (no tensorflow/tensorboard
+dependency) and aggregates per-op DEVICE time — the analogue of the
+reference's in-memory aggregate table built from engine-op exec stats
+(``src/profiler/aggregate_stats.cc``; ``DumpProfile``
+``src/profiler/profiler.h:299``).  Schema: tsl/profiler/protobuf/
+xplane.proto (field numbers mirrored below).
+
+Wire format refresher: each field is (tag = field_no << 3 | wire_type)
+varint; wire type 0 = varint, 1 = 64-bit, 2 = length-delimited,
+5 = 32-bit.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import struct
+from collections import defaultdict
+from typing import Dict, Iterator, List, Optional, Tuple
+
+__all__ = ["read_xspace", "device_op_table", "latest_trace_file",
+           "format_table"]
+
+
+# -- protobuf wire decoding -------------------------------------------------
+
+def _read_varint(buf: memoryview, off: int) -> Tuple[int, int]:
+    result = 0
+    shift = 0
+    while True:
+        b = buf[off]
+        off += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, off
+        shift += 7
+
+
+def _fields(buf: memoryview) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over a message buffer."""
+    off = 0
+    n = len(buf)
+    while off < n:
+        tag, off = _read_varint(buf, off)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:
+            val, off = _read_varint(buf, off)
+        elif wire == 1:
+            val = buf[off:off + 8]
+            off += 8
+        elif wire == 2:
+            ln, off = _read_varint(buf, off)
+            val = buf[off:off + ln]
+            off += ln
+        elif wire == 5:
+            val = buf[off:off + 4]
+            off += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        yield field, wire, val
+
+
+def _submsgs(buf: memoryview, field_no: int) -> Iterator[memoryview]:
+    for f, w, v in _fields(buf):
+        if f == field_no and w == 2:
+            yield v
+
+
+def _scalar(buf: memoryview, field_no: int, default=0) -> int:
+    for f, w, v in _fields(buf):
+        if f == field_no and w == 0:
+            return v
+    return default
+
+
+def _string(buf: memoryview, field_no: int) -> str:
+    for f, w, v in _fields(buf):
+        if f == field_no and w == 2:
+            return bytes(v).decode("utf-8", "replace")
+    return ""
+
+
+# -- xplane schema ----------------------------------------------------------
+
+class XEvent:
+    __slots__ = ("metadata_id", "offset_ps", "duration_ps")
+
+    def __init__(self, buf):
+        self.metadata_id = 0
+        self.offset_ps = 0
+        self.duration_ps = 0
+        for f, w, v in _fields(buf):
+            if f == 1 and w == 0:
+                self.metadata_id = v
+            elif f == 2 and w == 0:
+                self.offset_ps = v
+            elif f == 3 and w == 0:
+                self.duration_ps = v
+
+
+class XLine:
+    __slots__ = ("name", "display_name", "events", "timestamp_ns")
+
+    def __init__(self, buf):
+        self.name = ""
+        self.display_name = ""
+        self.timestamp_ns = 0
+        self.events: List[XEvent] = []
+        for f, w, v in _fields(buf):
+            if f == 2 and w == 2:
+                self.name = bytes(v).decode("utf-8", "replace")
+            elif f == 11 and w == 2:
+                self.display_name = bytes(v).decode("utf-8", "replace")
+            elif f == 3 and w == 0:
+                self.timestamp_ns = v
+            elif f == 4 and w == 2:
+                self.events.append(XEvent(v))
+
+
+class XPlane:
+    __slots__ = ("name", "lines", "event_metadata")
+
+    def __init__(self, buf):
+        self.name = ""
+        self.lines: List[XLine] = []
+        self.event_metadata: Dict[int, str] = {}
+        for f, w, v in _fields(buf):
+            if f == 2 and w == 2:
+                self.name = bytes(v).decode("utf-8", "replace")
+            elif f == 3 and w == 2:
+                self.lines.append(XLine(v))
+            elif f == 4 and w == 2:
+                # map<int64, XEventMetadata> entry: key=1, value=2
+                key = _scalar(v, 1)
+                for md in _submsgs(v, 2):
+                    name = _string(md, 2)
+                    disp = _string(md, 4)
+                    self.event_metadata[key] = disp or name
+
+
+def read_xspace(path: str) -> List[XPlane]:
+    with open(path, "rb") as f:
+        data = memoryview(f.read())
+    return [XPlane(b) for b in _submsgs(data, 1)]
+
+
+def latest_trace_file(trace_dir: str) -> Optional[str]:
+    pbs = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
+                                 "*.xplane.pb"))
+    return max(pbs, key=os.path.getmtime) if pbs else None
+
+
+# -- aggregation ------------------------------------------------------------
+
+def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
+    """Aggregate device-side op times from a captured trace.
+
+    Returns {op_name: {"count": n, "total_us": t, "avg_us": a}} summed
+    over the accelerator planes' XLA-op lines (TPU: "/device:TPU:*"
+    planes, XLA Ops line; CPU runtime: the host plane's per-thunk
+    events).  The reference analogue is the aggregate table the
+    profiler builds from per-op device exec stats
+    (src/profiler/aggregate_stats.cc).
+    """
+    path = trace_dir_or_file
+    if os.path.isdir(path):
+        path = latest_trace_file(path)
+        if path is None:
+            return {}
+    planes = read_xspace(path)
+
+    table: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_us": 0.0})
+
+    def feed(plane: XPlane, line: XLine):
+        for ev in line.events:
+            name = plane.event_metadata.get(ev.metadata_id)
+            if not name:
+                continue
+            row = table[name]
+            row["count"] += 1
+            row["total_us"] += ev.duration_ps / 1e6
+
+    device_planes = [p for p in planes
+                     if p.name.startswith("/device:")]
+    if device_planes:
+        for p in device_planes:
+            for line in p.lines:
+                nm = (line.display_name or line.name).lower()
+                # accelerator planes: per-op lines ("XLA Ops"); skip
+                # step/module summary lines to avoid double counting
+                if "step" in nm or "module" in nm:
+                    continue
+                feed(p, line)
+    else:
+        # CPU runtime: per-thunk op events live on the XLA client
+        # threadpool line ("tf_XLAPjRtCpuClient/..."); skip the paired
+        # "end:" markers and threadpool bookkeeping
+        skip = ("end: ", "ThreadpoolListener", "ThunkExecutor")
+        for p in planes:
+            for line in p.lines:
+                if "XLAPjRtCpuClient" not in line.name:
+                    continue
+                for ev in line.events:
+                    name = p.event_metadata.get(ev.metadata_id)
+                    if not name or name.startswith(skip):
+                        continue
+                    row = table[name]
+                    row["count"] += 1
+                    row["total_us"] += ev.duration_ps / 1e6
+
+    out = {}
+    for name, row in table.items():
+        out[name] = {"count": row["count"],
+                     "total_us": row["total_us"],
+                     "avg_us": row["total_us"] / max(row["count"], 1)}
+    return out
+
+
+def format_table(table: Dict[str, Dict[str, float]], limit: int = 40,
+                 title: str = "Device op statistics") -> str:
+    lines = [title + ":",
+             f"{'Name':<52}{'Count':>8}{'Total(us)':>14}{'Avg(us)':>12}"]
+    rows = sorted(table.items(), key=lambda kv: -kv[1]["total_us"])
+    for name, row in rows[:limit]:
+        nm = name if len(name) <= 50 else name[:47] + "..."
+        lines.append(f"{nm:<52}{row['count']:>8}"
+                     f"{row['total_us']:>14.1f}{row['avg_us']:>12.1f}")
+    total = sum(r["total_us"] for _, r in rows)
+    lines.append(f"{'TOTAL':<52}{'':>8}{total:>14.1f}")
+    return "\n".join(lines)
