@@ -43,7 +43,19 @@ def _st():
         _state.recording = False
         _state.training = False
         _state.tape = []
+    if not hasattr(_state, "grad_ready_hook"):
+        _state.grad_ready_hook = None
     return _state
+
+
+def set_grad_ready_hook(hook) -> None:
+    """Install (or clear, with None) a per-parameter grad-ready hook:
+    ``hook(grad_buffer)`` fires DURING backward the moment a parameter's
+    gradient is final, before later (earlier-layer) vjps dispatch — the
+    enabler for P3-style comm/compute overlap (p3store_dist.h:44-85):
+    an async collective issued from the hook interleaves with the rest
+    of the backward stream."""
+    _st().grad_ready_hook = hook
 
 
 def is_recording() -> bool:
@@ -231,6 +243,23 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             needed.add(id(rec))
             frontier.extend(rec.in_nodes)
 
+    # P3-style overlap (parity: p3store_dist.h:44-85 priority pushes
+    # overlapping backprop): when a grad-ready hook is installed, count
+    # each grad-buffered node's pending consumer records; the moment the
+    # last one runs, deliver the grad into its buffer EARLY and fire the
+    # hook — the hook's async dispatch (e.g. a per-layer allreduce)
+    # then interleaves with the remaining backward ops.
+    hook = _st().grad_ready_hook
+    pending: dict = {}
+    delivered: set = set()
+    if hook is not None:
+        for rec in tape:
+            if id(rec) not in needed:
+                continue
+            for n in rec.in_nodes:
+                if n.grad_array is not None and n.grad_req != "null":
+                    pending[id(n)] = pending.get(id(n), 0) + 1
+
     touched = list(head_nodes)
     with _Scope(None, train_mode):
         for rec in reversed(tape):
@@ -242,6 +271,20 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
             _apply_vjp(rec, out_grads, create_graph)
             touched.extend(rec.in_nodes)
             touched.extend(rec.out_nodes)
+            if hook is not None:
+                for n in rec.in_nodes:
+                    k = id(n)
+                    if k in pending:
+                        pending[k] -= 1
+                        if pending[k] == 0 and n.out_grad is not None \
+                                and k not in delivered:
+                            _deliver_grad(n)
+                            delivered.add(k)
+                            # recording OFF around the hook: its ops
+                            # (slices/collectives) must not land on
+                            # the live tape
+                            with _Scope(False, None):
+                                hook(n.grad_array)
             if not retain_graph:
                 rec.consumed = True
 
@@ -253,35 +296,13 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     # Deliver accumulated grads into attached buffers (write/add semantics),
     # then clear cotangents — grads persist only in grad buffers, matching
     # the reference (AGInfo out_grads freed after Backward).
-    seen = set()
+    seen = set(delivered)
     for node in touched:
         if id(node) in seen:
             continue
         seen.add(id(node))
-        if node.grad_array is not None and node.out_grad is not None \
-                and node.grad_req != "null":
-            from .ndarray.sparse import RowSparseNDArray, merge
-            buf = node.grad_array
-            og = node.out_grad
-            if isinstance(buf, RowSparseNDArray):
-                # grad_stype='row_sparse' buffer: keep grads sparse
-                if not isinstance(og, RowSparseNDArray):
-                    raise MXNetError(
-                        "parameter has grad_stype='row_sparse' but a "
-                        "dense gradient flowed into it; only ops with a "
-                        "sparse backward (Embedding(sparse_grad=True)) "
-                        "may feed a row_sparse grad buffer")
-                if node.grad_req == "add" and buf.nnz:
-                    og = merge(buf, og)
-                buf.data, buf.indices = og.data, og.indices
-            else:
-                if isinstance(og, RowSparseNDArray):
-                    og = og.todense()
-                g = _ct_data(og)
-                if node.grad_req == "add":
-                    buf._data = buf._data + g
-                else:
-                    buf._data = g
+        _deliver_grad(node)
+    for node in touched:
         node.out_grad = None
 
     if not retain_graph:
@@ -396,6 +417,36 @@ def _accumulate(node: _Node, g, create_graph: bool):
         node.out_grad = _recorded_add(node.out_grad, g)
     else:
         node.out_grad = _ct_sum(node.out_grad, g)
+
+
+def _deliver_grad(node: _Node) -> None:
+    """Write a node's accumulated cotangent into its attached grad
+    buffer honoring grad_req (write/add) and row_sparse buffers."""
+    if node.grad_array is None or node.out_grad is None \
+            or node.grad_req == "null":
+        return
+    from .ndarray.sparse import RowSparseNDArray, merge
+    buf = node.grad_array
+    og = node.out_grad
+    if isinstance(buf, RowSparseNDArray):
+        # grad_stype='row_sparse' buffer: keep grads sparse
+        if not isinstance(og, RowSparseNDArray):
+            raise MXNetError(
+                "parameter has grad_stype='row_sparse' but a dense "
+                "gradient flowed into it; only ops with a sparse "
+                "backward (Embedding(sparse_grad=True)) may feed a "
+                "row_sparse grad buffer")
+        if node.grad_req == "add" and buf.nnz:
+            og = merge(buf, og)
+        buf.data, buf.indices = og.data, og.indices
+    else:
+        if isinstance(og, RowSparseNDArray):
+            og = og.todense()
+        g = _ct_data(og)
+        if node.grad_req == "add":
+            buf._data = buf._data + g
+        else:
+            buf._data = g
 
 
 def _ct_sum(a, b):

@@ -114,7 +114,56 @@ class Trainer:
                     self._kvstore.init(str(i), p.data())
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
+            self._maybe_install_p3_hook()
         self._kv_initialized = True
+
+    def _maybe_install_p3_hook(self):
+        """P3 overlap (parity: p3store_dist.h:44-85 — early-layer grads
+        overlap ongoing backprop): with a P3 store, install a grad-ready
+        hook so each parameter's sliced pushpull is DISPATCHED the
+        moment its gradient is final, interleaving the async collective
+        with the rest of the backward stream instead of trailing it.
+        step() then skips re-pushing those params."""
+        from ..kvstore.p3store import P3StoreDist
+        if not isinstance(self._kvstore, P3StoreDist) or \
+                self._update_on_kvstore:
+            return
+        import weakref
+
+        from .. import autograd as ag
+        self._p3_pushed = set()
+        buf2idx = {}
+        for i, p in enumerate(self._params):
+            # 'write' grads only: with grad_req='add' (gradient
+            # accumulation across several backwards) a per-backward
+            # push would allreduce earlier microbatch grads repeatedly;
+            # those params keep the single push in step()
+            if p._grad is not None and p.grad_req == "write":
+                buf2idx[id(p._grad)] = (i, p)
+        self_ref = weakref.ref(self)
+
+        def _p3_hook(buf):
+            trainer = self_ref()
+            if trainer is None:
+                ag.set_grad_ready_hook(None)  # owner died: self-remove
+                return
+            ent = buf2idx.get(id(buf))
+            if ent is None:
+                return
+            i, p = ent
+            if p._trainer is not trainer:
+                # params were handed to a newer Trainer: retire this hook
+                ag.set_grad_ready_hook(None)
+                return
+            if i in trainer._p3_pushed:
+                return  # one push per step-cycle even if backward reruns
+            # priority = -i: the reference convention (layers needed
+            # soonest in the next forward reduce first)
+            trainer._kvstore.pushpull(str(i), p.grad(), out=p.grad(),
+                                      priority=-i)
+            trainer._p3_pushed.add(i)
+
+        ag.set_grad_ready_hook(_p3_hook)
 
     @property
     def learning_rate(self):
@@ -153,12 +202,17 @@ class Trainer:
             # stores (horovod/byteps) interpret a list value as
             # per-device replicas of ONE key, so they also stay on
             # the per-key path.
+            pushed = getattr(self, "_p3_pushed", None)
             for i, param in enumerate(self._params):
                 if param.grad_req != "null" and param._grad is not None:
+                    if pushed is not None and i in pushed:
+                        continue  # already pushed by the backward hook
                     out = (param.data() if self._update_on_kvstore
                            else param.grad())
                     self._kvstore.pushpull(str(i), param.grad(),
                                            out=out, priority=-i)
+            if pushed is not None:
+                pushed.clear()
             return
         # ONE pushpull for every parameter: dist stores fuse all keys
         # into a single collective per dtype (kvstore/dist.py

@@ -140,6 +140,35 @@ class DistKVStore(KVStoreBase):
         self._staleness_bound = max(
             1, getenv_int("MXNET_ASYNC_STALENESS_BOUND", 16))
         self._async_pushes = 0
+        # MXNET_ASYNC_UNCOORDINATED=1: TRULY uncoordinated async via a
+        # host-side parameter server (ps_server.py) — pushes apply
+        # immediately server-side, NO collectives, so ranks may push
+        # different counts (parity: kvstore_dist_server.h:337-346
+        # apply-immediately async; straggler tolerance restored)
+        self._uncoordinated = self._async and os.environ.get(
+            "MXNET_ASYNC_UNCOORDINATED", "0") not in ("0", "")
+        self._ps_server = None
+        self._ps_client = None
+        if self._uncoordinated:
+            self._init_ps()
+
+    def _init_ps(self):
+        from .ps_server import ParamServer, PSClient
+        addr = os.environ.get("MXNET_PS_ADDR")
+        if self._rank == 0:
+            host, port = ("127.0.0.1", 0)
+            if addr:
+                host, port = addr.rsplit(":", 1)
+                port = int(port)
+            self._ps_server = ParamServer(host, port)
+            addr = addr or self._ps_server.address
+            import atexit
+            atexit.register(self._ps_server.stop)
+        elif not addr:
+            raise MXNetError(
+                "uncoordinated dist_async with >1 process needs "
+                "MXNET_PS_ADDR=host:port shared by all ranks")
+        self._ps_client = PSClient(addr)
 
     @staticmethod
     def is_capable(capability: str) -> bool:
@@ -252,6 +281,8 @@ class DistKVStore(KVStoreBase):
         run so the tail window (pushes % K ≠ 0) doesn't leave replicas
         diverged at checkpoint/eval time.  No-op for sync stores and
         single-process runs."""
+        if self._uncoordinated:
+            return  # server holds the single source of truth; pull it
         if self._async and self._nproc > 1 and self._opt_states:
             self._async_reconcile()
 
@@ -288,6 +319,8 @@ class DistKVStore(KVStoreBase):
         vals = value if isinstance(value, (list, tuple)) else [value]
         for k, v in zip(keys, vals):
             self._data[k] = v.copy()
+            if self._uncoordinated:
+                self._ps_client.init(k, v.asnumpy())  # first init wins
 
     def _batched_allreduce(self, kv):
         """All keys of one push ride ONE fused sum collective per dtype
@@ -328,6 +361,25 @@ class DistKVStore(KVStoreBase):
                     local = local + x
             kv.append((k, local))
 
+        if self._uncoordinated:
+            # one-sided: each gradient goes straight to the server and
+            # is applied on arrival; no rendezvous with other ranks.
+            # A server-side optimizer is REQUIRED: without one the
+            # server would accumulate pushes forever and a pull would
+            # return the running gradient sum, not a weight.
+            if self._optimizer is None:
+                raise MXNetError(
+                    "uncoordinated dist_async needs the server-side "
+                    "optimizer (update_on_kvstore=True); do not disable "
+                    "update_on_kvstore in this mode")
+            if self._compression is not None:
+                raise MXNetError(
+                    "gradient compression is not supported on the "
+                    "uncoordinated dist_async path")
+            for k, v in kv:
+                self._ps_client.push(k, v.asnumpy())
+            return
+
         if self._async and self._optimizer is not None and \
                 all(k in self._data for k, _ in kv):
             self._async_apply(kv)       # no collective here
@@ -356,7 +408,11 @@ class DistKVStore(KVStoreBase):
         keys = key if isinstance(key, (list, tuple)) else [key]
         outs = out if isinstance(out, (list, tuple)) else [out]
         for k, o in zip(keys, outs):
-            val = self._data[k]
+            if self._uncoordinated:
+                val = NDArray(self._ps_client.pull(k))
+                self._data[k] = val
+            else:
+                val = self._data[k]
             targets = o if isinstance(o, (list, tuple)) else [o]
             for t in targets:
                 if t is not None:
@@ -395,7 +451,13 @@ class DistKVStore(KVStoreBase):
         collective: the reference API is routinely called from rank 0
         only, and a hidden barrier would deadlock that pattern.  To
         command every shard, call on every rank (e.g. outside a rank
-        guard)."""
+        guard).  In uncoordinated-async mode the command travels to the
+        param-server process over the wire — TRUE remote profiler
+        control (parity: kvstore.h:440 SetServerProfilerCommand,
+        tests/nightly/test_server_profiling.py)."""
+        if self._uncoordinated:
+            self._ps_client.command(str(head), str(body))
+            return
         from .base import _run_server_command
         _run_server_command(head, body)
 
@@ -407,6 +469,10 @@ class DistKVStore(KVStoreBase):
             optimizer = opt_mod.create(optimizer)
         self._optimizer = optimizer
         self._updater = opt_mod.get_updater(optimizer)
+        if self._uncoordinated:
+            # ship the optimizer to the server (parity: rank-0 sending
+            # the pickled optimizer to servers, kvstore.cc:62)
+            self._ps_client.set_optimizer(optimizer)
 
     def set_gradient_compression(self, compression_params):
         from .gradient_compression import GradientCompression

@@ -1,0 +1,228 @@
+"""Host-side parameter server for truly uncoordinated ``dist_async``.
+
+Parity: src/kvstore/kvstore_dist_server.h — ``DataHandleDefault``
+applies each push IMMEDIATELY server-side with no inter-worker
+coupling (:337-346 ``ApplyUpdates`` in async mode), which is what makes
+async tolerate stragglers: ranks may push different numbers of times
+and never rendezvous.  The reference's transport is ps-lite's ZeroMQ
+TCP van; ours is a plain threaded TCP server with length-prefixed
+pickle frames (local/DCN path — the ICI-collective stores remain the
+fast path for synchronous training).
+
+The server runs as a thread inside rank 0's process (the reference
+supports colocated servers the same way via its launcher); clients are
+plain sockets, one per worker process.  The optimizer runs server-side
+(``update_on_kvstore`` semantics): a push carries a gradient, the
+server applies ``optimizer.update`` on its copy of the weight, a pull
+returns the current weight.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as onp
+
+from ..base import MXNetError
+
+__all__ = ["ParamServer", "PSClient"]
+
+
+def _send_msg(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket):
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class ParamServer:
+    """Threaded TCP parameter server applying pushes immediately."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self.address = "%s:%d" % self._sock.getsockname()
+        self._lock = threading.Lock()
+        self._store: Dict[Any, onp.ndarray] = {}
+        self._states: Dict[Any, tuple] = {}
+        self._push_counts: Dict[Any, int] = {}
+        self._optimizer = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    # -- server side -------------------------------------------------------
+    def _serve(self):
+        self._sock.settimeout(0.2)
+        clients = []
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            t = threading.Thread(target=self._client_loop, args=(conn,),
+                                 daemon=True)
+            t.start()
+            clients.append(t)
+        self._sock.close()
+
+    def _client_loop(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = _recv_msg(conn)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                reply = self._handle(msg)
+                _send_msg(conn, reply)
+        finally:
+            conn.close()
+
+    def _handle(self, msg):
+        op = msg[0]
+        try:
+            if op == "init":
+                _, key, val = msg
+                with self._lock:
+                    # first init wins (parity: server Init handler)
+                    self._store.setdefault(key, onp.array(val))
+                return ("ok",)
+            if op == "push":
+                _, key, grad = msg
+                with self._lock:
+                    self._apply_push(key, onp.asarray(grad))
+                return ("ok",)
+            if op == "pull":
+                _, key = msg
+                with self._lock:
+                    if key not in self._store:
+                        return ("err", f"pull: unknown key {key!r}")
+                    return ("ok", self._store[key])
+            if op == "set_optimizer":
+                _, payload = msg
+                with self._lock:
+                    self._optimizer = pickle.loads(payload)
+                return ("ok",)
+            if op == "push_count":
+                _, key = msg
+                return ("ok", self._push_counts.get(key, 0))
+            if op == "command":
+                # remote server command (parity: kvstore.h:440
+                # SetServerProfilerCommand / CommandHandle): runs in the
+                # SERVER's process, so a worker can e.g. start/dump the
+                # profiler of the rank hosting the server
+                _, head, body = msg
+                from .base import _run_server_command
+                _run_server_command(head, body)
+                return ("ok",)
+            if op == "shutdown":
+                self._stop.set()
+                return ("ok",)
+            return ("err", f"unknown op {op!r}")
+        except Exception as e:  # surface server faults to the client
+            return ("err", f"{type(e).__name__}: {e}")
+
+    def _apply_push(self, key, grad: onp.ndarray):
+        """Apply one gradient immediately (kvstore_dist_server.h:337
+        DataHandleDefault async mode: no aggregation buffer, no wait
+        for other workers)."""
+        self._push_counts[key] = self._push_counts.get(key, 0) + 1
+        if key not in self._store:
+            # push before init: adopt the gradient as the value
+            # (reference server inits from the first blob it sees)
+            self._store[key] = grad.copy()
+            return
+        if self._optimizer is None:
+            # no optimizer: plain accumulation semantics
+            self._store[key] = self._store[key] + grad
+            return
+        from ..ndarray import NDArray
+
+        weight = NDArray(self._store[key])
+        g = NDArray(grad)
+        if key not in self._states:
+            self._states[key] = self._optimizer.create_state(key, weight)
+        self._optimizer.update(key, weight, g, self._states[key])
+        self._store[key] = onp.asarray(weight.asnumpy())
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+class PSClient:
+    """One worker's connection to the ParamServer (thread-safe)."""
+
+    def __init__(self, address: str, timeout: float = 60.0,
+                 retries: int = 50):
+        host, port = address.rsplit(":", 1)
+        last = None
+        for _ in range(retries):  # the server thread may still be booting
+            try:
+                self._sock = socket.create_connection((host, int(port)),
+                                                      timeout=timeout)
+                break
+            except OSError as e:
+                last = e
+                import time
+                time.sleep(0.2)
+        else:
+            raise MXNetError(f"cannot reach param server at {address}: "
+                             f"{last}")
+        self._lock = threading.Lock()
+
+    def _call(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            reply = _recv_msg(self._sock)
+        if reply[0] != "ok":
+            raise MXNetError(f"param server error: {reply[1]}")
+        return reply[1] if len(reply) > 1 else None
+
+    def init(self, key, val: onp.ndarray):
+        self._call("init", key, onp.asarray(val))
+
+    def push(self, key, grad: onp.ndarray):
+        self._call("push", key, onp.asarray(grad))
+
+    def pull(self, key) -> onp.ndarray:
+        return self._call("pull", key)
+
+    def set_optimizer(self, optimizer):
+        self._call("set_optimizer",
+                   pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL))
+
+    def push_count(self, key) -> int:
+        return self._call("push_count", key)
+
+    def command(self, head: str, body: str = "") -> None:
+        self._call("command", str(head), body)
+
+    def shutdown(self):
+        try:
+            self._call("shutdown")
+        except MXNetError:
+            pass
+
+    def close(self):
+        self._sock.close()

@@ -431,9 +431,8 @@ def reduce_list(values) -> RowSparseNDArray:
 
 # jit cache for the lazy update kernels: ONE jax.jit wrapper per
 # (kind, static hyperparams); jax's own signature cache compiles per
-# (vocab, dim, nnz) shape as batches with new nnz appear.  Weight/state
-# buffers are donated — the update is in-place in HBM, cost O(nnz*dim)
-# compute + O(vocab) aliased buffer, with no dense gradient ever built.
+# (vocab, dim, nnz) shape as batches with new nnz appear.  Cost is
+# O(nnz*dim) compute; no dense gradient is ever built.
 _LAZY_JITS: dict = {}
 
 
@@ -457,7 +456,6 @@ def _lazy_kernel(kind: str, statics: tuple):
             w_rows = w[rows]
             g = prep(vals, w_rows, wd)
             return (w.at[rows].set(w_rows - lr * g),)
-        donate = (2,)
     elif kind == "sgd_mom_update":
         mom_c = st.get("momentum", 0.0)
 
@@ -466,7 +464,6 @@ def _lazy_kernel(kind: str, statics: tuple):
             g = prep(vals, w_rows, wd)
             m_rows = mom_c * mom[rows] - lr * g
             return (w.at[rows].add(m_rows), mom.at[rows].set(m_rows))
-        donate = (2, 5)
     elif kind == "adagrad_update":
         eps = st.get("epsilon", 1e-7)
 
@@ -476,7 +473,6 @@ def _lazy_kernel(kind: str, statics: tuple):
             h_rows = hist[rows] + g * g
             step = lr * g / (jnp.sqrt(h_rows) + eps)
             return (w.at[rows].add(-step), hist.at[rows].set(h_rows))
-        donate = (2, 5)
     elif kind == "adam_update":
         b1 = st.get("beta1", 0.9)
         b2 = st.get("beta2", 0.999)
@@ -493,11 +489,14 @@ def _lazy_kernel(kind: str, statics: tuple):
             step = lr * m_rows / (jnp.sqrt(v_rows) + eps)
             return (w.at[rows].add(-step), mean.at[rows].set(m_rows),
                     var.at[rows].set(v_rows))
-        donate = (2, 5, 6)
     else:
         raise MXNetError(f"no row_sparse kernel for {kind!r}")
 
-    fn = jax.jit(raw, donate_argnums=donate)
+    # NO buffer donation: the weight array may be saved on the autograd
+    # tape (the Embedding forward's record) — donating it would
+    # invalidate a later backward replay.  Matches the dense
+    # _jitted_update convention.
+    fn = jax.jit(raw)
     _LAZY_JITS[key] = fn
     return fn
 

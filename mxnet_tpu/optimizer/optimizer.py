@@ -276,8 +276,8 @@ class Optimizer:
         self.update(index, weight, grad.todense(), state)
 
     def update_multi_precision(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
         if self.multi_precision and weight.dtype == onp.float16:
-            from ..ndarray.sparse import RowSparseNDArray
             master, sub_state = state[0], state[1:]
             if isinstance(grad, RowSparseNDArray):
                 grad32 = RowSparseNDArray(
@@ -287,6 +287,12 @@ class Optimizer:
                 grad32 = NDArray(grad._data.astype(jnp.float32))
             self.update(index, master, grad32, sub_state)
             weight._rebind(master._data.astype(weight._data.dtype))
+        elif isinstance(grad, RowSparseNDArray):
+            # route through the sparse dispatcher here too so optimizers
+            # that OVERRIDE update() (ftml/sgld/...) still reach the
+            # lazy kernel or the documented densify fallback instead of
+            # crashing on the sparse container
+            self._update_rsp(index, weight, grad, state)
         else:
             self.update(index, weight, grad, state)
 

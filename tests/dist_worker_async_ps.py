@@ -1,0 +1,73 @@
+"""Worker body for the UNCOORDINATED dist_async test: ranks push
+intentionally DIFFERENT numbers of gradients and still converge.
+
+Parity target: the reference async server applies each push immediately
+with no inter-worker coupling (kvstore_dist_server.h:337-346) — the
+property this test pins is exactly the one the collective-based SSP
+mode cannot provide (its ranks must make equal push counts).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _dist_bootstrap  # noqa: F401 (must run before jax users)
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import create as kv_create
+from mxnet_tpu.ndarray import NDArray
+
+
+def main(out_dir):
+    assert os.environ.get("MXNET_ASYNC_UNCOORDINATED") == "1"
+    kv = kv_create("dist_async")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2
+
+    kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.1))
+
+    target = onp.linspace(-1.0, 1.0, 12).astype("float32").reshape(3, 4)
+    w0 = onp.zeros((3, 4), "float32")
+    kv.init("w", NDArray(w0))
+
+    # rank 0 pushes 35 times, rank 1 pushes 60 — unequal BY DESIGN.
+    n_steps = 35 if rank == 0 else 60
+    out = NDArray(onp.zeros_like(w0))
+    for _ in range(n_steps):
+        kv.pull("w", out=out)
+        grad = out.asnumpy() - target      # d/dw 0.5||w-target||^2
+        kv.push("w", NDArray(grad))
+
+    # remote profiler control (parity: kvstore.h:440
+    # SetServerProfilerCommand): rank 1 — a DIFFERENT process from the
+    # server — drives the server-process profiler over the wire
+    if rank == 1:
+        import json
+        prof_file = os.path.join(out_dir, "server_profile.json")
+        kv.send_command_to_servers(
+            "profiler_set_config",
+            json.dumps({"profile_all": True, "filename": prof_file}))
+        kv.send_command_to_servers("profiler_start")
+        kv.send_command_to_servers("profiler_stop")
+        kv.send_command_to_servers("profiler_dump")
+        assert os.path.exists(prof_file), \
+            "remote profiler dump did not materialize on the server"
+
+    # no rendezvous was needed above; one explicit barrier only to
+    # sequence the final assertions after both ranks finished
+    kv.barrier()
+
+    kv.pull("w", out=out)
+    onp.testing.assert_allclose(out.asnumpy(), target, rtol=0, atol=1e-2)
+
+    if rank == 0:
+        total = kv._ps_client.push_count("w")
+        assert total == 35 + 60, f"server saw {total} pushes, want 95"
+
+    with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -21,9 +21,11 @@ def _free_port():
     return port
 
 
-def _run_launcher(n, worker, tmp_path):
+def _run_launcher(n, worker, tmp_path, extra_env=None):
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
+    if extra_env:
+        env.update(extra_env)
     # workers set their own xla_force_host_platform_device_count
     env.pop("XLA_FLAGS", None)
     cmd = [sys.executable, os.path.join(_REPO, "tools", "launch.py"),
@@ -49,3 +51,15 @@ def test_dist_sync_three_processes(tmp_path):
     """Rank-count-generic paths at N=3: allreduce, uneven ZeRO tail
     (7 elems -> 3/3/1 slices), fused multi-key batching."""
     _run_launcher(3, "dist_worker_n.py", tmp_path)
+
+
+@pytest.mark.timeout(600)
+def test_dist_async_uncoordinated_unequal_push_counts(tmp_path):
+    """Truly uncoordinated async (host parameter server): rank 0 pushes
+    35 times, rank 1 pushes 60, no rendezvous — both converge to the
+    target (parity: kvstore_dist_server.h:337-346 apply-immediately
+    semantics; VERDICT r3 item 7)."""
+    _run_launcher(2, "dist_worker_async_ps.py", tmp_path, extra_env={
+        "MXNET_ASYNC_UNCOORDINATED": "1",
+        "MXNET_PS_ADDR": f"127.0.0.1:{_free_port()}",
+    })

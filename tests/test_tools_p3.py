@@ -78,3 +78,106 @@ def test_rec2idx_tool(tmp_path):
     # idx positions let a reader seek directly
     w = recordio.MXIndexedRecordIO(str(tmp_path / "data.idx"), rec, "r")
     assert w.read_idx(3) == b"record-3"
+
+
+def test_p3_overlap_pushes_interleave_with_backward():
+    """The P3 re-landing (VERDICT r3 item 9): with a P3 store, each
+    parameter's pushpull is DISPATCHED during backward — before the
+    last vjp executes — instead of trailing the whole backward.  The
+    event sequence is the profiler evidence of dispatch-level overlap
+    (on real chips the async collectives then overlap backprop in the
+    runtime streams)."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.kvstore.p3store import P3StoreDist
+
+    events = []
+    orig_vjp = ag._apply_vjp
+    orig_pp = P3StoreDist.pushpull
+
+    def spy_vjp(*a, **kw):
+        events.append("vjp")
+        return orig_vjp(*a, **kw)
+
+    def spy_pp(self, *a, **kw):
+        events.append("push")
+        return orig_pp(self, *a, **kw)
+
+    net = nn.HybridSequential()
+    for _ in range(6):
+        net.add(nn.Dense(16, activation="relu"))
+    net.add(nn.Dense(2))
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01},
+                            kvstore="p3store_dist")
+    x = nd.array(onp.random.RandomState(0).randn(4, 8).astype("float32"))
+
+    try:
+        ag._apply_vjp = spy_vjp
+        P3StoreDist.pushpull = spy_pp
+        # first step installs the hook lazily (kvstore init)
+        with autograd.record():
+            net(x).sum().backward()
+        trainer.step(1)
+        events.clear()
+        # steady state: pushes must interleave with backward vjps
+        with autograd.record():
+            net(x).sum().backward()
+        trainer.step(1)
+    finally:
+        ag._apply_vjp = orig_vjp
+        P3StoreDist.pushpull = orig_pp
+        ag.set_grad_ready_hook(None)
+
+    assert "push" in events and "vjp" in events
+    last_vjp = len(events) - 1 - events[::-1].index("vjp")
+    first_push = events.index("push")
+    n_before = sum(1 for e in events[:last_vjp] if e == "push")
+    assert first_push < last_vjp and n_before >= 3, (
+        f"pushes do not interleave with backward: {events}")
+    # every param was pushed exactly once (hook + step dedup)
+    assert events.count("push") == len(net.collect_params())
+
+
+def test_p3_overlap_numerics_match_plain_store():
+    """Overlapped P3 training equals the same run on a plain store."""
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu import autograd as ag
+    from mxnet_tpu.gluon import nn
+
+    results = {}
+    saved = None
+    x = nd.array(onp.random.RandomState(5).randn(6, 4).astype("float32"))
+    for kvs in ("device", "p3store_dist"):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        net.initialize()
+        net(x)  # materialize deferred shapes
+        if saved is None:
+            saved = {k: p.data().asnumpy()
+                     for k, p in net.collect_params().items()}
+        else:
+            for k, p in net.collect_params().items():
+                p.set_data(nd.array(saved[k]))
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1}, kvstore=kvs)
+        try:
+            for _ in range(3):
+                with autograd.record():
+                    loss = (net(x) ** 2).sum()
+                loss.backward()
+                trainer.step(1)
+        finally:
+            ag.set_grad_ready_hook(None)
+        results[kvs] = {k: p.data().asnumpy()
+                        for k, p in net.collect_params().items()}
+    for k in results["device"]:
+        onp.testing.assert_allclose(results["p3store_dist"][k],
+                                    results["device"][k],
+                                    rtol=1e-6, atol=1e-7)
