@@ -475,7 +475,8 @@ class HybridBlock(Block):
     # -- export (parity: HybridBlock.export, block.py:1296: symbol json +
     #    params; here a *serialized StableHLO executable* via jax.export,
     #    loadable anywhere by SymbolBlock.imports) ------------------------
-    def export(self, path: str, epoch: int = 0):
+    def export(self, path: str, epoch: int = 0,
+               params_format: str = "npz"):
         """Serialize every compiled signature of this block.
 
         Writes ``{path}-symbol.json`` (manifest + base64 StableHLO
@@ -485,6 +486,11 @@ class HybridBlock(Block):
         process with no access to this Python class (parity:
         gluon/block.py:1296 "export for use with other language
         bindings").
+
+        ``params_format="mxnet"`` writes the .params file in the
+        reference's binary wire format with ``arg:``-prefixed names
+        (ndarray.cc:1679) — the artifact actual MXNet's
+        ``load_parameters``/``SymbolBlock`` can read directly.
         """
         if not self._cached_graphs:
             raise MXNetError(
@@ -495,7 +501,19 @@ class HybridBlock(Block):
         import json
         from jax import export as jexp
 
-        self.save_parameters(f"{path}-{epoch:04d}.params")
+        pfile = f"{path}-{epoch:04d}.params"
+        if params_format == "mxnet":
+            from ..ndarray import save as nd_save
+            # MXNet consumers split by prefix: trainable -> "arg:",
+            # auxiliary states (grad_req null: BN running stats) ->
+            # "aux:" (reference block.py export / model.load_checkpoint)
+            named = {}
+            for k, v in self.collect_params().items():
+                prefix = "aux" if v.grad_req == "null" else "arg"
+                named[f"{prefix}:{k}"] = v.data()
+            nd_save(pfile, named, format="mxnet")
+        else:
+            self.save_parameters(pfile)
         params = self.collect_params()
         pkeys = list(params.keys())
         pvals = [params[k] for k in pkeys]

@@ -151,12 +151,17 @@ class Trainer:
             if ent is None:
                 return
             i, p = ent
+            if p._grad is not buf:
+                # the param's grad buffer was re-created (force_reinit):
+                # a reused id() must not push another param's gradient
+                return
             if p._trainer is not trainer:
                 # params were handed to a newer Trainer: retire this hook
                 ag.set_grad_ready_hook(None)
                 return
-            if i in trainer._p3_pushed:
-                return  # one push per step-cycle even if backward reruns
+            # NOTE: no per-step dedup here — if backward runs again
+            # before step(), the re-push re-reduces the CURRENT buffer,
+            # keeping step()'s skip (below) correct for the last grads
             # priority = -i: the reference convention (layers needed
             # soonest in the next forward reduce first)
             trainer._kvstore.pushpull(str(i), p.grad(), out=p.grad(),
@@ -175,12 +180,24 @@ class Trainer:
 
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
+        self._reship_server_optimizer()
+
+    def _reship_server_optimizer(self):
+        """Uncoordinated-async PS holds its own optimizer copy: re-ship
+        when a host-side hyperparameter (lr, rescale_grad) changes so
+        server-side updates don't run with stale settings."""
+        if self._kv_initialized and self._update_on_kvstore and \
+                getattr(self._kvstore, "_uncoordinated", False):
+            self._kvstore.set_optimizer(self._optimizer)
 
     # -- training step (parity: trainer.py step:334) -----------------------
     def step(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        new_rescale = self._scale / batch_size
+        if new_rescale != self._optimizer.rescale_grad:
+            self._optimizer.rescale_grad = new_rescale
+            self._reship_server_optimizer()
         self._allreduce_grads()
         self._update(ignore_stale_grad)
 

@@ -55,6 +55,8 @@ from .base import KVStoreBase
 __all__ = ["DistKVStore", "init_distributed"]
 
 _initialized = False
+_PS_SERVER = None      # process-wide uncoordinated-async server
+_PS_ADDR = None
 
 
 def init_distributed(coordinator_address=None, num_processes=None,
@@ -156,14 +158,20 @@ class DistKVStore(KVStoreBase):
         from .ps_server import ParamServer, PSClient
         addr = os.environ.get("MXNET_PS_ADDR")
         if self._rank == 0:
-            host, port = ("127.0.0.1", 0)
-            if addr:
-                host, port = addr.rsplit(":", 1)
-                port = int(port)
-            self._ps_server = ParamServer(host, port)
-            addr = addr or self._ps_server.address
-            import atexit
-            atexit.register(self._ps_server.stop)
+            # ONE server per process: a second dist_async store reuses
+            # it (a fresh bind on the same port would fail)
+            global _PS_SERVER, _PS_ADDR
+            if _PS_SERVER is None:
+                host, port = ("127.0.0.1", 0)
+                if addr:
+                    host, port = addr.rsplit(":", 1)
+                    port = int(port)
+                _PS_SERVER = ParamServer(host, port)
+                _PS_ADDR = addr or _PS_SERVER.address
+                import atexit
+                atexit.register(_PS_SERVER.stop)
+            self._ps_server = _PS_SERVER
+            addr = _PS_ADDR
         elif not addr:
             raise MXNetError(
                 "uncoordinated dist_async with >1 process needs "
@@ -428,6 +436,16 @@ class DistKVStore(KVStoreBase):
     def broadcast(self, key, value, out, priority=0):
         """Broadcast rank-0's value to all (parity: KVStoreDist init +
         pull; multihost broadcast over DCN)."""
+        if self._uncoordinated:
+            v = value if isinstance(value, NDArray) else value[0]
+            if self._nproc > 1:
+                from jax.experimental import multihost_utils
+                v = NDArray(multihost_utils.broadcast_one_to_all(v._data))
+            self._data[key] = v
+            self._ps_client.init(key, v.asnumpy())  # register server-side
+            if out is not None:
+                self.pull(key, out, priority)
+            return
         if self._nproc > 1:
             from jax.experimental import multihost_utils
             v = value if isinstance(value, NDArray) else value[0]
@@ -471,8 +489,14 @@ class DistKVStore(KVStoreBase):
         self._updater = opt_mod.get_updater(optimizer)
         if self._uncoordinated:
             # ship the optimizer to the server (parity: rank-0 sending
-            # the pickled optimizer to servers, kvstore.cc:62)
-            self._ps_client.set_optimizer(optimizer)
+            # the pickled optimizer to servers, kvstore.cc:62).  A
+            # sanitized copy: gluon wires param_dict -> Parameter ->
+            # Trainer -> this store -> a live socket, which can't (and
+            # shouldn't) travel
+            import copy as _copy
+            clean = _copy.copy(optimizer)
+            clean.param_dict = {}
+            self._ps_client.set_optimizer(clean)
 
     def set_gradient_compression(self, compression_params):
         from .gradient_compression import GradientCompression

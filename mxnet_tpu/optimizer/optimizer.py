@@ -285,7 +285,10 @@ class Optimizer:
                     grad.shape)
             else:
                 grad32 = NDArray(grad._data.astype(jnp.float32))
-            self.update(index, master, grad32, sub_state)
+            if isinstance(grad32, RowSparseNDArray):
+                self._update_rsp(index, master, grad32, sub_state)
+            else:
+                self.update(index, master, grad32, sub_state)
             weight._rebind(master._data.astype(weight._data.dtype))
         elif isinstance(grad, RowSparseNDArray):
             # route through the sparse dispatcher here too so optimizers

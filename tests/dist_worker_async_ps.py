@@ -65,6 +65,32 @@ def main(out_dir):
         total = kv._ps_client.push_count("w")
         assert total == 35 + 60, f"server saw {total} pushes, want 95"
 
+    # gluon Trainer user path: update_on_kvstore -> the optimizer is
+    # pickled (sanitized) to the server; ranks run UNEQUAL step counts
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(1, in_units=1, use_bias=False)
+    net.initialize()
+    net.weight.set_data(NDArray(onp.zeros((1, 1), "float32")))
+    kv2 = kv_create("dist_async")
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.2}, kvstore=kv2)
+    xs = NDArray(onp.array([[1.0], [2.0]], "float32"))
+    ys = NDArray(onp.array([[3.0], [6.0]], "float32"))   # w* = 3
+    steps = 20 if rank == 0 else 33
+    for _ in range(steps):
+        with autograd.record():
+            loss = ((net(xs) - ys) ** 2).mean()
+        loss.backward()
+        trainer.step(2)                  # rescale reaches the server
+    kv2.barrier()
+    # both ranks read the SERVER weight after the final step
+    w = NDArray(onp.zeros((1, 1), "float32"))
+    kv2.pull("0", out=w)
+    got = float(w.asnumpy()[0, 0])
+    assert abs(got - 3.0) < 0.2, f"trainer async PS did not converge: {got}"
+
     with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
         f.write("ok")
 

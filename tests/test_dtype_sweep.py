@@ -1,0 +1,104 @@
+"""Low-precision forward sweep over the op catalog.
+
+bfloat16 is the TPU headline dtype (MXU-native); float16 is the
+reference's AMP dtype.  Every op with a float32 fd-sweep spec is run
+with its float inputs cast to bf16 (and a sample in f16), asserting the
+op (a) accepts the dtype, (b) returns finite values, (c) stays close to
+the float32 result at half-precision tolerance.  Catches
+dtype-promotion crashes and silent f32 upcasts the way the reference's
+AMP lists + test_contrib_amp do.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ops.registry import invoke
+
+from grad_sweep_specs import SPECS, _rng
+
+# ops whose reference kernels are float32-only or numerically
+# inappropriate at half precision (each with the reason)
+SKIP = {
+    # LAPACK-backed: jax lowers these through f32/f64 lapack kernels;
+    # the reference's linalg ops are likewise fp32/fp64-only
+    "_linalg_potrf": "LAPACK f32-only (reference la_op likewise)",
+    "_linalg_potri": "LAPACK f32-only",
+    "_linalg_gelqf": "LAPACK f32-only",
+    "_linalg_syevd": "LAPACK f32-only",
+    "_linalg_det": "LAPACK f32-only",
+    "_linalg_slogdet": "LAPACK f32-only",
+    "_linalg_inverse": "LAPACK f32-only",
+    "_npi_cholesky": "LAPACK f32-only",
+    "_npi_solve": "LAPACK f32-only",
+    "_npi_tensorinv": "LAPACK f32-only",
+    "_npi_tensorsolve": "LAPACK f32-only",
+    "_npi_pinv": "LAPACK f32-only (SVD)",
+    "_npi_pinv_scalar_rcond": "LAPACK f32-only (SVD)",
+    "_npi_svd": "LAPACK f32-only (SVD)",
+    "_npi_eigh": "LAPACK f32-only (eigh)",
+    "_npi_eigvalsh": "LAPACK f32-only (eigh)",
+    "_npi_lstsq": "LAPACK f32-only",
+    "_linalg_trsm": "triangular solve lowers via LAPACK",
+    "_contrib_hawkesll": "log-likelihood scan accumulates in f32 by "
+                         "design (matches reference CPU kernel)",
+    "_random_pdf_gamma": "gammaln in half precision overflows the pdf "
+                         "normalizer",
+    "erfinv": "erfinv half-precision ULP error exceeds comparison tol "
+              "near the domain edge",
+    "digamma": "polygamma series is f32-only in jax",
+    "gamma": "gamma function overflows f16 for |x|>2 inputs",
+    "gammaln": "lgamma accuracy in f16 below comparison tol",
+    "_npi_interp": "jnp.interp calls numpy finfo on the input dtype, "
+                   "which rejects bfloat16 (reference interp is "
+                   "f32/f64-only as well)",
+}
+
+
+def _cast(a, dt):
+    if a is None or a.dtype.kind != "f":
+        return a
+    return a.astype(dt)
+
+
+def _run(name, spec, dt, rtol, atol):
+    r = _rng(name)
+    raw = [b(r) if b is not None else None for b in spec["arrays"]]
+    f32 = [NDArray(a) if a is not None else None for a in raw]
+    low = [NDArray(_cast(a, dt)) if a is not None else None for a in raw]
+
+    def go(arrs):
+        out = invoke(name, arrs, **spec["params"])
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return [o.asnumpy() for o in outs]
+
+    ref = go(f32)
+    got = go(low)
+    assert len(ref) == len(got)
+    for rf, gt in zip(ref, got):
+        if rf.dtype.kind != "f":
+            continue
+        g64 = gt.astype(onp.float64)
+        assert onp.isfinite(g64[onp.isfinite(rf.astype(onp.float64))]).all(), \
+            f"{name}: non-finite {dt} output where f32 is finite"
+        onp.testing.assert_allclose(
+            g64, rf.astype(onp.float64), rtol=rtol, atol=atol,
+            err_msg=f"{name} diverges from f32 beyond {dt} tolerance")
+
+
+@pytest.mark.parametrize("name", sorted(n for n in SPECS if n not in SKIP))
+def test_bfloat16_forward(name):
+    import ml_dtypes
+    _run(name, SPECS[name], ml_dtypes.bfloat16, rtol=6e-2, atol=6e-2)
+
+
+# f16 on a sample of families (full sweep would double runtime for
+# little extra signal — bf16 is the TPU dtype; f16 is spot-checked)
+_F16_SAMPLE = ["Convolution", "FullyConnected", "BatchNorm", "softmax",
+               "dot", "elemwise_add", "tanh", "LayerNorm", "Pooling",
+               "_npi_mean", "matmul", "Activation"]
+
+
+@pytest.mark.parametrize("name", _F16_SAMPLE)
+def test_float16_forward(name):
+    _run(name, SPECS[name], onp.float16, rtol=4e-2, atol=4e-2)

@@ -1,0 +1,149 @@
+"""Edge semantics of the eager-dispatch jit cache (VERDICT r3 item 10).
+
+Pins the correctness-critical behaviors of ops/registry.py under churn:
+_JitEntry latch/fallback, _MAX_JIT_SIGS shape churn, _MAX_PARTIALS
+overflow, unhashable params, MXNET_SAFE_ACCUMULATION toggles mid-run,
+and impure ops staying uncached.
+"""
+import numpy as onp
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ops import registry
+from mxnet_tpu.ops.registry import (_JitEntry, _MAX_JIT_SIGS,
+                                    _MAX_PARTIALS, bound_fn, get, invoke)
+
+
+class TestJitEntryLatch:
+    def test_jit_failure_latches_to_eager(self):
+        """A fn that cannot trace (host round trip) but runs eagerly:
+        first call falls back AND latches; later calls skip jit."""
+        calls = {"jit_attempts": 0}
+
+        def fn(a):
+            # host-side conversion: fine eagerly, ConcretizationTypeError
+            # under jit tracing
+            return jnp.asarray(onp.asarray(a) * 2.0)
+
+        entry = _JitEntry(fn)
+        x = jnp.ones((3,))
+        out = entry.run(fn, [x])
+        onp.testing.assert_allclose(onp.asarray(out), 2.0)
+        assert entry.disabled is True
+        # subsequent calls run eager (and still compute correctly)
+        out2 = entry.run(fn, [x * 2])
+        onp.testing.assert_allclose(onp.asarray(out2), 4.0)
+
+    def test_input_error_raises_without_latching(self):
+        """When the eager re-run ALSO fails, it is a user error: raise
+        through and do NOT demote the op."""
+        def fn(a, b):
+            return a @ b
+
+        entry = _JitEntry(fn)
+        good_a, good_b = jnp.ones((2, 3)), jnp.ones((3, 2))
+        entry.run(fn, [good_a, good_b])
+        assert entry.disabled is False
+        with pytest.raises(Exception):
+            entry.run(fn, [jnp.ones((2, 3)), jnp.ones((4, 2))])
+        assert entry.disabled is False      # one bad call != broken op
+        out = entry.run(fn, [good_a, good_b])
+        assert out.shape == (2, 2)
+
+    def test_shape_churn_past_budget_disables_jit(self):
+        """More than _MAX_JIT_SIGS distinct signatures: stop compiling
+        (one executable per shape would leak); correctness unchanged."""
+        def fn(a):
+            return a * 3.0
+
+        entry = _JitEntry(fn)
+        for n in range(_MAX_JIT_SIGS):
+            entry.run(fn, [jnp.ones((n + 1,))])
+        assert entry.disabled is False
+        assert len(entry.sigs) == _MAX_JIT_SIGS
+        out = entry.run(fn, [jnp.ones((100,))])   # budget exceeded
+        assert entry.disabled is True
+        onp.testing.assert_allclose(onp.asarray(out), 3.0)
+        # known signatures keep working after the latch too
+        out = entry.run(fn, [jnp.ones((1,))])
+        onp.testing.assert_allclose(onp.asarray(out), 3.0)
+
+
+class TestPartialCache:
+    def test_unhashable_params_bypass_cache(self):
+        op = get("_plus_scalar")
+        before = dict(op._partials)
+        fn, jentry = bound_fn(op, {"scalar": onp.arange(3)})  # unhashable
+        assert jentry is None
+        assert op._partials == before       # nothing cached
+        out = fn(jnp.zeros((3,)))
+        onp.testing.assert_allclose(onp.asarray(out), [0, 1, 2])
+
+    def test_partials_overflow_stops_caching_but_keeps_working(self):
+        op = get("_power_scalar")
+        op._partials.clear()
+        x = NDArray(onp.full((2,), 2.0, "float32"))
+        for i in range(_MAX_PARTIALS + 10):
+            out = invoke("_power_scalar", [x], scalar=1.0 + i * 1e-6)
+            assert out.shape == (2,)
+        assert len(op._partials) <= _MAX_PARTIALS
+        # cached path still correct for a params value seen before cap
+        out = invoke("_power_scalar", [x], scalar=1.0)
+        onp.testing.assert_allclose(out.asnumpy(), 2.0)
+
+    def test_safe_accumulation_toggle_mid_run(self, monkeypatch):
+        """Toggling MXNET_SAFE_ACCUMULATION between calls must not
+        replay a stale executable: the env participates in the cache
+        key, and the numerics change accordingly."""
+        # fp16 softmax over large-magnitude logits: unsafe accumulation
+        # in fp16 loses the small terms; safe accumulation computes the
+        # log-sum-exp in f32
+        x = NDArray(onp.array([[0.0, 11.0]], "float16"))
+        monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "0")
+        out_unsafe = invoke("softmax", [x], axis=-1).asnumpy()
+        monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "1")
+        out_safe = invoke("softmax", [x], axis=-1).asnumpy()
+        # both are valid softmaxes...
+        onp.testing.assert_allclose(out_unsafe.sum(), 1.0, rtol=1e-2)
+        onp.testing.assert_allclose(out_safe.sum(), 1.0, rtol=1e-2)
+        # ...but they must come from DIFFERENT compiled partials
+        op = get("softmax")
+        keys = {k for k in op._partials}
+        assert len({k[-1] for k in keys}) == 2 or \
+            any(k[1] != keys.copy().pop()[1] for k in keys), (
+                "safe-accumulation toggle did not fork the cache key")
+        # flipping back replays the original numerics exactly
+        monkeypatch.setenv("MXNET_SAFE_ACCUMULATION", "0")
+        out_again = invoke("softmax", [x], axis=-1).asnumpy()
+        onp.testing.assert_array_equal(out_again, out_unsafe)
+
+
+class TestImpureOps:
+    def test_params_dependent_impurity_gates_the_jit_cache(self):
+        """RNN registers impure=callable(params): with inter-layer
+        dropout (p>0) it draws host PRNG state per call, so it must
+        NEVER be cached or jitted; with p=0 it is pure and gets a jit
+        entry.  Pins the conditional-impurity contract."""
+        op = get("RNN")
+        params = dict(state_size=4, num_layers=2, mode="lstm")
+        fn, jentry = bound_fn(op, dict(params, p=0.5))
+        assert jentry is None, "dropout-RNN must not be jit-cached"
+        fn2, jentry2 = bound_fn(op, dict(params, p=0.0))
+        assert jentry2 is not None, "dropout-free RNN should jit"
+
+    def test_samplers_thread_fresh_keys_through_the_cached_partial(self):
+        """Random samplers are PURE fns of an explicit key input; the
+        jit cache replays the compiled executable but the caller
+        threads a fresh key per call — two draws must differ even
+        though the partial/jit entry is shared."""
+        op = get("_random_uniform")
+        fn, jentry = bound_fn(op, {"shape": (4,)})
+        assert jentry is not None       # pure given the key input
+        a = mx.nd.random.uniform(shape=(4,)).asnumpy()
+        b = mx.nd.random.uniform(shape=(4,)).asnumpy()
+        assert not onp.array_equal(a, b), \
+            "cached sampler replayed a frozen PRNG draw"

@@ -206,3 +206,26 @@ class TestGluonLoad:
         net2.load_parameters(f)
         onp.testing.assert_array_equal(net.weight.data().asnumpy(),
                                        net2.weight.data().asnumpy())
+
+
+class TestExportBinaryParams:
+    def test_export_writes_reference_format_with_arg_prefixes(self, tmp_path):
+        from mxnet_tpu.gluon import nn
+        net = nn.HybridSequential()
+        net.add(nn.Dense(4, in_units=3))
+        net.initialize()
+        net.hybridize()
+        net(mx.nd.ones((1, 3)))
+        prefix = str(tmp_path / "model")
+        net.export(prefix, params_format="mxnet")
+        pfile = prefix + "-0000.params"
+        assert ls.is_mxnet_format(open(pfile, "rb").read(8))
+        loaded = mx.nd.load(pfile)
+        assert all(k.startswith("arg:") for k in loaded)
+        # round trip through load_parameters (prefix stripping)
+        net2 = nn.HybridSequential()
+        net2.add(nn.Dense(4, in_units=3))
+        net2.load_parameters(pfile)
+        onp.testing.assert_array_equal(
+            net(mx.nd.ones((1, 3))).asnumpy(),
+            net2(mx.nd.ones((1, 3))).asnumpy())
