@@ -504,13 +504,17 @@ class HybridBlock(Block):
         pfile = f"{path}-{epoch:04d}.params"
         if params_format == "mxnet":
             from ..ndarray import save as nd_save
-            # MXNet consumers split by prefix: trainable -> "arg:",
-            # auxiliary states (grad_req null: BN running stats) ->
-            # "aux:" (reference block.py export / model.load_checkpoint)
+            # MXNet consumers split by prefix: arguments -> "arg:",
+            # auxiliary STATES -> "aux:".  The aux set is determined by
+            # the parameter's ROLE (running statistics), not grad_req —
+            # a frozen trainable weight (grad_req forced to 'null') is
+            # still an argument of the symbol
             named = {}
             for k, v in self.collect_params().items():
-                prefix = "aux" if v.grad_req == "null" else "arg"
-                named[f"{prefix}:{k}"] = v.data()
+                leaf = k.rsplit(".", 1)[-1]
+                is_aux = v.grad_req == "null" and (
+                    leaf.startswith(("running_", "moving_")))
+                named[f"{'aux' if is_aux else 'arg'}:{k}"] = v.data()
             nd_save(pfile, named, format="mxnet")
         else:
             self.save_parameters(pfile)

@@ -494,8 +494,14 @@ class DistKVStore(KVStoreBase):
             # Trainer -> this store -> a live socket, which can't (and
             # shouldn't) travel
             import copy as _copy
+            from .ps_server import ParamMults
             clean = _copy.copy(optimizer)
-            clean.param_dict = {}
+            # keep per-parameter lr/wd multipliers, drop the Parameter
+            # objects themselves
+            clean.param_dict = {
+                k: ParamMults(getattr(p, "lr_mult", 1.0),
+                              getattr(p, "wd_mult", 1.0))
+                for k, p in getattr(optimizer, "param_dict", {}).items()}
             self._ps_client.set_optimizer(clean)
 
     def set_gradient_compression(self, compression_params):

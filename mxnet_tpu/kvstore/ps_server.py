@@ -28,7 +28,25 @@ import numpy as onp
 
 from ..base import MXNetError
 
-__all__ = ["ParamServer", "PSClient"]
+__all__ = ["ParamServer", "PSClient", "ParamMults"]
+
+
+class ParamMults:
+    """Picklable stand-in for a Parameter in the server-shipped
+    optimizer's param_dict: carries ONLY the per-parameter lr/wd
+    multipliers (_get_lr/_get_wd read nothing else)."""
+
+    __slots__ = ("lr_mult", "wd_mult")
+
+    def __init__(self, lr_mult=1.0, wd_mult=1.0):
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+
+    def __getstate__(self):
+        return (self.lr_mult, self.wd_mult)
+
+    def __setstate__(self, state):
+        self.lr_mult, self.wd_mult = state
 
 
 def _send_msg(sock: socket.socket, obj) -> None:
@@ -121,7 +139,15 @@ class ParamServer:
             if op == "set_optimizer":
                 _, payload = msg
                 with self._lock:
-                    self._optimizer = pickle.loads(payload)
+                    new = pickle.loads(payload)
+                    if self._optimizer is not None:
+                        # hyperparameter refresh must not reset step
+                        # counts: adam bias correction / lr_scheduler
+                        # continue from the server's counts
+                        new._index_update_count = \
+                            self._optimizer._index_update_count
+                        new.num_update = self._optimizer.num_update
+                    self._optimizer = new
                 return ("ok",)
             if op == "push_count":
                 _, key = msg

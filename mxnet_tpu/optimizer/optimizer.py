@@ -283,11 +283,9 @@ class Optimizer:
                 grad32 = RowSparseNDArray(
                     grad.data.astype(jnp.float32), grad.indices,
                     grad.shape)
-            else:
-                grad32 = NDArray(grad._data.astype(jnp.float32))
-            if isinstance(grad32, RowSparseNDArray):
                 self._update_rsp(index, master, grad32, sub_state)
             else:
+                grad32 = NDArray(grad._data.astype(jnp.float32))
                 self.update(index, master, grad32, sub_state)
             weight._rebind(master._data.astype(weight._data.dtype))
         elif isinstance(grad, RowSparseNDArray):

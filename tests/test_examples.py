@@ -377,3 +377,24 @@ def test_image_classification_cli_example():
     net, _val, hist = m.train(args)
     assert hist[-1] > hist[0] + 0.05, hist
     assert hist[-1] > 0.15, hist
+
+
+def test_sparse_text_classification_example():
+    """Sparse-embedding showcase: row_sparse grads + lazy updates; the
+    classifier must beat chance clearly and only a fraction of the
+    vocab's rows may ever be updated."""
+    m = _load("gluon/sparse_text_classification.py", "sparse_text_ex")
+    acc, max_step_nnz = m.train(epochs=2, steps=20, verbose=False)
+    assert acc > 0.75, f"accuracy {acc} not above chance (1/3)"
+    # the lazy win: EVERY update touches only the batch's live rows
+    assert max_step_nnz <= 32 * m.SEQ, max_step_nnz
+    assert max_step_nnz < m.VOCAB * 0.1, \
+        "each sparse update must touch a small fraction of the vocab"
+
+
+def test_convolutional_autoencoder_example():
+    """Conv AE must reconstruct held-out images far better than the
+    predict-the-mean baseline (parity: example/autoencoder)."""
+    m = _load("gluon/convolutional_autoencoder.py", "conv_ae_ex")
+    mse, baseline = m.train(epochs=4, steps=20, verbose=False)
+    assert mse < baseline * 0.5, (mse, baseline)

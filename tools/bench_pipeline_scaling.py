@@ -33,10 +33,10 @@ def make_rec(tmp, n, hw):
             hdr = recordio.IRHeader(flag=0, label=float(i % 10), id=i,
                                     id2=0)
             w.write(recordio.pack_img(hdr, blobs[i % 8], quality=90))
-    return rec, None
+    return rec
 
 
-def measure(rec, idx, threads, batch, hw, epochs=2):
+def measure(rec, threads, batch, hw, epochs=2):
     from mxnet_tpu.io.native import ImageRecordIter as NativeImageRecordIter
 
     it = NativeImageRecordIter(
@@ -67,10 +67,10 @@ def main():
 
     ncores = os.cpu_count() or 1
     with tempfile.TemporaryDirectory() as tmp:
-        rec, idx = make_rec(tmp, args.n, args.hw)
+        rec = make_rec(tmp, args.n, args.hw)
         rows = {}
         for t in [int(x) for x in args.threads.split(",")]:
-            rate = measure(rec, idx, t, args.batch, args.hw)
+            rate = measure(rec, t, args.batch, args.hw)
             rows[str(t)] = round(rate, 1)
             print(f"threads={t}: {rate:.1f} img/s", file=sys.stderr)
 
