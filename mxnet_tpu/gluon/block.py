@@ -194,7 +194,9 @@ class Block:
     def save_parameters(self, filename: str, deduplicate: bool = False):
         from ..ndarray import save as nd_save
         params = self.collect_params()
-        nd_save(filename, {k: v.data() for k, v in params.items()})
+        # _reduce, not data(): sparse-stype params serialize their full
+        # dense value (parity: reference _reduce gather before save)
+        nd_save(filename, {k: v._reduce() for k, v in params.items()})
 
     def load_parameters(self, filename: str, ctx=None, allow_missing=False,
                         ignore_extra=False, cast_dtype=False,
@@ -505,16 +507,14 @@ class HybridBlock(Block):
         if params_format == "mxnet":
             from ..ndarray import save as nd_save
             # MXNet consumers split by prefix: arguments -> "arg:",
-            # auxiliary STATES -> "aux:".  The aux set is determined by
-            # the parameter's ROLE (running statistics), not grad_req —
-            # a frozen trainable weight (grad_req forced to 'null') is
-            # still an argument of the symbol
+            # auxiliary STATES -> "aux:".  The role comes from the
+            # Parameter's aux_state flag (set by the layer that created
+            # the running statistic) — a frozen trainable weight
+            # (grad_req forced to 'null') is still an argument
             named = {}
             for k, v in self.collect_params().items():
-                leaf = k.rsplit(".", 1)[-1]
-                is_aux = v.grad_req == "null" and (
-                    leaf.startswith(("running_", "moving_")))
-                named[f"{'aux' if is_aux else 'arg'}:{k}"] = v.data()
+                prefix = "aux" if v._is_aux else "arg"
+                named[f"{prefix}:{k}"] = v._reduce()
             nd_save(pfile, named, format="mxnet")
         else:
             self.save_parameters(pfile)

@@ -180,11 +180,11 @@ class BatchNorm(HybridBlock):
                               grad_req="write" if center else "null")
         self.running_mean = Parameter(
             shape=(in_channels,), init=init_mod.create(running_mean_initializer),
-            allow_deferred_init=True, grad_req="null")
+            allow_deferred_init=True, grad_req="null", aux_state=True)
         self.running_var = Parameter(
             shape=(in_channels,),
             init=init_mod.create(running_variance_initializer),
-            allow_deferred_init=True, grad_req="null")
+            allow_deferred_init=True, grad_req="null", aux_state=True)
 
     def _finish_deferred(self, x):
         c = x.shape[self._axis]

@@ -42,7 +42,8 @@ class Parameter:
     def __init__(self, name: str = "weight", grad_req: str = "write",
                  shape=None, dtype="float32", lr_mult: float = 1.0,
                  wd_mult: float = 1.0, init=None, allow_deferred_init=False,
-                 differentiable=True, stype="default", grad_stype="default"):
+                 differentiable=True, stype="default",
+                 grad_stype="default", aux_state: bool = False):
         self._name = name
         self._shape = tuple(shape) if shape is not None else None
         self.dtype = np_dtype(dtype)
@@ -58,6 +59,10 @@ class Parameter:
             raise MXNetError(f"invalid parameter grad_stype {grad_stype!r}")
         self._stype = stype
         self._grad_stype = grad_stype
+        # aux_state: this parameter is an auxiliary STATE of the graph
+        # (BN running statistics), not an argument — the role marker
+        # export's arg:/aux: split keys on (set by the creating layer)
+        self._is_aux = bool(aux_state)
         self._data: Optional[NDArray] = None
         self._grad: Optional[NDArray] = None
         self._deferred_init: Optional[Tuple[Any, Any]] = None  # (init, ctx)
@@ -167,10 +172,57 @@ class Parameter:
 
     def data(self, ctx=None) -> NDArray:
         self._check_initialized()
+        if self._stype == "row_sparse":
+            # parity: reference parameter.py:585 — sparse params are
+            # accessed through row_sparse_data so dist training can pull
+            # only the needed rows (the TPU backing is a dense HBM
+            # buffer either way; this guards the ACCESS pattern)
+            raise MXNetError(
+                f"cannot return a copy of parameter '{self.name}' via "
+                "data() because its storage type is 'row_sparse'; use "
+                "row_sparse_data(row_id) instead")
         return self._data
 
     def list_data(self) -> List[NDArray]:
         return [self.data()]
+
+    def row_sparse_data(self, row_id) -> "object":
+        """Copy of a 'row_sparse' parameter retaining only ``row_id``
+        rows (parity: gluon/parameter.py:527).  With a distributed
+        trainer attached, the rows are pulled from the kvstore/server
+        (only the requested rows travel); otherwise they are gathered
+        from the local backing."""
+        from ..ndarray.sparse import RowSparseNDArray
+        import jax.numpy as jnp
+        import numpy as onp
+
+        if self._stype != "row_sparse":
+            raise MXNetError(
+                f"cannot return parameter '{self.name}' via "
+                f"row_sparse_data() because its storage type is "
+                f"'{self._stype}'; use data() instead")
+        self._check_initialized()
+        rid = row_id.asnumpy() if hasattr(row_id, "asnumpy") else row_id
+        rows = onp.unique(onp.asarray(rid, onp.int64).reshape(-1))
+        if self._trainer is not None and \
+                getattr(self._trainer, "_kvstore", None) is not None and \
+                getattr(self._trainer, "_distributed", False):
+            return self._trainer._row_sparse_pull(self, rows)
+        vals = jnp.take(self._data._data, jnp.asarray(rows, jnp.int32),
+                        axis=0)
+        return RowSparseNDArray(vals, rows, tuple(self._data.shape))
+
+    def list_row_sparse_data(self, row_id) -> List:
+        """Parity: gluon/parameter.py:547 (single-device list here)."""
+        return [self.row_sparse_data(row_id)]
+
+    def _reduce(self) -> NDArray:
+        """Full dense value regardless of stype — the save/checkpoint
+        path (parity: gluon/parameter.py:_reduce, which gathers ALL
+        rows of a sparse parameter before serialization).  The TPU
+        backing is already a dense buffer, so this is a view."""
+        self._check_initialized()
+        return self._data
 
     def grad(self, ctx=None) -> NDArray:
         self._check_initialized()
@@ -281,7 +333,7 @@ class ParameterDict(dict):
         for name, p in self.items():
             key = name[len(strip_prefix):] if name.startswith(strip_prefix) \
                 else name
-            arg[key] = p.data()
+            arg[key] = p._reduce()
         nd_save(filename, arg)
 
     def load(self, filename, ctx=None, allow_missing=False,

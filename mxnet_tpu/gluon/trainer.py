@@ -111,7 +111,7 @@ class Trainer:
             # register params with the store
             for i, p in enumerate(self._params):
                 if p._data is not None:
-                    self._kvstore.init(str(i), p.data())
+                    self._kvstore.init(str(i), p._data_nd())
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
             self._maybe_install_p3_hook()
@@ -224,7 +224,7 @@ class Trainer:
                 if param.grad_req != "null" and param._grad is not None:
                     if pushed is not None and i in pushed:
                         continue  # already pushed by the backward hook
-                    out = (param.data() if self._update_on_kvstore
+                    out = (param._data_nd() if self._update_on_kvstore
                            else param.grad())
                     self._kvstore.pushpull(str(i), param.grad(),
                                            out=out, priority=-i)
@@ -241,7 +241,7 @@ class Trainer:
             if param.grad_req != "null" and param._grad is not None:
                 keys.append(str(i))
                 grads.append(param.grad())
-                outs.append(param.data() if self._update_on_kvstore
+                outs.append(param._data_nd() if self._update_on_kvstore
                             else param.grad())
         if keys:
             self._kvstore.pushpull(keys, grads, out=outs)
@@ -273,10 +273,10 @@ class Trainer:
                 chunk = live[c:c + agg]
                 updater.update_multi([i for i, _ in chunk],
                                      [p.grad() for _, p in chunk],
-                                     [p.data() for _, p in chunk])
+                                     [p._data_nd() for _, p in chunk])
         else:
             for i, param in live:
-                updater(i, param.grad(), param.data())
+                updater(i, param.grad(), param._data_nd())
 
     # -- optimizer state persistence (parity: save_states/load_states) -----
     def save_states(self, fname):
@@ -290,3 +290,40 @@ class Trainer:
             self._init_kvstore()
         with open(fname, "rb") as f:
             self._updaters[0].set_states(f.read())
+
+
+    # -- sparse row pulls (parity: trainer._row_sparse_pull used by
+    #    Parameter.row_sparse_data, gluon/trainer.py:259) ---------------
+    def _row_sparse_pull(self, param, row_ids):
+        """Pull only ``row_ids`` rows of a parameter from the kvstore
+        (the sparse-embedding training flow: only the batch's rows
+        travel).  Also refreshes those rows of the local backing."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if not self._update_on_kvstore:
+            # with worker-side updates the store holds reduced GRADIENTS,
+            # not weights — pulling them as rows would corrupt the param
+            # (the reference likewise requires update_on_kvstore for
+            # sparse parameters, gluon/trainer.py:118)
+            raise MXNetError(
+                "sparse parameters need update_on_kvstore=True (the "
+                "store must hold the authoritative weights to pull "
+                "rows from)")
+        if not hasattr(self._kvstore, "row_sparse_pull"):
+            raise MXNetError(
+                f"kvstore {getattr(self._kvstore, 'type', '?')!r} has "
+                "no row_sparse_pull")
+        try:
+            i = self._params.index(param)
+        except ValueError:
+            raise MXNetError("parameter is not managed by this trainer")
+        rsp = self._kvstore.row_sparse_pull(str(i), row_ids=row_ids)
+        if isinstance(rsp, list):
+            rsp = rsp[0]
+        # refresh the pulled rows of the local dense backing so forward
+        # sees the server's latest values
+        backing = param._data_nd()
+        import jax.numpy as jnp
+        backing._rebind(backing._data.at[
+            jnp.asarray(rsp.indices, jnp.int32)].set(rsp.data))
+        return rsp

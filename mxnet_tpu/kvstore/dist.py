@@ -427,6 +427,32 @@ class DistKVStore(KVStoreBase):
                     val.copyto(t)
         return out
 
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only ``row_ids`` rows of a key (parity:
+        kvstore_dist.h:559 sparse pulls).  In uncoordinated-async mode
+        only the requested rows travel over the wire (ps pull_rows);
+        in collective modes the replicated local copy is sliced."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        rid = row_ids.asnumpy() if hasattr(row_ids, "asnumpy") else row_ids
+        rows = onp.unique(onp.asarray(rid, onp.int64).reshape(-1))
+        if self._uncoordinated:
+            if key not in self._data:
+                raise MXNetError(f"row_sparse_pull: unknown key {key!r} "
+                                 "(init it first)")
+            vals = self._ps_client.pull_rows(key, rows)
+            rsp = RowSparseNDArray(vals, rows,
+                                   tuple(self._data[key].shape))
+        else:
+            full = self._data[key]
+            vals = full._data[jnp.asarray(rows, jnp.int32)]
+            rsp = RowSparseNDArray(vals, rows, tuple(full.shape))
+        if out is not None:
+            rsp.copyto(out)
+            return out
+        return rsp
+
     def pushpull(self, key, value, out=None, priority=0):
         self.push(key, value, priority)
         if out is not None:

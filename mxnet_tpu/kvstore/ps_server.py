@@ -136,6 +136,14 @@ class ParamServer:
                     if key not in self._store:
                         return ("err", f"pull: unknown key {key!r}")
                     return ("ok", self._store[key])
+            if op == "pull_rows":
+                # sparse row pull: only the requested rows travel
+                # (parity: kvstore_dist.h:559 sparse row pulls)
+                _, key, rows = msg
+                with self._lock:
+                    if key not in self._store:
+                        return ("err", f"pull_rows: unknown key {key!r}")
+                    return ("ok", self._store[key][onp.asarray(rows)])
             if op == "set_optimizer":
                 _, payload = msg
                 with self._lock:
@@ -233,6 +241,9 @@ class PSClient:
 
     def pull(self, key) -> onp.ndarray:
         return self._call("pull", key)
+
+    def pull_rows(self, key, rows: onp.ndarray) -> onp.ndarray:
+        return self._call("pull_rows", key, onp.asarray(rows, onp.int64))
 
     def set_optimizer(self, optimizer):
         self._call("set_optimizer",

@@ -225,3 +225,61 @@ class TestKVStoreSparse:
                 trainer.step(1)
             outs[kvs] = emb.weight.data().asnumpy()
         onp.testing.assert_allclose(outs["device"], outs[None], rtol=1e-6)
+
+
+class TestRowSparseParameter:
+    """Parameter(stype='row_sparse') + row_sparse_data (parity:
+    gluon/parameter.py:527,547) — the sparse-embedding dist-training
+    access pattern: only requested rows travel."""
+
+    def test_data_raises_row_sparse_data_works(self):
+        from mxnet_tpu.gluon.parameter import Parameter
+        import mxnet_tpu as mx2
+        p = Parameter("w", shape=(10, 3), stype="row_sparse",
+                      grad_stype="row_sparse")
+        p.set_data(nd.array(onp.arange(30, dtype="float32")
+                            .reshape(10, 3)))
+        with pytest.raises(Exception, match="row_sparse_data"):
+            p.data()
+        rsp = p.row_sparse_data(nd.array(onp.array([7, 2, 2], "float32")))
+        assert isinstance(rsp, RowSparseNDArray)
+        assert sorted(onp.asarray(rsp.indices).tolist()) == [2, 7]
+        onp.testing.assert_array_equal(
+            rsp.todense().asnumpy()[2], onp.arange(6, 9, dtype="float32"))
+        assert p.list_row_sparse_data(nd.array([0.0]))[0].nnz == 1
+
+    def test_row_sparse_pull_through_uncoordinated_server(self, monkeypatch):
+        """Server-side updates become visible through row_sparse_data:
+        only the requested rows travel (ps pull_rows)."""
+        monkeypatch.setenv("MXNET_ASYNC_UNCOORDINATED", "1")
+        from mxnet_tpu.gluon.parameter import Parameter
+
+        p = Parameter("emb", shape=(8, 2), stype="row_sparse",
+                      grad_stype="row_sparse")
+        w0 = onp.zeros((8, 2), "float32")
+        p.set_data(nd.array(w0))
+        kv = mx.kv.create("dist_async")
+        trainer = gluon.Trainer([p], "sgd", {"learning_rate": 1.0},
+                                kvstore=kv)
+        trainer._init_kvstore()
+        assert trainer._update_on_kvstore
+
+        # a push updates rows 1 and 5 server-side (sgd: w -= lr*g)
+        g = RowSparseNDArray(onp.ones((2, 2), "float32"), [1, 5], (8, 2))
+        kv.push("0", g)
+        rsp = p.row_sparse_data(nd.array(onp.array([5, 3], "float32")))
+        dense = rsp.todense().asnumpy()
+        onp.testing.assert_allclose(dense[5], -1.0)   # updated row
+        onp.testing.assert_allclose(dense[3], 0.0)    # untouched row
+        # the local backing was refreshed for the pulled rows only
+        onp.testing.assert_allclose(p._data_nd().asnumpy()[5], -1.0)
+
+    def test_collective_mode_row_sparse_pull_slices_local(self):
+        from mxnet_tpu.kvstore.dist import DistKVStore
+        kv = DistKVStore("dist_sync")
+        kv.init("k", nd.array(onp.arange(12, dtype="float32")
+                              .reshape(4, 3)))
+        rsp = kv.row_sparse_pull("k", row_ids=onp.array([3, 0]))
+        assert sorted(onp.asarray(rsp.indices).tolist()) == [0, 3]
+        onp.testing.assert_array_equal(
+            onp.asarray(rsp.data)[1], onp.array([9., 10., 11.]))
