@@ -204,10 +204,25 @@ class Parameter:
         self._check_initialized()
         rid = row_id.asnumpy() if hasattr(row_id, "asnumpy") else row_id
         rows = onp.unique(onp.asarray(rid, onp.int64).reshape(-1))
-        if self._trainer is not None and \
-                getattr(self._trainer, "_kvstore", None) is not None and \
-                getattr(self._trainer, "_distributed", False):
-            return self._trainer._row_sparse_pull(self, rows)
+        n = self._data.shape[0]
+        if len(rows) and (rows[0] < 0 or rows[-1] >= n):
+            # jnp.take would silently clamp — wrong row labeled as the
+            # requested id; fail loudly like the server path does
+            raise MXNetError(
+                f"row_sparse_data: row ids out of range for parameter "
+                f"'{self.name}' with {n} rows")
+        tr = self._trainer
+        if tr is not None:
+            # gate on the trainer's CONFIG, not its lazily-built state:
+            # before the first step() the kvstore isn't created yet, and
+            # returning local init values instead of the server's rows
+            # would silently serve stale weights on iteration 1
+            kvconf = tr._kvstore_params.get("kvstore")
+            want_dist = tr._distributed or \
+                (isinstance(kvconf, str) and kvconf.startswith("dist")) \
+                or "dist" in getattr(kvconf, "type", "")
+            if want_dist:
+                return tr._row_sparse_pull(self, rows)
         vals = jnp.take(self._data._data, jnp.asarray(rows, jnp.int32),
                         axis=0)
         return RowSparseNDArray(vals, rows, tuple(self._data.shape))

@@ -177,6 +177,22 @@ class DistKVStore(KVStoreBase):
                 "uncoordinated dist_async with >1 process needs "
                 "MXNET_PS_ADDR=host:port shared by all ranks")
         self._ps_client = PSClient(addr)
+        self._ps_client.hello(self._rank)   # register for liveness
+
+    def get_num_dead_node(self, node_id=0, timeout=60) -> int:
+        """Failure detection (parity: kvstore.h:408 ps-lite heartbeats).
+        In uncoordinated-async mode the server counts distinct connected
+        ranks: dead = expected - alive.  Process death is detected
+        immediately (closed socket); a host crash/partition is reaped by
+        kernel TCP keepalive (~60 s as configured server-side — the
+        ``timeout`` argument is advisory here, keepalive granularity
+        governs).  Collective stores have no heartbeat channel (a dead
+        process surfaces as a collective error; checkpoint/resume is
+        the recovery story, SURVEY §5)."""
+        if self._uncoordinated:
+            alive = self._ps_client.num_alive()
+            return max(0, self._nproc - alive)
+        return 0
 
     @staticmethod
     def is_capable(capability: str) -> bool:
@@ -446,6 +462,10 @@ class DistKVStore(KVStoreBase):
                                    tuple(self._data[key].shape))
         else:
             full = self._data[key]
+            if len(rows) and (rows[0] < 0 or rows[-1] >= full.shape[0]):
+                raise MXNetError(
+                    f"row_sparse_pull: row_ids out of range for key "
+                    f"{key!r} with {full.shape[0]} rows")
             vals = full._data[jnp.asarray(rows, jnp.int32)]
             rsp = RowSparseNDArray(vals, rows, tuple(full.shape))
         if out is not None:

@@ -83,6 +83,12 @@ class ParamServer:
         self._states: Dict[Any, tuple] = {}
         self._push_counts: Dict[Any, int] = {}
         self._optimizer = None
+        # liveness: per-rank connection refcounts (parity: ps-lite
+        # heartbeats behind kvstore.h:408 get_num_dead_node).  Process
+        # death closes the socket and drops the rank; kernel TCP
+        # keepalive (set per-connection below) eventually reaps
+        # half-open connections after a host crash/partition
+        self._rank_refs: Dict[int, int] = {}
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
@@ -106,14 +112,34 @@ class ParamServer:
 
     def _client_loop(self, conn: socket.socket):
         try:
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, 30)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, 10)
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+        except (OSError, AttributeError):
+            pass  # keepalive is best-effort (platform-dependent)
+        rank = [None]
+        try:
             while not self._stop.is_set():
                 try:
                     msg = _recv_msg(conn)
                 except (ConnectionError, EOFError, OSError):
                     return
+                if msg[0] == "hello":
+                    rank[0] = int(msg[1])
+                    with self._lock:
+                        self._rank_refs[rank[0]] = \
+                            self._rank_refs.get(rank[0], 0) + 1
+                    _send_msg(conn, ("ok",))
+                    continue
                 reply = self._handle(msg)
                 _send_msg(conn, reply)
         finally:
+            with self._lock:
+                if rank[0] is not None:
+                    self._rank_refs[rank[0]] -= 1
+                    if self._rank_refs[rank[0]] <= 0:
+                        del self._rank_refs[rank[0]]
             conn.close()
 
     def _handle(self, msg):
@@ -160,6 +186,9 @@ class ParamServer:
             if op == "push_count":
                 _, key = msg
                 return ("ok", self._push_counts.get(key, 0))
+            if op == "num_alive":
+                with self._lock:
+                    return ("ok", len(self._rank_refs))
             if op == "command":
                 # remote server command (parity: kvstore.h:440
                 # SetServerProfilerCommand / CommandHandle): runs in the
@@ -254,6 +283,14 @@ class PSClient:
 
     def command(self, head: str, body: str = "") -> None:
         self._call("command", str(head), body)
+
+    def num_alive(self) -> int:
+        """Number of distinct worker ranks currently connected."""
+        return self._call("num_alive")
+
+    def hello(self, rank: int) -> None:
+        """Register this connection's worker rank for liveness."""
+        self._call("hello", int(rank))
 
     def shutdown(self):
         try:

@@ -6,8 +6,8 @@ the reference code-gens this module from its C op registry
 """
 from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       linspace, eye, concat, stack, waitall, save, load,
-                      from_numpy, from_dlpack, to_dlpack_for_read,
-                      to_dlpack_for_write)
+                      load_frombuffer, from_numpy, from_dlpack,
+                      to_dlpack_for_read, to_dlpack_for_write)
 from .register import populate_namespace, make_op_func
 from . import random
 from . import linalg

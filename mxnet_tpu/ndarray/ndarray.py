@@ -663,6 +663,28 @@ def save(fname: str, data, format: str = None):
         onp.savez(f, **arrays)
 
 
+def load_frombuffer(buf):
+    """Deserialize NDArrays from an in-memory buffer (parity:
+    nd.load_frombuffer over MXNDArrayLoadFromBuffer,
+    python/mxnet/ndarray/utils.py:185).  Accepts either codec: the
+    reference binary wire format (by magic) or npz bytes."""
+    from .legacy_serialization import is_mxnet_format, decode_list
+    buf = bytes(buf)
+    if is_mxnet_format(buf[:8]):
+        data, names = decode_list(buf)
+        return dict(zip(names, data)) if names else data
+    import os
+    import tempfile
+    # npz codec path: reuse load()'s manifest protocol via a temp file
+    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
+        f.write(buf)
+        tmp = f.name
+    try:
+        return load(tmp)
+    finally:
+        os.unlink(tmp)
+
+
 def load(fname: str):
     import os
     if not fname.endswith(".npz"):

@@ -229,3 +229,17 @@ class TestExportBinaryParams:
         onp.testing.assert_array_equal(
             net(mx.nd.ones((1, 3))).asnumpy(),
             net2(mx.nd.ones((1, 3))).asnumpy())
+
+
+class TestLoadFromBuffer:
+    def test_mxnet_format_buffer(self):
+        blob = ls.encode_list([mx.nd.ones((2, 2))], ["w"])
+        out = mx.nd.load_frombuffer(blob)
+        onp.testing.assert_array_equal(out["w"].asnumpy(),
+                                       onp.ones((2, 2)))
+
+    def test_npz_buffer(self, tmp_path):
+        f = str(tmp_path / "x.npz")
+        mx.nd.save(f, {"a": mx.nd.ones((3,))})
+        out = mx.nd.load_frombuffer(open(f, "rb").read())
+        onp.testing.assert_array_equal(out["a"].asnumpy(), onp.ones(3))

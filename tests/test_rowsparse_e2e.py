@@ -283,3 +283,30 @@ class TestRowSparseParameter:
         assert sorted(onp.asarray(rsp.indices).tolist()) == [0, 3]
         onp.testing.assert_array_equal(
             onp.asarray(rsp.data)[1], onp.array([9., 10., 11.]))
+
+
+class TestFailureDetection:
+    def test_num_dead_node_via_ps_liveness(self, monkeypatch):
+        """Server counts distinct connected ranks (parity: kvstore.h:408
+        get_num_dead_node over ps-lite heartbeats)."""
+        monkeypatch.setenv("MXNET_ASYNC_UNCOORDINATED", "1")
+        kv = mx.kv.create("dist_async")
+        assert kv.get_num_dead_node() == 0      # this rank is alive
+        # simulate a dead worker by closing an extra registered client
+        from mxnet_tpu.kvstore.ps_server import PSClient
+        from mxnet_tpu.kvstore import dist as dist_mod
+        ghost = PSClient(dist_mod._PS_ADDR or
+                         kv._ps_server.address)
+        ghost.hello(7)                           # rank 7 joins
+        import time
+        for _ in range(50):
+            if kv._ps_client.num_alive() >= 2:
+                break
+            time.sleep(0.1)
+        assert kv._ps_client.num_alive() == 2
+        ghost.close()                            # rank 7 dies
+        for _ in range(50):
+            if kv._ps_client.num_alive() == 1:
+                break
+            time.sleep(0.1)
+        assert kv._ps_client.num_alive() == 1
