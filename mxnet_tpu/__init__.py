@@ -79,3 +79,8 @@ from . import executor  # noqa: E402
 # MXNET_USE_INT64_TENSOR_SIZE build flag; here a runtime env toggle)
 if base.getenv_bool("MXNET_INT64_TENSOR_SIZE"):
     util.set_large_tensor(True)
+
+# snapshot the built-in op set (ops registered by the package itself);
+# later user/test/extension registrations are intentionally excluded
+# from library-completeness contracts
+ops.registry.freeze_builtin_snapshot()

@@ -190,7 +190,10 @@ class DistKVStore(KVStoreBase):
         process surfaces as a collective error; checkpoint/resume is
         the recovery story, SURVEY §5)."""
         if self._uncoordinated:
-            alive = self._ps_client.num_alive()
+            ranks = self._ps_client.alive_ranks()
+            # ghost/monitor clients may register ranks outside the
+            # worker range; only real worker ranks count as alive
+            alive = len([r for r in ranks if 0 <= r < self._nproc])
             return max(0, self._nproc - alive)
         return 0
 
@@ -457,6 +460,12 @@ class DistKVStore(KVStoreBase):
             if key not in self._data:
                 raise MXNetError(f"row_sparse_pull: unknown key {key!r} "
                                  "(init it first)")
+            n = self._data[key].shape[0]
+            if len(rows) and (rows[0] < 0 or rows[-1] >= n):
+                # numpy indexing server-side would WRAP negative ids
+                raise MXNetError(
+                    f"row_sparse_pull: row_ids out of range for key "
+                    f"{key!r} with {n} rows")
             vals = self._ps_client.pull_rows(key, rows)
             rsp = RowSparseNDArray(vals, rows,
                                    tuple(self._data[key].shape))

@@ -188,7 +188,7 @@ class ParamServer:
                 return ("ok", self._push_counts.get(key, 0))
             if op == "num_alive":
                 with self._lock:
-                    return ("ok", len(self._rank_refs))
+                    return ("ok", sorted(self._rank_refs))
             if op == "command":
                 # remote server command (parity: kvstore.h:440
                 # SetServerProfilerCommand / CommandHandle): runs in the
@@ -284,9 +284,13 @@ class PSClient:
     def command(self, head: str, body: str = "") -> None:
         self._call("command", str(head), body)
 
+    def alive_ranks(self) -> list:
+        """Sorted distinct worker ranks currently connected."""
+        return self._call("num_alive")
+
     def num_alive(self) -> int:
         """Number of distinct worker ranks currently connected."""
-        return self._call("num_alive")
+        return len(self.alive_ranks())
 
     def hello(self, rank: int) -> None:
         """Register this connection's worker rank for liveness."""

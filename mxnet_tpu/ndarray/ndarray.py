@@ -25,8 +25,8 @@ from ..ops.registry import apply_jax, invoke
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "linspace", "eye", "concat", "stack", "waitall", "save", "load",
-           "from_numpy", "from_dlpack", "to_dlpack_for_read",
-           "to_dlpack_for_write"]
+           "load_frombuffer", "from_numpy", "from_dlpack",
+           "to_dlpack_for_read", "to_dlpack_for_write"]
 
 
 def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
@@ -673,16 +673,8 @@ def load_frombuffer(buf):
     if is_mxnet_format(buf[:8]):
         data, names = decode_list(buf)
         return dict(zip(names, data)) if names else data
-    import os
-    import tempfile
-    # npz codec path: reuse load()'s manifest protocol via a temp file
-    with tempfile.NamedTemporaryFile(suffix=".npz", delete=False) as f:
-        f.write(buf)
-        tmp = f.name
-    try:
-        return load(tmp)
-    finally:
-        os.unlink(tmp)
+    import io
+    return _load_npz(io.BytesIO(buf))
 
 
 def load(fname: str):
@@ -696,7 +688,12 @@ def load(fname: str):
         from .legacy_serialization import is_mxnet_format, load_mxnet
         if is_mxnet_format(head):
             return load_mxnet(fname)
-    with onp.load(fname, allow_pickle=False) as z:
+    return _load_npz(fname)
+
+
+def _load_npz(path_or_filelike):
+    """npz-codec loader shared by load() and load_frombuffer()."""
+    with onp.load(path_or_filelike, allow_pickle=False) as z:
         keys = list(z.keys())
         dtype_tags = {}
         if "__empty__" in z:

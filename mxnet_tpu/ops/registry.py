@@ -99,6 +99,22 @@ def list_ops() -> List[str]:
     return sorted(_REGISTRY)
 
 
+# snapshot of the ops the LIBRARY itself registered, taken when the
+# package finishes importing (mxnet_tpu/__init__.py) — user/test/
+# extension ops registered later are excluded.  Consumers: the grad
+# sweep's catalog-completeness contract.
+_BUILTIN_NAMES: frozenset = frozenset()
+
+
+def freeze_builtin_snapshot() -> None:
+    global _BUILTIN_NAMES
+    _BUILTIN_NAMES = frozenset(op.name for op in _REGISTRY.values())
+
+
+def builtin_ops() -> List[str]:
+    return sorted(_BUILTIN_NAMES)
+
+
 # --------------------------------------------------------------------------
 # invocation (parity: Imperative::Invoke, src/imperative/imperative.cc:98)
 # --------------------------------------------------------------------------

@@ -13,14 +13,19 @@ import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu.ndarray import NDArray
-from mxnet_tpu.ops.registry import _REGISTRY, invoke
+from mxnet_tpu.ops.registry import invoke
 from mxnet_tpu.test_utils import check_numeric_gradient
 
 from grad_sweep_specs import SPECS, EXEMPT, _rng
 
 
 def _primary_ops():
-    return sorted({op.name for op in _REGISTRY.values()})
+    # only ops the LIBRARY itself registered (snapshot taken when the
+    # package finished importing): custom-op/extension tests register
+    # ops at runtime, and the completeness contract must not depend on
+    # test execution order
+    from mxnet_tpu.ops.registry import builtin_ops
+    return builtin_ops()
 
 
 def test_catalog_is_complete():
