@@ -379,6 +379,7 @@ class DistKVStore(KVStoreBase):
         keys = key if isinstance(key, (list, tuple)) else [key]
         if len(keys) == 1:
             value = [value]
+        from ..ndarray.sparse import BaseSparseNDArray, RowSparseNDArray
         kv = []
         for k, v in zip(keys, value):
             local = v
@@ -404,8 +405,22 @@ class DistKVStore(KVStoreBase):
                     "gradient compression is not supported on the "
                     "uncoordinated dist_async path")
             for k, v in kv:
-                self._ps_client.push(k, v.asnumpy())
+                if isinstance(v, RowSparseNDArray):
+                    # only (indices, values) travel — nnz wire cost
+                    # (parity: sparse ZPush, kvstore_dist.h:559)
+                    self._ps_client.push_sparse(
+                        k, onp.asarray(v.indices),
+                        onp.asarray(v.data), tuple(v.shape))
+                else:
+                    self._ps_client.push(k, v.asnumpy())
             return
+
+        # collective/SSP paths ride dense fused buffers; sparse values
+        # densify here (todense() emits the storage-fallback log; the
+        # nnz-cost paths are the uncoordinated PS push above and the
+        # local/device store's index merge)
+        kv = [(k, v.todense() if isinstance(v, BaseSparseNDArray) else v)
+              for k, v in kv]
 
         if self._async and self._optimizer is not None and \
                 all(k in self._data for k, _ in kv):

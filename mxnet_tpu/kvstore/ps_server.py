@@ -156,6 +156,15 @@ class ParamServer:
                 with self._lock:
                     self._apply_push(key, onp.asarray(grad))
                 return ("ok",)
+            if op == "push_sparse":
+                # row_sparse gradient: only (indices, values) traveled;
+                # the optimizer's lazy kernel touches only those rows
+                _, key, indices, values, shape = msg
+                with self._lock:
+                    self._apply_push_sparse(key, onp.asarray(indices),
+                                            onp.asarray(values),
+                                            tuple(shape))
+                return ("ok",)
             if op == "pull":
                 _, key = msg
                 with self._lock:
@@ -228,6 +237,39 @@ class ParamServer:
         self._optimizer.update(key, weight, g, self._states[key])
         self._store[key] = onp.asarray(weight.asnumpy())
 
+    def _apply_push_sparse(self, key, indices, values, shape):
+        """Apply a row_sparse gradient: optimizer sparse dispatch (lazy
+        row updates) when an optimizer is set; accumulation of the live
+        rows otherwise."""
+        from ..ndarray import NDArray
+        from ..ndarray.sparse import RowSparseNDArray
+
+        self._push_counts[key] = self._push_counts.get(key, 0) + 1
+        indices = onp.asarray(indices)
+        n = shape[0]
+        if indices.size and (indices.min() < 0 or indices.max() >= n):
+            # numpy/jax indexing would WRAP negative ids to real rows
+            raise MXNetError(
+                f"push_sparse: row indices out of range for key "
+                f"{key!r} with {n} rows")
+        rsp = RowSparseNDArray(values, indices, shape)
+        if key not in self._store:
+            self._store[key] = onp.asarray(rsp.todense().asnumpy())
+            return
+        if self._optimizer is None:
+            dense = self._store[key].copy()
+            onp.add.at(dense, indices, onp.asarray(values))
+            self._store[key] = dense
+            return
+        weight = NDArray(self._store[key])
+        if key not in self._states:
+            self._states[key] = self._optimizer.create_state(key, weight)
+        # update_multi_precision: the sparse-safe entry point (routes
+        # overridden update() optimizers to _update_rsp / densify)
+        self._optimizer.update_multi_precision(key, weight, rsp,
+                                               self._states[key])
+        self._store[key] = onp.asarray(weight.asnumpy())
+
     def stop(self):
         self._stop.set()
         self._thread.join(timeout=5)
@@ -267,6 +309,11 @@ class PSClient:
 
     def push(self, key, grad: onp.ndarray):
         self._call("push", key, onp.asarray(grad))
+
+    def push_sparse(self, key, indices: onp.ndarray, values: onp.ndarray,
+                    shape) -> None:
+        self._call("push_sparse", key, onp.asarray(indices),
+                   onp.asarray(values), tuple(shape))
 
     def pull(self, key) -> onp.ndarray:
         return self._call("pull", key)

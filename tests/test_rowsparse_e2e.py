@@ -310,3 +310,34 @@ class TestFailureDetection:
                 break
             time.sleep(0.1)
         assert kv._ps_client.num_alive() == 1
+
+
+class TestSparsePushPaths:
+    def test_sparse_push_over_uncoordinated_wire(self, monkeypatch):
+        """row_sparse pushes travel as (indices, values) and apply via
+        the optimizer's lazy kernel server-side."""
+        monkeypatch.setenv("MXNET_ASYNC_UNCOORDINATED", "1")
+        kv = mx.kv.create("dist_async")
+        kv.set_optimizer(mx.optimizer.create("sgd", learning_rate=0.5))
+        kv.init("s", nd.zeros((6, 3)))
+        g = RowSparseNDArray(onp.ones((2, 3), "float32"), [1, 4], (6, 3))
+        kv.push("s", g)
+        out = nd.zeros((6, 3))
+        kv.pull("s", out=out)
+        dense = out.asnumpy()
+        onp.testing.assert_allclose(dense[1], -0.5)
+        onp.testing.assert_allclose(dense[0], 0.0)
+
+    def test_sparse_push_over_collective_densifies(self):
+        """dist_sync (collective) path: sparse values densify with the
+        storage-fallback log instead of crashing."""
+        from mxnet_tpu.kvstore.dist import DistKVStore
+        kv = DistKVStore("dist_sync")
+        kv.init("c", nd.zeros((5, 2)))
+        g = RowSparseNDArray(2 * onp.ones((1, 2), "float32"), [3], (5, 2))
+        kv.push("c", g)
+        out = nd.zeros((5, 2))
+        kv.pull("c", out=out)
+        dense = out.asnumpy()
+        onp.testing.assert_allclose(dense[3], 2.0)
+        onp.testing.assert_allclose(dense[0], 0.0)
