@@ -233,8 +233,12 @@ class ParamServer:
         weight = NDArray(self._store[key])
         g = NDArray(grad)
         if key not in self._states:
-            self._states[key] = self._optimizer.create_state(key, weight)
-        self._optimizer.update(key, weight, g, self._states[key])
+            # multi-precision layout: same state shape as the sparse
+            # handler, so mixed dense/sparse pushes on one key agree
+            self._states[key] = \
+                self._optimizer.create_state_multi_precision(key, weight)
+        self._optimizer.update_multi_precision(key, weight, g,
+                                               self._states[key])
         self._store[key] = onp.asarray(weight.asnumpy())
 
     def _apply_push_sparse(self, key, indices, values, shape):
@@ -244,14 +248,20 @@ class ParamServer:
         from ..ndarray import NDArray
         from ..ndarray.sparse import RowSparseNDArray
 
-        self._push_counts[key] = self._push_counts.get(key, 0) + 1
         indices = onp.asarray(indices)
-        n = shape[0]
+        # validate against the STORED weight when it exists (a
+        # mismatched client shape must not sneak rows past the check:
+        # jax's scatter silently DROPS out-of-bounds updates)
+        n = (self._store[key].shape[0] if key in self._store
+             else shape[0])
         if indices.size and (indices.min() < 0 or indices.max() >= n):
-            # numpy/jax indexing would WRAP negative ids to real rows
+            # numpy/jax indexing would wrap/drop bad ids silently
             raise MXNetError(
                 f"push_sparse: row indices out of range for key "
                 f"{key!r} with {n} rows")
+        # count only pushes that passed validation (push_count is the
+        # applied-push probe)
+        self._push_counts[key] = self._push_counts.get(key, 0) + 1
         rsp = RowSparseNDArray(values, indices, shape)
         if key not in self._store:
             self._store[key] = onp.asarray(rsp.todense().asnumpy())
@@ -263,7 +273,9 @@ class ParamServer:
             return
         weight = NDArray(self._store[key])
         if key not in self._states:
-            self._states[key] = self._optimizer.create_state(key, weight)
+            # multi-precision layout to match the entry point below
+            self._states[key] = \
+                self._optimizer.create_state_multi_precision(key, weight)
         # update_multi_precision: the sparse-safe entry point (routes
         # overridden update() optimizers to _update_rsp / densify)
         self._optimizer.update_multi_precision(key, weight, rsp,

@@ -174,3 +174,46 @@ def test_mark_variables():
         y = x * 5
     y.backward()
     assert_almost_equal(g, [5.0, 5.0])
+
+
+def test_second_order_sweep_analytic():
+    """Second derivatives of smooth unary ops against closed forms
+    (parity: tests/python/unittest/test_higher_order_grad.py — sin, cos,
+    exp, log, sigmoid, tanh, sqrt, reciprocal...)."""
+    import numpy as onp
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ops.registry import invoke
+
+    def d2(name, x_np):
+        x = NDArray(x_np)
+        with autograd.record():
+            y = invoke(name, [x])
+            (gx,) = autograd.grad(y, [x], create_graph=True,
+                                  retain_graph=True)
+            s = gx.sum()
+        (ggx,) = autograd.grad(s, [x])
+        return ggx.asnumpy()
+
+    rng = onp.random.RandomState(5)
+    x = rng.uniform(0.3, 1.2, size=(3, 4)).astype("float32")
+
+    cases = {
+        "sin": -onp.sin(x),
+        "cos": -onp.cos(x),
+        "exp": onp.exp(x),
+        "log": -1.0 / x ** 2,
+        "sqrt": -0.25 * x ** -1.5,
+        "reciprocal": 2.0 / x ** 3,
+        "tanh": -2 * onp.tanh(x) * (1 - onp.tanh(x) ** 2),
+        "sigmoid": (lambda s_: s_ * (1 - s_) * (1 - 2 * s_))(
+            1 / (1 + onp.exp(-x))),
+        "square": onp.full_like(x, 2.0),
+        "erf": -2 * x * 2 / onp.sqrt(onp.pi) * onp.exp(-x ** 2),
+        "log1p": -1.0 / (1 + x) ** 2,
+        "expm1": onp.exp(x),
+    }
+    for name, expect in cases.items():
+        got = d2(name, x)
+        onp.testing.assert_allclose(
+            got, expect, rtol=2e-4, atol=2e-5,
+            err_msg=f"second derivative mismatch for {name}")
