@@ -63,3 +63,14 @@ def test_dist_async_uncoordinated_unequal_push_counts(tmp_path):
         "MXNET_ASYNC_UNCOORDINATED": "1",
         "MXNET_PS_ADDR": f"127.0.0.1:{_free_port()}",
     })
+
+
+@pytest.mark.timeout(600)
+def test_dist_sparse_embedding_training(tmp_path):
+    """Capstone: 2 ranks train a sparse embedding through the
+    uncoordinated PS — row_sparse grads over the wire, sparse row pulls,
+    unequal step counts (18 vs 31), convergence asserted."""
+    _run_launcher(2, "dist_worker_sparse.py", tmp_path, extra_env={
+        "MXNET_ASYNC_UNCOORDINATED": "1",
+        "MXNET_PS_ADDR": f"127.0.0.1:{_free_port()}",
+    })
