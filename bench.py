@@ -197,7 +197,42 @@ def _train_bench(dtype, batch):
             flops_step = ca["flops"] / N1
     except Exception:
         pass
-    return img_s, (flops_step / step_t if flops_step else None)
+
+    def capture_kernel_table():
+        """Optional extra: one short profiled window parsed into the
+        top kernels by device time (aggregate_stats.cc analogue).
+        main() calls this AFTER the measured rate is recorded in
+        RESULTS, so a tunnel wedge inside this window can never
+        discard an already-measured headline."""
+        dt_name = dtype or "float32"   # NOT 'label' (the labels array)
+        try:
+            import shutil
+
+            from mxnet_tpu import profiler as _prof
+            if _prof.is_running():
+                return     # don't disturb a user/autostart trace
+            _prof.set_config(filename=f"/tmp/bench_{dt_name}.json")
+            _prof.start()
+            tdir = None
+            try:
+                run(2)
+            finally:
+                _prof.stop()
+                tdir = _prof.trace_dir()
+            table = _prof.device_op_table()
+            if table:
+                top = sorted(table.items(),
+                             key=lambda kv: -kv[1]["total_us"])[:5]
+                RESULTS[f"top_kernels_{dt_name}"] = {
+                    k: round(v["total_us"], 1) for k, v in top}
+            if tdir:
+                shutil.rmtree(tdir, ignore_errors=True)
+        except Exception as e:  # record why the extra is absent
+            RESULTS[f"top_kernels_{dt_name}_err"] = \
+                f"{type(e).__name__}: {e}"[:160]
+
+    return img_s, (flops_step / step_t if flops_step else None), \
+        capture_kernel_table
 
 
 def _infer_bench(dtype, batch):
@@ -467,18 +502,22 @@ def main():
     # every row lands in RESULTS the moment it's measured, so a
     # mid-run tunnel wedge still emits everything measured so far
     _beat(f"device {kind}, starting bf16 train (headline)")
-    bf16_img_s, bf16_flops_s = _train_bench("bfloat16", TRAIN_BS_BF16)
+    bf16_img_s, bf16_flops_s, bf16_capture = _train_bench(
+        "bfloat16", TRAIN_BS_BF16)
     RESULTS["train_bf16_bs%d_img_s" % TRAIN_BS_BF16] = round(bf16_img_s, 2)
     if bf16_flops_s:
         RESULTS["train_bf16_tflops"] = round(bf16_flops_s / 1e12, 2)
         if peak:
             RESULTS["train_bf16_mfu"] = round(bf16_flops_s / peak, 4)
+    _beat("bf16 headline recorded; capturing kernel table")
+    bf16_capture()      # headline already safe in RESULTS
 
     _beat(f"bf16 {bf16_img_s:.1f} img/s; starting fp32 train")
-    fp32_img_s, _ = _train_bench(None, TRAIN_BS_FP32)
+    fp32_img_s, _, fp32_capture = _train_bench(None, TRAIN_BS_FP32)
     RESULTS["train_fp32_bs%d_img_s" % TRAIN_BS_FP32] = round(fp32_img_s, 2)
     RESULTS["train_fp32_vs_v100_343"] = round(fp32_img_s / TRAIN_BASE_FP32,
                                               3)
+    fp32_capture()      # fp32 row already safe in RESULTS
 
     _beat(f"fp32 {fp32_img_s:.1f} img/s; starting inference")
     infer32 = _infer_bench("float32", INFER_BS)

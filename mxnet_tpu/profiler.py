@@ -105,6 +105,16 @@ def dump(finished=True, profile_process="worker"):
                    "device_op_table": device_op_table()}, f)
 
 
+def trace_dir():
+    """Path of the current/last xplane trace dir (None before any
+    start()) — the single owner of the '<stem>_xplane' convention."""
+    return _trace_dir
+
+
+def is_running() -> bool:
+    return _running
+
+
 def device_op_table():
     """Per-op DEVICE-time aggregates parsed from the captured xplane
     trace: {op: {count, total_us, avg_us}} (parity: the reference's
