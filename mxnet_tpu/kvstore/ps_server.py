@@ -292,26 +292,60 @@ class PSClient:
 
     def __init__(self, address: str, timeout: float = 60.0,
                  retries: int = 50):
-        host, port = address.rsplit(":", 1)
+        self._address = address
+        self._timeout = timeout
+        self._rank: Optional[int] = None
+        self._sock = self._connect(retries)
+        self._lock = threading.Lock()
+
+    def _connect(self, retries: int = 50) -> socket.socket:
+        host, port = self._address.rsplit(":", 1)
         last = None
         for _ in range(retries):  # the server thread may still be booting
             try:
-                self._sock = socket.create_connection((host, int(port)),
-                                                      timeout=timeout)
-                break
+                return socket.create_connection((host, int(port)),
+                                                timeout=self._timeout)
             except OSError as e:
                 last = e
                 import time
                 time.sleep(0.2)
-        else:
-            raise MXNetError(f"cannot reach param server at {address}: "
-                             f"{last}")
-        self._lock = threading.Lock()
+        raise MXNetError(f"cannot reach param server at {self._address}: "
+                         f"{last}")
 
     def _call(self, *msg):
         with self._lock:
-            _send_msg(self._sock, msg)
-            reply = _recv_msg(self._sock)
+            try:
+                _send_msg(self._sock, msg)
+                reply = _recv_msg(self._sock)
+            except socket.timeout:
+                # healthy-but-slow server: the request may still be in
+                # flight — retrying would risk a silent DUPLICATE apply
+                # of a non-idempotent push; surface instead
+                raise MXNetError(
+                    f"param server timed out after {self._timeout}s "
+                    "(server alive but slow; request state unknown)")
+            except (ConnectionError, OSError):
+                # genuine drop (peer closed / keepalive reap): reconnect
+                # once and retry — the async-PS contract tolerates an
+                # at-most-once duplicate (apply-immediately SGD
+                # semantics), and all reads are idempotent
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                try:
+                    self._sock = self._connect(retries=25)
+                    if self._rank is not None and msg[0] != "hello":
+                        _send_msg(self._sock, ("hello", self._rank))
+                        _recv_msg(self._sock)   # re-register liveness
+                    _send_msg(self._sock, msg)
+                    reply = _recv_msg(self._sock)
+                except (ConnectionError, OSError) as e:
+                    # keep the class's error contract (shutdown() and
+                    # callers suppress/handle MXNetError)
+                    raise MXNetError(
+                        f"param server connection lost and retry "
+                        f"failed: {e}") from e
         if reply[0] != "ok":
             raise MXNetError(f"param server error: {reply[1]}")
         return reply[1] if len(reply) > 1 else None
@@ -353,7 +387,8 @@ class PSClient:
 
     def hello(self, rank: int) -> None:
         """Register this connection's worker rank for liveness."""
-        self._call("hello", int(rank))
+        self._rank = int(rank)
+        self._call("hello", self._rank)
 
     def shutdown(self):
         try:

@@ -91,6 +91,11 @@ def main(out_dir):
     got = float(w.asnumpy()[0, 0])
     assert abs(got - 3.0) < 0.2, f"trainer async PS did not converge: {got}"
 
+    # final barrier BEFORE exit: rank 0 hosts the server thread, and
+    # exiting while another rank is mid-pull kills its connection
+    # ("peer closed") — seen under full-suite load
+    kv2.barrier()
+
     with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
         f.write("ok")
 

@@ -72,6 +72,10 @@ def main(out_dir):
         total = kv._ps_client.push_count("0")
         assert total == 18 + 31, f"server saw {total} sparse pushes"
 
+    # final barrier BEFORE exit: rank 0 hosts the server thread, and
+    # exiting while another rank is mid-pull kills its connection
+    kv.barrier()
+
     with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
         f.write("ok")
 
