@@ -243,3 +243,27 @@ class TestLoadFromBuffer:
         mx.nd.save(f, {"a": mx.nd.ones((3,))})
         out = mx.nd.load_frombuffer(open(f, "rb").read())
         onp.testing.assert_array_equal(out["a"].asnumpy(), onp.ones(3))
+
+
+class TestBufferExportRoundTrip:
+    def test_exported_params_load_from_memory(self, tmp_path):
+        """An export(params_format='mxnet') artifact round-trips through
+        load_frombuffer (in-memory consumer path: model registries that
+        hold checkpoints as blobs)."""
+        from mxnet_tpu.gluon import nn
+        net = nn.HybridSequential()
+        net.add(nn.Dense(5, in_units=2), nn.Dense(3))
+        net.initialize()
+        net.hybridize()
+        x = mx.nd.ones((1, 2))
+        net(x)   # finishes deferred init eagerly
+        net(x)   # second call compiles + caches (exportable)
+        prefix = str(tmp_path / "m")
+        net.export(prefix, params_format="mxnet")
+        blob = open(prefix + "-0000.params", "rb").read()
+        loaded = mx.nd.load_frombuffer(blob)
+        params = net.collect_params()
+        assert len(loaded) == len(params)
+        for k, p in params.items():
+            onp.testing.assert_array_equal(
+                loaded[f"arg:{k}"].asnumpy(), p.data().asnumpy())
