@@ -1,0 +1,139 @@
+"""Byte-level tests for the XPlane protobuf wire parser (xplane.py).
+
+The blobs below are constructed BY HAND from the protobuf wire format
+(varint tags, length-delimited submessages) — independent of the parser
+under test — so these pin the byte layout the way the serialization
+goldens do, not just a round trip through jax.profiler.
+"""
+import pytest
+
+from mxnet_tpu import xplane
+
+
+def _varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            out += bytes([b])
+            return out
+
+
+def _field(no: int, wire: int, payload: bytes) -> bytes:
+    return _varint((no << 3) | wire) + payload
+
+
+def _ld(no: int, payload: bytes) -> bytes:      # length-delimited
+    return _field(no, 2, _varint(len(payload)) + payload)
+
+
+def _vi(no: int, val: int) -> bytes:            # varint field
+    return _field(no, 0, _varint(val))
+
+
+def _event(metadata_id: int, duration_ps: int) -> bytes:
+    return _vi(1, metadata_id) + _vi(3, duration_ps)
+
+
+def _line(name: str, events) -> bytes:
+    body = _ld(2, name.encode())
+    for e in events:
+        body += _ld(4, e)
+    return body
+
+
+def _evmeta(key: int, name: str) -> bytes:
+    # map<int64, XEventMetadata> entry: key=1, value=2{id=1, name=2}
+    val = _vi(1, key) + _ld(2, name.encode())
+    return _vi(1, key) + _ld(2, val)
+
+
+def _plane(name: str, lines, metas) -> bytes:
+    body = _ld(2, name.encode())
+    for ln in lines:
+        body += _ld(3, ln)
+    for m in metas:
+        body += _ld(4, m)
+    return body
+
+
+def _xspace(planes) -> bytes:
+    out = b""
+    for p in planes:
+        out += _ld(1, p)
+    return out
+
+
+def _write(tmp_path, blob: bytes) -> str:
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    f = d / "host.xplane.pb"
+    f.write_bytes(blob)
+    return str(tmp_path)
+
+
+class TestWireParsing:
+    def test_device_plane_aggregation(self, tmp_path):
+        """TPU-style '/device:...' plane: events aggregate by metadata
+        name; step/module summary lines are skipped."""
+        plane = _plane(
+            "/device:TPU:0",
+            lines=[
+                _line("XLA Ops", [_event(7, 3_000_000),   # 3 us
+                                  _event(7, 1_000_000),   # 1 us
+                                  _event(9, 500_000)]),   # 0.5 us
+                _line("Steps", [_event(7, 99_000_000)]),  # skipped
+            ],
+            metas=[_evmeta(7, "fusion.1"), _evmeta(9, "copy.2")])
+        root = _write(tmp_path, _xspace([plane]))
+        table = xplane.device_op_table(root)
+        assert table["fusion.1"]["count"] == 2
+        assert table["fusion.1"]["total_us"] == pytest.approx(4.0)
+        assert table["fusion.1"]["avg_us"] == pytest.approx(2.0)
+        assert table["copy.2"]["total_us"] == pytest.approx(0.5)
+
+    def test_cpu_runtime_thunk_line(self, tmp_path):
+        """CPU runtime: thunk events on the XLAPjRtCpuClient line count;
+        'end:' markers and threadpool bookkeeping do not."""
+        plane = _plane(
+            "/host:CPU",
+            lines=[
+                _line("tf_XLAPjRtCpuClient/123",
+                      [_event(1, 2_000_000), _event(2, 700_000),
+                       _event(3, 50_000), _event(4, 10_000)]),
+                _line("python", [_event(1, 88_000_000)]),  # not a thunk line
+            ],
+            metas=[_evmeta(1, "dot_general.1"),
+                   _evmeta(2, "wrapped_tanh"),
+                   _evmeta(3, "end: dot_general.1"),
+                   _evmeta(4, "ThreadpoolListener::StartRegion")])
+        root = _write(tmp_path, _xspace([plane]))
+        table = xplane.device_op_table(root)
+        assert set(table) == {"dot_general.1", "wrapped_tanh"}
+        assert table["dot_general.1"]["total_us"] == pytest.approx(2.0)
+
+    def test_format_table_totals(self, tmp_path):
+        plane = _plane(
+            "/device:TPU:0",
+            lines=[_line("XLA Ops", [_event(1, 1_500_000)])],
+            metas=[_evmeta(1, "conv.0")])
+        root = _write(tmp_path, _xspace([plane]))
+        out = xplane.format_table(xplane.device_op_table(root))
+        assert "conv.0" in out and "TOTAL" in out
+
+    def test_missing_trace_dir_returns_empty(self, tmp_path):
+        assert xplane.device_op_table(str(tmp_path)) == {}
+
+    def test_multibyte_varints(self, tmp_path):
+        """Durations larger than 2^14 ps exercise multi-byte varints."""
+        dur = 123_456_789_012          # ~123 ms in ps
+        plane = _plane(
+            "/device:TPU:0",
+            lines=[_line("XLA Ops", [_event(300, dur)])],   # 2-byte id
+            metas=[_evmeta(300, "big_fusion")])
+        root = _write(tmp_path, _xspace([plane]))
+        table = xplane.device_op_table(root)
+        assert table["big_fusion"]["total_us"] == pytest.approx(dur / 1e6)
