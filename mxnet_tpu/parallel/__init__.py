@@ -14,6 +14,9 @@ from .ring_attention import ring_attention, ring_self_attention
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .pipeline import (gpipe_apply, pipeline_forward,
                        interleaved_apply, pipeline_forward_1f1b,
+                       pipeline_forward_interleaved,
+                       pipeline_value_and_grad_1f1b, one_f_one_b_apply,
+                       one_f_one_b_ticks,
                        interleave_params, interleaved_ticks, gpipe_ticks)
 from .moe import switch_moe, moe_expert_sharding
 
@@ -22,5 +25,7 @@ __all__ = ["make_mesh", "default_mesh", "data_parallel_spec", "replicated",
            "ulysses_attention", "ulysses_self_attention",
            "gpipe_apply", "pipeline_forward", "switch_moe",
            "interleaved_apply", "pipeline_forward_1f1b",
+           "pipeline_forward_interleaved", "pipeline_value_and_grad_1f1b",
+           "one_f_one_b_apply", "one_f_one_b_ticks",
            "interleave_params", "interleaved_ticks", "gpipe_ticks",
            "moe_expert_sharding"]

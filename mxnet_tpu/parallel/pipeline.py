@@ -17,6 +17,7 @@ and M microbatches the loop runs S+M-1 ticks at 1/S bubble overhead.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Callable, Optional
 
 import jax
@@ -26,8 +27,10 @@ from jax.sharding import Mesh, PartitionSpec
 from jax import shard_map
 
 __all__ = ["gpipe_apply", "pipeline_forward", "interleaved_apply",
-           "pipeline_forward_1f1b", "interleave_params",
-           "interleaved_ticks", "gpipe_ticks"]
+           "pipeline_forward_interleaved", "pipeline_forward_1f1b",
+           "interleave_params", "interleaved_ticks", "gpipe_ticks",
+           "one_f_one_b_apply", "pipeline_value_and_grad_1f1b",
+           "one_f_one_b_ticks"]
 
 
 def gpipe_apply(stage_fn: Callable, n_stages: int, axis_name: str = "pp"):
@@ -197,10 +200,19 @@ def interleave_params(layer_params, n_stages: int):
     return jax.tree.map(rearrange, layer_params)
 
 
-def pipeline_forward_1f1b(stage_fn: Callable, layer_params, x, mesh: Mesh,
-                          n_microbatches: int, axis_name: str = "pp",
-                          batch_axis_name: Optional[str] = "dp"):
-    """Interleaved-schedule pipeline forward (1F1B-interleaved analogue).
+def pipeline_forward_interleaved(stage_fn: Callable, layer_params, x,
+                                 mesh: Mesh, n_microbatches: int,
+                                 axis_name: str = "pp",
+                                 batch_axis_name: Optional[str] = "dp"):
+    """Interleaved-GPipe pipeline forward (virtual stages, fill-drain).
+
+    Cuts the schedule bubble from GPipe's (S-1)/(S+M-1) to
+    (S-1)/(V*S+M-1) by circulating each microbatch V times around the
+    ring.  NOTE: this is a *forward* whose backward (under ``jax.grad``)
+    replays after the whole forward, so all M microbatches' activations
+    stay live — it does NOT have true 1F1B's O(S) activation bound.  For
+    the activation-bounded schedule use
+    :func:`pipeline_value_and_grad_1f1b`.
 
     ``layer_params``: pytree with leading axis L = V*S (the plain layer
     stack, in network order); rearranged internally to the interleaved
@@ -210,7 +222,8 @@ def pipeline_forward_1f1b(stage_fn: Callable, layer_params, x, mesh: Mesh,
     L = jax.tree.leaves(layer_params)[0].shape[0]
     V = L // S
     if L % S:
-        raise ValueError(f"1f1b: layer count {L} not divisible by S={S}")
+        raise ValueError(
+            f"interleaved: layer count {L} not divisible by S={S}")
     inter = interleave_params(layer_params, S)
     body = interleaved_apply(stage_fn, S, V, axis_name)
     dp = (batch_axis_name
@@ -219,8 +232,8 @@ def pipeline_forward_1f1b(stage_fn: Callable, layer_params, x, mesh: Mesh,
     n_dp = mesh.shape[dp] if dp else 1
     if x.shape[0] % (n_dp * n_microbatches):
         raise ValueError(
-            f"1f1b: batch {x.shape[0]} not divisible by dp({n_dp}) x "
-            f"n_microbatches({n_microbatches})")
+            f"interleaved: batch {x.shape[0]} not divisible by dp({n_dp}) "
+            f"x n_microbatches({n_microbatches})")
 
     def full(params, xb):
         local = jax.tree.map(lambda a: a[0], params)   # drop sharded S
@@ -233,3 +246,189 @@ def pipeline_forward_1f1b(stage_fn: Callable, layer_params, x, mesh: Mesh,
     xspec = PartitionSpec(dp)
     return shard_map(full, mesh=mesh, in_specs=(pspec, xspec),
                      out_specs=xspec, check_vma=False)(inter, x)
+
+
+def pipeline_forward_1f1b(*args, **kwargs):
+    """Deprecated alias for :func:`pipeline_forward_interleaved`.
+
+    The schedule it runs is interleaved fill-drain (smaller bubble), not
+    activation-bounded 1F1B; the honest name is ``interleaved``.  For
+    the true 1F1B training step see :func:`pipeline_value_and_grad_1f1b`.
+    """
+    warnings.warn(
+        "pipeline_forward_1f1b is renamed pipeline_forward_interleaved "
+        "(it is an interleaved fill-drain schedule, not activation-"
+        "bounded 1F1B); for true 1F1B use pipeline_value_and_grad_1f1b",
+        DeprecationWarning, stacklevel=2)
+    return pipeline_forward_interleaved(*args, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# True 1F1B: activation-bounded forward/backward interleaving.
+#
+# The defining property of 1F1B (PipeDream-flush / Megatron-LM's
+# schedule) is that backward work for microbatch m starts as soon as its
+# forward clears the last stage, so each device holds activations for at
+# most O(S) in-flight microbatches — NOT O(M) as in GPipe-under-
+# ``jax.grad`` (whose backward replays only after the entire forward).
+#
+# SPMD formulation: one `lax.scan` over T = M + 2S - 2 ticks.  Every
+# tick each device runs one forward slot (microbatch  mf = t - s  when
+# valid) and one backward slot (microbatch  mb = t - (2S-2) + s).
+# Activations hop +1 on the ring after the F slot, cotangents hop -1
+# after the B slot.  The last stage seeds each microbatch's cotangent
+# from the loss the same tick its forward lands (B(S-1,m) shares tick
+# m+S-1 with F(S-1,m)).
+#
+# Memory: the only cross-tick activation state is a stash of *stage
+# inputs*, one slot per in-flight microbatch — a ring buffer of
+# W = min(2S-1, M) entries (stage s holds at most 2S-1-2s in flight;
+# entry m is written at tick m+s and read at tick m+2S-2-s, so W=2S-1
+# slots never collide).  The backward slot recomputes its stage forward
+# from the stashed input (``jax.vjp`` at backward time) — the standard
+# remat trade: each tick costs 2f+b instead of f+b, identical to what
+# GPipe-under-grad pays once ``jax.checkpoint`` is on, but with the
+# activation working set O(S·|input|) instead of O(M·|residuals|).
+# This is what unlocks deep microbatching (M >> S): bubble fraction
+# (2S-2)/(M+2S-2) -> 0 while memory stays flat in M
+# (pinned by tests/test_parallel_extra.py memory-growth test).
+#
+# The reference has no pipeline parallelism at all (its model
+# parallelism is per-layer ctx placement, docs model_parallel_lstm.md);
+# this is north-star scaling work per SURVEY §7.
+# --------------------------------------------------------------------------
+
+def one_f_one_b_ticks(n_stages: int, n_microbatches: int) -> int:
+    """Total 1F1B schedule ticks; each tick is one F slot + one B slot."""
+    return n_microbatches + 2 * n_stages - 2
+
+
+def one_f_one_b_apply(stage_fn: Callable, loss_fn: Callable, n_stages: int,
+                      n_microbatches: int, axis_name: str = "pp"):
+    """Per-device 1F1B training-step body; call inside shard_map.
+
+    ``stage_fn(stage_params, x) -> y`` is one stage (uniform shapes);
+    ``loss_fn(y, target) -> scalar`` is applied to the last stage's
+    output per microbatch.  Returns ``apply(stage_params, x_mb, t_mb)``
+    -> ``(mean_loss, grads)`` where ``x_mb``/``t_mb`` are (M, mb, ...)
+    microbatches and ``grads`` matches ``stage_params`` (this device's
+    stage only; loss is replicated over the axis).
+    """
+    S, M = n_stages, n_microbatches
+    W = min(2 * S - 1, M)          # stash ring-buffer slots (O(S), not O(M))
+    T = one_f_one_b_ticks(S, M)
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def apply(stage_params, x_mb, t_mb):
+        idx = lax.axis_index(axis_name)
+        carry_f = jnp.zeros_like(x_mb[0])
+        stash = jnp.zeros((W,) + x_mb.shape[1:], x_mb.dtype)
+        # probe the output/cotangent shape once (abstract eval only)
+        y_shape = jax.eval_shape(stage_fn, stage_params, x_mb[0])
+        carry_b = jnp.zeros(y_shape.shape, y_shape.dtype)
+        grads0 = jax.tree.map(jnp.zeros_like, stage_params)
+        loss0 = jnp.zeros((), jnp.float32)
+
+        def tick(carry, t):
+            carry_f, carry_b, stash, grads, loss_acc = carry
+            # ---- F slot: microbatch mf = t - idx flows GPipe-style
+            mf = t - idx
+            valid_f = (mf >= 0) & (mf < M)
+            mf_c = jnp.clip(mf, 0, M - 1)
+            feed = lax.dynamic_index_in_dim(x_mb, mf_c, 0, keepdims=False)
+            inp = jnp.where(idx == 0, feed, carry_f)
+            slot_f = mf_c % W
+            old = lax.dynamic_index_in_dim(stash, slot_f, 0, keepdims=False)
+            stash = lax.dynamic_update_index_in_dim(
+                stash, jnp.where(valid_f, inp, old), slot_f, 0)
+            y = stage_fn(stage_params, inp)
+            new_carry_f = lax.ppermute(y, axis_name, fwd_perm)
+            # ---- B slot: microbatch mb = t - (2S-2) + idx drains the ring
+            mb = t - (2 * S - 2) + idx
+            valid_b = (mb >= 0) & (mb < M)
+            mb_c = jnp.clip(mb, 0, M - 1)
+            xin = lax.dynamic_index_in_dim(stash, mb_c % W, 0,
+                                           keepdims=False)
+            y2, vjp_fn = jax.vjp(stage_fn, stage_params, xin)
+            tgt = lax.dynamic_index_in_dim(t_mb, mb_c, 0, keepdims=False)
+            loss_m, dldy = jax.value_and_grad(
+                lambda yy: loss_fn(yy, tgt))(y2)
+            # last stage seeds from the loss; others consume the ring
+            cot = jnp.where(idx == S - 1, (dldy / M).astype(y2.dtype),
+                            carry_b)
+            dparams, dx = vjp_fn(cot)
+            grads = jax.tree.map(
+                lambda g, d: g + jnp.where(valid_b, d, jnp.zeros_like(d)),
+                grads, dparams)
+            loss_acc = loss_acc + jnp.where(
+                valid_b & (idx == S - 1), loss_m / M, 0.0).astype(
+                    jnp.float32)
+            new_carry_b = lax.ppermute(dx, axis_name, bwd_perm)
+            return (new_carry_f, new_carry_b, stash, grads, loss_acc), None
+
+        (_, _, _, grads, loss_acc), _ = lax.scan(
+            tick, (carry_f, carry_b, stash, grads0, loss0),
+            jnp.arange(T))
+        mask = (idx == S - 1).astype(loss_acc.dtype)
+        loss = lax.psum(loss_acc * mask, axis_name)
+        return loss, grads
+
+    return apply
+
+
+def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
+                                 stacked_params, x, targets, mesh: Mesh,
+                                 n_microbatches: int, axis_name: str = "pp",
+                                 batch_axis_name: Optional[str] = "dp"):
+    """True 1F1B pipeline training step: ``(mean_loss, grads)``.
+
+    Unlike :func:`pipeline_forward` (+ ``jax.grad``), backward work is
+    interleaved per microbatch, so activation memory is bounded by the
+    stage count S, not the microbatch count M — use this for deep
+    microbatching (no ``M <= S`` restriction).  ``stacked_params`` has a
+    leading stage axis of size mesh.shape[axis_name] (sharded over it);
+    ``x``/``targets`` are (B, ...) batches split into ``n_microbatches``
+    (and over ``batch_axis_name`` if present; grads/loss are averaged
+    over it).  Returned grads carry the same stacked layout as
+    ``stacked_params``.
+    """
+    S = mesh.shape[axis_name]
+    for leaf in jax.tree.leaves(stacked_params):
+        if leaf.shape[0] != S:
+            raise ValueError(
+                f"1f1b: param leading (stage) axis {leaf.shape[0]} != pp "
+                f"mesh size {S} — one stage per device")
+    dp = (batch_axis_name
+          if batch_axis_name and batch_axis_name in mesh.axis_names
+          else None)
+    n_dp = mesh.shape[dp] if dp else 1
+    if x.shape[0] % (n_dp * n_microbatches):
+        raise ValueError(
+            f"1f1b: batch {x.shape[0]} not divisible by dp({n_dp}) x "
+            f"n_microbatches({n_microbatches})")
+    if targets.shape[0] != x.shape[0]:
+        raise ValueError(
+            f"1f1b: targets batch {targets.shape[0]} != x batch "
+            f"{x.shape[0]} (a mismatch would silently broadcast in "
+            f"loss_fn)")
+    body = one_f_one_b_apply(stage_fn, loss_fn, S, n_microbatches,
+                             axis_name)
+
+    def full(params, xb, tb):
+        local = jax.tree.map(lambda a: a[0], params)   # drop sharded S
+        M = n_microbatches
+        xmb = xb.reshape((M, xb.shape[0] // M) + xb.shape[1:])
+        tmb = tb.reshape((M, tb.shape[0] // M) + tb.shape[1:])
+        loss, grads = body(local, xmb, tmb)
+        if dp:
+            loss = lax.pmean(loss, dp)
+            grads = jax.tree.map(lambda g: lax.pmean(g, dp), grads)
+        return loss, jax.tree.map(lambda g: g[None], grads)
+
+    pspec = jax.tree.map(lambda _: PartitionSpec(axis_name), stacked_params)
+    xspec = PartitionSpec(dp)
+    gspec = jax.tree.map(lambda _: PartitionSpec(axis_name), stacked_params)
+    return shard_map(full, mesh=mesh, in_specs=(pspec, xspec, xspec),
+                     out_specs=(PartitionSpec(), gspec),
+                     check_vma=False)(stacked_params, x, targets)

@@ -531,6 +531,13 @@ class SPMDTrainer:
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         out = dict(ca or {})
+        try:
+            ma = compiled.memory_analysis()
+            out["temp_size_in_bytes"] = int(ma.temp_size_in_bytes)
+            out["argument_size_in_bytes"] = int(ma.argument_size_in_bytes)
+            out["output_size_in_bytes"] = int(ma.output_size_in_bytes)
+        except Exception:
+            pass        # some backends expose cost but not memory stats
         if not hasattr(self, "_cost_cache"):
             self._cost_cache = {}
         self._cost_cache[sig] = out

@@ -354,24 +354,24 @@ def _layer_stack(rng, L, H):
     return (jnp.asarray(w), jnp.asarray(b))
 
 
-def test_1f1b_forward_matches_sequential():
-    from mxnet_tpu.parallel import pipeline_forward_1f1b
+def test_interleaved_forward_matches_sequential():
+    from mxnet_tpu.parallel import pipeline_forward_interleaved
     S, V, H, B, M = 4, 2, 6, 8, 4
     rng = onp.random.RandomState(4)
     mesh = make_mesh({"pp": S})
     params = _layer_stack(rng, S * V, H)
     x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
-    got = pipeline_forward_1f1b(_stage_fn, params, x, mesh,
+    got = pipeline_forward_interleaved(_stage_fn, params, x, mesh,
                                 n_microbatches=M, batch_axis_name=None)
     ref = _sequential(params, x)
     onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
                                 rtol=1e-5, atol=1e-5)
 
 
-def test_1f1b_matches_gpipe_numerics_and_grads():
+def test_interleaved_matches_gpipe_numerics_and_grads():
     """Same model through both schedules: identical losses and grads
     (the interleaved layout permutes parameter placement, not math)."""
-    from mxnet_tpu.parallel import pipeline_forward_1f1b
+    from mxnet_tpu.parallel import pipeline_forward_interleaved
     S, V, H, B, M = 4, 2, 4, 8, 4
     rng = onp.random.RandomState(5)
     mesh = make_mesh({"pp": S})
@@ -393,14 +393,14 @@ def test_1f1b_matches_gpipe_numerics_and_grads():
                                batch_axis_name=None)
         return jnp.mean((out - y) ** 2)
 
-    def f1b_loss(p):
-        out = pipeline_forward_1f1b(_stage_fn, p, x, mesh,
+    def inter_loss(p):
+        out = pipeline_forward_interleaved(_stage_fn, p, x, mesh,
                                     n_microbatches=M,
                                     batch_axis_name=None)
         return jnp.mean((out - y) ** 2)
 
     l_g, g_g = jax.value_and_grad(gpipe_loss)(gpipe_params)
-    l_f, g_f = jax.value_and_grad(f1b_loss)(layers)
+    l_f, g_f = jax.value_and_grad(inter_loss)(layers)
     onp.testing.assert_allclose(float(l_f), float(l_g), rtol=1e-5)
     for a, b in zip(g_f, g_g):
         onp.testing.assert_allclose(
@@ -408,11 +408,11 @@ def test_1f1b_matches_gpipe_numerics_and_grads():
             rtol=1e-4, atol=1e-5)
 
 
-def test_1f1b_bubble_lower_than_gpipe_at_m_eq_s():
+def test_interleaved_bubble_lower_than_gpipe_at_m_eq_s():
     """The measured win: per-device schedule length (in single-layer
     time units) and compiled FLOPs are both lower than GPipe at M=S."""
     from mxnet_tpu.parallel import (gpipe_ticks, interleaved_ticks,
-                                    pipeline_forward_1f1b)
+                                    pipeline_forward_interleaved)
     S, V, M = 4, 2, 4
     t_gpipe = gpipe_ticks(S, V, M)            # V*(S+M-1) = 14
     t_inter = interleaved_ticks(S, V, M)      # V*S+M-1  = 11
@@ -450,34 +450,160 @@ def test_1f1b_bubble_lower_than_gpipe_at_m_eq_s():
                                        batch_axis_name=None),
         gpipe_params, x)
     f_inter = flops_of(
-        lambda p, xx: pipeline_forward_1f1b(_stage_fn, p, xx, mesh,
+        lambda p, xx: pipeline_forward_interleaved(_stage_fn, p, xx, mesh,
                                             n_microbatches=M,
                                             batch_axis_name=None),
         layers, x)
     assert f_inter < f_gpipe, (f_inter, f_gpipe)
 
 
-def test_1f1b_rejects_deep_microbatching():
-    from mxnet_tpu.parallel import pipeline_forward_1f1b
+def test_interleaved_rejects_deep_microbatching():
+    from mxnet_tpu.parallel import pipeline_forward_interleaved
     S, V, H, B = 4, 2, 4, 16
     rng = onp.random.RandomState(7)
     mesh = make_mesh({"pp": S})
     layers = _layer_stack(rng, S * V, H)
     x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
     with pytest.raises(ValueError, match="M <= S"):
-        pipeline_forward_1f1b(_stage_fn, layers, x, mesh,
+        pipeline_forward_interleaved(_stage_fn, layers, x, mesh,
                               n_microbatches=8, batch_axis_name=None)
 
 
-def test_1f1b_dp_x_pp():
-    from mxnet_tpu.parallel import pipeline_forward_1f1b
+def test_interleaved_dp_x_pp():
+    from mxnet_tpu.parallel import pipeline_forward_interleaved
     S, V, H, B, M = 4, 2, 4, 16, 2
     rng = onp.random.RandomState(8)
     mesh = make_mesh({"dp": 2, "pp": S})
     layers = _layer_stack(rng, S * V, H)
     x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
-    got = pipeline_forward_1f1b(_stage_fn, layers, x, mesh,
+    got = pipeline_forward_interleaved(_stage_fn, layers, x, mesh,
                                 n_microbatches=M)
+    ref = _sequential(layers, x)
+    onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
+                                rtol=1e-5, atol=1e-5)
+
+# --------------------------------------------------------------------------
+# True 1F1B (activation-bounded): pipeline_value_and_grad_1f1b
+# --------------------------------------------------------------------------
+
+def _mse(y, t):
+    return jnp.mean((y - t) ** 2)
+
+
+def _seq_value_and_grad(params, x, t, M):
+    """Reference: same microbatched mean-of-means loss, no pipeline."""
+    def loss(p):
+        xmb = x.reshape((M, x.shape[0] // M) + x.shape[1:])
+        tmb = t.reshape((M, t.shape[0] // M) + t.shape[1:])
+        def one(xm, tm):
+            h = xm
+            for s in range(p[0].shape[0]):
+                h = _stage_fn(jax.tree.map(lambda a: a[s], p), h)
+            return _mse(h, tm)
+        return jnp.mean(jax.vmap(one)(xmb, tmb))
+    return jax.value_and_grad(loss)(params)
+
+
+def test_true_1f1b_matches_sequential_deep_microbatching():
+    """M=16 > S=4 — the regime the interleaved schedule rejects; true
+    1F1B runs it and matches sequential loss+grads exactly."""
+    from mxnet_tpu.parallel import pipeline_value_and_grad_1f1b
+    S, H, B, M = 4, 6, 32, 16
+    rng = onp.random.RandomState(40)
+    mesh = make_mesh({"pp": S})
+    params = _layer_stack(rng, S, H)
+    x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    t = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    loss, grads = pipeline_value_and_grad_1f1b(
+        _stage_fn, _mse, params, x, t, mesh, n_microbatches=M,
+        batch_axis_name=None)
+    lref, gref = _seq_value_and_grad(params, x, t, M)
+    onp.testing.assert_allclose(float(loss), float(lref), rtol=1e-6)
+    for g, gr in zip(grads, gref):
+        onp.testing.assert_allclose(onp.asarray(g), onp.asarray(gr),
+                                    rtol=1e-4, atol=1e-6)
+
+
+def test_true_1f1b_dp_x_pp_matches_sequential():
+    from mxnet_tpu.parallel import pipeline_value_and_grad_1f1b
+    S, H, B, M = 4, 4, 32, 8
+    rng = onp.random.RandomState(41)
+    mesh = make_mesh({"dp": 2, "pp": S})
+    params = _layer_stack(rng, S, H)
+    x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    t = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    loss, grads = pipeline_value_and_grad_1f1b(
+        _stage_fn, _mse, params, x, t, mesh, n_microbatches=M)
+    # dp shards see B/2 rows each with M microbatches; the reference is
+    # the mean over both shards of the per-shard microbatched loss
+    l0, g0 = _seq_value_and_grad(params, x[:B // 2], t[:B // 2], M)
+    l1, g1 = _seq_value_and_grad(params, x[B // 2:], t[B // 2:], M)
+    onp.testing.assert_allclose(float(loss), float((l0 + l1) / 2),
+                                rtol=1e-6)
+    for g, ga, gb in zip(grads, g0, g1):
+        onp.testing.assert_allclose(onp.asarray(g),
+                                    onp.asarray((ga + gb) / 2),
+                                    rtol=1e-4, atol=1e-6)
+
+
+def test_true_1f1b_activation_memory_bounded_in_M():
+    """THE 1F1B property: XLA temp allocation stays flat as M grows
+    (stash is a ring buffer of 2S-1 stage inputs), while GPipe-under-
+    jax.grad keeps all M microbatches' activations live and its temp
+    grows ~linearly.  Measured from compiled memory_analysis()."""
+    from mxnet_tpu.parallel import (pipeline_forward,
+                                    pipeline_value_and_grad_1f1b)
+    S, H, mb = 4, 32, 4
+    mesh = make_mesh({"pp": S})
+    W = jnp.zeros((S, H, H), jnp.float32)
+    b = jnp.zeros((S, H), jnp.float32)
+
+    def temp_1f1b(M):
+        x = jnp.zeros((M * mb, H), jnp.float32)
+        f = jax.jit(lambda p, xx, tt: pipeline_value_and_grad_1f1b(
+            _stage_fn, _mse, p, xx, tt, mesh, n_microbatches=M,
+            batch_axis_name=None))
+        return f.lower((W, b), x, x).compile() \
+                .memory_analysis().temp_size_in_bytes
+
+    def temp_gpipe(M):
+        x = jnp.zeros((M * mb, H), jnp.float32)
+        def loss(p, xx, tt):
+            out = pipeline_forward(_stage_fn, p, xx, mesh,
+                                   n_microbatches=M, batch_axis_name=None)
+            return _mse(out, tt)
+        f = jax.jit(jax.value_and_grad(loss))
+        return f.lower((W, b), x, x).compile() \
+                .memory_analysis().temp_size_in_bytes
+
+    t8, t32 = temp_1f1b(8), temp_1f1b(32)
+    g8, g32 = temp_gpipe(8), temp_gpipe(32)
+    # GPipe temp grows with M (4x microbatches -> ~4x activations)
+    assert g32 > 2.5 * g8, (g8, g32)
+    # 1F1B temp is bounded: growing M 4x moves temp by < 10%
+    assert t32 < 1.1 * t8, (t8, t32)
+    # and at deep microbatching 1F1B uses far less temp than GPipe
+    assert t32 < g32 / 4, (t32, g32)
+
+
+def test_one_f_one_b_tick_accounting():
+    from mxnet_tpu.parallel import one_f_one_b_ticks
+    # schedule length: M + 2S - 2 paired ticks (the O(S) stash property
+    # itself is pinned by the compiled-memory test above)
+    assert one_f_one_b_ticks(4, 16) == 22
+    assert one_f_one_b_ticks(8, 64) == 78
+
+
+def test_pipeline_forward_1f1b_alias_warns():
+    from mxnet_tpu.parallel import pipeline_forward_1f1b
+    S, V, H, B, M = 4, 2, 4, 8, 4
+    rng = onp.random.RandomState(42)
+    mesh = make_mesh({"pp": S})
+    layers = _layer_stack(rng, S * V, H)
+    x = jnp.asarray(rng.randn(B, H).astype(onp.float32))
+    with pytest.warns(DeprecationWarning, match="interleaved"):
+        got = pipeline_forward_1f1b(_stage_fn, layers, x, mesh,
+                                    n_microbatches=M, batch_axis_name=None)
     ref = _sequential(layers, x)
     onp.testing.assert_allclose(onp.asarray(got), onp.asarray(ref),
                                 rtol=1e-5, atol=1e-5)
