@@ -356,6 +356,21 @@ class HybridBlock(Block):
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   **kwargs):
+        if active:
+            # row_sparse grads only exist on the eager tape (sparse_bwd
+            # attaches to eager op records); the cached graph would
+            # deliver a dense cotangent into the row_sparse grad buffer
+            # mid-backward.  Fail HERE, at configuration time.
+            sparse = [name for name, p in self.collect_params().items()
+                      if getattr(p, "_grad_stype", "default")
+                      == "row_sparse"]
+            if sparse:
+                raise MXNetError(
+                    f"cannot hybridize a block holding "
+                    f"grad_stype='row_sparse' parameters {sparse}: "
+                    "sparse gradients need the eager (non-hybridized) "
+                    "backward; keep the embedding un-hybridized or use "
+                    "sparse_grad=False")
         self._active = active
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)

@@ -119,6 +119,71 @@ class _GlobalCollectives:
         out = self._gather(garr)
         return jnp.asarray(out.addressable_data(0))
 
+    def allreduce_rowsparse_batch(self, items):
+        """Row-sparse sum over processes WITHOUT densifying (parity:
+        comm.h:104 ReduceRowSparse / kvstore_dist.h:559 sparse wire).
+
+        ``items``: list of (indices, values) pairs — ALL keys of one
+        push ride fused collectives: ONE nnz-counts allgather for every
+        key, then per value-dtype ONE fused indices allgather (padded
+        with -1) and ONE fused flattened-values allgather (padded with
+        0).  Wire cost is O(nproc x Σ max_nnz_k x row_k) instead of the
+        dense O(Σ nrows_k x row_k).  The index-union merge + segment
+        sum happen host-side on the gathered nnz-sized payload.
+        Returns ([(merged_indices, merged_values)], payload_bytes).
+        """
+        counts = onp.asarray(self.allgather(jnp.asarray(
+            [int(i.shape[0]) for i, _ in items], jnp.int32)))
+        budgets = counts.reshape(self.nproc, len(items)).max(axis=0)
+        out = [None] * len(items)
+        payload = int(counts.nbytes)
+        by_dtype: Dict[str, list] = {}
+        for j, (idx, vals) in enumerate(items):
+            if budgets[j] == 0:
+                out[j] = (onp.zeros((0,), onp.int64),
+                          onp.zeros((0,) + tuple(vals.shape[1:]),
+                                    onp.asarray(vals).dtype))
+                continue
+            by_dtype.setdefault(str(onp.asarray(vals).dtype), []) \
+                .append(j)
+        for js in by_dtype.values():
+            idx_pads, val_pads = [], []
+            for j in js:
+                idx, vals = items[j]
+                B, n = int(budgets[j]), int(idx.shape[0])
+                idx_pads.append(jnp.full((B,), -1, jnp.int64)
+                                .at[:n].set(jnp.asarray(idx, jnp.int64)))
+                rowsz = int(onp.prod(vals.shape[1:])) \
+                    if vals.ndim > 1 else 1
+                val_pads.append(jnp.zeros((B * rowsz,), vals.dtype)
+                                .at[:n * rowsz].set(
+                                    jnp.asarray(vals).reshape(-1)))
+            all_idx = onp.asarray(self.allgather(
+                jnp.concatenate(idx_pads) if len(idx_pads) > 1
+                else idx_pads[0]))
+            all_val = onp.asarray(self.allgather(
+                jnp.concatenate(val_pads) if len(val_pads) > 1
+                else val_pads[0]))
+            payload += all_idx.nbytes + all_val.nbytes
+            io = vo = 0
+            for j in js:
+                idx, vals = items[j]
+                B = int(budgets[j])
+                row_shape = tuple(vals.shape[1:])
+                rowsz = int(onp.prod(row_shape)) if row_shape else 1
+                g_idx = all_idx[:, io:io + B].reshape(-1)
+                g_val = all_val[:, vo:vo + B * rowsz].reshape(
+                    (self.nproc * B,) + row_shape)
+                io += B
+                vo += B * rowsz
+                live = g_idx >= 0
+                uniq, inv = onp.unique(g_idx[live], return_inverse=True)
+                merged = onp.zeros((len(uniq),) + row_shape,
+                                   g_val.dtype)
+                onp.add.at(merged, inv, g_val[live])
+                out[j] = (uniq, merged)
+        return out, payload
+
 
 @KVStoreBase.register
 class DistKVStore(KVStoreBase):
@@ -328,6 +393,61 @@ class DistKVStore(KVStoreBase):
         if items:
             self._gather_shards(items)
 
+    # -- row-sparse collective path ----------------------------------------
+    def _sparse_allreduce_batch(self, values):
+        """Reduce RowSparseNDArrays over processes at nnz wire cost —
+        all keys of one push share fused collectives (one counts
+        allgather + one indices/values allgather per dtype), mirroring
+        the dense path's key batching.
+
+        The last call's payload accounting is kept in
+        ``last_sparse_comm`` (payload vs what densify would have moved)
+        as evidence that embedding gradients no longer pay O(vocab)
+        comm on dist_sync."""
+        from .. import profiler
+        from ..ndarray.sparse import RowSparseNDArray
+
+        dense_bytes = sum(
+            int(onp.prod(v.shape)) * onp.dtype(v.data.dtype).itemsize
+            for v in values)
+        if self._nproc == 1:
+            self.last_sparse_comm = {"payload_bytes": 0,
+                                     "dense_bytes": dense_bytes}
+            return list(values)
+        t0 = profiler.op_timer()
+        merged, payload = self._collectives().allreduce_rowsparse_batch(
+            [(jnp.asarray(v.indices), jnp.asarray(v.data))
+             for v in values])
+        profiler.op_record("kvstore_sparse_allgather", t0)
+        self.last_sparse_comm = {"payload_bytes": int(payload),
+                                 "dense_bytes": dense_bytes}
+        return [RowSparseNDArray(jnp.asarray(vals), jnp.asarray(idx),
+                                 tuple(v.shape))
+                for v, (idx, vals) in zip(values, merged)]
+
+    def _sparse_update(self, k, rsp):
+        """Server-optimizer update for a row-sparse-reduced key: every
+        rank applies the same reduced gradient to its full replica
+        through the optimizer's lazy row_sparse kernel (O(nnz·dim)
+        compute).  Optimizer state for sparse keys stays full-size and
+        replicated rather than ZeRO-sliced — slicing a flat buffer
+        would break row granularity (parity: the reference server also
+        keeps whole rows per key, kvstore_dist_server.h:346)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        if not hasattr(self, "_sparse_opt_states"):
+            self._sparse_opt_states = {}
+        idx = self._key_index.setdefault(k, len(self._key_index))
+        weight = self._data[k]
+        if isinstance(weight, RowSparseNDArray):
+            # an optimizer attached AFTER pure-reduce pushes: the stored
+            # sparse value must become a real dense weight first
+            weight = self._data[k] = weight.todense()
+        if k not in self._sparse_opt_states:
+            self._sparse_opt_states[k] = \
+                self._optimizer.create_state_multi_precision(idx, weight)
+        self._optimizer.update_multi_precision(idx, weight, rsp,
+                                               self._sparse_opt_states[k])
+
     # -- compression wire path --------------------------------------------
     def _compressed_allreduce(self, k, local: NDArray) -> NDArray:
         comp = self._compression
@@ -415,12 +535,39 @@ class DistKVStore(KVStoreBase):
                     self._ps_client.push(k, v.asnumpy())
             return
 
-        # collective/SSP paths ride dense fused buffers; sparse values
-        # densify here (todense() emits the storage-fallback log; the
-        # nnz-cost paths are the uncoordinated PS push above and the
-        # local/device store's index merge)
+        # row_sparse on the plain sync collective path reduces sparsely
+        # (fused index-union allgathers at nnz cost — parity:
+        # comm.h:104 ReduceRowSparse); the SSP-async and compressed
+        # paths ride dense fused buffers, so sparse values densify
+        # there (todense() emits the storage-fallback log).  Split by
+        # ENTRY, not key, so a push carrying both a dense and a sparse
+        # gradient for one key loses neither.
+        sparse_ok = self._compression is None and not self._async
+        sparse_pos = [i for i, (_, v) in enumerate(kv)
+                      if sparse_ok and isinstance(v, RowSparseNDArray)]
+        sparse_kv = [kv[i] for i in sparse_pos]
+        taken = set(sparse_pos)
         kv = [(k, v.todense() if isinstance(v, BaseSparseNDArray) else v)
-              for k, v in kv]
+              for i, (k, v) in enumerate(kv) if i not in taken]
+        if sparse_kv:
+            reduced = self._sparse_allreduce_batch(
+                [v for _, v in sparse_kv])
+            for (k, _), r in zip(sparse_kv, reduced):
+                if self._optimizer is not None and k in self._data:
+                    self._sparse_update(k, r)
+                elif self._updater is not None and k in self._data:
+                    self._updater(_key_int(k), r, self._data[k])
+                elif self._optimizer is not None or \
+                        self._updater is not None:
+                    # push-before-init under an updater/optimizer:
+                    # adopt DENSE so the next push's update sees a real
+                    # weight, not positional nnz rows (the PS server
+                    # adopts the same way)
+                    self._data[k] = r.todense()
+                else:
+                    self._data[k] = r     # pure reduce: stays sparse
+        if not kv:
+            return
 
         if self._async and self._optimizer is not None and \
                 all(k in self._data for k, _ in kv):
@@ -486,6 +633,9 @@ class DistKVStore(KVStoreBase):
                                    tuple(self._data[key].shape))
         else:
             full = self._data[key]
+            if isinstance(full, RowSparseNDArray):
+                # a no-optimizer store holds the sparse-reduced push
+                full = full.todense()
             if len(rows) and (rows[0] < 0 or rows[-1] >= full.shape[0]):
                 raise MXNetError(
                     f"row_sparse_pull: row_ids out of range for key "
@@ -512,7 +662,16 @@ class DistKVStore(KVStoreBase):
                 from jax.experimental import multihost_utils
                 v = NDArray(multihost_utils.broadcast_one_to_all(v._data))
             self._data[key] = v
-            self._ps_client.init(key, v.asnumpy())  # register server-side
+            # rank 0 overwrites explicitly (NOT init's first-write-wins:
+            # a re-broadcast, e.g. checkpoint load mid-run, must replace
+            # the server copy or the next pull reverts the parameter).
+            # Other ranks only register the key — in uncoordinated async
+            # a straggler's late set() would clobber optimizer updates
+            # the server already applied from faster ranks' pushes.
+            if self._rank == 0:
+                self._ps_client.set(key, v.asnumpy())
+            else:
+                self._ps_client.init(key, v.asnumpy())
             if out is not None:
                 self.pull(key, out, priority)
             return

@@ -5,24 +5,49 @@ applies each push IMMEDIATELY server-side with no inter-worker
 coupling (:337-346 ``ApplyUpdates`` in async mode), which is what makes
 async tolerate stragglers: ranks may push different numbers of times
 and never rendezvous.  The reference's transport is ps-lite's ZeroMQ
-TCP van; ours is a plain threaded TCP server with length-prefixed
-pickle frames (local/DCN path — the ICI-collective stores remain the
-fast path for synchronous training).
+TCP van with fixed protobuf schemas; ours is a threaded TCP server
+with a FIXED BINARY wire format (transport v2):
+
+* frames are ``<Q`` length-prefixed; the payload is a magic + tagged
+  argument list (str / int / int-tuple / raw-ndarray / opaque blob) —
+  tensors travel as dtype+shape+raw bytes, NEVER pickled, so a hostile
+  peer cannot execute code through the data plane;
+* the ONE opaque-blob channel is ``set_optimizer`` (a pickled optimizer
+  object).  That channel is trusted-local BY DESIGN — same trust level
+  as the reference shipping optimizer binaries to its servers
+  (kvstore_dist_server.h CommandHandle).  Deployments crossing a trust
+  boundary must set ``MXNET_PS_HMAC_KEY``: when present, every frame in
+  BOTH directions carries an HMAC-SHA256 trailer over the payload and
+  unauthenticated frames are rejected before parsing.  Scope: the HMAC
+  gives frame integrity + peer authentication, NOT replay protection or
+  confidentiality — an on-path attacker can replay a recorded frame.
+  Against on-path adversaries run the PS over an authenticated
+  encrypted transport (WireGuard/TLS tunnel), as the reference assumes
+  for ps-lite's plaintext van;
+* the server holds PER-KEY locks (not one global lock), so concurrent
+  pushes to different keys apply in parallel; each key gets its own
+  optimizer instance (hydrated from the latest ``set_optimizer`` blob),
+  so no cross-key shared counters race.  Per-key step counts match the
+  per-index semantics the optimizers already use.
 
 The server runs as a thread inside rank 0's process (the reference
 supports colocated servers the same way via its launcher); clients are
 plain sockets, one per worker process.  The optimizer runs server-side
 (``update_on_kvstore`` semantics): a push carries a gradient, the
 server applies ``optimizer.update`` on its copy of the weight, a pull
-returns the current weight.
+returns the current weight.  Throughput characteristics are recorded by
+``tools/bench_ps_throughput.py`` → ``docs/PS_THROUGHPUT.json``.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as hmac_mod
+import os
 import pickle
 import socket
 import struct
 import threading
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import numpy as onp
 
@@ -49,9 +74,135 @@ class ParamMults:
         self.lr_mult, self.wd_mult = state
 
 
-def _send_msg(sock: socket.socket, obj) -> None:
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+# -- transport v2: fixed binary framing ------------------------------------
+#
+# payload  := MAGIC(4) argc:u8 arg*
+# arg      := NONE(0x00)
+#           | STR(0x01)   len:u32 utf8
+#           | INT(0x02)   i64
+#           | INTS(0x03)  count:u32 i64*
+#           | ARR(0x04)   dlen:u8 dtype-ascii ndim:u8 dims:i64* raw-bytes
+#           | BLOB(0x05)  len:u32 raw       (opaque; see module doc)
+#
+# A frame on the socket is ``<Q`` payload length, payload, then — iff
+# MXNET_PS_HMAC_KEY is set — a 32-byte HMAC-SHA256 trailer (the length
+# prefix does NOT cover the trailer).
+
+_MAGIC = b"PS2\x00"
+_T_NONE, _T_STR, _T_INT, _T_INTS, _T_ARR, _T_BLOB = range(6)
+
+
+def _dtype_name(dt: onp.dtype) -> str:
+    return dt.name          # 'float32', 'int64', 'bfloat16', ...
+
+
+def _dtype_from_name(name: str):
+    try:
+        return onp.dtype(name)
+    except TypeError:
+        import ml_dtypes   # bfloat16/float8 registrations (jax dep)
+        return onp.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_msg(args) -> bytes:
+    parts = [_MAGIC, struct.pack("<B", len(args))]
+    for a in args:
+        if a is None:
+            parts.append(struct.pack("<B", _T_NONE))
+        elif isinstance(a, str):
+            b = a.encode("utf-8")
+            parts.append(struct.pack("<BI", _T_STR, len(b)))
+            parts.append(b)
+        elif isinstance(a, (int, onp.integer)):   # incl. bool
+            parts.append(struct.pack("<Bq", _T_INT, int(a)))
+        elif isinstance(a, bytes):
+            parts.append(struct.pack("<BI", _T_BLOB, len(a)))
+            parts.append(a)
+        elif isinstance(a, (tuple, list)) and \
+                all(isinstance(x, (int, onp.integer)) for x in a):
+            parts.append(struct.pack("<BI", _T_INTS, len(a)))
+            parts.append(struct.pack("<%dq" % len(a), *[int(x) for x in a]))
+        elif isinstance(a, onp.ndarray):
+            arr = onp.asarray(a)     # tobytes() below emits C-order
+                                     # (ascontiguousarray would promote
+                                     # 0-dim arrays to 1-dim)
+            dname = _dtype_name(arr.dtype).encode("ascii")
+            parts.append(struct.pack("<BB", _T_ARR, len(dname)))
+            parts.append(dname)
+            parts.append(struct.pack("<B", arr.ndim))
+            if arr.ndim:
+                parts.append(struct.pack("<%dq" % arr.ndim, *arr.shape))
+            parts.append(arr.tobytes())
+        else:
+            raise MXNetError(
+                f"ps wire: unsupported argument type {type(a).__name__} "
+                "(transport v2 carries only str/int/ints/ndarray/bytes)")
+    return b"".join(parts)
+
+
+def _decode_msg(payload: bytes):
+    if payload[:4] != _MAGIC:
+        raise MXNetError("ps wire: bad magic (not a v2 frame)")
+    off = 4
+    (argc,) = struct.unpack_from("<B", payload, off)
+    off += 1
+    out = []
+    for _ in range(argc):
+        (tag,) = struct.unpack_from("<B", payload, off)
+        off += 1
+        if tag == _T_NONE:
+            out.append(None)
+        elif tag == _T_STR:
+            (n,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            out.append(payload[off:off + n].decode("utf-8"))
+            off += n
+        elif tag == _T_INT:
+            (v,) = struct.unpack_from("<q", payload, off)
+            off += 8
+            out.append(v)
+        elif tag == _T_INTS:
+            (n,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            out.append(tuple(struct.unpack_from("<%dq" % n, payload, off)))
+            off += 8 * n
+        elif tag == _T_ARR:
+            (dlen,) = struct.unpack_from("<B", payload, off)
+            off += 1
+            dt = _dtype_from_name(payload[off:off + dlen].decode("ascii"))
+            off += dlen
+            (ndim,) = struct.unpack_from("<B", payload, off)
+            off += 1
+            shape = struct.unpack_from("<%dq" % ndim, payload, off) \
+                if ndim else ()
+            off += 8 * ndim
+            nbytes = int(onp.prod(shape, dtype=onp.int64)) * dt.itemsize \
+                if ndim else dt.itemsize
+            arr = onp.frombuffer(payload[off:off + nbytes], dtype=dt)
+            out.append(arr.reshape(shape).copy())
+            off += nbytes
+        elif tag == _T_BLOB:
+            (n,) = struct.unpack_from("<I", payload, off)
+            off += 4
+            out.append(payload[off:off + n])
+            off += n
+        else:
+            raise MXNetError(f"ps wire: unknown tag {tag}")
+    if off != len(payload):
+        raise MXNetError("ps wire: trailing bytes in frame")
+    return tuple(out)
+
+
+def _hmac_key() -> Optional[bytes]:
+    k = os.environ.get("MXNET_PS_HMAC_KEY")
+    return k.encode("utf-8") if k else None
+
+
+def _send_msg(sock: socket.socket, args, key: Optional[bytes]) -> None:
+    payload = _encode_msg(args)
+    trailer = hmac_mod.new(key, payload, hashlib.sha256).digest() \
+        if key else b""
+    sock.sendall(struct.pack("<Q", len(payload)) + payload + trailer)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -64,13 +215,26 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
-def _recv_msg(sock: socket.socket):
+def _recv_msg(sock: socket.socket, key: Optional[bytes]):
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    payload = _recv_exact(sock, n)
+    if key:
+        digest = _recv_exact(sock, 32)
+        want = hmac_mod.new(key, payload, hashlib.sha256).digest()
+        if not hmac_mod.compare_digest(digest, want):
+            raise MXNetError("ps wire: HMAC verification failed")
+    return _decode_msg(payload)
 
 
 class ParamServer:
-    """Threaded TCP parameter server applying pushes immediately."""
+    """Threaded TCP parameter server applying pushes immediately.
+
+    Concurrency: one handler thread per client connection; state is
+    guarded by PER-KEY locks (plus a meta lock for registry/liveness),
+    so pushes to different keys run in parallel.  Each key applies
+    updates through its OWN optimizer instance hydrated from the latest
+    ``set_optimizer`` blob — no shared mutable optimizer counters
+    across handler threads."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -78,11 +242,14 @@ class ParamServer:
         self._sock.bind((host, port))
         self._sock.listen(64)
         self.address = "%s:%d" % self._sock.getsockname()
-        self._lock = threading.Lock()
+        self._hmac = _hmac_key()     # captured once at construction
+        self._meta_lock = threading.Lock()
+        self._key_locks: Dict[Any, threading.Lock] = {}
         self._store: Dict[Any, onp.ndarray] = {}
         self._states: Dict[Any, tuple] = {}
         self._push_counts: Dict[Any, int] = {}
-        self._optimizer = None
+        self._opt_blob: Optional[bytes] = None
+        self._optimizers: Dict[Any, Any] = {}
         # liveness: per-rank connection refcounts (parity: ps-lite
         # heartbeats behind kvstore.h:408 get_num_dead_node).  Process
         # death closes the socket and drops the rank; kernel TCP
@@ -92,6 +259,33 @@ class ParamServer:
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._serve, daemon=True)
         self._thread.start()
+
+    def _key_lock(self, key) -> threading.Lock:
+        with self._meta_lock:
+            lock = self._key_locks.get(key)
+            if lock is None:
+                lock = self._key_locks[key] = threading.Lock()
+            return lock
+
+    def _key_optimizer(self, key):
+        """This key's optimizer instance (hydrated lazily from the
+        latest blob).  Call with the key's lock held."""
+        while True:
+            with self._meta_lock:
+                blob = self._opt_blob
+                opt = self._optimizers.get(key)
+            if opt is not None or blob is None:
+                return opt
+            # pickle hydration: trusted-local channel (module
+            # docstring); HMAC (when configured) authenticated the
+            # frame that carried it.  Hydrate OUTSIDE the meta lock
+            # (unpickle can be slow), then install only if the blob is
+            # still current — a concurrent set_optimizer swap restarts
+            # the loop so a stale-blob instance can never stick.
+            opt = pickle.loads(blob)
+            with self._meta_lock:
+                if self._opt_blob is blob:
+                    return self._optimizers.setdefault(key, opt)
 
     # -- server side -------------------------------------------------------
     def _serve(self):
@@ -122,20 +316,22 @@ class ParamServer:
         try:
             while not self._stop.is_set():
                 try:
-                    msg = _recv_msg(conn)
+                    msg = _recv_msg(conn, self._hmac)
                 except (ConnectionError, EOFError, OSError):
                     return
+                except MXNetError:
+                    return      # bad magic / failed HMAC: drop the peer
                 if msg[0] == "hello":
                     rank[0] = int(msg[1])
-                    with self._lock:
+                    with self._meta_lock:
                         self._rank_refs[rank[0]] = \
                             self._rank_refs.get(rank[0], 0) + 1
-                    _send_msg(conn, ("ok",))
+                    _send_msg(conn, ("ok",), self._hmac)
                     continue
                 reply = self._handle(msg)
-                _send_msg(conn, reply)
+                _send_msg(conn, reply, self._hmac)
         finally:
-            with self._lock:
+            with self._meta_lock:
                 if rank[0] is not None:
                     self._rank_refs[rank[0]] -= 1
                     if self._rank_refs[rank[0]] <= 0:
@@ -147,27 +343,35 @@ class ParamServer:
         try:
             if op == "init":
                 _, key, val = msg
-                with self._lock:
+                with self._key_lock(key):
                     # first init wins (parity: server Init handler)
                     self._store.setdefault(key, onp.array(val))
                 return ("ok",)
+            if op == "set":
+                # explicit overwrite (broadcast of new values, e.g.
+                # loading a checkpoint mid-run — init's setdefault must
+                # not leave the server copy stale)
+                _, key, val = msg
+                with self._key_lock(key):
+                    self._store[key] = onp.array(val)
+                return ("ok",)
             if op == "push":
                 _, key, grad = msg
-                with self._lock:
+                with self._key_lock(key):
                     self._apply_push(key, onp.asarray(grad))
                 return ("ok",)
             if op == "push_sparse":
                 # row_sparse gradient: only (indices, values) traveled;
                 # the optimizer's lazy kernel touches only those rows
                 _, key, indices, values, shape = msg
-                with self._lock:
+                with self._key_lock(key):
                     self._apply_push_sparse(key, onp.asarray(indices),
                                             onp.asarray(values),
                                             tuple(shape))
                 return ("ok",)
             if op == "pull":
                 _, key = msg
-                with self._lock:
+                with self._key_lock(key):
                     if key not in self._store:
                         return ("err", f"pull: unknown key {key!r}")
                     return ("ok", self._store[key])
@@ -175,29 +379,34 @@ class ParamServer:
                 # sparse row pull: only the requested rows travel
                 # (parity: kvstore_dist.h:559 sparse row pulls)
                 _, key, rows = msg
-                with self._lock:
+                with self._key_lock(key):
                     if key not in self._store:
                         return ("err", f"pull_rows: unknown key {key!r}")
                     return ("ok", self._store[key][onp.asarray(rows)])
             if op == "set_optimizer":
                 _, payload = msg
-                with self._lock:
-                    new = pickle.loads(payload)
-                    if self._optimizer is not None:
-                        # hyperparameter refresh must not reset step
-                        # counts: adam bias correction / lr_scheduler
-                        # continue from the server's counts
-                        new._index_update_count = \
-                            self._optimizer._index_update_count
-                        new.num_update = self._optimizer.num_update
-                    self._optimizer = new
+                with self._meta_lock:
+                    self._opt_blob = bytes(payload)
+                    stale = dict(self._optimizers)
+                    self._optimizers = {}
+                # hyperparameter refresh must not reset step counts:
+                # adam bias correction / lr_scheduler continue from the
+                # per-key counts (re-hydrate each key's instance and
+                # graft the old counters over)
+                for k, old in stale.items():
+                    new = pickle.loads(self._opt_blob)
+                    new._index_update_count = old._index_update_count
+                    new.num_update = old.num_update
+                    with self._meta_lock:
+                        self._optimizers[k] = new
                 return ("ok",)
             if op == "push_count":
                 _, key = msg
-                return ("ok", self._push_counts.get(key, 0))
+                with self._key_lock(key):
+                    return ("ok", self._push_counts.get(key, 0))
             if op == "num_alive":
-                with self._lock:
-                    return ("ok", sorted(self._rank_refs))
+                with self._meta_lock:
+                    return ("ok", tuple(sorted(self._rank_refs)))
             if op == "command":
                 # remote server command (parity: kvstore.h:440
                 # SetServerProfilerCommand / CommandHandle): runs in the
@@ -217,14 +426,15 @@ class ParamServer:
     def _apply_push(self, key, grad: onp.ndarray):
         """Apply one gradient immediately (kvstore_dist_server.h:337
         DataHandleDefault async mode: no aggregation buffer, no wait
-        for other workers)."""
+        for other workers).  Caller holds the key's lock."""
         self._push_counts[key] = self._push_counts.get(key, 0) + 1
         if key not in self._store:
             # push before init: adopt the gradient as the value
             # (reference server inits from the first blob it sees)
             self._store[key] = grad.copy()
             return
-        if self._optimizer is None:
+        optimizer = self._key_optimizer(key)
+        if optimizer is None:
             # no optimizer: plain accumulation semantics
             self._store[key] = self._store[key] + grad
             return
@@ -236,15 +446,15 @@ class ParamServer:
             # multi-precision layout: same state shape as the sparse
             # handler, so mixed dense/sparse pushes on one key agree
             self._states[key] = \
-                self._optimizer.create_state_multi_precision(key, weight)
-        self._optimizer.update_multi_precision(key, weight, g,
-                                               self._states[key])
+                optimizer.create_state_multi_precision(key, weight)
+        optimizer.update_multi_precision(key, weight, g,
+                                         self._states[key])
         self._store[key] = onp.asarray(weight.asnumpy())
 
     def _apply_push_sparse(self, key, indices, values, shape):
         """Apply a row_sparse gradient: optimizer sparse dispatch (lazy
         row updates) when an optimizer is set; accumulation of the live
-        rows otherwise."""
+        rows otherwise.  Caller holds the key's lock."""
         from ..ndarray import NDArray
         from ..ndarray.sparse import RowSparseNDArray
 
@@ -266,7 +476,8 @@ class ParamServer:
         if key not in self._store:
             self._store[key] = onp.asarray(rsp.todense().asnumpy())
             return
-        if self._optimizer is None:
+        optimizer = self._key_optimizer(key)
+        if optimizer is None:
             dense = self._store[key].copy()
             onp.add.at(dense, indices, onp.asarray(values))
             self._store[key] = dense
@@ -275,11 +486,11 @@ class ParamServer:
         if key not in self._states:
             # multi-precision layout to match the entry point below
             self._states[key] = \
-                self._optimizer.create_state_multi_precision(key, weight)
+                optimizer.create_state_multi_precision(key, weight)
         # update_multi_precision: the sparse-safe entry point (routes
         # overridden update() optimizers to _update_rsp / densify)
-        self._optimizer.update_multi_precision(key, weight, rsp,
-                                               self._states[key])
+        optimizer.update_multi_precision(key, weight, rsp,
+                                         self._states[key])
         self._store[key] = onp.asarray(weight.asnumpy())
 
     def stop(self):
@@ -294,6 +505,7 @@ class PSClient:
                  retries: int = 50):
         self._address = address
         self._timeout = timeout
+        self._hmac = _hmac_key()     # captured once at construction
         self._rank: Optional[int] = None
         self._sock = self._connect(retries)
         self._lock = threading.Lock()
@@ -315,8 +527,8 @@ class PSClient:
     def _call(self, *msg):
         with self._lock:
             try:
-                _send_msg(self._sock, msg)
-                reply = _recv_msg(self._sock)
+                _send_msg(self._sock, msg, self._hmac)
+                reply = _recv_msg(self._sock, self._hmac)
             except socket.timeout:
                 # healthy-but-slow server: the request may still be in
                 # flight — retrying would risk a silent DUPLICATE apply
@@ -336,10 +548,11 @@ class PSClient:
                 try:
                     self._sock = self._connect(retries=25)
                     if self._rank is not None and msg[0] != "hello":
-                        _send_msg(self._sock, ("hello", self._rank))
-                        _recv_msg(self._sock)   # re-register liveness
-                    _send_msg(self._sock, msg)
-                    reply = _recv_msg(self._sock)
+                        _send_msg(self._sock, ("hello", self._rank),
+                                  self._hmac)
+                        _recv_msg(self._sock, self._hmac)  # re-register
+                    _send_msg(self._sock, msg, self._hmac)
+                    reply = _recv_msg(self._sock, self._hmac)
                 except (ConnectionError, OSError) as e:
                     # keep the class's error contract (shutdown() and
                     # callers suppress/handle MXNetError)
@@ -351,35 +564,41 @@ class PSClient:
         return reply[1] if len(reply) > 1 else None
 
     def init(self, key, val: onp.ndarray):
-        self._call("init", key, onp.asarray(val))
+        self._call("init", str(key), onp.asarray(val))
+
+    def set(self, key, val: onp.ndarray):
+        """Overwrite a key's value (broadcast/checkpoint-load path —
+        unlike init, NOT first-write-wins)."""
+        self._call("set", str(key), onp.asarray(val))
 
     def push(self, key, grad: onp.ndarray):
-        self._call("push", key, onp.asarray(grad))
+        self._call("push", str(key), onp.asarray(grad))
 
     def push_sparse(self, key, indices: onp.ndarray, values: onp.ndarray,
                     shape) -> None:
-        self._call("push_sparse", key, onp.asarray(indices),
+        self._call("push_sparse", str(key), onp.asarray(indices),
                    onp.asarray(values), tuple(shape))
 
     def pull(self, key) -> onp.ndarray:
-        return self._call("pull", key)
+        return self._call("pull", str(key))
 
     def pull_rows(self, key, rows: onp.ndarray) -> onp.ndarray:
-        return self._call("pull_rows", key, onp.asarray(rows, onp.int64))
+        return self._call("pull_rows", str(key),
+                          onp.asarray(rows, onp.int64))
 
     def set_optimizer(self, optimizer):
         self._call("set_optimizer",
                    pickle.dumps(optimizer, pickle.HIGHEST_PROTOCOL))
 
     def push_count(self, key) -> int:
-        return self._call("push_count", key)
+        return self._call("push_count", str(key))
 
     def command(self, head: str, body: str = "") -> None:
         self._call("command", str(head), body)
 
     def alive_ranks(self) -> list:
         """Sorted distinct worker ranks currently connected."""
-        return self._call("num_alive")
+        return list(self._call("num_alive"))
 
     def num_alive(self) -> int:
         """Number of distinct worker ranks currently connected."""

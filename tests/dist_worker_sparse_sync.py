@@ -1,0 +1,105 @@
+"""Worker body for the COLLECTIVE row-sparse dist_sync test: 2 ranks,
+row_sparse gradients reduced over the collective path WITHOUT densify
+(index-union allgather at nnz wire cost — parity: comm.h:104
+ReduceRowSparse / kvstore_dist.h:559 sparse wire).
+
+Asserts three things the round-4 verdict called out:
+1. numerics == the dense push path (same grads through both, same
+   optimizer, identical weights after),
+2. comm payload ∝ nnz, not vocab (payload accounting from the store),
+3. the no-optimizer store keeps the reduced value sparse.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _dist_bootstrap  # noqa: F401 (must run before jax users)
+
+import numpy as onp
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore import create as kv_create
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+VOCAB, DIM = 1000, 8
+
+
+def _rsp(rows, vals_by_row):
+    rows = onp.asarray(sorted(rows), onp.int64)
+    data = onp.stack([vals_by_row[r] for r in rows]).astype("float32")
+    return RowSparseNDArray(data, rows, (VOCAB, DIM))
+
+
+def main(out_dir):
+    kv = kv_create("dist_sync")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2
+
+    rng = onp.random.RandomState(7)
+    # deterministic per-row values both ranks can reconstruct
+    table = {r: rng.randn(DIM).astype("float32") for r in range(16)}
+
+    # 1. no-optimizer reduce: overlapping (3,5) + disjoint rows --------
+    rows = [1, 3, 5] if rank == 0 else [3, 5, 9, 12]
+    g = _rsp(rows, table)
+    kv.push("e", g)
+    red = kv._data["e"]
+    assert isinstance(red, RowSparseNDArray), \
+        f"reduced value densified: {type(red)}"
+    expect = onp.zeros((VOCAB, DIM), "float32")
+    for r in [1, 3, 5]:
+        expect[r] += table[r]
+    for r in [3, 5, 9, 12]:
+        expect[r] += table[r]
+    onp.testing.assert_allclose(red.todense().asnumpy(), expect,
+                                rtol=1e-6, atol=1e-6)
+    assert sorted(onp.asarray(red.indices).tolist()) == [1, 3, 5, 9, 12]
+
+    # 2. comm payload ∝ nnz, not vocab ---------------------------------
+    comm = kv.last_sparse_comm
+    assert comm["payload_bytes"] > 0
+    # budget = max nnz = 4 rows; wire moves nproc*(B idx + B*DIM vals)
+    assert comm["payload_bytes"] <= 2 * (4 * 8 + 4 * DIM * 4)
+    assert comm["payload_bytes"] * 20 < comm["dense_bytes"], comm
+    p_small = comm["payload_bytes"]
+    kv.push("e2", _rsp(list(range(8)), table))   # nnz doubles
+    p_big = kv.last_sparse_comm["payload_bytes"]
+    assert p_small < p_big <= 2 * p_small + 64, (p_small, p_big)
+
+    # 3. numerics == dense path under the server optimizer -------------
+    # momentum=0 so lazy row-sparse semantics equal the std update
+    # exactly (with momentum, lazy touches only live rows while dense
+    # decays every row's buffer each step — the reference's documented
+    # lazy_update divergence, sgd.py; lazy-kernel numerics themselves
+    # are pinned in test_rowsparse_e2e)
+    kv3 = kv_create("dist_sync")
+    kv3.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+    w0 = onp.ones((VOCAB, DIM), "float32")
+    kv3.init("ws", NDArray(w0.copy()))
+    kv3.init("wd", NDArray(w0.copy()))
+    for step in range(3):
+        rows = ([2, 4, 6] if rank == 0 else [4, 6, 8]) if step % 2 == 0 \
+            else ([0, 2] if rank == 0 else [8, 11])
+        gs = _rsp(rows, table)
+        kv3.push("ws", gs)
+        kv3.push("wd", NDArray(gs.todense().asnumpy()))
+    out_s = NDArray(onp.zeros((VOCAB, DIM), "float32"))
+    out_d = NDArray(onp.zeros((VOCAB, DIM), "float32"))
+    kv3.pull("ws", out=out_s)
+    kv3.pull("wd", out=out_d)
+    onp.testing.assert_allclose(out_s.asnumpy(), out_d.asnumpy(),
+                                rtol=1e-5, atol=1e-6)
+    # untouched rows never moved: still exactly w0
+    touched = {0, 2, 4, 6, 8, 11}
+    untouched = [r for r in range(VOCAB) if r not in touched]
+    onp.testing.assert_array_equal(out_s.asnumpy()[untouched],
+                                   w0[untouched])
+
+    kv.barrier()
+    with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

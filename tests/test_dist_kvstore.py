@@ -74,3 +74,12 @@ def test_dist_sparse_embedding_training(tmp_path):
         "MXNET_ASYNC_UNCOORDINATED": "1",
         "MXNET_PS_ADDR": f"127.0.0.1:{_free_port()}",
     })
+
+
+@pytest.mark.timeout(600)
+def test_dist_sync_row_sparse_collective(tmp_path):
+    """Row-sparse gradients over the COLLECTIVE dist_sync path without
+    densify (index-union allgather at nnz wire cost): numerics == dense
+    path, payload ∝ nnz (parity: comm.h:104, kvstore_dist.h:559;
+    VERDICT r4 item 3)."""
+    _run_launcher(2, "dist_worker_sparse_sync.py", tmp_path)

@@ -1,0 +1,192 @@
+"""PS transport v2: fixed binary wire format, HMAC signing, per-key
+concurrency, set-overwrite semantics (VERDICT r4 item 4 + ADVICE
+medium).  Parity anchor: ps-lite's fixed-schema ZeroMQ van
+(src/kvstore/kvstore_dist.h:431-455) — tensors as raw bytes, never
+pickled."""
+import os
+import pickle
+import threading
+
+import numpy as onp
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.kvstore.ps_server import (ParamServer, PSClient,
+                                         _decode_msg, _encode_msg)
+
+
+@pytest.fixture
+def server():
+    s = ParamServer("127.0.0.1", 0)
+    yield s
+    s.stop()
+
+
+def _client(server):
+    c = PSClient(server.address)
+    c.hello(0)
+    return c
+
+
+# -- wire codec -------------------------------------------------------------
+
+def test_codec_roundtrip_all_types():
+    msgs = [
+        ("push", "w", onp.arange(12, dtype=onp.float32).reshape(3, 4)),
+        ("push_sparse", "e", onp.array([1, 5], onp.int64),
+         onp.ones((2, 3), onp.float16), (10, 3)),
+        ("ok", None),
+        ("ok", (0, 1, 2)),
+        ("ok", ()),
+        ("push_count", "k"),
+        ("ok", 42),
+        ("set_optimizer", b"\x00\x01opaque\xff"),
+        ("ok", onp.array(3.5, onp.float32)),            # 0-dim
+        ("ok", onp.zeros((0, 4), onp.int32)),           # 0-size
+    ]
+    for m in msgs:
+        got = _decode_msg(_encode_msg(m))
+        assert len(got) == len(m)
+        for a, b in zip(got, m):
+            if isinstance(b, onp.ndarray):
+                assert a.dtype == b.dtype and a.shape == b.shape
+                onp.testing.assert_array_equal(a, b)
+            elif isinstance(b, (tuple, list)):
+                assert tuple(a) == tuple(b)
+            else:
+                assert a == b
+
+
+def test_codec_bfloat16():
+    import ml_dtypes
+    arr = onp.asarray([1.5, -2.0, 3.25], ml_dtypes.bfloat16)
+    (got,) = _decode_msg(_encode_msg((arr,)))
+    assert got.dtype == arr.dtype
+    onp.testing.assert_array_equal(got.view(onp.uint16),
+                                   arr.view(onp.uint16))
+
+
+def test_codec_rejects_bad_magic_and_trailing():
+    with pytest.raises(MXNetError, match="magic"):
+        _decode_msg(b"XXXX\x00")
+    good = _encode_msg(("ok",))
+    with pytest.raises(MXNetError, match="trailing"):
+        _decode_msg(good + b"\x00")
+
+
+def test_wire_carries_no_pickle_for_tensors():
+    """The data plane must not be a pickle channel: an encoded push
+    frame contains the tensor as raw bytes (dtype+shape header), and
+    decoding never calls pickle.loads."""
+    arr = onp.arange(6, dtype=onp.float32)
+    payload = _encode_msg(("push", "w", arr))
+    assert arr.tobytes() in payload
+    called = []
+    orig = pickle.loads
+    try:
+        pickle.loads = lambda *a, **k: called.append(1) or orig(*a, **k)
+        _decode_msg(payload)
+    finally:
+        pickle.loads = orig
+    assert not called, "decode path invoked pickle.loads"
+
+
+def test_codec_rejects_arbitrary_objects():
+    class Evil:
+        pass
+    with pytest.raises(MXNetError, match="unsupported argument"):
+        _encode_msg(("push", "w", Evil()))
+
+
+# -- server behavior --------------------------------------------------------
+
+def test_push_pull_and_set_overwrite(server):
+    c = _client(server)
+    c.init("w", onp.ones((4,), onp.float32))
+    c.init("w", onp.full((4,), 9.0, onp.float32))     # first init wins
+    onp.testing.assert_array_equal(c.pull("w"), 1.0)
+    # set() overwrites — the broadcast/checkpoint-load path (ADVICE:
+    # init's setdefault must not leave the server stale)
+    c.set("w", onp.full((4,), 5.0, onp.float32))
+    onp.testing.assert_array_equal(c.pull("w"), 5.0)
+    c.push("w", onp.ones((4,), onp.float32))          # accumulate mode
+    onp.testing.assert_array_equal(c.pull("w"), 6.0)
+
+
+def test_push_count_read_is_locked(server):
+    c = _client(server)
+    c.init("k", onp.zeros((2,), onp.float32))
+    for _ in range(3):
+        c.push("k", onp.ones((2,), onp.float32))
+    assert c.push_count("k") == 3
+    assert c.push_count("nope") == 0
+
+
+def test_concurrent_pushes_different_keys(server):
+    """Per-key locks: concurrent clients hammering disjoint keys all
+    apply exactly; per-key counts and values are exact."""
+    n_keys, n_pushes = 4, 25
+
+    def worker(ki):
+        c = PSClient(server.address)
+        c.hello(10 + ki)
+        key = f"k{ki}"
+        c.init(key, onp.zeros((8,), onp.float32))
+        for _ in range(n_pushes):
+            c.push(key, onp.ones((8,), onp.float32))
+        c.close()
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_keys)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    probe = _client(server)
+    for i in range(n_keys):
+        onp.testing.assert_array_equal(probe.pull(f"k{i}"),
+                                       float(n_pushes))
+        assert probe.push_count(f"k{i}") == n_pushes
+
+
+def test_server_side_optimizer_per_key_counts(server):
+    """Each key's optimizer instance keeps its own step counts (adam
+    bias correction stays per-key correct under concurrency)."""
+    import mxnet_tpu as mx
+    c = _client(server)
+    c.set_optimizer(mx.optimizer.Adam(learning_rate=0.01))
+    c.init("a", onp.ones((3,), onp.float32))
+    c.init("b", onp.ones((3,), onp.float32))
+    for _ in range(5):
+        c.push("a", onp.full((3,), 0.5, onp.float32))
+    c.push("b", onp.full((3,), 0.5, onp.float32))
+    # same grad stream => first step of 'b' equals what 'a' saw at its
+    # first step; counts are independent (not 6 global updates)
+    a_opt = server._optimizers["a"]
+    b_opt = server._optimizers["b"]
+    assert a_opt is not b_opt
+    assert a_opt._index_update_count["a"] == 5
+    assert b_opt._index_update_count["b"] == 1
+
+
+def test_hmac_rejects_unauthenticated_peer():
+    os.environ["MXNET_PS_HMAC_KEY"] = "secret1"
+    try:
+        s = ParamServer("127.0.0.1", 0)
+        c = PSClient(s.address)
+        c.hello(0)
+        c.init("w", onp.ones((2,), onp.float32))
+        onp.testing.assert_array_equal(c.pull("w"), 1.0)
+        # a client with the wrong key is dropped before parsing
+        os.environ["MXNET_PS_HMAC_KEY"] = "wrongkey"
+        bad = PSClient(s.address)
+        with pytest.raises(MXNetError):
+            bad.pull("w")
+        bad.close()
+        # the good client still works
+        os.environ["MXNET_PS_HMAC_KEY"] = "secret1"
+        onp.testing.assert_array_equal(c.pull("w"), 1.0)
+        c.close()
+        s.stop()
+    finally:
+        del os.environ["MXNET_PS_HMAC_KEY"]

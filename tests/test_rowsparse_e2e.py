@@ -341,3 +341,25 @@ class TestSparsePushPaths:
         dense = out.asnumpy()
         onp.testing.assert_allclose(dense[3], 2.0)
         onp.testing.assert_allclose(dense[0], 0.0)
+
+
+def test_hybridize_rejects_sparse_grad_at_config_time():
+    """ADVICE r4: a hybridized block with Embedding(sparse_grad=True)
+    would deliver a dense cotangent into the row_sparse grad buffer
+    MID-BACKWARD; the failure must happen at hybridize() instead."""
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Embedding(8, 4, sparse_grad=True), nn.Dense(2))
+    net.initialize()
+    with pytest.raises(MXNetError, match="row_sparse"):
+        net.hybridize()
+    # deactivation is always allowed
+    net.hybridize(active=False)
+    # and a dense-grad embedding hybridizes fine
+    ok = nn.HybridSequential()
+    ok.add(nn.Embedding(8, 4, sparse_grad=False), nn.Dense(2))
+    ok.initialize()
+    ok.hybridize()

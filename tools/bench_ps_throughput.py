@@ -1,0 +1,105 @@
+#!/usr/bin/env python
+"""Concurrent-push throughput microbench for the PS transport
+(VERDICT r4 item 4: pushes/sec vs #clients x #keys, plus large-tensor
+bandwidth).  Writes docs/PS_THROUGHPUT.json next to
+PIPELINE_SCALING.json.
+
+Run: python tools/bench_ps_throughput.py [--seconds 2.0]
+Each client is a thread with its OWN PSClient connection (the server
+spawns one handler thread per connection, so per-key locks are actually
+contended the way a multi-worker job would).
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as onp
+
+from mxnet_tpu.kvstore.ps_server import ParamServer, PSClient
+
+
+def _run_config(server, n_clients, n_keys, shape, seconds, tag):
+    """Each client pushes round-robin over the key set for `seconds`;
+    returns (pushes/sec, MB/sec)."""
+    for k in range(n_keys):
+        c = PSClient(server.address)
+        c.hello(99)
+        c.init(f"{tag}/k{k}", onp.zeros(shape, onp.float32))
+        c.close()
+    counts = [0] * n_clients
+    stop = threading.Event()
+    grad_bytes = int(onp.prod(shape)) * 4
+
+    def client_body(ci):
+        c = PSClient(server.address)
+        c.hello(ci)
+        g = onp.ones(shape, onp.float32)
+        n = 0
+        while not stop.is_set():
+            c.push(f"{tag}/k{n % n_keys}", g)
+            n += 1
+        counts[ci] = n
+        c.close()
+
+    threads = [threading.Thread(target=client_body, args=(i,))
+               for i in range(n_clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    total = sum(counts)
+    return total / dt, total * grad_bytes / dt / 1e6
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "PS_THROUGHPUT.json"))
+    args = ap.parse_args()
+
+    server = ParamServer("127.0.0.1", 0)
+    results = []
+    configs = [
+        # (clients, keys, shape, label)
+        (1, 1, (256,), "1c1k-small"),
+        (4, 1, (256,), "4c1k-small (one key contended)"),
+        (4, 4, (256,), "4c4k-small (per-key locks in parallel)"),
+        (1, 1, (1024, 1024), "1c1k-4MB (bandwidth)"),
+        (4, 4, (1024, 1024), "4c4k-4MB (concurrent bandwidth)"),
+    ]
+    for n_clients, n_keys, shape, label in configs:
+        pps, mbs = _run_config(server, n_clients, n_keys, shape,
+                               args.seconds, label.split()[0])
+        results.append({
+            "label": label, "clients": n_clients, "keys": n_keys,
+            "tensor_shape": list(shape),
+            "pushes_per_sec": round(pps, 1),
+            "mb_per_sec": round(mbs, 2),
+        })
+        print(f"{label}: {pps:.0f} pushes/s, {mbs:.1f} MB/s")
+    server.stop()
+
+    host = {"note": ("threaded TCP PS, binary wire v2 (no pickled "
+                     "tensors), per-key locks; localhost loopback on "
+                     "this container's CPU — DCN numbers will differ"),
+            "cpu_count": os.cpu_count()}
+    with open(args.out, "w") as f:
+        json.dump({"host": host, "seconds_per_config": args.seconds,
+                   "results": results}, f, indent=1)
+    print("wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()
