@@ -37,10 +37,20 @@ class Horovod(KVStoreBase):
     type = "horovod"
 
     def __init__(self):
-        self._hvd = _import_or_raise(
-            "horovod.mxnet", "horovod",
-            "On TPU use kv.create('dist_sync') instead — it rides XLA "
-            "collectives over ICI/DCN.")
+        import os
+        if os.environ.get("MXNET_HOROVOD_BACKEND") == "jax":
+            # real-wire fallback: the horovod API surface implemented
+            # over jax.distributed collectives (_hvd_jax) — actual
+            # sockets between OS processes, no horovod install needed
+            from . import _hvd_jax as hvd
+            self._hvd = hvd
+        else:
+            self._hvd = _import_or_raise(
+                "horovod.mxnet", "horovod",
+                "On TPU use kv.create('dist_sync') instead — it rides "
+                "XLA collectives over ICI/DCN; or set "
+                "MXNET_HOROVOD_BACKEND=jax for the jax.distributed-"
+                "backed transport with horovod semantics.")
         self._hvd.init()
 
     @staticmethod

@@ -550,11 +550,22 @@ class DistKVStore(KVStoreBase):
         kv = [(k, v.todense() if isinstance(v, BaseSparseNDArray) else v)
               for i, (k, v) in enumerate(kv) if i not in taken]
         if sparse_kv:
+            from ..ndarray.sparse import _log_storage_fallback
             reduced = self._sparse_allreduce_batch(
                 [v for _, v in sparse_kv])
             for (k, _), r in zip(sparse_kv, reduced):
                 if self._optimizer is not None and k in self._data:
-                    self._sparse_update(k, r)
+                    if k in self._opt_states:
+                        # the key's state is already ZeRO-sliced from
+                        # dense pushes: a second, full-size sparse
+                        # state would fork the trajectory — densify
+                        # this gradient into the SAME sharded state
+                        _log_storage_fallback(
+                            f"sparse push on dense-stated key {k!r} "
+                            "joins the ZeRO-sliced update")
+                        self._sharded_update_batch([(k, r.todense())])
+                    else:
+                        self._sparse_update(k, r)
                 elif self._updater is not None and k in self._data:
                     self._updater(_key_int(k), r, self._data[k])
                 elif self._optimizer is not None or \
@@ -583,6 +594,17 @@ class DistKVStore(KVStoreBase):
         if self._optimizer is not None:
             batch = [(k, r) for k, r in reduced_kv if k in self._data]
             rest = [(k, r) for k, r in reduced_kv if k not in self._data]
+            # keys whose state is already full-size from sparse pushes
+            # keep that ONE state for dense gradients too (mixed
+            # dense/sparse pushes must share a trajectory, like the PS
+            # server's unified state layout)
+            sparse_stated = getattr(self, "_sparse_opt_states", {})
+            full = [(k, r) for k, r in batch if k in sparse_stated]
+            batch = [(k, r) for k, r in batch if k not in sparse_stated]
+            for k, r in full:
+                idx = self._key_index.setdefault(k, len(self._key_index))
+                self._optimizer.update_multi_precision(
+                    idx, self._data[k], r, sparse_stated[k])
             self._sharded_update_batch(batch)
             for k, r in rest:
                 self._data[k] = r

@@ -26,9 +26,11 @@ with a FIXED BINARY wire format (transport v2):
   for ps-lite's plaintext van;
 * the server holds PER-KEY locks (not one global lock), so concurrent
   pushes to different keys apply in parallel; each key gets its own
-  optimizer instance (hydrated from the latest ``set_optimizer`` blob),
-  so no cross-key shared counters race.  Per-key step counts match the
-  per-index semantics the optimizers already use.
+  optimizer instance (hydrated from the latest ``set_optimizer`` blob)
+  so no instance-internal state races across handler threads, while
+  per-index step counts live in ONE shared dict and ``num_update`` is
+  synced through a global max — the reference's single-server-optimizer
+  step semantics (lr_schedulers see total server progress).
 
 The server runs as a thread inside rank 0's process (the reference
 supports colocated servers the same way via its launcher); clients are
@@ -84,9 +86,12 @@ class ParamMults:
 #           | ARR(0x04)   dlen:u8 dtype-ascii ndim:u8 dims:i64* raw-bytes
 #           | BLOB(0x05)  len:u32 raw       (opaque; see module doc)
 #
-# A frame on the socket is ``<Q`` payload length, payload, then — iff
-# MXNET_PS_HMAC_KEY is set — a 32-byte HMAC-SHA256 trailer (the length
-# prefix does NOT cover the trailer).
+# A frame on the socket is ``<Q`` total length, then a flags byte
+# (bit 0: HMAC trailer present), the payload, and — iff flagged — a
+# 32-byte HMAC-SHA256 trailer over the payload.  The length prefix
+# covers flags+payload+trailer, so a key-presence mismatch between
+# peers is REJECTED (MXNetError, peer dropped), never a stall waiting
+# on bytes that are not coming.
 
 _MAGIC = b"PS2\x00"
 _T_NONE, _T_STR, _T_INT, _T_INTS, _T_ARR, _T_BLOB = range(6)
@@ -200,9 +205,11 @@ def _hmac_key() -> Optional[bytes]:
 
 def _send_msg(sock: socket.socket, args, key: Optional[bytes]) -> None:
     payload = _encode_msg(args)
+    flags = 1 if key else 0
     trailer = hmac_mod.new(key, payload, hashlib.sha256).digest() \
         if key else b""
-    sock.sendall(struct.pack("<Q", len(payload)) + payload + trailer)
+    body = struct.pack("<B", flags) + payload + trailer
+    sock.sendall(struct.pack("<Q", len(body)) + body)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -217,13 +224,34 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def _recv_msg(sock: socket.socket, key: Optional[bytes]):
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    payload = _recv_exact(sock, n)
-    if key:
-        digest = _recv_exact(sock, 32)
+    if n < 1:
+        raise MXNetError("ps wire: empty frame")
+    body = _recv_exact(sock, n)
+    flags = body[0]
+    signed = bool(flags & 1)
+    if signed != bool(key):
+        raise MXNetError(
+            "ps wire: HMAC configuration mismatch (one peer has "
+            "MXNET_PS_HMAC_KEY set, the other does not)")
+    if signed:
+        if n < 33:
+            raise MXNetError("ps wire: truncated HMAC frame")
+        payload, digest = body[1:-32], body[-32:]
         want = hmac_mod.new(key, payload, hashlib.sha256).digest()
         if not hmac_mod.compare_digest(digest, want):
             raise MXNetError("ps wire: HMAC verification failed")
-    return _decode_msg(payload)
+    else:
+        payload = body[1:]
+    try:
+        return _decode_msg(payload)
+    except MXNetError:
+        raise
+    except (struct.error, ValueError, UnicodeDecodeError,
+            IndexError) as e:
+        # malformed frame: surface as the class's error type so the
+        # server drops the peer (and clients keep their MXNetError
+        # contract) instead of an unhandled handler-thread death
+        raise MXNetError(f"ps wire: malformed frame ({e})") from e
 
 
 class ParamServer:
@@ -250,6 +278,13 @@ class ParamServer:
         self._push_counts: Dict[Any, int] = {}
         self._opt_blob: Optional[bytes] = None
         self._optimizers: Dict[Any, Any] = {}
+        # reference-parity step accounting across per-key instances:
+        # ONE _index_update_count dict shared by every instance (the
+        # reference's single server optimizer keeps per-index counts in
+        # one place), and num_update = max across keys, synced through
+        # _global_num_update so lr_schedulers see GLOBAL steps
+        self._shared_counts: Dict[Any, int] = {}
+        self._global_num_update = 0
         # liveness: per-rank connection refcounts (parity: ps-lite
         # heartbeats behind kvstore.h:408 get_num_dead_node).  Process
         # death closes the socket and drops the rank; kernel TCP
@@ -285,6 +320,8 @@ class ParamServer:
             opt = pickle.loads(blob)
             with self._meta_lock:
                 if self._opt_blob is blob:
+                    opt._index_update_count = self._shared_counts
+                    opt.num_update = self._global_num_update
                     return self._optimizers.setdefault(key, opt)
 
     # -- server side -------------------------------------------------------
@@ -385,20 +422,22 @@ class ParamServer:
                     return ("ok", self._store[key][onp.asarray(rows)])
             if op == "set_optimizer":
                 _, payload = msg
-                with self._meta_lock:
-                    self._opt_blob = bytes(payload)
-                    stale = dict(self._optimizers)
-                    self._optimizers = {}
+                blob = bytes(payload)
                 # hyperparameter refresh must not reset step counts:
-                # adam bias correction / lr_scheduler continue from the
-                # per-key counts (re-hydrate each key's instance and
-                # graft the old counters over)
-                for k, old in stale.items():
-                    new = pickle.loads(self._opt_blob)
-                    new._index_update_count = old._index_update_count
-                    new.num_update = old.num_update
-                    with self._meta_lock:
-                        self._optimizers[k] = new
+                # every instance shares _shared_counts (graft is just a
+                # reference), and num_update continues from the global
+                # max.  The whole swap happens atomically under the
+                # meta lock so a concurrent push can never hydrate a
+                # zero-count instance from a half-swapped state.
+                with self._meta_lock:
+                    self._opt_blob = blob
+                    fresh = {}
+                    for k in self._optimizers:
+                        new = pickle.loads(blob)
+                        new._index_update_count = self._shared_counts
+                        new.num_update = self._global_num_update
+                        fresh[k] = new
+                    self._optimizers = fresh
                 return ("ok",)
             if op == "push_count":
                 _, key = msg
@@ -422,6 +461,18 @@ class ParamServer:
             return ("err", f"unknown op {op!r}")
         except Exception as e:  # surface server faults to the client
             return ("err", f"{type(e).__name__}: {e}")
+
+    def _sync_steps_pre(self, opt):
+        """Before an update: the instance sees the GLOBAL step, so an
+        lr_scheduler keyed on num_update follows total server progress
+        (reference: one optimizer, num_update = max over all keys)."""
+        with self._meta_lock:
+            opt.num_update = max(opt.num_update, self._global_num_update)
+
+    def _sync_steps_post(self, opt):
+        with self._meta_lock:
+            self._global_num_update = max(self._global_num_update,
+                                          opt.num_update)
 
     def _apply_push(self, key, grad: onp.ndarray):
         """Apply one gradient immediately (kvstore_dist_server.h:337
@@ -447,8 +498,10 @@ class ParamServer:
             # handler, so mixed dense/sparse pushes on one key agree
             self._states[key] = \
                 optimizer.create_state_multi_precision(key, weight)
+        self._sync_steps_pre(optimizer)
         optimizer.update_multi_precision(key, weight, g,
                                          self._states[key])
+        self._sync_steps_post(optimizer)
         self._store[key] = onp.asarray(weight.asnumpy())
 
     def _apply_push_sparse(self, key, indices, values, shape):
@@ -489,8 +542,10 @@ class ParamServer:
                 optimizer.create_state_multi_precision(key, weight)
         # update_multi_precision: the sparse-safe entry point (routes
         # overridden update() optimizers to _update_rsp / densify)
+        self._sync_steps_pre(optimizer)
         optimizer.update_multi_precision(key, weight, rsp,
                                          self._states[key])
+        self._sync_steps_post(optimizer)
         self._store[key] = onp.asarray(weight.asnumpy())
 
     def stop(self):

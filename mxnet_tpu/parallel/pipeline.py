@@ -304,7 +304,8 @@ def one_f_one_b_ticks(n_stages: int, n_microbatches: int) -> int:
 
 
 def one_f_one_b_apply(stage_fn: Callable, loss_fn: Callable, n_stages: int,
-                      n_microbatches: int, axis_name: str = "pp"):
+                      n_microbatches: int, axis_name: str = "pp",
+                      return_input_grad: bool = False):
     """Per-device 1F1B training-step body; call inside shard_map.
 
     ``stage_fn(stage_params, x) -> y`` is one stage (uniform shapes);
@@ -312,7 +313,11 @@ def one_f_one_b_apply(stage_fn: Callable, loss_fn: Callable, n_stages: int,
     output per microbatch.  Returns ``apply(stage_params, x_mb, t_mb)``
     -> ``(mean_loss, grads)`` where ``x_mb``/``t_mb`` are (M, mb, ...)
     microbatches and ``grads`` matches ``stage_params`` (this device's
-    stage only; loss is replicated over the axis).
+    stage only; loss is replicated over the axis).  With
+    ``return_input_grad`` the result is ``(loss, grads, dx_mb)`` where
+    ``dx_mb`` is d(loss)/d(x_mb) — stage 0 collects its backward-slot
+    input cotangents per microbatch (for chaining e.g. an embedding
+    lookup in front of the pipeline).
     """
     S, M = n_stages, n_microbatches
     W = min(2 * S - 1, M)          # stash ring-buffer slots (O(S), not O(M))
@@ -329,9 +334,11 @@ def one_f_one_b_apply(stage_fn: Callable, loss_fn: Callable, n_stages: int,
         carry_b = jnp.zeros(y_shape.shape, y_shape.dtype)
         grads0 = jax.tree.map(jnp.zeros_like, stage_params)
         loss0 = jnp.zeros((), jnp.float32)
+        dx0 = jnp.zeros_like(x_mb) if return_input_grad else \
+            jnp.zeros((), x_mb.dtype)
 
         def tick(carry, t):
-            carry_f, carry_b, stash, grads, loss_acc = carry
+            carry_f, carry_b, stash, grads, loss_acc, dx_acc = carry
             # ---- F slot: microbatch mf = t - idx flows GPipe-style
             mf = t - idx
             valid_f = (mf >= 0) & (mf < M)
@@ -364,14 +371,29 @@ def one_f_one_b_apply(stage_fn: Callable, loss_fn: Callable, n_stages: int,
             loss_acc = loss_acc + jnp.where(
                 valid_b & (idx == S - 1), loss_m / M, 0.0).astype(
                     jnp.float32)
+            if return_input_grad:
+                # stage 0's backward-slot dx IS d(loss)/d(x_mb[mb])
+                slot = mb_c
+                old_dx = lax.dynamic_index_in_dim(dx_acc, slot, 0,
+                                                  keepdims=False)
+                dx_acc = lax.dynamic_update_index_in_dim(
+                    dx_acc,
+                    jnp.where(valid_b & (idx == 0),
+                              dx.astype(dx_acc.dtype), old_dx),
+                    slot, 0)
             new_carry_b = lax.ppermute(dx, axis_name, bwd_perm)
-            return (new_carry_f, new_carry_b, stash, grads, loss_acc), None
+            return (new_carry_f, new_carry_b, stash, grads, loss_acc,
+                    dx_acc), None
 
-        (_, _, _, grads, loss_acc), _ = lax.scan(
-            tick, (carry_f, carry_b, stash, grads0, loss0),
+        (_, _, _, grads, loss_acc, dx_acc), _ = lax.scan(
+            tick, (carry_f, carry_b, stash, grads0, loss0, dx0),
             jnp.arange(T))
         mask = (idx == S - 1).astype(loss_acc.dtype)
         loss = lax.psum(loss_acc * mask, axis_name)
+        if return_input_grad:
+            # dx lives on stage 0 only; replicate over the pp axis
+            m0 = (idx == 0).astype(dx_acc.dtype)
+            return loss, grads, lax.psum(dx_acc * m0, axis_name)
         return loss, grads
 
     return apply
@@ -380,7 +402,9 @@ def one_f_one_b_apply(stage_fn: Callable, loss_fn: Callable, n_stages: int,
 def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
                                  stacked_params, x, targets, mesh: Mesh,
                                  n_microbatches: int, axis_name: str = "pp",
-                                 batch_axis_name: Optional[str] = "dp"):
+                                 batch_axis_name: Optional[str] = "dp",
+                                 param_specs=None,
+                                 return_input_grad: bool = False):
     """True 1F1B pipeline training step: ``(mean_loss, grads)``.
 
     Unlike :func:`pipeline_forward` (+ ``jax.grad``), backward work is
@@ -392,6 +416,14 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
     (and over ``batch_axis_name`` if present; grads/loss are averaged
     over it).  Returned grads carry the same stacked layout as
     ``stacked_params``.
+
+    ``param_specs``: optional pytree of PartitionSpecs matching
+    ``stacked_params`` for additional intra-stage sharding (e.g. tensor
+    parallelism: P('pp', None, 'tp') on a column-parallel weight — the
+    stage_fn is then responsible for its own 'tp' collectives).
+    Defaults to P(axis_name) on every leaf.  ``return_input_grad``
+    additionally returns d(loss)/dx with x's sharding (for chaining an
+    embedding in front of the pipeline).
     """
     S = mesh.shape[axis_name]
     for leaf in jax.tree.leaves(stacked_params):
@@ -413,22 +445,33 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
             f"{x.shape[0]} (a mismatch would silently broadcast in "
             f"loss_fn)")
     body = one_f_one_b_apply(stage_fn, loss_fn, S, n_microbatches,
-                             axis_name)
+                             axis_name,
+                             return_input_grad=return_input_grad)
 
     def full(params, xb, tb):
         local = jax.tree.map(lambda a: a[0], params)   # drop sharded S
         M = n_microbatches
         xmb = xb.reshape((M, xb.shape[0] // M) + xb.shape[1:])
         tmb = tb.reshape((M, tb.shape[0] // M) + tb.shape[1:])
-        loss, grads = body(local, xmb, tmb)
+        res = body(local, xmb, tmb)
+        loss, grads = res[0], res[1]
         if dp:
             loss = lax.pmean(loss, dp)
             grads = jax.tree.map(lambda g: lax.pmean(g, dp), grads)
-        return loss, jax.tree.map(lambda g: g[None], grads)
+        grads = jax.tree.map(lambda g: g[None], grads)
+        if return_input_grad:
+            dx = res[2].reshape(xb.shape)
+            return loss, grads, dx
+        return loss, grads
 
-    pspec = jax.tree.map(lambda _: PartitionSpec(axis_name), stacked_params)
+    if param_specs is None:
+        pspec = jax.tree.map(lambda _: PartitionSpec(axis_name),
+                             stacked_params)
+    else:
+        pspec = param_specs
     xspec = PartitionSpec(dp)
-    gspec = jax.tree.map(lambda _: PartitionSpec(axis_name), stacked_params)
+    out_specs = (PartitionSpec(), pspec) + \
+        ((xspec,) if return_input_grad else ())
     return shard_map(full, mesh=mesh, in_specs=(pspec, xspec, xspec),
-                     out_specs=(PartitionSpec(), gspec),
+                     out_specs=out_specs,
                      check_vma=False)(stacked_params, x, targets)

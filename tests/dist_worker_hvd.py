@@ -1,0 +1,47 @@
+"""Worker body for the REAL-WIRE Horovod-adapter test: 2 OS processes,
+kv.create('horovod') with MXNET_HOROVOD_BACKEND=jax — the adapter's
+broadcast/pushpull traverse jax.distributed's gloo sockets, retiring
+the 'fake-backed only' caveat (VERDICT r4 item 10; parity:
+python/mxnet/kvstore/horovod.py:27,75-132)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _dist_bootstrap  # noqa: F401 (must run before jax users)
+
+import numpy as onp
+
+from mxnet_tpu.kvstore import create as kv_create
+from mxnet_tpu.ndarray import NDArray
+
+
+def main(out_dir):
+    assert os.environ.get("MXNET_HOROVOD_BACKEND") == "jax"
+    kv = kv_create("horovod")
+    rank, nw = kv.rank, kv.num_workers
+    assert nw == 2, f"expected 2 workers, got {nw}"
+    assert kv.local_rank == 0
+
+    # broadcast: both ranks end with rank 0's value
+    v = NDArray(onp.full((4, 3), float(rank + 10), "float32"))
+    out = NDArray(onp.zeros((4, 3), "float32"))
+    kv.broadcast("p0", v, out)
+    onp.testing.assert_allclose(out.asnumpy(), 10.0)
+
+    # pushpull == ring allreduce without averaging (horovod semantics)
+    g = NDArray(onp.full((5,), float(rank + 1), "float32"))
+    kv.pushpull("g0", g)
+    onp.testing.assert_allclose(g.asnumpy(), 3.0)   # 1 + 2
+
+    # out-form pushpull
+    g2 = NDArray(onp.full((2, 2), 0.5, "float32"))
+    o2 = NDArray(onp.zeros((2, 2), "float32"))
+    kv.pushpull("g1", g2, out=o2)
+    onp.testing.assert_allclose(o2.asnumpy(), 1.0)
+
+    with open(os.path.join(out_dir, f"ok_{rank}"), "w") as f:
+        f.write("ok")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

@@ -102,3 +102,107 @@ _F16_SAMPLE = ["Convolution", "FullyConnected", "BatchNorm", "softmax",
 @pytest.mark.parametrize("name", _F16_SAMPLE)
 def test_float16_forward(name):
     _run(name, SPECS[name], onp.float16, rtol=4e-2, atol=4e-2)
+
+
+# -- bf16 BACKWARD sweep (VERDICT r4 item 5) --------------------------------
+#
+# The headline bench trains in bf16; the forward sweep alone does not
+# exercise the vjp kernels in that regime.  For every fd-spec op, run
+# the analytic backward (autograd tape, same path the fd sweep
+# validates against f32 numerics) with inputs cast to bf16 and compare
+# the gradients to the f32 gradients at half-precision tolerance.
+
+# backward-specific skips, each with the reason (forward SKIP applies
+# too — an op whose forward is f32-only has no bf16 backward to check)
+SKIP_BWD = {
+    "_contrib_ctc_loss": "log-space forward-backward accumulates over "
+                         "the label lattice; bf16 rounding compounds "
+                         "past half-precision tolerance (reference "
+                         "runs CTC in f32 only)",
+    "log_softmax": "grad subtracts two near-equal exp-sums; bf16 "
+                   "cancellation exceeds tolerance on the tails",
+    "_npi_logsumexp": "same cancellation as log_softmax backward",
+    "_npi_std": "sqrt-of-variance chain divides by bf16-rounded std; "
+                "relative error blows up for near-constant inputs",
+    "_npi_diff": "integer-like differencing amplifies bf16 rounding "
+                 "of adjacent near-equal values",
+}
+
+
+def _grads(name, spec, arrays, diff):
+    """Analytic gradients of sum(op(...)) wrt the given diff inputs."""
+    from mxnet_tpu import autograd
+    inputs = [arrays[i] for i in diff]
+    for x in inputs:
+        x.attach_grad()
+    out_sel = spec["out"]
+    with autograd.record(train_mode=spec["train_mode"]):
+        out = invoke(name, arrays, **spec["params"])
+        if isinstance(out, (list, tuple)):
+            if out_sel is None:
+                acc = out[0].sum()
+                for o in out[1:]:
+                    acc = acc + o.sum()
+                out = acc
+            elif callable(out_sel):
+                out = out_sel(out)
+            else:
+                out = out[out_sel]
+        if spec.get("obj") is not None:
+            out = spec["obj"](out, arrays)
+        loss = out.sum()
+    loss.backward()
+    return [x.grad.asnumpy() if x.grad is not None else None
+            for x in inputs]
+
+
+@pytest.mark.parametrize("name", sorted(
+    n for n in SPECS if n not in SKIP and n not in SKIP_BWD))
+def test_bfloat16_backward(name):
+    import ml_dtypes
+    spec = SPECS[name]
+    r = _rng(name)
+    raw = [b(r) if b is not None else None for b in spec["arrays"]]
+    f32 = [NDArray(a) if a is not None else None for a in raw]
+    low = [NDArray(_cast(a, ml_dtypes.bfloat16)) if a is not None
+           else None for a in raw]
+    diff = spec["diff"]
+    if diff is None:
+        # detect float inputs from the RAW f32 arrays (bf16's numpy
+        # dtype kind is 'V', so detection must not look at the casts)
+        diff = [i for i, a in enumerate(raw)
+                if a is not None and a.dtype.kind == "f"]
+    if not diff:
+        pytest.skip(f"{name}: no differentiable inputs configured")
+    g32 = _grads(name, spec, f32, diff)
+    g16 = _grads(name, spec, low, diff)
+    assert len(g32) == len(g16)
+    # the op's gradient magnitude (across ALL inputs) sets the scale
+    # bf16 rounding noise is measured against — a normalizer's data
+    # gradient cancels to zero exactly, but its noise rides the γ/σ
+    # chain shared with the (non-degenerate) gamma gradient
+    gscale = max([float(onp.max(onp.abs(a.astype(onp.float64))))
+                  for a in g32 if a is not None and a.dtype.kind == "f"]
+                 or [0.0])
+    for i, (a, b) in enumerate(zip(g32, g16)):
+        if a is None or a.dtype.kind != "f":
+            continue
+        b64 = b.astype(onp.float64)
+        a64 = a.astype(onp.float64)
+        assert onp.isfinite(b64[onp.isfinite(a64)]).all(), \
+            f"{name}: non-finite bf16 grad (input {i}) where f32 finite"
+        scale = float(onp.max(onp.abs(a64)))
+        if scale < 1e-6:
+            # softmax/normalization family: the sum-objective gradient
+            # is EXACTLY zero by cancellation; in bf16 the jacobian
+            # rows cancel to within one ulp, not to zero — assert the
+            # noise floor instead of relative closeness to 0
+            floor = max(6e-3, 8e-2 * gscale)
+            assert float(onp.max(onp.abs(b64))) < floor, \
+                f"{name}: bf16 grad noise above floor (input {i})"
+            continue
+        # bf16 has an 8-bit mantissa; two passes (fwd+bwd) compound —
+        # compare at a scale-aware tolerance
+        onp.testing.assert_allclose(
+            b64, a64, rtol=8e-2, atol=8e-2 * max(1e-3, scale),
+            err_msg=f"{name}: bf16 grad diverges from f32 (input {i})")

@@ -169,6 +169,38 @@ def test_server_side_optimizer_per_key_counts(server):
     assert b_opt._index_update_count["b"] == 1
 
 
+def test_hmac_presence_mismatch_rejects_not_hangs():
+    """One peer keyed, the other not: the flags byte makes the frame
+    self-describing, so the mismatch is an immediate MXNetError on
+    both sides — never a read stalled on bytes that will not come."""
+    import time
+    os.environ["MXNET_PS_HMAC_KEY"] = "secret-xyz"
+    try:
+        keyed_server = ParamServer("127.0.0.1", 0)
+    finally:
+        del os.environ["MXNET_PS_HMAC_KEY"]
+    keyless = PSClient(keyed_server.address, timeout=30.0)
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError):
+        keyless.pull("w")
+    assert time.monotonic() - t0 < 15, "mismatch should fail fast"
+    keyless.close()
+    keyed_server.stop()
+    # reverse: keyless server, keyed client
+    plain_server = ParamServer("127.0.0.1", 0)
+    os.environ["MXNET_PS_HMAC_KEY"] = "secret-xyz"
+    try:
+        keyed_client = PSClient(plain_server.address, timeout=30.0)
+    finally:
+        del os.environ["MXNET_PS_HMAC_KEY"]
+    t0 = time.monotonic()
+    with pytest.raises(MXNetError):
+        keyed_client.pull("w")
+    assert time.monotonic() - t0 < 15
+    keyed_client.close()
+    plain_server.stop()
+
+
 def test_hmac_rejects_unauthenticated_peer():
     os.environ["MXNET_PS_HMAC_KEY"] = "secret1"
     try:
