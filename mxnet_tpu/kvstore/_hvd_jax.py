@@ -69,5 +69,8 @@ def broadcast(tensor, root_rank=0, name=None, priority=0):
     # other roots (adapter always uses root 0, but keep the API honest)
     if root_rank == 0:
         return NDArray(multihost_utils.broadcast_one_to_all(arr))
-    mask = 1.0 if rank() == root_rank else 0.0
-    return NDArray(_COLL.allreduce(arr * mask))
+    # dtype-safe masked allreduce: where() keeps integer dtypes intact
+    # and never multiplies non-root values (a non-root NaN/inf buffer
+    # must not poison the sum)
+    contrib = jnp.where(rank() == root_rank, arr, jnp.zeros_like(arr))
+    return NDArray(_COLL.allreduce(contrib))

@@ -553,7 +553,8 @@ class DistKVStore(KVStoreBase):
             from ..ndarray.sparse import _log_storage_fallback
             reduced = self._sparse_allreduce_batch(
                 [v for _, v in sparse_kv])
-            for (k, _), r in zip(sparse_kv, reduced):
+            densified_batch = []       # ZeRO-stated keys share ONE
+            for (k, _), r in zip(sparse_kv, reduced):   # fused gather
                 if self._optimizer is not None and k in self._data:
                     if k in self._opt_states:
                         # the key's state is already ZeRO-sliced from
@@ -563,7 +564,7 @@ class DistKVStore(KVStoreBase):
                         _log_storage_fallback(
                             f"sparse push on dense-stated key {k!r} "
                             "joins the ZeRO-sliced update")
-                        self._sharded_update_batch([(k, r.todense())])
+                        densified_batch.append((k, r.todense()))
                     else:
                         self._sparse_update(k, r)
                 elif self._updater is not None and k in self._data:
@@ -577,6 +578,8 @@ class DistKVStore(KVStoreBase):
                     self._data[k] = r.todense()
                 else:
                     self._data[k] = r     # pure reduce: stays sparse
+            if densified_batch:
+                self._sharded_update_batch(densified_batch)
         if not kv:
             return
 

@@ -223,6 +223,194 @@ def _fa_backward(causal, sm_scale, block_q, res, do):
 
 
 # --------------------------------------------------------------------------
+# pallas backward kernels: the standard flash-backward split — one pass
+# accumulates dk/dv per k-block (q innermost, f32 VMEM accumulators),
+# one accumulates dq per q-block (k innermost).  Unlike the scan
+# fallback above, the (block, block) score/probability recomputations
+# never leave VMEM, so backward HBM traffic drops from O(S_q * S_k)
+# temps to the O(S * D) operand streams.
+# --------------------------------------------------------------------------
+
+def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dk_acc, dv_acc, *,
+                        sm_scale, causal, block_q, block_k,
+                        seq_q, seq_k):
+    j = pl.program_id(1)              # k block
+    i = pl.program_id(2)              # q block (innermost)
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    run = (q_start + block_q - 1 >= k_start) if causal else (i >= 0)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]                  # (block_q, d)
+        k = k_ref[0]                  # (block_k, d)
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]              # (block_q,)
+        delta = delta_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        qpos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (qpos < seq_q) & (kpos < seq_k)
+        if causal:
+            mask = mask & (qpos >= kpos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dof = do.astype(jnp.float32)
+        # dv_j += P^T dO ;  dP = dO V^T ;  dS = P*(dP - delta)*scale
+        dv_acc[...] = dv_acc[...] + lax.dot_general(
+            p, dof, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = lax.dot_general(dof, v.astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dk_acc[...] = dk_acc[...] + lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_acc, *, sm_scale, causal, block_q,
+                      block_k, seq_q, seq_k):
+    i = pl.program_id(1)              # q block
+    j = pl.program_id(2)              # k block (innermost)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    run = (q_start + block_q - 1 >= k_start) if causal else (j >= 0)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0]
+        delta = delta_ref[0]
+        s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * sm_scale
+        qpos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        kpos = k_start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = (qpos < seq_q) & (kpos < seq_k)
+        if causal:
+            mask = mask & (qpos >= kpos)
+        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        dof = do.astype(jnp.float32)
+        dp = lax.dot_general(dof, v.astype(jnp.float32),
+                             (((1,), (1,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * sm_scale
+        dq_acc[...] = dq_acc[...] + lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
+
+
+def _fa_backward_pallas(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    bh, seq_q, d = q.shape
+    seq_k = k.shape[1]
+    block_q = min(block_q, _ceil_to(seq_q, 128))
+    block_k = min(block_k, _ceil_to(seq_k, 128))
+    pq = _ceil_to(seq_q, block_q) - seq_q
+    pk = _ceil_to(seq_k, block_k) - seq_k
+    if pq:
+        pad3 = ((0, 0), (0, pq), (0, 0))
+        q = jnp.pad(q, pad3)
+        out = jnp.pad(out, pad3)
+        do = jnp.pad(do, pad3)
+        lse = jnp.pad(lse, ((0, 0), (0, pq)))
+    if pk:
+        k = jnp.pad(k, ((0, 0), (0, pk), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pk), (0, 0)))
+    nq = q.shape[1] // block_q
+    nk = k.shape[1] // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)          # (BH, Sq')
+
+    common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_q=seq_q, seq_k=seq_k)
+    interp = jax.default_backend() != "tpu"
+
+    def qi_kj(sel_q, sel_k):
+        # index maps for (b, j, i) / (b, i, j) grids
+        return [
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, x, y: (b, sel_q(x, y), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, x, y: (b, sel_k(x, y), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, x, y: (b, sel_k(x, y), 0)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, x, y: (b, sel_q(x, y), 0)),
+            pl.BlockSpec((1, block_q),
+                         lambda b, x, y: (b, sel_q(x, y))),
+            pl.BlockSpec((1, block_q),
+                         lambda b, x, y: (b, sel_q(x, y))),
+        ]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkdv_kernel, **common),
+        grid=(bh, nk, nq),            # q innermost: dk/dv scratch lives
+        in_specs=qi_kj(lambda j, i: i, lambda j, i: j),
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+            jax.ShapeDtypeStruct(v.shape, v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interp,
+    )(q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, **common),
+        grid=(bh, nq, nk),            # k innermost: dq scratch lives
+        in_specs=qi_kj(lambda i, j: i, lambda i, j: j),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interp,
+    )(q, k, v, do, lse, delta)[0]
+
+    if pq:
+        dq = dq[:, :seq_q]
+    if pk:
+        dk = dk[:, :seq_k]
+        dv = dv[:, :seq_k]
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
 # public flash_attention on raw arrays (custom_vjp over the pallas fwd)
 # --------------------------------------------------------------------------
 
@@ -238,7 +426,13 @@ def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
-    return _fa_backward(causal, sm_scale, block_q, res, do)
+    import os
+    if os.environ.get("MXNET_TPU_FLASH_BWD", "pallas") == "scan":
+        # XLA-scan fallback (kept for A/B tuning and as the oracle the
+        # pallas kernels are pinned against in tests)
+        return _fa_backward(causal, sm_scale, block_q, res, do)
+    return _fa_backward_pallas(causal, sm_scale, block_q, block_k, res,
+                               do)
 
 
 _flash_attention.defvjp(_flash_fwd, _flash_bwd)

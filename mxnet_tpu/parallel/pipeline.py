@@ -422,8 +422,10 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
     parallelism: P('pp', None, 'tp') on a column-parallel weight — the
     stage_fn is then responsible for its own 'tp' collectives).
     Defaults to P(axis_name) on every leaf.  ``return_input_grad``
-    additionally returns d(loss)/dx with x's sharding (for chaining an
-    embedding in front of the pipeline).
+    additionally returns d(mean_loss)/dx with x's sharding — already
+    scaled for the dp-mean, so a caller chains it directly (e.g. into
+    an embedding scatter; summing each shard's rows yields the global
+    gradient).
     """
     S = mesh.shape[axis_name]
     for leaf in jax.tree.leaves(stacked_params):
@@ -461,6 +463,11 @@ def pipeline_value_and_grad_1f1b(stage_fn: Callable, loss_fn: Callable,
         grads = jax.tree.map(lambda g: g[None], grads)
         if return_input_grad:
             dx = res[2].reshape(xb.shape)
+            if dp:
+                # dx rows live only on their own dp shard (a pmean
+                # would mix different batch rows); the global-mean loss
+                # scales each shard's contribution by 1/n_dp
+                dx = (dx / n_dp).astype(dx.dtype)
             return loss, grads, dx
         return loss, grads
 

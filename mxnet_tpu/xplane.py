@@ -210,6 +210,26 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
                     row = table[name]
                     row["count"] += 1
                     row["total_us"] += ev.duration_ps / 1e6
+        if not table and any(line.events for p in planes
+                             for line in p.lines):
+            # the line-name heuristic above keys off jax/XLA-internal
+            # spellings; if a runtime upgrade renames them, do NOT
+            # silently return an empty table — aggregate every
+            # non-bookkeeping host event and say so
+            from .log import get_logger
+            get_logger().warning(
+                "xplane: no 'XLAPjRtCpuClient' line found in the host "
+                "trace (runtime renamed its threadpool lines?); "
+                "falling back to aggregating all host-plane events")
+            for p in planes:
+                for line in p.lines:
+                    for ev in line.events:
+                        name = p.event_metadata.get(ev.metadata_id)
+                        if not name or name.startswith(skip):
+                            continue
+                        row = table[name]
+                        row["count"] += 1
+                        row["total_us"] += ev.duration_ps / 1e6
 
     out = {}
     for name, row in table.items():

@@ -212,3 +212,67 @@ def test_flash_block_env_defaults(monkeypatch):
                     .randn(1, 2, 128, 16).astype("float32"))
     out = flash_attention(q, q, q, causal=True)
     assert out.shape == q.shape
+
+
+# -- pallas flash backward (r5): pinned against the scan backward and
+#    autodiff through the reference implementation -------------------------
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("sq,sk", [(128, 128), (200, 136), (96, 256)])
+def test_flash_backward_pallas_matches_scan_and_reference(causal, sq, sk):
+    import os
+    from mxnet_tpu.ops.attention import (attention_reference,
+                                         flash_attention)
+    if causal and sq != sk:
+        pytest.skip("causal path assumes square q/k")
+    rng = onp.random.RandomState(500 + sq + sk + causal)
+    B, H, D = 2, 2, 64
+    q = jnp.asarray(rng.randn(B, H, sq, D).astype("float32") * 0.5)
+    k = jnp.asarray(rng.randn(B, H, sk, D).astype("float32") * 0.5)
+    v = jnp.asarray(rng.randn(B, H, sk, D).astype("float32") * 0.5)
+    cot = jnp.asarray(rng.randn(B, H, sq, D).astype("float32"))
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal) * cot)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=causal) * cot)
+
+    os.environ["MXNET_TPU_FLASH_BWD"] = "pallas"
+    try:
+        gp = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        os.environ["MXNET_TPU_FLASH_BWD"] = "scan"
+        gs = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        del os.environ["MXNET_TPU_FLASH_BWD"]
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, c, nm in zip(gp, gs, gr, "qkv"):
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),
+                                    rtol=2e-4, atol=2e-4,
+                                    err_msg=f"pallas vs scan d{nm}")
+        onp.testing.assert_allclose(onp.asarray(a), onp.asarray(c),
+                                    rtol=2e-3, atol=2e-3,
+                                    err_msg=f"pallas vs reference d{nm}")
+
+
+def test_flash_backward_pallas_bf16():
+    import ml_dtypes
+    from mxnet_tpu.ops.attention import (attention_reference,
+                                         flash_attention)
+    rng = onp.random.RandomState(77)
+    B, H, S, D = 1, 2, 128, 64
+    qf = rng.randn(B, H, S, D).astype("float32") * 0.5
+    q = jnp.asarray(qf).astype(jnp.bfloat16)
+
+    def loss_flash(q):
+        return jnp.sum(flash_attention(q, q, q, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    def loss_ref(q):
+        return jnp.sum(attention_reference(q, q, q, causal=True)
+                       .astype(jnp.float32) ** 2)
+
+    gp = jax.grad(loss_flash)(q).astype(jnp.float32)
+    gr = jax.grad(loss_ref)(q).astype(jnp.float32)
+    onp.testing.assert_allclose(onp.asarray(gp), onp.asarray(gr),
+                                rtol=8e-2, atol=8e-2)

@@ -1,0 +1,113 @@
+"""Second-configuration gradient draws for the hot op families
+(VERDICT r4 weak #8: the fd sweep is one shape/param draw per op).
+
+Each entry re-checks an op's vjp under a DIFFERENT regime than its
+grad_sweep_specs entry: strides/padding/dilation/groups for conv,
+avg/lp pooling, non-default axes for softmax/reductions, broadcasting
+ranks for elemwise, rectangular matmuls, multi-layer/bidirectional RNN.
+Parity: the reference checks many of these combinations explicitly in
+test_operator.py (check_numeric_gradient over parameter grids).
+"""
+import numpy as onp
+import pytest
+
+from grad_sweep_specs import S
+from test_grad_sweep import run_spec
+
+
+def v(arrays, params=None, diff=None, out=None, rtol=2e-2, atol=2e-3,
+      eps=1e-3, train_mode=False, obj=None):
+    return dict(arrays=arrays, params=params or {}, diff=diff, out=out,
+                rtol=rtol, atol=atol, eps=eps, train_mode=train_mode,
+                obj=obj)
+
+
+VARIANTS = {
+    # conv family: stride+pad, dilation, and grouped kernels
+    "Convolution@stride_pad": v(
+        [S.f(1, 2, 6, 6), S.f(3, 2, 3, 3), S.f(3)],
+        params=dict(kernel=(3, 3), num_filter=3, stride=(2, 2),
+                    pad=(1, 1)), rtol=3e-2, eps=2e-3),
+    "Convolution@dilated": v(
+        [S.f(1, 1, 7, 7), S.f(2, 1, 3, 3), S.f(2)],
+        params=dict(kernel=(3, 3), num_filter=2, dilate=(2, 2)),
+        rtol=3e-2, eps=2e-3),
+    "Convolution@grouped": v(
+        [S.f(1, 4, 5, 5), S.f(4, 2, 3, 3), S.f(4)],
+        params=dict(kernel=(3, 3), num_filter=4, num_group=2),
+        rtol=3e-2, eps=2e-3),
+    "Convolution@1d": v(
+        [S.f(2, 2, 8), S.f(3, 2, 3), S.f(3)],
+        params=dict(kernel=(3,), num_filter=3), rtol=3e-2, eps=2e-3),
+    "Deconvolution@stride": v(
+        [S.f(1, 2, 3, 3), S.f(2, 2, 4, 4), S.f(2)],
+        params=dict(kernel=(4, 4), num_filter=2, stride=(2, 2),
+                    pad=(1, 1)), rtol=3e-2, eps=2e-3),
+    # pooling: avg + global + stride-1 overlap
+    "Pooling@avg": v(
+        [S.f(1, 2, 5, 5)],
+        params=dict(kernel=(3, 3), pool_type="avg", stride=(2, 2),
+                    pad=(1, 1))),
+    "Pooling@global": v(
+        [S.f(2, 3, 4, 4)],
+        params=dict(kernel=(1, 1), pool_type="avg", global_pool=True)),
+    "Pooling@lp": v(
+        [S.pos(1, 1, 4, 4)],
+        params=dict(kernel=(2, 2), pool_type="lp", p_value=2),
+        rtol=3e-2),
+    # dense/matmul: rectangular + flatten=False
+    "FullyConnected@no_flatten": v(
+        [S.f(2, 3, 5), S.f(4, 5), S.f(4)],
+        params=dict(num_hidden=4, flatten=False)),
+    "dot@rect": v([S.f(2, 5), S.f(5, 7)]),
+    "dot@transpose": v([S.f(5, 2), S.f(5, 3)],
+                       params=dict(transpose_a=True)),
+    "batch_dot@rect": v([S.f(3, 2, 4), S.f(3, 4, 5)]),
+    "_npi_matmul@bcast": v([S.f(2, 1, 3, 4), S.f(1, 5, 4, 2)]),
+    # normalization: channel-last / other axes
+    "BatchNorm@axis_last": v(
+        [S.f(2, 2, 3), S.pos(3), S.f(3), S.f(3), S.pos(3)],
+        diff=[0, 1, 2], params=dict(fix_gamma=False, axis=-1),
+        train_mode=True, rtol=4e-2, atol=5e-3, eps=2e-3),
+    "LayerNorm@mid_axis": v(
+        [S.f(2, 4, 3), S.pos(4), S.f(4)],
+        params=dict(axis=1), rtol=3e-2),
+    "softmax@axis0": v([S.f(3, 4)], params=dict(axis=0)),
+    "softmax@temperature": v([S.f(2, 5)],
+                             params=dict(temperature=2.5)),
+    "log_softmax@axis0": v([S.f(3, 4)], params=dict(axis=0),
+                           rtol=3e-2),
+    # reductions over explicit axes + keepdims
+    "_npi_sum@axis_keepdims": v(
+        [S.f(2, 3, 4)], params=dict(axis=(0, 2), keepdims=True)),
+    "_npi_mean@neg_axis": v([S.f(2, 3, 4)], params=dict(axis=-2)),
+    "_npi_prod@axis": v([S.away(2, 3)], params=dict(axis=1),
+                        rtol=3e-2),
+    "norm@ord1": v([S.away(2, 4)], params=dict(ord=1), rtol=3e-2),
+    # broadcasting elemwise at rank mismatch
+    "broadcast_add@rank": v([S.f(2, 1, 4), S.f(3, 1)]),
+    "broadcast_mul@rank": v([S.f(1, 3, 1), S.f(2, 1, 4)]),
+    "broadcast_div@rank": v([S.f(2, 1), S.away(1, 3)], rtol=3e-2),
+    # attention/transformer second draws
+    "_contrib_div_sqrt_dim@tall": v([S.f(5, 16)]),
+    # embedding-style gathers at other shapes
+    "take@axis1": v([S.f(3, 5), None], diff=[0],
+                    params=dict(axis=1),
+                    obj=None),
+    "gather_nd@deep": v([S.f(3, 4, 2), None], diff=[0]),
+    # (RNN deliberately absent: it is fd-EXEMPT — fused custom-vjp op
+    # verified against unfused cell references in test_rnn_op; the
+    # bidirectional/multi-layer regimes are covered there)
+}
+
+# take/gather_nd need index arrays (non-diff): build them here
+VARIANTS["take@axis1"]["arrays"][1] = \
+    lambda r: r.randint(0, 5, size=(2,)).astype("float32")
+VARIANTS["gather_nd@deep"]["arrays"][1] = \
+    lambda r: onp.asarray([[0, 2, 1], [1, 3, 0]], "float32")
+
+
+@pytest.mark.parametrize("key", sorted(VARIANTS))
+def test_fd_gradient_variant(key):
+    name = key.split("@")[0]
+    run_spec(name, VARIANTS[key])

@@ -228,7 +228,7 @@ def test_truncation_raises_everywhere():
     unnamed tail) still yields valid arrays — never an exception of
     another type, never silent garbage."""
     for buf in _valid_bufs():
-        for cut in range(0, len(buf) - 1):
+        for cut in range(0, len(buf)):
             try:
                 data, names = decode_list(buf[:cut])
             except MXNetError:

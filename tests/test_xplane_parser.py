@@ -137,3 +137,21 @@ class TestWireParsing:
         root = _write(tmp_path, _xspace([plane]))
         table = xplane.device_op_table(root)
         assert table["big_fusion"]["total_us"] == pytest.approx(dur / 1e6)
+
+
+class TestRenamedRuntimeLines:
+    def test_cpu_fallback_when_client_line_renamed(self, tmp_path):
+        """A jax upgrade renaming the 'XLAPjRtCpuClient' threadpool
+        line must NOT silently empty the table: the reader falls back
+        to aggregating all host events (with a warning)."""
+        blob = _xspace([_plane(
+            "/host:CPU",
+            [_line("tf_SomeNewRuntimeName/worker0",
+                   [_event(1, 3_000_000), _event(2, 1_000_000)])],
+            [_evmeta(1, "fusion.1"), _evmeta(2, "end: fusion.1")])])
+        path = _write(tmp_path, blob)
+        from mxnet_tpu.xplane import device_op_table
+        table = device_op_table(path)
+        assert "fusion.1" in table, table
+        assert "end: fusion.1" not in table      # bookkeeping still cut
+        assert table["fusion.1"]["count"] == 1
