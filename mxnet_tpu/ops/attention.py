@@ -429,7 +429,10 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     import os
     if os.environ.get("MXNET_TPU_FLASH_BWD", "pallas") == "scan":
         # XLA-scan fallback (kept for A/B tuning and as the oracle the
-        # pallas kernels are pinned against in tests)
+        # pallas kernels are pinned against in tests).  NOTE: read at
+        # TRACE time — a function already jitted has its backend baked
+        # into the compile cache; set the env var before tracing (or
+        # jax.clear_caches()) for an A/B comparison to measure both.
         return _fa_backward(causal, sm_scale, block_q, res, do)
     return _fa_backward_pallas(causal, sm_scale, block_q, block_k, res,
                                do)

@@ -199,20 +199,24 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
         # threadpool line ("tf_XLAPjRtCpuClient/..."); skip the paired
         # "end:" markers and threadpool bookkeeping
         skip = ("end: ", "ThreadpoolListener", "ThunkExecutor")
-        for p in planes:
-            for line in p.lines:
-                if "XLAPjRtCpuClient" not in line.name:
-                    continue
-                for ev in line.events:
-                    name = p.event_metadata.get(ev.metadata_id)
-                    if not name or name.startswith(skip):
+
+        def feed_host(line_filter):
+            for p in planes:
+                for line in p.lines:
+                    if not line_filter(line):
                         continue
-                    row = table[name]
-                    row["count"] += 1
-                    row["total_us"] += ev.duration_ps / 1e6
+                    for ev in line.events:
+                        name = p.event_metadata.get(ev.metadata_id)
+                        if not name or name.startswith(skip):
+                            continue
+                        row = table[name]
+                        row["count"] += 1
+                        row["total_us"] += ev.duration_ps / 1e6
+
+        feed_host(lambda line: "XLAPjRtCpuClient" in line.name)
         if not table and any(line.events for p in planes
                              for line in p.lines):
-            # the line-name heuristic above keys off jax/XLA-internal
+            # the line-name heuristic keys off jax/XLA-internal
             # spellings; if a runtime upgrade renames them, do NOT
             # silently return an empty table — aggregate every
             # non-bookkeeping host event and say so
@@ -221,15 +225,7 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
                 "xplane: no 'XLAPjRtCpuClient' line found in the host "
                 "trace (runtime renamed its threadpool lines?); "
                 "falling back to aggregating all host-plane events")
-            for p in planes:
-                for line in p.lines:
-                    for ev in line.events:
-                        name = p.event_metadata.get(ev.metadata_id)
-                        if not name or name.startswith(skip):
-                            continue
-                        row = table[name]
-                        row["count"] += 1
-                        row["total_us"] += ev.duration_ps / 1e6
+            feed_host(lambda line: True)
 
     out = {}
     for name, row in table.items():

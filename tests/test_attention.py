@@ -238,13 +238,14 @@ def test_flash_backward_pallas_matches_scan_and_reference(causal, sq, sk):
     def loss_ref(q, k, v):
         return jnp.sum(attention_reference(q, k, v, causal=causal) * cot)
 
-    os.environ["MXNET_TPU_FLASH_BWD"] = "pallas"
+    monkeypatch = pytest.MonkeyPatch()
     try:
+        monkeypatch.setenv("MXNET_TPU_FLASH_BWD", "pallas")
         gp = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
-        os.environ["MXNET_TPU_FLASH_BWD"] = "scan"
+        monkeypatch.setenv("MXNET_TPU_FLASH_BWD", "scan")
         gs = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     finally:
-        del os.environ["MXNET_TPU_FLASH_BWD"]
+        monkeypatch.undo()      # restores any pre-existing setting
     gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for a, b, c, nm in zip(gp, gs, gr, "qkv"):
         onp.testing.assert_allclose(onp.asarray(a), onp.asarray(b),

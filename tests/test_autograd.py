@@ -217,3 +217,70 @@ def test_second_order_sweep_analytic():
         onp.testing.assert_allclose(
             got, expect, rtol=2e-4, atol=2e-5,
             err_msg=f"second derivative mismatch for {name}")
+
+
+def test_second_order_sweep_analytic_extended():
+    """Round-5 extension of the closed-form second-derivative pins:
+    13 more unary ops (incl. domain-limited inverse-trig/hyperbolic)
+    plus second order THROUGH dot and a scalar power (parity:
+    test_higher_order_grad.py's wider op list)."""
+    import numpy as onp
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.ops.registry import invoke
+
+    def d2(name, x_np, **params):
+        x = NDArray(x_np)
+        with autograd.record():
+            y = invoke(name, [x], **params)
+            (gx,) = autograd.grad(y, [x], create_graph=True,
+                                  retain_graph=True)
+            s = gx.sum()
+        (ggx,) = autograd.grad(s, [x])
+        return ggx.asnumpy()
+
+    rng = onp.random.RandomState(6)
+    x = rng.uniform(0.3, 1.2, size=(3, 4)).astype("float32")
+    xs = (x * 0.7).astype("float32")        # domain |x|<1 cases
+
+    cases = {
+        "rsqrt": (x, 0.75 * x ** -2.5),
+        "cbrt": (x, -(2.0 / 9.0) * x ** (-5.0 / 3.0)),
+        "rcbrt": (x, (4.0 / 9.0) * x ** (-7.0 / 3.0)),
+        "arctan": (x, -2 * x / (1 + x ** 2) ** 2),
+        "arcsin": (xs, xs / (1 - xs ** 2) ** 1.5),
+        "arccos": (xs, -xs / (1 - xs ** 2) ** 1.5),
+        "arctanh": (xs, 2 * xs / (1 - xs ** 2) ** 2),
+        "arcsinh": (x, -x / (1 + x ** 2) ** 1.5),
+        "sinh": (x, onp.sinh(x)),
+        "cosh": (x, onp.cosh(x)),
+        "log2": (x, -1.0 / (x ** 2 * onp.log(2.0))),
+        "log10": (x, -1.0 / (x ** 2 * onp.log(10.0))),
+        "softsign": (x, -2.0 / (1 + x) ** 3),   # x>0: y=x/(1+x)
+    }
+    for name, (xin, expect) in cases.items():
+        got = d2(name, xin)
+        onp.testing.assert_allclose(
+            got, expect, rtol=4e-4, atol=4e-5,
+            err_msg=f"second derivative mismatch for {name}")
+
+    # scalar power: d2/dx2 x^3 = 6x
+    got = d2("_power_scalar", x, scalar=3.0)
+    onp.testing.assert_allclose(got, 6 * x, rtol=4e-4, atol=4e-5)
+
+    # second order THROUGH dot: s(x) = sum((xW)^2); grad = 2 xW W^T,
+    # grad of sum(grad) = 2 * ones @ (W W^T) summed rows -> per-entry
+    # closed form 2 * (W W^T summed over output col) broadcast on rows
+    W_np = rng.randn(4, 5).astype("float32")
+    xm = NDArray(x)
+    W = NDArray(W_np)
+    with autograd.record():
+        y = invoke("dot", [xm, W])
+        s = invoke("square", [y]).sum()
+        (gx,) = autograd.grad(s, [xm], create_graph=True,
+                              retain_graph=True)
+        t = gx.sum()
+    (ggx,) = autograd.grad(t, [xm])
+    expect = onp.broadcast_to(
+        2.0 * (W_np @ W_np.T).sum(axis=1), (3, 4)).astype("float32")
+    onp.testing.assert_allclose(ggx.asnumpy(), expect, rtol=4e-4,
+                                atol=4e-4)

@@ -398,3 +398,12 @@ def test_convolutional_autoencoder_example():
     m = _load("gluon/convolutional_autoencoder.py", "conv_ae_ex")
     mse, baseline = m.train(epochs=4, steps=20, verbose=False)
     assert mse < baseline * 0.5, (mse, baseline)
+
+
+def test_pipeline_1f1b_3d_example(capsys):
+    """3D-parallel recipe (pp x dp x tp, true 1F1B, sparse embedding,
+    bf16 AMP, ZeRO-1) trains as plain user code on the virtual mesh."""
+    m = _load("parallel/pipeline_1f1b_3d.py", "pipeline_1f1b_3d_example")
+    m.main()
+    out = capsys.readouterr().out
+    assert "3D-parallel (pp x dp x tp) 1F1B training: OK" in out
