@@ -49,12 +49,15 @@ class MultiHeadAttention(HybridBlock):
         # sequence parallelism: when a mesh with an "sp" axis is given,
         # attention runs context-parallel over that axis.  sp_mode
         # picks the scheme: "ring" (K/V blocks rotate by
-        # collective-permute, parallel/ring_attention.py) or "ulysses"
-        # (two all-to-alls re-shard sequence<->heads,
-        # parallel/ulysses.py) — the long-context training path
+        # collective-permute, parallel/ring_attention.py), "ring_flash"
+        # (same ring, the Pallas flash kernel as local block engine —
+        # long-context dense attention), or "ulysses" (two all-to-alls
+        # re-shard sequence<->heads, parallel/ulysses.py)
         self._ring_mesh = ring_mesh
-        if sp_mode not in ("ring", "ulysses"):
-            raise MXNetError(f"sp_mode {sp_mode!r}: 'ring' or 'ulysses'")
+        if sp_mode not in ("ring", "ring_flash", "ulysses"):
+            raise MXNetError(
+                f"sp_mode {sp_mode!r}: 'ring', 'ring_flash' or "
+                f"'ulysses'")
         self._sp_mode = sp_mode
         hkv = num_kv_heads if num_kv_heads is not None else num_heads
         kv_units = (units // num_heads) * hkv
@@ -81,12 +84,15 @@ class MultiHeadAttention(HybridBlock):
 
     def _ring_forward(self, q, k, v):
         from ...ops.registry import apply_jax
-        from ...parallel import ring_self_attention, ulysses_self_attention
+        from ...parallel import (ring_flash_self_attention,
+                                 ring_self_attention,
+                                 ulysses_self_attention)
 
         heads, causal, mesh = self._heads, self._causal, self._ring_mesh
         hkv = self._kv_heads if self._kv_heads is not None else heads
-        sp_attn = (ring_self_attention if self._sp_mode == "ring"
-                   else ulysses_self_attention)
+        sp_attn = {"ring": ring_self_attention,
+                   "ring_flash": ring_flash_self_attention,
+                   "ulysses": ulysses_self_attention}[self._sp_mode]
 
         def fn(qa, ka, va):
             from ...ops.attention import merge_heads, split_heads

@@ -328,7 +328,11 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
-def _fa_backward_pallas(causal, sm_scale, block_q, block_k, res, do):
+def _fa_backward_pallas(causal, sm_scale, block_q, block_k, res, do,
+                        delta=None):
+    """``delta`` may be precomputed (rowsum(do*out), shape (BH, Sq)) —
+    ring attention hoists it out of its per-step loop since do/out are
+    loop-invariant there."""
     q, k, v, out, lse = res
     bh, seq_q, d = q.shape
     seq_k = k.shape[1]
@@ -336,19 +340,21 @@ def _fa_backward_pallas(causal, sm_scale, block_q, block_k, res, do):
     block_k = min(block_k, _ceil_to(seq_k, 128))
     pq = _ceil_to(seq_q, block_q) - seq_q
     pk = _ceil_to(seq_k, block_k) - seq_k
+    if delta is None:
+        delta = jnp.sum(do.astype(jnp.float32)
+                        * out.astype(jnp.float32), axis=-1)  # (BH, Sq)
     if pq:
         pad3 = ((0, 0), (0, pq), (0, 0))
         q = jnp.pad(q, pad3)
         out = jnp.pad(out, pad3)
         do = jnp.pad(do, pad3)
         lse = jnp.pad(lse, ((0, 0), (0, pq)))
+        delta = jnp.pad(delta, ((0, 0), (0, pq)))
     if pk:
         k = jnp.pad(k, ((0, 0), (0, pk), (0, 0)))
         v = jnp.pad(v, ((0, 0), (0, pk), (0, 0)))
     nq = q.shape[1] // block_q
     nk = k.shape[1] // block_k
-    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)          # (BH, Sq')
 
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
                   block_k=block_k, seq_q=seq_q, seq_k=seq_k)

@@ -10,7 +10,9 @@ equivalent here — the compiler owns topology).
 """
 from .mesh import make_mesh, default_mesh, data_parallel_spec, replicated
 from .trainer import SPMDTrainer
-from .ring_attention import ring_attention, ring_self_attention
+from .ring_attention import (ring_attention, ring_self_attention,
+                             ring_flash_attention,
+                             ring_flash_self_attention)
 from .ulysses import ulysses_attention, ulysses_self_attention
 from .pipeline import (gpipe_apply, pipeline_forward,
                        interleaved_apply, pipeline_forward_1f1b,
@@ -22,6 +24,7 @@ from .moe import switch_moe, moe_expert_sharding
 
 __all__ = ["make_mesh", "default_mesh", "data_parallel_spec", "replicated",
            "SPMDTrainer", "ring_attention", "ring_self_attention",
+           "ring_flash_attention", "ring_flash_self_attention",
            "ulysses_attention", "ulysses_self_attention",
            "gpipe_apply", "pipeline_forward", "switch_moe",
            "interleaved_apply", "pipeline_forward_1f1b",

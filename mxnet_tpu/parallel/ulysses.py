@@ -95,14 +95,10 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
     """shard_map wrapper: shards the sequence axis of (B,H,S,D) over
     ``axis_name`` and runs Ulysses all-to-all attention across the
     mesh (mirror of ring_self_attention's contract)."""
-    spec = PartitionSpec(None, None, axis_name, None)
-    sh = NamedSharding(mesh, spec)
-    q, k, v = (jax.device_put(q, sh), jax.device_put(k, sh),
-               jax.device_put(v, sh))
+    from .ring_attention import seq_shard_call
 
     def fn(qq, kk, vv):
         return ulysses_attention(qq, kk, vv, axis_name=axis_name,
                                  causal=causal, sm_scale=sm_scale)
 
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec)(q, k, v)
+    return seq_shard_call(fn, mesh, axis_name, q, k, v, check_vma=True)

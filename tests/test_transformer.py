@@ -217,7 +217,7 @@ def test_generate_device_side_decode():
     onp.testing.assert_array_equal(out2.asnumpy(), out3.asnumpy())
 
 
-@pytest.mark.parametrize("sp_mode", ["ring", "ulysses"])
+@pytest.mark.parametrize("sp_mode", ["ring", "ring_flash", "ulysses"])
 def test_sequence_parallel_training(sp_mode):
     """Long-context path end to end: MultiHeadAttention(ring_mesh=...,
     sp_mode=...) + SPMDTrainer(seq_axis=1) trains with the sequence
