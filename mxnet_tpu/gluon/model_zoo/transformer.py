@@ -94,6 +94,12 @@ class MultiHeadAttention(HybridBlock):
                    "ring_flash": ring_flash_self_attention,
                    "ulysses": ulysses_self_attention}[self._sp_mode]
 
+        kwargs = {}
+        if self._sp_mode == "ulysses" and self._flash:
+            # use_flash routes the local (post-all-to-all) attention
+            # through the Pallas flash kernel
+            kwargs["use_flash"] = True
+
         def fn(qa, ka, va):
             from ...ops.attention import merge_heads, split_heads
             # GQA: the SMALL (hkv-head) K/V enter the ring — the ring
@@ -102,7 +108,7 @@ class MultiHeadAttention(HybridBlock):
             # K/V only when hkv doesn't divide the axis size)
             out = sp_attn(
                 split_heads(qa, heads), split_heads(ka, hkv),
-                split_heads(va, hkv), mesh, causal=causal)
+                split_heads(va, hkv), mesh, causal=causal, **kwargs)
             return merge_heads(out)
 
         return apply_jax(fn, [q, k, v])

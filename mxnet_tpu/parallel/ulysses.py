@@ -37,7 +37,8 @@ __all__ = ["ulysses_attention", "ulysses_self_attention"]
 
 def ulysses_attention(q, k, v, axis_name: str = "sp",
                       causal: bool = False,
-                      sm_scale: Optional[float] = None):
+                      sm_scale: Optional[float] = None,
+                      use_flash: bool = False):
     """Per-shard Ulysses body; call inside shard_map/pjit.
 
     q: (B, H, S_local, D); k, v: (B, Hkv, S_local, D) — this device's
@@ -80,10 +81,17 @@ def ulysses_attention(q, k, v, axis_name: str = "sp",
         # [i·h/p, (i+1)·h/p) consume, so a local repeat aligns them
         kh = jnp.repeat(kh, group, axis=1)
         vh = jnp.repeat(vh, group, axis=1)
-    # full local sequence for a head subset: plain dense attention —
-    # flash/blockwise kernels drop in here transparently since the
-    # call is an ordinary single-device attention
-    out = attention_reference(qh, kh, vh, causal=causal, sm_scale=scale)
+    # full local sequence for a head subset: ordinary single-device
+    # attention — with use_flash the Pallas flash kernel (VMEM-blocked
+    # scores + custom-vjp backward) replaces the materialized-scores
+    # path for long-context memory behavior
+    if use_flash:
+        from ..ops.attention import flash_attention
+        out = flash_attention(qh, kh, vh, causal=causal,
+                              sm_scale=scale)
+    else:
+        out = attention_reference(qh, kh, vh, causal=causal,
+                                  sm_scale=scale)
     # (B, H/P, S, D) -> (B, H, S/P, D)
     return lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                           tiled=True)
@@ -91,7 +99,8 @@ def ulysses_attention(q, k, v, axis_name: str = "sp",
 
 def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
                            causal: bool = False,
-                           sm_scale: Optional[float] = None):
+                           sm_scale: Optional[float] = None,
+                           use_flash: bool = False):
     """shard_map wrapper: shards the sequence axis of (B,H,S,D) over
     ``axis_name`` and runs Ulysses all-to-all attention across the
     mesh (mirror of ring_self_attention's contract)."""
@@ -99,6 +108,11 @@ def ulysses_self_attention(q, k, v, mesh: Mesh, axis_name: str = "sp",
 
     def fn(qq, kk, vv):
         return ulysses_attention(qq, kk, vv, axis_name=axis_name,
-                                 causal=causal, sm_scale=sm_scale)
+                                 causal=causal, sm_scale=sm_scale,
+                                 use_flash=use_flash)
 
-    return seq_shard_call(fn, mesh, axis_name, q, k, v, check_vma=True)
+    # pallas_call outputs (the use_flash local engine) carry no vma
+    # annotation, so the checker must be off for flash; the dense path
+    # keeps the shard_map vma validation it always had
+    return seq_shard_call(fn, mesh, axis_name, q, k, v,
+                          check_vma=not use_flash)

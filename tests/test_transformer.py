@@ -217,7 +217,8 @@ def test_generate_device_side_decode():
     onp.testing.assert_array_equal(out2.asnumpy(), out3.asnumpy())
 
 
-@pytest.mark.parametrize("sp_mode", ["ring", "ring_flash", "ulysses"])
+@pytest.mark.parametrize("sp_mode", ["ring", "ring_flash", "ulysses",
+                                     "ulysses_flash"])
 def test_sequence_parallel_training(sp_mode):
     """Long-context path end to end: MultiHeadAttention(ring_mesh=...,
     sp_mode=...) + SPMDTrainer(seq_axis=1) trains with the sequence
@@ -235,13 +236,20 @@ def test_sequence_parallel_training(sp_mode):
     def lm_loss(logits, labels):
         return loss_fn(logits.reshape((-1, V)), labels.reshape((-1,)))
 
+    # "ulysses_flash" = sp_mode "ulysses" with use_flash=True: the MHA
+    # wiring that routes the local post-all-to-all attention through
+    # the Pallas kernel
+    layer_mode = "ulysses" if sp_mode == "ulysses_flash" else sp_mode
+    layer_flash = sp_mode == "ulysses_flash"
+
     def build(ring_mesh):
         mx.random.seed(3)
         net = gnn.HybridSequential()
         net.add(gnn.Embedding(V, E),
-                MultiHeadAttention(E, 4, causal=True, use_flash=False,
+                MultiHeadAttention(E, 4, causal=True,
+                                   use_flash=layer_flash,
                                    ring_mesh=ring_mesh,
-                                   sp_mode=sp_mode),
+                                   sp_mode=layer_mode),
                 gnn.Dense(V, flatten=False))
         net.initialize(init=mx.initializer.Xavier())
         net(NDArray(onp.zeros((1, S), onp.int32)))
