@@ -40,6 +40,15 @@ __all__ = ["flash_attention", "attention_reference", "online_block_update",
 
 _NEG_INF = -1e30  # finite -inf stand-in: keeps masked-row math NaN-free
 
+# Per-row vectors (lse, delta) cross the pallas boundary with this many
+# broadcast lanes: TPU block specs need the last two dims (sublane,
+# lane) divisible by (8, 128) or equal to the array's, so a (1, block_q)
+# block over a (BH, S) array cannot lower.  Upstream flash/splash
+# attention store logsumexp the same way (NUM_LANES) and slice lane 0
+# outside the kernel.  CPU interpret mode accepts anything — only a
+# real-TPU run exercises this constraint.
+_LSE_LANES = 128
+
 
 # --------------------------------------------------------------------------
 # reference (materialized-scores) attention — the numerics oracle
@@ -114,7 +123,9 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
         l = l_ref[:, :1]
         l = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, 0] + jnp.log(l[:, 0])).astype(lse_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            m_ref[:, :1] + jnp.log(l),
+            lse_ref.shape[1:]).astype(lse_ref.dtype)
 
 
 def _ceil_to(x, m):
@@ -155,15 +166,18 @@ def _fa_forward_pallas(q, k, v, causal, sm_scale, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, q.shape[1], d), q.dtype),
-            jax.ShapeDtypeStruct((bh, q.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct((bh, q.shape[1], _LSE_LANES),
+                                 jnp.float32),
         ],
         scratch_shapes=scratch_shapes,
         interpret=jax.default_backend() != "tpu",
     )(q, k, v)
+    lse = lse[..., 0]
     if pq:
         out = out[:, :seq_q]
         lse = lse[:, :seq_q]
@@ -254,8 +268,8 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]                  # (block_k, d)
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]              # (block_q,)
-        delta = delta_ref[0]
+        lse = lse_ref[0][:, :1]       # (block_q, 1): lane-0 of broadcast
+        delta = delta_ref[0][:, :1]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         qpos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -263,7 +277,7 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (qpos < seq_q) & (kpos < seq_k)
         if causal:
             mask = mask & (qpos >= kpos)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dof = do.astype(jnp.float32)
         # dv_j += P^T dO ;  dP = dO V^T ;  dS = P*(dP - delta)*scale
         dv_acc[...] = dv_acc[...] + lax.dot_general(
@@ -272,7 +286,7 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dp = lax.dot_general(dof, v.astype(jnp.float32),
                              (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dk_acc[...] = dk_acc[...] + lax.dot_general(
             ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -304,8 +318,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]
-        delta = delta_ref[0]
+        lse = lse_ref[0][:, :1]       # (block_q, 1): lane-0 of broadcast
+        delta = delta_ref[0][:, :1]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         qpos = q_start + lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -313,12 +327,12 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         mask = (qpos < seq_q) & (kpos < seq_k)
         if causal:
             mask = mask & (qpos >= kpos)
-        p = jnp.where(mask, jnp.exp(s - lse[:, None]), 0.0)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dof = do.astype(jnp.float32)
         dp = lax.dot_general(dof, v.astype(jnp.float32),
                              (((1,), (1,)), ((), ())),
                              preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * sm_scale
+        ds = p * (dp - delta) * sm_scale
         dq_acc[...] = dq_acc[...] + lax.dot_general(
             ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -360,6 +374,11 @@ def _fa_backward_pallas(causal, sm_scale, block_q, block_k, res, do,
                   block_k=block_k, seq_q=seq_q, seq_k=seq_k)
     interp = jax.default_backend() != "tpu"
 
+    # per-row vectors cross the boundary lane-broadcast (see _LSE_LANES)
+    lse = jnp.broadcast_to(lse[..., None], lse.shape + (_LSE_LANES,))
+    delta = jnp.broadcast_to(delta[..., None],
+                             delta.shape + (_LSE_LANES,))
+
     def qi_kj(sel_q, sel_k):
         # index maps for (b, j, i) / (b, i, j) grids
         return [
@@ -371,10 +390,10 @@ def _fa_backward_pallas(causal, sm_scale, block_q, block_k, res, do,
                          lambda b, x, y: (b, sel_k(x, y), 0)),
             pl.BlockSpec((1, block_q, d),
                          lambda b, x, y: (b, sel_q(x, y), 0)),
-            pl.BlockSpec((1, block_q),
-                         lambda b, x, y: (b, sel_q(x, y))),
-            pl.BlockSpec((1, block_q),
-                         lambda b, x, y: (b, sel_q(x, y))),
+            pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, x, y: (b, sel_q(x, y), 0)),
+            pl.BlockSpec((1, block_q, _LSE_LANES),
+                         lambda b, x, y: (b, sel_q(x, y), 0)),
         ]
 
     dk, dv = pl.pallas_call(
