@@ -10,18 +10,42 @@ override the config knob before any backend initializes.
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = \
-        (_flags + " --xla_force_host_platform_device_count=8").strip()
+# MXNET_TEST_ON_TPU=1: run the suite on whatever real accelerator the
+# container exposes instead of the virtual CPU mesh.  Interpret-mode
+# pallas and CPU lowering skip real-TPU constraints (block-spec tiling,
+# MXU default precision), so targeted real-hardware passes during a
+# tunnel window catch what the CPU suite cannot.  Tests needing more
+# devices than the host has are converted to skips by the
+# pytest_runtest_call hook below (make_mesh raises ValueError on a
+# device shortage; on a 1-chip host that is expected, not a failure).
+_ON_TPU = os.environ.get("MXNET_TEST_ON_TPU", "") == "1"
+
+if not _ON_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = \
+            (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as _onp
 import pytest
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    outcome = yield
+    if _ON_TPU and outcome.excinfo is not None:
+        etype, evalue = outcome.excinfo[0], outcome.excinfo[1]
+        if issubclass(etype, ValueError) and \
+                "devices, have" in str(evalue):
+            outcome.force_exception(
+                pytest.skip.Exception(
+                    f"needs more devices than this host has: {evalue}"))
 
 
 @pytest.fixture(autouse=True)
