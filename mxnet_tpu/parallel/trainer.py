@@ -489,6 +489,11 @@ class SPMDTrainer:
                 from ..gluon.block import _TraceContext, _trace_scope
                 tc = _TraceContext(key0)
                 saved = [p._data for p in params]
+                if self._data_transform is not None:
+                    # same device-side preprocessing as the train step
+                    # (a uint8-wire trainer must not see raw pixels at
+                    # inference either)
+                    x = self._data_transform(x)
                 if amp is not None:
                     p_arrays = [a.astype(amp) if jnp.issubdtype(
                         a.dtype, jnp.floating) else a for a in p_arrays]

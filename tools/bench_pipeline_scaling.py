@@ -57,13 +57,46 @@ def measure(rec, threads, batch, hw, epochs=2):
     return seen / dt
 
 
+def _force_cpu_backend():
+    """The pipeline never touches the accelerator, but NDArray wrapping
+    initializes a jax backend — and the container's sitecustomize
+    registers the axon TPU plugin, so with a wedged tunnel a bare run
+    hangs at device init.  Pin jax to CPU (same dance as bench.py's
+    dry-run / tests/conftest.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+        clear_backends()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=512)
     ap.add_argument("--hw", type=int, default=224)
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--threads", default="1,2,4")
+    ap.add_argument("--one-rate", action="store_true",
+                    help="measure only the FIRST thread count and print "
+                         "one {'img_s': N} JSON line (clean-subprocess "
+                         "mode for bench.py's pipeline row)")
+    ap.add_argument("--rec", default=None,
+                    help="existing .rec file to read (skips the encode)")
     args = ap.parse_args()
+    _force_cpu_backend()
+
+    if args.one_rate:
+        t = int(args.threads.split(",")[0])
+        if args.rec:
+            rate = measure(args.rec, t, args.batch, args.hw)
+        else:
+            with tempfile.TemporaryDirectory() as tmp:
+                rec = make_rec(tmp, args.n, args.hw)
+                rate = measure(rec, t, args.batch, args.hw)
+        print(json.dumps({"img_s": round(rate, 1)}))
+        return
 
     ncores = os.cpu_count() or 1
     with tempfile.TemporaryDirectory() as tmp:

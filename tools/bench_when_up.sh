@@ -7,7 +7,10 @@
 #   3. real-TPU attention test pass   -> /tmp/tputests_when_up.log
 # (bench.py already succeeded twice this round — docs/BENCH_r05_
 # measured_run*.json — so the tune sweep goes first now.)
-# Exits after one fully-successful window; logs to
+# Exits once tune AND bench both succeed; the test stage's rc is
+# advisory (failing tests are themselves a result — every attempt's
+# log is kept as /tmp/tputests_when_up.<ts>.log, and a failed stage
+# leaves /tmp/tputests_when_up.FAILED pointing at its log).  Logs to
 # /tmp/tunnel_probe_loop.log.
 cd "$(dirname "$0")/.." || exit 1
 LOG=/tmp/tunnel_probe_loop.log
@@ -34,8 +37,12 @@ while true; do
             tests/test_attention.py tests/test_transformer.py -q \
             > "/tmp/tputests_when_up.$TS.log" 2>&1
         rc3=$?
-        [ $rc3 -eq 0 ] && cp "/tmp/tputests_when_up.$TS.log" \
-            /tmp/tputests_when_up.log
+        if [ $rc3 -eq 0 ]; then
+            cp "/tmp/tputests_when_up.$TS.log" /tmp/tputests_when_up.log
+        else
+            echo "/tmp/tputests_when_up.$TS.log" \
+                > /tmp/tputests_when_up.FAILED
+        fi
         echo "$(date -u +%H:%M:%S) tpu-tests rc=$rc3" >> "$LOG"
         if [ $rc1 -eq 0 ] && [ $rc2 -eq 0 ]; then
             echo "$(date -u +%H:%M:%S) window complete" >> "$LOG"
