@@ -247,20 +247,29 @@ def _train_bench(dtype, batch):
     return img_s, flops_step / step_t, capture_kernel_table
 
 
-def _infer_bench(dtype, batch):
+def _infer_bench(dtype, batch, model=None, image=None):
+    """Batch-inference rate for ``model`` (zoo name; default the
+    flagship ResNet-50) at the reference table's input size.  Parity
+    table: perf.md:189-211 measures ResNet-50/152, Inception-v3,
+    VGG-16 and AlexNet at their own batch sizes — `main` runs the same
+    grid so one bench run answers the full published-inference page."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     import mxnet_tpu as mx
     from mxnet_tpu import autograd as ag
-    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.gluon.model_zoo.vision import get_model, get_resnet
     from mxnet_tpu.gluon.block import _TraceContext, _trace_scope
     from mxnet_tpu.ndarray import NDArray
     from mxnet_tpu.ops.random import next_key
 
-    net = get_resnet(1, 50, classes=1000)
+    image = image or IMAGE
+    if model is None:
+        net = get_resnet(1, 50, classes=1000)
+    else:
+        net = get_model(model, classes=1000)
     net.initialize(init=mx.initializer.Xavier())
-    net(NDArray(onp.zeros((1, 3, IMAGE, IMAGE), onp.float32)))
+    net(NDArray(onp.zeros((1, 3, image, image), onp.float32)))
     if dtype != "float32":
         net.cast(dtype)
 
@@ -286,7 +295,7 @@ def _infer_bench(dtype, batch):
                 p._data = s
 
     x = jax.random.normal(jax.random.PRNGKey(0),
-                          (batch, 3, IMAGE, IMAGE), jnp.float32)
+                          (batch, 3, image, image), jnp.float32)
     if dtype != "float32":
         x = x.astype(jnp.dtype(dtype))
 
@@ -401,12 +410,13 @@ def _pipeline_bench(path, batch=64):
             capture_output=True, text=True, timeout=600)
         for line in out.stdout.strip().splitlines()[::-1]:
             if line.startswith("{"):
-                return json.loads(line)["img_s"]
+                return json.loads(line)["img_s"], True
         raise RuntimeError(f"no JSON in output (rc={out.returncode}): "
                            f"{out.stderr[-200:]}")
     except Exception as e:
         RESULTS["pipeline_row_note"] = \
             f"clean-subprocess measure failed ({e}); in-process value"
+    _beat("pipeline row: in-process fallback")
     from mxnet_tpu.io import native
 
     it = native.ImageRecordIter(
@@ -425,11 +435,11 @@ def _pipeline_bench(path, batch=64):
             seen += b.data[0].shape[0] - b.pad
         best = max(best, seen / (time.perf_counter() - t0))
     it.close()
-    return best
+    return best, False
 
 
 def _train_bench_datafed(path, dtype, batch, window=8, windows=3,
-                         pipe_img_s=None):
+                         pipe_img_s=None, pipe_rate_is_clean=True):
     """Data-FED training rate: ImageRecordIter batches staged into
     (window, batch, ...) arrays, trained via run_steps(per_step_data=
     True) — one transfer + one launch per window.  End-to-end img/s
@@ -460,10 +470,12 @@ def _train_bench_datafed(path, dtype, batch, window=8, windows=3,
 
     if pipe_img_s:
         # keep decode time for warmup + measured windows under ~5 min.
-        # pipe_img_s is the CLEAN-process rate; decoding inside this
-        # jax-heavy process runs ~4x slower (measured 117 vs 512 img/s
-        # on the 1-core container), so budget at rate/4.
-        while (windows + 1) * window * batch / (pipe_img_s / 4) > 300 \
+        # A CLEAN-process rate overstates what decoding inside this
+        # jax-heavy process achieves (~4x slower, measured 117 vs 512
+        # img/s on the 1-core container), so budget at rate/4; an
+        # in-process fallback rate is already contended — use as-is.
+        eff = pipe_img_s / 4 if pipe_rate_is_clean else pipe_img_s
+        while (windows + 1) * window * batch / eff > 300 \
                 and batch > 32:
             batch //= 2
 
@@ -544,12 +556,9 @@ def main():
     import jax
     if DRYRUN:
         # force the CPU backend past the container's sitecustomize
-        # axon override (same dance as tests/conftest.py)
-        jax.config.update("jax_platforms", "cpu")
-        from jax._src import xla_bridge as _xb
-        if _xb.backends_are_initialized():
-            from jax.extend.backend import clear_backends
-            clear_backends()
+        # axon override (shared helper; same dance as tests/conftest)
+        from mxnet_tpu.base import force_cpu_backend
+        force_cpu_backend()
     # persistent compilation cache: repeat bench runs become disk hits
     try:
         jax.config.update("jax_compilation_cache_dir",
@@ -619,6 +628,40 @@ def main():
             RESULTS["transformer_skipped"] = str(e)
             print(f"# transformer bench skipped: {e}", flush=True)
 
+    if not os.environ.get("MXNET_TPU_BENCH_SKIP_PARITY_TABLE"):
+        # the reference's full published inference page (perf.md:
+        # 189-211): same models, same batch sizes, fp32 + low precision.
+        # Each cell is independently wedge-safe; a failure records why.
+        _grid = ([("alexnet", 8, 32)] if DRYRUN else
+                 [("resnet152_v1", 128, 224),
+                  ("inceptionv3", 128, 299),
+                  ("vgg16", 64, 224),
+                  ("alexnet", 256, 224)])
+        _anchors = {  # V100 img/s rows from perf.md:189-211
+            ("resnet152_v1", "float32"): 511.79,
+            ("inceptionv3", "float32"): 904.33,
+            ("vgg16", "float32"): 701.59,
+            ("alexnet", "float32"): 10990.46,
+            ("resnet152_v1", "bfloat16"): 1046.98,   # vs V100 fp16
+            ("inceptionv3", "bfloat16"): 1818.26,
+        }
+        for name, bs, hw in _grid:
+            for dt in ("float32", "bfloat16"):
+                _beat(f"parity table: {name} {dt} bs={bs}")
+                key = f"infer_{name}_{dt}_bs{bs}_img_s"
+                try:
+                    rate = _infer_bench(dt, bs, model=name, image=hw)
+                    RESULTS[key] = round(rate, 2)
+                    anchor = _anchors.get((name, dt))
+                    if anchor:
+                        RESULTS[key.replace("_img_s", "_vs_v100")] = \
+                            round(rate / anchor, 3)
+                except Exception as e:      # pragma: no cover
+                    RESULTS[key + "_err"] = f"{type(e).__name__}: " \
+                        f"{e}"[:160]
+                    print(f"# parity cell {key} failed: {e}",
+                          flush=True)
+
     _beat("inference done; starting feed-the-chip rows")
     import shutil
     import tempfile
@@ -628,12 +671,12 @@ def main():
     try:
         rec = _make_rec(os.path.join(tmp, "bench.rec"),
                         n=64 if DRYRUN else 512)
-        pipe_img_s = _pipeline_bench(rec)
+        pipe_img_s, pipe_clean = _pipeline_bench(rec)
         RESULTS["pipeline_img_s_vs_ref_3000"] = round(pipe_img_s, 1)
         datafed_img_s, datafed_bs = _train_bench_datafed(
             rec, "bfloat16", TRAIN_BS_BF16,
             window=2 if DRYRUN else 8, windows=1 if DRYRUN else 3,
-            pipe_img_s=pipe_img_s)
+            pipe_img_s=pipe_img_s, pipe_rate_is_clean=pipe_clean)
         RESULTS["train_bf16_datafed_img_s"] = round(datafed_img_s, 2)
         RESULTS["train_bf16_datafed_bs"] = datafed_bs
     except Exception as e:      # pragma: no cover

@@ -22,6 +22,7 @@ __all__ = [
     "getenv",
     "getenv_bool",
     "getenv_int",
+    "force_cpu_backend",
 ]
 
 
@@ -94,3 +95,22 @@ def getenv_int(name: str, default: int = 0) -> int:
         return int(v)
     except ValueError:
         return default
+
+
+def force_cpu_backend():
+    """Pin jax to the host-CPU backend, tearing down an already-
+    initialized accelerator backend if needed.
+
+    The deployment container's sitecustomize force-registers a remote
+    TPU plugin, so host-only codepaths (input-pipeline benches, CPU
+    dry-runs, virtual-mesh tests) would otherwise initialize — and on
+    a wedged tunnel hang in — the remote backend the moment any
+    NDArray is built.  One shared helper so the private-API touchpoint
+    (jax._src.xla_bridge) has a single place to track jax upgrades."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from jax._src import xla_bridge as _xb
+    if _xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+        clear_backends()

@@ -36,12 +36,14 @@ def make_rec(tmp, n, hw):
     return rec
 
 
-def measure(rec, threads, batch, hw, epochs=2):
+def measure(rec, threads, batch, hw, epochs=2, rand_crop=False,
+            prefetch_buffer=1, shuffle=True):
     from mxnet_tpu.io.native import ImageRecordIter as NativeImageRecordIter
 
     it = NativeImageRecordIter(
         path_imgrec=rec, batch_size=batch,
-        data_shape=(3, hw, hw), shuffle=True, rand_mirror=True,
+        data_shape=(3, hw, hw), shuffle=shuffle, rand_mirror=True,
+        rand_crop=rand_crop, prefetch_buffer=prefetch_buffer,
         preprocess_threads=threads)
     # warm-up epoch: thread spin-up + page cache
     for _ in it:
@@ -61,15 +63,9 @@ def _force_cpu_backend():
     """The pipeline never touches the accelerator, but NDArray wrapping
     initializes a jax backend — and the container's sitecustomize
     registers the axon TPU plugin, so with a wedged tunnel a bare run
-    hangs at device init.  Pin jax to CPU (same dance as bench.py's
-    dry-run / tests/conftest.py)."""
-    import jax
-
-    jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-    if _xb.backends_are_initialized():
-        from jax.extend.backend import clear_backends
-        clear_backends()
+    hangs at device init."""
+    from mxnet_tpu.base import force_cpu_backend
+    force_cpu_backend()
 
 
 def main():
@@ -88,13 +84,17 @@ def main():
     _force_cpu_backend()
 
     if args.one_rate:
+        # bench.py's pipeline-row config EXACTLY (rand_crop + prefetch,
+        # no shuffle) so the clean-subprocess number is comparable to
+        # the in-process fallback and to the 3,000 img/s reference row
         t = int(args.threads.split(",")[0])
+        kw = dict(rand_crop=True, prefetch_buffer=4, shuffle=False)
         if args.rec:
-            rate = measure(args.rec, t, args.batch, args.hw)
+            rate = measure(args.rec, t, args.batch, args.hw, **kw)
         else:
             with tempfile.TemporaryDirectory() as tmp:
                 rec = make_rec(tmp, args.n, args.hw)
-                rate = measure(rec, t, args.batch, args.hw)
+                rate = measure(rec, t, args.batch, args.hw, **kw)
         print(json.dumps({"img_s": round(rate, 1)}))
         return
 

@@ -39,6 +39,7 @@ while true; do
         rc3=$?
         if [ $rc3 -eq 0 ]; then
             cp "/tmp/tputests_when_up.$TS.log" /tmp/tputests_when_up.log
+            rm -f /tmp/tputests_when_up.FAILED
         else
             echo "/tmp/tputests_when_up.$TS.log" \
                 > /tmp/tputests_when_up.FAILED

@@ -198,13 +198,10 @@ def main(argv=None):
     import jax
     if bench.DRYRUN:
         # force the CPU backend past the container's sitecustomize axon
-        # override (same dance as bench.main / tests/conftest.py) so
-        # the sweep program validates end to end without a TPU
-        jax.config.update("jax_platforms", "cpu")
-        from jax._src import xla_bridge as _xb
-        if _xb.backends_are_initialized():
-            from jax.extend.backend import clear_backends
-            clear_backends()
+        # override (shared helper) so the sweep program validates end
+        # to end without a TPU
+        from mxnet_tpu.base import force_cpu_backend
+        force_cpu_backend()
     try:
         jax.config.update("jax_compilation_cache_dir",
                           "/tmp/mxnet_tpu_jax_cache")
