@@ -169,17 +169,26 @@ def _timed(run, n):
     return time.perf_counter() - t0
 
 
-def _train_bench(dtype, batch):
+def _train_bench(dtype, batch, model=None, image=None,
+                 flops_per_img=None):
+    """Training rate for ``model`` (zoo name; default the flagship
+    ResNet-50).  ``flops_per_img``: analytic train FLOPs for the MFU
+    numerator (None -> no TFLOP/s figure for that model)."""
     import jax.numpy as jnp
     import mxnet_tpu as mx
-    from mxnet_tpu.gluon.model_zoo.vision import get_resnet
+    from mxnet_tpu.gluon.model_zoo.vision import get_model, get_resnet
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.parallel import make_mesh, SPMDTrainer
     from mxnet_tpu.ndarray import NDArray
 
-    net = get_resnet(1, 50, classes=1000)
+    image = image or IMAGE
+    if model is None:
+        net = get_resnet(1, 50, classes=1000)
+        flops_per_img = _RESNET50_TRAIN_FLOPS_PER_IMG
+    else:
+        net = get_model(model, classes=1000)
     net.initialize(init=mx.initializer.Xavier())
-    net(NDArray(onp.zeros((1, 3, IMAGE, IMAGE), onp.float32)))
+    net(NDArray(onp.zeros((1, 3, image, image), onp.float32)))
 
     trainer = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
                           optimizer="sgd",
@@ -192,7 +201,7 @@ def _train_bench(dtype, batch):
     import jax
     k1, k2 = jax.random.split(jax.random.PRNGKey(0))
     data = NDArray(jax.random.normal(
-        k1, (batch, 3, IMAGE, IMAGE), jnp.float32))
+        k1, (batch, 3, image, image), jnp.float32))
     label = NDArray(jax.random.randint(
         k2, (batch,), 0, 1000).astype(jnp.float32))
 
@@ -203,13 +212,9 @@ def _train_bench(dtype, batch):
     step_t = _marginal(run)
     img_s = batch / step_t
     # MFU accounting uses ANALYTIC model FLOPs (the standard MFU
-    # definition): ResNet-50/224 forward ~4.089 GFLOP/img, training
-    # ~3x forward.  XLA cost_analysis is the wrong numerator twice
-    # over: it counts a lax.scan (while) body ONCE regardless of trip
-    # count (verified empirically — dividing by the window length
-    # undercounts 4x), and TPU executables report tile-padded hardware
-    # FLOPs (overcounts vs model FLOPs).
-    flops_step = _RESNET50_TRAIN_FLOPS_PER_IMG * batch
+    # definition; see module docstring for why XLA cost_analysis is
+    # the wrong numerator)
+    flops_s = (flops_per_img * batch / step_t) if flops_per_img else None
 
     def capture_kernel_table():
         """Optional extra: one short profiled window parsed into the
@@ -244,7 +249,7 @@ def _train_bench(dtype, batch):
             RESULTS[f"top_kernels_{dt_name}_err"] = \
                 f"{type(e).__name__}: {e}"[:160]
 
-    return img_s, flops_step / step_t, capture_kernel_table
+    return img_s, flops_s, capture_kernel_table
 
 
 def _infer_bench(dtype, batch, model=None, image=None):
@@ -629,6 +634,26 @@ def main():
             print(f"# transformer bench skipped: {e}", flush=True)
 
     if not os.environ.get("MXNET_TPU_BENCH_SKIP_PARITY_TABLE"):
+        # the reference's published TRAINING rows beyond ResNet-50
+        # (perf.md:252-254): Inception-v3 bs128 (253.68 img/s V100)
+        # and AlexNet bs512 (2585.61 img/s V100), fp32 like the page.
+        _train_grid = ([("alexnet", 4, 32, 2585.61)] if DRYRUN else
+                       [("inceptionv3", 128, 299, 253.68),
+                        ("alexnet", 512, 224, 2585.61)])
+        for name, bs, hw, anchor in _train_grid:
+            _beat(f"train parity: {name} fp32 bs={bs}")
+            key = f"train_{name}_fp32_bs{bs}_img_s"
+            try:
+                rate, _, _ = _train_bench(None, bs, model=name,
+                                          image=hw)
+                RESULTS[key] = round(rate, 2)
+                RESULTS[key.replace("_img_s", "_vs_v100")] = \
+                    round(rate / anchor, 3)
+            except Exception as e:      # pragma: no cover
+                RESULTS[key + "_err"] = \
+                    f"{type(e).__name__}: {e}"[:160]
+                print(f"# train parity {key} failed: {e}", flush=True)
+
         # the reference's full published inference page (perf.md:
         # 189-211): same models, same batch sizes, fp32 + low precision.
         # Each cell is independently wedge-safe; a failure records why.

@@ -37,7 +37,7 @@ def make_rec(tmp, n, hw):
 
 
 def measure(rec, threads, batch, hw, epochs=2, rand_crop=False,
-            prefetch_buffer=1, shuffle=True):
+            prefetch_buffer=4, shuffle=True):
     from mxnet_tpu.io.native import ImageRecordIter as NativeImageRecordIter
 
     it = NativeImageRecordIter(
@@ -59,15 +59,6 @@ def measure(rec, threads, batch, hw, epochs=2, rand_crop=False,
     return seen / dt
 
 
-def _force_cpu_backend():
-    """The pipeline never touches the accelerator, but NDArray wrapping
-    initializes a jax backend — and the container's sitecustomize
-    registers the axon TPU plugin, so with a wedged tunnel a bare run
-    hangs at device init."""
-    from mxnet_tpu.base import force_cpu_backend
-    force_cpu_backend()
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--n", type=int, default=512)
@@ -81,7 +72,10 @@ def main():
     ap.add_argument("--rec", default=None,
                     help="existing .rec file to read (skips the encode)")
     args = ap.parse_args()
-    _force_cpu_backend()
+    # the pipeline never touches the accelerator; pin jax to CPU so a
+    # wedged remote-TPU tunnel cannot hang NDArray construction
+    from mxnet_tpu.base import force_cpu_backend
+    force_cpu_backend()
 
     if args.one_rate:
         # bench.py's pipeline-row config EXACTLY (rand_crop + prefetch,

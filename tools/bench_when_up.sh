@@ -34,8 +34,9 @@ while true; do
             /tmp/bench_when_up.json
         echo "$(date -u +%H:%M:%S) bench rc=$rc2" >> "$LOG"
         MXNET_TEST_ON_TPU=1 timeout 1800 python -m pytest \
-            tests/test_attention.py tests/test_transformer.py -q \
-            > "/tmp/tputests_when_up.$TS.log" 2>&1
+            tests/test_attention.py tests/test_transformer.py \
+            tests/test_quantization.py tests/test_frontend_misc.py \
+            -q > "/tmp/tputests_when_up.$TS.log" 2>&1
         rc3=$?
         if [ $rc3 -eq 0 ]; then
             cp "/tmp/tputests_when_up.$TS.log" /tmp/tputests_when_up.log

@@ -109,16 +109,16 @@ def _train_step_rate(bs, donate=True):
         bench._materialize(trainer.run_steps(data, label, n)._data)
 
     step_t = bench._marginal(run)
+    # analytic model FLOPs (bench.py's corrected MFU convention — XLA
+    # cost_analysis counts a scan body once and misses pallas calls)
     mfu = None
     try:
-        ca = trainer.cost_analysis(data, label, n_steps=bench.N1)
-        if ca.get("flops"):
-            import jax
-            dev = jax.devices()[0]
-            peak = bench._peak_flops(getattr(dev, "device_kind",
-                                             str(dev)))
-            if peak:
-                mfu = (ca["flops"] / bench.N1) / step_t / peak
+        import jax
+        dev = jax.devices()[0]
+        peak = bench._peak_flops(getattr(dev, "device_kind", str(dev)))
+        if peak:
+            mfu = (bench._RESNET50_TRAIN_FLOPS_PER_IMG * bs
+                   / step_t / peak)
     except Exception:
         pass
     return bs / step_t, mfu
