@@ -75,6 +75,21 @@ if DRYRUN:
 # figure (dry-run numbers are tagged meaningless anyway).
 _RESNET50_TRAIN_FLOPS_PER_IMG = 3 * 4.089e9 * (IMAGE / 224) ** 2
 
+# Parity grids: the reference's published perf page beyond ResNet-50
+# (model zoo name, batch, input px, V100 anchor img/s or None).
+# Single source of truth — tests/test_bench_parity_grid.py constructs
+# every model here so a zoo rename fails on CPU, not mid-tunnel-window.
+TRAIN_PARITY_GRID = [
+    ("inceptionv3", 128, 299, 253.68),     # perf.md:254
+    ("alexnet", 512, 224, 2585.61),        # perf.md:252
+]
+INFER_PARITY_GRID = [
+    ("resnet152_v1", 128, 224),            # perf.md:196/210
+    ("inceptionv3", 128, 299),             # perf.md:196/210
+    ("vgg16", 64, 224),                    # perf.md:195
+    ("alexnet", 256, 224),                 # perf.md:197
+]
+
 # peak bf16 FLOP/s per chip, by device_kind substring (public specs)
 _PEAKS = [
     ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
@@ -637,9 +652,8 @@ def main():
         # the reference's published TRAINING rows beyond ResNet-50
         # (perf.md:252-254): Inception-v3 bs128 (253.68 img/s V100)
         # and AlexNet bs512 (2585.61 img/s V100), fp32 like the page.
-        _train_grid = ([("alexnet", 4, 32, 2585.61)] if DRYRUN else
-                       [("inceptionv3", 128, 299, 253.68),
-                        ("alexnet", 512, 224, 2585.61)])
+        _train_grid = ([("alexnet", 4, 32, 2585.61)] if DRYRUN
+                       else TRAIN_PARITY_GRID)
         for name, bs, hw, anchor in _train_grid:
             _beat(f"train parity: {name} fp32 bs={bs}")
             key = f"train_{name}_fp32_bs{bs}_img_s"
@@ -657,11 +671,8 @@ def main():
         # the reference's full published inference page (perf.md:
         # 189-211): same models, same batch sizes, fp32 + low precision.
         # Each cell is independently wedge-safe; a failure records why.
-        _grid = ([("alexnet", 8, 32)] if DRYRUN else
-                 [("resnet152_v1", 128, 224),
-                  ("inceptionv3", 128, 299),
-                  ("vgg16", 64, 224),
-                  ("alexnet", 256, 224)])
+        _grid = ([("alexnet", 8, 32)] if DRYRUN
+                 else INFER_PARITY_GRID)
         _anchors = {  # V100 img/s rows from perf.md:189-211
             ("resnet152_v1", "float32"): 511.79,
             ("inceptionv3", "float32"): 904.33,
