@@ -163,6 +163,17 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
     events).  The reference analogue is the aggregate table the
     profiler builds from per-op device exec stats
     (src/profiler/aggregate_stats.cc).
+
+    Reading the numbers: totals are summed across ALL device queues,
+    and TPU DMA engines run CONCURRENTLY with compute — a large
+    ``async-copy`` total does not mean the copies sat on the critical
+    path, and queue totals can legitimately exceed wall-clock.  An
+    outer ``while`` (lax.scan) event's duration INCLUDES its body, so
+    compare an op's total against the enclosing while/jit event to
+    judge whether it matters.  (Measured round-5 example: a 2-step
+    profiled ResNet window showed async-copy 987ms vs while 212ms —
+    the while time matched the marginal step rate, i.e. the copies
+    overlapped and the table's #1 row was NOT the bottleneck.)
     """
     path = trace_dir_or_file
     if os.path.isdir(path):
