@@ -3,10 +3,15 @@
 TPU-first design: the hot path is a Pallas flash-attention kernel
 (online-softmax over K/V blocks, f32 accumulators in VMEM scratch,
 grid = (batch*heads, q_blocks, k_blocks) with the k dimension innermost
-so scratch persists across it).  Backward recomputes per-q-block from
-the saved logsumexp (the standard flash backward), expressed as a
-`lax.scan` so memory stays O(seq * block) — XLA tiles the matmuls onto
-the MXU.
+so scratch persists across it).  Backward is the standard flash
+split as two Pallas kernels — dk/dv (q innermost) and dq (k
+innermost), recomputing scores per block pair from the saved
+logsumexp so the (block, block) probability tiles never leave VMEM;
+an XLA `lax.scan` backward is kept as the A/B oracle
+(`MXNET_TPU_FLASH_BWD=scan`).  Per-row vectors (lse/delta) cross the
+pallas boundary lane-broadcast (see `_LSE_LANES`) to satisfy the TPU
+(8, 128) block-tiling rule — statically guarded on CPU by
+tests/test_pallas_tiling_guard.py.
 
 Parity targets (API, not implementation):
 - `_contrib_interleaved_matmul_selfatt_qk/valatt`,
