@@ -53,5 +53,5 @@ while true; do
     else
         echo "$(date -u +%H:%M:%S) probe failed/hung" >> "$LOG"
     fi
-    sleep 900
+    sleep 600
 done
