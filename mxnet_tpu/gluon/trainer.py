@@ -198,8 +198,23 @@ class Trainer:
         if new_rescale != self._optimizer.rescale_grad:
             self._optimizer.rescale_grad = new_rescale
             self._reship_server_optimizer()
-        self._allreduce_grads()
+        if not self._fold_device_allreduce():
+            self._allreduce_grads()
         self._update(ignore_stale_grad)
+
+    def _fold_device_allreduce(self):
+        """True when the gradient 'reduction' can fold into the fused
+        update: a single-process 'device'/'local' store reduces each key
+        over ONE pushed value — an identity copy through the store.
+        Skipping it, the (fused or fallback) update reads param.grad()
+        directly, which holds the very same values.  Compression and
+        server-side updates keep the store round-trip."""
+        if self._kvstore is None or self._update_on_kvstore or \
+                self._compression_params:
+            return False
+        from ..kvstore.kvstore import KVStore
+        from ..optimizer import fused_step
+        return type(self._kvstore) is KVStore and fused_step.enabled()
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -249,7 +264,12 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
             self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
+        new_rescale = self._scale / batch_size
+        if new_rescale != self._optimizer.rescale_grad:
+            self._optimizer.rescale_grad = new_rescale
+            # same reship as step(): an uncoordinated-async PS would
+            # otherwise keep updating with the stale rescale_grad
+            self._reship_server_optimizer()
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
@@ -265,6 +285,14 @@ class Trainer:
                     continue
                 raise MXNetError(f"parameter {param.name} has no gradient")
             live.append((i, param))
+        # whole-set fused path: ONE XLA dispatch updates every live
+        # param (optimizer/fused_step.py); dist stores already left the
+        # batched-allreduce output in param.grad(), device/None stores
+        # skip the identity reduce entirely (_fold_device_allreduce)
+        from ..optimizer import fused_step
+        if fused_step.step(updater,
+                           [(i, p._data_nd(), p.grad()) for i, p in live]):
+            return
         agg = getattr(self._optimizer, "aggregate_num", 0)
         if agg and agg > 1:
             # fused multi-tensor updates, `aggregate_num` params per

@@ -66,6 +66,7 @@ class KVStore(KVStoreBase):
         keys = key if isinstance(key, (list, tuple)) else [key]
         if len(keys) == 1:
             value = [value]
+        batch = []
         for k, v in zip(keys, value):
             if isinstance(v, (list, tuple)):
                 if all(self._is_rsp(x) for x in v):
@@ -83,9 +84,27 @@ class KVStore(KVStoreBase):
                 if k not in self._data:
                     self._data[k] = reduced.copy()
                 else:
-                    self._updater(_key_int(k), reduced, self._data[k])
+                    batch.append((k, reduced))
             else:
                 self._data[k] = reduced
+        if batch:
+            self._apply_updates(batch)
+
+    def _apply_updates(self, batch):
+        """Store-side optimizer application for one push call: the whole
+        key batch rides the fused whole-set step when eligible
+        (optimizer/fused_step.py — ONE dispatch for a multi-key push),
+        else the per-key updater.  Single-key pushes stay per-key so
+        per-parameter call patterns don't fill the fused signature
+        cache."""
+        if len(batch) > 1:
+            from ..optimizer import fused_step
+            if fused_step.step(
+                    self._updater,
+                    [(_key_int(k), self._data[k], r) for k, r in batch]):
+                return
+        for k, r in batch:
+            self._updater(_key_int(k), r, self._data[k])
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys = key if isinstance(key, (list, tuple)) else [key]

@@ -224,7 +224,27 @@ def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
 # autograd._get_jitted_bwd).
 # --------------------------------------------------------------------------
 
-_MAX_JIT_SIGS = 8       # distinct shape-signatures before giving up on jit
+def _read_max_jit_sigs(default: int = 8) -> int:
+    """MXNET_JIT_MAX_SIGS: distinct shape signatures a cached jit entry
+    may compile before latching off to eager execution.  Shared by the
+    eager-dispatch funnel below and the fused optimizer step
+    (optimizer/fused_step.py) so the two retrace guards can't drift."""
+    from ..base import getenv_int
+    return max(1, getenv_int("MXNET_JIT_MAX_SIGS", default))
+
+
+# distinct shape-signatures before giving up on jit (env-overridable)
+_MAX_JIT_SIGS = _read_max_jit_sigs()
+
+# cache-health counters surfaced by profiler.counters(): hits = replays
+# of an already-compiled signature, misses = fresh-signature compiles,
+# latches = entries demoted to eager (trace failure or signature churn)
+_JIT_STATS = {"hits": 0, "misses": 0, "latches": 0}
+
+
+def jit_cache_stats() -> Dict[str, int]:
+    """Snapshot of the eager jit-cache counters (see profiler.counters)."""
+    return dict(_JIT_STATS)
 
 
 class _JitEntry:
@@ -249,15 +269,20 @@ class _JitEntry:
             fresh = sig not in self.sigs
             if fresh and len(self.sigs) >= _MAX_JIT_SIGS:
                 self.disabled = True
+                _JIT_STATS["latches"] += 1
                 return fn(*arrays)
             try:
                 out = self.jfn(*arrays)
             except Exception:
                 out = fn(*arrays)       # raises through on input errors
                 self.disabled = True    # jit-specific failure, eager works
+                _JIT_STATS["latches"] += 1
                 return out
             if fresh:                   # only successful sigs burn budget
                 self.sigs.add(sig)
+                _JIT_STATS["misses"] += 1
+            else:
+                _JIT_STATS["hits"] += 1
             return out
         return fn(*arrays)
 

@@ -5,8 +5,10 @@ from .optimizer import (Optimizer, Updater, create, register, get_updater,
                         SGD, NAG, Adam, AdamW, AdaGrad, AdaDelta, Adamax,
                         Nadam, RMSProp, FTML, FTRL, LAMB, LANS, LARS, Signum,
                         SGLD, DCASGD, Test)
+from . import fused_step
 
 __all__ = ["Optimizer", "Updater", "create", "register", "get_updater",
+           "fused_step",
            "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta", "Adamax",
            "Nadam", "RMSProp", "FTML", "FTRL", "Ftrl", "LAMB", "LANS", "LARS", "Signum",
            "SGLD", "DCASGD", "Test"]

@@ -1,0 +1,224 @@
+"""Fused whole-parameter-set optimizer step.
+
+One jitted pytree update per (optimizer class, static-hyperparam
+signature, param-tree shape/dtype signature) applies the update rule to
+EVERY live parameter in a single XLA executable — collapsing the eager
+Trainer's per-step dispatch count from O(n_params) to O(1).  Weights and
+optimizer state are donated (``donate_argnums``) so the step is
+in-place on accelerators; gradients are NOT donated (users inspect them
+after ``step()``).  ``lr``/``wd``/``rescale_grad`` travel as traced f32
+scalars — per-parameter, as vectors indexed inside the trace — so lr
+schedules, ``lr_mult``/``wd_mult`` multipliers and rescale changes never
+retrace.  ``clip_gradient`` stays static (the ops branch on it in
+Python, ops/optimizer_ops.py:_apply_wd_rescale).
+
+Numerics are bitwise-identical to the per-parameter path: the same op
+functions run under the same ``_lowp_guard`` per parameter, and a traced
+f32 scalar multiplies exactly like the Python float the per-param path
+bakes in.
+
+Retrace guard: each family keeps the registry's ``_JitEntry`` latch
+discipline — after ``_MAX_JIT_SIGS`` distinct shape signatures (env
+``MXNET_JIT_MAX_SIGS``) or a trace failure the family latches off and
+callers fall back to the per-param/aggregate path.  ``MXNET_FUSED_STEP=0``
+disables fusion entirely.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import profiler
+from ..ops import registry as _reg
+from .optimizer import Updater, _lowp_guard, _note_dispatch
+
+__all__ = ["step", "enabled", "stats", "reset_stats", "reset_cache"]
+
+# jit-cache counters (surfaced by profiler.counters()).
+# compiles/hits count fused executions by cache outcome; fallbacks count
+# step() calls that declined (ineligible, latched, or trace failure);
+# steps counts successful fused applications.
+_STATS = {"compiles": 0, "hits": 0, "fallbacks": 0, "steps": 0}
+
+
+def stats() -> Dict[str, int]:
+    """Snapshot of the fused-step cache counters."""
+    return dict(_STATS)
+
+
+def reset_stats() -> None:
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def enabled() -> bool:
+    """MXNET_FUSED_STEP: set to 0/false/off to disable fusion (read per
+    step so tests and long-lived processes can toggle it)."""
+    return os.environ.get("MXNET_FUSED_STEP", "1").lower() \
+        not in ("0", "false", "off")
+
+
+class _FusedEntry:
+    """Per-family jit cache with the registry _JitEntry latch: after
+    _MAX_JIT_SIGS distinct param-tree signatures (or a trace failure)
+    the family latches off and every later call falls back."""
+
+    __slots__ = ("jfns", "disabled")
+
+    def __init__(self):
+        self.jfns: Dict[Any, Any] = {}
+        self.disabled = False
+
+
+_ENTRIES: Dict[Any, _FusedEntry] = {}
+
+
+def reset_cache() -> None:
+    """Drop all fused executables and latches (test helper)."""
+    _ENTRIES.clear()
+
+
+def _build(op_name: str, statics_key: Tuple, dyn_names: Tuple[str, ...]):
+    """One executable for the whole parameter set.  Donates weights
+    (arg 1) and states (arg 3); grads (arg 2) and the dynamic scalar
+    vectors (arg 0) are left alone."""
+    base_fn = _lowp_guard(_reg.get(op_name).fn)
+    statics = dict(statics_key)
+
+    def fused(dyn, weights, grads, states):
+        new_w, new_s = [], []
+        for i in range(len(weights)):
+            kw = dict(statics)
+            for j, nm in enumerate(dyn_names):
+                kw[nm] = dyn[j][i]
+            out = base_fn(weights[i], grads[i], *states[i], **kw)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            new_w.append(outs[0])
+            new_s.append(tuple(outs[1:]))
+        return tuple(new_w), tuple(new_s)
+
+    return jax.jit(fused, donate_argnums=(1, 3))
+
+
+def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
+    """Apply one fused optimizer step to ``items`` = [(index, weight,
+    grad)] through ``updater`` (an optimizer.Updater).  Returns True when
+    the fused path ran (weights/states rebound, update counts bumped);
+    False means nothing happened and the caller must take its existing
+    per-param / aggregate path.
+
+    No side effects before eligibility AND cache resolution succeed,
+    except lazily creating missing optimizer states — identical to what
+    the fallback's first touch would create.
+    """
+    if not items or not enabled() or type(updater) is not Updater:
+        if items:
+            _STATS["fallbacks"] += 1
+        return False
+    opt = updater.optimizer
+    if opt.op_name is None:
+        _STATS["fallbacks"] += 1
+        return False
+    from ..ndarray.sparse import RowSparseNDArray
+    import numpy as onp
+    indices = [it[0] for it in items]
+    weights = [it[1] for it in items]
+    grads = [it[2] for it in items]
+    if any(isinstance(g, RowSparseNDArray) for g in grads) or \
+            any(isinstance(w, RowSparseNDArray) for w in weights):
+        _STATS["fallbacks"] += 1
+        return False
+    if opt.multi_precision and any(w.dtype == onp.float16 for w in weights):
+        # fp16 master-weight discipline lives in update_multi_precision
+        _STATS["fallbacks"] += 1
+        return False
+    statics = opt._fused_statics(indices[0])
+    if statics is None:
+        _STATS["fallbacks"] += 1
+        return False
+    for i in indices[1:]:
+        if opt._fused_statics(i) != statics:
+            _STATS["fallbacks"] += 1
+            return False
+    statics_key = tuple(sorted(statics.items()))
+    # keys only — values are collected post-bump, below
+    dyn_names = tuple(sorted(opt._fused_dynamics(indices[0]).keys()))
+    family = (type(opt).__name__, opt.op_name, statics_key, dyn_names)
+
+    entry = _ENTRIES.setdefault(family, _FusedEntry())
+    if entry.disabled:
+        _STATS["fallbacks"] += 1
+        return False
+
+    # state creation mirrors Updater.__call__ / Updater.update_multi
+    for i, w in zip(indices, weights):
+        if i not in updater.states:
+            updater.states[i] = opt.create_state_multi_precision(i, w)
+            updater.states_synced[i] = True
+    states = [updater.states[i] for i in indices]
+
+    # donation safety: XLA rejects donating one buffer twice — DCASGD's
+    # state wraps the weight's own buffer, and tied/shared parameters
+    # can repeat a leaf.  Any repeated buffer falls back.
+    seen = set()
+    for w, g, sts in zip(weights, grads, states):
+        for a in (w._data, g._data, *(s._data for s in sts)):
+            if id(a) in seen:
+                _STATS["fallbacks"] += 1
+                return False
+            seen.add(id(a))
+
+    sig = tuple((tuple(w.shape), str(w._data.dtype), str(g._data.dtype),
+                 tuple((tuple(s.shape), str(s._data.dtype)) for s in sts))
+                for w, g, sts in zip(weights, grads, states))
+    jfn = entry.jfns.get(sig)
+    if jfn is None:
+        if len(entry.jfns) >= _reg._MAX_JIT_SIGS:
+            entry.disabled = True
+            _STATS["fallbacks"] += 1
+            return False
+        try:
+            jfn = _build(opt.op_name, statics_key, dyn_names)
+            entry.jfns[sig] = jfn
+        except Exception:
+            entry.disabled = True
+            _STATS["fallbacks"] += 1
+            return False
+        _STATS["compiles"] += 1
+    else:
+        _STATS["hits"] += 1
+
+    # side effects: bump counts first so _fused_dynamics sees this
+    # step's t (Adam's bias-correction fold) and lr schedules see the
+    # same num_update as the aggregate path
+    for i in indices:
+        opt._update_count(i)
+    dyns = [opt._fused_dynamics(i) for i in indices]
+    dyn = tuple(jnp.asarray([d[nm] for d in dyns], jnp.float32)
+                for nm in dyn_names)
+
+    t0 = profiler.op_timer()
+    try:
+        out_w, out_s = jfn(
+            dyn,
+            tuple(w._data for w in weights),
+            tuple(g._data for g in grads),
+            tuple(tuple(s._data for s in sts) for sts in states))
+    except Exception:
+        # donation means a failed execution may have consumed buffers on
+        # some backends; latch off, but surface the error — the step is
+        # half-applied and silent fallback would double-count updates
+        entry.disabled = True
+        raise
+    _note_dispatch()
+    profiler.op_record(f"FusedStep::{type(opt).__name__}", t0)
+    for w, nw in zip(weights, out_w):
+        w._rebind(nw)
+    for sts, ns in zip(states, out_s):
+        for s, n in zip(sts, ns):
+            s._rebind(n)
+    _STATS["steps"] += 1
+    return True

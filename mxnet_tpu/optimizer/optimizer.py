@@ -121,6 +121,23 @@ def _jitted_multi_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...
     return jax.jit(step)
 
 
+# executable-dispatch counter: one tick per optimizer-update XLA call
+# (per-param jit, aggregated multi-tensor call, or fused whole-set step).
+# The observable behind the O(n_params) -> O(1) dispatch claim — surfaced
+# by profiler.counters() and benchmark/fused_step_bench.py.
+_DISPATCHES = 0
+
+
+def _note_dispatch(n: int = 1) -> None:
+    global _DISPATCHES
+    _DISPATCHES += n
+
+
+def dispatch_count() -> int:
+    """Total optimizer-update executable dispatches this process."""
+    return _DISPATCHES
+
+
 class Optimizer:
     """Base optimizer (parity: optimizer.py Optimizer).
 
@@ -218,6 +235,36 @@ class Optimizer:
         """Per-op static attrs (everything but lr/wd/arrays)."""
         return {}
 
+    # -- fused whole-set step hooks (optimizer/fused_step.py) --------------
+    def _fused_statics(self, index) -> Optional[Dict[str, Any]]:
+        """Trace-baked hyperparams for the fused whole-parameter-set
+        step, or None when this optimizer can't ride it: a custom
+        ``update`` (impure or scalar-path-divergent), or statics that
+        vary with the step count (``t``/``m_schedule``) and would
+        force a retrace every step.  Must be free of update-count side
+        effects.  ``rescale_grad``/``lr``/``wd`` are deliberately NOT
+        here — they travel as traced scalars (see _fused_dynamics)."""
+        if type(self).update is not Optimizer.update:
+            return None
+        statics = dict(self.static_params(index))
+        if "t" in statics or "m_schedule" in statics:
+            return None
+        statics["clip_gradient"] = (
+            float(self.clip_gradient) if self.clip_gradient is not None
+            else -1.0)
+        return statics
+
+    def _fused_dynamics(self, index) -> Dict[str, float]:
+        """Schedule-dependent scalars for the fused step, passed as
+        traced values so lr schedules and rescale changes never
+        retrace.  Called AFTER this step's update-count bump, so
+        ``self._index_update_count[index]`` is this step's t."""
+        d = {"wd": self._get_wd(index),
+             "rescale_grad": float(self.rescale_grad)}
+        if self.uses_lr:
+            d["lr"] = self._get_lr(index)
+        return d
+
     def update(self, index, weight, grad, state):
         """Apply one update (parity: Optimizer.update).  Mutates weight and
         state NDArrays by rebinding their buffers."""
@@ -240,6 +287,7 @@ class Optimizer:
         else:
             fn = _jitted_update_nolr(self.op_name, key, len(arrays))
             out = fn(jnp.float32(wd), *arrays)
+        _note_dispatch()
         outs = out if isinstance(out, (tuple, list)) else (out,)
         weight._rebind(outs[0])
         for s, new in zip(state, outs[1:]):
@@ -351,6 +399,7 @@ class Optimizer:
                                   self.uses_lr)
         out = fn(jnp.float32(lr), jnp.float32(wd), *flat) if self.uses_lr \
             else fn(jnp.float32(wd), *flat)
+        _note_dispatch()
         per = 1 + n_state
         for gi, (w, s) in enumerate(zip(weights, states)):
             w._rebind(out[gi * per])
@@ -412,6 +461,25 @@ class Adam(Optimizer):
         return {"beta1": self.beta1, "beta2": self.beta2,
                 "epsilon": self.epsilon}
 
+    def _fused_statics(self, index):
+        # update() below is a pure scalar-path override (bias correction
+        # folded into lr) — fusable despite not being Optimizer.update
+        statics = dict(self.static_params(index))
+        statics["clip_gradient"] = (
+            float(self.clip_gradient) if self.clip_gradient is not None
+            else -1.0)
+        return statics
+
+    def _fused_dynamics(self, index):
+        # same fold, same float-op order as update(): called post-bump,
+        # so this step's t IS the current count
+        t = self._index_update_count.get(index, 1)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = self._get_lr(index) * (coef2 ** 0.5) / coef1
+        return {"lr": lr, "wd": self._get_wd(index),
+                "rescale_grad": float(self.rescale_grad)}
+
     def update(self, index, weight, grad, state):
         from ..ndarray.sparse import RowSparseNDArray
         if isinstance(grad, RowSparseNDArray):
@@ -433,6 +501,7 @@ class Adam(Optimizer):
         arrays = [weight._data, grad._data] + [s._data for s in state]
         fn = _jitted_update(self.op_name, key, len(arrays))
         out = fn(jnp.float32(lr), jnp.float32(wd), *arrays)
+        _note_dispatch()
         weight._rebind(out[0])
         for s, new in zip(state, out[1:]):
             s._rebind(new)
@@ -584,6 +653,7 @@ class FTML(Optimizer):
         arrays = [weight._data, grad._data] + [s._data for s in state]
         fn = _jitted_update(self.op_name, key, len(arrays))
         out = fn(jnp.float32(lr), jnp.float32(wd), *arrays)
+        _note_dispatch()
         weight._rebind(out[0])
         for s, new in zip(state, out[1:]):
             s._rebind(new)
@@ -676,6 +746,7 @@ class SGLD(Optimizer):
                  rescale_grad=self.rescale_grad,
                  clip_gradient=self.clip_gradient
                  if self.clip_gradient is not None else -1.0)
+        _note_dispatch()
         weight._rebind(out)
 
 

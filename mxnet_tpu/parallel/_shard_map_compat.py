@@ -1,0 +1,20 @@
+"""``jax.shard_map`` across jax versions.
+
+Newer jax exports :func:`jax.shard_map` with a ``check_vma`` kwarg; older
+releases only ship ``jax.experimental.shard_map.shard_map`` whose
+equivalent kwarg is ``check_rep``.  Every shard_map user in this package
+imports from here so the version probe lives in one place.
+"""
+from __future__ import annotations
+
+try:                                     # jax >= 0.6
+    from jax import shard_map            # type: ignore[attr-defined]
+except ImportError:                      # jax 0.4/0.5
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma,
+                              **kw)
+
+__all__ = ["shard_map"]

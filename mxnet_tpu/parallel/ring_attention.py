@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
-from jax import shard_map
+from ._shard_map_compat import shard_map
 
 from ..ops.attention import online_block_update, _NEG_INF
 

@@ -15,7 +15,7 @@ from typing import Dict, Optional
 import jax
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
-           "Task", "Frame", "Event", "Counter", "Marker", "scope",
+           "Task", "Frame", "Event", "Counter", "Marker", "scope", "counters",
            "device_memory_info", "device_memory_summary"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
@@ -56,6 +56,26 @@ def op_timer():
 def op_record(name: str, t0) -> None:
     if t0 is not None:
         record_op(name, time.perf_counter() - t0)
+
+
+def counters() -> Dict[str, Dict[str, int]]:
+    """Process-wide dispatch/jit-cache counter snapshot:
+
+    - ``eager_jit``: the op funnel's per-signature jit cache
+      (hits/misses/latches, ops/registry.py)
+    - ``fused_step``: the fused whole-parameter-set optimizer step
+      (compiles/hits/fallbacks/steps, optimizer/fused_step.py)
+    - ``optimizer``: total optimizer-update executable dispatches
+
+    Always live (unlike the aggregate table this needs no start()) —
+    the observable behind the O(n_params) -> O(1) dispatch claim.
+    """
+    from .ops import registry as _registry
+    from .optimizer import optimizer as _optimizer
+    from .optimizer import fused_step as _fused_step
+    return {"eager_jit": _registry.jit_cache_stats(),
+            "fused_step": _fused_step.stats(),
+            "optimizer": {"dispatches": _optimizer.dispatch_count()}}
 
 
 def set_config(**kwargs):

@@ -1,0 +1,408 @@
+"""Fused whole-parameter-set optimizer step (optimizer/fused_step.py).
+
+Covers the Trainer routing (one XLA dispatch per step), bitwise
+equivalence against the per-param and aggregate_num paths, the retrace
+latch, the MXNET_FUSED_STEP / MXNET_JIT_MAX_SIGS knobs, the
+profiler.counters() snapshot, the kvstore server-side batch, and the
+Trainer.update() rescale-reship fix.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, profiler
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.optimizer import fused_step
+from mxnet_tpu.optimizer import optimizer as opt_mod
+from mxnet_tpu.ops import registry
+
+
+def _make_net(n_layers=4, units=4, seed=0):
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.Sequential()
+    for _ in range(n_layers):
+        net.add(nn.Dense(units, in_units=units))
+    net.initialize()
+    return net
+
+
+def _train(opt_name, opt_args, nsteps=4, env=None, kvstore="device",
+           n_layers=4, monkeypatch=None, batch_sizes=None):
+    """Run nsteps of Trainer.step; returns (weights, states) numpy."""
+    if env:
+        assert monkeypatch is not None
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+    net = _make_net(n_layers=n_layers)
+    trainer = Trainer(net.collect_params(), opt_name, dict(opt_args),
+                      kvstore=kvstore)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    sizes = batch_sizes or [8] * nsteps
+    for bs in sizes:
+        with autograd.record():
+            y = net(x)
+            loss = (y * y).sum()
+        loss.backward()
+        trainer.step(batch_size=bs)
+    weights = [p._data_nd().asnumpy() for p in net.collect_params().values()]
+    states = {k: tuple(s.asnumpy() for s in v)
+              for k, v in trainer._updaters[0].states.items()}
+    if env:
+        for k in env:
+            monkeypatch.delenv(k)
+    return weights, states
+
+
+def _assert_bitwise(a, b):
+    ws_a, st_a = a
+    ws_b, st_b = b
+    assert len(ws_a) == len(ws_b)
+    for x, y in zip(ws_a, ws_b):
+        assert (x == y).all()
+    assert st_a.keys() == st_b.keys()
+    for k in st_a:
+        for x, y in zip(st_a[k], st_b[k]):
+            assert (x == y).all()
+
+
+# -- equivalence: fused vs per-param vs aggregate_num ----------------------
+
+@pytest.mark.parametrize("opt_name,opt_args", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4,
+             "clip_gradient": 0.25}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-4, "clip_gradient": 0.5}),
+])
+def test_fused_bitwise_equivalent(monkeypatch, opt_name, opt_args):
+    # rescale_grad != 1 and changing across steps (batch_size varies)
+    sizes = [8, 8, 4, 8]
+    fused = _train(opt_name, opt_args, batch_sizes=sizes)
+    per_param = _train(opt_name, opt_args, batch_sizes=sizes,
+                       env={"MXNET_FUSED_STEP": "0"}, monkeypatch=monkeypatch)
+    agg = _train(opt_name, dict(opt_args, aggregate_num=3),
+                 batch_sizes=sizes, env={"MXNET_FUSED_STEP": "0"},
+                 monkeypatch=monkeypatch)
+    _assert_bitwise(fused, per_param)
+    _assert_bitwise(fused, agg)
+
+
+def test_fused_kvstore_none_equivalent(monkeypatch):
+    args = {"learning_rate": 0.05, "momentum": 0.9}
+    fused = _train("sgd", args, kvstore=None)
+    per_param = _train("sgd", args, kvstore=None,
+                       env={"MXNET_FUSED_STEP": "0"}, monkeypatch=monkeypatch)
+    _assert_bitwise(fused, per_param)
+
+
+# -- tier-1 CI guard: one step == one FusedStep dispatch -------------------
+
+def test_one_fused_dispatch_per_step():
+    """8-param net, one Trainer.step(): exactly ONE FusedStep::* profiler
+    record and exactly one optimizer dispatch — the O(n_params) -> O(1)
+    guarantee this subsystem exists for."""
+    net = _make_net(n_layers=4)           # 4 Dense = 8 params
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05, "momentum": 0.9})
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    assert len(list(net.collect_params().values())) == 8
+    profiler._agg.clear()
+    profiler.set_config(profile_all=True, aggregate_stats=True)
+    profiler.start()
+    d0 = opt_mod.dispatch_count()
+    try:
+        trainer.step(batch_size=8)
+    finally:
+        profiler.stop()
+    records = {k: len(v) for k, v in profiler._agg.items()
+               if k.startswith("FusedStep::")}
+    profiler._agg.clear()
+    assert records == {"FusedStep::SGD": 1}
+    assert opt_mod.dispatch_count() - d0 == 1
+
+
+def test_disabled_falls_back_to_per_param(monkeypatch):
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    net = _make_net(n_layers=2)
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    d0 = opt_mod.dispatch_count()
+    s0 = fused_step.stats()["steps"]
+    trainer.step(batch_size=8)
+    assert opt_mod.dispatch_count() - d0 == 4   # one per param
+    assert fused_step.stats()["steps"] == s0
+
+
+# -- eligibility fallbacks -------------------------------------------------
+
+def test_custom_update_optimizer_falls_back():
+    """SGLD overrides update() (impure: rng noise) — must not fuse."""
+    opt = opt_mod.SGLD(learning_rate=0.01)
+    assert opt._fused_statics(0) is None
+    updater = opt_mod.get_updater(opt)
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    s0 = fused_step.stats()["fallbacks"]
+    assert fused_step.step(updater, [(0, w, g)]) is False
+    assert fused_step.stats()["fallbacks"] == s0 + 1
+
+
+def test_count_dependent_statics_fall_back():
+    for cls in (opt_mod.FTML, opt_mod.Adamax, opt_mod.Nadam):
+        opt = cls()
+        assert opt._fused_statics(0) is None, cls.__name__
+
+
+def test_non_updater_falls_back():
+    class NotAnUpdater:
+        pass
+    assert fused_step.step(NotAnUpdater(), [(0, nd.ones((2,)),
+                                             nd.ones((2,)))]) is False
+
+
+def test_sparse_grad_falls_back():
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    import jax.numpy as jnp
+    opt = opt_mod.SGD(learning_rate=0.1)
+    updater = opt_mod.get_updater(opt)
+    w = nd.ones((4, 2))
+    g = RowSparseNDArray(jnp.ones((1, 2)), jnp.array([1]), (4, 2))
+    assert fused_step.step(updater, [(0, w, g)]) is False
+    # the normal updater path still handles it
+    updater(0, g, w)
+    assert not (w.asnumpy() == 1.0).all()
+
+
+# -- retrace guard ---------------------------------------------------------
+
+def test_signature_cap_latches(monkeypatch):
+    monkeypatch.setattr(registry, "_MAX_JIT_SIGS", 2)
+    fused_step.reset_cache()
+    opt = opt_mod.SGD(learning_rate=0.1)
+    updater = opt_mod.get_updater(opt)
+    applied = []
+    for n in range(4):
+        w = nd.ones((n + 2,))
+        g = nd.ones((n + 2,))
+        applied.append(fused_step.step(updater, [(n, w, g)]))
+    # two fresh signatures compile, the third latches the family off
+    assert applied == [True, True, False, False]
+    fused_step.reset_cache()
+
+
+def test_signature_cache_hit(monkeypatch):
+    fused_step.reset_cache()
+    opt = opt_mod.SGD(learning_rate=0.1)
+    updater = opt_mod.get_updater(opt)
+    before = fused_step.stats()
+    for _ in range(3):
+        w = nd.ones((5,))
+        g = nd.ones((5,))
+        assert fused_step.step(updater, [(0, w, g)])
+    after = fused_step.stats()
+    assert after["compiles"] - before["compiles"] == 1
+    assert after["hits"] - before["hits"] == 2
+    fused_step.reset_cache()
+
+
+def test_max_jit_sigs_env(monkeypatch):
+    assert registry._read_max_jit_sigs() >= 1
+    monkeypatch.setenv("MXNET_JIT_MAX_SIGS", "3")
+    assert registry._read_max_jit_sigs() == 3
+    monkeypatch.setenv("MXNET_JIT_MAX_SIGS", "0")
+    assert registry._read_max_jit_sigs() == 1      # clamped
+    monkeypatch.setenv("MXNET_JIT_MAX_SIGS", "junk")
+    assert registry._read_max_jit_sigs() == 8      # default on parse error
+
+
+# -- counters snapshot -----------------------------------------------------
+
+def test_profiler_counters_snapshot():
+    c = profiler.counters()
+    assert set(c) == {"eager_jit", "fused_step", "optimizer"}
+    assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
+    assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
+    assert c["optimizer"]["dispatches"] >= 0
+    # it's a snapshot: mutating it must not touch the live counters
+    c["fused_step"]["steps"] += 100
+    assert profiler.counters()["fused_step"]["steps"] != \
+        c["fused_step"]["steps"]
+
+
+def test_counters_move_with_training():
+    net = _make_net(n_layers=2)
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    before = profiler.counters()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(batch_size=8)
+    after = profiler.counters()
+    assert after["optimizer"]["dispatches"] > \
+        before["optimizer"]["dispatches"]
+    assert after["fused_step"]["steps"] == \
+        before["fused_step"]["steps"] + 1
+
+
+# -- kvstore server-side batched update ------------------------------------
+
+def test_kvstore_push_batch_fused():
+    from mxnet_tpu.kvstore.kvstore import KVStore
+    kv = KVStore("device")
+    kv.set_optimizer(opt_mod.SGD(learning_rate=0.1, momentum=0.9))
+    keys = [str(i) for i in range(4)]
+    for k in keys:
+        kv.init(k, nd.ones((3, 3)))
+    s0 = fused_step.stats()
+    kv.push(keys, [nd.ones((3, 3)) for _ in keys])
+    s1 = fused_step.stats()
+    assert s1["steps"] > s0["steps"]
+
+
+def test_kvstore_fused_matches_per_key(monkeypatch):
+    from mxnet_tpu.kvstore.kvstore import KVStore
+
+    def run(env_off):
+        if env_off:
+            monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+        kv = KVStore("device")
+        kv.set_optimizer(opt_mod.SGD(learning_rate=0.1, momentum=0.9,
+                                     wd=1e-3))
+        keys = [str(i) for i in range(3)]
+        rs = onp.random.RandomState(3)
+        for k in keys:
+            kv.init(k, nd.array(rs.randn(4, 2).astype("float32")))
+        for _ in range(3):
+            kv.push(keys, [nd.array(rs.randn(4, 2).astype("float32"))
+                           for _ in keys])
+        out = {k: kv._data[k].asnumpy() for k in keys}
+        if env_off:
+            monkeypatch.delenv("MXNET_FUSED_STEP")
+        return out
+
+    # identical grad streams: reseeded RandomState drives both runs
+    fused = run(False)
+    plain = run(True)
+    assert fused.keys() == plain.keys()
+    for k in fused:
+        assert (fused[k] == plain[k]).all()
+
+
+# -- Trainer.update() reship fix -------------------------------------------
+
+class _ReshipProbe:
+    """Stub uncoordinated dist store counting optimizer (re)ships."""
+    type = "dist_async"
+    _uncoordinated = True
+
+    def __init__(self):
+        self.ships = 0
+        self._updater = None
+
+    def has_capability(self, cap):
+        return True
+
+    def set_gradient_compression(self, params):
+        pass
+
+    def init(self, key, value):
+        pass
+
+    def set_optimizer(self, optimizer):
+        self.ships += 1
+        from mxnet_tpu import optimizer as om
+        self._updater = om.get_updater(optimizer)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        # server-side update stub: leave weights untouched
+        return out
+
+
+def test_update_reships_on_rescale_change():
+    net = _make_net(n_layers=1)
+    probe = _ReshipProbe()
+    trainer = Trainer(net.collect_params(), "sgd",
+                      {"learning_rate": 0.05}, kvstore=probe,
+                      update_on_kvstore=True)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.update(batch_size=8)
+    ships0 = probe.ships
+    trainer.update(batch_size=8)       # same rescale: no reship
+    assert probe.ships == ships0
+    trainer.update(batch_size=4)       # rescale changed: must reship
+    assert probe.ships == ships0 + 1
+    trainer.step(batch_size=2)         # step() behaves the same
+    assert probe.ships == ships0 + 2
+
+
+# -- device-allreduce fold -------------------------------------------------
+
+def test_fold_device_allreduce_conditions(monkeypatch):
+    net = _make_net(n_layers=1)
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05})
+    trainer._init_kvstore()
+    assert trainer._fold_device_allreduce() is True
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    assert trainer._fold_device_allreduce() is False
+    monkeypatch.delenv("MXNET_FUSED_STEP")
+
+    net2 = _make_net(n_layers=1)
+    t2 = Trainer(net2.collect_params(), "sgd", {"learning_rate": 0.05},
+                 kvstore=None)
+    t2._init_kvstore()
+    assert t2._fold_device_allreduce() is False   # nothing to fold
+
+    net3 = _make_net(n_layers=1)
+    t3 = Trainer(net3.collect_params(), "sgd", {"learning_rate": 0.05},
+                 compression_params={"type": "2bit", "threshold": 0.5})
+    t3._init_kvstore()
+    assert t3._fold_device_allreduce() is False   # compression needs store
+
+
+def test_aliased_state_buffer_falls_back():
+    """DCASGD's state wraps the weight's own buffer — donating it twice
+    would crash XLA; the fused path must decline, and the per-param
+    fallback must still apply the update."""
+    opt = opt_mod.DCASGD(learning_rate=0.1)
+    updater = opt_mod.get_updater(opt)
+    w = nd.ones((3,))
+    g = nd.ones((3,))
+    assert fused_step.step(updater, [(0, w, g)]) is False
+    updater(0, g, w)
+    assert not (w.asnumpy() == 1.0).all()
+
+
+def test_shared_weight_buffer_falls_back():
+    opt = opt_mod.SGD(learning_rate=0.1)
+    updater = opt_mod.get_updater(opt)
+    w = nd.ones((3,))
+    g1, g2 = nd.ones((3,)), nd.ones((3,))
+    # two "params" sharing one buffer (tied weights)
+    w2 = nd.NDArray(w._data)
+    assert fused_step.step(updater, [(0, w, g1), (1, w2, g2)]) is False
+
+
+def test_low_precision_dtype_preserved_through_fused():
+    """bf16 params ride the fused path under _lowp_guard: dtype out ==
+    dtype in (mirrors test_update_preserves_low_precision_dtype)."""
+    import jax.numpy as jnp
+    opt = opt_mod.SGD(learning_rate=0.1, momentum=0.9)
+    updater = opt_mod.get_updater(opt)
+    w = nd.NDArray(jnp.ones((4,), jnp.bfloat16))
+    g = nd.NDArray(jnp.ones((4,), jnp.bfloat16))
+    w2 = nd.NDArray(jnp.ones((3,), jnp.float32))
+    g2 = nd.NDArray(jnp.ones((3,), jnp.float32))
+    assert fused_step.step(updater, [(0, w, g), (1, w2, g2)])
+    assert w._data.dtype == jnp.bfloat16
+    assert updater.states[0][0]._data.dtype == jnp.bfloat16
+    assert w2._data.dtype == jnp.float32
