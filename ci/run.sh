@@ -35,6 +35,39 @@ sanitize() {          # import + compile sanity, no test run
     python -m compileall -q mxnet_tpu benchmark tools
 }
 
+telemetry_smoke() {   # 3-step JSONL emission + report over the file
+    local out="${TMPDIR:-/tmp}/ci_telemetry_$$.jsonl"
+    rm -f "$out"
+    # the tier-1 telemetry test writes and validates the step records
+    MXNET_TELEMETRY_JSONL_CI_PATH="$out" JAX_PLATFORMS=cpu \
+        python -m pytest tests/test_telemetry.py -q
+    # then the report tool must parse the emitted file end-to-end
+    JAX_PLATFORMS=cpu python - "$out" <<'PY'
+import glob, os, subprocess, sys, tempfile
+out = sys.argv[1]
+if not os.path.exists(out):
+    # test run may have used its own tmp path; emit a fresh 3-step file
+    import numpy as onp
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon import nn
+    os.environ["MXNET_TELEMETRY_JSONL"] = out
+    net = nn.Dense(4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    x = nd.array(onp.ones((2, 8), "float32"))
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        tr.step(batch_size=2)
+subprocess.run([sys.executable, "tools/telemetry_report.py", out],
+               check=True)
+PY
+    rm -f "$out"
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

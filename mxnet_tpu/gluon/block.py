@@ -14,6 +14,7 @@ extra outputs and threads PRNG keys as extra inputs.
 from __future__ import annotations
 
 import threading
+import time as _time
 from collections import OrderedDict
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -422,7 +423,8 @@ class HybridBlock(Block):
             p._check_initialized()
         sig = self._signature(args, kwargs)
         entry = self._cached_graphs.get(sig)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build_cached(args, kwargs, pkeys, pvals)
             self._cached_graphs[sig] = entry
         jitted, cell = entry
@@ -430,9 +432,15 @@ class HybridBlock(Block):
         key = _rng.next_key()
         arrays = [NDArray(key)] + [p.data() for p in pvals] + \
             [a for a in args if isinstance(a, NDArray)]
-        from .. import profiler
+        from .. import profiler, telemetry
         t0 = profiler.op_timer()
+        # a fresh signature's first execution carries trace+compile —
+        # time it so recompiles surface in the telemetry stream
+        tc0 = _time.perf_counter() if fresh else None
         flat_out = apply_jax(jitted, arrays, multi_out=True)
+        if tc0 is not None:
+            telemetry.record_compile(_time.perf_counter() - tc0,
+                                     "cached_op")
         profiler.op_record(f"CachedOp::{type(self).__name__}", t0)
         n_out = cell["n_out"]
         outs, aux = flat_out[:n_out], flat_out[n_out:]

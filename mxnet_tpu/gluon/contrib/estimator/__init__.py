@@ -4,10 +4,10 @@ from .event_handler import (TrainBegin, TrainEnd, EpochBegin, EpochEnd,
                             BatchBegin, BatchEnd, StoppingHandler,
                             MetricHandler, ValidationHandler, LoggingHandler,
                             CheckpointHandler, EarlyStoppingHandler,
-                            GradientUpdateHandler)
+                            GradientUpdateHandler, TelemetryHandler)
 
 __all__ = ["Estimator", "BatchProcessor", "TrainBegin", "TrainEnd",
            "EpochBegin", "EpochEnd", "BatchBegin", "BatchEnd",
            "StoppingHandler", "MetricHandler", "ValidationHandler",
            "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
-           "GradientUpdateHandler"]
+           "GradientUpdateHandler", "TelemetryHandler"]

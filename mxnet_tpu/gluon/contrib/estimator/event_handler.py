@@ -10,7 +10,7 @@ import time
 __all__ = ["TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd", "BatchBegin",
            "BatchEnd", "StoppingHandler", "GradientUpdateHandler", "MetricHandler",
            "ValidationHandler", "LoggingHandler", "CheckpointHandler",
-           "EarlyStoppingHandler"]
+           "EarlyStoppingHandler", "TelemetryHandler"]
 
 
 class TrainBegin:
@@ -215,3 +215,56 @@ class GradientUpdateHandler(BatchEnd):
     def batch_end(self, estimator, *args, **kwargs):
         estimator.trainer.step(kwargs.get("batch_size", 1))
         return False
+
+
+class TelemetryHandler(TrainBegin, BatchEnd, TrainEnd):
+    """Bridge the estimator event loop onto the telemetry runtime
+    (mxnet_tpu/telemetry.py).
+
+    For the duration of the fit it attaches the sinks it was given —
+    ``jsonl=<path>`` (a JSONLSink), ``logdir=<dir>`` (a TensorBoardSink),
+    ``log_every=<N>`` (a LogSink), or any ready-made sink objects via
+    ``sinks=[...]`` — so the step records the Trainer.step funnel emits
+    flow while training runs, and stop when it ends.  At each batch end
+    it mirrors the estimator's train metrics into the registry as
+    ``estimator.<metric>`` gauges so they ride the same JSONL/TensorBoard
+    stream as the runtime counters.
+    """
+
+    def __init__(self, jsonl=None, logdir=None, log_every=None,
+                 sinks=None, priority=0):
+        self.priority = priority
+        self._specs = dict(jsonl=jsonl, logdir=logdir, log_every=log_every)
+        self._extra = list(sinks or [])
+        self._attached = []
+
+    def train_begin(self, estimator, *args, **kwargs):
+        from .... import telemetry
+        if self._specs["jsonl"]:
+            self._attached.append(telemetry.JSONLSink(self._specs["jsonl"]))
+        if self._specs["logdir"]:
+            self._attached.append(
+                telemetry.TensorBoardSink(self._specs["logdir"]))
+        if self._specs["log_every"]:
+            self._attached.append(
+                telemetry.LogSink(int(self._specs["log_every"])))
+        self._attached.extend(self._extra)
+        for s in self._attached:
+            telemetry.add_sink(s)
+
+    def batch_end(self, estimator, *args, **kwargs):
+        from .... import telemetry
+        for m in getattr(estimator, "train_metrics", None) or []:
+            try:
+                name, value = m.get()
+            except Exception:
+                continue
+            if isinstance(value, (int, float)):
+                telemetry.gauge(f"estimator.{name}").set(value)
+        return False
+
+    def train_end(self, estimator, *args, **kwargs):
+        from .... import telemetry
+        for s in self._attached:
+            telemetry.remove_sink(s)
+        self._attached = []

@@ -12,6 +12,7 @@ import os
 
 from typing import Dict, List, Optional, Sequence
 
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
@@ -192,15 +193,21 @@ class Trainer:
 
     # -- training step (parity: trainer.py step:334) -----------------------
     def step(self, batch_size, ignore_stale_grad=False):
-        if not self._kv_initialized:
-            self._init_kvstore()
-        new_rescale = self._scale / batch_size
-        if new_rescale != self._optimizer.rescale_grad:
-            self._optimizer.rescale_grad = new_rescale
-            self._reship_server_optimizer()
-        if not self._fold_device_allreduce():
-            self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        # step funnel #1: one telemetry record per Trainer.step — the
+        # inner kvstore pushpull nests and only accumulates counters
+        tok = telemetry.begin_step()
+        try:
+            if not self._kv_initialized:
+                self._init_kvstore()
+            new_rescale = self._scale / batch_size
+            if new_rescale != self._optimizer.rescale_grad:
+                self._optimizer.rescale_grad = new_rescale
+                self._reship_server_optimizer()
+            if not self._fold_device_allreduce():
+                self._allreduce_grads()
+            self._update(ignore_stale_grad)
+        finally:
+            telemetry.end_step(tok, "gluon.Trainer")
 
     def _fold_device_allreduce(self):
         """True when the gradient 'reduction' can fold into the fused
@@ -262,15 +269,19 @@ class Trainer:
             self._kvstore.pushpull(keys, grads, out=outs)
 
     def update(self, batch_size, ignore_stale_grad=False):
-        if not self._kv_initialized:
-            self._init_kvstore()
-        new_rescale = self._scale / batch_size
-        if new_rescale != self._optimizer.rescale_grad:
-            self._optimizer.rescale_grad = new_rescale
-            # same reship as step(): an uncoordinated-async PS would
-            # otherwise keep updating with the stale rescale_grad
-            self._reship_server_optimizer()
-        self._update(ignore_stale_grad)
+        tok = telemetry.begin_step()
+        try:
+            if not self._kv_initialized:
+                self._init_kvstore()
+            new_rescale = self._scale / batch_size
+            if new_rescale != self._optimizer.rescale_grad:
+                self._optimizer.rescale_grad = new_rescale
+                # same reship as step(): an uncoordinated-async PS would
+                # otherwise keep updating with the stale rescale_grad
+                self._reship_server_optimizer()
+            self._update(ignore_stale_grad)
+        finally:
+            telemetry.end_step(tok, "gluon.Trainer")
 
     def _update(self, ignore_stale_grad=False):
         if self._update_on_kvstore and self._kvstore is not None:

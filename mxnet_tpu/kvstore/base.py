@@ -14,6 +14,19 @@ __all__ = ["KVStoreBase", "TestStore", "create"]
 _KV_REGISTRY: Dict[str, type] = {}
 
 
+def payload_nbytes(v) -> int:
+    """Wire size of one kvstore value: dense = size × itemsize (NDArray
+    exposes no .nbytes), row-sparse = data + indices — the shared
+    measure behind the telemetry ``comm.*.bytes`` counters."""
+    import numpy as onp
+    if hasattr(v, "indices") and hasattr(v, "data"):     # row-sparse
+        return payload_nbytes(v.data) + payload_nbytes(v.indices)
+    try:
+        return int(v.size) * onp.dtype(v.dtype).itemsize
+    except Exception:
+        return 0
+
+
 class KVStoreBase:
     """Abstract key-value store for parameter synchronization."""
 

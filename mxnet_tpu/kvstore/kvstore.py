@@ -14,9 +14,10 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict, List, Optional
 
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
-from .base import KVStoreBase
+from .base import KVStoreBase, payload_nbytes
 
 __all__ = ["KVStore"]
 
@@ -63,6 +64,16 @@ class KVStore(KVStoreBase):
         return isinstance(v, RowSparseNDArray)
 
     def push(self, key, value, priority=0):
+        # step funnel #3: a bare push/pull training loop (server-side
+        # optimizer) emits one record per push; under Trainer.step this
+        # nests and only the counters accumulate
+        tok = telemetry.begin_step()
+        try:
+            self._push(key, value, priority)
+        finally:
+            telemetry.end_step(tok, "kvstore")
+
+    def _push(self, key, value, priority=0):
         keys = key if isinstance(key, (list, tuple)) else [key]
         if len(keys) == 1:
             value = [value]
@@ -80,6 +91,7 @@ class KVStore(KVStoreBase):
                 reduced = v
             else:
                 reduced = self._reduce(self._densify(v))
+            telemetry.record_comm_bytes(payload_nbytes(reduced), "local")
             if self._updater is not None:
                 if k not in self._data:
                     self._data[k] = reduced.copy()
@@ -165,32 +177,39 @@ class KVStore(KVStoreBase):
         return out
 
     def pushpull(self, key, value, out=None, priority=0):
-        if self._updater is not None:
-            # server-side optimizer: push applies update, pull returns weight
-            self.push(key, value, priority)
+        tok = telemetry.begin_step()
+        try:
+            if self._updater is not None:
+                # server-side optimizer: push applies update, pull
+                # returns weight
+                self._push(key, value, priority)
+                if out is not None:
+                    self.pull(key, out, priority)
+                return out
+            # plain allreduce semantics
+            keys = key if isinstance(key, (list, tuple)) else [key]
+            vals = value if isinstance(value, (list, tuple)) else [value]
+            if len(keys) == 1:
+                vals = [value]
+            for k, v in zip(keys, vals):
+                if isinstance(v, (list, tuple)):
+                    if all(self._is_rsp(x) for x in v):
+                        from ..ndarray.sparse import reduce_list
+                        self._data[k] = reduce_list(list(v))
+                    else:
+                        self._data[k] = self._reduce(
+                            [self._densify(x) for x in v])
+                elif self._is_rsp(v):
+                    self._data[k] = v
+                else:
+                    self._data[k] = self._reduce(self._densify(v))
+                telemetry.record_comm_bytes(
+                    payload_nbytes(self._data[k]), "local")
             if out is not None:
                 self.pull(key, out, priority)
             return out
-        # plain allreduce semantics
-        keys = key if isinstance(key, (list, tuple)) else [key]
-        vals = value if isinstance(value, (list, tuple)) else [value]
-        if len(keys) == 1:
-            vals = [value]
-        for k, v in zip(keys, vals):
-            if isinstance(v, (list, tuple)):
-                if all(self._is_rsp(x) for x in v):
-                    from ..ndarray.sparse import reduce_list
-                    self._data[k] = reduce_list(list(v))
-                else:
-                    self._data[k] = self._reduce(
-                        [self._densify(x) for x in v])
-            elif self._is_rsp(v):
-                self._data[k] = v
-            else:
-                self._data[k] = self._reduce(self._densify(v))
-        if out is not None:
-            self.pull(key, out, priority)
-        return out
+        finally:
+            telemetry.end_step(tok, "kvstore")
 
     def broadcast(self, key, value, out, priority=0):
         self.init(key, value)

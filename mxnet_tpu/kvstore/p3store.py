@@ -20,6 +20,7 @@ from typing import Any, Dict, List
 
 import jax.numpy as jnp
 
+from .. import telemetry
 from ..base import MXNetError, getenv_int
 from ..ndarray import NDArray
 from ..ops.registry import apply_jax
@@ -55,27 +56,31 @@ class P3StoreDist(DistKVStore):
 
         Higher priority issues first (reference: priority ~ -layer index
         so the layers needed soonest reduce first)."""
-        out = out if out is not None else value
-        flat, bounds = self._slices(value)
-        pieces: List[Any] = [None] * len(bounds)
+        tok = telemetry.begin_step()
+        try:
+            out = out if out is not None else value
+            flat, bounds = self._slices(value)
+            pieces: List[Any] = [None] * len(bounds)
 
-        def make_task(si, lo, hi):
-            def task():
-                piece = apply_jax(lambda f: f[lo:hi], [flat])
-                pieces[si] = self._allreduce(piece)
-            return task
+            def make_task(si, lo, hi):
+                def task():
+                    piece = apply_jax(lambda f: f[lo:hi], [flat])
+                    pieces[si] = self._allreduce(piece)
+                return task
 
-        for si, (lo, hi) in enumerate(bounds):
-            heapq.heappush(self._queue,
-                           (-priority, next(self._seq),
-                            make_task(si, lo, hi)))
-        self._flush()
-        merged = apply_jax(
-            lambda *ps: jnp.concatenate(ps).reshape(value.shape),
-            [p for p in pieces])
-        out._rebind(merged._data)
-        self._data[key] = merged
-        return out
+            for si, (lo, hi) in enumerate(bounds):
+                heapq.heappush(self._queue,
+                               (-priority, next(self._seq),
+                                make_task(si, lo, hi)))
+            self._flush()
+            merged = apply_jax(
+                lambda *ps: jnp.concatenate(ps).reshape(value.shape),
+                [p for p in pieces])
+            out._rebind(merged._data)
+            self._data[key] = merged
+            return out
+        finally:
+            telemetry.end_step(tok, "kvstore")
 
     def _flush(self):
         while self._queue:

@@ -11,9 +11,11 @@ op modules from the C registry (python/mxnet/ndarray/register.py:115-277).
 from __future__ import annotations
 
 import functools
+import time as _time
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
+from .. import telemetry
 from ..base import MXNetError
 
 __all__ = ["Operator", "register", "alias", "get", "list_ops", "invoke",
@@ -271,6 +273,11 @@ class _JitEntry:
                 self.disabled = True
                 _JIT_STATS["latches"] += 1
                 return fn(*arrays)
+            # a fresh signature's first execution is trace+compile
+            # dominated — time it so every compile carries wall time
+            # (telemetry compile.count/compile.ms); replays take the
+            # untimed path and cost nothing extra
+            t0 = _time.perf_counter() if fresh else None
             try:
                 out = self.jfn(*arrays)
             except Exception:
@@ -281,6 +288,8 @@ class _JitEntry:
             if fresh:                   # only successful sigs burn budget
                 self.sigs.add(sig)
                 _JIT_STATS["misses"] += 1
+                telemetry.record_compile(_time.perf_counter() - t0,
+                                         "eager_op")
             else:
                 _JIT_STATS["hits"] += 1
             return out

@@ -28,10 +28,13 @@ from __future__ import annotations
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+import time
+
 import jax
 import jax.numpy as jnp
 
 from .. import profiler
+from .. import telemetry
 from ..ops import registry as _reg
 from .optimizer import Updater, _lowp_guard, _note_dispatch
 
@@ -175,7 +178,8 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
                  tuple((tuple(s.shape), str(s._data.dtype)) for s in sts))
                 for w, g, sts in zip(weights, grads, states))
     jfn = entry.jfns.get(sig)
-    if jfn is None:
+    fresh = jfn is None
+    if fresh:
         if len(entry.jfns) >= _reg._MAX_JIT_SIGS:
             entry.disabled = True
             _STATS["fallbacks"] += 1
@@ -201,6 +205,10 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
                 for nm in dyn_names)
 
     t0 = profiler.op_timer()
+    # the executable actually compiles at its FIRST execution, not at
+    # _build (jax.jit is lazy) — time it so the compile records wall
+    # time, not just a count
+    tc = time.perf_counter() if fresh else None
     try:
         out_w, out_s = jfn(
             dyn,
@@ -213,6 +221,8 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
         # half-applied and silent fallback would double-count updates
         entry.disabled = True
         raise
+    if tc is not None:
+        telemetry.record_compile(time.perf_counter() - tc, "fused_step")
     _note_dispatch()
     profiler.op_record(f"FusedStep::{type(opt).__name__}", t0)
     for w, nw in zip(weights, out_w):

@@ -17,6 +17,7 @@ import numpy as onp
 import jax
 import jax.numpy as jnp
 
+from .. import telemetry as _telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 from ..ops import registry as _reg
@@ -124,18 +125,18 @@ def _jitted_multi_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...
 # executable-dispatch counter: one tick per optimizer-update XLA call
 # (per-param jit, aggregated multi-tensor call, or fused whole-set step).
 # The observable behind the O(n_params) -> O(1) dispatch claim — surfaced
-# by profiler.counters() and benchmark/fused_step_bench.py.
-_DISPATCHES = 0
+# by profiler.counters() and benchmark/fused_step_bench.py.  Lives in the
+# telemetry registry so the JSONL/TensorBoard sinks read the same number.
+_DISPATCHES = _telemetry.counter("optimizer.dispatches")
 
 
 def _note_dispatch(n: int = 1) -> None:
-    global _DISPATCHES
-    _DISPATCHES += n
+    _DISPATCHES.inc(n)
 
 
 def dispatch_count() -> int:
     """Total optimizer-update executable dispatches this process."""
-    return _DISPATCHES
+    return _DISPATCHES.value
 
 
 class Optimizer:

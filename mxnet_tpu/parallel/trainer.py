@@ -18,6 +18,7 @@ Design notes:
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as onp
@@ -25,6 +26,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import telemetry
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import autograd as ag
@@ -374,21 +376,31 @@ class SPMDTrainer:
         l = self._put(l, self._batch_sharding(l.ndim))
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
         entry = self._step_cache.get(sig)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build_step(*sig)
             self._step_cache[sig] = entry
         jitted, cell = entry
         from .. import profiler
+        # step funnel #2: the SPMD compiled-step path
+        tok = telemetry.begin_step()
         _prof_t0 = profiler.op_timer()
-        self.num_update += 1
-        lr = jnp.float32(self.optimizer.learning_rate)
-        wd = jnp.float32(self.optimizer.wd)
-        self.optimizer.num_update = self.num_update
-        p_arrays, opt_state = self._gather_state()
-        new_p, new_s, loss, aux = jitted(next_key(), lr, wd, p_arrays,
-                                         opt_state, d, l)
-        self._fold_back(new_p, new_s, cell, aux)
-        profiler.op_record("SPMDTrainer::step", _prof_t0)
+        try:
+            self.num_update += 1
+            lr = jnp.float32(self.optimizer.learning_rate)
+            wd = jnp.float32(self.optimizer.wd)
+            self.optimizer.num_update = self.num_update
+            p_arrays, opt_state = self._gather_state()
+            tc = time.perf_counter() if fresh else None
+            new_p, new_s, loss, aux = jitted(next_key(), lr, wd, p_arrays,
+                                             opt_state, d, l)
+            if tc is not None:
+                telemetry.record_compile(time.perf_counter() - tc,
+                                         "spmd_step")
+            self._fold_back(new_p, new_s, cell, aux)
+            profiler.op_record("SPMDTrainer::step", _prof_t0)
+        finally:
+            telemetry.end_step(tok, "SPMDTrainer")
         return NDArray(loss)
 
     def _gather_state(self):
@@ -451,23 +463,35 @@ class SPMDTrainer:
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype), int(n_steps),
                bool(per_step_data))
         entry = self._step_cache.get(sig)
-        if entry is None:
+        fresh = entry is None
+        if fresh:
             entry = self._build_multi(d.shape, str(d.dtype), l.shape,
                                       str(l.dtype), int(n_steps),
                                       per_step_data=per_step_data)
             self._step_cache[sig] = entry
         jitted, cell = entry
-        # read lr/wd BEFORE advancing num_update — matching what the
-        # first of n sequential step() calls would use (the whole fused
-        # window trains at the window-entry schedule point)
-        lr = jnp.float32(self.optimizer.learning_rate)
-        wd = jnp.float32(self.optimizer.wd)
-        self.num_update += int(n_steps)
-        self.optimizer.num_update = self.num_update
-        p_arrays, opt_state = self._gather_state()
-        new_p, new_s, losses = jitted(next_key(), lr, wd, p_arrays,
-                                      opt_state, d, l)
-        self._fold_back(new_p, new_s, cell)
+        # one telemetry record for the whole fused window (it IS one
+        # device program / one dispatch)
+        tok = telemetry.begin_step()
+        try:
+            # read lr/wd BEFORE advancing num_update — matching what the
+            # first of n sequential step() calls would use (the whole
+            # fused window trains at the window-entry schedule point)
+            lr = jnp.float32(self.optimizer.learning_rate)
+            wd = jnp.float32(self.optimizer.wd)
+            self.num_update += int(n_steps)
+            self.optimizer.num_update = self.num_update
+            p_arrays, opt_state = self._gather_state()
+            tc = time.perf_counter() if fresh else None
+            new_p, new_s, losses = jitted(next_key(), lr, wd, p_arrays,
+                                          opt_state, d, l)
+            if tc is not None:
+                telemetry.record_compile(time.perf_counter() - tc,
+                                         "spmd_step")
+            self._fold_back(new_p, new_s, cell)
+        finally:
+            telemetry.end_step(tok, "SPMDTrainer",
+                               extra={"n_steps": int(n_steps)})
         return NDArray(losses)
 
     def predict(self, data):

@@ -4,28 +4,38 @@ Parity: python/mxnet/profiler.py:34-477 (set_config, start/stop/pause,
 dump, dumps, scoped Task/Frame/Event/Counter/Marker) over src/profiler/.
 TPU-native backend: jax.profiler (XPlane/TensorBoard traces replace the
 Chrome-trace JSON; the aggregate table is kept host-side).
+
+The aggregate table and every counter here live in the process-wide
+telemetry registry (mxnet_tpu/telemetry.py) — ``dumps()``,
+``counters()``, the JSONL step stream and the TensorBoard scalars all
+read the SAME metric objects.  Per-op samples are bounded: each op keeps
+(count, total, min, max) plus a fixed-size reservoir, so million-step
+runs don't grow host RAM (the reference's AggregateStats has the same
+fold; the old port kept every raw sample).
 """
 from __future__ import annotations
 
 import os
 import time
-from collections import defaultdict
 from typing import Dict, Optional
 
 import jax
 
+from . import telemetry
+
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
            "Task", "Frame", "Event", "Counter", "Marker", "scope", "counters",
-           "device_memory_info", "device_memory_summary"]
+           "device_memory_info", "device_memory_summary", "op_stats",
+           "reset_stats"]
 
 _config = {"profile_all": False, "profile_symbolic": False,
            "profile_imperative": False, "profile_memory": False,
            "profile_api": False, "filename": "profile.json",
            "aggregate_stats": False}
 _running = False
+_paused = False
 _xplane_on = False
 _trace_dir: Optional[str] = None
-_agg: Dict[str, list] = defaultdict(list)
 
 
 # -- operator instrumentation ------------------------------------------------
@@ -35,15 +45,16 @@ _agg: Dict[str, list] = defaultdict(list)
 # (src/profiler/profiler.h; threaded_engine.cc ExecuteOprBlock).
 
 def imperative_enabled() -> bool:
-    """True when per-op profiling is active (profiler started and
-    imperative/all profiling configured)."""
-    return _running and (_config.get("profile_all")
-                         or _config.get("profile_imperative"))
+    """True when per-op profiling is active (profiler started, not
+    paused, and imperative/all profiling configured)."""
+    return _running and not _paused and (_config.get("profile_all")
+                                         or _config.get("profile_imperative"))
 
 
 def record_op(name: str, seconds: float) -> None:
-    """Feed one op execution into the aggregate table."""
-    _agg[name].append(seconds)
+    """Feed one op execution into the aggregate table (a bounded
+    ``op.<name>`` histogram in the telemetry registry)."""
+    telemetry.record_op_time(name, seconds)
 
 
 def op_timer():
@@ -58,6 +69,20 @@ def op_record(name: str, t0) -> None:
         record_op(name, time.perf_counter() - t0)
 
 
+def op_stats() -> Dict[str, Dict[str, float]]:
+    """Aggregate-table snapshot: {op: {count, total, min, max, mean}}
+    (seconds).  The public replacement for poking the old raw-sample
+    ``_agg`` dict."""
+    return {k[len("op."):]: v.describe()
+            for k, v in telemetry.metrics("op.").items()}
+
+
+def reset_stats() -> None:
+    """Clear the aggregate op table (values only; metric identity is
+    stable)."""
+    telemetry.reset("op.")
+
+
 def counters() -> Dict[str, Dict[str, int]]:
     """Process-wide dispatch/jit-cache counter snapshot:
 
@@ -66,16 +91,23 @@ def counters() -> Dict[str, Dict[str, int]]:
     - ``fused_step``: the fused whole-parameter-set optimizer step
       (compiles/hits/fallbacks/steps, optimizer/fused_step.py)
     - ``optimizer``: total optimizer-update executable dispatches
+    - ``compile``: jit compiles + compile wall ms across every compile
+      site (op funnel, fused step, CachedOp, SPMD step)
+    - ``comm``: collective payload bytes (dense + sparse kvstore paths)
 
-    Always live (unlike the aggregate table this needs no start()) —
-    the observable behind the O(n_params) -> O(1) dispatch claim.
+    Always live (unlike xplane tracing this needs no start()) — every
+    number is read from the telemetry registry, the same objects the
+    JSONL step records report deltas of.
     """
     from .ops import registry as _registry
     from .optimizer import optimizer as _optimizer
     from .optimizer import fused_step as _fused_step
     return {"eager_jit": _registry.jit_cache_stats(),
             "fused_step": _fused_step.stats(),
-            "optimizer": {"dispatches": _optimizer.dispatch_count()}}
+            "optimizer": {"dispatches": _optimizer.dispatch_count()},
+            "compile": {"count": telemetry.counter("compile.count").value,
+                        "ms": telemetry.counter("compile.ms").value},
+            "comm": {"bytes": telemetry.counter("comm.bytes").value}}
 
 
 def set_config(**kwargs):
@@ -84,11 +116,21 @@ def set_config(**kwargs):
 
 
 def start(profile_process="worker"):
-    global _running, _trace_dir, _xplane_on
+    """Begin a profiling cycle.  One xplane trace dir per
+    start()/stop() cycle — pause()/resume() suspend and re-enter the
+    SAME capture dir instead of rotating it."""
+    global _running, _paused, _trace_dir, _xplane_on
     if _running:
         return
     _running = True
+    _paused = False
     _trace_dir = os.path.splitext(_config["filename"])[0] + "_xplane"
+    telemetry._note_trace_start()
+    _start_xplane()
+
+
+def _start_xplane():
+    global _xplane_on
     try:
         jax.profiler.start_trace(_trace_dir)
         _xplane_on = True
@@ -96,28 +138,49 @@ def start(profile_process="worker"):
         _xplane_on = False
 
 
+def _stop_xplane():
+    global _xplane_on
+    if _xplane_on:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _xplane_on = False
+
+
 def stop(profile_process="worker"):
-    global _running, _xplane_on
+    global _running, _paused
     if _running:
         _running = False
-        if _xplane_on:
-            try:
-                jax.profiler.stop_trace()
-            finally:
-                _xplane_on = False
+        _paused = False
+        _stop_xplane()
+        telemetry._note_trace_stop(_trace_dir)
 
 
 def pause(profile_process="worker"):
-    stop(profile_process)
+    """Suspend stat collection WITHOUT ending the profiling cycle
+    (parity: MXSetProfilerState pause) — the trace dir is kept, so the
+    capture taken before pause() is not orphaned."""
+    global _paused
+    if _running and not _paused:
+        _paused = True
+        _stop_xplane()
 
 
 def resume(profile_process="worker"):
-    start(profile_process)
+    """Resume a paused cycle into the SAME trace dir."""
+    global _paused
+    if _running and _paused:
+        _paused = False
+        _start_xplane()
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write the trace (xplane dir path written into the json filename slot)."""
-    stop()
+    """Write the trace (xplane dir path written into the json filename
+    slot).  ``finished=False`` snapshots WITHOUT stopping the profiler
+    (parity: MXDumpProfile's finished flag — the old port stopped
+    unconditionally)."""
+    if finished:
+        stop()
     with open(_config["filename"], "w") as f:
         import json
         json.dump({"traceEvents": _dump_agg_events(),
@@ -154,13 +217,26 @@ def dumps(reset=False, device=True):
     profiler.py:460 / DumpProfile).  Host dispatch times first; when an
     xplane trace was captured, a device-time per-op table follows — the
     device numbers are the kernel truth (dispatch wall time says
-    nothing about a 4 ms kernel under async dispatch)."""
+    nothing about a 4 ms kernel under async dispatch).  User counters
+    (profiler.Counter) follow as a third section."""
     lines = ["Profile Statistics (host dispatch):",
              f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"]
-    for name, times in sorted(_agg.items()):
-        total = sum(times) * 1e3
-        lines.append(f"{name:<40}{len(times):>8}{total:>12.3f}"
-                     f"{total / max(len(times), 1):>12.3f}")
+    for name, st in sorted(op_stats().items()):
+        if not st["count"]:
+            # reset_stats() zeroes values in place (metric identity is
+            # stable) — an op that has recorded nothing since the last
+            # reset must not appear, matching the old cleared-dict table
+            continue
+        total = st["total"] * 1e3
+        lines.append(f"{name:<40}{st['count']:>8}{total:>12.3f}"
+                     f"{total / max(st['count'], 1):>12.3f}")
+    user = telemetry.metrics("user_counter.")
+    if user:
+        lines.append("")
+        lines.append("Counters:")
+        for name, g in user.items():
+            lines.append(f"{name[len('user_counter.'):]:<40}"
+                         f"{g.value if g.value is not None else 0:>12}")
     if device:
         dev = device_op_table()
         if dev:
@@ -168,15 +244,19 @@ def dumps(reset=False, device=True):
             lines.append("")
             lines.append(xplane.format_table(dev))
     if reset:
-        _agg.clear()
+        reset_stats()
     return "\n".join(lines)
 
 
 def _dump_agg_events():
+    """Chrome-trace-style events from the bounded reservoirs (the most
+    recent ≤64 samples per op; the full population only exists as
+    count/total/min/max)."""
     events = []
-    for name, times in _agg.items():
-        for t in times:
-            events.append({"name": name, "ph": "X", "dur": t * 1e6})
+    for name, h in telemetry.metrics("op.").items():
+        for t in h.samples():
+            events.append({"name": name[len("op."):], "ph": "X",
+                           "dur": t * 1e6})
     return events
 
 
@@ -197,7 +277,7 @@ class _Scope:
             self._ann = None
 
     def stop(self):
-        _agg[self.name].append(time.perf_counter() - self._t0)
+        record_op(self.name, time.perf_counter() - self._t0)
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
@@ -231,22 +311,32 @@ class Marker:
         self.name = name
 
     def mark(self, scope="process"):
-        _agg[f"marker:{self.name}"].append(0.0)
+        record_op(f"marker:{self.name}", 0.0)
 
 
 class Counter:
+    """User counter (parity: profiler.Counter).  Backed by a telemetry
+    gauge — set/increment/decrement are VISIBLE in ``dumps()`` and in
+    the JSONL snapshot, instead of being write-only attributes."""
+
     def __init__(self, name, domain=None, value=None):
         self.name = name
-        self.value = value or 0
+        self._gauge = telemetry.gauge(f"user_counter.{name}")
+        if value is not None or self._gauge.value is None:
+            self._gauge.set(value or 0)
+
+    @property
+    def value(self):
+        return self._gauge.value
 
     def set_value(self, value):
-        self.value = value
+        self._gauge.set(value)
 
     def increment(self, delta=1):
-        self.value += delta
+        self._gauge.inc(delta)
 
     def decrement(self, delta=1):
-        self.value -= delta
+        self._gauge.dec(delta)
 
 
 def scope(name="<unk>:"):

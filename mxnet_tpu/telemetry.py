@@ -1,0 +1,553 @@
+"""Unified per-step training telemetry runtime.
+
+One process-wide structured-metrics registry (counters / gauges /
+histograms with bounded reservoirs) plus a per-step record stream
+emitted by every step funnel — ``gluon.Trainer.step`` (incl. the fused
+path), ``parallel.SPMDTrainer.step``/``run_steps``, and direct
+``kvstore`` push/pull loops.  The registry is the single source of
+truth: ``profiler.counters()``, ``profiler.dumps()``, the JSONL stream,
+and the TensorBoard scalars all read the SAME metric objects — no
+number is computed in two places.
+
+The reference ships this as three separate stacks (``OprExecStat``
+wrapping every engine op, ``src/profiler/`` aggregate + memory stats,
+``mx.monitor.Monitor`` per-layer tensor stats); on the TPU build the
+first-order health signals are different — recompiles, compile seconds,
+collective payload bytes, device memory — so those are first-class
+fields of every step record.
+
+Hot-path contract: with no sink attached and the env switches unset,
+the per-step cost of the instrumentation is a couple of dict lookups
+(``begin_step`` returns ``None`` and every funnel skips straight
+through) — below measurement noise next to an XLA dispatch.  Counters
+still accumulate (they are plain attribute increments) so
+``profiler.counters()`` is always live, exactly like the jit-cache
+stats it already exposes.
+
+Sinks (pluggable, fan-out):
+
+- ``JSONLSink`` — one JSON object per step, appended to a file;
+  auto-attached when ``MXNET_TELEMETRY_JSONL=<path>`` is set.
+- ``LogSink`` — a rate-limited human log line every N steps;
+  auto-attached when ``MXNET_TELEMETRY_LOG_EVERY=<N>`` is set.
+- ``TensorBoardSink`` — scalars via any SummaryWriter backend
+  (contrib/tensorboard.py).
+- ``gluon.contrib.estimator.TelemetryHandler`` — estimator event-loop
+  bridge (attaches a sink for the fit, mirrors eval metrics as gauges).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
+           "metrics", "snapshot", "reset", "add_sink", "remove_sink",
+           "clear_sinks", "sinks", "enabled", "begin_step", "end_step",
+           "record_compile", "record_comm_bytes", "record_op_time",
+           "step_count", "last_record", "JSONLSink", "LogSink",
+           "TensorBoardSink", "device_memory_record"]
+
+_LOCK = threading.Lock()
+
+# bounded per-histogram sample memory: a fixed ring of the most recent
+# samples rides along count/total/min/max, so a million-step run keeps
+# O(1) host RAM per metric while percentile-ish views stay possible
+_RESERVOIR = 64
+
+
+class Counter:
+    """Monotonic (well, add-only) counter.  ``value`` may be int or
+    float; increments are plain attribute adds so the hot path costs one
+    method call."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def inc(self, n=1):
+        self.value += n
+
+    def get(self):
+        return self.value
+
+    def reset(self):
+        self.value = 0
+
+    def describe(self):
+        return self.value
+
+
+class Gauge:
+    """Last-value metric (set-only)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = None
+
+    def set(self, v):
+        self.value = v
+
+    def inc(self, n=1):
+        self.value = (self.value or 0) + n
+
+    def dec(self, n=1):
+        self.value = (self.value or 0) - n
+
+    def get(self):
+        return self.value
+
+    def reset(self):
+        self.value = None
+
+    def describe(self):
+        return self.value
+
+
+class Histogram:
+    """Aggregate distribution: (count, total, min, max) plus a bounded
+    ring reservoir of the most recent samples.  This is the bounded
+    replacement for the profiler's grow-forever per-op sample lists —
+    ``observe`` is O(1) in time AND memory."""
+
+    __slots__ = ("name", "count", "total", "min", "max", "_ring", "_pos")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._ring: List[float] = []
+        self._pos = 0
+
+    def observe(self, v: float):
+        self.count += 1
+        self.total += v
+        if self.min is None or v < self.min:
+            self.min = v
+        if self.max is None or v > self.max:
+            self.max = v
+        if len(self._ring) < _RESERVOIR:
+            self._ring.append(v)
+        else:
+            self._ring[self._pos] = v
+            self._pos = (self._pos + 1) % _RESERVOIR
+
+    @property
+    def mean(self):
+        return self.total / self.count if self.count else 0.0
+
+    def samples(self) -> List[float]:
+        """The bounded reservoir (most recent ≤ _RESERVOIR samples)."""
+        return list(self._ring)
+
+    def get(self):
+        return self.describe()
+
+    def reset(self):
+        self.count = 0
+        self.total = 0.0
+        self.min = None
+        self.max = None
+        self._ring = []
+        self._pos = 0
+
+    def describe(self):
+        return {"count": self.count, "total": self.total,
+                "min": self.min, "max": self.max, "mean": self.mean}
+
+
+_REGISTRY: Dict[str, Any] = {}
+
+
+def _get_or_create(name: str, cls):
+    m = _REGISTRY.get(name)
+    if m is None:
+        with _LOCK:
+            m = _REGISTRY.get(name)
+            if m is None:
+                m = _REGISTRY[name] = cls(name)
+    if not isinstance(m, cls):
+        from .base import MXNetError
+        raise MXNetError(
+            f"telemetry metric {name!r} already registered as "
+            f"{type(m).__name__}, not {cls.__name__}")
+    return m
+
+
+def counter(name: str) -> Counter:
+    return _get_or_create(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get_or_create(name, Gauge)
+
+
+def histogram(name: str) -> Histogram:
+    return _get_or_create(name, Histogram)
+
+
+def metrics(prefix: str = "") -> Dict[str, Any]:
+    """Live metric objects, optionally filtered by name prefix."""
+    return {k: v for k, v in sorted(_REGISTRY.items())
+            if k.startswith(prefix)}
+
+
+def snapshot(prefix: str = "") -> Dict[str, Any]:
+    """Plain-data view of the registry (JSON-serializable)."""
+    return {k: v.describe() for k, v in sorted(_REGISTRY.items())
+            if k.startswith(prefix)}
+
+
+def reset(prefix: str = "") -> None:
+    """Zero metric VALUES in place.  Metric object identity is
+    preserved — modules cache references to their counters (ops
+    registry, fused step), so entries are never dropped."""
+    for k, v in _REGISTRY.items():
+        if k.startswith(prefix):
+            v.reset()
+
+
+# -- the well-known metrics every step record is derived from ---------------
+# (created eagerly so callers can cache the references; see the
+# registry→funnels→sinks diagram in docs/ARCHITECTURE.md)
+
+_C_COMPILES = counter("compile.count")        # jit compiles, all sites
+_C_COMPILE_MS = counter("compile.ms")         # compile wall ms, all sites
+_C_COMM_BYTES = counter("comm.bytes")         # collective payload bytes
+_C_STEPS = counter("telemetry.steps")         # emitted step records
+
+
+def record_compile(seconds: float, kind: str) -> None:
+    """Account one jit compilation: ``kind`` is the compile site
+    (eager_op / fused_step / cached_op / spmd_step).  Wall time is the
+    first-execution time of the fresh signature — trace+compile
+    dominated; the steady-state replay path never calls this."""
+    ms = seconds * 1e3
+    _C_COMPILES.inc()
+    _C_COMPILE_MS.inc(ms)
+    counter(f"compile.{kind}.count").inc()
+    counter(f"compile.{kind}.ms").inc(ms)
+
+
+def record_comm_bytes(n: int, kind: str = "dense") -> None:
+    """Account collective payload bytes (the unified dense/sparse
+    kvstore byte accounting: dense fused allreduce/allgather payloads,
+    sparse gathered nnz payloads, compressed packed payloads)."""
+    _C_COMM_BYTES.inc(int(n))
+    counter(f"comm.{kind}.bytes").inc(int(n))
+
+
+def record_op_time(name: str, seconds: float) -> None:
+    """Per-op host-dispatch sample (the profiler aggregate table lives
+    in the registry as ``op.<name>`` histograms)."""
+    histogram("op." + name).observe(seconds)
+
+
+# -- sinks -------------------------------------------------------------------
+
+_SINKS: List[Any] = []
+
+
+def add_sink(sink) -> None:
+    if sink not in _SINKS:
+        _SINKS.append(sink)
+
+
+def remove_sink(sink) -> None:
+    if sink in _SINKS:
+        _SINKS.remove(sink)
+    # detaching an env-managed sink must also forget the cached env
+    # value, else _refresh_env_sinks would never re-attach while the
+    # env var is still set (clear_sinks() would otherwise silently kill
+    # MXNET_TELEMETRY_JSONL for the rest of the process)
+    for key, s in _env_sinks.items():
+        if s is sink:
+            _env_sinks[key] = None
+            _env_cache[key] = None
+    close = getattr(sink, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            pass
+
+
+def clear_sinks() -> None:
+    for s in list(_SINKS):
+        remove_sink(s)
+
+
+def sinks() -> List[Any]:
+    return list(_SINKS)
+
+
+class JSONLSink:
+    """One JSON object per step record, appended to ``path``.  Lines
+    are flushed per record so a live run can be tailed."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._f = None
+
+    def emit(self, record: dict) -> None:
+        if self._f is None:
+            self._f = open(self.path, "a", buffering=1)
+        self._f.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class LogSink:
+    """Rate-limited log line every ``every`` emitted step records."""
+
+    def __init__(self, every: int = 50):
+        self.every = max(1, int(every))
+        self._n = 0
+
+    def emit(self, record: dict) -> None:
+        self._n += 1
+        if self._n % self.every:
+            return
+        from .log import get_logger
+        mem = record.get("device_mem") or []
+        in_use = sum(d.get("bytes_in_use", 0) for d in mem)
+        get_logger("mxnet_tpu.telemetry").info(
+            "step %d [%s] host %.2f ms, %d compiles (%.0f ms), "
+            "%d comm bytes, mem %.1f MiB",
+            record["step"], record.get("source", "?"),
+            record.get("host_ms") or 0.0, record.get("compiles", 0),
+            record.get("compile_ms", 0), record.get("collective_bytes", 0),
+            in_use / 1048576)
+
+    def close(self) -> None:
+        pass
+
+
+class TensorBoardSink:
+    """Step-record scalars through any SummaryWriter backend (mxboard
+    or torch.utils.tensorboard — contrib/tensorboard.py resolves)."""
+
+    _SCALARS = ("host_ms", "device_ms", "compiles", "compile_ms",
+                "collective_bytes")
+
+    def __init__(self, logdir_or_writer):
+        if hasattr(logdir_or_writer, "add_scalar"):
+            self.writer = logdir_or_writer
+        else:
+            from .contrib.tensorboard import _summary_writer
+            self.writer = _summary_writer(logdir_or_writer)
+
+    def emit(self, record: dict) -> None:
+        step = record["step"]
+        for k in self._SCALARS:
+            v = record.get(k)
+            if v is not None:
+                self.writer.add_scalar(f"telemetry/{k}", v,
+                                       global_step=step)
+        mem = record.get("device_mem") or []
+        if mem:
+            self.writer.add_scalar(
+                "telemetry/device_bytes_in_use",
+                sum(d.get("bytes_in_use", 0) for d in mem),
+                global_step=step)
+        self.writer.flush()
+
+    def close(self) -> None:
+        self.writer.close()
+
+
+# -- env-driven sink auto-attach --------------------------------------------
+# MXNET_TELEMETRY_JSONL=<path> and MXNET_TELEMETRY_LOG_EVERY=<N> are
+# re-checked on every begin_step at the cost of two dict lookups, so a
+# long-lived process (or a test) can flip them without re-importing.
+
+_env_cache = {"jsonl": None, "log": None}
+_env_sinks = {"jsonl": None, "log": None}
+
+
+def _refresh_env_sinks() -> None:
+    jsonl = os.environ.get("MXNET_TELEMETRY_JSONL") or None
+    if jsonl != _env_cache["jsonl"]:
+        if _env_sinks["jsonl"] is not None:
+            remove_sink(_env_sinks["jsonl"])   # also resets the cache entry
+        _env_cache["jsonl"] = jsonl
+        if jsonl:
+            _env_sinks["jsonl"] = JSONLSink(jsonl)
+            add_sink(_env_sinks["jsonl"])
+    log_every = os.environ.get("MXNET_TELEMETRY_LOG_EVERY") or None
+    if log_every != _env_cache["log"]:
+        if _env_sinks["log"] is not None:
+            remove_sink(_env_sinks["log"])     # also resets the cache entry
+        _env_cache["log"] = log_every
+        if log_every:
+            try:
+                _env_sinks["log"] = LogSink(int(log_every))
+                add_sink(_env_sinks["log"])
+            except ValueError:
+                from .log import get_logger
+                get_logger("mxnet_tpu.telemetry").warning(
+                    "invalid MXNET_TELEMETRY_LOG_EVERY=%r (want an int)",
+                    log_every)
+
+
+def enabled() -> bool:
+    """True when at least one sink is (or should be) attached — the
+    step-record stream only runs then; bare counters always do."""
+    _refresh_env_sinks()
+    return bool(_SINKS)
+
+
+# -- the per-step record stream ---------------------------------------------
+
+class _StepToken:
+    __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes")
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.compiles = _C_COMPILES.value
+        self.compile_ms = _C_COMPILE_MS.value
+        self.comm_bytes = _C_COMM_BYTES.value
+
+
+# nesting guard: gluon.Trainer.step pushes through kvstore.pushpull —
+# only the OUTERMOST funnel emits the step record; inner funnels just
+# keep accumulating counters.  Per-thread so two trainers stepping in
+# different threads don't see each other as nested.
+_tls = threading.local()
+_last_record: Optional[dict] = None
+
+# device-time bridge: profiler.stop() notes the finished trace window
+# here; the next emitted record carries device_ms derived from the
+# xplane table (parsed once, lazily) averaged over the records emitted
+# while the trace was live
+_trace_note = {"dir": None, "steps_at_start": 0}
+_pending_device_ms: Optional[float] = None
+
+
+def _note_trace_start() -> None:
+    _trace_note["steps_at_start"] = _C_STEPS.value
+
+
+def _note_trace_stop(trace_dir: Optional[str]) -> None:
+    global _pending_device_ms
+    if trace_dir is None:
+        return
+    _trace_note["dir"] = trace_dir
+    _pending_device_ms = None   # computed lazily at next emit
+
+
+def _consume_device_ms() -> Optional[float]:
+    """device step ms from the last finished xplane trace, averaged
+    over the step records emitted during the trace window; None when no
+    trace has finished since the last consumption."""
+    global _pending_device_ms
+    tdir = _trace_note["dir"]
+    if tdir is None:
+        return None
+    _trace_note["dir"] = None
+    from . import xplane
+    try:
+        table = xplane.device_op_table(tdir)
+    except Exception:
+        return None
+    if not table:
+        return None
+    total_ms = sum(r["total_us"] for r in table.values()) / 1e3
+    n = max(1, _C_STEPS.value - _trace_note["steps_at_start"])
+    return total_ms / n
+
+
+def device_memory_record() -> List[dict]:
+    """Per-device allocator sample: [{device, bytes_in_use,
+    peak_bytes_in_use, bytes_limit}] — empty fields where the backend
+    exposes no allocator stats (CPU)."""
+    import jax
+    out = []
+    for d in jax.devices():
+        try:
+            st = d.memory_stats() or {}
+        except Exception:
+            st = {}
+        out.append({"device": str(d),
+                    "bytes_in_use": int(st.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(st.get("peak_bytes_in_use",
+                                                    0)),
+                    "bytes_limit": int(st.get("bytes_limit", 0))})
+    return out
+
+
+def begin_step():
+    """Enter a step funnel.  Returns None — the no-op fast path — when
+    telemetry is disabled or this funnel is nested inside another (a
+    Trainer.step's inner kvstore pushpull), else a token capturing the
+    counter baselines for this step's deltas."""
+    depth = getattr(_tls, "depth", 0)
+    if depth == 0 and not enabled():
+        return None
+    _tls.depth = depth + 1
+    if depth:
+        return "nested"
+    return _StepToken()
+
+
+def end_step(token, source: str, extra: Optional[dict] = None) -> None:
+    """Leave a step funnel; the outermost funnel emits one record to
+    every sink.  ``extra`` merges extra fields (e.g. a loss scalar)."""
+    global _last_record
+    if token is None:
+        return
+    _tls.depth = getattr(_tls, "depth", 1) - 1
+    if token == "nested":
+        return
+    host_ms = (time.perf_counter() - token.t0) * 1e3
+    _C_STEPS.inc()
+    record = {
+        "step": _C_STEPS.value,
+        "ts": round(time.time(), 3),
+        "source": source,
+        "host_ms": round(host_ms, 3),
+        "device_ms": _consume_device_ms(),
+        "compiles": _C_COMPILES.value - token.compiles,
+        "compile_ms": round(_C_COMPILE_MS.value - token.compile_ms, 3),
+        "collective_bytes": _C_COMM_BYTES.value - token.comm_bytes,
+        "device_mem": device_memory_record(),
+    }
+    histogram("step.host_ms").observe(host_ms)
+    if extra:
+        record.update(extra)
+    _last_record = record
+    # copy the sink list under the lock but emit OUTSIDE it: a sink's
+    # emit() may itself create registry metrics, and _get_or_create
+    # takes the same (non-reentrant) lock
+    with _LOCK:
+        sinks_now = list(_SINKS)
+    for s in sinks_now:
+        try:
+            s.emit(record)
+        except Exception:
+            # a broken sink must never take down the training step;
+            # drop it with a note rather than raising mid-step
+            from .log import get_logger
+            get_logger("mxnet_tpu.telemetry").exception(
+                "telemetry sink %r failed; detaching", s)
+            remove_sink(s)
+
+
+def last_record() -> Optional[dict]:
+    """The most recently emitted step record (None before any)."""
+    return _last_record
+
+
+def step_count() -> int:
+    return _C_STEPS.value

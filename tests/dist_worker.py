@@ -82,14 +82,15 @@ def main(out_dir):
             for i in range(3)]
     kv4.push(keys, vals)
     profiler.stop()
-    fused = profiler._agg.get("kvstore_fused_allreduce", [])
-    assert len(fused) == 1, \
-        f"expected 1 fused allreduce for 3 keys, saw {len(fused)}"
+    fused = profiler.op_stats().get("kvstore_fused_allreduce",
+                                    {"count": 0})["count"]
+    assert fused == 1, \
+        f"expected 1 fused allreduce for 3 keys, saw {fused}"
     outs = [NDArray(onp.zeros((4 + i,), "float32")) for i in range(3)]
     kv4.pull(keys, out=outs)
     for o in outs:
         onp.testing.assert_allclose(o.asnumpy(), 3.0)
-    profiler._agg.clear()
+    profiler.reset_stats()
 
     # 6. dist_async = SSP over ZeRO shards ------------------------------
     # toy linear regression: y = X·w*, each rank a different data

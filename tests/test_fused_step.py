@@ -109,7 +109,7 @@ def test_one_fused_dispatch_per_step():
         loss = (net(x) ** 2).sum()
     loss.backward()
     assert len(list(net.collect_params().values())) == 8
-    profiler._agg.clear()
+    profiler.reset_stats()
     profiler.set_config(profile_all=True, aggregate_stats=True)
     profiler.start()
     d0 = opt_mod.dispatch_count()
@@ -117,9 +117,9 @@ def test_one_fused_dispatch_per_step():
         trainer.step(batch_size=8)
     finally:
         profiler.stop()
-    records = {k: len(v) for k, v in profiler._agg.items()
+    records = {k: v["count"] for k, v in profiler.op_stats().items()
                if k.startswith("FusedStep::")}
-    profiler._agg.clear()
+    profiler.reset_stats()
     assert records == {"FusedStep::SGD": 1}
     assert opt_mod.dispatch_count() - d0 == 1
 
@@ -225,10 +225,13 @@ def test_max_jit_sigs_env(monkeypatch):
 
 def test_profiler_counters_snapshot():
     c = profiler.counters()
-    assert set(c) == {"eager_jit", "fused_step", "optimizer"}
+    assert set(c) == {"eager_jit", "fused_step", "optimizer",
+                      "compile", "comm"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
     assert c["optimizer"]["dispatches"] >= 0
+    assert set(c["compile"]) == {"count", "ms"}
+    assert set(c["comm"]) == {"bytes"}
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100
     assert profiler.counters()["fused_step"]["steps"] != \

@@ -1,0 +1,402 @@
+"""Unified telemetry runtime (mxnet_tpu/telemetry.py): per-step JSONL
+records from the Trainer funnel, one shared registry behind
+profiler.counters()/dumps(), zero-cost disabled path, Monitor parity,
+and the profiler satellite fixes (pause/resume trace dir, bounded
+aggregate table, visible user counters)."""
+import importlib.util
+import json
+import os
+import pathlib
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, profiler, telemetry
+from mxnet_tpu.gluon import nn
+
+
+@pytest.fixture(autouse=True)
+def _clean_sinks():
+    """Every test starts and ends with no sinks attached and the env
+    auto-attach cache in sync with the (restored) environment."""
+    telemetry.clear_sinks()
+    yield
+    telemetry.clear_sinks()
+    telemetry.enabled()     # re-sync env cache after monkeypatch undo
+
+
+def _make_net(seed=7):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"))
+    net.add(nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier(rnd_type="gaussian",
+                                              magnitude=2.0))
+    return net
+
+
+def _train_3_steps(net, trainer, x):
+    losses = []
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(batch_size=x.shape[0])
+        losses.append(float(loss.asnumpy()))
+    return losses
+
+
+REQUIRED_KEYS = ("step", "host_ms", "compiles", "collective_bytes",
+                 "device_mem")
+
+
+def test_jsonl_three_step_records(tmp_path, monkeypatch):
+    """The tier-1 contract: 3 Trainer.steps with MXNET_TELEMETRY_JSONL
+    set emit exactly 3 well-formed records whose compile deltas agree
+    with profiler.counters() (one shared registry)."""
+    path = os.environ.get("MXNET_TELEMETRY_JSONL_CI_PATH") \
+        or str(tmp_path / "run.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    x = nd.array(onp.random.RandomState(0).randn(8, 16).astype("float32"))
+
+    rng = onp.random.RandomState(0)
+    per_step_compiles = []
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        c0 = telemetry.counter("compile.count").value
+        trainer.step(batch_size=8)
+        per_step_compiles.append(
+            telemetry.counter("compile.count").value - c0)
+
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()       # detach the env sink, closing the file
+
+    lines = [l for l in pathlib.Path(path).read_text().splitlines() if l]
+    assert len(lines) == 3, f"expected exactly 3 records, got {len(lines)}"
+    records = [json.loads(l) for l in lines]
+    for rec in records:
+        for key in REQUIRED_KEYS:
+            assert key in rec, f"record missing {key!r}: {rec}"
+        assert rec["source"] == "gluon.Trainer"
+        assert rec["host_ms"] > 0
+        assert isinstance(rec["device_mem"], list) and rec["device_mem"]
+        assert "bytes_in_use" in rec["device_mem"][0]
+    # consecutive step indices (one record per step, none doubled by
+    # the nested kvstore funnel)
+    steps = [r["step"] for r in records]
+    assert steps == list(range(steps[0], steps[0] + 3))
+    # the per-record compile delta is the registry delta measured
+    # around each step — same counter, no second bookkeeping
+    assert [r["compiles"] for r in records] == per_step_compiles
+    # first step pays the fused-step compile; steady state pays none
+    assert records[0]["compiles"] >= 1
+    assert records[1]["compiles"] == records[2]["compiles"] == 0
+    # registry agreement: profiler.counters() reads the same objects
+    c = profiler.counters()
+    assert c["compile"]["count"] == telemetry.counter("compile.count").value
+    assert c["comm"]["bytes"] == telemetry.counter("comm.bytes").value
+    assert c["compile"]["ms"] == pytest.approx(
+        telemetry.counter("compile.ms").value)
+
+
+def test_report_tool_matches_jsonl(tmp_path, monkeypatch):
+    """tools/telemetry_report.py totals reconcile with the raw records
+    (acceptance: report output == JSONL sums == registry deltas)."""
+    path = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    x = nd.array(onp.random.RandomState(1).randn(4, 16).astype("float32"))
+    _train_3_steps(net, trainer, x)
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools" / "telemetry_report.py")
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+
+    records = report.load(path)
+    assert len(records) == 3
+    s = report.summarize(records)
+    assert s["steps"] == 3
+    assert s["compiles"] == sum(r["compiles"] for r in records)
+    assert s["collective_bytes"] == sum(r["collective_bytes"]
+                                        for r in records)
+    assert s["compile_ms"] == pytest.approx(
+        sum(r["compile_ms"] for r in records))
+    table = report.render(s)
+    assert "jit compiles" in table and "host step ms p50" in table
+
+
+def test_disabled_no_sink_io_and_bitwise_outputs(tmp_path, monkeypatch):
+    """With telemetry disabled: begin_step takes the no-op fast path, no
+    record is emitted, no file appears — and training numerics are
+    bitwise IDENTICAL to a run with the JSONL sink attached (the
+    instrumentation never touches the math)."""
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL", raising=False)
+    monkeypatch.delenv("MXNET_TELEMETRY_LOG_EVERY", raising=False)
+    telemetry.enabled()
+    assert telemetry.begin_step() is None      # the fast path
+
+    x = nd.array(onp.random.RandomState(2).randn(8, 16).astype("float32"))
+
+    def run(jsonl=None):
+        if jsonl is not None:
+            monkeypatch.setenv("MXNET_TELEMETRY_JSONL", jsonl)
+        else:
+            monkeypatch.delenv("MXNET_TELEMETRY_JSONL", raising=False)
+        telemetry.enabled()
+        mx.random.seed(42)          # identical init for both runs
+        net = _make_net()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.05, "momentum": 0.9})
+        losses = _train_3_steps(net, trainer, x)
+        params = {k: v.data().asnumpy()
+                  for k, v in net.collect_params().items()}
+        return losses, params
+
+    steps_before = telemetry.step_count()
+    off_losses, off_params = run(jsonl=None)
+    assert telemetry.step_count() == steps_before   # nothing emitted
+    assert list(tmp_path.iterdir()) == []           # and no file I/O
+
+    on_losses, on_params = run(jsonl=str(tmp_path / "on.jsonl"))
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()
+    assert (tmp_path / "on.jsonl").exists()
+
+    assert off_losses == on_losses
+    assert set(off_params) == set(on_params)
+    for k in off_params:
+        onp.testing.assert_array_equal(off_params[k], on_params[k])
+
+
+def test_nested_funnels_emit_one_record(tmp_path, monkeypatch):
+    """Trainer.step drives kvstore.pushpull internally — the depth
+    guard must keep that to ONE record per step (source = the outermost
+    funnel)."""
+    path = str(tmp_path / "nested.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    # without the fused fold, grads really round-trip kvstore.pushpull
+    monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05}, kvstore="local")
+    x = nd.array(onp.random.RandomState(3).randn(4, 16).astype("float32"))
+    _train_3_steps(net, trainer, x)
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()
+    records = [json.loads(l) for l in
+               pathlib.Path(path).read_text().splitlines() if l]
+    assert len(records) == 3
+    assert all(r["source"] == "gluon.Trainer" for r in records)
+    # the inner kvstore push accounted its payload into the step record
+    assert all(r["collective_bytes"] > 0 for r in records)
+
+
+def test_registry_metric_identity_and_reset():
+    c = telemetry.counter("test.some_counter")
+    c.inc(5)
+    assert telemetry.counter("test.some_counter") is c
+    telemetry.reset("test.")
+    assert c.value == 0
+    assert telemetry.counter("test.some_counter") is c   # object kept
+    with pytest.raises(mx.base.MXNetError):
+        telemetry.gauge("test.some_counter")     # type mismatch rejected
+
+
+def test_histogram_reservoir_bounded():
+    """The bounded-_agg satellite: 1000 samples keep count/total exact
+    while the raw-sample memory stays at the reservoir cap."""
+    h = telemetry.histogram("test.bounded")
+    h.reset()
+    for i in range(1000):
+        h.observe(float(i))
+    assert h.count == 1000
+    assert h.total == pytest.approx(sum(range(1000)))
+    assert h.min == 0.0 and h.max == 999.0
+    assert len(h.samples()) == telemetry._RESERVOIR
+    h.reset()
+
+
+def test_profiler_op_table_bounded(monkeypatch):
+    """record_op feeds the same bounded histograms (the old _agg list
+    grew one float per op call forever)."""
+    profiler.reset_stats()
+    for _ in range(500):
+        profiler.record_op("test_bounded_op", 1e-4)
+    st = profiler.op_stats()["test_bounded_op"]
+    assert st["count"] == 500
+    h = telemetry.histogram("op.test_bounded_op")
+    assert len(h.samples()) <= telemetry._RESERVOIR
+    profiler.reset_stats()
+
+
+def test_profiler_counter_visible_in_dumps():
+    """Satellite: profiler.Counter is registry-backed, not write-only —
+    set/increment/decrement show up in dumps()."""
+    c = profiler.Counter("telemetry_test_counter", value=5)
+    c.increment(4)
+    c.decrement(2)
+    assert c.value == 7
+    out = profiler.dumps()
+    assert "telemetry_test_counter" in out
+    assert "7" in out
+    telemetry.reset("user_counter.")
+
+
+def test_profiler_pause_resume_keeps_trace_dir(tmp_path):
+    """Satellite: pause()/resume() suspend the SAME capture cycle —
+    the trace dir must not rotate until stop()."""
+    profiler.set_config(profile_all=True,
+                        filename=str(tmp_path / "p.json"))
+    profiler.start()
+    try:
+        d0 = profiler.trace_dir()
+        assert d0 is not None
+        profiler.pause()
+        assert profiler.is_running()
+        profiler.resume()
+        assert profiler.trace_dir() == d0
+    finally:
+        profiler.stop()
+    assert profiler.trace_dir() == d0
+
+
+def test_profiler_dump_not_finished_keeps_running(tmp_path):
+    """Satellite: dump(finished=False) snapshots without stopping."""
+    profiler.set_config(profile_all=True,
+                        filename=str(tmp_path / "snap.json"))
+    profiler.start()
+    try:
+        profiler.dump(finished=False)
+        assert profiler.is_running(), \
+            "dump(finished=False) must not stop the profiler"
+        assert (tmp_path / "snap.json").exists()
+    finally:
+        profiler.stop()
+    assert not profiler.is_running()
+
+
+def test_monitor_collects_output_weight_grad_stats():
+    net = _make_net()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*").install(net)
+    x = nd.array(onp.random.RandomState(4).randn(4, 16).astype("float32"))
+    try:
+        mon.tic()
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        stats = mon.toc()
+    finally:
+        mon.uninstall()
+    names = {name for _, name, _ in stats}
+    assert any(name.endswith("_output") for name in names), names
+    assert any(name.endswith("_grad") for name in names), names
+    assert any(not name.endswith(("_output", "_grad"))
+               for name in names), names            # plain weights too
+    for _, name, stat in stats:
+        assert isinstance(stat, float)
+        assert telemetry.gauge(f"monitor.{name}").value == stat
+    # second tic with interval satisfied arms again; toc drains
+    mon2 = mx.monitor.Monitor(interval=2)
+    mon2.tic()
+    assert mon2.activated
+    mon2.toc()
+    mon2.tic()
+    assert not mon2.activated     # interval=2 skips the odd step
+
+
+def test_monitor_env_disarm(monkeypatch):
+    monkeypatch.setenv("MXNET_MONITOR", "0")
+    net = _make_net()
+    mon = mx.monitor.Monitor(interval=1).install(net)
+    x = nd.array(onp.random.RandomState(5).randn(4, 16).astype("float32"))
+    try:
+        mon.tic()
+        net(x)
+        stats = mon.toc()
+    finally:
+        mon.uninstall()
+    assert stats == []
+    assert not mon.activated
+
+
+def test_estimator_telemetry_handler(tmp_path):
+    from mxnet_tpu.gluon.contrib.estimator import TelemetryHandler
+
+    path = str(tmp_path / "est.jsonl")
+    handler = TelemetryHandler(jsonl=path)
+    handler.train_begin(None)
+    assert any(isinstance(s, telemetry.JSONLSink)
+               for s in telemetry.sinks())
+
+    class _Est:
+        pass
+
+    from mxnet_tpu.gluon import metric as metric_mod
+
+    est = _Est()
+    est.train_metrics = [metric_mod.Loss()]
+    est.train_metrics[0].update(0, nd.array(onp.ones((2,), "float32")))
+    handler.batch_end(est)
+    name, value = est.train_metrics[0].get()
+    assert telemetry.gauge(f"estimator.{name}").value == value
+    handler.train_end(None)
+    assert telemetry.sinks() == []
+
+
+def test_tensorboard_sink_writes_scalars():
+    class _FakeWriter:
+        def __init__(self):
+            self.scalars = []
+            self.flushed = self.closed = False
+
+        def add_scalar(self, tag, value, global_step=None):
+            self.scalars.append((tag, value, global_step))
+
+        def flush(self):
+            self.flushed = True
+
+        def close(self):
+            self.closed = True
+
+    w = _FakeWriter()
+    sink = telemetry.TensorBoardSink(w)
+    sink.emit({"step": 7, "host_ms": 1.5, "device_ms": None,
+               "compiles": 2, "compile_ms": 10.0,
+               "collective_bytes": 64,
+               "device_mem": [{"bytes_in_use": 128}]})
+    tags = {t for t, _, _ in w.scalars}
+    assert "telemetry/host_ms" in tags
+    assert "telemetry/device_ms" not in tags      # None is skipped
+    assert "telemetry/device_bytes_in_use" in tags
+    assert all(s == 7 for _, _, s in w.scalars)
+    assert w.flushed
+    sink.close()
+    assert w.closed
+
+
+def test_broken_sink_detaches_without_breaking_step(tmp_path, monkeypatch):
+    class _Boom:
+        def emit(self, record):
+            raise RuntimeError("sink exploded")
+
+    boom = _Boom()
+    telemetry.add_sink(boom)
+    net = _make_net()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05})
+    x = nd.array(onp.random.RandomState(6).randn(4, 16).astype("float32"))
+    _train_3_steps(net, trainer, x)     # must not raise
+    assert boom not in telemetry.sinks()

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Summarize a telemetry JSONL run (MXNET_TELEMETRY_JSONL output).
+
+Reads the per-step records mxnet_tpu/telemetry.py emits and prints one
+table: step-time percentiles (host + device where a trace was live),
+compile stalls (steps that paid jit compilation, and how much), and
+collective bytes per step — the three first-order XLA health signals.
+
+Usage:
+    python tools/telemetry_report.py run.jsonl
+    python tools/telemetry_report.py run.jsonl --json   # machine-readable
+
+The totals printed here are straight sums over the record deltas, so
+they reconcile exactly with ``profiler.counters()`` taken at the end of
+the run (both read the same registry — see docs/ARCHITECTURE.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def percentile(sorted_vals, q):
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   round(q / 100 * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+def load(path):
+    records = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i}: bad JSONL record: {e}")
+    return records
+
+
+def summarize(records):
+    host = sorted(r["host_ms"] for r in records if r.get("host_ms")
+                  is not None)
+    device = sorted(r["device_ms"] for r in records
+                    if r.get("device_ms") is not None)
+    compiles = sum(r.get("compiles", 0) for r in records)
+    compile_ms = sum(r.get("compile_ms", 0) for r in records)
+    stall_steps = [r for r in records if r.get("compiles", 0) > 0]
+    total_bytes = sum(r.get("collective_bytes", 0) for r in records)
+    peak_mem = 0
+    for r in records:
+        for d in r.get("device_mem") or []:
+            peak_mem = max(peak_mem, d.get("peak_bytes_in_use", 0),
+                           d.get("bytes_in_use", 0))
+    by_source = {}
+    for r in records:
+        by_source[r.get("source", "?")] = \
+            by_source.get(r.get("source", "?"), 0) + 1
+    return {
+        "steps": len(records),
+        "by_source": by_source,
+        "host_ms": {"p50": percentile(host, 50),
+                    "p95": percentile(host, 95),
+                    "max": host[-1] if host else 0.0},
+        "device_ms": {"p50": percentile(device, 50),
+                      "p95": percentile(device, 95)} if device else None,
+        "compiles": compiles,
+        "compile_ms": compile_ms,
+        "compile_stall_steps": len(stall_steps),
+        "collective_bytes": total_bytes,
+        "bytes_per_step": total_bytes / len(records) if records else 0,
+        "peak_device_bytes": peak_mem,
+    }
+
+
+def render(s):
+    lines = ["Telemetry run summary",
+             "=" * 52,
+             f"{'steps':<28}{s['steps']:>24}"]
+    for src, n in sorted(s["by_source"].items()):
+        lines.append(f"{'  from ' + src:<28}{n:>24}")
+    lines += [
+        f"{'host step ms p50':<28}{s['host_ms']['p50']:>24.3f}",
+        f"{'host step ms p95':<28}{s['host_ms']['p95']:>24.3f}",
+        f"{'host step ms max':<28}{s['host_ms']['max']:>24.3f}",
+    ]
+    if s["device_ms"]:
+        lines += [
+            f"{'device step ms p50':<28}{s['device_ms']['p50']:>24.3f}",
+            f"{'device step ms p95':<28}{s['device_ms']['p95']:>24.3f}",
+        ]
+    lines += [
+        f"{'jit compiles':<28}{s['compiles']:>24}",
+        f"{'compile wall ms':<28}{s['compile_ms']:>24.1f}",
+        f"{'steps stalled on compile':<28}{s['compile_stall_steps']:>24}",
+        f"{'collective bytes total':<28}{s['collective_bytes']:>24}",
+        f"{'collective bytes / step':<28}{s['bytes_per_step']:>24.1f}",
+        f"{'peak device bytes':<28}{s['peak_device_bytes']:>24}",
+    ]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="telemetry JSONL file to summarize")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as JSON instead of a table")
+    args = ap.parse_args(argv)
+    records = load(args.jsonl)
+    if not records:
+        raise SystemExit(f"{args.jsonl}: no telemetry records")
+    s = summarize(records)
+    if args.json:
+        json.dump(s, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print(render(s))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
