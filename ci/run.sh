@@ -68,6 +68,14 @@ PY
     rm -f "$out"
 }
 
+cached_step_smoke() { # whole-step capture: tests + dispatch-count bench
+    # the tier-1 suite covers the 1-dispatch acceptance + fallback matrix
+    JAX_PLATFORMS=cpu python -m pytest tests/test_cached_step.py -q
+    # then the bench must show 2N+1 -> 1 dispatches/step with matching
+    # numerics on the 8- and 32-layer MLPs (exits non-zero otherwise)
+    JAX_PLATFORMS=cpu python benchmark/cached_step_bench.py --steps 10
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

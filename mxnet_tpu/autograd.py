@@ -23,6 +23,12 @@ import jax
 import jax.numpy as jnp
 
 from .base import MXNetError
+from . import telemetry
+from .imperative import cached_step as _cached_step
+
+# every real vjp executable dispatch ticks the unified dispatch counter
+# (see imperative/cached_step.py — the observable behind 1-dispatch/step)
+_DISPATCH_CT = telemetry.counter("dispatch.count")
 
 __all__ = [
     "record", "pause", "train_mode", "predict_mode",
@@ -85,6 +91,11 @@ class _Scope:
     def __enter__(self):
         if self._rec is not None:
             self._old_rec = set_recording(self._rec)
+            if self._rec is True and not self._old_rec:
+                # outermost record() scope: the cached-step capture
+                # (imperative/cached_step.py) observes — or defers —
+                # the training step starting here
+                _cached_step.note_record_enter()
         if self._train is not None:
             self._old_train = set_training(self._train)
         return self
@@ -213,6 +224,13 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     if head_grads is None:
         head_grads = [None] * len(heads)
 
+    # A deferring cached step absorbs the backward into its capture
+    # (or materializes and falls through to the real one below).
+    if _cached_step._ACTIVE and _cached_step.deferred_backward(
+            heads, head_grads, retain_graph, train_mode, create_graph,
+            _collect_nodes):
+        return None
+
     # Seed output cotangents.
     head_nodes = []
     for h, hg in zip(heads, head_grads):
@@ -305,19 +323,32 @@ def backward(heads, head_grads=None, retain_graph: bool = False,
     for node in touched:
         node.out_grad = None
 
+    # expose the completed eager step to the cached-step observer so
+    # Trainer.step can arm a capture for the next iteration
+    if not create_graph and _collect_nodes is None:
+        _cached_step.note_backward(tape, heads, head_grads, train_mode,
+                                   retain_graph)
+
     if not retain_graph:
         _st().tape = [r for r in tape if not r.consumed]
     return collected
 
 
-# jitted-backward cache: (stable fn, n_in, multi_out) → (_JitEntry, bwd).
-# Keyed on the op registry's cached partials (registry._STABLE_FNS), whose
-# identity persists across steps — so the vjp of each op traces/compiles
-# once and every later eager backward replays the compiled transpose
-# (forward is rematerialized *inside* the compiled program: same
-# FLOPs-for-HBM trade as before, without per-step retracing).  The key
-# owns the fn, so no id-reuse hazard.
+# jitted-backward cache: ((stable fn, n_in, multi_out, env), avals) →
+# (_JitEntry, bwd).  Keyed on the op registry's cached partials
+# (registry._STABLE_FNS), whose identity persists across steps — so the
+# vjp of each op traces/compiles once PER INPUT SIGNATURE and every
+# _OpRecord with the same (fn, avals) — e.g. 32 identical Dense layers —
+# replays the SAME compiled transpose (forward is rematerialized
+# *inside* the compiled program: FLOPs-for-HBM trade without per-step
+# retracing).  The family table bounds distinct avals per fn at
+# registry._MAX_JIT_SIGS; signatures beyond the cap run the eager vjp
+# WITHOUT latching, so already-compiled signatures keep replaying
+# compiled (the old per-family _JitEntry demoted the whole fn to eager
+# forever once its sig budget overflowed).  The key owns the fn, so no
+# id-reuse hazard.
 _BWD_JIT: dict = {}
+_BWD_FAMS: dict = {}    # family → set of avals granted a compile slot
 
 
 def _make_bwd(fn, n_in, multi):
@@ -341,17 +372,29 @@ def _make_bwd(fn, n_in, multi):
 def _get_jitted_bwd(rec: _OpRecord):
     from .ops import registry
 
-    if rec.fn not in registry._STABLE_FNS:
+    fn = rec.fn
+    if fn not in registry._STABLE_FNS and \
+            not getattr(fn, "_mx_stable_fn", False):
         return None
     # env-numerics participates in the key: a no-params op caches the bare
     # op.fn under both env settings, so fn identity alone would replay a
     # backward traced under the other setting
-    key = (rec.fn, len(rec.saved_inputs), rec.multi_out,
+    fam = (fn, len(rec.saved_inputs), rec.multi_out,
            registry._env_numerics_key())
-    cached = _BWD_JIT.get(key)
+    try:
+        avals = tuple((tuple(a.shape), str(a.dtype))
+                      for a in rec.saved_inputs)
+    except Exception:       # shape-less saved input (sparse container)
+        return None
+    cached = _BWD_JIT.get((fam, avals))
     if cached is None:
-        bwd = _make_bwd(rec.fn, len(rec.saved_inputs), rec.multi_out)
-        cached = _BWD_JIT[key] = (registry._JitEntry(bwd), bwd)
+        seen = _BWD_FAMS.setdefault(fam, set())
+        if avals not in seen:
+            if len(seen) >= registry._MAX_JIT_SIGS:
+                return None         # over budget: eager vjp, no latch
+            seen.add(avals)
+        bwd = _make_bwd(fn, len(rec.saved_inputs), rec.multi_out)
+        cached = _BWD_JIT[(fam, avals)] = (registry._JitEntry(bwd), bwd)
     return cached
 
 
@@ -359,6 +402,7 @@ def _apply_vjp(rec: _OpRecord, out_grads, create_graph: bool):
     """Compute input cotangents for one record and accumulate into in_nodes."""
     from .ndarray import NDArray
 
+    _DISPATCH_CT.inc()
     fn, saved = rec.fn, rec.saved_inputs
 
     if rec.sparse_bwd is not None and not create_graph:

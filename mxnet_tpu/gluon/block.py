@@ -242,6 +242,11 @@ class Block:
 
     # -- call --------------------------------------------------------------
     def __call__(self, *args, **kwargs):
+        if self._forward_pre_hooks or self._forward_hooks:
+            # hooks observe real activations: a step with hooks attached
+            # can neither be captured nor stay deferred
+            from ..imperative import cached_step as _cs
+            _cs.notify_hooks()
         for hook in self._forward_pre_hooks:
             hook(self, args)
         out = self.forward(*args, **kwargs)
@@ -490,6 +495,16 @@ class HybridBlock(Block):
                     p._data = s
 
         jitted = jax.jit(traced)
+        # the cached-graph fn's identity is stable for the life of this
+        # signature entry: mark it so autograd's backward-jit cache and
+        # the whole-step capture (imperative/cached_step.py) treat it
+        # like a registry partial
+        try:
+            jitted._mx_stable_fn = True
+            from ..ops import registry as _registry
+            _registry._STABLE_FNS.add(jitted)
+        except Exception:
+            pass
         # prime the cache: one call to populate `cell` via tracing
         key = _rng.next_key()
         sample = [key] + [p.data()._data for p in pvals] + \

@@ -203,6 +203,13 @@ class Trainer:
             if new_rescale != self._optimizer.rescale_grad:
                 self._optimizer.rescale_grad = new_rescale
                 self._reship_server_optimizer()
+            # whole-step capture (imperative/cached_step.py): a deferred
+            # record→backward→step executes as ONE donated executable
+            # here; otherwise the completed eager step below is observed
+            # so the NEXT step can be captured
+            from ..imperative import cached_step
+            if cached_step.trainer_step(self, ignore_stale_grad):
+                return
             if not self._fold_device_allreduce():
                 self._allreduce_grads()
             self._update(ignore_stale_grad)
@@ -271,6 +278,10 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         tok = telemetry.begin_step()
         try:
+            # update() is the manual-allreduce variant: only step() owns
+            # whole-step capture, so materialize any pending deferral
+            from ..imperative import cached_step
+            cached_step.break_if_deferring("Trainer.update")
             if not self._kv_initialized:
                 self._init_kvstore()
             new_rescale = self._scale / batch_size

@@ -1,0 +1,10 @@
+"""Imperative-runtime subsystems (parity: src/imperative/).
+
+``cached_step`` is the analogue of the reference's CachedOp
+(src/imperative/cached_op.h:463) extended through the optimizer: whole
+``record -> backward -> step`` training steps captured as ONE donated
+XLA executable.
+"""
+from . import cached_step
+
+__all__ = ["cached_step"]

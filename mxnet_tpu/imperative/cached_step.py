@@ -1,0 +1,1163 @@
+"""Whole-step graph capture for eager Gluon training.
+
+Parity: the reference's only graph executor is ``CachedOp``
+(src/imperative/cached_op.h:463), which captures the full
+forward+backward graph of an imperatively written Gluon model and
+replays it as one engine op.  This module extends that idea through the
+optimizer: after a warm-up ``record() -> backward() -> Trainer.step()``
+runs eagerly, the autograd tape (the ``_OpRecord`` list — op fn, saved
+inputs, node topology) is exported into a *structure*, and the next
+matching step is **deferred**: every recorded op returns a placeholder
+(`_DeferredData`) instead of dispatching, ``backward`` marks the
+parameter gradients deferred, and ``Trainer.step`` compiles + executes
+ONE donated ``jax.jit`` that replays the forward ops, the whole-graph
+vjp, and the fused optimizer update (optimizer/fused_step.py) as a
+single XLA executable — 1 dispatch/step instead of ~2N+1.
+
+Keying and fallback:
+
+- executables are keyed on a *tape-structure hash* — per-record
+  (fn identity, input sources, shape/dtype signature), heads,
+  parameter specs, optimizer family, train-mode flags, env-numerics —
+  so an input shape change or control-flow divergence re-captures
+  under a new key;
+- per-trainer key count is capped at the op funnel's
+  ``MXNET_JIT_MAX_SIGS`` latch (ops/registry.py); structure churn
+  beyond the cap latches capture off for that trainer;
+- any host sync on a deferred array (``asnumpy``, ``wait_to_read``,
+  ``copyto``, dlpack, ``NDArray(...)`` construction) or a structure
+  mismatch is a **graph break**: the pending ops replay eagerly in
+  tape order, a pending backward runs for real, and the step falls
+  back to the normal eager path with identical results.  Persistent
+  breaks also latch capture off.
+- ``MXNET_CACHED_STEP=0`` disables capture entirely (bitwise-identical
+  to the plain eager path, since nothing is ever deferred).
+
+Numerics: the captured executable replays the SAME per-op fns the
+eager path dispatches, and the cotangent chain is the same composition
+``jax.vjp`` computes op-by-op — any difference is XLA fusion ordering
+inside one executable (within 1e-6; bitwise in practice for the common
+dense stacks).
+
+Telemetry: ``cachedstep.{hits,compiles,fallbacks,graph_breaks}``
+counters ride the per-step record (telemetry.end_step) and
+``profiler.counters()['cached_step']``; every real XLA dispatch
+anywhere (op funnel, vjp, fused/cached step) ticks ``dispatch.count``,
+the observable behind the 1-dispatch/step claim.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .. import telemetry
+
+__all__ = ["enabled", "stats", "reset_stats", "trainer_state",
+           "trainer_step", "resolve", "ensure_real"]
+
+# -- counters ----------------------------------------------------------------
+
+_STATS = {"captures": 0, "compiles": 0, "hits": 0, "steps": 0,
+          "fallbacks": 0, "graph_breaks": 0}
+
+_C_HITS = telemetry.counter("cachedstep.hits")
+_C_COMPILES = telemetry.counter("cachedstep.compiles")
+_C_FALLBACKS = telemetry.counter("cachedstep.fallbacks")
+_C_BREAKS = telemetry.counter("cachedstep.graph_breaks")
+# the unified dispatch counter: ONE tick per real XLA executable
+# dispatch, at every site (op funnel forward, autograd vjp, fused
+# optimizer step, cached whole-step).  profiler.counters()['dispatch'].
+_C_DISPATCH = telemetry.counter("dispatch.count")
+
+
+def stats() -> Dict[str, int]:
+    """Snapshot of the cached-step counters (profiler.counters())."""
+    return dict(_STATS)
+
+
+def reset_stats() -> None:
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def enabled() -> bool:
+    """MXNET_CACHED_STEP: set to 0/false/off to disable whole-step
+    capture (read per step so tests and long-lived processes can
+    toggle it)."""
+    return os.environ.get("MXNET_CACHED_STEP", "1").lower() \
+        not in ("0", "false", "off")
+
+
+# -- fast-path gate ----------------------------------------------------------
+# number of threads currently deferring: the op funnel and the NDArray
+# host-sync hooks check this one module int before paying any further
+# cost, so with capture idle the overhead is a single attribute read.
+_ACTIVE = 0
+
+_tls = threading.local()
+
+
+def _t():
+    st = _tls
+    if not hasattr(st, "ctx"):
+        st.ctx = None       # active _Ctx (this thread is deferring)
+        st.obs = None       # _Obs being gathered by the eager warm-up
+        st.armed = None     # _State of the last trainer that armed
+    return st
+
+
+_PASS = object()            # intercept sentinel: "run the op normally"
+
+
+# -- placeholder -------------------------------------------------------------
+
+class _DeferredData:
+    """Stands in for a not-yet-computed jax array while a step is
+    deferred.  Carries enough metadata (shape/dtype) for the cheap
+    NDArray properties; any real read is a graph break.  ``value`` is
+    filled at materialization so aliases held across the boundary still
+    resolve."""
+
+    __slots__ = ("shape", "dtype", "kind", "pos", "idx", "value", "owner")
+
+    def __init__(self, shape, dtype, kind, pos, idx, owner):
+        self.shape = tuple(shape)
+        self.dtype = onp.dtype(dtype)
+        self.kind = kind            # "out" (tape op output) | "grad"
+        self.pos = pos
+        self.idx = idx
+        self.value = None
+        self.owner = owner          # the _Ctx that created it
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        n = 1
+        for d in self.shape:
+            n *= int(d)
+        return n
+
+
+def resolve(a):
+    """Real jax array for ``a``: pass-through for non-deferred values;
+    a deferred value triggers a graph break (materializing the whole
+    pending step) and returns the computed array."""
+    if type(a) is not _DeferredData:
+        return a
+    if a.value is None:
+        st = _t()
+        if st.ctx is not None and a.owner is st.ctx:
+            _break(st.ctx, "host sync on a deferred array")
+    if a.value is None:
+        from ..base import MXNetError
+        raise MXNetError("internal: a deferred array escaped its "
+                         "captured step without being materialized")
+    return a.value
+
+
+def ensure_real(nd) -> None:
+    """Resolve ``nd._data`` in place when it is deferred (the NDArray
+    host-sync hook)."""
+    if type(nd._data) is _DeferredData:
+        nd._data = resolve(nd._data)
+
+
+# -- per-trainer capture state ----------------------------------------------
+
+class _Entry:
+    __slots__ = ("structure", "compiled", "jfn")
+
+    def __init__(self, structure):
+        self.structure = structure
+        self.jfn = None          # lazily built jax.jit wrapper
+        self.compiled = None     # AOT-compiled executable
+
+
+class _State:
+    """Per-trainer capture state: {structure key -> _Entry}, capped at
+    the funnel's ``MXNET_JIT_MAX_SIGS``; persistent graph breaks or key
+    churn latch capture off for the trainer."""
+
+    __slots__ = ("trainer", "cache", "bad", "current", "breaks",
+                 "disabled", "last_reason")
+
+    def __init__(self, trainer):
+        import weakref
+        self.trainer = weakref.ref(trainer)
+        self.cache: Dict[Any, _Entry] = {}
+        self.bad: set = set()
+        self.current: Optional[_Entry] = None
+        self.breaks = 0
+        self.disabled = False
+        self.last_reason: Optional[str] = None
+
+
+def trainer_state(trainer) -> Dict[str, Any]:
+    """Introspection helper (tests / debugging)."""
+    state = getattr(trainer, "_cached_step_state", None)
+    if state is None:
+        return {"captures": 0, "breaks": 0, "disabled": False,
+                "armed": False, "last_reason": None}
+    return {"captures": len(state.cache), "breaks": state.breaks,
+            "disabled": state.disabled,
+            "armed": state.current is not None,
+            "last_reason": state.last_reason}
+
+
+# -- structure (exported tape) ----------------------------------------------
+
+class _Step:
+    __slots__ = ("fn", "multi", "sources", "n_out")
+
+    def __init__(self, fn, multi, sources, n_out):
+        self.fn = fn
+        self.multi = multi
+        self.sources = sources      # per input: ("out",pos,idx) |
+        #                             ("param",k) | ("frozen",q) | ("ext",e)
+        self.n_out = n_out
+
+
+class _Structure:
+    __slots__ = ("steps", "out_shdty", "ext_specs", "diff_idx", "frozen_idx",
+                 "param_shdty", "frozen_shdty", "heads", "head_shdty",
+                 "head_seed_ext", "statics_key", "dyn_names", "op_name",
+                 "opt_type", "training", "bwd_train", "key")
+
+
+class _Obs:
+    """What the eager warm-up step exposes for arming: the tape segment
+    plus head/flag metadata, gathered by the autograd hooks."""
+
+    __slots__ = ("training", "poisoned", "reason", "records", "heads",
+                 "bwd_train", "tape_base")
+
+    def __init__(self, training, tape_base=0):
+        self.training = bool(training)
+        self.poisoned = False
+        self.reason = None
+        self.records: Optional[List] = None
+        self.heads: Optional[List] = None   # (node, shape, np_dtype, hg_spec)
+        self.bwd_train = True
+        # records before this index are stale tape garbage from earlier
+        # never-backpropagated work — outside the captured segment
+        self.tape_base = tape_base
+
+    def poison(self, reason):
+        if not self.poisoned:
+            self.poisoned = True
+            self.reason = reason
+
+
+# -- deferral context --------------------------------------------------------
+
+class _Ctx:
+    __slots__ = ("state", "structure", "pos", "recs", "ext_vals",
+                 "param_arrays", "frozen_arrays", "backward_done",
+                 "heads_nd", "head_grads_nd", "bwd_train_arg", "grad_marks")
+
+    def __init__(self, state, structure, param_arrays, frozen_arrays):
+        self.state = state
+        self.structure = structure
+        self.pos = 0
+        self.recs: List[Tuple[Any, List]] = []   # (_OpRecord, [out NDArray])
+        self.ext_vals: List = [None] * len(structure.ext_specs)
+        self.param_arrays = param_arrays
+        self.frozen_arrays = frozen_arrays
+        self.backward_done = False
+        self.heads_nd = None
+        self.head_grads_nd = None
+        self.bwd_train_arg = True
+        self.grad_marks: List = []               # (grad_nd, placeholder, orig)
+
+
+# -- autograd-facing hooks ---------------------------------------------------
+
+def note_record_enter() -> None:
+    """Called by ``autograd._Scope`` when an OUTERMOST ``record()``
+    scope opens: start a fresh observation, and — when a matching
+    structure is armed — begin deferring this step."""
+    st = _t()
+    if st.ctx is not None:
+        # previous deferred step never reached trainer.step
+        _break(st.ctx, "record() while a captured step was pending")
+    from .. import autograd
+    ast = autograd._st()
+    st.obs = _Obs(ast.training, tape_base=len(ast.tape))
+    state = st.armed
+    if state is None or state.disabled or state.current is None:
+        return
+    if not enabled():
+        return
+    from ..optimizer import fused_step
+    if not fused_step.enabled():
+        return
+    from .. import engine
+    if engine.naive_mode():
+        return
+    trainer = state.trainer()
+    if trainer is None:
+        st.armed = None
+        return
+    stt = state.current.structure
+    if stt.training != bool(ast.training):
+        return                       # train/predict flip: observe eagerly
+    from ..ops import registry as _reg
+    if stt.key[-1] != _reg._env_numerics_key():
+        state.current = None         # env numerics flipped: stale capture
+        return
+    # gather + check the leaf parameter arrays this replay will read
+    try:
+        params = trainer._params
+        pa, fa = [], []
+        for k, i in enumerate(stt.diff_idx):
+            a = params[i]._data_nd()._data
+            if (tuple(a.shape), str(a.dtype)) != stt.param_shdty[k]:
+                return
+            pa.append(a)
+        for q, i in enumerate(stt.frozen_idx):
+            a = params[i]._data_nd()._data
+            if (tuple(a.shape), str(a.dtype)) != stt.frozen_shdty[q]:
+                return
+            fa.append(a)
+    except Exception:
+        return
+    global _ACTIVE
+    st.ctx = _Ctx(state, stt, pa, fa)
+    _ACTIVE += 1
+
+
+def notify_hooks() -> None:
+    """A Block with forward hooks attached ran: hooks observe real
+    activations, so the step can neither capture nor stay deferred."""
+    st = _t()
+    if st.obs is not None:
+        st.obs.poison("forward hook attached")
+    if st.ctx is not None:
+        _break(st.ctx, "forward hook attached")
+
+
+def note_backward(records, heads, head_grads, train_mode,
+                  retain_graph) -> None:
+    """Called at the end of an EAGER ``autograd.backward`` with the
+    full tape segment — fills the observation the trainer may arm
+    from."""
+    st = _t()
+    obs = st.obs
+    if obs is None:
+        return
+    if obs.records is not None:
+        obs.poison("multiple backward calls in one step")
+        return
+    if retain_graph:
+        obs.poison("retain_graph backward")
+        return
+    from .. import autograd
+    if autograd._st().grad_ready_hook is not None:
+        obs.poison("grad-ready hook installed")
+        return
+    hs = []
+    hgs = head_grads if head_grads is not None else [None] * len(heads)
+    for h, hg in zip(heads, hgs):
+        node = getattr(h, "_node", None)
+        if node is None:
+            obs.poison("head outside the recorded graph")
+            return
+        spec = None
+        if hg is not None:
+            if type(hg._data) is _DeferredData:
+                obs.poison("deferred head_grad")
+                return
+            spec = (tuple(hg._data.shape), str(hg._data.dtype))
+        hs.append((node, tuple(h.shape), onp.dtype(h.dtype), spec))
+    obs.records = list(records[obs.tape_base:])
+    obs.heads = hs
+    obs.bwd_train = bool(train_mode)
+
+
+def deferred_backward(heads, head_grads, retain_graph, train_mode,
+                      create_graph, collect) -> bool:
+    """Intercept ``autograd.backward`` while deferring.  Returns True
+    when the backward was absorbed into the capture; False means the
+    caller must run the real backward (any pending ops have been
+    materialized first)."""
+    st = _t()
+    ctx = st.ctx
+    if ctx is None:
+        return False
+    if ctx.backward_done:
+        _break(ctx, "second backward in a captured step")
+        return False
+    if retain_graph or create_graph or collect is not None:
+        _break(ctx, "backward flags unsupported by capture")
+        return False
+    from .. import autograd
+    if autograd._st().grad_ready_hook is not None:
+        _break(ctx, "grad-ready hook installed")
+        return False
+    stt = ctx.structure
+    if ctx.pos != len(stt.steps):
+        _break(ctx, "backward before the captured graph completed")
+        return False
+    if bool(train_mode) != stt.bwd_train:
+        _break(ctx, "backward train_mode differs from capture")
+        return False
+    hgs = head_grads if head_grads is not None else [None] * len(heads)
+    if len(heads) != len(stt.heads):
+        _break(ctx, "different number of heads")
+        return False
+    for k, (h, hg) in enumerate(zip(heads, hgs)):
+        d = h._data
+        if type(d) is not _DeferredData or d.owner is not ctx \
+                or (d.pos, d.idx) != stt.heads[k]:
+            _break(ctx, "different heads than captured")
+            return False
+        eid = stt.head_seed_ext[k]
+        if (hg is None) != (eid is None):
+            _break(ctx, "head_grads pattern differs from capture")
+            return False
+        if hg is not None:
+            a = hg._data
+            if type(a) is _DeferredData:
+                _break(ctx, "deferred head_grad")
+                return False
+            if (tuple(a.shape), str(a.dtype)) != stt.ext_specs[eid]:
+                _break(ctx, "head_grad shape differs from capture")
+                return False
+            prev = ctx.ext_vals[eid]
+            if prev is not None and prev is not a:
+                _break(ctx, "conflicting head_grad value")
+                return False
+            ctx.ext_vals[eid] = a
+    trainer = ctx.state.trainer()
+    if trainer is None:
+        _break(ctx, "trainer collected")
+        return False
+    marks = []
+    for k, i in enumerate(stt.diff_idx):
+        p = trainer._params[i]
+        gnd = p._grad
+        if gnd is None or p.grad_req != "write":
+            _break(ctx, "parameter grad config changed since capture")
+            # restore nothing yet — marks not applied
+            return False
+        ph = _DeferredData(gnd.shape, gnd.dtype, "grad", k, 0, ctx)
+        marks.append((gnd, ph, gnd._data))
+        gnd._data = ph
+    ctx.grad_marks = marks
+    ctx.heads_nd = list(heads)
+    ctx.head_grads_nd = list(hgs)
+    ctx.bwd_train_arg = train_mode
+    ctx.backward_done = True
+    return True
+
+
+# -- op-funnel intercept -----------------------------------------------------
+
+_reg_mod = None             # late-bound ops.registry module
+
+
+def _registry():
+    global _reg_mod
+    if _reg_mod is None:
+        from ..ops import registry
+        _reg_mod = registry
+    return _reg_mod
+
+
+def intercept(fn, nd_inputs, multi_out, record, sparse_bwd):
+    """Called by ``registry.apply_jax`` while a step is deferred.
+    Returns ``_PASS`` to run the op normally, or the wrapped deferred
+    output(s)."""
+    st = _t()
+    ctx = st.ctx
+    if ctx is None:
+        return _PASS
+    from .. import autograd
+    should_record = autograd.is_recording() if record is None else record
+    if not should_record:
+        # pause-scope op: fine on real data; a deferred input is a break
+        for x in nd_inputs:
+            if type(x._data) is _DeferredData:
+                _break(ctx, "op on deferred data outside record()")
+                break
+        return _PASS
+    try:
+        return _validate_and_defer(ctx, fn, nd_inputs, sparse_bwd)
+    except _BreakSignal:
+        return _PASS
+    except Exception:
+        # never let capture bookkeeping take down a training step
+        _break(ctx, "internal capture error")
+        return _PASS
+
+
+class _BreakSignal(Exception):
+    pass
+
+
+def _mismatch(ctx, reason):
+    _break(ctx, reason)
+    raise _BreakSignal()
+
+
+def _op_matches(ctx, stt, fn, nd_inputs):
+    """Validate one incoming op against ``stt`` at ctx.pos WITHOUT
+    mutating the context.  Returns (reason, ext_fills): reason is None
+    on match; ext_fills lists the (slot, array) bindings to commit."""
+    if ctx.pos >= len(stt.steps):
+        return "more ops than captured", None
+    sp = stt.steps[ctx.pos]
+    if fn is not sp.fn:
+        return "op divergence from captured tape", None
+    if len(nd_inputs) != len(sp.sources):
+        return "op arity divergence", None
+    fills = []
+    for x, src in zip(nd_inputs, sp.sources):
+        a = x._data
+        tag = src[0]
+        if type(a) is _DeferredData:
+            if a.owner is not ctx or a.kind != "out" or tag != "out" \
+                    or a.pos != src[1] or a.idx != src[2]:
+                return "dataflow divergence from captured tape", None
+        elif tag == "param":
+            if a is not ctx.param_arrays[src[1]]:
+                return "parameter input divergence", None
+        elif tag == "frozen":
+            if a is not ctx.frozen_arrays[src[1]]:
+                return "frozen-parameter input divergence", None
+        elif tag == "ext":
+            eid = src[1]
+            if (tuple(a.shape), str(a.dtype)) != stt.ext_specs[eid]:
+                return "input shape/dtype divergence", None
+            prev = ctx.ext_vals[eid] if eid < len(ctx.ext_vals) else None
+            if prev is None:
+                fills.append((eid, a))
+            elif prev is not a:
+                return "external input aliasing divergence", None
+        else:
+            return "dataflow divergence from captured tape", None
+    return None, fills
+
+
+def _steps_equal(a, b):
+    return a.fn is b.fn and a.multi == b.multi and a.n_out == b.n_out \
+        and list(a.sources) == list(b.sources)
+
+
+def _find_candidate(ctx, fn, nd_inputs):
+    """On a structural mismatch, look for ANOTHER cached structure whose
+    prefix matches everything deferred so far and which accepts the
+    incoming op — the signature-keyed cache working as a cache instead
+    of breaking whenever the most-recently-armed entry doesn't fit
+    (e.g. two batch shapes alternating step to step)."""
+    if ctx.backward_done:
+        return None, None            # heads already validated vs current
+    cur = ctx.structure
+    p = ctx.pos
+    for ent in ctx.state.cache.values():
+        stt = ent.structure
+        if stt is cur:
+            continue
+        if (stt.training, stt.bwd_train, stt.op_name, stt.opt_type,
+                stt.statics_key, stt.dyn_names, stt.key[-1],
+                stt.diff_idx, stt.frozen_idx, stt.param_shdty,
+                stt.frozen_shdty) != \
+           (cur.training, cur.bwd_train, cur.op_name, cur.opt_type,
+                cur.statics_key, cur.dyn_names, cur.key[-1],
+                cur.diff_idx, cur.frozen_idx, cur.param_shdty,
+                cur.frozen_shdty):
+            continue
+        if len(stt.steps) <= p:
+            continue
+        if any(not _steps_equal(stt.steps[i], cur.steps[i])
+               or stt.out_shdty[i] != cur.out_shdty[i]
+               for i in range(p)):
+            continue
+        # ext slots bound so far must mean the same thing under stt
+        # (prefix equality makes slot ASSIGNMENT identical; specs of
+        # bound slots must accept the actual arrays)
+        if any(v is not None and
+               (eid >= len(stt.ext_specs) or
+                (tuple(v.shape), str(v.dtype)) != stt.ext_specs[eid])
+               for eid, v in enumerate(ctx.ext_vals)):
+            continue
+        reason, fills = _op_matches(ctx, stt, fn, nd_inputs)
+        if reason is None:
+            return ent, fills
+    return None, None
+
+
+def _validate_and_defer(ctx, fn, nd_inputs, sparse_bwd):
+    reg = _registry()
+    if reg._capture_stack:
+        _mismatch(ctx, "control-flow capture scope active")
+    if sparse_bwd is not None:
+        _mismatch(ctx, "sparse-backward op")
+    reason, fills = _op_matches(ctx, ctx.structure, fn, nd_inputs)
+    if reason is not None:
+        ent, alt_fills = _find_candidate(ctx, fn, nd_inputs)
+        if ent is None:
+            _mismatch(ctx, reason)
+        # swap the deferral onto the matching cache entry; re-arm it so
+        # the NEXT step's record-enter starts from the right structure
+        ctx.structure = ent.structure
+        ctx.state.current = ent
+        old = ctx.ext_vals
+        ctx.ext_vals = [old[i] if i < len(old) else None
+                        for i in range(len(ent.structure.ext_specs))]
+        fills = alt_fills
+    stt = ctx.structure
+    for eid, a in fills:
+        ctx.ext_vals[eid] = a
+    sp = stt.steps[ctx.pos]
+    # defer: placeholders out, recorded on the REAL tape so a later
+    # break replays an exactly-eager step
+    pos = ctx.pos
+    out_sd = stt.out_shdty[pos]
+    from ..ndarray import NDArray
+    out_cls = reg._np_flavor_of(nd_inputs) or NDArray
+    out_nds = []
+    for k, (shp, dt) in enumerate(out_sd):
+        nd = out_cls.__new__(out_cls)
+        nd._data = _DeferredData(shp, dt, "out", pos, k, ctx)
+        nd._node = None
+        nd._grad = None
+        out_nds.append(nd)
+    from .. import autograd
+    autograd.record_apply(fn, list(nd_inputs), out_nds, multi_out=sp.multi)
+    rec = autograd._tape()[-1]
+    ctx.recs.append((rec, out_nds))
+    ctx.pos = pos + 1
+    return out_nds if sp.multi else out_nds[0]
+
+
+# -- graph break / materialization ------------------------------------------
+
+def _break(ctx, reason: str) -> None:
+    """Abort a deferred step: replay the pending ops eagerly in tape
+    order (filling every placeholder), restore grad buffers, and run a
+    pending backward for real.  After this the step IS the eager step."""
+    global _ACTIVE
+    st = _t()
+    if st.ctx is ctx:
+        st.ctx = None
+        _ACTIVE = max(0, _ACTIVE - 1)
+    _STATS["graph_breaks"] += 1
+    _C_BREAKS.inc()
+    state = ctx.state
+    state.breaks += 1
+    state.last_reason = reason
+    from ..ops import registry as _reg
+    if state.breaks >= 4 * _reg._MAX_JIT_SIGS:
+        state.disabled = True
+    # restore grad buffers before any backward runs
+    for gnd, ph, orig in ctx.grad_marks:
+        if gnd._data is ph:
+            gnd._data = orig
+    ctx.grad_marks = []
+    # eager replay of the pending forward ops (tape order, so every
+    # input is real by induction)
+    for rec, out_nds in ctx.recs:
+        args = [a.value if type(a) is _DeferredData else a
+                for a in rec.saved_inputs]
+        out = rec.fn(*args)
+        outs = list(out) if isinstance(out, (tuple, list)) else [out]
+        rec.saved_inputs = args
+        for nd, o in zip(out_nds, outs):
+            ph = nd._data
+            if type(ph) is _DeferredData:
+                ph.value = o
+            nd._data = o
+        _C_DISPATCH.inc()
+    ctx.recs = []
+    if ctx.backward_done:
+        ctx.backward_done = False
+        from .. import autograd
+        autograd.backward(ctx.heads_nd, ctx.head_grads_nd,
+                          train_mode=ctx.bwd_train_arg)
+
+
+def break_if_deferring(reason: str) -> None:
+    """External escape hatch (e.g. Trainer.update): materialize any
+    pending deferred step on this thread."""
+    st = _t()
+    if st.ctx is not None:
+        _break(st.ctx, reason)
+
+
+# -- trainer integration -----------------------------------------------------
+
+def trainer_step(trainer, ignore_stale_grad=False) -> bool:
+    """The Trainer.step hook.  Returns True when the whole step was
+    executed by a captured executable (weights/states/grads all
+    updated, tape cleared); False means the caller must run the normal
+    eager step (any pending deferral has been materialized)."""
+    st = _t()
+    if not enabled():
+        if st.ctx is not None:
+            _break(st.ctx, "MXNET_CACHED_STEP disabled")
+        st.obs = None
+        return False
+    ctx = st.ctx
+    done = False
+    if ctx is not None:
+        if ctx.state.trainer() is not trainer:
+            _break(ctx, "step by a different trainer")
+        else:
+            done = _execute(trainer, ctx, ignore_stale_grad)
+    if not done:
+        _maybe_arm(trainer, ignore_stale_grad)
+    return done
+
+
+def _maybe_arm(trainer, ignore_stale_grad) -> None:
+    """Consume this thread's observation (from the eager warm-up that
+    just ran) and arm a structure for the next step."""
+    st = _t()
+    obs, st.obs = st.obs, None
+    state = getattr(trainer, "_cached_step_state", None)
+    if state is None:
+        state = trainer._cached_step_state = _State(trainer)
+    st.armed = state
+    state.current = None
+    if state.disabled:
+        return
+    if obs is None or obs.records is None:
+        return
+
+    def _decline(reason):
+        state.last_reason = reason
+        _STATS["fallbacks"] += 1
+        _C_FALLBACKS.inc()
+
+    if obs.poisoned:
+        return _decline(obs.reason)
+    from ..optimizer import fused_step
+    if not fused_step.enabled():
+        return _decline("fused step disabled")
+    from .. import engine
+    if engine.naive_mode():
+        return _decline("naive engine mode")
+    if trainer._kvstore is not None and not trainer._fold_device_allreduce():
+        return _decline("kvstore configuration not capturable")
+    structure, why = _build_structure(obs, trainer, ignore_stale_grad)
+    if structure is None:
+        return _decline(why)
+    if structure.key in state.bad:
+        return _decline("structure previously failed to capture")
+    ent = state.cache.get(structure.key)
+    if ent is None:
+        from ..ops import registry as _reg
+        if len(state.cache) >= _reg._MAX_JIT_SIGS:
+            state.disabled = True
+            return _decline("structure signature churn (latched)")
+        ent = state.cache[structure.key] = _Entry(structure)
+        _STATS["captures"] += 1
+    state.current = ent
+
+
+def _build_structure(obs, trainer, ignore_stale_grad):
+    """Export the observed tape into a replayable _Structure, or
+    (None, reason) when the step is not capturable."""
+    from ..ops import registry as _reg
+    from ..optimizer.optimizer import Updater
+    from ..ndarray.sparse import RowSparseNDArray
+
+    recs = obs.records
+    if not recs:
+        return None, "empty tape"
+    updater = trainer._updaters[0]
+    if type(updater) is not Updater:
+        return None, "custom updater"
+    opt = updater.optimizer
+    if opt.op_name is None:
+        return None, "optimizer has no in-trace update op"
+
+    node_src: Dict[int, Tuple] = {}
+    diff_idx: List[int] = []
+    frozen_idx: List[int] = []
+    param_shdty: List[Tuple] = []
+    frozen_shdty: List[Tuple] = []
+    for i, p in enumerate(trainer._params):
+        if p._data is None:
+            if p.grad_req != "null" and not ignore_stale_grad:
+                return None, "uninitialized parameter"
+            continue
+        nd = p._data_nd()
+        if isinstance(nd, RowSparseNDArray):
+            return None, "sparse parameter"
+        node = nd._node
+        if p.grad_req == "null" or p._grad is None:
+            if p.grad_req != "null" and p._grad is None \
+                    and not ignore_stale_grad:
+                return None, "parameter missing its gradient buffer"
+            if node is not None and id(node) not in node_src:
+                node_src[id(node)] = ("frozen", len(frozen_idx))
+                frozen_idx.append(i)
+                frozen_shdty.append((tuple(nd._data.shape),
+                                     str(nd._data.dtype)))
+            continue
+        if p.grad_req != "write":
+            return None, "grad_req != 'write'"
+        if isinstance(p._grad, RowSparseNDArray):
+            return None, "row_sparse gradient"
+        if node is None:
+            return None, "trainable parameter unused in forward"
+        if id(node) in node_src:
+            return None, "parameters share one graph node"
+        node_src[id(node)] = ("param", len(diff_idx))
+        diff_idx.append(i)
+        param_shdty.append((tuple(nd._data.shape), str(nd._data.dtype)))
+    if not diff_idx:
+        return None, "no trainable parameters"
+    if opt.multi_precision and any(
+            trainer._params[i]._data_nd().dtype == onp.float16
+            for i in diff_idx):
+        return None, "fp16 multi_precision"
+    statics = opt._fused_statics(diff_idx[0])
+    if statics is None:
+        return None, "optimizer statics not traceable"
+    for i in diff_idx[1:]:
+        if opt._fused_statics(i) != statics:
+            return None, "non-uniform optimizer statics"
+    statics_key = tuple(sorted(statics.items()))
+    dyn_names = tuple(sorted(opt._fused_dynamics(diff_idx[0]).keys()))
+
+    steps: List[_Step] = []
+    out_shdty: List[Tuple] = []
+    ext_specs: List[Tuple] = []
+    key_steps: List[Tuple] = []
+    for pos, rec in enumerate(recs):
+        if rec.sparse_bwd is not None:
+            return None, "op with sparse backward"
+        fn = rec.fn
+        if fn not in _reg._STABLE_FNS and \
+                not getattr(fn, "_mx_stable_fn", False):
+            return None, "op fn identity not stable across steps"
+        if rec.out_specs is None or \
+                len(rec.in_nodes) != len(rec.saved_inputs):
+            return None, "malformed tape record"
+        srcs: List[Tuple] = []
+        in_shdty: List[Tuple] = []
+        for node, a in zip(rec.in_nodes, rec.saved_inputs):
+            if not isinstance(a, jax.Array):
+                return None, "non-dense op input"
+            src = node_src.get(id(node))
+            if src is None:
+                if node.grad_array is not None and node.grad_req != "null":
+                    return None, "grad-attached non-trainer leaf"
+                if node.producer is not None:
+                    return None, "input produced outside the captured tape"
+                src = ("ext", len(ext_specs))
+                node_src[id(node)] = src
+                ext_specs.append((tuple(a.shape), str(a.dtype)))
+            srcs.append(src)
+            in_shdty.append((tuple(a.shape), str(a.dtype)))
+        osd: List[Tuple] = []
+        for k, (shp, dt) in enumerate(rec.out_specs):
+            osd.append((tuple(shp), onp.dtype(dt)))
+        for k, n in enumerate(rec.out_nodes):
+            if n.grad_array is not None and n.grad_req != "null":
+                return None, "grad-attached intermediate"
+            node_src[id(n)] = ("out", pos, k)
+        steps.append(_Step(fn, bool(rec.multi_out), tuple(srcs), len(osd)))
+        out_shdty.append(tuple(osd))
+        key_steps.append((id(fn), bool(rec.multi_out), tuple(srcs),
+                          tuple(in_shdty),
+                          tuple((s, str(d)) for s, d in osd)))
+
+    heads: List[Tuple[int, int]] = []
+    head_shdty: List[Tuple] = []
+    head_seed_ext: List[Optional[int]] = []
+    for node, shp, dt, hg_spec in obs.heads:
+        src = node_src.get(id(node))
+        if src is None or src[0] != "out":
+            return None, "head is not an output of the captured tape"
+        heads.append((src[1], src[2]))
+        head_shdty.append((tuple(shp), dt))
+        if hg_spec is None:
+            head_seed_ext.append(None)
+        else:
+            head_seed_ext.append(len(ext_specs))
+            ext_specs.append(hg_spec)
+
+    # reverse reachability: every diff param must receive its gradient
+    # from the head-reachable subgraph, else the eager path would have
+    # left its grad buffer untouched where the capture writes zeros
+    needed = set()
+    frontier = [h[0] for h in heads]
+    while frontier:
+        pos = frontier.pop()
+        if pos in needed:
+            continue
+        needed.add(pos)
+        for src in steps[pos].sources:
+            if src[0] == "out":
+                frontier.append(src[1])
+    reached = set()
+    for pos in needed:
+        for src in steps[pos].sources:
+            if src[0] == "param":
+                reached.add(src[1])
+    if len(reached) != len(diff_idx):
+        return None, "trainable parameter not reachable from heads"
+
+    stt = _Structure()
+    stt.steps = steps
+    stt.out_shdty = out_shdty
+    stt.ext_specs = tuple(ext_specs)
+    stt.diff_idx = tuple(diff_idx)
+    stt.frozen_idx = tuple(frozen_idx)
+    stt.param_shdty = tuple(param_shdty)
+    stt.frozen_shdty = tuple(frozen_shdty)
+    stt.heads = heads
+    stt.head_shdty = head_shdty
+    stt.head_seed_ext = head_seed_ext
+    stt.statics_key = statics_key
+    stt.dyn_names = dyn_names
+    stt.op_name = opt.op_name
+    stt.opt_type = type(opt).__name__
+    stt.training = obs.training
+    stt.bwd_train = obs.bwd_train
+    stt.key = (tuple(key_steps),
+               tuple(zip(heads, head_seed_ext)),
+               stt.ext_specs,
+               tuple(zip(diff_idx, param_shdty)),
+               tuple(zip(frozen_idx, frozen_shdty)),
+               (stt.opt_type, stt.op_name, statics_key, dyn_names),
+               obs.training, obs.bwd_train,
+               _reg._env_numerics_key())
+    return stt, None
+
+
+# -- the one executable ------------------------------------------------------
+
+def _build_step_fn(stt):
+    """forward replay + whole-graph vjp + fused optimizer update as one
+    function of (dyn, ext, frozen, weights, states); weights and states
+    donated."""
+    from ..optimizer import fused_step
+    update_fn = fused_step.make_update_fn(stt.op_name, stt.statics_key,
+                                          stt.dyn_names)
+    steps = stt.steps
+    heads = stt.heads
+    seeds = stt.head_seed_ext
+    head_shdty = stt.head_shdty
+
+    def forward(weights, frozen, ext):
+        env = {}
+        flat = []
+        for pos, sp in enumerate(steps):
+            args = []
+            for s in sp.sources:
+                tag = s[0]
+                if tag == "out":
+                    args.append(env[(s[1], s[2])])
+                elif tag == "param":
+                    args.append(weights[s[1]])
+                elif tag == "frozen":
+                    args.append(frozen[s[1]])
+                else:
+                    args.append(ext[s[1]])
+            out = sp.fn(*args)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for k, o in enumerate(outs):
+                env[(pos, k)] = o
+            flat.extend(outs)
+        return tuple(env[h] for h in heads), flat
+
+    def step_fn(dyn, ext, frozen, weights, states):
+        def fwd(ws):
+            hs, flat = forward(ws, frozen, ext)
+            return hs, flat
+
+        _, vjp_fn, flat = jax.vjp(fwd, weights, has_aux=True)
+        seed_vals = tuple(
+            jnp.ones(shp, dt) if eid is None else ext[eid]
+            for (shp, dt), eid in zip(head_shdty, seeds))
+        grads, = vjp_fn(seed_vals)
+        new_w, new_s = update_fn(dyn, weights, grads, states)
+        return new_w, new_s, grads, flat
+
+    return jax.jit(step_fn, donate_argnums=(3, 4))
+
+
+def _execute(trainer, ctx, ignore_stale_grad) -> bool:
+    """Finish a fully deferred step: validate, compile once, run the
+    one executable, fill every placeholder, rebind weights/states."""
+    stt = ctx.structure
+    state = ctx.state
+    if ctx.pos != len(stt.steps) or not ctx.backward_done:
+        _break(ctx, "trainer.step before forward+backward completed")
+        return False
+    from ..optimizer import fused_step
+    if not fused_step.enabled():
+        _break(ctx, "fused step disabled mid-capture")
+        return False
+    if trainer._kvstore is not None and not trainer._fold_device_allreduce():
+        _break(ctx, "kvstore configuration changed")
+        return False
+    updater = trainer._updaters[0]
+    from ..optimizer.optimizer import Updater
+    if type(updater) is not Updater or updater.optimizer.op_name != \
+            stt.op_name or type(updater.optimizer).__name__ != stt.opt_type:
+        _break(ctx, "optimizer changed since capture")
+        return False
+    opt = updater.optimizer
+    statics = opt._fused_statics(stt.diff_idx[0])
+    if statics is None or tuple(sorted(statics.items())) != stt.statics_key:
+        _break(ctx, "optimizer statics changed since capture")
+        return False
+    for i in stt.diff_idx[1:]:
+        if opt._fused_statics(i) != statics:
+            _break(ctx, "optimizer statics changed since capture")
+            return False
+    if tuple(sorted(opt._fused_dynamics(stt.diff_idx[0]).keys())) != \
+            stt.dyn_names:
+        _break(ctx, "optimizer dynamics changed since capture")
+        return False
+    if any(v is None for v in ctx.ext_vals):
+        _break(ctx, "unresolved external input")
+        return False
+    params = trainer._params
+    weights_nd = []
+    for k, i in enumerate(stt.diff_idx):
+        nd = params[i]._data_nd()
+        if nd._data is not ctx.param_arrays[k]:
+            _break(ctx, "weights changed between forward and step")
+            return False
+        weights_nd.append(nd)
+    for gnd, ph, _orig in ctx.grad_marks:
+        if gnd._data is not ph:
+            _break(ctx, "gradient buffer changed between backward and step")
+            return False
+    # state creation mirrors the eager Updater / fused_step
+    for i in stt.diff_idx:
+        if i not in updater.states:
+            updater.states[i] = opt.create_state_multi_precision(
+                i, params[i]._data_nd())
+            updater.states_synced[i] = True
+    states = [updater.states[i] for i in stt.diff_idx]
+    # donation safety: a repeated donated buffer is an XLA error
+    seen = set()
+    for w in weights_nd:
+        seen.add(id(w._data))
+    for sts in states:
+        for s in sts:
+            if id(s._data) in seen:
+                _break(ctx, "shared donated buffer")
+                return False
+            seen.add(id(s._data))
+    if len(seen) != len(weights_nd) + sum(len(sts) for sts in states):
+        _break(ctx, "shared donated buffer")
+        return False
+
+    ent = state.current if state.current is not None and \
+        state.current.structure is stt else state.cache.get(stt.key)
+    if ent is None:
+        _break(ctx, "capture entry evicted")
+        return False
+
+    ext_t = tuple(ctx.ext_vals)
+    frozen_t = tuple(ctx.frozen_arrays)
+    weights_t = tuple(w._data for w in weights_nd)
+    states_t = tuple(tuple(s._data for s in sts) for sts in states)
+
+    fresh = ent.compiled is None
+    if fresh:
+        # compile via AOT lower(): trace errors surface BEFORE any
+        # buffer is donated, so falling back here is safe
+        dyn0 = [opt._fused_dynamics(i) for i in stt.diff_idx]
+        dyn_probe = tuple(jnp.asarray([d[nm] for d in dyn0], jnp.float32)
+                          for nm in stt.dyn_names)
+        t0 = _time.perf_counter()
+        try:
+            if ent.jfn is None:
+                ent.jfn = _build_step_fn(stt)
+            ent.compiled = ent.jfn.lower(
+                dyn_probe, ext_t, frozen_t, weights_t, states_t).compile()
+        except Exception:
+            state.bad.add(stt.key)
+            state.current = None
+            _break(ctx, "capture failed to trace/compile")
+            return False
+        telemetry.record_compile(_time.perf_counter() - t0, "cached_step")
+        _STATS["compiles"] += 1
+        _C_COMPILES.inc()
+    else:
+        _STATS["hits"] += 1
+        _C_HITS.inc()
+
+    # side effects: bump counts first so lr schedules / Adam's t match
+    # the eager path exactly (same discipline as fused_step.step)
+    for i in stt.diff_idx:
+        opt._update_count(i)
+    dyns = [opt._fused_dynamics(i) for i in stt.diff_idx]
+    dyn = tuple(jnp.asarray([d[nm] for d in dyns], jnp.float32)
+                for nm in stt.dyn_names)
+
+    from .. import profiler
+    tp = profiler.op_timer()
+    try:
+        new_w, new_s, grads, flat = ent.compiled(
+            dyn, ext_t, frozen_t, weights_t, states_t)
+    except Exception:
+        # donation means buffers may already be consumed: latch off and
+        # surface the error rather than double-applying the step
+        state.disabled = True
+        ctx.state.last_reason = "captured executable failed"
+        global _ACTIVE
+        st = _t()
+        if st.ctx is ctx:
+            st.ctx = None
+            _ACTIVE = max(0, _ACTIVE - 1)
+        raise
+    from ..optimizer.optimizer import _note_dispatch
+    _note_dispatch()
+    profiler.op_record(f"CachedStep::{stt.opt_type}", tp)
+
+    # fill every placeholder (tape order == flat order)
+    k = 0
+    for rec, out_nds in ctx.recs:
+        outs = flat[k:k + len(out_nds)]
+        k += len(out_nds)
+        rec.saved_inputs = [a.value if type(a) is _DeferredData else a
+                            for a in rec.saved_inputs]
+        rec.consumed = True
+        for nd, o in zip(out_nds, outs):
+            ph = nd._data
+            if type(ph) is _DeferredData:
+                ph.value = o
+            nd._data = o
+    for (gnd, ph, _orig), g in zip(ctx.grad_marks, grads):
+        ph.value = g
+        gnd._data = g
+    for w, nw in zip(weights_nd, new_w):
+        w._rebind(nw)
+    for sts, ns in zip(states, new_s):
+        for s, n in zip(sts, ns):
+            s._rebind(n)
+
+    # remove exactly the deferred records; stale pre-existing tape
+    # entries (never-backpropagated work) stay, as they would eagerly
+    from .. import autograd
+    ast = autograd._st()
+    ids = {id(rec) for rec, _ in ctx.recs}
+    ast.tape = [r for r in ast.tape if id(r) not in ids]
+    st = _t()
+    if st.ctx is ctx:
+        st.ctx = None
+        _ACTIVE = max(0, _ACTIVE - 1)
+    st.obs = None
+    state.current = ent
+    _STATS["steps"] += 1
+    return True

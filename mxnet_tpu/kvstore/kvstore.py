@@ -111,9 +111,14 @@ class KVStore(KVStoreBase):
         cache."""
         if len(batch) > 1:
             from ..optimizer import fused_step
+            # donate_weights=False: init() stored v.copy(), which SHARES
+            # the param's jax buffer — donating it here deletes the
+            # buffer under param._data_nd(), and the trainer's later
+            # pull()/copyto crashes with "Array has been deleted"
             if fused_step.step(
                     self._updater,
-                    [(_key_int(k), self._data[k], r) for k, r in batch]):
+                    [(_key_int(k), self._data[k], r) for k, r in batch],
+                    donate_weights=False):
                 return
         for k, r in batch:
             self._updater(_key_int(k), r, self._data[k])

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from ..base import MXNetError, np_dtype, dtype_name, check_shape
 from ..context import Context, current_context
 from .. import autograd as ag
+from ..imperative import cached_step as _cs
 from ..ops import registry as _reg
 from ..ops.registry import apply_jax, invoke
 
@@ -32,6 +33,7 @@ __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
 def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
     if isinstance(data, NDArray):
         data = data._data
+    data = _cs.resolve(data)   # graph break: constructing from deferred
     if isinstance(data, jax.Array):
         arr = data if dtype is None else data.astype(np_dtype(dtype))
         if ctx is not None:
@@ -129,6 +131,7 @@ class NDArray:
 
     @property
     def context(self) -> Context:
+        _cs.ensure_real(self)
         dev = next(iter(self._data.devices()))
         return Context("cpu" if dev.platform == "cpu" else "tpu", dev.id)
 
@@ -162,7 +165,11 @@ class NDArray:
         return out
 
     # -- sync / transfer (parity: WaitToRead, CopyFromTo, asnumpy) ---------
+    # every host-sync point resolves a deferred buffer first: reading a
+    # value inside a captured step is a graph break (the pending step
+    # materializes eagerly — see imperative/cached_step.py)
     def wait_to_read(self):
+        _cs.ensure_real(self)
         self._data.block_until_ready()
 
     wait_to_write = wait_to_read
@@ -170,12 +177,15 @@ class NDArray:
     # DLPack protocol: delegate to the backing jax.Array so
     # torch.from_dlpack(nd) / np.from_dlpack(nd) work directly
     def __dlpack__(self, *args, **kwargs):
+        _cs.ensure_real(self)
         return self._data.__dlpack__(*args, **kwargs)
 
     def __dlpack_device__(self):
+        _cs.ensure_real(self)
         return self._data.__dlpack_device__()
 
     def asnumpy(self) -> onp.ndarray:
+        _cs.ensure_real(self)
         return onp.asarray(jax.device_get(self._data))
 
     def asscalar(self):
@@ -214,9 +224,11 @@ class NDArray:
         return NDArray(self._data)
 
     def copyto(self, other):
+        _cs.ensure_real(self)
         if isinstance(other, Context):
             return NDArray(jax.device_put(self._data, other.jax_device))
         if isinstance(other, NDArray):
+            _cs.ensure_real(other)
             other._rebind(jax.device_put(
                 self._data.astype(other.dtype),
                 next(iter(other._data.devices()))))
@@ -244,6 +256,7 @@ class NDArray:
     def _rebind(self, new_data: jax.Array):
         """Replace buffer contents; bumps the autograd version
         (parity: engine var version increment on write)."""
+        new_data = _cs.resolve(new_data)   # writing deferred data breaks
         old = self._node
         self._data = new_data
         self._node = None
@@ -254,8 +267,11 @@ class NDArray:
         return self
 
     def __setitem__(self, key, value):
+        _cs.ensure_real(self)
         key = _norm_index(key, self.shape)
         if isinstance(value, NDArray):
+            if not ag.is_recording():
+                _cs.ensure_real(value)
             if ag.is_recording():
                 res = apply_jax(lambda d, v: d.at[key].set(v.astype(d.dtype)),
                                 [self, value])

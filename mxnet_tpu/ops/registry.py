@@ -17,6 +17,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .. import telemetry
 from ..base import MXNetError
+from ..imperative import cached_step as _cached_step
+
+# unified dispatch counter: one tick per real XLA executable dispatch
+# (here, the vjp path in autograd, the fused/cached optimizer step)
+_DISPATCH_CT = telemetry.counter("dispatch.count")
 
 __all__ = ["Operator", "register", "alias", "get", "list_ops", "invoke",
            "apply_jax"]
@@ -190,8 +195,18 @@ def apply_jax(fn: Callable, nd_inputs: Sequence[Any], multi_out: bool = False,
     from ..ndarray import NDArray
     from .. import engine
 
+    if _cached_step._ACTIVE:
+        # a whole-step capture is deferring on this thread: matching ops
+        # return placeholders instead of dispatching (a mismatch breaks
+        # the capture and falls through to the normal path below)
+        res = _cached_step.intercept(fn, nd_inputs, multi_out, record,
+                                     sparse_bwd)
+        if res is not _cached_step._PASS:
+            return res
+
     arrays = [x._data for x in nd_inputs]
     out = jentry.run(fn, arrays) if jentry is not None else fn(*arrays)
+    _DISPATCH_CT.inc()
     multi = multi_out or isinstance(out, (tuple, list))
     outs = list(out) if isinstance(out, (tuple, list)) else [out]
     out_cls = _np_flavor_of(nd_inputs) or NDArray

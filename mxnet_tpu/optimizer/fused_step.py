@@ -38,7 +38,8 @@ from .. import telemetry
 from ..ops import registry as _reg
 from .optimizer import Updater, _lowp_guard, _note_dispatch
 
-__all__ = ["step", "enabled", "stats", "reset_stats", "reset_cache"]
+__all__ = ["step", "enabled", "stats", "reset_stats", "reset_cache",
+           "make_update_fn"]
 
 # jit-cache counters (surfaced by profiler.counters()).
 # compiles/hits count fused executions by cache outcome; fallbacks count
@@ -84,10 +85,13 @@ def reset_cache() -> None:
     _ENTRIES.clear()
 
 
-def _build(op_name: str, statics_key: Tuple, dyn_names: Tuple[str, ...]):
-    """One executable for the whole parameter set.  Donates weights
-    (arg 1) and states (arg 3); grads (arg 2) and the dynamic scalar
-    vectors (arg 0) are left alone."""
+def make_update_fn(op_name: str, statics_key: Tuple,
+                   dyn_names: Tuple[str, ...]):
+    """The un-jitted whole-parameter-set update:
+    ``fused(dyn, weights, grads, states) -> (new_weights, new_states)``.
+    Exposed so other captures — the whole-step CachedOp
+    (imperative/cached_step.py) — can inline the SAME update rule inside
+    their own traced program instead of paying a second dispatch."""
     base_fn = _lowp_guard(_reg.get(op_name).fn)
     statics = dict(statics_key)
 
@@ -103,15 +107,36 @@ def _build(op_name: str, statics_key: Tuple, dyn_names: Tuple[str, ...]):
             new_s.append(tuple(outs[1:]))
         return tuple(new_w), tuple(new_s)
 
-    return jax.jit(fused, donate_argnums=(1, 3))
+    return fused
 
 
-def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
+def _build(op_name: str, statics_key: Tuple, dyn_names: Tuple[str, ...],
+           donate_weights: bool = True):
+    """One executable for the whole parameter set.  Donates states
+    (arg 3) and — unless the caller holds external aliases to the weight
+    buffers, see ``step(donate_weights=False)`` — weights (arg 1);
+    grads (arg 2) and the dynamic scalar vectors (arg 0) are left
+    alone."""
+    fused = make_update_fn(op_name, statics_key, dyn_names)
+    return jax.jit(fused,
+                   donate_argnums=(1, 3) if donate_weights else (3,))
+
+
+def step(updater, items: Sequence[Tuple[Any, Any, Any]],
+         donate_weights: bool = True) -> bool:
     """Apply one fused optimizer step to ``items`` = [(index, weight,
     grad)] through ``updater`` (an optimizer.Updater).  Returns True when
     the fused path ran (weights/states rebound, update counts bumped);
     False means nothing happened and the caller must take its existing
     per-param / aggregate path.
+
+    ``donate_weights=False`` keeps the weight buffers alive through the
+    update: callers whose weight NDArrays are ALIASED elsewhere (the
+    single-process KVStore's update-on-store path shares its stored
+    buffers with ``param._data_nd()`` — kvstore.py ``init`` copies the
+    handle, not the buffer) must use it, or the aliases are left holding
+    deleted donated arrays.  Optimizer state is donated either way (it
+    has a single owner).
 
     No side effects before eligibility AND cache resolution succeed,
     except lazily creating missing optimizer states — identical to what
@@ -149,7 +174,8 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
     statics_key = tuple(sorted(statics.items()))
     # keys only — values are collected post-bump, below
     dyn_names = tuple(sorted(opt._fused_dynamics(indices[0]).keys()))
-    family = (type(opt).__name__, opt.op_name, statics_key, dyn_names)
+    family = (type(opt).__name__, opt.op_name, statics_key, dyn_names,
+              donate_weights)
 
     entry = _ENTRIES.setdefault(family, _FusedEntry())
     if entry.disabled:
@@ -185,7 +211,8 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]]) -> bool:
             _STATS["fallbacks"] += 1
             return False
         try:
-            jfn = _build(opt.op_name, statics_key, dyn_names)
+            jfn = _build(opt.op_name, statics_key, dyn_names,
+                         donate_weights=donate_weights)
             entry.jfns[sig] = jfn
         except Exception:
             entry.disabled = True

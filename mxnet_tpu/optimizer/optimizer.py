@@ -129,9 +129,16 @@ def _jitted_multi_update(op_name: str, static_params: Tuple[Tuple[str, Any], ...
 # telemetry registry so the JSONL/TensorBoard sinks read the same number.
 _DISPATCHES = _telemetry.counter("optimizer.dispatches")
 
+# the process-wide unified dispatch counter (see imperative/
+# cached_step.py): optimizer updates tick it too, so forward ops, vjps
+# and updates sum to the per-step dispatch total the cached-step
+# benchmark asserts on
+_ALL_DISPATCHES = _telemetry.counter("dispatch.count")
+
 
 def _note_dispatch(n: int = 1) -> None:
     _DISPATCHES.inc(n)
+    _ALL_DISPATCHES.inc(n)
 
 
 def dispatch_count() -> int:

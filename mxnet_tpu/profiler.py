@@ -90,9 +90,14 @@ def counters() -> Dict[str, Dict[str, int]]:
       (hits/misses/latches, ops/registry.py)
     - ``fused_step``: the fused whole-parameter-set optimizer step
       (compiles/hits/fallbacks/steps, optimizer/fused_step.py)
+    - ``cached_step``: the whole-step capture
+      (captures/compiles/hits/steps/fallbacks/graph_breaks,
+      imperative/cached_step.py)
     - ``optimizer``: total optimizer-update executable dispatches
+    - ``dispatch``: total XLA executable dispatches, all sites (forward
+      ops, vjps, optimizer/cached steps) — the 1-dispatch/step counter
     - ``compile``: jit compiles + compile wall ms across every compile
-      site (op funnel, fused step, CachedOp, SPMD step)
+      site (op funnel, fused step, CachedOp, cached step, SPMD step)
     - ``comm``: collective payload bytes (dense + sparse kvstore paths)
 
     Always live (unlike xplane tracing this needs no start()) — every
@@ -102,9 +107,12 @@ def counters() -> Dict[str, Dict[str, int]]:
     from .ops import registry as _registry
     from .optimizer import optimizer as _optimizer
     from .optimizer import fused_step as _fused_step
+    from .imperative import cached_step as _cached_step
     return {"eager_jit": _registry.jit_cache_stats(),
             "fused_step": _fused_step.stats(),
+            "cached_step": _cached_step.stats(),
             "optimizer": {"dispatches": _optimizer.dispatch_count()},
+            "dispatch": {"count": telemetry.counter("dispatch.count").value},
             "compile": {"count": telemetry.counter("compile.count").value,
                         "ms": telemetry.counter("compile.ms").value},
             "comm": {"bytes": telemetry.counter("comm.bytes").value}}

@@ -223,6 +223,12 @@ _C_COMPILES = counter("compile.count")        # jit compiles, all sites
 _C_COMPILE_MS = counter("compile.ms")         # compile wall ms, all sites
 _C_COMM_BYTES = counter("comm.bytes")         # collective payload bytes
 _C_STEPS = counter("telemetry.steps")         # emitted step records
+_C_DISPATCH = counter("dispatch.count")       # XLA executable dispatches
+# whole-step capture health (imperative/cached_step.py writes these)
+_C_CS_HITS = counter("cachedstep.hits")
+_C_CS_COMPILES = counter("cachedstep.compiles")
+_C_CS_FALLBACKS = counter("cachedstep.fallbacks")
+_C_CS_BREAKS = counter("cachedstep.graph_breaks")
 
 
 def record_compile(seconds: float, kind: str) -> None:
@@ -411,13 +417,20 @@ def enabled() -> bool:
 # -- the per-step record stream ---------------------------------------------
 
 class _StepToken:
-    __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes")
+    __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes",
+                 "dispatches", "cs_hits", "cs_compiles", "cs_fallbacks",
+                 "cs_breaks")
 
     def __init__(self):
         self.t0 = time.perf_counter()
         self.compiles = _C_COMPILES.value
         self.compile_ms = _C_COMPILE_MS.value
         self.comm_bytes = _C_COMM_BYTES.value
+        self.dispatches = _C_DISPATCH.value
+        self.cs_hits = _C_CS_HITS.value
+        self.cs_compiles = _C_CS_COMPILES.value
+        self.cs_fallbacks = _C_CS_FALLBACKS.value
+        self.cs_breaks = _C_CS_BREAKS.value
 
 
 # nesting guard: gluon.Trainer.step pushes through kvstore.pushpull —
@@ -522,6 +535,13 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
         "compile_ms": round(_C_COMPILE_MS.value - token.compile_ms, 3),
         "collective_bytes": _C_COMM_BYTES.value - token.comm_bytes,
         "device_mem": device_memory_record(),
+        "dispatches": _C_DISPATCH.value - token.dispatches,
+        "cached_step": {
+            "hits": _C_CS_HITS.value - token.cs_hits,
+            "compiles": _C_CS_COMPILES.value - token.cs_compiles,
+            "fallbacks": _C_CS_FALLBACKS.value - token.cs_fallbacks,
+            "graph_breaks": _C_CS_BREAKS.value - token.cs_breaks,
+        },
     }
     histogram("step.host_ms").observe(host_ms)
     if extra:

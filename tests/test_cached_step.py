@@ -1,0 +1,417 @@
+"""Whole-step graph capture for eager Gluon training
+(imperative/cached_step.py).
+
+Covers the acceptance criterion — steady-state record->backward->step
+runs as exactly ONE XLA dispatch, asserted through the unified
+``dispatch.count`` telemetry counter and the CachedStep:: profiler
+record — plus the fallback matrix (shape change re-captures, forward
+hooks bypass, control-flow divergence breaks with correct numerics,
+host sync inside the deferred window breaks, MXNET_CACHED_STEP=0 stays
+eager), numeric equivalence against the uncaptured path, the break
+latch, the shared backward-jit cache (autograd._BWD_JIT), and the
+kvstore update_on_kvstore donation regression.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, profiler, telemetry
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.imperative import cached_step
+from mxnet_tpu.ops import registry
+
+_DISPATCH = telemetry.counter("dispatch.count")
+
+
+def _make_net(n_layers=4, units=4, seed=0):
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.Sequential()
+    for _ in range(n_layers):
+        net.add(nn.Dense(units, in_units=units, activation="relu"))
+    net.add(nn.Dense(1, in_units=units))
+    net.initialize()
+    return net
+
+
+def _snapshot(net, trainer):
+    weights = [p._data_nd().asnumpy().copy()
+               for p in net.collect_params().values()]
+    states = {}
+    for upd in getattr(trainer, "_updaters", []):
+        for k, v in upd.states.items():
+            states[k] = tuple(s.asnumpy().copy() for s in v)
+    return weights, states
+
+
+def _assert_close(a, b, tol=1e-6):
+    ws_a, st_a = a
+    ws_b, st_b = b
+    assert len(ws_a) == len(ws_b)
+    for x, y in zip(ws_a, ws_b):
+        onp.testing.assert_allclose(x, y, rtol=0, atol=tol)
+    assert st_a.keys() == st_b.keys()
+    for k in st_a:
+        for x, y in zip(st_a[k], st_b[k]):
+            onp.testing.assert_allclose(x, y, rtol=0, atol=tol)
+
+
+def _train(opt_name="sgd", opt_args=None, nsteps=6, env=None,
+           monkeypatch=None, hybridize=False, loss_fn=None, n_layers=4,
+           batches=None, post_backward=None):
+    """nsteps of record->backward->step on a deterministic net; returns
+    (net, trainer, per-step dispatch deltas)."""
+    if env:
+        assert monkeypatch is not None
+        for k, v in env.items():
+            monkeypatch.setenv(k, v)
+    try:
+        net = _make_net(n_layers=n_layers)
+        if hybridize:
+            net.hybridize()
+        trainer = Trainer(net.collect_params(), opt_name,
+                          dict(opt_args or {"learning_rate": 0.1}),
+                          kvstore=None)
+        xs = batches or [nd.array(
+            onp.random.RandomState(1).randn(8, 4).astype("float32"))] \
+            * nsteps
+        deltas = []
+        for i, x in enumerate(xs):
+            d0 = _DISPATCH.value
+            with autograd.record():
+                y = net(x)
+                loss = loss_fn(y, i) if loss_fn else (y * y).sum()
+            loss.backward()
+            if post_backward:
+                post_backward(loss, i)
+            trainer.step(batch_size=x.shape[0])
+            deltas.append(_DISPATCH.value - d0)
+        return net, trainer, deltas
+    finally:
+        if env:
+            for k in env:
+                monkeypatch.delenv(k)
+
+
+# -- tier-1 acceptance: one XLA dispatch per steady-state step -------------
+
+def test_one_dispatch_per_step():
+    """After the eager warm-up step, every record->backward->step
+    executes as exactly ONE XLA dispatch — the 2N+1 -> 1 guarantee this
+    subsystem exists for — and the profiler sees one CachedStep record
+    per captured step."""
+    net = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      kvstore=None)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+
+    def one_step():
+        d0 = _DISPATCH.value
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(batch_size=8)
+        return _DISPATCH.value - d0
+
+    warmup = one_step()                       # eager: observe
+    assert warmup > 1                         # many per-op dispatches
+    assert cached_step.trainer_state(trainer)["armed"]
+    s0 = cached_step.stats()
+    compile_step = one_step()                 # capture compiles, 1 dispatch
+    assert compile_step == 1
+    assert cached_step.stats()["compiles"] == s0["compiles"] + 1
+
+    profiler.reset_stats()
+    profiler.set_config(profile_all=True, aggregate_stats=True)
+    profiler.start()
+    try:
+        for _ in range(3):
+            assert one_step() == 1            # steady state: cache hits
+    finally:
+        profiler.stop()
+    records = {k: v["count"] for k, v in profiler.op_stats().items()
+               if k.startswith("CachedStep::")}
+    profiler.reset_stats()
+    assert records == {"CachedStep::SGD": 3}
+    assert cached_step.stats()["hits"] >= s0["hits"] + 3
+
+
+@pytest.mark.parametrize("opt_name,opt_args", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-4}),
+    ("adam", {"learning_rate": 1e-3, "wd": 1e-4}),
+])
+def test_matches_eager_within_tolerance(monkeypatch, opt_name, opt_args):
+    """Captured weights AND optimizer state match the uncaptured eager
+    run within 1e-6 after several steps (acceptance bound)."""
+    net_c, tr_c, deltas = _train(opt_name, opt_args)
+    assert deltas[-1] == 1
+    net_e, tr_e, deltas_e = _train(opt_name, opt_args,
+                                   env={"MXNET_CACHED_STEP": "0"},
+                                   monkeypatch=monkeypatch)
+    assert min(deltas_e) > 1                  # stayed eager throughout
+    _assert_close(_snapshot(net_c, tr_c), _snapshot(net_e, tr_e))
+
+
+def test_hybridized_net_captures(monkeypatch):
+    """A hybridized forward (one jitted graph fn on the tape) rides the
+    cached step too and matches its eager twin."""
+    net_c, tr_c, deltas = _train(hybridize=True)
+    assert deltas[-1] == 1
+    net_e, tr_e, _ = _train(hybridize=True,
+                            env={"MXNET_CACHED_STEP": "0"},
+                            monkeypatch=monkeypatch)
+    _assert_close(_snapshot(net_c, tr_c), _snapshot(net_e, tr_e))
+
+
+# -- fallback matrix -------------------------------------------------------
+
+def test_disabled_env_stays_eager(monkeypatch):
+    """MXNET_CACHED_STEP=0: no capture ever arms, every step dispatches
+    per-op, and the numerics are bitwise-reproducible run-to-run (the
+    disabled path must not leave any capture machinery engaged)."""
+    s0 = cached_step.stats()
+    net_a, tr_a, deltas = _train(env={"MXNET_CACHED_STEP": "0"},
+                                 monkeypatch=monkeypatch)
+    assert min(deltas) > 1
+    assert cached_step.stats()["captures"] == s0["captures"]
+    assert not cached_step.trainer_state(tr_a)["armed"]
+    net_b, tr_b, _ = _train(env={"MXNET_CACHED_STEP": "0"},
+                            monkeypatch=monkeypatch)
+    _assert_close(_snapshot(net_a, tr_a), _snapshot(net_b, tr_b), tol=0)
+
+
+def test_shape_change_recaptures():
+    """Two alternating input shapes -> two cache entries; BOTH reach
+    the 1-dispatch steady state (signature-keyed cache, no thrash)."""
+    xa = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    xb = nd.array(onp.random.RandomState(2).randn(4, 4).astype("float32"))
+    net, trainer, deltas = _train(
+        nsteps=8, batches=[xa, xb, xa, xb, xa, xb, xa, xb])
+    assert cached_step.trainer_state(trainer)["captures"] == 2
+    # once both signatures are compiled, every step is one dispatch
+    assert deltas[-4:] == [1, 1, 1, 1]
+
+
+def test_forward_hook_bypasses_capture():
+    """A forward hook must see every step: capture declines up front
+    (the hook would be silently skipped inside a replayed graph)."""
+    net = _make_net()
+    calls = []
+    net[0].register_forward_hook(lambda blk, args, out: calls.append(1))
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      kvstore=None)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    for _ in range(3):
+        d0 = _DISPATCH.value
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(batch_size=8)
+        assert _DISPATCH.value - d0 > 1       # stayed eager
+    st = cached_step.trainer_state(trainer)
+    assert st["captures"] == 0
+    assert st["last_reason"] == "forward hook attached"
+    assert len(calls) == 3
+
+
+def test_control_flow_divergence_falls_back(monkeypatch):
+    """A Python-level branch changing the traced graph step-to-step
+    must never replay the wrong program: the mismatching steps break to
+    eager replay and the final weights match the uncaptured run."""
+    def loss_fn(y, i):
+        return (y * y).sum() if i % 2 == 0 else (y * y).sum() * 2.0
+
+    net_c, tr_c, _ = _train(loss_fn=loss_fn)
+    assert cached_step.trainer_state(tr_c)["breaks"] > 0
+    net_e, tr_e, _ = _train(loss_fn=loss_fn,
+                            env={"MXNET_CACHED_STEP": "0"},
+                            monkeypatch=monkeypatch)
+    _assert_close(_snapshot(net_c, tr_c), _snapshot(net_e, tr_e))
+
+
+def test_host_sync_graph_break(monkeypatch):
+    """asnumpy() on a deferred array inside the captured window is a
+    graph break: the pending ops replay eagerly, numerics stay correct,
+    and the break is counted + attributed."""
+    read = lambda loss, i: loss.asnumpy()
+    net_c, tr_c, deltas = _train(post_backward=read)
+    st = cached_step.trainer_state(tr_c)
+    assert st["breaks"] >= 1
+    assert st["last_reason"] == "host sync on a deferred array"
+    assert min(deltas) > 1                    # every step ran eagerly
+    net_e, tr_e, _ = _train(post_backward=read,
+                            env={"MXNET_CACHED_STEP": "0"},
+                            monkeypatch=monkeypatch)
+    _assert_close(_snapshot(net_c, tr_c), _snapshot(net_e, tr_e))
+
+
+def test_deferred_loss_readable_after_step():
+    """Reading the loss AFTER step() needs no break: the cached step's
+    outputs fill the deferred placeholders."""
+    losses = []
+    net = _make_net()
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      kvstore=None)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    deltas = []
+    for _ in range(5):
+        d0 = _DISPATCH.value
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(batch_size=8)
+        deltas.append(_DISPATCH.value - d0)
+        losses.append(float(loss.asnumpy()))  # filled, not broken
+    assert deltas[-1] == 1
+    assert all(onp.isfinite(l) for l in losses)
+    assert cached_step.trainer_state(trainer)["breaks"] == 0
+
+
+def test_break_storm_latches_off(monkeypatch):
+    """Persistent breaks (here: a host sync every step) latch capture
+    off for the trainer instead of re-capturing forever."""
+    monkeypatch.setattr(registry, "_MAX_JIT_SIGS", 1)
+    net, trainer, _ = _train(nsteps=8,
+                             post_backward=lambda loss, i: loss.asnumpy())
+    assert cached_step.trainer_state(trainer)["disabled"]
+
+
+# -- satellite: shared backward-jit cache ----------------------------------
+
+def test_bwd_jit_shared_across_identical_layers(monkeypatch):
+    """_OpRecords with the same (fn, avals) — e.g. a stack of identical
+    Dense layers — share ONE compiled vjp instead of one per record."""
+    monkeypatch.setenv("MXNET_CACHED_STEP", "0")
+    autograd._BWD_JIT.clear()
+    autograd._BWD_FAMS.clear()
+    net = _make_net(n_layers=8)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    n_records = len(autograd._tape())
+    loss.backward()
+    assert n_records >= 9
+    # 8 identical hidden layers collapse onto a handful of signatures
+    assert 0 < len(autograd._BWD_JIT) < n_records
+
+
+def test_bwd_jit_over_budget_has_no_family_latch(monkeypatch):
+    """Signatures past MXNET_JIT_MAX_SIGS run the eager vjp WITHOUT
+    demoting the family: already-compiled signatures keep replaying
+    their compiled transpose."""
+    import jax.numpy as jnp
+    from types import SimpleNamespace
+
+    monkeypatch.setattr(registry, "_MAX_JIT_SIGS", 1)
+
+    def f(x):
+        return x * 2.0
+    f._mx_stable_fn = True
+    rec_a = SimpleNamespace(fn=f, saved_inputs=[jnp.ones((3,))],
+                            multi_out=False)
+    rec_b = SimpleNamespace(fn=f, saved_inputs=[jnp.ones((5,))],
+                            multi_out=False)
+    try:
+        first = autograd._get_jitted_bwd(rec_a)
+        assert first is not None              # slot granted, compiled
+        assert autograd._get_jitted_bwd(rec_b) is None   # over budget
+        again = autograd._get_jitted_bwd(rec_a)
+        assert again is first                 # no latch: still compiled
+        assert autograd._get_jitted_bwd(rec_b) is None   # still eager
+    finally:
+        for key in [k for k in autograd._BWD_JIT if k[0][0] is f]:
+            del autograd._BWD_JIT[key]
+        for fam in [k for k in autograd._BWD_FAMS if k[0] is f]:
+            del autograd._BWD_FAMS[fam]
+
+
+# -- satellite: kvstore update_on_kvstore donation regression --------------
+
+def test_update_on_kvstore_no_deleted_array(monkeypatch):
+    """Single-process store + update_on_kvstore=True + fused step: the
+    store's weight copy shares the param's jax buffer, so the fused
+    path must NOT donate it — previously step 2+ crashed with
+    'Array has been deleted' when the param was read back."""
+    def run(fused):
+        if not fused:
+            monkeypatch.setenv("MXNET_FUSED_STEP", "0")
+        try:
+            net = _make_net()
+            trainer = Trainer(net.collect_params(), "sgd",
+                              {"learning_rate": 0.1, "momentum": 0.9},
+                              kvstore="local", update_on_kvstore=True)
+            x = nd.array(
+                onp.random.RandomState(1).randn(8, 4).astype("float32"))
+            for _ in range(3):
+                with autograd.record():
+                    loss = (net(x) ** 2).sum()
+                loss.backward()
+                trainer.step(batch_size=8)
+            # the read that used to throw "Array has been deleted"
+            return [p.data().asnumpy().copy()
+                    for p in net.collect_params().values()]
+        finally:
+            if not fused:
+                monkeypatch.delenv("MXNET_FUSED_STEP")
+
+    fused = run(True)
+    per_key = run(False)
+    assert len(fused) == len(per_key)
+    for a, b in zip(fused, per_key):
+        assert onp.isfinite(a).all()
+        assert (a == b).all()
+
+
+def test_kvstore_declines_capture():
+    """update_on_kvstore routes updates through the store, outside the
+    trainer's fused step — whole-step capture must decline, not wedge."""
+    net = _make_net(n_layers=2)
+    trainer = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                      kvstore="local", update_on_kvstore=True)
+    x = nd.array(onp.random.RandomState(1).randn(8, 4).astype("float32"))
+    for _ in range(3):
+        with autograd.record():
+            loss = (net(x) ** 2).sum()
+        loss.backward()
+        trainer.step(batch_size=8)
+    st = cached_step.trainer_state(trainer)
+    assert st["captures"] == 0
+    assert st["last_reason"] == "kvstore configuration not capturable"
+
+
+# -- telemetry / profiler integration --------------------------------------
+
+def test_profiler_counters_have_cached_step_sections():
+    c = profiler.counters()
+    assert set(c["cached_step"]) == {"captures", "compiles", "hits",
+                                     "steps", "fallbacks", "graph_breaks"}
+    assert c["dispatch"]["count"] == _DISPATCH.value
+
+
+def test_step_record_reports_dispatches_and_cached_step(tmp_path,
+                                                        monkeypatch):
+    """Per-step telemetry records carry the dispatch count and the
+    cached-step deltas: warm-up shows many dispatches, steady state
+    shows exactly 1 with a cache hit."""
+    import json
+    import pathlib
+    path = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    _train(nsteps=4)
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()                       # detach sink, flush file
+    records = [json.loads(l) for l in
+               pathlib.Path(path).read_text().splitlines() if l]
+    assert len(records) == 4
+    for rec in records:
+        assert set(rec["cached_step"]) == {"hits", "compiles",
+                                           "fallbacks", "graph_breaks"}
+        # the record window opens at trainer.step(): the eager warm-up's
+        # per-op forward/backward dispatches land before it, so every
+        # step window contains exactly its one optimizer-or-whole-step
+        # dispatch
+        assert rec["dispatches"] >= 1
+    assert records[1]["cached_step"]["compiles"] == 1
+    assert records[-1]["dispatches"] == 1     # steady state: whole step
+    assert records[-1]["cached_step"]["hits"] == 1

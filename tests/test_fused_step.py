@@ -225,11 +225,14 @@ def test_max_jit_sigs_env(monkeypatch):
 
 def test_profiler_counters_snapshot():
     c = profiler.counters()
-    assert set(c) == {"eager_jit", "fused_step", "optimizer",
-                      "compile", "comm"}
+    assert set(c) == {"eager_jit", "fused_step", "cached_step",
+                      "optimizer", "compile", "comm", "dispatch"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
+    assert set(c["cached_step"]) == {"captures", "compiles", "hits",
+                                     "steps", "fallbacks", "graph_breaks"}
     assert c["optimizer"]["dispatches"] >= 0
+    assert c["dispatch"]["count"] >= 0
     assert set(c["compile"]) == {"count", "ms"}
     assert set(c["comm"]) == {"bytes"}
     # it's a snapshot: mutating it must not touch the live counters

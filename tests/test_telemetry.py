@@ -94,9 +94,11 @@ def test_jsonl_three_step_records(tmp_path, monkeypatch):
     # the per-record compile delta is the registry delta measured
     # around each step — same counter, no second bookkeeping
     assert [r["compiles"] for r in records] == per_step_compiles
-    # first step pays the fused-step compile; steady state pays none
+    # first step pays the fused-step compile; the second pays the
+    # whole-step capture compile (imperative/cached_step.py, skipped
+    # when MXNET_CACHED_STEP=0); steady state pays none
     assert records[0]["compiles"] >= 1
-    assert records[1]["compiles"] == records[2]["compiles"] == 0
+    assert records[2]["compiles"] == 0
     # registry agreement: profiler.counters() reads the same objects
     c = profiler.counters()
     assert c["compile"]["count"] == telemetry.counter("compile.count").value
