@@ -76,6 +76,16 @@ cached_step_smoke() { # whole-step capture: tests + dispatch-count bench
     JAX_PLATFORMS=cpu python benchmark/cached_step_bench.py --steps 10
 }
 
+serving_smoke() {     # dynamic batching: tests + throughput-gate bench
+    # tier-1 covers bucket reuse (0 compiles / 1 dispatch per batch),
+    # bitwise batching parity, and the reject/timeout/drain matrix —
+    # all through the in-process API (CPU, no sockets)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q
+    # then the bench must beat the batch-1 baseline by >=3x on the
+    # closed-loop CPU MLP (exits non-zero otherwise)
+    JAX_PLATFORMS=cpu python benchmark/serving_bench.py --smoke
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

@@ -240,6 +240,20 @@ class Block:
         self._forward_hooks.append(hook)
         return _HookHandle(self._forward_hooks, hook)
 
+    def has_hooks(self) -> bool:
+        """True when any block in the tree carries a forward (pre-)hook.
+        Capture paths that would hide real activations from hooks — the
+        whole-step capture and the serving engine's bucketed compile
+        (serving/engine.py) — check this and decline to compile."""
+        seen = set()
+        for b in _walk_blocks(self):
+            if id(b) in seen:
+                continue
+            seen.add(id(b))
+            if b._forward_hooks or b._forward_pre_hooks:
+                return True
+        return False
+
     # -- call --------------------------------------------------------------
     def __call__(self, *args, **kwargs):
         if self._forward_pre_hooks or self._forward_hooks:
@@ -358,6 +372,7 @@ class HybridBlock(Block):
         super().__init__(prefix, params)
         self._active = False
         self._cached_graphs: Dict[Any, Any] = {}
+        self._sig_budget: Optional[Any] = None
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
@@ -381,6 +396,7 @@ class HybridBlock(Block):
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
         self._cached_graphs.clear()
+        self._sig_budget = None     # re-read MXNET_JIT_MAX_SIGS
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
 
@@ -430,6 +446,16 @@ class HybridBlock(Block):
         entry = self._cached_graphs.get(sig)
         fresh = entry is None
         if fresh:
+            # fresh signatures burn the shared MXNET_JIT_MAX_SIGS budget
+            # (the same per-family budget/latch the op funnel and the
+            # serving engine use); over budget this signature runs eager
+            # while every already-compiled signature keeps serving its
+            # executable — no eviction
+            if self._sig_budget is None:
+                from ..ops.registry import SigBudget
+                self._sig_budget = SigBudget()
+            if not self._sig_budget.admit(len(self._cached_graphs)):
+                return Block.__call__(self, *args, **kwargs)
             entry = self._build_cached(args, kwargs, pkeys, pvals)
             self._cached_graphs[sig] = entry
         jitted, cell = entry
@@ -716,6 +742,14 @@ class _ExportedBlock(Block):
 
     def __call__(self, *args, **kwargs):
         return self.forward(*args)
+
+    def input_signatures(self):
+        """The exported input signatures, one per serialized executable:
+        ``[((shape, dtype), ...), ...]``.  The serving engine
+        (serving/engine.py) derives its shape buckets from these — an
+        exported artifact can only serve the batch shapes it was
+        exported with."""
+        return [sig for sig, _, _, _ in self._entries]
 
     def forward(self, *args):
         nd_in = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))

@@ -24,7 +24,7 @@ from ..imperative import cached_step as _cached_step
 _DISPATCH_CT = telemetry.counter("dispatch.count")
 
 __all__ = ["Operator", "register", "alias", "get", "list_ops", "invoke",
-           "apply_jax"]
+           "apply_jax", "SigBudget"]
 
 _REGISTRY: Dict[str, "Operator"] = {}
 
@@ -309,6 +309,36 @@ class _JitEntry:
                 _JIT_STATS["hits"] += 1
             return out
         return fn(*arrays)
+
+
+class SigBudget:
+    """Shared ``MXNET_JIT_MAX_SIGS`` budget/latch for signature-keyed
+    compiled-executable caches (``HybridBlock._call_cached`` entries,
+    the serving engine's shape buckets — serving/engine.py).
+
+    ``admit(n_compiled)`` answers whether a FRESH signature may compile
+    given ``n_compiled`` already-compiled ones.  Over budget the cache
+    latches: new signatures run eager, while every already-compiled
+    signature keeps serving its executable — no eviction, so a compile
+    storm degrades to eager instead of thrashing the cache."""
+
+    __slots__ = ("limit", "latched", "declines")
+
+    def __init__(self, limit: Optional[int] = None):
+        self.limit = (int(limit) if limit is not None
+                      else _read_max_jit_sigs())
+        self.latched = False
+        self.declines = 0
+
+    def admit(self, n_compiled: int) -> bool:
+        if n_compiled < self.limit:
+            self.latched = False
+            return True
+        if not self.latched:
+            self.latched = True
+            _JIT_STATS["latches"] += 1
+        self.declines += 1
+        return False
 
 
 def _params_key(params: dict):

@@ -97,8 +97,12 @@ def counters() -> Dict[str, Dict[str, int]]:
     - ``dispatch``: total XLA executable dispatches, all sites (forward
       ops, vjps, optimizer/cached steps) — the 1-dispatch/step counter
     - ``compile``: jit compiles + compile wall ms across every compile
-      site (op funnel, fused step, CachedOp, cached step, SPMD step)
+      site (op funnel, fused step, CachedOp, cached step, SPMD step,
+      serving engine)
     - ``comm``: collective payload bytes (dense + sparse kvstore paths)
+    - ``serving``: the inference subsystem (requests/batches served,
+      eager fallback batches, bucket compiles, shed/expired requests —
+      mxnet_tpu/serving/)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -115,7 +119,18 @@ def counters() -> Dict[str, Dict[str, int]]:
             "dispatch": {"count": telemetry.counter("dispatch.count").value},
             "compile": {"count": telemetry.counter("compile.count").value,
                         "ms": telemetry.counter("compile.ms").value},
-            "comm": {"bytes": telemetry.counter("comm.bytes").value}}
+            "comm": {"bytes": telemetry.counter("comm.bytes").value},
+            "serving": {
+                "requests": telemetry.counter("serving.requests").value,
+                "batches": telemetry.counter("serving.batches").value,
+                "eager_batches":
+                    telemetry.counter("serving.eager_batches").value,
+                "compiles":
+                    telemetry.counter("compile.serving.count").value,
+                "rejects":
+                    telemetry.counter("serving.rejected.queue_full").value
+                    + telemetry.counter("serving.rejected.shape").value,
+                "timeouts": telemetry.counter("serving.timeouts").value}}
 
 
 def set_config(**kwargs):

@@ -1,0 +1,18 @@
+"""mxnet_tpu.serving — the inference serving subsystem.
+
+queue → :class:`DynamicBatcher` → shape-bucketed
+:class:`InferenceEngine` (AOT-compiled executable per bucket) →
+per-request futures; :class:`ServingServer` fronts the pair with an
+in-process ``predict()`` API and an optional stdlib HTTP JSON endpoint.
+See docs/ARCHITECTURE.md (Serving) for the dataflow and the
+admission/reject/timeout contract.
+"""
+from .engine import (InferenceEngine, BadRequestError, QueueFullError,
+                     RequestTimeoutError, ServingClosedError,
+                     serving_enabled)
+from .batcher import DynamicBatcher
+from .server import ServingServer
+
+__all__ = ["InferenceEngine", "DynamicBatcher", "ServingServer",
+           "BadRequestError", "QueueFullError", "RequestTimeoutError",
+           "ServingClosedError", "serving_enabled"]

@@ -1,0 +1,326 @@
+"""DynamicBatcher: coalesce concurrent requests into bucketed dispatches.
+
+The robustness surface of the serving subsystem sits here, in front of
+the engine:
+
+- **admission validation** — every request is shape/dtype-checked
+  (``InferenceEngine.validate``) BEFORE it enters the queue, so one
+  malformed request can never poison a coalesced batch;
+- **bounded queue** — at ``queue_depth`` pending requests, new arrivals
+  are rejected with :class:`QueueFullError` (shed load instead of
+  buffering toward OOM);
+- **per-request deadlines** — a request whose deadline passes while
+  queued is expired with :class:`RequestTimeoutError` instead of being
+  dispatched late;
+- **graceful drain** — ``close(drain=True)`` stops admission, then
+  delivers every already-admitted response before returning.
+
+One dispatcher thread pops the queue, waits up to ``max_delay_ms`` for
+the batch to fill toward ``max_batch_size``, groups concatenable
+requests (same padded example shape/dtype), and hands each group to the
+engine as ONE padded batch — results scatter back to the per-request
+futures.  Every dispatch emits a telemetry step record (source
+``serving.DynamicBatcher``) carrying batch occupancy, padding waste and
+per-request latency, reconciled by ``tools/telemetry_report.py``.
+
+Tests drive the batcher deterministically with ``start=False`` +
+``flush()`` (no thread, no sleeps); the server runs it threaded.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .. import telemetry
+from ..base import getenv_int
+from .engine import (InferenceEngine, QueueFullError, RequestTimeoutError,
+                     ServingClosedError)
+
+__all__ = ["DynamicBatcher"]
+
+
+def _getenv_float(name: str, default: float) -> float:
+    import os
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+class _Future:
+    """Minimal thread-safe future (stdlib concurrent.futures carries an
+    executor surface this queue doesn't need)."""
+
+    __slots__ = ("_event", "_result", "_exc")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._result = None
+        self._exc = None
+
+    def set_result(self, result):
+        self._result = result
+        self._event.set()
+
+    def set_exception(self, exc):
+        self._exc = exc
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise RequestTimeoutError(
+                f"no response within {timeout:.3f}s")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+
+class _Pending:
+    __slots__ = ("example", "future", "deadline", "t_submit", "group")
+
+    def __init__(self, example, group, deadline):
+        self.example = example
+        self.future = _Future()
+        self.deadline = deadline
+        self.t_submit = time.perf_counter()
+        self.group = group
+
+
+class DynamicBatcher:
+    """Coalesce concurrent single-example requests into padded batches.
+
+    Knobs (constructor arg > env var > default):
+
+    - ``max_batch_size`` / ``MXNET_SERVING_MAX_BATCH`` (32): most
+      requests coalesced into one dispatch.
+    - ``max_delay_ms`` / ``MXNET_SERVING_MAX_DELAY_MS`` (2.0): how long
+      the dispatcher holds the first request of a batch waiting for the
+      batch to fill.  0 dispatches whatever one queue sweep finds.
+    - ``queue_depth`` / ``MXNET_SERVING_QUEUE_DEPTH`` (256): pending
+      requests admitted before shedding load.
+    - ``timeout_ms``: default per-request deadline (None = no deadline).
+    """
+
+    def __init__(self, engine: InferenceEngine,
+                 max_batch_size: Optional[int] = None,
+                 max_delay_ms: Optional[float] = None,
+                 queue_depth: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 start: bool = True):
+        self.engine = engine
+        self.max_batch_size = max(1, max_batch_size if max_batch_size
+                                  is not None else
+                                  getenv_int("MXNET_SERVING_MAX_BATCH", 32))
+        self.max_delay_ms = max(0.0, max_delay_ms if max_delay_ms
+                                is not None else
+                                _getenv_float("MXNET_SERVING_MAX_DELAY_MS",
+                                              2.0))
+        self.queue_depth = max(1, queue_depth if queue_depth is not None
+                               else getenv_int("MXNET_SERVING_QUEUE_DEPTH",
+                                               256))
+        self.timeout_ms = timeout_ms
+        self._q: deque = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+        self._gauge = telemetry.gauge("serving.queue_depth")
+        self._gauge.set(0)
+        # last-emitted cumulative reject/timeout counts, so each step
+        # record carries deltas the report tool can sum; baselined at
+        # construction or the first record would claim every reject the
+        # process (an earlier batcher) ever counted
+        self._emitted = {
+            "rejects": telemetry.counter("serving.rejected.queue_full").value
+            + telemetry.counter("serving.rejected.shape").value,
+            "timeouts": telemetry.counter("serving.timeouts").value,
+        }
+        if start:
+            self.start()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self):
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._loop, name="mxnet-serving-batcher", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True, timeout: Optional[float] = 30.0):
+        """Stop admission; with ``drain`` deliver every admitted
+        response before returning, else fail pending futures with
+        :class:`ServingClosedError`."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                while self._q:
+                    self._q.popleft().future.set_exception(
+                        ServingClosedError("server shut down before "
+                                           "this request was dispatched"))
+            self._gauge.set(len(self._q))
+            self._cv.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout)
+        # no thread (start=False) or a wedged one: drain inline
+        if drain:
+            self.flush()
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def pending(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, x, timeout_ms: Optional[float] = None) -> _Future:
+        """Admit one request; returns a future resolving to the
+        per-example result.  Raises BadRequestError (shape/dtype),
+        QueueFullError (depth), ServingClosedError (draining) — all
+        BEFORE the request can touch a batch."""
+        # validation happens outside the lock (numpy work), and before
+        # admission: a request that raises here was never queued
+        example = self.engine.validate(x)
+        example, _ = self.engine.pad_example(example)
+        group = self.engine.group_key(example)
+        ms = timeout_ms if timeout_ms is not None else self.timeout_ms
+        deadline = (time.perf_counter() + ms / 1e3
+                    if ms is not None else None)
+        with self._cv:
+            if self._closed:
+                raise ServingClosedError("server is draining/closed")
+            if len(self._q) >= self.queue_depth:
+                telemetry.counter("serving.rejected.queue_full").inc()
+                raise QueueFullError(
+                    f"queue at depth {self.queue_depth}; load shed")
+            p = _Pending(example, group, deadline)
+            self._q.append(p)
+            self._gauge.set(len(self._q))
+            self._cv.notify()
+        return p.future
+
+    # -- dispatch -----------------------------------------------------------
+
+    def _expire(self, now: float) -> None:
+        """Expire queued requests whose deadline passed (caller holds
+        the lock)."""
+        live = [p for p in self._q
+                if not (p.deadline is not None and now > p.deadline)]
+        if len(live) != len(self._q):
+            for p in self._q:
+                if p.deadline is not None and now > p.deadline:
+                    telemetry.counter("serving.timeouts").inc()
+                    p.future.set_exception(RequestTimeoutError(
+                        "request expired in queue before dispatch"))
+            self._q.clear()
+            self._q.extend(live)
+            self._gauge.set(len(self._q))
+
+    def _take_group(self) -> List[_Pending]:
+        """Pop up to ``max_batch_size`` requests sharing the head
+        request's group key (caller holds the lock)."""
+        self._expire(time.perf_counter())
+        if not self._q:
+            return []
+        head = self._q[0].group
+        batch, keep = [], deque()
+        while self._q:
+            p = self._q.popleft()
+            if p.group == head and len(batch) < self.max_batch_size:
+                batch.append(p)
+            else:
+                keep.append(p)
+        self._q.extend(keep)
+        self._gauge.set(len(self._q))
+        return batch
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait(0.1)
+                if not self._q and self._closed:
+                    return
+                batch = self._take_group()
+                if batch and len(batch) < self.max_batch_size \
+                        and self.max_delay_ms > 0 and not self._closed:
+                    # hold the batch open for stragglers
+                    t_end = time.perf_counter() + self.max_delay_ms / 1e3
+                    while len(batch) < self.max_batch_size:
+                        left = t_end - time.perf_counter()
+                        if left <= 0:
+                            break
+                        self._cv.wait(left)
+                        head = batch[0].group
+                        keep = deque()
+                        while self._q and len(batch) < self.max_batch_size:
+                            p = self._q.popleft()
+                            (batch if p.group == head else keep).append(p)
+                        self._q.extend(keep)
+                        self._gauge.set(len(self._q))
+                        if self._closed:
+                            break
+            if batch:
+                self._dispatch(batch)
+
+    def flush(self):
+        """Synchronously dispatch everything currently queued (no delay
+        window) — the deterministic path tests and drain use."""
+        while True:
+            with self._cv:
+                batch = self._take_group()
+            if not batch:
+                return
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        token = telemetry.begin_step()
+        try:
+            results, meta = self.engine.infer_batch(
+                [p.example for p in batch])
+        except Exception as e:   # a failed dispatch fails ITS batch only
+            for p in batch:
+                p.future.set_exception(e)
+            telemetry.counter("serving.failed_batches").inc()
+            telemetry.end_step(token, "serving.DynamicBatcher",
+                               extra={"serving": {"error": str(e),
+                                                  "batch_size": len(batch)}})
+            return
+        now = time.perf_counter()
+        latencies = []
+        for p, r in zip(batch, results):
+            p.future.set_result(r)
+            latencies.append(round((now - p.t_submit) * 1e3, 3))
+        telemetry.record_serving_batch(len(batch), meta["padded"],
+                                       latencies,
+                                       eager=not meta["compiled"])
+        rejects = (telemetry.counter("serving.rejected.queue_full").value
+                   + telemetry.counter("serving.rejected.shape").value)
+        timeouts = telemetry.counter("serving.timeouts").value
+        extra: Dict[str, Any] = {"serving": {
+            "batch_size": len(batch),
+            "padded_batch": meta["padded"],
+            "bucket": meta["bucket"],
+            "compiled": meta["compiled"],
+            "padding_waste": round(1 - len(batch) / meta["padded"], 4)
+            if meta["padded"] else 0.0,
+            "queue_depth": self.pending(),
+            "request_ms": latencies,
+            "rejects": rejects - self._emitted["rejects"],
+            "timeouts": timeouts - self._emitted["timeouts"],
+        }}
+        self._emitted["rejects"] = rejects
+        self._emitted["timeouts"] = timeouts
+        telemetry.end_step(token, "serving.DynamicBatcher", extra=extra)

@@ -1,0 +1,467 @@
+"""InferenceEngine: shape-bucketed AOT-compiled serving forward.
+
+The serving half of the compiled-graph machinery the training funnels
+already use (``HybridBlock._call_cached``, ``imperative/cached_step``):
+one ahead-of-time compiled, donation-free inference executable per
+**shape bucket**, amortized across every request whose padded shape
+lands in that bucket.  Batch (and optional sequence) dims are padded up
+to configurable powers-of-two, so a steady request mix touches a small,
+bounded set of executables instead of one compile per arriving shape.
+
+Compile-storm behavior is shared with the op funnel: fresh buckets burn
+the same ``MXNET_JIT_MAX_SIGS`` budget (``ops.registry.SigBudget``);
+over budget the engine latches — new shapes run eager, every
+already-compiled bucket keeps serving its executable, nothing is
+evicted.  Blocks carrying forward hooks are never compiled (hooks
+observe real activations), and ``MXNET_SERVING=0`` forces the eager
+path process-wide; both fallbacks serve identical numerics.
+
+Requests are single examples (no batch axis).  Results come back as
+host numpy arrays: the dispatch path performs exactly ONE XLA
+executable dispatch per coalesced batch (asserted in tier-1 via the
+unified ``dispatch.count`` counter) — scatter/slicing happens host-side
+so per-request result delivery costs no extra device dispatches.
+"""
+from __future__ import annotations
+
+import threading
+import time as _time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as ag
+from .. import profiler, telemetry
+from ..base import MXNetError, getenv
+from ..gluon.block import (_ExportedBlock, _TraceContext, _trace_scope,
+                           _walk_blocks)
+from ..ndarray import NDArray
+from ..ops import random as _rng
+from ..ops.registry import SigBudget, apply_jax
+
+__all__ = ["InferenceEngine", "BadRequestError", "QueueFullError",
+           "RequestTimeoutError", "ServingClosedError", "serving_enabled"]
+
+
+class BadRequestError(MXNetError):
+    """Request rejected at admission: shape/dtype/rank incompatible with
+    the engine's example spec.  Raised BEFORE the request enters the
+    queue, so one malformed request can never poison a batch."""
+
+
+class QueueFullError(MXNetError):
+    """Request shed at admission: the bounded queue is at depth (load is
+    shed instead of buffering toward OOM)."""
+
+
+class RequestTimeoutError(MXNetError):
+    """Request expired before dispatch (per-request deadline passed)."""
+
+
+class ServingClosedError(MXNetError):
+    """Request arrived after shutdown/drain began."""
+
+
+def serving_enabled() -> bool:
+    """MXNET_SERVING=0 disables the compiled bucket path (every batch
+    runs eager).  Read per dispatch, so it can be flipped live."""
+    return (getenv("MXNET_SERVING", "1") or "1").lower() \
+        not in ("0", "false", "off")
+
+
+def _next_pow2(n: int) -> int:
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+class InferenceEngine:
+    """AOT-compiled, shape-bucketed inference over any Block.
+
+    Parameters
+    ----------
+    block : Block | HybridBlock | _ExportedBlock
+        The model.  For an ``_ExportedBlock`` (from ``HybridBlock.export``
+        → ``SymbolBlock.imports``) the buckets are the exported input
+        signatures — serialized StableHLO is already AOT, so the engine
+        only pads/routes.
+    example_shape : tuple, optional
+        Per-example input shape (no batch axis).  ``None`` entries mark
+        variable axes (bucketed when listed in ``seq_axes``).  Learned
+        from the first request when omitted.
+    dtype : str, optional
+        Expected input dtype (learned from the first request when
+        omitted).
+    bucket_sizes : sequence of int, optional
+        Explicit allowed batch-bucket sizes (sorted ascending); default
+        is unbounded powers of two.
+    seq_axes : sequence of int
+        Example axes (0-based, batch excluded) padded up to power-of-two
+        buckets, for variable-length inputs.  Padding is zeros; only use
+        for models whose per-row outputs ignore trailing positions.
+    max_sigs : int, optional
+        Compiled-bucket budget; defaults to ``MXNET_JIT_MAX_SIGS``.
+    """
+
+    def __init__(self, block, example_shape: Optional[Sequence] = None,
+                 dtype: Optional[str] = None,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 seq_axes: Sequence[int] = (),
+                 max_sigs: Optional[int] = None,
+                 name: Optional[str] = None):
+        self._block = block
+        self._name = name or type(block).__name__
+        self._exported = isinstance(block, _ExportedBlock)
+        self._example_shape = (tuple(example_shape)
+                               if example_shape is not None else None)
+        self._dtype = str(dtype) if dtype is not None else None
+        self._bucket_sizes = (sorted(int(b) for b in bucket_sizes)
+                              if bucket_sizes else None)
+        self._seq_axes = tuple(int(a) for a in seq_axes)
+        self._budget = SigBudget(max_sigs)
+        # bucket key -> (runner, treedef) | None (bucket latched eager
+        # after a failed compile)
+        self._compiled: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+        self._init_done = False
+        if self._exported:
+            self._adopt_exported_spec()
+
+    # -- spec / admission ---------------------------------------------------
+
+    def _adopt_exported_spec(self):
+        sigs = self._block.input_signatures()
+        if not sigs:
+            raise MXNetError("exported block carries no input signatures")
+        if any(len(s) != 1 for s in sigs):
+            raise MXNetError(
+                "serving supports single-input exported blocks; got "
+                f"signatures {sigs}")
+        shapes = [s[0][0] for s in sigs]
+        dtypes = {s[0][1] for s in sigs}
+        if len(dtypes) != 1:
+            raise MXNetError(
+                f"exported signatures disagree on dtype: {dtypes}")
+        self._dtype = dtypes.pop()
+        trailing = {tuple(sh[1:]) for sh in shapes}
+        if len(trailing) != 1:
+            raise MXNetError(
+                f"exported signatures disagree on example shape: {shapes}")
+        self._example_shape = trailing.pop()
+        # exported artifacts can only serve the batch sizes they were
+        # exported with — those ARE the buckets
+        self._bucket_sizes = sorted({int(sh[0]) for sh in shapes})
+
+    @property
+    def example_shape(self):
+        return self._example_shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    def validate(self, x) -> onp.ndarray:
+        """Admission gate: normalize one request to a host numpy example
+        and check it against the engine spec.  Raises
+        :class:`BadRequestError` (and ticks ``serving.rejected.shape``)
+        on any mismatch — malformed requests never reach a batch."""
+        try:
+            arr = onp.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
+        except Exception as e:
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError(f"request is not array-like: {e}") from None
+        if self._dtype is None:
+            if not (onp.issubdtype(arr.dtype, onp.floating)
+                    or onp.issubdtype(arr.dtype, onp.integer)
+                    or arr.dtype == onp.bool_):
+                telemetry.counter("serving.rejected.shape").inc()
+                raise BadRequestError(
+                    f"request dtype {arr.dtype} is not numeric")
+            self._dtype = str(arr.dtype)
+        elif str(arr.dtype) != self._dtype:
+            try:
+                cast = arr.astype(self._dtype)
+            except (TypeError, ValueError):
+                cast = None
+            if cast is None or not onp.array_equal(
+                    cast.astype(arr.dtype, copy=False), arr):
+                telemetry.counter("serving.rejected.shape").inc()
+                raise BadRequestError(
+                    f"request dtype {arr.dtype} does not match engine "
+                    f"dtype {self._dtype}")
+            arr = cast
+        if self._example_shape is None:
+            self._example_shape = tuple(
+                None if i in self._seq_axes else d
+                for i, d in enumerate(arr.shape))
+        spec = self._example_shape
+        if arr.ndim != len(spec):
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError(
+                f"request rank {arr.ndim} (shape {arr.shape}) does not "
+                f"match example spec {spec}")
+        for i, (have, want) in enumerate(zip(arr.shape, spec)):
+            if want is not None and have != want:
+                telemetry.counter("serving.rejected.shape").inc()
+                raise BadRequestError(
+                    f"request shape {arr.shape} does not match example "
+                    f"spec {spec} (axis {i}: {have} != {want})")
+        return arr
+
+    def _bucket_batch(self, n: int) -> int:
+        if self._bucket_sizes is not None:
+            for b in self._bucket_sizes:
+                if b >= n:
+                    return b
+            raise BadRequestError(
+                f"batch of {n} exceeds the largest available bucket "
+                f"{self._bucket_sizes[-1]} (exported artifacts serve "
+                "only their exported batch sizes)")
+        return _next_pow2(n)
+
+    def pad_example(self, arr: onp.ndarray) -> Tuple[onp.ndarray,
+                                                     Tuple[int, ...]]:
+        """Pad an admitted example's seq axes up to their buckets.
+        Returns (padded example, original shape)."""
+        orig = arr.shape
+        if not self._seq_axes:
+            return arr, orig
+        pads = []
+        for i, d in enumerate(arr.shape):
+            want = _next_pow2(d) if i in self._seq_axes else d
+            pads.append((0, want - d))
+        if any(p[1] for p in pads):
+            arr = onp.pad(arr, pads)
+        return arr, orig
+
+    def group_key(self, padded: onp.ndarray):
+        """Coalescing key: requests sharing it are concatenable."""
+        return (padded.shape, str(padded.dtype))
+
+    # -- compile ------------------------------------------------------------
+
+    def _bucket_tag(self, key) -> str:
+        (shape, dtype) = key
+        return "x".join(str(d) for d in shape) + ":" + dtype
+
+    def _ensure_init(self, batched: onp.ndarray):
+        """Finish deferred parameter init with one eager forward (the
+        analogue of HybridBlock's first-call eager pass)."""
+        if self._init_done or self._exported:
+            return
+        params = self._block.collect_params()
+        if any(p._deferred_init is not None for p in params.values()):
+            with ag.pause(train_mode=False):
+                self._block(NDArray(jnp.asarray(batched)))
+        for p in params.values():
+            p._check_initialized()
+        self._init_done = True
+
+    def _compile(self, key, batched_shape, dtype):
+        """Trace + AOT-compile the inference forward for one bucket:
+        a pure function of (rng key, *params, input) lowered and
+        compiled ahead of execution (donation-free — serving never owns
+        its inputs).  Returns the cache entry, or None when this bucket
+        latched eager (trace/compile failure)."""
+        block = self._block
+        params = block.collect_params()
+        pvals = list(params.values())
+        cell: Dict[str, Any] = {"n_out": None, "treedef": None}
+
+        def traced(rkey, *arrays):
+            p_arr = arrays[:len(pvals)]
+            in_arr = arrays[len(pvals):]
+            tc = _TraceContext(rkey)
+            saved = [p._data for p in pvals]
+            try:
+                for p, a in zip(pvals, p_arr):
+                    p._data = NDArray(a)
+                with _trace_scope(tc), ag.pause(train_mode=False):
+                    out = block(NDArray(in_arr[0]))
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))
+                raw = [l._data if isinstance(l, NDArray) else jnp.asarray(l)
+                       for l in leaves]
+                cell["n_out"] = len(raw)
+                cell["treedef"] = treedef
+                # inference never applies aux updates (running stats are
+                # read, not written, outside train_mode)
+                return tuple(raw)
+            finally:
+                for p, s in zip(pvals, saved):
+                    p._data = s
+
+        rkey = _rng.next_key()
+        specs = [jax.ShapeDtypeStruct(rkey.shape, rkey.dtype)]
+        specs += [jax.ShapeDtypeStruct(p.data().shape,
+                                       jnp.dtype(str(p.data().dtype)))
+                  for p in pvals]
+        specs += [jax.ShapeDtypeStruct(batched_shape, jnp.dtype(dtype))]
+        # hybridized children would nest their own jit inside this trace;
+        # suspend hybridization so the bucket lowers to ONE flat program
+        hybrid = [(b, b._active) for b in
+                  {id(b): b for b in _walk_blocks(block)}.values()
+                  if hasattr(b, "_active")]
+        t0 = _time.perf_counter()
+        try:
+            for b, _ in hybrid:
+                b._active = False
+            compiled = jax.jit(traced).lower(*specs).compile()
+        except Exception:
+            return None
+        finally:
+            for b, was in hybrid:
+                b._active = was
+        telemetry.record_compile(_time.perf_counter() - t0, "serving")
+        telemetry.counter(
+            f"serving.bucket.{self._bucket_tag(key)}.compiles").inc()
+        n_params = len(pvals)
+
+        def runner(batched_nd: NDArray):
+            rkey = _rng.next_key()
+            arrays = [NDArray(rkey)] + \
+                [params[k].data() for k in params] + [batched_nd]
+            assert len(arrays) == n_params + 2
+            return apply_jax(lambda *arr: compiled(*arr), arrays,
+                             multi_out=True, record=False)
+
+        return runner, cell
+
+    def warmup(self, specs: Sequence) -> List[str]:
+        """AOT-compile buckets ahead of traffic.  ``specs`` entries are
+        batch sizes (int) — example shape/dtype must be known — or full
+        batched shapes (tuple), optionally (shape, dtype).  Returns the
+        bucket tags compiled (or already present)."""
+        tags = []
+        for spec in specs:
+            dtype = self._dtype
+            if isinstance(spec, (int, onp.integer)):
+                if self._example_shape is None or dtype is None or \
+                        any(d is None for d in self._example_shape):
+                    raise MXNetError(
+                        "warmup(batch_size) needs a fully-specified "
+                        "example_shape and dtype at engine construction")
+                shape = (self._bucket_batch(int(spec)),
+                         *self._example_shape)
+            else:
+                if isinstance(spec, tuple) and len(spec) == 2 and \
+                        not isinstance(spec[0], (int, onp.integer)):
+                    shape, dtype = tuple(spec[0]), str(spec[1])
+                else:
+                    shape = tuple(spec)
+                shape = (self._bucket_batch(shape[0]), *shape[1:])
+            if dtype is None:
+                raise MXNetError("warmup spec needs a dtype")
+            key = (shape, str(dtype))
+            self._get_runner(key, warm=True)
+            tags.append(self._bucket_tag(key))
+        return tags
+
+    def _get_runner(self, key, warm: bool = False):
+        """The compiled entry for a bucket key, compiling under budget;
+        None when this dispatch must run eager."""
+        if self._exported:
+            return "exported"
+        if not serving_enabled():
+            return None
+        if not warm and self._block.has_hooks():
+            # hooks observe real activations: decline capture, run eager
+            # so the hooks fire per dispatch
+            return None
+        entry = self._compiled.get(key)
+        if entry is not None:
+            return entry          # includes the eager latch sentinel
+        with self._lock:
+            entry = self._compiled.get(key)
+            if entry is None:
+                n_live = sum(1 for v in self._compiled.values()
+                             if v is not None)
+                if not self._budget.admit(n_live):
+                    return None   # over budget: eager, no eviction
+                shape, dtype = key
+                self._ensure_init(onp.zeros(shape, dtype))
+                entry = self._compile(key, shape, dtype)
+                if entry is None:
+                    entry = "eager"     # failed compile: latch this bucket
+                self._compiled[key] = entry
+        return entry if entry != "eager" else None
+
+    # -- dispatch -----------------------------------------------------------
+
+    def infer_batch(self, examples: Sequence[onp.ndarray]):
+        """Run one coalesced batch of admitted (validated, seq-padded)
+        examples.  Returns ``(results, meta)``: per-example host-numpy
+        results mirroring the block's output structure, and dispatch
+        metadata for telemetry (bucket tag, padded size, compiled?)."""
+        if not examples:
+            return [], {"bucket": None, "padded": 0, "compiled": False}
+        n = len(examples)
+        stacked = onp.stack([onp.asarray(e) for e in examples])
+        bucket = self._bucket_batch(n)
+        if bucket > n:
+            stacked = onp.pad(
+                stacked, [(0, bucket - n)] + [(0, 0)] * (stacked.ndim - 1))
+        key = ((bucket, *stacked.shape[1:]), str(stacked.dtype))
+        entry = self._get_runner(key)
+        t0 = profiler.op_timer()
+        if entry == "exported":
+            with ag.pause(train_mode=False):
+                out = self._block(NDArray(jnp.asarray(stacked)))
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            compiled = True
+        elif entry is not None:
+            runner, cell = entry
+            leaves = runner(NDArray(jnp.asarray(stacked)))
+            treedef = cell["treedef"]
+            compiled = True
+        else:
+            self._ensure_init(stacked)
+            with ag.pause(train_mode=False):
+                out = self._block(NDArray(jnp.asarray(stacked)))
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            compiled = False
+        profiler.op_record(f"Serving::{self._name}", t0)
+        telemetry.counter(
+            f"serving.bucket.{self._bucket_tag(key)}.dispatches").inc()
+        # host-side scatter: one transfer per output leaf, zero extra
+        # device dispatches for per-request slicing
+        host = [l.asnumpy() if isinstance(l, NDArray) else onp.asarray(l)
+                for l in leaves]
+        results = []
+        for i in range(n):
+            rows = [h[i] if h.ndim and h.shape[0] == bucket else h
+                    for h in host]
+            results.append(jax.tree_util.tree_unflatten(treedef, rows)
+                           if treedef is not None else rows[0])
+        meta = {"bucket": self._bucket_tag(key), "padded": bucket,
+                "compiled": compiled}
+        return results, meta
+
+    def infer(self, x, timeout_ms=None):
+        """Single-request convenience: validate → pad → dispatch a
+        1-request batch.  (``timeout_ms`` accepted for API symmetry with
+        the batcher; a direct call never queues.)"""
+        arr = self.validate(x)
+        arr, _ = self.pad_example(arr)
+        results, _ = self.infer_batch([arr])
+        return results[0]
+
+    # -- introspection ------------------------------------------------------
+
+    def buckets(self) -> List[str]:
+        """Tags of the buckets currently holding a compiled executable."""
+        if self._exported:
+            return [self._bucket_tag(((b, *self._example_shape),
+                                      self._dtype))
+                    for b in self._bucket_sizes]
+        return sorted(self._bucket_tag(k)
+                      for k, v in self._compiled.items() if v is not None)
+
+    def stats(self) -> Dict[str, Any]:
+        return {
+            "buckets": len(self.buckets()),
+            "latched": self._budget.latched,
+            "budget_declines": self._budget.declines,
+        }

@@ -1,0 +1,162 @@
+"""ServingServer: in-process serving API + stdlib HTTP JSON endpoint.
+
+The in-process surface is primary — ``predict()`` submits to the
+batcher and blocks on the future, so tier-1 tests (and co-located
+Python callers) exercise the full queue → batcher → bucketed-engine
+path with no sockets.  The HTTP endpoint is a thin stdlib
+``http.server`` shim over the same calls:
+
+- ``POST /predict``  body ``{"data": <nested list>, "dtype"?: str,
+  "timeout_ms"?: number}`` → ``{"output": <nested list>}`` (or
+  ``{"outputs": [...]}`` for multi-output blocks).
+- ``GET /healthz`` → queue depth, compiled buckets, drain state.
+
+Error mapping: admission shape reject → 400, queue full (load shed) →
+429, request deadline → 504, draining/closed → 503.  ``stop()`` is
+drain-aware: admission closes first, every admitted response is
+delivered, then the HTTP listener (if any) shuts down.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Optional
+
+import numpy as onp
+
+from ..base import MXNetError
+from .batcher import DynamicBatcher
+from .engine import (BadRequestError, InferenceEngine, QueueFullError,
+                     RequestTimeoutError, ServingClosedError)
+
+__all__ = ["ServingServer"]
+
+
+class ServingServer:
+    """Serve a Block (or a prebuilt :class:`InferenceEngine`) behind a
+    :class:`DynamicBatcher`.  ``engine_args`` / ``batcher_args`` pass
+    through to the respective constructors."""
+
+    def __init__(self, block_or_engine, engine_args: Optional[dict] = None,
+                 batcher_args: Optional[dict] = None,
+                 start: bool = True):
+        if isinstance(block_or_engine, InferenceEngine):
+            self.engine = block_or_engine
+        else:
+            self.engine = InferenceEngine(block_or_engine,
+                                          **(engine_args or {}))
+        self.batcher = DynamicBatcher(self.engine, start=start,
+                                      **(batcher_args or {}))
+        self._httpd = None
+        self._http_thread = None
+
+    # -- in-process API ------------------------------------------------------
+
+    def predict(self, x, timeout_ms: Optional[float] = None):
+        """Submit one example and block for its result (host numpy).
+        ``timeout_ms`` bounds queue wait AND response wait."""
+        fut = self.batcher.submit(x, timeout_ms=timeout_ms)
+        # the dispatch itself runs after the deadline check, so give the
+        # future a grace window beyond the request deadline
+        wait = timeout_ms / 1e3 + 30.0 if timeout_ms is not None else None
+        return fut.result(wait)
+
+    def warmup(self, specs):
+        return self.engine.warmup(specs)
+
+    def healthz(self) -> dict:
+        return {
+            "status": "draining" if self.batcher.closed else "serving",
+            "queue_depth": self.batcher.pending(),
+            "buckets": self.engine.buckets(),
+            "max_batch_size": self.batcher.max_batch_size,
+            "max_delay_ms": self.batcher.max_delay_ms,
+            "queue_depth_limit": self.batcher.queue_depth,
+        }
+
+    def stop(self, drain: bool = True):
+        """Drain-aware shutdown: close admission (delivering admitted
+        responses when ``drain``), then stop the HTTP listener."""
+        self.batcher.close(drain=drain)
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            if self._http_thread is not None:
+                self._http_thread.join(10.0)
+            self._httpd = self._http_thread = None
+
+    # -- HTTP shim -----------------------------------------------------------
+
+    def start_http(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the JSON endpoint on a daemon thread; returns
+        ``(host, port)`` with the OS-assigned port when ``port=0``."""
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):   # quiet by default
+                pass
+
+            def _reply(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    self._reply(200, server.healthz())
+                else:
+                    self._reply(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                if self.path != "/predict":
+                    self._reply(404, {"error": f"no route {self.path}"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    dtype = req.get("dtype") or server.engine.dtype \
+                        or "float32"
+                    x = onp.asarray(req["data"], dtype=dtype)
+                except (KeyError, TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request body: {e}"})
+                    return
+                try:
+                    out = server.predict(x, timeout_ms=req.get("timeout_ms"))
+                except BadRequestError as e:
+                    self._reply(400, {"error": str(e)})
+                except QueueFullError as e:
+                    self._reply(429, {"error": str(e)})
+                except RequestTimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+                except ServingClosedError as e:
+                    self._reply(503, {"error": str(e)})
+                except MXNetError as e:
+                    self._reply(500, {"error": str(e)})
+                else:
+                    if isinstance(out, (list, tuple)):
+                        self._reply(200, {"outputs":
+                                          [onp.asarray(o).tolist()
+                                           for o in out]})
+                    else:
+                        self._reply(200, {"output":
+                                          onp.asarray(out).tolist()})
+
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self._http_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="mxnet-serving-http",
+            daemon=True)
+        self._http_thread.start()
+        return self._httpd.server_address
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop(drain=True)
+        return False

@@ -47,8 +47,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "metrics", "snapshot", "reset", "add_sink", "remove_sink",
            "clear_sinks", "sinks", "enabled", "begin_step", "end_step",
            "record_compile", "record_comm_bytes", "record_op_time",
-           "step_count", "last_record", "JSONLSink", "LogSink",
-           "TensorBoardSink", "device_memory_record"]
+           "record_serving_batch", "step_count", "last_record",
+           "JSONLSink", "LogSink", "TensorBoardSink",
+           "device_memory_record"]
 
 _LOCK = threading.Lock()
 
@@ -229,6 +230,19 @@ _C_CS_HITS = counter("cachedstep.hits")
 _C_CS_COMPILES = counter("cachedstep.compiles")
 _C_CS_FALLBACKS = counter("cachedstep.fallbacks")
 _C_CS_BREAKS = counter("cachedstep.graph_breaks")
+# serving subsystem health (mxnet_tpu/serving/ writes these; created
+# eagerly so profiler.counters() and tools/telemetry_report.py always
+# see the keys even before the first request)
+_C_SRV_REQS = counter("serving.requests")
+_C_SRV_BATCHES = counter("serving.batches")
+_C_SRV_EAGER = counter("serving.eager_batches")
+_C_SRV_REJ_FULL = counter("serving.rejected.queue_full")
+_C_SRV_REJ_SHAPE = counter("serving.rejected.shape")
+_C_SRV_TIMEOUTS = counter("serving.timeouts")
+_G_SRV_QUEUE = gauge("serving.queue_depth")
+_H_SRV_BATCH = histogram("serving.batch_size")
+_H_SRV_WASTE = histogram("serving.padding_waste")
+_H_SRV_REQ_MS = histogram("serving.request_ms")
 
 
 def record_compile(seconds: float, kind: str) -> None:
@@ -255,6 +269,23 @@ def record_op_time(name: str, seconds: float) -> None:
     """Per-op host-dispatch sample (the profiler aggregate table lives
     in the registry as ``op.<name>`` histograms)."""
     histogram("op." + name).observe(seconds)
+
+
+def record_serving_batch(n_requests: int, padded_size: int,
+                         latencies_ms, eager: bool = False) -> None:
+    """Account one coalesced serving dispatch: ``n_requests`` real rows
+    padded to ``padded_size``, with per-request submit→response wall
+    latencies.  The single accounting point the batcher calls, so the
+    counters, histograms, and JSONL serving records can't drift."""
+    _C_SRV_REQS.inc(int(n_requests))
+    _C_SRV_BATCHES.inc()
+    if eager:
+        _C_SRV_EAGER.inc()
+    _H_SRV_BATCH.observe(float(n_requests))
+    if padded_size:
+        _H_SRV_WASTE.observe((padded_size - n_requests) / padded_size)
+    for ms in latencies_ms:
+        _H_SRV_REQ_MS.observe(ms)
 
 
 # -- sinks -------------------------------------------------------------------

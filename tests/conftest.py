@@ -36,6 +36,13 @@ import numpy as _onp
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: second-tier tests excluded from the tier-1 run "
+        "(ROADMAP.md runs -m 'not slow')")
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
     outcome = yield

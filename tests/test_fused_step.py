@@ -226,7 +226,8 @@ def test_max_jit_sigs_env(monkeypatch):
 def test_profiler_counters_snapshot():
     c = profiler.counters()
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
-                      "optimizer", "compile", "comm", "dispatch"}
+                      "optimizer", "compile", "comm", "dispatch",
+                      "serving"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
     assert set(c["cached_step"]) == {"captures", "compiles", "hits",
@@ -235,6 +236,8 @@ def test_profiler_counters_snapshot():
     assert c["dispatch"]["count"] >= 0
     assert set(c["compile"]) == {"count", "ms"}
     assert set(c["comm"]) == {"bytes"}
+    assert set(c["serving"]) == {"requests", "batches", "eager_batches",
+                                 "compiles", "rejects", "timeouts"}
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100
     assert profiler.counters()["fused_step"]["steps"] != \

@@ -1,0 +1,477 @@
+"""Serving subsystem (mxnet_tpu/serving/): dynamic batching over
+shape-bucketed AOT-compiled executables.
+
+Tier-1 acceptance lives here, all through the in-process API (no
+sockets):
+
+- batched results are BITWISE identical to per-request eager forwards;
+- a warmed bucket serves at steady state with 0 new compiles and
+  exactly 1 ``dispatch.count`` tick per coalesced batch;
+- the robustness matrix: pre-admission shape rejection, bounded-queue
+  load shedding, per-request deadlines, graceful drain;
+- the shared ``MXNET_JIT_MAX_SIGS`` budget/latch, on both the engine's
+  buckets and ``HybridBlock._call_cached`` (regression: over budget the
+  fresh signature runs eager and nothing is evicted).
+"""
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, profiler, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.block import Block, SymbolBlock
+from mxnet_tpu.serving import (BadRequestError, DynamicBatcher,
+                               InferenceEngine, QueueFullError,
+                               RequestTimeoutError, ServingClosedError,
+                               ServingServer)
+
+UNITS = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_sinks():
+    telemetry.clear_sinks()
+    yield
+    telemetry.clear_sinks()
+    telemetry.enabled()     # re-sync env cache after monkeypatch undo
+
+
+def _make_net(seed=7):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(32, in_units=UNITS, activation="relu"))
+    net.add(nn.Dense(4, in_units=32))
+    net.initialize()
+    return net
+
+
+def _examples(n, seed=0):
+    rng = onp.random.RandomState(seed)
+    return [rng.randn(UNITS).astype("float32") for _ in range(n)]
+
+
+def _eager_rows(net, examples):
+    """Per-request eager reference: one batch-1 forward each."""
+    return [net(nd.array(x[None])).asnumpy()[0] for x in examples]
+
+
+def _engine(net, **kw):
+    kw.setdefault("example_shape", (UNITS,))
+    kw.setdefault("dtype", "float32")
+    return InferenceEngine(net, **kw)
+
+
+# -- batching correctness ---------------------------------------------------
+
+def test_batched_equals_eager_bitwise():
+    """N coalesced requests return rows bitwise identical to N separate
+    batch-1 eager forwards (the padded rows never leak)."""
+    net = _make_net()
+    xs = _examples(5)
+    ref = _eager_rows(net, xs)
+    batcher = DynamicBatcher(_engine(net), start=False)
+    futs = [batcher.submit(x) for x in xs]
+    batcher.flush()
+    for f, r in zip(futs, ref):
+        got = f.result(0)
+        assert got.dtype == r.dtype and got.shape == r.shape
+        assert onp.array_equal(got, r)      # bitwise
+
+
+def test_bucket_reuse_zero_compiles_one_dispatch_per_batch():
+    """The acceptance contract: after warmup, a steady stream of batches
+    into the same bucket pays 0 new compiles and exactly ONE XLA
+    dispatch per coalesced batch (asserted via the unified telemetry
+    counters the whole framework shares)."""
+    net = _make_net()
+    eng = _engine(net)
+    batcher = DynamicBatcher(eng, start=False)
+    assert eng.warmup([4]) == [f"4x{UNITS}:float32"]
+    comp = telemetry.counter("compile.count")
+    disp = telemetry.counter("dispatch.count")
+    bucket_disp = telemetry.counter(
+        f"serving.bucket.4x{UNITS}:float32.dispatches")
+    c0, b0 = comp.value, bucket_disp.value
+    for round_i in range(3):
+        futs = [batcher.submit(x) for x in _examples(4, seed=round_i)]
+        d0 = disp.value
+        batcher.flush()
+        assert disp.value - d0 == 1     # ONE dispatch for the batch
+        assert all(f.done() for f in futs)
+    assert comp.value - c0 == 0         # steady state: no new compiles
+    assert bucket_disp.value - b0 == 3
+    assert eng.buckets() == [f"4x{UNITS}:float32"]
+
+
+def test_warmup_padding_and_bucket_routing():
+    """warmup() pre-compiles buckets; a batch of 3 pads into the
+    4-bucket with zero new compiles, and per-example results stay
+    bitwise correct under padding."""
+    net = _make_net()
+    eng = _engine(net)
+    tags = eng.warmup([2, 4])
+    assert tags == [f"2x{UNITS}:float32", f"4x{UNITS}:float32"]
+    assert eng.buckets() == tags
+    xs = _examples(3, seed=9)
+    c0 = telemetry.counter("compile.count").value
+    results, meta = eng.infer_batch(xs)
+    assert telemetry.counter("compile.count").value - c0 == 0
+    assert meta == {"bucket": f"4x{UNITS}:float32", "padded": 4,
+                    "compiled": True}
+    for got, r in zip(results, _eager_rows(net, xs)):
+        assert onp.array_equal(got, r)
+
+
+def test_threaded_server_concurrent_predicts():
+    """Concurrent predict() calls through the threaded batcher each get
+    their own bitwise-correct row back."""
+    net = _make_net()
+    xs = _examples(8, seed=3)
+    ref = _eager_rows(net, xs)
+    with ServingServer(net, engine_args={"example_shape": (UNITS,),
+                                         "dtype": "float32"},
+                       batcher_args={"max_batch_size": 8,
+                                     "max_delay_ms": 5.0}) as srv:
+        srv.warmup([1, 2, 4, 8])
+        got = [None] * len(xs)
+
+        def client(i):
+            got[i] = srv.predict(xs[i])
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(len(xs))]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+    for g, r in zip(got, ref):
+        assert g is not None and onp.array_equal(g, r)
+
+
+# -- admission / robustness matrix ------------------------------------------
+
+def test_malformed_requests_rejected_at_admission():
+    """Shape/rank/dtype mismatches raise BadRequestError BEFORE
+    queueing (and tick serving.rejected.shape); the engine keeps serving
+    well-formed traffic afterwards."""
+    net = _make_net()
+    batcher = DynamicBatcher(_engine(net), start=False)
+    rej = telemetry.counter("serving.rejected.shape")
+    r0 = rej.value
+    with pytest.raises(BadRequestError):          # wrong trailing dim
+        batcher.submit(onp.zeros(UNITS + 1, "float32"))
+    with pytest.raises(BadRequestError):          # wrong rank
+        batcher.submit(onp.zeros((UNITS, 2), "float32"))
+    with pytest.raises(BadRequestError):          # lossy dtype
+        batcher.submit(onp.random.RandomState(0).randn(UNITS) + 1e-12)
+    assert rej.value - r0 == 3
+    assert batcher.pending() == 0                 # nothing was admitted
+    # losslessly castable ints ARE admitted (wire formats send ints)
+    ok = batcher.submit(onp.arange(UNITS))
+    xs = _examples(1, seed=5)
+    fut = batcher.submit(xs[0])
+    batcher.flush()
+    assert onp.array_equal(fut.result(0), _eager_rows(net, xs)[0])
+    assert ok.done()
+
+
+def test_queue_full_sheds_load():
+    net = _make_net()
+    batcher = DynamicBatcher(_engine(net), queue_depth=2, start=False)
+    xs = _examples(3, seed=1)
+    f1, f2 = batcher.submit(xs[0]), batcher.submit(xs[1])
+    c0 = telemetry.counter("serving.rejected.queue_full").value
+    with pytest.raises(QueueFullError):
+        batcher.submit(xs[2])
+    assert telemetry.counter("serving.rejected.queue_full").value - c0 == 1
+    batcher.flush()                 # the two admitted requests survive
+    ref = _eager_rows(net, xs[:2])
+    assert onp.array_equal(f1.result(0), ref[0])
+    assert onp.array_equal(f2.result(0), ref[1])
+
+
+def test_request_timeout_expires_in_queue():
+    """A request whose deadline passes while queued gets
+    RequestTimeoutError; sharing a batch window with it doesn't hurt
+    its neighbours."""
+    net = _make_net()
+    batcher = DynamicBatcher(_engine(net), start=False)
+    xs = _examples(2, seed=2)
+    f_late = batcher.submit(xs[0], timeout_ms=1.0)
+    f_ok = batcher.submit(xs[1])                  # no deadline
+    t0 = telemetry.counter("serving.timeouts").value
+    time.sleep(0.02)
+    batcher.flush()
+    with pytest.raises(RequestTimeoutError):
+        f_late.result(0)
+    assert telemetry.counter("serving.timeouts").value - t0 == 1
+    assert onp.array_equal(f_ok.result(0), _eager_rows(net, xs[1:])[0])
+
+
+def test_future_result_wait_timeout():
+    net = _make_net()
+    batcher = DynamicBatcher(_engine(net), start=False)
+    fut = batcher.submit(_examples(1)[0])
+    with pytest.raises(RequestTimeoutError):
+        fut.result(0.01)            # nothing dispatches without flush()
+
+
+def test_graceful_drain_and_closed_rejection():
+    net = _make_net()
+    xs = _examples(3, seed=4)
+    ref = _eager_rows(net, xs)
+    batcher = DynamicBatcher(_engine(net), start=False)
+    futs = [batcher.submit(x) for x in xs]
+    batcher.close(drain=True)       # delivers every admitted response
+    for f, r in zip(futs, ref):
+        assert onp.array_equal(f.result(0), r)
+    with pytest.raises(ServingClosedError):
+        batcher.submit(xs[0])
+    # drain=False fails pending futures instead of running them
+    b2 = DynamicBatcher(_engine(net), start=False)
+    f2 = b2.submit(xs[0])
+    b2.close(drain=False)
+    with pytest.raises(ServingClosedError):
+        f2.result(0)
+
+
+# -- capture fallbacks ------------------------------------------------------
+
+def test_forward_hooks_decline_capture():
+    """A block carrying forward hooks is never baked into a bucket
+    executable — the dispatch runs eager so hooks observe every batch —
+    and the numerics don't change."""
+    net = _make_net()
+    xs = _examples(2, seed=6)
+    ref = _eager_rows(net, xs)
+    fired = []
+    net.register_forward_hook(lambda blk, inp, out: fired.append(1))
+    assert net.has_hooks()
+    eng = _engine(net)
+    c0 = telemetry.counter("compile.serving.count").value
+    n_fired = len(fired)
+    results, meta = eng.infer_batch(xs)
+    assert meta["compiled"] is False
+    assert telemetry.counter("compile.serving.count").value - c0 == 0
+    assert len(fired) > n_fired                   # hook saw the batch
+    for got, r in zip(results, ref):
+        assert onp.array_equal(got, r)
+
+
+def test_mxnet_serving_disabled_env(monkeypatch):
+    """MXNET_SERVING=0 forces the eager path process-wide (no compiles,
+    identical numerics); re-enabling picks the compiled path back up."""
+    net = _make_net()
+    eng = _engine(net)
+    xs = _examples(2, seed=8)
+    ref = _eager_rows(net, xs)
+    monkeypatch.setenv("MXNET_SERVING", "0")
+    c0 = telemetry.counter("compile.serving.count").value
+    results, meta = eng.infer_batch(xs)
+    assert meta["compiled"] is False
+    assert telemetry.counter("compile.serving.count").value - c0 == 0
+    assert eng.buckets() == []
+    for got, r in zip(results, ref):
+        assert onp.array_equal(got, r)
+    monkeypatch.delenv("MXNET_SERVING")
+    results, meta = eng.infer_batch(xs)
+    assert meta["compiled"] is True
+    for got, r in zip(results, ref):
+        assert onp.array_equal(got, r)
+
+
+def test_engine_bucket_budget_latches_eager(monkeypatch):
+    """Over MXNET_JIT_MAX_SIGS, fresh buckets run eager while every
+    compiled bucket keeps its executable (no eviction)."""
+    net = _make_net()
+    eng = _engine(net, max_sigs=2)
+    eng.warmup([1, 2])
+    assert len(eng.buckets()) == 2
+    c0 = telemetry.counter("compile.serving.count").value
+    results, meta = eng.infer_batch(_examples(3, seed=10))   # bucket 4
+    assert meta["compiled"] is False      # over budget: eager
+    assert telemetry.counter("compile.serving.count").value - c0 == 0
+    assert eng.stats()["latched"] and eng.stats()["budget_declines"] >= 1
+    assert len(eng.buckets()) == 2        # nothing evicted
+    _, meta = eng.infer_batch(_examples(2, seed=11))         # bucket 2
+    assert meta["compiled"] is True       # warm bucket still compiled
+
+
+# -- shared MXNET_JIT_MAX_SIGS budget on HybridBlock._call_cached ------------
+
+def test_call_cached_shares_jit_sig_budget(monkeypatch):
+    """Regression for the unbounded per-block signature cache: over
+    MXNET_JIT_MAX_SIGS the fresh signature runs eager (numerics intact),
+    the cache stops growing, and already-compiled signatures keep
+    replaying with no new compiles."""
+    monkeypatch.setenv("MXNET_JIT_MAX_SIGS", "2")
+    mx.random.seed(13)
+    net = nn.Dense(4, in_units=8)
+    net.initialize()
+    net.hybridize()
+    w = net.weight.data().asnumpy()
+    b = net.bias.data().asnumpy()
+    rng = onp.random.RandomState(13)
+    outs = {}
+    for n in (2, 4, 8):
+        x = rng.randn(n, 8).astype("float32")
+        outs[n] = (x, net(nd.array(x)).asnumpy())
+    assert len(net._cached_graphs) == 2       # third signature declined
+    assert net._sig_budget is not None and net._sig_budget.latched
+    assert net._sig_budget.declines >= 1
+    for n, (x, y) in outs.items():            # eager fallback == math
+        onp.testing.assert_allclose(y, x @ w.T + b, rtol=1e-5, atol=1e-5)
+    # compiled signatures still replay: no new cached_op compiles, and
+    # the over-budget shape keeps running eager without evicting them
+    c0 = telemetry.counter("compile.cached_op.count").value
+    for n in (2, 4, 8):
+        x, y = outs[n]
+        assert onp.array_equal(net(nd.array(x)).asnumpy(), y)
+    assert telemetry.counter("compile.cached_op.count").value - c0 == 0
+    assert len(net._cached_graphs) == 2
+    # re-hybridizing re-reads the env and resets the latch
+    net.hybridize()
+    assert net._sig_budget is None and not net._cached_graphs
+
+
+# -- exported artifacts -----------------------------------------------------
+
+def test_exported_block_serving(tmp_path):
+    """export → SymbolBlock.imports → engine: buckets come from the
+    exported signatures, dispatches are 1 per batch, rows are bitwise
+    identical to the exporting net."""
+    mx.random.seed(17)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, in_units=UNITS, activation="relu"))
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    net.hybridize()
+    for bs in (2, 4):                # compile the exportable signatures
+        net(nd.array(onp.zeros((bs, UNITS), "float32")))
+    sym_file, param_file = net.export(str(tmp_path / "m"))
+    exported = SymbolBlock.imports(sym_file, ["data"], param_file)
+    eng = InferenceEngine(exported)
+    assert eng.example_shape == (UNITS,) and eng.dtype == "float32"
+    assert eng.buckets() == [f"2x{UNITS}:float32", f"4x{UNITS}:float32"]
+    xs = _examples(3, seed=12)
+    ref = _eager_rows(net, xs)
+    disp = telemetry.counter("dispatch.count")
+    batcher = DynamicBatcher(eng, start=False)
+    futs = [batcher.submit(x) for x in xs]
+    d0 = disp.value
+    batcher.flush()
+    assert disp.value - d0 == 1
+    for f, r in zip(futs, ref):
+        assert onp.array_equal(f.result(0), r)
+    # exported artifacts only serve their exported batch sizes
+    with pytest.raises(BadRequestError):
+        eng.infer_batch(_examples(5, seed=14))
+
+
+# -- server surface ---------------------------------------------------------
+
+def test_server_inprocess_predict_and_healthz():
+    net = _make_net()
+    xs = _examples(2, seed=15)
+    ref = _eager_rows(net, xs)
+    srv = ServingServer(net,
+                        engine_args={"example_shape": (UNITS,),
+                                     "dtype": "float32"},
+                        batcher_args={"max_delay_ms": 0.5,
+                                      "max_batch_size": 4})
+    try:
+        srv.warmup([1, 2])
+        for x, r in zip(xs, ref):
+            assert onp.array_equal(srv.predict(x), r)
+        h = srv.healthz()
+        assert h["status"] == "serving" and h["max_batch_size"] == 4
+        assert f"1x{UNITS}:float32" in h["buckets"]
+    finally:
+        srv.stop(drain=True)
+    assert srv.healthz()["status"] == "draining"
+    with pytest.raises(ServingClosedError):
+        srv.predict(xs[0])
+
+
+@pytest.mark.slow
+def test_http_endpoint_roundtrip():
+    """Second-tier (sockets): the stdlib HTTP shim maps JSON bodies and
+    serving errors onto status codes."""
+    import urllib.request
+    import urllib.error
+    net = _make_net()
+    x = _examples(1, seed=16)[0]
+    ref = _eager_rows(net, [x])[0]
+    with ServingServer(net, engine_args={"example_shape": (UNITS,),
+                                         "dtype": "float32"}) as srv:
+        host, port = srv.start_http()
+        url = f"http://{host}:{port}"
+        with urllib.request.urlopen(f"{url}/healthz", timeout=10) as resp:
+            assert json.loads(resp.read())["status"] == "serving"
+        body = json.dumps({"data": x.tolist()}).encode()
+        req = urllib.request.Request(
+            f"{url}/predict", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = onp.asarray(json.loads(resp.read())["output"],
+                              dtype="float32")
+        onp.testing.assert_allclose(out, ref, rtol=1e-6)
+        bad = urllib.request.Request(
+            f"{url}/predict",
+            data=json.dumps({"data": [[1.0]]}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=10)
+        assert ei.value.code == 400
+
+
+# -- telemetry / report reconciliation --------------------------------------
+
+def test_telemetry_report_serving_section(tmp_path, monkeypatch):
+    """Every coalesced dispatch emits a step record; the report tool's
+    serving section reconciles exactly with what was served (occupancy,
+    padding waste, reject/timeout deltas)."""
+    path = str(tmp_path / "serve.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    net = _make_net()
+    batcher = DynamicBatcher(_engine(net), queue_depth=3, start=False)
+    futs = [batcher.submit(x) for x in _examples(3, seed=18)]
+    with pytest.raises(QueueFullError):
+        batcher.submit(_examples(1, seed=19)[0])
+    batcher.flush()                     # batch of 3 → bucket 4
+    futs += [batcher.submit(x) for x in _examples(2, seed=20)]
+    batcher.flush()                     # batch of 2 → bucket 2
+    assert all(f.done() for f in futs)
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()                 # detach + close the sink
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools" / "telemetry_report.py")
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    records = report.load(path)
+    srv_records = [r for r in records if "serving" in r]
+    assert len(srv_records) == 2
+    s = report.summarize(records)["serving"]
+    assert s["batches"] == 2 and s["requests"] == 5
+    assert s["mean_batch_occupancy"] == pytest.approx(2.5)
+    # 3-of-4 + 2-of-2 real rows → 5/6 occupancy → 16.7% waste
+    assert s["padding_waste_pct"] == pytest.approx(100 * (1 - 5 / 6),
+                                                   rel=1e-3)
+    assert s["rejects"] == 1 and s["timeouts"] == 0
+    assert s["eager_batches"] == 0
+    assert s["request_ms"]["p95"] >= s["request_ms"]["p50"] >= 0.0
+    # rendered table carries the section
+    assert "Serving (dynamic batcher)" in report.render(
+        report.summarize(records))
+    # profiler.counters() reads the same registry the records came from
+    c = profiler.counters()["serving"]
+    assert c["requests"] >= 5 and c["batches"] >= 2

@@ -5,6 +5,10 @@ Reads the per-step records mxnet_tpu/telemetry.py emits and prints one
 table: step-time percentiles (host + device where a trace was live),
 compile stalls (steps that paid jit compilation, and how much), and
 collective bytes per step — the three first-order XLA health signals.
+Runs that served inference (records with a ``serving`` payload, emitted
+by serving/batcher.py per coalesced dispatch) get a second section:
+request p50/p95 latency, mean batch occupancy, padding-waste %, and
+reject/timeout totals — reconciled from the SAME JSONL stream.
 
 Usage:
     python tools/telemetry_report.py run.jsonl
@@ -62,6 +66,28 @@ def summarize(records):
     for r in records:
         by_source[r.get("source", "?")] = \
             by_source.get(r.get("source", "?"), 0) + 1
+    srv = [r["serving"] for r in records
+           if isinstance(r.get("serving"), dict) and "error" not in
+           r["serving"]]
+    serving = None
+    if srv:
+        n_req = sum(b.get("batch_size", 0) for b in srv)
+        padded = sum(b.get("padded_batch", 0) for b in srv)
+        lat = sorted(ms for b in srv for ms in b.get("request_ms", []))
+        serving = {
+            "batches": len(srv),
+            "requests": n_req,
+            "mean_batch_occupancy": n_req / len(srv),
+            "padding_waste_pct": 100.0 * (1 - n_req / padded)
+            if padded else 0.0,
+            "request_ms": {"p50": percentile(lat, 50),
+                           "p95": percentile(lat, 95),
+                           "max": lat[-1] if lat else 0.0},
+            "rejects": sum(b.get("rejects", 0) for b in srv),
+            "timeouts": sum(b.get("timeouts", 0) for b in srv),
+            "eager_batches": sum(1 for b in srv if not b.get("compiled",
+                                                             True)),
+        }
     return {
         "steps": len(records),
         "by_source": by_source,
@@ -76,6 +102,7 @@ def summarize(records):
         "collective_bytes": total_bytes,
         "bytes_per_step": total_bytes / len(records) if records else 0,
         "peak_device_bytes": peak_mem,
+        "serving": serving,
     }
 
 
@@ -103,6 +130,23 @@ def render(s):
         f"{'collective bytes / step':<28}{s['bytes_per_step']:>24.1f}",
         f"{'peak device bytes':<28}{s['peak_device_bytes']:>24}",
     ]
+    srv = s.get("serving")
+    if srv:
+        lines += [
+            "",
+            "Serving (dynamic batcher)",
+            "-" * 52,
+            f"{'requests served':<28}{srv['requests']:>24}",
+            f"{'coalesced batches':<28}{srv['batches']:>24}",
+            f"{'mean batch occupancy':<28}"
+            f"{srv['mean_batch_occupancy']:>24.2f}",
+            f"{'padding waste %':<28}{srv['padding_waste_pct']:>24.1f}",
+            f"{'request ms p50':<28}{srv['request_ms']['p50']:>24.3f}",
+            f"{'request ms p95':<28}{srv['request_ms']['p95']:>24.3f}",
+            f"{'rejects (shed+shape)':<28}{srv['rejects']:>24}",
+            f"{'timeouts':<28}{srv['timeouts']:>24}",
+            f"{'eager-fallback batches':<28}{srv['eager_batches']:>24}",
+        ]
     return "\n".join(lines)
 
 
