@@ -86,6 +86,16 @@ serving_smoke() {     # dynamic batching: tests + throughput-gate bench
     JAX_PLATFORMS=cpu python benchmark/serving_bench.py --smoke
 }
 
+data_pipeline_smoke() { # device-feed prefetch: tests + overlap-gate bench
+    # tier-1 covers bitwise wrapped-vs-bare parity, interrupted-consumer
+    # cleanup (threads/shm), and the SPMD no-step-device_put contract
+    JAX_PLATFORMS=cpu python -m pytest tests/test_data_pipeline.py -q
+    # then the bench must show >=1.3x steady-state step time vs the
+    # serial input loop with ~0 consumer input wait (exits non-zero
+    # otherwise)
+    JAX_PLATFORMS=cpu python benchmark/data_pipeline_bench.py --smoke
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

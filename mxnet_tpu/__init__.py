@@ -53,6 +53,7 @@ from . import parallel  # noqa: E402
 from . import profiler  # noqa: E402
 from . import telemetry  # noqa: E402
 from . import serving  # noqa: E402
+from . import data  # noqa: E402
 from . import monitor  # noqa: E402
 from . import amp  # noqa: E402
 from . import test_utils  # noqa: E402

@@ -1,0 +1,13 @@
+"""mxnet_tpu.data — device-feed input pipeline.
+
+The host→device half of the input story: ``gluon.data`` produces host
+batches (workers, batchify, shared memory); this package moves them
+onto the accelerator *ahead of the step that consumes them*, so the
+H2D transfer overlaps the previous step's compute instead of sitting
+on the critical path (the ``PrefetcherIter`` / threaded-engine idea of
+the reference, re-expressed as sharding-aware non-blocking
+``jax.device_put`` — see docs/ARCHITECTURE.md "Input pipeline").
+"""
+from .device_pipeline import DevicePrefetcher, prefetch_depth, wrap
+
+__all__ = ["DevicePrefetcher", "prefetch_depth", "wrap"]

@@ -1,0 +1,334 @@
+"""Async device-feed pipeline: sharding-aware batch prefetch.
+
+``DevicePrefetcher`` wraps any batch iterable — ``gluon.data.DataLoader``,
+an ``io.DataIter``, a plain generator — and keeps ``MXNET_DEVICE_PREFETCH``
+(default 2) batches *in flight on the device*: a background thread pulls
+host batches and dispatches each leaf as a non-blocking
+``jax.device_put`` against the consumer's declared
+``jax.sharding.Sharding``, so SPMD batches land pre-sharded across the
+``dp``/``sp`` mesh axes and the compiled step never reshards them.  The
+consumer's ``next()`` then hands back an already-committed device batch:
+H2D transfer (and the host-side batchify behind it) overlaps the
+previous step's compute instead of serializing with it.
+
+This is the reference's ``PrefetcherIter`` + threaded-engine dependency
+tracking (src/io/iter_prefetcher.h — fetch ops scheduled on the engine
+worker pool) re-expressed in JAX terms, and the standard TPU
+input-pipeline shape (flax ``prefetch_to_device``): the bounded queue is
+the dependency edge, the async ``device_put`` is the engine op, and the
+device ring of ``depth`` staged batches is what the reference's
+double-buffered prefetcher kept in its recycle queue.
+
+Dataflow::
+
+    workers ─▶ host queue ─▶ [H2D thread: device_put(sharding)] ─▶
+        device ring (depth batches) ─▶ step funnel
+
+Ordering is exactly the source's (single producer thread, FIFO queue),
+so a wrapped loader is bitwise-deterministic against the bare loader.
+``MXNET_DEVICE_PREFETCH=0`` (or ``depth=0``) disables the pipeline
+entirely — ``wrap`` returns the source unchanged, reproducing the
+unwrapped numerics bitwise.
+
+Telemetry: every transferred batch accounts its payload into
+``input.h2d_bytes``; every consumer ``next()`` that blocks records the
+blocked time into ``input.wait_ms``.  Both surface per step as the
+``h2d_bytes`` / ``input_wait_ms`` fields of the telemetry step record,
+which is how ``tools/telemetry_report.py`` classifies a run as
+input-bound vs compute-bound.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as onp
+import jax
+
+from .. import telemetry
+from ..base import MXNetError, getenv
+
+__all__ = ["DevicePrefetcher", "prefetch_depth", "wrap"]
+
+_DONE = "__done__"
+_ERROR = "__error__"
+
+
+def prefetch_depth(default: int = 2) -> int:
+    """Batches kept in flight on-device (``MXNET_DEVICE_PREFETCH``;
+    0 disables the pipeline — the bitwise-identical eager path)."""
+    v = getenv("MXNET_DEVICE_PREFETCH")
+    if v is None or v == "":
+        return default
+    try:
+        return max(0, int(v))
+    except ValueError:
+        raise MXNetError(
+            f"invalid MXNET_DEVICE_PREFETCH={v!r}; expected an integer")
+
+
+def _placement_of(consumer):
+    """A per-leaf placement fn from a consumer's declared sharding.
+
+    Accepts an ``SPMDTrainer`` (its ``_batch_sharding`` per-rank
+    NamedSharding — batches land pre-sharded over dp/sp), a
+    ``gluon.Trainer`` (the device its parameters live on), an explicit
+    ``jax.sharding.Sharding`` / ``jax.Device``, a callable
+    ``leaf -> sharding``, or None (the default device)."""
+    if consumer is None:
+        dev = jax.devices()[0]
+        return lambda leaf: dev
+    if callable(consumer) and not hasattr(consumer, "_batch_sharding") \
+            and not isinstance(consumer, (jax.sharding.Sharding,)):
+        return consumer
+    if isinstance(consumer, jax.sharding.Sharding):
+        return lambda leaf: consumer
+    if isinstance(consumer, jax.Device):
+        return lambda leaf: consumer
+    if hasattr(consumer, "_batch_sharding"):
+        # parallel.SPMDTrainer: rank-dependent NamedSharding over the
+        # trainer's mesh — data batch axis on 'dp', seq axis on 'sp'
+        return lambda leaf: consumer._batch_sharding(leaf.ndim)
+    if hasattr(consumer, "_input_placement"):
+        # gluon.Trainer: single-device eager funnel — commit batches to
+        # the device the parameters live on
+        dev = consumer._input_placement()
+        return lambda leaf: dev
+    raise MXNetError(
+        f"cannot derive a batch sharding from {type(consumer).__name__}; "
+        "pass a jax.sharding.Sharding, a Device, a callable, or a "
+        "trainer (SPMDTrainer / gluon.Trainer)")
+
+
+def _place_tree(batch, place_fn):
+    """Recursively dispatch every array leaf of ``batch`` to the device
+    via a non-blocking ``jax.device_put`` under ``place_fn``'s sharding,
+    preserving the batch structure (tuples/lists/dicts/DataBatch).
+    Returns (placed batch, bytes transferred)."""
+    from ..ndarray import NDArray
+    nbytes = [0]
+
+    def place(x):
+        if isinstance(x, NDArray):
+            arr = x._data
+        elif isinstance(x, (jax.Array, onp.ndarray)):
+            arr = x
+        elif isinstance(x, tuple):
+            return tuple(place(e) for e in x)
+        elif isinstance(x, list):
+            return [place(e) for e in x]
+        elif isinstance(x, dict):
+            return {k: place(v) for k, v in x.items()}
+        else:
+            # non-array payload (DataBatch.pad ints, names, None)
+            return x
+        target = place_fn(arr)
+        if isinstance(arr, jax.Array) and getattr(arr, "_committed", False):
+            shd = getattr(arr, "sharding", None)
+            if shd == target or (isinstance(target, jax.Device)
+                                 and shd is not None
+                                 and set(arr.devices()) == {target}):
+                # already committed where the consumer wants it
+                return x if isinstance(x, NDArray) else NDArray(arr)
+        put = jax.device_put(arr, target)   # async dispatch, no block
+        nbytes[0] += int(getattr(arr, "nbytes", 0))
+        return NDArray(put)
+
+    # io.DataBatch rides as an object: rebuild with placed data/label
+    if type(batch).__name__ == "DataBatch" and hasattr(batch, "data") \
+            and hasattr(batch, "label"):
+        from ..io.io import DataBatch
+        placed = DataBatch(place(batch.data), place(batch.label),
+                           pad=batch.pad, index=batch.index,
+                           provide_data=batch.provide_data,
+                           provide_label=batch.provide_label)
+        return placed, nbytes[0]
+    return place(batch), nbytes[0]
+
+
+def _shutdown(stop, q, thread, src_it):
+    """Tear one epoch pipeline down: no live thread, no in-flight
+    device_put, and the source generator's own cleanup (the DataLoader
+    shm drain) has run.  Runs from close(), from the weakref finalizer
+    when an interrupted consumer drops the iterator, and at natural
+    exhaustion."""
+    stop.set()
+    # drain so a producer blocked on a full queue can observe stop
+    while True:
+        try:
+            q.get_nowait()
+        except _queue.Empty:
+            break
+    if thread is not None and thread.is_alive() \
+            and thread is not threading.current_thread():
+        thread.join(timeout=10)
+    # after the producer has exited, run the source's own teardown —
+    # for a DataLoader generator this is the finally-drain that unlinks
+    # disowned shm segments
+    close = getattr(src_it, "close", None)
+    if close is not None:
+        try:
+            close()
+        except Exception:
+            pass
+
+
+def _produce(src, q, stop, place_fn):
+    """Producer loop (module-level: the thread must hold no reference
+    to the pipeline object, so an abandoned pipeline can be collected
+    and its finalizer can stop this thread)."""
+    def put(item) -> bool:
+        # bounded put that stays responsive to shutdown: never blocks
+        # forever on a ring the consumer abandoned
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    try:
+        while not stop.is_set():
+            try:
+                batch = next(src)
+            except StopIteration:
+                put((_DONE, None))
+                return
+            placed, nbytes = _place_tree(batch, place_fn)
+            if nbytes:
+                telemetry.record_h2d_bytes(nbytes)
+            if not put((None, placed)):
+                return
+    except BaseException as e:   # surface at the consumer's next()
+        put((_ERROR, e))
+
+
+class _EpochPipeline:
+    """One epoch's producer thread + bounded device ring.  Created per
+    ``__iter__`` so a prefetcher can be re-iterated epoch after epoch."""
+
+    def __init__(self, src_it, place_fn, depth: int, name: str):
+        self._q: _queue.Queue = _queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=_produce, args=(src_it, self._q, self._stop, place_fn),
+            name=f"DevicePrefetch-{name}", daemon=True)
+        # interrupted consumer (break mid-epoch): the for-loop drops its
+        # reference and the finalizer stops the thread, drains the ring
+        # and closes the source — no explicit close() required
+        self._finalizer = weakref.finalize(
+            self, _shutdown, self._stop, self._q, self._thread, src_it)
+        self._thread.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._stop.is_set():
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()
+        telemetry.record_input_wait(time.perf_counter() - t0)
+        tag, payload = item
+        if tag is None:
+            return payload
+        self.close()
+        if tag == _ERROR:
+            raise payload
+        raise StopIteration
+
+    def close(self):
+        self._finalizer()
+
+
+class DevicePrefetcher:
+    """Wrap a batch iterable so batches arrive device-committed, with
+    ``depth`` batches staged on-device ahead of the consumer.
+
+    Parameters
+    ----------
+    source : iterable
+        Any batch source: ``gluon.data.DataLoader``, ``io.DataIter``,
+        generator, list.  Re-iterables re-iterate (one epoch per
+        ``__iter__``); one-shot iterators are consumed once.
+    sharding : optional
+        Where batches land: a ``jax.sharding.Sharding``, a
+        ``jax.Device``, a callable ``leaf -> sharding``, a trainer
+        (``SPMDTrainer`` / ``gluon.Trainer``), or None for the default
+        device.  See :func:`wrap` for the trainer-driven spelling.
+    depth : int, optional
+        Batches kept in flight on-device; default
+        ``MXNET_DEVICE_PREFETCH`` (2).  0 disables: iteration passes the
+        source through untouched (bitwise-identical eager path).
+    """
+
+    def __init__(self, source: Iterable, sharding: Any = None,
+                 depth: Optional[int] = None, name: Optional[str] = None):
+        self._source = source
+        self._place_fn = _placement_of(sharding)
+        self._depth = prefetch_depth() if depth is None else max(0, int(depth))
+        self._name = name or type(source).__name__
+        self._live: Optional[_EpochPipeline] = None
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    def __len__(self):
+        return len(self._source)
+
+    def __iter__(self):
+        if self._depth <= 0:
+            return iter(self._source)
+        self.close()   # a fresh epoch retires any abandoned pipeline
+        self._live = _EpochPipeline(iter(self._source), self._place_fn,
+                                    self._depth, self._name)
+        return self._live
+
+    # -- io.DataIter protocol parity ------------------------------------
+    def __next__(self):
+        if self._depth <= 0:
+            return next(iter(self._source))
+        if self._live is None:
+            self.__iter__()
+        return next(self._live)
+
+    def next(self):
+        return self.__next__()
+
+    def reset(self):
+        """DataIter parity: tear down the in-flight epoch and reset the
+        source so the next iteration starts fresh."""
+        self.close()
+        reset = getattr(self._source, "reset", None)
+        if reset is not None:
+            reset()
+
+    def close(self):
+        """Stop the producer thread and drop the staged device ring."""
+        if self._live is not None:
+            self._live.close()
+            self._live = None
+
+
+def wrap(source: Iterable, consumer: Any = None,
+         depth: Optional[int] = None):
+    """Wrap ``source`` in a :class:`DevicePrefetcher` targeting
+    ``consumer``'s declared batch sharding.
+
+    ``consumer`` may be a ``parallel.SPMDTrainer`` (batches land
+    pre-sharded over the trainer's dp/sp mesh axes, so the compiled step
+    performs no ``device_put``), a ``gluon.Trainer`` (batches commit to
+    the parameters' device), an explicit sharding/device/callable, or
+    None (default device).  With ``MXNET_DEVICE_PREFETCH=0`` (or
+    ``depth=0``) the source is returned **unchanged** — the untouched
+    eager path, bitwise identical.
+    """
+    d = prefetch_depth() if depth is None else max(0, int(depth))
+    if d <= 0:
+        return source
+    return DevicePrefetcher(source, sharding=consumer, depth=d)

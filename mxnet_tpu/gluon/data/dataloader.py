@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import multiprocessing
 import os
+import queue as _queue
 import threading
 import weakref
 from typing import Any, Callable, Optional
@@ -53,6 +54,10 @@ def default_mp_batchify_fn(data):
         arr = arr.astype(onp.float32)
     return arr
 
+
+# sentinels for the num_workers=0 background-prefetch hand-off
+_SYNC_DONE = object()
+_SYNC_ERR = object()
 
 _FORK_GUARD_DONE = False
 
@@ -172,6 +177,21 @@ def _shm_unpack(payload):
             shm.unlink()
 
 
+def _unlink_payload(result):
+    """Unlink the shm segment behind a worker payload the parent will
+    never unpack (early exit, mid-yield failure) — the workers disowned
+    it (_shm_pack), so the parent is its only owner."""
+    if (isinstance(result, tuple) and len(result) == 4
+            and result[0] == "__shm__" and result[1]):
+        try:
+            from multiprocessing import shared_memory
+            seg = shared_memory.SharedMemory(name=result[1])
+            seg.close()
+            seg.unlink()
+        except Exception:
+            pass
+
+
 def _worker_fn(dataset, batchify_fn, indices, use_shm=False):
     batch = _to_np(batchify_fn([dataset[i] for i in indices]))
     if use_shm:
@@ -180,12 +200,30 @@ def _worker_fn(dataset, batchify_fn, indices, use_shm=False):
 
 
 class DataLoader:
-    """Loads batches from a Dataset (parity: gluon.data.DataLoader)."""
+    """Loads batches from a Dataset (parity: gluon.data.DataLoader).
+
+    Beyond the reference surface:
+
+    - ``prefetch`` is honored for ``num_workers=0`` too: a bounded
+      background thread runs sampling+batchify ``prefetch`` batches
+      ahead of the consumer (the reference silently ignores it without
+      workers).  The default stays ``2 * num_workers`` — i.e. 0, the
+      fully synchronous path — unless ``prefetch`` is passed.
+    - ``prefetch_to_device`` hands the epoch iterator to the async
+      device-feed pipeline (``mxnet_tpu.data.DevicePrefetcher``):
+      batches arrive device-committed, H2D overlapping step compute.
+      Pass ``True`` (default device), a trainer (``SPMDTrainer`` /
+      ``gluon.Trainer`` — batches land under its declared sharding), a
+      ``jax.sharding.Sharding`` / ``jax.Device``, or a callable
+      ``leaf -> sharding``.  ``MXNET_DEVICE_PREFETCH=0`` disables it
+      (bitwise-identical host path).
+    """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120, use_shared_mem=None):
+                 thread_pool=False, timeout=120, use_shared_mem=None,
+                 prefetch_to_device=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         # shared-memory hand-off is the default for process workers
@@ -225,6 +263,7 @@ class DataLoader:
         self._thread_pool = thread_pool
         self._timeout = timeout
         self._pool = None
+        self._prefetch_to_device = prefetch_to_device
 
     def _get_pool(self):
         if self._pool is None:
@@ -239,11 +278,76 @@ class DataLoader:
         return self._pool
 
     def __iter__(self):
-        if self._num_workers == 0:
-            for indices in self._batch_sampler:
-                yield self._batchify_fn([self._dataset[i] for i in indices])
-            return
+        it = self._iter_impl()
+        ptd = self._prefetch_to_device
+        if ptd is None or ptd is False:
+            return it
+        from ...data import device_pipeline
+        # one epoch per wrap: the pipeline owns this epoch's generator
+        # (its shutdown close()s it, running the shm finally-drain)
+        return iter(device_pipeline.wrap(
+            it, None if ptd is True else ptd))
 
+    def _iter_impl(self):
+        if self._num_workers == 0:
+            if self._prefetch > 0:
+                return self._threaded_sync_iter()
+            return (self._batchify_fn([self._dataset[i] for i in indices])
+                    for indices in self._batch_sampler)
+        return self._worker_iter()
+
+    def _threaded_sync_iter(self):
+        """num_workers=0 with prefetch>0: sampling + batchify run in one
+        bounded background thread, ``prefetch`` batches ahead.  Same
+        order, same batches — just pipelined against the consumer."""
+        q: _queue.Queue = _queue.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+        dataset, batchify = self._dataset, self._batchify_fn
+        sampler = self._batch_sampler
+
+        def produce():
+            def put(item) -> bool:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+
+            try:
+                for indices in sampler:
+                    if stop.is_set():
+                        return
+                    if not put((None,
+                                batchify([dataset[i] for i in indices]))):
+                        return
+                put((_SYNC_DONE, None))
+            except BaseException as e:   # surfaced at the consumer
+                put((_SYNC_ERR, e))
+
+        t = threading.Thread(target=produce, name="DataLoaderPrefetch",
+                             daemon=True)
+        t.start()
+        try:
+            while True:
+                tag, payload = q.get()
+                if tag is _SYNC_DONE:
+                    return
+                if tag is _SYNC_ERR:
+                    raise payload
+                yield payload
+        finally:
+            stop.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except _queue.Empty:
+                    break
+            if t is not threading.current_thread():
+                t.join(timeout=10)
+
+    def _worker_iter(self):
         pool = self._get_pool()
         pending = []
         it = iter(self._batch_sampler)
@@ -264,12 +368,22 @@ class DataLoader:
         try:
             while pending:
                 result = pending.pop(0).get(self._timeout)
-                submit()
-                if (isinstance(result, tuple) and len(result) == 4
-                        and result[0] == "__shm__"):
-                    yield _shm_unpack(result)
-                else:
-                    yield _rewrap(result)
+                # the popped payload is outside pending, so the
+                # finally-drain below can no longer see it: from here
+                # until _shm_unpack takes ownership (it unlinks even
+                # when unpacking raises), any exception — submit()'s
+                # sampler/pool failure included — must unlink it here
+                try:
+                    submit()
+                    is_shm = (isinstance(result, tuple)
+                              and len(result) == 4
+                              and result[0] == "__shm__")
+                    payload, result = result, None
+                    yield (_shm_unpack(payload) if is_shm
+                           else _rewrap(payload))
+                finally:
+                    if result is not None:
+                        _unlink_payload(result)
         finally:
             # consumer stopped early (break/exception/GeneratorExit):
             # drain in-flight results and unlink their shm segments,
@@ -284,22 +398,10 @@ class DataLoader:
                 # ~0.5s x prefetch, not timeout x prefetch) while still
                 # unlinking segments before pool teardown can race us.
                 # Stragglers get a best-effort daemon-thread drain.
-                def _unlink(result):
-                    if (isinstance(result, tuple) and len(result) == 4
-                            and result[0] == "__shm__" and result[1]):
-                        try:
-                            from multiprocessing import shared_memory
-                            seg = shared_memory.SharedMemory(
-                                name=result[1])
-                            seg.close()
-                            seg.unlink()
-                        except Exception:
-                            pass
-
                 stragglers = []
                 for fut in pending:
                     try:
-                        _unlink(fut.get(0.5))
+                        _unlink_payload(fut.get(0.5))
                     except multiprocessing.TimeoutError:
                         stragglers.append(fut)
                     except Exception:
@@ -310,7 +412,7 @@ class DataLoader:
                     def _drain_stragglers():
                         for fut in stragglers:
                             try:
-                                _unlink(fut.get(timeout))
+                                _unlink_payload(fut.get(timeout))
                             except Exception:
                                 pass
 

@@ -171,6 +171,18 @@ class Trainer:
 
         ag.set_grad_ready_hook(_p3_hook)
 
+    def _input_placement(self):
+        """The device input batches should be committed to so the eager
+        funnel performs no further transfer — the device the parameters
+        live on (used by ``data.device_pipeline.wrap(loader, trainer)``:
+        prefetched batches land here ahead of the step, and NDArray
+        construction from a committed buffer is a no-op)."""
+        import jax
+        for p in self._params:
+            if p._data is not None:
+                return next(iter(p._data._data.devices()))
+        return jax.devices()[0]
+
     @property
     def learning_rate(self):
         return self._optimizer.learning_rate

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 from ..base import MXNetError, np_dtype, dtype_name, check_shape
 from ..context import Context, current_context
 from .. import autograd as ag
+from .. import telemetry as _telemetry
 from ..imperative import cached_step as _cs
 from ..ops import registry as _reg
 from ..ops.registry import apply_jax, invoke
@@ -52,6 +53,11 @@ def _as_jax(data, ctx: Optional[Context], dtype) -> jax.Array:
             # reference keeps numpy float64 input as float64.
             np_arr = np_arr.astype(onp.float32)
     dev = (ctx or current_context()).jax_device
+    # host numpy → device buffer: the H2D payload accounting every
+    # eager-funnel input transfer flows through (telemetry h2d_bytes;
+    # prefetched batches skip this branch — they arrive as committed
+    # jax.Arrays above)
+    _telemetry.record_h2d_bytes(np_arr.nbytes)
     return jax.device_put(jnp.asarray(np_arr), dev)
 
 

@@ -368,12 +368,31 @@ class SPMDTrainer:
             return arr
         return jax.device_put(arr, sharding)
 
+    def _stage_input(self, x, sharding):
+        """Stage one batch tensor for the compiled step.  A batch the
+        device-feed pipeline already committed under this trainer's
+        sharding (data.DevicePrefetcher) passes through untouched — the
+        step path performs NO transfer.  Host inputs (numpy/list) and
+        mis-committed arrays pay an inline H2D/reshard here, accounted
+        as ``input.step_h2d`` so the telemetry report can see the input
+        pipeline sitting on the critical path."""
+        if isinstance(x, NDArray):
+            arr, was_host = x._data, False
+        elif isinstance(x, jax.Array):
+            arr, was_host = x, False
+        else:
+            arr, was_host = jnp.asarray(x), True
+        out = self._put(arr, sharding)
+        if was_host or out is not arr:
+            telemetry.record_h2d_bytes(out.nbytes, step_path=True)
+        return out
+
     def step(self, data, label, batch_size: Optional[int] = None):
         """One training step; returns the (device) loss as NDArray."""
-        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
-        d = self._put(d, self._batch_sharding(d.ndim))
-        l = self._put(l, self._batch_sharding(l.ndim))
+        d = self._stage_input(data, self._batch_sharding(
+            data.ndim if hasattr(data, "ndim") else onp.ndim(data)))
+        l = self._stage_input(label, self._batch_sharding(
+            label.ndim if hasattr(label, "ndim") else onp.ndim(label)))
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
         entry = self._step_cache.get(sig)
         fresh = entry is None
@@ -449,12 +468,12 @@ class SPMDTrainer:
         the feed-the-chip window: stage a whole window of input-pipeline
         batches onto the device in one transfer, then train through them
         in one launch."""
-        d = data._data if isinstance(data, NDArray) else jnp.asarray(data)
-        l = label._data if isinstance(label, NDArray) else jnp.asarray(label)
         shard_of = (self._window_sharding if per_step_data
                     else self._batch_sharding)
-        d = self._put(d, shard_of(d.ndim))
-        l = self._put(l, shard_of(l.ndim))
+        d = self._stage_input(data, shard_of(
+            data.ndim if hasattr(data, "ndim") else onp.ndim(data)))
+        l = self._stage_input(label, shard_of(
+            label.ndim if hasattr(label, "ndim") else onp.ndim(label)))
         if per_step_data and (d.shape[0] != n_steps
                               or l.shape[0] != n_steps):
             raise MXNetError(

@@ -103,6 +103,10 @@ def counters() -> Dict[str, Dict[str, int]]:
     - ``serving``: the inference subsystem (requests/batches served,
       eager fallback batches, bucket compiles, shed/expired requests —
       mxnet_tpu/serving/)
+    - ``input``: the device-feed pipeline (consumer blocked-on-input
+      wall ms, host→device payload bytes, inline step-path transfers —
+      data/device_pipeline.py; ``step_h2d`` staying flat across steps
+      means batches arrive pre-committed)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -130,7 +134,11 @@ def counters() -> Dict[str, Dict[str, int]]:
                 "rejects":
                     telemetry.counter("serving.rejected.queue_full").value
                     + telemetry.counter("serving.rejected.shape").value,
-                "timeouts": telemetry.counter("serving.timeouts").value}}
+                "timeouts": telemetry.counter("serving.timeouts").value},
+            "input": {
+                "wait_ms": telemetry.counter("input.wait_ms").value,
+                "h2d_bytes": telemetry.counter("input.h2d_bytes").value,
+                "step_h2d": telemetry.counter("input.step_h2d").value}}
 
 
 def set_config(**kwargs):

@@ -388,11 +388,88 @@ class InferenceEngine:
 
     # -- dispatch -----------------------------------------------------------
 
+    def _infer_committed(self, batch):
+        """Device-committed batch fast path: a pre-stacked batch the
+        device-feed pipeline already placed on-device
+        (``data.DevicePrefetcher`` / ``jax.device_put``) skips host
+        staging entirely — no ``asnumpy`` round-trip, no re-upload.
+        Batch-axis padding to the bucket happens device-side; variable
+        ``seq_axes`` must arrive pre-padded (their actual length keys
+        the bucket).  Dtype must match the engine spec exactly — device
+        batches are never cast."""
+        arr = batch._data if isinstance(batch, NDArray) else batch
+        if arr.ndim == 0:
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError("committed batch must carry a batch axis")
+        if self._dtype is None:
+            self._dtype = str(arr.dtype)
+        elif str(arr.dtype) != self._dtype:
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError(
+                f"committed batch dtype {arr.dtype} does not match "
+                f"engine dtype {self._dtype}")
+        if self._example_shape is None:
+            self._example_shape = tuple(
+                None if i in self._seq_axes else d
+                for i, d in enumerate(arr.shape[1:]))
+        spec = self._example_shape
+        if arr.ndim - 1 != len(spec) or any(
+                want is not None and have != want
+                for have, want in zip(arr.shape[1:], spec)):
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError(
+                f"committed batch shape {arr.shape} does not match "
+                f"example spec {spec}")
+        n = int(arr.shape[0])
+        bucket = self._bucket_batch(n)
+        if bucket > n:
+            arr = jnp.concatenate(
+                [arr, jnp.zeros((bucket - n, *arr.shape[1:]), arr.dtype)])
+        key = (tuple(arr.shape), str(arr.dtype))
+        entry = self._get_runner(key)
+        t0 = profiler.op_timer()
+        batched_nd = NDArray(arr)
+        if entry is not None and entry != "exported":
+            runner, cell = entry
+            leaves = runner(batched_nd)
+            treedef = cell["treedef"]
+            compiled = True
+        else:
+            if entry is None:
+                self._ensure_init(arr)
+            with ag.pause(train_mode=False):
+                out = self._block(batched_nd)
+            leaves, treedef = jax.tree_util.tree_flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            compiled = entry == "exported"
+        profiler.op_record(f"Serving::{self._name}", t0)
+        telemetry.counter(
+            f"serving.bucket.{self._bucket_tag(key)}.dispatches").inc()
+        telemetry.counter("serving.device_batches").inc()
+        host = [l.asnumpy() if isinstance(l, NDArray) else onp.asarray(l)
+                for l in leaves]
+        results = []
+        for i in range(n):
+            rows = [h[i] if h.ndim and h.shape[0] == bucket else h
+                    for h in host]
+            results.append(jax.tree_util.tree_unflatten(treedef, rows)
+                           if treedef is not None else rows[0])
+        meta = {"bucket": self._bucket_tag(key), "padded": bucket,
+                "compiled": compiled, "device_committed": True}
+        return results, meta
+
     def infer_batch(self, examples: Sequence[onp.ndarray]):
         """Run one coalesced batch of admitted (validated, seq-padded)
         examples.  Returns ``(results, meta)``: per-example host-numpy
         results mirroring the block's output structure, and dispatch
-        metadata for telemetry (bucket tag, padded size, compiled?)."""
+        metadata for telemetry (bucket tag, padded size, compiled?).
+
+        ``examples`` may also be a single pre-stacked, device-committed
+        batch (``NDArray`` / ``jax.Array``, batch axis leading) — e.g.
+        from a ``data.DevicePrefetcher``-fed offline scoring loop — in
+        which case host staging is skipped (:meth:`_infer_committed`)."""
+        if isinstance(examples, (NDArray, jax.Array)):
+            return self._infer_committed(examples)
         if not examples:
             return [], {"bucket": None, "padded": 0, "compiled": False}
         n = len(examples)

@@ -47,7 +47,8 @@ __all__ = ["Counter", "Gauge", "Histogram", "counter", "gauge", "histogram",
            "metrics", "snapshot", "reset", "add_sink", "remove_sink",
            "clear_sinks", "sinks", "enabled", "begin_step", "end_step",
            "record_compile", "record_comm_bytes", "record_op_time",
-           "record_serving_batch", "step_count", "last_record",
+           "record_serving_batch", "record_input_wait", "record_h2d_bytes",
+           "step_count", "last_record",
            "JSONLSink", "LogSink", "TensorBoardSink",
            "device_memory_record"]
 
@@ -243,6 +244,13 @@ _G_SRV_QUEUE = gauge("serving.queue_depth")
 _H_SRV_BATCH = histogram("serving.batch_size")
 _H_SRV_WASTE = histogram("serving.padding_waste")
 _H_SRV_REQ_MS = histogram("serving.request_ms")
+# input-pipeline health (mxnet_tpu/data/device_pipeline.py + the step
+# funnels write these; created eagerly for profiler.counters())
+_C_INPUT_WAIT_MS = counter("input.wait_ms")    # consumer blocked on batch
+_C_H2D_BYTES = counter("input.h2d_bytes")      # host→device payload bytes
+_C_STEP_H2D = counter("input.step_h2d")        # inline transfers ON the
+                                               # step path (0 when fed
+                                               # device-committed batches)
 
 
 def record_compile(seconds: float, kind: str) -> None:
@@ -269,6 +277,36 @@ def record_op_time(name: str, seconds: float) -> None:
     """Per-op host-dispatch sample (the profiler aggregate table lives
     in the registry as ``op.<name>`` histograms)."""
     histogram("op." + name).observe(seconds)
+
+
+# pending input-wait accumulator: the wait for step N's batch happens
+# BEFORE begin_step(N) (the user loop does next(batch) then step()), so
+# a counter delta inside the step token would miss it.  The prefetcher
+# deposits here; the next emitted step record drains it — attributing
+# each batch's wait to the step that consumed it.
+_pending_wait_ms = 0.0
+
+
+def record_input_wait(seconds: float) -> None:
+    """Account time a consumer blocked waiting for its next batch
+    (``DevicePrefetcher.__next__``).  With the pipeline keeping ahead of
+    the step this stays ≈0 — the input-bound/compute-bound signal."""
+    global _pending_wait_ms
+    ms = seconds * 1e3
+    _C_INPUT_WAIT_MS.inc(ms)
+    if _SINKS:
+        with _LOCK:
+            _pending_wait_ms += ms
+
+
+def record_h2d_bytes(n: int, step_path: bool = False) -> None:
+    """Account one host→device batch transfer.  ``step_path=True`` marks
+    an INLINE transfer on a step funnel's critical path (the thing the
+    device-feed pipeline exists to eliminate); ``input.step_h2d`` staying
+    flat is the pipeline's acceptance signal."""
+    _C_H2D_BYTES.inc(int(n))
+    if step_path:
+        _C_STEP_H2D.inc()
 
 
 def record_serving_batch(n_requests: int, padded_size: int,
@@ -450,7 +488,7 @@ def enabled() -> bool:
 class _StepToken:
     __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes",
                  "dispatches", "cs_hits", "cs_compiles", "cs_fallbacks",
-                 "cs_breaks")
+                 "cs_breaks", "h2d_bytes")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -462,6 +500,7 @@ class _StepToken:
         self.cs_compiles = _C_CS_COMPILES.value
         self.cs_fallbacks = _C_CS_FALLBACKS.value
         self.cs_breaks = _C_CS_BREAKS.value
+        self.h2d_bytes = _C_H2D_BYTES.value
 
 
 # nesting guard: gluon.Trainer.step pushes through kvstore.pushpull —
@@ -556,6 +595,9 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
         return
     host_ms = (time.perf_counter() - token.t0) * 1e3
     _C_STEPS.inc()
+    global _pending_wait_ms
+    with _LOCK:
+        wait_ms, _pending_wait_ms = _pending_wait_ms, 0.0
     record = {
         "step": _C_STEPS.value,
         "ts": round(time.time(), 3),
@@ -567,6 +609,11 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
         "collective_bytes": _C_COMM_BYTES.value - token.comm_bytes,
         "device_mem": device_memory_record(),
         "dispatches": _C_DISPATCH.value - token.dispatches,
+        # input-pipeline health: time step N's consumer blocked waiting
+        # for its batch (≈0 when the device-feed pipeline keeps ahead)
+        # and H2D payload bytes accounted during this record's window
+        "input_wait_ms": round(wait_ms, 3),
+        "h2d_bytes": _C_H2D_BYTES.value - token.h2d_bytes,
         "cached_step": {
             "hits": _C_CS_HITS.value - token.cs_hits,
             "compiles": _C_CS_COMPILES.value - token.cs_compiles,

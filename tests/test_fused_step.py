@@ -227,7 +227,7 @@ def test_profiler_counters_snapshot():
     c = profiler.counters()
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
-                      "serving"}
+                      "serving", "input"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
     assert set(c["cached_step"]) == {"captures", "compiles", "hits",
@@ -238,6 +238,7 @@ def test_profiler_counters_snapshot():
     assert set(c["comm"]) == {"bytes"}
     assert set(c["serving"]) == {"requests", "batches", "eager_batches",
                                  "compiles", "rejects", "timeouts"}
+    assert set(c["input"]) == {"wait_ms", "h2d_bytes", "step_h2d"}
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100
     assert profiler.counters()["fused_step"]["steps"] != \

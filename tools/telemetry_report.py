@@ -66,6 +66,24 @@ def summarize(records):
     for r in records:
         by_source[r.get("source", "?")] = \
             by_source.get(r.get("source", "?"), 0) + 1
+    waits = sorted(r.get("input_wait_ms", 0.0) for r in records)
+    h2d_total = sum(r.get("h2d_bytes", 0) for r in records)
+    # input-bound decision rule (docs/ARCHITECTURE.md "Input pipeline"):
+    # a step that spent >20% of its host wall blocked on next() is
+    # input-bound — the fix is the input pipeline (more workers, deeper
+    # MXNET_DEVICE_PREFETCH), not the model
+    bound = [r for r in records if r.get("host_ms")
+             and r.get("input_wait_ms", 0.0) > 0.2 * r["host_ms"]]
+    input_stats = {
+        "wait_ms": {"p50": percentile(waits, 50),
+                    "p95": percentile(waits, 95),
+                    "max": waits[-1] if waits else 0.0},
+        "input_bound_steps": len(bound),
+        "input_bound_pct": 100.0 * len(bound) / len(records)
+        if records else 0.0,
+        "h2d_bytes": h2d_total,
+        "h2d_bytes_per_step": h2d_total / len(records) if records else 0,
+    }
     srv = [r["serving"] for r in records
            if isinstance(r.get("serving"), dict) and "error" not in
            r["serving"]]
@@ -102,6 +120,7 @@ def summarize(records):
         "collective_bytes": total_bytes,
         "bytes_per_step": total_bytes / len(records) if records else 0,
         "peak_device_bytes": peak_mem,
+        "input": input_stats,
         "serving": serving,
     }
 
@@ -130,6 +149,19 @@ def render(s):
         f"{'collective bytes / step':<28}{s['bytes_per_step']:>24.1f}",
         f"{'peak device bytes':<28}{s['peak_device_bytes']:>24}",
     ]
+    inp = s.get("input")
+    if inp:
+        verdict = ("input-bound" if inp["input_bound_pct"] > 50
+                   else "compute-bound")
+        lines += [
+            f"{'input wait ms p50':<28}{inp['wait_ms']['p50']:>24.3f}",
+            f"{'input wait ms p95':<28}{inp['wait_ms']['p95']:>24.3f}",
+            f"{'h2d bytes / step':<28}{inp['h2d_bytes_per_step']:>24.1f}",
+            f"{'input-bound steps':<28}"
+            f"{inp['input_bound_steps']:>24}",
+            f"{'input-bound %':<28}"
+            f"{inp['input_bound_pct']:>22.1f} ({verdict})",
+        ]
     srv = s.get("serving")
     if srv:
         lines += [
