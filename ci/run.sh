@@ -96,6 +96,101 @@ data_pipeline_smoke() { # device-feed prefetch: tests + overlap-gate bench
     JAX_PLATFORMS=cpu python benchmark/data_pipeline_bench.py --smoke
 }
 
+tracing_smoke() {     # flight recorder: tests + traced run + off-path guard
+    # tier-1 covers span nesting/threading, the disabled singleton,
+    # export schema, watchdog once-per-incident, /varz + /tracez
+    JAX_PLATFORMS=cpu python -m pytest tests/test_tracing.py -q
+    # a 3-step traced run must export a Chrome trace whose step spans
+    # nest the input/compile/update sub-spans and reconcile with the
+    # telemetry JSONL; MXNET_TRACE=0 must record zero spans and keep
+    # step cost at the untraced baseline (asserted inside)
+    JAX_PLATFORMS=cpu python - <<'PY'
+import json, os, statistics, subprocess, sys, tempfile
+
+code = r'''
+import json, os, sys, time
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd, tracing
+from mxnet_tpu.gluon import nn
+
+mode = sys.argv[1]            # "on" | "off"
+out = sys.argv[2]
+net = nn.Sequential()
+net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+net.initialize(init=mx.initializer.Xavier())
+tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+rs = onp.random.RandomState(0)
+x = nd.array(rs.randn(8, 32).astype("float32"))
+times = []
+for i in range(6):            # 3 warm (compile) + 3 measured
+    t0 = time.perf_counter()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    tr.step(batch_size=8)
+    if i >= 3:
+        times.append(time.perf_counter() - t0)
+if mode == "on":
+    assert tracing.span_count() > 0, "traced run recorded no spans"
+    tracing.export(out + ".trace.json")
+else:
+    assert tracing.span_count() == 0, \
+        f"MXNET_TRACE=0 recorded {tracing.span_count()} spans"
+json.dump({"step_s": times}, open(out, "w"))
+'''
+
+tmp = tempfile.mkdtemp()
+runs = {}
+for mode, env in (("on", {"MXNET_TRACE": "1",
+                          "MXNET_TELEMETRY_JSONL":
+                          f"{tmp}/on.telemetry.jsonl"}),
+                  ("off", {"MXNET_TRACE": "0"})):
+    out = f"{tmp}/{mode}.json"
+    subprocess.run([sys.executable, "-c", code, mode, out],
+                   env=dict(os.environ, JAX_PLATFORMS="cpu", **env),
+                   check=True)
+    runs[mode] = json.load(open(out))
+
+# exported trace: step spans present, with nested sub-spans
+doc = json.load(open(f"{tmp}/on.json.trace.json"))
+evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+names = {e["name"] for e in evs}
+assert any(n.startswith("step.") for n in names), names
+assert any(n.startswith("compile.") for n in names), names
+assert {"step.gluon"} <= names, names
+steps = {e["args"]["span_id"] for e in evs if e["name"] == "step.gluon"}
+nested = {e["name"] for e in evs
+          if e["args"].get("parent_id") in steps}
+assert nested, "step spans have no nested sub-spans"
+
+# reconciliation: root step-span totals vs telemetry host_ms (+-10%
+# with a small absolute epsilon for sub-ms steps)
+recs = [json.loads(l) for l in open(f"{tmp}/on.telemetry.jsonl")]
+host_ms = sum(r["host_ms"] for r in recs if r.get("host_ms") is not None)
+span_ms = sum(e["dur"] / 1e3 for e in evs
+              if e["name"].startswith("step.")
+              and e["args"].get("parent_id") is None)
+assert abs(span_ms - host_ms) <= max(0.10 * host_ms, 2.0), \
+    (span_ms, host_ms)
+
+# bench guard: the MXNET_TRACE=0 path is the no-op singleton — its
+# median step must not exceed the TRACED run's (which pays for real
+# span objects + ring writes) beyond CI jitter, and must be sane in
+# absolute terms.  A regression that puts work on the disabled path
+# shows up as off >> on.
+off = statistics.median(runs["off"]["step_s"])
+on = statistics.median(runs["on"]["step_s"])
+print(f"tracing_smoke: step median off={off*1e3:.3f}ms "
+      f"on={on*1e3:.3f}ms  span/host recon "
+      f"{span_ms:.2f}/{host_ms:.2f}ms")
+assert off < 0.5, f"disabled-trace step median {off:.3f}s implausible"
+assert off <= on * 1.5 + 0.002, \
+    f"disabled-trace step {off*1e3:.3f}ms slower than traced " \
+    f"{on*1e3:.3f}ms — overhead on the MXNET_TRACE=0 path"
+PY
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

@@ -52,6 +52,7 @@ from . import numpy_extension as npx  # noqa: E402
 from . import parallel  # noqa: E402
 from . import profiler  # noqa: E402
 from . import telemetry  # noqa: E402
+from . import tracing  # noqa: E402
 from . import serving  # noqa: E402
 from . import data  # noqa: E402
 from . import monitor  # noqa: E402

@@ -49,6 +49,7 @@ import numpy as onp
 import jax
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError, getenv
 
 __all__ = ["DevicePrefetcher", "prefetch_depth", "wrap"]
@@ -191,14 +192,20 @@ def _produce(src, q, stop, place_fn):
                 continue
         return False
 
+    if tracing.enabled():
+        tracing.register_thread()
     try:
         while not stop.is_set():
-            try:
-                batch = next(src)
-            except StopIteration:
-                put((_DONE, None))
-                return
-            placed, nbytes = _place_tree(batch, place_fn)
+            with tracing.span("input.produce") as sp:
+                try:
+                    batch = next(src)
+                except StopIteration:
+                    put((_DONE, None))
+                    return
+                with tracing.span("input.h2d") as h2d:
+                    placed, nbytes = _place_tree(batch, place_fn)
+                    h2d.annotate(h2d_nbytes=nbytes)
+                sp.annotate(h2d_nbytes=nbytes)
             if nbytes:
                 telemetry.record_h2d_bytes(nbytes)
             if not put((None, placed)):
@@ -232,7 +239,9 @@ class _EpochPipeline:
             raise StopIteration
         t0 = time.perf_counter()
         item = self._q.get()
-        telemetry.record_input_wait(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        telemetry.record_input_wait(t1 - t0)
+        tracing.record_span("input.wait", t0, t1)
         tag, payload = item
         if tag is None:
             return payload

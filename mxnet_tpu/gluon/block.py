@@ -463,12 +463,17 @@ class HybridBlock(Block):
         key = _rng.next_key()
         arrays = [NDArray(key)] + [p.data() for p in pvals] + \
             [a for a in args if isinstance(a, NDArray)]
-        from .. import profiler, telemetry
+        from .. import profiler, telemetry, tracing
         t0 = profiler.op_timer()
         # a fresh signature's first execution carries trace+compile —
         # time it so recompiles surface in the telemetry stream
         tc0 = _time.perf_counter() if fresh else None
-        flat_out = apply_jax(jitted, arrays, multi_out=True)
+        if fresh:
+            with tracing.span("compile.cached_op",
+                              block=type(self).__name__):
+                flat_out = apply_jax(jitted, arrays, multi_out=True)
+        else:
+            flat_out = apply_jax(jitted, arrays, multi_out=True)
         if tc0 is not None:
             telemetry.record_compile(_time.perf_counter() - tc0,
                                      "cached_op")

@@ -13,6 +13,7 @@ import os
 from typing import Dict, List, Optional, Sequence
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import optimizer as opt_mod
@@ -209,22 +210,25 @@ class Trainer:
         # inner kvstore pushpull nests and only accumulates counters
         tok = telemetry.begin_step()
         try:
-            if not self._kv_initialized:
-                self._init_kvstore()
-            new_rescale = self._scale / batch_size
-            if new_rescale != self._optimizer.rescale_grad:
-                self._optimizer.rescale_grad = new_rescale
-                self._reship_server_optimizer()
-            # whole-step capture (imperative/cached_step.py): a deferred
-            # record→backward→step executes as ONE donated executable
-            # here; otherwise the completed eager step below is observed
-            # so the NEXT step can be captured
-            from ..imperative import cached_step
-            if cached_step.trainer_step(self, ignore_stale_grad):
-                return
-            if not self._fold_device_allreduce():
-                self._allreduce_grads()
-            self._update(ignore_stale_grad)
+            with tracing.span("step.gluon"):
+                if not self._kv_initialized:
+                    self._init_kvstore()
+                new_rescale = self._scale / batch_size
+                if new_rescale != self._optimizer.rescale_grad:
+                    self._optimizer.rescale_grad = new_rescale
+                    self._reship_server_optimizer()
+                # whole-step capture (imperative/cached_step.py): a
+                # deferred record→backward→step executes as ONE donated
+                # executable here; otherwise the completed eager step
+                # below is observed so the NEXT step can be captured
+                from ..imperative import cached_step
+                if cached_step.trainer_step(self, ignore_stale_grad):
+                    return
+                if not self._fold_device_allreduce():
+                    with tracing.span("step.allreduce"):
+                        self._allreduce_grads()
+                with tracing.span("step.update"):
+                    self._update(ignore_stale_grad)
         finally:
             telemetry.end_step(tok, "gluon.Trainer")
 
@@ -290,19 +294,21 @@ class Trainer:
     def update(self, batch_size, ignore_stale_grad=False):
         tok = telemetry.begin_step()
         try:
-            # update() is the manual-allreduce variant: only step() owns
-            # whole-step capture, so materialize any pending deferral
-            from ..imperative import cached_step
-            cached_step.break_if_deferring("Trainer.update")
-            if not self._kv_initialized:
-                self._init_kvstore()
-            new_rescale = self._scale / batch_size
-            if new_rescale != self._optimizer.rescale_grad:
-                self._optimizer.rescale_grad = new_rescale
-                # same reship as step(): an uncoordinated-async PS would
-                # otherwise keep updating with the stale rescale_grad
-                self._reship_server_optimizer()
-            self._update(ignore_stale_grad)
+            with tracing.span("step.gluon_update"):
+                # update() is the manual-allreduce variant: only step()
+                # owns whole-step capture, so materialize any deferral
+                from ..imperative import cached_step
+                cached_step.break_if_deferring("Trainer.update")
+                if not self._kv_initialized:
+                    self._init_kvstore()
+                new_rescale = self._scale / batch_size
+                if new_rescale != self._optimizer.rescale_grad:
+                    self._optimizer.rescale_grad = new_rescale
+                    # same reship as step(): an uncoordinated-async PS
+                    # would otherwise keep the stale rescale_grad
+                    self._reship_server_optimizer()
+                with tracing.span("step.update"):
+                    self._update(ignore_stale_grad)
         finally:
             telemetry.end_step(tok, "gluon.Trainer")
 

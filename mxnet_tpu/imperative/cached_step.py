@@ -57,6 +57,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import telemetry
+from .. import tracing
 
 __all__ = ["enabled", "stats", "reset_stats", "trainer_state",
            "trainer_step", "resolve", "ensure_real"]
@@ -1081,10 +1082,12 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
                           for nm in stt.dyn_names)
         t0 = _time.perf_counter()
         try:
-            if ent.jfn is None:
-                ent.jfn = _build_step_fn(stt)
-            ent.compiled = ent.jfn.lower(
-                dyn_probe, ext_t, frozen_t, weights_t, states_t).compile()
+            with tracing.span("compile.cached_step"):
+                if ent.jfn is None:
+                    ent.jfn = _build_step_fn(stt)
+                ent.compiled = ent.jfn.lower(
+                    dyn_probe, ext_t, frozen_t, weights_t,
+                    states_t).compile()
         except Exception:
             state.bad.add(stt.key)
             state.current = None
@@ -1107,10 +1110,13 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
 
     from .. import profiler
     tp = profiler.op_timer()
+    _rsp = tracing.begin("step.cached_replay", compiled=not fresh)
     try:
         new_w, new_s, grads, flat = ent.compiled(
             dyn, ext_t, frozen_t, weights_t, states_t)
+        tracing.end(_rsp)
     except Exception:
+        tracing.end(_rsp, error=True)
         # donation means buffers may already be consumed: latch off and
         # surface the error rather than double-applying the step
         state.disabled = True

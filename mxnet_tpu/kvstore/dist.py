@@ -49,6 +49,7 @@ import numpy as onp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError, getenv_int
 from ..ndarray import NDArray
 from .base import KVStoreBase, payload_nbytes
@@ -505,8 +506,12 @@ class DistKVStore(KVStoreBase):
         # step funnel #3 (dist): one record per push call when driven
         # directly; nested under Trainer.step only counters accumulate
         tok = telemetry.begin_step()
+        _b0 = telemetry.counter("comm.bytes").value
         try:
-            self._push(key, value, priority)
+            with tracing.span("comm.push") as sp:
+                self._push(key, value, priority)
+                sp.annotate(payload_nbytes=telemetry.counter(
+                    "comm.bytes").value - _b0)
         finally:
             telemetry.end_step(tok, "kvstore")
 
@@ -690,11 +695,15 @@ class DistKVStore(KVStoreBase):
 
     def pushpull(self, key, value, out=None, priority=0):
         tok = telemetry.begin_step()
+        _b0 = telemetry.counter("comm.bytes").value
         try:
-            self._push(key, value, priority)
-            if out is not None:
-                self.pull(key, out, priority)
-            return out
+            with tracing.span("comm.pushpull") as sp:
+                self._push(key, value, priority)
+                if out is not None:
+                    self.pull(key, out, priority)
+                sp.annotate(payload_nbytes=telemetry.counter(
+                    "comm.bytes").value - _b0)
+                return out
         finally:
             telemetry.end_step(tok, "kvstore")
 

@@ -15,6 +15,7 @@ import pickle
 from typing import Any, Dict, List, Optional
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .base import KVStoreBase, payload_nbytes
@@ -68,8 +69,12 @@ class KVStore(KVStoreBase):
         # optimizer) emits one record per push; under Trainer.step this
         # nests and only the counters accumulate
         tok = telemetry.begin_step()
+        _b0 = telemetry.counter("comm.bytes").value
         try:
-            self._push(key, value, priority)
+            with tracing.span("comm.push") as sp:
+                self._push(key, value, priority)
+                sp.annotate(payload_nbytes=telemetry.counter(
+                    "comm.bytes").value - _b0)
         finally:
             telemetry.end_step(tok, "kvstore")
 
@@ -183,7 +188,10 @@ class KVStore(KVStoreBase):
 
     def pushpull(self, key, value, out=None, priority=0):
         tok = telemetry.begin_step()
+        _b0 = telemetry.counter("comm.bytes").value
+        _sp = tracing.span("comm.pushpull")
         try:
+            _sp.__enter__()
             if self._updater is not None:
                 # server-side optimizer: push applies update, pull
                 # returns weight
@@ -214,6 +222,9 @@ class KVStore(KVStoreBase):
                 self.pull(key, out, priority)
             return out
         finally:
+            _sp.annotate(payload_nbytes=telemetry.counter(
+                "comm.bytes").value - _b0)
+            _sp.__exit__(None, None, None)
             telemetry.end_step(tok, "kvstore")
 
     def broadcast(self, key, value, out, priority=0):

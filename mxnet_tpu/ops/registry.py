@@ -16,6 +16,7 @@ import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 from ..imperative import cached_step as _cached_step
 
@@ -293,8 +294,15 @@ class _JitEntry:
             # (telemetry compile.count/compile.ms); replays take the
             # untimed path and cost nothing extra
             t0 = _time.perf_counter() if fresh else None
+            _sp = (tracing.span("compile.eager_op",
+                                op=getattr(fn, "__name__", "?"))
+                   if fresh else None)
             try:
-                out = self.jfn(*arrays)
+                if _sp is not None:
+                    with _sp:
+                        out = self.jfn(*arrays)
+                else:
+                    out = self.jfn(*arrays)
             except Exception:
                 out = fn(*arrays)       # raises through on input errors
                 self.disabled = True    # jit-specific failure, eager works

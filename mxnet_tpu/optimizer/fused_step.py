@@ -35,6 +35,7 @@ import jax.numpy as jnp
 
 from .. import profiler
 from .. import telemetry
+from .. import tracing
 from ..ops import registry as _reg
 from .optimizer import Updater, _lowp_guard, _note_dispatch
 
@@ -236,12 +237,15 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
     # _build (jax.jit is lazy) — time it so the compile records wall
     # time, not just a count
     tc = time.perf_counter() if fresh else None
+    _sp = tracing.span("compile.fused_step" if fresh
+                       else "step.fused_update")
     try:
-        out_w, out_s = jfn(
-            dyn,
-            tuple(w._data for w in weights),
-            tuple(g._data for g in grads),
-            tuple(tuple(s._data for s in sts) for sts in states))
+        with _sp:
+            out_w, out_s = jfn(
+                dyn,
+                tuple(w._data for w in weights),
+                tuple(g._data for g in grads),
+                tuple(tuple(s._data for s in sts) for sts in states))
     except Exception:
         # donation means a failed execution may have consumed buffers on
         # some backends; latch off, but surface the error — the step is

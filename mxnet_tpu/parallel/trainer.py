@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .. import telemetry
+from .. import tracing
 from ..base import MXNetError
 from ..ndarray import NDArray
 from .. import autograd as ag
@@ -405,18 +406,23 @@ class SPMDTrainer:
         tok = telemetry.begin_step()
         _prof_t0 = profiler.op_timer()
         try:
-            self.num_update += 1
-            lr = jnp.float32(self.optimizer.learning_rate)
-            wd = jnp.float32(self.optimizer.wd)
-            self.optimizer.num_update = self.num_update
-            p_arrays, opt_state = self._gather_state()
-            tc = time.perf_counter() if fresh else None
-            new_p, new_s, loss, aux = jitted(next_key(), lr, wd, p_arrays,
-                                             opt_state, d, l)
-            if tc is not None:
-                telemetry.record_compile(time.perf_counter() - tc,
-                                         "spmd_step")
-            self._fold_back(new_p, new_s, cell, aux)
+            with tracing.span("step.spmd") as _sp:
+                self.num_update += 1
+                lr = jnp.float32(self.optimizer.learning_rate)
+                wd = jnp.float32(self.optimizer.wd)
+                self.optimizer.num_update = self.num_update
+                p_arrays, opt_state = self._gather_state()
+                tc = time.perf_counter() if fresh else None
+                with tracing.span("compile.spmd_step" if fresh
+                                  else "step.dispatch"):
+                    new_p, new_s, loss, aux = jitted(next_key(), lr, wd,
+                                                     p_arrays, opt_state,
+                                                     d, l)
+                if tc is not None:
+                    telemetry.record_compile(time.perf_counter() - tc,
+                                             "spmd_step")
+                _sp.annotate(fresh_compile=fresh)
+                self._fold_back(new_p, new_s, cell, aux)
             profiler.op_record("SPMDTrainer::step", _prof_t0)
         finally:
             telemetry.end_step(tok, "SPMDTrainer")
@@ -493,21 +499,26 @@ class SPMDTrainer:
         # device program / one dispatch)
         tok = telemetry.begin_step()
         try:
-            # read lr/wd BEFORE advancing num_update — matching what the
-            # first of n sequential step() calls would use (the whole
-            # fused window trains at the window-entry schedule point)
-            lr = jnp.float32(self.optimizer.learning_rate)
-            wd = jnp.float32(self.optimizer.wd)
-            self.num_update += int(n_steps)
-            self.optimizer.num_update = self.num_update
-            p_arrays, opt_state = self._gather_state()
-            tc = time.perf_counter() if fresh else None
-            new_p, new_s, losses = jitted(next_key(), lr, wd, p_arrays,
-                                          opt_state, d, l)
-            if tc is not None:
-                telemetry.record_compile(time.perf_counter() - tc,
-                                         "spmd_step")
-            self._fold_back(new_p, new_s, cell)
+            with tracing.span("step.spmd_window", n_steps=int(n_steps)):
+                # read lr/wd BEFORE advancing num_update — matching what
+                # the first of n sequential step() calls would use (the
+                # whole fused window trains at the window-entry schedule
+                # point)
+                lr = jnp.float32(self.optimizer.learning_rate)
+                wd = jnp.float32(self.optimizer.wd)
+                self.num_update += int(n_steps)
+                self.optimizer.num_update = self.num_update
+                p_arrays, opt_state = self._gather_state()
+                tc = time.perf_counter() if fresh else None
+                with tracing.span("compile.spmd_step" if fresh
+                                  else "step.dispatch"):
+                    new_p, new_s, losses = jitted(next_key(), lr, wd,
+                                                  p_arrays, opt_state,
+                                                  d, l)
+                if tc is not None:
+                    telemetry.record_compile(time.perf_counter() - tc,
+                                             "spmd_step")
+                self._fold_back(new_p, new_s, cell)
         finally:
             telemetry.end_step(tok, "SPMDTrainer",
                                extra={"n_steps": int(n_steps)})

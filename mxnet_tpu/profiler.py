@@ -21,7 +21,7 @@ from typing import Dict, Optional
 
 import jax
 
-from . import telemetry
+from . import telemetry, tracing
 
 __all__ = ["set_config", "start", "stop", "pause", "resume", "dump", "dumps",
            "Task", "Frame", "Event", "Counter", "Marker", "scope", "counters",
@@ -107,6 +107,9 @@ def counters() -> Dict[str, Dict[str, int]]:
       wall ms, host→device payload bytes, inline step-path transfers —
       data/device_pipeline.py; ``step_h2d`` staying flat across steps
       means batches arrive pre-committed)
+    - ``tracing``: the span flight recorder (spans recorded / dropped
+      to ring-buffer overwrite / currently open, plus stall-watchdog
+      dump incidents — mxnet_tpu/tracing.py)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -138,7 +141,13 @@ def counters() -> Dict[str, Dict[str, int]]:
             "input": {
                 "wait_ms": telemetry.counter("input.wait_ms").value,
                 "h2d_bytes": telemetry.counter("input.h2d_bytes").value,
-                "step_h2d": telemetry.counter("input.step_h2d").value}}
+                "step_h2d": telemetry.counter("input.step_h2d").value},
+            "tracing": {
+                "spans": tracing.span_count(),
+                "dropped": tracing.dropped_count(),
+                "open": len(tracing.open_spans()),
+                "watchdog_dumps":
+                    telemetry.counter("watchdog.stall_dumps").value}}
 
 
 def set_config(**kwargs):
@@ -249,7 +258,9 @@ def dumps(reset=False, device=True):
     xplane trace was captured, a device-time per-op table follows — the
     device numbers are the kernel truth (dispatch wall time says
     nothing about a 4 ms kernel under async dispatch).  User counters
-    (profiler.Counter) follow as a third section."""
+    (profiler.Counter) follow as a third section, and when the span
+    flight recorder has recorded anything (MXNET_TRACE) a per-span-name
+    aggregate of the ring buffer closes the dump."""
     lines = ["Profile Statistics (host dispatch):",
              f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}{'Mean(ms)':>12}"]
     for name, st in sorted(op_stats().items()):
@@ -274,6 +285,21 @@ def dumps(reset=False, device=True):
             from . import xplane
             lines.append("")
             lines.append(xplane.format_table(dev))
+    spans = tracing.aggregate()
+    if spans:
+        lines.append("")
+        lines.append("Trace spans (flight recorder ring):")
+        lines.append(f"{'Name':<40}{'Count':>8}{'Total(ms)':>12}"
+                     f"{'Mean(ms)':>12}{'Max(ms)':>12}")
+        for name, st in sorted(spans.items(),
+                               key=lambda kv: -kv[1]["total_ms"]):
+            lines.append(f"{name:<40}{st['count']:>8}"
+                         f"{st['total_ms']:>12.3f}{st['mean_ms']:>12.3f}"
+                         f"{st['max_ms']:>12.3f}")
+        dropped = tracing.dropped_count()
+        if dropped:
+            lines.append(f"(+{dropped} spans dropped to ring-buffer "
+                         "overwrite; raise MXNET_TRACE_BUFFER)")
     if reset:
         reset_stats()
     return "\n".join(lines)

@@ -34,6 +34,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional
 
 from .. import telemetry
+from .. import tracing
 from ..base import getenv_int
 from .engine import (InferenceEngine, QueueFullError, RequestTimeoutError,
                      ServingClosedError)
@@ -192,6 +193,7 @@ class DynamicBatcher:
         BEFORE the request can touch a batch."""
         # validation happens outside the lock (numpy work), and before
         # admission: a request that raises here was never queued
+        _t0 = time.perf_counter()
         example = self.engine.validate(x)
         example, _ = self.engine.pad_example(example)
         group = self.engine.group_key(example)
@@ -207,8 +209,11 @@ class DynamicBatcher:
                     f"queue at depth {self.queue_depth}; load shed")
             p = _Pending(example, group, deadline)
             self._q.append(p)
-            self._gauge.set(len(self._q))
+            depth = len(self._q)
+            self._gauge.set(depth)
             self._cv.notify()
+        tracing.record_span("serving.enqueue", _t0, time.perf_counter(),
+                            queue_depth=depth)
         return p.future
 
     # -- dispatch -----------------------------------------------------------
@@ -231,7 +236,8 @@ class DynamicBatcher:
     def _take_group(self) -> List[_Pending]:
         """Pop up to ``max_batch_size`` requests sharing the head
         request's group key (caller holds the lock)."""
-        self._expire(time.perf_counter())
+        _t0 = time.perf_counter()
+        self._expire(_t0)
         if not self._q:
             return []
         head = self._q[0].group
@@ -244,6 +250,8 @@ class DynamicBatcher:
                 keep.append(p)
         self._q.extend(keep)
         self._gauge.set(len(self._q))
+        tracing.record_span("serving.coalesce", _t0, time.perf_counter(),
+                            batch_size=len(batch))
         return batch
 
     def _loop(self):
@@ -287,9 +295,14 @@ class DynamicBatcher:
 
     def _dispatch(self, batch: List[_Pending]) -> None:
         token = telemetry.begin_step()
+        t_dispatch = time.perf_counter()
+        _sp = tracing.span("serving.dispatch", batch_size=len(batch))
         try:
-            results, meta = self.engine.infer_batch(
-                [p.example for p in batch])
+            with _sp:
+                results, meta = self.engine.infer_batch(
+                    [p.example for p in batch])
+                _sp.annotate(padded=meta["padded"], bucket=meta["bucket"],
+                             compiled=meta["compiled"])
         except Exception as e:   # a failed dispatch fails ITS batch only
             for p in batch:
                 p.future.set_exception(e)
@@ -303,6 +316,13 @@ class DynamicBatcher:
         for p, r in zip(batch, results):
             p.future.set_result(r)
             latencies.append(round((now - p.t_submit) * 1e3, 3))
+            # enqueue→reply lifecycle span, one per request: queue wait
+            # (submit→dispatch start) rides as an attribute so /tracez
+            # and the report tool can separate waiting from compute
+            tracing.record_span(
+                "serving.request", p.t_submit, now,
+                queue_wait_ms=round((t_dispatch - p.t_submit) * 1e3, 3),
+                batch_size=len(batch))
         telemetry.record_serving_batch(len(batch), meta["padded"],
                                        latencies,
                                        eager=not meta["compiled"])

@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 
 from .. import autograd as ag
-from .. import profiler, telemetry
+from .. import profiler, telemetry, tracing
 from ..base import MXNetError, getenv
 from ..gluon.block import (_ExportedBlock, _TraceContext, _trace_scope,
                            _walk_blocks)
@@ -303,10 +303,13 @@ class InferenceEngine:
                   {id(b): b for b in _walk_blocks(block)}.values()
                   if hasattr(b, "_active")]
         t0 = _time.perf_counter()
+        _sp = tracing.span("compile.serving",
+                           bucket=self._bucket_tag(key))
         try:
-            for b, _ in hybrid:
-                b._active = False
-            compiled = jax.jit(traced).lower(*specs).compile()
+            with _sp:
+                for b, _ in hybrid:
+                    b._active = False
+                compiled = jax.jit(traced).lower(*specs).compile()
         except Exception:
             return None
         finally:

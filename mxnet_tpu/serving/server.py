@@ -10,6 +10,12 @@ path with no sockets.  The HTTP endpoint is a thin stdlib
   "timeout_ms"?: number}`` → ``{"output": <nested list>}`` (or
   ``{"outputs": [...]}`` for multi-output blocks).
 - ``GET /healthz`` → queue depth, compiled buckets, drain state.
+- ``GET /varz`` → the live telemetry registry snapshot (every counter /
+  gauge / histogram, JSON) — inspect a running server without
+  restarting it.
+- ``GET /tracez`` → the flight recorder's recent completed spans plus
+  currently-open spans (tracing.py ring buffer; empty lists when
+  ``MXNET_TRACE`` is off).
 
 Error mapping: admission shape reject → 400, queue full (load shed) →
 429, request deadline → 504, draining/closed → 503.  ``stop()`` is
@@ -24,6 +30,7 @@ from typing import Any, Optional
 
 import numpy as onp
 
+from .. import telemetry, tracing
 from ..base import MXNetError
 from .batcher import DynamicBatcher
 from .engine import (BadRequestError, InferenceEngine, QueueFullError,
@@ -74,6 +81,21 @@ class ServingServer:
             "queue_depth_limit": self.batcher.queue_depth,
         }
 
+    def varz(self) -> dict:
+        """Live telemetry registry snapshot (what ``GET /varz``
+        serves) — the same plain-data view ``telemetry.snapshot()``
+        returns, so numbers reconcile with profiler.counters()."""
+        return telemetry.snapshot()
+
+    def tracez(self, limit: int = 100) -> dict:
+        """Flight-recorder view (what ``GET /tracez`` serves): recent
+        completed spans + currently-open spans."""
+        return {"enabled": tracing.enabled(),
+                "spans": tracing.span_count(),
+                "dropped": tracing.dropped_count(),
+                "recent": tracing.recent(limit),
+                "open": tracing.open_spans()}
+
     def stop(self, drain: bool = True):
         """Drain-aware shutdown: close admission (delivering admitted
         responses when ``drain``), then stop the HTTP listener."""
@@ -109,6 +131,18 @@ class ServingServer:
             def do_GET(self):
                 if self.path == "/healthz":
                     self._reply(200, server.healthz())
+                elif self.path == "/varz":
+                    self._reply(200, server.varz())
+                elif self.path.split("?", 1)[0] == "/tracez":
+                    limit = 100
+                    if "?" in self.path:
+                        from urllib.parse import parse_qs
+                        q = parse_qs(self.path.split("?", 1)[1])
+                        try:
+                            limit = int(q.get("limit", ["100"])[0])
+                        except ValueError:
+                            pass
+                    self._reply(200, server.tracez(limit))
                 else:
                     self._reply(404, {"error": f"no route {self.path}"})
 

@@ -533,20 +533,19 @@ def _note_trace_stop(trace_dir: Optional[str]) -> None:
 def _consume_device_ms() -> Optional[float]:
     """device step ms from the last finished xplane trace, averaged
     over the step records emitted during the trace window; None when no
-    trace has finished since the last consumption."""
+    trace has finished since the last consumption, and None (skip the
+    column, never mis-report) when the capture is missing, late, or
+    partial — xplane.device_total_ms already folds truncated files and
+    non-positive totals into None."""
     global _pending_device_ms
     tdir = _trace_note["dir"]
     if tdir is None:
         return None
     _trace_note["dir"] = None
     from . import xplane
-    try:
-        table = xplane.device_op_table(tdir)
-    except Exception:
+    total_ms = xplane.device_total_ms(tdir)
+    if total_ms is None:
         return None
-    if not table:
-        return None
-    total_ms = sum(r["total_us"] for r in table.values()) / 1e3
     n = max(1, _C_STEPS.value - _trace_note["steps_at_start"])
     return total_ms / n
 

@@ -20,8 +20,8 @@ import struct
 from collections import defaultdict
 from typing import Dict, Iterator, List, Optional, Tuple
 
-__all__ = ["read_xspace", "device_op_table", "latest_trace_file",
-           "format_table"]
+__all__ = ["read_xspace", "device_op_table", "device_total_ms",
+           "latest_trace_file", "format_table"]
 
 
 # -- protobuf wire decoding -------------------------------------------------
@@ -146,6 +146,25 @@ def read_xspace(path: str) -> List[XPlane]:
     return [XPlane(b) for b in _submsgs(data, 1)]
 
 
+def _read_xspace_tolerant(path: str) -> List[XPlane]:
+    """Like :func:`read_xspace`, but a truncated / still-being-written
+    capture (the profiler plugin flushes the device table LATE — a
+    parse racing the flush sees a partial file) yields the planes that
+    decoded cleanly instead of raising mid-message."""
+    try:
+        with open(path, "rb") as f:
+            data = memoryview(f.read())
+    except OSError:
+        return []
+    planes = []
+    try:
+        for b in _submsgs(data, 1):
+            planes.append(XPlane(b))
+    except (IndexError, ValueError, struct.error):
+        pass   # keep whatever decoded before the truncation point
+    return planes
+
+
 def latest_trace_file(trace_dir: str) -> Optional[str]:
     pbs = glob.glob(os.path.join(trace_dir, "plugins", "profile", "*",
                                  "*.xplane.pb"))
@@ -180,7 +199,7 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
         path = latest_trace_file(path)
         if path is None:
             return {}
-    planes = read_xspace(path)
+    planes = _read_xspace_tolerant(path)
 
     table: Dict[str, Dict[str, float]] = defaultdict(
         lambda: {"count": 0, "total_us": 0.0})
@@ -207,14 +226,20 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
                 feed(p, line)
     else:
         # CPU runtime: per-thunk op events live on the XLA client
-        # threadpool line ("tf_XLAPjRtCpuClient/..."); skip the paired
-        # "end:" markers and threadpool bookkeeping
-        skip = ("end: ", "ThreadpoolListener", "ThunkExecutor")
+        # threadpool line — named "tf_XLATfrtCpuClient/..." or
+        # "tf_XLAPjRtCpuClient/..." depending on the runtime build, so
+        # key on the common "CpuClient" stem.  Skip the paired "end:"
+        # markers, threadpool bookkeeping, and the executable/dispatch
+        # wrappers whose durations NEST the thunks they run (summing
+        # them double-counts every kernel).
+        skip = ("end: ", "ThreadpoolListener", "ThunkExecutor",
+                "TfrtCpuExecutable", "PjRtCpuExecutable", "PjitFunction",
+                "$")   # "$..." = python-tracer frame events
 
         def feed_host(line_filter):
             for p in planes:
                 for line in p.lines:
-                    if not line_filter(line):
+                    if line.name == "python" or not line_filter(line):
                         continue
                     for ev in line.events:
                         name = p.event_metadata.get(ev.metadata_id)
@@ -224,7 +249,7 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
                         row["count"] += 1
                         row["total_us"] += ev.duration_ps / 1e6
 
-        feed_host(lambda line: "XLAPjRtCpuClient" in line.name)
+        feed_host(lambda line: "CpuClient" in line.name)
         if not table and any(line.events for p in planes
                              for line in p.lines):
             # the line-name heuristic keys off jax/XLA-internal
@@ -233,7 +258,7 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
             # non-bookkeeping host event and say so
             from .log import get_logger
             get_logger().warning(
-                "xplane: no 'XLAPjRtCpuClient' line found in the host "
+                "xplane: no '*CpuClient' line found in the host "
                 "trace (runtime renamed its threadpool lines?); "
                 "falling back to aggregating all host-plane events")
             feed_host(lambda line: True)
@@ -244,6 +269,25 @@ def device_op_table(trace_dir_or_file: str) -> Dict[str, Dict[str, float]]:
                      "total_us": row["total_us"],
                      "avg_us": row["total_us"] / max(row["count"], 1)}
     return out
+
+
+def device_total_ms(trace_dir_or_file: str) -> Optional[float]:
+    """Total device-op time in ms summed over the aggregate table, or
+    ``None`` when the capture has no usable device table (directory
+    missing, trace not flushed yet, truncated file, or a table whose
+    totals are non-positive).  Callers treat None as "no device timing
+    available this window" and skip device-side assertions/columns
+    rather than mis-reporting a partial capture as real timing."""
+    try:
+        table = device_op_table(trace_dir_or_file)
+    except Exception:
+        return None
+    if not table:
+        return None
+    total_us = sum(r["total_us"] for r in table.values())
+    if total_us <= 0:
+        return None
+    return total_us / 1e3
 
 
 def format_table(table: Dict[str, Dict[str, float]], limit: int = 40,

@@ -13,6 +13,16 @@ reject/timeout totals — reconciled from the SAME JSONL stream.
 Usage:
     python tools/telemetry_report.py run.jsonl
     python tools/telemetry_report.py run.jsonl --json   # machine-readable
+    python tools/telemetry_report.py run.jsonl --trace trace.jsonl
+
+``--trace`` reads the span stream the flight recorder emits
+(MXNET_TRACE_JSONL, one Chrome-trace event per line) and adds a
+section: top-5 span names by total AND by self time (self = duration
+minus direct children, via ``args.parent_id``), the widest single
+consumer input-wait gap, and a reconciliation of root step-span time
+against the telemetry records' ``host_ms`` — the two streams measure
+the same steps from different layers, so a large divergence means
+instrumentation drift, not workload change.
 
 The totals printed here are straight sums over the record deltas, so
 they reconcile exactly with ``profiler.counters()`` taken at the end of
@@ -125,6 +135,106 @@ def summarize(records):
     }
 
 
+def load_trace(path):
+    """Load a flight-recorder JSONL stream: one Chrome-trace event per
+    line (``ph: "X"`` complete spans; anything else is skipped)."""
+    events = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise SystemExit(f"{path}:{i}: bad trace record: {e}")
+            if ev.get("ph") == "X" and "dur" in ev:
+                events.append(ev)
+    return events
+
+
+def summarize_trace(events, records):
+    """Per-span-name totals, self times, widest input-wait gap, and the
+    step-span vs telemetry ``host_ms`` reconciliation."""
+    # direct-children duration per parent span id, for self time
+    child_dur_us = {}
+    for ev in events:
+        pid = (ev.get("args") or {}).get("parent_id")
+        if pid is not None:
+            child_dur_us[pid] = child_dur_us.get(pid, 0.0) + ev["dur"]
+    by_name = {}
+    for ev in events:
+        args = ev.get("args") or {}
+        st = by_name.setdefault(ev.get("name", "?"),
+                                {"count": 0, "total_ms": 0.0,
+                                 "self_ms": 0.0, "max_ms": 0.0})
+        dur_ms = ev["dur"] / 1e3
+        st["count"] += 1
+        st["total_ms"] += dur_ms
+        st["max_ms"] = max(st["max_ms"], dur_ms)
+        sid = args.get("span_id")
+        self_ms = dur_ms - (child_dur_us.get(sid, 0.0) / 1e3
+                            if sid is not None else 0.0)
+        st["self_ms"] += max(0.0, self_ms)
+
+    waits = [ev for ev in events if ev.get("name") == "input.wait"]
+    widest_wait = max(waits, key=lambda ev: ev["dur"], default=None)
+
+    # root step spans (no parent) measure the same interval telemetry's
+    # begin_step/end_step brackets as host_ms — totals should agree
+    step_span_ms = sum(
+        ev["dur"] / 1e3 for ev in events
+        if ev.get("name", "").startswith("step.")
+        and (ev.get("args") or {}).get("parent_id") is None)
+    host_ms = sum(r["host_ms"] for r in records
+                  if r.get("host_ms") is not None)
+    recon = None
+    if step_span_ms > 0 and host_ms > 0:
+        recon = {"step_span_ms": step_span_ms, "host_ms": host_ms,
+                 "delta_pct": 100.0 * (step_span_ms - host_ms) / host_ms}
+
+    def top5(key):
+        return [{"name": n, **st} for n, st in
+                sorted(by_name.items(), key=lambda kv: -kv[1][key])[:5]]
+
+    return {
+        "spans": len(events),
+        "names": len(by_name),
+        "top_total": top5("total_ms"),
+        "top_self": top5("self_ms"),
+        "widest_input_wait_ms": widest_wait["dur"] / 1e3
+        if widest_wait else None,
+        "reconciliation": recon,
+    }
+
+
+def render_trace(t):
+    lines = ["", "Trace spans (flight recorder)", "-" * 52,
+             f"{'spans':<28}{t['spans']:>24}",
+             f"{'distinct names':<28}{t['names']:>24}"]
+
+    def table(title, rows):
+        lines.append(f"top spans by {title}:")
+        lines.append(f"  {'name':<30}{'count':>6}{'total':>10}{'self':>10}")
+        for r in rows:
+            lines.append(f"  {r['name']:<30}{r['count']:>6}"
+                         f"{r['total_ms']:>10.2f}{r['self_ms']:>10.2f}")
+
+    table("total ms", t["top_total"])
+    table("self ms", t["top_self"])
+    if t["widest_input_wait_ms"] is not None:
+        lines.append(f"{'widest input.wait gap ms':<28}"
+                     f"{t['widest_input_wait_ms']:>24.3f}")
+    rec = t["reconciliation"]
+    if rec:
+        lines += [
+            f"{'root step-span ms total':<28}{rec['step_span_ms']:>24.3f}",
+            f"{'telemetry host_ms total':<28}{rec['host_ms']:>24.3f}",
+            f"{'span vs host_ms delta %':<28}{rec['delta_pct']:>24.2f}",
+        ]
+    return "\n".join(lines)
+
+
 def render(s):
     lines = ["Telemetry run summary",
              "=" * 52,
@@ -187,16 +297,25 @@ def main(argv=None):
     ap.add_argument("jsonl", help="telemetry JSONL file to summarize")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
+    ap.add_argument("--trace", metavar="TRACE_JSONL",
+                    help="flight-recorder span stream (MXNET_TRACE_JSONL) "
+                         "to summarize and reconcile against the step "
+                         "records")
     args = ap.parse_args(argv)
     records = load(args.jsonl)
     if not records:
         raise SystemExit(f"{args.jsonl}: no telemetry records")
     s = summarize(records)
+    if args.trace:
+        s["trace"] = summarize_trace(load_trace(args.trace), records)
     if args.json:
         json.dump(s, sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
-        print(render(s))
+        out = render(s)
+        if args.trace:
+            out += "\n" + render_trace(s["trace"])
+        print(out)
     return 0
 
 
