@@ -1,0 +1,531 @@
+"""Async sharded checkpoint service (elastic fault-tolerant training).
+
+The recovery architecture of the TensorFlow system paper (PAPERS.md,
+arxiv 1605.08695): checkpoint/restore IS the failure-handling design —
+a preempted worker loses at most the work since the last *published*
+checkpoint, and a restarted worker resumes deterministically.  The
+file layout mirrors the cross-replica sharding of the weight update
+(arxiv 2004.13336): each device's shard of every param / opt-state
+leaf lands in that device's own shard file, so a dp=8 save writes 8
+small files in parallel-friendly chunks instead of one monolithic
+gather.
+
+Three phases, only the first on the step path::
+
+    step path          background writer thread
+    ---------          ------------------------------------------
+    ckpt.snapshot  ─▶  ckpt.serialize            ─▶  ckpt.commit
+    (async device-     (np.asarray completes the     (manifest
+     side copy +        copies, per-device shard      written last,
+     D2H launch of      files written + fsynced       tmp dir renamed
+     each unique        to a tmp dir)                 into place)
+     shard)
+
+- **snapshot** gives each leaf a device-side defensive copy
+  (``jnp.copy``, an async dispatch — the step path waits on neither
+  the copy nor the in-flight step that produces the value) and
+  launches ``copy_to_host_async`` on each *unique* shard of the copy
+  (replicated leaves transfer one copy, sharded leaves one slice per
+  owning device).  The copy is a fresh buffer, so the next step
+  donating/invalidating the ORIGINAL param and opt-state buffers
+  cannot touch what the writer reads.
+- **serialize** runs on the writer thread: ``np.asarray`` blocks on
+  the in-flight copies (overlapping subsequent step compute), then
+  writes one ``shard-d<id>.npz`` per owning device, each entry
+  carrying the leaf's **global shape + shard slice** in the manifest
+  so restore can reassemble the global array onto a *different* mesh
+  shape (dp=8 save → dp=1 load).
+- **commit** writes ``manifest.json`` LAST inside the tmp dir (a tmp
+  dir without a manifest is garbage by definition), then publishes via
+  the rename protocol: ``tag`` → ``tag.old``, tmp → ``tag``, drop
+  ``tag.old`` — SOME complete checkpoint is loadable at every instant,
+  even if the process is SIGKILLed between the two renames.
+
+Failure semantics: transient IO errors retry ``MXNET_CKPT_RETRIES``
+times with ``MXNET_CKPT_BACKOFF_MS`` exponential backoff; a save that
+still fails increments ``checkpoint.failures`` telemetry and logs —
+an *async* save never raises into the training step (graceful
+degradation: training outlives a flaky filesystem), a *blocking* save
+raises ``MXNetError`` after the retries are exhausted.
+
+Telemetry (the off-step-path verification signal ROADMAP names):
+``checkpoint.save_ms`` (serialize+commit wall, writer thread),
+``checkpoint.snapshot_ms`` (the only step-path cost),
+``checkpoint.bytes``, ``checkpoint.saves`` / ``checkpoint.failures`` /
+``checkpoint.coalesced``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+
+from . import telemetry
+from . import tracing
+from .base import MXNetError, getenv, getenv_bool
+
+__all__ = ["snapshot", "save", "load", "wait_pending", "Snapshot",
+           "PendingSave", "FORMAT", "MANIFEST"]
+
+FORMAT = "mxnet_tpu-checkpoint-v2"
+MANIFEST = "manifest.json"
+
+# created eagerly so profiler.counters() shows zeros before first save
+_C_SAVES = telemetry.counter("checkpoint.saves")
+_C_FAILURES = telemetry.counter("checkpoint.failures")
+_C_COALESCED = telemetry.counter("checkpoint.coalesced")
+_C_BYTES = telemetry.counter("checkpoint.bytes")
+_H_SAVE_MS = telemetry.histogram("checkpoint.save_ms")
+_H_SNAP_MS = telemetry.histogram("checkpoint.snapshot_ms")
+
+
+def async_enabled() -> bool:
+    """``MXNET_CKPT_ASYNC`` (default on): serialize+publish on the
+    background writer; ``0`` forces every save to block inline."""
+    return getenv_bool("MXNET_CKPT_ASYNC", True)
+
+
+def _retries() -> int:
+    v = getenv("MXNET_CKPT_RETRIES")
+    if v is None or v == "":
+        return 3
+    try:
+        return max(0, int(v))
+    except ValueError:
+        raise MXNetError(
+            f"invalid MXNET_CKPT_RETRIES={v!r}; expected an integer")
+
+
+def _backoff_s() -> float:
+    v = getenv("MXNET_CKPT_BACKOFF_MS")
+    if v is None or v == "":
+        return 0.05
+    try:
+        return max(0.0, float(v)) / 1e3
+    except ValueError:
+        raise MXNetError(
+            f"invalid MXNET_CKPT_BACKOFF_MS={v!r}; expected a number")
+
+
+def _logger():
+    from .log import get_logger
+    return get_logger("mxnet_tpu.checkpoint")
+
+
+# -- snapshot (the only step-path phase) ------------------------------------
+
+class _LeafSnap:
+    """One pytree leaf: global shape/dtype + its unique device shards.
+    ``shards``: [(start, stop, device_id, host-bound array)] where
+    start/stop bound the shard's slice of the global array."""
+
+    __slots__ = ("shape", "dtype", "shards")
+
+    def __init__(self, shape, dtype, shards):
+        self.shape = shape
+        self.dtype = dtype
+        self.shards = shards
+
+
+class Snapshot:
+    """A consistent host-owned copy of one pytree — safe against later
+    donation/mutation of the device buffers it was taken from."""
+
+    def __init__(self, leaves: Dict[str, _LeafSnap], header: dict):
+        self.leaves = leaves
+        self.header = dict(header or {})
+
+    def nbytes(self) -> int:
+        return sum(int(getattr(d, "nbytes", 0))
+                   for leaf in self.leaves.values()
+                   for (_, _, _, d) in leaf.shards)
+
+
+def _unique_shards(arr: "jax.Array"):
+    """The minimal shard set covering ``arr``'s global value: one entry
+    per distinct index slice (replication collapses to one copy; a
+    partitioned sharding yields disjoint slices that tile the array)."""
+    shape = tuple(int(s) for s in arr.shape)
+    out, seen = [], set()
+    for sh in arr.addressable_shards:
+        bounds = tuple(sl.indices(dim) for sl, dim in zip(sh.index, shape))
+        key = tuple((a, b) for a, b, _ in bounds)
+        if key in seen:
+            continue
+        seen.add(key)
+        data = sh.data
+        try:
+            data.copy_to_host_async()   # launch D2H, don't wait
+        except Exception:
+            pass                        # backend without async copy
+        dev = getattr(sh, "device", None)
+        out.append((tuple(a for a, _ in key), tuple(b for _, b in key),
+                    int(getattr(dev, "id", 0)), data))
+    return shape, out
+
+
+# one fused executable copies EVERY jax leaf in a single dispatch (18
+# leaves = 18 eager dispatches ≈ 5ms of step-path overhead otherwise);
+# jit caches per shape/sharding signature.  No donation → XLA outputs
+# are fresh buffers, never aliased to the inputs being protected.
+@jax.jit
+def _copy_leaves(xs):
+    return [jnp.copy(x) for x in xs]
+
+
+def snapshot(tree: Dict[str, Any], header: Optional[dict] = None) -> Snapshot:
+    """Capture ``tree`` (flat name → array) for an async save without
+    waiting on anything.  Each jax leaf gets a *device-side* defensive
+    copy (``jnp.copy`` — an async dispatch ordered after the in-flight
+    step that produces the value, so the step path never blocks on the
+    step's own compute) plus a ``copy_to_host_async`` launch per unique
+    shard of the copy.  The copy is a fresh buffer no optimizer step
+    will ever donate, so the writer thread can materialize it whenever
+    the transfers land — even after the ORIGINAL buffers are donated
+    and invalidated by the very next step.  Accepts jax Arrays,
+    NDArrays, and host arrays (scalars ride along as single host
+    shards)."""
+    t0 = time.perf_counter()
+    with tracing.span("ckpt.snapshot", leaves=len(tree)):
+        leaves = {}
+        jax_named = []
+        for name, arr in tree.items():
+            arr = getattr(arr, "_data", arr)        # NDArray → jax.Array
+            if isinstance(arr, jax.Array) and hasattr(
+                    arr, "addressable_shards"):
+                jax_named.append((name, arr))
+            else:
+                host = onp.asarray(arr)
+                leaves[name] = _LeafSnap(
+                    tuple(host.shape), str(host.dtype),
+                    [(tuple(0 for _ in host.shape),
+                      tuple(host.shape), 0, host)])
+        if jax_named:
+            copies = _copy_leaves([a for _, a in jax_named])
+            for (name, arr), cp in zip(jax_named, copies):
+                shape, shards = _unique_shards(cp)
+                leaves[name] = _LeafSnap(shape, str(arr.dtype), shards)
+    _H_SNAP_MS.observe((time.perf_counter() - t0) * 1e3)
+    return Snapshot(leaves, header)
+
+
+# -- serialize + commit (writer thread) -------------------------------------
+
+def _bits_view(d: onp.ndarray) -> onp.ndarray:
+    """npz-safe view: ml_dtypes (bfloat16, fp8) save as raw void in
+    npz, so store the bit pattern as a uint of the same width."""
+    if d.dtype.kind not in "biufc":
+        return d.view(onp.dtype(f"u{d.dtype.itemsize}"))
+    return d
+
+
+def _np_dtype(name: str) -> onp.dtype:
+    try:
+        return onp.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 (registers bfloat16/fp8 names)
+        return onp.dtype(name)
+
+
+def _serialize(snap: Snapshot, tmp: str) -> int:
+    """Write per-device shard files + manifest (LAST) into ``tmp``.
+    Returns payload bytes written."""
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    by_dev: Dict[int, Dict[str, onp.ndarray]] = {}
+    manifest_leaves: Dict[str, dict] = {}
+    nbytes = 0
+    for name, leaf in snap.leaves.items():
+        entries = []
+        for start, stop, dev, data in leaf.shards:
+            host = _bits_view(onp.asarray(data))
+            arrays = by_dev.setdefault(dev, {})
+            key = f"a{len(arrays)}"                 # unique per file;
+            arrays[key] = host                      # manifest is the map
+            nbytes += int(host.nbytes)
+            entries.append({"file": f"shard-d{dev}.npz", "key": key,
+                            "start": list(start), "stop": list(stop)})
+        manifest_leaves[name] = {"shape": list(leaf.shape),
+                                 "dtype": leaf.dtype, "shards": entries}
+    for dev, arrays in by_dev.items():
+        with open(os.path.join(tmp, f"shard-d{dev}.npz"), "wb") as f:
+            onp.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+    doc = {"format": FORMAT, "header": snap.header,
+           "leaves": manifest_leaves}
+    # manifest written last + fsynced: its presence marks the shard set
+    # complete, so a torn serialize can never masquerade as a checkpoint
+    mpath = os.path.join(tmp, MANIFEST)
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(mpath + ".tmp", mpath)
+    return nbytes
+
+
+def _publish(directory: str, tag: str, tmp: str) -> str:
+    """Atomic rename publish: the previous checkpoint survives as
+    ``tag.old`` until the new one is in place, so a kill between the
+    two renames still leaves a loadable checkpoint (load falls back
+    to ``tag.old``)."""
+    final = os.path.join(directory, tag)
+    backup = os.path.join(directory, f"{tag}.old")
+    if os.path.exists(final):
+        # clear a stale backup only while a live 'final' still covers
+        # us; if a prior crash left ONLY the backup, it stays untouched
+        # until the new publish lands
+        if os.path.exists(backup):
+            shutil.rmtree(backup)
+        os.replace(final, backup)       # keep the old one until...
+    os.replace(tmp, final)              # ...the new one is in place
+    if os.path.exists(backup):
+        shutil.rmtree(backup)
+    return final
+
+
+class PendingSave:
+    """Handle for one submitted save.  ``wait()`` blocks until the
+    checkpoint is published (or the save failed/was coalesced away);
+    ``result()`` additionally raises the failure."""
+
+    def __init__(self, directory: str, tag: str, snap: Snapshot):
+        self.directory = directory
+        self.tag = tag
+        self.snapshot = snap
+        self.path: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.superseded = False
+        self._done = threading.Event()
+
+    def wait(self, timeout: Optional[float] = None) -> Optional[str]:
+        if not self._done.wait(timeout):
+            raise MXNetError(
+                f"checkpoint save of {self.directory!r}:{self.tag!r} "
+                f"did not complete within {timeout}s")
+        return self.path
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        self.wait(timeout)
+        if self.error is not None:
+            raise MXNetError(
+                f"checkpoint save to {os.path.join(self.directory, self.tag)} "
+                f"failed after retries: {self.error}") from self.error
+        if self.superseded:
+            raise MXNetError(
+                "checkpoint save was superseded by a newer save of the "
+                "same tag before it started")
+        return self.path
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+def _run_job(job: PendingSave) -> None:
+    t0 = time.perf_counter()
+    tmp = os.path.join(job.directory, f".{job.tag}.tmp")
+    attempts = _retries() + 1
+    backoff = _backoff_s()
+    for attempt in range(attempts):
+        try:
+            os.makedirs(job.directory, exist_ok=True)
+            with tracing.span("ckpt.serialize", tag=job.tag):
+                nbytes = _serialize(job.snapshot, tmp)
+            with tracing.span("ckpt.commit", tag=job.tag):
+                job.path = _publish(job.directory, job.tag, tmp)
+            _C_SAVES.inc()
+            _C_BYTES.inc(nbytes)
+            _H_SAVE_MS.observe((time.perf_counter() - t0) * 1e3)
+            return
+        except Exception as e:          # noqa: BLE001 — IO layer
+            try:
+                if os.path.exists(tmp):
+                    shutil.rmtree(tmp)
+            except OSError:
+                pass
+            if attempt == attempts - 1:
+                job.error = e
+                _C_FAILURES.inc()
+                _logger().exception(
+                    "checkpoint save to %s failed after %d attempt(s); "
+                    "training continues on the previous checkpoint",
+                    os.path.join(job.directory, job.tag), attempts)
+            else:
+                time.sleep(backoff * (2 ** attempt))
+
+
+# one writer thread per process: saves serialize in submission order,
+# so a blocking save at the end of fit() also drains everything before
+_LOCK = threading.Lock()
+_QUEUE: List[PendingSave] = []
+_PENDING: List[PendingSave] = []
+_WAKE = threading.Condition(_LOCK)
+_writer: Optional[threading.Thread] = None
+
+
+def _writer_loop() -> None:
+    tracing.register_thread("ckpt-writer")
+    while True:
+        with _LOCK:
+            while not _QUEUE:
+                _WAKE.wait()
+            job = _QUEUE.pop(0)
+        if not job.superseded:
+            _run_job(job)
+        job._done.set()
+        with _LOCK:
+            if job in _PENDING:
+                _PENDING.remove(job)
+
+
+def _submit(job: PendingSave) -> None:
+    global _writer
+    with _LOCK:
+        # coalesce: a queued-but-not-started save of the same target is
+        # stale the moment a newer snapshot of it arrives — skip it so a
+        # slow filesystem can't queue unbounded host copies
+        for old in _QUEUE:
+            if (old.directory, old.tag) == (job.directory, job.tag) \
+                    and not old.superseded:
+                old.superseded = True
+                _C_COALESCED.inc()
+        _QUEUE.append(job)
+        _PENDING.append(job)
+        if _writer is None or not _writer.is_alive():
+            _writer = threading.Thread(target=_writer_loop,
+                                       name="ckpt-writer", daemon=True)
+            _writer.start()
+        _WAKE.notify()
+
+
+def save(directory: str, tree: Dict[str, Any],
+         header: Optional[dict] = None, tag: str = "latest",
+         block: Optional[bool] = None) -> PendingSave:
+    """Checkpoint ``tree`` under ``directory/tag``.
+
+    The caller pays only the snapshot (non-blocking D2H launches);
+    serialization and the atomic publish run on the writer thread.
+    ``block=None`` follows ``MXNET_CKPT_ASYNC`` (async by default);
+    ``block=True`` waits for the publish and raises ``MXNetError`` on
+    failure, ``block=False`` returns immediately — a failed async save
+    logs + counts ``checkpoint.failures`` but never raises."""
+    snap = tree if isinstance(tree, Snapshot) else snapshot(tree, header)
+    if header is not None and isinstance(tree, Snapshot):
+        snap.header = dict(header)
+    job = PendingSave(str(directory), str(tag), snap)
+    _submit(job)
+    if block is None:
+        block = not async_enabled()
+    if block:
+        job.result()
+    return job
+
+
+def wait_pending(timeout: Optional[float] = None) -> None:
+    """Block until every submitted save has been published (or failed).
+    Call before process exit so the last async checkpoint lands."""
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        with _LOCK:
+            jobs = list(_PENDING)
+        if not jobs:
+            return
+        for j in jobs:
+            left = None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+            j.wait(left)
+
+
+# -- load -------------------------------------------------------------------
+
+def _read_manifest(path: str) -> dict:
+    mpath = os.path.join(path, MANIFEST)
+    try:
+        with open(mpath) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        raise MXNetError(f"{mpath}: unreadable checkpoint manifest "
+                         f"({e})") from e
+    if doc.get("format") != FORMAT:
+        raise MXNetError(f"{mpath}: unknown checkpoint format "
+                         f"{doc.get('format')!r} (expected {FORMAT!r})")
+    return doc
+
+
+def _assemble(path: str, doc: dict) -> Dict[str, onp.ndarray]:
+    """Reassemble every leaf's GLOBAL array from its shard files —
+    mesh-shape independent: the manifest's slice metadata places each
+    shard regardless of how many devices wrote it."""
+    cache: Dict[str, Any] = {}
+    out: Dict[str, onp.ndarray] = {}
+    try:
+        for name, leaf in doc["leaves"].items():
+            dtype = _np_dtype(leaf["dtype"])
+            arr = onp.empty(tuple(leaf["shape"]), dtype)
+            for shd in leaf["shards"]:
+                z = cache.get(shd["file"])
+                if z is None:
+                    fpath = os.path.join(path, shd["file"])
+                    try:
+                        z = onp.load(fpath, allow_pickle=False)
+                    except MXNetError:
+                        raise
+                    except Exception as e:
+                        raise MXNetError(
+                            f"{fpath}: corrupted or truncated checkpoint "
+                            f"shard file ({type(e).__name__}: {e})") from e
+                    cache[shd["file"]] = z
+                try:
+                    raw = z[shd["key"]]
+                except Exception as e:
+                    raise MXNetError(
+                        f"{os.path.join(path, shd['file'])}: missing or "
+                        f"unreadable shard entry {shd['key']!r} for leaf "
+                        f"{name!r} ({type(e).__name__}: {e})") from e
+                if raw.dtype != dtype:
+                    raw = raw.view(dtype)   # bit-pattern restore
+                sl = tuple(slice(a, b)
+                           for a, b in zip(shd["start"], shd["stop"]))
+                arr[sl] = raw
+            out[name] = arr
+    finally:
+        for z in cache.values():
+            try:
+                z.close()
+            except Exception:
+                pass
+    return out
+
+
+def load(directory: str, tag: str = "latest"
+         ) -> Optional[Tuple[Dict[str, onp.ndarray], dict]]:
+    """Load the published checkpoint at ``directory/tag`` (falling back
+    to ``tag.old`` if a crash interrupted a publish).  Returns
+    ``(leaves, header)`` with every leaf assembled to its GLOBAL host
+    array — re-place under any mesh/sharding you like — or None when
+    no v2 checkpoint exists.  Corruption raises ``MXNetError``."""
+    cands = [os.path.join(str(directory), tag),
+             os.path.join(str(directory), f"{tag}.old")]
+    for i, cand in enumerate(cands):
+        if not os.path.isfile(os.path.join(cand, MANIFEST)):
+            continue
+        try:
+            doc = _read_manifest(cand)
+            leaves = _assemble(cand, doc)
+        except MXNetError:
+            if i == 0 and os.path.isfile(os.path.join(cands[1], MANIFEST)):
+                # a torn primary with an intact backup behind it:
+                # fall back rather than fail the restore
+                continue
+            raise
+        return leaves, dict(doc.get("header") or {})
+    return None

@@ -177,10 +177,15 @@ def _shutdown(stop, q, thread, src_it):
             pass
 
 
-def _produce(src, q, stop, place_fn):
+def _produce(src, q, stop, place_fn, skip=0):
     """Producer loop (module-level: the thread must hold no reference
     to the pipeline object, so an abandoned pipeline can be collected
-    and its finalizer can stop this thread)."""
+    and its finalizer can stop this thread).
+
+    ``skip``: batches to draw from the source and DROP before staging
+    any — deterministic-resume replay (the source's sampler/RNG state
+    advances exactly as in the original run) without paying H2D for
+    batches the resumed run will not train on."""
     def put(item) -> bool:
         # bounded put that stays responsive to shutdown: never blocks
         # forever on a ring the consumer abandoned
@@ -195,6 +200,16 @@ def _produce(src, q, stop, place_fn):
     if tracing.enabled():
         tracing.register_thread()
     try:
+        for _ in range(skip):
+            if stop.is_set():
+                return
+            try:
+                next(src)               # replay, no device staging
+            except StopIteration:
+                put((_DONE, None))
+                return
+        if skip:
+            telemetry.counter("input.replayed").inc(skip)
         while not stop.is_set():
             with tracing.span("input.produce") as sp:
                 try:
@@ -218,11 +233,13 @@ class _EpochPipeline:
     """One epoch's producer thread + bounded device ring.  Created per
     ``__iter__`` so a prefetcher can be re-iterated epoch after epoch."""
 
-    def __init__(self, src_it, place_fn, depth: int, name: str):
+    def __init__(self, src_it, place_fn, depth: int, name: str,
+                 skip: int = 0):
         self._q: _queue.Queue = _queue.Queue(maxsize=depth)
         self._stop = threading.Event()
         self._thread = threading.Thread(
-            target=_produce, args=(src_it, self._q, self._stop, place_fn),
+            target=_produce,
+            args=(src_it, self._q, self._stop, place_fn, skip),
             name=f"DevicePrefetch-{name}", daemon=True)
         # interrupted consumer (break mid-epoch): the for-loop drops its
         # reference and the finalizer stops the thread, drains the ring
@@ -282,6 +299,7 @@ class DevicePrefetcher:
         self._depth = prefetch_depth() if depth is None else max(0, int(depth))
         self._name = name or type(source).__name__
         self._live: Optional[_EpochPipeline] = None
+        self._skip_next = 0
 
     @property
     def depth(self) -> int:
@@ -290,12 +308,28 @@ class DevicePrefetcher:
     def __len__(self):
         return len(self._source)
 
+    def fast_forward(self, n: int) -> None:
+        """Arrange for the NEXT epoch (``__iter__``) to draw and DROP
+        its first ``n`` source batches before staging any on-device —
+        the deterministic-resume replay used by checkpointed training
+        loops (``SPMDTrainer.fit``): the source's sampler/shuffle state
+        advances exactly as in the interrupted run, but the skipped
+        batches pay no H2D transfer."""
+        self._skip_next = max(0, int(n))
+
     def __iter__(self):
+        skip, self._skip_next = self._skip_next, 0
         if self._depth <= 0:
-            return iter(self._source)
+            it = iter(self._source)
+            for _ in range(skip):
+                try:
+                    next(it)            # replay, passthrough path
+                except StopIteration:
+                    break
+            return it
         self.close()   # a fresh epoch retires any abandoned pipeline
         self._live = _EpochPipeline(iter(self._source), self._place_fn,
-                                    self._depth, self._name)
+                                    self._depth, self._name, skip=skip)
         return self._live
 
     # -- io.DataIter protocol parity ------------------------------------

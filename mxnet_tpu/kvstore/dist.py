@@ -794,32 +794,84 @@ class DistKVStore(KVStoreBase):
     _ZERO_MAGIC = b"MXTPU-ZERO1\0"
 
     def save_optimizer_states(self, fname, dump_optimizer=False):
+        """ZeRO-1 server-shard states (or plain updater states) as npz
+        bytes — NO pickle anywhere on the save path, so the file is
+        pure data (aligned with the trainer-states/manifest formats)."""
         if self._optimizer is None and self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
-        import pickle
+        import io
+        import json
         with open(fname, "wb") as f:
             if self._opt_states:
+                arrays = {}
+                keys = []
+                for j, (k, st) in enumerate(self._opt_states.items()):
+                    tup = st if isinstance(st, tuple) else (st,)
+                    ent = {"key": k if isinstance(k, str) else int(k),
+                           "str": isinstance(k, str), "slots": len(tup),
+                           "dtypes": []}
+                    for i, s in enumerate(tup):
+                        d = onp.asarray(s.asnumpy()
+                                        if isinstance(s, NDArray) else s)
+                        ent["dtypes"].append(str(d.dtype))
+                        if d.dtype.kind not in "biufc":
+                            d = d.view(onp.dtype(f"u{d.dtype.itemsize}"))
+                        arrays[f"s{j}::{i}"] = d
+                    keys.append(ent)
+                header = {"format": "mxnet_tpu-zero-states-v1",
+                          "keys": keys}
+                arrays["__header__"] = onp.frombuffer(
+                    json.dumps(header).encode("utf-8"), dtype=onp.uint8)
+                buf = io.BytesIO()
+                onp.savez(buf, **arrays)
                 f.write(self._ZERO_MAGIC)
-                pickle.dump(
-                    {k: tuple(onp.asarray(s.asnumpy()
-                                          if isinstance(s, NDArray) else s)
-                              for s in (st if isinstance(st, tuple)
-                                        else (st,)))
-                     for k, st in self._opt_states.items()}, f)
+                f.write(buf.getvalue())
             else:
                 f.write(self._updater.get_states(dump_optimizer))
 
     def load_optimizer_states(self, fname):
+        """Restore :meth:`save_optimizer_states`.  Only the versioned
+        npz formats load (``allow_pickle=False``) — a legacy pickled
+        file is refused with a clear error instead of executing code
+        from an untrusted checkpoint."""
         if self._optimizer is None and self._updater is None:
             raise MXNetError("no optimizer set on kvstore")
-        import pickle
+        import io
+        import json
         with open(fname, "rb") as f:
             blob = f.read()
         if blob.startswith(self._ZERO_MAGIC):
-            loaded = pickle.loads(blob[len(self._ZERO_MAGIC):])
-            self._opt_states = {
-                k: tuple(NDArray(s) for s in st)
-                for k, st in loaded.items()}
+            try:
+                z = onp.load(io.BytesIO(blob[len(self._ZERO_MAGIC):]),
+                             allow_pickle=False)
+            except Exception as e:
+                raise MXNetError(
+                    f"{fname}: ZeRO optimizer states are not in the "
+                    "mxnet_tpu npz format (legacy pickle-format states "
+                    "are refused — loading pickle can execute arbitrary "
+                    f"code): {e}") from e
+            with z:
+                header = json.loads(
+                    bytes(z["__header__"]).decode("utf-8"))
+                if header.get("format") != "mxnet_tpu-zero-states-v1":
+                    raise MXNetError(
+                        f"{fname}: unknown zero-states format "
+                        f"{header.get('format')!r}")
+                out = {}
+                for j, ent in enumerate(header["keys"]):
+                    k = str(ent["key"]) if ent.get("str") \
+                        else int(ent["key"])
+                    slots = []
+                    for i in range(int(ent["slots"])):
+                        raw = z[f"s{j}::{i}"]
+                        dts = ent.get("dtypes") or []
+                        want = dts[i] if i < len(dts) else None
+                        if want is not None and str(raw.dtype) != want:
+                            import ml_dtypes  # noqa: F401
+                            raw = raw.view(onp.dtype(want))
+                        slots.append(NDArray(raw))
+                    out[k] = tuple(slots)
+                self._opt_states = out
         else:
             self._updater.set_states(blob)
 

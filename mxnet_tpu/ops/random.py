@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 from .registry import register
 
-__all__ = ["seed", "next_key", "current_key"]
+__all__ = ["seed", "next_key", "current_key", "get_state_bits",
+           "set_state_bits"]
 
 _state = threading.local()
 
@@ -52,6 +53,38 @@ def next_key():
 
 def current_key():
     return _get().key
+
+
+def _is_typed_key(k) -> bool:
+    try:
+        return jnp.issubdtype(k.dtype, jax.dtypes.prng_key)
+    except (AttributeError, TypeError):
+        return False
+
+
+def get_state_bits():
+    """The global key chain's raw bit pattern as a host uint32 array —
+    the checkpointable PRNG state (works for both raw uint32 keys and
+    jax's typed PRNG keys)."""
+    k = _get().key
+    if _is_typed_key(k):
+        k = jax.random.key_data(k)
+    import numpy as onp
+    return onp.asarray(k)
+
+
+def set_state_bits(bits) -> None:
+    """Restore the global key chain from :func:`get_state_bits` output
+    (list or array of uint32 words).  A resumed run continues the
+    EXACT key sequence of the saved run — deterministic dropout /
+    shuffle / sampler draws across preemption."""
+    import numpy as onp
+    arr = jnp.asarray(onp.asarray(bits, dtype=onp.uint32))
+    st = _get()
+    if _is_typed_key(st.key):
+        st.key = jax.random.wrap_key_data(arr)
+    else:
+        st.key = arr
 
 
 # -- samplers: fn(key, *, params) -> array ---------------------------------

@@ -817,21 +817,81 @@ class Updater:
         self.optimizer.update_multi(indices, weights, grads,
                                     [self.states[i] for i in indices])
 
+    _STATES_FORMAT = "mxnet_tpu-updater-states-v1"
+
     def get_states(self, dump_optimizer=False):
-        import pickle
-        state_np = {k: tuple(s.asnumpy() for s in v)
-                    for k, v in self.states.items()}
+        """Serialize the state dict as npz bytes with a JSON header —
+        NO pickle, so a checkpoint is pure data (parity surface:
+        updater.py get_states, which pickles; here loading can never
+        execute code).  ``dump_optimizer`` records the optimizer class
+        name in the header instead of pickling the instance."""
+        import io
+        import json
+        arrays = {}
+        keys = []
+        for j, (k, v) in enumerate(self.states.items()):
+            tup = v if isinstance(v, tuple) else (v,)
+            ent = {"key": k if isinstance(k, str) else int(k),
+                   "str": isinstance(k, str), "slots": len(tup),
+                   "tuple": isinstance(v, tuple), "dtypes": []}
+            for i, s in enumerate(tup):
+                d = onp.asarray(s.asnumpy() if hasattr(s, "asnumpy")
+                                else s)
+                ent["dtypes"].append(str(d.dtype))
+                if d.dtype.kind not in "biufc":
+                    # ml_dtypes (bfloat16, fp8): store the bit pattern
+                    d = d.view(onp.dtype(f"u{d.dtype.itemsize}"))
+                arrays[f"s{j}::{i}"] = d
+            keys.append(ent)
+        header = {"format": self._STATES_FORMAT, "keys": keys}
         if dump_optimizer:
-            return pickle.dumps((state_np, type(self.optimizer).__name__))
-        return pickle.dumps(state_np)
+            header["optimizer"] = type(self.optimizer).__name__
+        arrays["__header__"] = onp.frombuffer(
+            json.dumps(header).encode("utf-8"), dtype=onp.uint8)
+        buf = io.BytesIO()
+        onp.savez(buf, **arrays)
+        return buf.getvalue()
 
     def set_states(self, states):
-        import pickle
-        data = pickle.loads(states)
-        if isinstance(data, tuple):
-            data = data[0]
-        self.states = {k: tuple(NDArray(a) for a in v)
-                       for k, v in data.items()}
+        """Restore :meth:`get_states` bytes.  Only the versioned npz
+        format is accepted (``allow_pickle=False``): legacy pickled
+        states are refused with a clear error rather than executing
+        arbitrary code from an untrusted checkpoint."""
+        import io
+        import json
+        from ..base import MXNetError
+        try:
+            z = onp.load(io.BytesIO(states), allow_pickle=False)
+        except Exception as e:
+            raise MXNetError(
+                "optimizer states are not in the mxnet_tpu npz format "
+                "(legacy pickle-format states are refused — loading "
+                f"pickle can execute arbitrary code): {e}") from e
+        with z:
+            if "__header__" not in z:
+                raise MXNetError(
+                    "optimizer states blob has no __header__ entry; "
+                    "not a mxnet_tpu updater-states payload")
+            header = json.loads(bytes(z["__header__"]).decode("utf-8"))
+            if header.get("format") != self._STATES_FORMAT:
+                raise MXNetError(
+                    f"unknown updater-states format "
+                    f"{header.get('format')!r}")
+            states_out = {}
+            for j, ent in enumerate(header["keys"]):
+                k = str(ent["key"]) if ent.get("str") else int(ent["key"])
+                slots = []
+                for i in range(int(ent["slots"])):
+                    raw = z[f"s{j}::{i}"]
+                    want = (ent.get("dtypes") or [])[i] \
+                        if i < len(ent.get("dtypes") or []) else None
+                    if want is not None and str(raw.dtype) != want:
+                        import ml_dtypes  # noqa: F401 (dtype names)
+                        raw = raw.view(onp.dtype(want))
+                    slots.append(NDArray(raw))
+                states_out[k] = tuple(slots) if ent.get("tuple", True) \
+                    else slots[0]
+            self.states = states_out
         self.states_synced = {k: True for k in self.states}
 
 

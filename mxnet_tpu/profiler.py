@@ -110,6 +110,10 @@ def counters() -> Dict[str, Dict[str, int]]:
     - ``tracing``: the span flight recorder (spans recorded / dropped
       to ring-buffer overwrite / currently open, plus stall-watchdog
       dump incidents — mxnet_tpu/tracing.py)
+    - ``checkpoint``: the async checkpoint service (published saves,
+      failed saves after retries, queue-coalesced saves, bytes
+      committed — mxnet_tpu/checkpoint.py; ``failures`` staying 0 is
+      the graceful-degradation invariant)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -147,7 +151,14 @@ def counters() -> Dict[str, Dict[str, int]]:
                 "dropped": tracing.dropped_count(),
                 "open": len(tracing.open_spans()),
                 "watchdog_dumps":
-                    telemetry.counter("watchdog.stall_dumps").value}}
+                    telemetry.counter("watchdog.stall_dumps").value},
+            "checkpoint": {
+                "saves": telemetry.counter("checkpoint.saves").value,
+                "failures":
+                    telemetry.counter("checkpoint.failures").value,
+                "coalesced":
+                    telemetry.counter("checkpoint.coalesced").value,
+                "bytes": telemetry.counter("checkpoint.bytes").value}}
 
 
 def set_config(**kwargs):

@@ -4,7 +4,8 @@ Parity: python/mxnet/random.py (seed, uniform, normal, ...) over the
 kRandom per-device resource; TPU-native state is a jax PRNG key chain
 (mxnet_tpu/ops/random.py).
 """
-from .ops.random import seed, next_key, current_key
+from .ops.random import (seed, next_key, current_key, get_state_bits,
+                         set_state_bits)
 from .ndarray.random import (uniform, normal, randn, gamma, exponential,
                              poisson, negative_binomial,
                              generalized_negative_binomial, randint,
@@ -14,7 +15,8 @@ from .ndarray.random import (uniform, normal, randn, gamma, exponential,
 __all__ = ["seed", "uniform", "normal", "randn", "rand", "gamma", "exponential",
            "poisson", "negative_binomial", "generalized_negative_binomial",
            "randint", "multinomial", "bernoulli", "shuffle", "laplace",
-           "rayleigh", "gumbel", "logistic", "next_key", "current_key"]
+           "rayleigh", "gumbel", "logistic", "next_key", "current_key",
+           "get_state_bits", "set_state_bits"]
 
 
 def rand(*shape, **kwargs):

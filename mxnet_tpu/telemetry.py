@@ -251,6 +251,12 @@ _C_H2D_BYTES = counter("input.h2d_bytes")      # host→device payload bytes
 _C_STEP_H2D = counter("input.step_h2d")        # inline transfers ON the
                                                # step path (0 when fed
                                                # device-committed batches)
+# checkpoint-service health (mxnet_tpu/checkpoint.py writes these off
+# the step path; same registry objects by name, created eagerly for
+# profiler.counters() and the per-step record deltas below)
+_C_CKPT_SAVES = counter("checkpoint.saves")
+_C_CKPT_FAILURES = counter("checkpoint.failures")
+_C_CKPT_BYTES = counter("checkpoint.bytes")
 
 
 def record_compile(seconds: float, kind: str) -> None:
@@ -488,7 +494,8 @@ def enabled() -> bool:
 class _StepToken:
     __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes",
                  "dispatches", "cs_hits", "cs_compiles", "cs_fallbacks",
-                 "cs_breaks", "h2d_bytes")
+                 "cs_breaks", "h2d_bytes", "ckpt_saves", "ckpt_failures",
+                 "ckpt_bytes")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -501,6 +508,9 @@ class _StepToken:
         self.cs_fallbacks = _C_CS_FALLBACKS.value
         self.cs_breaks = _C_CS_BREAKS.value
         self.h2d_bytes = _C_H2D_BYTES.value
+        self.ckpt_saves = _C_CKPT_SAVES.value
+        self.ckpt_failures = _C_CKPT_FAILURES.value
+        self.ckpt_bytes = _C_CKPT_BYTES.value
 
 
 # nesting guard: gluon.Trainer.step pushes through kvstore.pushpull —
@@ -618,6 +628,14 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "compiles": _C_CS_COMPILES.value - token.cs_compiles,
             "fallbacks": _C_CS_FALLBACKS.value - token.cs_fallbacks,
             "graph_breaks": _C_CS_BREAKS.value - token.cs_breaks,
+        },
+        # checkpoint saves PUBLISHED during this step's window (the
+        # writer thread commits off the step path, so these deltas
+        # attribute background IO to wall-clock steps, not cause them)
+        "checkpoint": {
+            "saves": _C_CKPT_SAVES.value - token.ckpt_saves,
+            "failures": _C_CKPT_FAILURES.value - token.ckpt_failures,
+            "bytes": _C_CKPT_BYTES.value - token.ckpt_bytes,
         },
     }
     histogram("step.host_ms").observe(host_ms)

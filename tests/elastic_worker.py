@@ -1,0 +1,146 @@
+"""Subprocess training worker for the elastic kill/restart soak test
+(tests/test_elastic.py and ``ci/run.sh elastic_smoke``).
+
+Runs a small deterministic SPMD training loop with async checkpointing
+and appends one fsync'd JSONL progress line per trained step:
+
+    {"seen": <fit batch index>, "step": <global num_update>,
+     "loss": <float>}
+
+On start it auto-resumes from the last published checkpoint in
+``--ckpt-dir`` (if any) and skips the batches that run already
+consumed — so the parent test can SIGKILL it anywhere, re-launch the
+same command line, and join the two progress streams on ``seen`` to
+assert deterministic resume (overlapping steps must reproduce the
+same losses bit-for-bit on CPU).
+
+Deliberately a standalone script, not a pytest helper import: the soak
+is only honest if the restart is a fresh process (new interpreter, new
+jax runtime, nothing surviving but the published checkpoint files).
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--progress", required=True,
+                    help="JSONL file appended to, one line per step")
+    ap.add_argument("--steps", type=int, default=10,
+                    help="total batches the full run trains")
+    ap.add_argument("--ckpt-every", type=int, default=2)
+    ap.add_argument("--devices", type=int, default=2,
+                    help="virtual CPU device count (dp mesh width)")
+    ap.add_argument("--hidden", type=int, default=16,
+                    help="hidden width (the overhead-gate legs use a "
+                         "bigger model so step compute dominates the "
+                         "fixed per-leaf snapshot cost, as in real "
+                         "training)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--step-sleep", type=float, default=0.0,
+                    help="sleep this long after each step (stretches "
+                         "the run so an external kill -9 lands mid-"
+                         "training; the sleep is outside the timed "
+                         "step window)")
+    ap.add_argument("--kill-after", type=int, default=0,
+                    help="SIGKILL THIS process right after training "
+                         "batch N (a deterministic mid-run crash: no "
+                         "atexit, no writer-thread drain — only "
+                         "already-published checkpoints survive)")
+    ap.add_argument("--no-checkpoint", action="store_true",
+                    help="train without any checkpointing (the baseline "
+                         "leg of the step-overhead gate)")
+    args = ap.parse_args(argv)
+
+    # must happen before jax initializes a backend
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count"
+            f"={args.devices}").strip()
+
+    import numpy as onp
+
+    # runnable from anywhere: the repo root may not be on sys.path in a
+    # bare subprocess (no pytest rootdir injection, no install)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+    mx.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(args.hidden, activation="relu"),
+            nn.Dense(args.hidden, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((2, 8), "float32")))
+    tr = SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                     optimizer="adam",
+                     optimizer_params={"learning_rate": 1e-2},
+                     mesh=make_mesh({"dp": -1}))
+
+    # the dataset is a pure function of this seed — every (re)launch
+    # sees the identical batch sequence, like a seeded shuffled epoch
+    rng = onp.random.RandomState(1)
+    data = [(NDArray(rng.randn(args.batch, 8).astype("float32")),
+             NDArray(rng.randint(0, 4, (args.batch,))
+                     .astype("float32")))
+            for _ in range(args.steps)]
+
+    mx.random.seed(7)               # starting PRNG chain; a restored
+    seen = 0                        # checkpoint overrides both below
+    if not args.no_checkpoint:
+        meta = tr.load_checkpoint(args.ckpt_dir)
+        if meta:
+            seen = int(meta.get("fit_seen", 0))
+            print(f"resumed at seen={seen} num_update={tr.num_update}",
+                  flush=True)
+
+    import time
+    with open(args.progress, "a") as prog:
+        for i in range(seen, args.steps):
+            d, l = data[i]
+            t0 = time.perf_counter()
+            loss = float(tr.step(d, l))
+            if (not args.no_checkpoint and args.ckpt_every
+                    and (i + 1) % args.ckpt_every == 0
+                    and i + 1 < args.steps):
+                tr.save_checkpoint(args.ckpt_dir, block=False,
+                                   meta={"fit_seen": i + 1})
+            # the timed window covers step + async-save submission (the
+            # snapshot cost) but NOT the JSONL bookkeeping below — this
+            # is what the ci elastic_smoke overhead gate compares
+            ms = (time.perf_counter() - t0) * 1e3
+            seen = i + 1
+            # fsync so a SIGKILL right after a step can't lose the line
+            prog.write(json.dumps({"seen": seen,
+                                   "step": int(tr.num_update),
+                                   "loss": loss,
+                                   "ms": round(ms, 4)}) + "\n")
+            prog.flush()
+            os.fsync(prog.fileno())
+            if args.kill_after and seen == args.kill_after:
+                import signal
+                # let queued async saves publish, so the crash point is
+                # "just after a publish" (not a race on writer latency),
+                # then die the hard way — no cleanup of any kind
+                from mxnet_tpu import checkpoint as _ckpt
+                _ckpt.wait_pending()
+                os.kill(os.getpid(), signal.SIGKILL)
+            if args.step_sleep:
+                time.sleep(args.step_sleep)
+    if not args.no_checkpoint:
+        tr.save_checkpoint(args.ckpt_dir, meta={"fit_seen": seen})
+    print(f"done seen={seen} num_update={tr.num_update}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

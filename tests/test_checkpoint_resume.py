@@ -157,3 +157,116 @@ def test_publish_survives_backup_only_state(tmp_path):
     meta = _trainer(seed=3).load_checkpoint(tmp_path)
     assert meta and meta["num_update"] == 2
     assert not os.path.exists(os.path.join(tmp_path, "latest.old"))
+
+
+def test_v2_layout_manifest_and_shards(tmp_path):
+    """The published checkpoint is the v2 sharded layout: per-device
+    shard npz files plus a manifest (written last) that carries the
+    format tag, the header (step counter, PRNG chain, meta), and per
+    leaf the global shape + per-shard slice bounds."""
+    import json
+
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    path = tr.save_checkpoint(tmp_path, meta={"note": "hi"})
+
+    names = sorted(os.listdir(path))
+    assert "manifest.json" in names
+    shard_files = [n for n in names if n.startswith("shard-d")]
+    assert shard_files, names
+    with open(os.path.join(path, "manifest.json")) as f:
+        doc = json.load(f)
+    assert doc["format"] == "mxnet_tpu-checkpoint-v2"
+    assert doc["header"]["num_update"] == 1
+    assert doc["header"]["rng_key"]                 # PRNG chain saved
+    assert doc["header"]["meta"]["note"] == "hi"
+    for k in tr._pkeys:
+        leaf = doc["leaves"][f"param/{k}"]
+        assert tuple(leaf["shape"]) == tuple(tr._params[k].shape)
+        for sh in leaf["shards"]:
+            assert sh["file"] in shard_files
+            assert len(sh["start"]) == len(leaf["shape"])
+
+
+def test_checkpoint_restores_prng_chain(tmp_path):
+    """A restored checkpoint continues the exact global key sequence:
+    draws after load match the draws the saving process would have
+    made next."""
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    mx.random.seed(1234)
+    _ = mx.nd.random.uniform(shape=(3,))     # advance the chain
+    tr.save_checkpoint(tmp_path)
+    expect = mx.nd.random.uniform(shape=(4,)).asnumpy()
+
+    tr2 = _trainer(seed=999)                 # scrambles the chain
+    mx.random.seed(42)
+    assert tr2.load_checkpoint(tmp_path)
+    got = mx.nd.random.uniform(shape=(4,)).asnumpy()
+    onp.testing.assert_array_equal(got, expect)
+
+
+def test_load_states_tolerates_short_dtypes_header(tmp_path):
+    """Regression for the ``[None] * 99`` magic-length hack: a states
+    file whose dtypes header lists FEWER entries than the slot count
+    (any-slot-count optimizer, or an older writer) must still load —
+    missing entries just skip the bit-pattern view."""
+    import json
+
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    fname = os.path.join(tmp_path, "trainer.npz")
+    tr.save_states(fname)
+
+    with onp.load(fname, allow_pickle=False) as z:
+        arrays = {k: z[k] for k in z.files}
+    header = json.loads(bytes(arrays["__header__"]).decode("utf-8"))
+    header["dtypes"] = {k: v[:1] for k, v in header["dtypes"].items()}
+    arrays["__header__"] = onp.frombuffer(
+        json.dumps(header).encode("utf-8"), dtype=onp.uint8)
+    with open(fname, "wb") as f:
+        onp.savez(f, **arrays)
+
+    tr2 = _trainer(seed=31)
+    tr2.load_states(fname)                   # must not IndexError
+    assert tr2.num_update == 1
+    for k in tr._pkeys:
+        for a, b in zip(tr._opt_state[k], tr2._opt_state[k]):
+            onp.testing.assert_allclose(onp.asarray(b), onp.asarray(a),
+                                        rtol=1e-6)
+
+
+def test_updater_states_refuse_pickle(tmp_path):
+    """No load path may execute code from an untrusted checkpoint: the
+    gluon updater refuses legacy pickle-format states outright, and
+    its own npz format round-trips."""
+    import pickle
+
+    net = nn.Dense(4)
+    net.initialize()
+    net(NDArray(onp.zeros((2, 8), "float32")))
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-2})
+    d = NDArray(onp.random.RandomState(0).randn(2, 8).astype("float32"))
+    from mxnet_tpu import autograd
+    with autograd.record():
+        out = (net(d) ** 2).sum()
+    out.backward()
+    tr.step(batch_size=2)
+
+    fname = os.path.join(tmp_path, "updater.states")
+    tr.save_states(fname)
+    with open(fname, "rb") as f:
+        blob = f.read()
+    assert blob[:6] == b"\x93NUMPY" or blob[:2] == b"PK", blob[:8]
+    assert b"c__builtin__" not in blob       # no pickle opcodes
+    tr.load_states(fname)                    # round-trips
+
+    evil = os.path.join(tmp_path, "evil.states")
+    with open(evil, "wb") as f:
+        pickle.dump({"anything": 1}, f)
+    with pytest.raises(mx.MXNetError, match="pickle"):
+        tr.load_states(evil)

@@ -1,0 +1,242 @@
+"""Elastic fault-tolerance: the kill/restart soak (a real SIGKILL mid
+training, real fresh-process restart), resharded restore across mesh
+shapes, and the checkpoint failure-semantics contract (torn publish,
+corrupted shards, async degradation)."""
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_worker.py")
+
+
+def _trainer(seed=0, mesh_axes=None):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((2, 8), "float32")))
+    return SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                       optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-2},
+                       mesh=make_mesh(mesh_axes or {"dp": -1}))
+
+
+def _batches(n=2, bs=16, seed=1):
+    rng = onp.random.RandomState(seed)
+    return [(NDArray(rng.randn(bs, 8).astype("float32")),
+             NDArray(rng.randint(0, 4, (bs,)).astype("float32")))
+            for _ in range(n)]
+
+
+# -- the soak: SIGKILL a real training subprocess, restart it ---------------
+
+def _read_progress(path):
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _worker_cmd(ckpt_dir, progress, steps=10, every=2):
+    return [sys.executable, WORKER, "--ckpt-dir", str(ckpt_dir),
+            "--progress", str(progress), "--steps", str(steps),
+            "--ckpt-every", str(every), "--devices", "2"]
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    # the worker picks its own virtual-device width; don't inherit the
+    # parent test process's 8-device XLA_FLAGS
+    env.pop("XLA_FLAGS", None)
+    return env
+
+
+def test_kill_restart_soak(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    progress = tmp_path / "progress.jsonl"
+    cmd = _worker_cmd(ckpt, progress)
+    env = _worker_env()
+
+    # run 1: trains 5 batches (checkpoints published at seen=2 and 4),
+    # then SIGKILLs itself mid-run — a hard death, nothing drains
+    r1 = subprocess.run(cmd + ["--kill-after", "5"], env=env,
+                        capture_output=True, text=True, timeout=300)
+    assert r1.returncode == -signal.SIGKILL, r1.stdout + r1.stderr
+    assert (ckpt / "latest" / "manifest.json").exists()
+    run1 = _read_progress(progress)
+    assert len(run1) == 5
+
+    # run 2: same command line, fresh process — must resume and finish
+    r2 = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                        timeout=300)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+    assert "resumed at seen=" in r2.stdout
+    run2 = _read_progress(progress)[len(run1):]
+    assert run2, "restarted run trained nothing"
+
+    # resumed from a published checkpoint (first ckpt lands at seen=2),
+    # NOT from scratch — the already-trained prefix was skipped
+    assert run2[0]["seen"] >= 3
+    # global step counter continued where the checkpoint left off
+    assert run2[0]["step"] == run2[0]["seen"]
+
+    # the two runs together cover every batch exactly once (taking the
+    # latest occurrence where the kill window made them overlap)
+    by_seen = {}
+    for rec in run1 + run2:
+        by_seen[rec["seen"]] = rec
+    assert sorted(by_seen) == list(range(1, 11))
+    assert by_seen[10]["step"] == 10
+
+    # deterministic resume: steps both runs trained (after the resume
+    # point, before the kill) reproduce the SAME losses
+    overlap = ({r["seen"] for r in run1} & {r["seen"] for r in run2})
+    assert overlap, "kill landed exactly on a checkpoint boundary"
+    l1 = {r["seen"]: r["loss"] for r in run1}
+    l2 = {r["seen"]: r["loss"] for r in run2}
+    for s in overlap:
+        onp.testing.assert_allclose(l1[s], l2[s], rtol=1e-7)
+
+    # loss parity with an uninterrupted run: a fresh single-process run
+    # over the same schedule produces the same per-batch loss curve
+    ref_progress = tmp_path / "ref.jsonl"
+    ref = subprocess.run(
+        _worker_cmd(tmp_path / "ref_ckpt", ref_progress),
+        env=env, capture_output=True, text=True, timeout=300)
+    assert ref.returncode == 0, ref.stdout + ref.stderr
+    ref_by_seen = {r["seen"]: r["loss"] for r in _read_progress(ref_progress)}
+    assert sorted(ref_by_seen) == list(range(1, 11))
+    for s in range(1, 11):
+        onp.testing.assert_allclose(by_seen[s]["loss"], ref_by_seen[s],
+                                    rtol=1e-7)
+
+
+# -- resharded restore ------------------------------------------------------
+
+def test_resharded_restore_dp2_to_dp1(tmp_path):
+    """A checkpoint saved from a dp=2 mesh restores bit-identically
+    onto a dp=1 trainer (shards carry global shape + slice metadata,
+    so reassembly is mesh-shape independent) — and vice versa."""
+    tr = _trainer(mesh_axes={"dp": 2})
+    for d, l in _batches(3):
+        tr.step(d, l)
+    tr.save_checkpoint(tmp_path)
+
+    tr1 = _trainer(seed=77, mesh_axes={"dp": 1})
+    meta = tr1.load_checkpoint(tmp_path)
+    assert meta and tr1.num_update == 3
+    for k in tr._pkeys:
+        onp.testing.assert_array_equal(
+            tr1._params[k].data().asnumpy(),
+            tr._params[k].data().asnumpy())
+        for a, b in zip(tr._opt_state[k], tr1._opt_state[k]):
+            onp.testing.assert_array_equal(onp.asarray(b), onp.asarray(a))
+
+    # restored trainer trains on its own mesh; and the widened restore
+    # (dp=1 save → dp=4 load) reassembles identically too
+    d, l = _batches(1)[0]
+    tr1.step(d, l)
+    tr1.save_checkpoint(tmp_path / "from_dp1")
+    tr4 = _trainer(seed=5, mesh_axes={"dp": 4})
+    assert tr4.load_checkpoint(tmp_path / "from_dp1")
+    for k in tr1._pkeys:
+        onp.testing.assert_array_equal(
+            tr4._params[k].data().asnumpy(),
+            tr1._params[k].data().asnumpy())
+
+
+# -- failure semantics ------------------------------------------------------
+
+def test_kill_between_publish_renames_leaves_loadable(tmp_path,
+                                                      monkeypatch):
+    """Dying between the two publish renames (old→.old done, tmp→final
+    not) must leave a loadable checkpoint: load falls back to the .old
+    backup."""
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    tr.save_checkpoint(tmp_path)
+    tr.step(d, l)
+
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "0")
+    final = os.path.abspath(os.path.join(tmp_path, "latest"))
+    real_replace = os.replace
+
+    def crash_before_final_rename(src, dst, *a, **kw):
+        if os.path.abspath(dst) == final:
+            raise OSError("simulated SIGKILL between publish renames")
+        return real_replace(src, dst, *a, **kw)
+
+    monkeypatch.setattr(os, "replace", crash_before_final_rename)
+    with pytest.raises(MXNetError):
+        tr.save_checkpoint(tmp_path)        # block=True surfaces it
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    assert not os.path.exists(final)        # genuinely torn state
+    tr2 = _trainer(seed=11)
+    meta = tr2.load_checkpoint(tmp_path)    # falls back to latest.old
+    assert meta and meta["num_update"] == 1
+
+
+def test_corrupted_shard_raises_clear_error(tmp_path):
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    path = tr.save_checkpoint(tmp_path)
+    shards = [f for f in os.listdir(path) if f.startswith("shard-")]
+    assert shards
+    victim = os.path.join(path, shards[0])
+    with open(victim, "r+b") as f:          # truncate mid-file
+        f.truncate(os.path.getsize(victim) // 2)
+    with pytest.raises(MXNetError, match="checkpoint|shard"):
+        _trainer(seed=2).load_checkpoint(tmp_path)
+
+
+def test_truncated_manifest_raises(tmp_path):
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+    path = tr.save_checkpoint(tmp_path)
+    with open(os.path.join(path, "manifest.json"), "w") as f:
+        f.write('{"format": "mxnet_tpu-checkpoint-v2", "leav')
+    with pytest.raises(MXNetError):
+        _trainer(seed=2).load_checkpoint(tmp_path)
+
+
+def test_async_save_failure_degrades_gracefully(tmp_path, monkeypatch):
+    """A failing async save must never raise into the training step:
+    it logs, increments checkpoint.failures, and training continues."""
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "1")
+    monkeypatch.setenv("MXNET_CKPT_BACKOFF_MS", "1")
+    tr = _trainer()
+    d, l = _batches(1)[0]
+    tr.step(d, l)
+
+    blocker = tmp_path / "not_a_dir"
+    blocker.write_text("file where a directory must go")
+    target = blocker / "ckpt"               # mkdir under a file: ENOTDIR
+
+    before = telemetry.counter("checkpoint.failures").value
+    job = tr.save_checkpoint(str(target), block=False)
+    job.wait(timeout=60)
+    assert job.error is not None
+    assert telemetry.counter("checkpoint.failures").value == before + 1
+    tr.step(d, l)                           # training is unaffected
+    assert tr.num_update == 2
+
+    # the same failure surfaces as MXNetError when the caller blocks
+    with pytest.raises(MXNetError):
+        tr.save_checkpoint(str(target), block=True)

@@ -227,7 +227,7 @@ def test_profiler_counters_snapshot():
     c = profiler.counters()
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
-                      "serving", "input"}
+                      "serving", "input", "tracing", "checkpoint"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
     assert set(c["cached_step"]) == {"captures", "compiles", "hits",
@@ -239,6 +239,10 @@ def test_profiler_counters_snapshot():
     assert set(c["serving"]) == {"requests", "batches", "eager_batches",
                                  "compiles", "rejects", "timeouts"}
     assert set(c["input"]) == {"wait_ms", "h2d_bytes", "step_h2d"}
+    assert set(c["tracing"]) == {"spans", "dropped", "open",
+                                 "watchdog_dumps"}
+    assert set(c["checkpoint"]) == {"saves", "failures", "coalesced",
+                                    "bytes"}
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100
     assert profiler.counters()["fused_step"]["steps"] != \

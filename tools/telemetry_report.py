@@ -8,7 +8,10 @@ collective bytes per step — the three first-order XLA health signals.
 Runs that served inference (records with a ``serving`` payload, emitted
 by serving/batcher.py per coalesced dispatch) get a second section:
 request p50/p95 latency, mean batch occupancy, padding-waste %, and
-reject/timeout totals — reconciled from the SAME JSONL stream.
+reject/timeout totals — reconciled from the SAME JSONL stream.  Runs
+that checkpointed (records with a ``checkpoint`` delta payload) get a
+section: saves published, failed saves, bytes committed — the
+``failures`` total staying 0 is the async-save health signal.
 
 Usage:
     python tools/telemetry_report.py run.jsonl
@@ -94,6 +97,22 @@ def summarize(records):
         "h2d_bytes": h2d_total,
         "h2d_bytes_per_step": h2d_total / len(records) if records else 0,
     }
+    # checkpoint-service deltas (async saves publish off the step path;
+    # a record's delta counts commits that LANDED during that step's
+    # window).  Section only renders for runs that checkpointed.
+    ck = [r["checkpoint"] for r in records
+          if isinstance(r.get("checkpoint"), dict)]
+    ck_saves = sum(c.get("saves", 0) for c in ck)
+    ckpt = None
+    if ck_saves or any(c.get("failures", 0) for c in ck):
+        ck_bytes = sum(c.get("bytes", 0) for c in ck)
+        ckpt = {
+            "saves": ck_saves,
+            "failures": sum(c.get("failures", 0) for c in ck),
+            "bytes": ck_bytes,
+            "bytes_per_save": ck_bytes / ck_saves if ck_saves else 0,
+            "steps_with_commit": sum(1 for c in ck if c.get("saves", 0)),
+        }
     srv = [r["serving"] for r in records
            if isinstance(r.get("serving"), dict) and "error" not in
            r["serving"]]
@@ -132,6 +151,7 @@ def summarize(records):
         "peak_device_bytes": peak_mem,
         "input": input_stats,
         "serving": serving,
+        "checkpoint": ckpt,
     }
 
 
@@ -271,6 +291,18 @@ def render(s):
             f"{inp['input_bound_steps']:>24}",
             f"{'input-bound %':<28}"
             f"{inp['input_bound_pct']:>22.1f} ({verdict})",
+        ]
+    ck = s.get("checkpoint")
+    if ck:
+        lines += [
+            "",
+            "Checkpointing (async sharded saves)",
+            "-" * 52,
+            f"{'saves published':<28}{ck['saves']:>24}",
+            f"{'failed saves':<28}{ck['failures']:>24}",
+            f"{'bytes committed':<28}{ck['bytes']:>24}",
+            f"{'bytes / save':<28}{ck['bytes_per_save']:>24.1f}",
+            f"{'steps with a commit':<28}{ck['steps_with_commit']:>24}",
         ]
     srv = s.get("serving")
     if srv:
