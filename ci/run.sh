@@ -261,6 +261,18 @@ PY
     rm -rf "$tmp"
 }
 
+zero_smoke() {        # ZeRO-1 sharded update: tests + memory/time gates
+    # tier-1 covers dp=2 equivalence, env gating, checkpoint resharding
+    # across dp=1/2/4, eager bitwise parity and the 1-dispatch cached
+    # capture
+    JAX_PLATFORMS=cpu python -m pytest tests/test_zero_sharding.py \
+        tests/test_zero_gluon.py -q
+    # then the bench must show per-device opt-state <=0.6x replicated
+    # with median step <=1.15x on the dp=2 CPU mesh (exits non-zero
+    # otherwise)
+    JAX_PLATFORMS=cpu python benchmark/zero_bench.py --smoke
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

@@ -36,6 +36,18 @@ blocked time into ``input.wait_ms``.  Both surface per step as the
 ``h2d_bytes`` / ``input_wait_ms`` fields of the telemetry step record,
 which is how ``tools/telemetry_report.py`` classifies a run as
 input-bound vs compute-bound.
+
+Window staging (``window=n_steps``): instead of one batch per item,
+the producer host-stacks ``n_steps`` consecutive batches into a single
+window tree whose leaves carry a leading ``n_steps`` axis, and commits
+each window under the consumer's ``_window_sharding`` (step axis
+replicated, batch/seq axes shifted right by one).  That is exactly the
+layout ``SPMDTrainer.run_steps(..., per_step_data=True)`` declares for
+its fused ``lax.scan`` window, so the whole window lands on-device once
+and the scan consumes one batch per step with zero per-step H2D — the
+device-side counterpart of the one-launch-per-window training loop.  A
+trailing partial window (fewer than ``n_steps`` batches left in the
+epoch) is dropped and counted in ``input.window_dropped``.
 """
 from __future__ import annotations
 
@@ -148,6 +160,71 @@ def _place_tree(batch, place_fn):
                            provide_label=batch.provide_label)
         return placed, nbytes[0]
     return place(batch), nbytes[0]
+
+
+def _to_host(leaf):
+    from ..ndarray import NDArray
+    if isinstance(leaf, NDArray):
+        leaf = leaf._data
+    return onp.asarray(leaf)
+
+
+def _stack_window(batches):
+    """Stack ``n_steps`` structurally-identical batch trees into one
+    window tree: every array leaf gains a leading ``n_steps`` axis
+    (host-side ``onp.stack``); non-array payloads keep the first
+    batch's value.  The stacked tree then rides through
+    :func:`_place_tree` as one item, so a window pays exactly one
+    ``device_put`` per leaf."""
+    from ..ndarray import NDArray
+
+    def stack(items):
+        x0 = items[0]
+        if isinstance(x0, tuple):
+            return tuple(stack([it[i] for it in items])
+                         for i in range(len(x0)))
+        if isinstance(x0, list):
+            return [stack([it[i] for it in items]) for i in range(len(x0))]
+        if isinstance(x0, dict):
+            return {k: stack([it[k] for it in items]) for k in x0}
+        if isinstance(x0, (NDArray, jax.Array, onp.ndarray)):
+            return onp.stack([_to_host(it) for it in items])
+        return x0
+
+    if type(batches[0]).__name__ == "DataBatch" \
+            and hasattr(batches[0], "data"):
+        from ..io.io import DataBatch
+        return DataBatch(stack([b.data for b in batches]),
+                         stack([b.label for b in batches]),
+                         pad=batches[0].pad, index=batches[0].index,
+                         provide_data=batches[0].provide_data,
+                         provide_label=batches[0].provide_label)
+    return stack(batches)
+
+
+def _window_iter(src, window: int):
+    """Regroup a batch iterator into whole ``window``-step windows; a
+    trailing partial window is dropped (counted in
+    ``input.window_dropped``) so every staged item matches the fused
+    multi-step executable's fixed ``n_steps``."""
+    buf = []
+    try:
+        for batch in src:
+            buf.append(batch)
+            if len(buf) == window:
+                yield _stack_window(buf)
+                buf = []
+        if buf:
+            telemetry.counter("input.window_dropped").inc(len(buf))
+    finally:
+        # a generator.close() on this iterator (pipeline shutdown) must
+        # reach the wrapped source's own teardown (DataLoader shm drain)
+        close = getattr(src, "close", None)
+        if close is not None:
+            try:
+                close()
+            except Exception:
+                pass
 
 
 def _shutdown(stop, q, thread, src_it):
@@ -290,37 +367,65 @@ class DevicePrefetcher:
         Batches kept in flight on-device; default
         ``MXNET_DEVICE_PREFETCH`` (2).  0 disables: iteration passes the
         source through untouched (bitwise-identical eager path).
+    window : int, optional
+        Stage whole ``window``-step windows instead of single batches:
+        each item is ``window`` consecutive source batches host-stacked
+        along a new leading step axis and committed under the
+        consumer's ``_window_sharding`` (when it declares one) — the
+        input layout of ``SPMDTrainer.run_steps(per_step_data=True)``.
+        A trailing partial window is dropped (``input.window_dropped``).
+        Windowing applies even at ``depth=0`` (host-stacked, staged
+        inline by the consumer).
     """
 
     def __init__(self, source: Iterable, sharding: Any = None,
-                 depth: Optional[int] = None, name: Optional[str] = None):
+                 depth: Optional[int] = None, name: Optional[str] = None,
+                 window: Optional[int] = None):
         self._source = source
-        self._place_fn = _placement_of(sharding)
+        self._window = 1 if window is None else max(1, int(window))
+        if self._window > 1 and hasattr(sharding, "_window_sharding"):
+            # SPMDTrainer window layout: leading n_steps axis replicated,
+            # batch/seq mesh axes shifted right by one
+            self._place_fn = lambda leaf: sharding._window_sharding(leaf.ndim)
+        else:
+            self._place_fn = _placement_of(sharding)
         self._depth = prefetch_depth() if depth is None else max(0, int(depth))
         self._name = name or type(source).__name__
         self._live: Optional[_EpochPipeline] = None
+        self._plain = None
         self._skip_next = 0
 
     @property
     def depth(self) -> int:
         return self._depth
 
+    @property
+    def window(self) -> int:
+        return self._window
+
     def __len__(self):
-        return len(self._source)
+        n = len(self._source)
+        return n // self._window if self._window > 1 else n
 
     def fast_forward(self, n: int) -> None:
         """Arrange for the NEXT epoch (``__iter__``) to draw and DROP
-        its first ``n`` source batches before staging any on-device —
-        the deterministic-resume replay used by checkpointed training
+        its first ``n`` items before staging any on-device — the
+        deterministic-resume replay used by checkpointed training
         loops (``SPMDTrainer.fit``): the source's sampler/shuffle state
         advances exactly as in the interrupted run, but the skipped
-        batches pay no H2D transfer."""
+        items pay no H2D transfer.  With ``window > 1`` an item is a
+        whole window, so ``n`` counts WINDOWS (= resumed ``run_steps``
+        calls), not individual batches."""
         self._skip_next = max(0, int(n))
+
+    def _source_iter(self):
+        it = iter(self._source)
+        return _window_iter(it, self._window) if self._window > 1 else it
 
     def __iter__(self):
         skip, self._skip_next = self._skip_next, 0
         if self._depth <= 0:
-            it = iter(self._source)
+            it = self._source_iter()
             for _ in range(skip):
                 try:
                     next(it)            # replay, passthrough path
@@ -328,14 +433,20 @@ class DevicePrefetcher:
                     break
             return it
         self.close()   # a fresh epoch retires any abandoned pipeline
-        self._live = _EpochPipeline(iter(self._source), self._place_fn,
+        self._live = _EpochPipeline(self._source_iter(), self._place_fn,
                                     self._depth, self._name, skip=skip)
         return self._live
 
     # -- io.DataIter protocol parity ------------------------------------
     def __next__(self):
         if self._depth <= 0:
-            return next(iter(self._source))
+            if self._plain is None:
+                self._plain = self.__iter__()
+            try:
+                return next(self._plain)
+            except StopIteration:
+                self._plain = None
+                raise
         if self._live is None:
             self.__iter__()
         return next(self._live)
@@ -347,6 +458,7 @@ class DevicePrefetcher:
         """DataIter parity: tear down the in-flight epoch and reset the
         source so the next iteration starts fresh."""
         self.close()
+        self._plain = None
         reset = getattr(self._source, "reset", None)
         if reset is not None:
             reset()
@@ -359,7 +471,7 @@ class DevicePrefetcher:
 
 
 def wrap(source: Iterable, consumer: Any = None,
-         depth: Optional[int] = None):
+         depth: Optional[int] = None, window: Optional[int] = None):
     """Wrap ``source`` in a :class:`DevicePrefetcher` targeting
     ``consumer``'s declared batch sharding.
 
@@ -370,8 +482,16 @@ def wrap(source: Iterable, consumer: Any = None,
     None (default device).  With ``MXNET_DEVICE_PREFETCH=0`` (or
     ``depth=0``) the source is returned **unchanged** — the untouched
     eager path, bitwise identical.
+
+    ``window=n_steps`` stages whole multi-step windows pre-sharded for
+    ``SPMDTrainer.run_steps(..., per_step_data=True)`` — see
+    :class:`DevicePrefetcher`.  Windowing is structural (the consumer
+    expects ``(n_steps, batch, ...)`` leaves), so it applies even when
+    prefetch is disabled: at ``depth=0`` the wrapper still regroups the
+    source into host-stacked windows, it just stages nothing on-device.
     """
     d = prefetch_depth() if depth is None else max(0, int(depth))
-    if d <= 0:
+    w = 1 if window is None else max(1, int(window))
+    if d <= 0 and w <= 1:
         return source
-    return DevicePrefetcher(source, sharding=consumer, depth=d)
+    return DevicePrefetcher(source, sharding=consumer, depth=d, window=w)

@@ -25,7 +25,7 @@ __all__ = ["Trainer"]
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None,
                  kvstore="device", compression_params=None,
-                 update_on_kvstore=None):
+                 update_on_kvstore=None, zero=None):
         if isinstance(params, (dict, ParameterDict)):
             param_list = []
             for key in sorted(params.keys()):
@@ -54,6 +54,19 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._distributed = False
+        # ZeRO-1 weight-update sharding (optimizer/fused_step.py):
+        # None defers to MXNET_ZERO, re-read per step so long-lived
+        # processes can toggle it; an explicit 0/1 pins the choice
+        self._zero = zero
+
+    def _zero_active(self):
+        """True when this step's fused update should shard over the dp
+        mesh (ZeRO-1).  Worker-side updates only — server-side
+        (update_on_kvstore) optimizers keep their own layout."""
+        from ..optimizer import fused_step
+        if self._zero is None:
+            return fused_step.zero_enabled()
+        return bool(self._zero)
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: p for i, p in enumerate(self._params)}
@@ -331,7 +344,8 @@ class Trainer:
         # skip the identity reduce entirely (_fold_device_allreduce)
         from ..optimizer import fused_step
         if fused_step.step(updater,
-                           [(i, p._data_nd(), p.grad()) for i, p in live]):
+                           [(i, p._data_nd(), p.grad()) for i, p in live],
+                           zero=self._zero_active()):
             return
         agg = getattr(self._optimizer, "aggregate_num", 0)
         if agg and agg > 1:

@@ -231,7 +231,7 @@ class _Structure:
     __slots__ = ("steps", "out_shdty", "ext_specs", "diff_idx", "frozen_idx",
                  "param_shdty", "frozen_shdty", "heads", "head_shdty",
                  "head_seed_ext", "statics_key", "dyn_names", "op_name",
-                 "opt_type", "training", "bwd_train", "key")
+                 "opt_type", "training", "bwd_train", "zero_ndev", "key")
 
 
 class _Obs:
@@ -929,12 +929,26 @@ def _build_structure(obs, trainer, ignore_stale_grad):
     stt.opt_type = type(opt).__name__
     stt.training = obs.training
     stt.bwd_train = obs.bwd_train
+    # ZeRO-1: when the trainer's fused update is dp-sharded, the whole
+    # captured step compiles mesh-wide with flat dp-sharded optimizer
+    # state — the sharded update stays inside the ONE executable, so
+    # the dispatch count is still 1.  The width is part of the key (an
+    # MXNET_ZERO flip recaptures rather than replays a stale layout).
+    zero_ndev = 0
+    if getattr(trainer, "_zero_active", None) is not None \
+            and trainer._zero_active():
+        from ..optimizer import fused_step as _fs
+        nd_ = _fs.zero_degree()
+        if nd_ > 1:
+            zero_ndev = nd_
+    stt.zero_ndev = zero_ndev
     stt.key = (tuple(key_steps),
                tuple(zip(heads, head_seed_ext)),
                stt.ext_specs,
                tuple(zip(diff_idx, param_shdty)),
                tuple(zip(frozen_idx, frozen_shdty)),
-               (stt.opt_type, stt.op_name, statics_key, dyn_names),
+               (stt.opt_type, stt.op_name, statics_key, dyn_names,
+                zero_ndev),
                obs.training, obs.bwd_train,
                _reg._env_numerics_key())
     return stt, None
@@ -947,8 +961,15 @@ def _build_step_fn(stt):
     function of (dyn, ext, frozen, weights, states); weights and states
     donated."""
     from ..optimizer import fused_step
-    update_fn = fused_step.make_update_fn(stt.op_name, stt.statics_key,
-                                          stt.dyn_names)
+    zero = stt.zero_ndev > 1
+    if zero:
+        from ..parallel.mesh import default_mesh
+        mesh = default_mesh()
+        update_fn = fused_step.make_sharded_update_fn(
+            stt.op_name, stt.statics_key, stt.dyn_names, mesh)
+    else:
+        update_fn = fused_step.make_update_fn(stt.op_name, stt.statics_key,
+                                              stt.dyn_names)
     steps = stt.steps
     heads = stt.heads
     seeds = stt.head_seed_ext
@@ -989,6 +1010,19 @@ def _build_step_fn(stt):
         new_w, new_s = update_fn(dyn, weights, grads, states)
         return new_w, new_s, grads, flat
 
+    if zero:
+        # mesh-wide compile: everything replicated except the flat
+        # dp-sharded optimizer state; the forward replays redundantly
+        # per replica (wall-time-neutral on parallel hardware) while
+        # the update runs on each replica's 1/dp slice.  Donation
+        # covers the caller's broadcast weight temps and the states.
+        from jax.sharding import NamedSharding, PartitionSpec
+        rep = NamedSharding(mesh, PartitionSpec())
+        shd = NamedSharding(mesh, PartitionSpec("dp"))
+        return jax.jit(step_fn,
+                       in_shardings=(rep, rep, rep, rep, shd),
+                       out_shardings=(rep, shd, rep, rep),
+                       donate_argnums=(3, 4))
     return jax.jit(step_fn, donate_argnums=(3, 4))
 
 
@@ -1041,6 +1075,14 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
         if gnd._data is not ph:
             _break(ctx, "gradient buffer changed between backward and step")
             return False
+    zero = stt.zero_ndev > 1
+    if zero != (getattr(trainer, "_zero_active", None) is not None
+                and trainer._zero_active()
+                and fused_step.zero_degree() > 1):
+        # MXNET_ZERO flipped since capture; the eager fallback's own
+        # fused step (or its unshard) handles the new layout
+        _break(ctx, "zero sharding toggled since capture")
+        return False
     # state creation mirrors the eager Updater / fused_step
     for i in stt.diff_idx:
         if i not in updater.states:
@@ -1048,6 +1090,17 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
                 i, params[i]._data_nd())
             updater.states_synced[i] = True
     states = [updater.states[i] for i in stt.diff_idx]
+    if zero:
+        # same eligibility as fused_step's sharded path: flat sharding
+        # only preserves the rule for weight-shaped slots
+        meta = fused_step._zero_meta(updater)
+        for k, i in enumerate(stt.diff_idx):
+            if i not in meta and any(
+                    tuple(s.shape) != stt.param_shdty[k][0]
+                    for s in states[k]):
+                _break(ctx, "optimizer state not weight-shaped "
+                            "(sharded update)")
+                return False
     # donation safety: a repeated donated buffer is an XLA error
     seen = set()
     for w in weights_nd:
@@ -1071,6 +1124,20 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
     ext_t = tuple(ctx.ext_vals)
     frozen_t = tuple(ctx.frozen_arrays)
     weights_t = tuple(w._data for w in weights_nd)
+    dev0 = rep = None
+    if zero:
+        # broadcast the single-device inputs to the mesh as replicated
+        # TEMPS (AOT-compiled executables don't reshard arguments) and
+        # migrate optimizer state to the flat dp-sharded layout; the
+        # caller's own dev0 weight buffers are never donated
+        from ..parallel.mesh import default_mesh
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = default_mesh()
+        fused_step.shard_states(updater, stt.diff_idx, mesh)
+        rep = NamedSharding(mesh, PartitionSpec())
+        dev0 = next(iter(weights_t[0].devices()))
+        ext_t, frozen_t, weights_t = jax.device_put(
+            (ext_t, frozen_t, weights_t), rep)
     states_t = tuple(tuple(s._data for s in sts) for sts in states)
 
     fresh = ent.compiled is None
@@ -1080,6 +1147,8 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
         dyn0 = [opt._fused_dynamics(i) for i in stt.diff_idx]
         dyn_probe = tuple(jnp.asarray([d[nm] for d in dyn0], jnp.float32)
                           for nm in stt.dyn_names)
+        if zero:
+            dyn_probe = jax.device_put(dyn_probe, rep)
         t0 = _time.perf_counter()
         try:
             with tracing.span("compile.cached_step"):
@@ -1107,6 +1176,8 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
     dyns = [opt._fused_dynamics(i) for i in stt.diff_idx]
     dyn = tuple(jnp.asarray([d[nm] for d in dyns], jnp.float32)
                 for nm in stt.dyn_names)
+    if zero:
+        dyn = jax.device_put(dyn, rep)
 
     from .. import profiler
     tp = profiler.op_timer()
@@ -1130,6 +1201,19 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
     from ..optimizer.optimizer import _note_dispatch
     _note_dispatch()
     profiler.op_record(f"CachedStep::{stt.opt_type}", tp)
+    if zero:
+        # back to the eager device: placeholder fills, grad buffers and
+        # rebound weights must stay single-device so eager ops outside
+        # the captured step never meet mesh-committed arrays
+        new_w, grads, flat = jax.device_put((new_w, grads, flat), dev0)
+        frac = (stt.zero_ndev - 1) / stt.zero_ndev
+        telemetry.record_comm_bytes(
+            int(sum(g.nbytes for g in grads) * frac), "reduce_scatter")
+        telemetry.record_comm_bytes(
+            int(sum(w.nbytes for w in new_w) * frac), "all_gather")
+    telemetry.record_opt_state_bytes(
+        fused_step.opt_state_bytes_per_device(
+            s for sts in new_s for s in sts))
 
     # fill every placeholder (tape order == flat order)
     k = 0

@@ -22,6 +22,17 @@ discipline — after ``_MAX_JIT_SIGS`` distinct shape signatures (env
 ``MXNET_JIT_MAX_SIGS``) or a trace failure the family latches off and
 callers fall back to the per-param/aggregate path.  ``MXNET_FUSED_STEP=0``
 disables fusion entirely.
+
+ZeRO-1 weight-update sharding (``MXNET_ZERO=1`` / ``Trainer(zero=1)``,
+arxiv 2004.13336): ``make_sharded_update_fn`` is the flat/padded
+variant of the update — optimizer state lives permanently as flat
+dp-sharded vectors (per-device state memory ~1/dp), each replica
+updates only its slice, and the updated weight is all-gathered back to
+the param shape inside the SAME single executable, so the dispatch
+count stays 1.  Numerics stay bitwise-identical for elementwise update
+rules: padding with zeros and slicing never alters the surviving
+elements.  Any decline restores the original param-shaped state layout
+before the fallback runs (``unshard_states``).
 """
 from __future__ import annotations
 
@@ -40,13 +51,17 @@ from ..ops import registry as _reg
 from .optimizer import Updater, _lowp_guard, _note_dispatch
 
 __all__ = ["step", "enabled", "stats", "reset_stats", "reset_cache",
-           "make_update_fn"]
+           "make_update_fn", "make_sharded_update_fn", "zero_enabled",
+           "zero_degree", "shard_states", "unshard_states",
+           "opt_state_bytes_per_device"]
 
 # jit-cache counters (surfaced by profiler.counters()).
 # compiles/hits count fused executions by cache outcome; fallbacks count
 # step() calls that declined (ineligible, latched, or trace failure);
-# steps counts successful fused applications.
-_STATS = {"compiles": 0, "hits": 0, "fallbacks": 0, "steps": 0}
+# steps counts successful fused applications; zero_steps the subset
+# that ran the dp-sharded (ZeRO-1) update.
+_STATS = {"compiles": 0, "hits": 0, "fallbacks": 0, "steps": 0,
+          "zero_steps": 0}
 
 
 def stats() -> Dict[str, int]:
@@ -123,8 +138,192 @@ def _build(op_name: str, statics_key: Tuple, dyn_names: Tuple[str, ...],
                    donate_argnums=(1, 3) if donate_weights else (3,))
 
 
+# -- ZeRO-1 weight-update sharding (arxiv 2004.13336) ------------------------
+
+
+def zero_enabled() -> bool:
+    """MXNET_ZERO: set to 1/true/on to shard the weight update over the
+    dp mesh axis (read per step, same live-toggle discipline as
+    MXNET_FUSED_STEP)."""
+    return os.environ.get("MXNET_ZERO", "0").lower() in ("1", "true", "on")
+
+
+def _zero_mesh():
+    from ..parallel.mesh import default_mesh
+    return default_mesh()
+
+
+def zero_degree(mesh=None) -> int:
+    """The dp width a sharded update would split over (1 = sharding is
+    a no-op and callers should stay on the replicated path)."""
+    if mesh is None:
+        mesh = _zero_mesh()
+    return int(mesh.shape.get("dp", 1))
+
+
+def make_sharded_update_fn(op_name: str, statics_key: Tuple,
+                           dyn_names: Tuple[str, ...], mesh):
+    """ZeRO-1 variant of :func:`make_update_fn`: the same update rule,
+    but optimizer state travels as flat vectors zero-padded to a
+    multiple of the dp width and sharded ``PartitionSpec('dp')``.
+    Weights/grads come in param-shaped (replicated); inside the trace
+    each is flattened, padded, and pinned to the dp layout — the
+    reduce-scatter point (for an already-reduced replicated gradient it
+    degenerates to taking the local slice) — so every elementwise op of
+    the update runs on 1/dp of the elements per device.  Un-padding and
+    reshaping the updated flat weight back to the param shape is the
+    all-gather point.  Zero-padding preserves elementwise update
+    semantics exactly, and reshape-invariant reductions (LAMB/LARS
+    norms) only ever add zeros to their sums."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    ndev = int(mesh.shape["dp"])
+    shd = NamedSharding(mesh, PartitionSpec("dp"))
+    base_fn = _lowp_guard(_reg.get(op_name).fn)
+    statics = dict(statics_key)
+
+    def fused(dyn, weights, grads, states):
+        new_w, new_s = [], []
+        for i, w in enumerate(weights):
+            kw = dict(statics)
+            for j, nm in enumerate(dyn_names):
+                kw[nm] = dyn[j][i]
+            pad = (-w.size) % ndev
+            wf = w.reshape(-1)
+            gf = grads[i].reshape(-1)
+            if pad:
+                wf = jnp.concatenate([wf, jnp.zeros((pad,), wf.dtype)])
+                gf = jnp.concatenate([gf, jnp.zeros((pad,), gf.dtype)])
+            wf = jax.lax.with_sharding_constraint(wf, shd)
+            gf = jax.lax.with_sharding_constraint(gf, shd)
+            out = base_fn(wf, gf, *states[i], **kw)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            new_w.append(outs[0][:w.size].reshape(w.shape))
+            new_s.append(tuple(outs[1:]))
+        return tuple(new_w), tuple(new_s)
+
+    return fused
+
+
+def _build_sharded(op_name: str, statics_key: Tuple,
+                   dyn_names: Tuple[str, ...], mesh):
+    """One mesh-wide executable for the whole parameter set.  Weights
+    and grads arrive as replicated broadcast TEMPS (the caller's real
+    single-device buffers are never donated — aliased-weight callers
+    are always safe on this path); states arrive flat dp-sharded.  The
+    weight temp (arg 1) and states (arg 3) are donated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    fused = make_sharded_update_fn(op_name, statics_key, dyn_names, mesh)
+    rep = NamedSharding(mesh, PartitionSpec())
+    shd = NamedSharding(mesh, PartitionSpec("dp"))
+    return jax.jit(fused,
+                   in_shardings=(rep, rep, rep, shd),
+                   out_shardings=(rep, shd),
+                   donate_argnums=(1, 3))
+
+
+def _zero_meta(updater) -> Dict[Any, Tuple]:
+    """index → per-slot ORIGINAL shapes for states currently held in
+    the flat dp-sharded layout.  Lives on the updater so save/restore
+    (Updater.get_states) and the fallback paths can undo the layout."""
+    meta = getattr(updater, "_zero_states", None)
+    if meta is None:
+        meta = updater._zero_states = {}
+    return meta
+
+
+def shard_states(updater, indices, mesh) -> None:
+    """Migrate param-shaped optimizer state to the flat, padded,
+    dp-sharded layout (idempotent per index).  This is also how a
+    REPLICATED checkpoint enters a ZeRO run: set_states lands
+    param-shaped slots, and the next sharded step flattens them here."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    ndev = int(mesh.shape["dp"])
+    shd = NamedSharding(mesh, PartitionSpec("dp"))
+    meta = _zero_meta(updater)
+    for i in indices:
+        if i in meta:
+            continue
+        sts = updater.states[i]
+        tup = sts if isinstance(sts, tuple) else (sts,)
+        shapes = []
+        for s in tup:
+            shapes.append(tuple(int(d) for d in s.shape))
+            flat = s._data.reshape(-1)
+            pad = (-flat.size) % ndev
+            if pad:
+                flat = jnp.concatenate(
+                    [flat, jnp.zeros((pad,), flat.dtype)])
+            s._rebind(jax.device_put(flat, shd))
+        meta[i] = tuple(shapes)
+
+
+def unshard_states(updater, device=None) -> None:
+    """Restore flat dp-sharded optimizer state to its original param
+    shapes on ``device`` (default: jax's first device).  Called before
+    any non-sharded path touches the states — the eager per-param
+    update, aggregate updates, and the replicated fused path all expect
+    param-shaped slots."""
+    meta = getattr(updater, "_zero_states", None)
+    if not meta:
+        return
+    if device is None:
+        device = jax.devices()[0]
+    for i, shapes in list(meta.items()):
+        sts = updater.states.get(i)
+        if sts is None:
+            continue
+        tup = sts if isinstance(sts, tuple) else (sts,)
+        for s, shp in zip(tup, shapes):
+            size = 1
+            for d in shp:
+                size *= d
+            full = jax.device_put(s._data, device)
+            s._rebind(full[:size].reshape(shp))
+    meta.clear()
+
+
+def opt_state_bytes_per_device(arrays) -> int:
+    """Bytes of optimizer state resident on the BUSIEST device — the
+    telemetry memory gauge: replicated state counts fully on every
+    device, dp-sharded state ~1/dp per device."""
+    per: Dict[Any, int] = {}
+    for a in arrays:
+        try:
+            shards = a.addressable_shards
+        except Exception:
+            shards = None
+        if not shards:
+            per[None] = per.get(None, 0) + int(a.nbytes)
+            continue
+        for sh in shards:
+            per[sh.device] = per.get(sh.device, 0) + int(sh.data.nbytes)
+    return max(per.values()) if per else 0
+
+
 def step(updater, items: Sequence[Tuple[Any, Any, Any]],
-         donate_weights: bool = True) -> bool:
+         donate_weights: bool = True, zero: bool = False) -> bool:
+    """Apply one fused optimizer step (see :func:`_step_impl` for the
+    contract).  ``zero=True`` requests the dp-sharded (ZeRO-1) update;
+    it silently degrades to the replicated fused path when the mesh has
+    no dp width, and ANY decline first restores param-shaped optimizer
+    state so the fallback never sees the flat sharded layout."""
+    dev = None
+    if items:
+        try:
+            dev = next(iter(items[0][1]._data.devices()))
+        except Exception:
+            dev = None
+    zero = bool(zero) and zero_degree() > 1
+    if getattr(updater, "_zero_states", None) and not (zero and enabled()):
+        unshard_states(updater, dev)
+    ok = _step_impl(updater, items, donate_weights, zero)
+    if not ok and getattr(updater, "_zero_states", None):
+        unshard_states(updater, dev)
+    return ok
+
+
+def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
+               donate_weights: bool = True, zero: bool = False) -> bool:
     """Apply one fused optimizer step to ``items`` = [(index, weight,
     grad)] through ``updater`` (an optimizer.Updater).  Returns True when
     the fused path ran (weights/states rebound, update counts bumped);
@@ -175,8 +374,14 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
     statics_key = tuple(sorted(statics.items()))
     # keys only — values are collected post-bump, below
     dyn_names = tuple(sorted(opt._fused_dynamics(indices[0]).keys()))
+    mesh = ndev = None
+    if zero:
+        mesh = _zero_mesh()
+        ndev = zero_degree(mesh)
+        if ndev <= 1:
+            zero = False
     family = (type(opt).__name__, opt.op_name, statics_key, dyn_names,
-              donate_weights)
+              donate_weights, ("zero", ndev) if zero else None)
 
     entry = _ENTRIES.setdefault(family, _FusedEntry())
     if entry.disabled:
@@ -190,6 +395,19 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
             updater.states_synced[i] = True
     states = [updater.states[i] for i in indices]
 
+    if zero:
+        # flat dp-sharding preserves the update rule only for
+        # weight-shaped slots — a broadcasting slot (GroupAdaGrad's
+        # (n,1,..) accumulator) would change meaning when flattened
+        meta = _zero_meta(updater)
+        for i, w in zip(indices, weights):
+            sts = updater.states[i]
+            tup = sts if isinstance(sts, tuple) else (sts,)
+            if i not in meta and any(tuple(s.shape) != tuple(w.shape)
+                                     for s in tup):
+                _STATS["fallbacks"] += 1
+                return False
+
     # donation safety: XLA rejects donating one buffer twice — DCASGD's
     # state wraps the weight's own buffer, and tied/shared parameters
     # can repeat a leaf.  Any repeated buffer falls back.
@@ -201,9 +419,20 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
                 return False
             seen.add(id(a))
 
-    sig = tuple((tuple(w.shape), str(w._data.dtype), str(g._data.dtype),
-                 tuple((tuple(s.shape), str(s._data.dtype)) for s in sts))
-                for w, g, sts in zip(weights, grads, states))
+    if zero:
+        # states may be param-shaped (pre-migration) or already flat
+        # sharded — sign with the PROSPECTIVE flat length either way so
+        # the signature is stable across the migration
+        sig = tuple((tuple(w.shape), str(w._data.dtype),
+                     str(g._data.dtype),
+                     tuple((w.size + (-w.size) % ndev, str(s._data.dtype))
+                           for s in sts))
+                    for w, g, sts in zip(weights, grads, states))
+    else:
+        sig = tuple((tuple(w.shape), str(w._data.dtype), str(g._data.dtype),
+                     tuple((tuple(s.shape), str(s._data.dtype))
+                           for s in sts))
+                    for w, g, sts in zip(weights, grads, states))
     jfn = entry.jfns.get(sig)
     fresh = jfn is None
     if fresh:
@@ -212,8 +441,10 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
             _STATS["fallbacks"] += 1
             return False
         try:
-            jfn = _build(opt.op_name, statics_key, dyn_names,
-                         donate_weights=donate_weights)
+            jfn = (_build_sharded(opt.op_name, statics_key, dyn_names,
+                                  mesh) if zero else
+                   _build(opt.op_name, statics_key, dyn_names,
+                          donate_weights=donate_weights))
             entry.jfns[sig] = jfn
         except Exception:
             entry.disabled = True
@@ -241,11 +472,33 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
                        else "step.fused_update")
     try:
         with _sp:
-            out_w, out_s = jfn(
-                dyn,
-                tuple(w._data for w in weights),
-                tuple(g._data for g in grads),
-                tuple(tuple(s._data for s in sts) for sts in states))
+            if zero:
+                # broadcast weights/grads to the mesh as replicated
+                # TEMPS (the caller's single-device buffers are never
+                # donated on this path) and run the sharded update;
+                # sharded-state migration happens here so a declined
+                # call above never leaves the flat layout behind
+                from jax.sharding import NamedSharding, PartitionSpec
+                shard_states(updater, indices, mesh)
+                rep = NamedSharding(mesh, PartitionSpec())
+                dev0 = next(iter(weights[0]._data.devices()))
+                dyn_t, w_t, g_t = jax.device_put(
+                    (dyn,
+                     tuple(w._data for w in weights),
+                     tuple(g._data for g in grads)), rep)
+                out_w, out_s = jfn(
+                    dyn_t, w_t, g_t,
+                    tuple(tuple(s._data for s in updater.states[i])
+                          for i in indices))
+                # back to the eager device so ops outside the step
+                # never see mesh-committed weights
+                out_w = jax.device_put(out_w, dev0)
+            else:
+                out_w, out_s = jfn(
+                    dyn,
+                    tuple(w._data for w in weights),
+                    tuple(g._data for g in grads),
+                    tuple(tuple(s._data for s in sts) for sts in states))
     except Exception:
         # donation means a failed execution may have consumed buffers on
         # some backends; latch off, but surface the error — the step is
@@ -261,5 +514,19 @@ def step(updater, items: Sequence[Tuple[Any, Any, Any]],
     for sts, ns in zip(states, out_s):
         for s, n in zip(sts, ns):
             s._rebind(n)
+    if zero:
+        # the tradeoff, measured: ring-cost wire bytes of the two
+        # collectives that replaced the (folded) allreduce, and the
+        # optimizer-state residency of the busiest device (~1/dp)
+        frac = (ndev - 1) / ndev
+        telemetry.record_comm_bytes(
+            int(sum(g._data.nbytes for g in grads) * frac),
+            "reduce_scatter")
+        telemetry.record_comm_bytes(
+            int(sum(w._data.nbytes for w in weights) * frac),
+            "all_gather")
+        _STATS["zero_steps"] += 1
+    telemetry.record_opt_state_bytes(opt_state_bytes_per_device(
+        s._data for sts in states for s in sts))
     _STATS["steps"] += 1
     return True

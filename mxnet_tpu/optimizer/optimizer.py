@@ -829,14 +829,26 @@ class Updater:
         import json
         arrays = {}
         keys = []
+        # ZeRO layout (optimizer/fused_step.py shard_states): slots held
+        # as flat dp-sharded vectors are serialized back in their param
+        # shape, so a states blob is portable across sharded/replicated
+        # runs and any dp width
+        zero_meta = getattr(self, "_zero_states", None) or {}
         for j, (k, v) in enumerate(self.states.items()):
             tup = v if isinstance(v, tuple) else (v,)
+            shapes = zero_meta.get(k)
             ent = {"key": k if isinstance(k, str) else int(k),
                    "str": isinstance(k, str), "slots": len(tup),
                    "tuple": isinstance(v, tuple), "dtypes": []}
             for i, s in enumerate(tup):
                 d = onp.asarray(s.asnumpy() if hasattr(s, "asnumpy")
                                 else s)
+                if shapes is not None and i < len(shapes):
+                    shp = shapes[i]
+                    size = 1
+                    for dim in shp:
+                        size *= dim
+                    d = d.reshape(-1)[:size].reshape(shp)
                 ent["dtypes"].append(str(d.dtype))
                 if d.dtype.kind not in "biufc":
                     # ml_dtypes (bfloat16, fp8): store the bit pattern
@@ -893,6 +905,9 @@ class Updater:
                     else slots[0]
             self.states = states_out
         self.states_synced = {k: True for k in self.states}
+        # restored slots are param-shaped: clear any ZeRO flat-layout
+        # record (the next sharded step re-shards them)
+        self._zero_states = {}
 
 
 def get_updater(optimizer: Optimizer) -> Updater:

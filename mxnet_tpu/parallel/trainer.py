@@ -46,8 +46,9 @@ class SPMDTrainer:
                  mesh: Optional[Mesh] = None, batch_axis: int = 0,
                  donate: bool = True, dtype: Optional[str] = None,
                  remat: bool = False, seq_axis: Optional[int] = None,
-                 micro_batches: int = 1, zero_stage: int = 0,
-                 data_transform: Optional[Callable] = None):
+                 micro_batches: int = 1, zero_stage: Optional[int] = None,
+                 data_transform: Optional[Callable] = None,
+                 zero: Optional[int] = None):
         self.net = net
         self.loss_fn = loss_fn
         # device-side input preprocessing: a jittable fn applied to each
@@ -91,6 +92,14 @@ class SPMDTrainer:
         #       the forward all-gathers just-in-time.
         # Per-parameter TP shardings (Parameter.shard) take precedence;
         # tensors with no dp-divisible axis stay replicated.
+        # ``zero=`` is the cross-funnel constructor knob (same name as
+        # gluon.Trainer's); both default to MXNET_ZERO so `MXNET_ZERO=1`
+        # turns on stage-1 sharding with no code change.
+        if zero_stage is None:
+            zero_stage = zero
+        if zero_stage is None:
+            from ..optimizer.fused_step import zero_enabled
+            zero_stage = 1 if zero_enabled() else 0
         if zero_stage not in (0, 1, 2, 3):
             raise MXNetError("zero_stage must be 0, 1, 2 or 3")
         self.zero_stage = int(zero_stage)
@@ -112,6 +121,7 @@ class SPMDTrainer:
         self._step_cache: Dict[Any, Any] = {}
         self._donate = donate
         self.num_update = 0
+        self._comm_model = None   # lazy (rs, ag, ar) analytic bytes/step
 
     # -- sharding ----------------------------------------------------------
     def _zero_spec(self, param):
@@ -423,10 +433,59 @@ class SPMDTrainer:
                                              "spmd_step")
                 _sp.annotate(fresh_compile=fresh)
                 self._fold_back(new_p, new_s, cell, aux)
+                self._account_step_telemetry()
             profiler.op_record("SPMDTrainer::step", _prof_t0)
         finally:
             telemetry.end_step(tok, "SPMDTrainer")
         return NDArray(loss)
+
+    def opt_state_bytes_per_device(self) -> int:
+        """Optimizer-state bytes resident on the busiest device —
+        ~1/dp of the replicated total under zero_stage>=1 (plus
+        non-dp-divisible stragglers that stay replicated)."""
+        from ..optimizer.fused_step import opt_state_bytes_per_device
+        return opt_state_bytes_per_device(
+            a for k in self._pkeys for a in self._opt_state[k])
+
+    @staticmethod
+    def _spec_has_dp(spec) -> bool:
+        for s in spec or ():
+            if s == "dp" or (isinstance(s, (tuple, list)) and "dp" in s):
+                return True
+        return False
+
+    def _account_step_telemetry(self, n_steps: int = 1) -> None:
+        """Per-step collective-byte split + opt-state residency gauge.
+        GSPMD inserts the collectives inside the compiled program, where
+        no host-side hook can count them, so the funnel records the
+        ring-cost model instead: a replicated-update param's gradient
+        allreduce moves 2(n-1)/n·bytes; a dp-sharded update moves
+        reduce-scatter + all-gather (n-1)/n·bytes each — equal wire
+        volume, the arxiv 2004.13336 identity the ZeRO tradeoff rests
+        on.  The model is computed once (shapes and shardings are
+        static per trainer)."""
+        model = self._comm_model
+        if model is None:
+            ndp = int(self.mesh.shape.get("dp", 1)) \
+                if "dp" in self.mesh.axis_names else 1
+            rs = ag = ar = 0
+            if ndp > 1:
+                for k in self._pkeys:
+                    p = self._params[k]
+                    nbytes = int(p.data()._data.nbytes)
+                    if self._spec_has_dp(self._opt_state_sharding(p).spec):
+                        rs += nbytes * (ndp - 1) // ndp
+                        ag += nbytes * (ndp - 1) // ndp
+                    else:
+                        ar += 2 * nbytes * (ndp - 1) // ndp
+            model = self._comm_model = (rs, ag, ar)
+        rs, ag, ar = model
+        if rs or ag:
+            telemetry.record_comm_bytes(rs * n_steps, "reduce_scatter")
+            telemetry.record_comm_bytes(ag * n_steps, "all_gather")
+        if ar:
+            telemetry.record_comm_bytes(ar * n_steps, "allreduce")
+        telemetry.record_opt_state_bytes(self.opt_state_bytes_per_device())
 
     def _gather_state(self):
         """Current param/opt-state arrays, resharded onto the step's
@@ -519,6 +578,7 @@ class SPMDTrainer:
                     telemetry.record_compile(time.perf_counter() - tc,
                                              "spmd_step")
                 self._fold_back(new_p, new_s, cell)
+                self._account_step_telemetry(n_steps=int(n_steps))
         finally:
             telemetry.end_step(tok, "SPMDTrainer",
                                extra={"n_steps": int(n_steps)})

@@ -257,6 +257,21 @@ _C_STEP_H2D = counter("input.step_h2d")        # inline transfers ON the
 _C_CKPT_SAVES = counter("checkpoint.saves")
 _C_CKPT_FAILURES = counter("checkpoint.failures")
 _C_CKPT_BYTES = counter("checkpoint.bytes")
+# ZeRO weight-update sharding health (optimizer/fused_step.py and
+# parallel/trainer.py write these).  The three split counters are the
+# same registry objects record_comm_bytes(kind=...) creates, so split
+# bytes also accumulate into comm.bytes; the gauge holds the busiest
+# device's optimizer-state residency, refreshed by the step funnels.
+_C_RS_BYTES = counter("comm.reduce_scatter.bytes")
+_C_AG_BYTES = counter("comm.all_gather.bytes")
+_C_AR_BYTES = counter("comm.allreduce.bytes")
+_G_OPT_STATE = gauge("opt_state.bytes_per_device")
+
+
+def record_opt_state_bytes(per_device: int) -> None:
+    """Refresh the per-device optimizer-state residency gauge (bytes on
+    the busiest device — ~1/dp of the replicated total under ZeRO)."""
+    _G_OPT_STATE.set(int(per_device))
 
 
 def record_compile(seconds: float, kind: str) -> None:
@@ -495,7 +510,7 @@ class _StepToken:
     __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes",
                  "dispatches", "cs_hits", "cs_compiles", "cs_fallbacks",
                  "cs_breaks", "h2d_bytes", "ckpt_saves", "ckpt_failures",
-                 "ckpt_bytes")
+                 "ckpt_bytes", "rs_bytes", "ag_bytes", "ar_bytes")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -511,6 +526,9 @@ class _StepToken:
         self.ckpt_saves = _C_CKPT_SAVES.value
         self.ckpt_failures = _C_CKPT_FAILURES.value
         self.ckpt_bytes = _C_CKPT_BYTES.value
+        self.rs_bytes = _C_RS_BYTES.value
+        self.ag_bytes = _C_AG_BYTES.value
+        self.ar_bytes = _C_AR_BYTES.value
 
 
 # nesting guard: gluon.Trainer.step pushes through kvstore.pushpull —
@@ -616,6 +634,17 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
         "compiles": _C_COMPILES.value - token.compiles,
         "compile_ms": round(_C_COMPILE_MS.value - token.compile_ms, 3),
         "collective_bytes": _C_COMM_BYTES.value - token.comm_bytes,
+        # the ZeRO tradeoff, per step: which collectives moved the
+        # gradient/weight bytes (reduce-scatter + all-gather when the
+        # update is dp-sharded, allreduce when replicated) and how much
+        # optimizer state the busiest device holds (None before any
+        # funnel has measured it)
+        "collective_split": {
+            "reduce_scatter": _C_RS_BYTES.value - token.rs_bytes,
+            "all_gather": _C_AG_BYTES.value - token.ag_bytes,
+            "allreduce": _C_AR_BYTES.value - token.ar_bytes,
+        },
+        "opt_state_bytes": _G_OPT_STATE.value,
         "device_mem": device_memory_record(),
         "dispatches": _C_DISPATCH.value - token.dispatches,
         # input-pipeline health: time step N's consumer blocked waiting

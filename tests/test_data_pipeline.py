@@ -379,3 +379,92 @@ def test_profiler_counters_input_section():
     c1 = profiler.counters()["input"]
     assert c1["h2d_bytes"] - c0["h2d_bytes"] >= 16 * 4 * 4
     assert c1["step_h2d"] == c0["step_h2d"]
+
+
+# -- whole-window staging for run_steps(per_step_data=True) -----------------
+
+def _window_trainer():
+    from mxnet_tpu.gluon import loss as gloss, nn
+    from mxnet_tpu.ndarray import NDArray
+    from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+    mx.random.seed(3)
+    net = nn.Dense(3)
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((1, 4), onp.float32)))
+    return SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                       optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.1},
+                       mesh=make_mesh({"dp": -1}))
+
+
+def _window_batches(n, bs=8, d=4, seed=0):
+    rng = onp.random.RandomState(seed)
+    return [(rng.randn(bs, d).astype("float32"),
+             rng.randint(0, 3, (bs,)).astype("float32")) for _ in range(n)]
+
+
+def test_window_staging_feeds_run_steps_without_step_h2d():
+    """wrap(window=n) stages whole (n_steps, batch, ...) windows under
+    the trainer's _window_sharding, so run_steps(per_step_data=True)
+    consumes them with ZERO step-path H2D; the trailing partial window
+    is dropped and counted."""
+    tr = _window_trainer()
+    W = 4
+    batches = _window_batches(3 * W + 2)
+    pf = wrap(batches, consumer=tr, window=W)
+    assert len(pf) == 3
+    seen = 0
+    drop0 = telemetry.counter("input.window_dropped").value
+    for d, l in pf:
+        assert d.shape == (W, 8, 4) and l.shape == (W, 8)
+        spec = tuple(d._data.sharding.spec)
+        assert spec[0] is None and "dp" in spec
+        c0 = telemetry.counter("input.step_h2d").value
+        tr.run_steps(d, l, W, per_step_data=True)
+        assert telemetry.counter("input.step_h2d").value == c0, \
+            "staged window paid H2D on the step path"
+        seen += 1
+    assert seen == 3
+    assert telemetry.counter("input.window_dropped").value - drop0 == 2
+
+
+def test_window_matches_per_step_feed():
+    """Training from staged windows is numerically identical to feeding
+    run_steps the same host-stacked window directly."""
+    W = 3
+    batches = _window_batches(2 * W, seed=4)
+
+    ta = _window_trainer()
+    mx.random.seed(11)
+    for i in range(2):
+        d = onp.stack([b[0] for b in batches[i * W:(i + 1) * W]])
+        l = onp.stack([b[1] for b in batches[i * W:(i + 1) * W]])
+        ta.run_steps(d, l, W, per_step_data=True)
+
+    tb = _window_trainer()
+    mx.random.seed(11)
+    for d, l in wrap(batches, consumer=tb, window=W):
+        tb.run_steps(d, l, W, per_step_data=True)
+
+    for k in ta._pkeys:
+        onp.testing.assert_array_equal(ta._params[k].data().asnumpy(),
+                                       tb._params[k].data().asnumpy())
+
+
+def test_window_applies_at_depth_zero_and_fast_forward():
+    """window regroups even with prefetch disabled (host-stacked), and
+    fast_forward counts WINDOWS, replaying whole run_steps calls."""
+    W = 4
+    batches = _window_batches(3 * W)
+    pf0 = wrap(batches, consumer=None, depth=0, window=W)
+    assert isinstance(pf0, DevicePrefetcher)
+    first = next(iter(pf0))
+    assert isinstance(first[0], onp.ndarray) and first[0].shape == (W, 8, 4)
+
+    pf = wrap(batches, consumer=None, window=W)
+    pf.fast_forward(2)
+    remaining = list(pf)
+    assert len(remaining) == 1
+    onp.testing.assert_array_equal(
+        onp.asarray(remaining[0][0]._data),
+        onp.stack([b[0] for b in batches[2 * W:]]))

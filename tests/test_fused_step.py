@@ -229,7 +229,8 @@ def test_profiler_counters_snapshot():
                       "optimizer", "compile", "comm", "dispatch",
                       "serving", "input", "tracing", "checkpoint"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
-    assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks", "steps"}
+    assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
+                                    "steps", "zero_steps"}
     assert set(c["cached_step"]) == {"captures", "compiles", "hits",
                                      "steps", "fallbacks", "graph_breaks"}
     assert c["optimizer"]["dispatches"] >= 0

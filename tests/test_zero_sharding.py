@@ -146,3 +146,145 @@ def test_zero_invalid_stage():
     with pytest.raises(mx.base.MXNetError):
         SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
                     mesh=make_mesh({"dp": -1}), zero_stage=5)
+
+
+# -- MXNET_ZERO / sharded-update PR: env gate, dp=2 equivalence, ------------
+# -- checkpoint resharding, telemetry splits --------------------------------
+
+def _trainer_dp(dp, zero_stage=None, seed=0, **kw):
+    net = _net(seed)
+    return SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                       optimizer="adam",
+                       optimizer_params={"learning_rate": 1e-2},
+                       mesh=make_mesh({"dp": dp}),
+                       zero_stage=zero_stage, **kw)
+
+
+def test_zero_env_default_enables_stage1(monkeypatch):
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    tr = _trainer_dp(2)
+    assert tr.zero_stage == 1
+    x, y = _data()
+    tr.step(x, y)
+    assert any("dp" in _spec_of(st) for k in tr._pkeys
+               for st in tr._opt_state[k])
+    monkeypatch.setenv("MXNET_ZERO", "0")
+    assert _trainer_dp(2).zero_stage == 0
+    # explicit zero_stage wins over the env default
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    assert _trainer_dp(2, zero_stage=0).zero_stage == 0
+
+
+def test_zero_alias_knob():
+    # zero= is an alias for zero_stage= (the ISSUE's constructor knob)
+    tr = _trainer_dp(2, zero=1)
+    assert tr.zero_stage == 1
+
+
+def test_zero_equivalence_10_steps_dp2():
+    """ZeRO-vs-replicated over 10 steps at dp=2: same update math, so
+    the trajectories must agree to accumulated float epsilon (the two
+    executables partition the forward/vjp differently, so bitwise
+    equality is not guaranteed across XLA fusions)."""
+    x, y = _data()
+
+    def run(stage):
+        tr = _trainer_dp(2, zero_stage=stage)
+        mx.random.seed(123)
+        losses = [float(tr.step(x, y).asnumpy()) for _ in range(10)]
+        return tr, losses
+
+    tr0, base = run(0)
+    tr1, zs = run(1)
+    onp.testing.assert_allclose(zs, base, rtol=2e-5, atol=2e-6)
+    for k in tr0._pkeys:
+        onp.testing.assert_allclose(
+            tr1._params[k].data().asnumpy(),
+            tr0._params[k].data().asnumpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_zero_opt_state_bytes_under_gate():
+    """Acceptance gate: per-device optimizer-state residency under
+    MXNET_ZERO at dp=2 is <= 0.6x the replicated trainer's."""
+    x, y = _data()
+    tr0 = _trainer_dp(2, zero_stage=0)
+    tr1 = _trainer_dp(2, zero_stage=1)
+    tr0.step(x, y)
+    tr1.step(x, y)
+    b0 = tr0.opt_state_bytes_per_device()
+    b1 = tr1.opt_state_bytes_per_device()
+    assert b0 > 0 and b1 > 0
+    assert b1 <= 0.6 * b0, (b1, b0)
+
+
+def test_zero_checkpoint_reshards_across_dp(tmp_path, monkeypatch):
+    """A checkpoint saved under MXNET_ZERO=1 at dp=2 restores onto
+    dp=1 and dp=4 trainers with identical global params/opt state
+    (the manifest stores global arrays, placement is the restoring
+    trainer's)."""
+    monkeypatch.setenv("MXNET_ZERO", "1")
+    tr = _trainer_dp(2)
+    assert tr.zero_stage == 1
+    x, y = _data()
+    for _ in range(2):
+        tr.step(x, y)
+    tr.save_checkpoint(tmp_path)
+    want_p = {k: tr._params[k].data().asnumpy() for k in tr._pkeys}
+    want_s = {k: [onp.asarray(st) for st in tr._opt_state[k]]
+              for k in tr._pkeys}
+    for dp in (1, 4):
+        tr2 = _trainer_dp(dp, seed=9)
+        assert tr2.load_checkpoint(tmp_path) is not None
+        for k in tr._pkeys:
+            onp.testing.assert_array_equal(
+                tr2._params[k].data().asnumpy(), want_p[k])
+            for a, b in zip(want_s[k], tr2._opt_state[k]):
+                onp.testing.assert_array_equal(onp.asarray(b), a)
+        if dp > 1:
+            assert any("dp" in _spec_of(st) for k in tr2._pkeys
+                       for st in tr2._opt_state[k])
+        tr2.step(x, y)       # restored state steps fine at the new dp
+
+
+def test_replicated_checkpoint_loads_into_zero_trainer(tmp_path):
+    """Migration path: a checkpoint from a replicated run loads into a
+    ZeRO trainer — state lands dp-sharded with identical values."""
+    tr = _trainer_dp(2, zero_stage=0)
+    x, y = _data()
+    tr.step(x, y)
+    tr.save_checkpoint(tmp_path)
+    tr2 = _trainer_dp(2, zero_stage=1, seed=9)
+    assert tr2.load_checkpoint(tmp_path) is not None
+    for k in tr._pkeys:
+        for a, b in zip(tr._opt_state[k], tr2._opt_state[k]):
+            onp.testing.assert_array_equal(onp.asarray(b), onp.asarray(a))
+    assert any("dp" in _spec_of(st) for k in tr2._pkeys
+               for st in tr2._opt_state[k])
+    tr2.step(x, y)
+
+
+def test_zero_telemetry_collective_split():
+    """ZeRO steps account reduce_scatter+all_gather bytes; replicated
+    steps account allreduce bytes; both set the opt-state gauge."""
+    from mxnet_tpu import telemetry
+    x, y = _data()
+
+    def split_of(tr):
+        rs0 = telemetry.counter("comm.reduce_scatter.bytes").value
+        ag0 = telemetry.counter("comm.all_gather.bytes").value
+        ar0 = telemetry.counter("comm.allreduce.bytes").value
+        tr.step(x, y)
+        return (telemetry.counter("comm.reduce_scatter.bytes").value - rs0,
+                telemetry.counter("comm.all_gather.bytes").value - ag0,
+                telemetry.counter("comm.allreduce.bytes").value - ar0,
+                telemetry.gauge("opt_state.bytes_per_device").value)
+
+    rs, ag, ar, gauge0 = split_of(_trainer_dp(2, zero_stage=0))
+    assert rs == 0 and ag == 0 and ar > 0 and gauge0 > 0
+    rs, ag, ar, gauge1 = split_of(_trainer_dp(2, zero_stage=1))
+    assert rs > 0 and ag > 0 and gauge1 > 0
+    # ring-equivalence: RS + AG wire volume == the allreduce it replaced
+    # for the dp-sharded params (BatchNorm's moving stats stay on the
+    # allreduce ledger, so compare against the split's own total)
+    assert rs == ag
+    assert gauge1 < gauge0

@@ -11,7 +11,11 @@ request p50/p95 latency, mean batch occupancy, padding-waste %, and
 reject/timeout totals — reconciled from the SAME JSONL stream.  Runs
 that checkpointed (records with a ``checkpoint`` delta payload) get a
 section: saves published, failed saves, bytes committed — the
-``failures`` total staying 0 is the async-save health signal.
+``failures`` total staying 0 is the async-save health signal.  Runs
+with optimizer-sharding signal (``collective_split`` /
+``opt_state_bytes`` fields, emitted under MXNET_ZERO or zero_stage>=1)
+get an "Optimizer sharding" section: per-device optimizer-state
+residency and the reduce-scatter / all-gather vs allreduce byte split.
 
 Usage:
     python tools/telemetry_report.py run.jsonl
@@ -113,6 +117,28 @@ def summarize(records):
             "bytes_per_save": ck_bytes / ck_saves if ck_saves else 0,
             "steps_with_commit": sum(1 for c in ck if c.get("saves", 0)),
         }
+    # optimizer-sharding deltas (ZeRO sharded update): per-record
+    # collective splits (reduce_scatter / all_gather vs allreduce) and
+    # the busiest-device optimizer-state gauge.  Section only renders
+    # for runs whose records carry the fields with signal.
+    splits = [r["collective_split"] for r in records
+              if isinstance(r.get("collective_split"), dict)]
+    opt_bytes = [r.get("opt_state_bytes", 0) for r in records
+                 if r.get("opt_state_bytes")]
+    sharding = None
+    if opt_bytes or any(any(c.values()) for c in splits):
+        n = len(records) or 1
+        rs = sum(c.get("reduce_scatter", 0) for c in splits)
+        ag = sum(c.get("all_gather", 0) for c in splits)
+        ar = sum(c.get("allreduce", 0) for c in splits)
+        sharding = {
+            "opt_state_bytes_per_device": max(opt_bytes, default=0),
+            "reduce_scatter_bytes_per_step": rs / n,
+            "all_gather_bytes_per_step": ag / n,
+            "allreduce_bytes_per_step": ar / n,
+            "sharded_update_steps": sum(
+                1 for c in splits if c.get("reduce_scatter", 0)),
+        }
     srv = [r["serving"] for r in records
            if isinstance(r.get("serving"), dict) and "error" not in
            r["serving"]]
@@ -152,6 +178,7 @@ def summarize(records):
         "input": input_stats,
         "serving": serving,
         "checkpoint": ckpt,
+        "sharding": sharding,
     }
 
 
@@ -303,6 +330,23 @@ def render(s):
             f"{'bytes committed':<28}{ck['bytes']:>24}",
             f"{'bytes / save':<28}{ck['bytes_per_save']:>24.1f}",
             f"{'steps with a commit':<28}{ck['steps_with_commit']:>24}",
+        ]
+    sh = s.get("sharding")
+    if sh:
+        lines += [
+            "",
+            "Optimizer sharding (ZeRO update)",
+            "-" * 52,
+            f"{'opt state bytes / device':<28}"
+            f"{sh['opt_state_bytes_per_device']:>24}",
+            f"{'reduce-scatter bytes/step':<28}"
+            f"{sh['reduce_scatter_bytes_per_step']:>24.1f}",
+            f"{'all-gather bytes / step':<28}"
+            f"{sh['all_gather_bytes_per_step']:>24.1f}",
+            f"{'allreduce bytes / step':<28}"
+            f"{sh['allreduce_bytes_per_step']:>24.1f}",
+            f"{'sharded-update steps':<28}"
+            f"{sh['sharded_update_steps']:>24}",
         ]
     srv = s.get("serving")
     if srv:
