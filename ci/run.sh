@@ -261,6 +261,84 @@ PY
     rm -rf "$tmp"
 }
 
+elastic_multihost_smoke() { # 2-rank commit barrier: kill a rank mid-publish
+    # tier-1's phase-2 matrix first: barrier roundtrip, rank-death
+    # branches, single-failure invariant, GC, digest verify, quarantine
+    JAX_PLATFORMS=cpu python -m pytest tests/test_elastic.py -q \
+        -k "rank or barrier or gc or verify or digest or failure or scan"
+    local tmp; tmp="$(mktemp -d)"
+    # leg 1: threads-as-ranks soak over a shared directory — rank 1 is
+    # killed mid-publish (its ready marker is the injected casualty),
+    # rank 0 must time out WITHOUT publishing, and the survivor's next
+    # load must resolve to the previous fully-digest-verified
+    # checkpoint.  Telemetry JSONL feeds the report check below.
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY_JSONL="$tmp/telemetry.jsonl" \
+        MXNET_CKPT_BARRIER_TIMEOUT_S=3 MXNET_CKPT_KEEP=3 \
+        MXNET_CKPT_RETRIES=0 python - "$tmp" <<'PY'
+import os, sys
+import numpy as np
+from mxnet_tpu import checkpoint, checkpoint_gc, faultinject, telemetry
+
+tmp = sys.argv[1]
+d = os.path.join(tmp, "mh_ckpt")
+tok = telemetry.begin_step()
+
+def save2(step):
+    j0 = checkpoint.save(d, {"w0": np.full((64, 64), float(step), "float32")},
+                         header={"num_update": step}, block=False,
+                         rank=0, world=2)
+    j1 = checkpoint.save(d, {"w1": np.full((64,), step * 2.0, "float32")},
+                         header={"num_update": step}, block=False,
+                         rank=1, world=2)
+    j0.wait(120); j1.wait(120)
+    return j0, j1
+
+for step in range(1, 5):                      # healthy publishes + GC
+    j0, j1 = save2(step)
+    assert j0.error is None and j1.error is None, (j0.error, j1.error)
+
+faultinject.configure("marker_write@1:1")     # rank 1 dies mid-publish
+j0, j1 = save2(5)
+assert isinstance(j1.error, faultinject.FaultInjected), j1.error
+assert j0.error is not None and "barrier" in str(j0.error), j0.error
+faultinject.clear()
+
+leaves, header = checkpoint.load(d)           # survivor's restore:
+assert header["num_update"] == 4, header      # previous publish, and
+assert float(leaves["w0"][0, 0]) == 4.0       # load() re-hashed every
+assert float(leaves["w1"][0]) == 8.0          # shard on the way in
+report = checkpoint_gc.verify_checkpoint(d)
+assert report["ok"] and report["files"] == 2, report
+assert checkpoint_gc.verify_and_heal(d) is True
+assert telemetry.counter("checkpoint.gc_removed").value >= 1
+telemetry.end_step(tok, "multihost_smoke")
+print(f"elastic_multihost_smoke: rank death blocked publish; survivor "
+      f"load resolved to step {header['num_update']} (digest-verified)")
+PY
+    # the report renders the GC/verify rows off that run's JSONL
+    python tools/telemetry_report.py "$tmp/telemetry.jsonl" \
+        | tee "$tmp/report.txt"
+    grep -q "gc removed (keep-last-N)" "$tmp/report.txt"
+    grep -q "verify passes" "$tmp/report.txt"
+    # leg 2: process-level mid-publish SIGKILL — fault injection kills
+    # the worker exactly between the two publish renames (rename #3 is
+    # the tmp→latest rename of its SECOND publish, after latest was
+    # already moved to latest.old: the torn window).  The restart must
+    # fall back to the .old backup and finish the run.
+    local rc=0
+    JAX_PLATFORMS=cpu python tests/elastic_worker.py \
+        --ckpt-dir "$tmp/ckpt" --progress "$tmp/progress.jsonl" \
+        --steps 10 --ckpt-every 2 --fault-spec "rename:3:kill" \
+        || rc=$?
+    [ "$rc" -ne 0 ] || { echo "worker survived its injected kill"; exit 1; }
+    JAX_PLATFORMS=cpu python tests/elastic_worker.py \
+        --ckpt-dir "$tmp/ckpt" --progress "$tmp/progress.jsonl" \
+        --steps 10 --ckpt-every 2 | tee "$tmp/run2.log"
+    grep -q "resumed at seen=" "$tmp/run2.log"
+    grep -q "done seen=10" "$tmp/run2.log"
+    rm -rf "$tmp"
+}
+
 zero_smoke() {        # ZeRO-1 sharded update: tests + memory/time gates
     # tier-1 covers dp=2 equivalence, env gating, checkpoint resharding
     # across dp=1/2/4, eager bitwise parity and the 1-dispatch cached

@@ -18,8 +18,8 @@ Three phases, only the first on the step path::
     (async device-     (np.asarray completes the     (manifest
      side copy +        copies, per-device shard      written last,
      D2H launch of      files written + fsynced       tmp dir renamed
-     each unique        to a tmp dir)                 into place)
-     shard)
+     each unique        to a tmp dir, SHA-256         into place,
+     shard)             digest per file)              parent fsynced)
 
 - **snapshot** gives each leaf a device-side defensive copy
   (``jnp.copy``, an async dispatch — the step path waits on neither
@@ -34,30 +34,58 @@ Three phases, only the first on the step path::
   writes one ``shard-d<id>.npz`` per owning device, each entry
   carrying the leaf's **global shape + shard slice** in the manifest
   so restore can reassemble the global array onto a *different* mesh
-  shape (dp=8 save → dp=1 load).
+  shape (dp=8 save → dp=1 load).  Every shard file's SHA-256 lands in
+  the manifest and is re-checked on every load AND by the background
+  verify pass (``mxnet_tpu/checkpoint_gc.py``).
 - **commit** writes ``manifest.json`` LAST inside the tmp dir (a tmp
   dir without a manifest is garbage by definition), then publishes via
-  the rename protocol: ``tag`` → ``tag.old``, tmp → ``tag``, drop
-  ``tag.old`` — SOME complete checkpoint is loadable at every instant,
-  even if the process is SIGKILLed between the two renames.
+  the rename protocol: ``tag`` → ``tag.old``, tmp → ``tag``, retire
+  ``tag.old`` into the ``step-<n>`` history (keep-last-N GC) — SOME
+  complete checkpoint is loadable at every instant, even if the
+  process is SIGKILLed between the two renames.  The parent directory
+  is fsynced after the renames: fsyncing the manifest alone does not
+  make a *rename* durable.
+
+**Multi-process commit barrier** (``world > 1``, the rank-0 commit
+protocol).  On a shared filesystem every process serializes the shards
+it owns into the SAME tmp dir (files namespaced ``shard-r<rank>-…``),
+fsyncs them, and signals readiness with a ``commit-r<rank>.ready``
+marker carrying its shard list, per-file SHA-256 digests, and manifest
+fragment.  Only rank 0 publishes: it waits (bounded by
+``MXNET_CKPT_BARRIER_TIMEOUT_S``) for every marker of the SAME commit
+id, merges the fragments into one manifest, and runs the rename
+protocol — so a host dying mid-save can never yield a published
+manifest referencing shards that were never written or fsynced (rank 0
+times out and does NOT publish).  Non-zero ranks then poll for the
+published manifest with the same bounded wait and raise ``MXNetError``
+on expiry.  Rank/world resolve per save: explicit arguments >
+``MXNET_CKPT_RANK``/``MXNET_CKPT_WORLD`` env > the dist kvstore's
+:func:`set_rank` plumbing > ``jax.process_index()``.
 
 Failure semantics: transient IO errors retry ``MXNET_CKPT_RETRIES``
-times with ``MXNET_CKPT_BACKOFF_MS`` exponential backoff; a save that
+times with ``MXNET_CKPT_BACKOFF_MS`` exponential backoff (a barrier
+expiry does NOT retry — the peer is gone, not flaky); a save that
 still fails increments ``checkpoint.failures`` telemetry and logs —
 an *async* save never raises into the training step (graceful
 degradation: training outlives a flaky filesystem), a *blocking* save
-raises ``MXNetError`` after the retries are exhausted.
+raises ``MXNetError`` after the retries are exhausted.  Every IO/
+commit site calls ``faultinject.fire`` so the test matrix
+(``MXNET_FAULT_SPEC``) can drive each failure branch deterministically.
 
 Telemetry (the off-step-path verification signal ROADMAP names):
 ``checkpoint.save_ms`` (serialize+commit wall, writer thread),
 ``checkpoint.snapshot_ms`` (the only step-path cost),
-``checkpoint.bytes``, ``checkpoint.saves`` / ``checkpoint.failures`` /
-``checkpoint.coalesced``.
+``checkpoint.barrier_wait_ms``, ``checkpoint.bytes``,
+``checkpoint.saves`` / ``failures`` / ``coalesced``, plus the GC and
+verify counters in ``checkpoint_gc.py``.
 """
 from __future__ import annotations
 
+import hashlib
+import io
 import json
 import os
+import re
 import shutil
 import threading
 import time
@@ -67,15 +95,17 @@ import numpy as onp
 import jax
 import jax.numpy as jnp
 
+from . import faultinject
 from . import telemetry
 from . import tracing
 from .base import MXNetError, getenv, getenv_bool
 
 __all__ = ["snapshot", "save", "load", "wait_pending", "Snapshot",
-           "PendingSave", "FORMAT", "MANIFEST"]
+           "PendingSave", "FORMAT", "MANIFEST", "set_rank", "rank_world"]
 
 FORMAT = "mxnet_tpu-checkpoint-v2"
 MANIFEST = "manifest.json"
+_STEP_TAG_RE = re.compile(r"step-(\d+)$")
 
 # created eagerly so profiler.counters() shows zeros before first save
 _C_SAVES = telemetry.counter("checkpoint.saves")
@@ -84,6 +114,7 @@ _C_COALESCED = telemetry.counter("checkpoint.coalesced")
 _C_BYTES = telemetry.counter("checkpoint.bytes")
 _H_SAVE_MS = telemetry.histogram("checkpoint.save_ms")
 _H_SNAP_MS = telemetry.histogram("checkpoint.snapshot_ms")
+_H_BARRIER_MS = telemetry.histogram("checkpoint.barrier_wait_ms")
 
 
 def async_enabled() -> bool:
@@ -114,9 +145,57 @@ def _backoff_s() -> float:
             f"invalid MXNET_CKPT_BACKOFF_MS={v!r}; expected a number")
 
 
+def _barrier_timeout_s() -> float:
+    v = getenv("MXNET_CKPT_BARRIER_TIMEOUT_S")
+    if v is None or v == "":
+        return 120.0
+    try:
+        return max(0.0, float(v))
+    except ValueError:
+        raise MXNetError(
+            f"invalid MXNET_CKPT_BARRIER_TIMEOUT_S={v!r}; expected a "
+            f"number of seconds")
+
+
 def _logger():
     from .log import get_logger
     return get_logger("mxnet_tpu.checkpoint")
+
+
+# -- rank/world plumbing ----------------------------------------------------
+
+_rank_override: Optional[Tuple[int, int]] = None
+
+
+def set_rank(rank: int, world: int) -> None:
+    """Register this process's (rank, world size) for the commit
+    barrier.  Called by the dist kvstore layer on init; tests and
+    launchers may call it directly.  ``MXNET_CKPT_RANK`` /
+    ``MXNET_CKPT_WORLD`` env still win (per-process overrides for
+    harnesses that can't reach in-process state)."""
+    global _rank_override
+    _rank_override = (int(rank), max(1, int(world)))
+
+
+def rank_world() -> Tuple[int, int]:
+    """(rank, world) the checkpoint layer will use for a save that
+    doesn't pass them explicitly.  Resolution order: env > the dist
+    kvstore's :func:`set_rank` > ``jax.process_index()`` (1-process
+    jax runs are world=1 → no barrier)."""
+    r, w = getenv("MXNET_CKPT_RANK"), getenv("MXNET_CKPT_WORLD")
+    if r not in (None, ""):
+        try:
+            return int(r), max(1, int(w or "1"))
+        except ValueError:
+            raise MXNetError(
+                f"invalid MXNET_CKPT_RANK={r!r}/MXNET_CKPT_WORLD={w!r}; "
+                f"expected integers")
+    if _rank_override is not None:
+        return _rank_override
+    try:
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
 
 
 # -- snapshot (the only step-path phase) ------------------------------------
@@ -234,14 +313,42 @@ def _np_dtype(name: str) -> onp.dtype:
         return onp.dtype(name)
 
 
-def _serialize(snap: Snapshot, tmp: str) -> int:
-    """Write per-device shard files + manifest (LAST) into ``tmp``.
-    Returns payload bytes written."""
-    if os.path.exists(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
+def _fsync_dir(path: str) -> None:
+    """Make renames/creates IN ``path`` durable: fsyncing a file does
+    not persist its directory entry (satellite of the rename
+    protocol's durability claim).  Best-effort on platforms where
+    directories can't be opened."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _serialize_shards(snap: Snapshot, tmp: str, rank: int, world: int
+                      ) -> Tuple[int, Dict[str, dict], Dict[str, dict]]:
+    """Write THIS rank's shard files into ``tmp`` (created if needed;
+    a multi-rank save shares the dir, so nothing here deletes other
+    ranks' files).  Returns ``(payload_bytes, leaves_fragment,
+    files_fragment)`` — the manifest pieces this rank contributes:
+    per-leaf shard placement and per-file SHA-256 digests."""
+    os.makedirs(tmp, exist_ok=True)
+    prefix = f"shard-r{rank}-d" if world > 1 else "shard-d"
     by_dev: Dict[int, Dict[str, onp.ndarray]] = {}
-    manifest_leaves: Dict[str, dict] = {}
+    leaves_frag: Dict[str, dict] = {}
     nbytes = 0
     for name, leaf in snap.leaves.items():
         entries = []
@@ -251,33 +358,197 @@ def _serialize(snap: Snapshot, tmp: str) -> int:
             key = f"a{len(arrays)}"                 # unique per file;
             arrays[key] = host                      # manifest is the map
             nbytes += int(host.nbytes)
-            entries.append({"file": f"shard-d{dev}.npz", "key": key,
+            entries.append({"file": f"{prefix}{dev}.npz", "key": key,
                             "start": list(start), "stop": list(stop)})
-        manifest_leaves[name] = {"shape": list(leaf.shape),
-                                 "dtype": leaf.dtype, "shards": entries}
+        leaves_frag[name] = {"shape": list(leaf.shape),
+                             "dtype": leaf.dtype, "shards": entries}
+    files_frag: Dict[str, dict] = {}
     for dev, arrays in by_dev.items():
-        with open(os.path.join(tmp, f"shard-d{dev}.npz"), "wb") as f:
+        fname = f"{prefix}{dev}.npz"
+        fpath = os.path.join(tmp, fname)
+        faultinject.fire("shard_write", rank=rank, file=fname)
+        with open(fpath, "wb") as f:
             onp.savez(f, **arrays)
             f.flush()
+            faultinject.fire("fsync", rank=rank, file=fname)
             os.fsync(f.fileno())
-    doc = {"format": FORMAT, "header": snap.header,
-           "leaves": manifest_leaves}
-    # manifest written last + fsynced: its presence marks the shard set
-    # complete, so a torn serialize can never masquerade as a checkpoint
+        # digest computed from the bytes on disk (page-cache read) —
+        # what load() and the background verifier will re-hash
+        files_frag[fname] = {"sha256": _sha256_file(fpath),
+                             "bytes": os.path.getsize(fpath)}
+    _fsync_dir(tmp)                     # shard dir entries durable too
+    return nbytes, leaves_frag, files_frag
+
+
+def _write_manifest(tmp: str, doc: dict, rank: int) -> None:
+    """Manifest written last + fsynced: its presence marks the shard
+    set complete, so a torn serialize can never masquerade as a
+    checkpoint."""
+    faultinject.fire("manifest_write", rank=rank)
     mpath = os.path.join(tmp, MANIFEST)
     with open(mpath + ".tmp", "w") as f:
         json.dump(doc, f)
         f.flush()
         os.fsync(f.fileno())
     os.replace(mpath + ".tmp", mpath)
-    return nbytes
+    _fsync_dir(tmp)
 
 
-def _publish(directory: str, tag: str, tmp: str) -> str:
+def _marker_name(rank: int) -> str:
+    return f"commit-r{rank}.ready"
+
+
+def _write_marker(tmp: str, rank: int, commit: str, nbytes: int,
+                  leaves_frag: dict, files_frag: dict) -> None:
+    """Per-rank readiness signal of the commit barrier: written (and
+    fsynced) only AFTER this rank's shard files are durable, carrying
+    the rank's manifest fragment so rank 0 can assemble the full
+    manifest without re-reading anything."""
+    faultinject.fire("marker_write", rank=rank)
+    doc = {"format": FORMAT, "rank": rank, "commit": commit,
+           "nbytes": int(nbytes), "leaves": leaves_frag,
+           "files": files_frag}
+    path = os.path.join(tmp, _marker_name(rank))
+    with open(path + ".tmp", "w") as f:
+        json.dump(doc, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(path + ".tmp", path)
+    _fsync_dir(tmp)
+
+
+class _BarrierTimeout(MXNetError):
+    """Commit-barrier expiry: a peer never signalled (or rank 0 never
+    published).  Deliberately NOT retried — the peer is dead or
+    partitioned, not transiently slow; retrying would just double the
+    wait while the training step stalls behind a blocking save."""
+
+
+def _collect_markers(tmp: str, world: int, commit: str,
+                     timeout: float, rank: int) -> Dict[int, dict]:
+    """Rank 0's half of the barrier: bounded wait for every non-zero
+    rank's ready marker of THIS commit (stale markers from a crashed
+    earlier save carry a different commit id and are ignored)."""
+    faultinject.fire("barrier_wait", rank=rank)
+    t0 = time.perf_counter()
+    deadline = time.monotonic() + timeout
+    missing = set(range(1, world))
+    frags: Dict[int, dict] = {}
+    with tracing.span("ckpt.barrier", world=world, commit=commit):
+        while missing:
+            for r in sorted(missing):
+                path = os.path.join(tmp, _marker_name(r))
+                try:
+                    with open(path) as f:
+                        doc = json.load(f)
+                except (OSError, ValueError):
+                    continue            # absent or mid-write
+                if doc.get("format") != FORMAT or \
+                        str(doc.get("commit")) != str(commit):
+                    continue            # stale marker from an old save
+                frags[r] = doc
+                missing.discard(r)
+            if not missing:
+                break
+            if time.monotonic() >= deadline:
+                raise _BarrierTimeout(
+                    f"rank 0 commit barrier timed out after {timeout}s "
+                    f"waiting for ready markers from rank(s) "
+                    f"{sorted(missing)} (commit {commit!r}) — NOT "
+                    f"publishing; the previous checkpoint stays live")
+            time.sleep(0.02)
+    _H_BARRIER_MS.observe((time.perf_counter() - t0) * 1e3)
+    return frags
+
+
+def _await_publish(directory: str, tag: str, commit: str,
+                   timeout: float, rank: int) -> str:
+    """Non-zero ranks' half of the barrier: bounded wait for rank 0's
+    published manifest of THIS commit."""
+    faultinject.fire("barrier_wait", rank=rank)
+    t0 = time.perf_counter()
+    deadline = time.monotonic() + timeout
+    final = os.path.join(directory, tag)
+    mpath = os.path.join(final, MANIFEST)
+    with tracing.span("ckpt.barrier", rank=rank, commit=commit):
+        while True:
+            try:
+                with open(mpath) as f:
+                    doc = json.load(f)
+                if doc.get("format") == FORMAT and \
+                        str(doc.get("commit")) == str(commit):
+                    break
+            except (OSError, ValueError):
+                pass                    # not published yet / mid-swap
+            if time.monotonic() >= deadline:
+                raise _BarrierTimeout(
+                    f"rank {rank} timed out after {timeout}s waiting "
+                    f"for rank 0 to publish {final!r} (commit "
+                    f"{commit!r}) — coordinator dead or partitioned")
+            time.sleep(0.05)
+    _H_BARRIER_MS.observe((time.perf_counter() - t0) * 1e3)
+    return final
+
+
+def _merge_fragments(own_leaves: dict, own_files: dict,
+                     frags: Dict[int, dict]) -> Tuple[dict, dict, int]:
+    """Assemble the full manifest from rank 0's fragment plus every
+    marker's.  Replicated leaves appear in several fragments with the
+    same slice — deduped; partitioned leaves contribute disjoint
+    slices that tile the global array."""
+    leaves = {k: dict(v, shards=list(v["shards"]))
+              for k, v in own_leaves.items()}
+    files = dict(own_files)
+    extra = 0
+    for r in sorted(frags):
+        doc = frags[r]
+        for name, leaf in (doc.get("leaves") or {}).items():
+            if name not in leaves:
+                leaves[name] = dict(leaf, shards=list(leaf["shards"]))
+                continue
+            base = leaves[name]
+            if list(base["shape"]) != list(leaf["shape"]) or \
+                    base["dtype"] != leaf["dtype"]:
+                raise MXNetError(
+                    f"commit barrier: rank {r} disagrees on leaf "
+                    f"{name!r} ({leaf['shape']}/{leaf['dtype']} vs "
+                    f"{base['shape']}/{base['dtype']}) — aborting "
+                    f"publish")
+            seen = {(tuple(s["start"]), tuple(s["stop"]))
+                    for s in base["shards"]}
+            for s in leaf["shards"]:
+                if (tuple(s["start"]), tuple(s["stop"])) not in seen:
+                    base["shards"].append(s)
+        files.update(doc.get("files") or {})
+        extra += int(doc.get("nbytes", 0))
+    return leaves, files, extra
+
+
+def _clean_stale(tmp: str, files: Dict[str, dict]) -> None:
+    """Drop barrier markers and any shard file the merged manifest
+    does not reference (leftovers of a crashed earlier save sharing
+    the tmp dir) so the published dir is exactly the manifest's
+    content."""
+    try:
+        names = os.listdir(tmp)
+    except OSError:
+        return
+    for name in names:
+        if name == MANIFEST or name in files:
+            continue
+        try:
+            os.remove(os.path.join(tmp, name))
+        except OSError:
+            pass
+
+
+def _publish(directory: str, tag: str, tmp: str, rank: int = 0) -> str:
     """Atomic rename publish: the previous checkpoint survives as
     ``tag.old`` until the new one is in place, so a kill between the
     two renames still leaves a loadable checkpoint (load falls back
-    to ``tag.old``)."""
+    to ``tag.old``).  After the renames the parent directory is
+    fsynced (rename durability) and the superseded checkpoint is
+    retired into the ``step-<n>`` history for keep-last-N GC."""
     final = os.path.join(directory, tag)
     backup = os.path.join(directory, f"{tag}.old")
     if os.path.exists(final):
@@ -286,10 +557,15 @@ def _publish(directory: str, tag: str, tmp: str) -> str:
         # until the new publish lands
         if os.path.exists(backup):
             shutil.rmtree(backup)
+        faultinject.fire("rename", rank=rank, src=final, dst=backup)
         os.replace(final, backup)       # keep the old one until...
+    faultinject.fire("rename", rank=rank, src=tmp, dst=final)
     os.replace(tmp, final)              # ...the new one is in place
+    _fsync_dir(directory)               # make the renames durable
     if os.path.exists(backup):
-        shutil.rmtree(backup)
+        from . import checkpoint_gc
+        checkpoint_gc.retire(directory, backup)
+        _fsync_dir(directory)
     return final
 
 
@@ -298,10 +574,15 @@ class PendingSave:
     checkpoint is published (or the save failed/was coalesced away);
     ``result()`` additionally raises the failure."""
 
-    def __init__(self, directory: str, tag: str, snap: Snapshot):
+    def __init__(self, directory: str, tag: str, snap: Snapshot,
+                 rank: int = 0, world: int = 1,
+                 commit: Optional[str] = None):
         self.directory = directory
         self.tag = tag
         self.snapshot = snap
+        self.rank = int(rank)
+        self.world = max(1, int(world))
+        self.commit = commit if commit is not None else ""
         self.path: Optional[str] = None
         self.error: Optional[BaseException] = None
         self.superseded = False
@@ -330,6 +611,50 @@ class PendingSave:
         return self._done.is_set()
 
 
+def _run_single(job: "PendingSave", tmp: str) -> Tuple[str, int]:
+    """world == 1: the whole save is local — exclusive tmp dir,
+    serialize, manifest, publish."""
+    if os.path.exists(tmp):
+        shutil.rmtree(tmp)
+    with tracing.span("ckpt.serialize", tag=job.tag):
+        nbytes, leaves, files = _serialize_shards(job.snapshot, tmp, 0, 1)
+        doc = {"format": FORMAT, "header": job.snapshot.header,
+               "commit": job.commit, "world": 1,
+               "leaves": leaves, "files": files}
+        _write_manifest(tmp, doc, rank=0)
+    with tracing.span("ckpt.commit", tag=job.tag):
+        path = _publish(job.directory, job.tag, tmp, rank=0)
+    return path, nbytes
+
+
+def _run_multirank(job: "PendingSave", tmp: str) -> Tuple[str, int]:
+    """world > 1: the rank-0 commit protocol over the shared tmp dir
+    (see module doc)."""
+    rank, world, commit = job.rank, job.world, job.commit
+    timeout = _barrier_timeout_s()
+    with tracing.span("ckpt.serialize", tag=job.tag, rank=rank):
+        nbytes, leaves, files = _serialize_shards(
+            job.snapshot, tmp, rank, world)
+    if rank != 0:
+        _write_marker(tmp, rank, commit, nbytes, leaves, files)
+        path = _await_publish(job.directory, job.tag, commit,
+                              timeout, rank)
+        return path, nbytes
+    frags = _collect_markers(tmp, world, commit, timeout, rank)
+    leaves, files, extra = _merge_fragments(leaves, files, frags)
+    # the window a coordinator death is most expensive: markers
+    # collected, manifest not yet live — the matrix kills here
+    faultinject.fire("commit", rank=rank, tag=job.tag)
+    _clean_stale(tmp, files)
+    doc = {"format": FORMAT, "header": job.snapshot.header,
+           "commit": commit, "world": world,
+           "leaves": leaves, "files": files}
+    _write_manifest(tmp, doc, rank=rank)
+    with tracing.span("ckpt.commit", tag=job.tag):
+        path = _publish(job.directory, job.tag, tmp, rank=rank)
+    return path, nbytes + extra
+
+
 def _run_job(job: PendingSave) -> None:
     t0 = time.perf_counter()
     tmp = os.path.join(job.directory, f".{job.tag}.tmp")
@@ -338,17 +663,27 @@ def _run_job(job: PendingSave) -> None:
     for attempt in range(attempts):
         try:
             os.makedirs(job.directory, exist_ok=True)
-            with tracing.span("ckpt.serialize", tag=job.tag):
-                nbytes = _serialize(job.snapshot, tmp)
-            with tracing.span("ckpt.commit", tag=job.tag):
-                job.path = _publish(job.directory, job.tag, tmp)
+            if job.world > 1:
+                job.path, nbytes = _run_multirank(job, tmp)
+            else:
+                job.path, nbytes = _run_single(job, tmp)
             _C_SAVES.inc()
             _C_BYTES.inc(nbytes)
             _H_SAVE_MS.observe((time.perf_counter() - t0) * 1e3)
+            if job.rank == 0:
+                _after_publish(job)
+            return
+        except _BarrierTimeout as e:    # peers dead — never retried
+            job.error = e
+            _C_FAILURES.inc()
+            _logger().error("%s", e)
             return
         except Exception as e:          # noqa: BLE001 — IO layer
             try:
-                if os.path.exists(tmp):
+                # a shared multi-rank tmp dir holds OTHER ranks' live
+                # shards — only the exclusive single-rank tmp is ours
+                # to clear
+                if job.world == 1 and os.path.exists(tmp):
                     shutil.rmtree(tmp)
             except OSError:
                 pass
@@ -363,22 +698,42 @@ def _run_job(job: PendingSave) -> None:
                 time.sleep(backoff * (2 ** attempt))
 
 
-# one writer thread per process: saves serialize in submission order,
-# so a blocking save at the end of fit() also drains everything before
+def _after_publish(job: PendingSave) -> None:
+    """Post-publish housekeeping on the writer thread (rank 0 only):
+    keep-last-N GC of the step-tagged history, and registration with
+    the background verifier.  Never fails the save — the checkpoint is
+    already durable."""
+    from . import checkpoint_gc
+    try:
+        checkpoint_gc.collect(job.directory, rank=job.rank)
+    except Exception:                   # noqa: BLE001
+        _logger().exception("checkpoint GC of %s failed (non-fatal; "
+                            "history kept)", job.directory)
+    try:
+        checkpoint_gc.note_save(job.directory, job.tag)
+    except Exception:                   # noqa: BLE001
+        _logger().exception("background-verify registration failed")
+
+
+# one writer thread per rank key: saves of a rank serialize in
+# submission order (a blocking save at the end of fit() drains
+# everything before it), while threads-as-ranks harnesses get one
+# writer per rank so rank 0's barrier wait can't deadlock rank 1's
+# marker write behind it in a shared queue
 _LOCK = threading.Lock()
-_QUEUE: List[PendingSave] = []
+_QUEUES: Dict[int, List[PendingSave]] = {}
 _PENDING: List[PendingSave] = []
 _WAKE = threading.Condition(_LOCK)
-_writer: Optional[threading.Thread] = None
+_writers: Dict[int, threading.Thread] = {}
 
 
-def _writer_loop() -> None:
-    tracing.register_thread("ckpt-writer")
+def _writer_loop(key: int) -> None:
+    tracing.register_thread(f"ckpt-writer-{key}")
     while True:
         with _LOCK:
-            while not _QUEUE:
+            while not _QUEUES.get(key):
                 _WAKE.wait()
-            job = _QUEUE.pop(0)
+            job = _QUEUES[key].pop(0)
         if not job.superseded:
             _run_job(job)
         job._done.set()
@@ -388,28 +743,40 @@ def _writer_loop() -> None:
 
 
 def _submit(job: PendingSave) -> None:
-    global _writer
+    key = job.rank
     with _LOCK:
+        queue = _QUEUES.setdefault(key, [])
         # coalesce: a queued-but-not-started save of the same target is
         # stale the moment a newer snapshot of it arrives — skip it so a
         # slow filesystem can't queue unbounded host copies
-        for old in _QUEUE:
+        for old in queue:
             if (old.directory, old.tag) == (job.directory, job.tag) \
                     and not old.superseded:
                 old.superseded = True
                 _C_COALESCED.inc()
-        _QUEUE.append(job)
+        queue.append(job)
         _PENDING.append(job)
-        if _writer is None or not _writer.is_alive():
-            _writer = threading.Thread(target=_writer_loop,
-                                       name="ckpt-writer", daemon=True)
-            _writer.start()
-        _WAKE.notify()
+        w = _writers.get(key)
+        if w is None or not w.is_alive():
+            w = threading.Thread(target=_writer_loop, args=(key,),
+                                 name=f"ckpt-writer-{key}", daemon=True)
+            _writers[key] = w
+            w.start()
+        _WAKE.notify_all()
+
+
+def pending_targets() -> List[Tuple[str, str]]:
+    """(directory, tag) of every save submitted but not yet finished —
+    the GC's do-not-touch list."""
+    with _LOCK:
+        return [(j.directory, j.tag) for j in _PENDING]
 
 
 def save(directory: str, tree: Dict[str, Any],
          header: Optional[dict] = None, tag: str = "latest",
-         block: Optional[bool] = None) -> PendingSave:
+         block: Optional[bool] = None, rank: Optional[int] = None,
+         world: Optional[int] = None,
+         commit: Optional[str] = None) -> PendingSave:
     """Checkpoint ``tree`` under ``directory/tag``.
 
     The caller pays only the snapshot (non-blocking D2H launches);
@@ -417,11 +784,25 @@ def save(directory: str, tree: Dict[str, Any],
     ``block=None`` follows ``MXNET_CKPT_ASYNC`` (async by default);
     ``block=True`` waits for the publish and raises ``MXNetError`` on
     failure, ``block=False`` returns immediately — a failed async save
-    logs + counts ``checkpoint.failures`` but never raises."""
+    logs + counts ``checkpoint.failures`` but never raises.
+
+    ``rank``/``world`` (default: :func:`rank_world`) select the commit
+    protocol: with ``world > 1`` every rank serializes its own shards
+    and only rank 0 publishes, after the ready-marker barrier.
+    ``commit`` identifies the save across ranks (default: the header's
+    ``num_update``) — all ranks of one logical save must agree on it."""
     snap = tree if isinstance(tree, Snapshot) else snapshot(tree, header)
     if header is not None and isinstance(tree, Snapshot):
         snap.header = dict(header)
-    job = PendingSave(str(directory), str(tag), snap)
+    if rank is None or world is None:
+        d_rank, d_world = rank_world()
+        rank = d_rank if rank is None else rank
+        world = d_world if world is None else world
+    if commit is None:
+        nu = snap.header.get("num_update")
+        commit = "" if nu is None else str(nu)
+    job = PendingSave(str(directory), str(tag), snap,
+                      rank=rank, world=world, commit=commit)
     _submit(job)
     if block is None:
         block = not async_enabled()
@@ -461,10 +842,40 @@ def _read_manifest(path: str) -> dict:
     return doc
 
 
+def _open_shard_file(path: str, fname: str, files_meta: Dict[str, dict]):
+    """Open one shard npz, digest-verified against the manifest when
+    the save recorded digests (every v2 save since the commit-barrier
+    work; older manifests load digest-unchecked)."""
+    fpath = os.path.join(path, fname)
+    meta = (files_meta or {}).get(fname) or {}
+    want = meta.get("sha256")
+    try:
+        if want:
+            with open(fpath, "rb") as f:
+                raw = f.read()
+            got = hashlib.sha256(raw).hexdigest()
+            if got != want:
+                raise MXNetError(
+                    f"{fpath}: checkpoint shard digest mismatch — "
+                    f"shard file {fname!r} is corrupt (manifest sha256 "
+                    f"{want[:16]}…, on-disk bytes hash {got[:16]}…)")
+            return onp.load(io.BytesIO(raw), allow_pickle=False)
+        return onp.load(fpath, allow_pickle=False)
+    except MXNetError:
+        raise
+    except Exception as e:
+        raise MXNetError(
+            f"{fpath}: corrupted or truncated checkpoint "
+            f"shard file ({type(e).__name__}: {e})") from e
+
+
 def _assemble(path: str, doc: dict) -> Dict[str, onp.ndarray]:
     """Reassemble every leaf's GLOBAL array from its shard files —
     mesh-shape independent: the manifest's slice metadata places each
-    shard regardless of how many devices wrote it."""
+    shard regardless of how many devices (or hosts) wrote it.  Every
+    shard file is SHA-256-verified against the manifest digest before
+    a byte of it is parsed."""
+    files_meta = doc.get("files") or {}
     cache: Dict[str, Any] = {}
     out: Dict[str, onp.ndarray] = {}
     try:
@@ -474,15 +885,7 @@ def _assemble(path: str, doc: dict) -> Dict[str, onp.ndarray]:
             for shd in leaf["shards"]:
                 z = cache.get(shd["file"])
                 if z is None:
-                    fpath = os.path.join(path, shd["file"])
-                    try:
-                        z = onp.load(fpath, allow_pickle=False)
-                    except MXNetError:
-                        raise
-                    except Exception as e:
-                        raise MXNetError(
-                            f"{fpath}: corrupted or truncated checkpoint "
-                            f"shard file ({type(e).__name__}: {e})") from e
+                    z = _open_shard_file(path, shd["file"], files_meta)
                     cache[shd["file"]] = z
                 try:
                     raw = z[shd["key"]]
@@ -506,26 +909,73 @@ def _assemble(path: str, doc: dict) -> Dict[str, onp.ndarray]:
     return out
 
 
+def step_history(directory: str) -> List[Tuple[int, str]]:
+    """The retained ``step-<n>`` checkpoint directories under
+    ``directory`` that still hold a manifest, newest first."""
+    try:
+        names = os.listdir(str(directory))
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        m = _STEP_TAG_RE.fullmatch(name)
+        if not m:
+            continue
+        path = os.path.join(str(directory), name)
+        if os.path.isfile(os.path.join(path, MANIFEST)):
+            out.append((int(m.group(1)), path))
+    out.sort(reverse=True)
+    return out
+
+
 def load(directory: str, tag: str = "latest"
          ) -> Optional[Tuple[Dict[str, onp.ndarray], dict]]:
     """Load the published checkpoint at ``directory/tag`` (falling back
-    to ``tag.old`` if a crash interrupted a publish).  Returns
+    to ``tag.old`` if a crash interrupted a publish, then to the newest
+    ``step-<n>`` history entry with a valid manifest if both are
+    missing or unreadable — each fallback is logged).  Returns
     ``(leaves, header)`` with every leaf assembled to its GLOBAL host
     array — re-place under any mesh/sharding you like — or None when
-    no v2 checkpoint exists.  Corruption raises ``MXNetError``."""
-    cands = [os.path.join(str(directory), tag),
-             os.path.join(str(directory), f"{tag}.old")]
-    for i, cand in enumerate(cands):
+    no v2 checkpoint exists anywhere.  Corruption with no intact
+    fallback raises ``MXNetError``."""
+    primary = os.path.join(str(directory), tag)
+    cands = [(primary, None),
+             (os.path.join(str(directory), f"{tag}.old"),
+              f"publish of {tag!r} was interrupted; restored the "
+              f"{tag}.old backup")]
+    first_err: Optional[MXNetError] = None
+    for cand, note in cands:
         if not os.path.isfile(os.path.join(cand, MANIFEST)):
             continue
         try:
             doc = _read_manifest(cand)
             leaves = _assemble(cand, doc)
-        except MXNetError:
-            if i == 0 and os.path.isfile(os.path.join(cands[1], MANIFEST)):
-                # a torn primary with an intact backup behind it:
-                # fall back rather than fail the restore
-                continue
-            raise
+        except MXNetError as e:
+            if first_err is None:
+                first_err = e
+            _logger().warning("checkpoint %s unreadable (%s); trying "
+                              "fallbacks", cand, e)
+            continue
+        if note:
+            _logger().warning("%s (%s)", note, cand)
         return leaves, dict(doc.get("header") or {})
+    # both the tag and its .old backup are missing or unreadable: scan
+    # the keep-last-N history for the newest loadable checkpoint
+    for step, cand in step_history(directory):
+        try:
+            doc = _read_manifest(cand)
+            leaves = _assemble(cand, doc)
+        except MXNetError as e:
+            if first_err is None:
+                first_err = e
+            _logger().warning("checkpoint history %s unreadable (%s); "
+                              "trying older", cand, e)
+            continue
+        _logger().warning(
+            "checkpoint %s and its backup are missing or unreadable; "
+            "fell back to retained history %s (step %d)",
+            primary, cand, step)
+        return leaves, dict(doc.get("header") or {})
+    if first_err is not None:
+        raise first_err
     return None

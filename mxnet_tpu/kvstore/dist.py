@@ -200,6 +200,12 @@ class DistKVStore(KVStoreBase):
         self._compression = None
         self._nproc = jax.process_count()
         self._rank = jax.process_index()
+        # plumb (rank, world) into the checkpoint layer so multi-host
+        # saves run the rank-0 commit barrier even when callers never
+        # touch MXNET_CKPT_RANK/WORLD — the store is the one component
+        # that reliably knows its process identity
+        from .. import checkpoint as _ckpt
+        _ckpt.set_rank(self._rank, self._nproc)
         self._coll: Optional[_GlobalCollectives] = None
         # ZeRO weight-update sharding state (update_on_kvstore):
         self._opt_states: Dict[Any, tuple] = {}

@@ -771,7 +771,14 @@ class SPMDTrainer:
         failed after retries.  ``block=False`` returns a
         ``checkpoint.PendingSave`` immediately — a failed async save
         logs + increments ``checkpoint.failures`` telemetry, never
-        raises into the training step."""
+        raises into the training step.
+
+        Multi-process runs route through the rank-0 commit protocol:
+        every rank calls this with its OWN addressable shards (the
+        snapshot only captures what this process holds), writes a
+        ready marker, and only rank 0 publishes the merged manifest —
+        rank/world come from ``checkpoint.rank_world()`` (env >
+        kvstore plumbing > ``jax.process_index()``)."""
         from .. import checkpoint as _ckpt
         from ..ops import random as _rand
 
@@ -787,12 +794,16 @@ class SPMDTrainer:
             "slots": {k: len(self._opt_state[k]) for k in self._pkeys},
             "meta": dict(meta or {}),
         }
-        job = _ckpt.save(directory, tree, header, tag=tag, block=block)
+        rank, world = _ckpt.rank_world()
+        job = _ckpt.save(directory, tree, header, tag=tag, block=block,
+                         rank=rank, world=world)
         return job.result() if block else job
 
     def load_checkpoint(self, directory, tag="latest"):
         """Restore a :meth:`save_checkpoint` snapshot (falling back to
-        the ``tag.old`` backup if a crash interrupted a publish).
+        the ``tag.old`` backup if a crash interrupted a publish, then
+        to the newest ``step-<n>`` directory the keep-last-N GC
+        retains when both are missing or digest-corrupt).
         Shards are reassembled to GLOBAL arrays and re-placed under
         THIS trainer's mesh/shardings — a dp=8 save restores onto a
         dp=1 trainer bit-identically (resharded restore).  Also

@@ -158,7 +158,16 @@ def counters() -> Dict[str, Dict[str, int]]:
                     telemetry.counter("checkpoint.failures").value,
                 "coalesced":
                     telemetry.counter("checkpoint.coalesced").value,
-                "bytes": telemetry.counter("checkpoint.bytes").value}}
+                "bytes": telemetry.counter("checkpoint.bytes").value,
+                "gc_removed":
+                    telemetry.counter("checkpoint.gc_removed").value,
+                "verify_passes":
+                    telemetry.counter("checkpoint.verify_passes").value,
+                "verify_failures":
+                    telemetry.counter("checkpoint.verify_failures").value,
+                "faults_injected":
+                    telemetry.counter(
+                        "checkpoint.faults_injected").value}}
 
 
 def set_config(**kwargs):

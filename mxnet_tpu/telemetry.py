@@ -257,6 +257,13 @@ _C_STEP_H2D = counter("input.step_h2d")        # inline transfers ON the
 _C_CKPT_SAVES = counter("checkpoint.saves")
 _C_CKPT_FAILURES = counter("checkpoint.failures")
 _C_CKPT_BYTES = counter("checkpoint.bytes")
+# phase-2 self-healing signals (mxnet_tpu/checkpoint_gc.py): retained
+# checkpoints pruned by keep-last-N GC, background digest sweeps, and
+# faults the injection harness actually delivered (0 in production)
+_C_CKPT_GC = counter("checkpoint.gc_removed")
+_C_CKPT_VPASS = counter("checkpoint.verify_passes")
+_C_CKPT_VFAIL = counter("checkpoint.verify_failures")
+_C_CKPT_FAULTS = counter("checkpoint.faults_injected")
 # ZeRO weight-update sharding health (optimizer/fused_step.py and
 # parallel/trainer.py write these).  The three split counters are the
 # same registry objects record_comm_bytes(kind=...) creates, so split
@@ -510,7 +517,8 @@ class _StepToken:
     __slots__ = ("t0", "compiles", "compile_ms", "comm_bytes",
                  "dispatches", "cs_hits", "cs_compiles", "cs_fallbacks",
                  "cs_breaks", "h2d_bytes", "ckpt_saves", "ckpt_failures",
-                 "ckpt_bytes", "rs_bytes", "ag_bytes", "ar_bytes")
+                 "ckpt_bytes", "ckpt_gc", "ckpt_vpass", "ckpt_vfail",
+                 "rs_bytes", "ag_bytes", "ar_bytes")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -526,6 +534,9 @@ class _StepToken:
         self.ckpt_saves = _C_CKPT_SAVES.value
         self.ckpt_failures = _C_CKPT_FAILURES.value
         self.ckpt_bytes = _C_CKPT_BYTES.value
+        self.ckpt_gc = _C_CKPT_GC.value
+        self.ckpt_vpass = _C_CKPT_VPASS.value
+        self.ckpt_vfail = _C_CKPT_VFAIL.value
         self.rs_bytes = _C_RS_BYTES.value
         self.ag_bytes = _C_AG_BYTES.value
         self.ar_bytes = _C_AR_BYTES.value
@@ -665,6 +676,9 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "saves": _C_CKPT_SAVES.value - token.ckpt_saves,
             "failures": _C_CKPT_FAILURES.value - token.ckpt_failures,
             "bytes": _C_CKPT_BYTES.value - token.ckpt_bytes,
+            "gc_removed": _C_CKPT_GC.value - token.ckpt_gc,
+            "verify_passes": _C_CKPT_VPASS.value - token.ckpt_vpass,
+            "verify_failures": _C_CKPT_VFAIL.value - token.ckpt_vfail,
         },
     }
     histogram("step.host_ms").observe(host_ms)

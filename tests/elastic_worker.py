@@ -53,8 +53,17 @@ def main(argv=None):
     ap.add_argument("--no-checkpoint", action="store_true",
                     help="train without any checkpointing (the baseline "
                          "leg of the step-overhead gate)")
+    ap.add_argument("--fault-spec", default=None,
+                    help="MXNET_FAULT_SPEC to install before training, "
+                         "e.g. 'rename:2:kill' dies exactly at the "
+                         "second publish rename — the deterministic "
+                         "'host dies mid-publish' crash the multihost "
+                         "smoke drives (vs --kill-after's timing-based "
+                         "kill)")
     args = ap.parse_args(argv)
 
+    if args.fault_spec:
+        os.environ["MXNET_FAULT_SPEC"] = args.fault_spec
     # must happen before jax initializes a backend
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     flags = os.environ.get("XLA_FLAGS", "")

@@ -1,18 +1,24 @@
 """Elastic fault-tolerance: the kill/restart soak (a real SIGKILL mid
 training, real fresh-process restart), resharded restore across mesh
-shapes, and the checkpoint failure-semantics contract (torn publish,
-corrupted shards, async degradation)."""
+shapes, the checkpoint failure-semantics contract (torn publish,
+corrupted shards, async degradation), and the phase-2 fault-injection
+matrix: rank-0 commit barrier, keep-last-N GC, digest verification +
+quarantine.  The matrix invariant under test — ANY single injected
+failure leaves ``load("latest")`` returning either a complete
+digest-verified checkpoint or the previous published one, never a
+partial restore."""
 import json
 import os
 import signal
 import subprocess
 import sys
+import time
 
 import numpy as onp
 import pytest
 
 import mxnet_tpu as mx
-from mxnet_tpu import telemetry
+from mxnet_tpu import checkpoint, checkpoint_gc, faultinject, telemetry
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.gluon import nn, loss as gloss
 from mxnet_tpu.ndarray import NDArray
@@ -240,3 +246,248 @@ def test_async_save_failure_degrades_gracefully(tmp_path, monkeypatch):
     # the same failure surfaces as MXNetError when the caller blocks
     with pytest.raises(MXNetError):
         tr.save_checkpoint(str(target), block=True)
+
+
+# -- phase 2: commit barrier, fault matrix, GC, verification ----------------
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    yield
+    faultinject.clear()
+    checkpoint_gc.stop()
+
+
+def _save_np(d, step, **kw):
+    """One-leaf checkpoint whose payload encodes its step — a restore
+    proves WHICH publish it came from, not just that one loaded."""
+    tree = {"w": onp.full((4, 4), float(step), "float32")}
+    return checkpoint.save(str(d), tree, header={"num_update": step},
+                           block=kw.pop("block", True), **kw)
+
+
+def _assert_loaded_step(d, step):
+    leaves, header = checkpoint.load(str(d))
+    assert header["num_update"] == step
+    onp.testing.assert_array_equal(
+        leaves["w"], onp.full((4, 4), float(step), "float32"))
+
+
+def test_two_rank_commit_barrier_roundtrip(tmp_path, monkeypatch):
+    """Threads-as-ranks happy path: each rank serializes only its own
+    leaves, rank 0 merges the marker fragments and publishes ONE
+    manifest covering both, rank 1 returns once that publish lands."""
+    monkeypatch.setenv("MXNET_CKPT_BARRIER_TIMEOUT_S", "30")
+    j0 = checkpoint.save(str(tmp_path),
+                         {"w0": onp.ones((2, 3), "float32")},
+                         header={"num_update": 1}, block=False,
+                         rank=0, world=2)
+    j1 = checkpoint.save(str(tmp_path),
+                         {"w1": onp.full((3,), 2.0, "float32")},
+                         header={"num_update": 1}, block=False,
+                         rank=1, world=2)
+    assert j0.result(60) == j1.result(60)
+    leaves, header = checkpoint.load(str(tmp_path))
+    assert sorted(leaves) == ["w0", "w1"]
+    assert header["num_update"] == 1
+    doc = json.load(open(tmp_path / "latest" / "manifest.json"))
+    assert doc["world"] == 2
+    assert sorted(doc["files"]) == ["shard-r0-d0.npz", "shard-r1-d0.npz"]
+    # barrier-wait telemetry recorded for both sides
+    assert telemetry.histogram("checkpoint.barrier_wait_ms").count >= 2
+
+
+def test_rank_death_before_marker_blocks_publish(tmp_path, monkeypatch):
+    """A non-zero rank dying after its shard writes but BEFORE its
+    ready marker must make rank 0 time out WITHOUT publishing — the
+    previous checkpoint stays the loadable one."""
+    _save_np(tmp_path, 1)                   # previous good publish
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "0")
+    monkeypatch.setenv("MXNET_CKPT_BARRIER_TIMEOUT_S", "1.5")
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "marker_write@1:1")
+    fails = telemetry.counter("checkpoint.failures").value
+    j0 = checkpoint.save(str(tmp_path), {"w": onp.zeros(2, "float32")},
+                         header={"num_update": 2}, block=False,
+                         rank=0, world=2)
+    j1 = checkpoint.save(str(tmp_path), {"v": onp.ones(2, "float32")},
+                         header={"num_update": 2}, block=False,
+                         rank=1, world=2)
+    j0.wait(60), j1.wait(60)
+    assert isinstance(j1.error, faultinject.FaultInjected)
+    assert j0.error is not None             # barrier timeout, no retry
+    assert "barrier" in str(j0.error)
+    assert telemetry.counter("checkpoint.failures").value == fails + 2
+    _assert_loaded_step(tmp_path, 1)        # step-2 was never published
+
+
+def test_rank0_death_after_barrier_blocks_publish(tmp_path, monkeypatch):
+    """Rank 0 dying between marker collection and the manifest rename:
+    nothing publishes, rank 1's bounded wait expires with MXNetError,
+    and a restart loads the previous tag."""
+    _save_np(tmp_path, 1)
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "0")
+    monkeypatch.setenv("MXNET_CKPT_BARRIER_TIMEOUT_S", "1.5")
+    monkeypatch.setenv("MXNET_FAULT_SPEC", "commit@0:1")
+    j0 = checkpoint.save(str(tmp_path), {"w": onp.zeros(2, "float32")},
+                         header={"num_update": 2}, block=False,
+                         rank=0, world=2)
+    j1 = checkpoint.save(str(tmp_path), {"v": onp.ones(2, "float32")},
+                         header={"num_update": 2}, block=False,
+                         rank=1, world=2)
+    j0.wait(60), j1.wait(60)
+    assert isinstance(j0.error, faultinject.FaultInjected)
+    assert j0.error.site == "commit"
+    assert isinstance(j1.error, MXNetError)
+    assert "timed out" in str(j1.error)
+    _assert_loaded_step(tmp_path, 1)
+    # the "restart": a fresh save of the same step goes through (the
+    # stale tmp shards + markers are superseded, not corrupting);
+    # retries back on, as a restarted run would have
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "2")
+    j0 = checkpoint.save(str(tmp_path), {"w": onp.zeros(2, "float32")},
+                         header={"num_update": 2}, block=False,
+                         rank=0, world=2)
+    j1 = checkpoint.save(str(tmp_path), {"v": onp.ones(2, "float32")},
+                         header={"num_update": 2}, block=False,
+                         rank=1, world=2)
+    j0.result(60), j1.result(60)
+    leaves, header = checkpoint.load(str(tmp_path))
+    assert header["num_update"] == 2 and sorted(leaves) == ["v", "w"]
+
+
+@pytest.mark.parametrize("spec", [
+    "shard_write:1", "fsync:1", "manifest_write:1",
+    "rename:1",                     # before latest → latest.old
+    "rename:2",                     # torn: after latest → latest.old
+])
+def test_single_failure_invariant(tmp_path, monkeypatch, spec):
+    """The matrix: under ANY single injected failure (retries off, so
+    the failure sticks), load("latest") returns the PREVIOUS published
+    checkpoint — complete and digest-verified — never a partial one."""
+    _save_np(tmp_path, 1)
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "0")
+    monkeypatch.setenv("MXNET_FAULT_SPEC", spec)
+    with pytest.raises(MXNetError):
+        _save_np(tmp_path, 2, block=True)
+    site = spec.split(":")[0]
+    assert faultinject.hits(site) >= 1      # the site actually ran
+    _assert_loaded_step(tmp_path, 1)
+    # and the retry path heals: same failure with retries on publishes
+    monkeypatch.setenv("MXNET_CKPT_RETRIES", "2")
+    monkeypatch.setenv("MXNET_FAULT_SPEC", spec + ",")  # reset counters
+    _save_np(tmp_path, 3, block=True)
+    _assert_loaded_step(tmp_path, 3)
+
+
+def test_gc_keeps_last_n(tmp_path, monkeypatch):
+    """MXNET_CKPT_KEEP=3: after six publishes only the live tag plus
+    the two newest step dirs remain, and each superseded checkpoint
+    was retired (not deleted) before the excess was pruned."""
+    monkeypatch.setenv("MXNET_CKPT_KEEP", "3")
+    removed = telemetry.counter("checkpoint.gc_removed").value
+    for step in range(1, 7):
+        _save_np(tmp_path, step)
+    entries = sorted(e for e in os.listdir(tmp_path)
+                     if not e.startswith("."))
+    assert entries == ["latest", "step-4", "step-5"]
+    assert telemetry.counter("checkpoint.gc_removed").value == removed + 3
+    _assert_loaded_step(tmp_path, 6)
+    # the retained history is itself loadable (digest-verified)
+    doc_leaves, header = checkpoint.load(str(tmp_path / "step-4"), ".")
+    # (step dirs ARE checkpoint dirs; load(dir, tag) joins dir/tag)
+    assert header["num_update"] == 4
+
+
+def test_gc_never_touches_inflight_target(tmp_path, monkeypatch):
+    """GC must skip any directory an in-flight PendingSave targets,
+    however stale it looks."""
+    monkeypatch.setenv("MXNET_CKPT_KEEP", "2")
+    for step in range(1, 4):
+        _save_np(tmp_path, step)            # leaves latest + step-2
+    assert (tmp_path / "step-2").is_dir()
+    # pin step-2 as an in-flight target, then force a collection
+    snap = checkpoint.snapshot({"w": onp.zeros(1, "float32")}, {})
+    pin = checkpoint.PendingSave(str(tmp_path), "step-2", snap)
+    with checkpoint._LOCK:
+        checkpoint._PENDING.append(pin)
+    try:
+        assert checkpoint_gc.collect(str(tmp_path), keep=1) == 0
+        assert (tmp_path / "step-2").is_dir()
+    finally:
+        with checkpoint._LOCK:
+            checkpoint._PENDING.remove(pin)
+    assert checkpoint_gc.collect(str(tmp_path), keep=1) == 1
+    assert not (tmp_path / "step-2").exists()
+
+
+def test_digest_mismatch_names_offending_shard(tmp_path):
+    """A silent single-byte flip (size and npz framing intact — only
+    the digest can catch it) must fail the load with an error naming
+    the corrupt shard file."""
+    path = _save_np(tmp_path, 1).result()
+    shard = [f for f in os.listdir(path) if f.startswith("shard-")][0]
+    victim = os.path.join(path, shard)
+    raw = bytearray(open(victim, "rb").read())
+    raw[len(raw) // 2] ^= 0x01
+    with open(victim, "wb") as f:
+        f.write(bytes(raw))
+    with pytest.raises(MXNetError, match="digest mismatch") as ei:
+        checkpoint.load(str(tmp_path))
+    assert shard in str(ei.value)
+
+
+def test_verify_and_heal_quarantines_corrupt_checkpoint(tmp_path):
+    """The background verify pass: clean sweep counts a pass; a rotted
+    shard quarantines the checkpoint (demoted out of every load path)
+    and the next load falls back to the previous good one."""
+    for step in (1, 2):
+        _save_np(tmp_path, step)
+    vp = telemetry.counter("checkpoint.verify_passes").value
+    vf = telemetry.counter("checkpoint.verify_failures").value
+    assert checkpoint_gc.verify_and_heal(str(tmp_path)) is True
+    assert telemetry.counter("checkpoint.verify_passes").value == vp + 1
+    shard = [f for f in os.listdir(tmp_path / "latest")
+             if f.startswith("shard-")][0]
+    victim = tmp_path / "latest" / shard
+    raw = bytearray(victim.read_bytes())
+    raw[-5] ^= 0x10
+    victim.write_bytes(bytes(raw))
+    assert checkpoint_gc.verify_and_heal(str(tmp_path)) is False
+    assert telemetry.counter("checkpoint.verify_failures").value == vf + 1
+    assert not (tmp_path / "latest").exists()
+    assert [e for e in os.listdir(tmp_path) if "quarantine" in e]
+    _assert_loaded_step(tmp_path, 1)        # fell back to the history
+
+
+def test_background_verifier_thread_heals(tmp_path, monkeypatch):
+    """End to end: MXNET_CKPT_VERIFY_SEC starts the daemon off a save,
+    and a corrupt newest checkpoint is quarantined within a few
+    periods without anyone calling verify explicitly."""
+    monkeypatch.setenv("MXNET_CKPT_VERIFY_SEC", "0.05")
+    for step in (1, 2):
+        _save_np(tmp_path, step)
+    shard = [f for f in os.listdir(tmp_path / "latest")
+             if f.startswith("shard-")][0]
+    victim = tmp_path / "latest" / shard
+    raw = bytearray(victim.read_bytes())
+    raw[len(raw) // 3] ^= 0x80
+    victim.write_bytes(bytes(raw))
+    deadline = time.monotonic() + 30
+    while (tmp_path / "latest").exists():
+        assert time.monotonic() < deadline, \
+            "background verifier never quarantined the corrupt ckpt"
+        time.sleep(0.05)
+    _assert_loaded_step(tmp_path, 1)
+
+
+def test_load_scan_fallback_logs_which_checkpoint(tmp_path, caplog):
+    """With latest AND latest.old gone, load scans the step-tagged
+    history for the newest valid manifest and logs the fallback."""
+    import shutil
+    for step in (1, 2, 3):
+        _save_np(tmp_path, step)
+    shutil.rmtree(tmp_path / "latest")
+    assert not (tmp_path / "latest.old").exists()
+    with caplog.at_level("WARNING", logger="mxnet_tpu.checkpoint"):
+        _assert_loaded_step(tmp_path, 2)    # newest retained history
+    assert any("fell back to retained history" in r.message
+               and "step-2" in r.message for r in caplog.records)

@@ -243,7 +243,9 @@ def test_profiler_counters_snapshot():
     assert set(c["tracing"]) == {"spans", "dropped", "open",
                                  "watchdog_dumps"}
     assert set(c["checkpoint"]) == {"saves", "failures", "coalesced",
-                                    "bytes"}
+                                    "bytes", "gc_removed",
+                                    "verify_passes", "verify_failures",
+                                    "faults_injected"}
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100
     assert profiler.counters()["fused_step"]["steps"] != \

@@ -107,8 +107,12 @@ def summarize(records):
     ck = [r["checkpoint"] for r in records
           if isinstance(r.get("checkpoint"), dict)]
     ck_saves = sum(c.get("saves", 0) for c in ck)
+    ck_gc = sum(c.get("gc_removed", 0) for c in ck)
+    ck_vpass = sum(c.get("verify_passes", 0) for c in ck)
+    ck_vfail = sum(c.get("verify_failures", 0) for c in ck)
     ckpt = None
-    if ck_saves or any(c.get("failures", 0) for c in ck):
+    if ck_saves or ck_gc or ck_vpass or ck_vfail \
+            or any(c.get("failures", 0) for c in ck):
         ck_bytes = sum(c.get("bytes", 0) for c in ck)
         ckpt = {
             "saves": ck_saves,
@@ -116,6 +120,12 @@ def summarize(records):
             "bytes": ck_bytes,
             "bytes_per_save": ck_bytes / ck_saves if ck_saves else 0,
             "steps_with_commit": sum(1 for c in ck if c.get("saves", 0)),
+            # phase-2 self-healing: keep-last-N GC prunes + background
+            # digest-verification sweeps (a nonzero verify_failures
+            # means a published checkpoint rotted and was quarantined)
+            "gc_removed": ck_gc,
+            "verify_passes": ck_vpass,
+            "verify_failures": ck_vfail,
         }
     # optimizer-sharding deltas (ZeRO sharded update): per-record
     # collective splits (reduce_scatter / all_gather vs allreduce) and
@@ -330,6 +340,10 @@ def render(s):
             f"{'bytes committed':<28}{ck['bytes']:>24}",
             f"{'bytes / save':<28}{ck['bytes_per_save']:>24.1f}",
             f"{'steps with a commit':<28}{ck['steps_with_commit']:>24}",
+            f"{'gc removed (keep-last-N)':<28}"
+            f"{ck.get('gc_removed', 0):>24}",
+            f"{'verify passes':<28}{ck.get('verify_passes', 0):>24}",
+            f"{'verify failures':<28}{ck.get('verify_failures', 0):>24}",
         ]
     sh = s.get("sharding")
     if sh:
