@@ -339,6 +339,111 @@ PY
     rm -rf "$tmp"
 }
 
+cluster_obs_smoke() { # 2 threads-as-ranks + injected slow rank: detector + /metrics
+    # tier-1 covers the join/skew/straggler unit matrix, Prometheus
+    # exposition correctness (TYPE lines, escaping, scrape-vs-step
+    # race), spool tailing, and the disabled-path contract
+    JAX_PLATFORMS=cpu python -m pytest tests/test_cluster_obs.py -q
+    local tmp; tmp="$(mktemp -d)"
+    # a real 2-rank (threads-as-ranks) gluon training run over a shared
+    # spool dir; rank 1 gets a fault-injected 50 ms input stall inside
+    # every step window.  The live aggregator must name rank 1 /
+    # input_bound, and /metrics must serve parseable exposition.
+    JAX_PLATFORMS=cpu MXNET_CLUSTER_DIR="$tmp/spool" \
+        MXNET_CACHED_STEP=0 MXNET_CLUSTER_WINDOW=8 \
+        MXNET_STRAGGLER_FACTOR=1.5 python - <<'PY'
+import json, threading, time, urllib.request
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, clustermon, gluon, nd, telemetry
+
+STEPS = 12
+barrier = threading.Barrier(2)
+errors = []
+
+
+def run_rank(r):
+    try:
+        clustermon.set_thread_rank(r, 2)
+        net = mx.gluon.nn.Sequential()
+        net.add(mx.gluon.nn.Dense(16, activation="relu"),
+                mx.gluon.nn.Dense(4))
+        net.initialize(init=mx.initializer.Xavier())
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1})
+        if r == 1:
+            orig = tr._update
+            def slow_update(ignore):
+                # the injected fault: this rank's input pipeline
+                # stalls 50 ms inside its step window
+                time.sleep(0.05)
+                telemetry.record_input_wait(0.05)
+                return orig(ignore)
+            tr._update = slow_update
+        x = nd.array(onp.random.RandomState(r)
+                     .randn(8, 32).astype("float32"))
+        for _ in range(STEPS):
+            barrier.wait(60)       # lockstep, like a synchronous mesh
+            with autograd.record():
+                loss = (net(x) ** 2).sum()
+            loss.backward()
+            tr.step(batch_size=8)
+    except Exception as e:         # surface thread failures in CI
+        errors.append((r, e))
+        raise
+
+
+telemetry.enabled()                # attach the spool sink up front
+threads = [threading.Thread(target=run_rank, args=(r,)) for r in (0, 1)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join(300)
+assert not errors, errors
+
+agg = clustermon.aggregator()      # auto-started by MXNET_CLUSTER_DIR
+assert agg is not None, "rank-0 aggregator did not start"
+view = agg.poll()                  # one deterministic pass at the end
+st = view["straggler"]
+print("cluster view:", json.dumps(
+    {k: view[k] for k in ("skew", "straggler", "joined_steps")},
+    indent=2))
+assert view["joined_steps"] >= STEPS - 1, view["joined_steps"]
+assert view["skew"]["step_ms"] > 10.0, view["skew"]
+assert st is not None and st["rank"] == 1, st
+assert st["cause"] == "input_bound", st
+assert telemetry.gauge("cluster.straggler_rank").value == 1
+assert telemetry.gauge("cluster.straggler_cause").value == "input_bound"
+
+host, port = clustermon.start_metrics_server(0, host="127.0.0.1")
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                            timeout=10) as resp:
+    assert "version=0.0.4" in resp.headers["Content-Type"]
+    text = resp.read().decode()
+parsed = clustermon.parse_prometheus_text(text)   # raises if malformed
+(labels, val), = parsed["mxnet_cluster_straggler_rank"]
+assert val == 1.0 and labels["rank"] == "0", (labels, val)
+assert all("rank" in l for ss in parsed.values() for l, _v in ss), \
+    "sample without a rank label"
+clustermon.stop_metrics_server()
+print(f"cluster_obs_smoke: straggler rank {st['rank']} "
+      f"cause {st['cause']} ({st['ratio']:.1f}x over peer median); "
+      f"/metrics parsed clean ({len(parsed)} series)")
+PY
+    # the offline post-mortem over the same spools must agree with the
+    # live aggregator (same join/detect code path)
+    JAX_PLATFORMS=cpu python tools/cluster_report.py "$tmp/spool" \
+        --factor 1.5 | tee "$tmp/report.txt"
+    grep -q "rank 1 is the straggler" "$tmp/report.txt"
+    grep -q "dominant cause: input_bound" "$tmp/report.txt"
+    # and the merged multi-rank telemetry report renders the per-rank
+    # breakdown off the very same files
+    JAX_PLATFORMS=cpu python tools/telemetry_report.py \
+        "$tmp"/spool/rank-*.jsonl | tee "$tmp/telemetry.txt"
+    grep -q "Per-rank breakdown" "$tmp/telemetry.txt"
+    rm -rf "$tmp"
+}
+
 zero_smoke() {        # ZeRO-1 sharded update: tests + memory/time gates
     # tier-1 covers dp=2 equivalence, env gating, checkpoint resharding
     # across dp=1/2/4, eager bitwise parity and the 1-dispatch cached

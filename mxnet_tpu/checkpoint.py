@@ -115,6 +115,17 @@ _C_BYTES = telemetry.counter("checkpoint.bytes")
 _H_SAVE_MS = telemetry.histogram("checkpoint.save_ms")
 _H_SNAP_MS = telemetry.histogram("checkpoint.snapshot_ms")
 _H_BARRIER_MS = telemetry.histogram("checkpoint.barrier_wait_ms")
+# cumulative twin of the histogram: per-step DELTAS of a counter are
+# cheap, so telemetry.end_step exports this one into each step record
+# (checkpoint.barrier_wait_ms) for clustermon's cross-rank
+# barrier-asymmetry view
+_C_BARRIER_MS = telemetry.counter("checkpoint.barrier_wait_ms_total")
+
+
+def _observe_barrier_wait(t0: float) -> None:
+    ms = (time.perf_counter() - t0) * 1e3
+    _H_BARRIER_MS.observe(ms)
+    _C_BARRIER_MS.inc(ms)
 
 
 def async_enabled() -> bool:
@@ -457,7 +468,7 @@ def _collect_markers(tmp: str, world: int, commit: str,
                     f"{sorted(missing)} (commit {commit!r}) — NOT "
                     f"publishing; the previous checkpoint stays live")
             time.sleep(0.02)
-    _H_BARRIER_MS.observe((time.perf_counter() - t0) * 1e3)
+    _observe_barrier_wait(t0)
     return frags
 
 
@@ -486,7 +497,7 @@ def _await_publish(directory: str, tag: str, commit: str,
                     f"for rank 0 to publish {final!r} (commit "
                     f"{commit!r}) — coordinator dead or partitioned")
             time.sleep(0.05)
-    _H_BARRIER_MS.observe((time.perf_counter() - t0) * 1e3)
+    _observe_barrier_wait(t0)
     return final
 
 

@@ -1,0 +1,727 @@
+"""Cluster-scope observability: rank-aware aggregation + Prometheus.
+
+Telemetry (telemetry.py) and the flight recorder (tracing.py) are
+strictly single-process; once the commit barrier (checkpoint.py) makes
+multi-host the default failure domain, the first-order question stops
+being "is this step slow" and becomes "WHICH rank made it slow, and
+why".  This module is that layer, built on the same file-based rank
+coordination the checkpoint barrier already proved out:
+
+- **Spools**: with ``MXNET_CLUSTER_DIR`` set, every rank appends its
+  per-step telemetry record (stamped ``rank``/``world`` — resolved
+  through the checkpoint ``set_rank`` precedence chain, plus a
+  thread-local override for threads-as-ranks harnesses) to
+  ``<dir>/rank-<r>.jsonl``.  One JSON object per line, flushed per
+  record, so a live cluster can be tailed from any host that mounts
+  the shared directory.
+- **Aggregator** (rank 0 only): a daemon thread tails all spools,
+  joins records by per-rank step ordinal, and produces a cluster view:
+  per-rank step-time skew, barrier-wait asymmetry, and a per-step
+  critical-path decomposition (input wait / H2D / compile / collective
+  / optimizer update / checkpoint) derived from tracing-span bucket
+  deltas where tracing is live and record fields where it is not.
+- **Straggler detector**: over a sliding window
+  (``MXNET_CLUSTER_WINDOW`` joined steps) the slowest rank is named
+  when its mean step time exceeds ``MXNET_STRAGGLER_FACTOR`` × the
+  median of its peers, and its dominant cause is classified
+  (``input_bound`` / ``compile_stall`` / ``ckpt_interference`` /
+  ``comm_skew``) from the per-signal excess over the peer median.
+  Results land in the ``cluster.straggler_rank`` /
+  ``cluster.straggler_cause`` gauges with ONE log line per incident
+  (re-logged only when the rank or cause changes).
+- **Prometheus**: :func:`prometheus_text` renders the whole telemetry
+  registry in text exposition format (``# TYPE`` lines, ``rank=""``
+  label on every sample, histograms as summaries with reservoir
+  quantiles).  ``GET /metrics`` on the serving server and a standalone
+  ``MXNET_METRICS_PORT`` exporter for training runs serve it.
+
+Disabled contract: with ``MXNET_CLUSTER_DIR`` and
+``MXNET_METRICS_PORT`` unset nothing here runs — no spool files, no
+aggregator or exporter thread, and the step path is bitwise identical
+to the pre-clustermon build (telemetry's ``begin_step`` fast path is
+untouched).  ``tools/cluster_report.py`` replays the same join +
+detection over spools offline for post-mortems.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from . import telemetry
+
+__all__ = ["rank_world", "set_thread_rank", "note_rank", "SpoolSink",
+           "ClusterAggregator", "aggregator", "cluster_view",
+           "join_by_step", "window_stats", "detect_straggler",
+           "record_signals", "CAUSES",
+           "prometheus_text", "parse_prometheus_text",
+           "start_metrics_server", "stop_metrics_server",
+           "metrics_server_address"]
+
+_LOCK = threading.Lock()
+
+_SPOOL_RE = re.compile(r"rank-(\d+)\.jsonl$")
+
+# cluster-health metrics (created eagerly so profiler.counters() and a
+# /metrics scrape always see the keys, zeros/none before the first
+# aggregator pass)
+_G_RANKS = telemetry.gauge("cluster.ranks")
+_G_SKEW = telemetry.gauge("cluster.step_ms_skew")
+_G_BARRIER_SKEW = telemetry.gauge("cluster.barrier_wait_skew_ms")
+_G_STRAGGLER = telemetry.gauge("cluster.straggler_rank")
+_G_CAUSE = telemetry.gauge("cluster.straggler_cause")
+_C_INCIDENTS = telemetry.counter("cluster.straggler_incidents")
+_C_JOINED = telemetry.counter("cluster.joined_steps")
+
+
+def _logger():
+    from .log import get_logger
+    return get_logger("mxnet_tpu.clustermon")
+
+
+# -- rank/world resolution ---------------------------------------------------
+# Precedence: per-thread override (threads-as-ranks harnesses) > the
+# checkpoint chain (explicit env > DistKVStore's set_rank plumbing >
+# jax.process_index()).  The checkpoint resolution is cached keyed on
+# the inputs it depends on, so per-span stamping never pays a backend
+# call.
+
+_tls = threading.local()
+_rank_cache: Dict[str, Any] = {"key": None, "rw": (0, 1)}
+
+
+def set_thread_rank(rank: Optional[int], world: int = 1) -> None:
+    """Pin (rank, world) for the CALLING thread only — how a
+    threads-as-ranks harness gives each worker thread its own spool.
+    ``None`` clears the override."""
+    if rank is None:
+        _tls.rw = None
+    else:
+        _tls.rw = (int(rank), max(1, int(world)))
+
+
+def note_rank(rank: int, world: int) -> None:
+    """Invalidate the cached process-level resolution (called by the
+    dist kvstore right after ``checkpoint.set_rank`` so the next record
+    picks the plumbed identity up immediately)."""
+    with _LOCK:
+        _rank_cache["key"] = None
+
+
+def rank_world() -> Tuple[int, int]:
+    """(rank, world) for stamping records and spans."""
+    rw = getattr(_tls, "rw", None)
+    if rw is not None:
+        return rw
+    return _process_rank_world()
+
+
+def _process_rank_world() -> Tuple[int, int]:
+    """The checkpoint-chain resolution only (no thread-local override)
+    — what decides which PROCESS hosts the aggregator."""
+    from . import checkpoint
+    key = (os.environ.get("MXNET_CKPT_RANK"),
+           os.environ.get("MXNET_CKPT_WORLD"),
+           checkpoint._rank_override)
+    with _LOCK:
+        if key == _rank_cache["key"]:
+            return _rank_cache["rw"]
+    try:
+        rw = checkpoint.rank_world()
+    except Exception:
+        rw = (0, 1)     # invalid env raises at save() where it matters
+    with _LOCK:
+        _rank_cache["key"] = key
+        _rank_cache["rw"] = rw
+    return rw
+
+
+# -- per-rank spools ---------------------------------------------------------
+
+class SpoolSink:
+    """Telemetry sink appending each step record to the emitting rank's
+    spool (``<dir>/rank-<r>.jsonl``).  A ``rank_step`` ordinal (this
+    rank's Nth record) is stamped so the aggregator can join steps
+    across ranks even when the process-global ``step`` counter
+    interleaves (threads-as-ranks)."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._files: Dict[int, Any] = {}
+        self._counts: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict) -> None:
+        r = int(record.get("rank", 0))
+        with self._lock:
+            n = self._counts.get(r, 0) + 1
+            self._counts[r] = n
+            f = self._files.get(r)
+            if f is None:
+                path = os.path.join(self.directory, f"rank-{r}.jsonl")
+                f = self._files[r] = open(path, "a", buffering=1)
+        f.write(json.dumps(dict(record, rank_step=n)) + "\n")
+
+    def close(self) -> None:
+        with self._lock:
+            for f in self._files.values():
+                try:
+                    f.close()
+                except Exception:
+                    pass
+            self._files.clear()
+
+
+# -- record signal extraction ------------------------------------------------
+
+# straggler cause classes, in the order the ARCHITECTURE decision-rule
+# table documents them
+CAUSES = ("input_bound", "compile_stall", "ckpt_interference",
+          "comm_skew")
+
+_SIG_OF_CAUSE = {"input_bound": "input", "compile_stall": "compile",
+                 "ckpt_interference": "checkpoint", "comm_skew": "comm"}
+_CAUSE_OF_SIG = {v: k for k, v in _SIG_OF_CAUSE.items()}
+
+
+def record_signals(rec: dict) -> Dict[str, float]:
+    """Per-record attribution signals (ms) for the straggler
+    classifier.  Span-bucket deltas (``critical_path``, present when
+    tracing is live) and record fields measure overlapping intervals —
+    ``max`` of the two is taken per signal rather than their sum so a
+    traced run never double-counts."""
+    cp = rec.get("critical_path") or {}
+    ck = rec.get("checkpoint") or {}
+    return {
+        "input": max(float(rec.get("input_wait_ms") or 0.0),
+                     float(cp.get("input_wait") or 0.0)),
+        "compile": max(float(rec.get("compile_ms") or 0.0),
+                       float(cp.get("compile") or 0.0)),
+        "checkpoint": max(float(ck.get("barrier_wait_ms") or 0.0),
+                          float(cp.get("checkpoint") or 0.0)),
+        "comm": float(cp.get("collective") or 0.0),
+    }
+
+
+def join_by_step(by_rank: Dict[int, List[dict]]) -> Dict[int, Dict[int,
+                                                                   dict]]:
+    """Join records across ranks: {step: {rank: record}}.  The join key
+    is the per-rank ``rank_step`` ordinal the spool sink stamps (the
+    i-th record a rank emitted IS its i-th step), falling back to
+    position for spools that predate the field."""
+    joined: Dict[int, Dict[int, dict]] = {}
+    for r, recs in by_rank.items():
+        for i, rec in enumerate(recs):
+            step = int(rec.get("rank_step", i + 1))
+            joined.setdefault(step, {})[r] = rec
+    return joined
+
+
+def _mean(vals: List[float]) -> float:
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def _median(vals: List[float]) -> float:
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    n = len(s)
+    return s[n // 2] if n % 2 else 0.5 * (s[n // 2 - 1] + s[n // 2])
+
+
+def window_stats(by_rank: Dict[int, List[dict]],
+                 window: int) -> Dict[int, dict]:
+    """Per-rank aggregates over the trailing ``window`` JOINED steps
+    (only steps every rank has reported — a rank that is behind must
+    not look fast because its slow steps haven't landed yet)."""
+    joined = join_by_step(by_rank)
+    ranks = sorted(by_rank)
+    complete = sorted(s for s, per in joined.items()
+                      if all(r in per for r in ranks))
+    tail = complete[-window:] if window else complete
+    stats: Dict[int, dict] = {}
+    for r in ranks:
+        recs = [joined[s][r] for s in tail]
+        host = [float(x.get("host_ms") or 0.0) for x in recs]
+        sigs = [record_signals(x) for x in recs]
+        cps = [x.get("critical_path") or {} for x in recs]
+        stats[r] = {
+            "steps": len(recs),
+            "host_ms_mean": _mean(host),
+            "host_ms_max": max(host, default=0.0),
+            "signals": {k: _mean([s[k] for s in sigs])
+                        for k in ("input", "compile", "checkpoint",
+                                  "comm")},
+            "critical_path": {
+                k: _mean([float(c.get(k) or 0.0) for c in cps])
+                for k in ("input_wait", "h2d", "compile", "collective",
+                          "optimizer", "checkpoint", "compute")},
+            "barrier_wait_ms_mean": _mean(
+                [float((x.get("checkpoint") or {})
+                       .get("barrier_wait_ms") or 0.0) for x in recs]),
+        }
+    return stats
+
+
+def detect_straggler(stats: Dict[int, dict],
+                     factor: float) -> Optional[dict]:
+    """Name the slowest rank in a window and classify its dominant
+    cause.  Decision rule (docs/ARCHITECTURE.md "Cluster
+    observability"): the slowest rank is a straggler when its mean
+    step time exceeds ``factor`` × the median of the OTHER ranks';
+    its cause is the signal with the largest excess over the peer
+    median, or ``unknown`` when no signal explains ≥10% of the step
+    -time excess (unattributed compute — a thermally-throttled chip
+    looks like this)."""
+    live = {r: s for r, s in stats.items() if s["steps"]}
+    if len(live) < 2:
+        return None
+    slowest = max(live, key=lambda r: live[r]["host_ms_mean"])
+    peers = [live[r]["host_ms_mean"] for r in live if r != slowest]
+    med = _median(peers)
+    mean = live[slowest]["host_ms_mean"]
+    if med <= 0.0 or mean <= factor * med:
+        return None
+    excess = {
+        sig: live[slowest]["signals"][sig]
+        - _median([live[r]["signals"][sig] for r in live if r != slowest])
+        for sig in ("input", "compile", "checkpoint", "comm")}
+    total_excess = mean - med
+    top = max(excess, key=lambda k: excess[k])
+    if excess[top] <= 0.0 or excess[top] < 0.1 * total_excess:
+        cause = "unknown"
+    else:
+        cause = _CAUSE_OF_SIG[top]
+    return {"rank": slowest, "cause": cause,
+            "ratio": mean / med, "step_ms": mean, "peer_ms": med,
+            "excess_ms": {_CAUSE_OF_SIG[k]: round(v, 3)
+                          for k, v in excess.items()}}
+
+
+# -- the rank-0 aggregator ---------------------------------------------------
+
+def _straggler_factor() -> float:
+    v = os.environ.get("MXNET_STRAGGLER_FACTOR")
+    try:
+        return max(1.0, float(v)) if v else 1.5
+    except ValueError:
+        return 1.5
+
+
+def _cluster_window() -> int:
+    v = os.environ.get("MXNET_CLUSTER_WINDOW")
+    try:
+        return max(1, int(v)) if v else 20
+    except ValueError:
+        return 20
+
+
+class ClusterAggregator:
+    """Tails every ``rank-*.jsonl`` spool in ``directory``, joins
+    records by step, and maintains the cluster view + gauges.  Owns an
+    optional daemon thread (:meth:`start`); :meth:`poll` runs one pass
+    synchronously so tests and the report tool stay deterministic."""
+
+    def __init__(self, directory: str, window: Optional[int] = None,
+                 factor: Optional[float] = None, poll_s: float = 0.5,
+                 keep: int = 512):
+        self.directory = directory
+        self.window = window if window is not None else _cluster_window()
+        self.factor = factor if factor is not None else _straggler_factor()
+        self.poll_s = max(0.05, float(poll_s))
+        self.keep = max(self.window * 4, keep)
+        self._tails: Dict[str, Tuple[int, bytes]] = {}
+        self._by_rank: Dict[int, List[dict]] = {}
+        self._view: dict = {"ranks": {}, "straggler": None, "skew": None,
+                            "window": self.window, "joined_steps": 0}
+        self._joined_seen = 0
+        self._incident: Optional[Tuple[int, str]] = None
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+
+    # -- spool tailing -------------------------------------------------------
+
+    def _read_new(self) -> bool:
+        """Drain complete new lines from every spool; True when any
+        record arrived.  Offsets are byte-exact and a partial trailing
+        line (a rank mid-write) is buffered until its newline lands."""
+        grew = False
+        try:
+            names = sorted(os.listdir(self.directory))
+        except OSError:
+            return False
+        for name in names:
+            m = _SPOOL_RE.match(name)
+            if not m:
+                continue
+            rank = int(m.group(1))
+            path = os.path.join(self.directory, name)
+            off, buf = self._tails.get(path, (0, b""))
+            try:
+                with open(path, "rb") as f:
+                    f.seek(off)
+                    data = f.read()
+            except OSError:
+                continue
+            if not data:
+                continue
+            off += len(data)
+            buf += data
+            *lines, buf = buf.split(b"\n")
+            self._tails[path] = (off, buf)
+            recs = self._by_rank.setdefault(rank, [])
+            for ln in lines:
+                if not ln.strip():
+                    continue
+                try:
+                    recs.append(json.loads(ln))
+                    grew = True
+                except ValueError:
+                    continue            # torn write; skip the line
+            if len(recs) > self.keep:
+                del recs[:len(recs) - self.keep]
+        return grew
+
+    # -- view / gauges -------------------------------------------------------
+
+    def poll(self) -> dict:
+        """One synchronous pass: tail spools, recompute the view,
+        refresh gauges, log new incidents.  Returns the view."""
+        with self._lock:
+            grew = self._read_new()
+            if grew or not self._view["ranks"]:
+                self._recompute()
+            return dict(self._view)
+
+    def _recompute(self) -> None:
+        stats = window_stats(self._by_rank, self.window)
+        straggler = detect_straggler(stats, self.factor)
+        means = [s["host_ms_mean"] for s in stats.values() if s["steps"]]
+        barrier = [s["barrier_wait_ms_mean"] for s in stats.values()
+                   if s["steps"]]
+        joined = join_by_step(self._by_rank)
+        ranks = sorted(self._by_rank)
+        complete = sum(1 for per in joined.values()
+                       if all(r in per for r in ranks))
+        skew = None
+        if len(means) >= 2:
+            skew = {"step_ms": max(means) - min(means),
+                    "step_ratio": max(means) / min(means)
+                    if min(means) > 0 else None,
+                    "barrier_wait_ms": max(barrier) - min(barrier)}
+        self._view = {"ranks": stats, "straggler": straggler,
+                      "skew": skew, "window": self.window,
+                      "joined_steps": complete}
+        # gauges: the scrapeable face of the view
+        _G_RANKS.set(len(ranks))
+        new_joined = complete - self._joined_seen
+        if new_joined > 0:
+            _C_JOINED.inc(new_joined)
+            self._joined_seen = complete
+        if skew:
+            _G_SKEW.set(round(skew["step_ms"], 3))
+            _G_BARRIER_SKEW.set(round(skew["barrier_wait_ms"], 3))
+        if straggler is None:
+            _G_STRAGGLER.set(-1)
+            _G_CAUSE.set("none")
+            self._incident = None
+            return
+        _G_STRAGGLER.set(int(straggler["rank"]))
+        _G_CAUSE.set(straggler["cause"])
+        incident = (int(straggler["rank"]), straggler["cause"])
+        if incident != self._incident:    # once per incident
+            self._incident = incident
+            _C_INCIDENTS.inc()
+            _logger().warning(
+                "cluster straggler: rank %d is %.2fx the peer median "
+                "(%.2f ms vs %.2f ms over the last %d joined steps); "
+                "dominant cause: %s (excess ms %s)",
+                straggler["rank"], straggler["ratio"],
+                straggler["step_ms"], straggler["peer_ms"],
+                self.window, straggler["cause"], straggler["excess_ms"])
+
+    def view(self) -> dict:
+        with self._lock:
+            return dict(self._view)
+
+    # -- thread lifecycle ----------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="mxnet-clustermon",
+                                        daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop_evt.wait(self.poll_s):
+            try:
+                self.poll()
+            except Exception:
+                _logger().exception("cluster aggregator poll failed")
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        t = self._thread
+        if t is not None:
+            t.join(5.0)
+        self._thread = None
+
+
+_aggregator: Optional[ClusterAggregator] = None
+
+
+def aggregator() -> Optional[ClusterAggregator]:
+    """The live aggregator (rank 0 with MXNET_CLUSTER_DIR set), else
+    None."""
+    return _aggregator
+
+
+def cluster_view() -> Optional[dict]:
+    """The aggregator's current cluster view (None when not running)."""
+    agg = _aggregator
+    return agg.view() if agg is not None else None
+
+
+def _on_cluster_dir(directory: Optional[str]) -> None:
+    """telemetry's env-refresh hook: start/stop the aggregator as
+    ``MXNET_CLUSTER_DIR`` appears/changes/vanishes.  Only the rank-0
+    PROCESS runs one (the thread-local rank override is deliberately
+    ignored: under threads-as-ranks any worker thread may trigger the
+    env refresh, and the process as a whole is rank 0)."""
+    global _aggregator
+    if _aggregator is not None and \
+            (directory is None or _aggregator.directory != directory):
+        _aggregator.stop()
+        _aggregator = None
+    if directory and _aggregator is None and \
+            _process_rank_world()[0] == 0:
+        _aggregator = ClusterAggregator(directory)
+        _aggregator.start()
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_NAME_SANE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str) -> str:
+    return "mxnet_" + _NAME_SANE.sub("_", name)
+
+
+def _escape_label(v: str) -> str:
+    return (str(v).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def _labels(d: Dict[str, Any]) -> str:
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(d.items()))
+    return "{" + inner + "}"
+
+
+def _fmt(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return repr(f) if f == f else "NaN"
+
+
+def prometheus_text(extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """The whole telemetry registry in Prometheus text exposition
+    format (v0.0.4).  Every sample carries a ``rank`` label (the
+    MegaScale-style per-rank metrics plane: one scrape config, rank as
+    the aggregation dimension); counters render as ``counter``, gauges
+    as ``gauge`` (string-valued gauges like ``cluster.straggler_cause``
+    become a ``1``-valued sample with the string in a label), and
+    histograms as ``summary`` — reservoir p50/p95 quantiles plus exact
+    ``_sum``/``_count``."""
+    r, _w = rank_world()
+    base = dict(extra_labels or {})
+    base["rank"] = str(r)
+    out: List[str] = []
+    for name, m in telemetry.metrics().items():
+        pname = _metric_name(name)
+        if isinstance(m, telemetry.Counter):
+            out.append(f"# TYPE {pname} counter")
+            out.append(f"{pname}{_labels(base)} {_fmt(m.value)}")
+        elif isinstance(m, telemetry.Gauge):
+            v = m.value
+            if v is None:
+                continue
+            out.append(f"# TYPE {pname} gauge")
+            if isinstance(v, str):
+                key = "cause" if name.endswith("cause") else "value"
+                out.append(f"{pname}{_labels(dict(base, **{key: v}))} 1")
+            else:
+                out.append(f"{pname}{_labels(base)} {_fmt(v)}")
+        elif isinstance(m, telemetry.Histogram):
+            out.append(f"# TYPE {pname} summary")
+            samples = sorted(m.samples())
+            for q, qs in ((50, "0.5"), (95, "0.95")):
+                if samples:
+                    k = max(0, min(len(samples) - 1,
+                                   round(q / 100 * (len(samples) - 1))))
+                    out.append(f"{pname}{_labels(dict(base, quantile=qs))}"
+                               f" {_fmt(samples[k])}")
+            out.append(f"{pname}_sum{_labels(base)} {_fmt(m.total)}")
+            out.append(f"{pname}_count{_labels(base)} {_fmt(m.count)}")
+    return "\n".join(out) + "\n"
+
+
+_TYPE_RE = re.compile(
+    r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) "
+    r"(counter|gauge|histogram|summary|untyped)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{((?:[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\]|\\.)*\",?)*)\})?"
+    r" (-?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)|NaN|[+-]Inf)"
+    r"(?: -?[0-9]+)?$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    return (v.replace("\\\\", "\x00").replace("\\n", "\n")
+            .replace('\\"', '"').replace("\x00", "\\"))
+
+
+def parse_prometheus_text(text: str) -> Dict[str, List[Tuple[Dict[str,
+                                                                  str],
+                                                             float]]]:
+    """Strict-ish exposition parser used by the tests and the CI
+    scrape check: validates ``# TYPE`` lines and sample syntax, resolves
+    label escapes, and requires every sample's base metric (modulo
+    ``_sum``/``_count``/``_bucket`` suffixes) to have a preceding TYPE
+    line.  Raises ``ValueError`` on any malformed line.  Returns
+    {metric name: [(labels, value)]}."""
+    types: Dict[str, str] = {}
+    out: Dict[str, List[Tuple[Dict[str, str], float]]] = {}
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            if line.startswith("# HELP "):
+                continue
+            m = _TYPE_RE.match(line)
+            if m is None:
+                raise ValueError(f"line {i}: bad comment/TYPE line "
+                                 f"{line!r}")
+            types[m.group(1)] = m.group(2)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"line {i}: bad sample line {line!r}")
+        name, rawlabels, val = m.group(1), m.group(2), m.group(3)
+        base = re.sub(r"_(sum|count|bucket)$", "", name)
+        if name not in types and base not in types:
+            raise ValueError(f"line {i}: sample {name!r} has no "
+                             f"preceding # TYPE line")
+        labels = {}
+        if rawlabels:
+            consumed = 0
+            for lm in _LABEL_RE.finditer(rawlabels):
+                labels[lm.group(1)] = _unescape_label(lm.group(2))
+                consumed = lm.end()
+            if rawlabels[consumed:].strip(", "):
+                raise ValueError(f"line {i}: bad label syntax "
+                                 f"{rawlabels!r}")
+        out.setdefault(name, []).append((labels, float(val)))
+    return out
+
+
+# -- standalone /metrics exporter (training processes) -----------------------
+
+_metrics_httpd = None
+_metrics_thread = None
+_metrics_addr: Optional[Tuple[str, int]] = None
+
+
+def start_metrics_server(port: int = 0,
+                         host: str = "0.0.0.0") -> Tuple[str, int]:
+    """Serve ``GET /metrics`` (text exposition) + ``GET /healthz`` on a
+    daemon thread — the scrape surface for training processes, which
+    have no serving server.  Returns the bound ``(host, port)``
+    (OS-assigned when ``port=0``).  Idempotent: an exporter already
+    running keeps its socket."""
+    global _metrics_httpd, _metrics_thread, _metrics_addr
+    with _LOCK:
+        if _metrics_httpd is not None:
+            return _metrics_addr
+        from http.server import BaseHTTPRequestHandler, \
+            ThreadingHTTPServer
+
+        class _Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                pass
+
+            def do_GET(self):
+                if self.path.split("?", 1)[0] == "/metrics":
+                    body = prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path == "/healthz":
+                    view = cluster_view()
+                    body = json.dumps(
+                        {"status": "ok", "rank": rank_world()[0],
+                         "world": rank_world()[1],
+                         "cluster": view}).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        _metrics_httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        _metrics_httpd.daemon_threads = True
+        _metrics_thread = threading.Thread(
+            target=_metrics_httpd.serve_forever,
+            name="mxnet-metrics-exporter", daemon=True)
+        _metrics_thread.start()
+        _metrics_addr = _metrics_httpd.server_address[:2]
+        return _metrics_addr
+
+
+def stop_metrics_server() -> None:
+    global _metrics_httpd, _metrics_thread, _metrics_addr
+    with _LOCK:
+        httpd, thread = _metrics_httpd, _metrics_thread
+        _metrics_httpd = _metrics_thread = _metrics_addr = None
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+        if thread is not None:
+            thread.join(5.0)
+
+
+def metrics_server_address() -> Optional[Tuple[str, int]]:
+    return _metrics_addr
+
+
+def _on_metrics_port(port: Optional[str]) -> None:
+    """telemetry's env-refresh hook for ``MXNET_METRICS_PORT``."""
+    if not port:
+        stop_metrics_server()
+        return
+    try:
+        p = int(port)
+    except ValueError:
+        _logger().warning("invalid MXNET_METRICS_PORT=%r (want an int)",
+                          port)
+        return
+    if _metrics_httpd is None:
+        addr = start_metrics_server(p)
+        _logger().info("metrics exporter serving /metrics on %s:%d",
+                       *addr)

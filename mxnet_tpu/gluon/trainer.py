@@ -223,7 +223,8 @@ class Trainer:
         # inner kvstore pushpull nests and only accumulates counters
         tok = telemetry.begin_step()
         try:
-            with tracing.span("step.gluon"):
+            with tracing.span("step.gluon",
+                              step=self._optimizer.num_update + 1):
                 if not self._kv_initialized:
                     self._init_kvstore()
                 new_rescale = self._scale / batch_size

@@ -203,9 +203,13 @@ class DistKVStore(KVStoreBase):
         # plumb (rank, world) into the checkpoint layer so multi-host
         # saves run the rank-0 commit barrier even when callers never
         # touch MXNET_CKPT_RANK/WORLD — the store is the one component
-        # that reliably knows its process identity
+        # that reliably knows its process identity.  clustermon shares
+        # the same chain (its telemetry-record/span stamping caches the
+        # resolution, so poke it to re-resolve now)
         from .. import checkpoint as _ckpt
+        from .. import clustermon as _cmon
         _ckpt.set_rank(self._rank, self._nproc)
+        _cmon.note_rank(self._rank, self._nproc)
         self._coll: Optional[_GlobalCollectives] = None
         # ZeRO weight-update sharding state (update_on_kvstore):
         self._opt_states: Dict[Any, tuple] = {}

@@ -418,6 +418,7 @@ class SPMDTrainer:
         try:
             with tracing.span("step.spmd") as _sp:
                 self.num_update += 1
+                _sp.annotate(step=self.num_update)
                 lr = jnp.float32(self.optimizer.learning_rate)
                 wd = jnp.float32(self.optimizer.wd)
                 self.optimizer.num_update = self.num_update
@@ -558,7 +559,8 @@ class SPMDTrainer:
         # device program / one dispatch)
         tok = telemetry.begin_step()
         try:
-            with tracing.span("step.spmd_window", n_steps=int(n_steps)):
+            with tracing.span("step.spmd_window", n_steps=int(n_steps),
+                              step=self.num_update + 1):
                 # read lr/wd BEFORE advancing num_update — matching what
                 # the first of n sequential step() calls would use (the
                 # whole fused window trains at the window-entry schedule

@@ -114,6 +114,10 @@ def counters() -> Dict[str, Dict[str, int]]:
       failed saves after retries, queue-coalesced saves, bytes
       committed — mxnet_tpu/checkpoint.py; ``failures`` staying 0 is
       the graceful-degradation invariant)
+    - ``cluster``: cross-rank observability (this process's rank/world,
+      the rank-0 aggregator's straggler verdict and incident count —
+      mxnet_tpu/clustermon.py; ``straggler_rank`` is -1 while no rank
+      is slow enough to name)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -123,6 +127,7 @@ def counters() -> Dict[str, Dict[str, int]]:
     from .optimizer import optimizer as _optimizer
     from .optimizer import fused_step as _fused_step
     from .imperative import cached_step as _cached_step
+    from . import clustermon as _clustermon
     return {"eager_jit": _registry.jit_cache_stats(),
             "fused_step": _fused_step.stats(),
             "cached_step": _cached_step.stats(),
@@ -167,7 +172,24 @@ def counters() -> Dict[str, Dict[str, int]]:
                     telemetry.counter("checkpoint.verify_failures").value,
                 "faults_injected":
                     telemetry.counter(
-                        "checkpoint.faults_injected").value}}
+                        "checkpoint.faults_injected").value},
+            "cluster": {
+                "rank": _clustermon.rank_world()[0],
+                "world": _clustermon.rank_world()[1],
+                "ranks": telemetry.gauge("cluster.ranks").value or 0,
+                "straggler_rank":
+                    telemetry.gauge("cluster.straggler_rank").value
+                    if telemetry.gauge(
+                        "cluster.straggler_rank").value is not None
+                    else -1,
+                "straggler_cause":
+                    telemetry.gauge("cluster.straggler_cause").value
+                    or "none",
+                "incidents":
+                    telemetry.counter(
+                        "cluster.straggler_incidents").value,
+                "joined_steps":
+                    telemetry.counter("cluster.joined_steps").value}}
 
 
 def set_config(**kwargs):

@@ -16,6 +16,9 @@ path with no sockets.  The HTTP endpoint is a thin stdlib
 - ``GET /tracez`` → the flight recorder's recent completed spans plus
   currently-open spans (tracing.py ring buffer; empty lists when
   ``MXNET_TRACE`` is off).
+- ``GET /metrics`` → the same registry in Prometheus text exposition
+  format (clustermon.prometheus_text: ``# TYPE`` lines, rank label on
+  every sample) — point a scrape config at the serving port directly.
 
 Error mapping: admission shape reject → 400, queue full (load shed) →
 429, request deadline → 504, draining/closed → 503.  ``stop()`` is
@@ -96,6 +99,12 @@ class ServingServer:
                 "recent": tracing.recent(limit),
                 "open": tracing.open_spans()}
 
+    def metricz(self) -> str:
+        """Prometheus text exposition of the registry (what
+        ``GET /metrics`` serves) — same numbers as /varz, scrapeable."""
+        from .. import clustermon
+        return clustermon.prometheus_text()
+
     def stop(self, drain: bool = True):
         """Drain-aware shutdown: close admission (delivering admitted
         responses when ``drain``), then stop the HTTP listener."""
@@ -128,9 +137,21 @@ class ServingServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _reply_text(self, code: int, text: str, ctype: str):
+                body = text.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 if self.path == "/healthz":
                     self._reply(200, server.healthz())
+                elif self.path.split("?", 1)[0] == "/metrics":
+                    self._reply_text(
+                        200, server.metricz(),
+                        "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path == "/varz":
                     self._reply(200, server.varz())
                 elif self.path.split("?", 1)[0] == "/tracez":

@@ -32,6 +32,9 @@ Sinks (pluggable, fan-out):
   auto-attached when ``MXNET_TELEMETRY_LOG_EVERY=<N>`` is set.
 - ``TensorBoardSink`` — scalars via any SummaryWriter backend
   (contrib/tensorboard.py).
+- ``clustermon.SpoolSink`` — per-rank JSONL spool under a shared
+  directory for cluster-scope aggregation; auto-attached when
+  ``MXNET_CLUSTER_DIR=<dir>`` is set (clustermon.py).
 - ``gluon.contrib.estimator.TelemetryHandler`` — estimator event-loop
   bridge (attaches a sink for the fit, mirrors eval metrics as gauges).
 """
@@ -198,14 +201,19 @@ def histogram(name: str) -> Histogram:
 
 def metrics(prefix: str = "") -> Dict[str, Any]:
     """Live metric objects, optionally filtered by name prefix."""
-    return {k: v for k, v in sorted(_REGISTRY.items())
-            if k.startswith(prefix)}
+    # snapshot the item list under the lock: a /metrics scrape iterating
+    # while a stepping thread registers a new metric must not see the
+    # registry dict change size mid-iteration
+    with _LOCK:
+        items = sorted(_REGISTRY.items())
+    return {k: v for k, v in items if k.startswith(prefix)}
 
 
 def snapshot(prefix: str = "") -> Dict[str, Any]:
     """Plain-data view of the registry (JSON-serializable)."""
-    return {k: v.describe() for k, v in sorted(_REGISTRY.items())
-            if k.startswith(prefix)}
+    with _LOCK:
+        items = sorted(_REGISTRY.items())
+    return {k: v.describe() for k, v in items if k.startswith(prefix)}
 
 
 def reset(prefix: str = "") -> None:
@@ -264,6 +272,11 @@ _C_CKPT_GC = counter("checkpoint.gc_removed")
 _C_CKPT_VPASS = counter("checkpoint.verify_passes")
 _C_CKPT_VFAIL = counter("checkpoint.verify_failures")
 _C_CKPT_FAULTS = counter("checkpoint.faults_injected")
+# cumulative ms ranks spent blocked in the multi-host commit barrier
+# (checkpoint.py increments it alongside the barrier_wait_ms histogram);
+# the per-step delta feeds cross-rank barrier-asymmetry attribution —
+# the rank with ~zero barrier wait is the one everyone else waited FOR
+_C_CKPT_BARRIER_MS = counter("checkpoint.barrier_wait_ms_total")
 # ZeRO weight-update sharding health (optimizer/fused_step.py and
 # parallel/trainer.py write these).  The three split counters are the
 # same registry objects record_comm_bytes(kind=...) creates, so split
@@ -309,22 +322,23 @@ def record_op_time(name: str, seconds: float) -> None:
 
 # pending input-wait accumulator: the wait for step N's batch happens
 # BEFORE begin_step(N) (the user loop does next(batch) then step()), so
-# a counter delta inside the step token would miss it.  The prefetcher
+# a counter delta inside the step token would miss it.  The consumer
 # deposits here; the next emitted step record drains it — attributing
-# each batch's wait to the step that consumed it.
-_pending_wait_ms = 0.0
+# each batch's wait to the step that consumed it.  Per-thread because
+# the wait is measured ON the consuming thread (DevicePrefetcher's
+# __next__ blocks the caller), so two trainers stepping in different
+# threads — a threads-as-ranks harness — never swap waits.
+_wait_tls = threading.local()
 
 
 def record_input_wait(seconds: float) -> None:
     """Account time a consumer blocked waiting for its next batch
     (``DevicePrefetcher.__next__``).  With the pipeline keeping ahead of
     the step this stays ≈0 — the input-bound/compute-bound signal."""
-    global _pending_wait_ms
     ms = seconds * 1e3
     _C_INPUT_WAIT_MS.inc(ms)
     if _SINKS:
-        with _LOCK:
-            _pending_wait_ms += ms
+        _wait_tls.ms = getattr(_wait_tls, "ms", 0.0) + ms
 
 
 def record_h2d_bytes(n: int, step_path: bool = False) -> None:
@@ -471,12 +485,15 @@ class TensorBoardSink:
 
 
 # -- env-driven sink auto-attach --------------------------------------------
-# MXNET_TELEMETRY_JSONL=<path> and MXNET_TELEMETRY_LOG_EVERY=<N> are
-# re-checked on every begin_step at the cost of two dict lookups, so a
+# MXNET_TELEMETRY_JSONL=<path>, MXNET_TELEMETRY_LOG_EVERY=<N>,
+# MXNET_CLUSTER_DIR=<shared dir>, and MXNET_METRICS_PORT=<port> are
+# re-checked on every begin_step at the cost of a few dict lookups, so a
 # long-lived process (or a test) can flip them without re-importing.
+# clustermon is only imported once one of its two switches is actually
+# set — the disabled path never pays the import.
 
-_env_cache = {"jsonl": None, "log": None}
-_env_sinks = {"jsonl": None, "log": None}
+_env_cache = {"jsonl": None, "log": None, "cluster": None, "mport": None}
+_env_sinks = {"jsonl": None, "log": None, "cluster": None}
 
 
 def _refresh_env_sinks() -> None:
@@ -502,6 +519,26 @@ def _refresh_env_sinks() -> None:
                 get_logger("mxnet_tpu.telemetry").warning(
                     "invalid MXNET_TELEMETRY_LOG_EVERY=%r (want an int)",
                     log_every)
+    cluster = os.environ.get("MXNET_CLUSTER_DIR") or None
+    if cluster != _env_cache["cluster"]:
+        if _env_sinks["cluster"] is not None:
+            remove_sink(_env_sinks["cluster"])  # also resets the cache entry
+        _env_cache["cluster"] = cluster
+        from . import clustermon
+        if cluster:
+            try:
+                _env_sinks["cluster"] = clustermon.SpoolSink(cluster)
+                add_sink(_env_sinks["cluster"])
+            except OSError:
+                from .log import get_logger
+                get_logger("mxnet_tpu.telemetry").exception(
+                    "cannot open cluster spool dir %r; disabling", cluster)
+        clustermon._on_cluster_dir(cluster)
+    mport = os.environ.get("MXNET_METRICS_PORT") or None
+    if mport != _env_cache["mport"]:
+        _env_cache["mport"] = mport
+        from . import clustermon
+        clustermon._on_metrics_port(mport)
 
 
 def enabled() -> bool:
@@ -518,7 +555,8 @@ class _StepToken:
                  "dispatches", "cs_hits", "cs_compiles", "cs_fallbacks",
                  "cs_breaks", "h2d_bytes", "ckpt_saves", "ckpt_failures",
                  "ckpt_bytes", "ckpt_gc", "ckpt_vpass", "ckpt_vfail",
-                 "rs_bytes", "ag_bytes", "ar_bytes")
+                 "rs_bytes", "ag_bytes", "ar_bytes", "barrier_ms",
+                 "buckets")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -540,6 +578,9 @@ class _StepToken:
         self.rs_bytes = _C_RS_BYTES.value
         self.ag_bytes = _C_AG_BYTES.value
         self.ar_bytes = _C_AR_BYTES.value
+        self.barrier_ms = _C_CKPT_BARRIER_MS.value
+        from . import tracing
+        self.buckets = tracing.bucket_totals_ms()
 
 
 # nesting guard: gluon.Trainer.step pushes through kvstore.pushpull —
@@ -633,13 +674,16 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
         return
     host_ms = (time.perf_counter() - token.t0) * 1e3
     _C_STEPS.inc()
-    global _pending_wait_ms
-    with _LOCK:
-        wait_ms, _pending_wait_ms = _pending_wait_ms, 0.0
+    wait_ms = getattr(_wait_tls, "ms", 0.0)
+    _wait_tls.ms = 0.0
+    from . import clustermon
+    rank, world = clustermon.rank_world()
     record = {
         "step": _C_STEPS.value,
         "ts": round(time.time(), 3),
         "source": source,
+        "rank": rank,
+        "world": world,
         "host_ms": round(host_ms, 3),
         "device_ms": _consume_device_ms(),
         "compiles": _C_COMPILES.value - token.compiles,
@@ -679,8 +723,22 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "gc_removed": _C_CKPT_GC.value - token.ckpt_gc,
             "verify_passes": _C_CKPT_VPASS.value - token.ckpt_vpass,
             "verify_failures": _C_CKPT_VFAIL.value - token.ckpt_vfail,
+            # ms this rank spent blocked in the commit barrier during
+            # this step's window — the cross-rank asymmetry signal
+            "barrier_wait_ms": round(
+                _C_CKPT_BARRIER_MS.value - token.barrier_ms, 3),
         },
     }
+    # critical-path decomposition: where this step's wall time went,
+    # from flight-recorder span-bucket deltas (all zeros when tracing is
+    # off — the buckets only accumulate while spans are recorded), with
+    # the unattributed remainder reported as compute
+    from . import tracing
+    buckets = tracing.bucket_totals_ms()
+    cp = {k: round(max(0.0, buckets[k] - token.buckets.get(k, 0.0)), 3)
+          for k in buckets}
+    cp["compute"] = round(max(0.0, host_ms - sum(cp.values())), 3)
+    record["critical_path"] = cp
     histogram("step.host_ms").observe(host_ms)
     if extra:
         record.update(extra)

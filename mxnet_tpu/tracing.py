@@ -57,7 +57,8 @@ from . import telemetry
 __all__ = ["Span", "span", "begin", "end", "record_span", "enabled",
            "enable", "disable", "export", "recent", "open_spans",
            "aggregate", "clear", "span_count", "dropped_count",
-           "start_watchdog", "stop_watchdog", "register_thread"]
+           "bucket_totals_ms", "start_watchdog", "stop_watchdog",
+           "register_thread"]
 
 _LOCK = threading.Lock()
 _PID = os.getpid()
@@ -101,6 +102,59 @@ _OFF_VALUES = ("", "0", "false", "off", "no")
 # stall means "training/serving is wedged" rather than "slow moment"
 _WATCH_PREFIXES = ("step.",)
 _WATCH_NAMES = frozenset({"serving.dispatch"})
+
+# critical-path buckets: cumulative ms of completed spans per phase
+# class.  telemetry.end_step snapshots/deltas these into each step
+# record's critical_path, and clustermon's straggler classifier reads
+# the deltas.  Only LEAF-ish names are classified — step.allreduce
+# contains the comm.* collectives and step.gluon contains everything,
+# so counting containers would double-book the same wall time.
+_BUCKET_KEYS = ("input_wait", "h2d", "compile", "collective",
+                "optimizer", "checkpoint")
+_bucket_ms: Dict[str, float] = {k: 0.0 for k in _BUCKET_KEYS}
+
+
+def _bucket_of(name: str) -> Optional[str]:
+    if name.startswith("comm."):
+        return "collective"
+    if name.startswith("compile."):
+        return "compile"
+    if name.startswith("ckpt."):
+        return "checkpoint"
+    if name == "step.update":
+        return "optimizer"
+    if name == "input.wait":
+        return "input_wait"
+    if name == "input.h2d":
+        return "h2d"
+    return None
+
+
+def bucket_totals_ms() -> Dict[str, float]:
+    """Cumulative per-bucket span ms since process start (fixed key
+    set, all zeros while tracing is disabled).  Buckets measure span
+    wall time on whatever thread ran them, so phases that overlap the
+    step (producer-side H2D, background checkpoint serialize) can sum
+    past host_ms — consumers treat them as attribution signals, not a
+    partition."""
+    with _LOCK:
+        return dict(_bucket_ms)
+
+
+# lazily bound clustermon module (rank stamping); never imported on the
+# disabled path
+_clustermon = None
+
+
+def _rank_world():
+    global _clustermon
+    if _clustermon is None:
+        from . import clustermon
+        _clustermon = clustermon
+    try:
+        return _clustermon.rank_world()
+    except Exception:
+        return (0, 1)
 
 _forced: Optional[bool] = None   # enable()/disable() override; None = env
 
@@ -282,12 +336,18 @@ def _store(name: str, t0: float, t1: float, tid: int, args: dict,
     """Append one completed span to the ring (+ JSONL sink)."""
     global _ring_pos
     cat = name.split(".", 1)[0]
+    # every span carries its emitting rank so merged multi-host traces
+    # (and the JSONL stream) stay attributable without filename lore
+    args.setdefault("rank", _rank_world()[0])
     ev = {"name": name, "ph": "X", "cat": cat,
           "ts": round((t0 - _EPOCH) * 1e6, 3),
           "dur": round(max(0.0, t1 - t0) * 1e6, 3),
           "pid": _PID, "tid": tid, "args": args}
     watched = name.startswith(_WATCH_PREFIXES) or name in _WATCH_NAMES
+    bucket = _bucket_of(name)
     with _LOCK:
+        if bucket is not None:
+            _bucket_ms[bucket] += max(0.0, t1 - t0) * 1e3
         if span_id is not None:
             _open.pop(span_id, None)
             _dumped.discard(span_id)
@@ -398,8 +458,11 @@ def export(path: str) -> str:
     events flagged ``"open": true`` so a stalled run's export still
     shows what was in flight."""
     evs = _completed_events()
+    rank, world = _rank_world()
     meta = [{"name": "process_name", "ph": "M", "pid": _PID, "tid": 0,
-             "args": {"name": "mxnet_tpu"}},
+             "args": {"name": f"mxnet_tpu rank {rank}/{world}"}},
+            {"name": "rank_world", "ph": "M", "pid": _PID, "tid": 0,
+             "args": {"rank": rank, "world": world}},
             {"name": "trace_epoch_unix", "ph": "M", "pid": _PID, "tid": 0,
              "args": {"ts": _EPOCH_WALL}}]
     with _LOCK:
@@ -438,6 +501,8 @@ def clear() -> None:
         _cap_cache = None        # re-read MXNET_TRACE_BUFFER
         _durations.clear()
         _dumped.clear()
+        for k in _bucket_ms:
+            _bucket_ms[k] = 0.0
 
 
 # -- stall watchdog ----------------------------------------------------------
@@ -495,14 +560,39 @@ def _dump_stall(sp: "Span", elapsed: float, p95: float,
     """One diagnostic dump per incident: every live span + every
     thread's Python stack."""
     from .log import get_logger
+    rank, world = _rank_world()
     lines = [
-        f"STALL: span {sp.name!r} (id {sp.span_id}) open for "
+        f"STALL: rank {rank}/{world}: span {sp.name!r} "
+        f"(id {sp.span_id}) open for "
         f"{elapsed * 1e3:.1f} ms > {factor:g} x p95 {p95 * 1e3:.1f} ms",
         "live spans:"]
+    ckpt_open = []
     for o in open_spans():
         lines.append(f"  {o['name']} id={o['span_id']} "
                      f"tid={o['tid']} age={o['elapsed_ms']:.1f} ms "
                      f"{o['args']}")
+        if o["name"].startswith("ckpt."):
+            ckpt_open.append(o)
+    # checkpoint/barrier state: on a multi-host stall the interesting
+    # question is whether this rank is wedged INSIDE the commit
+    # barrier (open ckpt.barrier span = waiting on peers' markers) or
+    # behind a slow background save
+    try:
+        from . import checkpoint
+        pending = checkpoint.pending_targets()
+        lines.append(f"checkpoint: {len(pending)} pending background "
+                     f"save(s): {pending if pending else '[]'}")
+        if ckpt_open:
+            names = ", ".join(
+                f"{o['name']}(age {o['elapsed_ms']:.1f} ms)"
+                for o in ckpt_open)
+            lines.append(f"checkpoint: open spans: {names}"
+                         + ("  << stuck in commit barrier: waiting on "
+                            "peer rank markers"
+                            if any(o["name"] == "ckpt.barrier"
+                                   for o in ckpt_open) else ""))
+    except Exception:
+        pass           # a stall dump must never fail on diagnostics
     lines.append("thread stacks:")
     with _LOCK:
         names = dict(_thread_names)

@@ -1,0 +1,539 @@
+"""Cluster-scope observability (mxnet_tpu/clustermon.py): rank-stamped
+step records spooled per rank, the rank-0 aggregator's join / skew /
+straggler attribution, Prometheus text exposition (+ the standalone
+exporter), and the disabled-path contract (no MXNET_CLUSTER_DIR → no
+spool files, no threads, no step-path change)."""
+import importlib.util
+import json
+import os
+import pathlib
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from mxnet_tpu import checkpoint, clustermon, telemetry, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_cluster_state():
+    """Every test starts/ends with no sinks, no aggregator, no exporter,
+    no thread-rank override, and the cluster gauges zeroed."""
+    saved_override = checkpoint._rank_override
+    telemetry.clear_sinks()
+    clustermon.set_thread_rank(None)
+    yield
+    telemetry.clear_sinks()
+    clustermon.set_thread_rank(None)
+    agg = clustermon.aggregator()
+    if agg is not None:
+        agg.stop()
+    clustermon._aggregator = None
+    clustermon.stop_metrics_server()
+    checkpoint._rank_override = saved_override
+    clustermon.note_rank(0, 1)          # invalidate the resolution cache
+    telemetry.reset("cluster.")
+    telemetry.enabled()     # re-sync env cache after monkeypatch undo
+
+
+class _ListSink:
+    def __init__(self):
+        self.records = []
+
+    def emit(self, record):
+        self.records.append(record)
+
+
+# -- rank/world resolution ---------------------------------------------------
+
+def test_rank_world_precedence(monkeypatch):
+    # default: no override, no env, single process
+    assert clustermon.rank_world() == (0, 1)
+    # the dist-kvstore chain (checkpoint.set_rank) is picked up
+    checkpoint.set_rank(2, 4)
+    clustermon.note_rank(2, 4)
+    assert clustermon.rank_world() == (2, 4)
+    # env wins over set_rank (same precedence as checkpoint.rank_world)
+    monkeypatch.setenv("MXNET_CKPT_RANK", "3")
+    monkeypatch.setenv("MXNET_CKPT_WORLD", "8")
+    assert clustermon.rank_world() == (3, 8)
+    # the per-thread override wins over everything (threads-as-ranks)
+    clustermon.set_thread_rank(1, 2)
+    assert clustermon.rank_world() == (1, 2)
+    clustermon.set_thread_rank(None)
+    assert clustermon.rank_world() == (3, 8)
+
+
+def test_thread_rank_is_per_thread():
+    clustermon.set_thread_rank(0, 2)
+    seen = {}
+
+    def worker():
+        clustermon.set_thread_rank(1, 2)
+        seen["worker"] = clustermon.rank_world()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["worker"] == (1, 2)
+    assert clustermon.rank_world() == (0, 2)
+
+
+# -- spool sink --------------------------------------------------------------
+
+def test_spool_sink_per_rank_files_and_ordinals(tmp_path):
+    sink = clustermon.SpoolSink(str(tmp_path))
+    # interleaved emits from two ranks: each rank gets its own file and
+    # its own 1-based rank_step ordinal sequence
+    for step, rank in enumerate([0, 1, 0, 1, 1], 1):
+        sink.emit({"step": step, "rank": rank, "host_ms": 1.0})
+    sink.close()
+    r0 = [json.loads(l) for l in
+          (tmp_path / "rank-0.jsonl").read_text().splitlines()]
+    r1 = [json.loads(l) for l in
+          (tmp_path / "rank-1.jsonl").read_text().splitlines()]
+    assert [r["rank_step"] for r in r0] == [1, 2]
+    assert [r["rank_step"] for r in r1] == [1, 2, 3]
+    assert all(r["rank"] == 0 for r in r0)
+    assert all(r["rank"] == 1 for r in r1)
+
+
+# -- join / window stats / straggler detection -------------------------------
+
+def _rec(step, host_ms, input_wait=0.0, compile_ms=0.0, barrier=0.0,
+         comm=0.0):
+    return {"rank_step": step, "host_ms": host_ms,
+            "input_wait_ms": input_wait, "compile_ms": compile_ms,
+            "checkpoint": {"barrier_wait_ms": barrier},
+            "critical_path": {"collective": comm}}
+
+
+def _spools(n_steps, slow_rank=None, slow_ms=100.0, base_ms=10.0,
+            **slow_signals):
+    by_rank = {}
+    for r in (0, 1, 2):
+        recs = []
+        for s in range(1, n_steps + 1):
+            if r == slow_rank:
+                recs.append(_rec(s, slow_ms, **slow_signals))
+            else:
+                recs.append(_rec(s, base_ms))
+        by_rank[r] = recs
+    return by_rank
+
+
+def test_join_by_step_uses_rank_step_ordinal():
+    by_rank = {0: [_rec(1, 1.0), _rec(2, 1.0)],
+               1: [_rec(1, 2.0)]}
+    joined = clustermon.join_by_step(by_rank)
+    assert set(joined) == {1, 2}
+    assert set(joined[1]) == {0, 1}
+    assert set(joined[2]) == {0}       # rank 1 hasn't reported step 2
+
+
+def test_window_stats_only_counts_complete_steps():
+    # rank 1 is 3 steps behind: its unreported steps must not be
+    # averaged as if they were fast
+    by_rank = {0: [_rec(s, 10.0) for s in range(1, 9)],
+               1: [_rec(s, 50.0) for s in range(1, 6)]}
+    stats = clustermon.window_stats(by_rank, window=100)
+    assert stats[0]["steps"] == 5
+    assert stats[1]["steps"] == 5
+    assert stats[0]["host_ms_mean"] == pytest.approx(10.0)
+    assert stats[1]["host_ms_mean"] == pytest.approx(50.0)
+
+
+def test_window_stats_trailing_window():
+    recs0 = [_rec(s, 10.0) for s in range(1, 11)]
+    recs1 = [_rec(s, 10.0 if s <= 5 else 90.0) for s in range(1, 11)]
+    stats = clustermon.window_stats({0: recs0, 1: recs1}, window=5)
+    # only the last 5 joined steps count: rank 1 averages 90, not 50
+    assert stats[1]["host_ms_mean"] == pytest.approx(90.0)
+
+
+@pytest.mark.parametrize("signals,expected_cause", [
+    (dict(input_wait=85.0), "input_bound"),
+    (dict(compile_ms=85.0), "compile_stall"),
+    (dict(barrier=85.0), "ckpt_interference"),
+    (dict(comm=85.0), "comm_skew"),
+    (dict(), "unknown"),               # slow but nothing explains it
+])
+def test_straggler_cause_classification(signals, expected_cause):
+    by_rank = _spools(8, slow_rank=1, slow_ms=100.0, **signals)
+    stats = clustermon.window_stats(by_rank, window=8)
+    st = clustermon.detect_straggler(stats, factor=1.5)
+    assert st is not None
+    assert st["rank"] == 1
+    assert st["cause"] == expected_cause
+    assert st["ratio"] == pytest.approx(10.0)
+
+
+def test_no_straggler_below_factor():
+    by_rank = _spools(8, slow_rank=1, slow_ms=12.0)   # 1.2x < 1.5x
+    stats = clustermon.window_stats(by_rank, window=8)
+    assert clustermon.detect_straggler(stats, factor=1.5) is None
+
+
+def test_no_straggler_single_rank():
+    by_rank = {0: [_rec(s, 10.0) for s in range(1, 6)]}
+    stats = clustermon.window_stats(by_rank, window=5)
+    assert clustermon.detect_straggler(stats, factor=1.5) is None
+
+
+# -- the aggregator ----------------------------------------------------------
+
+def _write_spool(directory, rank, records):
+    path = pathlib.Path(directory) / f"rank-{rank}.jsonl"
+    with open(path, "a") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+
+
+def test_aggregator_poll_detects_injected_straggler(tmp_path):
+    for r in (0, 1):
+        ms = 100.0 if r == 1 else 10.0
+        _write_spool(tmp_path, r,
+                     [_rec(s, ms, input_wait=85.0 if r == 1 else 0.0)
+                      for s in range(1, 9)])
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=8,
+                                       factor=1.5)
+    inc0 = telemetry.counter("cluster.straggler_incidents").value
+    view = agg.poll()
+    assert view["joined_steps"] == 8
+    st = view["straggler"]
+    assert st["rank"] == 1 and st["cause"] == "input_bound"
+    assert view["skew"]["step_ms"] == pytest.approx(90.0)
+    # gauges mirror the view
+    assert telemetry.gauge("cluster.ranks").value == 2
+    assert telemetry.gauge("cluster.straggler_rank").value == 1
+    assert telemetry.gauge("cluster.straggler_cause").value == \
+        "input_bound"
+    # once-per-incident: a second poll of the same state must not
+    # re-count the incident
+    agg.poll()
+    assert telemetry.counter("cluster.straggler_incidents").value \
+        == inc0 + 1
+
+
+def test_aggregator_tails_incrementally_and_buffers_torn_lines(tmp_path):
+    _write_spool(tmp_path, 0, [_rec(1, 10.0)])
+    _write_spool(tmp_path, 1, [_rec(1, 10.0)])
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=8,
+                                       factor=1.5)
+    assert agg.poll()["joined_steps"] == 1
+    # a torn (newline-less) write must not be consumed...
+    p = pathlib.Path(tmp_path) / "rank-0.jsonl"
+    whole = json.dumps(_rec(2, 10.0))
+    with open(p, "a") as f:
+        f.write(whole[:10])
+    _write_spool(tmp_path, 1, [_rec(2, 10.0)])
+    view = agg.poll()
+    assert view["joined_steps"] == 1
+    # ...until its remainder lands, then the record joins
+    with open(p, "a") as f:
+        f.write(whole[10:] + "\n")
+    assert agg.poll()["joined_steps"] == 2
+
+
+def test_aggregator_recovers_when_straggler_clears(tmp_path):
+    for r in (0, 1):
+        _write_spool(tmp_path, r,
+                     [_rec(s, 100.0 if r == 1 else 10.0,
+                           input_wait=85.0 if r == 1 else 0.0)
+                      for s in range(1, 5)])
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=4,
+                                       factor=1.5)
+    assert agg.poll()["straggler"]["rank"] == 1
+    # the slow rank catches up: the trailing window goes clean
+    for r in (0, 1):
+        _write_spool(tmp_path, r,
+                     [_rec(s, 10.0) for s in range(5, 13)])
+    view = agg.poll()
+    assert view["straggler"] is None
+    assert telemetry.gauge("cluster.straggler_rank").value == -1
+    assert telemetry.gauge("cluster.straggler_cause").value == "none"
+
+
+# -- Prometheus exposition ---------------------------------------------------
+
+def test_prometheus_text_counter_gauge_histogram():
+    telemetry.counter("obs_test.counter").inc(7)
+    telemetry.gauge("obs_test.gauge").set(2.5)
+    h = telemetry.histogram("obs_test.hist")
+    for v in (1.0, 2.0, 3.0, 4.0):
+        h.observe(v)
+    text = clustermon.prometheus_text()
+    parsed = clustermon.parse_prometheus_text(text)
+    assert "# TYPE mxnet_obs_test_counter counter" in text
+    assert "# TYPE mxnet_obs_test_gauge gauge" in text
+    assert "# TYPE mxnet_obs_test_hist summary" in text
+    (labels, val), = parsed["mxnet_obs_test_counter"]
+    assert val == 7 and labels["rank"] == "0"
+    (_, gval), = parsed["mxnet_obs_test_gauge"]
+    assert gval == 2.5
+    # summary: quantile samples + exact _sum/_count
+    quants = {l["quantile"]: v for l, v in parsed["mxnet_obs_test_hist"]}
+    assert set(quants) == {"0.5", "0.95"}
+    (_, hsum), = parsed["mxnet_obs_test_hist_sum"]
+    (_, hcount), = parsed["mxnet_obs_test_hist_count"]
+    assert hsum == pytest.approx(10.0) and hcount == 4
+
+
+def test_prometheus_rank_label_on_every_sample():
+    clustermon.set_thread_rank(3, 4)
+    telemetry.counter("obs_test.counter").inc()
+    parsed = clustermon.parse_prometheus_text(
+        clustermon.prometheus_text())
+    for samples in parsed.values():
+        for labels, _val in samples:
+            assert labels["rank"] == "3"
+
+
+def test_prometheus_string_gauge_and_label_escaping():
+    telemetry.gauge("cluster.straggler_cause").set('we"ird\\cau\nse')
+    text = clustermon.prometheus_text(extra_labels={"job": 'a"b\\c\nd'})
+    parsed = clustermon.parse_prometheus_text(text)
+    (labels, val), = parsed["mxnet_cluster_straggler_cause"]
+    assert val == 1
+    assert labels["cause"] == 'we"ird\\cau\nse'    # escape round-trip
+    assert labels["job"] == 'a"b\\c\nd'
+
+
+def test_prometheus_none_gauges_skipped():
+    telemetry.gauge("obs_test.unset_gauge")
+    text = clustermon.prometheus_text()
+    assert "obs_test_unset_gauge" not in text
+    clustermon.parse_prometheus_text(text)
+
+
+@pytest.mark.parametrize("bad", [
+    "# TYPE mxnet_x bogus_kind\n",
+    "mxnet_orphan 1\n",                          # sample without TYPE
+    "# TYPE mxnet_x counter\nmxnet_x{a=b} 1\n",  # unquoted label value
+    "# TYPE mxnet_x counter\nmxnet_x one\n",     # non-numeric value
+])
+def test_prometheus_parser_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        clustermon.parse_prometheus_text(bad)
+
+
+def test_scrape_while_stepping_race():
+    """A /metrics scrape racing live steps (new metrics registered
+    mid-iteration) must never raise."""
+    sink = _ListSink()
+    telemetry.add_sink(sink)
+    stop = threading.Event()
+    errors = []
+
+    def stepper():
+        i = 0
+        try:
+            while not stop.is_set():
+                i += 1
+                telemetry.counter(f"obs_race.c{i % 97}").inc()
+                telemetry.histogram("obs_race.h").observe(float(i))
+                tok = telemetry.begin_step()
+                telemetry.end_step(tok, "race-test")
+        except Exception as e:       # pragma: no cover
+            errors.append(e)
+
+    t = threading.Thread(target=stepper)
+    t.start()
+    try:
+        deadline = time.monotonic() + 1.0
+        while time.monotonic() < deadline:
+            clustermon.parse_prometheus_text(
+                clustermon.prometheus_text())
+            telemetry.snapshot()
+    finally:
+        stop.set()
+        t.join(10.0)
+    assert not errors
+    assert sink.records                # the stepper actually stepped
+
+
+# -- standalone exporter + serving route -------------------------------------
+
+def test_metrics_http_exporter():
+    host, port = clustermon.start_metrics_server(0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            parsed = clustermon.parse_prometheus_text(
+                resp.read().decode())
+        assert any("rank" in labels for samples in parsed.values()
+                   for labels, _ in samples)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok" and "rank" in health
+        # idempotent: a second start keeps the bound socket
+        assert clustermon.start_metrics_server(0) == (host, port)
+    finally:
+        clustermon.stop_metrics_server()
+    assert clustermon.metrics_server_address() is None
+
+
+def test_metrics_port_env_lifecycle(monkeypatch):
+    monkeypatch.setenv("MXNET_METRICS_PORT", "0")
+    telemetry.enabled()
+    addr = clustermon.metrics_server_address()
+    assert addr is not None
+    monkeypatch.delenv("MXNET_METRICS_PORT")
+    telemetry.enabled()
+    assert clustermon.metrics_server_address() is None
+
+
+# -- telemetry integration ---------------------------------------------------
+
+def test_step_record_carries_rank_world_and_critical_path():
+    clustermon.set_thread_rank(1, 2)
+    sink = _ListSink()
+    telemetry.add_sink(sink)
+    tok = telemetry.begin_step()
+    telemetry.end_step(tok, "test")
+    rec = sink.records[-1]
+    assert rec["rank"] == 1 and rec["world"] == 2
+    assert "barrier_wait_ms" in rec["checkpoint"]
+    cp = rec["critical_path"]
+    assert set(cp) == {"input_wait", "h2d", "compile", "collective",
+                       "optimizer", "checkpoint", "compute"}
+    assert cp["compute"] >= 0.0
+
+
+def test_input_wait_is_per_thread():
+    """Two threads-as-ranks stepping concurrently must not swap their
+    input-wait attribution (the old global accumulator would)."""
+    sink = _ListSink()
+    telemetry.add_sink(sink)
+    waits = {}
+
+    def rank_thread(r, wait_s):
+        clustermon.set_thread_rank(r, 2)
+        telemetry.record_input_wait(wait_s)
+        tok = telemetry.begin_step()
+        telemetry.end_step(tok, "test")
+
+    t0 = threading.Thread(target=rank_thread, args=(0, 0.0))
+    t1 = threading.Thread(target=rank_thread, args=(1, 0.5))
+    t0.start(), t1.start()
+    t0.join(), t1.join()
+    for rec in sink.records:
+        waits[rec["rank"]] = rec["input_wait_ms"]
+    assert waits[0] == pytest.approx(0.0)
+    assert waits[1] == pytest.approx(500.0)
+
+
+def test_cluster_dir_env_attaches_spool_and_aggregator(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setenv("MXNET_CLUSTER_DIR", str(tmp_path))
+    tok = telemetry.begin_step()
+    telemetry.end_step(tok, "test")
+    spool = tmp_path / "rank-0.jsonl"
+    assert spool.exists()
+    rec = json.loads(spool.read_text().splitlines()[0])
+    assert rec["rank"] == 0 and rec["rank_step"] == 1
+    # rank 0 started the aggregator thread
+    agg = clustermon.aggregator()
+    assert agg is not None
+    assert any(t.name == "mxnet-clustermon"
+               for t in threading.enumerate())
+    monkeypatch.delenv("MXNET_CLUSTER_DIR")
+    telemetry.enabled()
+    assert clustermon.aggregator() is None
+
+
+def test_disabled_run_no_files_no_threads(tmp_path, monkeypatch):
+    """The bitwise-identity contract: with MXNET_CLUSTER_DIR and
+    MXNET_METRICS_PORT unset nothing spools, no clustermon thread runs,
+    and begin_step stays the no-op fast path."""
+    monkeypatch.delenv("MXNET_CLUSTER_DIR", raising=False)
+    monkeypatch.delenv("MXNET_METRICS_PORT", raising=False)
+    monkeypatch.chdir(tmp_path)
+    assert telemetry.begin_step() is None
+    assert list(tmp_path.iterdir()) == []
+    names = {t.name for t in threading.enumerate()}
+    assert "mxnet-clustermon" not in names
+    assert "mxnet-metrics-exporter" not in names
+
+
+def test_tracing_spans_stamped_with_rank():
+    clustermon.set_thread_rank(1, 2)
+    tracing.enable()
+    try:
+        with tracing.span("obs_test.span"):
+            pass
+        ev = tracing.recent(1)[0]
+        assert ev["args"]["rank"] == 1
+    finally:
+        tracing._env_default()
+        tracing.clear()
+
+
+def test_tracing_bucket_totals_feed_critical_path():
+    sink = _ListSink()
+    telemetry.add_sink(sink)
+    tracing.enable()
+    try:
+        tok = telemetry.begin_step()
+        t0 = time.perf_counter()
+        tracing.record_span("input.wait", t0 - 0.05, t0)
+        with tracing.span("comm.pushpull"):
+            time.sleep(0.01)
+        telemetry.end_step(tok, "test")
+    finally:
+        tracing._env_default()
+        tracing.clear()
+    cp = sink.records[-1]["critical_path"]
+    assert cp["input_wait"] == pytest.approx(50.0, rel=0.3)
+    assert cp["collective"] > 0.0
+
+
+# -- report tools ------------------------------------------------------------
+
+def _load_tool(name):
+    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    spec = importlib.util.spec_from_file_location(name,
+                                                 tools / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_telemetry_report_merges_multi_rank_spools(tmp_path, capsys):
+    tr = _load_tool("telemetry_report")
+    for r in (0, 1):
+        recs = [dict(_rec(s, 10.0 * (r + 1)), rank=r, step=s,
+                     compiles=0, collective_bytes=0, device_mem=[])
+                for s in range(1, 4)]
+        _write_spool(tmp_path, r, recs)
+    merged = tr.load_many(tr.expand_paths(
+        [str(tmp_path / "rank-*.jsonl")]))
+    # merged by (rank, step): all of rank 0 before rank 1, steps ordered
+    assert [(m["rank"], m["rank_step"]) for m in merged] == \
+        [(0, 1), (0, 2), (0, 3), (1, 1), (1, 2), (1, 3)]
+    s = tr.summarize(merged)
+    assert set(s["by_rank"]) == {0, 1}
+    assert s["by_rank"][1]["host_ms_p50"] == pytest.approx(20.0)
+    assert tr.main([str(tmp_path / "rank-*.jsonl")]) == 0
+    assert "Per-rank breakdown" in capsys.readouterr().out
+
+
+def test_cluster_report_names_straggler(tmp_path, capsys):
+    cr = _load_tool("cluster_report")
+    for r in (0, 1):
+        _write_spool(tmp_path, r,
+                     [_rec(s, 100.0 if r else 10.0,
+                           compile_ms=85.0 if r else 0.0)
+                      for s in range(1, 9)])
+    assert cr.main([str(tmp_path), "--factor", "1.5"]) == 0
+    out = capsys.readouterr().out
+    assert "rank 1 is the straggler" in out
+    assert "compile_stall" in out
+    a = cr.analyze(cr.load_spools(str(tmp_path)), window=0, factor=1.5)
+    assert a["straggler"]["rank"] == 1
+    assert a["skew"]["step_ms"] == pytest.approx(90.0)

@@ -227,7 +227,8 @@ def test_profiler_counters_snapshot():
     c = profiler.counters()
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
-                      "serving", "input", "tracing", "checkpoint"}
+                      "serving", "input", "tracing", "checkpoint",
+                      "cluster"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
                                     "steps", "zero_steps"}
@@ -246,6 +247,10 @@ def test_profiler_counters_snapshot():
                                     "bytes", "gc_removed",
                                     "verify_passes", "verify_failures",
                                     "faults_injected"}
+    assert set(c["cluster"]) == {"rank", "world", "ranks",
+                                 "straggler_rank", "straggler_cause",
+                                 "incidents", "joined_steps"}
+    assert c["cluster"]["straggler_rank"] == -1   # no aggregator running
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100
     assert profiler.counters()["fused_step"]["steps"] != \

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Cluster post-mortem over a MXNET_CLUSTER_DIR spool directory.
+
+Replays exactly the join + window-stats + straggler-detection pipeline
+the live rank-0 aggregator (mxnet_tpu/clustermon.py) runs, but offline
+over the ``rank-*.jsonl`` spools a finished (or dead) run left behind:
+
+- per-rank step-time table (mean/max host ms over the analysis window,
+  with each rank's mean critical-path decomposition: input wait / H2D /
+  compile / collective / optimizer / checkpoint / compute),
+- cross-rank skew (slowest vs fastest mean step time, barrier-wait
+  asymmetry — the rank with ~zero barrier wait is the one the others
+  waited FOR),
+- the straggler verdict: which rank, how much slower than the peer
+  median, and the dominant cause class with its per-signal excess.
+
+Usage:
+    python tools/cluster_report.py /path/to/cluster_dir
+    python tools/cluster_report.py dir --window 50 --factor 1.3
+    python tools/cluster_report.py dir --json     # machine-readable
+
+Numbers reconcile with the live aggregator's gauges
+(``cluster.straggler_rank`` / ``cluster.straggler_cause``) because both
+call the same pure functions — this tool is the offline face of the
+same code path, not a reimplementation.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu import clustermon  # noqa: E402
+
+_SPOOL_RE = re.compile(r"rank-(\d+)\.jsonl$")
+
+
+def load_spools(directory):
+    """{rank: [records]} from every ``rank-*.jsonl`` in ``directory``
+    (torn/blank lines skipped, matching the live tailer)."""
+    by_rank = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError as e:
+        raise SystemExit(f"{directory}: {e}")
+    for name in names:
+        m = _SPOOL_RE.match(name)
+        if not m:
+            continue
+        recs = []
+        with open(os.path.join(directory, name)) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    recs.append(json.loads(line))
+                except ValueError:
+                    continue
+        by_rank[int(m.group(1))] = recs
+    if not by_rank:
+        raise SystemExit(f"{directory}: no rank-*.jsonl spools found")
+    return by_rank
+
+
+def analyze(by_rank, window, factor):
+    stats = clustermon.window_stats(by_rank, window)
+    joined = clustermon.join_by_step(by_rank)
+    ranks = sorted(by_rank)
+    complete = sum(1 for per in joined.values()
+                   if all(r in per for r in ranks))
+    means = [s["host_ms_mean"] for s in stats.values() if s["steps"]]
+    barrier = [s["barrier_wait_ms_mean"] for s in stats.values()
+               if s["steps"]]
+    skew = None
+    if len(means) >= 2:
+        skew = {"step_ms": max(means) - min(means),
+                "step_ratio": max(means) / min(means)
+                if min(means) > 0 else None,
+                "barrier_wait_ms": max(barrier) - min(barrier)}
+    return {"ranks": stats, "records": {r: len(v) for r, v in
+                                        by_rank.items()},
+            "joined_steps": complete, "window": window, "factor": factor,
+            "skew": skew,
+            "straggler": clustermon.detect_straggler(stats, factor)}
+
+
+_CP_COLS = ("input_wait", "h2d", "compile", "collective", "optimizer",
+            "checkpoint", "compute")
+
+
+def render(a):
+    lines = ["Cluster report", "=" * 72,
+             f"ranks: {len(a['ranks'])}   joined steps: "
+             f"{a['joined_steps']}   window: last {a['window']} "
+             f"joined steps   straggler factor: {a['factor']:g}", ""]
+    hdr = (f"  {'rank':<5}{'steps':>6}{'mean ms':>10}{'max ms':>10}"
+           f"{'barrier':>9}")
+    lines += ["Per-rank step time", "-" * 72, hdr]
+    for r in sorted(a["ranks"]):
+        s = a["ranks"][r]
+        lines.append(f"  {r:<5}{s['steps']:>6}{s['host_ms_mean']:>10.2f}"
+                     f"{s['host_ms_max']:>10.2f}"
+                     f"{s['barrier_wait_ms_mean']:>9.2f}")
+    lines += ["", "Mean critical path per step (ms)", "-" * 72,
+              "  rank " + "".join(f"{c:>11}" for c in _CP_COLS)]
+    for r in sorted(a["ranks"]):
+        cp = a["ranks"][r]["critical_path"]
+        lines.append(f"  {r:<5}" + "".join(
+            f"{cp.get(c, 0.0):>11.2f}" for c in _CP_COLS))
+    sk = a["skew"]
+    if sk:
+        ratio = f"{sk['step_ratio']:.2f}x" if sk["step_ratio"] else "n/a"
+        lines += ["", "Cross-rank skew", "-" * 72,
+                  f"  step-time spread : {sk['step_ms']:.2f} ms "
+                  f"(slowest/fastest {ratio})",
+                  f"  barrier-wait asymmetry : "
+                  f"{sk['barrier_wait_ms']:.2f} ms"]
+    st = a["straggler"]
+    lines += ["", "Straggler verdict", "-" * 72]
+    if st is None:
+        lines.append("  none: no rank exceeds the factor over the peer "
+                     "median in this window")
+    else:
+        lines += [
+            f"  rank {st['rank']} is the straggler: "
+            f"{st['step_ms']:.2f} ms mean vs peer median "
+            f"{st['peer_ms']:.2f} ms ({st['ratio']:.2f}x)",
+            f"  dominant cause: {st['cause']}",
+            "  per-signal excess over peer median (ms): "
+            + ", ".join(f"{k}={v:.2f}"
+                        for k, v in st["excess_ms"].items())]
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("cluster_dir",
+                    help="MXNET_CLUSTER_DIR spool directory "
+                         "(rank-*.jsonl files)")
+    ap.add_argument("--window", type=int, default=0,
+                    help="analyze only the last N joined steps "
+                         "(default 0 = all joined steps)")
+    ap.add_argument("--factor", type=float, default=None,
+                    help="straggler threshold: slowest mean vs peer "
+                         "median (default MXNET_STRAGGLER_FACTOR or "
+                         "1.5)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the analysis as JSON instead of a table")
+    args = ap.parse_args(argv)
+    factor = args.factor
+    if factor is None:
+        factor = clustermon._straggler_factor()
+    a = analyze(load_spools(args.cluster_dir), args.window, factor)
+    if args.json:
+        json.dump(a, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        print(render(a))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,14 @@ Usage:
     python tools/telemetry_report.py run.jsonl
     python tools/telemetry_report.py run.jsonl --json   # machine-readable
     python tools/telemetry_report.py run.jsonl --trace trace.jsonl
+    python tools/telemetry_report.py 'spool/rank-*.jsonl'   # multi-rank
+
+Multiple files (or shell/quoted globs, e.g. a MXNET_CLUSTER_DIR spool)
+are merged by ``(rank, step)`` — records keep their emitting rank's
+order instead of interleaving ranks into one stream — and a per-rank
+breakdown renders when more than one rank is present.  For cluster-
+level skew/straggler analysis over the same spools, use
+tools/cluster_report.py.
 
 ``--trace`` reads the span stream the flight recorder emits
 (MXNET_TRACE_JSONL, one Chrome-trace event per line) and adds a
@@ -38,6 +46,7 @@ the run (both read the same registry — see docs/ARCHITECTURE.md).
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import sys
 
@@ -65,6 +74,38 @@ def load(path):
     return records
 
 
+def expand_paths(args):
+    """Expand quoted glob patterns (each arg may be a literal path or a
+    pattern); order is args-then-glob-sorted, duplicates dropped."""
+    paths, seen = [], set()
+    for a in args:
+        matches = sorted(glob.glob(a)) if glob.has_magic(a) else [a]
+        if not matches:
+            raise SystemExit(f"{a}: no files match")
+        for p in matches:
+            if p not in seen:
+                seen.add(p)
+                paths.append(p)
+    return paths
+
+
+def load_many(paths):
+    """Load several JSONL files and merge by ``(rank, step)``: each
+    record's sort key is its stamped rank (0 for pre-rank streams) and
+    its per-rank step — ``rank_step`` where a cluster spool stamped it
+    (the process-global ``step`` counter interleaves under
+    threads-as-ranks), else ``step``, else file position.  A stable
+    sort keeps same-key records in file order."""
+    merged = []
+    for path in paths:
+        for i, rec in enumerate(load(path)):
+            key = (int(rec.get("rank") or 0),
+                   int(rec.get("rank_step") or rec.get("step") or i + 1))
+            merged.append((key, rec))
+    merged.sort(key=lambda kr: kr[0])
+    return [rec for _key, rec in merged]
+
+
 def summarize(records):
     host = sorted(r["host_ms"] for r in records if r.get("host_ms")
                   is not None)
@@ -83,6 +124,25 @@ def summarize(records):
     for r in records:
         by_source[r.get("source", "?")] = \
             by_source.get(r.get("source", "?"), 0) + 1
+    # per-rank breakdown (meaningful for merged multi-rank spools; a
+    # single-process stream collapses to one row and is not rendered)
+    by_rank = {}
+    for r in records:
+        by_rank.setdefault(int(r.get("rank") or 0), []).append(r)
+    rank_stats = None
+    if len(by_rank) > 1:
+        rank_stats = {}
+        for rk in sorted(by_rank):
+            rh = sorted(x["host_ms"] for x in by_rank[rk]
+                        if x.get("host_ms") is not None)
+            rank_stats[rk] = {
+                "steps": len(by_rank[rk]),
+                "host_ms_p50": percentile(rh, 50),
+                "host_ms_p95": percentile(rh, 95),
+                "input_wait_ms_mean":
+                    sum(x.get("input_wait_ms", 0.0)
+                        for x in by_rank[rk]) / len(by_rank[rk]),
+            }
     waits = sorted(r.get("input_wait_ms", 0.0) for r in records)
     h2d_total = sum(r.get("h2d_bytes", 0) for r in records)
     # input-bound decision rule (docs/ARCHITECTURE.md "Input pipeline"):
@@ -174,6 +234,7 @@ def summarize(records):
     return {
         "steps": len(records),
         "by_source": by_source,
+        "by_rank": rank_stats,
         "host_ms": {"p50": percentile(host, 50),
                     "p95": percentile(host, 95),
                     "max": host[-1] if host else 0.0},
@@ -298,6 +359,16 @@ def render(s):
              f"{'steps':<28}{s['steps']:>24}"]
     for src, n in sorted(s["by_source"].items()):
         lines.append(f"{'  from ' + src:<28}{n:>24}")
+    if s.get("by_rank"):
+        lines += ["", "Per-rank breakdown", "-" * 52,
+                  f"  {'rank':<6}{'steps':>8}{'p50 ms':>12}{'p95 ms':>12}"
+                  f"{'in-wait ms':>12}"]
+        for rk, st in sorted(s["by_rank"].items()):
+            lines.append(
+                f"  {rk:<6}{st['steps']:>8}{st['host_ms_p50']:>12.3f}"
+                f"{st['host_ms_p95']:>12.3f}"
+                f"{st['input_wait_ms_mean']:>12.3f}")
+        lines.append("")
     lines += [
         f"{'host step ms p50':<28}{s['host_ms']['p50']:>24.3f}",
         f"{'host step ms p95':<28}{s['host_ms']['p95']:>24.3f}",
@@ -384,7 +455,10 @@ def render(s):
 
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", help="telemetry JSONL file to summarize")
+    ap.add_argument("jsonl", nargs="+",
+                    help="telemetry JSONL file(s) to summarize; several "
+                         "files or quoted globs (a cluster spool's "
+                         "rank-*.jsonl) are merged by (rank, step)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as JSON instead of a table")
     ap.add_argument("--trace", metavar="TRACE_JSONL",
@@ -392,9 +466,10 @@ def main(argv=None):
                          "to summarize and reconcile against the step "
                          "records")
     args = ap.parse_args(argv)
-    records = load(args.jsonl)
+    paths = expand_paths(args.jsonl)
+    records = load_many(paths) if len(paths) > 1 else load(paths[0])
     if not records:
-        raise SystemExit(f"{args.jsonl}: no telemetry records")
+        raise SystemExit(f"{', '.join(paths)}: no telemetry records")
     s = summarize(records)
     if args.trace:
         s["trace"] = summarize_trace(load_trace(args.trace), records)
