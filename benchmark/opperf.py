@@ -513,7 +513,42 @@ def main(argv=None):
     p.add_argument("--device-time", action="store_true",
                    help="report per-op DEVICE time from an xplane "
                         "capture (kernel truth) instead of wall time")
+    p.add_argument("--tune", action="store_true",
+                   help="autotune registered Pallas kernels over their "
+                        "shape grids (--ops filters by kernel name) and "
+                        "commit winners to the persistent cache "
+                        "(MXNET_KERNEL_CACHE_DIR)")
     args = p.parse_args(argv)
+
+    if args.tune:
+        # tuning is an explicit request here, whatever MXNET_KERNEL_TUNE
+        # says — the cache file this emits is what makes training/serving
+        # starts measurement-free
+        os.environ["MXNET_KERNEL_TUNE"] = "1"
+        from mxnet_tpu import kernels
+        names = [s for s in args.ops.split(",") if s] or None
+        rows = kernels.tune_registered(names=names, warmup=args.warmup,
+                                       runs=args.runs, verbose=True)
+        winners = [r for r in rows if "winner" in r]
+        hdr = (f"{'kernel':<22s}{'shape sig':<22s}{'dtype':<10s}"
+               f"{'winner config':<34s}{'ms':>9s}")
+        print()
+        print(hdr)
+        print("-" * len(hdr))
+        for r in winners:
+            print(f"{r['kernel']:<22s}{r['sig']:<22s}{r['dtype']:<10s}"
+                  f"{str(r['winner']):<34s}{r['ms']:>9.4f}")
+        path = kernels.cache_path()
+        if path:
+            print(f"# cache written: {path}")
+        else:
+            print("# MXNET_KERNEL_CACHE_DIR unset: winners kept "
+                  "in-process only (not persisted)")
+        if args.output_json:
+            with open(args.output_json, "w") as f:
+                json.dump(rows, f, indent=1)
+            print(f"# wrote {len(rows)} rows to {args.output_json}")
+        return rows
 
     if args.device_time:
         ops = [s for s in args.ops.split(",") if s] or \

@@ -456,6 +456,66 @@ zero_smoke() {        # ZeRO-1 sharded update: tests + memory/time gates
     JAX_PLATFORMS=cpu python benchmark/zero_bench.py --smoke
 }
 
+kernel_smoke() {      # autotune cache: tests + cold tune -> kill -> warm relaunch
+    # tier-1 covers kernel-vs-oracle parity (dtype x ragged shape x
+    # causal), cache round-trip, corruption -> re-tune, stale-version
+    # invalidation, and env-override precedence
+    JAX_PLATFORMS=cpu python -m pytest tests/test_kernels.py -q
+    local tmp; tmp="$(mktemp -d)"
+    # cold leg: measure every registered kernel's config space into a
+    # fresh cache dir, then the tuner process EXITS — the shell-level
+    # equivalent of killing the tuned worker
+    JAX_PLATFORMS=cpu MXNET_KERNEL_CACHE_DIR="$tmp/cache" \
+        python -m benchmark.opperf --tune --warmup 0 --runs 1 \
+        | tee "$tmp/tune.log"
+    grep -q "cache written:" "$tmp/tune.log"
+    # warm leg: a NEW process over the same cache dir must resolve every
+    # winner from disk — cache hits > 0 with ZERO tuning measurements
+    # and zero tune wall ms, even with MXNET_KERNEL_TUNE=1 — and the
+    # tuned flash config must not lose to the env-default config
+    JAX_PLATFORMS=cpu MXNET_KERNEL_CACHE_DIR="$tmp/cache" \
+        MXNET_KERNEL_TUNE=1 python - <<'PY'
+import jax
+from benchmark.opperf import _time_loop
+from mxnet_tpu import kernels, telemetry
+import mxnet_tpu.ops  # registers every KernelSpec
+
+n = kernels.warm_cache()
+assert n >= 1, f"warm relaunch loaded {n} cache entries"
+
+spec = kernels.get_kernel("flash_attention")
+arrays, params = spec.make_args(spec.tune_grid[0])
+sig, dt = spec.signature(*arrays, **params)
+cfg = kernels.resolve("flash_attention", sig, dt,
+                      tune_args=(arrays, params))
+
+hits = telemetry.counter("kernel.cache_hits").value
+tune_ms = telemetry.counter("kernel.tune_ms").value
+tune_runs = telemetry.counter("kernel.tune_measurements").value
+assert hits >= 1, f"warm relaunch reported {hits} cache hits"
+assert tune_ms == 0, f"warm relaunch spent {tune_ms}ms tuning"
+assert tune_runs == 0, f"warm relaunch ran {tune_runs} measurements"
+
+# acceptance gate: tuned config <= env-default config (+ CI jitter
+# epsilon — the tuner's argmin included the default, so a real loss
+# means the cache served a stale/garbage winner)
+def bench(c):
+    def f():
+        jax.block_until_ready(spec.run(c, *arrays, **params))
+    f()
+    return _time_loop(f, 1, 3)
+
+tuned = bench(cfg)
+default = bench(dict(spec.default_config))
+eps = max(5.0, 0.25 * default)
+print(f"kernel_smoke: warm start {hits} hits / 0 tune runs; flash "
+      f"tuned {cfg} {tuned:.1f}ms vs default {default:.1f}ms")
+assert tuned <= default + eps, \
+    f"tuned flash {tuned:.1f}ms slower than default {default:.1f}ms"
+PY
+    rm -rf "$tmp"
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

@@ -1,0 +1,24 @@
+"""Custom-kernel layer: registry + autotuner + persistent cache.
+
+Hot ops (flash attention, fused LayerNorm+residual, the ZeRO
+flatten/pad layout) register a :class:`KernelSpec` here — Pallas
+implementation, tunable config space, XLA fallback/oracle — and
+resolve their configs through :func:`resolve` instead of reading env
+vars per call.  Tuned winners persist in a fleet-shared JSON cache
+(``MXNET_KERNEL_CACHE_DIR``) written with the checkpoint layer's
+atomic rename protocol; see docs/ARCHITECTURE.md "Custom kernels &
+autotune cache".
+"""
+from . import cache  # noqa: F401
+from .cache import cache_dir, cache_path  # noqa: F401
+from .registry import (KernelSpec, register_kernel, get_kernel,  # noqa: F401
+                       list_kernels, resolve, commit, invalidate,
+                       warm_cache, cache_key, record_fallback, stats,
+                       tune_enabled)
+from .autotune import tune, tune_registered, candidates  # noqa: F401
+
+__all__ = ["KernelSpec", "register_kernel", "get_kernel", "list_kernels",
+           "resolve", "commit", "invalidate", "warm_cache", "cache_key",
+           "record_fallback", "stats", "tune_enabled", "tune",
+           "tune_registered", "candidates", "cache", "cache_dir",
+           "cache_path"]

@@ -1,0 +1,131 @@
+"""Measured autotuner over a KernelSpec's config space.
+
+TVM-style (PAPERS.md arxiv 1802.04799) but exhaustive rather than
+model-guided: config spaces here are a handful of block-size/layout
+candidates, so the tuner simply measures each through the
+``benchmark/opperf.py`` timing harness (median-of-runs wall time,
+device-synced per call) and commits the argmin.  Configs that fail to
+build/lower for a shape are skipped, not fatal — a spec's default
+config is always in the candidate set, so the winner is never slower
+than the untuned default on the shapes measured.
+
+Every measured run ticks ``kernel.tune_measurements`` and the total
+wall time ticks ``kernel.tune_ms`` — the two signals ``kernel_smoke``
+asserts are ZERO on a warm-cache relaunch.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import registry as _kreg
+from .registry import _C_TUNE_MS, _C_TUNE_RUNS
+
+__all__ = ["candidates", "tune", "tune_registered"]
+
+
+def _time_loop(fn, warmup: int, runs: int) -> float:
+    """Median wall ms — the opperf harness's loop, imported so the
+    tuner and the benchmark report measure identically (a local copy
+    is kept only for contexts where ``benchmark`` isn't on the path)."""
+    try:
+        from benchmark.opperf import _time_loop as impl
+        return impl(fn, warmup, runs)
+    except ImportError:
+        for _ in range(warmup):
+            fn()
+        samples = []
+        for _ in range(runs):
+            t0 = time.perf_counter()
+            fn()
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return samples[len(samples) // 2] * 1e3
+
+
+def candidates(spec) -> List[Dict[str, Any]]:
+    """The full cartesian product of the config space, default first
+    (so ties resolve to the untuned behavior)."""
+    keys = sorted(spec.config_space)
+    out = [dict(spec.default_config)]
+    for combo in itertools.product(*(spec.config_space[k] for k in keys)):
+        cfg = dict(spec.default_config)
+        cfg.update(zip(keys, combo))
+        if cfg not in out:
+            out.append(cfg)
+    return out
+
+
+def tune(spec, arrays: Sequence[Any], params: Optional[dict] = None,
+         warmup: int = 1, runs: int = 3, verbose: bool = False
+         ) -> Tuple[Dict[str, Any], float, List[dict]]:
+    """Measure every candidate config on ``arrays``; returns
+    ``(best_config, best_ms, rows)`` where rows carry the per-config
+    table ``opperf --tune`` prints."""
+    import jax
+
+    params = params or {}
+    t_start = time.perf_counter()
+    rows: List[dict] = []
+    best_cfg, best_ms = dict(spec.default_config), float("inf")
+    for cfg in candidates(spec):
+
+        def run_once(cfg=cfg):
+            jax.block_until_ready(spec.run(cfg, *arrays, **params))
+            _C_TUNE_RUNS.inc()
+
+        try:
+            run_once()                       # build/compile probe
+            ms = _time_loop(run_once, warmup, runs)
+        except Exception as e:               # config invalid for shape
+            rows.append({"kernel": spec.name, "config": cfg, "ms": None,
+                         "error": f"{type(e).__name__}"})
+            if verbose:
+                print(f"    {cfg}  FAILED ({type(e).__name__})")
+            continue
+        rows.append({"kernel": spec.name, "config": cfg,
+                     "ms": round(ms, 4)})
+        if verbose:
+            print(f"    {cfg}  {ms:9.4f} ms")
+        if ms < best_ms:
+            best_cfg, best_ms = cfg, ms
+    _C_TUNE_MS.inc((time.perf_counter() - t_start) * 1e3)
+    if best_ms == float("inf"):              # nothing ran: keep default
+        best_ms = 0.0
+    return best_cfg, best_ms, rows
+
+
+def tune_registered(names: Optional[Sequence[str]] = None,
+                    warmup: int = 1, runs: int = 3,
+                    verbose: bool = False) -> List[dict]:
+    """Drive the tuner over each kernel's shape grid and commit the
+    winners (memo + persistent cache).  The ``opperf --tune`` backend.
+
+    Returns one row per (kernel, case, config) measurement, plus a
+    ``winner`` row per case.
+    """
+    all_rows: List[dict] = []
+    for name in (list(names) if names else _kreg.list_kernels()):
+        spec = _kreg.get_kernel(name)
+        if spec.make_args is None or not spec.tune_grid:
+            if verbose:
+                print(f"# {name}: no tune grid, skipped")
+            continue
+        for case in spec.tune_grid:
+            arrays, params = spec.make_args(case)
+            sig, dtype = spec.signature(*arrays, **params)
+            if verbose:
+                print(f"# tune {name} [{sig} {dtype}]")
+            cfg, ms, rows = tune(spec, arrays, params=params,
+                                 warmup=warmup, runs=runs, verbose=verbose)
+            key = _kreg.commit(spec, sig, dtype, cfg, ms)
+            for r in rows:
+                r.update({"sig": sig, "dtype": dtype})
+            all_rows.extend(rows)
+            all_rows.append({"kernel": name, "sig": sig, "dtype": dtype,
+                             "winner": cfg, "ms": round(ms, 4),
+                             "key": key})
+            if verbose:
+                print(f"  -> winner {cfg}  {ms:.4f} ms  ({key})")
+    return all_rows

@@ -1,0 +1,96 @@
+"""Persistent kernel-autotune cache (the TVM-style pay-once store).
+
+One versioned JSON document per fleet-shared directory
+(``$MXNET_KERNEL_CACHE_DIR/kernel_cache.json``): measured winning
+configs keyed by the full tuning key (op | kernel version | backend |
+device count | dtype | shape signature — see ``registry.cache_key``).
+A fresh process or a new serving replica looks a config up here instead
+of re-measuring, so tuning cost is paid once per fleet, not once per
+process (PAPERS.md TVM, arxiv 1802.04799).
+
+Durability/corruption contract (shared with the checkpoint layer):
+
+- writes go tmp → flush → fsync → ``os.replace`` → dir fsync
+  (checkpoint.py's rename protocol), so a crashed tuner can never
+  publish a torn file;
+- loads treat ANY defect — missing file, bad JSON, wrong format tag,
+  stale format version, non-dict entries — as an empty cache.  The
+  failure mode is re-tuning, never crashing.
+
+With ``MXNET_KERNEL_CACHE_DIR`` unset the cache is memory-only (the
+in-process memo in ``registry`` still deduplicates within a process).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, Optional
+
+FORMAT = "mxnet-tpu-kernel-cache"
+VERSION = 1
+FILENAME = "kernel_cache.json"
+
+_LOCK = threading.Lock()
+
+
+def cache_dir() -> Optional[str]:
+    """The fleet-shared cache directory, or None for memory-only."""
+    return os.environ.get("MXNET_KERNEL_CACHE_DIR") or None
+
+
+def cache_path() -> Optional[str]:
+    d = cache_dir()
+    return os.path.join(d, FILENAME) if d else None
+
+
+def load() -> Dict[str, dict]:
+    """Entries from disk: ``{key: {"config": {...}, "ms": float}}``.
+
+    Empty dict on every defect (missing/corrupt/stale-version file) —
+    the caller re-tunes instead of crashing, and the next ``store``
+    overwrites the bad file.
+    """
+    path = cache_path()
+    if path is None:
+        return {}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(doc, dict) or doc.get("format") != FORMAT \
+            or doc.get("version") != VERSION:
+        return {}
+    entries = doc.get("entries")
+    if not isinstance(entries, dict):
+        return {}
+    return {k: v for k, v in entries.items()
+            if isinstance(v, dict) and isinstance(v.get("config"), dict)}
+
+
+def store(entries: Dict[str, dict]) -> bool:
+    """Merge ``entries`` into the on-disk document atomically.
+
+    Read-merge-replace under a process lock: concurrent tuners in one
+    process can't drop each other's commits, and the rename keeps a
+    reader (or a crash) from ever observing a torn file.  Returns False
+    (memory-only) when no cache dir is configured.
+    """
+    path = cache_path()
+    if path is None:
+        return False
+    from ..checkpoint import _fsync_dir
+    with _LOCK:
+        merged = load()
+        merged.update(entries)
+        doc = {"format": FORMAT, "version": VERSION, "entries": merged}
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(os.path.dirname(path))
+    return True

@@ -20,5 +20,6 @@ from . import legacy  # noqa: F401
 from . import image   # noqa: F401
 from . import rnn     # noqa: F401
 from . import contrib_extra  # noqa: F401
+from . import layernorm_residual  # noqa: F401
 
 __all__ = ["register", "get", "list_ops", "invoke", "apply_jax"]

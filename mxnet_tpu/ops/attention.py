@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +39,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import kernels as _kernels
 from .registry import register
 
 __all__ = ["flash_attention", "attention_reference", "online_block_update",
@@ -472,17 +474,99 @@ _flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 def _flash_block_default(which, fallback=512):
-    """Tunable default block size (MXNET_TPU_FLASH_BLOCK_Q/_K) so
-    tools/tune_tpu.py results can be applied without code changes.
-    Read per call site at trace time."""
-    import os
-
+    """Parse one MXNET_TPU_FLASH_BLOCK_Q/_K override (invalid/non-
+    positive values fall back).  Only consulted when the env override
+    is actually set — the default path resolves block sizes through the
+    kernel registry (``_resolve_flash_blocks``), once per shape."""
     try:
         v = int(os.environ.get(f"MXNET_TPU_FLASH_BLOCK_{which}",
                                fallback))
     except ValueError:
         return fallback
     return v if v > 0 else fallback
+
+
+# -- kernel-registry integration -------------------------------------------
+# Block sizes come from mxnet_tpu.kernels: env override > in-process
+# memo > on-disk autotune cache > tuner (MXNET_KERNEL_TUNE=1) > default.
+# The env vars are observed as a SNAPSHOT tuple — two dict lookups per
+# call instead of the old per-call int() parse — and any change
+# invalidates the kernel's resolved configs so the override wins
+# immediately in a live process.
+
+_FLASH_ENV_KEYS = ("MXNET_TPU_FLASH_BLOCK_Q", "MXNET_TPU_FLASH_BLOCK_K")
+_flash_env_snapshot: tuple = (False, False)      # impossible sentinel
+
+
+def _pow2_bucket(n, floor=128):
+    """Bucket a sequence length to the next power of two ≥ ``floor`` —
+    ragged lengths share one tuned config per bucket instead of
+    fragmenting the cache per exact length."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+def _flash_signature(q, k, v, causal=False, sm_scale=None):
+    """(shape-sig, dtype) cache-key parts from (BH, S, D) arrays."""
+    return (f"sq{_pow2_bucket(q.shape[1])}_sk{_pow2_bucket(k.shape[1])}"
+            f"_d{q.shape[2]}_c{int(bool(causal))}", str(q.dtype))
+
+
+def _flash_kernel_run(config, q, k, v, causal=False, sm_scale=None):
+    scale = (sm_scale if sm_scale is not None
+             else 1.0 / math.sqrt(q.shape[-1]))
+    return _flash_attention(q, k, v, bool(causal), float(scale),
+                            int(config["block_q"]), int(config["block_k"]))
+
+
+def _flash_kernel_fallback(q, k, v, causal=False, sm_scale=None):
+    """XLA lowering on (BH, S, D) — the numerics oracle the Pallas
+    kernel is pinned against in tests/test_kernels.py."""
+    return attention_reference(q[None], k[None], v[None], causal=causal,
+                               sm_scale=sm_scale)[0]
+
+
+def _flash_make_args(case):
+    import numpy as onp
+    rng = onp.random.RandomState(11)
+    bh, sq, sk, d = case["bh"], case["sq"], case["sk"], case["d"]
+    dtype = case.get("dtype", "float32")
+    q, k, v = (jnp.asarray(rng.randn(bh, s, d) * 0.5, dtype=dtype)
+               for s in (sq, sk, sk))
+    return (q, k, v), {"causal": bool(case.get("causal", False))}
+
+
+_kernels.register_kernel(_kernels.KernelSpec(
+    "flash_attention", version=1,
+    run=_flash_kernel_run, fallback=_flash_kernel_fallback,
+    config_space={"block_q": (128, 256, 512),
+                  "block_k": (128, 256, 512)},
+    default_config={"block_q": 512, "block_k": 512},
+    signature=_flash_signature, make_args=_flash_make_args,
+    tune_grid=({"bh": 4, "sq": 128, "sk": 128, "d": 64, "causal": False},
+               {"bh": 2, "sq": 256, "sk": 256, "d": 64, "causal": True}),
+))
+
+
+def _resolve_flash_blocks(qf, kf, vf, causal, scale):
+    """(block_q, block_k) for one call, resolved once per shape bucket
+    through the kernel registry (satellite fix: the old path re-parsed
+    MXNET_TPU_FLASH_BLOCK_Q/_K from the environment on every call)."""
+    global _flash_env_snapshot
+    env = (os.environ.get(_FLASH_ENV_KEYS[0]),
+           os.environ.get(_FLASH_ENV_KEYS[1]))
+    if env != _flash_env_snapshot:
+        _flash_env_snapshot = env
+        _kernels.invalidate("flash_attention")
+    if env[0] is not None or env[1] is not None:
+        return _flash_block_default("Q"), _flash_block_default("K")
+    sig, dt = _flash_signature(qf, kf, vf, causal=causal)
+    cfg = _kernels.resolve(
+        "flash_attention", sig, dt,
+        tune_args=((qf, kf, vf), {"causal": causal, "sm_scale": scale}))
+    return int(cfg["block_q"]), int(cfg["block_k"])
 
 
 def flash_attention(q, k, v, *, causal=False, sm_scale=None,
@@ -495,10 +579,6 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     (MQA is ``Hkv == 1``).  KV heads are broadcast across the group
     before the kernel; the flash tiling itself is unchanged.
     """
-    if block_q is None:
-        block_q = _flash_block_default("Q")
-    if block_k is None:
-        block_k = _flash_block_default("K")
     squeeze = q.ndim == 3
     if squeeze:
         q, k, v = q[None], k[None], v[None]
@@ -518,6 +598,11 @@ def flash_attention(q, k, v, *, causal=False, sm_scale=None,
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * h, k.shape[2], d)
     vf = v.reshape(b * h, v.shape[2], d)
+    if block_q is None or block_k is None:
+        rq, rk = _resolve_flash_blocks(qf, kf, vf, bool(causal),
+                                       float(scale))
+        block_q = rq if block_q is None else block_q
+        block_k = rk if block_k is None else block_k
     out = _flash_attention(qf, kf, vf, bool(causal), float(scale),
                            int(block_q), int(block_k))
     out = out.reshape(b, h, sq, d)

@@ -36,6 +36,7 @@ before the fallback runs (``unshard_states``).
 """
 from __future__ import annotations
 
+import functools as _functools
 import os
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -52,8 +53,8 @@ from .optimizer import Updater, _lowp_guard, _note_dispatch
 
 __all__ = ["step", "enabled", "stats", "reset_stats", "reset_cache",
            "make_update_fn", "make_sharded_update_fn", "zero_enabled",
-           "zero_degree", "shard_states", "unshard_states",
-           "opt_state_bytes_per_device"]
+           "zero_degree", "zero_pad_unit", "shard_states",
+           "unshard_states", "opt_state_bytes_per_device"]
 
 # jit-cache counters (surfaced by profiler.counters()).
 # compiles/hits count fused executions by cache outcome; fallbacks count
@@ -161,6 +162,102 @@ def zero_degree(mesh=None) -> int:
     return int(mesh.shape.get("dp", 1))
 
 
+# -- flat/pad layout through the kernel config machinery --------------------
+# The sharded update flattens every weight and zero-pads to a layout
+# unit before pinning it PartitionSpec('dp').  pad_multiple=1 (the
+# historical behavior) pads to the dp width only; larger multiples pad
+# each per-device slice to a sublane/lane-aligned length (8, 128) so
+# XLA's per-shard elementwise loops stay tiled.  Zero-padding + final
+# slice preserves elementwise update numerics bitwise for ANY multiple,
+# so the choice is purely a measured layout decision — which is why it
+# lives in the kernel registry's config space rather than in code.
+
+_ZFP_SPACE = (1, 8, 128)
+
+
+def zero_pad_unit(ndev: int) -> int:
+    """The flat-layout pad unit (``ndev × pad_multiple``) the three
+    layout sites below share.  Resolution is memoized per process —
+    every site sees the same unit, and the jit signature derived from
+    it stays stable."""
+    from .. import kernels
+    try:
+        cfg = kernels.resolve("zero_flatten_pad", f"ndev{ndev}", "any")
+        mult = max(1, int(cfg.get("pad_multiple", 1)))
+    except Exception:
+        mult = 1
+    return int(ndev) * mult
+
+
+@_functools.lru_cache(maxsize=32)
+def _zfp_bench_fn(unit: int, nw: int):
+    """One jitted flatten/pad/update/unpad pass over ``nw`` weights —
+    the measurable core the pad-multiple candidates differ on."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = _zero_mesh()
+    shd = NamedSharding(mesh, PartitionSpec("dp"))
+
+    def f(weights, grads):
+        outs = []
+        for w, g in zip(weights, grads):
+            pad = (-w.size) % unit
+            wf = w.reshape(-1)
+            gf = g.reshape(-1)
+            if pad:
+                wf = jnp.concatenate([wf, jnp.zeros((pad,), wf.dtype)])
+                gf = jnp.concatenate([gf, jnp.zeros((pad,), gf.dtype)])
+            wf = jax.lax.with_sharding_constraint(wf, shd)
+            gf = jax.lax.with_sharding_constraint(gf, shd)
+            nw_ = wf - 0.01 * gf
+            outs.append(nw_[:w.size].reshape(w.shape))
+        return tuple(outs)
+
+    return jax.jit(f)
+
+
+def _zfp_run(config, *arrays):
+    n = len(arrays) // 2
+    weights, grads = arrays[:n], arrays[n:]
+    unit = zero_degree() * max(1, int(config["pad_multiple"]))
+    return _zfp_bench_fn(unit, n)(tuple(weights), tuple(grads))
+
+
+def _zfp_fallback(*arrays):
+    """Plain unpadded elementwise update — the numerics oracle: padding
+    with zeros and slicing must never change the surviving elements."""
+    n = len(arrays) // 2
+    return tuple(w - 0.01 * g for w, g in zip(arrays[:n], arrays[n:]))
+
+
+def _zfp_signature(*arrays):
+    n = len(arrays) // 2
+    return f"ndev{zero_degree()}", str(arrays[0].dtype)
+
+
+def _zfp_make_args(case):
+    import numpy as onp
+    rng = onp.random.RandomState(7)
+    sizes = case.get("sizes", (1000, 4097, 65536))
+    ws = tuple(jnp.asarray(rng.randn(s), "float32") for s in sizes)
+    gs = tuple(jnp.asarray(rng.randn(s), "float32") for s in sizes)
+    return ws + gs, {}
+
+
+def _register_zfp_spec():
+    from .. import kernels
+    kernels.register_kernel(kernels.KernelSpec(
+        "zero_flatten_pad", version=1,
+        run=_zfp_run, fallback=_zfp_fallback,
+        config_space={"pad_multiple": _ZFP_SPACE},
+        default_config={"pad_multiple": 1},
+        signature=_zfp_signature, make_args=_zfp_make_args,
+        tune_grid=({"sizes": (1000, 4097, 65536)},),
+    ))
+
+
+_register_zfp_spec()
+
+
 def make_sharded_update_fn(op_name: str, statics_key: Tuple,
                            dyn_names: Tuple[str, ...], mesh):
     """ZeRO-1 variant of :func:`make_update_fn`: the same update rule,
@@ -177,6 +274,7 @@ def make_sharded_update_fn(op_name: str, statics_key: Tuple,
     norms) only ever add zeros to their sums."""
     from jax.sharding import NamedSharding, PartitionSpec
     ndev = int(mesh.shape["dp"])
+    unit = zero_pad_unit(ndev)
     shd = NamedSharding(mesh, PartitionSpec("dp"))
     base_fn = _lowp_guard(_reg.get(op_name).fn)
     statics = dict(statics_key)
@@ -187,7 +285,7 @@ def make_sharded_update_fn(op_name: str, statics_key: Tuple,
             kw = dict(statics)
             for j, nm in enumerate(dyn_names):
                 kw[nm] = dyn[j][i]
-            pad = (-w.size) % ndev
+            pad = (-w.size) % unit
             wf = w.reshape(-1)
             gf = grads[i].reshape(-1)
             if pad:
@@ -238,6 +336,7 @@ def shard_states(updater, indices, mesh) -> None:
     param-shaped slots, and the next sharded step flattens them here."""
     from jax.sharding import NamedSharding, PartitionSpec
     ndev = int(mesh.shape["dp"])
+    unit = zero_pad_unit(ndev)
     shd = NamedSharding(mesh, PartitionSpec("dp"))
     meta = _zero_meta(updater)
     for i in indices:
@@ -249,7 +348,7 @@ def shard_states(updater, indices, mesh) -> None:
         for s in tup:
             shapes.append(tuple(int(d) for d in s.shape))
             flat = s._data.reshape(-1)
-            pad = (-flat.size) % ndev
+            pad = (-flat.size) % unit
             if pad:
                 flat = jnp.concatenate(
                     [flat, jnp.zeros((pad,), flat.dtype)])
@@ -422,10 +521,13 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
     if zero:
         # states may be param-shaped (pre-migration) or already flat
         # sharded — sign with the PROSPECTIVE flat length either way so
-        # the signature is stable across the migration
+        # the signature is stable across the migration.  The pad unit
+        # comes from the same memoized kernel-config resolution the
+        # layout sites use, so signature and layout can't drift.
+        unit = zero_pad_unit(ndev)
         sig = tuple((tuple(w.shape), str(w._data.dtype),
                      str(g._data.dtype),
-                     tuple((w.size + (-w.size) % ndev, str(s._data.dtype))
+                     tuple((w.size + (-w.size) % unit, str(s._data.dtype))
                            for s in sts))
                     for w, g, sts in zip(weights, grads, states))
     else:

@@ -118,6 +118,11 @@ def counters() -> Dict[str, Dict[str, int]]:
       the rank-0 aggregator's straggler verdict and incident count —
       mxnet_tpu/clustermon.py; ``straggler_rank`` is -1 while no rank
       is slow enough to name)
+    - ``kernel``: the custom-kernel layer (config resolutions served
+      from the persistent autotune cache vs default-config misses,
+      autotune wall ms + measurement runs, XLA-fallback dispatches —
+      mxnet_tpu/kernels/; ``tune_ms``/``tune_measurements`` staying 0
+      is the warm-cache acceptance signal)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -189,7 +194,17 @@ def counters() -> Dict[str, Dict[str, int]]:
                     telemetry.counter(
                         "cluster.straggler_incidents").value,
                 "joined_steps":
-                    telemetry.counter("cluster.joined_steps").value}}
+                    telemetry.counter("cluster.joined_steps").value},
+            "kernel": {
+                "cache_hits":
+                    telemetry.counter("kernel.cache_hits").value,
+                "cache_misses":
+                    telemetry.counter("kernel.cache_misses").value,
+                "tune_ms": telemetry.counter("kernel.tune_ms").value,
+                "tune_measurements":
+                    telemetry.counter("kernel.tune_measurements").value,
+                "fallbacks":
+                    telemetry.counter("kernel.fallbacks").value}}
 
 
 def set_config(**kwargs):

@@ -335,6 +335,12 @@ class InferenceEngine:
         batch sizes (int) — example shape/dtype must be known — or full
         batched shapes (tuple), optionally (shape, dtype).  Returns the
         bucket tags compiled (or already present)."""
+        # prefetch the persistent kernel-autotune cache first: any
+        # Pallas-backed op traced during bucket compilation resolves
+        # its tuned config from the in-process memo instead of parsing
+        # the cache file (or worse, measuring) inside a compile
+        from .. import kernels
+        kernels.warm_cache()
         tags = []
         for spec in specs:
             dtype = self._dtype

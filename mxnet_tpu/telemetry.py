@@ -286,6 +286,16 @@ _C_RS_BYTES = counter("comm.reduce_scatter.bytes")
 _C_AG_BYTES = counter("comm.all_gather.bytes")
 _C_AR_BYTES = counter("comm.allreduce.bytes")
 _G_OPT_STATE = gauge("opt_state.bytes_per_device")
+# custom-kernel layer health (mxnet_tpu/kernels/ writes these): config
+# resolutions served from the persistent autotune cache vs falling to
+# the default config, wall ms + measurement runs spent tuning (both
+# MUST stay 0 on a warm-cache start — ci/run.sh kernel_smoke asserts
+# it), and dispatches that took the XLA fallback instead of Pallas
+_C_KRN_HITS = counter("kernel.cache_hits")
+_C_KRN_MISSES = counter("kernel.cache_misses")
+_C_KRN_TUNE_MS = counter("kernel.tune_ms")
+_C_KRN_TUNE_RUNS = counter("kernel.tune_measurements")
+_C_KRN_FALLBACKS = counter("kernel.fallbacks")
 
 
 def record_opt_state_bytes(per_device: int) -> None:
@@ -556,7 +566,8 @@ class _StepToken:
                  "cs_breaks", "h2d_bytes", "ckpt_saves", "ckpt_failures",
                  "ckpt_bytes", "ckpt_gc", "ckpt_vpass", "ckpt_vfail",
                  "rs_bytes", "ag_bytes", "ar_bytes", "barrier_ms",
-                 "buckets")
+                 "krn_hits", "krn_misses", "krn_tune_ms", "krn_tune_runs",
+                 "krn_fallbacks", "buckets")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -579,6 +590,11 @@ class _StepToken:
         self.ag_bytes = _C_AG_BYTES.value
         self.ar_bytes = _C_AR_BYTES.value
         self.barrier_ms = _C_CKPT_BARRIER_MS.value
+        self.krn_hits = _C_KRN_HITS.value
+        self.krn_misses = _C_KRN_MISSES.value
+        self.krn_tune_ms = _C_KRN_TUNE_MS.value
+        self.krn_tune_runs = _C_KRN_TUNE_RUNS.value
+        self.krn_fallbacks = _C_KRN_FALLBACKS.value
         from . import tracing
         self.buckets = tracing.bucket_totals_ms()
 
@@ -727,6 +743,19 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             # this step's window — the cross-rank asymmetry signal
             "barrier_wait_ms": round(
                 _C_CKPT_BARRIER_MS.value - token.barrier_ms, 3),
+        },
+        # custom-kernel layer activity in this step's window.  tune_ms
+        # > 0 means a first-encounter autotune STALLED this step — the
+        # exact stall the persistent cache exists to eliminate (a warm
+        # fleet shows hits>0 on the first steps and tune_ms always 0)
+        "kernel": {
+            "cache_hits": _C_KRN_HITS.value - token.krn_hits,
+            "cache_misses": _C_KRN_MISSES.value - token.krn_misses,
+            "tune_ms": round(
+                _C_KRN_TUNE_MS.value - token.krn_tune_ms, 3),
+            "tune_measurements": (_C_KRN_TUNE_RUNS.value
+                                  - token.krn_tune_runs),
+            "fallbacks": _C_KRN_FALLBACKS.value - token.krn_fallbacks,
         },
     }
     # critical-path decomposition: where this step's wall time went,

@@ -16,6 +16,10 @@ with optimizer-sharding signal (``collective_split`` /
 ``opt_state_bytes`` fields, emitted under MXNET_ZERO or zero_stage>=1)
 get an "Optimizer sharding" section: per-device optimizer-state
 residency and the reduce-scatter / all-gather vs allreduce byte split.
+Runs with custom-kernel signal (``kernel`` delta payloads from
+mxnet_tpu/kernels/) get a "Kernels" section: autotune-cache hit/miss
+traffic, tune wall time, steps stalled by a first-encounter tune, and
+XLA-fallback dispatches — a warm cache keeps stalls at 0.
 
 Usage:
     python tools/telemetry_report.py run.jsonl
@@ -209,6 +213,27 @@ def summarize(records):
             "sharded_update_steps": sum(
                 1 for c in splits if c.get("reduce_scatter", 0)),
         }
+    # custom-kernel layer deltas (mxnet_tpu/kernels/): autotune-cache
+    # hit/miss traffic, steps stalled by a first-encounter tune, and
+    # XLA-fallback dispatches.  Section only renders for runs whose
+    # records carry kernel signal.
+    kn = [r["kernel"] for r in records
+          if isinstance(r.get("kernel"), dict)]
+    kernel = None
+    if any(any(c.values()) for c in kn):
+        kernel = {
+            "cache_hits": sum(c.get("cache_hits", 0) for c in kn),
+            "cache_misses": sum(c.get("cache_misses", 0) for c in kn),
+            "tune_ms": sum(c.get("tune_ms", 0.0) for c in kn),
+            "tune_measurements": sum(c.get("tune_measurements", 0)
+                                     for c in kn),
+            "fallbacks": sum(c.get("fallbacks", 0) for c in kn),
+            # steps that paid an autotune inside their window — a warm
+            # fleet (MXNET_KERNEL_CACHE_DIR primed by opperf --tune)
+            # keeps this at 0
+            "tune_stall_steps": sum(1 for c in kn
+                                    if c.get("tune_ms", 0.0) > 0),
+        }
     srv = [r["serving"] for r in records
            if isinstance(r.get("serving"), dict) and "error" not in
            r["serving"]]
@@ -250,6 +275,7 @@ def summarize(records):
         "serving": serving,
         "checkpoint": ckpt,
         "sharding": sharding,
+        "kernel": kernel,
     }
 
 
@@ -432,6 +458,19 @@ def render(s):
             f"{sh['allreduce_bytes_per_step']:>24.1f}",
             f"{'sharded-update steps':<28}"
             f"{sh['sharded_update_steps']:>24}",
+        ]
+    kn = s.get("kernel")
+    if kn:
+        lines += [
+            "",
+            "Kernels (autotune cache)",
+            "-" * 52,
+            f"{'cache hits':<28}{kn['cache_hits']:>24}",
+            f"{'cache misses':<28}{kn['cache_misses']:>24}",
+            f"{'tune wall ms':<28}{kn['tune_ms']:>24.3f}",
+            f"{'tune measurements':<28}{kn['tune_measurements']:>24}",
+            f"{'steps stalled by tune':<28}{kn['tune_stall_steps']:>24}",
+            f"{'XLA fallbacks':<28}{kn['fallbacks']:>24}",
         ]
     srv = s.get("serving")
     if srv:
