@@ -444,6 +444,166 @@ PY
     rm -rf "$tmp"
 }
 
+incident_smoke() { # incident lifecycle + spool rotation + remediation, end to end
+    # tier-1 covers the unit matrix: rotation/pruning/compaction,
+    # torn lines across segment boundaries, demotion/re-admission,
+    # the incident state machine, advice plumbing, stale-series zeros
+    JAX_PLATFORMS=cpu python -m pytest tests/test_cluster_obs.py -q \
+        -k "incident or rotation or advice or advised or demot or \
+health or stale or summaries or torn or pruned"
+    local tmp; tmp="$(mktemp -d)"
+    # threads-as-ranks over a shared spool dir with a tiny rotation
+    # threshold (~2 KB) so segments roll mid-run.  Rank 1 gets a
+    # fault-injected 50 ms input stall for the first two phases: the
+    # aggregator must open EXACTLY ONE input_bound incident, escalate
+    # it into published prefetch advice (applied under MXNET_REMEDIATE),
+    # then close it when the stall is lifted — all surviving the forced
+    # rotations underneath the tailer.
+    JAX_PLATFORMS=cpu MXNET_CLUSTER_DIR="$tmp/spool" \
+        MXNET_CACHED_STEP=0 MXNET_CLUSTER_WINDOW=6 \
+        MXNET_STRAGGLER_FACTOR=3 MXNET_CLUSTER_SPOOL_MAX_MB=0.002 \
+        MXNET_CLUSTER_SPOOL_KEEP=64 MXNET_CLUSTER_HISTORY=16 \
+        MXNET_REMEDIATE=1 python - <<'PY'
+import json, os, threading, time, urllib.request
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, clustermon, gluon, nd, telemetry
+from mxnet_tpu.data import device_pipeline
+
+telemetry.enabled()                # attach the spool sink up front
+agg = clustermon.aggregator()      # auto-started by MXNET_CLUSTER_DIR
+assert agg is not None, "rank-0 aggregator did not start"
+agg.stop()                         # drive poll() by hand: deterministic
+
+kinds = []
+clustermon.on_incident(lambda ev, inc: kinds.append(ev))
+
+
+def run_phase(stalled, steps):
+    barrier = threading.Barrier(2)
+    errors = []
+
+    def run_rank(r):
+        try:
+            clustermon.set_thread_rank(r, 2)
+            net = mx.gluon.nn.Sequential()
+            net.add(mx.gluon.nn.Dense(16, activation="relu"),
+                    mx.gluon.nn.Dense(4))
+            net.initialize(init=mx.initializer.Xavier())
+            tr = gluon.Trainer(net.collect_params(), "sgd",
+                               {"learning_rate": 0.1})
+            if r == 1 and stalled:
+                orig = tr._update
+                def slow_update(ignore):
+                    time.sleep(0.05)
+                    telemetry.record_input_wait(0.05)
+                    return orig(ignore)
+                tr._update = slow_update
+            x = nd.array(onp.random.RandomState(r)
+                         .randn(8, 32).astype("float32"))
+            for _ in range(steps):
+                barrier.wait(60)
+                with autograd.record():
+                    loss = (net(x) ** 2).sum()
+                loss.backward()
+                tr.step(batch_size=8)
+        except Exception as e:
+            errors.append((r, e))
+            raise
+
+    threads = [threading.Thread(target=run_rank, args=(r,))
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    assert not errors, errors
+
+
+# phase 1a: sustained stall -> exactly one incident opens
+run_phase(stalled=True, steps=10)
+view = agg.poll()
+iv = clustermon.incident_view()
+assert len(iv["open"]) == 1, iv
+assert iv["open"][0]["rank"] == 1, iv
+assert iv["open"][0]["cause"] == "input_bound", iv
+assert telemetry.counter("cluster.straggler_incidents").value == 1
+host, port = clustermon.start_metrics_server(0, host="127.0.0.1")
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                            timeout=10) as resp:       # mid-incident
+    parsed = clustermon.parse_prometheus_text(resp.read().decode())
+causes = {l["cause"]: v
+          for l, v in parsed["mxnet_cluster_straggler_cause"]}
+assert causes == {"input_bound": 1}, causes
+
+# phase 1b: STILL stalled on the next poll -> escalate + advice
+run_phase(stalled=True, steps=4)
+agg.poll()
+assert telemetry.counter("cluster.advice_published").value == 1
+assert os.path.exists(os.path.join(agg.directory,
+                                   clustermon.ADVICE_FILE))
+
+# phase 2: stall lifted -> the incident closes; the rank-side sink
+# consumed the advice along the way and applied it (MXNET_REMEDIATE=1)
+run_phase(stalled=False, steps=14)
+view = agg.poll()
+iv = clustermon.incident_view()
+assert not iv["open"], iv
+assert len(iv["recent"]) == 1 and iv["recent"][0]["status"] == "closed"
+assert iv["recent"][0]["escalated"], iv
+assert iv["counts"] == {"input_bound": 1}, iv
+assert view["straggler"] is None, view["straggler"]
+assert telemetry.counter("cluster.straggler_incidents").value == 1
+assert telemetry.counter(
+    "cluster.incidents_total.input_bound").value == 1
+assert kinds[0] == "open" and kinds[-1] == "close", kinds
+assert "escalate" in kinds, kinds
+assert telemetry.counter("cluster.advice_applied").value >= 1
+assert device_pipeline.advised_depth() >= 4
+
+# the run rotated spools underneath the tailer without losing a line
+segs = [n for n in os.listdir(agg.directory)
+        if clustermon._SEG_RE.match(n)]
+assert segs, "no rotation happened: lower MXNET_CLUSTER_SPOOL_MAX_MB"
+assert telemetry.counter("cluster.spool_lost_segments").value == 0
+assert view["joined_steps"] >= 26, view["joined_steps"]
+health = clustermon.rank_health()
+assert all(h["status"] == "healthy" for h in health.values()), health
+
+# scrape: the incident counter family + the zeroed stale cause series
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics",
+                            timeout=10) as resp:
+    parsed = clustermon.parse_prometheus_text(resp.read().decode())
+fam = {l["cause"]: v
+       for l, v in parsed["mxnet_cluster_incidents_total"]}
+assert fam["input_bound"] == 1, fam
+assert all(v == 0 for c, v in fam.items() if c != "input_bound"), fam
+causes = {l["cause"]: v
+          for l, v in parsed["mxnet_cluster_straggler_cause"]}
+assert causes["none"] == 1 and causes["input_bound"] == 0, causes
+with urllib.request.urlopen(f"http://127.0.0.1:{port}/incidents",
+                            timeout=10) as resp:
+    iv = json.loads(resp.read())
+assert iv["counts"] == {"input_bound": 1}, iv
+assert not iv["open"] and iv["recent"][0]["status"] == "closed", iv
+clustermon.stop_metrics_server()
+print(f"incident_smoke: 1 incident opened/escalated/closed across "
+      f"{len(segs)} rotated segments; advice depth "
+      f"{device_pipeline.advised_depth()} applied; /metrics + "
+      f"/incidents consistent")
+PY
+    # offline: the incident timeline and the rotated-segment history
+    # must render from the same files the live run left behind
+    JAX_PLATFORMS=cpu python tools/cluster_report.py "$tmp/spool" \
+        --factor 3 --incidents | tee "$tmp/report.txt"
+    grep -q "Incident timeline" "$tmp/report.txt"
+    grep -q "input_bound" "$tmp/report.txt"
+    JAX_PLATFORMS=cpu python tools/telemetry_report.py \
+        "$tmp"/spool/rank-*.jsonl | tee "$tmp/telemetry.txt"
+    grep -q "Incidents (clustermon incident store)" "$tmp/telemetry.txt"
+    rm -rf "$tmp"
+}
+
 zero_smoke() {        # ZeRO-1 sharded update: tests + memory/time gates
     # tier-1 covers dp=2 equivalence, env gating, checkpoint resharding
     # across dp=1/2/4, eager bitwise parity and the 1-dispatch cached

@@ -64,10 +64,33 @@ from .. import telemetry
 from .. import tracing
 from ..base import MXNetError, getenv
 
-__all__ = ["DevicePrefetcher", "prefetch_depth", "wrap"]
+__all__ = ["DevicePrefetcher", "prefetch_depth", "wrap",
+           "note_advice_depth", "advised_depth"]
 
 _DONE = "__done__"
 _ERROR = "__error__"
+
+# clustermon remediation advice (cluster.advice_* counters tell the
+# story): a persistently input-bound rank is advised to deepen its
+# prefetch ring.  Applied at the next epoch boundary, and ONLY when the
+# pipeline is already enabled — advice never flips a depth=0 (bitwise
+# passthrough) pipeline on.
+_ADVICE_LOCK = threading.Lock()
+_advised_depth = 0
+
+
+def note_advice_depth(depth: int) -> None:
+    """Record a prefetch-depth advice (monotonic max).  Called by
+    ``clustermon.SpoolSink`` when an ``input_bound`` incident escalates
+    and ``MXNET_REMEDIATE=1``."""
+    global _advised_depth
+    with _ADVICE_LOCK:
+        _advised_depth = max(_advised_depth, int(depth))
+
+
+def advised_depth() -> int:
+    """The current advised depth (0 = no advice)."""
+    return _advised_depth
 
 
 def prefetch_depth(default: int = 2) -> int:
@@ -432,9 +455,12 @@ class DevicePrefetcher:
                 except StopIteration:
                     break
             return it
+        # remediation advice deepens an ENABLED pipeline at the epoch
+        # boundary; a depth=0 passthrough stays bitwise untouched above
+        depth = max(self._depth, _advised_depth)
         self.close()   # a fresh epoch retires any abandoned pipeline
         self._live = _EpochPipeline(self._source_iter(), self._place_fn,
-                                    self._depth, self._name, skip=skip)
+                                    depth, self._name, skip=skip)
         return self._live
 
     # -- io.DataIter protocol parity ------------------------------------

@@ -193,6 +193,12 @@ def counters() -> Dict[str, Dict[str, int]]:
                 "incidents":
                     telemetry.counter(
                         "cluster.straggler_incidents").value,
+                "incidents_total": {
+                    c: telemetry.counter(
+                        "cluster.incidents_total." + c).value
+                    for c in _clustermon.CAUSES + ("unknown",)},
+                "live_ranks":
+                    telemetry.gauge("cluster.live_ranks").value or 0,
                 "joined_steps":
                     telemetry.counter("cluster.joined_steps").value},
             "kernel": {

@@ -19,6 +19,9 @@ path with no sockets.  The HTTP endpoint is a thin stdlib
 - ``GET /metrics`` → the same registry in Prometheus text exposition
   format (clustermon.prometheus_text: ``# TYPE`` lines, rank label on
   every sample) — point a scrape config at the serving port directly.
+- ``GET /incidents`` → clustermon incident history (open + recent
+  closed straggler incidents with per-cause counts, JSON; empty shape
+  when no aggregator runs in this process).
 
 Error mapping: admission shape reject → 400, queue full (load shed) →
 429, request deadline → 504, draining/closed → 503.  ``stop()`` is
@@ -105,6 +108,14 @@ class ServingServer:
         from .. import clustermon
         return clustermon.prometheus_text()
 
+    def incidentz(self) -> dict:
+        """Cluster incident history (what ``GET /incidents`` serves):
+        open + recent closed incidents and per-cause counts from the
+        rank-0 aggregator's incident store; the empty shape when no
+        aggregator runs in this process."""
+        from .. import clustermon
+        return clustermon.incident_view()
+
     def stop(self, drain: bool = True):
         """Drain-aware shutdown: close admission (delivering admitted
         responses when ``drain``), then stop the HTTP listener."""
@@ -154,6 +165,8 @@ class ServingServer:
                         "text/plain; version=0.0.4; charset=utf-8")
                 elif self.path == "/varz":
                     self._reply(200, server.varz())
+                elif self.path.split("?", 1)[0] == "/incidents":
+                    self._reply(200, server.incidentz())
                 elif self.path.split("?", 1)[0] == "/tracez":
                     limit = 100
                     if "?" in self.path:

@@ -530,10 +530,17 @@ def _refresh_env_sinks() -> None:
                     "invalid MXNET_TELEMETRY_LOG_EVERY=%r (want an int)",
                     log_every)
     cluster = os.environ.get("MXNET_CLUSTER_DIR") or None
-    if cluster != _env_cache["cluster"]:
+    # the rotation knobs are constructor state on the sink, so changing
+    # them mid-run re-attaches it (None when disabled: the key must
+    # stay None-equal so the disabled path never imports clustermon)
+    ckey = None if cluster is None else (
+        cluster,
+        os.environ.get("MXNET_CLUSTER_SPOOL_MAX_MB") or None,
+        os.environ.get("MXNET_CLUSTER_SPOOL_KEEP") or None)
+    if ckey != _env_cache["cluster"]:
         if _env_sinks["cluster"] is not None:
             remove_sink(_env_sinks["cluster"])  # also resets the cache entry
-        _env_cache["cluster"] = cluster
+        _env_cache["cluster"] = ckey
         from . import clustermon
         if cluster:
             try:

@@ -54,7 +54,8 @@ from typing import Any, Dict, List, Optional
 
 from . import telemetry
 
-__all__ = ["Span", "span", "begin", "end", "record_span", "enabled",
+__all__ = ["Span", "span", "begin", "end", "record_span", "instant",
+           "enabled",
            "enable", "disable", "export", "recent", "open_spans",
            "aggregate", "clear", "span_count", "dropped_count",
            "bucket_totals_ms", "start_watchdog", "stop_watchdog",
@@ -329,6 +330,15 @@ def record_span(name: str, t_start: float, t_end: float, **attrs) -> None:
         args["parent_id"] = stack[-1].span_id
     args.update(attrs)
     _store(name, t_start, t_end, threading.get_ident(), args)
+
+
+def instant(name: str, **attrs) -> None:
+    """Zero-duration marker event — how out-of-band state transitions
+    (e.g. a clustermon incident opening or closing) land on the trace
+    timeline next to the steps they explain.  No-op when tracing is
+    disabled."""
+    t = time.perf_counter()
+    record_span(name, t, t, **attrs)
 
 
 def _store(name: str, t0: float, t1: float, tid: int, args: dict,

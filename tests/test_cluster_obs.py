@@ -14,15 +14,21 @@ import urllib.request
 import pytest
 
 from mxnet_tpu import checkpoint, clustermon, telemetry, tracing
+from mxnet_tpu.data import device_pipeline
 
 
 @pytest.fixture(autouse=True)
 def _clean_cluster_state():
     """Every test starts/ends with no sinks, no aggregator, no exporter,
-    no thread-rank override, and the cluster gauges zeroed."""
+    no thread-rank override, no incident hooks or stale string-gauge
+    series, no standing prefetch advice, and the cluster gauges
+    zeroed."""
     saved_override = checkpoint._rank_override
     telemetry.clear_sinks()
     clustermon.set_thread_rank(None)
+    clustermon._HOOKS.clear()
+    clustermon._STR_SEEN.clear()
+    device_pipeline._advised_depth = 0
     yield
     telemetry.clear_sinks()
     clustermon.set_thread_rank(None)
@@ -33,6 +39,9 @@ def _clean_cluster_state():
     clustermon.stop_metrics_server()
     checkpoint._rank_override = saved_override
     clustermon.note_rank(0, 1)          # invalidate the resolution cache
+    clustermon._HOOKS.clear()
+    clustermon._STR_SEEN.clear()
+    device_pipeline._advised_depth = 0
     telemetry.reset("cluster.")
     telemetry.enabled()     # re-sync env cache after monkeypatch undo
 
@@ -537,3 +546,444 @@ def test_cluster_report_names_straggler(tmp_path, capsys):
     a = cr.analyze(cr.load_spools(str(tmp_path)), window=0, factor=1.5)
     assert a["straggler"]["rank"] == 1
     assert a["skew"]["step_ms"] == pytest.approx(90.0)
+
+
+# -- spool lifecycle: rotation / pruning / compaction ------------------------
+
+def _emit_n(sink, rank, n, start=1, host_ms=10.0):
+    for s in range(start, start + n):
+        sink.emit({"step": s, "rank": rank, "host_ms": host_ms})
+
+
+def test_spool_rotation_segments_keep_ordinals(tmp_path):
+    rot0 = telemetry.counter("cluster.spool_rotations").value
+    sink = clustermon.SpoolSink(str(tmp_path), max_bytes=120, keep=0)
+    _emit_n(sink, 0, 10)
+    sink.close()
+    segs = sorted(p.name for p in tmp_path.iterdir()
+                  if clustermon._SEG_RE.match(p.name))
+    assert segs                      # rotation actually happened
+    assert telemetry.counter("cluster.spool_rotations").value > rot0
+    # keep=0 retains every segment: the concatenated stream still holds
+    # every record, ordinals unbroken
+    cr = _load_tool("cluster_report")
+    recs = cr.load_spools(str(tmp_path))[0]
+    assert [r["rank_step"] for r in recs] == list(range(1, 11))
+
+
+def test_spool_keep_n_prunes_and_summaries_reconcile(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("MXNET_CLUSTER_WINDOW", "5")
+    sink = clustermon.SpoolSink(str(tmp_path), max_bytes=120, keep=2)
+    total = 30
+    _emit_n(sink, 0, total)
+    sink.close()
+    segs = [p for p in tmp_path.iterdir()
+            if clustermon._SEG_RE.match(p.name)]
+    assert len(segs) <= 2            # keep-N pruned the older segments
+    summary = tmp_path / "rank-0.summary.jsonl"
+    assert summary.exists()
+    sums = [json.loads(l) for l in summary.read_text().splitlines()]
+    assert all(s["summary"] and s["rank"] == 0 for s in sums)
+    # compacted steps + surviving raw records reconcile with the
+    # unrotated total (±1 step tolerance per the contract)
+    cr = _load_tool("cluster_report")
+    surviving = len(cr.load_spools(str(tmp_path))[0])
+    compacted = sum(s["steps"] for s in sums)
+    assert abs((compacted + surviving) - total) <= 1
+    # summaries carry the step range and host-ms mass of what they fold
+    assert min(s["rank_step_first"] for s in sums) == 1
+    assert sum(s["host_ms_total"] for s in sums) == \
+        pytest.approx(compacted * 10.0)
+
+
+def test_aggregator_follows_rotation_with_torn_line(tmp_path):
+    _write_spool(tmp_path, 1, [_rec(1, 10.0)])
+    live = tmp_path / "rank-0.jsonl"
+    whole = json.dumps(_rec(2, 10.0)) + "\n"
+    with open(live, "w") as f:
+        f.write(json.dumps(_rec(1, 10.0)) + "\n" + whole[:12])
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=8,
+                                       factor=1.5)
+    assert agg.poll()["joined_steps"] == 1   # torn tail buffered
+    # the writer rotates mid-record: the torn line's remainder lands at
+    # the head of the NEW live file, and must reassemble across the
+    # segment boundary
+    live.rename(tmp_path / "rank-0.jsonl.1")
+    with open(live, "w") as f:
+        f.write(whole[12:] + json.dumps(_rec(3, 10.0)) + "\n")
+    _write_spool(tmp_path, 1, [_rec(2, 10.0), _rec(3, 10.0)])
+    view = agg.poll()
+    assert view["joined_steps"] == 3
+    assert telemetry.counter("cluster.spool_lost_segments").value == 0
+
+
+def test_aggregator_counts_pruned_unread_segments(tmp_path):
+    # segments 1 and 2 were pruned before the tailer ever saw them:
+    # ingestion resumes at segment 3 and the gap is counted, not fatal
+    _write_spool(tmp_path, 1, [_rec(s, 10.0) for s in (1, 2, 3)])
+    with open(tmp_path / "rank-0.jsonl.3", "w") as f:
+        f.write(json.dumps(_rec(1, 10.0)) + "\n"
+                + json.dumps(_rec(2, 10.0)) + "\n")
+    _write_spool(tmp_path, 0, [_rec(3, 10.0)])
+    lost0 = telemetry.counter("cluster.spool_lost_segments").value
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=8,
+                                       factor=1.5)
+    view = agg.poll()
+    assert telemetry.counter("cluster.spool_lost_segments").value == \
+        lost0 + 2
+    assert view["joined_steps"] == 3
+
+
+# -- dead-rank demotion / rank health ----------------------------------------
+
+def test_dead_rank_demoted_then_readmitted(tmp_path):
+    _write_spool(tmp_path, 0, [_rec(s, 10.0) for s in range(1, 5)])
+    _write_spool(tmp_path, 1, [_rec(s, 10.0) for s in range(1, 5)])
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=4,
+                                       factor=1.5, rank_timeout_s=0.2)
+    clustermon._aggregator = agg
+    view = agg.poll()
+    assert view["live_ranks"] == [0, 1] and view["joined_steps"] == 4
+    # rank 1 goes silent; rank 0 keeps stepping
+    _write_spool(tmp_path, 0, [_rec(s, 10.0) for s in range(5, 9)])
+    time.sleep(0.25)
+    view = agg.poll()
+    assert view["live_ranks"] == [0]
+    assert view["missing_ranks"] == [1]
+    # join proceeds on survivors instead of freezing at step 4
+    assert view["joined_steps"] == 8
+    health = clustermon.rank_health()
+    assert health[1]["status"] == "missing"
+    assert health[1]["last_rank_step"] == 4
+    assert health[1]["since_s"] >= 0.2
+    assert health[0]["status"] == "healthy"
+    assert telemetry.gauge("cluster.live_ranks").value == 1
+    # the spool resumes: the rank is re-admitted automatically
+    _write_spool(tmp_path, 1, [_rec(s, 10.0) for s in range(5, 9)])
+    view = agg.poll()
+    assert view["live_ranks"] == [0, 1]
+    assert clustermon.rank_health()[1]["status"] == "healthy"
+
+
+def test_rank_health_empty_without_aggregator():
+    assert clustermon.rank_health() == {}
+    assert clustermon.incident_view() == {"open": [], "recent": [],
+                                          "counts": {}}
+
+
+def test_barrier_timeout_message_carries_rank_health(tmp_path):
+    assert checkpoint._rank_health_hint({1}) == ""   # no aggregator
+    _write_spool(tmp_path, 0, [_rec(s, 10.0) for s in range(1, 9)])
+    _write_spool(tmp_path, 1, [_rec(s, 10.0) for s in range(1, 5)])
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=4,
+                                       factor=1.5, rank_timeout_s=0.05)
+    clustermon._aggregator = agg
+    agg.poll()
+    time.sleep(0.1)
+    agg.poll()
+    hint = checkpoint._rank_health_hint({1})
+    assert "rank 1: missing" in hint
+    assert "last spool step 4" in hint
+
+
+# -- incident lifecycle ------------------------------------------------------
+
+def _straggler_spools(tmp_path, start, n, slow=True):
+    for r in (0, 1):
+        ms = 100.0 if (r == 1 and slow) else 10.0
+        _write_spool(tmp_path, r,
+                     [_rec(s, ms, input_wait=85.0 if ms > 10.0 else 0.0)
+                      for s in range(start, start + n)])
+
+
+def test_incident_open_escalate_close_lifecycle(tmp_path):
+    events = []
+    clustermon.on_incident(lambda ev, inc: events.append((ev, inc)))
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=4,
+                                       factor=1.5)
+    clustermon._aggregator = agg
+    inc0 = telemetry.counter("cluster.straggler_incidents").value
+    fam0 = telemetry.counter("cluster.incidents_total.input_bound").value
+    _straggler_spools(tmp_path, 1, 4)
+    agg.poll()
+    iv = clustermon.incident_view()
+    assert len(iv["open"]) == 1 and not iv["recent"]
+    opened = iv["open"][0]
+    assert opened["rank"] == 1 and opened["cause"] == "input_bound"
+    assert opened["status"] == "open" and opened["start_rank_step"] == 4
+    assert telemetry.counter("cluster.straggler_incidents").value == \
+        inc0 + 1
+    assert telemetry.counter(
+        "cluster.incidents_total.input_bound").value == fam0 + 1
+    assert clustermon.rank_health()[1] == {
+        "status": "degraded", "cause": "input_bound",
+        "last_rank_step": 4,
+        "since_s": clustermon.rank_health()[1]["since_s"]}
+    # still slow on the next poll: the incident escalates (once) and
+    # the built-in input_bound remediation publishes prefetch advice
+    _straggler_spools(tmp_path, 5, 4)
+    agg.poll()
+    advice = tmp_path / clustermon.ADVICE_FILE
+    assert advice.exists()
+    adv = json.loads(advice.read_text().splitlines()[0])
+    assert adv["action"] == "prefetch_depth" and adv["rank"] == 1
+    assert adv["depth"] >= 4 and adv["incident_id"] == opened["id"]
+    assert telemetry.counter("cluster.advice_published").value == 1
+    # the straggler clears: the incident closes, nothing stays open
+    _straggler_spools(tmp_path, 9, 8, slow=False)
+    agg.poll()
+    iv = clustermon.incident_view()
+    assert not iv["open"] and len(iv["recent"]) == 1
+    closed = iv["recent"][0]
+    assert closed["status"] == "closed" and closed["escalated"]
+    assert closed["end_rank_step"] == 16
+    assert closed["duration_s"] >= 0.0
+    assert closed["peak_ratio"] == pytest.approx(10.0)
+    assert iv["counts"] == {"input_bound": 1}
+    # exactly one incident end-to-end, every transition hooked in order
+    assert telemetry.counter("cluster.straggler_incidents").value == \
+        inc0 + 1
+    assert [e for e, _ in events] == ["open", "escalate", "close"]
+    assert all(i["id"] == opened["id"] for _, i in events)
+    # the whole lifecycle is persisted for post-mortems
+    lines = [json.loads(l) for l in
+             (tmp_path / clustermon.INCIDENT_FILE)
+             .read_text().splitlines()]
+    assert [l["event"] for l in lines] == ["open", "escalate", "close"]
+
+
+def test_incident_hook_exception_is_swallowed(tmp_path):
+    seen = []
+
+    def bad_hook(ev, inc):
+        raise RuntimeError("boom")
+
+    clustermon.on_incident(bad_hook)
+    clustermon.on_incident(lambda ev, inc: seen.append(ev))
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=4,
+                                       factor=1.5)
+    _straggler_spools(tmp_path, 1, 4)
+    agg.poll()                       # must not raise
+    assert seen == ["open"]          # later hooks still ran
+    clustermon.remove_incident_hook(bad_hook)
+    with clustermon._LOCK:
+        assert bad_hook not in clustermon._HOOKS
+
+
+def test_incident_store_ring_is_bounded():
+    store = clustermon.IncidentStore(keep=2)
+    for i in range(3):
+        store.observe({"rank": i, "cause": "comm_skew", "ratio": 2.0,
+                       "step_ms": 20.0}, step=i * 10 + 5, now=100.0 + i)
+        store.observe(None, step=i * 10 + 9, now=101.0 + i)
+    snap = store.snapshot()
+    assert not snap["open"]
+    assert len(snap["recent"]) == 2          # ring kept the newest 2
+    assert [i["rank"] for i in snap["recent"]] == [1, 2]
+    assert snap["counts"] == {"comm_skew": 3}  # counts survive the ring
+
+
+def test_incident_reopens_as_new_incident_on_cause_change():
+    store = clustermon.IncidentStore()
+    store.observe({"rank": 1, "cause": "input_bound", "ratio": 3.0,
+                   "step_ms": 30.0}, step=4, now=10.0)
+    # same rank, different cause: close + open, not a mutation
+    events = store.observe({"rank": 1, "cause": "comm_skew",
+                            "ratio": 2.0, "step_ms": 20.0},
+                           step=8, now=11.0)
+    assert [e["event"] for e in events] == ["close", "open"]
+    snap = store.snapshot()
+    assert snap["open"][0]["cause"] == "comm_skew"
+    assert snap["recent"][0]["cause"] == "input_bound"
+    assert snap["open"][0]["id"] != snap["recent"][0]["id"]
+
+
+# -- remediation advice (rank side) ------------------------------------------
+
+def _advice_line(tmp_path, rank=0, depth=4, incident=1):
+    with open(tmp_path / clustermon.ADVICE_FILE, "a") as f:
+        f.write(json.dumps({"action": "prefetch_depth", "rank": rank,
+                            "depth": depth, "incident_id": incident})
+                + "\n")
+
+
+def test_advice_ignored_without_remediate_env(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_REMEDIATE", raising=False)
+    ign0 = telemetry.counter("cluster.advice_ignored").value
+    _advice_line(tmp_path, rank=0, depth=4)
+    sink = clustermon.SpoolSink(str(tmp_path))
+    _emit_n(sink, 0, 4)              # advice checked every 4th record
+    sink.close()
+    assert telemetry.counter("cluster.advice_ignored").value == ign0 + 1
+    assert telemetry.counter("cluster.advice_applied").value == 0
+    assert device_pipeline.advised_depth() == 0
+
+
+def test_advice_applied_under_remediate_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_REMEDIATE", "1")
+    _advice_line(tmp_path, rank=0, depth=5)
+    _advice_line(tmp_path, rank=7, depth=99)     # not our rank: ignored
+    sink = clustermon.SpoolSink(str(tmp_path))
+    _emit_n(sink, 0, 4)
+    sink.close()
+    assert telemetry.counter("cluster.advice_applied").value == 1
+    assert device_pipeline.advised_depth() == 5
+
+
+def test_advised_depth_deepens_enabled_pipeline_only():
+    import numpy as onp
+    data = [onp.zeros((2, 2), dtype="float32") for _ in range(3)]
+    # enabled pipeline: advice raises the ring depth at the next epoch
+    p = device_pipeline.DevicePrefetcher(data, depth=1)
+    device_pipeline.note_advice_depth(3)
+    list(iter(p))
+    assert p._live._q.maxsize == 3
+    p.close()
+    # disabled pipeline stays the bitwise passthrough: advice must
+    # never flip it on
+    p0 = device_pipeline.DevicePrefetcher(data, depth=0)
+    it = iter(p0)
+    assert p0._live is None
+    assert not isinstance(it, device_pipeline._EpochPipeline)
+    assert len(list(it)) == 3
+
+
+# -- stale-series fix + incident counter family ------------------------------
+
+def test_prometheus_stale_cause_series_zeroed():
+    telemetry.gauge("cluster.straggler_cause").set("input_bound")
+    parsed = clustermon.parse_prometheus_text(
+        clustermon.prometheus_text())
+    (labels, val), = parsed["mxnet_cluster_straggler_cause"]
+    assert labels["cause"] == "input_bound" and val == 1
+    # the cause clears: the old series must report 0, not linger at 1
+    telemetry.gauge("cluster.straggler_cause").set("none")
+    parsed = clustermon.parse_prometheus_text(
+        clustermon.prometheus_text())
+    by_cause = {l["cause"]: v
+                for l, v in parsed["mxnet_cluster_straggler_cause"]}
+    assert by_cause == {"none": 1, "input_bound": 0}
+
+
+def test_prometheus_incident_counter_family():
+    telemetry.counter("cluster.incidents_total.input_bound").inc(2)
+    text = clustermon.prometheus_text()
+    # ONE family, one TYPE line, cause as a label — not five metrics
+    assert text.count("# TYPE mxnet_cluster_incidents_total counter") \
+        == 1
+    assert "mxnet_cluster_incidents_total_input_bound" not in text
+    parsed = clustermon.parse_prometheus_text(text)
+    fam = {l["cause"]: v
+           for l, v in parsed["mxnet_cluster_incidents_total"]}
+    assert fam == {"input_bound": 2, "compile_stall": 0,
+                   "ckpt_interference": 0, "comm_skew": 0, "unknown": 0}
+    assert all(l["rank"] == "0"
+               for l, _ in parsed["mxnet_cluster_incidents_total"])
+
+
+def test_incidents_endpoint_on_exporter(tmp_path):
+    agg = clustermon.ClusterAggregator(str(tmp_path), window=4,
+                                       factor=1.5)
+    clustermon._aggregator = agg
+    _straggler_spools(tmp_path, 1, 4)
+    agg.poll()
+    _host, port = clustermon.start_metrics_server(0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/incidents", timeout=10) as r:
+            assert r.headers["Content-Type"] == "application/json"
+            iv = json.loads(r.read())
+        assert iv["counts"] == {"input_bound": 1}
+        assert iv["open"][0]["rank"] == 1
+        # the incident also shows in the /metrics counter family
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            parsed = clustermon.parse_prometheus_text(r.read().decode())
+        fam = {l["cause"]: v
+               for l, v in parsed["mxnet_cluster_incidents_total"]}
+        assert fam["input_bound"] == 1
+    finally:
+        clustermon.stop_metrics_server()
+
+
+# -- report tools: lifecycle-aware loading -----------------------------------
+
+def test_cluster_report_reads_rotated_segments_and_incidents(tmp_path,
+                                                             capsys):
+    cr = _load_tool("cluster_report")
+    # records written through the rotating sink itself, two ranks
+    sink = clustermon.SpoolSink(str(tmp_path), max_bytes=150, keep=0)
+    for s in range(1, 9):
+        for r in (0, 1):
+            sink.emit(dict(_rec(s, 100.0 if r else 10.0,
+                                input_wait=85.0 if r else 0.0), rank=r))
+    sink.close()
+    assert any(clustermon._SEG_RE.match(p.name)
+               for p in tmp_path.iterdir())
+    by_rank = cr.load_spools(str(tmp_path))
+    assert [x["rank_step"] for x in by_rank[0]] == list(range(1, 9))
+    assert [x["rank_step"] for x in by_rank[1]] == list(range(1, 9))
+    # incident history written by the store, rendered by the tool
+    store = clustermon.IncidentStore(str(tmp_path))
+    store.observe({"rank": 1, "cause": "input_bound", "ratio": 10.0,
+                   "step_ms": 100.0}, step=4, now=50.0)
+    store.observe(None, step=8, now=60.0)
+    assert cr.main([str(tmp_path), "--factor", "1.5",
+                    "--incidents"]) == 0
+    out = capsys.readouterr().out
+    assert "rank 1 is the straggler" in out
+    assert "Incident timeline" in out
+    assert "input_bound" in out and "closed" in out
+
+
+def test_cluster_report_offline_torn_segment_boundary(tmp_path):
+    cr = _load_tool("cluster_report")
+    whole = json.dumps(_rec(2, 10.0)) + "\n"
+    with open(tmp_path / "rank-0.jsonl.1", "w") as f:
+        f.write(json.dumps(_rec(1, 10.0)) + "\n" + whole[:9])
+    with open(tmp_path / "rank-0.jsonl", "w") as f:
+        f.write(whole[9:] + json.dumps(_rec(3, 10.0)) + "\n")
+    recs = cr.load_spools(str(tmp_path))[0]
+    assert [x["rank_step"] for x in recs] == [1, 2, 3]
+
+
+def test_cluster_report_compacted_summaries_reconcile(tmp_path, capsys,
+                                                      monkeypatch):
+    monkeypatch.setenv("MXNET_CLUSTER_WINDOW", "5")
+    cr = _load_tool("cluster_report")
+    sink = clustermon.SpoolSink(str(tmp_path), max_bytes=120, keep=1)
+    _emit_n(sink, 0, 25)
+    sink.close()
+    sums = cr.load_summaries(str(tmp_path))
+    assert 0 in sums
+    a = cr.analyze(cr.load_spools(str(tmp_path)), 0, 1.5,
+                   summaries=sums)
+    total = a["compacted"][0]["steps"] + a["records"][0]
+    assert abs(total - 25) <= 1
+    assert cr.main([str(tmp_path)]) == 0
+    assert "Compacted history" in capsys.readouterr().out
+
+
+def test_telemetry_report_incidents_section(tmp_path, capsys):
+    tr = _load_tool("telemetry_report")
+    recs = [dict(_rec(s, 10.0), rank=0, step=s, compiles=0,
+                 collective_bytes=0, device_mem=[])
+            for s in range(1, 4)]
+    _write_spool(tmp_path, 0, recs)
+    store = clustermon.IncidentStore(str(tmp_path))
+    store.observe({"rank": 1, "cause": "input_bound", "ratio": 3.0,
+                   "step_ms": 30.0}, step=2, now=10.0)
+    store.observe(None, step=3, now=12.0)
+    store.observe({"rank": 0, "cause": "comm_skew", "ratio": 2.0,
+                   "step_ms": 20.0}, step=3, now=13.0)
+    inc = tr.summarize_incidents([str(tmp_path / "rank-0.jsonl")])
+    # final-state-per-id counting == the live counter family semantics
+    assert inc["total_opened"] == 2 and inc["total_closed"] == 1
+    assert inc["open_now"] == 1
+    assert inc["by_cause"]["input_bound"] == {"opened": 1, "closed": 1}
+    assert inc["by_cause"]["comm_skew"] == {"opened": 1, "closed": 0}
+    assert tr.main([str(tmp_path / "rank-0.jsonl")]) == 0
+    out = capsys.readouterr().out
+    assert "Incidents (clustermon incident store)" in out
+    assert "input_bound" in out

@@ -228,7 +228,7 @@ def test_profiler_counters_snapshot():
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
                       "serving", "input", "tracing", "checkpoint",
-                      "cluster"}
+                      "cluster", "kernel"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
                                     "steps", "zero_steps"}
@@ -247,9 +247,15 @@ def test_profiler_counters_snapshot():
                                     "bytes", "gc_removed",
                                     "verify_passes", "verify_failures",
                                     "faults_injected"}
-    assert set(c["cluster"]) == {"rank", "world", "ranks",
+    assert set(c["cluster"]) == {"rank", "world", "ranks", "live_ranks",
                                  "straggler_rank", "straggler_cause",
-                                 "incidents", "joined_steps"}
+                                 "incidents", "incidents_total",
+                                 "joined_steps"}
+    assert set(c["cluster"]["incidents_total"]) == {
+        "input_bound", "compile_stall", "ckpt_interference",
+        "comm_skew", "unknown"}
+    assert set(c["kernel"]) == {"cache_hits", "cache_misses", "tune_ms",
+                                "tune_measurements", "fallbacks"}
     assert c["cluster"]["straggler_rank"] == -1   # no aggregator running
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100

@@ -392,6 +392,9 @@ def test_server_inprocess_predict_and_healthz():
         h = srv.healthz()
         assert h["status"] == "serving" and h["max_batch_size"] == 4
         assert f"1x{UNITS}:float32" in h["buckets"]
+        # /incidents surface: the empty shape when no aggregator runs
+        assert srv.incidentz() == {"open": [], "recent": [],
+                                   "counts": {}}
     finally:
         srv.stop(drain=True)
     assert srv.healthz()["status"] == "draining"
@@ -414,6 +417,10 @@ def test_http_endpoint_roundtrip():
         url = f"http://{host}:{port}"
         with urllib.request.urlopen(f"{url}/healthz", timeout=10) as resp:
             assert json.loads(resp.read())["status"] == "serving"
+        with urllib.request.urlopen(f"{url}/incidents",
+                                    timeout=10) as resp:
+            inc = json.loads(resp.read())
+        assert set(inc) == {"open", "recent", "counts"}
         body = json.dumps({"data": x.tolist()}).encode()
         req = urllib.request.Request(
             f"{url}/predict", data=body,

@@ -14,9 +14,17 @@ over the ``rank-*.jsonl`` spools a finished (or dead) run left behind:
 - the straggler verdict: which rank, how much slower than the peer
   median, and the dominant cause class with its per-signal excess.
 
+Spool lifecycle aware: each rank's records are reassembled from its
+rotated segments (``rank-<r>.jsonl.<k>`` in ``k`` order, torn lines
+carried across segment boundaries) followed by the live spool; history
+already folded into ``rank-<r>.summary.jsonl`` by the compactor is
+reported separately, and ``incidents.jsonl`` feeds the incident
+timeline (``--incidents``).
+
 Usage:
     python tools/cluster_report.py /path/to/cluster_dir
     python tools/cluster_report.py dir --window 50 --factor 1.3
+    python tools/cluster_report.py dir --incidents   # + timeline table
     python tools/cluster_report.py dir --json     # machine-readable
 
 Numbers reconcile with the live aggregator's gauges
@@ -38,18 +46,62 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from mxnet_tpu import clustermon  # noqa: E402
 
 _SPOOL_RE = re.compile(r"rank-(\d+)\.jsonl$")
+_SEG_RE = re.compile(r"rank-(\d+)\.jsonl\.(\d+)$")
+_SUM_RE = re.compile(r"rank-(\d+)\.summary\.jsonl$")
+_LIVE = float("inf")    # sort key: the live spool reads last
 
 
 def load_spools(directory):
-    """{rank: [records]} from every ``rank-*.jsonl`` in ``directory``
-    (torn/blank lines skipped, matching the live tailer)."""
+    """{rank: [records]} with each rank's rotated segments
+    (``rank-<r>.jsonl.<k>`` in ``k`` order) concatenated before its
+    live spool — one logical byte stream per rank, so a record torn
+    across a rotation boundary reassembles exactly as the live tailer
+    sees it.  Torn/blank lines are skipped."""
     by_rank = {}
+    files = {}
     try:
         names = sorted(os.listdir(directory))
     except OSError as e:
         raise SystemExit(f"{directory}: {e}")
     for name in names:
         m = _SPOOL_RE.match(name)
+        if m:
+            files.setdefault(int(m.group(1)), []).append((_LIVE, name))
+            continue
+        m = _SEG_RE.match(name)
+        if m:
+            files.setdefault(int(m.group(1)), []).append(
+                (int(m.group(2)), name))
+    for r in sorted(files):
+        stream = "".join(
+            open(os.path.join(directory, name)).read()
+            for _k, name in sorted(files[r]))
+        recs = []
+        for line in stream.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                continue
+        by_rank[r] = recs
+    if not by_rank:
+        raise SystemExit(f"{directory}: no rank-*.jsonl spools found")
+    return by_rank
+
+
+def load_summaries(directory):
+    """{rank: [summary records]} from the compactor's
+    ``rank-<r>.summary.jsonl`` files (empty when nothing was ever
+    pruned)."""
+    out = {}
+    try:
+        names = sorted(os.listdir(directory))
+    except OSError:
+        return out
+    for name in names:
+        m = _SUM_RE.match(name)
         if not m:
             continue
         recs = []
@@ -62,13 +114,36 @@ def load_spools(directory):
                     recs.append(json.loads(line))
                 except ValueError:
                     continue
-        by_rank[int(m.group(1))] = recs
-    if not by_rank:
-        raise SystemExit(f"{directory}: no rank-*.jsonl spools found")
-    return by_rank
+        if recs:
+            out[int(m.group(1))] = recs
+    return out
 
 
-def analyze(by_rank, window, factor):
+def load_incidents(directory):
+    """Final state per incident id from ``incidents.jsonl`` (each
+    lifecycle transition appends a full copy; the last line per id
+    wins)."""
+    path = os.path.join(directory, clustermon.INCIDENT_FILE)
+    by_id = {}
+    try:
+        f = open(path)
+    except OSError:
+        return []
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if "id" in rec:
+                by_id[rec["id"]] = rec
+    return [by_id[i] for i in sorted(by_id)]
+
+
+def analyze(by_rank, window, factor, summaries=None, incidents=None):
     stats = clustermon.window_stats(by_rank, window)
     joined = clustermon.join_by_step(by_rank)
     ranks = sorted(by_rank)
@@ -83,10 +158,20 @@ def analyze(by_rank, window, factor):
                 "step_ratio": max(means) / min(means)
                 if min(means) > 0 else None,
                 "barrier_wait_ms": max(barrier) - min(barrier)}
+    compacted = {
+        r: {"steps": sum(s.get("steps", 0) for s in recs),
+            "rank_step_first": min(s.get("rank_step_first", 0)
+                                   for s in recs),
+            "rank_step_last": max(s.get("rank_step_last", 0)
+                                  for s in recs),
+            "host_ms_total": round(sum(s.get("host_ms_total", 0.0)
+                                       for s in recs), 3)}
+        for r, recs in (summaries or {}).items()}
     return {"ranks": stats, "records": {r: len(v) for r, v in
                                         by_rank.items()},
             "joined_steps": complete, "window": window, "factor": factor,
-            "skew": skew,
+            "skew": skew, "compacted": compacted,
+            "incidents": incidents or [],
             "straggler": clustermon.detect_straggler(stats, factor)}
 
 
@@ -121,6 +206,17 @@ def render(a):
                   f"(slowest/fastest {ratio})",
                   f"  barrier-wait asymmetry : "
                   f"{sk['barrier_wait_ms']:.2f} ms"]
+    if a.get("compacted"):
+        lines += ["", "Compacted history (pruned segments, from "
+                      "rank-*.summary.jsonl)", "-" * 72,
+                  f"  {'rank':<5}{'steps':>6}{'first':>8}{'last':>8}"
+                  f"{'host ms total':>15}"]
+        for r in sorted(a["compacted"]):
+            c = a["compacted"][r]
+            lines.append(f"  {r:<5}{c['steps']:>6}"
+                         f"{c['rank_step_first']:>8}"
+                         f"{c['rank_step_last']:>8}"
+                         f"{c['host_ms_total']:>15.2f}")
     st = a["straggler"]
     lines += ["", "Straggler verdict", "-" * 72]
     if st is None:
@@ -138,6 +234,30 @@ def render(a):
     return "\n".join(lines)
 
 
+def render_incidents(incidents):
+    """The incident-timeline table (detect -> open -> escalate ->
+    close), from the final state of each id in incidents.jsonl."""
+    lines = ["", "Incident timeline", "-" * 72]
+    if not incidents:
+        lines.append("  none recorded")
+        return "\n".join(lines)
+    lines.append(f"  {'id':<4}{'rank':<6}{'cause':<19}{'open@step':>10}"
+                 f"{'close@step':>11}{'dur s':>8}{'peak':>7}  status")
+    for inc in incidents:
+        end = inc.get("end_rank_step")
+        dur = inc.get("duration_s")
+        lines.append(
+            f"  {inc.get('id', '?'):<4}{inc.get('rank', '?'):<6}"
+            f"{inc.get('cause', '?'):<19}"
+            f"{inc.get('start_rank_step', 0):>10}"
+            f"{end if end is not None else '-':>11}"
+            f"{dur if dur is not None else '-':>8}"
+            f"{str(inc.get('peak_ratio', '?')) + 'x':>7}"
+            f"  {inc.get('status', '?')}"
+            + ("  [escalated]" if inc.get("escalated") else ""))
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("cluster_dir",
@@ -152,16 +272,23 @@ def main(argv=None):
                          "1.5)")
     ap.add_argument("--json", action="store_true",
                     help="emit the analysis as JSON instead of a table")
+    ap.add_argument("--incidents", action="store_true",
+                    help="append the incident-timeline table "
+                         "(incidents.jsonl)")
     args = ap.parse_args(argv)
     factor = args.factor
     if factor is None:
         factor = clustermon._straggler_factor()
-    a = analyze(load_spools(args.cluster_dir), args.window, factor)
+    a = analyze(load_spools(args.cluster_dir), args.window, factor,
+                summaries=load_summaries(args.cluster_dir),
+                incidents=load_incidents(args.cluster_dir))
     if args.json:
         json.dump(a, sys.stdout, indent=2)
         sys.stdout.write("\n")
     else:
         print(render(a))
+        if args.incidents:
+            print(render_incidents(a["incidents"]))
     return 0
 
 

@@ -52,6 +52,7 @@ from __future__ import annotations
 import argparse
 import glob
 import json
+import os
 import sys
 
 
@@ -492,6 +493,66 @@ def render(s):
     return "\n".join(lines)
 
 
+def summarize_incidents(paths):
+    """Per-cause incident counts from an ``incidents.jsonl`` sitting
+    next to the input spool files (clustermon's incident store writes
+    it into MXNET_CLUSTER_DIR, beside ``rank-*.jsonl``).  None when no
+    sibling incident history exists.  Counting final-state-per-id keeps
+    ``opened`` per cause identical to the live
+    ``cluster.incidents_total{cause=...}`` counter family — both count
+    each incident id exactly once — so the offline report reconciles
+    with a /metrics scrape of the same run."""
+    dirs = []
+    for p in paths:
+        d = os.path.dirname(os.path.abspath(p))
+        if d not in dirs:
+            dirs.append(d)
+    by_id = {}
+    for d in dirs:
+        try:
+            f = open(os.path.join(d, "incidents.jsonl"))
+        except OSError:
+            continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if "id" in rec:
+                    by_id[(d, rec["id"])] = rec
+    if not by_id:
+        return None
+    causes = {}
+    open_now = 0
+    for rec in by_id.values():
+        c = causes.setdefault(rec.get("cause", "unknown"),
+                              {"opened": 0, "closed": 0})
+        c["opened"] += 1
+        if rec.get("status") == "closed":
+            c["closed"] += 1
+        else:
+            open_now += 1
+    return {"total_opened": len(by_id),
+            "total_closed": sum(c["closed"] for c in causes.values()),
+            "open_now": open_now, "by_cause": causes}
+
+
+def render_incidents(inc):
+    lines = ["", "Incidents (clustermon incident store)", "-" * 52,
+             f"{'opened':<28}{inc['total_opened']:>24}",
+             f"{'closed':<28}{inc['total_closed']:>24}",
+             f"{'open now':<28}{inc['open_now']:>24}"]
+    for cause in sorted(inc["by_cause"]):
+        c = inc["by_cause"][cause]
+        detail = f"{c['opened']} opened / {c['closed']} closed"
+        lines.append(f"{'  ' + cause:<28}{detail:>24}")
+    return "\n".join(lines)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("jsonl", nargs="+",
@@ -510,6 +571,9 @@ def main(argv=None):
     if not records:
         raise SystemExit(f"{', '.join(paths)}: no telemetry records")
     s = summarize(records)
+    incidents = summarize_incidents(paths)
+    if incidents:
+        s["incidents"] = incidents
     if args.trace:
         s["trace"] = summarize_trace(load_trace(args.trace), records)
     if args.json:
@@ -517,6 +581,8 @@ def main(argv=None):
         sys.stdout.write("\n")
     else:
         out = render(s)
+        if incidents:
+            out += "\n" + render_incidents(incidents)
         if args.trace:
             out += "\n" + render_trace(s["trace"])
         print(out)
