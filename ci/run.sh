@@ -676,6 +676,36 @@ PY
     rm -rf "$tmp"
 }
 
+embedding_smoke() {   # sharded embedding tables: tests + DLRM bench gates
+    # tier-1 covers partition routing, the bitwise pull->compute->push
+    # round trip vs a dense reference (1- AND 2-shard), server-side
+    # duplicate-id coalescing under momentum, cross-shard-count
+    # checkpoint restore, the 2-bit compressed sparse push with error
+    # feedback, both cache tiers, the engine admission hook, and the
+    # LibSVM last_batch_handle matrix
+    JAX_PLATFORMS=cpu python -m pytest tests/test_embedding.py -q
+    local tmp; tmp="$(mktemp -d)"
+    # then the DLRM bench (2-shard threads-as-ranks soak on generated
+    # LibSVM) must hold all four gates: the table exceeds one device's
+    # allotment while each of the 2 shards fits, sparse wire bytes stay
+    # <=0.2x the dense-push equivalent, the 2-shard save -> kill ->
+    # 1-shard digest-verified restore is assert_array_equal with the
+    # pre-kill table, and the repeated-user serving batch scores >=1
+    # lookup-cache hit (the bench exits non-zero otherwise)
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY_JSONL="$tmp/run.jsonl" \
+        python benchmark/embedding_bench.py --smoke \
+        | tee "$tmp/bench.json"
+    grep -q '"restore_match": true' "$tmp/bench.json"
+    grep -q '"serving_cache_hits": [1-9]' "$tmp/bench.json"
+    grep -q '"ok": true' "$tmp/bench.json"
+    # the report renders the embedding section off the same run's JSONL
+    JAX_PLATFORMS=cpu python tools/telemetry_report.py "$tmp/run.jsonl" \
+        | tee "$tmp/report.txt"
+    grep -q "Embedding (sharded tables)" "$tmp/report.txt"
+    grep -q "sparse/dense wire ratio" "$tmp/report.txt"
+    rm -rf "$tmp"
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

@@ -54,6 +54,7 @@ from . import profiler  # noqa: E402
 from . import telemetry  # noqa: E402
 from . import tracing  # noqa: E402
 from . import serving  # noqa: E402
+from . import embedding  # noqa: E402
 from . import checkpoint  # noqa: E402
 from . import data  # noqa: E402
 from . import monitor  # noqa: E402

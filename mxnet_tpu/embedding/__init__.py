@@ -1,0 +1,22 @@
+"""Sharded embedding tables: the planet-scale recommender path.
+
+Row-shards one logical embedding table across parameter-server shards
+(mod- or range-partitioned by row id) so the table can exceed any
+single device's memory; a training step sparse-pulls only the touched
+rows, computes densely on device, and row-sparse-pushes gradients back
+through the existing kvstore/PS wire — with the 2-bit
+gradient-compression format applying to the sparse payloads and the
+unified ``payload_nbytes`` accounting feeding the ``embedding.*``
+telemetry counters.  Table shards checkpoint deterministically through
+``mxnet_tpu.checkpoint`` (one manifest-listed, SHA-256-digested
+artifact per shard, portable across shard counts the way dense
+checkpoints reshard across dp), and a serving-side LRU lookup tier
+(:class:`EmbeddingLookupCache`) fronts the PS for inference batches.
+
+Heritage: the parameter-server kvstore layer (PAPER.md layer 8) and
+TensorFlow's sparse PS design (PAPERS.md, arxiv 1605.08695).
+"""
+from .sharded import ShardedEmbedding, num_shards_env
+from .cache import EmbeddingLookupCache
+
+__all__ = ["ShardedEmbedding", "EmbeddingLookupCache", "num_shards_env"]

@@ -52,12 +52,35 @@ class LibSVMIter(DataIter):
     dimension (int or 1-tuple); optional ``label_libsvm``/``label_shape``
     stream multi-dimensional labels from a second file (parity:
     iter_libsvm.cc param struct).
+
+    ``last_batch_handle`` makes the trailing-partial-batch policy
+    explicit:
+
+    * ``'pad'`` — the DEFAULT: the final batch wraps around to the
+      epoch head to fill up (``DataBatch.pad`` tells the consumer how
+      many trailing rows are refill, exactly the reference's
+      round_batch semantics), so every batch has full ``batch_size``
+      and no row is silently lost;
+    * ``'discard'`` — the trailing partial batch is DROPPED; the
+      dropped row count ticks the ``io.libsvm.discarded_rows``
+      telemetry counter every epoch, so the loss is visible instead of
+      silent.
+
+    Legacy ``round_batch=False`` (with no ``last_batch_handle``) keeps
+    its historical behavior of yielding the short final batch as-is.
     """
 
     def __init__(self, data_libsvm: str, data_shape, batch_size: int,
                  label_libsvm: Optional[str] = None, label_shape=None,
-                 round_batch: bool = True, **kwargs):
+                 round_batch: bool = True,
+                 last_batch_handle: Optional[str] = None, **kwargs):
         super().__init__(batch_size)
+        if last_batch_handle not in (None, "pad", "discard"):
+            raise MXNetError(
+                f"last_batch_handle must be 'pad' or 'discard', got "
+                f"{last_batch_handle!r}")
+        self.last_batch_handle = last_batch_handle or \
+            ("pad" if round_batch else "partial")
         if isinstance(data_shape, (tuple, list)):
             data_shape = int(data_shape[0])
         self.data_shape = int(data_shape)
@@ -121,7 +144,15 @@ class LibSVMIter(DataIter):
             raise StopIteration
         stop = min(self.cur + self.batch_size, self.num_rows)
         pad = self.batch_size - (stop - self.cur)
-        if pad and self.round_batch:
+        if pad and self.last_batch_handle == "discard":
+            # drop the trailing partial batch — visibly: the discarded
+            # row count is telemetry, not silence
+            from .. import telemetry
+            telemetry.counter("io.libsvm.discarded_rows").inc(
+                stop - self.cur)
+            self.cur = stop
+            raise StopIteration
+        if pad and self.last_batch_handle == "pad":
             # wrap around to fill the final batch (parity: round_batch)
             head = self._slice(self.cur, stop)
             tail = self._slice(0, pad)

@@ -436,6 +436,12 @@ class DistKVStore(KVStoreBase):
         telemetry.record_comm_bytes(int(payload), "sparse")
         self.last_sparse_comm = {"payload_bytes": int(payload),
                                  "dense_bytes": dense_bytes}
+        # embedding-path accounting: row-sparse gradient traffic IS the
+        # sharded-embedding push dataflow (rows + payload vs densify)
+        telemetry.counter("embedding.rows_pushed").inc(
+            sum(int(v.nnz) for v in values))
+        telemetry.counter("embedding.sparse_bytes").inc(int(payload))
+        telemetry.counter("embedding.dense_equiv_bytes").inc(dense_bytes)
         return [RowSparseNDArray(jnp.asarray(vals), jnp.asarray(idx),
                                  tuple(v.shape))
                 for v, (idx, vals) in zip(values, merged)]
@@ -559,6 +565,14 @@ class DistKVStore(KVStoreBase):
                 if isinstance(v, RowSparseNDArray):
                     # only (indices, values) travel — nnz wire cost
                     # (parity: sparse ZPush, kvstore_dist.h:559)
+                    telemetry.counter("embedding.rows_pushed").inc(
+                        int(v.nnz))
+                    telemetry.counter("embedding.sparse_bytes").inc(
+                        payload_nbytes(v))
+                    telemetry.counter(
+                        "embedding.dense_equiv_bytes").inc(
+                        int(onp.prod(v.shape))
+                        * onp.dtype(v.data.dtype).itemsize)
                     self._ps_client.push_sparse(
                         k, onp.asarray(v.indices),
                         onp.asarray(v.data), tuple(v.shape))
@@ -698,6 +712,12 @@ class DistKVStore(KVStoreBase):
                     f"{key!r} with {full.shape[0]} rows")
             vals = full._data[jnp.asarray(rows, jnp.int32)]
             rsp = RowSparseNDArray(vals, rows, tuple(full.shape))
+        telemetry.counter("embedding.rows_pulled").inc(len(rows))
+        telemetry.counter("embedding.sparse_bytes").inc(
+            payload_nbytes(rsp))
+        telemetry.counter("embedding.dense_equiv_bytes").inc(
+            int(onp.prod(rsp.shape))
+            * onp.dtype(rsp.data.dtype).itemsize)
         if out is not None:
             rsp.copyto(out)
             return out

@@ -14,6 +14,8 @@ from __future__ import annotations
 import pickle
 from typing import Any, Dict, List, Optional
 
+import numpy as onp
+
 from .. import telemetry
 from .. import tracing
 from ..base import MXNetError
@@ -97,6 +99,17 @@ class KVStore(KVStoreBase):
             else:
                 reduced = self._reduce(self._densify(v))
             telemetry.record_comm_bytes(payload_nbytes(reduced), "local")
+            if self._is_rsp(reduced):
+                # embedding-path accounting: row-sparse kvstore traffic
+                # is the sharded-embedding dataflow (rows moved + sparse
+                # vs dense-equivalent payload), unified with the PS wire
+                telemetry.counter("embedding.rows_pushed").inc(
+                    reduced.nnz)
+                telemetry.counter("embedding.sparse_bytes").inc(
+                    payload_nbytes(reduced))
+                telemetry.counter("embedding.dense_equiv_bytes").inc(
+                    int(onp.prod(reduced.shape))
+                    * onp.dtype(reduced.dtype).itemsize)
             if self._updater is not None:
                 if k not in self._data:
                     self._data[k] = reduced.copy()
@@ -174,6 +187,11 @@ class KVStore(KVStoreBase):
                     f"row_sparse_pull: row_ids out of range for key "
                     f"{k!r} with {dense.shape[0]} rows")
             rsp = RowSparseNDArray(dense[ridx], ridx, dense.shape)
+            telemetry.counter("embedding.rows_pulled").inc(len(ridx))
+            telemetry.counter("embedding.sparse_bytes").inc(
+                payload_nbytes(rsp))
+            telemetry.counter("embedding.dense_equiv_bytes").inc(
+                dense.nbytes)
             if o is not None:
                 # fill the caller's buffer in place (the reference
                 # contract: pre-allocated RowSparseNDArray outs)

@@ -198,6 +198,21 @@ def _decode_msg(payload: bytes):
     return tuple(out)
 
 
+def _unpack_2bit_np(packed: onp.ndarray, n: int, threshold: float,
+                    dtype) -> onp.ndarray:
+    """Numpy twin of gradient_compression._unpack_2bit: 2-bit codes
+    {0: zero, 1: +t, 2: -t}, 4 per byte — handler threads dequantize
+    without touching jax (no jit churn on the server hot path)."""
+    b = packed.reshape(-1, 1)
+    codes = onp.concatenate(
+        [(b >> 0) & 3, (b >> 2) & 3, (b >> 4) & 3, (b >> 6) & 3],
+        axis=1).reshape(-1)[:n]
+    t = onp.asarray(threshold, dtype)
+    return onp.where(codes == 1, t,
+                     onp.where(codes == 2, -t,
+                               onp.zeros((), dtype))).astype(dtype)
+
+
 def _hmac_key() -> Optional[bytes]:
     k = os.environ.get("MXNET_PS_HMAC_KEY")
     return k.encode("utf-8") if k else None
@@ -406,6 +421,22 @@ class ParamServer:
                                             onp.asarray(values),
                                             tuple(shape))
                 return ("ok",)
+            if op == "push_sparse_packed":
+                # gradient-compressed row_sparse push: the values block
+                # traveled as 2-bit codes (4/byte) — dequantize to
+                # {-t, 0, +t} server-side, then the normal sparse apply.
+                # Residual error feedback lives CLIENT-side (same
+                # protocol as the dense compressed path).
+                _, key, indices, packed, n, shape, dtype, thr = msg
+                values = _unpack_2bit_np(
+                    onp.asarray(packed, onp.uint8), int(n),
+                    float(onp.asarray(thr)), _dtype_from_name(dtype))
+                shape = tuple(shape)
+                values = values.reshape((-1,) + shape[1:])
+                with self._key_lock(key):
+                    self._apply_push_sparse(key, onp.asarray(indices),
+                                            values, shape)
+                return ("ok",)
             if op == "pull":
                 _, key = msg
                 with self._key_lock(key):
@@ -507,11 +538,16 @@ class ParamServer:
     def _apply_push_sparse(self, key, indices, values, shape):
         """Apply a row_sparse gradient: optimizer sparse dispatch (lazy
         row updates) when an optimizer is set; accumulation of the live
-        rows otherwise.  Caller holds the key's lock."""
+        rows otherwise.  Duplicate ids within one push are coalesced
+        (sort + segment-sum) FIRST — a batch that touches row r twice
+        must apply one summed gradient, not two order-dependent
+        optimizer updates (a momentum/adagrad state row is not
+        associative under repeated dispatch).  Caller holds the key's
+        lock."""
         from ..ndarray import NDArray
-        from ..ndarray.sparse import RowSparseNDArray
+        from ..ndarray.sparse import RowSparseNDArray, coalesce_rows
 
-        indices = onp.asarray(indices)
+        indices, values = coalesce_rows(indices, values)
         # validate against the STORED weight when it exists (a
         # mismatched client shape must not sneak rows past the check:
         # jax's scatter silently DROPS out-of-bounds updates)
@@ -633,6 +669,18 @@ class PSClient:
                     shape) -> None:
         self._call("push_sparse", str(key), onp.asarray(indices),
                    onp.asarray(values), tuple(shape))
+
+    def push_sparse_packed(self, key, indices: onp.ndarray,
+                           packed: onp.ndarray, n: int, shape,
+                           dtype: str, threshold: float) -> None:
+        """Gradient-compressed sparse push: ``packed`` holds ``n``
+        2-bit codes (4/byte) over the touched rows' values; the server
+        dequantizes to {-threshold, 0, +threshold} before the sparse
+        apply.  Residual error feedback is the CALLER's job (the
+        client-side ``GradientCompression`` keeps it)."""
+        self._call("push_sparse_packed", str(key), onp.asarray(indices),
+                   onp.asarray(packed, onp.uint8), int(n), tuple(shape),
+                   str(dtype), onp.asarray(threshold, onp.float64))
 
     def pull(self, key) -> onp.ndarray:
         return self._call("pull", str(key))

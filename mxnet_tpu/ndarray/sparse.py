@@ -32,7 +32,8 @@ from .ndarray import NDArray, array as _dense_array
 
 __all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
            "row_sparse_array", "csr_matrix", "zeros", "array",
-           "cast_storage", "retain", "dot", "add", "where_rows"]
+           "cast_storage", "retain", "dot", "add", "where_rows",
+           "coalesce_rows"]
 
 
 def _log_storage_fallback(what: str):
@@ -405,6 +406,36 @@ def adagrad_update(weight: NDArray, grad: RowSparseNDArray, history: NDArray,
 # ReduceRowSparse) and the row_sparse optimizer kernels
 # (src/operator/optimizer_op.cc:299,509,649,858 storage dispatch).
 # --------------------------------------------------------------------------
+
+def coalesce_rows(indices, values):
+    """Host-side duplicate-row coalescing: sort row ids and segment-sum
+    their values so each id appears ONCE, in ascending order.  This is
+    the deterministic pre-pass both ends of the sparse push wire use —
+    a batch with repeated ids must not depend on optimizer dispatch
+    order (a momentum/adagrad state row updated twice in one push is
+    order-sensitive; summed-once it is not).  Pure numpy: it runs on PS
+    handler threads and the client push path without touching jax.
+
+    Returns ``(unique_sorted_indices, summed_values)``."""
+    import numpy as _onp
+    idx = _onp.asarray(indices)
+    val = _onp.asarray(values)
+    if idx.ndim != 1 or val.shape[:1] != idx.shape:
+        raise MXNetError(
+            f"coalesce_rows: indices {idx.shape} / values {val.shape} "
+            "mismatch (want indices (nnz,), values (nnz, ...))")
+    if idx.size == 0:
+        return idx, val
+    uniq, inv = _onp.unique(idx, return_inverse=True)
+    if uniq.size == idx.size:
+        # duplicate-free: just establish sorted order (unique already
+        # gave us the sort; reindex values to match)
+        order = _onp.argsort(idx, kind="stable")
+        return idx[order], val[order]
+    out = _onp.zeros((uniq.size,) + val.shape[1:], dtype=val.dtype)
+    _onp.add.at(out, inv, val)
+    return uniq, out
+
 
 def merge(a: RowSparseNDArray, b: RowSparseNDArray) -> RowSparseNDArray:
     """Sum two row_sparse arrays at O(nnz log nnz) cost, never

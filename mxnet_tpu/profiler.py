@@ -123,6 +123,10 @@ def counters() -> Dict[str, Dict[str, int]]:
       autotune wall ms + measurement runs, XLA-fallback dispatches —
       mxnet_tpu/kernels/; ``tune_ms``/``tune_measurements`` staying 0
       is the warm-cache acceptance signal)
+    - ``embedding``: the sharded embedding-table subsystem (rows on the
+      sparse pull/push wire, sparse vs dense-equivalent payload bytes,
+      the serving lookup tier's LRU hit/miss/evict admission, hot-row
+      cache spills — mxnet_tpu/embedding/)
 
     Always live (unlike xplane tracing this needs no start()) — every
     number is read from the telemetry registry, the same objects the
@@ -210,7 +214,25 @@ def counters() -> Dict[str, Dict[str, int]]:
                 "tune_measurements":
                     telemetry.counter("kernel.tune_measurements").value,
                 "fallbacks":
-                    telemetry.counter("kernel.fallbacks").value}}
+                    telemetry.counter("kernel.fallbacks").value},
+            "embedding": {
+                "rows_pulled":
+                    telemetry.counter("embedding.rows_pulled").value,
+                "rows_pushed":
+                    telemetry.counter("embedding.rows_pushed").value,
+                "sparse_bytes":
+                    telemetry.counter("embedding.sparse_bytes").value,
+                "dense_equiv_bytes":
+                    telemetry.counter(
+                        "embedding.dense_equiv_bytes").value,
+                "cache_hits":
+                    telemetry.counter("embedding.cache_hits").value,
+                "cache_misses":
+                    telemetry.counter("embedding.cache_misses").value,
+                "cache_evictions":
+                    telemetry.counter("embedding.cache_evictions").value,
+                "rows_spilled":
+                    telemetry.counter("embedding.rows_spilled").value}}
 
 
 def set_config(**kwargs):

@@ -124,6 +124,7 @@ class InferenceEngine:
         self._compiled: Dict[Any, Any] = {}
         self._lock = threading.Lock()
         self._init_done = False
+        self._embedding = None
         if self._exported:
             self._adopt_exported_spec()
 
@@ -160,16 +161,45 @@ class InferenceEngine:
     def dtype(self):
         return self._dtype
 
+    def attach_embedding(self, lookup) -> None:
+        """Attach an embedding lookup tier (an
+        ``embedding.EmbeddingLookupCache`` or anything with
+        ``lookup(ids) -> (n, dim)`` and ``dim``): integer-dtype
+        requests are treated as row ids and translated to dense
+        embedding features AT ADMISSION, so inference batches consult
+        the LRU tier instead of the parameter server (repeated users
+        hit the cache; only cold rows travel on the sparse pull wire)
+        and the compiled shape buckets always see float batches."""
+        self._embedding = lookup
+
+    def _embed_request(self, arr: onp.ndarray) -> onp.ndarray:
+        """ids ``(...,)`` -> features ``(..., dim)`` through the
+        attached lookup tier; malformed ids surface as admission
+        rejects like any other bad request."""
+        try:
+            vecs = self._embedding.lookup(arr.reshape(-1))
+        except Exception as e:
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError(
+                f"embedding lookup rejected request ids: {e}") from None
+        return vecs.reshape(tuple(arr.shape) + (vecs.shape[-1],))
+
     def validate(self, x) -> onp.ndarray:
         """Admission gate: normalize one request to a host numpy example
         and check it against the engine spec.  Raises
         :class:`BadRequestError` (and ticks ``serving.rejected.shape``)
-        on any mismatch — malformed requests never reach a batch."""
+        on any mismatch — malformed requests never reach a batch.  With
+        an embedding lookup tier attached, integer requests are row ids
+        and are translated to dense features here, BEFORE the spec
+        check (the engine spec describes the embedded batch)."""
         try:
             arr = onp.asarray(x.asnumpy() if isinstance(x, NDArray) else x)
         except Exception as e:
             telemetry.counter("serving.rejected.shape").inc()
             raise BadRequestError(f"request is not array-like: {e}") from None
+        if self._embedding is not None and \
+                onp.issubdtype(arr.dtype, onp.integer):
+            arr = self._embed_request(arr)
         if self._dtype is None:
             if not (onp.issubdtype(arr.dtype, onp.floating)
                     or onp.issubdtype(arr.dtype, onp.integer)
@@ -546,8 +576,12 @@ class InferenceEngine:
                       for k, v in self._compiled.items() if v is not None)
 
     def stats(self) -> Dict[str, Any]:
-        return {
+        out = {
             "buckets": len(self.buckets()),
             "latched": self._budget.latched,
             "budget_declines": self._budget.declines,
         }
+        if self._embedding is not None and \
+                hasattr(self._embedding, "stats"):
+            out["embedding"] = self._embedding.stats()
+        return out

@@ -296,6 +296,22 @@ _C_KRN_MISSES = counter("kernel.cache_misses")
 _C_KRN_TUNE_MS = counter("kernel.tune_ms")
 _C_KRN_TUNE_RUNS = counter("kernel.tune_measurements")
 _C_KRN_FALLBACKS = counter("kernel.fallbacks")
+# sharded embedding-table subsystem health (mxnet_tpu/embedding/ writes
+# these): table rows that actually traveled on the sparse pull/push
+# wire, their payload bytes vs the dense-push equivalent (the full
+# table gradient a dense push would move — the ratio is the sparse
+# path's wire win), the serving lookup tier's LRU admission counters,
+# hot-row cache spills (device copies dropped back to the host/PS
+# authority), and LibSVM rows dropped by last_batch_handle='discard'
+_C_EMB_PULL_ROWS = counter("embedding.rows_pulled")
+_C_EMB_PUSH_ROWS = counter("embedding.rows_pushed")
+_C_EMB_SPARSE_BYTES = counter("embedding.sparse_bytes")
+_C_EMB_DENSE_BYTES = counter("embedding.dense_equiv_bytes")
+_C_EMB_CACHE_HITS = counter("embedding.cache_hits")
+_C_EMB_CACHE_MISSES = counter("embedding.cache_misses")
+_C_EMB_CACHE_EVICTS = counter("embedding.cache_evictions")
+_C_EMB_SPILLS = counter("embedding.rows_spilled")
+_C_LIBSVM_DISCARDS = counter("io.libsvm.discarded_rows")
 
 
 def record_opt_state_bytes(per_device: int) -> None:
@@ -314,6 +330,25 @@ def record_compile(seconds: float, kind: str) -> None:
     _C_COMPILE_MS.inc(ms)
     counter(f"compile.{kind}.count").inc()
     counter(f"compile.{kind}.ms").inc(ms)
+
+
+def record_embedding_wire(rows_pulled: int = 0, rows_pushed: int = 0,
+                          sparse_bytes: int = 0,
+                          dense_equiv_bytes: int = 0) -> None:
+    """Account one sharded-embedding wire exchange: how many table rows
+    traveled (pull and/or push direction) and the sparse payload bytes
+    actually moved vs the dense-push equivalent (the whole table
+    gradient, ``payload_nbytes`` of the dense shape).  Sparse bytes also
+    fold into the unified ``comm.sparse.bytes`` accounting."""
+    if rows_pulled:
+        _C_EMB_PULL_ROWS.inc(int(rows_pulled))
+    if rows_pushed:
+        _C_EMB_PUSH_ROWS.inc(int(rows_pushed))
+    if sparse_bytes:
+        _C_EMB_SPARSE_BYTES.inc(int(sparse_bytes))
+        record_comm_bytes(sparse_bytes, kind="sparse")
+    if dense_equiv_bytes:
+        _C_EMB_DENSE_BYTES.inc(int(dense_equiv_bytes))
 
 
 def record_comm_bytes(n: int, kind: str = "dense") -> None:
@@ -574,7 +609,9 @@ class _StepToken:
                  "ckpt_bytes", "ckpt_gc", "ckpt_vpass", "ckpt_vfail",
                  "rs_bytes", "ag_bytes", "ar_bytes", "barrier_ms",
                  "krn_hits", "krn_misses", "krn_tune_ms", "krn_tune_runs",
-                 "krn_fallbacks", "buckets")
+                 "krn_fallbacks", "emb_pull", "emb_push", "emb_sbytes",
+                 "emb_dbytes", "emb_hits", "emb_misses", "emb_evicts",
+                 "emb_spills", "buckets")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -602,6 +639,14 @@ class _StepToken:
         self.krn_tune_ms = _C_KRN_TUNE_MS.value
         self.krn_tune_runs = _C_KRN_TUNE_RUNS.value
         self.krn_fallbacks = _C_KRN_FALLBACKS.value
+        self.emb_pull = _C_EMB_PULL_ROWS.value
+        self.emb_push = _C_EMB_PUSH_ROWS.value
+        self.emb_sbytes = _C_EMB_SPARSE_BYTES.value
+        self.emb_dbytes = _C_EMB_DENSE_BYTES.value
+        self.emb_hits = _C_EMB_CACHE_HITS.value
+        self.emb_misses = _C_EMB_CACHE_MISSES.value
+        self.emb_evicts = _C_EMB_CACHE_EVICTS.value
+        self.emb_spills = _C_EMB_SPILLS.value
         from . import tracing
         self.buckets = tracing.bucket_totals_ms()
 
@@ -763,6 +808,22 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "tune_measurements": (_C_KRN_TUNE_RUNS.value
                                   - token.krn_tune_runs),
             "fallbacks": _C_KRN_FALLBACKS.value - token.krn_fallbacks,
+        },
+        # sharded embedding-table activity in this step's window: rows
+        # on the sparse wire, sparse vs dense-equivalent payload bytes
+        # (their ratio is the sparse-path wire win the subsystem
+        # exists for), and the serving lookup tier's cache admission
+        "embedding": {
+            "rows_pulled": _C_EMB_PULL_ROWS.value - token.emb_pull,
+            "rows_pushed": _C_EMB_PUSH_ROWS.value - token.emb_push,
+            "sparse_bytes": _C_EMB_SPARSE_BYTES.value - token.emb_sbytes,
+            "dense_equiv_bytes": (_C_EMB_DENSE_BYTES.value
+                                  - token.emb_dbytes),
+            "cache_hits": _C_EMB_CACHE_HITS.value - token.emb_hits,
+            "cache_misses": _C_EMB_CACHE_MISSES.value - token.emb_misses,
+            "cache_evictions": (_C_EMB_CACHE_EVICTS.value
+                                - token.emb_evicts),
+            "rows_spilled": _C_EMB_SPILLS.value - token.emb_spills,
         },
     }
     # critical-path decomposition: where this step's wall time went,

@@ -1,0 +1,486 @@
+"""Sharded embedding-table subsystem (mxnet_tpu/embedding/).
+
+Covers the recommender-path contract end to end: partition routing,
+shard-count-invariant init, the sparse pull -> dense compute -> sparse
+push round trip against a dense reference (bitwise, 1- and 2-shard),
+server-side duplicate-index coalescing (the non-associative-optimizer
+regression), checkpoint portability across shard counts, the 2-bit
+compressed push with per-row error feedback, the worker hot-row cache
+and serving lookup tier, the engine admission hook, LibSVM
+last_batch_handle semantics, and the telemetry embedding section.
+"""
+import importlib.util
+import pathlib
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.embedding import (EmbeddingLookupCache, ShardedEmbedding,
+                                 num_shards_env)
+from mxnet_tpu.embedding.cache import cache_rows_env
+from mxnet_tpu.embedding.sharded import _default_init, _Partition
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray, coalesce_rows
+
+
+def _delta(name):
+    """Counter-value closure: call once for a baseline, again for the
+    delta since (global counters; tests must measure deltas)."""
+    base = telemetry.counter(name).value
+    return lambda: telemetry.counter(name).value - base
+
+
+# -- partitioning -----------------------------------------------------------
+
+@pytest.mark.parametrize("kind", ["mod", "range"])
+@pytest.mark.parametrize("num_shards", [1, 2, 3])
+def test_partition_roundtrip(kind, num_shards):
+    part = _Partition(kind, 11, num_shards)
+    rows = onp.arange(11, dtype=onp.int64)
+    shards = part.shard_of(rows)
+    locals_ = part.local_of(rows)
+    assert ((0 <= shards) & (shards < num_shards)).all()
+    # shard_of/local_of and global_of are inverses
+    for s in range(num_shards):
+        mask = shards == s
+        back = part.global_of(s, locals_[mask])
+        onp.testing.assert_array_equal(back, rows[mask])
+        assert int(mask.sum()) == part.local_count(s)
+    assert sum(part.local_count(s) for s in range(num_shards)) == 11
+
+
+def test_partition_validates():
+    with pytest.raises(MXNetError):
+        _Partition("hash", 8, 2)
+    with pytest.raises(MXNetError):
+        _Partition("mod", 0, 2)
+    with pytest.raises(MXNetError):
+        ShardedEmbedding("bad", 8, 4, num_shards=1, partition="hash")
+
+
+def test_default_init_is_shard_count_invariant():
+    # the per-row hash init depends only on (row, col, seed), so any
+    # subset gather equals the corresponding rows of the full table
+    full = _default_init(onp.arange(16), 4, seed=3, dtype=onp.float32)
+    sub = _default_init(onp.array([5, 2, 11]), 4, seed=3,
+                        dtype=onp.float32)
+    onp.testing.assert_array_equal(sub, full[[5, 2, 11]])
+    with ShardedEmbedding("inv", 10, 4, num_shards=1, seed=7) as e1, \
+            ShardedEmbedding("inv", 10, 4, num_shards=2, seed=7) as e2:
+        onp.testing.assert_array_equal(e1.dump(), e2.dump())
+
+
+# -- coalescing -------------------------------------------------------------
+
+def test_coalesce_rows_sums_duplicates():
+    idx = onp.array([3, 1, 3, 1, 2], onp.int64)
+    vals = onp.array([[1.], [2.], [4.], [8.], [16.]], onp.float32)
+    u, s = coalesce_rows(idx, vals)
+    onp.testing.assert_array_equal(u, [1, 2, 3])
+    onp.testing.assert_array_equal(s, [[10.], [16.], [5.]])
+
+
+def test_coalesce_rows_no_duplicates_identity():
+    idx = onp.array([4, 0, 2], onp.int64)
+    vals = onp.arange(6, dtype=onp.float32).reshape(3, 2)
+    u, s = coalesce_rows(idx, vals)
+    onp.testing.assert_array_equal(u, [0, 2, 4])
+    onp.testing.assert_array_equal(s, vals[[1, 2, 0]])
+
+
+def _ps_pair():
+    from mxnet_tpu.kvstore.ps_server import ParamServer, PSClient
+    srv = ParamServer("127.0.0.1", 0)
+    cli = PSClient(srv.address)
+    cli.hello(0)
+    return srv, cli
+
+
+def test_server_coalesces_repeated_ids_under_momentum():
+    """_apply_push_sparse must see each row ONCE: momentum/adagrad row
+    updates are not associative under repeated per-duplicate dispatch,
+    so a push with repeated ids must match a pre-coalesced push."""
+    init = onp.ones((6, 2), onp.float32)
+    srv_a, cli_a = _ps_pair()
+    srv_b, cli_b = _ps_pair()
+    try:
+        for cli in (cli_a, cli_b):
+            cli.init("w", init)
+            cli.set_optimizer(
+                mx.optimizer.SGD(learning_rate=0.5, momentum=0.875))
+        dup_idx = onp.array([1, 1, 3], onp.int64)
+        dup_val = onp.array([[1., 1.], [3., 3.], [2., 2.]], onp.float32)
+        cli_a.push_sparse("w", dup_idx, dup_val, (6, 2))
+        co_idx, co_val = coalesce_rows(dup_idx, dup_val)
+        cli_b.push_sparse("w", co_idx, co_val, (6, 2))
+        onp.testing.assert_array_equal(onp.asarray(cli_a.pull("w")),
+                                       onp.asarray(cli_b.pull("w")))
+        # two momentum steps from identical starts stay identical
+        cli_a.push_sparse("w", dup_idx, dup_val, (6, 2))
+        cli_b.push_sparse("w", co_idx, co_val, (6, 2))
+        onp.testing.assert_array_equal(onp.asarray(cli_a.pull("w")),
+                                       onp.asarray(cli_b.pull("w")))
+    finally:
+        srv_a.stop()
+        srv_b.stop()
+
+
+# -- pull -> compute -> push round trip vs dense reference ------------------
+
+@pytest.mark.parametrize("num_shards", [1, 2])
+@pytest.mark.parametrize("partition", ["mod", "range"])
+def test_roundtrip_matches_dense_reference(num_shards, partition):
+    """Accumulate-mode (no optimizer) push: the sharded table must end
+    bitwise equal to a dense numpy scatter-add, at 1 AND 2 shards."""
+    with ShardedEmbedding("rt", 9, 3, num_shards=num_shards,
+                          partition=partition, seed=1) as emb:
+        ref = emb.dump().copy()
+        ids = onp.array([0, 4, 4, 8, 2], onp.int64)
+        grads = onp.array([[1.0] * 3, [0.5] * 3, [0.25] * 3,
+                           [2.0] * 3, [4.0] * 3], onp.float32)
+        u, s = coalesce_rows(ids, grads)
+        ref[u] += s
+        emb.push_grad(ids, grads)
+        onp.testing.assert_array_equal(emb.dump(), ref)
+        # pull with duplicates gathers the updated rows positionally
+        got = emb.pull_rows(onp.array([4, 0, 4], onp.int64))
+        onp.testing.assert_array_equal(got, ref[[4, 0, 4]])
+
+
+@pytest.mark.parametrize("num_shards", [1, 2])
+def test_sgd_roundtrip_with_duplicate_ids(num_shards):
+    with ShardedEmbedding("sgd", 8, 2, num_shards=num_shards,
+                          seed=2) as emb:
+        emb.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+        ref = emb.dump().copy()
+        ids = onp.array([2, 7, 2], onp.int64)
+        grads = onp.ones((3, 2), onp.float32)
+        emb.push_grad(ids, grads)
+        ref[2] -= 0.5 * 2.0     # coalesced duplicate: summed then one step
+        ref[7] -= 0.5 * 1.0
+        onp.testing.assert_array_equal(emb.dump(), ref)
+
+
+def test_push_pull_validate_range():
+    with ShardedEmbedding("rng", 4, 2, num_shards=1) as emb:
+        with pytest.raises(MXNetError):
+            emb.pull_rows([4])
+        with pytest.raises(MXNetError):
+            emb.push_grad([-1], onp.zeros((1, 2), onp.float32))
+
+
+# -- wire accounting --------------------------------------------------------
+
+def test_wire_accounting_sparse_vs_dense_equiv():
+    pulled = _delta("embedding.rows_pulled")
+    pushed = _delta("embedding.rows_pushed")
+    sparse = _delta("embedding.sparse_bytes")
+    dense = _delta("embedding.dense_equiv_bytes")
+    with ShardedEmbedding("wire", 1000, 16, num_shards=2) as emb:
+        ids = onp.array([3, 977, 3, 41], onp.int64)
+        emb.pull_rows(ids)                       # 3 distinct rows travel
+        emb.push_grad(ids, onp.ones((4, 16), onp.float32))
+        assert pulled() == 3
+        assert pushed() == 3
+        # a 3-row exchange against a 1000-row table: the sparse wire is
+        # far under the bench's 0.2x dense-equivalent gate
+        assert 0 < sparse() < 0.2 * dense()
+        assert dense() == 2 * emb.table_nbytes   # one pull + one push
+
+
+def test_local_kvstore_row_sparse_paths_tick_embedding_counters():
+    from mxnet_tpu.kvstore.kvstore import KVStore
+    pulled = _delta("embedding.rows_pulled")
+    pushed = _delta("embedding.rows_pushed")
+    kv = KVStore()
+    kv.init("w", nd.array(onp.arange(12, dtype=onp.float32).reshape(6, 2)))
+    rsp = kv.row_sparse_pull("w", row_ids=onp.array([1, 4, 1]))
+    onp.testing.assert_array_equal(onp.asarray(rsp.indices), [1, 4])
+    assert pulled() == 2
+    kv.push("w", RowSparseNDArray(onp.ones((2, 2), onp.float32),
+                                  onp.array([0, 5]), (6, 2)))
+    assert pushed() == 2
+
+
+# -- compressed sparse push -------------------------------------------------
+
+def test_compressed_push_quantizes_with_error_feedback():
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    with ShardedEmbedding("cmp", 8, 4, num_shards=2,
+                          compression=GradientCompression(
+                              threshold=0.5)) as emb:
+        ref = emb.dump().copy()
+        ids = onp.array([1, 6], onp.int64)
+        grads = onp.full((2, 4), 0.7, onp.float32)
+        emb.push_grad(ids, grads)        # q=+0.5, residual 0.2
+        step1 = ref[ids] + onp.float32(0.5)
+        onp.testing.assert_array_equal(emb.dump()[ids], step1)
+        emb.push_grad(ids, grads)        # acc 0.9 -> q=+0.5, residual 0.4
+        # accumulate in the server's order: two fp32 +0.5 steps, not +1.0
+        onp.testing.assert_array_equal(emb.dump()[ids],
+                                       step1 + onp.float32(0.5))
+        # untouched rows never moved
+        others = [r for r in range(8) if r not in (1, 6)]
+        onp.testing.assert_array_equal(emb.dump()[others], ref[others])
+
+
+def test_compressed_push_wire_is_smaller_than_raw():
+    from mxnet_tpu.kvstore.gradient_compression import GradientCompression
+    sparse = _delta("embedding.sparse_bytes")
+    with ShardedEmbedding("cmpw", 64, 64, num_shards=1,
+                          compression=GradientCompression(
+                              threshold=0.5)) as emb:
+        ids = onp.arange(8, dtype=onp.int64)
+        emb.push_grad(ids, onp.ones((8, 64), onp.float32))
+        compressed = sparse()
+    raw = 8 * 64 * 4 + 8 * 8            # fp32 values + int64 indices
+    assert 0 < compressed < raw / 4     # 2-bit codes: ~16x on values
+
+
+# -- hot-row cache (trainer side) -------------------------------------------
+
+def test_hot_row_cache_hits_spills_and_invalidates():
+    hits = _delta("embedding.cache_hits")
+    misses = _delta("embedding.cache_misses")
+    spilled = _delta("embedding.rows_spilled")
+    evicted = _delta("embedding.cache_evictions")
+    with ShardedEmbedding("hot", 16, 2, num_shards=2, hot_rows=2) as emb:
+        first = emb.pull_rows([0, 1])
+        assert misses() == 2 and hits() == 0
+        onp.testing.assert_array_equal(emb.pull_rows([0, 1]), first)
+        assert hits() == 2              # served locally, no wire
+        emb.pull_rows([2])              # over capacity: LRU spills
+        assert spilled() == 1 and evicted() == 1
+        assert emb.hot_stats() == {"capacity": 2, "resident": 2}
+        # a push makes local copies stale -> next pull misses again
+        h0 = hits()
+        emb.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+        emb.push_grad([2], onp.ones((1, 2), onp.float32))
+        after = emb.pull_rows([2])
+        assert hits() == h0
+        onp.testing.assert_array_equal(after, emb.dump()[[2]])
+
+
+# -- serving lookup tier ----------------------------------------------------
+
+def test_lookup_cache_dedups_hits_and_evicts():
+    with ShardedEmbedding("srv", 32, 3, num_shards=2) as emb:
+        cache = EmbeddingLookupCache(emb, capacity=2)
+        out = cache.lookup(onp.array([5, 5, 9]))
+        onp.testing.assert_array_equal(out, emb.dump()[[5, 5, 9]])
+        st = cache.stats()
+        assert (st["hits"], st["misses"]) == (0, 2)   # batch deduped
+        cache.lookup(onp.array([5]))
+        assert cache.stats()["hits"] == 1
+        cache.lookup(onp.array([11]))                 # evicts LRU (9)
+        st = cache.stats()
+        assert st["evictions"] == 1 and st["resident"] == 2
+        assert st["hit_rate"] == pytest.approx(1 / 4)
+        cache.invalidate([5])
+        cache.lookup(onp.array([5]))
+        assert cache.stats()["misses"] == 5 - 1       # 4 misses total
+
+
+def test_lookup_cache_empty_and_all_hot():
+    with ShardedEmbedding("srv2", 8, 2, num_shards=1) as emb:
+        cache = EmbeddingLookupCache(emb, capacity=4)
+        assert cache.lookup(onp.array([], onp.int64)).shape == (0, 2)
+        cache.lookup(onp.array([3]))
+        out = cache.lookup(onp.array([3, 3]))         # zero-miss path
+        onp.testing.assert_array_equal(out, emb.dump()[[3, 3]])
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXNET_EMB_SHARDS", "3")
+    monkeypatch.setenv("MXNET_EMB_CACHE_ROWS", "17")
+    assert num_shards_env() == 3
+    assert cache_rows_env() == 17
+    monkeypatch.setenv("MXNET_EMB_SHARDS", "bogus")
+    monkeypatch.setenv("MXNET_EMB_CACHE_ROWS", "0")
+    assert num_shards_env(2) == 2       # unparsable -> default
+    assert cache_rows_env() == 1        # clamped to >= 1
+    monkeypatch.delenv("MXNET_EMB_SHARDS")
+    monkeypatch.delenv("MXNET_EMB_CACHE_ROWS")
+    with ShardedEmbedding("env", 6, 2) as emb:
+        assert emb.num_shards == 1      # default
+
+
+# -- checkpointing ----------------------------------------------------------
+
+def test_checkpoint_restores_across_shard_counts(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    with ShardedEmbedding("tbl", 10, 3, num_shards=2, seed=5) as src:
+        src.set_optimizer(mx.optimizer.SGD(learning_rate=0.25))
+        src.push_grad(onp.array([0, 3, 9]),
+                      onp.ones((3, 3), onp.float32))
+        src.save_checkpoint(ckdir, block=True)
+        want = src.dump()
+    # 2-shard save -> 1-shard restore (and back up to 3)
+    for shards in (1, 3):
+        with ShardedEmbedding("tbl", 10, 3, num_shards=shards,
+                              seed=99) as dst:
+            dst.load_checkpoint(ckdir)
+            onp.testing.assert_array_equal(dst.dump(), want)
+
+
+def test_checkpoint_shard_artifacts_and_header(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    with ShardedEmbedding("tbl", 6, 2, num_shards=2) as emb:
+        emb.save_checkpoint(ckdir, block=True)
+    from mxnet_tpu import checkpoint
+    leaves, header = checkpoint.load(ckdir)
+    assert set(leaves) == {"tbl/shard-00000-of-00002",
+                           "tbl/shard-00001-of-00002"}
+    assert header["embedding"] == {
+        "name": "tbl", "dim": 2, "dtype": "float32",
+        "kind": "mod", "num_rows": 6, "num_shards": 2}
+
+
+def test_checkpoint_restore_rejects_mismatch(tmp_path):
+    ckdir = str(tmp_path / "ck")
+    with ShardedEmbedding("tbl", 6, 2, num_shards=1) as emb:
+        emb.save_checkpoint(ckdir, block=True)
+    with ShardedEmbedding("other", 6, 2, num_shards=1) as dst:
+        with pytest.raises(MXNetError):
+            dst.load_checkpoint(ckdir)
+    with ShardedEmbedding("tbl", 8, 2, num_shards=1) as dst:
+        with pytest.raises(MXNetError):
+            dst.load_checkpoint(ckdir)
+    with ShardedEmbedding("tbl", 6, 2, num_shards=1) as dst:
+        with pytest.raises(MXNetError):
+            dst.load_checkpoint(str(tmp_path / "nowhere"))
+
+
+# -- serving-engine admission hook ------------------------------------------
+
+def test_engine_translates_integer_requests_through_lookup_tier():
+    from mxnet_tpu.serving import InferenceEngine
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    with ShardedEmbedding("eng", 12, 4, num_shards=2, seed=4) as emb:
+        cache = EmbeddingLookupCache(emb, capacity=8)
+        eng = InferenceEngine(net, example_shape=(4,), dtype="float32")
+        eng.attach_embedding(cache)
+        table = emb.dump()
+        got = eng.infer(onp.array(7, onp.int64))
+        want = net(nd.array(table[7][None])).asnumpy()[0]
+        onp.testing.assert_allclose(got, want, rtol=1e-6)
+        eng.infer(onp.array(7, onp.int64))      # repeated user: cache hit
+        st = eng.stats()["embedding"]
+        assert st["hits"] >= 1 and st["misses"] >= 1
+        # float requests bypass the embedding translation untouched
+        direct = eng.infer(table[7])
+        onp.testing.assert_allclose(direct, want, rtol=1e-6)
+
+
+def test_engine_rejects_out_of_range_ids():
+    from mxnet_tpu.serving import InferenceEngine
+    from mxnet_tpu.serving.engine import BadRequestError
+    net = gluon.nn.Dense(2, in_units=4)
+    net.initialize()
+    with ShardedEmbedding("engr", 4, 4, num_shards=1) as emb:
+        eng = InferenceEngine(net, example_shape=(4,), dtype="float32")
+        eng.attach_embedding(EmbeddingLookupCache(emb, capacity=4))
+        with pytest.raises(BadRequestError):
+            eng.validate(onp.array(99, onp.int64))
+
+
+# -- LibSVM last_batch_handle -----------------------------------------------
+
+def _write_libsvm(path, rows):
+    with open(path, "w") as f:
+        for r in range(rows):
+            f.write(f"{float(r)} 0:{r + 1}.0 2:1.0\n")
+    return str(path)
+
+
+def test_libsvm_pad_is_default_and_wraps(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+    it = LibSVMIter(_write_libsvm(tmp_path / "a.svm", 5),
+                    data_shape=4, batch_size=2)
+    assert it.last_batch_handle == "pad"
+    batches = list(it)
+    assert len(batches) == 3
+    assert [b.pad for b in batches] == [0, 0, 1]
+    last = batches[-1].data[0].todense().asnumpy()
+    assert last[1, 0] == 1.0            # wrapped back to row 0
+
+
+def test_libsvm_discard_drops_and_counts(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+    discards = _delta("io.libsvm.discarded_rows")
+    it = LibSVMIter(_write_libsvm(tmp_path / "b.svm", 5),
+                    data_shape=4, batch_size=2,
+                    last_batch_handle="discard")
+    assert len(list(it)) == 2           # 5 rows -> 2 full batches
+    assert discards() == 1
+    it.reset()
+    list(it)
+    assert discards() == 2              # counted once per epoch
+
+
+def test_libsvm_legacy_partial_and_validation(tmp_path):
+    from mxnet_tpu.io import LibSVMIter
+    path = _write_libsvm(tmp_path / "c.svm", 5)
+    it = LibSVMIter(path, data_shape=4, batch_size=2, round_batch=False)
+    assert it.last_batch_handle == "partial"
+    batches = list(it)
+    assert batches[-1].data[0].shape == (1, 4)   # short final batch
+    with pytest.raises(MXNetError):
+        LibSVMIter(path, data_shape=4, batch_size=2,
+                   last_batch_handle="drop")
+
+
+# -- telemetry step record --------------------------------------------------
+
+def test_step_record_carries_embedding_section(tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", str(tmp_path / "t.jsonl"))
+    telemetry.clear_sinks()
+    try:
+        with ShardedEmbedding("rec", 8, 2, num_shards=1) as emb:
+            tok = telemetry.begin_step()
+            assert tok is not None
+            emb.pull_rows([1, 5])
+            emb.push_grad([5], onp.ones((1, 2), onp.float32))
+            telemetry.end_step(tok, "emb_test")
+        rec = telemetry.last_record()
+        e = rec["embedding"]
+        assert e["rows_pulled"] == 2 and e["rows_pushed"] == 1
+        assert 0 < e["sparse_bytes"] < e["dense_equiv_bytes"]
+        assert set(e) == {"rows_pulled", "rows_pushed", "sparse_bytes",
+                          "dense_equiv_bytes", "cache_hits",
+                          "cache_misses", "cache_evictions",
+                          "rows_spilled"}
+    finally:
+        monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+        telemetry.clear_sinks()
+        telemetry.enabled()
+
+
+def test_telemetry_report_renders_embedding_section(tmp_path, monkeypatch,
+                                                    capsys):
+    path = str(tmp_path / "run.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    telemetry.clear_sinks()
+    try:
+        with ShardedEmbedding("rep", 100, 8, num_shards=1) as emb:
+            for _ in range(2):
+                tok = telemetry.begin_step()
+                emb.pull_rows([0, 3])
+                telemetry.end_step(tok, "emb_test")
+    finally:
+        monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+        telemetry.clear_sinks()
+        telemetry.enabled()
+    tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", tools / "telemetry_report.py")
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    s = report.summarize(report.load(path))
+    assert s["embedding"]["rows_pulled"] == 4
+    assert s["embedding"]["wire_ratio"] < 0.2
+    report.main([path])
+    assert "Embedding (sharded tables)" in capsys.readouterr().out

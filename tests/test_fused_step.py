@@ -228,7 +228,7 @@ def test_profiler_counters_snapshot():
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
                       "serving", "input", "tracing", "checkpoint",
-                      "cluster", "kernel"}
+                      "cluster", "kernel", "embedding"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
                                     "steps", "zero_steps"}
@@ -256,6 +256,10 @@ def test_profiler_counters_snapshot():
         "comm_skew", "unknown"}
     assert set(c["kernel"]) == {"cache_hits", "cache_misses", "tune_ms",
                                 "tune_measurements", "fallbacks"}
+    assert set(c["embedding"]) == {"rows_pulled", "rows_pushed",
+                                   "sparse_bytes", "dense_equiv_bytes",
+                                   "cache_hits", "cache_misses",
+                                   "cache_evictions", "rows_spilled"}
     assert c["cluster"]["straggler_rank"] == -1   # no aggregator running
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100

@@ -167,10 +167,32 @@ def analyze(by_rank, window, factor, summaries=None, incidents=None):
             "host_ms_total": round(sum(s.get("host_ms_total", 0.0)
                                        for s in recs), 3)}
         for r, recs in (summaries or {}).items()}
+    # sharded-embedding rollup: per-rank sums over each record's
+    # ``embedding`` delta section (rows moved, sparse vs dense-
+    # equivalent wire bytes, lookup-cache traffic).  Omitted (None)
+    # when no rank carried embedding signal.
+    emb_keys = ("rows_pulled", "rows_pushed", "sparse_bytes",
+                "dense_equiv_bytes", "cache_hits", "cache_misses",
+                "cache_evictions", "rows_spilled")
+    embedding = {}
+    for r, recs in by_rank.items():
+        ems = [rec["embedding"] for rec in recs
+               if isinstance(rec.get("embedding"), dict)]
+        if not any(any(e.values()) for e in ems):
+            continue
+        row = {k: sum(e.get(k, 0) for e in ems) for k in emb_keys}
+        row["wire_ratio"] = (row["sparse_bytes"]
+                             / row["dense_equiv_bytes"]) \
+            if row["dense_equiv_bytes"] else None
+        lookups = row["cache_hits"] + row["cache_misses"]
+        row["cache_hit_rate"] = (row["cache_hits"] / lookups) \
+            if lookups else None
+        embedding[r] = row
     return {"ranks": stats, "records": {r: len(v) for r, v in
                                         by_rank.items()},
             "joined_steps": complete, "window": window, "factor": factor,
             "skew": skew, "compacted": compacted,
+            "embedding": embedding or None,
             "incidents": incidents or [],
             "straggler": clustermon.detect_straggler(stats, factor)}
 
@@ -217,6 +239,22 @@ def render(a):
                          f"{c['rank_step_first']:>8}"
                          f"{c['rank_step_last']:>8}"
                          f"{c['host_ms_total']:>15.2f}")
+    if a.get("embedding"):
+        lines += ["", "Embedding (sharded tables, per-rank totals)",
+                  "-" * 72,
+                  f"  {'rank':<5}{'pulled':>9}{'pushed':>9}"
+                  f"{'sparse B':>12}{'dense-eq B':>12}{'ratio':>8}"
+                  f"{'hit %':>8}{'spill':>7}"]
+        for r in sorted(a["embedding"]):
+            e = a["embedding"][r]
+            ratio = (f"{e['wire_ratio']:.3f}"
+                     if e["wire_ratio"] is not None else "n/a")
+            hit = (f"{100.0 * e['cache_hit_rate']:.1f}"
+                   if e["cache_hit_rate"] is not None else "n/a")
+            lines.append(
+                f"  {r:<5}{e['rows_pulled']:>9}{e['rows_pushed']:>9}"
+                f"{e['sparse_bytes']:>12}{e['dense_equiv_bytes']:>12}"
+                f"{ratio:>8}{hit:>8}{e['rows_spilled']:>7}")
     st = a["straggler"]
     lines += ["", "Straggler verdict", "-" * 72]
     if st is None:

@@ -19,7 +19,11 @@ residency and the reduce-scatter / all-gather vs allreduce byte split.
 Runs with custom-kernel signal (``kernel`` delta payloads from
 mxnet_tpu/kernels/) get a "Kernels" section: autotune-cache hit/miss
 traffic, tune wall time, steps stalled by a first-encounter tune, and
-XLA-fallback dispatches — a warm cache keeps stalls at 0.
+XLA-fallback dispatches — a warm cache keeps stalls at 0.  Runs with
+sharded-embedding signal (``embedding`` delta payloads from
+mxnet_tpu/embedding/) get an "Embedding" section: rows pulled/pushed
+per step, sparse wire bytes vs their dense-push equivalent, and lookup
+cache hit rate.
 
 Usage:
     python tools/telemetry_report.py run.jsonl
@@ -235,6 +239,39 @@ def summarize(records):
             "tune_stall_steps": sum(1 for c in kn
                                     if c.get("tune_ms", 0.0) > 0),
         }
+    # sharded-embedding deltas (mxnet_tpu/embedding/): rows moved on the
+    # sparse wire per step, sparse payload vs its dense-push equivalent
+    # (the wire-compression win), and lookup-cache health.  Section only
+    # renders for runs whose records carry embedding signal.
+    em = [r["embedding"] for r in records
+          if isinstance(r.get("embedding"), dict)]
+    embedding = None
+    if any(any(c.values()) for c in em):
+        n = len(records) or 1
+        pulled = sum(c.get("rows_pulled", 0) for c in em)
+        pushed = sum(c.get("rows_pushed", 0) for c in em)
+        sbytes = sum(c.get("sparse_bytes", 0) for c in em)
+        dbytes = sum(c.get("dense_equiv_bytes", 0) for c in em)
+        hits = sum(c.get("cache_hits", 0) for c in em)
+        misses = sum(c.get("cache_misses", 0) for c in em)
+        embedding = {
+            "rows_pulled": pulled,
+            "rows_pushed": pushed,
+            "rows_pulled_per_step": pulled / n,
+            "rows_pushed_per_step": pushed / n,
+            "sparse_bytes": sbytes,
+            "dense_equiv_bytes": dbytes,
+            # <1.0 is the point of the sparse path; the embedding bench
+            # gates on <=0.2 for a realistically skewed id stream
+            "wire_ratio": (sbytes / dbytes) if dbytes else None,
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "cache_hit_rate": (hits / (hits + misses))
+            if (hits + misses) else None,
+            "cache_evictions": sum(c.get("cache_evictions", 0)
+                                   for c in em),
+            "rows_spilled": sum(c.get("rows_spilled", 0) for c in em),
+        }
     srv = [r["serving"] for r in records
            if isinstance(r.get("serving"), dict) and "error" not in
            r["serving"]]
@@ -277,6 +314,7 @@ def summarize(records):
         "checkpoint": ckpt,
         "sharding": sharding,
         "kernel": kernel,
+        "embedding": embedding,
     }
 
 
@@ -472,6 +510,32 @@ def render(s):
             f"{'tune measurements':<28}{kn['tune_measurements']:>24}",
             f"{'steps stalled by tune':<28}{kn['tune_stall_steps']:>24}",
             f"{'XLA fallbacks':<28}{kn['fallbacks']:>24}",
+        ]
+    em = s.get("embedding")
+    if em:
+        ratio = (f"{em['wire_ratio']:.4f}"
+                 if em["wire_ratio"] is not None else "n/a")
+        hit_rate = (f"{100.0 * em['cache_hit_rate']:.1f}"
+                    if em["cache_hit_rate"] is not None else "n/a")
+        lines += [
+            "",
+            "Embedding (sharded tables)",
+            "-" * 52,
+            f"{'rows pulled':<28}{em['rows_pulled']:>24}",
+            f"{'rows pushed':<28}{em['rows_pushed']:>24}",
+            f"{'rows pulled / step':<28}"
+            f"{em['rows_pulled_per_step']:>24.1f}",
+            f"{'rows pushed / step':<28}"
+            f"{em['rows_pushed_per_step']:>24.1f}",
+            f"{'sparse wire bytes':<28}{em['sparse_bytes']:>24}",
+            f"{'dense-equivalent bytes':<28}"
+            f"{em['dense_equiv_bytes']:>24}",
+            f"{'sparse/dense wire ratio':<28}{ratio:>24}",
+            f"{'cache hits':<28}{em['cache_hits']:>24}",
+            f"{'cache misses':<28}{em['cache_misses']:>24}",
+            f"{'cache hit rate %':<28}{hit_rate:>24}",
+            f"{'cache evictions':<28}{em['cache_evictions']:>24}",
+            f"{'rows spilled to host':<28}{em['rows_spilled']:>24}",
         ]
     srv = s.get("serving")
     if srv:
