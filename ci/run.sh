@@ -676,6 +676,74 @@ PY
     rm -rf "$tmp"
 }
 
+amp_smoke() {         # bf16/fp8 AMP: tests + dispatch-count run + bench gates
+    # tier-1 covers the policy unit surface, the 1-dispatch captured
+    # funnel, the in-graph overflow skip, checkpoint portability across
+    # AMP on/off and bf16/fp8, loss-scale resume, and the kernel-key
+    # regression
+    JAX_PLATFORMS=cpu python -m pytest tests/test_amp.py -q
+    # a 20-step bf16 gluon run must hold 1 dispatch per steady-state
+    # step, and an injected-inf batch must take the traced skip path —
+    # scale halved, weights untouched, compiles unchanged (no recompile)
+    JAX_PLATFORMS=cpu MXNET_AMP=1 python - <<'PY'
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, telemetry
+from mxnet_tpu.amp.loss_scaler import LossScaler
+from mxnet_tpu.gluon import Trainer, nn
+from mxnet_tpu.imperative import cached_step
+
+_D = telemetry.counter("dispatch.count")
+mx.random.seed(0)
+net = nn.Sequential()
+net.add(nn.Dense(32, in_units=32, activation="relu"),
+        nn.Dense(1, in_units=32))
+net.initialize()
+tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.05},
+             kvstore=None)
+tr._amp_loss_scaler = LossScaler(init_scale=256.0, scale_window=1000)
+x = onp.random.RandomState(1).randn(16, 32).astype("float32")
+
+def one(arr):
+    d0 = _D.value
+    with autograd.record():
+        loss = (net(nd.array(arr)) ** 2).sum()
+    loss.backward()
+    tr.step(batch_size=16)
+    return _D.value - d0
+
+one(x)                                  # eager warm-up observation
+assert one(x) == 1, "capture step not single-dispatch"
+deltas = [one(x) for _ in range(17)]
+assert deltas == [1] * 17, f"steady-state dispatches: {deltas}"
+compiles = cached_step.stats()["compiles"]
+bad = x.copy()
+bad[0, 0] = onp.inf
+w0 = [p._data_nd().asnumpy().copy()
+      for p in net.collect_params().values()]
+assert one(bad) == 1, "overflow step broke the capture"
+assert cached_step.stats()["compiles"] == compiles, \
+    "overflow step recompiled"
+assert tr._amp_loss_scaler.loss_scale == 128.0, \
+    tr._amp_loss_scaler.loss_scale
+for p, w in zip(net.collect_params().values(), w0):
+    onp.testing.assert_array_equal(p._data_nd().asnumpy(), w)
+assert all(str(p.data().dtype) == "float32"
+           for p in net.collect_params().values()), "masters not fp32"
+print("amp_smoke: 20-step bf16 run at 1 dispatch/step; injected-inf "
+      "skipped in-graph (scale 256->128, 0 recompiles)")
+PY
+    # then the bench must hold the wire (<=0.55x fp32 reduce-scatter
+    # bytes), numerics (rtol 1e-2 vs fp32) and fp32-master gates on the
+    # dp=2 ZeRO mesh (exits non-zero otherwise)
+    local tmp; tmp="$(mktemp -d)"
+    JAX_PLATFORMS=cpu python benchmark/amp_bench.py --smoke \
+        | tee "$tmp/bench.json"
+    grep -q '"pass": true' "$tmp/bench.json"
+    grep -q '"masters_fp32": true' "$tmp/bench.json"
+    rm -rf "$tmp"
+}
+
 embedding_smoke() {   # sharded embedding tables: tests + DLRM bench gates
     # tier-1 covers partition routing, the bitwise pull->compute->push
     # round trip vs a dense reference (1- AND 2-shard), server-side

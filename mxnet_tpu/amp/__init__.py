@@ -6,10 +6,11 @@ low_precision_pass.cc.  TPU-first: the target dtype is bfloat16 — same
 exponent range as fp32, so loss scaling is a no-op by default — but the
 full dynamic LossScaler is provided for float16 parity.
 """
-from .amp import (init, init_trainer, scale_loss, unscale, convert_model,
-                  convert_hybrid_block)
-from .loss_scaler import LossScaler
-from . import lists
+from .amp import (init, init_trainer, reset, scale_loss, unscale,
+                  convert_model, convert_hybrid_block)
+from .loss_scaler import LossScaler, all_finite
+from . import lists, policy
 
-__all__ = ["init", "init_trainer", "scale_loss", "unscale", "convert_model",
-           "convert_hybrid_block", "LossScaler", "lists"]
+__all__ = ["init", "init_trainer", "reset", "scale_loss", "unscale",
+           "convert_model", "convert_hybrid_block", "LossScaler",
+           "all_finite", "lists", "policy"]

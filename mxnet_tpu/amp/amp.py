@@ -1,88 +1,51 @@
 """AMP core.
 
 Parity: python/mxnet/contrib/amp/amp.py (init :282, init_trainer :322,
-convert_model :548, convert_hybrid_block :633).  ``init`` patches the op
-registry so MXU-bound ops (conv/FC/matmul) compute in the target dtype
-with amp_cast insertions at their inputs — the imperative analogue of the
-reference's monkeypatching; graph-mode conversion casts parameters and
-wraps the block.
+convert_model :548, convert_hybrid_block :633).  ``init`` activates the
+execution policy (:mod:`.policy`) consulted by the op funnel when it
+builds bound partials, so MXU-bound ops (conv/FC/matmul) compute in the
+target dtype with the casts TRACED into every derived executable —
+eager jit, autograd vjp, the cached whole-step capture, the SPMD scan
+and serving buckets — instead of monkeypatched around eager calls.
+Graph-mode conversion casts parameters and wraps the block.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import numpy as onp
-import jax.numpy as jnp
 
-from ..base import MXNetError, np_dtype
-from ..ops import registry as _reg
-from . import lists
+from ..base import np_dtype
+from . import lists, policy
 from .loss_scaler import LossScaler
 
 _initialized = False
 _target_dtype = None
-_orig_fns = {}
 
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
     """Enable AMP globally (parity: amp.init).
 
-    Wraps the registered compute fn of every TARGET_DTYPE_OP so inputs are
-    cast to ``target_dtype`` (amp_cast) and outputs stay in low precision;
-    FP32_OPS get their inputs cast up.
-    """
+    Activates the execution policy: every TARGET_DTYPE_OP's bound
+    partial gets its f32 inputs cast to ``target_dtype`` at trace time
+    (amp_cast), FP32_OPS get theirs cast up.  Custom op lists are not
+    supported on the policy path — the lists are the single source the
+    cache keys are derived from."""
     global _initialized, _target_dtype
     if _initialized:
         return
-    dt = np_dtype(target_dtype)
-    _target_dtype = dt
-    low_ops = list(target_precision_ops or lists.TARGET_DTYPE_OPS)
-    fp32 = list(fp32_ops or lists.FP32_OPS)
-
-    def wrap_low(fn):
-        @functools.wraps(fn)
-        def wrapped(*arrays, **params):
-            cast = [a.astype(dt) if hasattr(a, "dtype")
-                    and onp.dtype(a.dtype) == onp.float32 else a
-                    for a in arrays]
-            return fn(*cast, **params)
-        return wrapped
-
-    def wrap_fp32(fn):
-        @functools.wraps(fn)
-        def wrapped(*arrays, **params):
-            cast = [a.astype(jnp.float32) if hasattr(a, "dtype")
-                    and onp.dtype(a.dtype) == dt else a for a in arrays]
-            return fn(*cast, **params)
-        return wrapped
-
-    for name in low_ops:
-        try:
-            op = _reg.get(name)
-        except MXNetError:
-            continue
-        if name not in _orig_fns:
-            _orig_fns[name] = op.fn
-            op.fn = wrap_low(op.fn)
-    for name in fp32:
-        try:
-            op = _reg.get(name)
-        except MXNetError:
-            continue
-        if name not in _orig_fns:
-            _orig_fns[name] = op.fn
-            op.fn = wrap_fp32(op.fn)
+    _target_dtype = policy._canon(target_dtype)
+    policy.activate(target_dtype)
     _initialized = True
 
 
 def reset():
-    """Undo init() (test helper; the reference has no un-init)."""
+    """Undo init() (test helper; the reference has no un-init).  Cached
+    executables traced under the policy are retired by cache-key
+    participation, not mutation — nothing to restore here."""
     global _initialized, _target_dtype
-    for name, fn in _orig_fns.items():
-        _reg.get(name).fn = fn
-    _orig_fns.clear()
+    policy.deactivate()
     _initialized = False
     _target_dtype = None
 

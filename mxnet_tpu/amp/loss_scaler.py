@@ -3,39 +3,158 @@
 Parity: python/mxnet/contrib/amp/loss_scaler.py:26 — scale up every
 `scale_window` clean steps, halve on overflow, skip the update that
 overflowed.
+
+Two execution shapes:
+
+- the eager path (``amp.scale_loss``) calls :meth:`has_overflow` /
+  :meth:`update_scale` on the host.  ``has_overflow`` runs ONE jitted
+  fused all-finite reduction over the whole gradient pytree (a single
+  dispatch, one bool crossing the device boundary) instead of the old
+  per-param ``isfinite().all()`` materialization; the legacy loop is
+  kept behind ``MXNET_AMP_FUSED_OVERFLOW=0``.
+- the captured funnels (cached_step, the SPMD scan) trace the scale
+  arithmetic and the all-finite predicate INTO the step executable and
+  hand the resulting device scalars back via :meth:`adopt_traced`,
+  which defers the host read until someone actually looks at
+  ``loss_scale`` — the hot path never blocks on the scaler.
 """
 from __future__ import annotations
 
-import numpy as onp
+import os
 
-__all__ = ["LossScaler"]
+__all__ = ["LossScaler", "all_finite"]
+
+_FUSED_FN = None
+
+
+def _fused_all_finite():
+    """The jitted reduction, built lazily (jax import cost) and cached
+    per gradient-pytree structure by jax.jit itself."""
+    global _FUSED_FN
+    if _FUSED_FN is None:
+        import jax
+        import jax.numpy as jnp
+
+        def allfin(leaves):
+            acc = jnp.bool_(True)
+            for g in leaves:
+                if jnp.issubdtype(g.dtype, jnp.floating):
+                    acc = jnp.logical_and(acc, jnp.isfinite(g).all())
+            return acc
+        _FUSED_FN = jax.jit(allfin)
+    return _FUSED_FN
+
+
+def all_finite(leaves):
+    """One fused device-side all-finite over a list of arrays; returns
+    a 0-d device bool (callers decide when to sync)."""
+    if not leaves:
+        import jax.numpy as jnp
+        return jnp.bool_(True)
+    return _fused_all_finite()(list(leaves))
 
 
 class LossScaler:
     def __init__(self, init_scale=2 ** 16, scale_factor=2.0,
                  scale_window=2000):
-        self.loss_scale = float(init_scale)
+        self._loss_scale = float(init_scale)
         self._scale_factor = scale_factor
         self._scale_window = scale_window
         self._unskipped = 0
+        self._pending = None    # traced (scale, unskipped, skipped)
+
+    # -- traced-state adoption (captured funnels) -----------------------
+
+    def adopt_traced(self, scale, unskipped, skipped) -> None:
+        """Adopt this step's traced scaler outputs (device scalars)
+        without a host sync; the previous pending triple folds into
+        host floats first (one step of lag, read off the critical
+        path)."""
+        self._fold()
+        self._pending = (scale, unskipped, skipped)
+
+    def _fold(self) -> None:
+        p = self._pending
+        if p is None:
+            return
+        self._pending = None
+        skipped = int(p[2])      # bool for one step, a count for a
+        self._loss_scale = float(p[0])   # fused scan window
+        self._unskipped = int(p[1])
+        self._note(skipped)
+
+    def _note(self, skipped) -> None:
+        from .. import telemetry
+        n = int(skipped)
+        if n:
+            telemetry.counter("amp.overflow_steps").inc(n)
+            telemetry.counter("amp.skipped_updates").inc(n)
+        telemetry.gauge("amp.loss_scale").set(self._loss_scale)
+
+    # -- host-visible state --------------------------------------------
+
+    @property
+    def loss_scale(self) -> float:
+        self._fold()
+        return self._loss_scale
+
+    @loss_scale.setter
+    def loss_scale(self, v) -> None:
+        self._pending = None
+        self._loss_scale = float(v)
+
+    def state(self) -> dict:
+        """JSON-able scaler state for checkpoint headers; restoring it
+        resumes the dynamic schedule deterministically."""
+        self._fold()
+        return {"loss_scale": self._loss_scale,
+                "unskipped": int(self._unskipped),
+                "scale_factor": float(self._scale_factor),
+                "scale_window": int(self._scale_window)}
+
+    def load_state(self, d: dict) -> None:
+        self._pending = None
+        self._loss_scale = float(d["loss_scale"])
+        self._unskipped = int(d.get("unskipped", 0))
+        self._scale_factor = float(d.get("scale_factor",
+                                         self._scale_factor))
+        self._scale_window = int(d.get("scale_window",
+                                       self._scale_window))
+
+    # -- eager path -----------------------------------------------------
 
     def has_overflow(self, params) -> bool:
-        """Check grads for inf/nan (parity: LossScaler.has_overflow)."""
+        """Check grads for inf/nan (parity: LossScaler.has_overflow).
+        One fused jitted reduction by default; MXNET_AMP_FUSED_OVERFLOW=0
+        restores the per-param host loop."""
         import jax.numpy as jnp
+        from ..imperative.cached_step import ensure_real
+        grads = []
         for p in params:
             g = getattr(p, "_grad", None)
             if g is None:
                 continue
-            if not bool(jnp.isfinite(g._data).all()):
-                return True
-        return False
+            # under a captured step the grad buffer may still be a
+            # deferred placeholder: reading it here is a host sync,
+            # which takes the documented graph-break path
+            ensure_real(g)
+            grads.append(g._data)
+        if os.environ.get("MXNET_AMP_FUSED_OVERFLOW", "1") == "0":
+            for g in grads:
+                if not bool(jnp.isfinite(g).all()):
+                    return True
+            return False
+        return not bool(all_finite(grads))
 
     def update_scale(self, overflow: bool):
+        self._fold()
         if overflow:
-            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._loss_scale = max(
+                self._loss_scale / self._scale_factor, 1.0)
             self._unskipped = 0
         else:
             self._unskipped += 1
             if self._unskipped >= self._scale_window:
-                self.loss_scale *= self._scale_factor
+                self._loss_scale *= self._scale_factor
                 self._unskipped = 0
+        self._note(overflow)

@@ -1,0 +1,221 @@
+"""AMP execution policy: per-op compute dtype, traced INTO executables.
+
+The legacy ``amp.init`` monkeypatched ``op.fn`` — a mutation the
+compiled hot paths can't see (fused_step and cached_step replay cached
+partials, so a wrapper installed after capture never runs) and one that
+breaks the partial-identity caching the capture layer keys on.  The
+policy replaces that: a process-global (enabled, compute-dtype) pair
+that the op funnel consults when it BUILDS a bound partial
+(ops/registry.bound_fn), so the casts are part of the traced function
+itself and flow into every executable derived from it — the eager
+per-op jit, the autograd vjp, the cached whole-step capture, the SPMD
+scan, and the serving engine's bucket compiles.
+
+Cache coherence is by key participation, not mutation:
+:func:`cache_token` joins ``ops.registry._env_numerics_key()``, which
+is a component of every partial/jit cache key, the fused-step family
+key, the cached-step structure key, and the serving bucket key.
+Flipping AMP on/off (or changing the dtype) therefore mints fresh
+executables instead of corrupting cached ones.
+
+Compute dtypes:
+
+- ``bfloat16`` (default) — same exponent range as fp32, the TPU MXU's
+  native low precision.
+- ``float8_e4m3fn`` (``MXNET_AMP_DTYPE=float8_e4m3fn`` or ``fp8``) —
+  inputs of matmul-class ops are quantized through e4m3 and the op
+  computes in bf16 (quantize-dequantize emulation: e4m3 does not
+  implicitly promote against f32, so letting raw fp8 arrays escape an
+  op would poison every downstream elementwise op; the wire layers
+  that explicitly want 1-byte payloads cast explicitly).
+
+Category semantics (from :mod:`.lists`):
+
+- TARGET_DTYPE_OPS: f32/f64 float inputs cast down to the compute
+  dtype (storage dtype for fp8), output left in low precision.
+- FP32_OPS: low-precision float inputs cast up to f32.
+- WIDEST_TYPE_CASTS: all float inputs cast to the widest float dtype
+  among them.
+- unlisted ops: untouched.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import lists
+
+__all__ = [
+    "enabled", "activate", "deactivate", "compute_dtype",
+    "compute_dtype_str", "storage_dtype", "compute_itemsize",
+    "cache_token", "category", "wrap", "kernel_key_dtype",
+]
+
+# explicit amp.init() activation; the MXNET_AMP env var activates
+# without an init call (read per-token so tests can flip it)
+_active = False
+_active_dtype: Optional[str] = None   # dtype passed to activate()
+
+_TARGET = frozenset(lists.TARGET_DTYPE_OPS)
+_FP32 = frozenset(lists.FP32_OPS)
+_WIDEST = frozenset(lists.WIDEST_TYPE_CASTS)
+
+_DTYPE_ALIASES = {
+    "bfloat16": "bfloat16", "bf16": "bfloat16",
+    "float16": "float16", "fp16": "float16",
+    "float8_e4m3fn": "float8_e4m3fn", "fp8": "float8_e4m3fn",
+    "e4m3": "float8_e4m3fn",
+}
+
+
+def _canon(name) -> str:
+    s = str(name).lower()
+    try:
+        return _DTYPE_ALIASES[s]
+    except KeyError:
+        raise ValueError(
+            f"unsupported AMP compute dtype {name!r}; one of "
+            f"{sorted(set(_DTYPE_ALIASES))}") from None
+
+
+def activate(dtype=None) -> None:
+    """Turn the policy on (amp.init calls this).  ``dtype`` overrides
+    ``MXNET_AMP_DTYPE``; None defers to the env var / bf16 default."""
+    global _active, _active_dtype
+    _active = True
+    _active_dtype = _canon(dtype) if dtype is not None else None
+
+
+def deactivate() -> None:
+    global _active, _active_dtype
+    _active = False
+    _active_dtype = None
+
+
+def enabled() -> bool:
+    """True when amp.init() ran or MXNET_AMP=1 is exported."""
+    return _active or os.environ.get("MXNET_AMP") == "1"
+
+
+def compute_dtype_str() -> str:
+    """Canonical name of the active compute dtype (bf16 when off —
+    callers should gate on :func:`enabled` first)."""
+    if _active_dtype is not None:
+        return _active_dtype
+    env = os.environ.get("MXNET_AMP_DTYPE")
+    return _canon(env) if env else "bfloat16"
+
+
+def storage_dtype():
+    """The dtype low-precision values are QUANTIZED through (e4m3 for
+    fp8) — what the wire layers ship."""
+    import jax.numpy as jnp
+    s = compute_dtype_str()
+    if s == "float8_e4m3fn":
+        import ml_dtypes
+        return jnp.dtype(ml_dtypes.float8_e4m3fn)
+    return jnp.dtype(s)
+
+
+def compute_dtype():
+    """The dtype matmul-class ops COMPUTE in: bf16 for both the bf16
+    and fp8 policies (fp8 is quantize-dequantize emulated), f16 for
+    the float16 parity mode."""
+    import jax.numpy as jnp
+    s = compute_dtype_str()
+    if s == "float8_e4m3fn":
+        return jnp.dtype(jnp.bfloat16)
+    return jnp.dtype(s)
+
+
+def compute_itemsize() -> int:
+    """Bytes per element on the gradient wire under the policy (1 for
+    fp8, 2 for bf16/f16, 4 when the policy is off)."""
+    if not enabled():
+        return 4
+    return storage_dtype().itemsize
+
+
+def cache_token():
+    """Hashable policy fingerprint; joins every executable cache key
+    via ``ops.registry._env_numerics_key()``.  None while off keeps
+    pre-existing keys stable."""
+    if not enabled():
+        return None
+    return ("amp", compute_dtype_str())
+
+
+def category(op_name: str) -> Optional[str]:
+    if op_name in _TARGET:
+        return "target"
+    if op_name in _FP32:
+        return "fp32"
+    if op_name in _WIDEST:
+        return "widest"
+    return None
+
+
+def kernel_key_dtype(dtype_str: str) -> str:
+    """The dtype a kernel-registry cache key should carry for a call
+    arriving as ``dtype_str``: under AMP an fp32 call site runs the
+    kernel on policy-cast operands, so the key must name the compute
+    dtype or a bf16 call after an fp32 tune resolves the fp32 winner
+    (ISSUE 15 satellite fix)."""
+    if enabled() and dtype_str in ("float32", "float64"):
+        return str(compute_dtype())
+    return dtype_str
+
+
+def _is_float(a) -> bool:
+    import jax.numpy as jnp
+    dt = getattr(a, "dtype", None)
+    if dt is None:
+        return False
+    try:
+        return jnp.issubdtype(dt, jnp.floating)
+    except TypeError:
+        return False
+
+
+def wrap(op_name: str, fn):
+    """Return ``fn`` or a casting closure per the op's category.  The
+    closure runs INSIDE the traced function, so the casts are baked
+    into whichever executable captures it.  Must only be called while
+    :func:`enabled` — the caller keys its cache on
+    :func:`cache_token`, which is what invalidates stale wrappers."""
+    cat = category(op_name)
+    if cat is None:
+        return fn
+    import jax.numpy as jnp
+    if cat == "target":
+        sdt = storage_dtype()
+        cdt = compute_dtype()
+        wide = (jnp.float32, jnp.float64)
+
+        def target_cast(a):
+            if _is_float(a) and a.dtype in wide:
+                a = a.astype(sdt)
+                if sdt != cdt:       # fp8: quantize, compute in bf16
+                    a = a.astype(cdt)
+            return a
+
+        def wrapped_target(*arrays, **params):
+            return fn(*[target_cast(a) for a in arrays], **params)
+        return wrapped_target
+    if cat == "fp32":
+        def wrapped_fp32(*arrays, **params):
+            cast = [a.astype(jnp.float32)
+                    if _is_float(a) and a.dtype != jnp.float64
+                    and a.dtype != jnp.float32 else a
+                    for a in arrays]
+            return fn(*cast, **params)
+        return wrapped_fp32
+
+    def wrapped_widest(*arrays, **params):
+        fdts = [a.dtype for a in arrays if _is_float(a)]
+        if len(set(fdts)) > 1:
+            widest = max(fdts, key=lambda d: (d.itemsize, str(d)))
+            arrays = [a.astype(widest) if _is_float(a) else a
+                      for a in arrays]
+        return fn(*arrays, **params)
+    return wrapped_widest

@@ -231,7 +231,8 @@ class _Structure:
     __slots__ = ("steps", "out_shdty", "ext_specs", "diff_idx", "frozen_idx",
                  "param_shdty", "frozen_shdty", "heads", "head_shdty",
                  "head_seed_ext", "statics_key", "dyn_names", "op_name",
-                 "opt_type", "training", "bwd_train", "zero_ndev", "key")
+                 "opt_type", "training", "bwd_train", "zero_ndev", "amp",
+                 "key")
 
 
 class _Obs:
@@ -569,11 +570,11 @@ def _find_candidate(ctx, fn, nd_inputs):
         if stt is cur:
             continue
         if (stt.training, stt.bwd_train, stt.op_name, stt.opt_type,
-                stt.statics_key, stt.dyn_names, stt.key[-1],
+                stt.statics_key, stt.dyn_names, stt.key[-1], stt.amp,
                 stt.diff_idx, stt.frozen_idx, stt.param_shdty,
                 stt.frozen_shdty) != \
            (cur.training, cur.bwd_train, cur.op_name, cur.opt_type,
-                cur.statics_key, cur.dyn_names, cur.key[-1],
+                cur.statics_key, cur.dyn_names, cur.key[-1], cur.amp,
                 cur.diff_idx, cur.frozen_idx, cur.param_shdty,
                 cur.frozen_shdty):
             continue
@@ -942,16 +943,48 @@ def _build_structure(obs, trainer, ignore_stale_grad):
         if nd_ > 1:
             zero_ndev = nd_
     stt.zero_ndev = zero_ndev
+    # AMP: the scaler configuration is structure.  The traced step bakes
+    # the scale-window arithmetic into the executable, so a different
+    # factor/window (or compute dtype) must mint a fresh capture rather
+    # than replay a stale one.  The env-numerics key (kept LAST — the
+    # stt.key[-1] staleness checks depend on that position) already
+    # covers the policy on/off + dtype flips.
+    amp_cfg = None
+    from ..amp import policy as _amp_policy
+    if _amp_policy.enabled():
+        scaler = _trainer_scaler(trainer)
+        amp_cfg = (_amp_policy.compute_dtype_str(),
+                   float(scaler._scale_factor), int(scaler._scale_window))
+    stt.amp = amp_cfg
     stt.key = (tuple(key_steps),
                tuple(zip(heads, head_seed_ext)),
                stt.ext_specs,
                tuple(zip(diff_idx, param_shdty)),
                tuple(zip(frozen_idx, frozen_shdty)),
                (stt.opt_type, stt.op_name, statics_key, dyn_names,
-                zero_ndev),
+                zero_ndev, amp_cfg),
                obs.training, obs.bwd_train,
                _reg._env_numerics_key())
     return stt, None
+
+
+def _trainer_scaler(trainer):
+    """The trainer's LossScaler, creating one when ``MXNET_AMP`` style
+    activation never went through ``amp.init_trainer``.  bf16/fp8 share
+    f32's exponent range, so the implicit scaler starts at 1.0 (the
+    traced machinery — overflow skip, halving floored at 1.0 — stays
+    live, the multiplies are exact no-ops); float16 gets the reference
+    2**16."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        from ..amp import policy as _amp_policy
+        from ..amp.loss_scaler import LossScaler
+        init = 2.0 ** 16 if _amp_policy.compute_dtype_str() == "float16" \
+            else 1.0
+        scaler = LossScaler(init_scale=init)
+        trainer._amp_loss_scaler = scaler
+        trainer._amp_original_scale = getattr(trainer, "_scale", 1.0)
+    return scaler
 
 
 # -- the one executable ------------------------------------------------------
@@ -1010,6 +1043,56 @@ def _build_step_fn(stt):
         new_w, new_s = update_fn(dyn, weights, grads, states)
         return new_w, new_s, grads, flat
 
+    if stt.amp is not None:
+        # AMP variant: the dynamic loss scale rides as a sixth traced
+        # argument (scale, clean-step count) so scale updates never
+        # retrigger compilation.  Seeds are multiplied by the scale
+        # (power of two — bitwise-exact for bf16/f32), gradients are
+        # unscaled back in their own (f32 master) dtype, and the whole
+        # optimizer update sits under ``lax.cond`` on a fused all-finite
+        # predicate: an overflow step ships back the untouched weights
+        # and a halved scale from the SAME executable — no graph break,
+        # still one dispatch.
+        _, factor, window = stt.amp
+
+        def step_fn(dyn, ext, frozen, weights, states, amp_state):
+            scale, good = amp_state
+
+            def fwd(ws):
+                hs, flat = forward(ws, frozen, ext)
+                return hs, flat
+
+            _, vjp_fn, flat = jax.vjp(fwd, weights, has_aux=True)
+            seed_vals = tuple(
+                (jnp.ones(shp, dt) if eid is None else ext[eid])
+                * scale.astype(dt)
+                for (shp, dt), eid in zip(head_shdty, seeds))
+            grads, = vjp_fn(seed_vals)
+            inv = 1.0 / scale
+            grads = tuple(g * inv.astype(g.dtype) for g in grads)
+            finite = jnp.bool_(True)
+            for g in grads:
+                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+
+            def _apply(opnds):
+                w, s, gr = opnds
+                return update_fn(dyn, w, gr, s)
+
+            def _skip(opnds):
+                w, s, _gr = opnds
+                return w, s
+
+            new_w, new_s = jax.lax.cond(
+                finite, _apply, _skip, (weights, states, grads))
+            good1 = good + 1.0
+            grown = jnp.where(good1 >= window, scale * factor, scale)
+            new_scale = jnp.where(
+                finite, grown, jnp.maximum(scale * (1.0 / factor), 1.0))
+            new_good = jnp.where(
+                finite, jnp.where(good1 >= window, 0.0, good1), 0.0)
+            return (new_w, new_s, grads, flat,
+                    (new_scale, new_good, jnp.logical_not(finite)))
+
     if zero:
         # mesh-wide compile: everything replicated except the flat
         # dp-sharded optimizer state; the forward replays redundantly
@@ -1019,6 +1102,11 @@ def _build_step_fn(stt):
         from jax.sharding import NamedSharding, PartitionSpec
         rep = NamedSharding(mesh, PartitionSpec())
         shd = NamedSharding(mesh, PartitionSpec("dp"))
+        if stt.amp is not None:
+            return jax.jit(step_fn,
+                           in_shardings=(rep, rep, rep, rep, shd, rep),
+                           out_shardings=(rep, shd, rep, rep, rep),
+                           donate_argnums=(3, 4))
         return jax.jit(step_fn,
                        in_shardings=(rep, rep, rep, rep, shd),
                        out_shardings=(rep, shd, rep, rep),
@@ -1060,6 +1148,17 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
             stt.dyn_names:
         _break(ctx, "optimizer dynamics changed since capture")
         return False
+    from ..amp import policy as _amp_policy
+    if (stt.amp is not None) != _amp_policy.enabled():
+        _break(ctx, "amp policy toggled since capture")
+        return False
+    if stt.amp is not None:
+        _scaler = _trainer_scaler(trainer)
+        if stt.amp != (_amp_policy.compute_dtype_str(),
+                       float(_scaler._scale_factor),
+                       int(_scaler._scale_window)):
+            _break(ctx, "amp scaler config changed since capture")
+            return False
     if any(v is None for v in ctx.ext_vals):
         _break(ctx, "unresolved external input")
         return False
@@ -1139,6 +1238,15 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
         ext_t, frozen_t, weights_t = jax.device_put(
             (ext_t, frozen_t, weights_t), rep)
     states_t = tuple(tuple(s._data for s in sts) for sts in states)
+    amp_t = None
+    if stt.amp is not None:
+        # host->device of two 4-byte scalars; reading loss_scale folds
+        # the PREVIOUS step's traced triple (its arrays are long since
+        # computed, so this never blocks on in-flight work)
+        amp_t = (jnp.asarray(_scaler.loss_scale, jnp.float32),
+                 jnp.asarray(float(_scaler._unskipped), jnp.float32))
+        if zero:
+            amp_t = jax.device_put(amp_t, rep)
 
     fresh = ent.compiled is None
     if fresh:
@@ -1154,9 +1262,14 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
             with tracing.span("compile.cached_step"):
                 if ent.jfn is None:
                     ent.jfn = _build_step_fn(stt)
-                ent.compiled = ent.jfn.lower(
-                    dyn_probe, ext_t, frozen_t, weights_t,
-                    states_t).compile()
+                if stt.amp is not None:
+                    ent.compiled = ent.jfn.lower(
+                        dyn_probe, ext_t, frozen_t, weights_t,
+                        states_t, amp_t).compile()
+                else:
+                    ent.compiled = ent.jfn.lower(
+                        dyn_probe, ext_t, frozen_t, weights_t,
+                        states_t).compile()
         except Exception:
             state.bad.add(stt.key)
             state.current = None
@@ -1183,8 +1296,12 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
     tp = profiler.op_timer()
     _rsp = tracing.begin("step.cached_replay", compiled=not fresh)
     try:
-        new_w, new_s, grads, flat = ent.compiled(
-            dyn, ext_t, frozen_t, weights_t, states_t)
+        if stt.amp is not None:
+            new_w, new_s, grads, flat, amp_out = ent.compiled(
+                dyn, ext_t, frozen_t, weights_t, states_t, amp_t)
+        else:
+            new_w, new_s, grads, flat = ent.compiled(
+                dyn, ext_t, frozen_t, weights_t, states_t)
         tracing.end(_rsp)
     except Exception:
         tracing.end(_rsp, error=True)
@@ -1200,6 +1317,10 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
         raise
     from ..optimizer.optimizer import _note_dispatch
     _note_dispatch()
+    if stt.amp is not None:
+        # device scalars only — the host reads them next step (or when
+        # someone looks at loss_scale); the dispatch path never blocks
+        _scaler.adopt_traced(*amp_out)
     profiler.op_record(f"CachedStep::{stt.opt_type}", tp)
     if zero:
         # back to the eager device: placeholder fills, grad buffers and
@@ -1207,8 +1328,14 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
         # the captured step never meet mesh-committed arrays
         new_w, grads, flat = jax.device_put((new_w, grads, flat), dev0)
         frac = (stt.zero_ndev - 1) / stt.zero_ndev
+        # under AMP the sharded update casts the gradient to the policy
+        # storage dtype BEFORE its reduce-scatter constraint, so the
+        # wire leg is accounted at the compute itemsize (the all-gather
+        # leg stays f32 — master weights come back whole)
+        isz = _amp_policy.compute_itemsize() if stt.amp is not None else 4
         telemetry.record_comm_bytes(
-            int(sum(g.nbytes for g in grads) * frac), "reduce_scatter")
+            int(sum(g.size * min(isz, g.dtype.itemsize) for g in grads)
+                * frac), "reduce_scatter")
         telemetry.record_comm_bytes(
             int(sum(w.nbytes for w in new_w) * frac), "all_gather")
     telemetry.record_opt_state_bytes(

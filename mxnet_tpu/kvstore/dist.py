@@ -498,12 +498,33 @@ class DistKVStore(KVStoreBase):
 
         if self._nproc == 1:
             return kv
+        # AMP wire discipline: gradient payloads cross the network in
+        # the policy compute dtype (bf16 — sum-safe on every backend;
+        # fp8 still ships bf16 here, its e4m3 leg is the ZeRO ring's),
+        # dequantized back to the stored dtype on the way out.  The
+        # ``cat.nbytes`` accounting below then reports the REAL bytes on
+        # the wire — the push span's payload_nbytes shows ~0.5x fp32.
+        from ..amp import policy as _amp_policy
+        wire_dt = (jnp.dtype(_amp_policy.compute_dtype())
+                   if _amp_policy.enabled() else None)
+
+        def _wire(a):
+            if (wire_dt is not None
+                    and jnp.issubdtype(a.dtype, jnp.floating)
+                    and a.dtype.itemsize > wire_dt.itemsize):
+                return a.astype(wire_dt)
+            return a
         by_dtype: Dict[str, list] = {}
         for i, (k, v) in enumerate(kv):
-            by_dtype.setdefault(str(v.dtype), []).append(i)
+            dt = str(v.dtype)
+            if (wire_dt is not None
+                    and jnp.issubdtype(v._data.dtype, jnp.floating)
+                    and v._data.dtype.itemsize > wire_dt.itemsize):
+                dt = str(wire_dt)
+            by_dtype.setdefault(dt, []).append(i)
         out = list(kv)
         for idxs in by_dtype.values():
-            flats = [kv[i][1]._data.reshape(-1) for i in idxs]
+            flats = [_wire(kv[i][1]._data.reshape(-1)) for i in idxs]
             cat = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
             t0 = profiler.op_timer()
             red = self._collectives().allreduce(cat)

@@ -509,9 +509,15 @@ def _pow2_bucket(n, floor=128):
 
 
 def _flash_signature(q, k, v, causal=False, sm_scale=None):
-    """(shape-sig, dtype) cache-key parts from (BH, S, D) arrays."""
+    """(shape-sig, dtype) cache-key parts from (BH, S, D) arrays.  The
+    dtype leg resolves through the AMP policy: under AMP an fp32 call
+    site runs the kernel on policy-cast operands, so the key must name
+    the compute dtype — otherwise a bf16 call after an fp32 tune would
+    resolve the fp32 winner."""
+    from ..amp import policy as _amp_policy
     return (f"sq{_pow2_bucket(q.shape[1])}_sk{_pow2_bucket(k.shape[1])}"
-            f"_d{q.shape[2]}_c{int(bool(causal))}", str(q.dtype))
+            f"_d{q.shape[2]}_c{int(bool(causal))}",
+            _amp_policy.kernel_key_dtype(str(q.dtype)))
 
 
 def _flash_kernel_run(config, q, k, v, causal=False, sm_scale=None):

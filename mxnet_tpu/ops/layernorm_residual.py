@@ -102,12 +102,16 @@ _lnr.defvjp(_lnr_fwd, _lnr_bwd)
 # -- kernel-registry spec ---------------------------------------------------
 
 def _lnr_signature(x, residual, gamma, beta, eps=1e-5):
+    # the dtype leg resolves through the AMP policy (see
+    # attention._flash_signature): an fp32 call under AMP runs on
+    # policy-cast operands, so the cache key names the compute dtype
+    from ..amp import policy as _amp_policy
     from .attention import _pow2_bucket
     rows = 1
     for s in x.shape[:-1]:
         rows *= s
     return (f"rows{_pow2_bucket(rows, floor=8)}_f{x.shape[-1]}",
-            str(x.dtype))
+            _amp_policy.kernel_key_dtype(str(x.dtype)))
 
 
 def _lnr_kernel_run(config, x, residual, gamma, beta, eps=1e-5):

@@ -384,8 +384,13 @@ def safe_accumulation_enabled() -> bool:
 def _env_numerics_key():
     """Env switches that ops read at trace time participate in the cache
     key, so toggling them is honored instead of replaying a stale
-    compiled executable."""
-    return safe_accumulation_enabled()
+    compiled executable.  The AMP policy token rides here too: flipping
+    AMP on/off (or changing MXNET_AMP_DTYPE) mints fresh partials, jit
+    entries, fused-step families, cached-step structures and serving
+    buckets instead of replaying executables traced under the other
+    numerics."""
+    from ..amp import policy as _amp_policy
+    return (safe_accumulation_enabled(), _amp_policy.cache_token())
 
 
 def bound_fn(op: Operator, params: dict):
@@ -398,18 +403,30 @@ def bound_fn(op: Operator, params: dict):
     if imp:         # per-call host state (PRNG): never cache or jit
         return (functools.partial(op.fn, **params) if params
                 else op.fn), None
+    from ..amp import policy as _amp_policy
     pkey = _params_key(params) if params else ()
     if pkey is None:                      # unhashable params: no caching
-        return functools.partial(op.fn, **params), None
+        base = (_amp_policy.wrap(op.name, op.fn)
+                if _amp_policy.enabled() else op.fn)
+        return functools.partial(base, **params), None
     key = (pkey, _env_numerics_key())
     fn = op._partials.get(key)
     if fn is None:
         if len(op._partials) >= _MAX_PARTIALS:
             # params vary per call (e.g. slice indices in a loop): caching
             # would leak one compiled executable per value
-            return (functools.partial(op.fn, **params) if params
-                    else op.fn), None
-        fn = functools.partial(op.fn, **params) if params else op.fn
+            base = (_amp_policy.wrap(op.name, op.fn)
+                    if _amp_policy.enabled() else op.fn)
+            return (functools.partial(base, **params) if params
+                    else base), None
+        base = op.fn
+        if key[1][1] is not None:   # AMP on: bake the policy casts into
+            # the partial itself, so every executable derived from it
+            # (eager jit, autograd vjp, cached-step replay, SPMD scan,
+            # serving buckets) traces them — the key's policy token is
+            # what retires this wrapper when the policy changes
+            base = _amp_policy.wrap(op.name, base)
+        fn = functools.partial(base, **params) if params else base
         op._partials[key] = fn
         _STABLE_FNS.add(fn)
     jentry = op._jits.get(key)

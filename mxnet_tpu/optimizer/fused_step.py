@@ -273,11 +273,20 @@ def make_sharded_update_fn(op_name: str, statics_key: Tuple,
     semantics exactly, and reshape-invariant reductions (LAMB/LARS
     norms) only ever add zeros to their sums."""
     from jax.sharding import NamedSharding, PartitionSpec
+    from ..amp import policy as _amp_policy
     ndev = int(mesh.shape["dp"])
     unit = zero_pad_unit(ndev)
     shd = NamedSharding(mesh, PartitionSpec("dp"))
     base_fn = _lowp_guard(_reg.get(op_name).fn)
     statics = dict(statics_key)
+    # AMP: the gradient flat vector is cast to the policy's storage
+    # dtype BEFORE its sharding constraint, so the reduce-scatter wire
+    # carries bf16/fp8 payloads (~0.5×/0.25× fp32); _lowp_guard casts
+    # back up for the update arithmetic and the master weight (wf, f32)
+    # keeps the all-gather leg full precision.  Resolved at build time —
+    # the family key carries the policy token, so a flip rebuilds.
+    wire_dt = (_amp_policy.storage_dtype()
+               if _amp_policy.enabled() else None)
 
     def fused(dyn, weights, grads, states):
         new_w, new_s = [], []
@@ -288,6 +297,10 @@ def make_sharded_update_fn(op_name: str, statics_key: Tuple,
             pad = (-w.size) % unit
             wf = w.reshape(-1)
             gf = grads[i].reshape(-1)
+            if wire_dt is not None and jnp.issubdtype(
+                    gf.dtype, jnp.floating) and \
+                    gf.dtype.itemsize > wire_dt.itemsize:
+                gf = gf.astype(wire_dt)
             if pad:
                 wf = jnp.concatenate([wf, jnp.zeros((pad,), wf.dtype)])
                 gf = jnp.concatenate([gf, jnp.zeros((pad,), gf.dtype)])
@@ -480,7 +493,8 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
         if ndev <= 1:
             zero = False
     family = (type(opt).__name__, opt.op_name, statics_key, dyn_names,
-              donate_weights, ("zero", ndev) if zero else None)
+              donate_weights, ("zero", ndev) if zero else None,
+              _reg._env_numerics_key())
 
     entry = _ENTRIES.setdefault(family, _FusedEntry())
     if entry.disabled:
@@ -619,11 +633,21 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
     if zero:
         # the tradeoff, measured: ring-cost wire bytes of the two
         # collectives that replaced the (folded) allreduce, and the
-        # optimizer-state residency of the busiest device (~1/dp)
+        # optimizer-state residency of the busiest device (~1/dp).
+        # Under AMP the gradient leg is cast to the policy's storage
+        # dtype before its sharding constraint, so account its bytes
+        # at the wire itemsize, not the fp32 buffer size; the
+        # all-gather leg carries fp32 master weights either way.
+        from ..amp import policy as _amp_policy
         frac = (ndev - 1) / ndev
-        telemetry.record_comm_bytes(
-            int(sum(g._data.nbytes for g in grads) * frac),
-            "reduce_scatter")
+        if _amp_policy.enabled():
+            isz = _amp_policy.compute_itemsize()
+            gbytes = sum(g._data.size
+                         * min(isz, g._data.dtype.itemsize)
+                         for g in grads)
+        else:
+            gbytes = sum(g._data.nbytes for g in grads)
+        telemetry.record_comm_bytes(int(gbytes * frac), "reduce_scatter")
         telemetry.record_comm_bytes(
             int(sum(w._data.nbytes for w in weights) * frac),
             "all_gather")

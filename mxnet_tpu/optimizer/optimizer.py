@@ -51,8 +51,10 @@ def _lowp_guard(base_fn):
     reference's mp_* kernels' discipline, applied generally)."""
 
     def guarded(*arrays, **kw):
-        lowp = any(a.dtype in (jnp.bfloat16, jnp.float16)
-                   for a in arrays)
+        # any sub-f32 float (bf16/fp16, and the AMP fp8 wire dtype —
+        # which does not even implicitly promote) takes the cast path
+        lowp = any(jnp.issubdtype(a.dtype, jnp.floating)
+                   and a.dtype.itemsize < 4 for a in arrays)
         if not lowp:
             return base_fn(*arrays, **kw)
         a32 = [a.astype(jnp.float32) if jnp.issubdtype(

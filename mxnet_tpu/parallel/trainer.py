@@ -109,6 +109,20 @@ class SPMDTrainer:
         self.amp_dtype = (jnp.bfloat16
                           if dtype in ("bfloat16", "bf16", "float16")
                           else None)
+        # the global AMP policy (amp.init / MXNET_AMP) reaches this
+        # funnel too: compute dtype from the policy when the ctor did
+        # not pin one, and a dynamic loss scaler whose state rides the
+        # scan carry so a whole fused window still dispatches once
+        from ..amp import policy as _amp_policy
+        self._amp_scaler = None
+        if _amp_policy.enabled():
+            if self.amp_dtype is None:
+                self.amp_dtype = jnp.dtype(_amp_policy.compute_dtype())
+            from ..amp.loss_scaler import LossScaler
+            init = (2.0 ** 16
+                    if _amp_policy.compute_dtype_str() == "float16"
+                    else 1.0)
+            self._amp_scaler = LossScaler(init_scale=init)
         self.optimizer = opt_mod.create(optimizer, **(optimizer_params or {}))
         self._params = net.collect_params()
         self._pkeys = list(self._params.keys())
@@ -184,9 +198,14 @@ class SPMDTrainer:
 
         amp = self.amp_dtype
 
-        def step(key, lr, wd, p_arrays, opt_state, data, label):
+        scaler = self._amp_scaler
+
+        def step(key, lr, wd, p_arrays, opt_state, data, label,
+                 amp_state=None):
             if self._data_transform is not None:
                 data = self._data_transform(data)
+            # traced loss scale: a dynamic-scale update never recompiles
+            scale = amp_state[0] if scaler is not None else None
 
             def loss_of(p_list):
                 tc = _TraceContext(key)
@@ -205,8 +224,11 @@ class SPMDTrainer:
                         out = net.forward(NDArray(d_in))
                         loss = loss_fn(out, NDArray(label))
                     cell["aux"] = list(tc.aux)
-                    return (loss._data.astype(jnp.float32).mean(),
-                            tuple(v for _, v in tc.aux))
+                    loss_mean = loss._data.astype(jnp.float32).mean()
+                    if scale is not None:
+                        # power-of-two multiply: exact for f32/bf16
+                        loss_mean = loss_mean * scale
+                    return loss_mean, tuple(v for _, v in tc.aux)
                 finally:
                     for p, s in zip(params, saved):
                         p._data = s
@@ -263,29 +285,82 @@ class SPMDTrainer:
                 aux = jax.tree_util.tree_map(lambda x: x[-1], aux_stack)
                 data, label = saved_batch
 
-            new_params, new_state = [], []
-            for k, w, g, st in zip(pkeys, p_arrays, grads, opt_state):
-                param = self._params[k]
-                if param.grad_req == "null":
-                    new_params.append(w)
-                    new_state.append(st)
-                    continue
-                sp = dict(opt.static_params(0))
-                sp.setdefault("rescale_grad", 1.0)
-                sp.setdefault("clip_gradient",
-                              float(opt.clip_gradient)
-                              if opt.clip_gradient is not None else -1.0)
-                from ..optimizer.optimizer import _lowp_guard
-                fn = _lowp_guard(_reg.get(opt.op_name).fn)
-                eff_lr = lr * param.lr_mult
-                eff_wd = wd * param.wd_mult
-                if opt.uses_lr:
-                    out = fn(w, g, *st, lr=eff_lr, wd=eff_wd, **sp)
-                else:
-                    out = fn(w, g, *st, wd=eff_wd, **sp)
-                outs = out if isinstance(out, tuple) else (out,)
-                new_params.append(outs[0])
-                new_state.append(tuple(outs[1:]))
+            def do_update(p_in, g_in, s_in):
+                new_params, new_state = [], []
+                for k, w, g, st in zip(pkeys, p_in, g_in, s_in):
+                    param = self._params[k]
+                    if param.grad_req == "null":
+                        new_params.append(w)
+                        new_state.append(st)
+                        continue
+                    sp = dict(opt.static_params(0))
+                    sp.setdefault("rescale_grad", 1.0)
+                    sp.setdefault("clip_gradient",
+                                  float(opt.clip_gradient)
+                                  if opt.clip_gradient is not None else -1.0)
+                    from ..optimizer.optimizer import _lowp_guard
+                    fn = _lowp_guard(_reg.get(opt.op_name).fn)
+                    eff_lr = lr * param.lr_mult
+                    eff_wd = wd * param.wd_mult
+                    if opt.uses_lr:
+                        out = fn(w, g, *st, lr=eff_lr, wd=eff_wd, **sp)
+                    else:
+                        out = fn(w, g, *st, wd=eff_wd, **sp)
+                    outs = out if isinstance(out, tuple) else (out,)
+                    new_params.append(outs[0])
+                    new_state.append(tuple(outs[1:]))
+                return new_params, new_state
+
+            amp_out = None
+            if scaler is None:
+                new_params, new_state = do_update(p_arrays, grads,
+                                                  opt_state)
+            else:
+                good = amp_state[1]
+                inv = 1.0 / scale
+                loss_val = loss_val * inv
+                grads = [g * inv.astype(g.dtype)
+                         if jnp.issubdtype(g.dtype, jnp.floating) else g
+                         for g in grads]
+                finite = jnp.bool_(True)
+                for g in grads:
+                    if jnp.issubdtype(g.dtype, jnp.floating):
+                        finite = jnp.logical_and(finite,
+                                                 jnp.isfinite(g).all())
+                # wire discipline: the gradient collective GSPMD inserts
+                # rides next to this round-trip, so the dp ring carries
+                # the policy storage dtype; masters update from the
+                # dequantized value (checked BEFORE the cast — fp8 e4m3
+                # has no inf and would fold overflow into NaN)
+                from ..amp import policy as _amp_policy
+                wire = jnp.dtype(_amp_policy.storage_dtype())
+                grads = [g.astype(wire).astype(g.dtype)
+                         if (jnp.issubdtype(g.dtype, jnp.floating)
+                             and g.dtype.itemsize > wire.itemsize) else g
+                         for g in grads]
+
+                def _apply(opnds):
+                    p_in, g_in, s_in = opnds
+                    return do_update(p_in, g_in, s_in)
+
+                def _skip(opnds):
+                    p_in, _g, s_in = opnds
+                    return list(p_in), [tuple(s) for s in s_in]
+
+                new_params, new_state = jax.lax.cond(
+                    finite, _apply, _skip,
+                    (list(p_arrays), grads, list(opt_state)))
+                factor = scaler._scale_factor
+                window = scaler._scale_window
+                good1 = good + 1.0
+                grown = jnp.where(good1 >= window, scale * factor, scale)
+                new_scale = jnp.where(
+                    finite, grown,
+                    jnp.maximum(scale * (1.0 / factor), 1.0))
+                new_good = jnp.where(
+                    finite, jnp.where(good1 >= window, 0.0, good1), 0.0)
+                amp_out = (new_scale, new_good,
+                           jnp.logical_not(finite).astype(jnp.float32))
             # fold traced aux updates (BN running stats) into new_params
             # so they flow through the step output — a scanned step sees
             # iteration i's stats at iteration i+1
@@ -293,6 +368,8 @@ class SPMDTrainer:
                 idx = pindex.get(id(pobj))
                 if idx is not None:
                     new_params[idx] = v.astype(p_arrays[idx].dtype)
+            if scaler is not None:
+                return new_params, new_state, loss_val, aux, amp_out
             return new_params, new_state, loss_val, aux
 
         return step, cell, params
@@ -317,6 +394,9 @@ class SPMDTrainer:
         # GSPMD may hand back e.g. a bias sharded like the matmul it
         # feeds, and the next call's replicated in_sharding rejects it
         out_shardings = (p_shardings, s_shardings, rep, rep)
+        if self._amp_scaler is not None:
+            in_shardings = in_shardings + (rep,)
+            out_shardings = out_shardings + (rep,)
         jitted = jax.jit(step, in_shardings=in_shardings,
                          out_shardings=out_shardings,
                          donate_argnums=donate)
@@ -341,19 +421,43 @@ class SPMDTrainer:
         and the scan consumes one batch per step — the data-fed window
         (input pipeline → device once per window, not per step)."""
         step, cell, params = self._make_step_fn()
+        amp = self._amp_scaler is not None
 
-        def many(key, lr, wd, p_arrays, opt_state, data, label):
-            def body(carry, xs):
-                key, p, s = carry
-                d, l = (data, label) if xs is None else xs
-                key, sub = jax.random.split(key)
-                new_p, new_s, loss, _aux = step(sub, lr, wd, p, s, d, l)
-                return (key, new_p, new_s), loss
-            (key, p, s), losses = jax.lax.scan(
-                body, (key, list(p_arrays), list(opt_state)),
-                (data, label) if per_step_data else None,
-                length=None if per_step_data else n_steps)
-            return p, s, losses
+        if amp:
+            # the loss-scale pair rides the scan carry: the whole fused
+            # window stays one executable, overflow steps inside it skip
+            # their own update, and the skip count accumulates so the
+            # scaler's host-side telemetry stays exact
+            def many(key, lr, wd, p_arrays, opt_state, data, label,
+                     amp_state):
+                def body(carry, xs):
+                    key, p, s, scale, good, nskip = carry
+                    d, l = (data, label) if xs is None else xs
+                    key, sub = jax.random.split(key)
+                    new_p, new_s, loss, _aux, (ns, ng, sk) = step(
+                        sub, lr, wd, p, s, d, l, (scale, good))
+                    return (key, new_p, new_s, ns, ng, nskip + sk), loss
+                carry0 = (key, list(p_arrays), list(opt_state),
+                          amp_state[0], amp_state[1], jnp.float32(0.0))
+                (key, p, s, scale, good, nskip), losses = jax.lax.scan(
+                    body, carry0,
+                    (data, label) if per_step_data else None,
+                    length=None if per_step_data else n_steps)
+                return p, s, losses, (scale, good, nskip)
+        else:
+            def many(key, lr, wd, p_arrays, opt_state, data, label):
+                def body(carry, xs):
+                    key, p, s = carry
+                    d, l = (data, label) if xs is None else xs
+                    key, sub = jax.random.split(key)
+                    new_p, new_s, loss, _aux = step(sub, lr, wd, p, s,
+                                                    d, l)
+                    return (key, new_p, new_s), loss
+                (key, p, s), losses = jax.lax.scan(
+                    body, (key, list(p_arrays), list(opt_state)),
+                    (data, label) if per_step_data else None,
+                    length=None if per_step_data else n_steps)
+                return p, s, losses
 
         p_shardings, s_shardings = self._state_shardings(params)
         rep = NamedSharding(self.mesh, PartitionSpec())
@@ -362,9 +466,13 @@ class SPMDTrainer:
         in_shardings = (rep, rep, rep, p_shardings, s_shardings,
                         shard_of(len(data_shape)),
                         shard_of(len(label_shape)))
+        out_shardings = (p_shardings, s_shardings, rep)
+        if amp:
+            in_shardings = in_shardings + (rep,)
+            out_shardings = out_shardings + (rep,)
         donate = (3, 4) if self._donate else ()
         jitted = jax.jit(many, in_shardings=in_shardings,
-                         out_shardings=(p_shardings, s_shardings, rep),
+                         out_shardings=out_shardings,
                          donate_argnums=donate)
         return jitted, cell
 
@@ -426,9 +534,15 @@ class SPMDTrainer:
                 tc = time.perf_counter() if fresh else None
                 with tracing.span("compile.spmd_step" if fresh
                                   else "step.dispatch"):
-                    new_p, new_s, loss, aux = jitted(next_key(), lr, wd,
-                                                     p_arrays, opt_state,
-                                                     d, l)
+                    if self._amp_scaler is not None:
+                        new_p, new_s, loss, aux, amp_out = jitted(
+                            next_key(), lr, wd, p_arrays, opt_state,
+                            d, l, self._amp_state_in())
+                        self._amp_scaler.adopt_traced(*amp_out)
+                    else:
+                        new_p, new_s, loss, aux = jitted(
+                            next_key(), lr, wd, p_arrays, opt_state,
+                            d, l)
                 if tc is not None:
                     telemetry.record_compile(time.perf_counter() - tc,
                                              "spmd_step")
@@ -439,6 +553,14 @@ class SPMDTrainer:
         finally:
             telemetry.end_step(tok, "SPMDTrainer")
         return NDArray(loss)
+
+    def _amp_state_in(self):
+        """(scale, clean-step count) as device scalars.  Reading
+        ``loss_scale`` folds the PREVIOUS step's traced triple — those
+        arrays are long computed, so this never blocks on in-flight
+        work."""
+        s = self._amp_scaler
+        return (jnp.float32(s.loss_scale), jnp.float32(s._unskipped))
 
     def opt_state_bytes_per_device(self) -> int:
         """Optimizer-state bytes resident on the busiest device —
@@ -469,16 +591,23 @@ class SPMDTrainer:
         if model is None:
             ndp = int(self.mesh.shape.get("dp", 1)) \
                 if "dp" in self.mesh.axis_names else 1
+            # gradient legs (reduce-scatter / allreduce) ship in the AMP
+            # storage dtype under the policy; the all-gather leg returns
+            # f32 master weights and stays full-width
+            from ..amp import policy as _amp_policy
+            gfrac = 1.0
+            if self._amp_scaler is not None:
+                gfrac = min(_amp_policy.compute_itemsize(), 4) / 4.0
             rs = ag = ar = 0
             if ndp > 1:
                 for k in self._pkeys:
                     p = self._params[k]
                     nbytes = int(p.data()._data.nbytes)
                     if self._spec_has_dp(self._opt_state_sharding(p).spec):
-                        rs += nbytes * (ndp - 1) // ndp
+                        rs += int(nbytes * gfrac) * (ndp - 1) // ndp
                         ag += nbytes * (ndp - 1) // ndp
                     else:
-                        ar += 2 * nbytes * (ndp - 1) // ndp
+                        ar += 2 * int(nbytes * gfrac) * (ndp - 1) // ndp
             model = self._comm_model = (rs, ag, ar)
         rs, ag, ar = model
         if rs or ag:
@@ -573,9 +702,15 @@ class SPMDTrainer:
                 tc = time.perf_counter() if fresh else None
                 with tracing.span("compile.spmd_step" if fresh
                                   else "step.dispatch"):
-                    new_p, new_s, losses = jitted(next_key(), lr, wd,
-                                                  p_arrays, opt_state,
-                                                  d, l)
+                    if self._amp_scaler is not None:
+                        new_p, new_s, losses, amp_out = jitted(
+                            next_key(), lr, wd, p_arrays, opt_state,
+                            d, l, self._amp_state_in())
+                        self._amp_scaler.adopt_traced(*amp_out)
+                    else:
+                        new_p, new_s, losses = jitted(
+                            next_key(), lr, wd, p_arrays, opt_state,
+                            d, l)
                 if tc is not None:
                     telemetry.record_compile(time.perf_counter() - tc,
                                              "spmd_step")
@@ -659,8 +794,13 @@ class SPMDTrainer:
         opt_state = [self._opt_state[k] for k in self._pkeys]
         lr = jnp.float32(self.optimizer.learning_rate)
         wd = jnp.float32(self.optimizer.wd)
-        compiled = jitted.lower(next_key(), lr, wd, p_arrays, opt_state,
-                                d, l).compile()
+        if self._amp_scaler is not None:
+            compiled = jitted.lower(next_key(), lr, wd, p_arrays,
+                                    opt_state, d, l,
+                                    self._amp_state_in()).compile()
+        else:
+            compiled = jitted.lower(next_key(), lr, wd, p_arrays,
+                                    opt_state, d, l).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
@@ -796,6 +936,19 @@ class SPMDTrainer:
             "slots": {k: len(self._opt_state[k]) for k in self._pkeys},
             "meta": dict(meta or {}),
         }
+        # AMP provenance: the tree always holds fp32 MASTER weights (the
+        # compute-dtype casts live in the traced step, never in the
+        # stored arrays), so a checkpoint written under AMP loads into an
+        # AMP-off run — and across compute dtypes — unchanged.  The
+        # header records the policy + scaler state for deterministic
+        # loss-scale resume.
+        if self._amp_scaler is not None:
+            from ..amp import policy as _amp_policy
+            header["amp"] = {
+                "enabled": True,
+                "compute_dtype": _amp_policy.compute_dtype_str(),
+                "scaler": self._amp_scaler.state(),
+            }
         rank, world = _ckpt.rank_world()
         job = _ckpt.save(directory, tree, header, tag=tag, block=block,
                          rank=rank, world=world)
@@ -853,6 +1006,13 @@ class SPMDTrainer:
         self.optimizer.num_update = self.num_update
         if header.get("rng_key"):
             _rand.set_state_bits(header["rng_key"])
+        # deterministic loss-scale resume; an AMP-on checkpoint into an
+        # AMP-off trainer (or vice versa) just drops/starts the scaler
+        # schedule — the weights themselves are dtype-portable masters
+        amp_hdr = header.get("amp")
+        if amp_hdr and self._amp_scaler is not None \
+                and amp_hdr.get("scaler"):
+            self._amp_scaler.load_state(amp_hdr["scaler"])
         meta = dict(header.get("meta") or {})
         meta["num_update"] = self.num_update
         return meta

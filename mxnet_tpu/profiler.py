@@ -123,6 +123,9 @@ def counters() -> Dict[str, Dict[str, int]]:
       autotune wall ms + measurement runs, XLA-fallback dispatches —
       mxnet_tpu/kernels/; ``tune_ms``/``tune_measurements`` staying 0
       is the warm-cache acceptance signal)
+    - ``amp``: the mixed-precision policy (whether it is active and at
+      which compute dtype, the live dynamic loss scale, overflow steps
+      seen and updates skipped in-graph — mxnet_tpu/amp/)
     - ``embedding``: the sharded embedding-table subsystem (rows on the
       sparse pull/push wire, sparse vs dense-equivalent payload bytes,
       the serving lookup tier's LRU hit/miss/evict admission, hot-row
@@ -137,6 +140,7 @@ def counters() -> Dict[str, Dict[str, int]]:
     from .optimizer import fused_step as _fused_step
     from .imperative import cached_step as _cached_step
     from . import clustermon as _clustermon
+    from .amp import policy as _amp_policy
     return {"eager_jit": _registry.jit_cache_stats(),
             "fused_step": _fused_step.stats(),
             "cached_step": _cached_step.stats(),
@@ -215,6 +219,15 @@ def counters() -> Dict[str, Dict[str, int]]:
                     telemetry.counter("kernel.tune_measurements").value,
                 "fallbacks":
                     telemetry.counter("kernel.fallbacks").value},
+            "amp": {
+                "enabled": _amp_policy.enabled(),
+                "compute_dtype": (_amp_policy.compute_dtype_str()
+                                  if _amp_policy.enabled() else "float32"),
+                "loss_scale": telemetry.gauge("amp.loss_scale").value,
+                "overflow_steps":
+                    telemetry.counter("amp.overflow_steps").value,
+                "skipped_updates":
+                    telemetry.counter("amp.skipped_updates").value},
             "embedding": {
                 "rows_pulled":
                     telemetry.counter("embedding.rows_pulled").value,

@@ -407,11 +407,18 @@ class InferenceEngine:
             # hooks observe real activations: decline capture, run eager
             # so the hooks fire per dispatch
             return None
-        entry = self._compiled.get(key)
+        # the AMP policy token joins the cache key: the traced forward
+        # bakes the policy's compute-dtype casts into the bucket
+        # executable (via the op funnel's bound partials), so a bucket
+        # compiled fp32 must not serve traffic after an amp.init flip —
+        # the fresh token minted here compiles a fresh executable
+        from ..amp import policy as _amp_policy
+        ckey = (key, _amp_policy.cache_token())
+        entry = self._compiled.get(ckey)
         if entry is not None:
             return entry          # includes the eager latch sentinel
         with self._lock:
-            entry = self._compiled.get(key)
+            entry = self._compiled.get(ckey)
             if entry is None:
                 n_live = sum(1 for v in self._compiled.values()
                              if v is not None)
@@ -422,7 +429,7 @@ class InferenceEngine:
                 entry = self._compile(key, shape, dtype)
                 if entry is None:
                     entry = "eager"     # failed compile: latch this bucket
-                self._compiled[key] = entry
+                self._compiled[ckey] = entry
         return entry if entry != "eager" else None
 
     # -- dispatch -----------------------------------------------------------
@@ -573,7 +580,8 @@ class InferenceEngine:
                                       self._dtype))
                     for b in self._bucket_sizes]
         return sorted(self._bucket_tag(k)
-                      for k, v in self._compiled.items() if v is not None)
+                      for (k, _tok), v in self._compiled.items()
+                      if v is not None)
 
     def stats(self) -> Dict[str, Any]:
         out = {

@@ -311,6 +311,14 @@ _C_EMB_CACHE_HITS = counter("embedding.cache_hits")
 _C_EMB_CACHE_MISSES = counter("embedding.cache_misses")
 _C_EMB_CACHE_EVICTS = counter("embedding.cache_evictions")
 _C_EMB_SPILLS = counter("embedding.rows_spilled")
+# mixed-precision health (mxnet_tpu/amp/ and the captured funnels write
+# these): steps whose fused all-finite predicate saw an inf/nan, updates
+# skipped in-graph because of it, and the live dynamic loss scale (the
+# captured funnels refresh the gauge one step late — the scaler state
+# stays on device and folds lazily, off the hot path)
+_C_AMP_OVERFLOWS = counter("amp.overflow_steps")
+_C_AMP_SKIPPED = counter("amp.skipped_updates")
+_G_AMP_SCALE = gauge("amp.loss_scale")
 _C_LIBSVM_DISCARDS = counter("io.libsvm.discarded_rows")
 
 
@@ -611,7 +619,7 @@ class _StepToken:
                  "krn_hits", "krn_misses", "krn_tune_ms", "krn_tune_runs",
                  "krn_fallbacks", "emb_pull", "emb_push", "emb_sbytes",
                  "emb_dbytes", "emb_hits", "emb_misses", "emb_evicts",
-                 "emb_spills", "buckets")
+                 "emb_spills", "amp_overflows", "amp_skipped", "buckets")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -647,6 +655,8 @@ class _StepToken:
         self.emb_misses = _C_EMB_CACHE_MISSES.value
         self.emb_evicts = _C_EMB_CACHE_EVICTS.value
         self.emb_spills = _C_EMB_SPILLS.value
+        self.amp_overflows = _C_AMP_OVERFLOWS.value
+        self.amp_skipped = _C_AMP_SKIPPED.value
         from . import tracing
         self.buckets = tracing.bucket_totals_ms()
 
@@ -826,6 +836,20 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "rows_spilled": _C_EMB_SPILLS.value - token.emb_spills,
         },
     }
+    # mixed-precision state for this step's window.  Only present while
+    # the AMP policy is active — an fp32 run's records are unchanged.
+    # loss_scale is the live gauge (the captured funnels fold the traced
+    # scaler state one step late, so overflow deltas can trail the step
+    # that overflowed by one record — never by more).
+    from .amp import policy as _amp_policy
+    if _amp_policy.enabled():
+        record["amp"] = {
+            "compute_dtype": _amp_policy.compute_dtype_str(),
+            "loss_scale": _G_AMP_SCALE.value,
+            "overflow_steps": _C_AMP_OVERFLOWS.value
+            - token.amp_overflows,
+            "skipped_updates": _C_AMP_SKIPPED.value - token.amp_skipped,
+        }
     # critical-path decomposition: where this step's wall time went,
     # from flight-recorder span-bucket deltas (all zeros when tracing is
     # off — the buckets only accumulate while spans are recorded), with

@@ -1,0 +1,536 @@
+"""Low-precision end-to-end (mxnet_tpu/amp/): the AMP execution policy
+traced INTO the captured hot paths.
+
+Covers the ISSUE 15 acceptance criteria: the policy resolves per-op
+compute dtypes from the casting lists and joins every executable cache
+key (so flipping AMP mints fresh executables instead of corrupting
+cached ones); the cached whole-step stays ONE dispatch per step with
+the dynamic loss scale and the all-finite predicate traced in-graph
+(an overflow skips the update and halves the scale WITHOUT recompiling);
+parameters stay fp32 masters; 10-step losses match fp32 within 1e-2;
+checkpoints are portable across AMP on/off and bf16/fp8; the dynamic
+loss-scale schedule resumes deterministically; the fused has_overflow
+runs one jitted reduction (legacy loop under MXNET_AMP_FUSED_OVERFLOW=0);
+the ZeRO wire carries compute-dtype gradient payloads; and the kernel
+registry keys autotune entries by the policy dtype.
+"""
+import importlib.util
+import pathlib
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, nd, profiler, telemetry
+from mxnet_tpu.amp import policy
+from mxnet_tpu.amp.loss_scaler import LossScaler, all_finite
+from mxnet_tpu.gluon import Trainer, loss as gloss, nn
+from mxnet_tpu.imperative import cached_step
+from mxnet_tpu.ndarray import NDArray
+from mxnet_tpu.parallel import SPMDTrainer, make_mesh
+
+_DISPATCH = telemetry.counter("dispatch.count")
+
+
+@pytest.fixture(autouse=True)
+def _amp_clean(monkeypatch):
+    """Every test starts and ends with the policy OFF (amp.init is a
+    process-global switch; leaking it poisons unrelated suites)."""
+    monkeypatch.delenv("MXNET_AMP", raising=False)
+    monkeypatch.delenv("MXNET_AMP_DTYPE", raising=False)
+    amp.reset()
+    yield
+    amp.reset()
+
+
+# -- policy unit surface ----------------------------------------------------
+
+def test_policy_canon_aliases_and_errors():
+    assert policy._canon("bf16") == "bfloat16"
+    assert policy._canon("BFLOAT16") == "bfloat16"
+    assert policy._canon("fp16") == "float16"
+    assert policy._canon("fp8") == "float8_e4m3fn"
+    assert policy._canon("e4m3") == "float8_e4m3fn"
+    with pytest.raises(ValueError):
+        policy._canon("int8")
+
+
+def test_policy_activation_env_and_cache_token(monkeypatch):
+    assert not policy.enabled()
+    assert policy.cache_token() is None          # off keeps keys stable
+    assert policy.compute_itemsize() == 4
+    monkeypatch.setenv("MXNET_AMP", "1")         # env var activates
+    assert policy.enabled()
+    assert policy.cache_token() == ("amp", "bfloat16")
+    assert policy.compute_itemsize() == 2
+    monkeypatch.setenv("MXNET_AMP_DTYPE", "fp8")
+    assert policy.cache_token() == ("amp", "float8_e4m3fn")
+    # fp8 is quantize-dequantize emulated: compute in bf16, 1B wire
+    assert str(policy.compute_dtype()) == "bfloat16"
+    assert policy.storage_dtype().itemsize == 1
+    assert policy.compute_itemsize() == 1
+    monkeypatch.delenv("MXNET_AMP")
+    monkeypatch.delenv("MXNET_AMP_DTYPE")
+    amp.init("bfloat16")                         # explicit init wins
+    assert policy.enabled() and policy.compute_dtype_str() == "bfloat16"
+    amp.reset()
+    assert not policy.enabled()
+
+
+def test_policy_categories():
+    assert policy.category("FullyConnected") == "target"
+    assert policy.category("dot") == "target"
+    assert policy.category("softmax") == "fp32"
+    assert policy.category("elemwise_add") == "widest"
+    assert policy.category("relu") is None
+
+
+def test_policy_wrap_casts():
+    import jax.numpy as jnp
+    amp.init("bfloat16")
+    seen = {}
+
+    def probe(*arrays):
+        seen["dtypes"] = [str(a.dtype) for a in arrays]
+        return arrays[0]
+
+    out = policy.wrap("dot", probe)(jnp.ones((2, 2), jnp.float32),
+                                    jnp.ones((2, 2), jnp.float32))
+    assert seen["dtypes"] == ["bfloat16", "bfloat16"]
+    assert str(out.dtype) == "bfloat16"
+    policy.wrap("softmax", probe)(jnp.ones((2,), jnp.bfloat16))
+    assert seen["dtypes"] == ["float32"]          # fp32 list casts UP
+    policy.wrap("elemwise_add", probe)(jnp.ones((2,), jnp.bfloat16),
+                                       jnp.ones((2,), jnp.float32))
+    assert seen["dtypes"] == ["float32", "float32"]   # widest wins
+    assert policy.wrap("relu", probe) is probe        # unlisted: untouched
+
+
+def test_policy_wrap_fp8_quantize_dequantize():
+    """fp8 policy: f32 inputs are QUANTIZED through e4m3 but the op
+    computes in bf16 (e4m3 does not implicitly promote against f32 —
+    raw fp8 arrays must never escape an op)."""
+    import jax.numpy as jnp
+    import ml_dtypes
+    amp.init("fp8")
+    seen = {}
+
+    def probe(*arrays):
+        seen["dtypes"] = [str(a.dtype) for a in arrays]
+        return arrays[0]
+
+    x = jnp.asarray(onp.array([1.0, 1.06, 240.0], onp.float32))
+    policy.wrap("dot", probe)(x)
+    assert seen["dtypes"] == ["bfloat16"]         # compute dtype, not e4m3
+    got = policy.wrap("dot", lambda a: a)(x)
+    want = x.astype(jnp.dtype(ml_dtypes.float8_e4m3fn)).astype(jnp.bfloat16)
+    onp.testing.assert_array_equal(onp.asarray(got, onp.float32),
+                                   onp.asarray(want, onp.float32))
+
+
+# -- loss scaler ------------------------------------------------------------
+
+def test_scaler_update_schedule_and_state_roundtrip():
+    s = LossScaler(init_scale=8.0, scale_factor=2.0, scale_window=2)
+    s.update_scale(False)
+    assert s.loss_scale == 8.0 and s._unskipped == 1
+    s.update_scale(False)                         # window hit: grow
+    assert s.loss_scale == 16.0 and s._unskipped == 0
+    s.update_scale(True)                          # overflow: halve
+    assert s.loss_scale == 8.0
+    s.loss_scale = 1.0
+    s.update_scale(True)                          # floored at 1.0
+    assert s.loss_scale == 1.0
+    blob = s.state()
+    assert blob == {"loss_scale": 1.0, "unskipped": 0,
+                    "scale_factor": 2.0, "scale_window": 2}
+    t = LossScaler()
+    t.load_state(blob)
+    assert t.loss_scale == 1.0 and t._scale_window == 2
+
+
+def test_scaler_adopt_traced_defers_and_counts():
+    import jax.numpy as jnp
+    s = LossScaler(init_scale=4.0)
+    ov0 = telemetry.counter("amp.overflow_steps").value
+    s.adopt_traced(jnp.float32(2.0), jnp.float32(0.0), jnp.bool_(True))
+    assert telemetry.counter("amp.overflow_steps").value == ov0  # lazy
+    assert s.loss_scale == 2.0                    # property folds
+    assert telemetry.counter("amp.overflow_steps").value == ov0 + 1
+    # a fused scan window folds a numeric skip COUNT, not a bool
+    s.adopt_traced(jnp.float32(1.0), jnp.float32(0.0), jnp.float32(3.0))
+    assert s.state()["loss_scale"] == 1.0
+    assert telemetry.counter("amp.overflow_steps").value == ov0 + 4
+
+
+class _FakeParam:
+    def __init__(self, g):
+        self._grad = nd.array(g) if g is not None else None
+
+
+def test_has_overflow_fused_and_legacy(monkeypatch):
+    clean = [_FakeParam(onp.ones((3,), "float32")), _FakeParam(None)]
+    bad = clean + [_FakeParam(onp.array([1.0, onp.inf], "float32"))]
+    nan = clean + [_FakeParam(onp.array([onp.nan], "float32"))]
+    s = LossScaler()
+    assert not s.has_overflow(clean)
+    assert s.has_overflow(bad)
+    assert s.has_overflow(nan)
+    monkeypatch.setenv("MXNET_AMP_FUSED_OVERFLOW", "0")   # legacy loop
+    assert not s.has_overflow(clean)
+    assert s.has_overflow(bad)
+    assert s.has_overflow(nan)
+    assert bool(all_finite([]))                   # empty pytree is finite
+
+
+# -- cached whole-step funnel ----------------------------------------------
+
+def _gluon_net(seed=0, units=8):
+    mx.random.seed(seed)
+    onp.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(units, in_units=units, activation="relu"))
+    net.add(nn.Dense(1, in_units=units))
+    net.initialize()
+    return net
+
+
+def _one_step(net, trainer, x):
+    d0 = _DISPATCH.value
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    trainer.step(batch_size=x.shape[0])
+    return _DISPATCH.value - d0, float(loss.asnumpy())
+
+
+def test_cached_step_amp_single_dispatch_and_fp32_masters():
+    """MXNET_AMP on the captured funnel: the policy casts are traced
+    into the step executable, so steady state is STILL one dispatch per
+    step — and storage never leaves fp32 (masters)."""
+    amp.init("bfloat16")
+    net = _gluon_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    x = nd.array(onp.random.RandomState(1).randn(8, 8).astype("float32"))
+    warm, _ = _one_step(net, tr, x)
+    assert warm > 1                               # eager observation
+    s0 = cached_step.stats()
+    d, _ = _one_step(net, tr, x)
+    assert d == 1                                 # capture compiles
+    assert cached_step.stats()["compiles"] == s0["compiles"] + 1
+    for _ in range(3):
+        assert _one_step(net, tr, x)[0] == 1      # steady state
+    for p in net.collect_params().values():
+        assert str(p.data().dtype) == "float32"
+    c = profiler.counters()["amp"]
+    assert c["enabled"] and c["compute_dtype"] == "bfloat16"
+
+
+def test_cached_step_overflow_skips_in_graph_without_recompile():
+    """An inf batch takes the lax.cond skip path INSIDE the same
+    executable: weights untouched, scale halved, overflow counters
+    ticked — compiles and dispatch count unchanged."""
+    amp.init("bfloat16")
+    net = _gluon_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    tr._amp_loss_scaler = LossScaler(init_scale=256.0, scale_window=50)
+    x = onp.random.RandomState(1).randn(8, 8).astype("float32")
+    _one_step(net, tr, nd.array(x))               # eager warm-up
+    _one_step(net, tr, nd.array(x))               # capture compiles
+    s0 = cached_step.stats()
+    ov0 = telemetry.counter("amp.overflow_steps").value
+    sk0 = telemetry.counter("amp.skipped_updates").value
+    w0 = [p._data_nd().asnumpy().copy()
+          for p in net.collect_params().values()]
+    bad = x.copy()
+    bad[0, 0] = onp.inf
+    d, _ = _one_step(net, tr, nd.array(bad))
+    assert d == 1                                 # same executable
+    assert cached_step.stats()["compiles"] == s0["compiles"]
+    assert tr._amp_loss_scaler.loss_scale == 128.0
+    for p, w in zip(net.collect_params().values(), w0):
+        onp.testing.assert_array_equal(p._data_nd().asnumpy(), w)
+    assert telemetry.counter("amp.overflow_steps").value == ov0 + 1
+    assert telemetry.counter("amp.skipped_updates").value == sk0 + 1
+    d, _ = _one_step(net, tr, nd.array(x))        # clean step resumes
+    assert d == 1
+    assert cached_step.stats()["compiles"] == s0["compiles"]
+    assert tr._amp_loss_scaler.loss_scale == 128.0
+    changed = any(
+        not onp.array_equal(p._data_nd().asnumpy(), w)
+        for p, w in zip(net.collect_params().values(), w0))
+    assert changed                                # update really applied
+
+
+def test_cached_step_scale_grows_in_graph():
+    """scale_window clean captured steps double the scale without a
+    recompile — the growth arithmetic is traced, the scale is data."""
+    amp.init("bfloat16")
+    net = _gluon_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.01},
+                 kvstore=None)
+    tr._amp_loss_scaler = LossScaler(init_scale=4.0, scale_window=2)
+    x = nd.array(onp.random.RandomState(1).randn(8, 8).astype("float32"))
+    _one_step(net, tr, x)                         # eager warm-up
+    s0 = cached_step.stats()
+    for _ in range(2):                            # window=2 clean steps
+        _one_step(net, tr, x)
+    assert tr._amp_loss_scaler.loss_scale == 8.0
+    for _ in range(2):
+        _one_step(net, tr, x)
+    assert tr._amp_loss_scaler.loss_scale == 16.0
+    assert cached_step.stats()["compiles"] == s0["compiles"] + 1
+
+
+def test_cached_step_amp_toggle_retires_stale_executable():
+    """Flipping the policy mid-stream changes the structure key (the
+    policy token rides the env numerics component), so the funnel
+    re-observes eagerly and compiles a FRESH executable under the new
+    numerics — the fp32 capture is never replayed with amp live."""
+    net = _gluon_net()
+    tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                 kvstore=None)
+    x = nd.array(onp.random.RandomState(1).randn(8, 8).astype("float32"))
+    _one_step(net, tr, x)
+    assert _one_step(net, tr, x)[0] == 1          # fp32 capture live
+    s0 = cached_step.stats()
+    amp.init("bfloat16")
+    assert _one_step(net, tr, x)[0] > 1           # eager re-observation
+    assert cached_step.stats()["captures"] == s0["captures"] + 1
+    assert _one_step(net, tr, x)[0] == 1          # fresh amp capture
+    assert cached_step.stats()["compiles"] == s0["compiles"] + 1
+    assert _one_step(net, tr, x)[0] == 1
+
+
+def test_amp_loss_parity_10_steps():
+    """10 training steps under bf16 AMP track the fp32 run within
+    rtol=1e-2 per step (momentum-SGD: the gate measures the traced
+    casts, not optimizer chaos amplification)."""
+
+    def run(use_amp):
+        if use_amp:
+            amp.init("bfloat16")
+        try:
+            net = _gluon_net(seed=3, units=16)
+            tr = Trainer(net.collect_params(), "sgd",
+                         {"learning_rate": 0.05, "momentum": 0.9},
+                         kvstore=None)
+            x = nd.array(onp.random.RandomState(2)
+                         .randn(8, 16).astype("float32"))
+            losses = [_one_step(net, tr, x)[1] for _ in range(10)]
+            dts = {str(p.data().dtype)
+                   for p in net.collect_params().values()}
+            return losses, dts
+        finally:
+            amp.reset()
+
+    ref, dt_ref = run(False)
+    got, dt_amp = run(True)
+    assert dt_ref == dt_amp == {"float32"}
+    for a, b in zip(got, ref):
+        assert abs(a - b) <= 1e-2 * max(abs(b), 1e-6), (a, b)
+
+
+def test_gluon_zero_wire_bytes_at_compute_itemsize(monkeypatch):
+    """ZeRO-1 eager fused path: the gradient is cast to the policy
+    storage dtype BEFORE the reduce-scatter, so the ring carries
+    exactly half the fp32 bytes under bf16."""
+    monkeypatch.setenv("MXNET_CACHED_STEP", "0")
+    ctr = telemetry.counter("comm.reduce_scatter.bytes")
+
+    def one_wire_delta():
+        net = _gluon_net(seed=5)
+        tr = Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1},
+                     kvstore=None, zero=True)
+        x = nd.array(onp.random.RandomState(1)
+                     .randn(8, 8).astype("float32"))
+        _one_step(net, tr, x)
+        b0 = ctr.value
+        _one_step(net, tr, x)
+        return ctr.value - b0
+
+    fp32 = one_wire_delta()
+    amp.init("bfloat16")
+    lowp = one_wire_delta()
+    # the per-device fraction makes the counter integer-truncate, so
+    # the halving is exact only up to rounding
+    assert fp32 > 0 and 0.45 * fp32 <= lowp <= 0.55 * fp32, (lowp, fp32)
+
+
+# -- SPMD funnel ------------------------------------------------------------
+
+def _spmd_trainer(seed=0, zero_stage=0, optimizer="sgd"):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(init=mx.initializer.Xavier())
+    net(NDArray(onp.zeros((2, 8), "float32")))
+    return SPMDTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                       optimizer=optimizer,
+                       optimizer_params={"learning_rate": 0.05,
+                                         "momentum": 0.9},
+                       mesh=make_mesh({"dp": 2}), zero_stage=zero_stage)
+
+
+def _spmd_batch(bs=8, seed=1):
+    rng = onp.random.RandomState(seed)
+    return (NDArray(rng.randn(bs, 8).astype("float32")),
+            NDArray(rng.randint(0, 4, (bs,)).astype("float32")))
+
+
+def test_spmd_amp_step_and_scan_skip_counts():
+    """The SPMD funnel threads the loss-scale state through the scan
+    carry: an inf batch inside a fused run_steps window skips exactly
+    its own update, halves the scale once, and the window still
+    launches as one program."""
+    amp.init("bfloat16")
+    tr = _spmd_trainer()
+    assert str(tr.amp_dtype) == "bfloat16"        # policy fallback
+    tr._amp_scaler = LossScaler(init_scale=64.0, scale_window=1000)
+    d, l = _spmd_batch()
+    loss = tr.step(d, l)
+    assert onp.isfinite(float(loss.asnumpy()))
+    assert tr._amp_scaler.loss_scale == 64.0
+    sk0 = telemetry.counter("amp.skipped_updates").value
+    dw = onp.stack([d.asnumpy()] * 4)             # 4-step window,
+    dw[2, 0, 0] = onp.inf                         # one poisoned batch
+    lw = onp.stack([l.asnumpy()] * 4)
+    losses = tr.run_steps(NDArray(dw), NDArray(lw), 4,
+                          per_step_data=True)
+    assert losses.shape == (4,)
+    assert tr._amp_scaler.loss_scale == 32.0      # halved exactly once
+    assert telemetry.counter("amp.skipped_updates").value == sk0 + 1
+    for k in tr._pkeys:
+        assert str(tr._params[k].data().dtype) == "float32"
+
+
+def test_spmd_checkpoint_portable_across_amp(tmp_path):
+    """AMP-on checkpoints hold fp32 masters: loading into an fp32
+    trainer restores weights BITWISE, and the reverse direction too."""
+    amp.init("bfloat16")
+    tr = _spmd_trainer(seed=4)
+    d, l = _spmd_batch()
+    for _ in range(3):
+        tr.step(d, l)
+    path_on = tmp_path / "amp_on"
+    tr.save_checkpoint(path_on)
+    ref = {k: tr._params[k].data().asnumpy().copy() for k in tr._pkeys}
+    amp.reset()
+
+    tr_off = _spmd_trainer(seed=9)                # fp32, different init
+    assert tr_off.load_checkpoint(path_on) is not None
+    for k in tr_off._pkeys:
+        onp.testing.assert_array_equal(
+            tr_off._params[k].data().asnumpy(), ref[k])
+    for _ in range(2):
+        tr_off.step(d, l)                         # keeps training fine
+    path_off = tmp_path / "amp_off"
+    tr_off.save_checkpoint(path_off)
+    ref_off = {k: tr_off._params[k].data().asnumpy().copy()
+               for k in tr_off._pkeys}
+
+    amp.init("bfloat16")                          # reverse direction
+    tr_on2 = _spmd_trainer(seed=11)
+    assert tr_on2.load_checkpoint(path_off) is not None
+    for k in tr_on2._pkeys:
+        onp.testing.assert_array_equal(
+            tr_on2._params[k].data().asnumpy(), ref_off[k])
+
+
+def test_spmd_checkpoint_bf16_to_fp8_and_scaler_resume(tmp_path):
+    """bf16-trained masters load under the fp8 policy unchanged (fp32
+    on disk either way), and the dynamic loss-scale schedule resumes
+    deterministically from the header."""
+    amp.init("bfloat16")
+    tr = _spmd_trainer(seed=6)
+    tr._amp_scaler = LossScaler(init_scale=64.0, scale_window=2)
+    d, l = _spmd_batch()
+    for _ in range(3):                            # grows once: 64 -> 128
+        tr.step(d, l)
+    want = tr._amp_scaler.state()
+    assert want["loss_scale"] == 128.0
+    tr.save_checkpoint(tmp_path)
+    ref = {k: tr._params[k].data().asnumpy().copy() for k in tr._pkeys}
+    amp.reset()
+
+    amp.init("fp8")
+    tr2 = _spmd_trainer(seed=13)
+    assert tr2.load_checkpoint(tmp_path) is not None
+    for k in tr2._pkeys:
+        onp.testing.assert_array_equal(
+            tr2._params[k].data().asnumpy(), ref[k])
+    got = tr2._amp_scaler.state()
+    assert got["loss_scale"] == want["loss_scale"]
+    assert got["unskipped"] == want["unskipped"]
+
+
+# -- kernel registry keys ---------------------------------------------------
+
+def test_kernel_cache_keys_carry_policy_dtype():
+    """Regression (ISSUE 15): an fp32 call site under AMP runs the
+    kernel on policy-cast operands, so the autotune cache key must name
+    the COMPUTE dtype — a bf16 run must never resolve an fp32 winner."""
+    from mxnet_tpu import kernels
+    assert policy.kernel_key_dtype("float32") == "float32"
+    for name, case in (("flash_attention",
+                        {"bh": 4, "sq": 128, "sk": 128, "d": 64,
+                         "causal": False}),
+                       ("layer_norm_residual", {"rows": 64, "f": 64})):
+        spec = kernels.get_kernel(name)
+        arrays, params = spec.make_args(case)
+        sig0, dt0 = spec.signature(*arrays, **params)
+        assert dt0 == "float32"
+        amp.init("bfloat16")
+        sig1, dt1 = spec.signature(*arrays, **params)
+        amp.reset()
+        assert sig1 == sig0                       # shape bucket unchanged
+        assert dt1 == "bfloat16"
+    amp.init("fp8")                               # fp8 computes in bf16
+    assert policy.kernel_key_dtype("float32") == "bfloat16"
+    amp.reset()
+    assert policy.kernel_key_dtype("float32") == "float32"
+    assert policy.kernel_key_dtype("bfloat16") == "bfloat16"
+
+
+# -- telemetry / report -----------------------------------------------------
+
+def test_telemetry_report_amp_section(tmp_path, monkeypatch):
+    """AMP step records carry the per-step amp payload; the report tool
+    summarizes the loss-scale trajectory and renders the Mixed
+    precision table (absent for fp32 runs)."""
+    path = str(tmp_path / "amp.jsonl")
+    amp.init("bfloat16")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    tr = _spmd_trainer(seed=8)
+    d, l = _spmd_batch()
+    for _ in range(3):
+        tr.step(d, l)
+    _ = tr._amp_scaler.loss_scale                 # fold the last step
+    tr.step(d, l)                                 # record sees the gauge
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()                           # detach + close sink
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report",
+        pathlib.Path(__file__).resolve().parents[1]
+        / "tools" / "telemetry_report.py")
+    report = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(report)
+    records = report.load(path)
+    am_records = [r for r in records if isinstance(r.get("amp"), dict)]
+    assert len(am_records) == 4
+    s = report.summarize(records)
+    am = s["amp"]
+    assert am["steps"] == 4
+    assert am["compute_dtype"] == "bfloat16"
+    assert am["overflow_steps"] == 0 and am["skipped_updates"] == 0
+    assert am["loss_scale_last"] == 1.0           # bf16 default scale
+    text = report.render(s)
+    assert "Mixed precision" in text
+    assert "compute dtype" in text
+    # fp32 records render no amp section
+    s2 = report.summarize([r for r in records if "amp" not in r]
+                          or [{"step": 0}])
+    assert s2["amp"] is None

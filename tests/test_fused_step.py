@@ -228,7 +228,7 @@ def test_profiler_counters_snapshot():
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
                       "serving", "input", "tracing", "checkpoint",
-                      "cluster", "kernel", "embedding"}
+                      "cluster", "kernel", "embedding", "amp"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
                                     "steps", "zero_steps"}
@@ -260,6 +260,8 @@ def test_profiler_counters_snapshot():
                                    "sparse_bytes", "dense_equiv_bytes",
                                    "cache_hits", "cache_misses",
                                    "cache_evictions", "rows_spilled"}
+    assert set(c["amp"]) == {"enabled", "compute_dtype", "loss_scale",
+                             "overflow_steps", "skipped_updates"}
     assert c["cluster"]["straggler_rank"] == -1   # no aggregator running
     # it's a snapshot: mutating it must not touch the live counters
     c["fused_step"]["steps"] += 100

@@ -272,6 +272,26 @@ def summarize(records):
                                    for c in em),
             "rows_spilled": sum(c.get("rows_spilled", 0) for c in em),
         }
+    # mixed precision (mxnet_tpu/amp/): per-step records carry an "amp"
+    # payload while the policy is active — compute dtype, the dynamic
+    # loss scale trajectory, and how many updates the in-graph overflow
+    # predicate skipped.  Section renders only for AMP runs.
+    am = [r["amp"] for r in records if isinstance(r.get("amp"), dict)]
+    amp = None
+    if am:
+        scales = [c.get("loss_scale") for c in am
+                  if c.get("loss_scale") is not None]
+        amp = {
+            "steps": len(am),
+            "compute_dtype": am[-1].get("compute_dtype"),
+            "loss_scale_last": scales[-1] if scales else None,
+            "loss_scale_min": min(scales) if scales else None,
+            "loss_scale_max": max(scales) if scales else None,
+            "overflow_steps": sum(c.get("overflow_steps", 0)
+                                  for c in am),
+            "skipped_updates": sum(c.get("skipped_updates", 0)
+                                   for c in am),
+        }
     srv = [r["serving"] for r in records
            if isinstance(r.get("serving"), dict) and "error" not in
            r["serving"]]
@@ -315,6 +335,7 @@ def summarize(records):
         "sharding": sharding,
         "kernel": kernel,
         "embedding": embedding,
+        "amp": amp,
     }
 
 
@@ -536,6 +557,23 @@ def render(s):
             f"{'cache hit rate %':<28}{hit_rate:>24}",
             f"{'cache evictions':<28}{em['cache_evictions']:>24}",
             f"{'rows spilled to host':<28}{em['rows_spilled']:>24}",
+        ]
+    am = s.get("amp")
+    if am:
+        scale_rng = (f"{am['loss_scale_min']:g}..{am['loss_scale_max']:g}"
+                     if am["loss_scale_min"] is not None else "n/a")
+        scale_last = (f"{am['loss_scale_last']:g}"
+                      if am["loss_scale_last"] is not None else "n/a")
+        lines += [
+            "",
+            "Mixed precision",
+            "-" * 52,
+            f"{'compute dtype':<28}{str(am['compute_dtype']):>24}",
+            f"{'amp steps':<28}{am['steps']:>24}",
+            f"{'loss scale (last)':<28}{scale_last:>24}",
+            f"{'loss scale range':<28}{scale_rng:>24}",
+            f"{'overflow steps':<28}{am['overflow_steps']:>24}",
+            f"{'skipped updates':<28}{am['skipped_updates']:>24}",
         ]
     srv = s.get("serving")
     if srv:
