@@ -604,6 +604,130 @@ PY
     rm -rf "$tmp"
 }
 
+serving_slo_smoke() { # SLO burn-rate alerting on the live serving path
+    # tier-1 covers the unit matrix: burn math, saturation attribution,
+    # hysteresis, advice plumbing, /slo + /requestz on both surfaces,
+    # the deadline-expiry fixes, the offline report
+    JAX_PLATFORMS=cpu python -m pytest tests/test_serving_slo.py -q
+    local tmp; tmp="$(mktemp -d)"
+    # open-loop Poisson traffic against a threaded ServingServer with
+    # env-declared objectives (p95 <= 20 ms over a 1.5 s window).  An
+    # injected 50 ms dispatch stall must open EXACTLY ONE latency_slo
+    # incident (compute-dominant saturation — the stall sits in the
+    # engine, not the queue), visible in /slo, /incidents and parsed
+    # /metrics over HTTP, then close after the stall lifts; the spool
+    # the run leaves behind must replay to the same verdict offline.
+    JAX_PLATFORMS=cpu MXNET_CLUSTER_DIR="$tmp/spool" \
+        MXNET_SLO_LATENCY_MS=20 MXNET_SLO_WINDOW_S=1.5 \
+        python - <<'PY'
+import json, time, urllib.request
+import numpy as onp
+import mxnet_tpu as mx
+from mxnet_tpu import clustermon, telemetry
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import ServingServer, slo
+
+UNITS = 16
+telemetry.enabled()               # attach the spool sink up front
+agg = clustermon.aggregator()
+if agg is not None:
+    agg.stop()                    # serving only: no training poller
+
+kinds = []
+clustermon.on_incident(lambda ev, inc: kinds.append((ev, inc["cause"])))
+
+mx.random.seed(7)
+net = nn.Sequential()
+net.add(nn.Dense(8, in_units=UNITS, activation="relu"))
+net.add(nn.Dense(4, in_units=8))
+net.initialize()
+srv = ServingServer(net, engine_args={"example_shape": (UNITS,),
+                                      "dtype": "float32"},
+                    batcher_args={"max_delay_ms": 0.0})
+srv.warmup([1, 2, 4, 8])
+host, port = srv.start_http()
+base = f"http://{host}:{port}"
+rng = onp.random.RandomState(0)
+
+
+def drive(seconds, mean_gap_s):
+    """Open-loop Poisson arrivals: submit on the schedule regardless of
+    completions; returns the submitted futures."""
+    futs, t_end = [], time.perf_counter() + seconds
+    while time.perf_counter() < t_end:
+        futs.append(srv.batcher.submit(
+            rng.randn(UNITS).astype("float32")))
+        time.sleep(rng.exponential(mean_gap_s))
+    return futs
+
+# phase A: healthy traffic — objectives declared from env, no burn
+drive(0.4, 0.025)
+v = srv.sloz()
+assert v["declared"] is True, v
+assert v["burning"] is None, v
+assert slo.declared() and slo.get().from_env
+
+# phase B: inject a 50 ms stall into every dispatch (engine-side, so
+# saturation attribution must blame compute, not the queue)
+real_infer = srv.engine.infer_batch
+def stalled_infer(examples):
+    time.sleep(0.05)
+    return real_infer(examples)
+srv.engine.infer_batch = stalled_infer
+drive(1.8, 0.08)
+v = srv.sloz()
+assert v["burning"] is not None, v
+assert v["burning"]["cause"] == "latency_slo", v["burning"]
+sat = v["saturation"]
+assert sat["compute"] == max(sat.values()), sat
+iv = clustermon.incident_view()
+assert len(iv["open"]) == 1 and iv["open"][0]["cause"] == "latency_slo", iv
+assert telemetry.counter(
+    "cluster.incidents_total.latency_slo").value == 1
+h = srv.healthz()
+assert h["ready"] is False and h["slo_burning"] == "latency_slo", h
+with urllib.request.urlopen(f"{base}/slo", timeout=10) as resp:
+    v_http = json.loads(resp.read())
+assert v_http["burning"]["cause"] == "latency_slo", v_http
+with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+    fam = clustermon.parse_prometheus_text(resp.read().decode())
+assert fam["mxnet_serving_slo_burning"][0][1] == 1.0, fam
+inc_fam = {l["cause"]: x for l, x in fam["mxnet_cluster_incidents_total"]}
+assert inc_fam["latency_slo"] == 1, inc_fam
+with urllib.request.urlopen(f"{base}/requestz?limit=5",
+                            timeout=10) as resp:
+    rz = json.loads(resp.read())
+assert rz["slowest"] and rz["slowest"][0]["latency_ms"] > 20, rz
+
+# phase C: lift the stall — the incident must close (and never reopen)
+srv.engine.infer_batch = real_infer
+t_end = time.perf_counter() + 6.0
+while time.perf_counter() < t_end:
+    drive(0.3, 0.02)
+    if srv.sloz()["burning"] is None:
+        break
+v = srv.sloz()
+assert v["burning"] is None, v
+iv = clustermon.incident_view()
+assert not iv["open"], iv
+assert iv["counts"] == {"latency_slo": 1}, iv
+assert telemetry.counter("serving_slo.incidents").value == 1
+assert [k for k in kinds if k[0] == "open"] == [("open", "latency_slo")]
+assert kinds[-1] == ("close", "latency_slo"), kinds
+srv.stop()
+print(f"serving_slo_smoke: 1 latency_slo incident "
+      f"opened/escalated/closed; peak burn "
+      f"{iv['recent'][0]['peak_ratio']}x; /slo + /metrics + /incidents "
+      f"consistent over HTTP")
+PY
+    # offline: the spool must replay to the same verdict
+    JAX_PLATFORMS=cpu python tools/slo_report.py "$tmp/spool" \
+        --latency-ms 20 --window-s 1.5 | tee "$tmp/slo_report.txt"
+    grep -q "VERDICT: burning:latency_slo" "$tmp/slo_report.txt"
+    grep -q "burn episodes (" "$tmp/slo_report.txt"
+    rm -rf "$tmp"
+}
+
 zero_smoke() {        # ZeRO-1 sharded update: tests + memory/time gates
     # tier-1 covers dp=2 equivalence, env gating, checkpoint resharding
     # across dp=1/2/4, eager bitwise parity and the 1-dispatch cached

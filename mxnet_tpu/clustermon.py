@@ -80,9 +80,11 @@ from . import telemetry
 __all__ = ["rank_world", "set_thread_rank", "note_rank", "SpoolSink",
            "ClusterAggregator", "aggregator", "cluster_view",
            "join_by_step", "window_stats", "detect_straggler",
-           "record_signals", "CAUSES",
+           "record_signals", "CAUSES", "SERVING_CAUSES",
            "IncidentStore", "incident_view", "on_incident",
-           "remove_incident_hook", "rank_health",
+           "remove_incident_hook", "incident_hooks",
+           "register_incident_store", "unregister_incident_store",
+           "rank_health",
            "prometheus_text", "parse_prometheus_text",
            "start_metrics_server", "stop_metrics_server",
            "metrics_server_address"]
@@ -121,7 +123,8 @@ _INCIDENTS_FAMILY = "cluster.incidents_total."
 _C_INCIDENT_CAUSE = {
     c: telemetry.counter(_INCIDENTS_FAMILY + c)
     for c in ("input_bound", "compile_stall", "ckpt_interference",
-              "comm_skew", "unknown")}
+              "comm_skew", "latency_slo", "error_budget",
+              "queue_saturation", "unknown")}
 
 # string-gauge values ever rendered, per metric — the stale-series fix:
 # a scrape emits the CURRENT value at 1 and every previously-seen value
@@ -474,6 +477,10 @@ class SpoolSink:
 CAUSES = ("input_bound", "compile_stall", "ckpt_interference",
           "comm_skew")
 
+# serving-side incident causes (serving/slo.py burn-rate alerting);
+# same IncidentStore state machine and incidents_total counter family
+SERVING_CAUSES = ("latency_slo", "error_budget", "queue_saturation")
+
 _SIG_OF_CAUSE = {"input_bound": "input", "compile_stall": "compile",
                  "ckpt_interference": "checkpoint", "comm_skew": "comm"}
 _CAUSE_OF_SIG = {v: k for k, v in _SIG_OF_CAUSE.items()}
@@ -724,6 +731,33 @@ def remove_incident_hook(fn) -> None:
     with _LOCK:
         if fn in _HOOKS:
             _HOOKS.remove(fn)
+
+
+def incident_hooks() -> List[Any]:
+    """The registered on_incident hooks (a copy) — so out-of-aggregator
+    incident producers (serving/slo.py) fire the same hook plane."""
+    with _LOCK:
+        return list(_HOOKS)
+
+
+# extra incident stores merged into incident_view(): anything with a
+# ``snapshot(limit)`` returning the IncidentStore shape (open / recent /
+# counts).  serving/slo.py registers its engine here so GET /incidents
+# shows serving incidents beside straggler incidents.
+_EXTRA_STORES: List[Any] = []
+
+
+def register_incident_store(store) -> Any:
+    with _LOCK:
+        if store not in _EXTRA_STORES:
+            _EXTRA_STORES.append(store)
+    return store
+
+
+def unregister_incident_store(store) -> None:
+    with _LOCK:
+        if store in _EXTRA_STORES:
+            _EXTRA_STORES.remove(store)
 
 
 # -- the rank-0 aggregator ---------------------------------------------------
@@ -1092,13 +1126,33 @@ def rank_health() -> Dict[int, dict]:
 
 def incident_view(limit: int = 50) -> dict:
     """Open + recent closed incidents and per-cause counts — the JSON
-    body ``GET /incidents`` serves on both scrape surfaces.  Empty
-    shape when no aggregator runs in this process."""
+    body ``GET /incidents`` serves on both scrape surfaces, merging the
+    rank-0 aggregator's straggler store with any registered extra
+    stores (serving SLO incidents).  Empty shape when neither runs in
+    this process."""
     agg = _aggregator
     if agg is None:
-        return {"open": [], "recent": [], "counts": {}}
-    with agg._lock:
-        return agg.incidents.snapshot(limit)
+        view = {"open": [], "recent": [], "counts": {}}
+    else:
+        with agg._lock:
+            view = agg.incidents.snapshot(limit)
+    with _LOCK:
+        extras = list(_EXTRA_STORES)
+    for store in extras:
+        try:
+            snap = store.snapshot(limit)
+        except Exception:
+            continue
+        view["open"].extend(snap.get("open", ()))
+        view["recent"].extend(snap.get("recent", ()))
+        for cause, n in (snap.get("counts") or {}).items():
+            view["counts"][cause] = view["counts"].get(cause, 0) + n
+    if len(view["recent"]) > limit:
+        view["recent"] = sorted(
+            view["recent"],
+            key=lambda i: i.get("end_ts") or i.get("start_ts") or 0
+        )[-limit:]
+    return view
 
 
 def _on_cluster_dir(directory: Optional[str]) -> None:
@@ -1283,11 +1337,12 @@ _metrics_addr: Optional[Tuple[str, int]] = None
 def start_metrics_server(port: int = 0,
                          host: str = "0.0.0.0") -> Tuple[str, int]:
     """Serve ``GET /metrics`` (text exposition), ``GET /incidents``
-    (incident history JSON) + ``GET /healthz`` on a daemon thread — the
-    scrape surface for training processes, which have no serving
-    server.  Returns the bound ``(host, port)``
-    (OS-assigned when ``port=0``).  Idempotent: an exporter already
-    running keeps its socket."""
+    (incident history JSON), ``GET /slo`` + ``GET /requestz`` (serving
+    SLO view and slowest-request ring, when the serving subsystem is in
+    this process) + ``GET /healthz`` on a daemon thread — the scrape
+    surface for training processes, which have no serving server.
+    Returns the bound ``(host, port)`` (OS-assigned when ``port=0``).
+    Idempotent: an exporter already running keeps its socket."""
     global _metrics_httpd, _metrics_thread, _metrics_addr
     with _LOCK:
         if _metrics_httpd is not None:
@@ -1306,6 +1361,22 @@ def start_metrics_server(port: int = 0,
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif route == "/incidents":
                     body = json.dumps(incident_view()).encode()
+                    ctype = "application/json"
+                elif route == "/slo":
+                    from .serving import slo as _slo
+                    body = json.dumps(_slo.slo_view()).encode()
+                    ctype = "application/json"
+                elif route == "/requestz":
+                    from .serving import slo as _slo
+                    limit = None
+                    if "?" in self.path:
+                        from urllib.parse import parse_qs
+                        q = parse_qs(self.path.split("?", 1)[1])
+                        try:
+                            limit = int(q.get("limit", [None])[0])
+                        except (TypeError, ValueError):
+                            pass
+                    body = json.dumps(_slo.requestz(limit)).encode()
                     ctype = "application/json"
                 elif self.path == "/healthz":
                     view = cluster_view()

@@ -83,6 +83,20 @@ def reset_stats() -> None:
     telemetry.reset("op.")
 
 
+def _slo_declared() -> bool:
+    """Whether serving SLO objectives are declared — read through
+    sys.modules so a process that never imported the serving subsystem
+    doesn't pull it in just to report False."""
+    import sys
+    m = sys.modules.get("mxnet_tpu.serving.slo")
+    if m is None:
+        return False
+    try:
+        return bool(m.declared())
+    except Exception:
+        return False
+
+
 def counters() -> Dict[str, Dict[str, int]]:
     """Process-wide dispatch/jit-cache counter snapshot:
 
@@ -102,7 +116,10 @@ def counters() -> Dict[str, Dict[str, int]]:
     - ``comm``: collective payload bytes (dense + sparse kvstore paths)
     - ``serving``: the inference subsystem (requests/batches served,
       eager fallback batches, bucket compiles, shed/expired requests —
-      mxnet_tpu/serving/)
+      mxnet_tpu/serving/), plus the ``slo`` burn-rate engine's
+      activity (whether objectives are declared, evaluation passes,
+      sampled requests, latency-target breaches, errored requests,
+      SLO incidents opened — serving/slo.py)
     - ``input``: the device-feed pipeline (consumer blocked-on-input
       wall ms, host→device payload bytes, inline step-path transfers —
       data/device_pipeline.py; ``step_h2d`` staying flat across steps
@@ -159,7 +176,20 @@ def counters() -> Dict[str, Dict[str, int]]:
                 "rejects":
                     telemetry.counter("serving.rejected.queue_full").value
                     + telemetry.counter("serving.rejected.shape").value,
-                "timeouts": telemetry.counter("serving.timeouts").value},
+                "timeouts": telemetry.counter("serving.timeouts").value,
+                "slo": {
+                    "declared": _slo_declared(),
+                    "evals":
+                        telemetry.counter("serving_slo.evals").value,
+                    "samples":
+                        telemetry.counter("serving_slo.requests").value,
+                    "breaches":
+                        telemetry.counter("serving_slo.breaches").value,
+                    "errors":
+                        telemetry.counter("serving_slo.errors").value,
+                    "incidents":
+                        telemetry.counter(
+                            "serving_slo.incidents").value}},
             "input": {
                 "wait_ms": telemetry.counter("input.wait_ms").value,
                 "h2d_bytes": telemetry.counter("input.h2d_bytes").value,
@@ -204,7 +234,9 @@ def counters() -> Dict[str, Dict[str, int]]:
                 "incidents_total": {
                     c: telemetry.counter(
                         "cluster.incidents_total." + c).value
-                    for c in _clustermon.CAUSES + ("unknown",)},
+                    for c in (_clustermon.CAUSES
+                              + _clustermon.SERVING_CAUSES
+                              + ("unknown",))},
                 "live_ranks":
                     telemetry.gauge("cluster.live_ranks").value or 0,
                 "joined_steps":

@@ -4,9 +4,13 @@ queue → :class:`DynamicBatcher` → shape-bucketed
 :class:`InferenceEngine` (AOT-compiled executable per bucket) →
 per-request futures; :class:`ServingServer` fronts the pair with an
 in-process ``predict()`` API and an optional stdlib HTTP JSON endpoint.
-See docs/ARCHITECTURE.md (Serving) for the dataflow and the
+The ``slo`` submodule adds the SLO plane on top: request identity,
+sliding-window burn-rate objectives, saturation-attributed clustermon
+incidents, and the ``/slo`` + ``/requestz`` views.  See
+docs/ARCHITECTURE.md (Serving, Serving SLOs) for the dataflow and the
 admission/reject/timeout contract.
 """
+from . import slo
 from .engine import (InferenceEngine, BadRequestError, QueueFullError,
                      RequestTimeoutError, ServingClosedError,
                      serving_enabled)
@@ -15,4 +19,4 @@ from .server import ServingServer
 
 __all__ = ["InferenceEngine", "DynamicBatcher", "ServingServer",
            "BadRequestError", "QueueFullError", "RequestTimeoutError",
-           "ServingClosedError", "serving_enabled"]
+           "ServingClosedError", "serving_enabled", "slo"]

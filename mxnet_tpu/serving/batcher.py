@@ -23,6 +23,16 @@ futures.  Every dispatch emits a telemetry step record (source
 ``serving.DynamicBatcher``) carrying batch occupancy, padding waste and
 per-request latency, reconciled by ``tools/telemetry_report.py``.
 
+Every admitted request also carries a monotonic request id (slo.py):
+stamped into its ``serving.enqueue`` span, its cross-thread
+``serving.request`` lifecycle span (begun at admission, ended at
+dispatch/expiry with the validate / queue-wait / hold / dispatch /
+pad-share decomposition), and the ``request_ids`` list on the
+``serving.coalesce`` / ``serving.dispatch`` spans and the step record —
+so one slow request is joinable across every serving layer.  When SLO
+objectives are declared (``slo.declare()`` / ``MXNET_SLO_LATENCY_MS``)
+each finished request feeds the burn-rate evaluator inline.
+
 Tests drive the batcher deterministically with ``start=False`` +
 ``flush()`` (no thread, no sleeps); the server runs it threaded.
 """
@@ -36,6 +46,7 @@ from typing import Any, Dict, List, Optional
 from .. import telemetry
 from .. import tracing
 from ..base import getenv_int
+from . import slo
 from .engine import (InferenceEngine, QueueFullError, RequestTimeoutError,
                      ServingClosedError)
 
@@ -85,14 +96,19 @@ class _Future:
 
 
 class _Pending:
-    __slots__ = ("example", "future", "deadline", "t_submit", "group")
+    __slots__ = ("example", "future", "deadline", "t_submit", "group",
+                 "rid", "validate_ms", "t_taken", "span")
 
-    def __init__(self, example, group, deadline):
+    def __init__(self, example, group, deadline, rid, validate_ms):
         self.example = example
         self.future = _Future()
         self.deadline = deadline
         self.t_submit = time.perf_counter()
         self.group = group
+        self.rid = rid
+        self.validate_ms = validate_ms
+        self.t_taken = None      # stamped when popped into a batch
+        self.span = None         # open serving.request span (tracing on)
 
 
 class DynamicBatcher:
@@ -143,6 +159,7 @@ class DynamicBatcher:
             + telemetry.counter("serving.rejected.shape").value,
             "timeouts": telemetry.counter("serving.timeouts").value,
         }
+        slo.note_batcher(self)   # queue_saturation remediation target
         if start:
             self.start()
 
@@ -165,7 +182,9 @@ class DynamicBatcher:
             self._closed = True
             if not drain:
                 while self._q:
-                    self._q.popleft().future.set_exception(
+                    p = self._q.popleft()
+                    tracing.end(p.span, error="ServingClosedError")
+                    p.future.set_exception(
                         ServingClosedError("server shut down before "
                                            "this request was dispatched"))
             self._gauge.set(len(self._q))
@@ -197,41 +216,74 @@ class DynamicBatcher:
         example = self.engine.validate(x)
         example, _ = self.engine.pad_example(example)
         group = self.engine.group_key(example)
+        validate_ms = round((time.perf_counter() - _t0) * 1e3, 3)
         ms = timeout_ms if timeout_ms is not None else self.timeout_ms
         deadline = (time.perf_counter() + ms / 1e3
                     if ms is not None else None)
+        rid = slo.next_request_id()
         with self._cv:
+            # expire overdue neighbours on the submitter's clock too —
+            # a deadline that lapsed behind a long dispatch shouldn't
+            # wait for the dispatcher to wake up to resolve
+            self._expire(time.perf_counter())
             if self._closed:
                 raise ServingClosedError("server is draining/closed")
             if len(self._q) >= self.queue_depth:
                 telemetry.counter("serving.rejected.queue_full").inc()
                 raise QueueFullError(
                     f"queue at depth {self.queue_depth}; load shed")
-            p = _Pending(example, group, deadline)
+            p = _Pending(example, group, deadline, rid, validate_ms)
+            # cross-thread request lifecycle span: begun here (after
+            # admission — rejects never open one), ended at dispatch or
+            # expiry with the full latency decomposition
+            p.span = tracing.begin("serving.request", request_id=rid)
             self._q.append(p)
             depth = len(self._q)
             self._gauge.set(depth)
             self._cv.notify()
         tracing.record_span("serving.enqueue", _t0, time.perf_counter(),
-                            queue_depth=depth)
+                            queue_depth=depth, request_id=rid)
         return p.future
 
     # -- dispatch -----------------------------------------------------------
 
+    def _fail_expired(self, pend, now: float) -> list:
+        """Fail every request in ``pend`` whose deadline passed and
+        return the survivors (order preserved)."""
+        live = []
+        for p in pend:
+            if p.deadline is not None and now > p.deadline:
+                telemetry.counter("serving.timeouts").inc()
+                tracing.end(p.span, error="RequestTimeoutError")
+                if slo.active():
+                    lat = round((now - p.t_submit) * 1e3, 3)
+                    slo.observe_request({
+                        "id": p.rid, "ok": False,
+                        "error": "RequestTimeoutError",
+                        "latency_ms": lat, "queue_ms": lat,
+                        "validate_ms": p.validate_ms,
+                        "ts": round(time.time(), 3)})
+                p.future.set_exception(RequestTimeoutError(
+                    "request expired in queue before dispatch"))
+            else:
+                live.append(p)
+        return live
+
     def _expire(self, now: float) -> None:
         """Expire queued requests whose deadline passed (caller holds
         the lock)."""
-        live = [p for p in self._q
-                if not (p.deadline is not None and now > p.deadline)]
+        live = self._fail_expired(self._q, now)
         if len(live) != len(self._q):
-            for p in self._q:
-                if p.deadline is not None and now > p.deadline:
-                    telemetry.counter("serving.timeouts").inc()
-                    p.future.set_exception(RequestTimeoutError(
-                        "request expired in queue before dispatch"))
             self._q.clear()
             self._q.extend(live)
             self._gauge.set(len(self._q))
+
+    def _nearest_deadline(self, batch) -> Optional[float]:
+        """Earliest deadline across a held batch and the queue (caller
+        holds the lock) — bounds hold-loop waits so expiry is prompt."""
+        dl = [p.deadline for p in batch if p.deadline is not None]
+        dl += [p.deadline for p in self._q if p.deadline is not None]
+        return min(dl) if dl else None
 
     def _take_group(self) -> List[_Pending]:
         """Pop up to ``max_batch_size`` requests sharing the head
@@ -245,41 +297,66 @@ class DynamicBatcher:
         while self._q:
             p = self._q.popleft()
             if p.group == head and len(batch) < self.max_batch_size:
+                p.t_taken = time.perf_counter()
                 batch.append(p)
             else:
                 keep.append(p)
         self._q.extend(keep)
         self._gauge.set(len(self._q))
         tracing.record_span("serving.coalesce", _t0, time.perf_counter(),
-                            batch_size=len(batch))
+                            batch_size=len(batch),
+                            request_ids=[p.rid for p in batch])
         return batch
 
     def _loop(self):
         while True:
             with self._cv:
                 while not self._q and not self._closed:
+                    # expire on every idle wakeup too: with an empty
+                    # queue nothing can lapse, but a request admitted
+                    # and lapsed between wakeups must not wait for the
+                    # next coalesce to resolve
                     self._cv.wait(0.1)
+                    self._expire(time.perf_counter())
                 if not self._q and self._closed:
                     return
                 batch = self._take_group()
                 if batch and len(batch) < self.max_batch_size \
                         and self.max_delay_ms > 0 and not self._closed:
-                    # hold the batch open for stragglers
+                    # hold the batch open for stragglers — but keep
+                    # expiring: a deadline that lapses inside the hold
+                    # window (queued OR already held) resolves now, not
+                    # after the window closes
                     t_end = time.perf_counter() + self.max_delay_ms / 1e3
                     while len(batch) < self.max_batch_size:
-                        left = t_end - time.perf_counter()
+                        now = time.perf_counter()
+                        self._expire(now)
+                        batch = self._fail_expired(batch, now)
+                        if not batch:
+                            break
+                        left = t_end - now
                         if left <= 0:
                             break
+                        dl = self._nearest_deadline(batch)
+                        if dl is not None:
+                            left = min(left, max(dl - now, 1e-4))
                         self._cv.wait(left)
                         head = batch[0].group
                         keep = deque()
                         while self._q and len(batch) < self.max_batch_size:
                             p = self._q.popleft()
-                            (batch if p.group == head else keep).append(p)
+                            if p.group == head:
+                                p.t_taken = time.perf_counter()
+                                batch.append(p)
+                            else:
+                                keep.append(p)
                         self._q.extend(keep)
                         self._gauge.set(len(self._q))
                         if self._closed:
                             break
+                    now = time.perf_counter()
+                    batch = self._fail_expired(batch, now)
+                    self._expire(now)
             if batch:
                 self._dispatch(batch)
 
@@ -296,7 +373,9 @@ class DynamicBatcher:
     def _dispatch(self, batch: List[_Pending]) -> None:
         token = telemetry.begin_step()
         t_dispatch = time.perf_counter()
-        _sp = tracing.span("serving.dispatch", batch_size=len(batch))
+        rids = [p.rid for p in batch]
+        _sp = tracing.span("serving.dispatch", batch_size=len(batch),
+                           request_ids=rids)
         try:
             with _sp:
                 results, meta = self.engine.infer_batch(
@@ -304,25 +383,64 @@ class DynamicBatcher:
                 _sp.annotate(padded=meta["padded"], bucket=meta["bucket"],
                              compiled=meta["compiled"])
         except Exception as e:   # a failed dispatch fails ITS batch only
+            now = time.perf_counter()
+            slo_on = slo.active()
             for p in batch:
+                tracing.end(p.span, error=type(e).__name__)
+                if slo_on:
+                    lat = round((now - p.t_submit) * 1e3, 3)
+                    slo.observe_request({
+                        "id": p.rid, "ok": False,
+                        "error": type(e).__name__, "latency_ms": lat,
+                        "queue_ms": round(
+                            ((p.t_taken or t_dispatch) - p.t_submit)
+                            * 1e3, 3),
+                        "dispatch_ms": round((now - t_dispatch) * 1e3, 3),
+                        "validate_ms": p.validate_ms,
+                        "batch_size": len(batch),
+                        "ts": round(time.time(), 3)})
                 p.future.set_exception(e)
             telemetry.counter("serving.failed_batches").inc()
             telemetry.end_step(token, "serving.DynamicBatcher",
                                extra={"serving": {"error": str(e),
-                                                  "batch_size": len(batch)}})
+                                                  "batch_size": len(batch),
+                                                  "request_ids": rids}})
             return
         now = time.perf_counter()
+        dispatch_ms = round((now - t_dispatch) * 1e3, 3)
+        pad_share = (round(1 - len(batch) / meta["padded"], 4)
+                     if meta["padded"] else 0.0)
+        compile_ms = float(meta.get("compile_ms") or 0.0)
+        slo_on = slo.active()
         latencies = []
+        ts_wall = round(time.time(), 3)
         for p, r in zip(batch, results):
             p.future.set_result(r)
-            latencies.append(round((now - p.t_submit) * 1e3, 3))
-            # enqueue→reply lifecycle span, one per request: queue wait
-            # (submit→dispatch start) rides as an attribute so /tracez
-            # and the report tool can separate waiting from compute
-            tracing.record_span(
-                "serving.request", p.t_submit, now,
-                queue_wait_ms=round((t_dispatch - p.t_submit) * 1e3, 3),
-                batch_size=len(batch))
+            lat = round((now - p.t_submit) * 1e3, 3)
+            latencies.append(lat)
+            # per-request latency decomposition: queue wait
+            # (submit→taken), hold window (taken→dispatch start),
+            # dispatch, validate, pad-waste share — the enqueue→reply
+            # lifecycle span carries it so /tracez, /requestz and the
+            # report tool can separate waiting from compute
+            t_taken = p.t_taken if p.t_taken is not None else t_dispatch
+            queue_ms = round((t_taken - p.t_submit) * 1e3, 3)
+            hold_ms = round(max(0.0, t_dispatch - t_taken) * 1e3, 3)
+            tracing.end(p.span,
+                        queue_wait_ms=round(
+                            (t_dispatch - p.t_submit) * 1e3, 3),
+                        hold_ms=hold_ms, dispatch_ms=dispatch_ms,
+                        validate_ms=p.validate_ms, pad_share=pad_share,
+                        batch_size=len(batch))
+            if slo_on:
+                slo.observe_request({
+                    "id": p.rid, "ok": True, "latency_ms": lat,
+                    "validate_ms": p.validate_ms, "queue_ms": queue_ms,
+                    "hold_ms": hold_ms, "dispatch_ms": dispatch_ms,
+                    "pad_share": pad_share,
+                    "compile_ms": round(compile_ms / len(batch), 3),
+                    "bucket": meta["bucket"], "batch_size": len(batch),
+                    "ts": ts_wall})
         telemetry.record_serving_batch(len(batch), meta["padded"],
                                        latencies,
                                        eager=not meta["compiled"])
@@ -334,10 +452,10 @@ class DynamicBatcher:
             "padded_batch": meta["padded"],
             "bucket": meta["bucket"],
             "compiled": meta["compiled"],
-            "padding_waste": round(1 - len(batch) / meta["padded"], 4)
-            if meta["padded"] else 0.0,
+            "padding_waste": pad_share,
             "queue_depth": self.pending(),
             "request_ms": latencies,
+            "request_ids": rids,
             "rejects": rejects - self._emitted["rejects"],
             "timeouts": timeouts - self._emitted["timeouts"],
         }}

@@ -22,6 +22,12 @@ path with no sockets.  The HTTP endpoint is a thin stdlib
 - ``GET /incidents`` → clustermon incident history (open + recent
   closed straggler incidents with per-cause counts, JSON; empty shape
   when no aggregator runs in this process).
+- ``GET /slo`` → the serving SLO view (slo.py): declared objectives,
+  sliding-window latency percentiles, multi-window burn rates,
+  saturation attribution, burning incident if any.
+- ``GET /requestz`` → the bounded ring of the N slowest requests
+  served, each with its request id and latency decomposition
+  (``?limit=`` caps the list).
 
 Error mapping: admission shape reject → 400, queue full (load shed) →
 429, request deadline → 504, draining/closed → 503.  ``stop()`` is
@@ -78,14 +84,36 @@ class ServingServer:
         return self.engine.warmup(specs)
 
     def healthz(self) -> dict:
-        return {
+        """Liveness + readiness: beyond drain state, load balancers get
+        warmed-bucket count, queue saturation (depth / capacity) and
+        open serving-incident count, so live-but-saturated is
+        distinguishable from healthy."""
+        from . import slo
+        depth = self.batcher.pending()
+        limit = self.batcher.queue_depth
+        buckets = self.engine.buckets()
+        open_serving = 0
+        burning = None
+        s = slo.get()
+        if s is not None:
+            open_serving = len(s.snapshot(1)["open"])
+            burning = slo.burning_cause()
+        h = {
             "status": "draining" if self.batcher.closed else "serving",
-            "queue_depth": self.batcher.pending(),
-            "buckets": self.engine.buckets(),
+            "queue_depth": depth,
+            "buckets": buckets,
             "max_batch_size": self.batcher.max_batch_size,
             "max_delay_ms": self.batcher.max_delay_ms,
-            "queue_depth_limit": self.batcher.queue_depth,
+            "queue_depth_limit": limit,
+            "warmed_buckets": len(buckets),
+            "queue_saturation": round(depth / limit, 4) if limit else 0.0,
+            "open_serving_incidents": open_serving,
+            "ready": (not self.batcher.closed and depth < limit
+                      and open_serving == 0),
         }
+        if burning:
+            h["slo_burning"] = burning
+        return h
 
     def varz(self) -> dict:
         """Live telemetry registry snapshot (what ``GET /varz``
@@ -115,6 +143,22 @@ class ServingServer:
         aggregator runs in this process."""
         from .. import clustermon
         return clustermon.incident_view()
+
+    def sloz(self) -> dict:
+        """Serving SLO view (what ``GET /slo`` serves): declared
+        objectives, sliding-window percentiles, burn rates, saturation
+        attribution and any burning incident — ``{"declared": false}``
+        shape when no objectives are declared.  Forces a fresh
+        evaluation so a burn clears even after traffic stops."""
+        from . import slo
+        return slo.slo_view()
+
+    def requestz(self, limit: Optional[int] = None) -> dict:
+        """Slowest-request ring (what ``GET /requestz`` serves): the N
+        slowest requests served with their per-request latency
+        decomposition, slowest first."""
+        from . import slo
+        return slo.requestz(limit)
 
     def stop(self, drain: bool = True):
         """Drain-aware shutdown: close admission (delivering admitted
@@ -167,6 +211,18 @@ class ServingServer:
                     self._reply(200, server.varz())
                 elif self.path.split("?", 1)[0] == "/incidents":
                     self._reply(200, server.incidentz())
+                elif self.path.split("?", 1)[0] == "/slo":
+                    self._reply(200, server.sloz())
+                elif self.path.split("?", 1)[0] == "/requestz":
+                    limit = None
+                    if "?" in self.path:
+                        from urllib.parse import parse_qs
+                        q = parse_qs(self.path.split("?", 1)[1])
+                        try:
+                            limit = int(q.get("limit", [None])[0])
+                        except (TypeError, ValueError):
+                            pass
+                    self._reply(200, server.requestz(limit))
                 elif self.path.split("?", 1)[0] == "/tracez":
                     limit = 100
                     if "?" in self.path:

@@ -608,6 +608,19 @@ def enabled() -> bool:
     return bool(_SINKS)
 
 
+# serving-SLO record-section provider: serving/slo.py installs a
+# zero-arg callable returning the compact per-step "serving_slo"
+# section when objectives are declared (None → section absent).  A
+# provider hook instead of a direct import keeps telemetry (layer 0)
+# from depending on the serving subsystem.
+_slo_provider = None
+
+
+def set_slo_provider(fn) -> None:
+    global _slo_provider
+    _slo_provider = fn
+
+
 # -- the per-step record stream ---------------------------------------------
 
 class _StepToken:
@@ -850,6 +863,16 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             - token.amp_overflows,
             "skipped_updates": _C_AMP_SKIPPED.value - token.amp_skipped,
         }
+    # serving SLO state at this step's emission.  Only present while
+    # objectives are declared (serving/slo.py installs the provider);
+    # an undeclared run's records are unchanged.
+    if _slo_provider is not None:
+        try:
+            _slo_sec = _slo_provider()
+        except Exception:
+            _slo_sec = None
+        if _slo_sec:
+            record["serving_slo"] = _slo_sec
     # critical-path decomposition: where this step's wall time went,
     # from flight-recorder span-bucket deltas (all zeros when tracing is
     # off — the buckets only accumulate while spans are recorded), with

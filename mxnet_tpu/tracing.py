@@ -99,10 +99,12 @@ _C_DUMPS = telemetry.counter("watchdog.stall_dumps")
 _DEFAULT_BUFFER = 4096
 _OFF_VALUES = ("", "0", "false", "off", "no")
 
-# watchdog scope: step funnels and serving dispatches — the spans whose
-# stall means "training/serving is wedged" rather than "slow moment"
+# watchdog scope: step funnels, serving dispatches, and request
+# lifecycle spans — the spans whose stall means "training/serving is
+# wedged" (a serving.request left open past the threshold is a request
+# stuck in the queue/hold path) rather than "slow moment"
 _WATCH_PREFIXES = ("step.",)
-_WATCH_NAMES = frozenset({"serving.dispatch"})
+_WATCH_NAMES = frozenset({"serving.dispatch", "serving.request"})
 
 # critical-path buckets: cumulative ms of completed spans per phase
 # class.  telemetry.end_step snapshots/deltas these into each step

@@ -239,7 +239,11 @@ def test_profiler_counters_snapshot():
     assert set(c["compile"]) == {"count", "ms"}
     assert set(c["comm"]) == {"bytes"}
     assert set(c["serving"]) == {"requests", "batches", "eager_batches",
-                                 "compiles", "rejects", "timeouts"}
+                                 "compiles", "rejects", "timeouts",
+                                 "slo"}
+    assert set(c["serving"]["slo"]) == {"declared", "evals", "samples",
+                                        "breaches", "errors",
+                                        "incidents"}
     assert set(c["input"]) == {"wait_ms", "h2d_bytes", "step_h2d"}
     assert set(c["tracing"]) == {"spans", "dropped", "open",
                                  "watchdog_dumps"}
@@ -253,7 +257,8 @@ def test_profiler_counters_snapshot():
                                  "joined_steps"}
     assert set(c["cluster"]["incidents_total"]) == {
         "input_bound", "compile_stall", "ckpt_interference",
-        "comm_skew", "unknown"}
+        "comm_skew", "latency_slo", "error_budget",
+        "queue_saturation", "unknown"}
     assert set(c["kernel"]) == {"cache_hits", "cache_misses", "tune_ms",
                                 "tune_measurements", "fallbacks"}
     assert set(c["embedding"]) == {"rows_pulled", "rows_pushed",

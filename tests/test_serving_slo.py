@@ -1,0 +1,590 @@
+"""Serving SLO plane (mxnet_tpu/serving/slo.py): request identity,
+multi-window burn-rate alerting, saturation-attributed incidents, the
+/slo + /requestz surfaces, and the batcher deadline-expiry fixes.
+
+The SLO engine is driven deterministically by feeding synthetic
+request-decomposition entries through ``ServingSLO.observe`` and
+forcing evaluations — no sleeps, no Poisson load (that lives in
+``ci/run.sh serving_slo_smoke``).  Batcher integration runs through the
+same ``start=False`` + ``flush()`` path the rest of the serving tests
+use; the hold-window expiry fix is the one test that runs the
+dispatcher thread for real.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import clustermon, profiler, telemetry, tracing
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import (DynamicBatcher, InferenceEngine,
+                               RequestTimeoutError, ServingServer, slo)
+
+UNITS = 16
+
+
+@pytest.fixture(autouse=True)
+def _clean_slo():
+    """Every test starts undeclared, with an empty slow ring, default
+    tracing enablement and no sinks; counters are process-cumulative so
+    tests read deltas."""
+    telemetry.clear_sinks()
+    slo.undeclare()
+    slo.clear_ring()
+    tracing._env_default()
+    tracing.clear()
+    yield
+    slo.undeclare()
+    slo.clear_ring()
+    telemetry.clear_sinks()
+    telemetry.enabled()     # re-sync env cache after monkeypatch undo
+    tracing._env_default()
+    tracing.clear()
+
+
+def _make_net(seed=7):
+    mx.random.seed(seed)
+    net = nn.Sequential()
+    net.add(nn.Dense(8, in_units=UNITS, activation="relu"))
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    return net
+
+
+def _engine(net, **kw):
+    kw.setdefault("example_shape", (UNITS,))
+    kw.setdefault("dtype", "float32")
+    return InferenceEngine(net, **kw)
+
+
+def _x(seed=0):
+    return onp.random.RandomState(seed).randn(UNITS).astype("float32")
+
+
+def _entry(lat, ok=True, queue=None, dispatch=None, pad=0.0, comp=0.0):
+    """A synthetic per-request decomposition entry; queue/dispatch
+    default to a compute-dominant split."""
+    if dispatch is None:
+        dispatch = lat if queue is None else max(0.0, lat - queue)
+    return {"id": slo.next_request_id(), "ok": ok, "latency_ms": lat,
+            "queue_ms": queue or 0.0, "hold_ms": 0.0,
+            "dispatch_ms": dispatch, "pad_share": pad,
+            "compile_ms": comp, "ts": round(time.time(), 3)}
+
+
+# -- request identity / slow ring --------------------------------------------
+
+def test_request_ids_monotonic():
+    a = slo.next_request_id()
+    b = slo.next_request_id()
+    assert b == a + 1
+    assert slo.request_count() >= b
+
+
+def test_slow_ring_keeps_n_slowest(monkeypatch):
+    monkeypatch.setenv("MXNET_SERVING_SLOW_RING", "3")
+    for i in range(8):
+        slo._ring_add({"id": i, "latency_ms": float(i)})
+    rz = slo.requestz()
+    assert rz["ring_capacity"] == 3
+    assert [e["id"] for e in rz["slowest"]] == [7, 6, 5]
+    assert [e["id"] for e in slo.requestz(limit=1)["slowest"]] == [7]
+
+
+# -- burn-rate engine --------------------------------------------------------
+
+def test_undeclared_view_shape():
+    v = slo.slo_view()
+    assert v["declared"] is False and v["objectives"] is None
+    assert slo.declared() is False and slo.active() in (False, True)
+    assert slo.burning_cause() is None
+
+
+def test_burn_opens_exactly_one_incident_then_closes(tmp_path):
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5,
+                    directory=str(tmp_path))
+    inc0 = telemetry.counter("serving_slo.incidents").value
+    c0 = telemetry.counter(
+        "cluster.incidents_total.queue_saturation").value
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=90.0))
+    v = s.evaluate()
+    # all-breach traffic burns at 20x >= 14.4 on both windows
+    assert v["latency"]["burn_long"] == 20.0
+    assert v["burning"]["cause"] == "queue_saturation"
+    assert slo.burning_cause() == "queue_saturation"
+    # still burning on further evals: the incident does NOT re-open
+    for _ in range(3):
+        s.observe(_entry(100.0, queue=90.0))
+        s.evaluate()
+    assert telemetry.counter("serving_slo.incidents").value - inc0 == 1
+    assert telemetry.counter(
+        "cluster.incidents_total.queue_saturation").value - c0 == 1
+    # incident_view (the /incidents body) shows it without an aggregator
+    iv = clustermon.incident_view()
+    assert len(iv["open"]) == 1
+    assert iv["open"][0]["cause"] == "queue_saturation"
+    # dilute with good traffic until the long-window burn drops: closes
+    for _ in range(80):
+        s.observe(_entry(2.0, queue=0.5))
+    v = s.evaluate()
+    assert v["burning"] is None
+    iv = clustermon.incident_view()
+    assert iv["open"] == []
+    assert iv["counts"] == {"queue_saturation": 1}
+    assert [i["cause"] for i in iv["recent"]] == ["queue_saturation"]
+    # every transition persisted for the offline report
+    events = [json.loads(l)["event"] for l in
+              (tmp_path / "incidents.jsonl").read_text().splitlines()]
+    assert events[0] == "open" and events[-1] == "close"
+
+
+def test_error_budget_outranks_latency():
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    for i in range(20):
+        s.observe(_entry(100.0, ok=i % 2 == 0, queue=90.0))
+    v = s.evaluate()
+    assert v["burning"]["cause"] == "error_budget"
+    assert v["availability"]["observed"] == 0.5
+
+
+def test_saturation_attribution_compute_dominant():
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=1.0, dispatch=95.0))
+    v = s.evaluate()
+    assert v["burning"]["cause"] == "latency_slo"
+    sat = v["saturation"]
+    assert sat["compute"] > sat["queue_wait"]
+    assert set(sat) == set(slo.SAT_SIGNALS)
+
+
+def test_hysteresis_latches_cause_while_burning():
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=90.0))
+    assert s.evaluate()["burning"]["cause"] == "queue_saturation"
+    # signal mix shifts compute-ward but the long window still burns:
+    # the latched cause must not flap (no close+reopen)
+    inc0 = telemetry.counter("serving_slo.incidents").value
+    for _ in range(10):
+        s.observe(_entry(100.0, queue=1.0, dispatch=95.0))
+    v = s.evaluate()
+    assert v["burning"]["cause"] == "queue_saturation"
+    assert telemetry.counter("serving_slo.incidents").value == inc0
+    assert len(clustermon.incident_view()["open"]) == 1
+
+
+def test_min_samples_gates_alerting():
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=50)
+    for _ in range(20):
+        s.observe(_entry(100.0))
+    assert s.evaluate()["burning"] is None
+
+
+# -- remediation / advice plane ----------------------------------------------
+
+def _burn_to_escalation(s):
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=90.0))
+    s.evaluate()    # poll 1: open
+    s.evaluate()    # poll 2: escalate (ESCALATE_POLLS)
+
+
+def test_queue_saturation_escalation_publishes_and_applies_advice(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("MXNET_REMEDIATE", "1")
+    net = _make_net()
+    b = DynamicBatcher(_engine(net), start=False, max_batch_size=8,
+                       max_delay_ms=4.0)
+    applied0 = telemetry.counter("cluster.advice_applied").value
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5,
+                    directory=str(tmp_path))
+    _burn_to_escalation(s)
+    recs = [json.loads(l) for l in
+            (tmp_path / "advice.jsonl").read_text().splitlines()]
+    assert len(recs) == 1
+    assert recs[0]["action"] == "batcher_tuning"
+    assert recs[0]["cause"] == "queue_saturation"
+    assert recs[0]["max_batch"] == 16 and recs[0]["max_delay_ms"] == 2.0
+    # remediation touched the LIVE batcher
+    assert b.max_batch_size == 16 and b.max_delay_ms == 2.0
+    assert telemetry.counter(
+        "cluster.advice_applied").value - applied0 == 1
+    b.close(drain=False)
+
+
+def test_advice_without_remediate_is_advisory(tmp_path, monkeypatch):
+    monkeypatch.delenv("MXNET_REMEDIATE", raising=False)
+    net = _make_net()
+    b = DynamicBatcher(_engine(net), start=False, max_batch_size=8,
+                       max_delay_ms=4.0)
+    ignored0 = telemetry.counter("cluster.advice_ignored").value
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5,
+                    directory=str(tmp_path))
+    _burn_to_escalation(s)
+    assert (tmp_path / "advice.jsonl").exists()
+    assert b.max_batch_size == 8 and b.max_delay_ms == 4.0   # untouched
+    assert telemetry.counter(
+        "cluster.advice_ignored").value - ignored0 == 1
+    b.close(drain=False)
+
+
+def test_incident_hooks_fire_for_serving_incidents():
+    seen = []
+    def hook(event, incident):
+        seen.append((event, incident["cause"]))
+    clustermon.on_incident(hook)
+    try:
+        s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+        _burn_to_escalation(s)
+    finally:
+        clustermon.remove_incident_hook(hook)
+    assert seen[0] == ("open", "queue_saturation")
+    assert ("escalate", "queue_saturation") in seen
+
+
+# -- scrape surfaces ---------------------------------------------------------
+
+def test_prometheus_roundtrip_serving_slo_series():
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=90.0))
+    s.evaluate()
+    fam = clustermon.parse_prometheus_text(clustermon.prometheus_text())
+    for name in ("mxnet_serving_slo_latency_p95_ms",
+                 "mxnet_serving_slo_latency_burn_long",
+                 "mxnet_serving_slo_latency_target_ms",
+                 "mxnet_serving_slo_error_budget_remaining",
+                 "mxnet_serving_slo_burning",
+                 "mxnet_serving_slo_requests",
+                 "mxnet_serving_slo_incidents"):
+        assert name in fam, name
+    assert fam["mxnet_serving_slo_latency_target_ms"][0][1] == 20.0
+    assert fam["mxnet_serving_slo_burning"][0][1] == 1.0
+    # the burning cause renders as a labelled string-gauge family
+    causes = {l["cause"]: v for l, v in
+              fam["mxnet_serving_slo_burning_cause"]}
+    assert causes["queue_saturation"] == 1.0
+    # and the incident landed in the shared counter family
+    inc = {l["cause"]: v for l, v in
+           fam["mxnet_cluster_incidents_total"]}
+    assert inc["queue_saturation"] >= 1.0
+
+
+def test_server_sloz_requestz_healthz_inprocess():
+    net = _make_net()
+    slo.declare(latency_ms=1000.0, window_s=30.0)
+    with ServingServer(net, engine_args={"example_shape": (UNITS,),
+                                         "dtype": "float32"},
+                       batcher_args={"max_delay_ms": 0.0},
+                       start=False) as srv:
+        n0 = slo.requestz()["tracked"]
+        fut = srv.batcher.submit(_x())
+        srv.batcher.flush()
+        fut.result(0)
+        v = srv.sloz()
+        assert v["declared"] is True
+        assert v["samples"]["long"] >= 1
+        assert v["latency"]["p95_ms"] > 0
+        assert v["burning"] is None
+        rz = srv.requestz()
+        assert rz["tracked"] - n0 >= 1
+        e = rz["slowest"][0]
+        assert {"id", "latency_ms", "queue_ms", "hold_ms",
+                "dispatch_ms", "validate_ms", "pad_share",
+                "compile_ms", "bucket", "batch_size"} <= set(e)
+        h = srv.healthz()
+        assert h["ready"] is True
+        assert h["open_serving_incidents"] == 0
+        assert h["queue_saturation"] == 0.0
+        assert "warmed_buckets" in h and "slo_burning" not in h
+
+
+def test_healthz_not_ready_while_burning():
+    net = _make_net()
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    with ServingServer(net, engine_args={"example_shape": (UNITS,),
+                                         "dtype": "float32"},
+                       start=False) as srv:
+        for _ in range(20):
+            s.observe(_entry(100.0, queue=90.0))
+        s.evaluate()
+        h = srv.healthz()
+        assert h["status"] == "serving"       # live...
+        assert h["ready"] is False            # ...but not ready
+        assert h["open_serving_incidents"] == 1
+        assert h["slo_burning"] == "queue_saturation"
+
+
+def test_step_record_gains_serving_slo_section():
+    class _Capture:
+        def __init__(self):
+            self.records = []
+        def emit(self, rec):
+            self.records.append(rec)
+    cap = _Capture()
+    telemetry.add_sink(cap)
+    net = _make_net()
+    b = DynamicBatcher(_engine(net), start=False, max_delay_ms=0.0)
+    b.submit(_x())
+    b.flush()
+    assert cap.records and "serving_slo" not in cap.records[-1]
+    slo.declare(latency_ms=1000.0, window_s=30.0)
+    b.submit(_x())
+    b.flush()
+    sec = cap.records[-1]["serving_slo"]
+    assert set(sec) == {"p95_ms", "p99_ms", "burn_long", "burn_short",
+                        "budget_remaining", "burning"}
+    assert sec["burning"] is None
+    b.close(drain=False)
+
+
+def test_request_id_span_taxonomy():
+    tracing.enable()
+    net = _make_net()
+    b = DynamicBatcher(_engine(net), start=False, max_delay_ms=0.0)
+    futs = [b.submit(_x(i)) for i in range(3)]
+    b.flush()
+    for f in futs:
+        f.result(0)
+    evs = {e["name"]: [x for x in tracing._completed_events()
+                       if x["name"] == e["name"]]
+           for e in tracing._completed_events()}
+    enq_ids = [e["args"]["request_id"] for e in evs["serving.enqueue"]]
+    assert len(enq_ids) == 3 and sorted(enq_ids) == enq_ids
+    reqs = evs["serving.request"]
+    assert {e["args"]["request_id"] for e in reqs} == set(enq_ids)
+    for e in reqs:
+        assert {"queue_wait_ms", "hold_ms", "dispatch_ms",
+                "validate_ms", "pad_share",
+                "batch_size"} <= set(e["args"])
+    # coalesce + dispatch both list the request ids they carried
+    assert evs["serving.coalesce"][0]["args"]["request_ids"] == enq_ids
+    assert evs["serving.dispatch"][0]["args"]["request_ids"] == enq_ids
+    b.close(drain=False)
+
+
+# -- batcher deadline expiry (satellite fix) ---------------------------------
+
+def test_submit_expires_stale_neighbors_without_dispatcher():
+    net = _make_net()
+    b = DynamicBatcher(_engine(net), start=False)
+    fa = b.submit(_x(0), timeout_ms=1.0)
+    time.sleep(0.02)
+    # admitting B sweeps the queue on the submitter's thread: A's
+    # lapsed deadline resolves NOW, not at the next coalesce
+    b.submit(_x(1))
+    assert fa.done()
+    with pytest.raises(RequestTimeoutError):
+        fa.result(0)
+    assert b.pending() == 1
+    b.close(drain=False)
+
+
+def test_hold_window_expires_held_request_promptly():
+    """A request whose deadline passes INSIDE the straggler-hold window
+    fails at its deadline (~30 ms), not at the end of the 500 ms hold —
+    while the batch-mate without a deadline still dispatches."""
+    net = _make_net()
+    b = DynamicBatcher(_engine(net), max_batch_size=4,
+                       max_delay_ms=500.0, start=True)
+    t0 = time.perf_counter()
+    fa = b.submit(_x(0))                      # no deadline: holds
+    fb = b.submit(_x(1), timeout_ms=30.0)     # lapses mid-hold
+    with pytest.raises(RequestTimeoutError):
+        fb.result(5.0)
+    waited = time.perf_counter() - t0
+    assert waited < 0.4, f"timeout resolved after {waited:.3f}s"
+    assert fa.result(5.0) is not None         # survivor dispatches
+    b.close(drain=False)
+    t_close = time.perf_counter() - t0
+    assert t_close >= 0.03   # sanity: the hold window actually ran
+
+
+# -- disabled contract -------------------------------------------------------
+
+def test_disabled_contract_no_threads_no_accounting(monkeypatch):
+    for k in ("MXNET_SLO_LATENCY_MS", "MXNET_SLO_WINDOW_S",
+              "MXNET_TRACE"):
+        monkeypatch.delenv(k, raising=False)
+    tracing._env_default()
+    assert slo.active() is False
+    net = _make_net()
+    ref = net(mx.nd.array(_x()[None])).asnumpy()
+    n_threads = threading.active_count()
+    b = DynamicBatcher(_engine(net), start=False, max_delay_ms=0.0)
+    fut = b.submit(_x())
+    b.flush()
+    # bitwise-identical result, zero new threads, nothing sampled
+    assert onp.array_equal(fut.result(0), ref[0])
+    assert threading.active_count() == n_threads
+    assert slo.requestz()["tracked"] == 0
+    assert slo.slo_view()["declared"] is False
+    b.close(drain=False)
+
+
+def test_env_declaration_lifecycle(monkeypatch):
+    monkeypatch.setenv("MXNET_SLO_LATENCY_MS", "50")
+    monkeypatch.setenv("MXNET_SLO_WINDOW_S", "12")
+    assert slo.declared() is True
+    s = slo.get()
+    assert s.latency_ms == 50.0 and s.window_s == 12.0
+    assert s.short_s == 1.0 and s.from_env is True
+    monkeypatch.delenv("MXNET_SLO_LATENCY_MS")
+    monkeypatch.delenv("MXNET_SLO_WINDOW_S")
+    assert slo.declared() is False
+
+
+def test_weights_age_gauge():
+    assert slo.weights_age_s() is None      # never stamped: no series
+    slo.note_weights_published(time.time() - 5.0)
+    age = slo.weights_age_s()
+    assert age is not None and 4.0 <= age <= 10.0
+    assert slo.slo_view()["weights_age_s"] == pytest.approx(age, abs=1)
+    assert telemetry.gauge("serving.weights_age_s").value >= 4.0
+    slo._weights_ts = None
+    telemetry.gauge("serving.weights_age_s").set(None)
+
+
+def test_profiler_counters_slo_section():
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    e0 = telemetry.counter("serving_slo.evals").value
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=90.0))
+    s.evaluate()
+    c = profiler.counters()["serving"]["slo"]
+    assert c["declared"] is True
+    assert c["evals"] > e0 and c["samples"] >= 20
+    assert c["breaches"] >= 20 and c["incidents"] >= 1
+    slo.undeclare()
+    assert profiler.counters()["serving"]["slo"]["declared"] is False
+
+
+# -- HTTP surfaces (sockets: slow tier) --------------------------------------
+
+@pytest.mark.slow
+def test_slo_requestz_http_on_serving_server():
+    import urllib.request
+    net = _make_net()
+    slo.declare(latency_ms=1000.0, window_s=30.0)
+    with ServingServer(net, engine_args={"example_shape": (UNITS,),
+                                         "dtype": "float32"},
+                       batcher_args={"max_delay_ms": 0.0}) as srv:
+        srv.predict(_x())
+        host, port = srv.start_http()
+        url = f"http://{host}:{port}"
+        with urllib.request.urlopen(f"{url}/slo", timeout=10) as resp:
+            v = json.loads(resp.read())
+        assert v["declared"] is True and v["samples"]["long"] >= 1
+        with urllib.request.urlopen(f"{url}/requestz?limit=1",
+                                    timeout=10) as resp:
+            rz = json.loads(resp.read())
+        assert rz["tracked"] >= 1 and len(rz["slowest"]) == 1
+        with urllib.request.urlopen(f"{url}/metrics",
+                                    timeout=10) as resp:
+            fam = clustermon.parse_prometheus_text(resp.read().decode())
+        assert "mxnet_serving_slo_latency_p95_ms" in fam
+
+
+@pytest.mark.slow
+def test_slo_requestz_http_on_standalone_exporter():
+    import urllib.request
+    s = slo.declare(latency_ms=20, window_s=30.0, min_samples=5)
+    for _ in range(20):
+        s.observe(_entry(100.0, queue=90.0))
+    _host, port = clustermon.start_metrics_server(0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(f"{base}/slo", timeout=10) as resp:
+            v = json.loads(resp.read())
+        assert v["burning"]["cause"] == "queue_saturation"
+        with urllib.request.urlopen(f"{base}/requestz",
+                                    timeout=10) as resp:
+            assert "slowest" in json.loads(resp.read())
+        with urllib.request.urlopen(f"{base}/incidents",
+                                    timeout=10) as resp:
+            iv = json.loads(resp.read())
+        assert iv["counts"].get("queue_saturation", 0) >= 1
+        with urllib.request.urlopen(f"{base}/metrics",
+                                    timeout=10) as resp:
+            fam = clustermon.parse_prometheus_text(resp.read().decode())
+        assert fam["mxnet_serving_slo_burning"][0][1] == 1.0
+    finally:
+        clustermon.stop_metrics_server()
+
+
+# -- offline report ----------------------------------------------------------
+
+def _spool_record(ts, lats, ids, error=None):
+    s = {"batch_size": len(lats), "padded_batch": len(lats),
+         "bucket": f"{len(lats)}x{UNITS}:float32", "compiled": True,
+         "padding_waste": 0.0, "queue_depth": 0, "request_ms": lats,
+         "request_ids": ids, "rejects": 0, "timeouts": 0}
+    if error:
+        s = {"error": error, "batch_size": len(lats),
+             "request_ids": ids}
+    return {"step": 0, "ts": ts, "source": "serving.DynamicBatcher",
+            "rank": 0, "world": 1, "serving": s}
+
+
+def test_slo_report_tool_reconstructs_burn(tmp_path):
+    t0 = 1000.0
+    rid = 0
+    with open(tmp_path / "rank-0.jsonl", "w") as f:
+        for i in range(20):          # healthy phase
+            rid += 1
+            f.write(json.dumps(_spool_record(
+                t0 + i * 0.1, [5.0], [rid])) + "\n")
+        for i in range(20):          # stalled phase: every request slow
+            rid += 1
+            f.write(json.dumps(_spool_record(
+                t0 + 10 + i * 0.1, [120.0], [rid])) + "\n")
+    with open(tmp_path / "incidents.jsonl", "w") as f:
+        f.write(json.dumps({"event": "open", "id": 1, "rank": 0,
+                            "cause": "latency_slo", "peak_ratio": 20.0,
+                            "peak_step_ms": 120.0}) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join("tools", "slo_report.py"),
+         str(tmp_path), "--latency-ms", "20", "--window-s", "2",
+         "--json"],
+        capture_output=True, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["requests"] == 40
+    assert rep["latency"]["p95_ms"] == 120.0
+    assert len(rep["burn_episodes"]) == 1
+    assert rep["burn_episodes"][0]["peak_burn"] >= 14.4
+    assert rep["incidents"]["causes"] == ["latency_slo"]
+    assert rep["verdict"] == "burning:latency_slo"
+    assert rep["slowest"][0]["latency_ms"] == 120.0
+    # human-readable mode prints the greppable VERDICT line
+    out2 = subprocess.run(
+        [sys.executable, os.path.join("tools", "slo_report.py"),
+         str(tmp_path), "--latency-ms", "20", "--window-s", "2"],
+        capture_output=True, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    assert "VERDICT: burning:latency_slo" in out2.stdout
+
+
+def test_slo_report_healthy_run(tmp_path):
+    with open(tmp_path / "rank-0.jsonl", "w") as f:
+        for i in range(30):
+            f.write(json.dumps(_spool_record(
+                1000.0 + i * 0.1, [5.0], [i + 1])) + "\n")
+    out = subprocess.run(
+        [sys.executable, os.path.join("tools", "slo_report.py"),
+         str(tmp_path), "--latency-ms", "20", "--json"],
+        capture_output=True, text=True, cwd=os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    assert out.returncode == 0, out.stderr
+    rep = json.loads(out.stdout)
+    assert rep["verdict"] == "healthy"
+    assert rep["burn_episodes"] == []

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Offline serving-SLO report: replay budget burn from JSONL spools.
+
+Reads the per-dispatch step records serving/batcher.py emits (source
+``serving.DynamicBatcher``, from a ``MXNET_CLUSTER_DIR`` spool dir or
+explicit JSONL files) and reconstructs, WITHOUT the live process, what
+the in-process SLO engine (mxnet_tpu/serving/slo.py) computed online:
+
+- request latency percentiles (p50/p95/p99) over the whole run;
+- sliding-window budget burn against a latency objective — the same
+  multi-window multi-burn-rate rule the live engine alerts on — and
+  the burn EPISODES (intervals where the long- and short-window burn
+  both exceeded the threshold), each with its peak burn and the
+  dominant saturation signal over the episode (queue wait vs compute
+  from the dispatch records' padding/occupancy split);
+- the slowest-request table (request id ↔ latency, zipped from each
+  dispatch record's ``request_ids`` × ``request_ms``);
+- the serving incidents recorded in the sibling ``incidents.jsonl``
+  (causes ``latency_slo`` / ``error_budget`` / ``queue_saturation``),
+  reconciled against the replayed episodes.
+
+The final VERDICT line names the burning causes found (grep target for
+ci/run.sh serving_slo_smoke), or "healthy" when the budget held.
+
+Usage:
+    python tools/slo_report.py <spool-dir> [--latency-ms 20]
+    python tools/slo_report.py rank-0.jsonl --latency-ms 20 --json
+
+Defaults mirror the live engine: objective from MXNET_SLO_LATENCY_MS,
+window from MXNET_SLO_WINDOW_S (60 s), threshold 14.4, p95 budget.
+Stdlib-only (json/argparse) — runs anywhere the spools land.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+SERVING_SOURCE = "serving.DynamicBatcher"
+SERVING_CAUSES = ("latency_slo", "error_budget", "queue_saturation")
+_SPOOL_RE = re.compile(r"rank-(\d+)\.jsonl(\.\d+)?$")
+
+
+def _read_jsonl(path):
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
+    except OSError as e:
+        print(f"warning: {path}: {e}", file=sys.stderr)
+    return out
+
+
+def load(paths):
+    """(serving records sorted by ts, incident transitions).  ``paths``
+    mixes spool dirs (rank-*.jsonl + incidents.jsonl inside) and
+    explicit JSONL files/globs."""
+    records, incidents = [], []
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for name in sorted(os.listdir(p)):
+                if _SPOOL_RE.match(name):
+                    files.append(os.path.join(p, name))
+            inc = os.path.join(p, "incidents.jsonl")
+            if os.path.exists(inc):
+                incidents.extend(_read_jsonl(inc))
+        else:
+            hits = glob.glob(p) or [p]
+            for f in sorted(hits):
+                if f.endswith("incidents.jsonl"):
+                    incidents.extend(_read_jsonl(f))
+                else:
+                    files.append(f)
+    for f in files:
+        for rec in _read_jsonl(f):
+            if rec.get("source") == SERVING_SOURCE \
+                    and isinstance(rec.get("serving"), dict):
+                records.append(rec)
+    records.sort(key=lambda r: r.get("ts") or 0)
+    return records, incidents
+
+
+def requests_of(records):
+    """Flatten dispatch records into one request list: (ts, id,
+    latency_ms, queue_share_hint).  Request ids pre-date this tool's
+    schema in old spools — synthesize ordinal ids then."""
+    reqs = []
+    synth = 0
+    for rec in records:
+        s = rec["serving"]
+        lats = s.get("request_ms") or []
+        ids = s.get("request_ids") or []
+        ts = rec.get("ts") or 0.0
+        waste = float(s.get("padding_waste") or 0.0)
+        for i, lat in enumerate(lats):
+            if i < len(ids):
+                rid = ids[i]
+            else:
+                synth += 1
+                rid = f"?{synth}"
+            reqs.append({"ts": ts, "id": rid,
+                         "latency_ms": float(lat),
+                         "padding_waste": waste,
+                         "batch_size": s.get("batch_size"),
+                         "bucket": s.get("bucket")})
+    return reqs
+
+
+def pct(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   round(p / 100.0 * (len(sorted_vals) - 1))))
+    return sorted_vals[k]
+
+
+def burn_episodes(reqs, latency_ms, window_s, threshold,
+                  percentile=95.0, min_samples=10):
+    """Replay the sliding-window burn over the request stream; returns
+    (episodes, timeline).  An episode opens when long- AND short-window
+    burn reach the threshold at some request arrival and closes when
+    the long-window burn drops back under it — the live engine's rule
+    evaluated at each sample point."""
+    budget = max(1e-6, 1.0 - percentile / 100.0)
+    short_s = max(0.05, window_s / 12.0)
+    episodes, timeline = [], []
+    cur = None
+    win = []             # (ts, latency_ms) within the long window
+    for r in reqs:
+        ts = r["ts"]
+        win.append((ts, r["latency_ms"]))
+        win = [w for w in win if w[0] >= ts - window_s]
+        short = [w for w in win if w[0] >= ts - short_s]
+        frac_l = sum(1 for _, l in win if l > latency_ms) / len(win)
+        frac_s = (sum(1 for _, l in short if l > latency_ms)
+                  / len(short)) if short else 0.0
+        burn_l, burn_s = frac_l / budget, frac_s / budget
+        timeline.append((ts, round(burn_l, 3)))
+        if cur is None:
+            if len(win) >= min_samples and burn_l >= threshold \
+                    and burn_s >= threshold:
+                cur = {"start_ts": ts, "end_ts": None,
+                       "peak_burn": round(burn_l, 3),
+                       "requests": len(win)}
+        else:
+            cur["peak_burn"] = max(cur["peak_burn"], round(burn_l, 3))
+            cur["requests"] += 1
+            if burn_l < threshold:
+                cur["end_ts"] = ts
+                cur["duration_s"] = round(ts - cur["start_ts"], 3)
+                episodes.append(cur)
+                cur = None
+    if cur is not None:
+        cur["duration_s"] = round(
+            (reqs[-1]["ts"] - cur["start_ts"]), 3) if reqs else 0.0
+        episodes.append(cur)
+    return episodes, timeline
+
+
+def report(paths, latency_ms, window_s, threshold, slow_n, as_json):
+    records, incidents = load(paths)
+    if not records:
+        raise SystemExit("no serving records "
+                         f"(source={SERVING_SOURCE!r}) found in "
+                         + ", ".join(paths))
+    reqs = requests_of(records)
+    lats = sorted(r["latency_ms"] for r in reqs)
+    episodes, timeline = burn_episodes(reqs, latency_ms, window_s,
+                                       threshold)
+    slowest = sorted(reqs, key=lambda r: -r["latency_ms"])[:slow_n]
+    serving_inc = [i for i in incidents
+                   if i.get("cause") in SERVING_CAUSES]
+    opened = [i for i in serving_inc if i.get("event") == "open"]
+    causes = sorted({i["cause"] for i in opened})
+    if not causes and episodes:
+        causes = ["latency_slo"]      # replay found burn the live
+        #                               engine did not record
+    breaches = sum(1 for l in lats if l > latency_ms)
+    errors = sum(1 for r in records if "error" in r["serving"])
+    out = {
+        "files": paths,
+        "objective": {"latency_ms": latency_ms, "percentile": 95.0,
+                      "window_s": window_s,
+                      "burn_threshold": threshold},
+        "requests": len(reqs),
+        "dispatches": len(records),
+        "failed_dispatches": errors,
+        "latency": {"p50_ms": round(pct(lats, 50), 3),
+                    "p95_ms": round(pct(lats, 95), 3),
+                    "p99_ms": round(pct(lats, 99), 3),
+                    "max_ms": round(lats[-1], 3) if lats else 0.0,
+                    "breaches": breaches,
+                    "breach_fraction": round(
+                        breaches / len(lats), 4) if lats else 0.0},
+        "burn_episodes": episodes,
+        "peak_burn": max((b for _, b in timeline), default=0.0),
+        "slowest": slowest,
+        "incidents": {"transitions": serving_inc, "opened": len(opened),
+                      "causes": causes},
+        "verdict": ("burning:" + ",".join(causes)) if causes
+        else "healthy",
+    }
+    if as_json:
+        json.dump(out, sys.stdout, indent=2)
+        print()
+        return out
+    o = out["objective"]
+    print(f"Serving SLO report — {len(reqs)} requests over "
+          f"{len(records)} dispatches")
+    print(f"  objective: p95 <= {o['latency_ms']:g} ms, window "
+          f"{o['window_s']:g}s, burn threshold {o['burn_threshold']:g}")
+    lt = out["latency"]
+    print(f"  latency: p50 {lt['p50_ms']:g}  p95 {lt['p95_ms']:g}  "
+          f"p99 {lt['p99_ms']:g}  max {lt['max_ms']:g} ms; "
+          f"{lt['breaches']} breaches "
+          f"({100 * lt['breach_fraction']:.1f}%)")
+    print(f"  peak burn: {out['peak_burn']:g}x budget")
+    if episodes:
+        print(f"  burn episodes ({len(episodes)}):")
+        for ep in episodes:
+            end = ("open" if ep.get("end_ts") is None
+                   else f"{ep['duration_s']:g}s")
+            print(f"    start {ep['start_ts']:.3f}  duration {end}  "
+                  f"peak {ep['peak_burn']:g}x  "
+                  f"({ep['requests']} requests)")
+    else:
+        print("  burn episodes: none")
+    if serving_inc:
+        print(f"  incidents (incidents.jsonl): {len(opened)} opened")
+        for i in serving_inc:
+            print(f"    [{i.get('event')}] #{i.get('id')} "
+                  f"{i.get('cause')} peak {i.get('peak_ratio')}x "
+                  f"p95 {i.get('peak_step_ms')} ms")
+    else:
+        print("  incidents (incidents.jsonl): none recorded")
+    print(f"  slowest {len(slowest)} requests:")
+    print("    id         latency_ms  batch  bucket")
+    for r in slowest:
+        print(f"    {str(r['id']):<10} {r['latency_ms']:>10.3f}  "
+              f"{str(r['batch_size'] or '-'):>5}  "
+              f"{r['bucket'] or '-'}")
+    print(f"VERDICT: {out['verdict']}")
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="spool dir(s) and/or JSONL files/globs")
+    ap.add_argument("--latency-ms", type=float,
+                    default=float(os.environ.get("MXNET_SLO_LATENCY_MS")
+                                  or 20.0),
+                    help="latency objective (default: "
+                         "MXNET_SLO_LATENCY_MS or 20)")
+    ap.add_argument("--window-s", type=float,
+                    default=float(os.environ.get("MXNET_SLO_WINDOW_S")
+                                  or 60.0),
+                    help="long burn window seconds (default: "
+                         "MXNET_SLO_WINDOW_S or 60)")
+    ap.add_argument("--burn-threshold", type=float,
+                    default=float(
+                        os.environ.get("MXNET_SLO_BURN_THRESHOLD")
+                        or 14.4))
+    ap.add_argument("--slow", type=int, default=10,
+                    help="slowest-request table size (default 10)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+    report(args.paths, args.latency_ms, args.window_s,
+           args.burn_threshold, args.slow, args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
