@@ -868,6 +868,33 @@ PY
     rm -rf "$tmp"
 }
 
+parallel_4d_smoke() { # composed dp×tp×pp×ep mesh: tests + bench gates
+    # tier-1 covers MeshPlan construction/env parsing, zero_spec
+    # composition, the 1F1B and MoE trainer paths, one-dispatch
+    # windows, schedule value_and_grad parity (rtol 1e-6) and
+    # cross-mesh (dp2×tp2 -> dp4×tp1) checkpoint restore
+    JAX_PLATFORMS=cpu python -m pytest tests/test_mesh4d.py \
+        tests/test_pipeline_parity.py -q
+    local tmp; tmp="$(mktemp -d)"
+    # then the bench must hold the composed-mesh gates on dp2×tp2 vs
+    # dp4 (AMP bf16 on both): per-device param+opt residency <=0.55x,
+    # median step <=1.15x, ONE device program per run_steps window,
+    # and collective bytes attributed to BOTH axes (exits non-zero
+    # otherwise)
+    JAX_PLATFORMS=cpu MXNET_TELEMETRY_JSONL="$tmp/run.jsonl" \
+        python benchmark/parallel4d_bench.py --smoke \
+        | tee "$tmp/bench.json"
+    grep -q '"pass": true' "$tmp/bench.json"
+    grep -q '"dispatch_per_window": \[1\]' "$tmp/bench.json"
+    # the same run's JSONL carries the per-axis split and the report
+    # renders it in the Optimizer sharding section
+    grep -q '"by_axis"' "$tmp/run.jsonl"
+    JAX_PLATFORMS=cpu python tools/telemetry_report.py "$tmp/run.jsonl" \
+        | tee "$tmp/report.txt"
+    grep -q "comm.tp bytes / step" "$tmp/report.txt"
+    rm -rf "$tmp"
+}
+
 embedding_smoke() {   # sharded embedding tables: tests + DLRM bench gates
     # tier-1 covers partition routing, the bitwise pull->compute->push
     # round trip vs a dense reference (1- AND 2-shard), server-side

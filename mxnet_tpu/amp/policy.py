@@ -48,7 +48,7 @@ from . import lists
 __all__ = [
     "enabled", "activate", "deactivate", "compute_dtype",
     "compute_dtype_str", "storage_dtype", "compute_itemsize",
-    "cache_token", "category", "wrap", "kernel_key_dtype",
+    "cache_token", "category", "wrap", "wire_cast", "kernel_key_dtype",
 ]
 
 # explicit amp.init() activation; the MXNET_AMP env var activates
@@ -153,6 +153,27 @@ def category(op_name: str) -> Optional[str]:
     if op_name in _WIDEST:
         return "widest"
     return None
+
+
+def wire_cast(g):
+    """The gradient-wire round-trip, traced: quantize ``g`` through the
+    policy's storage dtype and dequantize back, so the collective GSPMD
+    inserts next to it ships 1-byte (fp8) / 2-byte (bf16/f16) payloads
+    while the consumer (optimizer master update) sees the dequantized
+    value.  Identity for non-float inputs, for arrays already at or
+    below the wire width, and while the policy is off — safe to leave
+    in a traced step unconditionally.  Every mesh-axis wire (dp
+    gradient legs, pp activation hops, ep dispatch payloads) funnels
+    through this one cast discipline."""
+    if not enabled():
+        return g
+    import jax.numpy as jnp
+    if not _is_float(g):
+        return g
+    wire = storage_dtype()
+    if g.dtype.itemsize <= wire.itemsize:
+        return g
+    return g.astype(wire).astype(g.dtype)
 
 
 def kernel_key_dtype(dtype_str: str) -> str:

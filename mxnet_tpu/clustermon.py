@@ -108,6 +108,10 @@ _G_SKEW = telemetry.gauge("cluster.step_ms_skew")
 _G_BARRIER_SKEW = telemetry.gauge("cluster.barrier_wait_skew_ms")
 _G_STRAGGLER = telemetry.gauge("cluster.straggler_rank")
 _G_CAUSE = telemetry.gauge("cluster.straggler_cause")
+# which mesh axis a comm_skew straggler is skewed on ("dp"/"tp"/...,
+# "none" otherwise).  A detail gauge, NOT part of the cause string —
+# the incidents_total{cause=...} family stays at bounded cardinality
+_G_COMM_AXIS = telemetry.gauge("cluster.straggler_comm_axis")
 _C_INCIDENTS = telemetry.counter("cluster.straggler_incidents")
 _C_JOINED = telemetry.counter("cluster.joined_steps")
 _C_ROTATIONS = telemetry.counter("cluster.spool_rotations")
@@ -552,6 +556,8 @@ def window_stats(by_rank: Dict[int, List[dict]], window: int,
         host = [float(x.get("host_ms") or 0.0) for x in recs]
         sigs = [record_signals(x) for x in recs]
         cps = [x.get("critical_path") or {} for x in recs]
+        axs = [(x.get("collective_split") or {}).get("by_axis") or {}
+               for x in recs]
         stats[r] = {
             "steps": len(recs),
             "host_ms_mean": _mean(host),
@@ -563,6 +569,13 @@ def window_stats(by_rank: Dict[int, List[dict]], window: int,
                 k: _mean([float(c.get(k) or 0.0) for c in cps])
                 for k in ("input_wait", "h2d", "compile", "collective",
                           "optimizer", "checkpoint", "compute")},
+            # mean modeled collective bytes per mesh axis
+            # (collective_split.by_axis) — lets a comm_skew verdict
+            # name WHICH axis (dp grad sync vs tp activation
+            # all-reduce vs ep all_to_all) carries the skew
+            "comm_axis_bytes": {
+                ax: _mean([float(a.get(ax) or 0.0) for a in axs])
+                for ax in telemetry.MESH_AXES},
             "barrier_wait_ms_mean": _mean(
                 [float((x.get("checkpoint") or {})
                        .get("barrier_wait_ms") or 0.0) for x in recs]),
@@ -599,7 +612,29 @@ def detect_straggler(stats: Dict[int, dict],
         cause = "unknown"
     else:
         cause = _CAUSE_OF_SIG[top]
-    return {"rank": slowest, "cause": cause,
+    # mesh-axis attribution for comm_skew: the axis whose modeled
+    # byte volume on the straggler most exceeds the peer median.  A
+    # DETAIL field beside the cause — the cause string (and the
+    # incidents_total counter family) stays "comm_skew" so Prometheus
+    # cardinality is unchanged.
+    comm_axis = None
+    if cause == "comm_skew":
+        ax_excess = {}
+        for ax in telemetry.MESH_AXES:
+            mine = live[slowest].get("comm_axis_bytes", {}).get(ax, 0.0)
+            peer = _median([live[r].get("comm_axis_bytes", {})
+                            .get(ax, 0.0) for r in live if r != slowest])
+            ax_excess[ax] = mine - peer
+        best = max(ax_excess, key=lambda a: ax_excess[a])
+        if ax_excess[best] > 0.0:
+            comm_axis = best
+        elif live[slowest].get("comm_axis_bytes"):
+            # symmetric volumes — fall back to the heaviest axis on
+            # the straggler itself so operators still get a name
+            vols = live[slowest]["comm_axis_bytes"]
+            heaviest = max(vols, key=lambda a: vols[a])
+            comm_axis = heaviest if vols[heaviest] > 0.0 else None
+    return {"rank": slowest, "cause": cause, "comm_axis": comm_axis,
             "ratio": mean / med, "step_ms": mean, "peer_ms": med,
             "excess_ms": {_CAUSE_OF_SIG[k]: round(v, 3)
                           for k, v in excess.items()}}
@@ -658,6 +693,7 @@ class IncidentStore:
             cur = self._open = {
                 "id": self._next_id, "status": "open",
                 "rank": rank, "cause": cause,
+                "comm_axis": straggler.get("comm_axis"),
                 "start_rank_step": int(step), "end_rank_step": None,
                 "start_ts": round(now, 3), "end_ts": None,
                 "duration_s": None,
@@ -671,6 +707,8 @@ class IncidentStore:
             events.append({"event": "open", "incident": dict(cur)})
             return events
         cur["polls"] += 1
+        if straggler.get("comm_axis"):
+            cur["comm_axis"] = straggler["comm_axis"]
         cur["peak_ratio"] = round(max(cur["peak_ratio"],
                                       float(straggler["ratio"])), 3)
         cur["peak_step_ms"] = round(max(cur["peak_step_ms"],
@@ -986,9 +1024,11 @@ class ClusterAggregator:
         if straggler is None:
             _G_STRAGGLER.set(-1)
             _G_CAUSE.set("none")
+            _G_COMM_AXIS.set("none")
         else:
             _G_STRAGGLER.set(int(straggler["rank"]))
             _G_CAUSE.set(straggler["cause"])
+            _G_COMM_AXIS.set(straggler.get("comm_axis") or "none")
         for ev in events:
             inc = ev["incident"]
             if ev["event"] == "open":
@@ -998,9 +1038,11 @@ class ClusterAggregator:
                 _logger().warning(
                     "cluster incident %d opened: rank %d is %.2fx the "
                     "peer median (%.2f ms over the last %d joined "
-                    "steps); dominant cause: %s",
+                    "steps); dominant cause: %s%s",
                     inc["id"], inc["rank"], inc["peak_ratio"],
-                    inc["peak_step_ms"], self.window, inc["cause"])
+                    inc["peak_step_ms"], self.window, inc["cause"],
+                    (" on mesh axis '%s'" % inc["comm_axis"])
+                    if inc.get("comm_axis") else "")
             elif ev["event"] == "close":
                 _logger().info(
                     "cluster incident %d closed: rank %d (%s) after "

@@ -651,6 +651,11 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
         telemetry.record_comm_bytes(
             int(sum(w._data.nbytes for w in weights) * frac),
             "all_gather")
+        # both legs ride the dp ring — attribute them to the axis so
+        # comm-skew tooling can blame dp rather than a lump sum
+        telemetry.record_axis_comm_bytes(
+            int(gbytes * frac)
+            + int(sum(w._data.nbytes for w in weights) * frac), "dp")
         _STATS["zero_steps"] += 1
     telemetry.record_opt_state_bytes(opt_state_bytes_per_device(
         s._data for sts in states for s in sts))

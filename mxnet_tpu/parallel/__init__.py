@@ -9,6 +9,7 @@ over ICI (the reference's gpu_topology.h spanning-tree solver has no
 equivalent here — the compiler owns topology).
 """
 from .mesh import make_mesh, default_mesh, data_parallel_spec, replicated
+from .mesh4d import MeshPlan, Mesh4DTrainer, mesh_plan_from_env
 from .trainer import SPMDTrainer
 from .ring_attention import (ring_attention, ring_self_attention,
                              ring_flash_attention,
@@ -23,6 +24,7 @@ from .pipeline import (gpipe_apply, pipeline_forward,
 from .moe import switch_moe, moe_expert_sharding
 
 __all__ = ["make_mesh", "default_mesh", "data_parallel_spec", "replicated",
+           "MeshPlan", "Mesh4DTrainer", "mesh_plan_from_env",
            "SPMDTrainer", "ring_attention", "ring_self_attention",
            "ring_flash_attention", "ring_flash_self_attention",
            "ulysses_attention", "ulysses_self_attention",

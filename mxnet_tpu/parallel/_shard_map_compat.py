@@ -1,20 +1,24 @@
-"""``jax.shard_map`` across jax versions.
+"""``shard_map`` with the modern kwarg surface, on the pinned jax.
 
-Newer jax exports :func:`jax.shard_map` with a ``check_vma`` kwarg; older
-releases only ship ``jax.experimental.shard_map.shard_map`` whose
-equivalent kwarg is ``check_rep``.  Every shard_map user in this package
-imports from here so the version probe lives in one place.
+Every shard_map user in this package imports from here so the API
+probe lives in one place.  Re-checked against the toolchain's jax
+(0.4.x): ``jax.shard_map`` is NOT exported there — the old
+``try: from jax import shard_map`` branch could never fire and has
+been deleted — so this wraps ``jax.experimental.shard_map.shard_map``
+directly, translating the modern ``check_vma`` kwarg to the
+experimental API's ``check_rep``.  When the toolchain moves to a jax
+that exports ``jax.shard_map`` (>= 0.6), this module shrinks to a
+re-export.
 """
 from __future__ import annotations
 
-try:                                     # jax >= 0.6
-    from jax import shard_map            # type: ignore[attr-defined]
-except ImportError:                      # jax 0.4/0.5
-    from jax.experimental.shard_map import shard_map as _shard_map_exp
+from jax.experimental.shard_map import shard_map as _shard_map_exp
 
-    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
-        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
-                              out_specs=out_specs, check_rep=check_vma,
-                              **kw)
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+    return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma,
+                          **kw)
+
 
 __all__ = ["shard_map"]

@@ -1,0 +1,728 @@
+"""Composable 4-D parallelism: dp × tp × pp × ep on ONE mesh.
+
+The scale lever ROADMAP names: a single :class:`MeshPlan` builds one
+``jax.sharding.Mesh`` carrying every parallelism axis the framework
+knows (dp/tp/pp/sp/ep, the mesh.py convention) and derives composed
+per-leaf ``NamedSharding``s from it, so the SAME compiled program
+combines:
+
+- **dp** — ZeRO weight-update sharding (arxiv 2004.13336): gradients
+  reduce-scatter onto the dp shards that own the optimizer state, the
+  updated weights all-gather back.  :meth:`MeshPlan.zero_spec` composes
+  the dp shard onto whatever other axes a leaf already carries.
+- **tp** — GSPMD tensor parallelism: column→row matmul pairs
+  constrained with ``with_sharding_constraint``
+  (:meth:`MeshPlan.tp_column` / :meth:`MeshPlan.tp_row`); XLA inserts
+  the activation partial-sum allreduce over 'tp'.
+- **pp** — the existing :func:`..pipeline.one_f_one_b_apply` 1F1B
+  lax-loop schedule, lifted by :class:`Mesh4DTrainer` so a whole
+  ``run_steps`` window stays ONE dispatch (PAPERS.md 1810.09868).
+- **ep** — :func:`..moe.switch_moe` expert dispatch: expert weights
+  sharded over 'ep', the dispatch/combine einsums lower to all_to_all.
+
+Axis sizes come from the constructor or ``MXNET_MESH`` (e.g.
+``MXNET_MESH=dp2,tp2`` — docs/ENV_VARS.md).  Requested axes are KEPT
+even at size 1, so a ``PartitionSpec`` mentioning 'tp' stays valid on a
+dp4×tp1 mesh — which is what lets an AMP/ZeRO checkpoint saved under
+dp2×tp2 restore onto dp4×tp1: the checkpoint service reassembles
+global arrays and this plan just re-places them.
+
+Every collective each axis carries is attributed to it through
+``telemetry.record_axis_comm_bytes`` (``comm.dp.bytes``,
+``comm.tp.bytes``, …) via the same analytic ring-cost model the dp-only
+funnels use — GSPMD inserts the collectives inside the executable where
+no host hook can count them, so the model is the accounting.
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as onp
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .. import telemetry
+from ..base import MXNetError
+from .mesh import make_mesh
+from .pipeline import pipeline_value_and_grad_1f1b
+
+__all__ = ["MeshPlan", "Mesh4DTrainer", "mesh_plan_from_env"]
+
+# device-grid axis order: pp outermost (stages are the coarsest, often
+# cross-slice boundary), tp innermost (its activation allreduces are
+# the latency-critical ones and want the tightest ICI ring)
+_AXIS_ORDER = ("pp", "dp", "ep", "sp", "tp")
+
+
+class MeshPlan:
+    """One mesh, every parallelism axis, composed shardings.
+
+    ``MeshPlan(dp=2, tp=2)`` on 4+ devices builds a mesh whose axis
+    names are exactly the requested ones (size-1 axes INCLUDED — specs
+    naming them stay valid, the cross-mesh-restore requirement).
+    ``dp=-1`` fills the devices the named axes leave over.
+    """
+
+    def __init__(self, dp: int = -1, tp: int = 1, pp: int = 1,
+                 ep: int = 1, sp: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        sizes = {"dp": int(dp), "tp": int(tp), "pp": int(pp),
+                 "ep": int(ep), "sp": int(sp)}
+        for ax, s in sizes.items():
+            if s == 0 or s < -1:
+                raise MXNetError(f"MeshPlan: bad {ax}={s} (>=1, or "
+                                 f"dp=-1 to fill)")
+            if s == -1 and ax != "dp":
+                raise MXNetError(f"MeshPlan: only dp may be -1, got "
+                                 f"{ax}=-1")
+        self._mesh = make_mesh({ax: sizes[ax] for ax in _AXIS_ORDER},
+                               devices)
+        self.dp = int(self._mesh.shape["dp"])
+        self.tp = int(self._mesh.shape["tp"])
+        self.pp = int(self._mesh.shape["pp"])
+        self.ep = int(self._mesh.shape["ep"])
+        self.sp = int(self._mesh.shape["sp"])
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_env(cls, default: Optional[str] = None,
+                 devices: Optional[Sequence[jax.Device]] = None
+                 ) -> Optional["MeshPlan"]:
+        """Build from ``MXNET_MESH`` (``dp2,tp2`` / ``dp=2,tp=2`` /
+        ``dp:2 tp:2``); None when unset and no ``default`` given."""
+        spec = os.environ.get("MXNET_MESH", default)
+        if not spec:
+            return None
+        sizes: Dict[str, int] = {}
+        for tok in re.split(r"[,\s]+", spec.strip()):
+            if not tok:
+                continue
+            m = re.fullmatch(r"(dp|tp|pp|ep|sp)[=:]?(-?\d+)", tok)
+            if m is None:
+                raise MXNetError(
+                    f"MXNET_MESH: cannot parse {tok!r} in {spec!r} "
+                    f"(expected e.g. dp2,tp2)")
+            sizes[m.group(1)] = int(m.group(2))
+        return cls(devices=devices, **sizes)
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def axis_sizes(self) -> Dict[str, int]:
+        return {ax: int(self._mesh.shape[ax]) for ax in _AXIS_ORDER}
+
+    def describe(self) -> str:
+        """One-line mesh summary for logs/reports."""
+        live = [f"{ax}{n}" for ax, n in self.axis_sizes.items() if n > 1]
+        return "×".join(live) if live else "single-device"
+
+    # -- shardings ---------------------------------------------------------
+    def named(self, *spec) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return self.named()
+
+    def batch_spec(self, ndim: int, batch_axis: int = 0,
+                   seq_axis: Optional[int] = None) -> PartitionSpec:
+        """Batch tensors: dp on the batch axis, sp on the sequence axis
+        when sequence parallelism is requested."""
+        spec = [None] * ndim
+        if batch_axis < ndim:
+            spec[batch_axis] = "dp"
+        if (seq_axis is not None and seq_axis < ndim
+                and seq_axis != batch_axis and self.sp > 1):
+            spec[seq_axis] = "sp"
+        return PartitionSpec(*spec)
+
+    def batch_sharding(self, ndim: int, batch_axis: int = 0,
+                       seq_axis: Optional[int] = None) -> NamedSharding:
+        return NamedSharding(self._mesh,
+                             self.batch_spec(ndim, batch_axis, seq_axis))
+
+    @staticmethod
+    def column_spec(ndim: int = 2) -> PartitionSpec:
+        """Column-parallel weight in the gluon (out, in) layout: the
+        OUTPUT dim sharded over 'tp' (each tp shard computes a slice of
+        the activations; no forward collective)."""
+        return PartitionSpec(*(("tp",) + (None,) * (ndim - 1)))
+
+    @staticmethod
+    def row_spec(ndim: int = 2) -> PartitionSpec:
+        """Row-parallel weight in the gluon (out, in) layout: the INPUT
+        dim sharded over 'tp' (partial sums — the forward allreduce the
+        column→row pair pays once)."""
+        return PartitionSpec(*((None,) * (ndim - 1) + ("tp",)))
+
+    def tp_column(self, x, feature_axis: int = -1):
+        """Constrain a column-parallel matmul's output: feature axis
+        sharded over 'tp'.  GSPMD then keeps the following elementwise
+        ops sharded instead of gathering."""
+        ax = feature_axis % x.ndim
+        spec = [None] * x.ndim
+        spec[ax] = "tp"
+        return jax.lax.with_sharding_constraint(x, self.named(*spec))
+
+    def tp_row(self, x):
+        """Constrain a row-parallel matmul's output replicated over
+        'tp' — the point GSPMD materializes the partial-sum allreduce
+        (the column→row pair's single forward collective)."""
+        return jax.lax.with_sharding_constraint(
+            x, self.named(*([None] * x.ndim)))
+
+    @staticmethod
+    def _spec_axes(spec) -> set:
+        used = set()
+        for s in spec or ():
+            if isinstance(s, (tuple, list)):
+                used.update(s)
+            elif s is not None:
+                used.add(s)
+        return used
+
+    def zero_spec(self, shape, base_spec: Optional[PartitionSpec] = None
+                  ) -> Optional[PartitionSpec]:
+        """Compose the ZeRO dp-shard onto ``base_spec``: the largest
+        still-unsharded dp-divisible axis takes 'dp'.  Returns the
+        composed spec, or ``base_spec`` unchanged (possibly None) when
+        nothing divides — small biases stay replicated, their memory is
+        noise.  This is the per-leaf composition rule the tentpole is
+        about: a P(None, 'tp') row weight's optimizer state becomes
+        P('dp', 'tp') — sharded over BOTH axes, 1/(dp·tp) per device.
+        """
+        if self.dp <= 1:
+            return base_spec
+        base = list(base_spec) if base_spec is not None else []
+        base += [None] * (len(shape or ()) - len(base))
+        used = self._spec_axes(base)
+        if "dp" in used:
+            return base_spec
+        best = None
+        for ax, dim in enumerate(shape or ()):
+            if base[ax] is not None:
+                continue            # already carries tp/pp/ep/sp
+            if dim % self.dp == 0 and (best is None
+                                       or dim > shape[best]):
+                best = ax
+        if best is None:
+            return base_spec
+        base[best] = "dp"
+        return PartitionSpec(*base)
+
+    def param_sharding(self, spec: Optional[PartitionSpec]
+                       ) -> NamedSharding:
+        return NamedSharding(self._mesh, spec or PartitionSpec())
+
+    def opt_state_sharding(self, shape,
+                           spec: Optional[PartitionSpec] = None,
+                           zero: bool = True) -> NamedSharding:
+        """Optimizer-state sharding for a leaf of ``shape`` whose param
+        carries ``spec``: the param's own axes plus (``zero=True``) the
+        composed ZeRO dp-shard."""
+        s = self.zero_spec(shape, spec) if zero else spec
+        return NamedSharding(self._mesh, s or PartitionSpec())
+
+    # -- analytic per-axis comm model --------------------------------------
+    def ring_bytes(self, nbytes: int, axis: str,
+                   kind: str = "allreduce") -> int:
+        """Ring-cost wire bytes for one collective of ``nbytes`` payload
+        over ``axis``: allreduce 2(n-1)/n, reduce_scatter / all_gather /
+        all_to_all (n-1)/n, ppermute the full payload per hop."""
+        n = self.axis_sizes.get(axis, 1)
+        if n <= 1:
+            return 0
+        if kind == "allreduce":
+            return 2 * int(nbytes) * (n - 1) // n
+        if kind == "ppermute":
+            return int(nbytes)
+        return int(nbytes) * (n - 1) // n
+
+
+def mesh_plan_from_env() -> Optional[MeshPlan]:
+    """The process-wide ``MXNET_MESH`` plan, or None when unset.  The
+    SPMD funnels consult this when no mesh was passed, so exporting
+    ``MXNET_MESH=dp2,tp2`` re-lays a run with no code change."""
+    return MeshPlan.from_env()
+
+
+class Mesh4DTrainer:
+    """Functional 4-D trainer: one jitted program per ``run_steps``
+    window composing dp (ZeRO), tp (GSPMD constraints or stage-level
+    psum), pp (1F1B), ep (MoE all_to_all) and the AMP policy.
+
+    Two composition paths, chosen by the plan's pp size:
+
+    - ``pp == 1`` — **GSPMD path**: ``stage_fn(params, x)`` is a plain
+      traced function; tensor parallelism comes from the param specs +
+      ``plan.tp_column``/``tp_row`` constraints, expert parallelism
+      from specs carrying 'ep' (switch_moe's einsums lower to
+      all_to_all).  ``stage_fn`` may return ``(out, aux_loss)`` (e.g.
+      the Switch load-balancing loss) or ``(out, aux_loss, dropped)``
+      to surface capacity-dropped token counts into telemetry.
+    - ``pp > 1`` — **1F1B path**: ``stage_fn(stage_params, h)`` is the
+      per-stage function :func:`..pipeline.one_f_one_b_apply` runs
+      under shard_map; param leaves carry a leading stage axis of size
+      pp and specs like ``P('pp', None, 'tp')``; intra-stage tensor
+      parallelism uses ``lax.psum(..., 'tp')`` (the stage_fn owns its
+      collectives — examples/parallel/pipeline_1f1b_3d.py is the
+      template).  Specs carrying 'ep' are rejected here: expert
+      parallelism composes on the GSPMD path.
+
+    Either way the optimizer (SGD + momentum) updates under composed
+    ZeRO shardings — ``with_sharding_constraint`` on the momentum/new
+    weights makes GSPMD emit reduce-scatter(grad) → sharded update →
+    all-gather(weight) on the dp axis — and the AMP policy's storage
+    dtype rides every gradient wire.  ``run_steps`` scans the whole
+    window inside ONE executable: exactly one dispatch per window.
+
+    Checkpoints go through the async sharded checkpoint service; the
+    saved tree holds fp32 masters as GLOBAL arrays, so a dp2×tp2 save
+    restores bit-identically onto a dp4×tp1 plan.
+    """
+
+    def __init__(self, plan: MeshPlan, stage_fn: Callable,
+                 loss_fn: Callable, params, *,
+                 param_specs=None, learning_rate: float = 0.01,
+                 momentum: float = 0.9, weight_decay: float = 0.0,
+                 n_microbatches: Optional[int] = None,
+                 zero: bool = True, donate: bool = True):
+        self.plan = plan
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.learning_rate = float(learning_rate)
+        self.momentum = float(momentum)
+        self.weight_decay = float(weight_decay)
+        self.zero = bool(zero)
+        self._donate = bool(donate)
+        self.n_microbatches = int(n_microbatches
+                                  if n_microbatches is not None
+                                  else max(plan.pp, 1))
+        self.num_update = 0
+        self._cache: Dict[Any, Any] = {}
+        self._comm_model: Optional[Dict[str, int]] = None
+
+        leaves, self._treedef = jax.tree_util.tree_flatten(params)
+        if param_specs is None:
+            specs = [None] * len(leaves)
+        else:
+            specs = jax.tree_util.tree_flatten(
+                param_specs, is_leaf=lambda s: s is None
+                or isinstance(s, PartitionSpec))[0]
+        if len(specs) != len(leaves):
+            raise MXNetError(
+                f"param_specs has {len(specs)} leaves, params "
+                f"{len(leaves)}")
+        if plan.pp > 1:
+            for lf, sp in zip(leaves, specs):
+                if lf.shape[0] != plan.pp:
+                    raise MXNetError(
+                        f"pp={plan.pp}: param leaf {lf.shape} must "
+                        f"carry a leading stage axis of size pp")
+                if "ep" in MeshPlan._spec_axes(sp):
+                    raise MXNetError(
+                        "expert parallelism ('ep' in a param spec) "
+                        "composes on the GSPMD path (pp=1); in-pipeline "
+                        "MoE runs with replicated experts")
+        self._specs = specs
+        # masters are fp32 on device under their composed shardings;
+        # momentum under the ZeRO-composed shardings
+        self._p_shardings = [plan.param_sharding(s) for s in specs]
+        self._m_shardings = [plan.opt_state_sharding(l.shape, s,
+                                                     zero=self.zero)
+                             for l, s in zip(leaves, specs)]
+        self._params = [jax.device_put(
+            jnp.asarray(l, jnp.float32)
+            if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+            else jnp.asarray(l), sh)
+            for l, sh in zip(leaves, self._p_shardings)]
+        self._momentum = [jax.device_put(jnp.zeros(l.shape, jnp.float32),
+                                         sh)
+                          for l, sh in zip(leaves, self._m_shardings)]
+
+        from ..amp import policy as _amp_policy
+        self._amp = _amp_policy.enabled()
+        if self._amp:
+            self._compute_dtype = jnp.dtype(_amp_policy.compute_dtype())
+            init = (2.0 ** 16
+                    if _amp_policy.compute_dtype_str() == "float16"
+                    else 1.0)
+            self._scale = jnp.float32(init)
+            self._good = jnp.float32(0.0)
+        else:
+            self._compute_dtype = None
+
+    # -- pytree views ------------------------------------------------------
+    @property
+    def params(self):
+        """Current fp32 master params as the constructor's pytree."""
+        return jax.tree_util.tree_unflatten(self._treedef, self._params)
+
+    # -- the traced step ---------------------------------------------------
+    def _cast(self, a):
+        if self._compute_dtype is not None and jnp.issubdtype(
+                a.dtype, jnp.floating):
+            return a.astype(self._compute_dtype)
+        return a
+
+    def _value_and_grads(self, p_list, x, y, scale):
+        """(mean_loss, grads[, dropped]) on either composition path.
+        The loss is scaled INSIDE (so f16 gradients stay representable)
+        and unscaled by the caller after the finite check."""
+        plan = self.plan
+        params = jax.tree_util.tree_unflatten(self._treedef, p_list)
+        if plan.pp > 1:
+            cfn = self._cast
+
+            def stage(sp, h):
+                return self.stage_fn(jax.tree_util.tree_map(cfn, sp),
+                                     cfn(h))
+
+            def lfn(out, t):
+                loss = self.loss_fn(out, t).astype(jnp.float32)
+                return loss * scale if scale is not None else loss
+
+            pspec = jax.tree_util.tree_unflatten(
+                self._treedef,
+                [s if s is not None else PartitionSpec("pp")
+                 for s in self._specs])
+            loss, grads = pipeline_value_and_grad_1f1b(
+                stage, lfn, params, self._cast(x), y, plan.mesh,
+                self.n_microbatches, axis_name="pp",
+                batch_axis_name="dp", param_specs=pspec)
+            return loss, jax.tree_util.tree_leaves(grads), None
+
+        def loss_of(p_list_in):
+            p = jax.tree_util.tree_unflatten(
+                self._treedef, [self._cast(a) for a in p_list_in])
+            res = self.stage_fn(p, self._cast(x))
+            dropped = None
+            aux = None
+            if isinstance(res, tuple):
+                out = res[0]
+                aux = res[1] if len(res) > 1 else None
+                dropped = res[2] if len(res) > 2 else None
+            else:
+                out = res
+            loss = self.loss_fn(out, y).astype(jnp.float32)
+            if aux is not None:
+                loss = loss + aux.astype(jnp.float32)
+            if scale is not None:
+                loss = loss * scale
+            return loss, dropped
+
+        (loss, dropped), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(list(p_list))
+        return loss, grads, dropped
+
+    def _constrain(self, a, sharding):
+        return jax.lax.with_sharding_constraint(a, sharding)
+
+    def _step(self, p_list, m_list, x, y, amp_state):
+        """One full training step (fwd+bwd+update), traced.  Returns
+        (new_p, new_m, loss, dropped, new_amp_state)."""
+        from ..amp import policy as _amp_policy
+        scale = amp_state[0] if self._amp else None
+        loss, grads, dropped = self._value_and_grads(p_list, x, y, scale)
+        lr = jnp.float32(self.learning_rate)
+        mu = jnp.float32(self.momentum)
+        wd = jnp.float32(self.weight_decay)
+
+        def do_update(p_in, g_in, m_in):
+            new_p, new_m = [], []
+            for w, g, m, psh, msh in zip(p_in, g_in, m_in,
+                                         self._p_shardings,
+                                         self._m_shardings):
+                g = g.astype(jnp.float32)
+                if self._amp:
+                    # wire discipline: the dp gradient leg ships the
+                    # policy storage dtype; masters update from the
+                    # dequantized value
+                    g = _amp_policy.wire_cast(g)
+                # reduce-scatter point: grads land dp-sharded where the
+                # momentum lives
+                g = self._constrain(g, msh)
+                m2 = self._constrain(mu * m + g, msh)
+                upd = m2 + wd * w.astype(jnp.float32)
+                # all-gather point: the updated master returns to the
+                # param's own sharding
+                w2 = self._constrain(
+                    (w.astype(jnp.float32) - lr * upd).astype(w.dtype),
+                    psh)
+                new_p.append(w2)
+                new_m.append(m2)
+            return new_p, new_m
+
+        if not self._amp:
+            new_p, new_m = do_update(list(p_list), grads, list(m_list))
+            return new_p, new_m, loss, dropped, amp_state
+
+        good = amp_state[1]
+        inv = 1.0 / scale
+        loss = loss * inv
+        grads = [g * inv.astype(g.dtype)
+                 if jnp.issubdtype(g.dtype, jnp.floating) else g
+                 for g in grads]
+        finite = jnp.bool_(True)
+        for g in grads:
+            if jnp.issubdtype(g.dtype, jnp.floating):
+                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+
+        def _apply(opnds):
+            p_in, g_in, m_in = opnds
+            return do_update(p_in, g_in, m_in)
+
+        def _skip(opnds):
+            p_in, _g, m_in = opnds
+            return list(p_in), list(m_in)
+
+        new_p, new_m = jax.lax.cond(
+            finite, _apply, _skip, (list(p_list), grads, list(m_list)))
+        # dynamic loss scale: grow after 2000 clean steps, halve on
+        # overflow (the LossScaler schedule, traced)
+        good1 = good + 1.0
+        grown = jnp.where(good1 >= 2000.0, scale * 2.0, scale)
+        new_scale = jnp.where(finite, grown,
+                              jnp.maximum(scale * 0.5, 1.0))
+        new_good = jnp.where(finite,
+                             jnp.where(good1 >= 2000.0, 0.0, good1), 0.0)
+        nskip = amp_state[2] + jnp.where(finite, 0.0, 1.0)
+        return new_p, new_m, loss, dropped, (new_scale, new_good, nskip)
+
+    def _build(self, data_shape, data_dtype, label_shape, label_dtype,
+               n_steps, per_step_data):
+        plan = self.plan
+
+        def many(p_list, m_list, x, y, amp_state):
+            def body(carry, xs):
+                p, m, amp = carry
+                d, l = (x, y) if xs is None else xs
+                new_p, new_m, loss, dropped, amp = self._step(
+                    p, m, d, l, amp)
+                drop = (jnp.int32(0) if dropped is None
+                        else dropped.astype(jnp.int32))
+                return (new_p, new_m, amp), (loss, drop)
+            (p, m, amp), (losses, drops) = jax.lax.scan(
+                body, (list(p_list), list(m_list), amp_state),
+                (x, y) if per_step_data else None,
+                length=None if per_step_data else n_steps)
+            return p, m, losses, jnp.sum(drops), amp
+
+        rep = plan.replicated
+        if per_step_data:
+            dsh = NamedSharding(plan.mesh, PartitionSpec(
+                None, *self.plan.batch_spec(len(data_shape) - 1)))
+            lsh = NamedSharding(plan.mesh, PartitionSpec(
+                None, *self.plan.batch_spec(len(label_shape) - 1)))
+        else:
+            dsh = plan.batch_sharding(len(data_shape))
+            lsh = plan.batch_sharding(len(label_shape))
+        amp_sh = (rep, rep, rep)
+        in_shardings = (self._p_shardings, self._m_shardings, dsh, lsh,
+                        amp_sh)
+        out_shardings = (self._p_shardings, self._m_shardings, rep, rep,
+                         amp_sh)
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(many, in_shardings=in_shardings,
+                       out_shardings=out_shardings,
+                       donate_argnums=donate)
+
+    # -- the host API ------------------------------------------------------
+    def _amp_state_in(self):
+        if not self._amp:
+            return (jnp.float32(1.0), jnp.float32(0.0), jnp.float32(0.0))
+        return (self._scale, self._good, jnp.float32(0.0))
+
+    def run_steps(self, data, label, n_steps: int = 1,
+                  per_step_data: bool = False):
+        """``n_steps`` fused training steps in ONE dispatch (lax.scan
+        inside one jitted executable).  With ``per_step_data=True`` the
+        inputs carry a leading ``n_steps`` axis consumed one batch per
+        step.  Returns the per-step losses as a device array."""
+        import time as _time
+        d = jnp.asarray(data)
+        l = jnp.asarray(label)
+        if per_step_data and (d.shape[0] != n_steps
+                              or l.shape[0] != n_steps):
+            raise MXNetError(
+                f"run_steps(per_step_data=True): leading axis must be "
+                f"n_steps={n_steps}, got {d.shape}/{l.shape}")
+        sig = (d.shape, str(d.dtype), l.shape, str(l.dtype),
+               int(n_steps), bool(per_step_data))
+        jitted = self._cache.get(sig)
+        fresh = jitted is None
+        if fresh:
+            jitted = self._build(d.shape, str(d.dtype), l.shape,
+                                 str(l.dtype), int(n_steps),
+                                 per_step_data)
+            self._cache[sig] = jitted
+        tok = telemetry.begin_step()
+        try:
+            from .. import tracing
+            with tracing.span("step.mesh4d_window",
+                              n_steps=int(n_steps),
+                              mesh=self.plan.describe()):
+                tc = _time.perf_counter() if fresh else None
+                with tracing.span("compile.spmd_step" if fresh
+                                  else "step.dispatch"):
+                    new_p, new_m, losses, dropped, amp = jitted(
+                        self._params, self._momentum, d, l,
+                        self._amp_state_in())
+                    telemetry.record_dispatch()
+                if tc is not None:
+                    telemetry.record_compile(_time.perf_counter() - tc,
+                                             "spmd_step")
+                self._params = list(new_p)
+                self._momentum = list(new_m)
+                if self._amp:
+                    self._scale, self._good = amp[0], amp[1]
+                self.num_update += int(n_steps)
+                self._account(int(n_steps),
+                              d[0] if per_step_data else d)
+                telemetry.record_moe_dropped(dropped)
+        finally:
+            telemetry.end_step(tok, "Mesh4DTrainer",
+                               extra={"n_steps": int(n_steps)})
+        return losses
+
+    def step(self, data, label):
+        """One training step; returns the scalar loss array."""
+        return self.run_steps(data, label, n_steps=1)[0]
+
+    # -- per-axis comm accounting ------------------------------------------
+    def _account(self, n_steps: int, d) -> None:
+        """Analytic per-axis wire attribution for one window (ring-cost
+        model — GSPMD's collectives are inside the executable, so the
+        model IS the accounting, same as the dp-only funnels):
+
+        - dp: gradient reduce-scatter + master all-gather (ZeRO) or the
+          folded allreduce, at the AMP wire itemsize on gradient legs.
+        - tp: one activation partial-sum allreduce per tp-sharded
+          matmul, forward + backward.
+        - pp: each microbatch's activations ppermute S-1 hops forward
+          and S-1 back.
+        - ep: dispatch + combine all_to_all, forward + backward.
+        """
+        model = self._comm_model
+        if model is None:
+            from ..amp import policy as _amp_policy
+            plan = self.plan
+            isz = _amp_policy.compute_itemsize() if self._amp else 4
+            gfrac = isz / 4.0
+            model = {ax: 0 for ax in ("dp", "tp", "pp", "ep")}
+            rs = ag = ar = 0
+            for lf, spec, msh in zip(self._params, self._specs,
+                                     self._m_shardings):
+                nb = int(lf.nbytes)
+                if plan.dp > 1:
+                    if "dp" in MeshPlan._spec_axes(msh.spec):
+                        rs += plan.ring_bytes(int(nb * gfrac), "dp",
+                                              "reduce_scatter")
+                        ag += plan.ring_bytes(nb, "dp", "all_gather")
+                    else:
+                        ar += plan.ring_bytes(int(nb * gfrac), "dp",
+                                              "allreduce")
+            model["dp"] = rs + ag + ar
+            self._comm_split = (rs, ag, ar)
+            # activation volume: one step's batch in compute-dtype
+            # bytes (tokens × features) — coarse but stable
+            act_elems = int(onp.prod(d.shape)) or 1
+            act_bytes = act_elems * isz
+            if plan.tp > 1:
+                n_tp = sum(1 for s in self._specs
+                           if "tp" in MeshPlan._spec_axes(s))
+                model["tp"] = 2 * max(n_tp, 1) * plan.ring_bytes(
+                    act_bytes, "tp", "allreduce")
+            if plan.pp > 1:
+                mb = act_bytes // max(self.n_microbatches, 1)
+                model["pp"] = (2 * self.n_microbatches * (plan.pp - 1)
+                               * plan.ring_bytes(mb, "pp", "ppermute"))
+            if plan.ep > 1:
+                model["ep"] = 4 * plan.ring_bytes(act_bytes, "ep",
+                                                  "all_to_all")
+            self._comm_model = model
+        rs, ag, ar = self._comm_split
+        if rs or ag:
+            telemetry.record_comm_bytes(rs * n_steps, "reduce_scatter")
+            telemetry.record_comm_bytes(ag * n_steps, "all_gather")
+        if ar:
+            telemetry.record_comm_bytes(ar * n_steps, "allreduce")
+        if model["tp"]:
+            telemetry.record_comm_bytes(model["tp"] * n_steps,
+                                        "allreduce")
+        if model["pp"]:
+            telemetry.record_comm_bytes(model["pp"] * n_steps,
+                                        "ppermute")
+        if model["ep"]:
+            telemetry.record_comm_bytes(model["ep"] * n_steps,
+                                        "all_to_all")
+        for ax, b in model.items():
+            if b:
+                telemetry.record_axis_comm_bytes(b * n_steps, ax)
+        telemetry.record_opt_state_bytes(self.state_bytes_per_device(
+            params=False))
+
+    def state_bytes_per_device(self, params: bool = True) -> int:
+        """Bytes of fp32 masters (+``params``) and momentum resident on
+        the busiest device — the per-device memory the ZeRO×tp
+        composition exists to shrink."""
+        from ..optimizer.fused_step import opt_state_bytes_per_device
+        arrays = list(self._momentum)
+        if params:
+            arrays += list(self._params)
+        return opt_state_bytes_per_device(arrays)
+
+    # -- checkpoint --------------------------------------------------------
+    def save_checkpoint(self, directory, tag="latest", block=True):
+        """fp32 masters + momentum through the async sharded checkpoint
+        service.  The manifest records this plan's axis sizes as
+        provenance; restore does NOT require them to match — shards
+        reassemble to global arrays and re-place under the loading
+        plan's composed shardings."""
+        from .. import checkpoint as _ckpt
+        tree = {}
+        for i, (p, m) in enumerate(zip(self._params, self._momentum)):
+            tree[f"param/{i}"] = p
+            tree[f"momentum/{i}"] = m
+        header = {"num_update": int(self.num_update),
+                  "mesh_axes": self.plan.axis_sizes,
+                  "n_leaves": len(self._params)}
+        if self._amp:
+            header["amp"] = {"scale": float(self._scale),
+                             "good": float(self._good)}
+        rank, world = _ckpt.rank_world()
+        job = _ckpt.save(directory, tree, header, tag=tag, block=block,
+                         rank=rank, world=world)
+        return job.result() if block else job
+
+    def load_checkpoint(self, directory, tag="latest"):
+        """Restore a :meth:`save_checkpoint` snapshot onto THIS plan's
+        shardings (any mesh shape — a dp2×tp2 save restores onto
+        dp4×tp1 bit-identically).  Returns the header dict or None."""
+        from .. import checkpoint as _ckpt
+        loaded = _ckpt.load(directory, tag)
+        if loaded is None:
+            return None
+        leaves, header = loaded
+        n = int(header.get("n_leaves", len(self._params)))
+        if n != len(self._params):
+            raise MXNetError(
+                f"checkpoint has {n} param leaves, trainer has "
+                f"{len(self._params)}")
+        for i in range(n):
+            self._params[i] = jax.device_put(
+                jnp.asarray(leaves[f"param/{i}"]), self._p_shardings[i])
+            self._momentum[i] = jax.device_put(
+                jnp.asarray(leaves[f"momentum/{i}"]),
+                self._m_shardings[i])
+        self.num_update = int(header.get("num_update", self.num_update))
+        amp_hdr = header.get("amp")
+        if amp_hdr and self._amp:
+            self._scale = jnp.float32(amp_hdr.get("scale", 1.0))
+            self._good = jnp.float32(amp_hdr.get("good", 0.0))
+        return dict(header)

@@ -20,21 +20,31 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from .. import telemetry
+
 __all__ = ["switch_moe", "moe_expert_sharding"]
 
 
-def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25
-               ) -> Tuple[jax.Array, jax.Array]:
+def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25,
+               return_stats: bool = False):
     """Top-1 (Switch) MoE layer.
 
     Tokens route to their argmax expert, subject to a per-expert
     capacity of ``ceil(N/E * capacity_factor)`` — overflow tokens pass
     through with zero expert output (standard Switch behavior, which
-    keeps every shape static for XLA).
+    keeps every shape static for XLA).  Dropped tokens are ACCOUNTED,
+    never silent: an eager call ticks the ``moe.dropped_tokens``
+    telemetry counter directly; a traced caller passes
+    ``return_stats=True`` and folds ``stats['dropped_tokens']`` out of
+    the executable (Mesh4DTrainer records it per window via
+    ``telemetry.record_moe_dropped``).
 
     Returns ``(y, aux_loss)`` where ``aux_loss`` is the Switch
     load-balancing loss (E · Σ_e f_e · p̄_e) to be added to the training
-    objective.
+    objective — or ``(y, aux_loss, stats)`` with ``return_stats=True``,
+    where ``stats`` carries ``dropped_tokens`` (int32 scalar),
+    ``capacity`` (static int) and ``expert_load`` ((E,) tokens routed
+    per expert, pre-drop).
     """
     n, h = x.shape
     e = gate_w.shape[1]
@@ -67,6 +77,17 @@ def switch_moe(x, gate_w, w1, b1, w2, b2, capacity_factor: float = 1.25
     frac_tokens = jnp.mean(onehot, axis=0)                # f_e
     frac_probs = jnp.mean(probs, axis=0)                  # p̄_e
     aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    # capacity-overflow accounting: tokens the cap zeroed out.  keep is
+    # exactly onehot minus the overflow rows, so N - Σkeep IS the drop.
+    dropped = (n - jnp.sum(keep)).astype(jnp.int32)
+    if return_stats:
+        stats = {"dropped_tokens": dropped, "capacity": cap,
+                 "expert_load": jnp.sum(onehot, axis=0)}
+        return y, aux, stats
+    if not isinstance(dropped, jax.core.Tracer):
+        # eager call: the count is concrete — account it here
+        telemetry.record_moe_dropped(int(dropped))
     return y, aux
 
 

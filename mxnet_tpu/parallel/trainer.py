@@ -60,6 +60,18 @@ class SPMDTrainer:
         # which quadruples the host->device transfer; on TPU the wire is
         # the scarce resource, so the transform belongs device-side.)
         self._data_transform = data_transform
+        # ``mesh`` accepts a raw jax Mesh OR a parallel.mesh4d.MeshPlan
+        # (the composed-axes front door); with neither, an exported
+        # MXNET_MESH=dp2,tp2 lays out the run, else dp over all devices
+        self.plan = None
+        if mesh is not None and not isinstance(mesh, Mesh):
+            self.plan = mesh
+            mesh = mesh.mesh
+        elif mesh is None:
+            from .mesh4d import mesh_plan_from_env
+            self.plan = mesh_plan_from_env()
+            if self.plan is not None:
+                mesh = self.plan.mesh
         self.mesh = mesh or default_mesh()
         self.batch_axis = batch_axis
         # sequence parallelism: shard this data axis over the mesh's
@@ -164,12 +176,43 @@ class SPMDTrainer:
             spec = self._zero_spec(param)
         return NamedSharding(self.mesh, spec or PartitionSpec())
 
+    def _composed_zero_spec(self, param):
+        """Compose the ZeRO dp-shard ONTO the param's existing spec:
+        the largest still-unsharded dp-divisible axis takes 'dp', so a
+        P(None, 'tp') row weight's optimizer state lands P('dp', 'tp')
+        — 1/(dp·tp) per device, the 4-D composition rule.  Returns the
+        spec unchanged when dp is absent/1, already used, or nothing
+        divides."""
+        spec = param._sharding
+        if "dp" not in self.mesh.axis_names:
+            return spec
+        ndp = self.mesh.shape["dp"]
+        if ndp <= 1:
+            return spec
+        shape = param.shape or ()
+        base = list(spec) if spec is not None else []
+        base += [None] * (len(shape) - len(base))
+        for s in base:
+            if s == "dp" or (isinstance(s, (tuple, list)) and "dp" in s):
+                return spec
+        best = None
+        for ax, dim in enumerate(shape):
+            if base[ax] is not None:
+                continue            # already carries tp/pp/sp/ep
+            if dim % ndp == 0 and (best is None or dim > shape[best]):
+                best = ax
+        if best is None:
+            return spec
+        base[best] = "dp"
+        return PartitionSpec(*base)
+
     def _opt_state_sharding(self, param):
         """Optimizer-state sharding: follows the param (TP etc.), plus
-        the ZeRO dp-shard for otherwise-replicated params."""
+        the ZeRO dp-shard composed onto whatever axes the param already
+        carries."""
         spec = param._sharding
-        if spec is None and self.zero_stage >= 1:
-            spec = self._zero_spec(param)
+        if self.zero_stage >= 1:
+            spec = self._composed_zero_spec(param)
         return NamedSharding(self.mesh, spec or PartitionSpec())
 
     def _batch_sharding(self, ndim):
@@ -512,6 +555,7 @@ class SPMDTrainer:
             data.ndim if hasattr(data, "ndim") else onp.ndim(data)))
         l = self._stage_input(label, self._batch_sharding(
             label.ndim if hasattr(label, "ndim") else onp.ndim(label)))
+        self._last_tokens = self._token_count(d)
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype))
         entry = self._step_cache.get(sig)
         fresh = entry is None
@@ -543,6 +587,7 @@ class SPMDTrainer:
                         new_p, new_s, loss, aux = jitted(
                             next_key(), lr, wd, p_arrays, opt_state,
                             d, l)
+                    telemetry.record_dispatch()
                 if tc is not None:
                     telemetry.record_compile(time.perf_counter() - tc,
                                              "spmd_step")
@@ -577,6 +622,32 @@ class SPMDTrainer:
                 return True
         return False
 
+    @staticmethod
+    def _spec_axis_names(spec) -> set:
+        used = set()
+        for s in spec or ():
+            if isinstance(s, (tuple, list)):
+                used.update(s)
+            elif s is not None:
+                used.add(s)
+        return used
+
+    @staticmethod
+    def _token_count(d) -> int:
+        """Token count of one batch for the tp activation-volume model:
+        integer inputs of rank >= 2 are (B, T) id grids — B·T tokens;
+        anything else contributes its batch rows."""
+        shape = getattr(d, "shape", None)
+        if not shape:
+            return 1
+        try:
+            is_int = jnp.issubdtype(d.dtype, jnp.integer)
+        except Exception:
+            is_int = False
+        if is_int and len(shape) >= 2:
+            return int(shape[0]) * int(shape[1])
+        return int(shape[0])
+
     def _account_step_telemetry(self, n_steps: int = 1) -> None:
         """Per-step collective-byte split + opt-state residency gauge.
         GSPMD inserts the collectives inside the compiled program, where
@@ -587,10 +658,15 @@ class SPMDTrainer:
         volume, the arxiv 2004.13336 identity the ZeRO tradeoff rests
         on.  The model is computed once (shapes and shardings are
         static per trainer)."""
+        tokens = getattr(self, "_last_tokens", 1)
         model = self._comm_model
+        if model is not None and model[4] != tokens:
+            model = None        # batch geometry changed: re-derive
         if model is None:
             ndp = int(self.mesh.shape.get("dp", 1)) \
                 if "dp" in self.mesh.axis_names else 1
+            ntp = int(self.mesh.shape.get("tp", 1)) \
+                if "tp" in self.mesh.axis_names else 1
             # gradient legs (reduce-scatter / allreduce) ship in the AMP
             # storage dtype under the policy; the all-gather leg returns
             # f32 master weights and stays full-width
@@ -598,23 +674,45 @@ class SPMDTrainer:
             gfrac = 1.0
             if self._amp_scaler is not None:
                 gfrac = min(_amp_policy.compute_itemsize(), 4) / 4.0
-            rs = ag = ar = 0
-            if ndp > 1:
-                for k in self._pkeys:
-                    p = self._params[k]
-                    nbytes = int(p.data()._data.nbytes)
+            isz = (_amp_policy.compute_itemsize()
+                   if self._amp_scaler is not None else 4)
+            rs = ag = ar = tpb = 0
+            for k in self._pkeys:
+                p = self._params[k]
+                nbytes = int(p.data()._data.nbytes)
+                if ndp > 1:
                     if self._spec_has_dp(self._opt_state_sharding(p).spec):
                         rs += int(nbytes * gfrac) * (ndp - 1) // ndp
                         ag += nbytes * (ndp - 1) // ndp
                     else:
                         ar += 2 * int(nbytes * gfrac) * (ndp - 1) // ndp
-            model = self._comm_model = (rs, ag, ar)
-        rs, ag, ar = model
+                # tp activation partial-sum allreduce, one per sharded
+                # matmul per direction: a column-parallel (out,in)
+                # weight pays it on the backward dx (tokens × in), a
+                # row-parallel one on the forward y (tokens × out) —
+                # the dim the shard does NOT split
+                spec = p._sharding
+                shape = p.shape or ()
+                if (ntp > 1 and len(shape) >= 2
+                        and "tp" in self._spec_axis_names(spec)):
+                    first = spec[0] if len(spec) else None
+                    col = first == "tp" or (
+                        isinstance(first, (tuple, list)) and "tp" in first)
+                    dim = int(shape[1]) if col else int(shape[0])
+                    tpb += 2 * tokens * dim * isz * (ntp - 1) // ntp
+            model = self._comm_model = (rs, ag, ar, tpb, tokens)
+        rs, ag, ar, tpb, _ = model
         if rs or ag:
             telemetry.record_comm_bytes(rs * n_steps, "reduce_scatter")
             telemetry.record_comm_bytes(ag * n_steps, "all_gather")
         if ar:
             telemetry.record_comm_bytes(ar * n_steps, "allreduce")
+        if rs or ag or ar:
+            telemetry.record_axis_comm_bytes((rs + ag + ar) * n_steps,
+                                             "dp")
+        if tpb:
+            telemetry.record_comm_bytes(tpb * n_steps, "allreduce")
+            telemetry.record_axis_comm_bytes(tpb * n_steps, "tp")
         telemetry.record_opt_state_bytes(self.opt_state_bytes_per_device())
 
     def _gather_state(self):
@@ -674,6 +772,8 @@ class SPMDTrainer:
             raise MXNetError(
                 f"run_steps(per_step_data=True): leading axis must be "
                 f"n_steps={n_steps}, got data {d.shape} label {l.shape}")
+        self._last_tokens = self._token_count(
+            d[0] if per_step_data else d)
         sig = (d.shape, str(d.dtype), l.shape, str(l.dtype), int(n_steps),
                bool(per_step_data))
         entry = self._step_cache.get(sig)
@@ -711,6 +811,9 @@ class SPMDTrainer:
                         new_p, new_s, losses = jitted(
                             next_key(), lr, wd, p_arrays, opt_state,
                             d, l)
+                    # the whole fused window is ONE executable launch —
+                    # the record's ``dispatches`` delta asserts it
+                    telemetry.record_dispatch()
                 if tc is not None:
                     telemetry.record_compile(time.perf_counter() - tc,
                                              "spmd_step")
@@ -935,6 +1038,12 @@ class SPMDTrainer:
             "rng_key": [int(w) for w in _rand.get_state_bits().ravel()],
             "slots": {k: len(self._opt_state[k]) for k in self._pkeys},
             "meta": dict(meta or {}),
+            # mesh provenance (informational — restore re-places global
+            # arrays under the LOADING trainer's mesh, so a dp2×tp2
+            # save restores onto dp4×tp1; the header just records where
+            # the bytes came from for post-mortems)
+            "mesh_axes": {ax: int(self.mesh.shape[ax])
+                          for ax in self.mesh.axis_names},
         }
         # AMP provenance: the tree always holds fp32 MASTER weights (the
         # compute-dtype casts live in the traced step, never in the

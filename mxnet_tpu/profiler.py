@@ -113,7 +113,12 @@ def counters() -> Dict[str, Dict[str, int]]:
     - ``compile``: jit compiles + compile wall ms across every compile
       site (op funnel, fused step, CachedOp, cached step, SPMD step,
       serving engine)
-    - ``comm``: collective payload bytes (dense + sparse kvstore paths)
+    - ``comm``: collective payload bytes (dense + sparse kvstore
+      paths), plus ``by_axis`` — the same wire re-bucketed by the mesh
+      axis that carried it (dp/tp/pp/sp/ep, parallel/mesh4d.py)
+    - ``moe``: Switch-MoE routing health (tokens dropped by the
+      per-expert capacity cap — parallel/moe.py; staying 0 is the
+      balanced-router signal)
     - ``serving``: the inference subsystem (requests/batches served,
       eager fallback batches, bucket compiles, shed/expired requests —
       mxnet_tpu/serving/), plus the ``slo`` burn-rate engine's
@@ -165,7 +170,12 @@ def counters() -> Dict[str, Dict[str, int]]:
             "dispatch": {"count": telemetry.counter("dispatch.count").value},
             "compile": {"count": telemetry.counter("compile.count").value,
                         "ms": telemetry.counter("compile.ms").value},
-            "comm": {"bytes": telemetry.counter("comm.bytes").value},
+            "comm": {"bytes": telemetry.counter("comm.bytes").value,
+                     "by_axis": {
+                         ax: telemetry.counter(f"comm.{ax}.bytes").value
+                         for ax in telemetry.MESH_AXES}},
+            "moe": {"dropped_tokens":
+                    telemetry.counter("moe.dropped_tokens").value},
             "serving": {
                 "requests": telemetry.counter("serving.requests").value,
                 "batches": telemetry.counter("serving.batches").value,

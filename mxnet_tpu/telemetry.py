@@ -286,6 +286,22 @@ _C_RS_BYTES = counter("comm.reduce_scatter.bytes")
 _C_AG_BYTES = counter("comm.all_gather.bytes")
 _C_AR_BYTES = counter("comm.allreduce.bytes")
 _G_OPT_STATE = gauge("opt_state.bytes_per_device")
+# per-mesh-axis collective attribution (parallel/mesh4d.py and the step
+# funnels write these): the SAME wire bytes the kind-split above counts,
+# re-bucketed by WHICH mesh axis the collective rode — dp gradient
+# sync, tp activation partial-sum allreduces, pp ppermute activation
+# hops, ep all_to_all dispatch/combine, sp ring K/V exchange.  An
+# attribution VIEW, not an additive ledger: axis bytes do NOT fold into
+# comm.bytes (the kind counters already did), so skew tooling can blame
+# the axis without double counting the total.
+MESH_AXES = ("dp", "tp", "pp", "sp", "ep")
+_C_AXIS_BYTES = {ax: counter(f"comm.{ax}.bytes") for ax in MESH_AXES}
+# Switch-MoE capacity overflow: tokens whose expert queue was full and
+# therefore passed through with ZERO expert output (parallel/moe.py).
+# A rising rate means the router is imbalanced or capacity_factor is
+# too small — quality silently degrades with no loss-curve signature,
+# which is why it gets a first-class counter.
+_C_MOE_DROPPED = counter("moe.dropped_tokens")
 # custom-kernel layer health (mxnet_tpu/kernels/ writes these): config
 # resolutions served from the persistent autotune cache vs falling to
 # the default config, wall ms + measurement runs spent tuning (both
@@ -365,6 +381,36 @@ def record_comm_bytes(n: int, kind: str = "dense") -> None:
     sparse gathered nnz payloads, compressed packed payloads)."""
     _C_COMM_BYTES.inc(int(n))
     counter(f"comm.{kind}.bytes").inc(int(n))
+
+
+def record_axis_comm_bytes(n: int, axis: str) -> None:
+    """Attribute collective payload bytes to the mesh axis that carried
+    them (``comm.<axis>.bytes`` for axis in :data:`MESH_AXES`).  Pure
+    attribution — does NOT increment ``comm.bytes`` (callers account
+    the total through :func:`record_comm_bytes`'s kind split; this
+    second bucketing answers "which axis", the first "which
+    collective")."""
+    c = _C_AXIS_BYTES.get(axis)
+    if c is None:        # unknown axis name: still record, never lose it
+        c = counter(f"comm.{axis}.bytes")
+    c.inc(int(n))
+
+
+def record_dispatch(n: int = 1) -> None:
+    """Account ``n`` XLA executable launches on this funnel's critical
+    path.  The SPMD step funnels call this once per jitted call — a
+    whole ``run_steps`` window is ONE launch, which is exactly what the
+    per-step record's ``dispatches`` delta asserts in CI."""
+    _C_DISPATCH.inc(int(n))
+
+
+def record_moe_dropped(n) -> None:
+    """Account Switch-MoE tokens dropped by the per-expert capacity cap
+    (zero expert output passed through).  ``n`` may be a device scalar —
+    coerced on the host, off the traced path."""
+    n = int(n)
+    if n > 0:
+        _C_MOE_DROPPED.inc(n)
 
 
 def record_op_time(name: str, seconds: float) -> None:
@@ -632,7 +678,8 @@ class _StepToken:
                  "krn_hits", "krn_misses", "krn_tune_ms", "krn_tune_runs",
                  "krn_fallbacks", "emb_pull", "emb_push", "emb_sbytes",
                  "emb_dbytes", "emb_hits", "emb_misses", "emb_evicts",
-                 "emb_spills", "amp_overflows", "amp_skipped", "buckets")
+                 "emb_spills", "amp_overflows", "amp_skipped", "buckets",
+                 "axis_bytes", "moe_dropped")
 
     def __init__(self):
         self.t0 = time.perf_counter()
@@ -670,6 +717,8 @@ class _StepToken:
         self.emb_spills = _C_EMB_SPILLS.value
         self.amp_overflows = _C_AMP_OVERFLOWS.value
         self.amp_skipped = _C_AMP_SKIPPED.value
+        self.axis_bytes = {ax: c.value for ax, c in _C_AXIS_BYTES.items()}
+        self.moe_dropped = _C_MOE_DROPPED.value
         from . import tracing
         self.buckets = tracing.bucket_totals_ms()
 
@@ -789,6 +838,13 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "reduce_scatter": _C_RS_BYTES.value - token.rs_bytes,
             "all_gather": _C_AG_BYTES.value - token.ag_bytes,
             "allreduce": _C_AR_BYTES.value - token.ar_bytes,
+            # the same window's bytes re-bucketed by the mesh axis that
+            # carried them (dp gradient sync, tp activation allreduce,
+            # pp ppermute hops, ep all_to_all, sp ring exchange) — the
+            # field comm-skew attribution names an axis from
+            "by_axis": {
+                ax: _C_AXIS_BYTES[ax].value - token.axis_bytes[ax]
+                for ax in MESH_AXES},
         },
         "opt_state_bytes": _G_OPT_STATE.value,
         "device_mem": device_memory_record(),
@@ -862,6 +918,13 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "overflow_steps": _C_AMP_OVERFLOWS.value
             - token.amp_overflows,
             "skipped_updates": _C_AMP_SKIPPED.value - token.amp_skipped,
+        }
+    # Switch-MoE capacity overflow in this step's window.  Only present
+    # once any token has ever been dropped (a non-MoE run's — or a
+    # perfectly balanced router's — records are unchanged).
+    if _C_MOE_DROPPED.value > 0:
+        record["moe"] = {
+            "dropped_tokens": _C_MOE_DROPPED.value - token.moe_dropped,
         }
     # serving SLO state at this step's emission.  Only present while
     # objectives are declared (serving/slo.py installs the provider);

@@ -228,7 +228,7 @@ def test_profiler_counters_snapshot():
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
                       "serving", "input", "tracing", "checkpoint",
-                      "cluster", "kernel", "embedding", "amp"}
+                      "cluster", "kernel", "embedding", "amp", "moe"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
                                     "steps", "zero_steps"}
@@ -237,7 +237,9 @@ def test_profiler_counters_snapshot():
     assert c["optimizer"]["dispatches"] >= 0
     assert c["dispatch"]["count"] >= 0
     assert set(c["compile"]) == {"count", "ms"}
-    assert set(c["comm"]) == {"bytes"}
+    assert set(c["comm"]) == {"bytes", "by_axis"}
+    assert set(c["comm"]["by_axis"]) == {"dp", "tp", "pp", "sp", "ep"}
+    assert set(c["moe"]) == {"dropped_tokens"}
     assert set(c["serving"]) == {"requests", "batches", "eager_batches",
                                  "compiles", "rejects", "timeouts",
                                  "slo"}

@@ -12,7 +12,9 @@ over the ``rank-*.jsonl`` spools a finished (or dead) run left behind:
   asymmetry — the rank with ~zero barrier wait is the one the others
   waited FOR),
 - the straggler verdict: which rank, how much slower than the peer
-  median, and the dominant cause class with its per-signal excess.
+  median, and the dominant cause class with its per-signal excess —
+  a ``comm_skew`` verdict also names the mesh axis (dp / tp / pp / ep,
+  from ``collective_split.by_axis``) carrying the skewed volume.
 
 Spool lifecycle aware: each rank's records are reassembled from its
 rotated segments (``rank-<r>.jsonl.<k>`` in ``k`` order, torn lines
@@ -220,6 +222,21 @@ def render(a):
         cp = a["ranks"][r]["critical_path"]
         lines.append(f"  {r:<5}" + "".join(
             f"{cp.get(c, 0.0):>11.2f}" for c in _CP_COLS))
+    # per-mesh-axis collective bytes (collective_split.by_axis means)
+    # — only rendered when some rank reported axis-attributed comm,
+    # i.e. the run trained on a composed dp×tp×pp×ep mesh
+    ax_cols = sorted({ax for r in a["ranks"].values()
+                      for ax, v in (r.get("comm_axis_bytes") or
+                                    {}).items() if v})
+    if ax_cols:
+        lines += ["", "Mean collective bytes per step, by mesh axis",
+                  "-" * 72,
+                  "  rank " + "".join(f"{'comm.' + c:>13}"
+                                      for c in ax_cols)]
+        for r in sorted(a["ranks"]):
+            ab = a["ranks"][r].get("comm_axis_bytes") or {}
+            lines.append(f"  {r:<5}" + "".join(
+                f"{ab.get(c, 0.0):>13.0f}" for c in ax_cols))
     sk = a["skew"]
     if sk:
         ratio = f"{sk['step_ratio']:.2f}x" if sk["step_ratio"] else "n/a"
@@ -265,7 +282,9 @@ def render(a):
             f"  rank {st['rank']} is the straggler: "
             f"{st['step_ms']:.2f} ms mean vs peer median "
             f"{st['peer_ms']:.2f} ms ({st['ratio']:.2f}x)",
-            f"  dominant cause: {st['cause']}",
+            f"  dominant cause: {st['cause']}"
+            + (f" (mesh axis: {st['comm_axis']})"
+               if st.get("comm_axis") else ""),
             "  per-signal excess over peer median (ms): "
             + ", ".join(f"{k}={v:.2f}"
                         for k, v in st["excess_ms"].items())]
@@ -284,9 +303,12 @@ def render_incidents(incidents):
     for inc in incidents:
         end = inc.get("end_rank_step")
         dur = inc.get("duration_s")
+        cause = str(inc.get("cause", "?"))
+        if inc.get("comm_axis"):
+            cause += f"({inc['comm_axis']})"
         lines.append(
             f"  {inc.get('id', '?'):<4}{inc.get('rank', '?'):<6}"
-            f"{inc.get('cause', '?'):<19}"
+            f"{cause:<19}"
             f"{inc.get('start_rank_step', 0):>10}"
             f"{end if end is not None else '-':>11}"
             f"{dur if dur is not None else '-':>8}"

@@ -15,7 +15,10 @@ section: saves published, failed saves, bytes committed — the
 with optimizer-sharding signal (``collective_split`` /
 ``opt_state_bytes`` fields, emitted under MXNET_ZERO or zero_stage>=1)
 get an "Optimizer sharding" section: per-device optimizer-state
-residency and the reduce-scatter / all-gather vs allreduce byte split.
+residency, the reduce-scatter / all-gather vs allreduce byte split,
+and the per-mesh-axis attribution (``comm.dp`` grad sync vs ``comm.tp``
+activation all-reduce vs ``comm.pp``/``comm.ep``) when the run trained
+on a composed mesh.
 Runs with custom-kernel signal (``kernel`` delta payloads from
 mxnet_tpu/kernels/) get a "Kernels" section: autotune-cache hit/miss
 traffic, tune wall time, steps stalled by a first-encounter tune, and
@@ -205,11 +208,18 @@ def summarize(records):
     opt_bytes = [r.get("opt_state_bytes", 0) for r in records
                  if r.get("opt_state_bytes")]
     sharding = None
-    if opt_bytes or any(any(c.values()) for c in splits):
-        n = len(records) or 1
-        rs = sum(c.get("reduce_scatter", 0) for c in splits)
-        ag = sum(c.get("all_gather", 0) for c in splits)
-        ar = sum(c.get("allreduce", 0) for c in splits)
+    n = len(records) or 1
+    rs = sum(c.get("reduce_scatter", 0) for c in splits)
+    ag = sum(c.get("all_gather", 0) for c in splits)
+    ar = sum(c.get("allreduce", 0) for c in splits)
+    # per-mesh-axis attribution (collective_split.by_axis) — which
+    # axis (dp grad sync / tp activation all-reduce / pp ppermute /
+    # ep all_to_all) the modeled comm volume rode on
+    by_axis: dict = {}
+    for c in splits:
+        for ax, v in (c.get("by_axis") or {}).items():
+            by_axis[ax] = by_axis.get(ax, 0) + v
+    if opt_bytes or rs or ag or ar or any(by_axis.values()):
         sharding = {
             "opt_state_bytes_per_device": max(opt_bytes, default=0),
             "reduce_scatter_bytes_per_step": rs / n,
@@ -217,6 +227,9 @@ def summarize(records):
             "allreduce_bytes_per_step": ar / n,
             "sharded_update_steps": sum(
                 1 for c in splits if c.get("reduce_scatter", 0)),
+            "comm_axis_bytes_per_step": {
+                ax: tot / n for ax, tot in sorted(by_axis.items())
+                if tot},
         }
     # custom-kernel layer deltas (mxnet_tpu/kernels/): autotune-cache
     # hit/miss traffic, steps stalled by a first-encounter tune, and
@@ -519,6 +532,9 @@ def render(s):
             f"{'sharded-update steps':<28}"
             f"{sh['sharded_update_steps']:>24}",
         ]
+        for ax, v in (sh.get("comm_axis_bytes_per_step") or {}).items():
+            lines.append(f"{'comm.' + ax + ' bytes / step':<28}"
+                         f"{v:>24.1f}")
     kn = s.get("kernel")
     if kn:
         lines += [
