@@ -925,6 +925,22 @@ embedding_smoke() {   # sharded embedding tables: tests + DLRM bench gates
     rm -rf "$tmp"
 }
 
+decode_smoke() {      # autoregressive decode: tests + continuous-batching gates
+    # tier-1 covers page-allocator recycling/exhaustion, paged-attention
+    # ragged parity vs the dense oracle, scheduler parity vs
+    # greedy_reference, the zero-recompile admission contract,
+    # spec-vs-greedy token identity (matched AND mismatched drafts),
+    # the drain/fail-fast/deadline-eviction lifecycle matrix, and the
+    # /generate error mapping — all in-process (CPU, no sockets)
+    JAX_PLATFORMS=cpu python -m pytest tests/test_decode.py -q
+    # then the bench must hold all three gates: open-loop Poisson at
+    # 10x the sequential baseline's request rate yields >=3x tokens/s,
+    # the measured window sees 0 new compiles, and greedy speculative
+    # decode is token-identical to the non-speculative path (exits
+    # non-zero otherwise)
+    JAX_PLATFORMS=cpu python benchmark/decode_bench.py --smoke
+}
+
 nightly() {           # slower second-tier pass rerun in isolation
     # (parity: tests/nightly/ + the reference's CI matrix)
     sanitize

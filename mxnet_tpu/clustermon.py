@@ -128,7 +128,7 @@ _C_INCIDENT_CAUSE = {
     c: telemetry.counter(_INCIDENTS_FAMILY + c)
     for c in ("input_bound", "compile_stall", "ckpt_interference",
               "comm_skew", "latency_slo", "error_budget",
-              "queue_saturation", "unknown")}
+              "queue_saturation", "ttft_slo", "unknown")}
 
 # string-gauge values ever rendered, per metric — the stale-series fix:
 # a scrape emits the CURRENT value at 1 and every previously-seen value
@@ -483,7 +483,8 @@ CAUSES = ("input_bound", "compile_stall", "ckpt_interference",
 
 # serving-side incident causes (serving/slo.py burn-rate alerting);
 # same IncidentStore state machine and incidents_total counter family
-SERVING_CAUSES = ("latency_slo", "error_budget", "queue_saturation")
+SERVING_CAUSES = ("latency_slo", "error_budget", "queue_saturation",
+                  "ttft_slo")
 
 _SIG_OF_CAUSE = {"input_bound": "input", "compile_stall": "compile",
                  "ckpt_interference": "checkpoint", "comm_skew": "comm"}

@@ -21,5 +21,7 @@ from . import image   # noqa: F401
 from . import rnn     # noqa: F401
 from . import contrib_extra  # noqa: F401
 from . import layernorm_residual  # noqa: F401
+from . import rope    # noqa: F401
+from . import paged_attention  # noqa: F401
 
 __all__ = ["register", "get", "list_ops", "invoke", "apply_jax"]

@@ -1,0 +1,253 @@
+"""Paged attention: one query token per slot over a paged KV pool.
+
+The decode serving plane (serving/decode/) keeps every slot's KV
+history in a pre-allocated page pool ``(num_pages, page_size, H, D)``
+plus a per-slot page table ``(max_slots, pages_per_slot)`` — sequence
+state lives behind traced integer indices, so one compiled
+``decode_step`` serves any mix of lengths (the fixed-shape-executable
+invariant, docs/ARCHITECTURE.md "Decode serving").
+
+The Pallas path rides ``PrefetchScalarGridSpec``: the page table and
+per-slot lengths are scalar-prefetched, and the K/V BlockSpec index
+maps dereference ``table[slot, page]`` directly, so the pipeline DMAs
+exactly the pages each slot owns — no gather materialization.  Grid is
+``(slots, pages_per_slot, page_size // block_k)`` with online-softmax
+f32 accumulators in VMEM scratch persisting across the two inner
+dims; pages wholly past a slot's length are skipped via ``pl.when``.
+Slots with length 0 (inactive) produce exact zeros, matching the
+oracle.
+
+The XLA fallback (:func:`paged_attention_reference`) gathers
+``pool[tables]`` and runs a masked softmax — the numerics oracle the
+parity tests pin the kernel against across ragged lengths.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .. import kernels as _kernels
+from .registry import register
+
+__all__ = ["paged_attention", "paged_attention_reference"]
+
+_NEG_INF = -1e30
+_ACC_LANES = 128            # m/l scratch lane broadcast (TPU tiling)
+
+_PAGED_ENV_KEY = "MXNET_TPU_PAGED_BLOCK_K"
+_paged_env_snapshot: tuple = (False,)          # impossible sentinel
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, lengths,
+                              sm_scale=None):
+    """Gather-based oracle: q (S, H, D), pools (pages, ps, H, D),
+    tables (S, P) int32, lengths (S,) int32 → (S, H, D).  Positions at
+    or past a slot's length are masked; length-0 slots yield zeros."""
+    s_, h, d = q.shape
+    ps = k_pool.shape[1]
+    p_ = tables.shape[1]
+    scale = sm_scale if sm_scale is not None else 1.0 / math.sqrt(d)
+    k = k_pool[tables].reshape(s_, p_ * ps, h, d).astype(jnp.float32)
+    v = v_pool[tables].reshape(s_, p_ * ps, h, d).astype(jnp.float32)
+    scores = jnp.einsum("shd,skhd->shk", q.astype(jnp.float32), k) * scale
+    kpos = lax.broadcasted_iota(jnp.int32, scores.shape, 2)
+    mask = kpos < lengths[:, None, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    m = scores.max(axis=-1, keepdims=True)
+    p = jnp.where(mask, jnp.exp(scores - m), 0.0)
+    l = p.sum(axis=-1, keepdims=True)
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = jnp.einsum("shk,skhd->shd", p / l, v)
+    return out.astype(q.dtype)
+
+
+def _pa_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+               acc_ref, m_ref, l_ref, *, sm_scale, block_k, page_size):
+    s_i = pl.program_id(0)
+    p_i = pl.program_id(1)
+    b_i = pl.program_id(2)
+    np_ = pl.num_programs(1)
+    nb = pl.num_programs(2)
+
+    @pl.when((p_i == 0) & (b_i == 0))
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    length = len_ref[s_i]
+    start = p_i * page_size + b_i * block_k
+
+    @pl.when(start < length)
+    def _body():
+        q = q_ref[0]                              # (H, D)
+        kt = jnp.swapaxes(k_ref[0], 0, 1)         # (H, block_k, D)
+        vt = jnp.swapaxes(v_ref[0], 0, 1).astype(jnp.float32)
+        s = lax.dot_general(q, kt, (((1,), (2,)), ((0,), (0,))),
+                            preferred_element_type=jnp.float32) * sm_scale
+        kpos = start + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        mask = kpos < length
+        s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:, :1]                     # (H, 1)
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        corr = jnp.exp(m_prev - m_cur)
+        p = jnp.where(mask, jnp.exp(s - m_cur), 0.0)
+        l_new = l_ref[:, :1] * corr + p.sum(axis=1, keepdims=True)
+        pv = lax.dot_general(p, vt, (((1,), (1,)), ((0,), (0,))),
+                             preferred_element_type=jnp.float32)
+        acc_ref[...] = acc_ref[...] * corr + pv
+        m_ref[...] = jnp.broadcast_to(m_cur, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when((p_i == np_ - 1) & (b_i == nb - 1))
+    def _finish():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l).astype(o_ref.dtype)
+
+
+def _paged_attention_pallas(q, k_pool, v_pool, tables, lengths,
+                            sm_scale, block_k):
+    s_, h, d = q.shape
+    page_size = k_pool.shape[1]
+    p_ = tables.shape[1]
+    block_k = max(1, min(int(block_k), page_size))
+    block_k = math.gcd(block_k, page_size)    # must tile the page
+    kernel = functools.partial(
+        _pa_kernel, sm_scale=float(sm_scale), block_k=block_k,
+        page_size=page_size)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s_, p_, page_size // block_k),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda s, p, b, tbl, ln: (s, 0, 0)),
+            pl.BlockSpec((1, block_k, h, d),
+                         lambda s, p, b, tbl, ln: (tbl[s, p], b, 0, 0)),
+            pl.BlockSpec((1, block_k, h, d),
+                         lambda s, p, b, tbl, ln: (tbl[s, p], b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda s, p, b, tbl, ln: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h, d), jnp.float32),
+            pltpu.VMEM((h, _ACC_LANES), jnp.float32),
+            pltpu.VMEM((h, _ACC_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s_, h, d), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+# -- kernel-registry integration -------------------------------------------
+
+def _paged_signature(q, k_pool, v_pool, tables, lengths, sm_scale=None):
+    """Slots/pages/page-size are fixed by the serving deployment, so
+    they key exactly; ragged per-slot lengths deliberately share one
+    entry (they are data, not shape)."""
+    from ..amp import policy as _amp_policy
+    return (f"s{q.shape[0]}_h{q.shape[1]}_d{q.shape[2]}"
+            f"_ps{k_pool.shape[1]}_p{tables.shape[1]}",
+            _amp_policy.kernel_key_dtype(str(q.dtype)))
+
+
+def _paged_kernel_run(config, q, k_pool, v_pool, tables, lengths,
+                      sm_scale=None):
+    scale = (sm_scale if sm_scale is not None
+             else 1.0 / math.sqrt(q.shape[-1]))
+    return _paged_attention_pallas(q, k_pool, v_pool, tables, lengths,
+                                   float(scale), int(config["block_k"]))
+
+
+def _paged_kernel_fallback(q, k_pool, v_pool, tables, lengths,
+                           sm_scale=None):
+    return paged_attention_reference(q, k_pool, v_pool, tables, lengths,
+                                     sm_scale=sm_scale)
+
+
+def _paged_make_args(case):
+    import numpy as onp
+    rng = onp.random.RandomState(17)
+    slots, pps = case["slots"], case["pages_per_slot"]
+    ps, h, d = case["page_size"], case["h"], case["d"]
+    dtype = case.get("dtype", "float32")
+    num_pages = slots * pps + 1
+    q = jnp.asarray(rng.randn(slots, h, d) * 0.5, dtype=dtype)
+    k_pool = jnp.asarray(rng.randn(num_pages, ps, h, d) * 0.5, dtype=dtype)
+    v_pool = jnp.asarray(rng.randn(num_pages, ps, h, d) * 0.5, dtype=dtype)
+    tables = jnp.asarray(
+        rng.permutation(num_pages - 1)[:slots * pps].reshape(slots, pps),
+        jnp.int32)
+    # ragged lengths, a zero (inactive slot) included
+    lengths = rng.randint(0, pps * ps + 1, size=(slots,))
+    lengths[0] = 0
+    return (q, k_pool, v_pool, tables,
+            jnp.asarray(lengths, jnp.int32)), {}
+
+
+_kernels.register_kernel(_kernels.KernelSpec(
+    "paged_attention", version=1,
+    run=_paged_kernel_run, fallback=_paged_kernel_fallback,
+    config_space={"block_k": (16, 32, 64, 128)},
+    default_config={"block_k": 64},
+    signature=_paged_signature, make_args=_paged_make_args,
+    tune_grid=({"slots": 8, "pages_per_slot": 4, "page_size": 64,
+                "h": 4, "d": 64},
+               {"slots": 4, "pages_per_slot": 8, "page_size": 128,
+                "h": 8, "d": 64}),
+))
+
+
+def _resolve_paged_block(q, k_pool, v_pool, tables, lengths, scale):
+    global _paged_env_snapshot
+    env = (os.environ.get(_PAGED_ENV_KEY),)
+    if env != _paged_env_snapshot:
+        _paged_env_snapshot = env
+        _kernels.invalidate("paged_attention")
+    if env[0] is not None:
+        try:
+            v = int(env[0])
+        except ValueError:
+            v = 0
+        if v > 0:
+            return v
+    sig, dt = _paged_signature(q, k_pool, v_pool, tables, lengths)
+    cfg = _kernels.resolve(
+        "paged_attention", sig, dt,
+        tune_args=((q, k_pool, v_pool, tables, lengths),
+                   {"sm_scale": scale}))
+    return int(cfg["block_k"])
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    sm_scale=None, block_k=None):
+    """One attention step per slot against its paged KV history.
+
+    ``q (slots, H, D)`` — one query token per slot; ``k_pool/v_pool
+    (num_pages, page_size, H, D)``; ``tables (slots, pages_per_slot)``
+    int32 page ids; ``lengths (slots,)`` int32 valid context lengths
+    (0 = inactive slot → zero output)."""
+    scale = (sm_scale if sm_scale is not None
+             else 1.0 / math.sqrt(q.shape[-1]))
+    if block_k is None:
+        block_k = _resolve_paged_block(q, k_pool, v_pool, tables,
+                                       lengths, float(scale))
+    return _paged_attention_pallas(q, k_pool, v_pool, tables, lengths,
+                                   float(scale), int(block_k))
+
+
+register("paged_attention", aliases=("_npx_paged_attention",))(
+    lambda q, k_pool, v_pool, tables, lengths, sm_scale=None,
+    block_k=None:
+    paged_attention(q, k_pool, v_pool, tables, lengths,
+                    sm_scale=sm_scale, block_k=block_k))

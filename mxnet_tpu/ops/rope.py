@@ -1,0 +1,187 @@
+"""Fused rotary position embedding (RoPE) — kernel-registry phase 2.
+
+One Pallas kernel applies the NeoX-style half-split rotation in place:
+for head-dim pairs ``(i, i + D/2)`` the rotation angle at position
+``p`` is ``p * base**(-2i/D)``, so
+
+    out[..., :D/2] = x1 * cos - x2 * sin
+    out[..., D/2:] = x2 * cos + x1 * sin
+
+with ``x1/x2`` the two halves.  The fused path computes angles from an
+in-kernel iota (no host-materialized cos/sin tables) and streams
+``(block_r, H, D)`` row blocks through VMEM; positions cross the
+boundary lane-broadcast like flash attention's lse (attention.py
+``_LSE_LANES``).  The XLA lowering (:func:`rope_reference`) is both
+the production fallback and the numerics oracle tests pin against.
+
+Registered through ``mxnet_tpu.kernels`` as ``rope`` with a block-size
+config space; the decode serving plane (serving/decode/) applies it to
+every q/k projection, and training attention stacks can call
+:func:`rope` on (B, S, H, D) activations directly.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from .. import kernels as _kernels
+from .registry import register
+
+__all__ = ["rope", "rope_reference"]
+
+# positions cross the pallas boundary lane-broadcast (TPU (8, 128)
+# block-tiling rule — see attention.py _LSE_LANES)
+_POS_LANES = 128
+
+_ROPE_ENV_KEY = "MXNET_TPU_ROPE_BLOCK_R"
+_rope_env_snapshot: tuple = (False,)          # impossible sentinel
+
+
+def rope_reference(x, positions, base=10000.0):
+    """XLA RoPE on ``x (..., H, D)`` with ``positions`` shaped like
+    ``x.shape[:-2]`` (or scalar) — fallback and oracle."""
+    d = x.shape[-1]
+    half = d // 2
+    xf = x.astype(jnp.float32)
+    pos = jnp.broadcast_to(jnp.asarray(positions), x.shape[:-2])
+    pos = pos.astype(jnp.float32)[..., None, None]        # (..., 1, 1)
+    k = jnp.arange(half, dtype=jnp.float32)
+    inv = jnp.exp(k * (-math.log(base) / half))           # base^(-2i/D)
+    ang = pos * inv                                       # (..., 1, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = xf[..., :half], xf[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _rope_kernel(x_ref, pos_ref, o_ref, *, base, half):
+    x = x_ref[...].astype(jnp.float32)        # (block_r, H, D)
+    pos = pos_ref[:, :1]                      # (block_r, 1): lane 0
+    k = lax.broadcasted_iota(jnp.float32, (1, 1, half), 2)
+    inv = jnp.exp(k * (-math.log(base) / half))
+    ang = pos[:, :, None] * inv               # (block_r, 1, half)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate([x1 * cos - x2 * sin,
+                           x2 * cos + x1 * sin], axis=-1)
+    o_ref[...] = out.astype(o_ref.dtype)
+
+
+def _ceil_to(x, m):
+    return (x + m - 1) // m * m
+
+
+def _rope_pallas(x, positions, base, block_r):
+    """x (R, H, D), positions (R,) → rotated (R, H, D)."""
+    r, h, d = x.shape
+    if d % 2:
+        raise ValueError(f"rope requires an even head_dim, got {d}")
+    block_r = max(1, min(block_r, _ceil_to(r, 8)))
+    pad = _ceil_to(r, block_r) - r
+    pos = jnp.asarray(positions).astype(jnp.float32)
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+        pos = jnp.pad(pos, (0, pad))
+    pos = jnp.broadcast_to(pos[:, None], (pos.shape[0], _POS_LANES))
+    out = pl.pallas_call(
+        functools.partial(_rope_kernel, base=float(base), half=d // 2),
+        grid=(x.shape[0] // block_r,),
+        in_specs=[
+            pl.BlockSpec((block_r, h, d), lambda i: (i, 0, 0)),
+            pl.BlockSpec((block_r, _POS_LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_r, h, d), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=jax.default_backend() != "tpu",
+    )(x, pos)
+    return out[:r] if pad else out
+
+
+# -- kernel-registry integration -------------------------------------------
+
+def _rope_signature(x, positions, base=10000.0):
+    from ..amp import policy as _amp_policy
+    from .attention import _pow2_bucket
+    return (f"r{_pow2_bucket(x.shape[0], floor=64)}"
+            f"_h{x.shape[1]}_d{x.shape[2]}",
+            _amp_policy.kernel_key_dtype(str(x.dtype)))
+
+
+def _rope_kernel_run(config, x, positions, base=10000.0):
+    return _rope_pallas(x, positions, base, int(config["block_r"]))
+
+
+def _rope_kernel_fallback(x, positions, base=10000.0):
+    return rope_reference(x, jnp.asarray(positions), base=base)
+
+
+def _rope_make_args(case):
+    import numpy as onp
+    rng = onp.random.RandomState(23)
+    r, h, d = case["r"], case["h"], case["d"]
+    x = jnp.asarray(rng.randn(r, h, d) * 0.5,
+                    dtype=case.get("dtype", "float32"))
+    pos = jnp.asarray(rng.randint(0, 4096, size=(r,)), jnp.int32)
+    return (x, pos), {"base": float(case.get("base", 10000.0))}
+
+
+_kernels.register_kernel(_kernels.KernelSpec(
+    "rope", version=1,
+    run=_rope_kernel_run, fallback=_rope_kernel_fallback,
+    config_space={"block_r": (32, 64, 128, 256)},
+    default_config={"block_r": 128},
+    signature=_rope_signature, make_args=_rope_make_args,
+    tune_grid=({"r": 128, "h": 4, "d": 64},
+               {"r": 512, "h": 8, "d": 128}),
+))
+
+
+def _resolve_rope_block(xf, pos, base):
+    """block_r for one call: env override > registry (memo/disk/tune/
+    default), snapshot-invalidated like attention's flash blocks."""
+    global _rope_env_snapshot
+    env = (os.environ.get(_ROPE_ENV_KEY),)
+    if env != _rope_env_snapshot:
+        _rope_env_snapshot = env
+        _kernels.invalidate("rope")
+    if env[0] is not None:
+        try:
+            v = int(env[0])
+        except ValueError:
+            v = 0
+        if v > 0:
+            return v
+    sig, dt = _rope_signature(xf, pos, base)
+    cfg = _kernels.resolve("rope", sig, dt,
+                           tune_args=((xf, pos), {"base": base}))
+    return int(cfg["block_r"])
+
+
+def rope(x, positions, *, base=10000.0, block_r=None):
+    """Rotary embedding on ``x (..., H, D)`` at integer ``positions``
+    shaped like ``x.shape[:-2]`` (scalars broadcast).  Leading axes are
+    flattened into row blocks for the kernel and restored after."""
+    x = jnp.asarray(x)
+    lead = x.shape[:-2]
+    r = 1
+    for n in lead:
+        r *= n
+    if r == 0:
+        return x
+    xf = x.reshape((r,) + x.shape[-2:])
+    pos = jnp.broadcast_to(jnp.asarray(positions), lead).reshape(r)
+    if block_r is None:
+        block_r = _resolve_rope_block(xf, pos, float(base))
+    out = _rope_pallas(xf, pos, float(base), int(block_r))
+    return out.reshape(x.shape)
+
+
+register("rope", aliases=("_npx_rope",))(
+    lambda x, positions, base=10000.0, block_r=None:
+    rope(x, positions, base=base, block_r=block_r))

@@ -125,6 +125,10 @@ def counters() -> Dict[str, Dict[str, int]]:
       activity (whether objectives are declared, evaluation passes,
       sampled requests, latency-target breaches, errored requests,
       SLO incidents opened — serving/slo.py)
+    - ``decode``: the autoregressive decode plane (tokens emitted,
+      prompt tokens prefilled, scheduler steps, deadline/shutdown slot
+      evictions, speculative proposals vs accepted, live slot/page
+      occupancy — mxnet_tpu/serving/decode/)
     - ``input``: the device-feed pipeline (consumer blocked-on-input
       wall ms, host→device payload bytes, inline step-path transfers —
       data/device_pipeline.py; ``step_h2d`` staying flat across steps
@@ -200,6 +204,21 @@ def counters() -> Dict[str, Dict[str, int]]:
                     "incidents":
                         telemetry.counter(
                             "serving_slo.incidents").value}},
+            "decode": {
+                "tokens": telemetry.counter("decode.tokens").value,
+                "prefill_tokens":
+                    telemetry.counter("decode.prefill_tokens").value,
+                "steps": telemetry.counter("decode.steps").value,
+                "evictions":
+                    telemetry.counter("decode.evictions").value,
+                "spec_proposed":
+                    telemetry.counter("decode.spec_proposed").value,
+                "spec_accepted":
+                    telemetry.counter("decode.spec_accepted").value,
+                "slots_active":
+                    telemetry.gauge("decode.slots_active").value or 0,
+                "pages_used":
+                    telemetry.gauge("decode.pages_used").value or 0},
             "input": {
                 "wait_ms": telemetry.counter("input.wait_ms").value,
                 "h2d_bytes": telemetry.counter("input.h2d_bytes").value,

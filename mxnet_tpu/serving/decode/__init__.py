@@ -1,0 +1,18 @@
+"""Autoregressive decode serving: continuous batching over a paged KV
+cache with optional speculative decode.
+
+- :mod:`paged_kv` — pre-allocated device page pool + host free-list
+  allocator with per-slot page tables;
+- :mod:`engine` — the small causal LM + fixed-shape compiled decode /
+  prefill / draft / verify executables;
+- :mod:`scheduler` — the continuous batcher (``DecodeScheduler``):
+  per-step admission/eviction, chunked prefill, speculative accept.
+
+See docs/ARCHITECTURE.md "Decode serving".
+"""
+from .paged_kv import OutOfPagesError, PageAllocator, PagedKVCache
+from .engine import DecodeEngine, DecodeModel
+from .scheduler import DecodeScheduler
+
+__all__ = ["PageAllocator", "PagedKVCache", "OutOfPagesError",
+           "DecodeModel", "DecodeEngine", "DecodeScheduler"]

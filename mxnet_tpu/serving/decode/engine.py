@@ -1,0 +1,507 @@
+"""Decode engine: fixed-shape compiled executables over paged KV state.
+
+Every device-side path is ONE jit-compiled executable per static
+shape, compiled lazily on first use and reused forever (the
+fixed-shape-executable invariant):
+
+- ``decode_step`` — one token per active slot over the full
+  ``(max_slots,)`` grid: active-slot mask, per-slot positions and page
+  tables are traced int arrays, so admission/completion NEVER
+  recompiles;
+- ``prefill[bucket]`` — one prompt chunk for one slot, chunk length
+  padded into pow2 sequence buckets (chunked prefill: long prompts
+  are fed bucket-by-bucket so running decodes aren't stalled behind
+  one long prompt);
+- ``draft``/``verify`` — the speculative path: the draft model
+  proposes ``k`` tokens per slot (its own paged KV pool, same page
+  geometry, shared page tables), then the target model scores all
+  ``k+1`` positions in a single dispatch and accepts the longest
+  matching prefix on device (greedy speculative decode is
+  token-identical to the non-speculative path by construction: every
+  emitted token is the target's own argmax).
+
+Attention inside ``decode_step``/``verify`` runs through the
+``paged_attention`` kernel registrant (ops/paged_attention.py) and all
+rotary embeddings through the ``rope`` registrant (ops/rope.py), so
+block configs resolve through the kernel autotune cache exactly like
+flash attention in training.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as onp
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ... import telemetry
+from ...ops.paged_attention import paged_attention
+from ...ops.rope import rope, rope_reference
+from .paged_kv import PagedKVCache
+
+__all__ = ["DecodeModel", "DecodeEngine"]
+
+_NEG_INF = -1e30
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        v = int(os.environ.get(name, default))
+    except ValueError:
+        return default
+    return v if v > 0 else default
+
+
+def _pow2(n: int, floor: int) -> int:
+    b = max(1, floor)
+    while b < n:
+        b *= 2
+    return b
+
+
+def _rms(x, g, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    scale = lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * g
+
+
+class DecodeModel:
+    """A small causal LM as a plain parameter pytree + pure functions.
+
+    Deliberately framework-free (no gluon Block machinery): the decode
+    executables trace straight jnp math over ``self.params``, which is
+    what lets the engine AOT-compile them against fixed shapes.  The
+    LM head is tied to the embedding."""
+
+    def __init__(self, vocab_size: int, *, dim: int = 64,
+                 n_heads: int = 4, n_layers: int = 2, mlp_ratio: int = 2,
+                 rope_base: float = 10000.0, seed: int = 0,
+                 dtype="float32"):
+        if dim % n_heads:
+            raise ValueError(f"dim {dim} not divisible by heads {n_heads}")
+        if (dim // n_heads) % 2:
+            raise ValueError("head_dim must be even for rope")
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.n_heads = int(n_heads)
+        self.n_layers = int(n_layers)
+        self.head_dim = dim // n_heads
+        self.rope_base = float(rope_base)
+        rng = onp.random.RandomState(seed)
+
+        def mat(*shape, scale):
+            return jnp.asarray(rng.randn(*shape) * scale, dtype=dtype)
+
+        w = 1.0 / (dim ** 0.5)
+        layers = []
+        for _ in range(n_layers):
+            layers.append({
+                "ln1": jnp.ones((dim,), dtype=dtype),
+                "wq": mat(dim, dim, scale=w),
+                "wk": mat(dim, dim, scale=w),
+                "wv": mat(dim, dim, scale=w),
+                "wo": mat(dim, dim, scale=w),
+                "ln2": jnp.ones((dim,), dtype=dtype),
+                "w1": mat(dim, mlp_ratio * dim, scale=w),
+                "w2": mat(mlp_ratio * dim, dim,
+                          scale=1.0 / ((mlp_ratio * dim) ** 0.5)),
+            })
+        self.params: Dict[str, Any] = {
+            "embed": mat(vocab_size, dim, scale=0.5),
+            "layers": layers,
+            "lnf": jnp.ones((dim,), dtype=dtype),
+        }
+
+    # -- dense full-recompute oracle (tests pin the paged path to it) --------
+
+    def _ref_logits_last(self, tokens):
+        """Last-position logits of a dense causal forward over the
+        whole sequence — O(T^2) recompute, eager, test-only."""
+        t = tokens.shape[0]
+        pos = jnp.arange(t, dtype=jnp.int32)
+        x = self.params["embed"][tokens]
+        h_, hd = self.n_heads, self.head_dim
+        scale = 1.0 / (hd ** 0.5)
+        for lp in self.params["layers"]:
+            h1 = _rms(x, lp["ln1"])
+            q = rope_reference((h1 @ lp["wq"]).reshape(t, h_, hd), pos,
+                               base=self.rope_base)
+            k = rope_reference((h1 @ lp["wk"]).reshape(t, h_, hd), pos,
+                               base=self.rope_base)
+            v = (h1 @ lp["wv"]).reshape(t, h_, hd)
+            s = jnp.einsum("qhd,khd->hqk", q.astype(jnp.float32),
+                           k.astype(jnp.float32)) * scale
+            qp = lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            kp = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(qp >= kp, s, _NEG_INF)
+            p = jax.nn.softmax(s, axis=-1)
+            o = jnp.einsum("hqk,khd->qhd", p, v.astype(jnp.float32))
+            x = x + o.reshape(t, self.dim).astype(x.dtype) @ lp["wo"]
+            h2 = _rms(x, lp["ln2"])
+            x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+        x = _rms(x, self.params["lnf"])
+        return x[-1] @ self.params["embed"].T
+
+    def greedy_reference(self, prompt, max_new_tokens: int,
+                         eos: Optional[int] = None) -> List[int]:
+        """Reference greedy generation (dense attention, full recompute
+        per token).  Returns the generated tokens only."""
+        toks = [int(t) for t in prompt]
+        out: List[int] = []
+        for _ in range(int(max_new_tokens)):
+            nxt = int(jnp.argmax(self._ref_logits_last(
+                jnp.asarray(toks, jnp.int32))))
+            out.append(nxt)
+            toks.append(nxt)
+            if eos is not None and nxt == int(eos):
+                break
+        return out
+
+
+# -- traced cores ------------------------------------------------------------
+
+def _write_kv(pool, li, idx, k, v):
+    """Scatter this step's K/V rows into layer ``li``'s page pool.
+    ``idx`` carries the flat (page*page_size + offset) position per
+    row, with out-of-range sentinels for masked rows (mode='drop')."""
+    layers, _, num_pages, ps, h_, hd = pool.shape
+    kflat = pool[li, 0].reshape(num_pages * ps, h_, hd)
+    vflat = pool[li, 1].reshape(num_pages * ps, h_, hd)
+    kflat = kflat.at[idx].set(k.astype(pool.dtype), mode="drop")
+    vflat = vflat.at[idx].set(v.astype(pool.dtype), mode="drop")
+    pool = pool.at[li, 0].set(kflat.reshape(num_pages, ps, h_, hd))
+    return pool.at[li, 1].set(vflat.reshape(num_pages, ps, h_, hd))
+
+
+def _decode_core(mdl: DecodeModel, params, pool, tokens, positions,
+                 tables, active):
+    """Consume one token per slot at ``positions`` (writing its KV),
+    return (pool, argmax next token per slot)."""
+    s_ = tokens.shape[0]
+    h_, hd = mdl.n_heads, mdl.head_dim
+    num_pages, ps = pool.shape[2], pool.shape[3]
+    x = params["embed"][tokens]
+    lengths = jnp.where(active, positions + 1, 0).astype(jnp.int32)
+    pagerow = jnp.take_along_axis(
+        tables, (positions // ps)[:, None], axis=1)[:, 0]
+    flat = pagerow * ps + positions % ps
+    idx = jnp.where(active, flat, num_pages * ps).astype(jnp.int32)
+    for li, lp in enumerate(params["layers"]):
+        h1 = _rms(x, lp["ln1"])
+        q = rope((h1 @ lp["wq"]).reshape(s_, h_, hd), positions,
+                 base=mdl.rope_base)
+        k = rope((h1 @ lp["wk"]).reshape(s_, h_, hd), positions,
+                 base=mdl.rope_base)
+        v = (h1 @ lp["wv"]).reshape(s_, h_, hd)
+        pool = _write_kv(pool, li, idx, k, v)
+        attn = paged_attention(q, pool[li, 0], pool[li, 1], tables,
+                               lengths)
+        x = x + attn.reshape(s_, mdl.dim).astype(x.dtype) @ lp["wo"]
+        h2 = _rms(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    x = _rms(x, params["lnf"])
+    logits = x @ params["embed"].T
+    return pool, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def _verify_core(mdl: DecodeModel, params, pool, tokens, base_pos,
+                 tables, active):
+    """Target-model scoring of a ``(slots, k+1)`` speculative window in
+    one dispatch: writes KV for every window position, computes greedy
+    targets at each, and resolves the accepted prefix length on
+    device.  Attention per window offset goes through the SAME
+    paged_attention kernel as decode_step, so accepted tokens are
+    bitwise those the non-speculative path would emit."""
+    s_, w_ = tokens.shape
+    h_, hd = mdl.n_heads, mdl.head_dim
+    num_pages, ps = pool.shape[2], pool.shape[3]
+    pos = base_pos[:, None] + jnp.arange(w_, dtype=jnp.int32)[None, :]
+    x = params["embed"][tokens]                       # (S, W, dim)
+    pagerow = jnp.take_along_axis(tables, pos // ps, axis=1)
+    flat = pagerow * ps + pos % ps
+    idx = jnp.where(active[:, None], flat,
+                    num_pages * ps).astype(jnp.int32).reshape(s_ * w_)
+    for li, lp in enumerate(params["layers"]):
+        h1 = _rms(x, lp["ln1"])
+        q = rope((h1 @ lp["wq"]).reshape(s_, w_, h_, hd), pos,
+                 base=mdl.rope_base)
+        k = rope((h1 @ lp["wk"]).reshape(s_, w_, h_, hd), pos,
+                 base=mdl.rope_base)
+        v = (h1 @ lp["wv"]).reshape(s_, w_, h_, hd)
+        pool = _write_kv(pool, li, idx,
+                         k.reshape(s_ * w_, h_, hd),
+                         v.reshape(s_ * w_, h_, hd))
+        cols = []
+        for j in range(w_):
+            lens_j = jnp.where(active, base_pos + j + 1,
+                               0).astype(jnp.int32)
+            cols.append(paged_attention(q[:, j], pool[li, 0],
+                                        pool[li, 1], tables, lens_j))
+        attn = jnp.stack(cols, axis=1)                # (S, W, H, hd)
+        x = x + attn.reshape(s_, w_, mdl.dim).astype(x.dtype) @ lp["wo"]
+        h2 = _rms(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    x = _rms(x, params["lnf"])
+    logits = x @ params["embed"].T                    # (S, W, V)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    drafts = tokens[:, 1:]
+    eq = (drafts == greedy[:, :-1]).astype(jnp.int32)
+    accepted = jnp.cumprod(eq, axis=1).sum(axis=1)    # (S,)
+    return pool, greedy, accepted
+
+
+def _draft_core(mdl: DecodeModel, params, pool, tokens, base_pos,
+                tables, active, k: int):
+    """k+1 chained draft decode steps (unrolled — ``k`` is static):
+    proposes k tokens and leaves the draft pool position-aligned with
+    the target's write window (positions base..base+k)."""
+    tok = tokens
+    outs = []
+    for j in range(k + 1):
+        pool, tok = _decode_core(mdl, params, pool, tok, base_pos + j,
+                                 tables, active)
+        outs.append(tok)
+    return pool, jnp.stack(outs[:k], axis=1)          # (S, k)
+
+
+def _prefill_core(mdl: DecodeModel, params, pool, tokens, start,
+                  chunk_len, table):
+    """One prompt chunk for ONE slot: ``tokens (bucket,)`` padded,
+    ``start``/``chunk_len`` traced scalars, ``table (pages_per_slot,)``
+    the slot's page row.  Writes the chunk's KV and returns the greedy
+    next token after the chunk's last valid position (meaningful only
+    on the final chunk)."""
+    b_ = tokens.shape[0]
+    h_, hd = mdl.n_heads, mdl.head_dim
+    num_pages, ps = pool.shape[2], pool.shape[3]
+    scale = 1.0 / (hd ** 0.5)
+    pos = start + jnp.arange(b_, dtype=jnp.int32)
+    valid = jnp.arange(b_) < chunk_len
+    total = start + chunk_len
+    x = params["embed"][tokens]
+    page = table[pos // ps]
+    idx = jnp.where(valid, page * ps + pos % ps,
+                    num_pages * ps).astype(jnp.int32)
+    p_ = table.shape[0]
+    for li, lp in enumerate(params["layers"]):
+        h1 = _rms(x, lp["ln1"])
+        q = rope((h1 @ lp["wq"]).reshape(b_, h_, hd), pos,
+                 base=mdl.rope_base)
+        k = rope((h1 @ lp["wk"]).reshape(b_, h_, hd), pos,
+                 base=mdl.rope_base)
+        v = (h1 @ lp["wv"]).reshape(b_, h_, hd)
+        pool = _write_kv(pool, li, idx, k, v)
+        # chunk attends its causal prefix (earlier chunks included)
+        # over the slot's gathered pages — the chunk itself was just
+        # written, so one mask covers intra- and cross-chunk keys
+        kctx = pool[li, 0][table].reshape(p_ * ps, h_, hd)
+        vctx = pool[li, 1][table].reshape(p_ * ps, h_, hd)
+        s = jnp.einsum("bhd,khd->bhk", q.astype(jnp.float32),
+                       kctx.astype(jnp.float32)) * scale
+        kpos = lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        mask = (kpos <= pos[:, None, None]) & (kpos < total)
+        s = jnp.where(mask, s, _NEG_INF)
+        m = s.max(axis=-1, keepdims=True)
+        pr = jnp.where(mask, jnp.exp(s - m), 0.0)
+        l = pr.sum(axis=-1, keepdims=True)
+        l = jnp.where(l == 0.0, 1.0, l)
+        attn = jnp.einsum("bhk,khd->bhd", pr / l,
+                          vctx.astype(jnp.float32))
+        x = x + attn.reshape(b_, mdl.dim).astype(x.dtype) @ lp["wo"]
+        h2 = _rms(x, lp["ln2"])
+        x = x + jax.nn.gelu(h2 @ lp["w1"]) @ lp["w2"]
+    x = _rms(x, params["lnf"])
+    last = lax.dynamic_index_in_dim(x, jnp.maximum(chunk_len - 1, 0),
+                                    axis=0, keepdims=False)
+    logits = last @ params["embed"].T
+    return pool, jnp.argmax(logits).astype(jnp.int32)
+
+
+# -- the engine --------------------------------------------------------------
+
+class DecodeEngine:
+    """Owns the model(s), the paged KV pools, and the compiled
+    executables.  All knobs default from the environment:
+    ``MXNET_DECODE_SLOTS`` / ``MXNET_DECODE_PAGES`` /
+    ``MXNET_DECODE_PAGE_SIZE`` / ``MXNET_DECODE_SPEC_K``."""
+
+    def __init__(self, model: DecodeModel, *,
+                 draft_model: Optional[DecodeModel] = None,
+                 spec_k: Optional[int] = None,
+                 max_slots: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 page_size: Optional[int] = None,
+                 pages_per_slot: Optional[int] = None,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_floor: int = 16):
+        self.model = model
+        self.draft = draft_model
+        self.max_slots = (int(max_slots) if max_slots is not None
+                          else _env_int("MXNET_DECODE_SLOTS", 8))
+        self.page_size = (int(page_size) if page_size is not None
+                          else _env_int("MXNET_DECODE_PAGE_SIZE", 16))
+        self.num_pages = (int(num_pages) if num_pages is not None
+                          else _env_int("MXNET_DECODE_PAGES", 256))
+        self.spec_k = (int(spec_k) if spec_k is not None
+                       else _env_int("MXNET_DECODE_SPEC_K", 4))
+        self.prefill_chunk = (int(prefill_chunk)
+                              if prefill_chunk is not None
+                              else _env_int("MXNET_DECODE_PREFILL_CHUNK",
+                                            128))
+        self.prefill_floor = min(int(prefill_floor), self.prefill_chunk)
+        if draft_model is not None:
+            if draft_model.vocab_size != model.vocab_size:
+                raise ValueError("draft/target vocab sizes differ")
+        self.cache = PagedKVCache(
+            layers=model.n_layers, num_pages=self.num_pages,
+            page_size=self.page_size, heads=model.n_heads,
+            head_dim=model.head_dim, max_slots=self.max_slots,
+            pages_per_slot=pages_per_slot)
+        self.draft_cache = None
+        if draft_model is not None:
+            self.draft_cache = PagedKVCache(
+                layers=draft_model.n_layers, num_pages=self.num_pages,
+                page_size=self.page_size, heads=draft_model.n_heads,
+                head_dim=draft_model.head_dim, max_slots=self.max_slots,
+                pages_per_slot=self.cache.pages_per_slot)
+        self._exec: Dict[str, Any] = {}
+        self.compiles = 0
+
+    # -- properties ----------------------------------------------------------
+
+    @property
+    def spec_enabled(self) -> bool:
+        return self.draft is not None and self.spec_k >= 1
+
+    @property
+    def slot_capacity(self) -> int:
+        return self.cache.slot_capacity
+
+    def prefill_bucket(self, n: int) -> int:
+        return min(_pow2(n, self.prefill_floor), self.prefill_chunk)
+
+    # -- compiled-executable plumbing ---------------------------------------
+
+    def _call(self, key: str, fn, args):
+        ex = self._exec.get(key)
+        if ex is None:
+            donate = ((1,) if jax.default_backend() == "tpu" else ())
+            t0 = time.perf_counter()
+            ex = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+            telemetry.record_compile(time.perf_counter() - t0, "decode")
+            self._exec[key] = ex
+            self.compiles += 1
+        return ex(*args)
+
+    def _tables(self, cache) -> jnp.ndarray:
+        return jnp.asarray(cache.tables, jnp.int32)
+
+    # -- device steps --------------------------------------------------------
+
+    def decode_step(self, tokens, positions, active):
+        """One non-speculative engine step over the full slot grid.
+        Returns the next token per slot (host numpy)."""
+        mdl = self.model
+        args = (mdl.params, self.cache.pool,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(positions, jnp.int32),
+                self._tables(self.cache),
+                jnp.asarray(active, bool))
+        pool, nxt = self._call(
+            "decode",
+            lambda p, kv, t, po, tb, a:
+            _decode_core(mdl, p, kv, t, po, tb, a), args)
+        self.cache.pool = pool
+        return onp.asarray(nxt)
+
+    def spec_step(self, tokens, base_pos, active):
+        """Draft k proposals then verify in one target dispatch.
+        Returns (greedy (S, k+1), accepted (S,)) host numpy."""
+        mdl, dm, k = self.model, self.draft, self.spec_k
+        tok = jnp.asarray(tokens, jnp.int32)
+        pos = jnp.asarray(base_pos, jnp.int32)
+        act = jnp.asarray(active, bool)
+        dargs = (dm.params, self.draft_cache.pool, tok, pos,
+                 self._tables(self.draft_cache), act)
+        dpool, props = self._call(
+            "draft",
+            lambda p, kv, t, po, tb, a:
+            _draft_core(dm, p, kv, t, po, tb, a, k), dargs)
+        self.draft_cache.pool = dpool
+        window = jnp.concatenate([tok[:, None], props], axis=1)
+        vargs = (mdl.params, self.cache.pool, window, pos,
+                 self._tables(self.cache), act)
+        pool, greedy, accepted = self._call(
+            "verify",
+            lambda p, kv, t, po, tb, a:
+            _verify_core(mdl, p, kv, t, po, tb, a), vargs)
+        self.cache.pool = pool
+        return onp.asarray(greedy), onp.asarray(accepted)
+
+    def prefill_chunk_step(self, slot: int, chunk, start: int) -> int:
+        """Feed one prompt chunk for ``slot`` (padded into its pow2
+        bucket); returns the greedy next token after the chunk."""
+        mdl = self.model
+        bucket = self.prefill_bucket(len(chunk))
+        padded = onp.zeros((bucket,), onp.int32)
+        padded[:len(chunk)] = chunk
+        args = (mdl.params, self.cache.pool, jnp.asarray(padded),
+                jnp.asarray(start, jnp.int32),
+                jnp.asarray(len(chunk), jnp.int32),
+                jnp.asarray(self.cache.tables[slot], jnp.int32))
+        pool, nxt = self._call(
+            f"prefill_b{bucket}",
+            lambda p, kv, t, st, cl, tb:
+            _prefill_core(mdl, p, kv, t, st, cl, tb), args)
+        self.cache.pool = pool
+        if self.draft_cache is not None:
+            dm = self.draft
+            dargs = (dm.params, self.draft_cache.pool,
+                     jnp.asarray(padded), jnp.asarray(start, jnp.int32),
+                     jnp.asarray(len(chunk), jnp.int32),
+                     jnp.asarray(self.draft_cache.tables[slot],
+                                 jnp.int32))
+            dpool, _ = self._call(
+                f"draft_prefill_b{bucket}",
+                lambda p, kv, t, st, cl, tb:
+                _prefill_core(dm, p, kv, t, st, cl, tb), dargs)
+            self.draft_cache.pool = dpool
+        return int(nxt)
+
+    # -- slot page lifecycle -------------------------------------------------
+
+    def acquire_slot(self, slot: int, tokens: int) -> None:
+        self.cache.acquire(slot, tokens)
+        if self.draft_cache is not None:
+            try:
+                self.draft_cache.acquire(slot, tokens)
+            except Exception:
+                self.cache.release(slot)
+                raise
+
+    def release_slot(self, slot: int) -> int:
+        n = self.cache.release(slot)
+        if self.draft_cache is not None:
+            self.draft_cache.release(slot)
+        return n
+
+    def can_admit(self, tokens: int) -> bool:
+        need = self.cache.pages_for(tokens)
+        ok = self.cache.allocator.available >= need
+        if self.draft_cache is not None:
+            ok = ok and self.draft_cache.allocator.available >= need
+        return ok
+
+    def stats(self) -> dict:
+        return {"compiles": self.compiles,
+                "executables": sorted(self._exec),
+                "max_slots": self.max_slots,
+                "page_size": self.page_size,
+                "num_pages": self.num_pages,
+                "pages_used": self.cache.pages_used(),
+                "slot_capacity": self.slot_capacity,
+                "spec_k": self.spec_k if self.spec_enabled else 0}

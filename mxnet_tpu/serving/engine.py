@@ -220,6 +220,13 @@ class InferenceEngine:
                     f"request dtype {arr.dtype} does not match engine "
                     f"dtype {self._dtype}")
             arr = cast
+        if arr.size == 0:
+            # a zero-size example would poison its whole coalesced
+            # batch with a degenerate bucket (and a zero-length prompt
+            # has no last position to decode from)
+            telemetry.counter("serving.rejected.shape").inc()
+            raise BadRequestError(
+                f"request shape {arr.shape} has a zero-size axis")
         if self._example_shape is None:
             self._example_shape = tuple(
                 None if i in self._seq_axes else d
@@ -239,6 +246,9 @@ class InferenceEngine:
         return arr
 
     def _bucket_batch(self, n: int) -> int:
+        if n <= 0:
+            raise BadRequestError(
+                f"batch size must be positive, got {n}")
         if self._bucket_sizes is not None:
             for b in self._bucket_sizes:
                 if b >= n:

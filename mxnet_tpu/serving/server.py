@@ -9,6 +9,11 @@ path with no sockets.  The HTTP endpoint is a thin stdlib
 - ``POST /predict``  body ``{"data": <nested list>, "dtype"?: str,
   "timeout_ms"?: number}`` → ``{"output": <nested list>}`` (or
   ``{"outputs": [...]}`` for multi-output blocks).
+- ``POST /generate`` body ``{"prompt": [ids...], "max_new_tokens"?: n,
+  "eos"?: id, "timeout_ms"?: ms}`` → ``{"tokens": [ids...]}`` — the
+  autoregressive decode plane (serving/decode/); 503 until a
+  ``DecodeScheduler`` is attached (constructor ``decoder=`` or
+  ``attach_decoder()``).
 - ``GET /healthz`` → queue depth, compiled buckets, drain state.
 - ``GET /varz`` → the live telemetry registry snapshot (every counter /
   gauge / histogram, JSON) — inspect a running server without
@@ -58,7 +63,7 @@ class ServingServer:
 
     def __init__(self, block_or_engine, engine_args: Optional[dict] = None,
                  batcher_args: Optional[dict] = None,
-                 start: bool = True):
+                 decoder=None, start: bool = True):
         if isinstance(block_or_engine, InferenceEngine):
             self.engine = block_or_engine
         else:
@@ -66,8 +71,16 @@ class ServingServer:
                                           **(engine_args or {}))
         self.batcher = DynamicBatcher(self.engine, start=start,
                                       **(batcher_args or {}))
+        self.decoder = decoder        # DecodeScheduler (or None)
         self._httpd = None
         self._http_thread = None
+
+    def attach_decoder(self, scheduler) -> "ServingServer":
+        """Attach a ``DecodeScheduler`` so ``generate()`` and
+        ``POST /generate`` serve autoregressive requests alongside
+        ``predict()``."""
+        self.decoder = scheduler
+        return self
 
     # -- in-process API ------------------------------------------------------
 
@@ -77,6 +90,21 @@ class ServingServer:
         fut = self.batcher.submit(x, timeout_ms=timeout_ms)
         # the dispatch itself runs after the deadline check, so give the
         # future a grace window beyond the request deadline
+        wait = timeout_ms / 1e3 + 30.0 if timeout_ms is not None else None
+        return fut.result(wait)
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None,
+                 eos: Optional[int] = None,
+                 timeout_ms: Optional[float] = None):
+        """Submit one generation request to the attached
+        ``DecodeScheduler`` and block for the generated token list.
+        Raises :class:`ServingClosedError` when no decoder is
+        attached."""
+        if self.decoder is None:
+            raise ServingClosedError(
+                "no decode scheduler attached to this server")
+        fut = self.decoder.submit(prompt, max_new_tokens=max_new_tokens,
+                                  eos=eos, timeout_ms=timeout_ms)
         wait = timeout_ms / 1e3 + 30.0 if timeout_ms is not None else None
         return fut.result(wait)
 
@@ -164,6 +192,8 @@ class ServingServer:
         """Drain-aware shutdown: close admission (delivering admitted
         responses when ``drain``), then stop the HTTP listener."""
         self.batcher.close(drain=drain)
+        if self.decoder is not None and not self.decoder.closed:
+            self.decoder.close(drain=drain)
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -237,6 +267,9 @@ class ServingServer:
                     self._reply(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if self.path == "/generate":
+                    self._generate()
+                    return
                 if self.path != "/predict":
                     self._reply(404, {"error": f"no route {self.path}"})
                     return
@@ -269,6 +302,37 @@ class ServingServer:
                     else:
                         self._reply(200, {"output":
                                           onp.asarray(out).tolist()})
+
+            def _generate(self):
+                """POST /generate body ``{"prompt": [ids...],
+                "max_new_tokens"?: n, "eos"?: id, "timeout_ms"?: ms}``
+                → ``{"tokens": [ids...]}`` (same error mapping as
+                /predict; 503 when no decoder is attached)."""
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = [int(t) for t in req["prompt"]]
+                    max_new = req.get("max_new_tokens")
+                    eos = req.get("eos")
+                except (KeyError, TypeError, ValueError) as e:
+                    self._reply(400, {"error": f"bad request body: {e}"})
+                    return
+                try:
+                    toks = server.generate(
+                        prompt, max_new_tokens=max_new, eos=eos,
+                        timeout_ms=req.get("timeout_ms"))
+                except BadRequestError as e:
+                    self._reply(400, {"error": str(e)})
+                except QueueFullError as e:
+                    self._reply(429, {"error": str(e)})
+                except RequestTimeoutError as e:
+                    self._reply(504, {"error": str(e)})
+                except ServingClosedError as e:
+                    self._reply(503, {"error": str(e)})
+                except MXNetError as e:
+                    self._reply(500, {"error": str(e)})
+                else:
+                    self._reply(200, {"tokens": [int(t) for t in toks]})
 
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True

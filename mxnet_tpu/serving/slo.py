@@ -218,9 +218,16 @@ class ServingSLO:
                  burn_threshold: Optional[float] = None,
                  min_samples: Optional[int] = None,
                  directory: Optional[str] = None,
+                 ttft_ms: Optional[float] = None,
                  from_env: bool = False):
         from .. import clustermon
         self.latency_ms = float(latency_ms)
+        # decode-plane time-to-first-token objective: only requests
+        # that report a ttft_ms (generation requests) feed it; same
+        # percentile budget as end-to-end latency
+        self.ttft_ms = (float(ttft_ms)
+                        if ttft_ms is not None and float(ttft_ms) > 0
+                        else None)
         self.percentile = float(percentile) if percentile else 95.0
         self.availability = (float(availability)
                              if availability is not None else 0.999)
@@ -241,6 +248,7 @@ class ServingSLO:
         self._lock = threading.RLock()
         self._samples: deque = deque()      # (t_mono, latency_ms, ok)
         self._signals: deque = deque()      # (t_mono, {signal: ms})
+        self._ttft: deque = deque()         # (t_mono, ttft_ms)
         self._burning: Optional[dict] = None
         self._view: dict = {}
         self._last_eval = 0.0
@@ -275,9 +283,14 @@ class ServingSLO:
             "padding": pad,
             "compile": comp,
         }
+        ttft = entry.get("ttft_ms")
         with self._lock:
             self._samples.append((now, lat, ok))
             self._signals.append((now, sig))
+            if ttft is not None and self.ttft_ms is not None:
+                self._ttft.append((now, float(ttft)))
+                if float(ttft) > self.ttft_ms:
+                    telemetry.counter("serving_slo.ttft_breaches").inc()
             self._c_req.inc()
             if lat > self.latency_ms:
                 self._c_breach.inc()
@@ -338,6 +351,8 @@ class ServingSLO:
             self._samples.popleft()
         while self._signals and self._signals[0][0] < cut:
             self._signals.popleft()
+        while self._ttft and self._ttft[0][0] < cut:
+            self._ttft.popleft()
 
     def _saturation(self) -> Dict[str, float]:
         n = len(self._signals)
@@ -390,6 +405,21 @@ class ServingSLO:
         lat_burn_short = lat_frac_short / self._lat_budget
         av_burn_long = err_frac_long / self._avail_budget
         av_burn_short = err_frac_short / self._avail_budget
+        # ttft objective (decode plane): its own sample stream — only
+        # generation requests report a first-token time
+        ttft_long = list(self._ttft)
+        ttft_short = [s for s in ttft_long if s[0] >= cut_short]
+        ttfts = sorted(v for (_t, v) in ttft_long)
+        ttft_p50 = round(self._pct(ttfts, 50), 3)
+        ttft_p95 = round(self._pct(ttfts, 95), 3)
+        ttft_burn_long = ttft_burn_short = 0.0
+        if self.ttft_ms is not None:
+            ttft_burn_long = _frac(
+                ttft_long,
+                lambda s: s[1] > self.ttft_ms) / self._lat_budget
+            ttft_burn_short = _frac(
+                ttft_short,
+                lambda s: s[1] > self.ttft_ms) / self._lat_budget
         sat = self._saturation()
         # multi-window multi-burn-rate rule with hysteresis: open when
         # BOTH windows exceed the threshold, close when the long window
@@ -397,29 +427,39 @@ class ServingSLO:
         # incident store never flaps close/open on a signal wobble)
         thr = self.burn_threshold
         enough = n_long >= self.min_samples and n_short >= 1
-        if self._burning is None and enough:
-            if av_burn_long >= thr and av_burn_short >= thr:
+        enough_ttft = (self.ttft_ms is not None
+                       and len(ttft_long) >= self.min_samples
+                       and len(ttft_short) >= 1)
+        if self._burning is None and (enough or enough_ttft):
+            if enough and av_burn_long >= thr and av_burn_short >= thr:
                 self._burning = {"objective": "availability",
                                  "cause": "error_budget",
                                  "since_ts": round(time.time(), 3)}
-            elif lat_burn_long >= thr and lat_burn_short >= thr:
+            elif enough and lat_burn_long >= thr \
+                    and lat_burn_short >= thr:
                 self._burning = {"objective": "latency",
                                  "cause": self._attribute(sat),
                                  "since_ts": round(time.time(), 3)}
+            elif enough_ttft and ttft_burn_long >= thr \
+                    and ttft_burn_short >= thr:
+                self._burning = {"objective": "ttft",
+                                 "cause": "ttft_slo",
+                                 "since_ts": round(time.time(), 3)}
         elif self._burning is not None:
-            long_burn = (av_burn_long
-                         if self._burning["objective"] == "availability"
-                         else lat_burn_long)
+            long_burn = {"availability": av_burn_long,
+                         "ttft": ttft_burn_long}.get(
+                             self._burning["objective"], lat_burn_long)
             if long_burn < thr:
                 self._burning = None
         if self._burning is None:
             verdict = None
-            burn_rep = round(max(lat_burn_long, av_burn_long), 3)
+            burn_rep = round(max(lat_burn_long, av_burn_long,
+                                 ttft_burn_long), 3)
         else:
             burn_rep = round(
-                av_burn_long
-                if self._burning["objective"] == "availability"
-                else lat_burn_long, 3)
+                {"availability": av_burn_long,
+                 "ttft": ttft_burn_long}.get(
+                     self._burning["objective"], lat_burn_long), 3)
             verdict = {"rank": clustermon.rank_world()[0],
                        "cause": self._burning["cause"],
                        "ratio": burn_rep, "step_ms": p95}
@@ -463,6 +503,15 @@ class ServingSLO:
                     max(0.0, 1.0 - av_burn_long), 3),
             },
             "saturation": sat,
+            "ttft": ({
+                "target_ms": self.ttft_ms,
+                "p50_ms": ttft_p50, "p95_ms": ttft_p95,
+                "samples": len(ttft_long),
+                "burn_long": round(ttft_burn_long, 3),
+                "burn_short": round(ttft_burn_short, 3),
+                "budget_remaining": round(
+                    max(0.0, 1.0 - ttft_burn_long), 3),
+            } if self.ttft_ms is not None else None),
             "weights_age_s": weights_age_s(),
             "burning": (dict(self._burning, saturation=sat,
                              burn=burn_rep)
@@ -487,6 +536,11 @@ class ServingSLO:
                                                           3))
         g("serving_slo.error_budget_remaining").set(
             round(max(0.0, 1.0 - av_burn_long), 3))
+        if self.ttft_ms is not None:
+            g("serving_slo.ttft_p50_ms").set(ttft_p50)
+            g("serving_slo.ttft_p95_ms").set(ttft_p95)
+            g("serving_slo.ttft_burn_long").set(
+                round(ttft_burn_long, 3))
         g("serving_slo.burning").set(1 if self._burning else 0)
         g("serving_slo.burning_cause").set(
             self._burning["cause"] if self._burning else "none")
@@ -611,7 +665,8 @@ def _refresh_env() -> None:
     key = (os.environ.get("MXNET_SLO_LATENCY_MS") or None,
            os.environ.get("MXNET_SLO_WINDOW_S") or None,
            os.environ.get("MXNET_SLO_AVAILABILITY") or None,
-           os.environ.get("MXNET_SLO_BURN_THRESHOLD") or None)
+           os.environ.get("MXNET_SLO_BURN_THRESHOLD") or None,
+           os.environ.get("MXNET_SLO_TTFT_MS") or None)
     if key == _env_cache["key"]:
         return
     with _LOCK:
@@ -630,6 +685,7 @@ def _refresh_env() -> None:
                 availability=_getenv_float("MXNET_SLO_AVAILABILITY"),
                 burn_threshold=_getenv_float(
                     "MXNET_SLO_BURN_THRESHOLD"),
+                ttft_ms=_getenv_float("MXNET_SLO_TTFT_MS"),
                 from_env=True)
 
 
@@ -638,16 +694,20 @@ def declare(latency_ms: float, percentile: float = 95.0,
             window_s: Optional[float] = None,
             burn_threshold: Optional[float] = None,
             min_samples: Optional[int] = None,
-            directory: Optional[str] = None) -> ServingSLO:
+            directory: Optional[str] = None,
+            ttft_ms: Optional[float] = None) -> ServingSLO:
     """Declare (or re-declare) the serving objectives explicitly.
-    Replaces any live SLO engine, env-declared or not."""
+    Replaces any live SLO engine, env-declared or not.  ``ttft_ms``
+    adds the decode-plane time-to-first-token objective (also
+    declarable via ``MXNET_SLO_TTFT_MS`` alongside
+    ``MXNET_SLO_LATENCY_MS``)."""
     with _LOCK:
         _undeclare_locked()
         return _declare_locked(
             latency_ms=latency_ms, percentile=percentile,
             availability=availability, window_s=window_s,
             burn_threshold=burn_threshold, min_samples=min_samples,
-            directory=directory, from_env=False)
+            directory=directory, ttft_ms=ttft_ms, from_env=False)
 
 
 def undeclare() -> None:

@@ -252,6 +252,20 @@ _G_SRV_QUEUE = gauge("serving.queue_depth")
 _H_SRV_BATCH = histogram("serving.batch_size")
 _H_SRV_WASTE = histogram("serving.padding_waste")
 _H_SRV_REQ_MS = histogram("serving.request_ms")
+# autoregressive decode plane (mxnet_tpu/serving/decode/ writes these;
+# eager so profiler.counters() and the report tools see the keys before
+# the first generation): tokens emitted / prompt tokens prefilled /
+# slots evicted on deadline or shutdown, speculative proposals vs
+# accepted, scheduler turns, and the live slot/page occupancy gauges
+_C_DEC_TOKENS = counter("decode.tokens")
+_C_DEC_PREFILL = counter("decode.prefill_tokens")
+_C_DEC_EVICTIONS = counter("decode.evictions")
+_C_DEC_SPEC_PROP = counter("decode.spec_proposed")
+_C_DEC_SPEC_ACC = counter("decode.spec_accepted")
+_C_DEC_STEPS = counter("decode.steps")
+_G_DEC_SLOTS = gauge("decode.slots_active")
+_G_DEC_PAGES = gauge("decode.pages_used")
+_G_DEC_SPEC_RATE = gauge("decode.spec_accept_rate")
 # input-pipeline health (mxnet_tpu/data/device_pipeline.py + the step
 # funnels write these; created eagerly for profiler.counters())
 _C_INPUT_WAIT_MS = counter("input.wait_ms")    # consumer blocked on batch

@@ -879,7 +879,8 @@ def test_prometheus_incident_counter_family():
     assert fam == {"input_bound": 2, "compile_stall": 0,
                    "ckpt_interference": 0, "comm_skew": 0,
                    "latency_slo": 0, "error_budget": 0,
-                   "queue_saturation": 0, "unknown": 0}
+                   "queue_saturation": 0, "ttft_slo": 0,
+                   "unknown": 0}
     assert all(l["rank"] == "0"
                for l, _ in parsed["mxnet_cluster_incidents_total"])
 

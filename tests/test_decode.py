@@ -1,0 +1,463 @@
+"""Autoregressive decode plane (mxnet_tpu/serving/decode/): paged KV
+cache, continuous batching, speculative decode.
+
+Tier-1 acceptance lives here, all in-process (CPU, no sockets):
+
+- the page allocator recycles freed pages and fails atomically on
+  exhaustion; the paged-attention kernel matches the gather-based
+  oracle across ragged lengths including a length-0 slot;
+- scheduler output is token-identical to the dense
+  ``greedy_reference`` oracle across ragged prompts, eos and max_new;
+- the fixed-shape contract: admissions/evictions after warmup never
+  recompile (``engine.compiles`` stays flat across a second wave with
+  staggered arrivals);
+- greedy speculative decode is token-identical to the plain path with
+  a matched draft (every proposal accepted) AND a mismatched draft;
+- lifecycle: ``close(drain=True)`` completes in-flight work,
+  ``close(drain=False)`` fails it with ``ServingClosedError`` and
+  frees every page, per-request deadlines expire queued requests and
+  evict running slots (``decode.evictions``);
+- the pre-admission reject matrix + the batch-engine zero-size fixes;
+- report reconciliation: telemetry_report / slo_report decode
+  sections rebuild the run from the JSONL step records; a breached
+  TTFT objective burns with cause ``ttft_slo``.
+"""
+import importlib.util
+import json
+import pathlib
+import time
+
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx  # noqa: F401  (registers ops + kernel specs)
+from mxnet_tpu import profiler, telemetry
+from mxnet_tpu.serving import (BadRequestError, DecodeEngine, DecodeModel,
+                               DecodeScheduler, QueueFullError,
+                               RequestTimeoutError, ServingClosedError,
+                               ServingServer, slo)
+from mxnet_tpu.serving.decode import OutOfPagesError
+from mxnet_tpu.serving.decode.paged_kv import PageAllocator, PagedKVCache
+
+VOCAB = 48
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    telemetry.clear_sinks()
+    slo.undeclare()
+    yield
+    slo.undeclare()
+    telemetry.clear_sinks()
+    telemetry.enabled()     # re-sync env cache after monkeypatch undo
+
+
+@pytest.fixture(scope="module")
+def model():
+    return DecodeModel(VOCAB, dim=32, n_heads=4, n_layers=2, seed=0)
+
+
+@pytest.fixture(scope="module")
+def draft():
+    """Different architecture AND seed: near-zero accept rate, output
+    must still be token-identical (the verify pass is the target)."""
+    return DecodeModel(VOCAB, dim=16, n_heads=2, n_layers=1, seed=7)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("num_pages", 32)
+    kw.setdefault("page_size", 8)
+    return DecodeEngine(model, **kw)
+
+
+def _sched(eng, **kw):
+    kw.setdefault("start", False)
+    return DecodeScheduler(eng, **kw)
+
+
+def _run(sch):
+    while sch._has_work():
+        sch.step()
+
+
+def _prompts(n, lo=3, hi=12, seed=1):
+    rs = onp.random.RandomState(seed)
+    return [[int(t) for t in rs.randint(0, VOCAB,
+                                        size=rs.randint(lo, hi + 1))]
+            for _ in range(n)]
+
+
+def _gen(sch, prompts, max_new=8, **kw):
+    futs = [sch.submit(p, max_new_tokens=max_new, **kw) for p in prompts]
+    _run(sch)
+    return [f.result(0) for f in futs]
+
+
+# -- page allocator / paged KV cache ----------------------------------------
+
+def test_page_allocator_recycle_and_exhaustion():
+    al = PageAllocator(4)
+    a = al.alloc(3)
+    assert len(a) == 3 and al.available == 1 and al.used == 3
+    with pytest.raises(OutOfPagesError):
+        al.alloc(2)
+    assert al.available == 1            # failed alloc is atomic
+    al.free(a)
+    assert al.available == 4
+    b = al.alloc(4)
+    assert sorted(b) == sorted(set(b))  # recycled, no duplicates
+    al.free(b)
+
+
+def test_paged_kv_slot_acquire_release():
+    # pool deliberately smaller than max_slots * pages_per_slot so a
+    # full-budget acquire can exhaust the free list
+    c = PagedKVCache(layers=2, num_pages=6, page_size=4, max_slots=2,
+                     pages_per_slot=4, heads=2, head_dim=8)
+    assert c.slot_capacity == 4 * 4     # pages_per_slot * page_size
+    c.acquire(0, 9)                     # 9 tokens → 3 pages
+    assert c.pages_used() == 3
+    with pytest.raises(OutOfPagesError):
+        c.acquire(1, 16)                # needs 4, only 3 free
+    assert c.pages_used() == 3          # failed acquire is atomic
+    with pytest.raises(mx.base.MXNetError):
+        c.acquire(1, 17)                # over per-slot capacity
+    freed = c.release(0)
+    assert freed == 3 and c.pages_used() == 0
+    c.acquire(1, 16)                    # recycled pages serve a new slot
+    assert c.pages_used() == 4
+    assert c.release(1) == 4 and c.release(1) == 0
+    assert c.pages_used() == 0
+
+
+def test_paged_attention_ragged_parity_vs_oracle():
+    """Kernel vs gather-oracle over ragged lengths, including an
+    inactive (length-0) slot, through the public entry point."""
+    from mxnet_tpu.ops.paged_attention import (paged_attention,
+                                               paged_attention_reference)
+    import jax.numpy as jnp
+    rs = onp.random.RandomState(3)
+    s_, p_, pages, ps, h, d = 3, 3, 12, 4, 2, 8
+    q = jnp.asarray(rs.randn(s_, h, d), jnp.float32)
+    kp = jnp.asarray(rs.randn(pages, ps, h, d), jnp.float32)
+    vp = jnp.asarray(rs.randn(pages, ps, h, d), jnp.float32)
+    tables = jnp.asarray(
+        rs.permutation(pages)[:s_ * p_].reshape(s_, p_), jnp.int32)
+    lengths = jnp.asarray([5, 0, 12], jnp.int32)
+    out = paged_attention(q, kp, vp, tables, lengths)
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    onp.testing.assert_allclose(onp.asarray(out), onp.asarray(ref),
+                                rtol=2e-4, atol=2e-4)
+    assert not onp.asarray(out)[1].any()    # length-0 slot → zeros
+
+
+# -- continuous batching vs the dense oracle --------------------------------
+
+def test_scheduler_matches_greedy_reference(model):
+    prompts = _prompts(5, seed=2)
+    sch = _sched(_engine(model))
+    got = _gen(sch, prompts, max_new=10)
+    sch.close(drain=True)
+    for p, g in zip(prompts, got):
+        assert g == model.greedy_reference(p, 10)
+
+
+def test_eos_stops_generation(model):
+    p = _prompts(1, seed=4)[0]
+    ref = model.greedy_reference(p, 12)
+    eos = ref[3]                        # cut mid-stream
+    sch = _sched(_engine(model))
+    got = _gen(sch, [p], max_new=12, eos=eos)[0]
+    sch.close(drain=True)
+    assert got == model.greedy_reference(p, 12, eos=eos)
+    cut = ref.index(eos)                # first occurrence stops it
+    assert got == ref[:cut + 1] and got[-1] == eos
+
+
+def test_warm_admissions_never_recompile(model):
+    """The fixed-shape contract: after the first wave compiles the
+    prefill bucket + decode executables, a second wave with staggered
+    admissions (requests joining mid-flight) adds zero compiles, and
+    every page returns to the free list."""
+    eng = _engine(model)
+    sch = _sched(eng)
+    prompts = _prompts(6, lo=3, hi=8, seed=5)   # one pow2 bucket
+    _gen(sch, prompts[:3], max_new=6)
+    warm = eng.compiles
+    assert warm > 0 and eng.cache.pages_used() == 0
+    futs = [sch.submit(prompts[3], max_new_tokens=6)]
+    sch.step()                          # admit + begin while others queue
+    futs += [sch.submit(p, max_new_tokens=6) for p in prompts[4:]]
+    _run(sch)
+    assert [f.result(0) for f in futs] == [
+        model.greedy_reference(p, 6) for p in prompts[3:]]
+    assert eng.compiles == warm         # steady state: 0 new compiles
+    assert eng.cache.pages_used() == 0
+    sch.close(drain=True)
+
+
+# -- speculative decode ------------------------------------------------------
+
+def test_spec_identical_with_matched_draft(model):
+    """Same-weights draft: every proposal accepted, output bitwise
+    identical, and the whole run takes fewer engine steps."""
+    prompts = _prompts(4, seed=6)
+    ref = [model.greedy_reference(p, 9) for p in prompts]
+    eng = _engine(model, num_pages=64, draft_model=model, spec_k=3)
+    sch = _sched(eng)
+    got = _gen(sch, prompts, max_new=9)
+    st = sch.stats()
+    sch.close(drain=True)
+    assert got == ref
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] == st["spec_proposed"]
+    assert eng.cache.pages_used() == 0
+
+
+def test_spec_identical_with_mismatched_draft(model, draft):
+    """A draft that almost never agrees must not change the output —
+    the verify pass IS the target model's greedy decode."""
+    prompts = _prompts(4, seed=8)
+    eng = _engine(model, num_pages=64, draft_model=draft, spec_k=3)
+    sch = _sched(eng)
+    got = _gen(sch, prompts, max_new=9)
+    st = sch.stats()
+    sch.close(drain=True)
+    assert got == [model.greedy_reference(p, 9) for p in prompts]
+    assert st["spec_proposed"] > 0
+    assert st["spec_accepted"] <= st["spec_proposed"]
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def test_close_drain_completes_inflight(model):
+    sch = DecodeScheduler(_engine(model), start=True)
+    prompts = _prompts(5, seed=9)
+    futs = [sch.submit(p, max_new_tokens=6) for p in prompts]
+    sch.close(drain=True)
+    assert [f.result(0) for f in futs] == [
+        model.greedy_reference(p, 6) for p in prompts]
+    with pytest.raises(ServingClosedError):
+        sch.submit(prompts[0])
+
+
+def test_close_no_drain_fails_pending_and_frees_pages(model):
+    eng = _engine(model)
+    sch = _sched(eng)
+    prompts = _prompts(6, seed=10)
+    futs = [sch.submit(p, max_new_tokens=8) for p in prompts]
+    sch.step()                          # some admitted, some queued
+    assert eng.cache.pages_used() > 0
+    sch.close(drain=False)
+    for f in futs:
+        with pytest.raises(ServingClosedError):
+            f.result(0)
+    assert eng.cache.pages_used() == 0
+    with pytest.raises(ServingClosedError):
+        sch.submit(prompts[0])
+
+
+def test_queued_deadline_expires(model):
+    sch = _sched(_engine(model))
+    t0 = telemetry.counter("serving.timeouts").value
+    fut = sch.submit(_prompts(1, seed=11)[0], max_new_tokens=4,
+                     timeout_ms=1.0)
+    time.sleep(0.02)
+    sch.step()
+    with pytest.raises(RequestTimeoutError):
+        fut.result(0)
+    assert telemetry.counter("serving.timeouts").value == t0 + 1
+    sch.close(drain=False)
+
+
+def test_running_deadline_evicts_slot_and_frees_pages(model):
+    eng = _engine(model)
+    sch = _sched(eng)
+    e0 = telemetry.counter("decode.evictions").value
+    p = _prompts(1, seed=12)[0]
+    # full slot budget (~50 tokens at >=10ms/step) far outlasts the
+    # deadline; the step loop must evict it mid-generation
+    fut = sch.submit(p, max_new_tokens=eng.slot_capacity - len(p),
+                     timeout_ms=60.0)
+    sch.step()                          # admitted + generating
+    assert eng.cache.pages_used() > 0
+    deadline = time.monotonic() + 10.0
+    while not fut.done() and time.monotonic() < deadline:
+        time.sleep(0.01)
+        sch.step()
+    with pytest.raises(RequestTimeoutError):
+        fut.result(0)
+    assert telemetry.counter("decode.evictions").value == e0 + 1
+    assert eng.cache.pages_used() == 0
+    sch.close(drain=False)
+
+
+# -- pre-admission rejects + batch-engine zero-size fixes --------------------
+
+def test_submit_reject_matrix(model):
+    eng = _engine(model)
+    sch = _sched(eng, queue_depth=1)
+    r0 = telemetry.counter("serving.rejected.shape").value
+    with pytest.raises(BadRequestError):
+        sch.submit([])                  # empty prompt
+    with pytest.raises(BadRequestError):
+        sch.submit([1, 2], max_new_tokens=0)
+    with pytest.raises(BadRequestError):
+        sch.submit([1, VOCAB])          # token out of range
+    with pytest.raises(BadRequestError):
+        sch.submit([-1, 2])
+    with pytest.raises(BadRequestError):  # budget exceeds slot capacity
+        sch.submit([1, 2], max_new_tokens=eng.slot_capacity + 1)
+    assert telemetry.counter("serving.rejected.shape").value == r0 + 5
+    q0 = telemetry.counter("serving.rejected.queue_full").value
+    sch.submit([1, 2, 3], max_new_tokens=2)
+    with pytest.raises(QueueFullError):
+        sch.submit([1, 2, 3], max_new_tokens=2)
+    assert telemetry.counter(
+        "serving.rejected.queue_full").value == q0 + 1
+    sch.close(drain=False)
+
+
+def test_batch_engine_rejects_zero_size():
+    """Regression: a zero-size example (or an empty batch) must be
+    rejected up front, not crash inside bucketing/dispatch."""
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import InferenceEngine
+    mx.random.seed(0)
+    net = nn.Sequential()
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    eng = InferenceEngine(net, example_shape=(8,), dtype="float32")
+    with pytest.raises(BadRequestError):
+        eng.validate(onp.zeros((0,), "float32"))
+    with pytest.raises(BadRequestError):
+        eng.validate(onp.zeros((8, 0), "float32"))
+    with pytest.raises(BadRequestError):
+        eng._bucket_batch(0)
+    with pytest.raises(BadRequestError):
+        eng._bucket_batch(-1)
+
+
+# -- server integration ------------------------------------------------------
+
+def test_server_generate_inprocess(model):
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.Sequential()
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    srv = ServingServer(net, engine_args={"example_shape": (8,),
+                                          "dtype": "float32"})
+    with pytest.raises(ServingClosedError):    # no decoder attached
+        srv.generate([1, 2, 3])
+    sch = DecodeScheduler(_engine(model), start=True)
+    srv.attach_decoder(sch)
+    p = _prompts(1, seed=13)[0]
+    assert srv.generate(p, max_new_tokens=5) == \
+        model.greedy_reference(p, 5)
+    srv.stop(drain=True)                # stops batcher AND decoder
+    assert sch.closed
+    with pytest.raises(ServingClosedError):
+        srv.generate(p)
+
+
+@pytest.mark.slow
+def test_server_generate_http(model):
+    import urllib.error
+    import urllib.request
+    from mxnet_tpu.gluon import nn
+    mx.random.seed(0)
+    net = nn.Sequential()
+    net.add(nn.Dense(4, in_units=8))
+    net.initialize()
+    srv = ServingServer(net, engine_args={"example_shape": (8,),
+                                          "dtype": "float32"},
+                        decoder=DecodeScheduler(_engine(model),
+                                                start=True))
+    host, port = srv.start_http()
+    base = f"http://{host}:{port}"
+    try:
+        p = _prompts(1, seed=14)[0]
+        req = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": p, "max_new_tokens": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            body = json.loads(resp.read())
+        assert body["tokens"] == model.greedy_reference(p, 5)
+        bad = urllib.request.Request(
+            base + "/generate",
+            data=json.dumps({"prompt": []}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(bad, timeout=30)
+        assert ei.value.code == 400
+    finally:
+        srv.stop(drain=True)
+
+
+# -- telemetry / report reconciliation --------------------------------------
+
+def test_reports_reconcile_decode_section(model, tmp_path, monkeypatch):
+    """Every scheduler step emits one record; both report tools rebuild
+    the run (tokens, TTFT, occupancy, completions) from the JSONL."""
+    path = str(tmp_path / "decode.jsonl")
+    monkeypatch.setenv("MXNET_TELEMETRY_JSONL", path)
+    prompts = _prompts(3, seed=15)
+    sch = _sched(_engine(model))
+    got = _gen(sch, prompts, max_new=5)
+    sch.close(drain=True)
+    monkeypatch.delenv("MXNET_TELEMETRY_JSONL")
+    telemetry.enabled()                 # detach + close the sink
+
+    tools = pathlib.Path(__file__).resolve().parents[1] / "tools"
+
+    spec = importlib.util.spec_from_file_location(
+        "telemetry_report", tools / "telemetry_report.py")
+    trep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(trep)
+    records = trep.load(path)
+    d = trep.summarize(records)["decode"]
+    assert d["tokens"] == sum(len(g) for g in got) == 15
+    assert d["completed"] == 3 and d["steps"] > 0
+    assert d["ttft_ms"]["n"] == 3
+    assert d["compiles"] > 0            # cold run compiled
+    assert 0 < d["slot_occupancy_pct"] <= 100
+    assert "Decode (continuous batching)" in trep.render(
+        trep.summarize(records))
+    c = profiler.counters()["decode"]
+    assert c["tokens"] >= 15
+
+    spec = importlib.util.spec_from_file_location(
+        "slo_report", tools / "slo_report.py")
+    srep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(srep)
+    out = srep.report([path], latency_ms=10_000.0, window_s=30.0,
+                      threshold=14.4, slow_n=3, as_json=True,
+                      ttft_ms=10_000.0)
+    assert out["decode"]["tokens"] == 15
+    assert out["decode"]["ttft"]["samples"] == 3
+    assert out["decode"]["ttft"]["breaches"] == 0
+    assert out["verdict"] == "healthy"
+
+
+def test_ttft_objective_burns(tmp_path):
+    """Latency healthy, TTFT blown: the burn opens with the decode
+    plane's own cause and closes when TTFT recovers."""
+    s = slo.declare(latency_ms=1000.0, window_s=30.0, min_samples=5,
+                    ttft_ms=5.0, directory=str(tmp_path))
+    b0 = telemetry.counter("serving_slo.ttft_breaches").value
+    for _ in range(20):
+        s.observe({"id": 1, "ok": True, "latency_ms": 2.0,
+                   "ttft_ms": 100.0})
+    v = s.evaluate()
+    assert v["burning"]["cause"] == "ttft_slo"
+    assert v["ttft"]["target_ms"] == 5.0
+    assert v["ttft"]["burn_long"] >= 14.4
+    assert telemetry.counter(
+        "serving_slo.ttft_breaches").value == b0 + 20
+    for _ in range(200):
+        s.observe({"id": 2, "ok": True, "latency_ms": 2.0,
+                   "ttft_ms": 1.0})
+    assert s.evaluate()["burning"] is None

@@ -227,8 +227,9 @@ def test_profiler_counters_snapshot():
     c = profiler.counters()
     assert set(c) == {"eager_jit", "fused_step", "cached_step",
                       "optimizer", "compile", "comm", "dispatch",
-                      "serving", "input", "tracing", "checkpoint",
-                      "cluster", "kernel", "embedding", "amp", "moe"}
+                      "serving", "decode", "input", "tracing",
+                      "checkpoint", "cluster", "kernel", "embedding",
+                      "amp", "moe"}
     assert set(c["eager_jit"]) == {"hits", "misses", "latches"}
     assert set(c["fused_step"]) == {"compiles", "hits", "fallbacks",
                                     "steps", "zero_steps"}
@@ -246,6 +247,10 @@ def test_profiler_counters_snapshot():
     assert set(c["serving"]["slo"]) == {"declared", "evals", "samples",
                                         "breaches", "errors",
                                         "incidents"}
+    assert set(c["decode"]) == {"tokens", "prefill_tokens", "steps",
+                                "evictions", "spec_proposed",
+                                "spec_accepted", "slots_active",
+                                "pages_used"}
     assert set(c["input"]) == {"wait_ms", "h2d_bytes", "step_h2d"}
     assert set(c["tracing"]) == {"spans", "dropped", "open",
                                  "watchdog_dumps"}
@@ -260,7 +265,7 @@ def test_profiler_counters_snapshot():
     assert set(c["cluster"]["incidents_total"]) == {
         "input_bound", "compile_stall", "ckpt_interference",
         "comm_skew", "latency_slo", "error_budget",
-        "queue_saturation", "unknown"}
+        "queue_saturation", "ttft_slo", "unknown"}
     assert set(c["kernel"]) == {"cache_hits", "cache_misses", "tune_ms",
                                 "tune_measurements", "fallbacks"}
     assert set(c["embedding"]) == {"rows_pulled", "rows_pushed",

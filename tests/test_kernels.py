@@ -21,7 +21,8 @@ from mxnet_tpu.kernels import cache as kcache
 from mxnet_tpu.ops import attention as att
 from mxnet_tpu.ops.layernorm_residual import layer_norm_residual
 
-KERNELS = ("flash_attention", "layer_norm_residual", "zero_flatten_pad")
+KERNELS = ("flash_attention", "layer_norm_residual", "zero_flatten_pad",
+           "rope", "paged_attention")
 
 
 @pytest.fixture

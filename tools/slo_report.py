@@ -40,7 +40,9 @@ import re
 import sys
 
 SERVING_SOURCE = "serving.DynamicBatcher"
-SERVING_CAUSES = ("latency_slo", "error_budget", "queue_saturation")
+DECODE_SOURCE = "serving.DecodeScheduler"
+SERVING_CAUSES = ("latency_slo", "error_budget", "queue_saturation",
+                  "ttft_slo")
 _SPOOL_RE = re.compile(r"rank-(\d+)\.jsonl(\.\d+)?$")
 
 
@@ -62,10 +64,10 @@ def _read_jsonl(path):
 
 
 def load(paths):
-    """(serving records sorted by ts, incident transitions).  ``paths``
-    mixes spool dirs (rank-*.jsonl + incidents.jsonl inside) and
-    explicit JSONL files/globs."""
-    records, incidents = [], []
+    """(serving records, decode records, incident transitions), record
+    lists sorted by ts.  ``paths`` mixes spool dirs (rank-*.jsonl +
+    incidents.jsonl inside) and explicit JSONL files/globs."""
+    records, decode_records, incidents = [], [], []
     files = []
     for p in paths:
         if os.path.isdir(p):
@@ -87,8 +89,12 @@ def load(paths):
             if rec.get("source") == SERVING_SOURCE \
                     and isinstance(rec.get("serving"), dict):
                 records.append(rec)
+            elif rec.get("source") == DECODE_SOURCE \
+                    and isinstance(rec.get("decode"), dict):
+                decode_records.append(rec)
     records.sort(key=lambda r: r.get("ts") or 0)
-    return records, incidents
+    decode_records.sort(key=lambda r: r.get("ts") or 0)
+    return records, decode_records, incidents
 
 
 def requests_of(records):
@@ -168,11 +174,52 @@ def burn_episodes(reqs, latency_ms, window_s, threshold,
     return episodes, timeline
 
 
-def report(paths, latency_ms, window_s, threshold, slow_n, as_json):
-    records, incidents = load(paths)
-    if not records:
+def decode_summary(decode_records, ttft_ms_objective):
+    """The decode-plane section: TTFT percentiles against the TTFT
+    objective plus throughput/occupancy reconciled from the scheduler's
+    step records (source ``serving.DecodeScheduler``)."""
+    if not decode_records:
+        return None
+    dc = [r["decode"] for r in decode_records]
+    tokens = sum(d.get("tokens", 0) for d in dc)
+    wall_ms = sum(d.get("step_ms", 0.0) for d in dc)
+    ttfts = sorted(t for d in dc for t in d.get("ttft_ms", []))
+    occ = [d["slots_active"] / d["max_slots"] for d in dc
+           if d.get("max_slots")]
+    pages = [d["pages_used"] / d["num_pages"] for d in dc
+             if d.get("num_pages")]
+    prop = dc[-1].get("spec_proposed", 0)
+    acc = dc[-1].get("spec_accepted", 0)
+    breaches = (sum(1 for t in ttfts if t > ttft_ms_objective)
+                if ttft_ms_objective else 0)
+    return {
+        "steps": len(dc),
+        "tokens": tokens,
+        "tokens_per_s": round(tokens / (wall_ms / 1e3), 1)
+        if wall_ms else 0.0,
+        "ttft": {"objective_ms": ttft_ms_objective,
+                 "p50_ms": round(pct(ttfts, 50), 3),
+                 "p95_ms": round(pct(ttfts, 95), 3),
+                 "samples": len(ttfts),
+                 "breaches": breaches,
+                 "breach_fraction": round(breaches / len(ttfts), 4)
+                 if ttfts else 0.0},
+        "slot_occupancy_pct": round(100.0 * sum(occ) / len(occ), 1)
+        if occ else 0.0,
+        "page_utilization_pct": round(
+            100.0 * sum(pages) / len(pages), 1) if pages else 0.0,
+        "evictions": sum(d.get("evictions", 0) for d in dc),
+        "spec_accept_rate": round(acc / prop, 4) if prop else None,
+    }
+
+
+def report(paths, latency_ms, window_s, threshold, slow_n, as_json,
+           ttft_ms=None):
+    records, decode_records, incidents = load(paths)
+    if not records and not decode_records:
         raise SystemExit("no serving records "
-                         f"(source={SERVING_SOURCE!r}) found in "
+                         f"(source={SERVING_SOURCE!r} or "
+                         f"{DECODE_SOURCE!r}) found in "
                          + ", ".join(paths))
     reqs = requests_of(records)
     lats = sorted(r["latency_ms"] for r in reqs)
@@ -186,6 +233,12 @@ def report(paths, latency_ms, window_s, threshold, slow_n, as_json):
     if not causes and episodes:
         causes = ["latency_slo"]      # replay found burn the live
         #                               engine did not record
+    decode = decode_summary(decode_records, ttft_ms)
+    if decode and ttft_ms and decode["ttft"]["samples"] and \
+            decode["ttft"]["breach_fraction"] / 0.05 >= threshold \
+            and "ttft_slo" not in causes:
+        # p95 budget (5%) — same budget the live ttft objective burns
+        causes = sorted(set(causes) | {"ttft_slo"})
     breaches = sum(1 for l in lats if l > latency_ms)
     errors = sum(1 for r in records if "error" in r["serving"])
     out = {
@@ -205,6 +258,7 @@ def report(paths, latency_ms, window_s, threshold, slow_n, as_json):
                         breaches / len(lats), 4) if lats else 0.0},
         "burn_episodes": episodes,
         "peak_burn": max((b for _, b in timeline), default=0.0),
+        "decode": decode,
         "slowest": slowest,
         "incidents": {"transitions": serving_inc, "opened": len(opened),
                       "causes": causes},
@@ -236,6 +290,23 @@ def report(paths, latency_ms, window_s, threshold, slow_n, as_json):
                   f"({ep['requests']} requests)")
     else:
         print("  burn episodes: none")
+    if decode:
+        tt = decode["ttft"]
+        obj = (f" (objective {tt['objective_ms']:g} ms, "
+               f"{tt['breaches']} breaches "
+               f"{100 * tt['breach_fraction']:.1f}%)"
+               if tt["objective_ms"] else "")
+        rate = (f"{100 * decode['spec_accept_rate']:.1f}%"
+                if decode["spec_accept_rate"] is not None else "n/a")
+        print(f"  decode: {decode['tokens']} tokens over "
+              f"{decode['steps']} steps, "
+              f"{decode['tokens_per_s']:g} tok/s")
+        print(f"    ttft: p50 {tt['p50_ms']:g}  p95 {tt['p95_ms']:g} "
+              f"ms over {tt['samples']} requests{obj}")
+        print(f"    slots {decode['slot_occupancy_pct']:g}% occupied, "
+              f"KV pages {decode['page_utilization_pct']:g}% used, "
+              f"{decode['evictions']} evictions, "
+              f"spec accept {rate}")
     if serving_inc:
         print(f"  incidents (incidents.jsonl): {len(opened)} opened")
         for i in serving_inc:
@@ -274,11 +345,17 @@ def main(argv=None):
                         or 14.4))
     ap.add_argument("--slow", type=int, default=10,
                     help="slowest-request table size (default 10)")
+    ap.add_argument("--ttft-ms", type=float,
+                    default=float(os.environ.get("MXNET_SLO_TTFT_MS")
+                                  or 0.0) or None,
+                    help="decode TTFT objective (default: "
+                         "MXNET_SLO_TTFT_MS; off when unset)")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output")
     args = ap.parse_args(argv)
     report(args.paths, args.latency_ms, args.window_s,
-           args.burn_threshold, args.slow, args.json)
+           args.burn_threshold, args.slow, args.json,
+           ttft_ms=args.ttft_ms)
     return 0
 
 

@@ -327,6 +327,47 @@ def summarize(records):
             "eager_batches": sum(1 for b in srv if not b.get("compiled",
                                                              True)),
         }
+    # decode-plane deltas (serving/decode/ DecodeScheduler): per-step
+    # records carry a "decode" payload — tokens emitted, prefill
+    # volume, slot/page occupancy, speculative accept bookkeeping and
+    # any first-token latencies landed that step.  Section only renders
+    # for runs that decoded.
+    dc = [r["decode"] for r in records
+          if isinstance(r.get("decode"), dict)]
+    decode = None
+    if dc:
+        tokens = sum(d.get("tokens", 0) for d in dc)
+        prefill = sum(d.get("prefill_tokens", 0) for d in dc)
+        wall_ms = sum(d.get("step_ms", 0.0) for d in dc)
+        ttfts = sorted(t for d in dc for t in d.get("ttft_ms", []))
+        occ = [d["slots_active"] / d["max_slots"] for d in dc
+               if d.get("max_slots")]
+        pages = [d["pages_used"] / d["num_pages"] for d in dc
+                 if d.get("num_pages")]
+        # spec_proposed/accepted are cumulative on the record; the last
+        # record carries the run's totals
+        prop = dc[-1].get("spec_proposed", 0)
+        acc = dc[-1].get("spec_accepted", 0)
+        decode = {
+            "steps": len(dc),
+            "tokens": tokens,
+            "prefill_tokens": prefill,
+            "tokens_per_s": (tokens / (wall_ms / 1e3))
+            if wall_ms else 0.0,
+            "ttft_ms": {"p50": percentile(ttfts, 50),
+                        "p95": percentile(ttfts, 95),
+                        "n": len(ttfts)},
+            "slot_occupancy_pct": 100.0 * sum(occ) / len(occ)
+            if occ else 0.0,
+            "page_utilization_pct": 100.0 * sum(pages) / len(pages)
+            if pages else 0.0,
+            "completed": sum(d.get("completed", 0) for d in dc),
+            "evictions": sum(d.get("evictions", 0) for d in dc),
+            "compiles": sum(d.get("compiles", 0) for d in dc),
+            "spec_proposed": prop,
+            "spec_accepted": acc,
+            "spec_accept_rate": (acc / prop) if prop else None,
+        }
     return {
         "steps": len(records),
         "by_source": by_source,
@@ -344,6 +385,7 @@ def summarize(records):
         "peak_device_bytes": peak_mem,
         "input": input_stats,
         "serving": serving,
+        "decode": decode,
         "checkpoint": ckpt,
         "sharding": sharding,
         "kernel": kernel,
@@ -607,6 +649,31 @@ def render(s):
             f"{'rejects (shed+shape)':<28}{srv['rejects']:>24}",
             f"{'timeouts':<28}{srv['timeouts']:>24}",
             f"{'eager-fallback batches':<28}{srv['eager_batches']:>24}",
+        ]
+    dc = s.get("decode")
+    if dc:
+        rate = (f"{100.0 * dc['spec_accept_rate']:.1f}"
+                if dc["spec_accept_rate"] is not None else "n/a")
+        lines += [
+            "",
+            "Decode (continuous batching)",
+            "-" * 52,
+            f"{'scheduler steps':<28}{dc['steps']:>24}",
+            f"{'tokens generated':<28}{dc['tokens']:>24}",
+            f"{'prompt tokens prefilled':<28}{dc['prefill_tokens']:>24}",
+            f"{'tokens / s':<28}{dc['tokens_per_s']:>24.1f}",
+            f"{'ttft ms p50':<28}{dc['ttft_ms']['p50']:>24.3f}",
+            f"{'ttft ms p95':<28}{dc['ttft_ms']['p95']:>24.3f}",
+            f"{'slot occupancy %':<28}"
+            f"{dc['slot_occupancy_pct']:>24.1f}",
+            f"{'KV page utilization %':<28}"
+            f"{dc['page_utilization_pct']:>24.1f}",
+            f"{'requests completed':<28}{dc['completed']:>24}",
+            f"{'slots evicted':<28}{dc['evictions']:>24}",
+            f"{'steady-state compiles':<28}{dc['compiles']:>24}",
+            f"{'spec tokens proposed':<28}{dc['spec_proposed']:>24}",
+            f"{'spec tokens accepted':<28}{dc['spec_accepted']:>24}",
+            f"{'spec accept rate %':<28}{rate:>24}",
         ]
     return "\n".join(lines)
 
