@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Cold-start warmup bench: artifact-store replay vs full compilation.
+
+Two OS processes against one ``MXNET_ARTIFACT_DIR``:
+
+- **cold leg**: empty store — pays every XLA compile on the startup
+  critical path (serving bucket, decode executables, SPMD train step,
+  eager-op funnels) and commits the executables;
+- **warm leg**: same program, fresh process — every executable must
+  deserialize from the store.  The leg *asserts* ``compile.count == 0``
+  and ``DecodeEngine.compiles == 0`` before reporting, so a silent
+  cache miss fails the bench instead of skewing it.
+
+Each leg times its warmup-to-first-result window per plane (bucketed
+serving first batch, decode first generation, trainer first step) —
+imports and process spawn are excluded, matching what a restarted
+replica actually saves.  The gate is ``warm_wall <= max_ratio *
+cold_wall`` (default 0.2).
+
+Prints one JSON line per leg and a final summary:
+  {"cold_wall_s", "warm_wall_s", "ratio", "max_ratio",
+   "warm_compiles", "artifact_files", "pass"}
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as onp
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _leg(name: str) -> dict:
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.artifacts import store
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel.trainer import SPMDTrainer
+    from mxnet_tpu.serving import DecodeEngine, DecodeModel, \
+        DecodeScheduler, InferenceEngine
+
+    mx.random.seed(0)
+    onp.random.seed(0)
+    out: dict = {"leg": name}
+
+    # first-touch the backend outside the timed windows: platform init
+    # and the first dispatch cost the same in both legs and are not
+    # what a warm store saves
+    mx.nd.zeros((1,)).asnumpy()
+
+    # -- serving replica: bucketed engine, first batch ----------------
+    # weight init (eager PRNG ops, or a checkpoint load in production)
+    # costs the same cold and warm — the timed window is what the store
+    # changes: warmup-to-first-result
+    snet = nn.Sequential()
+    for _ in range(3):
+        snet.add(nn.Dense(64, in_units=64, activation="relu"))
+    snet.add(nn.Dense(16, in_units=64))
+    snet.initialize()
+    t0 = time.perf_counter()
+    eng = InferenceEngine(snet, example_shape=(64,), dtype="float32")
+    eng.warmup([4])
+    x = onp.random.RandomState(3).randn(4, 64).astype(onp.float32)
+    eng.infer_batch([x[i] for i in range(4)])
+    out["serving_s"] = time.perf_counter() - t0
+
+    # -- decode replica: paged KV engine, first generation ------------
+    model = DecodeModel(48, dim=64, n_heads=4, n_layers=3, seed=0)
+    prompts = [[int(t) for t in onp.random.RandomState(7).randint(
+        0, 48, size=6)] for _ in range(2)]
+    t0 = time.perf_counter()
+    deng = DecodeEngine(model, max_slots=4, num_pages=32, page_size=8)
+    deng.warmup(prefill_lengths=[len(p) for p in prompts])
+    sch = DecodeScheduler(deng, start=False)
+    futs = [sch.submit(p, max_new_tokens=4) for p in prompts]
+    while sch._has_work():
+        sch.step()
+    tokens = [f.result(0) for f in futs]
+    out["decode_s"] = time.perf_counter() - t0
+    out["decode_tokens"] = tokens
+    out["decode_compiles"] = deng.compiles
+
+    # -- restarted trainer: SPMD step ----------------------------------
+    net = nn.Sequential()
+    net.add(nn.Dense(32, in_units=16, activation="relu"))
+    net.add(nn.Dense(8, in_units=32))
+    net.initialize()
+    t0 = time.perf_counter()
+
+    class SqLoss:
+        __name__ = "sq"
+
+        def __call__(self, o, l):
+            return (o - l) ** 2
+
+    tr = SPMDTrainer(net, SqLoss(), optimizer="sgd",
+                     optimizer_params={"learning_rate": 0.1})
+    out["warm_start_loaded"] = tr.warm_start() if name == "warm" else 0
+    d = onp.random.RandomState(1).randn(8, 16).astype(onp.float32)
+    lbl = onp.random.RandomState(2).randn(8, 8).astype(onp.float32)
+    loss = tr.step(d, lbl)
+    out["trainer_loss"] = float(loss.asnumpy().mean())
+    out["trainer_s"] = time.perf_counter() - t0
+
+    out["wall_s"] = out["serving_s"] + out["decode_s"] + out["trainer_s"]
+    out["compile_count"] = telemetry.counter("compile.count").value
+    out["artifact"] = {k: v for k, v in store.stats().items()
+                      if k in ("hits", "misses", "saves", "files")}
+    if name == "warm":
+        assert out["compile_count"] == 0, \
+            f"warm leg compiled: {out['compile_count']}"
+        assert out["decode_compiles"] == 0, \
+            f"warm decode engine compiled: {out['decode_compiles']}"
+        assert out["warm_start_loaded"] >= 1, "warm_start loaded nothing"
+    return out
+
+
+def _run_leg(name: str, art_dir: str) -> dict:
+    env = dict(os.environ)
+    env["MXNET_ARTIFACT_DIR"] = art_dir
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--leg", name],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=560)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise SystemExit(f"{name} leg failed (rc={proc.returncode})")
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("LEG ")][-1]
+    rec = json.loads(line[len("LEG "):])
+    print(json.dumps(rec))
+    return rec
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--artifact-dir", default=None,
+                    help="store directory (default: fresh temp dir)")
+    ap.add_argument("--output-json", default=None)
+    ap.add_argument("--max-ratio", type=float, default=0.2,
+                    help="gate: warm wall must be <= this x cold wall")
+    ap.add_argument("--leg", choices=("cold", "warm"), default=None,
+                    help=argparse.SUPPRESS)  # internal: run one leg
+    args = ap.parse_args(argv)
+
+    if args.leg:
+        print("LEG " + json.dumps(_leg(args.leg)))
+        return 0
+
+    art = args.artifact_dir
+    if art is None:
+        import tempfile
+        art = tempfile.mkdtemp(prefix="mxart_bench_")
+    cold = _run_leg("cold", art)
+    if cold["compile_count"] == 0:
+        raise SystemExit("cold leg compiled nothing — stale artifact "
+                         "dir? point --artifact-dir at an empty one")
+    warm = _run_leg("warm", art)
+    for k in ("decode_tokens", "trainer_loss"):
+        if warm[k] != cold[k]:
+            raise SystemExit(f"cold/warm outputs diverge on {k}: "
+                             f"{cold[k]} vs {warm[k]}")
+    ratio = warm["wall_s"] / cold["wall_s"]
+    verdict = {
+        "cold_wall_s": round(cold["wall_s"], 3),
+        "warm_wall_s": round(warm["wall_s"], 3),
+        "ratio": round(ratio, 4),
+        "max_ratio": args.max_ratio,
+        "cold_compiles": cold["compile_count"],
+        "warm_compiles": warm["compile_count"],
+        "artifact_files": warm["artifact"]["files"],
+        "warm_artifact_hits": warm["artifact"]["hits"],
+        "pass": bool(ratio <= args.max_ratio),
+    }
+    print(json.dumps(verdict))
+    if args.output_json:
+        with open(args.output_json, "w") as f:
+            json.dump({"cold": cold, "warm": warm,
+                       "verdict": verdict}, f, indent=1)
+    return 0 if verdict["pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

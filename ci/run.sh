@@ -925,6 +925,23 @@ embedding_smoke() {   # sharded embedding tables: tests + DLRM bench gates
     rm -rf "$tmp"
 }
 
+warmup_smoke() {      # artifact store: tests + cold populate -> warm zero-compile
+    # tier-1 covers the store contract (round trip, corruption -> miss,
+    # stale key material, MAX_MB eviction), batched kernel-cache
+    # commits, the warm_loaded tick, and the cross-process
+    # zero-compile round trip with bitwise-identical outputs
+    JAX_PLATFORMS=cpu python -m pytest tests/test_artifacts.py -q
+    # then the two-process bench: the cold leg pays every compile into
+    # a fresh store, the warm leg (new process) must reach its first
+    # serving batch / decode generation / train step with
+    # compile.count == 0 AND within --max-ratio of the cold wall
+    local tmp; tmp="$(mktemp -d)"
+    JAX_PLATFORMS=cpu python benchmark/warmup_bench.py \
+        --artifact-dir "$tmp/store" --max-ratio 0.2 \
+        --output-json "$tmp/warmup_bench.json"
+    rm -rf "$tmp"
+}
+
 decode_smoke() {      # autoregressive decode: tests + continuous-batching gates
     # tier-1 covers page-allocator recycling/exhaustion, paged-attention
     # ragged parity vs the dense oracle, scheduler parity vs

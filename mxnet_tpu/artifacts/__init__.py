@@ -1,0 +1,18 @@
+"""Executable-artifact store: pay compile once per fleet, not per
+process.
+
+``store.py`` holds the content-addressed on-disk store of AOT-serialized
+XLA executables (``MXNET_ARTIFACT_DIR``); every compiled-executable
+cache in the stack — op funnel, cached whole-step, fused optimizer
+step, serving buckets, decode executables, SPMD trainer steps —
+consults it before compiling and commits into it after.  See
+docs/ARCHITECTURE.md "Executable artifact store".
+"""
+from .store import (Artifact, FORMAT, VERSION, SUFFIX,  # noqa: F401
+                    store_dir, enabled, max_bytes, env_fingerprint,
+                    artifact_key, artifact_path, save, load, load_all,
+                    stats)
+
+__all__ = ["Artifact", "FORMAT", "VERSION", "SUFFIX", "store_dir",
+           "enabled", "max_bytes", "env_fingerprint", "artifact_key",
+           "artifact_path", "save", "load", "load_all", "stats"]

@@ -1,0 +1,326 @@
+"""Content-addressed executable-artifact store (zero-compile cold start).
+
+One on-disk store (``MXNET_ARTIFACT_DIR``) unifying the five
+independently-grown compile caches behind a single pay-once protocol
+(PAPERS.md TVM 1802.04799; the whole-program AOT argument of
+1810.09868): op-funnel jit entries (``ops/registry._JitEntry``),
+whole-step captures (``imperative/cached_step``), fused optimizer-step
+families (``optimizer/fused_step``), serving buckets + decode
+executables (``serving/``), and SPMD trainer steps
+(``parallel/trainer``).  Values are REAL AOT-serialized executables
+(``jax.experimental.serialize_executable``) — a warm process
+deserializes and dispatches without ever invoking XLA.
+
+Key anatomy — artifacts strand by construction, they are never
+invalidated in place::
+
+    sha256(FORMAT | VERSION | kind | signature
+           | amp policy.cache_token() | jax/jaxlib versions
+           | backend | device count)
+
+``signature`` is the caller's content signature: the structure /
+shape-dtype key the in-process cache already uses (a serving bucket
+key, a cached-step structure key, a fused-step family+sig, an SPMD
+step sig) — anything whose ``repr`` is stable across processes.  A jax
+upgrade, an ``amp.init`` flip, a different backend, or a new device
+count each mint different hashes, so stale executables simply stop
+matching.
+
+Durability (the kernels/cache.py protocol, generalized): commits go
+tmp → flush → fsync → ``os.replace`` → dir fsync, so a crashed writer
+never publishes a torn artifact.  Loads treat ANY defect — missing
+file, bad pickle, header mismatch, ``deserialize_and_load`` raising on
+version skew — as a miss (ticking ``artifact.deserialize_failures``
+for real corruption/skew): the failure mode is recompiling, never
+crashing.
+
+Telemetry: ``artifact.{hits,misses,saves,bytes,load_ms,
+deserialize_failures}`` (eager in telemetry.py; per-step deltas ride
+the step record's ``artifact`` section).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from .. import telemetry
+
+__all__ = ["Artifact", "FORMAT", "VERSION", "SUFFIX", "store_dir",
+           "enabled", "max_bytes", "env_fingerprint", "artifact_key",
+           "artifact_path", "save", "load", "load_all", "stats"]
+
+FORMAT = "mxnet-tpu-artifact"
+VERSION = 1
+SUFFIX = ".mxart"
+
+_LOCK = threading.Lock()
+
+# store-health counters (created eagerly in telemetry.py so
+# profiler.counters() and the step-record deltas always see the keys)
+_C_HITS = telemetry.counter("artifact.hits")
+_C_MISSES = telemetry.counter("artifact.misses")
+_C_SAVES = telemetry.counter("artifact.saves")
+_C_BYTES = telemetry.counter("artifact.bytes")
+_C_LOAD_MS = telemetry.counter("artifact.load_ms")
+_C_DESER_FAIL = telemetry.counter("artifact.deserialize_failures")
+
+
+def store_dir() -> Optional[str]:
+    """The artifact directory, or None when the store is off.  Read
+    per call (like the kernel cache dir) so tests and long-lived
+    processes can flip it live."""
+    return os.environ.get("MXNET_ARTIFACT_DIR") or None
+
+
+def enabled() -> bool:
+    return store_dir() is not None
+
+
+def max_bytes() -> Optional[int]:
+    """MXNET_ARTIFACT_MAX_MB: total on-disk budget; oldest artifacts
+    (by mtime) are evicted past it.  None/unparseable → unbounded."""
+    raw = os.environ.get("MXNET_ARTIFACT_MAX_MB")
+    if not raw:
+        return None
+    try:
+        mb = float(raw)
+    except ValueError:
+        return None
+    return int(mb * 1048576) if mb > 0 else None
+
+
+def env_fingerprint() -> tuple:
+    """The platform part of every key: an executable serialized under
+    one jax/jaxlib/backend/device-count never loads under another."""
+    import jax
+    import jaxlib
+    return (jax.__version__, jaxlib.__version__,
+            jax.default_backend(), jax.device_count())
+
+
+def _key_material(kind: str, signature: Any) -> str:
+    from ..amp import policy as _amp_policy
+    return repr((FORMAT, VERSION, str(kind), signature,
+                 _amp_policy.cache_token(), env_fingerprint()))
+
+
+def artifact_key(kind: str, signature: Any) -> str:
+    """Content hash of (kind, signature, AMP token, platform)."""
+    return hashlib.sha256(_key_material(kind, signature).encode()).hexdigest()
+
+
+def artifact_path(kind: str, signature: Any) -> Optional[str]:
+    d = store_dir()
+    if d is None:
+        return None
+    return os.path.join(d, f"{kind}-{artifact_key(kind, signature)[:32]}"
+                           f"{SUFFIX}")
+
+
+class Artifact:
+    """One loaded artifact: the ready-to-dispatch executable plus the
+    side-channel metadata the save recorded (output treedefs, exec
+    keys, owner fingerprints — whatever the caller needs to re-install
+    the executable without re-tracing)."""
+
+    __slots__ = ("compiled", "meta", "kind", "nbytes")
+
+    def __init__(self, compiled, meta, kind, nbytes):
+        self.compiled = compiled
+        self.meta = meta
+        self.kind = kind
+        self.nbytes = nbytes
+
+
+def save(kind: str, signature: Any, compiled, meta: Optional[dict] = None,
+         ) -> bool:
+    """Serialize ``compiled`` (a ``jax.stages.Compiled``) and commit it
+    atomically under its content key.  Returns False — never raises —
+    when the store is off or the executable declines serialization
+    (some backends/executables can't round-trip; the in-process cache
+    still has it, nothing is lost)."""
+    d = store_dir()
+    if d is None:
+        return False
+    try:
+        from jax.experimental import serialize_executable as _se
+        payload, in_tree, out_tree = _se.serialize(compiled)
+        blob = pickle.dumps(
+            {"format": FORMAT, "version": VERSION, "kind": str(kind),
+             "key_material": _key_material(kind, signature),
+             "signature": signature, "meta": dict(meta or {}),
+             "payload": payload, "in_tree": in_tree, "out_tree": out_tree},
+            protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception:
+        return False
+    path = artifact_path(kind, signature)
+    try:
+        with _LOCK:
+            os.makedirs(d, exist_ok=True)
+            from ..checkpoint import _fsync_dir
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            _fsync_dir(d)
+            _evict_over_budget(d, keep=path)
+    except OSError:
+        return False
+    _C_SAVES.inc()
+    _C_BYTES.inc(len(blob))
+    return True
+
+
+def _evict_over_budget(d: str, keep: str) -> None:
+    """Drop oldest artifacts (by mtime) until the directory fits
+    MXNET_ARTIFACT_MAX_MB; the just-committed file is never evicted."""
+    cap = max_bytes()
+    if cap is None:
+        return
+    entries = []
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return
+    for name in names:
+        if not name.endswith(SUFFIX):
+            continue
+        p = os.path.join(d, name)
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        entries.append((st.st_mtime, st.st_size, p))
+    total = sum(sz for _, sz, _ in entries)
+    for _, sz, p in sorted(entries):
+        if total <= cap:
+            break
+        if p == keep:
+            continue
+        try:
+            os.remove(p)
+            total -= sz
+        except OSError:
+            pass
+
+
+def _read_doc(path: str) -> Optional[dict]:
+    """Unpickle + header-check one artifact file; None on any defect
+    (ticks ``artifact.deserialize_failures`` — a present-but-unusable
+    file is corruption/skew, not a plain miss)."""
+    try:
+        with open(path, "rb") as f:
+            doc = pickle.load(f)
+    except Exception:
+        _C_DESER_FAIL.inc()
+        return None
+    if not isinstance(doc, dict) or doc.get("format") != FORMAT \
+            or doc.get("version") != VERSION:
+        _C_DESER_FAIL.inc()
+        return None
+    return doc
+
+
+def _deserialize(doc: dict):
+    try:
+        from jax.experimental import serialize_executable as _se
+        return _se.deserialize_and_load(doc["payload"], doc["in_tree"],
+                                        doc["out_tree"])
+    except Exception:
+        _C_DESER_FAIL.inc()
+        return None
+
+
+def load(kind: str, signature: Any) -> Optional[Artifact]:
+    """The executable for (kind, signature) on this platform, or None
+    (store off / miss / corrupt / version skew — callers recompile)."""
+    path = artifact_path(kind, signature)
+    if path is None:
+        return None
+    t0 = time.perf_counter()
+    if not os.path.exists(path):
+        _C_MISSES.inc()
+        return None
+    doc = _read_doc(path)
+    if doc is None or doc.get("key_material") != _key_material(kind,
+                                                               signature):
+        _C_MISSES.inc()
+        return None
+    compiled = _deserialize(doc)
+    if compiled is None:
+        _C_MISSES.inc()
+        return None
+    _C_HITS.inc()
+    _C_LOAD_MS.inc((time.perf_counter() - t0) * 1e3)
+    try:
+        nbytes = os.path.getsize(path)
+    except OSError:
+        nbytes = 0
+    return Artifact(compiled, doc.get("meta") or {}, kind, nbytes)
+
+
+def load_all(kind: str) -> Iterator[Artifact]:
+    """Every loadable artifact of ``kind`` valid on this platform —
+    the one-call warmup drain (``SPMDTrainer.warm_start``,
+    ``DecodeEngine.warmup``).  Stale entries (other amp token / jax
+    version / backend) are silently skipped; corrupt ones tick
+    ``artifact.deserialize_failures``.  Hit/load_ms accounting matches
+    :func:`load`."""
+    d = store_dir()
+    if d is None:
+        return
+    try:
+        names = sorted(os.listdir(d))
+    except OSError:
+        return
+    prefix = f"{kind}-"
+    for name in names:
+        if not name.startswith(prefix) or not name.endswith(SUFFIX):
+            continue
+        t0 = time.perf_counter()
+        doc = _read_doc(os.path.join(d, name))
+        if doc is None or doc.get("kind") != kind:
+            continue
+        # validity: re-deriving the key material from the stored
+        # signature must reproduce what the writer recorded — a
+        # mismatch means the artifact was minted under a different
+        # amp token / jax version / topology and is stranded
+        if doc.get("key_material") != _key_material(kind,
+                                                    doc.get("signature")):
+            continue
+        compiled = _deserialize(doc)
+        if compiled is None:
+            continue
+        _C_HITS.inc()
+        _C_LOAD_MS.inc((time.perf_counter() - t0) * 1e3)
+        try:
+            nbytes = os.path.getsize(os.path.join(d, name))
+        except OSError:
+            nbytes = 0
+        yield Artifact(compiled, doc.get("meta") or {}, kind, nbytes)
+
+
+def stats() -> Dict[str, Any]:
+    """Snapshot of the store counters plus the on-disk census."""
+    out = {"hits": _C_HITS.value, "misses": _C_MISSES.value,
+           "saves": _C_SAVES.value, "bytes": _C_BYTES.value,
+           "load_ms": round(_C_LOAD_MS.value, 3),
+           "deserialize_failures": _C_DESER_FAIL.value,
+           "dir": store_dir(), "files": 0, "disk_bytes": 0}
+    d = store_dir()
+    if d is not None and os.path.isdir(d):
+        for name in os.listdir(d):
+            if name.endswith(SUFFIX):
+                out["files"] += 1
+                try:
+                    out["disk_bytes"] += os.path.getsize(
+                        os.path.join(d, name))
+                except OSError:
+                    pass
+    return out

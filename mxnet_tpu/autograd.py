@@ -394,7 +394,14 @@ def _get_jitted_bwd(rec: _OpRecord):
                 return None         # over budget: eager vjp, no latch
             seen.add(avals)
         bwd = _make_bwd(fn, len(rec.saved_inputs), rec.multi_out)
-        cached = _BWD_JIT[(fam, avals)] = (registry._JitEntry(bwd), bwd)
+        # artifact-store key: the forward partial's stable identity
+        # stands in for the fn object (which only ids this process)
+        akey = getattr(fn, "_mx_akey", None)
+        jakey = (("bwd", akey, len(rec.saved_inputs), bool(rec.multi_out),
+                  registry._env_numerics_key())
+                 if akey is not None else None)
+        cached = _BWD_JIT[(fam, avals)] = (registry._JitEntry(
+            bwd, akey=jakey), bwd)
     return cached
 
 

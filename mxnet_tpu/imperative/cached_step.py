@@ -202,6 +202,21 @@ class _State:
         self.last_reason: Optional[str] = None
 
 
+def _portable_key(stt):
+    """``stt.key`` with every in-process ``id(fn)`` swapped for the
+    partial's cross-process-stable identity (``_mx_akey``, stamped by
+    ops.registry.bound_fn) — the content signature the executable-
+    artifact store hashes.  None when any step's fn lacks a stable
+    identity (uncached partial, user fn): such a structure can't be
+    keyed portably, so it simply never persists."""
+    names = [getattr(s.fn, "_mx_akey", None) for s in stt.steps]
+    if any(n is None for n in names) or len(names) != len(stt.key[0]):
+        return None
+    steps = tuple((n,) + tuple(ks[1:])
+                  for n, ks in zip(names, stt.key[0]))
+    return (steps,) + tuple(stt.key[1:])
+
+
 def trainer_state(trainer) -> Dict[str, Any]:
     """Introspection helper (tests / debugging)."""
     state = getattr(trainer, "_cached_step_state", None)
@@ -1249,7 +1264,10 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
             amp_t = jax.device_put(amp_t, rep)
 
     fresh = ent.compiled is None
-    if fresh:
+    if not fresh:
+        _STATS["hits"] += 1
+        _C_HITS.inc()
+    else:
         # compile via AOT lower(): trace errors surface BEFORE any
         # buffer is donated, so falling back here is safe
         dyn0 = [opt._fused_dynamics(i) for i in stt.diff_idx]
@@ -1257,6 +1275,27 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
                           for nm in stt.dyn_names)
         if zero:
             dyn_probe = jax.device_put(dyn_probe, rep)
+        call_args = (dyn_probe, ext_t, frozen_t, weights_t, states_t)
+        if stt.amp is not None:
+            call_args = call_args + (amp_t,)
+        # executable-artifact store: a restarted trainer deserializes
+        # the whole-step executable instead of re-tracing — counts as a
+        # HIT (no record_compile, stats()["compiles"] stays 0)
+        from .. import artifacts
+        asig = None
+        if artifacts.enabled():
+            asig = _portable_key(stt)
+        if asig is not None:
+            leaves, treedef = jax.tree_util.tree_flatten(call_args)
+            asig = (asig, str(treedef),
+                    tuple((tuple(l.shape), str(l.dtype)) for l in leaves))
+            art = artifacts.load("cached_step", asig)
+            if art is not None:
+                ent.compiled = art.compiled
+                fresh = False
+                _STATS["hits"] += 1
+                _C_HITS.inc()
+    if fresh:
         t0 = _time.perf_counter()
         try:
             with tracing.span("compile.cached_step"):
@@ -1278,9 +1317,9 @@ def _execute(trainer, ctx, ignore_stale_grad) -> bool:
         telemetry.record_compile(_time.perf_counter() - t0, "cached_step")
         _STATS["compiles"] += 1
         _C_COMPILES.inc()
-    else:
-        _STATS["hits"] += 1
-        _C_HITS.inc()
+        if asig is not None:
+            from .. import artifacts
+            artifacts.save("cached_step", asig, ent.compiled)
 
     # side effects: bump counts first so lr schedules / Adam's t match
     # the eager path exactly (same discipline as fused_step.step)

@@ -104,7 +104,17 @@ def tune_registered(names: Optional[Sequence[str]] = None,
 
     Returns one row per (kernel, case, config) measurement, plus a
     ``winner`` row per case.
+
+    Winner commits across the whole sweep batch into a single
+    read-merge-replace cache write (``cache.batched_store``) instead of
+    paying one lock+reread+rewrite per winner.
     """
+    from . import cache as _cache
+    with _cache.batched_store():
+        return _tune_registered(names, warmup, runs, verbose)
+
+
+def _tune_registered(names, warmup, runs, verbose) -> List[dict]:
     all_rows: List[dict] = []
     for name in (list(names) if names else _kreg.list_kernels()):
         spec = _kreg.get_kernel(name)

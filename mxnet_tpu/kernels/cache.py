@@ -25,6 +25,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+from contextlib import contextmanager
 from typing import Dict, Optional
 
 FORMAT = "mxnet-tpu-kernel-cache"
@@ -32,6 +33,13 @@ VERSION = 1
 FILENAME = "kernel_cache.json"
 
 _LOCK = threading.Lock()
+
+# batched-commit buffer: inside a batched_store() block, store() calls
+# merge here instead of each paying a full lock+reread+rewrite cycle;
+# one read-merge-replace write lands on block exit (the opperf --tune
+# sweep commits N winners with ONE disk write)
+_PENDING: Dict[str, dict] = {}
+_BATCH_DEPTH = 0
 
 
 def cache_dir() -> Optional[str]:
@@ -75,8 +83,20 @@ def store(entries: Dict[str, dict]) -> bool:
     Read-merge-replace under a process lock: concurrent tuners in one
     process can't drop each other's commits, and the rename keeps a
     reader (or a crash) from ever observing a torn file.  Returns False
-    (memory-only) when no cache dir is configured.
+    (memory-only) when no cache dir is configured.  Inside a
+    :func:`batched_store` block the entries are buffered instead and
+    land in one write when the block exits.
     """
+    if cache_path() is None:
+        return False
+    with _LOCK:
+        if _BATCH_DEPTH > 0:
+            _PENDING.update(entries)
+            return True
+    return _write_merged(entries)
+
+
+def _write_merged(entries: Dict[str, dict]) -> bool:
     path = cache_path()
     if path is None:
         return False
@@ -94,3 +114,28 @@ def store(entries: Dict[str, dict]) -> bool:
         os.replace(tmp, path)
         _fsync_dir(os.path.dirname(path))
     return True
+
+
+@contextmanager
+def batched_store():
+    """Coalesce every :func:`store` call in the block into ONE
+    read-merge-replace write on exit.  A tune sweep over many cases
+    (``opperf --tune`` → ``autotune.tune_registered``) wraps itself in
+    this so each winner costs a dict update, not a full
+    lock+reread+rewrite of the cache file.  Re-entrant; the write
+    happens when the outermost block exits (even on error — measured
+    winners are never dropped)."""
+    global _BATCH_DEPTH
+    with _LOCK:
+        _BATCH_DEPTH += 1
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _BATCH_DEPTH -= 1
+            flush = dict(_PENDING) if (_BATCH_DEPTH == 0
+                                       and _PENDING) else None
+            if flush is not None:
+                _PENDING.clear()
+        if flush is not None:
+            _write_merged(flush)

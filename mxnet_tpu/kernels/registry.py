@@ -47,6 +47,7 @@ _C_MISSES = telemetry.counter("kernel.cache_misses")
 _C_TUNE_MS = telemetry.counter("kernel.tune_ms")
 _C_TUNE_RUNS = telemetry.counter("kernel.tune_measurements")
 _C_FALLBACKS = telemetry.counter("kernel.fallbacks")
+_C_WARM = telemetry.counter("kernel.warm_loaded")
 
 _LOCK = threading.Lock()
 
@@ -226,7 +227,10 @@ def warm_cache() -> int:
     """Prefetch every on-disk entry matching a registered kernel (at
     its current version) into the in-process memo — a serving replica's
     warmup calls this so its first request never waits on a cache-file
-    parse, let alone a tune.  Returns the number of entries loaded."""
+    parse, let alone a tune.  Returns the number of entries loaded and
+    ticks ``kernel.warm_loaded`` by it, so warmup callers
+    (serving.Engine.warmup, the decode engine) can log and assert the
+    prefetch instead of firing it blind."""
     n = 0
     with _LOCK:
         for key, entry in _disk_entries().items():
@@ -237,6 +241,8 @@ def warm_cache() -> int:
                 _MEMO[key] = (dict(entry["config"]), "disk")
                 _C_HITS.inc()
                 n += 1
+    if n:
+        _C_WARM.inc(n)
     return n
 
 

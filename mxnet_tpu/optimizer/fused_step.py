@@ -139,6 +139,24 @@ def _build(op_name: str, statics_key: Tuple, dyn_names: Tuple[str, ...],
                    donate_argnums=(1, 3) if donate_weights else (3,))
 
 
+def _aot_commit(entry, sig, family, jfn, call_args):
+    """AOT-compile a fresh family executable on its first concrete
+    arguments and commit it to the executable-artifact store (so a
+    restarted rank deserializes instead of recompiling).  Installs the
+    ``jax.stages.Compiled`` in place of the lazy jit wrapper — they are
+    call-compatible — and returns it; on any lowering/serialization
+    defect the lazy wrapper is returned untouched (the store is an
+    optimization, never a failure mode)."""
+    from .. import artifacts
+    try:
+        ex = jfn.lower(*call_args).compile()
+    except Exception:
+        return jfn
+    entry.jfns[sig] = ex
+    artifacts.save("fused_step", (family, sig), ex)
+    return ex
+
+
 # -- ZeRO-1 weight-update sharding (arxiv 2004.13336) ------------------------
 
 
@@ -549,13 +567,29 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
                      tuple((tuple(s.shape), str(s._data.dtype))
                            for s in sts))
                     for w, g, sts in zip(weights, grads, states))
+    from .. import artifacts
     jfn = entry.jfns.get(sig)
     fresh = jfn is None
-    if fresh:
+    aot_save = False
+    if not fresh:
+        _STATS["hits"] += 1
+    else:
         if len(entry.jfns) >= _reg._MAX_JIT_SIGS:
             entry.disabled = True
             _STATS["fallbacks"] += 1
             return False
+        # executable-artifact store: a restarted rank deserializes the
+        # family executable instead of building + compiling — a HIT
+        # (no record_compile; stats()["compiles"] stays 0).  The load
+        # needs no concrete arrays: (family, sig) IS the content key.
+        if artifacts.enabled():
+            art = artifacts.load("fused_step", (family, sig))
+            if art is not None:
+                jfn = art.compiled
+                entry.jfns[sig] = jfn
+                fresh = False
+                _STATS["hits"] += 1
+    if fresh:
         try:
             jfn = (_build_sharded(opt.op_name, statics_key, dyn_names,
                                   mesh) if zero else
@@ -567,8 +601,7 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
             _STATS["fallbacks"] += 1
             return False
         _STATS["compiles"] += 1
-    else:
-        _STATS["hits"] += 1
+        aot_save = artifacts.enabled()
 
     # side effects: bump counts first so _fused_dynamics sees this
     # step's t (Adam's bias-correction fold) and lr schedules see the
@@ -602,19 +635,23 @@ def _step_impl(updater, items: Sequence[Tuple[Any, Any, Any]],
                     (dyn,
                      tuple(w._data for w in weights),
                      tuple(g._data for g in grads)), rep)
-                out_w, out_s = jfn(
-                    dyn_t, w_t, g_t,
-                    tuple(tuple(s._data for s in updater.states[i])
-                          for i in indices))
+                st_t = tuple(tuple(s._data for s in updater.states[i])
+                             for i in indices)
+                if aot_save:
+                    jfn = _aot_commit(entry, sig, family, jfn,
+                                      (dyn_t, w_t, g_t, st_t))
+                out_w, out_s = jfn(dyn_t, w_t, g_t, st_t)
                 # back to the eager device so ops outside the step
                 # never see mesh-committed weights
                 out_w = jax.device_put(out_w, dev0)
             else:
-                out_w, out_s = jfn(
-                    dyn,
-                    tuple(w._data for w in weights),
-                    tuple(g._data for g in grads),
-                    tuple(tuple(s._data for s in sts) for sts in states))
+                w_t = tuple(w._data for w in weights)
+                g_t = tuple(g._data for g in grads)
+                st_t = tuple(tuple(s._data for s in sts) for sts in states)
+                if aot_save:
+                    jfn = _aot_commit(entry, sig, family, jfn,
+                                      (dyn, w_t, g_t, st_t))
+                out_w, out_s = jfn(dyn, w_t, g_t, st_t)
     except Exception:
         # donation means a failed execution may have consumed buffers on
         # some backends; latch off, but surface the error — the step is

@@ -445,6 +445,100 @@ class SPMDTrainer:
                          donate_argnums=donate)
         return jitted, cell
 
+    # -- executable-artifact store (zero-compile restart) ------------------
+    def _artifact_fp(self):
+        """Content fingerprint of everything a compiled step bakes in
+        beyond the (data, label) signature: model identity + parameter
+        spec (shapes/dtypes/shardings/mults), optimizer statics, mesh
+        geometry, and the trainer's compile-relevant knobs.  Part of
+        every ``spmd_step`` artifact key, so a different model, mesh or
+        optimizer can never replay this trainer's executables."""
+        opt = self.optimizer
+        try:
+            statics = tuple(sorted(opt.static_params(0).items()))
+        except Exception:
+            statics = ()
+        pspec = tuple(
+            (k, tuple(self._params[k].data().shape),
+             str(self._params[k].data().dtype),
+             repr(self._params[k]._sharding),
+             float(self._params[k].lr_mult),
+             float(self._params[k].wd_mult),
+             self._params[k].grad_req,
+             tuple((tuple(a.shape), str(a.dtype))
+                   for a in self._opt_state[k]))
+            for k in self._pkeys)
+        return (type(self.net).__name__,
+                getattr(self.loss_fn, "__name__",
+                        type(self.loss_fn).__name__),
+                pspec, type(opt).__name__, opt.op_name, statics,
+                repr(opt.clip_gradient),
+                bool(self._donate), self.batch_axis, self.seq_axis,
+                self.remat, self.micro_batches, self.zero_stage,
+                str(self.amp_dtype), self._data_transform is not None,
+                tuple(self.mesh.axis_names),
+                tuple(int(self.mesh.shape[a])
+                      for a in self.mesh.axis_names))
+
+    def _resolve_exec(self, sig, jitted, cell, args):
+        """First execution of a step signature: consult the executable-
+        artifact store.  Hit → deserialize (no compile recorded;
+        aux-param discovery re-runs as a compile-free abstract trace so
+        ``cell`` matches what a real trace would have found).  Miss
+        with the store on → AOT-compile here and commit.  Store off →
+        keep the lazy jit wrapper (it compiles at first call, as
+        before).  Returns ``(executable, compiled_now)``."""
+        from .. import artifacts
+        if not artifacts.enabled():
+            return jitted, True
+        asig = (self._artifact_fp(), sig)
+        art = artifacts.load("spmd_step", asig)
+        if art is not None:
+            try:
+                jitted.eval_shape(*args)    # trace-only: fills cell
+            except Exception:
+                pass
+            self._step_cache[sig] = (art.compiled, cell)
+            return art.compiled, False
+        try:
+            ex = jitted.lower(*args).compile()
+        except Exception:
+            # lowering declined (or AOT unsupported): the lazy wrapper
+            # still works, the store just stays cold for this signature
+            return jitted, True
+        artifacts.save("spmd_step", asig, ex,
+                       meta={"trainer_fp": repr(self._artifact_fp()),
+                             "sig": sig})
+        self._step_cache[sig] = (ex, cell)
+        return ex, True
+
+    def warm_start(self) -> int:
+        """Drain every compatible ``spmd_step`` artifact into the step
+        cache in ONE call, so a restarted trainer reaches its first
+        ``step()``/``run_steps()`` with ``compile.count == 0``.  Only
+        artifacts recorded under this trainer's exact fingerprint (and
+        the store's own amp/jax/backend key) install; everything else
+        is skipped silently.  Returns the number of executables
+        installed."""
+        from .. import artifacts
+        if not artifacts.enabled():
+            return 0
+        fp = repr(self._artifact_fp())
+        n = 0
+        for art in artifacts.load_all("spmd_step"):
+            sig = art.meta.get("sig")
+            if art.meta.get("trainer_fp") != fp or sig is None \
+                    or sig in self._step_cache:
+                continue
+            self._step_cache[sig] = (art.compiled, {"aux": []})
+            n += 1
+        if n:
+            from ..log import get_logger
+            get_logger("mxnet_tpu.parallel").info(
+                "warm_start: %d step executable(s) loaded from %s",
+                n, artifacts.store_dir())
+        return n
+
     def _window_sharding(self, ndim):
         """Sharding for a (n_steps, batch, ...) window: the leading
         step axis is replicated, batch/seq axes shift right by one."""
@@ -575,18 +669,22 @@ class SPMDTrainer:
                 wd = jnp.float32(self.optimizer.wd)
                 self.optimizer.num_update = self.num_update
                 p_arrays, opt_state = self._gather_state()
+                args = (next_key(), lr, wd, p_arrays, opt_state, d, l)
+                if self._amp_scaler is not None:
+                    args = args + (self._amp_state_in(),)
                 tc = time.perf_counter() if fresh else None
+                if fresh:
+                    jitted, compiled_now = self._resolve_exec(
+                        sig, jitted, cell, args)
+                    if not compiled_now:    # artifact hit: no compile
+                        tc, fresh = None, False
                 with tracing.span("compile.spmd_step" if fresh
                                   else "step.dispatch"):
                     if self._amp_scaler is not None:
-                        new_p, new_s, loss, aux, amp_out = jitted(
-                            next_key(), lr, wd, p_arrays, opt_state,
-                            d, l, self._amp_state_in())
+                        new_p, new_s, loss, aux, amp_out = jitted(*args)
                         self._amp_scaler.adopt_traced(*amp_out)
                     else:
-                        new_p, new_s, loss, aux = jitted(
-                            next_key(), lr, wd, p_arrays, opt_state,
-                            d, l)
+                        new_p, new_s, loss, aux = jitted(*args)
                     telemetry.record_dispatch()
                 if tc is not None:
                     telemetry.record_compile(time.perf_counter() - tc,
@@ -799,18 +897,22 @@ class SPMDTrainer:
                 self.num_update += int(n_steps)
                 self.optimizer.num_update = self.num_update
                 p_arrays, opt_state = self._gather_state()
+                args = (next_key(), lr, wd, p_arrays, opt_state, d, l)
+                if self._amp_scaler is not None:
+                    args = args + (self._amp_state_in(),)
                 tc = time.perf_counter() if fresh else None
+                if fresh:
+                    jitted, compiled_now = self._resolve_exec(
+                        sig, jitted, cell, args)
+                    if not compiled_now:    # artifact hit: no compile
+                        tc, fresh = None, False
                 with tracing.span("compile.spmd_step" if fresh
                                   else "step.dispatch"):
                     if self._amp_scaler is not None:
-                        new_p, new_s, losses, amp_out = jitted(
-                            next_key(), lr, wd, p_arrays, opt_state,
-                            d, l, self._amp_state_in())
+                        new_p, new_s, losses, amp_out = jitted(*args)
                         self._amp_scaler.adopt_traced(*amp_out)
                     else:
-                        new_p, new_s, losses = jitted(
-                            next_key(), lr, wd, p_arrays, opt_state,
-                            d, l)
+                        new_p, new_s, losses = jitted(*args)
                     # the whole fused window is ONE executable launch —
                     # the record's ``dispatches`` delta asserts it
                     telemetry.record_dispatch()

@@ -30,7 +30,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as onp
 
@@ -39,6 +39,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ... import telemetry
+from ...log import get_logger
 from ...ops.paged_attention import paged_attention
 from ...ops.rope import rope, rope_reference
 from .paged_kv import PagedKVCache
@@ -386,19 +387,118 @@ class DecodeEngine:
 
     # -- compiled-executable plumbing ---------------------------------------
 
-    def _call(self, key: str, fn, args):
+    @staticmethod
+    def _model_fp(mdl):
+        """Architecture fingerprint of one model for artifact keys —
+        everything the traced cores bake in besides the arg shapes."""
+        if mdl is None:
+            return None
+        return (mdl.vocab_size, mdl.dim, mdl.n_heads, mdl.n_layers,
+                mdl.head_dim, mdl.rope_base)
+
+    def _artifact_sig(self, key: str, args):
+        """Content signature of one decode executable: the exec key,
+        both model architectures, the engine's KV/spec geometry, and
+        the exact arg pytree (structure + leaf shapes/dtypes)."""
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        return (key, self._model_fp(self.model), self._model_fp(self.draft),
+                self.spec_k, self.max_slots, self.page_size, self.num_pages,
+                str(treedef),
+                tuple((tuple(jnp.shape(l)), str(jnp.result_type(l)))
+                      for l in leaves))
+
+    def _get_exec(self, key: str, fn, args):
+        """Load-or-compile one executable WITHOUT running it.  Order:
+        in-process memo → artifact store (deserialize; ``compiles``
+        stays 0) → jit compile (ticks ``compiles``, commits back)."""
         ex = self._exec.get(key)
-        if ex is None:
-            donate = ((1,) if jax.default_backend() == "tpu" else ())
-            t0 = time.perf_counter()
-            ex = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
-            telemetry.record_compile(time.perf_counter() - t0, "decode")
-            self._exec[key] = ex
-            self.compiles += 1
-        return ex(*args)
+        if ex is not None:
+            return ex
+        from ... import artifacts
+        asig = self._artifact_sig(key, args)
+        art = artifacts.load("decode_exec", asig)
+        if art is not None:
+            self._exec[key] = art.compiled
+            return art.compiled
+        donate = ((1,) if jax.default_backend() == "tpu" else ())
+        t0 = time.perf_counter()
+        ex = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+        telemetry.record_compile(time.perf_counter() - t0, "decode")
+        self._exec[key] = ex
+        self.compiles += 1
+        artifacts.save("decode_exec", asig, ex, meta={"exec_key": key})
+        return ex
+
+    def _call(self, key: str, fn, args):
+        return self._get_exec(key, fn, args)(*args)
 
     def _tables(self, cache) -> jnp.ndarray:
         return jnp.asarray(cache.tables, jnp.int32)
+
+    def warmup(self, prefill_lengths: Sequence[int] = (1,)) -> List[str]:
+        """Materialize every executable this engine will dispatch —
+        decode (+ draft/verify under speculation) and one prefill per
+        bucket covering ``prefill_lengths`` — WITHOUT running any of
+        them.  Against a populated artifact store each one deserializes
+        (``compiles`` stays 0); otherwise this pays the compiles ahead
+        of traffic.  Also prefetches the kernel-autotune cache.
+        Returns the exec keys materialized."""
+        from ... import kernels
+        n_kern = kernels.warm_cache()
+        if n_kern:
+            get_logger("mxnet_tpu.serving.decode").info(
+                "warmup: %d tuned kernel config(s) preloaded", n_kern)
+        mdl, keys = self.model, []
+        s = self.max_slots
+        tok = jnp.zeros((s,), jnp.int32)
+        pos = jnp.zeros((s,), jnp.int32)
+        act = jnp.zeros((s,), bool)
+        self._get_exec(
+            "decode",
+            lambda p, kv, t, po, tb, a:
+            _decode_core(mdl, p, kv, t, po, tb, a),
+            (mdl.params, self.cache.pool, tok, pos,
+             self._tables(self.cache), act))
+        keys.append("decode")
+        if self.spec_enabled:
+            dm, k = self.draft, self.spec_k
+            self._get_exec(
+                "draft",
+                lambda p, kv, t, po, tb, a:
+                _draft_core(dm, p, kv, t, po, tb, a, k),
+                (dm.params, self.draft_cache.pool, tok, pos,
+                 self._tables(self.draft_cache), act))
+            window = jnp.zeros((s, k + 1), jnp.int32)
+            self._get_exec(
+                "verify",
+                lambda p, kv, t, po, tb, a:
+                _verify_core(mdl, p, kv, t, po, tb, a),
+                (mdl.params, self.cache.pool, window, pos,
+                 self._tables(self.cache), act))
+            keys += ["draft", "verify"]
+        for bucket in sorted({self.prefill_bucket(int(n))
+                              for n in prefill_lengths}):
+            padded = jnp.zeros((bucket,), jnp.int32)
+            start = jnp.asarray(0, jnp.int32)
+            clen = jnp.asarray(1, jnp.int32)
+            row = jnp.asarray(self.cache.tables[0], jnp.int32)
+            self._get_exec(
+                f"prefill_b{bucket}",
+                lambda p, kv, t, st, cl, tb:
+                _prefill_core(mdl, p, kv, t, st, cl, tb),
+                (mdl.params, self.cache.pool, padded, start, clen, row))
+            keys.append(f"prefill_b{bucket}")
+            if self.draft_cache is not None:
+                dm = self.draft
+                drow = jnp.asarray(self.draft_cache.tables[0], jnp.int32)
+                self._get_exec(
+                    f"draft_prefill_b{bucket}",
+                    lambda p, kv, t, st, cl, tb:
+                    _prefill_core(dm, p, kv, t, st, cl, tb),
+                    (dm.params, self.draft_cache.pool, padded, start,
+                     clen, drow))
+                keys.append(f"draft_prefill_b{bucket}")
+        return keys
 
     # -- device steps --------------------------------------------------------
 

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 from .. import autograd as ag
 from .. import profiler, telemetry, tracing
 from ..base import MXNetError, getenv
+from ..log import get_logger
 from ..gluon.block import (_ExportedBlock, _TraceContext, _trace_scope,
                            _walk_blocks)
 from ..ndarray import NDArray
@@ -297,16 +298,44 @@ class InferenceEngine:
             p._check_initialized()
         self._init_done = True
 
+    def _artifact_sig(self, key):
+        """Content signature of one bucket executable for the artifact
+        store: the bucket key plus everything the traced forward bakes
+        in that the store's own key material doesn't already carry —
+        model identity (name/class), the parameter spec in call order,
+        and the engine's padding config.  Stable across processes for
+        the same model construction."""
+        params = self._block.collect_params()
+        return (self._name, type(self._block).__name__,
+                tuple((k, tuple(p.data().shape), str(p.data().dtype))
+                      for k, p in params.items()),
+                key, tuple(self._seq_axes))
+
     def _compile(self, key, batched_shape, dtype):
         """Trace + AOT-compile the inference forward for one bucket:
         a pure function of (rng key, *params, input) lowered and
         compiled ahead of execution (donation-free — serving never owns
-        its inputs).  Returns the cache entry, or None when this bucket
-        latched eager (trace/compile failure)."""
+        its inputs).  Consults the executable-artifact store first — a
+        warm replica deserializes the bucket (zero compiles, output
+        treedef restored from the artifact metadata since no trace
+        runs) — and commits every fresh compile back.  Returns the
+        cache entry, or None when this bucket latched eager
+        (trace/compile failure)."""
+        from .. import artifacts
         block = self._block
         params = block.collect_params()
         pvals = list(params.values())
         cell: Dict[str, Any] = {"n_out": None, "treedef": None}
+        asig = self._artifact_sig(key)
+        art = artifacts.load("serving_bucket", asig)
+        if art is not None:
+            # warm replica: the executable deserializes instead of
+            # compiling — no trace runs, so the output structure comes
+            # from the artifact's metadata, and neither record_compile
+            # nor the bucket compile counter ticks (compiles stays 0)
+            cell["n_out"] = art.meta["n_out"]
+            cell["treedef"] = art.meta["treedef"]
+            return self._make_runner(art.compiled, params, pvals), cell
 
         def traced(rkey, *arrays):
             p_arr = arrays[:len(pvals)]
@@ -331,7 +360,10 @@ class InferenceEngine:
                 for p, s in zip(pvals, saved):
                     p._data = s
 
-        rkey = _rng.next_key()
+        # current_key(): only the key's shape/dtype matter for the spec,
+        # and peeking keeps the host PRNG stream identical whether this
+        # bucket compiled fresh or deserialized from the artifact store
+        rkey = _rng.current_key()
         specs = [jax.ShapeDtypeStruct(rkey.shape, rkey.dtype)]
         specs += [jax.ShapeDtypeStruct(p.data().shape,
                                        jnp.dtype(str(p.data().dtype)))
@@ -358,6 +390,17 @@ class InferenceEngine:
         telemetry.record_compile(_time.perf_counter() - t0, "serving")
         telemetry.counter(
             f"serving.bucket.{self._bucket_tag(key)}.compiles").inc()
+        artifacts.save("serving_bucket", asig, compiled,
+                       meta={"n_out": cell["n_out"],
+                             "treedef": cell["treedef"],
+                             "bucket": self._bucket_tag(key)})
+        return self._make_runner(compiled, params, pvals), cell
+
+    @staticmethod
+    def _make_runner(compiled, params, pvals):
+        """Dispatch closure over one bucket executable — shared by the
+        fresh-compile and artifact-deserialize paths, which produce
+        call-compatible executables."""
         n_params = len(pvals)
 
         def runner(batched_nd: NDArray):
@@ -368,7 +411,7 @@ class InferenceEngine:
             return apply_jax(lambda *arr: compiled(*arr), arrays,
                              multi_out=True, record=False)
 
-        return runner, cell
+        return runner
 
     def warmup(self, specs: Sequence) -> List[str]:
         """AOT-compile buckets ahead of traffic.  ``specs`` entries are
@@ -380,7 +423,10 @@ class InferenceEngine:
         # its tuned config from the in-process memo instead of parsing
         # the cache file (or worse, measuring) inside a compile
         from .. import kernels
-        kernels.warm_cache()
+        n_kern = kernels.warm_cache()
+        if n_kern:
+            get_logger("mxnet_tpu.serving").info(
+                "warmup: %d tuned kernel config(s) preloaded", n_kern)
         tags = []
         for spec in specs:
             dtype = self._dtype

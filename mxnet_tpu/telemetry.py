@@ -326,6 +326,23 @@ _C_KRN_MISSES = counter("kernel.cache_misses")
 _C_KRN_TUNE_MS = counter("kernel.tune_ms")
 _C_KRN_TUNE_RUNS = counter("kernel.tune_measurements")
 _C_KRN_FALLBACKS = counter("kernel.fallbacks")
+# tuned winners prefetched into the in-process memo by a warmup call
+# (kernels/registry.warm_cache) — a warm replica shows this > 0 with
+# tune_ms staying 0
+_C_KRN_WARM = counter("kernel.warm_loaded")
+# executable-artifact store health (mxnet_tpu/artifacts/ writes these):
+# AOT-serialized executables loaded instead of compiled (hits), lookups
+# that fell through to a compile (misses), executables committed
+# (saves) and their serialized payload bytes, wall ms spent
+# deserializing, and present-but-unusable artifacts — corruption or
+# jax-version skew — that fell back to recompile (the never-crash
+# contract of the load path)
+_C_ART_HITS = counter("artifact.hits")
+_C_ART_MISSES = counter("artifact.misses")
+_C_ART_SAVES = counter("artifact.saves")
+_C_ART_BYTES = counter("artifact.bytes")
+_C_ART_LOAD_MS = counter("artifact.load_ms")
+_C_ART_DESER_FAIL = counter("artifact.deserialize_failures")
 # sharded embedding-table subsystem health (mxnet_tpu/embedding/ writes
 # these): table rows that actually traveled on the sparse pull/push
 # wire, their payload bytes vs the dense-push equivalent (the full
@@ -690,7 +707,9 @@ class _StepToken:
                  "ckpt_bytes", "ckpt_gc", "ckpt_vpass", "ckpt_vfail",
                  "rs_bytes", "ag_bytes", "ar_bytes", "barrier_ms",
                  "krn_hits", "krn_misses", "krn_tune_ms", "krn_tune_runs",
-                 "krn_fallbacks", "emb_pull", "emb_push", "emb_sbytes",
+                 "krn_fallbacks", "art_hits", "art_misses", "art_saves",
+                 "art_bytes", "art_load_ms", "art_deser",
+                 "emb_pull", "emb_push", "emb_sbytes",
                  "emb_dbytes", "emb_hits", "emb_misses", "emb_evicts",
                  "emb_spills", "amp_overflows", "amp_skipped", "buckets",
                  "axis_bytes", "moe_dropped")
@@ -721,6 +740,12 @@ class _StepToken:
         self.krn_tune_ms = _C_KRN_TUNE_MS.value
         self.krn_tune_runs = _C_KRN_TUNE_RUNS.value
         self.krn_fallbacks = _C_KRN_FALLBACKS.value
+        self.art_hits = _C_ART_HITS.value
+        self.art_misses = _C_ART_MISSES.value
+        self.art_saves = _C_ART_SAVES.value
+        self.art_bytes = _C_ART_BYTES.value
+        self.art_load_ms = _C_ART_LOAD_MS.value
+        self.art_deser = _C_ART_DESER_FAIL.value
         self.emb_pull = _C_EMB_PULL_ROWS.value
         self.emb_push = _C_EMB_PUSH_ROWS.value
         self.emb_sbytes = _C_EMB_SPARSE_BYTES.value
@@ -901,6 +926,23 @@ def end_step(token, source: str, extra: Optional[dict] = None) -> None:
             "tune_measurements": (_C_KRN_TUNE_RUNS.value
                                   - token.krn_tune_runs),
             "fallbacks": _C_KRN_FALLBACKS.value - token.krn_fallbacks,
+        },
+        # executable-artifact store activity in this step's window:
+        # compiles avoided by loading a serialized executable (hits),
+        # lookups that fell through to a compile (misses), executables
+        # committed (saves/bytes), deserialize wall ms, and artifacts
+        # that were present but unusable (corruption / version skew).
+        # A warm-started process shows hits > 0 with the record's
+        # "compiles" field staying 0 — the store's acceptance signal.
+        "artifact": {
+            "hits": _C_ART_HITS.value - token.art_hits,
+            "misses": _C_ART_MISSES.value - token.art_misses,
+            "saves": _C_ART_SAVES.value - token.art_saves,
+            "bytes": _C_ART_BYTES.value - token.art_bytes,
+            "load_ms": round(
+                _C_ART_LOAD_MS.value - token.art_load_ms, 3),
+            "deserialize_failures": (_C_ART_DESER_FAIL.value
+                                     - token.art_deser),
         },
         # sharded embedding-table activity in this step's window: rows
         # on the sparse wire, sparse vs dense-equivalent payload bytes

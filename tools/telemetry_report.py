@@ -252,6 +252,23 @@ def summarize(records):
             "tune_stall_steps": sum(1 for c in kn
                                     if c.get("tune_ms", 0.0) > 0),
         }
+    # executable-artifact store deltas (mxnet_tpu/artifacts/): warm
+    # deserializations vs misses, bytes committed, and deserialize
+    # failures (corruption / version skew).  Section only renders for
+    # runs whose records carry artifact signal.
+    ar = [r["artifact"] for r in records
+          if isinstance(r.get("artifact"), dict)]
+    artifact = None
+    if any(any(c.values()) for c in ar):
+        artifact = {
+            "hits": sum(c.get("hits", 0) for c in ar),
+            "misses": sum(c.get("misses", 0) for c in ar),
+            "saves": sum(c.get("saves", 0) for c in ar),
+            "bytes": sum(c.get("bytes", 0) for c in ar),
+            "load_ms": sum(c.get("load_ms", 0.0) for c in ar),
+            "deserialize_failures": sum(
+                c.get("deserialize_failures", 0) for c in ar),
+        }
     # sharded-embedding deltas (mxnet_tpu/embedding/): rows moved on the
     # sparse wire per step, sparse payload vs its dense-push equivalent
     # (the wire-compression win), and lookup-cache health.  Section only
@@ -389,6 +406,7 @@ def summarize(records):
         "checkpoint": ckpt,
         "sharding": sharding,
         "kernel": kernel,
+        "artifact": artifact,
         "embedding": embedding,
         "amp": amp,
     }
@@ -589,6 +607,20 @@ def render(s):
             f"{'tune measurements':<28}{kn['tune_measurements']:>24}",
             f"{'steps stalled by tune':<28}{kn['tune_stall_steps']:>24}",
             f"{'XLA fallbacks':<28}{kn['fallbacks']:>24}",
+        ]
+    ar = s.get("artifact")
+    if ar:
+        lines += [
+            "",
+            "Executable artifacts (AOT store)",
+            "-" * 52,
+            f"{'store hits':<28}{ar['hits']:>24}",
+            f"{'store misses':<28}{ar['misses']:>24}",
+            f"{'executables saved':<28}{ar['saves']:>24}",
+            f"{'bytes committed':<28}{ar['bytes']:>24}",
+            f"{'deserialize wall ms':<28}{ar['load_ms']:>24.3f}",
+            f"{'deserialize failures':<28}"
+            f"{ar['deserialize_failures']:>24}",
         ]
     em = s.get("embedding")
     if em:
